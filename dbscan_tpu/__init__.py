@@ -1,0 +1,35 @@
+"""tpu-dbscan: a TPU-native distributed DBSCAN framework on JAX/XLA/Pallas/pjit.
+
+A ground-up rebuild of the capabilities of ningchungui/dbscan-on-spark
+(distributed 2-D DBSCAN via spatial domain decomposition with eps-halo
+replication; reference layer map in /root/repo/SURVEY.md), re-designed
+TPU-first:
+
+- the per-partition O(n^2) BFS engine (reference LocalDBSCANNaive.scala:37-118)
+  becomes a tiled pairwise-distance + min-label-propagation kernel that runs on
+  the MXU/VPU under `jit` / Pallas;
+- the Spark shuffle/broadcast fan-out (reference DBSCAN.scala:126-173) becomes
+  `shard_map` over a `jax.sharding.Mesh`;
+- the driver-side cluster-alias merge (reference DBSCAN.scala:179-228,
+  DBSCANGraph.scala) becomes a host-side union-find over doubly-labeled halo
+  points.
+
+Public API mirrors the reference surface (DBSCAN.train -> model with
+labeled_points / partitions / predict) while staying idiomatic JAX.
+"""
+
+from dbscan_tpu.config import DBSCANConfig, Engine, Precision
+from dbscan_tpu.ops.labels import CORE, BORDER, NOISE, NOT_FLAGGED, UNKNOWN
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DBSCANConfig",
+    "Engine",
+    "Precision",
+    "CORE",
+    "BORDER",
+    "NOISE",
+    "NOT_FLAGGED",
+    "UNKNOWN",
+]

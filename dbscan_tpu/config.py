@@ -1,0 +1,114 @@
+"""Configuration for tpu-dbscan.
+
+The reference has no config system at all — three positional hyperparameters
+(reference DBSCAN.scala:40-44) and hardcoded sample paths
+(DBSCANSample.scala:18,35). We fix that with one explicit dataclass that every
+entry point takes, covering the algorithm knobs plus the TPU-execution knobs
+that have no Spark counterpart (bucketing, precision, mesh shape).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Tuple
+
+
+class Engine(str, enum.Enum):
+    """Which local-engine semantics to emulate.
+
+    The reference ships two engines whose border-adoption semantics diverge
+    (SURVEY.md section 3.2/3.3):
+
+    - ``NAIVE``: reference LocalDBSCANNaive.scala:80-118 — a point visited as
+      noise before any cluster expansion reaches it is NEVER adopted as Border
+      (dead re-labeling code at :108-111). This is what the distributed driver
+      actually runs (DBSCAN.scala:154).
+    - ``ARCHERY``: reference LocalDBSCANArchery.scala:71-112 — textbook DBSCAN;
+      visited noise points ARE adopted as Border (:103-106).
+
+    Both reduce to vectorizable rules on TPU: with connected-component labels
+    equal to the minimum core-point row index ("seed index"), a non-core point
+    with a core neighbor is Border-with-cluster = min adjacent seed (both
+    engines agree on the cluster), and under NAIVE it additionally requires
+    that min adjacent seed < its own row index (else it stays Noise).
+    """
+
+    NAIVE = "naive"
+    ARCHERY = "archery"
+
+
+class Precision(str, enum.Enum):
+    """Compute dtype for the distance kernel.
+
+    The reference computes squared distances in float64 on the JVM
+    (DBSCANPoint.scala:26-30). TPUs natively prefer f32/bf16; eps-boundary
+    decisions (d^2 <= eps^2) can flip under f32, so parity runs use F64 (CPU
+    or x64 mode) while throughput runs use F32.
+    """
+
+    F32 = "f32"
+    F64 = "f64"
+    BF16 = "bf16"
+
+
+@dataclasses.dataclass(frozen=True)
+class DBSCANConfig:
+    """All knobs for a distributed DBSCAN run.
+
+    Attributes:
+      eps: max distance between two points to be in the same eps-neighborhood
+        (reference DBSCAN.scala:41-43).
+      min_points: minimum neighborhood size (self-inclusive, matching
+        LocalDBSCANNaive.scala:72-78 where the query point is its own
+        neighbor) to be a core point.
+      max_points_per_partition: best-effort upper bound on points per spatial
+        partition (reference DBSCAN.scala:53-56).
+      engine: local-engine semantics, see :class:`Engine`.
+      precision: distance-kernel dtype, see :class:`Precision`.
+      metric: distance metric name registered in dbscan_tpu.ops.distance
+        ("euclidean", "haversine", "cosine"). The reference supports only
+        2-D Euclidean (DBSCANPoint.scala:26-30); extra metrics per
+        BASELINE.json configs.
+      bucket_multiple: partition buffers are padded to a multiple of this
+        (sublane*lane friendly) to bound recompilation across runs.
+      max_partitions_hint: optional cap used when padding the partition axis
+        for the device mesh.
+      use_pallas: route the per-partition kernel through the Pallas tiled
+        implementation instead of plain XLA ops.
+    """
+
+    eps: float
+    min_points: int
+    max_points_per_partition: int = 250
+    engine: Engine = Engine.NAIVE
+    precision: Precision = Precision.F32
+    metric: str = "euclidean"
+    bucket_multiple: int = 128
+    max_partitions_hint: Optional[int] = None
+    use_pallas: bool = False
+
+    @property
+    def eps_sq(self) -> float:
+        return float(self.eps) * float(self.eps)
+
+    @property
+    def minimum_rectangle_size(self) -> float:
+        """Grid cell size = 2*eps (reference DBSCAN.scala:289)."""
+        return 2.0 * float(self.eps)
+
+    def validate(self) -> "DBSCANConfig":
+        if not self.eps > 0:
+            raise ValueError(f"eps must be > 0, got {self.eps}")
+        if self.min_points < 1:
+            raise ValueError(f"min_points must be >= 1, got {self.min_points}")
+        if self.max_points_per_partition < 1:
+            raise ValueError(
+                "max_points_per_partition must be >= 1, got "
+                f"{self.max_points_per_partition}"
+            )
+        if self.bucket_multiple < 1:
+            raise ValueError(
+                f"bucket_multiple must be >= 1, got {self.bucket_multiple}"
+            )
+        return self

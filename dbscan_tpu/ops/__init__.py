@@ -1,0 +1,1 @@
+"""Device-side ops: geometry, distances, the local DBSCAN kernel."""

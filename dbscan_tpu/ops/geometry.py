@@ -1,0 +1,193 @@
+"""Geometry primitives: axis-aligned rectangles + 2eps grid snapping.
+
+TPU-native reformulation of the reference's geometry layer
+(DBSCANRectangle.scala:23-54, DBSCANPoint.scala:21-31, and the grid-snapping
+helpers DBSCAN.scala:345-356): rectangles are ``[..., 4]`` float arrays
+``(x, y, x2, y2)`` (bottom-left, top-right) and every predicate is vectorized
+over arbitrary batches of rectangles and ``[..., 2]`` point arrays, so the same
+code runs on host numpy and under ``jit`` on device. No scalar objects, no
+Python loops.
+
+Semantics preserved exactly:
+- ``contains_point`` is INCLUSIVE on all edges (DBSCANRectangle.scala:35-37);
+- ``almost_contains`` is STRICT interior (:50-52);
+- ``contains_rect`` is inclusive (:28-30);
+- ``shrink(amount)`` moves every edge inward by ``amount`` (negative grows,
+  :42-44);
+- grid snapping maps a coordinate to the lower-left corner of its 2eps cell
+  with the reference's negative-shift quirk (``shiftIfNegative`` DBSCAN.scala
+  :352-356: negative coordinates are shifted down one full cell BEFORE the
+  integer truncation, which both fixes truncation-toward-zero AND displaces
+  cells of exact-multiple negative coordinates — we reproduce it bit-for-bit
+  since partition layout depends on it).
+
+The inclusive/strict split is load-bearing for the distributed merge: a point
+with ``main.contains && !inner.almost_contains`` is a merge candidate
+(DBSCAN.scala:167), and ``inner.almost_contains`` decides inner-point
+membership (:304-315).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Rectangle component indices.
+X, Y, X2, Y2 = 0, 1, 2, 3
+
+
+def rect(x, y, x2, y2, dtype=np.float64):
+    """Build a [4] rectangle array (host-side convenience)."""
+    return np.array([x, y, x2, y2], dtype=dtype)
+
+
+def contains_rect(outer, inner):
+    """Inclusive rect-in-rect containment (DBSCANRectangle.scala:28-30).
+
+    outer: [..., 4], inner: [..., 4] (broadcastable). Returns bool [...].
+    """
+    return (
+        (outer[..., X] <= inner[..., X])
+        & (inner[..., X2] <= outer[..., X2])
+        & (outer[..., Y] <= inner[..., Y])
+        & (inner[..., Y2] <= outer[..., Y2])
+    )
+
+
+def contains_point(r, pts):
+    """Inclusive point containment (DBSCANRectangle.scala:35-37).
+
+    r: [..., 4], pts: [..., 2] (broadcastable leading dims). Returns bool.
+    """
+    px, py = pts[..., 0], pts[..., 1]
+    return (
+        (r[..., X] <= px)
+        & (px <= r[..., X2])
+        & (r[..., Y] <= py)
+        & (py <= r[..., Y2])
+    )
+
+
+def almost_contains(r, pts):
+    """Strict-interior containment (DBSCANRectangle.scala:50-52)."""
+    px, py = pts[..., 0], pts[..., 1]
+    return (
+        (r[..., X] < px)
+        & (px < r[..., X2])
+        & (r[..., Y] < py)
+        & (py < r[..., Y2])
+    )
+
+
+def shrink(r, amount):
+    """Shrink every edge inward by `amount`; negative grows
+    (DBSCANRectangle.scala:42-44). Works on [..., 4] stacks."""
+    offs = np.asarray([amount, amount, -amount, -amount], dtype=np.float64)
+    return np.asarray(r, dtype=np.float64) + offs
+
+
+def snap_corner(coords, cell_size):
+    """Snap coordinates to their cell's lower-left corner on a `cell_size` grid.
+
+    Bit-for-bit port of corner/shiftIfNegative (DBSCAN.scala:352-356):
+    ``corner(p) = intValue(shift(p) / cell) * cell`` where ``shift(p)`` is
+    ``p - cell`` for p < 0 else p, and intValue truncates toward zero. Note
+    the quirk: a negative exact multiple (p = -k*cell) lands in the cell BELOW
+    itself; we reproduce that because the reference's partition layout (and
+    its fixtures) depend on it.
+    """
+    coords = np.asarray(coords, dtype=np.float64)
+    shifted = np.where(coords < 0, coords - cell_size, coords)
+    return np.trunc(shifted / cell_size) * cell_size
+
+
+def cell_index(points, cell_size):
+    """Map [N, 2] points to integer grid-cell indices [N, 2] (int64).
+
+    Same cell assignment as corner/shiftIfNegative (DBSCAN.scala:352-356) but
+    returning the integer index instead of the float corner: all downstream
+    partitioning runs in exact integer arithmetic so no cell can be lost to
+    floating-point drift between accumulated cut positions and trunc-derived
+    corners (a real hazard in the reference's all-double formulation — see
+    tests/test_partitioner.py::test_no_points_lost_to_fp_drift).
+    The float corner is recovered exactly as ``index * cell_size``.
+    """
+    points = np.asarray(points, dtype=np.float64)[..., :2]
+    shifted = np.where(points < 0, points - cell_size, points)
+    return np.trunc(shifted / cell_size).astype(np.int64)
+
+
+def cell_histogram_int(points, cell_size):
+    """Unique integer cells + counts (the aggregateByKey pass,
+    DBSCAN.scala:91-97, in exact arithmetic).
+
+    Returns (cells [C, 2] int64 lower-left indices, counts [C] int64,
+    inverse [N] mapping points to cell rows).
+    """
+    idx = cell_index(points, cell_size)
+    uniq, inverse, counts = np.unique(
+        idx, axis=0, return_inverse=True, return_counts=True
+    )
+    return uniq, counts.astype(np.int64), inverse.astype(np.int64)
+
+
+def int_rects_to_float(rects_int, cell_size):
+    """Convert [..., 4] integer cell-unit rectangles to float rects.
+
+    Each corner is an exact product index * cell_size, matching what
+    snap_corner produces for the same grid — so float containment tests
+    against point coordinates are consistent everywhere.
+    """
+    return np.asarray(rects_int, dtype=np.float64) * cell_size
+
+
+def points_to_cells(points, cell_size):
+    """Map [N, 2] points to their minimum bounding grid cells as [N, 4] rects.
+
+    Port of toMinimumBoundingRectangle (DBSCAN.scala:345-350): each point's
+    cell is the 2eps x 2eps rectangle whose lower-left corner is the snapped
+    coordinate.
+    """
+    points = np.asarray(points, dtype=np.float64)
+    corners = snap_corner(points, cell_size)  # [N, 2]
+    return np.concatenate([corners, corners + cell_size], axis=-1)
+
+
+def cell_histogram(points, cell_size):
+    """Unique cells + counts: the reference's aggregateByKey-then-collect pass
+    (DBSCAN.scala:91-97), done as one vectorized host pass.
+
+    Returns (cells [C, 4] float64, counts [C] int64, cell_index [N] int64
+    mapping each point to its row in `cells`).
+    """
+    cells = points_to_cells(points, cell_size)
+    uniq, inverse, counts = np.unique(
+        cells, axis=0, return_inverse=True, return_counts=True
+    )
+    return uniq, counts.astype(np.int64), inverse.astype(np.int64)
+
+
+def bounding_rect_of_cells(cells):
+    """Fold min/max over cell rects (EvenSplitPartitioner.scala:183-209)."""
+    cells = np.asarray(cells)
+    return np.array(
+        [
+            cells[:, X].min(),
+            cells[:, Y].min(),
+            cells[:, X2].max(),
+            cells[:, Y2].max(),
+        ],
+        dtype=cells.dtype,
+    )
+
+
+def pairwise_sq_dists(a, b):
+    """Squared Euclidean distances [N, M] between [N, 2] and [M, 2] (host).
+
+    Device-side distances live in dbscan_tpu.ops.distance; this numpy helper
+    backs the host oracles and predict(). Matches DBSCANPoint.distanceSquared
+    (DBSCANPoint.scala:26-30): only the first two coordinates participate.
+    """
+    a = np.asarray(a, dtype=np.float64)[:, :2]
+    b = np.asarray(b, dtype=np.float64)[:, :2]
+    diff = a[:, None, :] - b[None, :, :]
+    return np.einsum("nmd,nmd->nm", diff, diff)

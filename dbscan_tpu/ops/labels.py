@@ -1,0 +1,56 @@
+"""Point flag / cluster-label constants.
+
+Mirrors the reference's labeled-point data model (DBSCANLabeledPoint.scala:24-47)
+but as plain integer codes suitable for device arrays instead of a mutable JVM
+object: the reference's ``Flag`` enumeration {Border, Core, Noise, NotFlagged}
+(:28-31) and the ``Unknown = 0`` cluster sentinel (:26).
+
+Cluster-label conventions used throughout this package:
+
+- "seed labels" (device-internal): a cluster is identified by the minimum row
+  index of its core points within one partition buffer; ``SEED_NONE`` marks
+  noise / padding. Seed labels are canonical and order-free.
+- "local ids" (reference-compatible): 1-based dense ranks of the sorted seed
+  values, exactly reproducing the sequential numbering the reference's fold
+  produces (LocalDBSCANNaive.scala:45-64 assigns cluster k to the k-th seed in
+  input order). 0 == UNKNOWN == noise, as in the reference.
+"""
+
+import numpy as np
+
+# Flags (int8 device codes).
+NOT_FLAGGED = np.int8(0)  # reference Flag.NotFlagged
+CORE = np.int8(1)  # reference Flag.Core
+BORDER = np.int8(2)  # reference Flag.Border
+NOISE = np.int8(3)  # reference Flag.Noise
+
+# Cluster sentinel (reference DBSCANLabeledPoint.scala:26).
+UNKNOWN = 0
+
+# Device-internal sentinel for "no seed" (noise / invalid); any value larger
+# than every row index works because labels only ever shrink via min().
+SEED_NONE = np.int32(2**31 - 1)
+
+FLAG_NAMES = {
+    int(NOT_FLAGGED): "NotFlagged",
+    int(CORE): "Core",
+    int(BORDER): "Border",
+    int(NOISE): "Noise",
+}
+
+
+def seed_to_local_ids(seed_labels: np.ndarray) -> np.ndarray:
+    """Convert seed labels to the reference's 1-based sequential numbering.
+
+    The reference assigns cluster ids 1,2,3,... in fold order of the first
+    core point ("seed") of each cluster (LocalDBSCANNaive.scala:45-64). Sorted
+    seed row-indices ARE fold order, so dense-ranking them reproduces the
+    reference numbering exactly. Noise (SEED_NONE) maps to UNKNOWN (0).
+    """
+    seed_labels = np.asarray(seed_labels)
+    out = np.zeros(seed_labels.shape, dtype=np.int32)
+    mask = seed_labels != SEED_NONE
+    if mask.any():
+        uniq, inv = np.unique(seed_labels[mask], return_inverse=True)
+        out[mask] = (inv + 1).astype(np.int32)
+    return out

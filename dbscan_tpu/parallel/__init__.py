@@ -1,0 +1,2 @@
+"""Host-side orchestration: spatial partitioning, halo binning, mesh fan-out,
+and the global cluster merge."""

@@ -1,0 +1,1 @@
+"""Host utilities: IO, metrics, logging, reference oracles."""

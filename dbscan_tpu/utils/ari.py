@@ -1,0 +1,75 @@
+"""Adjusted Rand Index, implemented from scratch (sklearn is not available
+in this environment). Used by tests and the benchmark harness to compare
+clusterings permutation-invariantly — the reference's own end-to-end test
+already needs a hand-built label correspondence map (DBSCANSuite.scala:28);
+ARI is the principled version of that."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def contingency(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    a = np.asarray(a)
+    b = np.asarray(b)
+    _, ai = np.unique(a, return_inverse=True)
+    _, bi = np.unique(b, return_inverse=True)
+    n_a = ai.max() + 1 if ai.size else 0
+    n_b = bi.max() + 1 if bi.size else 0
+    table = np.zeros((n_a, n_b), dtype=np.int64)
+    np.add.at(table, (ai, bi), 1)
+    return table
+
+
+def adjusted_rand_index(a: np.ndarray, b: np.ndarray) -> float:
+    """ARI in [-1, 1]; 1.0 iff the two labelings are identical up to
+    permutation. Noise is treated as an ordinary label (as scikit-learn's
+    adjusted_rand_score does)."""
+    a = np.asarray(a).ravel()
+    b = np.asarray(b).ravel()
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch: {a.shape} vs {b.shape}")
+    n = a.size
+    if n < 2:
+        return 1.0
+    table = contingency(a, b)
+
+    def comb2(x):
+        x = np.asarray(x, dtype=np.float64)
+        return x * (x - 1.0) / 2.0
+
+    sum_ij = comb2(table).sum()
+    sum_a = comb2(table.sum(axis=1)).sum()
+    sum_b = comb2(table.sum(axis=0)).sum()
+    total = comb2(n)
+    expected = sum_a * sum_b / total if total else 0.0
+    max_index = 0.5 * (sum_a + sum_b)
+    denom = max_index - expected
+    if denom == 0.0:
+        return 1.0
+    return float((sum_ij - expected) / denom)
+
+
+def exact_match_up_to_permutation(a: np.ndarray, b: np.ndarray, noise_a=0, noise_b=0) -> bool:
+    """True iff labelings agree exactly after the optimal label bijection,
+    with noise required to map to noise."""
+    a = np.asarray(a).ravel()
+    b = np.asarray(b).ravel()
+    if a.shape != b.shape:
+        return False
+    if not np.array_equal(a == noise_a, b == noise_b):
+        return False
+    mapping = {}
+    used = set()
+    for la, lb in zip(a, b):
+        if la == noise_a:
+            continue
+        if la in mapping:
+            if mapping[la] != lb:
+                return False
+        else:
+            if lb in used:
+                return False
+            mapping[la] = lb
+            used.add(lb)
+    return True

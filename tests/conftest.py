@@ -1,0 +1,43 @@
+"""Test fixture: force a virtual 8-device CPU mesh BEFORE jax is imported.
+
+This is our stand-in for the reference's `local[2]` in-process SparkContext
+(MLlibTestSparkContext.scala:28-41): real shardings and collectives, one host,
+no TPU pod needed. Must run before any test module imports jax."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Parity tests compare eps-boundary decisions against the reference's float64
+# JVM arithmetic; enable x64 so CPU test runs can opt into f64.
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+REFERENCE_CSV = "/root/reference/src/test/resources/labeled_data.csv"
+
+
+def reference_fixture_available() -> bool:
+    return os.path.exists(REFERENCE_CSV)
+
+
+def load_reference_fixture():
+    """Load the reference's 749-point golden fixture (x, y, label) at test
+    time from the read-only reference mount — never copied into this repo."""
+    data = np.loadtxt(REFERENCE_CSV, delimiter=",", dtype=np.float64)
+    return data[:, :2], data[:, 2]

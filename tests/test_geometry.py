@@ -1,0 +1,85 @@
+"""Geometry semantics tests, mirroring the reference's DBSCANRectangle
+behaviors (inclusive contains vs strict almost_contains, shrink, grid
+snapping quirks of DBSCAN.scala:345-356)."""
+
+import numpy as np
+
+from dbscan_tpu.ops import geometry as geo
+
+
+def test_contains_point_inclusive_edges():
+    r = geo.rect(0.0, 0.0, 1.0, 1.0)
+    pts = np.array([[0.0, 0.0], [1.0, 1.0], [0.5, 0.5], [1.0001, 0.5], [-0.0001, 0.5]])
+    got = geo.contains_point(r, pts)
+    assert got.tolist() == [True, True, True, False, False]
+
+
+def test_almost_contains_strict_interior():
+    r = geo.rect(0.0, 0.0, 1.0, 1.0)
+    pts = np.array([[0.0, 0.5], [1.0, 0.5], [0.5, 0.0], [0.5, 1.0], [0.5, 0.5]])
+    got = geo.almost_contains(r, pts)
+    assert got.tolist() == [False, False, False, False, True]
+
+
+def test_contains_rect_inclusive():
+    outer = geo.rect(0.0, 0.0, 2.0, 2.0)
+    assert geo.contains_rect(outer, geo.rect(0.0, 0.0, 2.0, 2.0))
+    assert geo.contains_rect(outer, geo.rect(0.5, 0.5, 1.5, 1.5))
+    assert not geo.contains_rect(outer, geo.rect(-0.1, 0.0, 2.0, 2.0))
+    assert not geo.contains_rect(outer, geo.rect(0.0, 0.0, 2.1, 2.0))
+
+
+def test_shrink_grows_with_negative_amount():
+    r = geo.rect(0.0, 0.0, 2.0, 2.0)
+    inner = geo.shrink(r, 0.3)
+    outer = geo.shrink(r, -0.3)
+    np.testing.assert_allclose(inner, [0.3, 0.3, 1.7, 1.7])
+    np.testing.assert_allclose(outer, [-0.3, -0.3, 2.3, 2.3])
+
+
+def test_shrink_batched():
+    rs = np.stack([geo.rect(0.0, 0.0, 2.0, 2.0), geo.rect(1.0, 1.0, 3.0, 3.0)])
+    out = geo.shrink(rs, 0.5)
+    np.testing.assert_allclose(out, [[0.5, 0.5, 1.5, 1.5], [1.5, 1.5, 2.5, 2.5]])
+
+
+def test_snap_corner_positive():
+    # cell = 0.6: 0.7 -> 0.6; 0.0 -> 0.0; 0.59 -> 0.0
+    got = geo.snap_corner(np.array([0.7, 0.0, 0.59]), 0.6)
+    np.testing.assert_allclose(got, [0.6, 0.0, 0.0])
+
+
+def test_snap_corner_negative_shift_quirk():
+    # Reference shiftIfNegative (DBSCAN.scala:352-356): negative coords are
+    # shifted down one full cell before truncation. -0.1 -> trunc((-0.1-0.6)/0.6)
+    # = trunc(-1.1667) = -1 -> -0.6. Exact negative multiple -0.6 ->
+    # trunc((-0.6-0.6)/0.6) = -2 -> -1.2 (the quirk: it lands a cell below).
+    got = geo.snap_corner(np.array([-0.1, -0.6]), 0.6)
+    np.testing.assert_allclose(got, [-0.6, -1.2])
+
+
+def test_points_to_cells_and_histogram():
+    pts = np.array([[0.1, 0.1], [0.2, 0.3], [0.7, 0.1], [-0.1, 0.0]])
+    cells, counts, inv = geo.cell_histogram(pts, 0.5)
+    # cells: [0,0], [0.5,0] and [-0.5,0] corners
+    assert cells.shape == (3, 4)
+    assert counts.sum() == 4
+    # the two points in the [0,0] cell map to the same row
+    assert inv[0] == inv[1]
+    # each cell is cell_size wide
+    np.testing.assert_allclose(cells[:, 2] - cells[:, 0], 0.5)
+    np.testing.assert_allclose(cells[:, 3] - cells[:, 1], 0.5)
+
+
+def test_bounding_rect_of_cells():
+    cells = np.array(
+        [[0.0, 0.0, 1.0, 1.0], [2.0, -1.0, 3.0, 0.0], [-1.0, 2.0, 0.0, 3.0]]
+    )
+    np.testing.assert_allclose(geo.bounding_rect_of_cells(cells), [-1.0, -1.0, 3.0, 3.0])
+
+
+def test_pairwise_sq_dists_uses_first_two_dims_only():
+    # DBSCANPoint uses only dims 0,1 (DBSCANPoint.scala:23-24)
+    a = np.array([[0.0, 0.0, 99.0], [1.0, 1.0, -5.0]])
+    d2 = geo.pairwise_sq_dists(a, a)
+    np.testing.assert_allclose(d2, [[0.0, 2.0], [2.0, 0.0]])

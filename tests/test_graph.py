@@ -1,0 +1,62 @@
+"""DBSCANGraph + UnionFind tests. The four graph tests mirror the reference's
+DBSCANGraphSuite.scala:22-64 one-for-one; the union-find tests pin the global
+id numbering contract of DBSCAN.scala:206-222."""
+
+from dbscan_tpu.parallel.graph import DBSCANGraph, UnionFind
+
+
+def test_should_return_connected():
+    graph = DBSCANGraph().connect(1, 3)
+    assert graph.get_connected(1) == {3}
+
+
+def test_should_return_doubly_connected():
+    graph = DBSCANGraph().connect(1, 3).connect(3, 4)
+    assert graph.get_connected(1) == {3, 4}
+
+
+def test_should_return_none_for_vertex():
+    graph = DBSCANGraph().add_vertex(5).connect(1, 3)
+    assert graph.get_connected(5) == set()
+
+
+def test_should_return_none_for_unknown():
+    graph = DBSCANGraph().add_vertex(5).connect(1, 3)
+    assert graph.get_connected(6) == set()
+
+
+def test_graph_immutability():
+    g0 = DBSCANGraph()
+    g1 = g0.connect(1, 2)
+    assert g0.get_connected(1) == set()
+    assert g1.get_connected(1) == {2}
+
+
+def test_union_find_transitive():
+    uf = UnionFind()
+    uf.union((0, 1), (1, 2))
+    uf.union((1, 2), (2, 5))
+    assert uf.find((0, 1)) == uf.find((2, 5))
+    assert uf.find((3, 3)) != uf.find((0, 1))
+
+
+def test_assign_global_ids_matches_reference_numbering():
+    # Reference numbering (DBSCAN.scala:206-222): iterate cluster ids in
+    # order; each unseen component gets the next id starting at 1, and the
+    # whole component inherits it.
+    uf = UnionFind()
+    uf.union((0, 1), (1, 1))  # component A
+    uf.union((2, 1), (3, 1))  # component B
+    ordered = [(0, 1), (1, 1), (2, 1), (3, 1), (4, 7)]
+    total, mapping = uf.assign_global_ids(ordered)
+    assert total == 3
+    assert mapping[(0, 1)] == 1 and mapping[(1, 1)] == 1
+    assert mapping[(2, 1)] == 2 and mapping[(3, 1)] == 2
+    assert mapping[(4, 7)] == 3
+
+
+def test_assign_global_ids_order_dependence():
+    uf = UnionFind()
+    uf.union("a", "b")
+    _, mapping = uf.assign_global_ids(["c", "a", "b"])
+    assert mapping == {"c": 1, "a": 2, "b": 2}

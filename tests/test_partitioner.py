@@ -1,0 +1,126 @@
+"""EvenSplitPartitioner tests.
+
+The two fixture tests reproduce reference EvenSplitPartitionerSuite.scala:22-61
+EXACTLY — same cell sets, same max-points/min-size, same expected rectangles in
+the same output order — pinning our deterministic candidate order (x-cuts
+ascending then y-cuts, first-win ties) as the reference order made explicit."""
+
+import numpy as np
+
+from dbscan_tpu.parallel import partitioner
+
+
+def _sections(rows):
+    cells = np.array([r[:4] for r in rows], dtype=np.float64)
+    counts = np.array([r[4] for r in rows], dtype=np.int64)
+    return cells, counts
+
+
+def test_should_find_partitions():
+    # EvenSplitPartitionerSuite.scala:23-49
+    cells, counts = _sections(
+        [
+            (0, 0, 1, 1, 3),
+            (0, 2, 1, 3, 6),
+            (1, 1, 2, 2, 7),
+            (1, 0, 2, 1, 2),
+            (2, 0, 3, 1, 5),
+            (2, 2, 3, 3, 4),
+        ]
+    )
+    got = partitioner.partition(cells, counts, 9, 1.0)
+    expected = [
+        ((1, 2, 3, 3), 4),
+        ((0, 2, 1, 3), 6),
+        ((0, 1, 3, 2), 7),
+        ((2, 0, 3, 1), 5),
+        ((0, 0, 2, 1), 5),
+    ]
+    assert len(got) == len(expected)
+    for (rect, count), (erect, ecount) in zip(got, expected):
+        np.testing.assert_allclose(rect, erect)
+        assert count == ecount
+
+
+def test_should_find_two_splits():
+    # EvenSplitPartitionerSuite.scala:51-60
+    cells, counts = _sections(
+        [
+            (0, 0, 1, 1, 3),
+            (2, 2, 3, 3, 4),
+            (0, 1, 1, 2, 2),
+        ]
+    )
+    got = partitioner.partition(cells, counts, 4, 1.0)
+    np.testing.assert_allclose(got[0][0], (1, 0, 3, 3))
+    assert got[0][1] == 4
+    np.testing.assert_allclose(got[1][0], (0, 1, 1, 3))
+    assert got[1][1] == 2
+
+
+def test_respects_max_points_where_splittable(rng):
+    pts = rng.uniform(-5, 5, size=(2000, 2))
+    from dbscan_tpu.ops import geometry as geo
+
+    cells, counts, _ = geo.cell_histogram(pts, 0.5)
+    parts = partitioner.partition(cells, counts, 300, 0.5)
+    assert sum(c for _, c in parts) == 2000
+    # every partition either fits the bound or is a minimal unsplittable cell
+    for rect, count in parts:
+        splittable = (rect[2] - rect[0] > 1.0) or (rect[3] - rect[1] > 1.0)
+        assert count <= 300 or not splittable
+
+
+def test_empty_partitions_dropped(rng):
+    # two far-apart blobs force empty middle partitions to appear and be cut
+    pts = np.concatenate(
+        [
+            rng.normal(0, 0.1, size=(400, 2)),
+            rng.normal(20, 0.1, size=(400, 2)),
+        ]
+    )
+    from dbscan_tpu.ops import geometry as geo
+
+    cells, counts, _ = geo.cell_histogram(pts, 0.5)
+    parts = partitioner.partition(cells, counts, 100, 0.5)
+    assert all(c > 0 for _, c in parts)
+    assert sum(c for _, c in parts) == 800
+
+
+def test_no_points_lost_to_fp_drift(rng):
+    # Regression: with eps=0.3 (cell 0.6, not exactly representable) the
+    # reference's all-double formulation drifts cut positions away from
+    # trunc-derived cell corners by ulps, dropping cells from counts and
+    # leaving coverage holes. The integer-domain partitioner must keep the
+    # exact-count invariant and tile the bounding box.
+    from dbscan_tpu.ops import geometry as geo
+
+    pts = np.concatenate(
+        [rng.normal(0, 1, (3000, 2)), rng.normal(8, 0.5, (2000, 2))]
+    )
+    cells, counts, _ = geo.cell_histogram_int(pts, 0.6)
+    parts = partitioner.partition_cells(cells, counts, 250)
+    assert sum(c for _, c in parts) == 5000
+    # partitions tile the bounding box: every cell in exactly one partition
+    rects = np.stack([r for r, _ in parts])
+    cx, cy = cells[:, 0], cells[:, 1]
+    owners = (
+        (rects[:, None, 0] <= cx[None, :])
+        & (cx[None, :] + 1 <= rects[:, None, 2])
+        & (rects[:, None, 1] <= cy[None, :])
+        & (cy[None, :] + 1 <= rects[:, None, 3])
+    ).sum(axis=0)
+    assert (owners == 1).all()
+    # every point is inside its own partition's float main rect
+    fr = geo.int_rects_to_float(rects, 0.6)
+    covered = geo.contains_point(fr[:, None, :], pts[None, :, :]).any(axis=0)
+    assert covered.all()
+
+
+def test_unsplittable_overfull_cell_emitted_as_is():
+    # one cell with more points than the bound cannot be split
+    cells = np.array([[0.0, 0.0, 1.0, 1.0]])
+    counts = np.array([50])
+    parts = partitioner.partition(cells, counts, 10, 1.0)
+    assert len(parts) == 1
+    assert parts[0][1] == 50
