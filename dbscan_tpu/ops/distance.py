@@ -1,0 +1,101 @@
+"""Pluggable pairwise distance measures for the local DBSCAN kernel.
+
+The reference supports exactly one metric — 2-D squared Euclidean computed
+pointwise on the JVM (DBSCANPoint.scala:26-30). Here each metric is a pair of
+functions:
+
+- ``pairwise(a, b) -> [N, M]`` measure matrix, written so XLA maps the inner
+  contraction onto the MXU (matmul form) instead of an elementwise O(N*M*D)
+  broadcast — this is where the FLOPs are on TPU;
+- ``threshold(eps) -> scalar`` mapping the user-facing ``eps`` to the measure
+  scale (eps^2 for squared Euclidean, eps itself for haversine/cosine).
+
+A point pair is eps-adjacent iff ``pairwise(a, b) <= threshold(eps)``,
+matching the reference's inclusive comparison (LocalDBSCANNaive.scala:76).
+
+All functions accept jnp or np arrays; under ``jit`` they trace to pure XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+EARTH_RADIUS_KM = 6371.0088
+
+
+class Metric(NamedTuple):
+    pairwise: Callable  # (a [N,D], b [M,D]) -> [N,M] measure
+    threshold: Callable  # eps -> comparable scalar
+
+
+def _euclidean_sq(a, b):
+    """Squared L2, matching the reference's dx*dx + dy*dy
+    (DBSCANPoint.scala:26-30) for D == 2; any D supported.
+
+    Two regimes, both chosen for eps-boundary fidelity on TPU:
+    - D <= 4: direct difference form on the VPU. Exact in the input dtype —
+      no matmul, so no silent bf16 accumulation (TPU matmuls default to
+      bf16 inputs, which flips thousands of boundary decisions at N~4k; the
+      direct form flips none vs same-dtype numpy).
+    - larger D: the |a|^2 + |b|^2 - 2ab^T expansion on the MXU with
+      Precision.HIGHEST (f32 accumulate), clamped at zero since the
+      expansion can go slightly negative for near-identical points.
+    """
+    if a.shape[-1] <= 4:
+        diff = a[:, None, :] - b[None, :, :]
+        return jnp.sum(diff * diff, axis=-1)
+    a2 = jnp.sum(a * a, axis=-1)[:, None]
+    b2 = jnp.sum(b * b, axis=-1)[None, :]
+    ab = jnp.matmul(a, b.T, precision=jax.lax.Precision.HIGHEST)
+    d2 = a2 + b2 - 2.0 * ab
+    return jnp.maximum(d2, 0.0)
+
+
+def _haversine(a, b):
+    """Great-circle distance in km between [.., 2] (lon_deg, lat_deg) arrays.
+
+    For the NYC-taxi geospatial config (BASELINE.json configs[1]); eps is in
+    km. Uses the numerically-stable asin(sqrt(...)) form.
+    """
+    lon1, lat1 = jnp.deg2rad(a[:, 0])[:, None], jnp.deg2rad(a[:, 1])[:, None]
+    lon2, lat2 = jnp.deg2rad(b[:, 0])[None, :], jnp.deg2rad(b[:, 1])[None, :]
+    dlat = lat2 - lat1
+    dlon = lon2 - lon1
+    h = (
+        jnp.sin(dlat / 2.0) ** 2
+        + jnp.cos(lat1) * jnp.cos(lat2) * jnp.sin(dlon / 2.0) ** 2
+    )
+    return 2.0 * EARTH_RADIUS_KM * jnp.arcsin(jnp.sqrt(jnp.clip(h, 0.0, 1.0)))
+
+
+def _cosine(a, b):
+    """Cosine distance 1 - cos_sim, one normalized matmul (MXU). For the
+    embeddings config (BASELINE.json configs[2]); eps is a distance in
+    [0, 2]."""
+    an = a / jnp.maximum(jnp.linalg.norm(a, axis=-1, keepdims=True), 1e-30)
+    bn = b / jnp.maximum(jnp.linalg.norm(b, axis=-1, keepdims=True), 1e-30)
+    return 1.0 - jnp.matmul(an, bn.T, precision=jax.lax.Precision.HIGHEST)
+
+
+_REGISTRY: Dict[str, Metric] = {
+    "euclidean": Metric(_euclidean_sq, lambda eps: eps * eps),
+    "haversine": Metric(_haversine, lambda eps: eps),
+    "cosine": Metric(_cosine, lambda eps: eps),
+}
+
+
+def get_metric(name: str) -> Metric:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown metric {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def register_metric(name: str, pairwise: Callable, threshold: Callable) -> None:
+    """Extension point for user metrics (e.g. sparse kernels)."""
+    _REGISTRY[name] = Metric(pairwise, threshold)

@@ -147,7 +147,7 @@ def points_to_cells(points, cell_size):
     cell is the 2eps x 2eps rectangle whose lower-left corner is the snapped
     coordinate.
     """
-    points = np.asarray(points, dtype=np.float64)
+    points = np.asarray(points, dtype=np.float64)[..., :2]
     corners = snap_corner(points, cell_size)  # [N, 2]
     return np.concatenate([corners, corners + cell_size], axis=-1)
 
@@ -156,14 +156,14 @@ def cell_histogram(points, cell_size):
     """Unique cells + counts: the reference's aggregateByKey-then-collect pass
     (DBSCAN.scala:91-97), done as one vectorized host pass.
 
-    Returns (cells [C, 4] float64, counts [C] int64, cell_index [N] int64
-    mapping each point to its row in `cells`).
+    Thin float view over cell_histogram_int (single source of truth for the
+    grouping); corners are the exact index * cell_size products the
+    partitioner emits. Returns (cells [C, 4] float64, counts [C] int64,
+    cell_index [N] int64 mapping each point to its row in `cells`).
     """
-    cells = points_to_cells(points, cell_size)
-    uniq, inverse, counts = np.unique(
-        cells, axis=0, return_inverse=True, return_counts=True
-    )
-    return uniq, counts.astype(np.int64), inverse.astype(np.int64)
+    idx, counts, inverse = cell_histogram_int(points, cell_size)
+    cells = np.concatenate([idx, idx + 1], axis=-1).astype(np.float64) * cell_size
+    return cells, counts, inverse
 
 
 def bounding_rect_of_cells(cells):

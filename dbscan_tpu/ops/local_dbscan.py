@@ -1,0 +1,166 @@
+"""The per-partition DBSCAN kernel: vectorized, jittable, TPU-native.
+
+This replaces the reference's sequential queue-BFS engines
+(LocalDBSCANNaive.scala:37-118, LocalDBSCANArchery.scala:36-112) with a
+fixed-point formulation built from ops XLA tiles onto the MXU/VPU:
+
+1. pairwise measure matrix via the metric registry (matmul form — MXU);
+2. eps-adjacency + self-inclusive neighbor counts -> core mask
+   (``counts >= min_points``, matching the reference where the query point is
+   its own neighbor, LocalDBSCANNaive.scala:72-78);
+3. connected components of the core-core adjacency by iterated min-label
+   propagation + pointer jumping inside ``lax.while_loop`` — converges in
+   O(log diameter) iterations; the resulting component label IS the minimum
+   core row index, i.e. exactly the fold index of the point that would have
+   seeded that cluster in the reference's sequential scan ("seed index");
+4. border assignment closed-form from seed indices. Both reference engines'
+   order-dependent behaviors become order-free algebra:
+   - the cluster any border point joins is the one whose expansion runs
+     first = min seed index among eps-adjacent clusters (both engines);
+   - NAIVE additionally leaves the point Noise unless that min adjacent seed
+     precedes the point's own fold index (min_seed < own row index), which is
+     precisely "was first reached by an expansion before its own fold visit"
+     (the dead adoption branch, LocalDBSCANNaive.scala:108-111);
+   - ARCHERY adopts unconditionally (LocalDBSCANArchery.scala:103-106).
+
+Cluster ids are "seed labels" (min core row index, SEED_NONE for noise);
+``labels.seed_to_local_ids`` densifies them to the reference's sequential
+1-based numbering when needed.
+
+Inputs are padded to static shapes with a validity mask — partitions of
+varying size share one compiled kernel per bucket size (no dynamic shapes
+under jit).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dbscan_tpu.ops import distance as dist_mod
+from dbscan_tpu.ops.labels import BORDER, CORE, NOISE, NOT_FLAGGED, SEED_NONE
+
+
+class LocalResult(NamedTuple):
+    """Per-point outputs of the local kernel (all padded to the input shape).
+
+    seed_labels: int32 cluster seed index per point; SEED_NONE for
+      noise/invalid.
+    flags: int8 in {NOT_FLAGGED (padding), CORE, BORDER, NOISE}.
+    counts: int32 eps-neighborhood sizes (self-inclusive); diagnostics.
+    """
+
+    seed_labels: jnp.ndarray
+    flags: jnp.ndarray
+    counts: jnp.ndarray
+
+
+def _components_min_label(adj_cc: jnp.ndarray, core: jnp.ndarray) -> jnp.ndarray:
+    """Min-row-index label per connected component of the core-core adjacency.
+
+    Label propagation (masked neighbor-min) + one pointer jump per iteration
+    inside a while_loop. Invariants: labels only decrease; a core's label is
+    always a core row index within its own component and <= its own index; so
+    the fixed point is the component minimum — the "seed index". Non-core
+    rows hold SEED_NONE throughout.
+    """
+    n = core.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    none = jnp.int32(SEED_NONE)
+    init = jnp.where(core, idx, none)
+
+    def cond(state):
+        _, changed = state
+        return changed
+
+    def body(state):
+        labels, _ = state
+        nbr_min = jnp.min(
+            jnp.where(adj_cc, labels[None, :], none), axis=1
+        )
+        new = jnp.minimum(labels, nbr_min)
+        # pointer jump: adopt the label of my current label (a smaller-index
+        # core in the same component) — collapses chains logarithmically
+        safe = jnp.clip(new, 0, n - 1)
+        hop = jnp.where(new == none, none, new[safe])
+        new = jnp.minimum(new, hop)
+        return new, jnp.any(new != labels)
+
+    labels, _ = lax.while_loop(cond, body, (init, jnp.bool_(True)))
+    return labels
+
+
+@functools.partial(
+    jax.jit, static_argnames=("min_points", "engine", "metric")
+)
+def local_dbscan(
+    points: jnp.ndarray,
+    mask: jnp.ndarray,
+    eps: float,
+    min_points: int,
+    engine: str = "naive",
+    metric: str = "euclidean",
+) -> LocalResult:
+    """Cluster one (padded) partition.
+
+    Args:
+      points: [N, D] coordinates (D == 2 for parity with the reference,
+        DBSCANPoint.scala:23-24; any D for the extended metrics). Padding
+        rows can hold arbitrary values.
+      mask: [N] bool validity; padding rows False.
+      eps: neighborhood radius (measure scale set by the metric).
+      min_points: self-inclusive density threshold (static).
+      engine: "naive" | "archery" — see module docstring (static).
+      metric: registered metric name (static).
+
+    Returns a :class:`LocalResult` of [N] arrays.
+    """
+    if engine not in ("naive", "archery"):
+        raise ValueError(f"unknown engine {engine!r}")
+    m = dist_mod.get_metric(metric)
+    n = points.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    none = jnp.int32(SEED_NONE)
+
+    measure = m.pairwise(points, points)
+    thr = m.threshold(jnp.asarray(eps, dtype=measure.dtype))
+    adj = (measure <= thr) & mask[None, :] & mask[:, None]
+    # Self-adjacency for every valid point: guaranteed for euclidean/cosine
+    # (measure 0 at the diagonal) but made explicit so counts are
+    # self-inclusive under any registered metric.
+    adj = adj | (jnp.eye(n, dtype=bool) & mask[:, None])
+
+    counts = jnp.sum(adj, axis=1, dtype=jnp.int32)
+    core = (counts >= jnp.int32(min_points)) & mask
+
+    adj_cc = adj & core[None, :] & core[:, None]
+    comp = _components_min_label(adj_cc, core)
+
+    # Minimum seed index among eps-adjacent cores (for cores: own component).
+    core_nbr_seed = jnp.min(
+        jnp.where(adj & core[None, :], comp[None, :], none), axis=1
+    )
+
+    has_core_nbr = core_nbr_seed != none
+    if engine == "naive":
+        border = mask & ~core & has_core_nbr & (core_nbr_seed < idx)
+    else:
+        border = mask & ~core & has_core_nbr
+
+    seed_labels = jnp.where(
+        core, comp, jnp.where(border, core_nbr_seed, none)
+    )
+    flags = jnp.where(
+        ~mask,
+        jnp.int8(NOT_FLAGGED),
+        jnp.where(
+            core,
+            jnp.int8(CORE),
+            jnp.where(border, jnp.int8(BORDER), jnp.int8(NOISE)),
+        ),
+    )
+    return LocalResult(seed_labels.astype(jnp.int32), flags, counts)

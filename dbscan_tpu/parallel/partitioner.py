@@ -55,13 +55,20 @@ def _points_in(cells: np.ndarray, counts: np.ndarray, rects: np.ndarray) -> np.n
     """
     rects = np.atleast_2d(rects)
     cx, cy = cells[:, 0], cells[:, 1]
-    inside = (
-        (rects[:, None, X] <= cx[None, :])
-        & (cx[None, :] + 1 <= rects[:, None, X2])
-        & (rects[:, None, Y] <= cy[None, :])
-        & (cy[None, :] + 1 <= rects[:, None, Y2])
-    )  # [K, C]
-    return inside @ counts
+    out = np.empty(rects.shape[0], dtype=np.int64)
+    # Chunk the candidate axis: K can reach tens of thousands on wide fine
+    # grids and a single [K, C] bool broadcast would be gigabytes.
+    chunk = max(1, int(2**24 // max(1, cx.size)))
+    for s in range(0, rects.shape[0], chunk):
+        r = rects[s : s + chunk]
+        inside = (
+            (r[:, None, X] <= cx[None, :])
+            & (cx[None, :] + 1 <= r[:, None, X2])
+            & (r[:, None, Y] <= cy[None, :])
+            & (cy[None, :] + 1 <= r[:, None, Y2])
+        )  # [k, C]
+        out[s : s + chunk] = inside @ counts
+    return out
 
 
 def _possible_splits(rect: np.ndarray) -> np.ndarray:
@@ -178,10 +185,14 @@ def partition(
         return []
     idx = np.rint(cells[:, :2] / minimum_rectangle_size).astype(np.int64)
     recon = idx * minimum_rectangle_size
-    if not np.allclose(recon, cells[:, :2], rtol=0, atol=1e-9 * max(1.0, minimum_rectangle_size)):
+    atol = 1e-9 * max(1.0, minimum_rectangle_size)
+    extents = cells[:, 2:] - cells[:, :2]
+    if not np.allclose(recon, cells[:, :2], rtol=0, atol=atol) or not np.allclose(
+        extents, minimum_rectangle_size, rtol=0, atol=atol
+    ):
         raise ValueError(
-            "cells are not aligned to the minimum_rectangle_size grid; "
-            "use partition_cells with integer indices instead"
+            "cells are not minimum_rectangle_size-sized rects aligned to the "
+            "grid; use partition_cells with integer indices instead"
         )
     parts = partition_cells(idx, counts, max_points_per_partition)
     return [

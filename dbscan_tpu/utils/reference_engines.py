@@ -1,0 +1,87 @@
+"""Sequential numpy oracles reproducing the reference's two local engines.
+
+These are test oracles, NOT production code paths: straight-line Python/numpy
+implementations of the documented semantics of LocalDBSCANNaive.scala:37-118
+and LocalDBSCANArchery.scala:36-112, used to check the vectorized TPU kernel
+bit-for-bit on arbitrary inputs. Iteration order is input order (the reference
+Naive folds input order; Archery iterates R-tree entry order — border cluster
+CHOICE is order-dependent in DBSCAN, so our oracles fix input order and the
+kernel matches that).
+
+Semantics captured:
+- neighborhoods are inclusive of the query point and use d^2 <= eps^2
+  (LocalDBSCANNaive.scala:72-78);
+- a cluster is seeded by the first (fold-order) unvisited core point; cluster
+  ids count up from 1 (fit fold, :45-64);
+- NAIVE: a point already visited as noise is NEVER adopted as Border — the
+  re-labeling code at :108-111 sits inside the !visited branch, after cluster
+  was already assigned at :97, so it is dead;
+- ARCHERY: the adoption check sits OUTSIDE the !visited branch
+  (LocalDBSCANArchery.scala:103-106), so visited noise IS adopted as Border
+  by the first expansion that reaches it.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Tuple
+
+import numpy as np
+
+from dbscan_tpu.ops import geometry as geo
+from dbscan_tpu.ops.labels import BORDER, CORE, NOISE, NOT_FLAGGED
+
+
+def _fit(points: np.ndarray, eps: float, min_points: int, adopt_visited_noise: bool):
+    pts = np.asarray(points, dtype=np.float64)[:, :2]
+    n = len(pts)
+    d2 = geo.pairwise_sq_dists(pts, pts)
+    eps_sq = float(eps) * float(eps)
+    nbr_lists = [np.flatnonzero(d2[i] <= eps_sq) for i in range(n)]
+
+    visited = np.zeros(n, dtype=bool)
+    flags = np.full(n, NOT_FLAGGED, dtype=np.int8)
+    cluster = np.zeros(n, dtype=np.int32)  # 0 == Unknown == noise
+
+    c = 0
+    for i in range(n):
+        if visited[i]:
+            continue
+        visited[i] = True
+        nbrs = nbr_lists[i]
+        if len(nbrs) < min_points:
+            flags[i] = NOISE
+            continue
+        c += 1
+        flags[i] = CORE
+        cluster[i] = c
+        queue = deque([nbrs])
+        while queue:
+            for j in queue.popleft():
+                if not visited[j]:
+                    visited[j] = True
+                    cluster[j] = c
+                    nn = nbr_lists[j]
+                    if len(nn) >= min_points:
+                        flags[j] = CORE
+                        queue.append(nn)
+                    else:
+                        flags[j] = BORDER
+                elif adopt_visited_noise and cluster[j] == 0:
+                    cluster[j] = c
+                    flags[j] = BORDER
+    return cluster, flags
+
+
+def naive_fit(points, eps, min_points) -> Tuple[np.ndarray, np.ndarray]:
+    """Oracle for the Naive engine (no adoption of visited noise)."""
+    return _fit(points, eps, min_points, adopt_visited_noise=False)
+
+
+def archery_fit(points, eps, min_points) -> Tuple[np.ndarray, np.ndarray]:
+    """Oracle for the Archery/textbook engine (visited noise adopted as
+    Border), with exact d^2 <= eps^2 range queries (we do not reproduce the
+    reference's Float-truncated R-tree bounding boxes,
+    LocalDBSCANArchery.scala:118-124, which can drop boundary-exact
+    neighbors by rounding)."""
+    return _fit(points, eps, min_points, adopt_visited_noise=True)

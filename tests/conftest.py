@@ -7,18 +7,23 @@ no TPU pod needed. Must run before any test module imports jax."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-# Parity tests compare eps-boundary decisions against the reference's float64
-# JVM arithmetic; enable x64 so CPU test runs can opt into f64.
-os.environ.setdefault("JAX_ENABLE_X64", "1")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# This environment ships a sitecustomize that force-registers the axon TPU
+# plugin and sets JAX_PLATFORMS=axon; the env var alone cannot win, so pin the
+# platform through jax.config before any backend initializes.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# Parity tests compare eps-boundary decisions against the reference's float64
+# JVM arithmetic; enable x64 so CPU test runs can use f64.
+jax.config.update("jax_enable_x64", True)
 
 import numpy as np
 import pytest
