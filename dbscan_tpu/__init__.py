@@ -20,6 +20,7 @@ labeled_points / partitions / predict) while staying idiomatic JAX.
 
 from dbscan_tpu.config import DBSCANConfig, Engine, Precision
 from dbscan_tpu.ops.labels import CORE, BORDER, NOISE, NOT_FLAGGED, UNKNOWN
+from dbscan_tpu.models.dbscan import DBSCANModel, train
 
 __version__ = "0.1.0"
 
@@ -27,6 +28,8 @@ __all__ = [
     "DBSCANConfig",
     "Engine",
     "Precision",
+    "DBSCANModel",
+    "train",
     "CORE",
     "BORDER",
     "NOISE",

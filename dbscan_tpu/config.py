@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Optional, Tuple
 
 
 class Engine(str, enum.Enum):
@@ -72,8 +71,6 @@ class DBSCANConfig:
         BASELINE.json configs.
       bucket_multiple: partition buffers are padded to a multiple of this
         (sublane*lane friendly) to bound recompilation across runs.
-      max_partitions_hint: optional cap used when padding the partition axis
-        for the device mesh.
       use_pallas: route the per-partition kernel through the Pallas tiled
         implementation instead of plain XLA ops.
     """
@@ -85,7 +82,6 @@ class DBSCANConfig:
     precision: Precision = Precision.F32
     metric: str = "euclidean"
     bucket_multiple: int = 128
-    max_partitions_hint: Optional[int] = None
     use_pallas: bool = False
 
     @property

@@ -1,0 +1,1 @@
+"""Public model surface: the DBSCAN estimator and (later) streaming."""

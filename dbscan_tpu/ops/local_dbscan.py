@@ -90,7 +90,11 @@ def _components_min_label(adj_cc: jnp.ndarray, core: jnp.ndarray) -> jnp.ndarray
         new = jnp.minimum(new, hop)
         return new, jnp.any(new != labels)
 
-    labels, _ = lax.while_loop(cond, body, (init, jnp.bool_(True)))
+    # One unrolled body step first: the while_loop carry must be
+    # data-derived ("varying") for shard_map, and a constant True init is
+    # not; semantically free since body is idempotent at the fixed point.
+    state = body((init, jnp.bool_(True)))
+    labels, _ = lax.while_loop(cond, body, state)
     return labels
 
 

@@ -1,0 +1,114 @@
+"""Host-side halo binning: margins, eps-halo duplication, static bucketing.
+
+This is the TPU replacement for the reference's broadcast + shuffle stages
+(DBSCAN.scala:116-152): instead of shipping margin lists to executors and
+shuffling points through groupByKey, the host computes margins, replicates
+each point into every partition whose grown rectangle contains it, and packs
+the result into STATIC [P, B, ...] device buffers (padding + mask) so one
+compiled kernel handles every partition — no dynamic shapes under jit.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+from dbscan_tpu.ops import geometry as geo
+
+
+class Margins(NamedTuple):
+    """Per-partition (inner, main, outer) float rects, the reference's
+    Margins triple (DBSCAN.scala:70, :116-121): inner = main shrunk by eps,
+    outer = main grown by eps."""
+
+    inner: np.ndarray  # [P, 4]
+    main: np.ndarray  # [P, 4]
+    outer: np.ndarray  # [P, 4]
+
+
+class Buckets(NamedTuple):
+    """Static device buffers for the partition fan-out.
+
+    points: [P_pad, B, D] float; rows beyond a partition's count are zero.
+    mask: [P_pad, B] bool validity.
+    point_idx: [P_pad, B] int64 original row index, -1 on padding.
+    n_parts: true number of partitions (P_pad may include empty padding
+      partitions so the leading axis divides the mesh).
+    """
+
+    points: np.ndarray
+    mask: np.ndarray
+    point_idx: np.ndarray
+    n_parts: int
+
+
+def build_margins(rects_int: np.ndarray, cell_size: float, eps: float) -> Margins:
+    """Margins from integer partition rects (DBSCAN.scala:116-121)."""
+    main = geo.int_rects_to_float(np.asarray(rects_int).reshape(-1, 4), cell_size)
+    return Margins(
+        inner=geo.shrink(main, eps), main=main, outer=geo.shrink(main, -eps)
+    )
+
+
+def duplicate_points(
+    points: np.ndarray, outer: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """eps-halo replication: every (partition, point) pair with
+    outer.contains(point) (DBSCAN.scala:132-137), vectorized and chunked over
+    points. Returns (part_ids [M], point_idx [M]) sorted by partition then
+    point order."""
+    pts = np.asarray(points, dtype=np.float64)[:, :2]
+    P = outer.shape[0]
+    part_ids = []
+    point_idx = []
+    # bound the [P, chunk] bool intermediate regardless of partition count
+    chunk = max(1, int(2**24 // max(1, P)))
+    for s in range(0, len(pts), chunk):
+        c = pts[s : s + chunk]
+        inside = geo.contains_point(outer[:, None, :], c[None, :, :])  # [P, nc]
+        p, i = np.nonzero(inside)
+        part_ids.append(p)
+        point_idx.append(i + s)
+    part_ids = np.concatenate(part_ids) if part_ids else np.empty(0, np.int64)
+    point_idx = np.concatenate(point_idx) if point_idx else np.empty(0, np.int64)
+    order = np.lexsort((point_idx, part_ids))
+    return part_ids[order].astype(np.int64), point_idx[order]
+
+
+def bucketize(
+    points: np.ndarray,
+    part_ids: np.ndarray,
+    point_idx: np.ndarray,
+    n_parts: int,
+    bucket_multiple: int = 128,
+    pad_parts_to: int = 1,
+    dtype=np.float32,
+) -> Buckets:
+    """Pack duplicated points into static [P_pad, B, D] buffers.
+
+    B is the max per-partition count rounded up to `bucket_multiple` (bounds
+    recompilation across runs: kernels specialize on B, not exact counts).
+    P_pad rounds the partition axis up to a multiple of `pad_parts_to`
+    (device count) with empty partitions.
+    """
+    pts = np.asarray(points)
+    d = pts.shape[1]
+    counts = np.bincount(part_ids, minlength=n_parts)
+    max_count = int(counts.max()) if counts.size else 0
+    b = max(bucket_multiple, math.ceil(max(1, max_count) / bucket_multiple) * bucket_multiple)
+    p_pad = max(1, math.ceil(n_parts / pad_parts_to) * pad_parts_to)
+
+    buf = np.zeros((p_pad, b, d), dtype=dtype)
+    mask = np.zeros((p_pad, b), dtype=bool)
+    idx = np.full((p_pad, b), -1, dtype=np.int64)
+
+    if part_ids.size:
+        # part_ids is sorted; slot = position within its partition group
+        starts = np.searchsorted(part_ids, np.arange(n_parts))
+        slot = np.arange(part_ids.size) - np.repeat(starts, counts)
+        buf[part_ids, slot] = pts[point_idx].astype(dtype)
+        mask[part_ids, slot] = True
+        idx[part_ids, slot] = point_idx
+    return Buckets(points=buf, mask=mask, point_idx=idx, n_parts=n_parts)

@@ -1,0 +1,30 @@
+"""Device mesh helpers.
+
+The reference's execution fabric is the Spark RDD runtime (groupByKey fan-out
+over executor JVMs, DBSCAN.scala:150-154); ours is a 1-D `jax.sharding.Mesh`
+over the partition axis — each device processes a contiguous slab of spatial
+partitions via shard_map, with ICI carrying any cross-device layout moves.
+Multi-host (DCN) extends the same mesh via jax.distributed initialization.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+PARTS_AXIS = "parts"
+
+
+def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over all (or the given) devices, axis name 'parts'."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (PARTS_AXIS,))
+
+
+def mesh_size(mesh: Optional[Mesh]) -> int:
+    if mesh is None:
+        return 1
+    return int(np.prod(mesh.devices.shape))

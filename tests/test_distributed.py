@@ -1,0 +1,146 @@
+"""End-to-end distributed pipeline tests on the 8-device CPU mesh (the
+stand-in for the reference's local[2] integration suite, DBSCANSuite.scala).
+
+Comparison semantics: with eps-halo decomposition, distributed output equals
+the single-machine oracle exactly when clusters are separated by > 2*eps (no
+cross-cluster border bridging, which the reference's merge would over-merge —
+DBSCAN.scala:317-342 unions on any doubly-non-noise point). Tests use
+separated data for exact checks, plus the reference's own 749-point fixture
+with its exact hyperparameters."""
+
+import numpy as np
+import pytest
+
+import conftest
+import jax
+from dbscan_tpu import DBSCANConfig, Engine, train
+from dbscan_tpu.ops.labels import BORDER, CORE, NOISE
+from dbscan_tpu.parallel.mesh import make_mesh
+from dbscan_tpu.utils import reference_engines as oracle
+from dbscan_tpu.utils.ari import adjusted_rand_index, exact_match_up_to_permutation
+
+
+def separated_blobs(rng, n_per=400, centers=((0, 0), (8, 8), (-7, 9), (9, -6)), scale=0.5):
+    pts = np.concatenate([rng.normal(c, scale, size=(n_per, 2)) for c in centers])
+    noise = rng.uniform(-15, 15, size=(60, 2)) + 30  # far-away sparse noise
+    pts = np.concatenate([pts, noise])
+    rng.shuffle(pts)
+    return pts
+
+
+@pytest.mark.parametrize("engine", [Engine.ARCHERY, Engine.NAIVE])
+def test_single_partition_exact_vs_oracle(engine):
+    # max_points_per_partition large enough that everything lands in one
+    # partition: buffer order == input order, so even the order-dependent
+    # naive semantics must match the oracle EXACTLY.
+    rng = np.random.default_rng(0)
+    pts = separated_blobs(rng, n_per=150)
+    model = train(pts, eps=0.4, min_points=8, max_points_per_partition=10**6,
+                  engine=engine)
+    assert model.stats["n_partitions"] == 1
+    ofit = oracle.naive_fit if engine == Engine.NAIVE else oracle.archery_fit
+    oc, of = ofit(pts, 0.4, 8)
+    assert exact_match_up_to_permutation(model.clusters, oc)
+    np.testing.assert_array_equal(model.flags, of)
+
+
+def test_multi_partition_exact_vs_oracle_archery():
+    rng = np.random.default_rng(1)
+    pts = separated_blobs(rng)
+    model = train(pts, eps=0.4, min_points=8, max_points_per_partition=300,
+                  engine=Engine.ARCHERY)
+    assert model.stats["n_partitions"] > 1
+    oc, of = oracle.archery_fit(pts, 0.4, 8)
+    assert exact_match_up_to_permutation(model.clusters, oc)
+    # flags: core is partition-independent; border/noise equal here because
+    # clusters are separated
+    np.testing.assert_array_equal(model.flags == CORE, of == CORE)
+    np.testing.assert_array_equal(model.flags, of)
+
+
+def test_cluster_split_across_many_partitions():
+    # one huge connected blob forced through many partitions must come back
+    # as ONE global cluster (exercises halo adjacency + union-find chain)
+    rng = np.random.default_rng(2)
+    pts = rng.uniform(0, 10, size=(4000, 2))  # dense uniform square: 1 cluster
+    model = train(pts, eps=0.5, min_points=5, max_points_per_partition=250,
+                  engine=Engine.ARCHERY)
+    assert model.stats["n_partitions"] > 4
+    oc, _ = oracle.archery_fit(pts, 0.5, 5)
+    assert oc.max() == 1  # sanity: oracle sees one cluster
+    assert model.n_clusters == 1
+    assert (model.clusters == 1).all()
+
+
+@pytest.mark.parametrize("engine", [Engine.NAIVE, Engine.ARCHERY])
+def test_golden_fixture_end_to_end(engine):
+    # The reference integration test: eps=0.3F, minPoints=10,
+    # maxPointsPerPartition=250 on the 749-point fixture
+    # (DBSCANSuite.scala:36), labels must match up to permutation (:28).
+    if not conftest.reference_fixture_available():
+        pytest.skip("reference fixture not mounted")
+    pts, expected = conftest.load_reference_fixture()
+    eps = float(np.float32(0.3))
+    model = train(pts, eps=eps, min_points=10, max_points_per_partition=250,
+                  engine=engine)
+    assert model.stats["n_partitions"] > 1
+    assert exact_match_up_to_permutation(model.clusters, expected.astype(int))
+    assert adjusted_rand_index(model.clusters, expected) == 1.0
+    assert model.n_clusters == 3
+
+
+def test_mesh_matches_single_device():
+    rng = np.random.default_rng(3)
+    pts = separated_blobs(rng)
+    kw = dict(eps=0.4, min_points=8, max_points_per_partition=200,
+              engine=Engine.ARCHERY)
+    m0 = train(pts, **kw)
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+    m1 = train(pts, mesh=mesh, **kw)
+    np.testing.assert_array_equal(m0.clusters, m1.clusters)
+    np.testing.assert_array_equal(m0.flags, m1.flags)
+
+
+def test_extra_columns_ride_along():
+    if not conftest.reference_fixture_available():
+        pytest.skip("reference fixture not mounted")
+    pts, expected = conftest.load_reference_fixture()
+    data3 = np.concatenate([pts, expected[:, None]], axis=1)  # x,y,label
+    model = train(data3, eps=float(np.float32(0.3)), min_points=10,
+                  max_points_per_partition=250)
+    lp = model.labeled_points
+    assert lp.shape == (749, 5)  # x, y, orig label, cluster, flag
+    np.testing.assert_array_equal(lp[:, 2], expected)
+
+
+def test_predict_nearest_core():
+    rng = np.random.default_rng(4)
+    pts = separated_blobs(rng, n_per=200)
+    model = train(pts, eps=0.4, min_points=8, max_points_per_partition=10**6)
+    # core training points predict their own cluster
+    core = model.flags == CORE
+    pred = model.predict(pts[core][:50])
+    np.testing.assert_array_equal(pred, model.clusters[core][:50])
+    # far away -> noise
+    assert model.predict(np.array([[999.0, 999.0]]))[0] == 0
+
+
+def test_empty_and_tiny_inputs():
+    m = train(np.empty((0, 2)), eps=0.5, min_points=3)
+    assert m.n_clusters == 0 and len(m.clusters) == 0
+    m = train(np.array([[0.0, 0.0]]), eps=0.5, min_points=3)
+    assert m.clusters.tolist() == [0] and m.flags.tolist() == [int(NOISE)]
+    m = train(np.array([[0.0, 0.0], [0.1, 0.0], [0.2, 0.0]]), eps=0.5, min_points=3)
+    assert m.n_clusters == 1
+    assert (m.clusters == 1).all()
+
+
+def test_partitions_accessor_and_stats():
+    rng = np.random.default_rng(5)
+    pts = separated_blobs(rng)
+    model = train(pts, eps=0.4, min_points=8, max_points_per_partition=300)
+    assert model.stats["n_partitions"] == len(model.partitions)
+    for pid, rect in model.partitions:
+        assert rect.shape == (4,)
+    assert model.stats["duplication_factor"] >= 1.0

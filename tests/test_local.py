@@ -100,7 +100,7 @@ def test_padding_mask_is_inert():
     eps, min_points = 0.5, 5
     s1, f1, _ = run_kernel(pts, eps, min_points, "naive")
     # pad with garbage rows that would otherwise join clusters
-    pad = np.tile(pts[:7], (1, 1))
+    pad = np.tile(pts[:7], (3, 1))
     padded = np.concatenate([pts, pad])
     mask = np.concatenate([np.ones(len(pts), bool), np.zeros(len(pad), bool)])
     s2, f2, _ = run_kernel(padded, eps, min_points, "naive", mask=mask)

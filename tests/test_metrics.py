@@ -1,0 +1,93 @@
+"""Extended-metric tests: haversine and cosine through the full train() path
+(single-partition routing — the 2eps spatial decomposition is Euclidean-only),
+plus precision handling."""
+
+import numpy as np
+import pytest
+
+import jax
+from dbscan_tpu import DBSCANConfig, Precision, train
+from dbscan_tpu.ops.distance import EARTH_RADIUS_KM, get_metric
+
+
+def test_cosine_uses_all_dimensions():
+    # regression (code-review finding): two groups identical in the first two
+    # coords but opposite in the third must NOT merge under cosine
+    rng = np.random.default_rng(0)
+    base = rng.normal(size=(40, 2))
+    up = np.concatenate([base, np.full((40, 1), 1.0)], axis=1)
+    down = np.concatenate([base, np.full((40, 1), -50.0)], axis=1)
+    data = np.concatenate([up, down])
+    model = train(data, eps=0.05, min_points=3, metric="cosine")
+    assert model.stats["n_partitions"] == 1
+    assert model.n_clusters >= 2
+    # the two groups never share a cluster id
+    assert not (set(model.clusters[:40]) & set(model.clusters[40:]) - {0})
+
+
+def test_cosine_embeddings_clusters():
+    rng = np.random.default_rng(1)
+    d = 64
+    c1, c2 = rng.normal(size=(2, d))
+    a = c1 + 0.01 * rng.normal(size=(30, d))
+    b = c2 + 0.01 * rng.normal(size=(30, d))
+    data = np.concatenate([a, b])
+    model = train(data, eps=0.01, min_points=3, metric="cosine")
+    assert model.n_clusters == 2
+    assert len(set(model.clusters[:30])) == 1
+    assert len(set(model.clusters[30:])) == 1
+
+
+def test_haversine_km_scale():
+    # three points within ~150m around Manhattan + one in Brooklyn (~8 km)
+    data = np.array(
+        [
+            [-73.9851, 40.7589],
+            [-73.9855, 40.7593],
+            [-73.9860, 40.7585],
+            [-73.9442, 40.6782],
+        ]
+    )
+    model = train(data, eps=0.5, min_points=3, metric="haversine")
+    assert model.n_clusters == 1
+    assert model.clusters[3] == 0  # Brooklyn point is noise at 0.5 km eps
+
+
+def test_haversine_matches_known_distance():
+    m = get_metric("haversine")
+    # JFK (-73.7781, 40.6413) to LAX (-118.4085, 33.9416) ~ 3974-3983 km
+    d = np.asarray(m.pairwise(
+        np.array([[-73.7781, 40.6413]]), np.array([[-118.4085, 33.9416]])
+    ))[0, 0]
+    assert 3950 < d < 4010
+    assert EARTH_RADIUS_KM > 6000
+
+
+def test_f64_precision_requires_x64():
+    # conftest enables x64, so F64 must work...
+    pts = np.array([[0.0, 0.0], [0.1, 0.0], [0.2, 0.0]])
+    model = train(
+        pts, eps=0.5, min_points=3,
+        config=DBSCANConfig(eps=0.5, min_points=3, precision=Precision.F64),
+    )
+    assert model.n_clusters == 1
+
+
+def test_bf16_runs():
+    rng = np.random.default_rng(2)
+    pts = np.concatenate(
+        [rng.normal((0, 0), 0.3, (50, 2)), rng.normal((20, 20), 0.3, (50, 2))]
+    )
+    model = train(
+        pts, eps=1.0, min_points=3,
+        config=DBSCANConfig(eps=1.0, min_points=3, precision=Precision.BF16),
+    )
+    assert model.n_clusters == 2
+
+
+def test_use_pallas_not_yet_wired():
+    with pytest.raises(NotImplementedError):
+        train(
+            np.zeros((4, 2)), eps=0.5, min_points=2,
+            config=DBSCANConfig(eps=0.5, min_points=2, use_pallas=True),
+        )
