@@ -1,0 +1,132 @@
+"""Benchmark harness: distributed DBSCAN throughput on the local accelerator
+vs a CPU baseline of the SAME pipeline (XLA-CPU), plus ARI cross-check.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": <Mpoints/s on accelerator>, "unit": "Mpoints/s",
+   "vs_baseline": <accelerator/cpu speedup>, ...extras}
+
+The reference publishes no numbers (BASELINE.md); the baseline here is the
+same workload on XLA-CPU in a subprocess — a strictly stronger baseline than
+Spark-CPU's scalar JVM loops for this O(B^2)-per-partition algorithm.
+
+Env knobs: BENCH_N (points, default 200k), BENCH_MAXPP (max points per
+partition, default 2048), BENCH_CPU_N (baseline points, default min(N, 100k)).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+EPS = 0.35
+MIN_POINTS = 10
+
+
+def make_data(n: int) -> np.ndarray:
+    """Clustered + noise workload (moons/blobs-style per BASELINE.json
+    configs[0]), spread over a wide area so spatial partitioning engages."""
+    rng = np.random.default_rng(42)
+    n_clusters = max(4, n // 25000)
+    centers = rng.uniform(-60, 60, size=(n_clusters, 2))
+    per = (n * 9 // 10) // n_clusters
+    pts = np.concatenate(
+        [rng.normal(c, 0.8, size=(per, 2)) for c in centers]
+        + [rng.uniform(-70, 70, size=(n - per * n_clusters, 2))]
+    ).astype(np.float64)
+    rng.shuffle(pts)
+    return pts
+
+
+def run_train(pts, maxpp):
+    from dbscan_tpu import Engine, train
+
+    kw = dict(
+        eps=EPS,
+        min_points=MIN_POINTS,
+        max_points_per_partition=maxpp,
+        engine=Engine.ARCHERY,
+    )
+    # compile warm-up on identical shapes, then timed run
+    train(pts, **kw)
+    t0 = time.perf_counter()
+    model = train(pts, **kw)
+    dt = time.perf_counter() - t0
+    return model, dt
+
+
+def child_cpu(data_path: str, out_path: str, maxpp: int) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    pts = np.load(data_path)["pts"]
+    model, dt = run_train(pts, maxpp)
+    np.savez(out_path, clusters=model.clusters, seconds=dt, n=len(pts))
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_N", "200000"))
+    maxpp = int(os.environ.get("BENCH_MAXPP", "2048"))
+    cpu_n = int(os.environ.get("BENCH_CPU_N", str(min(n, 100000))))
+
+    if len(sys.argv) >= 4 and sys.argv[1] == "--cpu-child":
+        child_cpu(sys.argv[2], sys.argv[3], maxpp)
+        return
+
+    import jax
+
+    backend = jax.default_backend()
+    pts = make_data(n)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        data_path = os.path.join(tmp, "data.npz")
+        out_path = os.path.join(tmp, "cpu.npz")
+        np.savez(data_path, pts=pts[:cpu_n])
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--cpu-child", data_path, out_path],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+        model, dt = run_train(pts, maxpp)
+        throughput = len(pts) / dt / 1e6
+
+        proc.wait(timeout=3600)
+        cpu = np.load(out_path)
+        cpu_throughput = float(cpu["n"]) / float(cpu["seconds"]) / 1e6
+
+    # correctness cross-check on the shared prefix
+    from dbscan_tpu.utils.ari import adjusted_rand_index
+
+    ari = adjusted_rand_index(model.clusters[:cpu_n], cpu["clusters"])
+
+    print(
+        json.dumps(
+            {
+                "metric": "dbscan_2d_euclidean_throughput",
+                "value": round(throughput, 4),
+                "unit": "Mpoints/s",
+                "vs_baseline": round(throughput / max(cpu_throughput, 1e-12), 3),
+                "backend": backend,
+                "n_points": n,
+                "cpu_baseline_mpts": round(cpu_throughput, 4),
+                "ari_vs_cpu": round(float(ari), 6),
+                "n_clusters": model.n_clusters,
+                "n_partitions": model.stats["n_partitions"],
+                "seconds": round(dt, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

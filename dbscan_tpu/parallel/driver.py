@@ -36,13 +36,14 @@ import logging
 from typing import List, NamedTuple, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec
 
 from dbscan_tpu.config import DBSCANConfig
 from dbscan_tpu.ops import geometry as geo
-from dbscan_tpu.ops.labels import NOISE, SEED_NONE
+from dbscan_tpu.ops.labels import CORE, NOISE, SEED_NONE
 from dbscan_tpu.ops.local_dbscan import local_dbscan
 from dbscan_tpu.parallel import binning, partitioner
 from dbscan_tpu.parallel.graph import UnionFind
@@ -82,20 +83,29 @@ def _run_partitions(bucket_pts, bucket_mask, cfg: DBSCANConfig, mesh):
         return r.seed_labels, r.flags
 
     def block(pts_blk, msk_blk):
-        return lax.map(one, (pts_blk, msk_blk), batch_size=batch)
+        seeds, flags = lax.map(one, (pts_blk, msk_blk), batch_size=batch)
+        # Global core count via all-reduce over the mesh. Derivable on host,
+        # but kept in the compiled step deliberately: it keeps one real ICI
+        # collective in the production program (the comms-backend analog of
+        # the reference's aggregate-to-driver pass) so multichip dryruns
+        # validate the communication path, at the cost of one fused scalar.
+        ncore = jnp.sum(flags == CORE, dtype=jnp.int32)
+        if mesh is not None:
+            ncore = lax.psum(ncore, PARTS_AXIS)
+        return seeds, flags, ncore
 
     if mesh is None:
-        seeds, flags = jax.jit(block)(bucket_pts, bucket_mask)
+        seeds, flags, ncore = jax.jit(block)(bucket_pts, bucket_mask)
     else:
         spec = PartitionSpec(PARTS_AXIS)
         fn = jax.shard_map(
             block,
             mesh=mesh,
             in_specs=(spec, spec),
-            out_specs=(spec, spec),
+            out_specs=(spec, spec, PartitionSpec()),
         )
-        seeds, flags = jax.jit(fn)(bucket_pts, bucket_mask)
-    return np.asarray(seeds), np.asarray(flags)
+        seeds, flags, ncore = jax.jit(fn)(bucket_pts, bucket_mask)
+    return np.asarray(seeds), np.asarray(flags), int(ncore)
 
 
 def _local_ids(seeds: np.ndarray, valid: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -164,7 +174,18 @@ def train_arrays(
     n = len(pts)
     if n == 0:
         return TrainOutput(
-            np.empty(0, np.int32), np.empty(0, np.int8), [], 0, {"n_points": 0}
+            np.empty(0, np.int32),
+            np.empty(0, np.int8),
+            [],
+            0,
+            {
+                "n_points": 0,
+                "n_partitions": 0,
+                "bucket_size": 0,
+                "duplication_factor": 0.0,
+                "n_clusters": 0,
+                "n_core_instances": 0,
+            },
         )
 
     cell = cfg.minimum_rectangle_size
@@ -225,7 +246,7 @@ def train_arrays(
     )
 
     # 5. per-partition clustering on device.
-    seeds, flags = _run_partitions(buckets.points, buckets.mask, cfg, mesh)
+    seeds, flags, n_core = _run_partitions(buckets.points, buckets.mask, cfg, mesh)
     p_true = buckets.n_parts
     seeds = seeds[:p_true]
     flags = flags[:p_true]
@@ -342,5 +363,6 @@ def train_arrays(
         "bucket_size": int(buckets.points.shape[1]),
         "duplication_factor": float(len(part_ids)) / max(1, n),
         "n_clusters": n_clusters,
+        "n_core_instances": n_core,
     }
     return TrainOutput(res_cluster, res_flag, partitions, n_clusters, stats)
