@@ -91,6 +91,7 @@ def train(
     metric: str = "euclidean",
     precision: Precision = Precision.F32,
     bucket_multiple: int = 128,
+    use_pallas: bool = False,
     mesh=None,
     config: Optional[DBSCANConfig] = None,
 ) -> DBSCANModel:
@@ -111,6 +112,7 @@ def train(
         metric=metric,
         precision=precision,
         bucket_multiple=bucket_multiple,
+        use_pallas=use_pallas,
     )
     out: TrainOutput = train_arrays(data, cfg, mesh=mesh)
     return DBSCANModel(

@@ -99,7 +99,7 @@ def _components_min_label(adj_cc: jnp.ndarray, core: jnp.ndarray) -> jnp.ndarray
 
 
 @functools.partial(
-    jax.jit, static_argnames=("min_points", "engine", "metric")
+    jax.jit, static_argnames=("min_points", "engine", "metric", "use_pallas")
 )
 def local_dbscan(
     points: jnp.ndarray,
@@ -108,6 +108,7 @@ def local_dbscan(
     min_points: int,
     engine: str = "naive",
     metric: str = "euclidean",
+    use_pallas: bool = False,
 ) -> LocalResult:
     """Cluster one (padded) partition.
 
@@ -120,34 +121,48 @@ def local_dbscan(
       min_points: self-inclusive density threshold (static).
       engine: "naive" | "archery" — see module docstring (static).
       metric: registered metric name (static).
+      use_pallas: route the adjacency sweeps through the streaming Pallas
+        kernels (O(N) memory, euclidean 2-D only) instead of the
+        materialized [N, N] XLA form (static).
 
     Returns a :class:`LocalResult` of [N] arrays.
     """
     if engine not in ("naive", "archery"):
         raise ValueError(f"unknown engine {engine!r}")
-    m = dist_mod.get_metric(metric)
     n = points.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
     none = jnp.int32(SEED_NONE)
 
-    measure = m.pairwise(points, points)
-    thr = m.threshold(jnp.asarray(eps, dtype=measure.dtype))
-    adj = (measure <= thr) & mask[None, :] & mask[:, None]
-    # Self-adjacency for every valid point: guaranteed for euclidean/cosine
-    # (measure 0 at the diagonal) but made explicit so counts are
-    # self-inclusive under any registered metric.
-    adj = adj | (jnp.eye(n, dtype=bool) & mask[:, None])
+    if use_pallas:
+        if metric != "euclidean":
+            raise ValueError(
+                f"use_pallas supports only the euclidean metric, got {metric!r}"
+            )
+        from dbscan_tpu.ops.pallas_kernel import pallas_engine
 
-    counts = jnp.sum(adj, axis=1, dtype=jnp.int32)
-    core = (counts >= jnp.int32(min_points)) & mask
+        counts, core, comp, core_nbr_seed = pallas_engine(
+            points, mask, eps, min_points
+        )
+    else:
+        m = dist_mod.get_metric(metric)
+        measure = m.pairwise(points, points)
+        thr = m.threshold(jnp.asarray(eps, dtype=measure.dtype))
+        adj = (measure <= thr) & mask[None, :] & mask[:, None]
+        # Self-adjacency for every valid point: guaranteed for
+        # euclidean/cosine (measure 0 at the diagonal) but made explicit so
+        # counts are self-inclusive under any registered metric.
+        adj = adj | (jnp.eye(n, dtype=bool) & mask[:, None])
 
-    adj_cc = adj & core[None, :] & core[:, None]
-    comp = _components_min_label(adj_cc, core)
+        counts = jnp.sum(adj, axis=1, dtype=jnp.int32)
+        core = (counts >= jnp.int32(min_points)) & mask
 
-    # Minimum seed index among eps-adjacent cores (for cores: own component).
-    core_nbr_seed = jnp.min(
-        jnp.where(adj & core[None, :], comp[None, :], none), axis=1
-    )
+        adj_cc = adj & core[None, :] & core[:, None]
+        comp = _components_min_label(adj_cc, core)
+
+        # Min seed index among eps-adjacent cores (for cores: own component).
+        core_nbr_seed = jnp.min(
+            jnp.where(adj & core[None, :], comp[None, :], none), axis=1
+        )
 
     has_core_nbr = core_nbr_seed != none
     if engine == "naive":

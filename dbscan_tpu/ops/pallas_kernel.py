@@ -1,0 +1,256 @@
+"""Pallas TPU kernels for the per-partition DBSCAN hot loop.
+
+The XLA path in :mod:`dbscan_tpu.ops.local_dbscan` materializes the full
+[N, N] eps-adjacency in HBM — fine for small partition buckets, quadratic
+memory for large ones. These kernels stream (row-tile x col-tile) blocks
+through VMEM instead, recomputing the tiny 2-D distance math per sweep
+(a handful of VPU flops per pair) so memory stays O(N) no matter how large
+the bucket. This is the "never materialize N x N, stream tile pairs"
+strategy from SURVEY.md section 7 and replaces the reference's O(n^2)
+scalar scan (LocalDBSCANNaive.scala:72-78) with hardware-shaped tiles.
+
+Two sweeps, both with grid (rows/T, cols/T) and an output block revisited
+across the column dimension (init at j == 0, accumulate after):
+
+- ``neighbor_counts``: per-row count of valid eps-neighbors, self-inclusive
+  (d^2 to itself is 0), accumulated with ``+``.
+- ``neighbor_min_label``: per-row minimum of ``labels[j]`` over eps-adjacent
+  columns with ``col_mask`` set, accumulated with ``min``. One such sweep is
+  one step of min-label propagation; at the fixed point it also yields each
+  non-core row's minimum adjacent core seed (the border-assignment input).
+
+Coordinates are fed twice — as an [N, 1] column vector for rows and a
+[1, N] row vector for columns — so the (T, T) broadcast needs no in-kernel
+relayout. Scalars ride in SMEM. Padding rows/cols are masked out by
+``mask`` / ``col_mask``; callers pad N to a tile multiple via the wrappers.
+
+On non-TPU backends the kernels run in interpreter mode, which is how the
+CPU test suite validates them bit-for-bit against the XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dbscan_tpu.ops.labels import SEED_NONE
+
+# Row/col tile edge. (T, T) f32/int32 intermediates must fit VMEM several
+# times over: 256^2 * 4 B = 256 KiB per buffer — comfortable.
+TILE = 256
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pad_to_tile(a: jnp.ndarray, fill) -> jnp.ndarray:
+    n = a.shape[0]
+    pad = (-n) % TILE
+    if pad == 0:
+        return a
+    return jnp.pad(a, ((0, pad),), constant_values=fill)
+
+
+def _counts_kernel(eps2_ref, xr, yr, vr, xc, yc, vc, out):
+    j = pl.program_id(1)
+    dx = xr[:] - xc[:]  # (T,1) - (1,T) -> (T,T)
+    dy = yr[:] - yc[:]
+    d2 = dx * dx + dy * dy
+    adj = (d2 <= eps2_ref[0, 0]) & (vr[:] > 0.5) & (vc[:] > 0.5)
+    partial = jnp.sum(
+        jnp.where(adj, jnp.float32(1.0), jnp.float32(0.0)),
+        axis=1,
+        keepdims=True,
+    )
+
+    @pl.when(j == 0)
+    def _():
+        out[:] = partial
+
+    @pl.when(j > 0)
+    def _():
+        out[:] = out[:] + partial
+
+
+def _min_label_kernel(eps2_ref, xr, yr, vr, xc, yc, cmask, lab, out):
+    j = pl.program_id(1)
+    dx = xr[:] - xc[:]
+    dy = yr[:] - yc[:]
+    d2 = dx * dx + dy * dy
+    adj = (d2 <= eps2_ref[0, 0]) & (vr[:] > 0.5) & (cmask[:] > 0.5)
+    partial = jnp.min(
+        jnp.where(adj, lab[:], jnp.int32(SEED_NONE)), axis=1, keepdims=True
+    )
+
+    @pl.when(j == 0)
+    def _():
+        out[:] = partial
+
+    @pl.when(j > 0)
+    def _():
+        out[:] = jnp.minimum(out[:], partial)
+
+
+def _row_spec():
+    return pl.BlockSpec((TILE, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
+
+
+def _col_spec():
+    return pl.BlockSpec((1, TILE), lambda i, j: (0, j), memory_space=pltpu.VMEM)
+
+
+def _smem_spec():
+    return pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
+
+
+def _grid_params(n: int):
+    grid = (n // TILE, n // TILE)
+    compiler_params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary")
+    )
+    return grid, compiler_params
+
+
+def neighbor_counts(
+    points: jnp.ndarray, mask: jnp.ndarray, eps2: jnp.ndarray
+) -> jnp.ndarray:
+    """Self-inclusive eps-neighbor counts.
+
+    points: [N, 2] float; mask: [N] bool; eps2: scalar threshold on squared
+    distance. Returns [N] int32. Equivalent to
+    ``sum_j [d2(i,j) <= eps2 and mask_i and mask_j]``.
+    """
+    n = points.shape[0]
+    x = _pad_to_tile(points[:, 0].astype(jnp.float32), 0.0)
+    y = _pad_to_tile(points[:, 1].astype(jnp.float32), 0.0)
+    v = _pad_to_tile(mask.astype(jnp.float32), 0.0)
+    npad = x.shape[0]
+    grid, compiler_params = _grid_params(npad)
+    out = pl.pallas_call(
+        _counts_kernel,
+        grid=grid,
+        in_specs=[
+            _smem_spec(),
+            _row_spec(),
+            _row_spec(),
+            _row_spec(),
+            _col_spec(),
+            _col_spec(),
+            _col_spec(),
+        ],
+        out_specs=_row_spec(),
+        out_shape=jax.ShapeDtypeStruct((npad, 1), jnp.float32),
+        compiler_params=compiler_params,
+        interpret=_interpret(),
+    )(
+        jnp.asarray(eps2, jnp.float32).reshape(1, 1),
+        x[:, None],
+        y[:, None],
+        v[:, None],
+        x[None, :],
+        y[None, :],
+        v[None, :],
+    )
+    return out[:n, 0].astype(jnp.int32)
+
+
+def neighbor_min_label(
+    points: jnp.ndarray,
+    mask: jnp.ndarray,
+    col_mask: jnp.ndarray,
+    labels: jnp.ndarray,
+    eps2: jnp.ndarray,
+) -> jnp.ndarray:
+    """Per-row min of ``labels[j]`` over eps-adjacent cols with col_mask set.
+
+    Rows with ``mask`` unset, or with no qualifying neighbor, return
+    SEED_NONE. One call is one masked min-propagation step.
+    """
+    n = points.shape[0]
+    x = _pad_to_tile(points[:, 0].astype(jnp.float32), 0.0)
+    y = _pad_to_tile(points[:, 1].astype(jnp.float32), 0.0)
+    v = _pad_to_tile(mask.astype(jnp.float32), 0.0)
+    c = _pad_to_tile(col_mask.astype(jnp.float32), 0.0)
+    lab = _pad_to_tile(labels.astype(jnp.int32), SEED_NONE)
+    npad = x.shape[0]
+    grid, compiler_params = _grid_params(npad)
+    out = pl.pallas_call(
+        _min_label_kernel,
+        grid=grid,
+        in_specs=[
+            _smem_spec(),
+            _row_spec(),
+            _row_spec(),
+            _row_spec(),
+            _col_spec(),
+            _col_spec(),
+            _col_spec(),
+            _col_spec(),
+        ],
+        out_specs=_row_spec(),
+        out_shape=jax.ShapeDtypeStruct((npad, 1), jnp.int32),
+        compiler_params=compiler_params,
+        interpret=_interpret(),
+    )(
+        jnp.asarray(eps2, jnp.float32).reshape(1, 1),
+        x[:, None],
+        y[:, None],
+        v[:, None],
+        x[None, :],
+        y[None, :],
+        c[None, :],
+        lab[None, :],
+    )
+    return out[:n, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("min_points",))
+def pallas_engine(points, mask, eps, min_points):
+    """counts / core / component seeds via the streaming sweeps.
+
+    Returns (counts [N] i32, core [N] bool, comp [N] i32 — component seed on
+    core rows else SEED_NONE, core_nbr_seed [N] i32 — min adjacent core seed,
+    meaningful for non-core rows).
+
+    The propagation loop runs min-sweeps over core columns for ALL rows:
+    core rows converge to their component minimum (seed index) exactly as
+    the XLA path's masked matrix-min does, and non-core rows converge — one
+    step behind — to the min seed among their adjacent cores, which is
+    precisely the border-assignment input. The pointer-jump
+    (``labels[labels]`` gather) stays plain XLA between sweeps.
+    """
+    n = points.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    none = jnp.int32(SEED_NONE)
+    eps2 = jnp.asarray(eps, jnp.float32) ** 2
+
+    counts = neighbor_counts(points, mask, eps2)
+    core = (counts >= jnp.int32(min_points)) & mask
+    init = jnp.where(core, idx, none)
+
+    def cond(state):
+        _, changed = state
+        return changed
+
+    def body(state):
+        labels, _ = state
+        nbr = neighbor_min_label(points, mask, core, labels, eps2)
+        new = jnp.minimum(labels, nbr)
+        safe = jnp.clip(new, 0, n - 1)
+        hop = jnp.where(new == none, none, new[safe])
+        new = jnp.minimum(new, hop)
+        return new, jnp.any(new != labels)
+
+    # Unrolled first step: gives the while_loop a data-derived carry (needed
+    # under shard_map) and is idempotent at the fixed point.
+    state = body((init, jnp.bool_(True)))
+    final, _ = jax.lax.while_loop(cond, body, state)
+
+    comp = jnp.where(core, final, none)
+    core_nbr_seed = final
+    return counts, core, comp, core_nbr_seed

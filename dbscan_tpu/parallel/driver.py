@@ -72,13 +72,20 @@ def _run_partitions(bucket_pts, bucket_mask, cfg: DBSCANConfig, mesh):
     min_points = int(cfg.min_points)
     engine = cfg.engine.value
     metric = cfg.metric
+    use_pallas = bool(cfg.use_pallas)
     p_total = bucket_pts.shape[0]
     batch = max(1, min(8, p_total // max(1, mesh_size(mesh))))
 
     def one(args):
         pts, msk = args
         r = local_dbscan(
-            pts, msk, eps, min_points, engine=engine, metric=metric
+            pts,
+            msk,
+            eps,
+            min_points,
+            engine=engine,
+            metric=metric,
+            use_pallas=use_pallas,
         )
         return r.seed_labels, r.flags
 
@@ -164,9 +171,15 @@ def train_arrays(
     flags aligned with the input row order.
     """
     cfg = cfg.validate()
-    if cfg.use_pallas:
-        raise NotImplementedError(
-            "use_pallas: the Pallas kernel path is not wired up yet"
+    if cfg.use_pallas and cfg.metric != "euclidean":
+        raise ValueError(
+            "use_pallas supports only the euclidean metric; got "
+            f"{cfg.metric!r}"
+        )
+    if cfg.use_pallas and cfg.precision.value == "f64":
+        raise ValueError(
+            "use_pallas computes in f32 (TPU Pallas has no f64); use "
+            "Precision.F32 or the XLA path for f64 parity runs"
         )
     pts = np.asarray(points, dtype=np.float64)
     if pts.ndim != 2 or pts.shape[1] < 2:

@@ -85,9 +85,14 @@ def test_bf16_runs():
     assert model.n_clusters == 2
 
 
-def test_use_pallas_not_yet_wired():
-    with pytest.raises(NotImplementedError):
+def test_use_pallas_rejects_f64():
+    from dbscan_tpu.config import Precision
+
+    with pytest.raises(ValueError, match="f32"):
         train(
             np.zeros((4, 2)), eps=0.5, min_points=2,
-            config=DBSCANConfig(eps=0.5, min_points=2, use_pallas=True),
+            config=DBSCANConfig(
+                eps=0.5, min_points=2, use_pallas=True,
+                precision=Precision.F64,
+            ),
         )

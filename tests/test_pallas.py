@@ -1,0 +1,102 @@
+"""Pallas streaming-sweep kernels: bit-parity with the materialized XLA path.
+
+On CPU the kernels run in interpreter mode (same program, pure-JAX
+semantics); the real Mosaic lowering is exercised on TPU by bench.py and the
+driver harness. Parity here is exact — both paths make identical f32
+eps-boundary decisions, so labels/flags/counts must match elementwise, not
+just up to permutation.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dbscan_tpu import Engine, train
+from dbscan_tpu.ops.labels import SEED_NONE
+from dbscan_tpu.ops.local_dbscan import local_dbscan
+from dbscan_tpu.ops.pallas_kernel import TILE, neighbor_counts, neighbor_min_label
+
+
+def _blobs(rng, n, spread=8.0):
+    centers = rng.uniform(-spread, spread, size=(max(2, n // 200), 2))
+    per = n // len(centers)
+    pts = np.concatenate(
+        [rng.normal(c, 0.5, size=(per, 2)) for c in centers]
+        + [rng.uniform(-spread, spread, size=(n - per * len(centers), 2))]
+    )
+    rng.shuffle(pts)
+    return pts.astype(np.float32)
+
+
+def test_neighbor_counts_matches_bruteforce(rng):
+    n = 300  # deliberately not a TILE multiple
+    pts = _blobs(rng, n)
+    mask = np.ones(n, dtype=bool)
+    mask[::17] = False
+    eps = 0.7
+    got = np.asarray(neighbor_counts(jnp.asarray(pts), jnp.asarray(mask), eps**2))
+    d2 = ((pts[:, None, :] - pts[None, :, :]) ** 2).sum(-1)
+    want = ((d2 <= eps**2) & mask[None, :] & mask[:, None]).sum(1)
+    assert got.shape == (n,)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_neighbor_min_label_matches_bruteforce(rng):
+    n = TILE + 37
+    pts = _blobs(rng, n)
+    mask = np.ones(n, dtype=bool)
+    col_mask = rng.random(n) < 0.4
+    labels = rng.integers(0, n, size=n).astype(np.int32)
+    eps = 0.5
+    got = np.asarray(
+        neighbor_min_label(
+            jnp.asarray(pts),
+            jnp.asarray(mask),
+            jnp.asarray(col_mask),
+            jnp.asarray(labels),
+            eps**2,
+        )
+    )
+    d2 = ((pts[:, None, :] - pts[None, :, :]) ** 2).sum(-1)
+    adj = (d2 <= eps**2) & col_mask[None, :] & mask[:, None]
+    want = np.where(adj, labels[None, :], SEED_NONE).min(1)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("engine", ["naive", "archery"])
+@pytest.mark.parametrize("n", [100, 256, 777])
+def test_local_kernel_parity(rng, engine, n):
+    pts = jnp.asarray(_blobs(rng, n))
+    mask_np = np.ones(n, dtype=bool)
+    mask_np[rng.random(n) < 0.1] = False
+    mask = jnp.asarray(mask_np)
+    ref = local_dbscan(pts, mask, 0.6, 6, engine=engine)
+    got = local_dbscan(pts, mask, 0.6, 6, engine=engine, use_pallas=True)
+    np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(ref.counts))
+    np.testing.assert_array_equal(np.asarray(got.flags), np.asarray(ref.flags))
+    np.testing.assert_array_equal(
+        np.asarray(got.seed_labels), np.asarray(ref.seed_labels)
+    )
+
+
+def test_train_end_to_end_parity(rng):
+    pts = _blobs(rng, 3000, spread=25.0).astype(np.float64)
+    kw = dict(eps=0.5, min_points=8, max_points_per_partition=400)
+    ref = train(pts, engine=Engine.ARCHERY, **kw)
+    got = train(pts, engine=Engine.ARCHERY, use_pallas=True, **kw)
+    np.testing.assert_array_equal(got.clusters, ref.clusters)
+    np.testing.assert_array_equal(got.flags, ref.flags)
+    assert got.n_clusters == ref.n_clusters
+
+
+def test_pallas_rejects_non_euclidean(rng):
+    pts = _blobs(rng, 64)
+    with pytest.raises(ValueError, match="euclidean"):
+        train(
+            pts.astype(np.float64),
+            eps=0.5,
+            min_points=5,
+            metric="cosine",
+            use_pallas=True,
+        )
