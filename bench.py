@@ -10,7 +10,9 @@ same workload on XLA-CPU in a subprocess — a strictly stronger baseline than
 Spark-CPU's scalar JVM loops for this O(B^2)-per-partition algorithm.
 
 Env knobs: BENCH_N (points, default 200k), BENCH_MAXPP (max points per
-partition, default 2048), BENCH_CPU_N (baseline points, default min(N, 100k)).
+partition, default 2048), BENCH_CPU_N (baseline points, default min(N, 100k)),
+BENCH_PALLAS (1 = route the accelerator run through the streaming Pallas
+kernels; the CPU baseline always uses the XLA path).
 """
 
 import json
@@ -44,7 +46,7 @@ def make_data(n: int) -> np.ndarray:
     return pts
 
 
-def run_train(pts, maxpp):
+def run_train(pts, maxpp, use_pallas=False):
     from dbscan_tpu import Engine, train
 
     kw = dict(
@@ -52,6 +54,7 @@ def run_train(pts, maxpp):
         min_points=MIN_POINTS,
         max_points_per_partition=maxpp,
         engine=Engine.ARCHERY,
+        use_pallas=use_pallas,
     )
     # compile warm-up on identical shapes, then timed run
     train(pts, **kw)
@@ -97,7 +100,8 @@ def main() -> None:
             stderr=subprocess.DEVNULL,
         )
 
-        model, dt = run_train(pts, maxpp)
+        use_pallas = os.environ.get("BENCH_PALLAS", "0") == "1"
+        model, dt = run_train(pts, maxpp, use_pallas=use_pallas)
         throughput = len(pts) / dt / 1e6
 
         proc.wait(timeout=3600)

@@ -43,6 +43,7 @@ from jax import lax
 
 from dbscan_tpu.ops import distance as dist_mod
 from dbscan_tpu.ops.labels import BORDER, CORE, NOISE, NOT_FLAGGED, SEED_NONE
+from dbscan_tpu.ops.propagation import min_label_fixed_point
 
 
 class LocalResult(NamedTuple):
@@ -60,42 +61,17 @@ class LocalResult(NamedTuple):
 
 
 def _components_min_label(adj_cc: jnp.ndarray, core: jnp.ndarray) -> jnp.ndarray:
-    """Min-row-index label per connected component of the core-core adjacency.
-
-    Label propagation (masked neighbor-min) + one pointer jump per iteration
-    inside a while_loop. Invariants: labels only decrease; a core's label is
-    always a core row index within its own component and <= its own index; so
-    the fixed point is the component minimum — the "seed index". Non-core
-    rows hold SEED_NONE throughout.
-    """
+    """Min-row-index label per connected component of the core-core adjacency
+    (the "seed index"); non-core rows hold SEED_NONE throughout."""
     n = core.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
     none = jnp.int32(SEED_NONE)
     init = jnp.where(core, idx, none)
 
-    def cond(state):
-        _, changed = state
-        return changed
+    def neighbor_min(labels):
+        return jnp.min(jnp.where(adj_cc, labels[None, :], none), axis=1)
 
-    def body(state):
-        labels, _ = state
-        nbr_min = jnp.min(
-            jnp.where(adj_cc, labels[None, :], none), axis=1
-        )
-        new = jnp.minimum(labels, nbr_min)
-        # pointer jump: adopt the label of my current label (a smaller-index
-        # core in the same component) — collapses chains logarithmically
-        safe = jnp.clip(new, 0, n - 1)
-        hop = jnp.where(new == none, none, new[safe])
-        new = jnp.minimum(new, hop)
-        return new, jnp.any(new != labels)
-
-    # One unrolled body step first: the while_loop carry must be
-    # data-derived ("varying") for shard_map, and a constant True init is
-    # not; semantically free since body is idempotent at the fixed point.
-    state = body((init, jnp.bool_(True)))
-    labels, _ = lax.while_loop(cond, body, state)
-    return labels
+    return min_label_fixed_point(init, neighbor_min)
 
 
 @functools.partial(
@@ -137,6 +113,11 @@ def local_dbscan(
         if metric != "euclidean":
             raise ValueError(
                 f"use_pallas supports only the euclidean metric, got {metric!r}"
+            )
+        if points.shape[1] != 2:
+            raise ValueError(
+                "use_pallas supports only 2-D points (the sweeps read x/y "
+                f"columns); got D={points.shape[1]} — use the XLA path"
             )
         from dbscan_tpu.ops.pallas_kernel import pallas_engine
 

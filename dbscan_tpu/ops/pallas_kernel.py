@@ -25,7 +25,9 @@ relayout. Scalars ride in SMEM. Padding rows/cols are masked out by
 ``mask`` / ``col_mask``; callers pad N to a tile multiple via the wrappers.
 
 On non-TPU backends the kernels run in interpreter mode, which is how the
-CPU test suite validates them bit-for-bit against the XLA path.
+CPU test suite validates them bit-for-bit against the XLA path; the real
+Mosaic lowering is exercised on TPU via ``bench.py`` with ``BENCH_PALLAS=1``
+and by the driver harness's bench runs.
 """
 
 from __future__ import annotations
@@ -38,6 +40,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from dbscan_tpu.ops.labels import SEED_NONE
+from dbscan_tpu.ops.propagation import min_label_fixed_point
 
 # Row/col tile edge. (T, T) f32/int32 intermediates must fit VMEM several
 # times over: 256^2 * 4 B = 256 KiB per buffer — comfortable.
@@ -233,23 +236,10 @@ def pallas_engine(points, mask, eps, min_points):
     core = (counts >= jnp.int32(min_points)) & mask
     init = jnp.where(core, idx, none)
 
-    def cond(state):
-        _, changed = state
-        return changed
+    def neighbor_min(labels):
+        return neighbor_min_label(points, mask, core, labels, eps2)
 
-    def body(state):
-        labels, _ = state
-        nbr = neighbor_min_label(points, mask, core, labels, eps2)
-        new = jnp.minimum(labels, nbr)
-        safe = jnp.clip(new, 0, n - 1)
-        hop = jnp.where(new == none, none, new[safe])
-        new = jnp.minimum(new, hop)
-        return new, jnp.any(new != labels)
-
-    # Unrolled first step: gives the while_loop a data-derived carry (needed
-    # under shard_map) and is idempotent at the fixed point.
-    state = body((init, jnp.bool_(True)))
-    final, _ = jax.lax.while_loop(cond, body, state)
+    final = min_label_fixed_point(init, neighbor_min)
 
     comp = jnp.where(core, final, none)
     core_nbr_seed = final

@@ -1,0 +1,58 @@
+"""Shared min-label fixed-point harness for connected components.
+
+Both local-engine backends (materialized XLA adjacency and streaming Pallas
+sweeps) find connected components by the same iteration: masked neighbor-min
+propagation plus one pointer jump per step inside ``lax.while_loop``. Only
+the neighbor-min computation differs, so the convergence harness lives here
+once. Invariants: labels only decrease; a core row's label is always a core
+row index inside its own component and <= its own index; the fixed point is
+the component minimum — the "seed index" (the fold index of the point that
+would have seeded the cluster in the reference's sequential scan,
+LocalDBSCANNaive.scala:45-64).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+from dbscan_tpu.ops.labels import SEED_NONE
+
+
+def min_label_fixed_point(
+    init: jnp.ndarray, neighbor_min: Callable[[jnp.ndarray], jnp.ndarray]
+) -> jnp.ndarray:
+    """Iterate ``labels -> min(labels, neighbor_min(labels), hop)`` to a fixed
+    point.
+
+    init: [N] int32 starting labels (row index on active rows, SEED_NONE
+      elsewhere).
+    neighbor_min: labels -> [N] int32 per-row min of neighbor labels
+      (SEED_NONE where no neighbor qualifies).
+
+    The pointer jump (``new[new]`` gather, chain-collapsing) keeps iteration
+    count O(log diameter) instead of O(diameter) for chain-shaped clusters.
+    """
+    n = init.shape[0]
+    none = jnp.int32(SEED_NONE)
+
+    def cond(state):
+        _, changed = state
+        return changed
+
+    def body(state):
+        labels, _ = state
+        new = jnp.minimum(labels, neighbor_min(labels))
+        safe = jnp.clip(new, 0, n - 1)
+        hop = jnp.where(new == none, none, new[safe])
+        new = jnp.minimum(new, hop)
+        return new, jnp.any(new != labels)
+
+    # One unrolled body step first: the while_loop carry must be
+    # data-derived ("varying") for shard_map, and a constant True init is
+    # not; semantically free since body is idempotent at the fixed point.
+    state = body((init, jnp.bool_(True)))
+    labels, _ = lax.while_loop(cond, body, state)
+    return labels

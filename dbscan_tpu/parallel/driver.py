@@ -176,10 +176,12 @@ def train_arrays(
             "use_pallas supports only the euclidean metric; got "
             f"{cfg.metric!r}"
         )
-    if cfg.use_pallas and cfg.precision.value == "f64":
+    if cfg.use_pallas and cfg.precision.value != "f32":
         raise ValueError(
-            "use_pallas computes in f32 (TPU Pallas has no f64); use "
-            "Precision.F32 or the XLA path for f64 parity runs"
+            "use_pallas computes distances in f32 only (no f64 on TPU "
+            "Pallas; bf16 inputs would silently upcast, diverging from "
+            f"the XLA bf16 kernel); got precision={cfg.precision.value!r} "
+            "— use Precision.F32 or the XLA path"
         )
     pts = np.asarray(points, dtype=np.float64)
     if pts.ndim != 2 or pts.shape[1] < 2:

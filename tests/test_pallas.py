@@ -1,8 +1,9 @@
 """Pallas streaming-sweep kernels: bit-parity with the materialized XLA path.
 
 On CPU the kernels run in interpreter mode (same program, pure-JAX
-semantics); the real Mosaic lowering is exercised on TPU by bench.py and the
-driver harness. Parity here is exact — both paths make identical f32
+semantics); the real Mosaic lowering is exercised on TPU via
+``BENCH_PALLAS=1 python bench.py`` and the driver harness's bench runs.
+Parity here is exact — both paths make identical f32
 eps-boundary decisions, so labels/flags/counts must match elementwise, not
 just up to permutation.
 """
@@ -88,6 +89,24 @@ def test_train_end_to_end_parity(rng):
     np.testing.assert_array_equal(got.clusters, ref.clusters)
     np.testing.assert_array_equal(got.flags, ref.flags)
     assert got.n_clusters == ref.n_clusters
+
+
+def test_pallas_rejects_3d_points(rng):
+    pts = jnp.asarray(rng.normal(size=(64, 3)).astype(np.float32))
+    mask = jnp.ones(64, dtype=bool)
+    with pytest.raises(ValueError, match="2-D"):
+        local_dbscan(pts, mask, 0.5, 4, use_pallas=True)
+
+
+def test_pallas_rejects_bf16_precision(rng):
+    from dbscan_tpu.config import Precision
+
+    pts = _blobs(rng, 64).astype(np.float64)
+    with pytest.raises(ValueError, match="f32"):
+        train(
+            pts, eps=0.5, min_points=5,
+            precision=Precision.BF16, use_pallas=True,
+        )
 
 
 def test_pallas_rejects_non_euclidean(rng):
