@@ -21,6 +21,16 @@ labeled_points / partitions / predict) while staying idiomatic JAX.
 from dbscan_tpu.config import DBSCANConfig, Engine, Precision
 from dbscan_tpu.ops.labels import CORE, BORDER, NOISE, NOT_FLAGGED, UNKNOWN
 from dbscan_tpu.models.dbscan import DBSCANModel, train
+from dbscan_tpu.streaming import StreamingDBSCAN
+
+
+def sparse_cosine_dbscan(*args, **kwargs):
+    """Lazy re-export of :func:`dbscan_tpu.ops.sparse.sparse_cosine_dbscan`
+    (keeps scipy an optional import for the dense-only paths)."""
+    from dbscan_tpu.ops.sparse import sparse_cosine_dbscan as impl
+
+    return impl(*args, **kwargs)
+
 
 __version__ = "0.1.0"
 
@@ -30,6 +40,8 @@ __all__ = [
     "Precision",
     "DBSCANModel",
     "train",
+    "StreamingDBSCAN",
+    "sparse_cosine_dbscan",
     "CORE",
     "BORDER",
     "NOISE",

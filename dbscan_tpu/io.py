@@ -1,0 +1,120 @@
+"""Point-set I/O: CSV / Parquet / NumPy loaders and labeled-output writers.
+
+The reference's only I/O is the sample driver's ``sc.textFile`` CSV parse and
+``saveAsTextFile`` of ``"x,y,cluster"`` lines with hardcoded Windows paths
+(DBSCANSample.scala:18-20,35). Here the same capability is a proper module:
+format inferred from the extension (or forced), plain host-side readers
+feeding the device pipeline, and writers that emit the reference's
+``x,y,cluster`` shape plus a flag column.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+_CSV_EXTS = {".csv", ".txt", ".tsv"}
+_PARQUET_EXTS = {".parquet", ".pq"}
+_NUMPY_EXTS = {".npy", ".npz"}
+
+
+def _infer_format(path: str, fmt: Optional[str]) -> str:
+    if fmt:
+        return fmt
+    ext = os.path.splitext(path)[1].lower()
+    if ext in _CSV_EXTS:
+        return "csv"
+    if ext in _PARQUET_EXTS:
+        return "parquet"
+    if ext in _NUMPY_EXTS:
+        return "numpy"
+    raise ValueError(
+        f"cannot infer format from {path!r}; pass format= one of "
+        "csv/parquet/numpy"
+    )
+
+
+def load_points(
+    path: str, fmt: Optional[str] = None, delimiter: str = ","
+) -> np.ndarray:
+    """Load an [N, D>=2] float64 point array.
+
+    csv: one point per line, ``delimiter``-separated floats (the reference
+    sample's ``split(',').map(_.toDouble)``, DBSCANSample.scala:19-20).
+    Extra columns ride along (the pipeline clusters on the first two,
+    reference DBSCAN.scala:33-34).
+    parquet: all numeric columns, in file order.
+    numpy: .npy array or .npz (first array).
+    """
+    f = _infer_format(path, fmt)
+    if f == "csv":
+        pts = np.loadtxt(path, delimiter=delimiter, dtype=np.float64, ndmin=2)
+    elif f == "parquet":
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path)
+        cols = [
+            np.asarray(table[name], dtype=np.float64)
+            for name in table.column_names
+            if np.issubdtype(np.asarray(table[name]).dtype, np.number)
+        ]
+        if not cols:
+            raise ValueError(f"no numeric columns in {path!r}")
+        pts = np.stack(cols, axis=1)
+    elif f == "numpy":
+        loaded = np.load(path)
+        if isinstance(loaded, np.lib.npyio.NpzFile):
+            loaded = loaded[loaded.files[0]]
+        pts = np.asarray(loaded, dtype=np.float64)
+    else:
+        raise ValueError(f"unknown format {f!r}")
+    if pts.ndim != 2 or pts.shape[1] < 2:
+        raise ValueError(f"expected [N, >=2] points in {path!r}, got {pts.shape}")
+    return pts
+
+
+def save_labeled(
+    path: str,
+    points: np.ndarray,
+    clusters: np.ndarray,
+    flags: Optional[np.ndarray] = None,
+    fmt: Optional[str] = None,
+    delimiter: str = ",",
+) -> None:
+    """Write per-point results.
+
+    csv: ``x,y,...,cluster[,flag]`` lines — the reference sample's
+    ``"$x,$y,$cluster"`` output (DBSCANSample.scala:35) with the input's
+    extra columns preserved and an optional flag code appended.
+    parquet: columns ``c0..c{D-1}, cluster [, flag]``.
+    numpy: .npz with ``points``, ``clusters`` [, ``flags``] arrays.
+    """
+    pts = np.asarray(points, dtype=np.float64)
+    cl = np.asarray(clusters)
+    f = _infer_format(path, fmt)
+    if f == "csv":
+        cols = [pts, cl[:, None].astype(np.int64)]
+        if flags is not None:
+            cols.append(np.asarray(flags)[:, None].astype(np.int64))
+        widths = [pts.shape[1], 1] + ([1] if flags is not None else [])
+        data = np.concatenate([np.asarray(c, dtype=np.float64) for c in cols], axis=1)
+        fmt_spec = ["%.17g"] * pts.shape[1] + ["%d"] * (sum(widths) - pts.shape[1])
+        np.savetxt(path, data, delimiter=delimiter, fmt=fmt_spec)
+    elif f == "parquet":
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        arrays = {f"c{i}": pts[:, i] for i in range(pts.shape[1])}
+        arrays["cluster"] = cl.astype(np.int64)
+        if flags is not None:
+            arrays["flag"] = np.asarray(flags).astype(np.int64)
+        pq.write_table(pa.table(arrays), path)
+    elif f == "numpy":
+        payload = {"points": pts, "clusters": cl}
+        if flags is not None:
+            payload["flags"] = np.asarray(flags)
+        np.savez(path, **payload)
+    else:
+        raise ValueError(f"unknown format {f!r}")

@@ -106,8 +106,6 @@ def local_dbscan(
     if engine not in ("naive", "archery"):
         raise ValueError(f"unknown engine {engine!r}")
     n = points.shape[0]
-    idx = jnp.arange(n, dtype=jnp.int32)
-    none = jnp.int32(SEED_NONE)
 
     if use_pallas:
         if metric != "euclidean":
@@ -133,18 +131,44 @@ def local_dbscan(
         # euclidean/cosine (measure 0 at the diagonal) but made explicit so
         # counts are self-inclusive under any registered metric.
         adj = adj | (jnp.eye(n, dtype=bool) & mask[:, None])
+        return cluster_from_adjacency(adj, mask, min_points, engine)
 
-        counts = jnp.sum(adj, axis=1, dtype=jnp.int32)
-        core = (counts >= jnp.int32(min_points)) & mask
+    return _finalize(mask, core, comp, core_nbr_seed, counts, engine)
 
-        adj_cc = adj & core[None, :] & core[:, None]
-        comp = _components_min_label(adj_cc, core)
 
-        # Min seed index among eps-adjacent cores (for cores: own component).
-        core_nbr_seed = jnp.min(
-            jnp.where(adj & core[None, :], comp[None, :], none), axis=1
-        )
+def cluster_from_adjacency(
+    adj: jnp.ndarray, mask: jnp.ndarray, min_points: int, engine: str
+) -> LocalResult:
+    """Full DBSCAN labeling from a materialized [N, N] eps-adjacency.
 
+    The engine tail shared by every adjacency producer: the dense-metric
+    path above, and external adjacency builders (e.g. the sparse TF-IDF
+    gram pipeline in :mod:`dbscan_tpu.ops.sparse`). ``adj`` must already be
+    masked (no true entries on invalid rows/cols) and self-inclusive on
+    valid rows.
+    """
+    if engine not in ("naive", "archery"):
+        raise ValueError(f"unknown engine {engine!r}")
+    none = jnp.int32(SEED_NONE)
+    counts = jnp.sum(adj, axis=1, dtype=jnp.int32)
+    core = (counts >= jnp.int32(min_points)) & mask
+
+    adj_cc = adj & core[None, :] & core[:, None]
+    comp = _components_min_label(adj_cc, core)
+
+    # Min seed index among eps-adjacent cores (for cores: own component).
+    core_nbr_seed = jnp.min(
+        jnp.where(adj & core[None, :], comp[None, :], none), axis=1
+    )
+    return _finalize(mask, core, comp, core_nbr_seed, counts, engine)
+
+
+def _finalize(mask, core, comp, core_nbr_seed, counts, engine: str) -> LocalResult:
+    """Border/noise algebra + flag packing shared by all engine backends
+    (see module docstring items 3-4)."""
+    n = mask.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    none = jnp.int32(SEED_NONE)
     has_core_nbr = core_nbr_seed != none
     if engine == "naive":
         border = mask & ~core & has_core_nbr & (core_nbr_seed < idx)

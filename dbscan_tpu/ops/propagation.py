@@ -34,25 +34,30 @@ def min_label_fixed_point(
 
     The pointer jump (``new[new]`` gather, chain-collapsing) keeps iteration
     count O(log diameter) instead of O(diameter) for chain-shaped clusters.
+
+    The loop is hard-capped at n iterations: labels strictly decrease while
+    unconverged, so n steps always suffice — and the cap guarantees the
+    on-device loop terminates even if a backend miscompiles the neighbor-min
+    (an unbounded device loop wedges the whole chip for every client).
     """
     n = init.shape[0]
     none = jnp.int32(SEED_NONE)
 
     def cond(state):
-        _, changed = state
-        return changed
+        _, changed, it = state
+        return changed & (it < n)
 
     def body(state):
-        labels, _ = state
+        labels, _, it = state
         new = jnp.minimum(labels, neighbor_min(labels))
         safe = jnp.clip(new, 0, n - 1)
         hop = jnp.where(new == none, none, new[safe])
         new = jnp.minimum(new, hop)
-        return new, jnp.any(new != labels)
+        return new, jnp.any(new != labels), it + 1
 
     # One unrolled body step first: the while_loop carry must be
     # data-derived ("varying") for shard_map, and a constant True init is
     # not; semantically free since body is idempotent at the fixed point.
-    state = body((init, jnp.bool_(True)))
-    labels, _ = lax.while_loop(cond, body, state)
+    state = body((init, jnp.bool_(True), jnp.int32(0)))
+    labels, _, _ = lax.while_loop(cond, body, state)
     return labels

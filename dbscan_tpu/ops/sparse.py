@@ -1,0 +1,145 @@
+"""Sparse cosine DBSCAN: TF-IDF-style CSR input on the MXU.
+
+The reference has no sparse support (its only metric is 2-D Euclidean,
+DBSCANPoint.scala:26-30); this implements BASELINE.json configs[3]
+("TF-IDF 20-Newsgroups sparse vectors") TPU-first:
+
+1. only the nonzeros travel to the device — (row, col, val) triples sorted
+   by feature column, sliced into feature blocks, padded to one static
+   shape (tens of MB for ~2M nnz vs tens of GB densified);
+2. a ``lax.scan`` over feature blocks scatter-densifies each [N, F_block]
+   slab on device and accumulates the gram matrix with one MXU matmul per
+   block — rows are L2-normalized on the host first, so the gram IS the
+   cosine similarity;
+3. cosine distance = 1 - gram; thresholding yields the [N, N] adjacency,
+   and the shared engine tail (ops.local_dbscan.cluster_from_adjacency)
+   produces labels/flags.
+
+Memory is bounded by the [N, N] f32 gram (N = 20k -> 1.6 GB), not by the
+vocabulary size: D only affects how many feature blocks the scan walks.
+Single-partition by design — high-dimensional sparse space has no 2-D
+rectangle decomposition (see the spatial gate in parallel/driver.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dbscan_tpu.ops.local_dbscan import LocalResult, cluster_from_adjacency
+
+FEATURE_BLOCK = 4096
+
+
+class _PackedCSR(NamedTuple):
+    rows: np.ndarray  # [n_blocks, max_nnz] int32 row index per nnz
+    cols: np.ndarray  # [n_blocks, max_nnz] int32 col index WITHIN its block
+    vals: np.ndarray  # [n_blocks, max_nnz] f32; 0 on padding
+    n_rows: int
+    n_blocks: int
+
+
+def _pack_csr(x_csr, feature_block: int) -> _PackedCSR:
+    """Sort nnz by feature column and slice into equal-width feature blocks,
+    padded to the max per-block nnz count (one static scan shape)."""
+    coo = x_csr.tocoo()
+    rows = np.asarray(coo.row, dtype=np.int64)
+    cols = np.asarray(coo.col, dtype=np.int64)
+    vals = np.asarray(coo.data, dtype=np.float32)
+    n, d = x_csr.shape
+    n_blocks = max(1, math.ceil(d / feature_block))
+
+    order = np.argsort(cols, kind="stable")
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    block_of = cols // feature_block
+    starts = np.searchsorted(block_of, np.arange(n_blocks))
+    ends = np.r_[starts[1:], len(cols)]
+    max_nnz = int((ends - starts).max()) if len(cols) else 1
+    # pad slot: row 0 / col 0 / val 0 — scatters +0.0, a no-op
+    r = np.zeros((n_blocks, max_nnz), dtype=np.int32)
+    c = np.zeros((n_blocks, max_nnz), dtype=np.int32)
+    v = np.zeros((n_blocks, max_nnz), dtype=np.float32)
+    for b in range(n_blocks):
+        s, e = starts[b], ends[b]
+        r[b, : e - s] = rows[s:e]
+        c[b, : e - s] = cols[s:e] - b * feature_block
+        v[b, : e - s] = vals[s:e]
+    return _PackedCSR(r, c, v, n, n_blocks)
+
+
+@functools.partial(jax.jit, static_argnames=("n_rows", "feature_block"))
+def _gram_from_packed(rows, cols, vals, n_rows: int, feature_block: int):
+    """Accumulate X @ X.T over feature blocks: scatter-densify each
+    [N, F_block] slab, one MXU matmul per block."""
+
+    def step(gram, triple):
+        r, c, v = triple
+        slab = jnp.zeros((n_rows, feature_block), dtype=jnp.float32)
+        slab = slab.at[r, c].add(v)
+        gram = gram + jnp.dot(
+            slab, slab.T, preferred_element_type=jnp.float32
+        )
+        return gram, None
+
+    init = jnp.zeros((n_rows, n_rows), dtype=jnp.float32)
+    gram, _ = jax.lax.scan(step, init, (rows, cols, vals))
+    return gram
+
+
+def sparse_cosine_gram(x_csr, feature_block: int = FEATURE_BLOCK) -> jnp.ndarray:
+    """Cosine-similarity gram matrix of a scipy CSR matrix, on device.
+
+    Rows are L2-normalized on the host (zero rows stay zero). Returns the
+    [N, N] f32 similarity.
+    """
+    import scipy.sparse as sp
+
+    x = sp.csr_matrix(x_csr, dtype=np.float64)
+    norms = np.sqrt(np.asarray(x.multiply(x).sum(axis=1)).ravel())
+    inv = np.where(norms > 0, 1.0 / np.maximum(norms, 1e-300), 0.0)
+    x = sp.diags(inv) @ x
+    packed = _pack_csr(x.tocsr(), feature_block)
+    return _gram_from_packed(
+        jnp.asarray(packed.rows),
+        jnp.asarray(packed.cols),
+        jnp.asarray(packed.vals),
+        packed.n_rows,
+        feature_block,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("min_points", "engine"))
+def _cluster_gram(gram, eps, min_points: int, engine: str) -> LocalResult:
+    n = gram.shape[0]
+    dist = 1.0 - gram
+    adj = dist <= eps
+    adj = adj | jnp.eye(n, dtype=bool)  # self-inclusive regardless of eps
+    return cluster_from_adjacency(
+        adj, jnp.ones(n, dtype=bool), min_points, engine
+    )
+
+
+def sparse_cosine_dbscan(
+    x_csr,
+    eps: float,
+    min_points: int,
+    engine: str = "archery",
+    feature_block: int = FEATURE_BLOCK,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """DBSCAN over sparse rows with cosine distance (1 - similarity) <= eps.
+
+    Returns (clusters [N] int32 with 0 = noise, flags [N] int8) in the
+    package's standard label conventions. Zero rows (empty documents) have
+    similarity 0 to everything — they cluster only if eps >= 1.
+    """
+    gram = sparse_cosine_gram(x_csr, feature_block)
+    res: LocalResult = _cluster_gram(gram, jnp.float32(eps), min_points, engine)
+    from dbscan_tpu.ops.labels import seed_to_local_ids
+
+    clusters = seed_to_local_ids(np.asarray(res.seed_labels))
+    return clusters, np.asarray(res.flags)

@@ -1,0 +1,196 @@
+"""Streaming micro-batch DBSCAN with persistent cluster identities.
+
+The reference has no streaming mode; this implements BASELINE.json
+configs[4] ("Spark Streaming micro-batch DBSCAN (incremental; reuse TPU
+partition buffers)") on the batch pipeline:
+
+Each ``update(batch)`` clusters the new batch TOGETHER with a sliding
+window of recently-seen core points (the density skeleton of earlier
+batches), then carries cluster identity forward: a fresh cluster that
+contains a window core point inherits that point's stream id; clusters
+bridging several old ids merge them (tracked in a union-find, so earlier
+emitted labels stay resolvable via :meth:`resolve`); clusters touching no
+window point get a new stream id.
+
+Device-buffer reuse falls out of the batch pipeline's static bucketing
+(parallel/binning.py): padded bucket shapes repeat across micro-batches of
+similar size, so every update after the first hits the jit cache instead of
+recompiling — the TPU analog of reusing executor-resident partition state.
+
+Semantics notes (documented, inherent to windowed streaming):
+- density is evaluated against the window skeleton, not all history: a
+  point is core if its eps-neighborhood within (batch + window cores)
+  reaches min_points. Only core points persist in the window — border and
+  noise points of a batch do not densify later batches.
+- a cluster split across batches keeps the elder id for both halves (ids
+  never un-merge).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from dbscan_tpu.config import DBSCANConfig, Engine, Precision
+from dbscan_tpu.ops.labels import CORE
+from dbscan_tpu.parallel.driver import train_arrays
+
+
+class _MinUnionFind:
+    """Union-find over positive int stream ids where the component root is
+    always the MINIMUM id — the "elder id wins" rule needs the canonical id
+    to be deterministic, which weighted union does not guarantee. Tracks the
+    live-root count incrementally so callers never scan all ids ever made."""
+
+    def __init__(self):
+        self._parent: dict = {}
+        self.n_roots = 0
+
+    def find(self, x: int) -> int:
+        parent = self._parent
+        if x not in parent:
+            parent[x] = x
+            self.n_roots += 1
+            return x
+        root = x
+        while parent[root] != root:
+            root = parent[root]
+        while parent[x] != root:
+            parent[x], x = root, parent[x]
+        return root
+
+    def union(self, a: int, b: int) -> int:
+        ra, rb = self.find(a), self.find(b)
+        if ra == rb:
+            return ra
+        lo, hi = (ra, rb) if ra < rb else (rb, ra)
+        self._parent[hi] = lo
+        self.n_roots -= 1
+        return lo
+
+
+class StreamUpdate(NamedTuple):
+    clusters: np.ndarray  # [B] stream-stable cluster ids; 0 = noise
+    flags: np.ndarray  # [B] int8 Core/Border/Noise for the new batch
+    n_stream_clusters: int  # distinct live stream ids so far
+    stats: dict
+
+
+class StreamingDBSCAN:
+    """Micro-batch DBSCAN front-end over the distributed batch pipeline.
+
+    window: number of past micro-batches whose core points stay in the
+    density skeleton. mesh: optional device mesh, as in train().
+    """
+
+    def __init__(
+        self,
+        eps: float,
+        min_points: int,
+        max_points_per_partition: int = 250,
+        *,
+        window: int = 3,
+        engine: Engine = Engine.ARCHERY,
+        precision: Precision = Precision.F32,
+        use_pallas: bool = False,
+        mesh=None,
+        config: Optional[DBSCANConfig] = None,
+    ):
+        self.config = config or DBSCANConfig(
+            eps=eps,
+            min_points=min_points,
+            max_points_per_partition=max_points_per_partition,
+            engine=engine,
+            precision=precision,
+            use_pallas=use_pallas,
+        )
+        self.config.validate()
+        self.window = int(window)
+        if self.window < 0:
+            raise ValueError(f"window must be >= 0, got {window}")
+        self.mesh = mesh
+        # (core points [K, 2], their stream ids [K]) per retained batch
+        self._window: Deque[Tuple[np.ndarray, np.ndarray]] = deque(
+            maxlen=self.window if self.window > 0 else None
+        )
+        self._uf = _MinUnionFind()
+        self._next_id = 1
+        self._n_updates = 0
+
+    def _window_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        if not self._window:
+            return np.empty((0, 2), np.float64), np.empty(0, np.int64)
+        pts = np.concatenate([p for p, _ in self._window])
+        ids = np.concatenate([i for _, i in self._window])
+        return pts, ids
+
+    def resolve(self, ids: np.ndarray) -> np.ndarray:
+        """Map previously-emitted stream ids to their current canonical ids
+        (after later batches merged clusters)."""
+        ids = np.asarray(ids)
+        out = ids.copy()
+        for v in np.unique(ids):
+            if v > 0:
+                out[ids == v] = self._uf.find(int(v))
+        return out
+
+    def update(self, batch: np.ndarray) -> StreamUpdate:
+        """Ingest one micro-batch; returns stream-stable labels for it."""
+        batch = np.asarray(batch, dtype=np.float64)
+        if batch.ndim != 2 or batch.shape[1] < 2:
+            raise ValueError(f"batch must be [B, >=2], got {batch.shape}")
+        self._n_updates += 1
+        wpts, wids = self._window_arrays()
+        combined = (
+            np.concatenate([batch[:, :2], wpts]) if len(wpts) else batch[:, :2]
+        )
+        out = train_arrays(combined, self.config, mesh=self.mesh)
+
+        b = len(batch)
+        batch_cl = out.clusters[:b]
+        batch_fl = out.flags[:b]
+        win_cl = out.clusters[b:]
+
+        # carry identity: batch-local cluster id -> stream id
+        mapping: dict = {}
+        # window points vote first (elder ids win: union-by-min)
+        for local_id in np.unique(win_cl[win_cl > 0]):
+            members = [int(s) for s in np.unique(wids[win_cl == local_id])]
+            canon = self._uf.find(members[0])
+            for s in members[1:]:
+                canon = self._uf.union(canon, s)
+            mapping[int(local_id)] = canon
+        # re-canonicalize: a later cluster's union may have merged an id
+        # assigned earlier in this same update
+        mapping = {k: self._uf.find(v) for k, v in mapping.items()}
+        for local_id in np.unique(batch_cl[batch_cl > 0]):
+            if int(local_id) not in mapping:
+                sid = self._next_id
+                self._next_id += 1
+                self._uf.find(sid)  # register
+                mapping[int(local_id)] = sid
+
+        stream_cl = np.zeros(b, dtype=np.int64)
+        for local_id, sid in mapping.items():
+            stream_cl[batch_cl == local_id] = sid
+
+        # retain this batch's core points in the window skeleton
+        core_mask = batch_fl == CORE
+        self._window.append(
+            (batch[core_mask][:, :2].copy(), stream_cl[core_mask].copy())
+        )
+
+        stats = dict(out.stats)
+        stats.update(
+            n_updates=self._n_updates,
+            window_points=int(len(wpts)),
+            batch_clusters=int(len(np.unique(batch_cl[batch_cl > 0]))),
+        )
+        return StreamUpdate(
+            clusters=stream_cl,
+            flags=batch_fl,
+            n_stream_clusters=self._uf.n_roots,
+            stats=stats,
+        )

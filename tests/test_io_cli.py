@@ -1,0 +1,114 @@
+"""io loaders/writers + CLI driver (the parameterized DBSCANSample,
+reference DBSCANSample.scala:13-38)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import io as io_mod
+from dbscan_tpu.cli import main as cli_main
+
+
+@pytest.fixture
+def blob_csv(tmp_path, rng):
+    pts = np.concatenate(
+        [rng.normal(c, 0.3, (80, 2)) for c in [(0, 0), (6, 6), (-5, 5)]]
+    )
+    rng.shuffle(pts)
+    path = tmp_path / "pts.csv"
+    np.savetxt(path, pts, delimiter=",")
+    return str(path), pts
+
+
+def test_csv_roundtrip(tmp_path, rng):
+    pts = rng.normal(size=(50, 3))
+    p = tmp_path / "a.csv"
+    np.savetxt(p, pts, delimiter=",")
+    loaded = io_mod.load_points(str(p))
+    np.testing.assert_allclose(loaded, pts, rtol=1e-6)
+
+    out = tmp_path / "out.csv"
+    clusters = np.arange(50, dtype=np.int32)
+    flags = np.ones(50, dtype=np.int8)
+    io_mod.save_labeled(str(out), pts, clusters, flags)
+    back = np.loadtxt(out, delimiter=",")
+    assert back.shape == (50, 5)  # 3 coords + cluster + flag
+    np.testing.assert_allclose(back[:, :3], pts, rtol=1e-12)
+    np.testing.assert_array_equal(back[:, 3].astype(int), clusters)
+
+
+def test_parquet_roundtrip(tmp_path, rng):
+    pytest.importorskip("pyarrow")
+    pts = rng.normal(size=(40, 2))
+    out = tmp_path / "out.parquet"
+    io_mod.save_labeled(str(out), pts, np.zeros(40, np.int32))
+    loaded = io_mod.load_points(str(out))
+    # columns come back as c0, c1, cluster — first two are the coords
+    np.testing.assert_allclose(loaded[:, :2], pts, rtol=1e-12)
+
+
+def test_numpy_roundtrip(tmp_path, rng):
+    pts = rng.normal(size=(30, 2))
+    p = tmp_path / "a.npy"
+    np.save(p, pts)
+    np.testing.assert_array_equal(io_mod.load_points(str(p)), pts)
+
+
+def test_load_rejects_1d(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("1.0\n2.0\n")
+    with pytest.raises(ValueError, match=r"\[N, >=2\]"):
+        io_mod.load_points(str(p))
+
+
+def test_unknown_extension_rejected(tmp_path):
+    with pytest.raises(ValueError, match="cannot infer"):
+        io_mod.load_points(str(tmp_path / "a.weird"))
+
+
+def test_cli_end_to_end(tmp_path, blob_csv, capsys):
+    inp, pts = blob_csv
+    out = str(tmp_path / "labeled.csv")
+    rc = cli_main(
+        [
+            "--input", inp, "--output", out,
+            "--eps", "0.5", "--min-points", "5",
+            "--max-points-per-partition", "100",
+            "--engine", "archery", "--stats",
+        ]
+    )
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip())
+    assert stats["n_points"] == len(pts)
+    assert stats["n_clusters"] == 3
+    back = np.loadtxt(out, delimiter=",")
+    assert back.shape == (len(pts), 4)  # x, y, cluster, flag
+    assert set(np.unique(back[:, 2].astype(int))) <= {0, 1, 2, 3}
+    # clusters are spatially coherent: points of one input blob share a label
+    np.testing.assert_allclose(back[:, :2], pts, rtol=1e-9)
+
+
+def test_cli_mesh_devices(tmp_path, blob_csv):
+    inp, pts = blob_csv
+    rc = cli_main(
+        [
+            "--input", inp,
+            "--eps", "0.5", "--min-points", "5",
+            "--max-points-per-partition", "60",
+            "--mesh-devices", "4",
+        ]
+    )
+    assert rc == 0
+
+
+def test_cli_too_many_devices(blob_csv):
+    inp, _ = blob_csv
+    rc = cli_main(
+        [
+            "--input", inp, "--eps", "0.5", "--min-points", "5",
+            "--mesh-devices", "4096",
+        ]
+    )
+    assert rc == 2

@@ -1,0 +1,91 @@
+"""Streaming micro-batch DBSCAN: identity persistence, merges, windowing."""
+
+import numpy as np
+import pytest
+
+from dbscan_tpu.streaming import StreamingDBSCAN
+
+
+def _blob(rng, center, n=60, s=0.25):
+    return rng.normal(center, s, size=(n, 2))
+
+
+def test_stable_identity_across_batches(rng):
+    s = StreamingDBSCAN(eps=0.6, min_points=5, max_points_per_partition=500)
+    u1 = s.update(_blob(rng, (0, 0)))
+    assert u1.n_stream_clusters == 1
+    sid = np.unique(u1.clusters[u1.clusters > 0])
+    assert len(sid) == 1
+    # same region next batch -> same stream id
+    u2 = s.update(_blob(rng, (0.1, 0.1)))
+    sid2 = np.unique(u2.clusters[u2.clusters > 0])
+    np.testing.assert_array_equal(sid, sid2)
+    # far-away new blob -> new id
+    u3 = s.update(_blob(rng, (30, 30)))
+    sid3 = np.unique(u3.clusters[u3.clusters > 0])
+    assert len(sid3) == 1 and sid3[0] != sid[0]
+    assert u3.n_stream_clusters == 2
+
+
+def test_merge_unifies_ids(rng):
+    s = StreamingDBSCAN(eps=0.6, min_points=5, max_points_per_partition=500)
+    a = s.update(_blob(rng, (0, 0)))
+    b = s.update(_blob(rng, (4, 0)))
+    ida = int(np.unique(a.clusters[a.clusters > 0])[0])
+    idb = int(np.unique(b.clusters[b.clusters > 0])[0])
+    assert ida != idb
+    # a bridge batch connecting both blobs
+    bridge = np.stack(
+        [np.linspace(-0.5, 4.5, 120), np.zeros(120)], axis=1
+    ) + rng.normal(0, 0.05, (120, 2))
+    u = s.update(bridge)
+    merged = np.unique(u.clusters[u.clusters > 0])
+    assert len(merged) == 1
+    assert merged[0] == min(ida, idb)  # elder id wins
+    assert u.n_stream_clusters == 1
+    # previously-emitted labels resolve to the surviving id
+    np.testing.assert_array_equal(
+        s.resolve(np.array([ida, idb])), [min(ida, idb)] * 2
+    )
+
+
+def test_window_expiry_forgets_old_density(rng):
+    s = StreamingDBSCAN(
+        eps=0.6, min_points=5, max_points_per_partition=500, window=1
+    )
+    u1 = s.update(_blob(rng, (0, 0)))
+    id1 = int(np.unique(u1.clusters[u1.clusters > 0])[0])
+    # push two unrelated batches through the window=1 skeleton
+    s.update(_blob(rng, (20, 20)))
+    s.update(_blob(rng, (40, 40)))
+    # back at the origin: old cores expired, so this is a NEW stream id
+    u4 = s.update(_blob(rng, (0, 0)))
+    id4 = int(np.unique(u4.clusters[u4.clusters > 0])[0])
+    assert id4 != id1
+
+
+def test_noise_batch(rng):
+    s = StreamingDBSCAN(eps=0.3, min_points=8, max_points_per_partition=500)
+    u = s.update(rng.uniform(-50, 50, size=(40, 2)))
+    assert (u.clusters == 0).all()
+    assert u.n_stream_clusters == 0
+
+
+def test_buffer_reuse_no_recompile(rng):
+    """Same-shaped micro-batches must hit the jit cache (the TPU
+    partition-buffer-reuse contract): compiled-function count stays flat
+    after the first update."""
+    from dbscan_tpu.ops.local_dbscan import local_dbscan
+
+    s = StreamingDBSCAN(eps=0.6, min_points=5, max_points_per_partition=500)
+    s.update(_blob(rng, (0, 0), n=128))
+    misses0 = local_dbscan._cache_size()
+    for i in range(3):
+        s.update(_blob(rng, (i * 0.2, 0), n=128))
+    assert local_dbscan._cache_size() == misses0
+
+
+def test_rejects_bad_batch(rng):
+    s = StreamingDBSCAN(eps=0.5, min_points=3)
+    with pytest.raises(ValueError, match=r"\[B, >=2\]"):
+        s.update(np.zeros(5))
