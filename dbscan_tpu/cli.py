@@ -140,14 +140,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         log.info("wrote %s", args.output)
 
     if args.stats:
+        def as_json(v):
+            if isinstance(v, dict):
+                return {k: as_json(x) for k, x in v.items()}
+            return float(v) if isinstance(v, float) else int(v)
+
         print(
             json.dumps(
                 {
                     "n_points": int(len(points)),
                     "n_clusters": int(model.n_clusters),
                     "seconds": round(seconds, 4),
-                    **{k: (float(v) if isinstance(v, float) else int(v))
-                       for k, v in model.stats.items()},
+                    **{k: as_json(v) for k, v in model.stats.items()},
                 }
             )
         )

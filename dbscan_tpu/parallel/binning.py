@@ -28,22 +28,6 @@ class Margins(NamedTuple):
     outer: np.ndarray  # [P, 4]
 
 
-class Buckets(NamedTuple):
-    """Static device buffers for the partition fan-out.
-
-    points: [P_pad, B, D] float; rows beyond a partition's count are zero.
-    mask: [P_pad, B] bool validity.
-    point_idx: [P_pad, B] int64 original row index, -1 on padding.
-    n_parts: true number of partitions (P_pad may include empty padding
-      partitions so the leading axis divides the mesh).
-    """
-
-    points: np.ndarray
-    mask: np.ndarray
-    point_idx: np.ndarray
-    n_parts: int
-
-
 def build_margins(rects_int: np.ndarray, cell_size: float, eps: float) -> Margins:
     """Margins from integer partition rects (DBSCAN.scala:116-121)."""
     main = geo.int_rects_to_float(np.asarray(rects_int).reshape(-1, 4), cell_size)
@@ -77,7 +61,21 @@ def duplicate_points(
     return part_ids[order].astype(np.int64), point_idx[order]
 
 
-def bucketize(
+class BucketGroup(NamedTuple):
+    """One same-width slab of partitions (see :func:`bucketize_grouped`).
+
+    points: [P_pad, B, D]; mask: [P_pad, B] validity; point_idx: [P_pad, B]
+    original row index (-1 padding); part_ids: [P_pad] ORIGINAL partition id
+    per row, -1 on padding partitions.
+    """
+
+    points: np.ndarray
+    mask: np.ndarray
+    point_idx: np.ndarray
+    part_ids: np.ndarray
+
+
+def bucketize_grouped(
     points: np.ndarray,
     part_ids: np.ndarray,
     point_idx: np.ndarray,
@@ -85,30 +83,64 @@ def bucketize(
     bucket_multiple: int = 128,
     pad_parts_to: int = 1,
     dtype=np.float32,
-) -> Buckets:
-    """Pack duplicated points into static [P_pad, B, D] buffers.
+) -> Tuple[list, int]:
+    """Pack partitions into SIZE-GROUPED static buffers.
 
-    B is the max per-partition count rounded up to `bucket_multiple` (bounds
-    recompilation across runs: kernels specialize on B, not exact counts).
-    P_pad rounds the partition axis up to a multiple of `pad_parts_to`
-    (device count) with empty partitions.
+    One global bucket width would make every partition pay the largest
+    partition's O(B^2) sweep cost; here each partition's
+    width is its count rounded up to ``bucket_multiple * 2^k`` (geometric —
+    the compile cache stays bounded) and partitions of equal width share one
+    [P_g, B_g] slab. Total device work drops from P * B_max^2 toward
+    sum(B_i^2). The group's partition axis pads to `pad_parts_to` (device
+    count) with empty partitions, like bucketize.
+
+    Returns (groups sorted by ascending width, max width).
     """
     pts = np.asarray(points)
     d = pts.shape[1]
     counts = np.bincount(part_ids, minlength=n_parts)
-    max_count = int(counts.max()) if counts.size else 0
-    b = max(bucket_multiple, math.ceil(max(1, max_count) / bucket_multiple) * bucket_multiple)
-    p_pad = max(1, math.ceil(n_parts / pad_parts_to) * pad_parts_to)
 
-    buf = np.zeros((p_pad, b, d), dtype=dtype)
-    mask = np.zeros((p_pad, b), dtype=bool)
-    idx = np.full((p_pad, b), -1, dtype=np.int64)
+    def width(c: int) -> int:
+        # 1.5x geometric ladder of bucket_multiple multiples
+        # (q in 1, 1.5, 2, 3, 4, 6, ... when it divides evenly): area waste
+        # bounded at ~2.25x worst-case vs exact, while widths recur across
+        # runs so the compile cache stays small.
+        c = max(1, int(c))
+        q_needed = math.ceil(c / bucket_multiple)
+        q = 1
+        while q < q_needed:
+            nq = q * 3 // 2 if (q & (q - 1)) == 0 else q * 4 // 3
+            q = nq if nq > q else q + 1  # progress even at q=1
+        return q * bucket_multiple
 
-    if part_ids.size:
-        # part_ids is sorted; slot = position within its partition group
-        starts = np.searchsorted(part_ids, np.arange(n_parts))
-        slot = np.arange(part_ids.size) - np.repeat(starts, counts)
-        buf[part_ids, slot] = pts[point_idx].astype(dtype)
-        mask[part_ids, slot] = True
-        idx[part_ids, slot] = point_idx
-    return Buckets(points=buf, mask=mask, point_idx=idx, n_parts=n_parts)
+    widths = np.array([width(c) for c in counts], dtype=np.int64)
+    starts = np.searchsorted(part_ids, np.arange(n_parts))
+    slot_all = (
+        np.arange(part_ids.size) - np.repeat(starts, counts)
+        if part_ids.size
+        else np.empty(0, np.int64)
+    )
+
+    groups = []
+    max_b = 0
+    for b in sorted(set(widths.tolist())):
+        sel_parts = np.flatnonzero(widths == b)
+        p_pad = max(1, math.ceil(len(sel_parts) / pad_parts_to) * pad_parts_to)
+        buf = np.zeros((p_pad, b, d), dtype=dtype)
+        mask = np.zeros((p_pad, b), dtype=bool)
+        idx = np.full((p_pad, b), -1, dtype=np.int64)
+        pid = np.full(p_pad, -1, dtype=np.int64)
+        pid[: len(sel_parts)] = sel_parts
+        if part_ids.size:
+            row_of_part = np.full(n_parts, -1, dtype=np.int64)
+            row_of_part[sel_parts] = np.arange(len(sel_parts))
+            in_group = row_of_part[part_ids] >= 0
+            gi = np.flatnonzero(in_group)
+            rows = row_of_part[part_ids[gi]]
+            slots = slot_all[gi]
+            buf[rows, slots] = pts[point_idx[gi]].astype(dtype)
+            mask[rows, slots] = True
+            idx[rows, slots] = point_idx[gi]
+        groups.append(BucketGroup(buf, mask, idx, pid))
+        max_b = max(max_b, b)
+    return groups, max_b

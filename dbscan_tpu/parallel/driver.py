@@ -33,6 +33,7 @@ fixes):
 from __future__ import annotations
 
 import logging
+import time
 from typing import List, NamedTuple, Optional, Tuple
 
 import jax
@@ -74,7 +75,14 @@ def _run_partitions(bucket_pts, bucket_mask, cfg: DBSCANConfig, mesh):
     metric = cfg.metric
     use_pallas = bool(cfg.use_pallas)
     p_total = bucket_pts.shape[0]
-    batch = max(1, min(8, p_total // max(1, mesh_size(mesh))))
+    # XLA path: vmap small batches of partitions for utilization. Pallas
+    # path: strictly sequential (batch 1) — batching would vmap the
+    # pallas_calls, a lowering with no wins here (the sweeps already fill
+    # the chip) and extra risk on top of an on-device while_loop.
+    if use_pallas:
+        batch = 1
+    else:
+        batch = max(1, min(8, p_total // max(1, mesh_size(mesh))))
 
     def one(args):
         pts, msk = args
@@ -115,30 +123,33 @@ def _run_partitions(bucket_pts, bucket_mask, cfg: DBSCANConfig, mesh):
     return np.asarray(seeds), np.asarray(flags), int(ncore)
 
 
-def _local_ids(seeds: np.ndarray, valid: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Dense 1-based per-partition cluster ids from seed labels, vectorized
-    across all partitions at once.
+def _local_ids_flat(
+    inst_part: np.ndarray, inst_seed: np.ndarray, n_parts: int, max_b: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Dense 1-based per-partition cluster ids from flat per-instance seed
+    labels.
 
-    Returns (loc [P, B] int32 local ids with 0 for noise, uniq_part [K],
+    Returns (loc [M] int32 local ids with 0 for noise, uniq_part [K],
     uniq_loc [K]) where (uniq_part, uniq_loc) enumerate all distinct
     non-noise (partition, local id) pairs sorted by partition then id — the
     deterministic ordering we feed the global-id assignment (reference
-    localClusterIds, DBSCAN.scala:194-200).
+    localClusterIds, DBSCAN.scala:194-200). Seed row-index order IS the
+    reference's fold order, so dense-ranking seeds per partition reproduces
+    its sequential numbering.
     """
-    p, b = seeds.shape
-    labeled = valid & (seeds != SEED_NONE)
-    offset = np.arange(p, dtype=np.int64)[:, None] * (b + 1)
-    comb = np.where(labeled, seeds.astype(np.int64) + offset, -1)
-    flat = comb[comb >= 0]
-    loc = np.zeros((p, b), dtype=np.int32)
+    labeled = inst_seed != SEED_NONE
+    loc = np.zeros(len(inst_part), dtype=np.int32)
+    key = np.where(
+        labeled, inst_part.astype(np.int64) * (max_b + 1) + inst_seed, -1
+    )
+    flat = key[labeled]
     if flat.size == 0:
         return loc, np.empty(0, np.int64), np.empty(0, np.int32)
     u = np.unique(flat)
-    upart = u // (b + 1)
-    first = np.searchsorted(upart, np.arange(p))
+    upart = u // (max_b + 1)
+    first = np.searchsorted(upart, np.arange(n_parts))
     uloc = (np.arange(len(u)) - first[upart] + 1).astype(np.int32)
-    pos = np.searchsorted(u, flat)
-    loc[comb >= 0] = uloc[pos]
+    loc[labeled] = uloc[np.searchsorted(u, flat)]
     return loc, upart, uloc
 
 
@@ -197,13 +208,22 @@ def train_arrays(
                 "n_points": 0,
                 "n_partitions": 0,
                 "bucket_size": 0,
+                "n_bucket_groups": 0,
                 "duplication_factor": 0.0,
                 "n_clusters": 0,
                 "n_core_instances": 0,
+                "timings": {},
             },
         )
 
     cell = cfg.minimum_rectangle_size
+    timings: dict = {}
+    t_start = time.perf_counter()
+
+    def _mark(phase: str, t0: float) -> float:
+        now = time.perf_counter()
+        timings[phase] = round(now - t0, 6)
+        return now
 
     # The 2eps-grid spatial decomposition is Euclidean geometry on the first
     # two coordinates (reference DBSCAN.scala:33-34, :345-356). Non-Euclidean
@@ -218,10 +238,13 @@ def train_arrays(
 
     if spatial:
         # 1-2. cell histogram + spatial partitioning (driver-local metadata).
+        t0 = time.perf_counter()
         cells, counts, _ = geo.cell_histogram_int(pts, cell)
+        t0 = _mark("histogram_s", t0)
         parts = partitioner.partition_cells(
             cells, counts, cfg.max_points_per_partition
         )
+        _mark("partition_s", t0)
         rects_int = np.stack([r for r, _ in parts])
         logger.info("found %d partitions for %d points", len(parts), n)
         # 3. margins.
@@ -237,7 +260,9 @@ def train_arrays(
         )
 
     # 4. halo duplication + static bucketing.
+    t0 = time.perf_counter()
     part_ids, point_idx = binning.duplicate_points(pts, margins.outer)
+    t0 = _mark("duplicate_s", t0)
     if cfg.precision.value == "f64" and not jax.config.jax_enable_x64:
         raise ValueError(
             "precision=F64 requires jax_enable_x64 (else buffers silently "
@@ -250,7 +275,7 @@ def train_arrays(
         "f64": np.float64,
         "bf16": ml_dtypes.bfloat16,
     }[cfg.precision.value]
-    buckets = binning.bucketize(
+    groups, max_b = binning.bucketize_grouped(
         kernel_cols,
         part_ids,
         point_idx,
@@ -259,23 +284,31 @@ def train_arrays(
         pad_parts_to=mesh_size(mesh),
         dtype=dtype,
     )
+    t0 = _mark("bucketize_s", t0)
 
-    # 5. per-partition clustering on device.
-    seeds, flags, n_core = _run_partitions(buckets.points, buckets.mask, cfg, mesh)
-    p_true = buckets.n_parts
-    seeds = seeds[:p_true]
-    flags = flags[:p_true]
-    ptidx = buckets.point_idx[:p_true]
-    valid = ptidx >= 0
+    # 5. per-partition clustering on device, one launch per bucket width
+    # (ascending; same widths recur across runs -> jit cache hits).
+    p_true = margins.main.shape[0]
+    n_core = 0
+    inst_part_l, inst_ptidx_l, inst_seed_l, inst_flag_l = [], [], [], []
+    for g in groups:
+        seeds_g, flags_g, nc = _run_partitions(g.points, g.mask, cfg, mesh)
+        n_core += nc
+        rows, slots = np.nonzero(g.point_idx >= 0)
+        inst_part_l.append(g.part_ids[rows])
+        inst_ptidx_l.append(g.point_idx[rows, slots])
+        inst_seed_l.append(seeds_g[rows, slots])
+        inst_flag_l.append(flags_g[rows, slots])
+    inst_part = np.concatenate(inst_part_l) if inst_part_l else np.empty(0, np.int64)
+    inst_ptidx = np.concatenate(inst_ptidx_l) if inst_ptidx_l else np.empty(0, np.int64)
+    inst_seed = np.concatenate(inst_seed_l) if inst_seed_l else np.empty(0, np.int32)
+    inst_flag = np.concatenate(inst_flag_l) if inst_flag_l else np.empty(0, np.int8)
+    t0 = _mark("device_s", t0)
 
     # 6. local ids + deterministic cluster enumeration.
-    loc, upart, uloc = _local_ids(seeds, valid)
+    inst_loc, upart, uloc = _local_ids_flat(inst_part, inst_seed, p_true, max_b)
 
     # 7. merge: union clusters observed on the same halo point.
-    inst_part, inst_slot = np.nonzero(valid)
-    inst_ptidx = ptidx[inst_part, inst_slot]
-    inst_loc = loc[inst_part, inst_slot]
-    inst_flag = flags[inst_part, inst_slot]
 
     band_any = _band_membership(pts, margins)
     cand = band_any[inst_ptidx]
@@ -319,7 +352,7 @@ def train_arrays(
     labeled_inst = inst_loc > 0
     if labeled_inst.any():
         # key into the sorted unique (part, loc) table
-        b = seeds.shape[1]
+        b = max_b
         ukey = upart * (b + 2) + uloc
         ikey = inst_part[labeled_inst] * (b + 2) + inst_loc[labeled_inst]
         pos = np.searchsorted(ukey, ikey)
@@ -372,12 +405,16 @@ def train_arrays(
     partitions = [
         (i, margins.main[i]) for i in range(p_true)
     ]
+    timings["merge_s"] = round(time.perf_counter() - t0, 6)
+    timings["total_s"] = round(time.perf_counter() - t_start, 6)
     stats = {
         "n_points": n,
         "n_partitions": p_true,
-        "bucket_size": int(buckets.points.shape[1]),
+        "bucket_size": int(max_b),
+        "n_bucket_groups": len(groups),
         "duplication_factor": float(len(part_ids)) / max(1, n),
         "n_clusters": n_clusters,
         "n_core_instances": n_core,
+        "timings": timings,
     }
     return TrainOutput(res_cluster, res_flag, partitions, n_clusters, stats)

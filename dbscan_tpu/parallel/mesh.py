@@ -28,3 +28,29 @@ def mesh_size(mesh: Optional[Mesh]) -> int:
     if mesh is None:
         return 1
     return int(np.prod(mesh.devices.shape))
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Mesh:
+    """Join a multi-host (DCN) job and return the global partition mesh.
+
+    The reference scales out by adding Spark executors over its cluster
+    manager; the TPU equivalent is one process per host joined through
+    ``jax.distributed.initialize`` (args auto-detected on TPU pods, explicit
+    for manual launches), after which ``jax.devices()`` spans every host and
+    the same 1-D 'parts' mesh covers the whole slice — shard_map then runs
+    each host's slab locally with collectives riding ICI within a slice and
+    DCN across slices. Call once per process before any other JAX API.
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    return make_mesh()
