@@ -87,10 +87,12 @@ def bucketize_grouped(
     """Pack partitions into SIZE-GROUPED static buffers.
 
     One global bucket width would make every partition pay the largest
-    partition's O(B^2) sweep cost; here each partition's
-    width is its count rounded up to ``bucket_multiple * 2^k`` (geometric —
-    the compile cache stays bounded) and partitions of equal width share one
-    [P_g, B_g] slab. Total device work drops from P * B_max^2 toward
+    partition's O(B^2) sweep cost; here each partition's width is its count
+    rounded up along a ~1.5x geometric ladder of ``bucket_multiple``
+    multiples (1, 2, 3, 4, 6, 8, 12, ... x) — widths recur across runs so
+    the compile cache stays bounded, with per-partition padding waste under
+    2x (1.5x asymptotically; the ladder's first rung is 1 -> 2) — and
+    partitions of equal width share one [P_g, B_g] slab. Total device work drops from P * B_max^2 toward
     sum(B_i^2). The group's partition axis pads to `pad_parts_to` (device
     count) with empty partitions, like bucketize.
 

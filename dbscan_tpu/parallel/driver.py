@@ -32,6 +32,7 @@ fixes):
 
 from __future__ import annotations
 
+import functools
 import logging
 import time
 from typing import List, NamedTuple, Optional, Tuple
@@ -61,28 +62,31 @@ class TrainOutput(NamedTuple):
     stats: dict
 
 
-def _run_partitions(bucket_pts, bucket_mask, cfg: DBSCANConfig, mesh):
-    """Fan the local kernel out over the partition axis.
+def clear_compile_cache() -> None:
+    """Drop all cached jitted executors (and the Mesh objects and XLA
+    executables they retain). For long-lived processes sweeping many
+    configurations or meshes."""
+    _compiled_block.cache_clear()
 
-    Inside each mesh shard, partitions are processed with lax.map (bounded
-    memory: one [B, B] adjacency at a time, `batch_size` of them in flight) —
-    the moral equivalent of one Spark executor looping its assigned tasks
-    (DBSCAN.scala:150-154), but compiled.
+
+@functools.lru_cache(maxsize=256)
+def _compiled_block(
+    eps: float,
+    min_points: int,
+    engine: str,
+    metric: str,
+    use_pallas: bool,
+    batch: Optional[int],
+    mesh,
+):
+    """Build (once per distinct config+mesh) the jitted per-group executor.
+
+    The jit wrapper MUST be cached at module level: jax.jit keys its
+    trace/compile cache on the wrapped function's identity, so a fresh
+    closure per train() call would re-trace and re-XLA-compile every bucket
+    group on every call (and every streaming micro-batch update), defeating
+    the geometric width ladder's whole purpose.
     """
-    eps = float(cfg.eps)
-    min_points = int(cfg.min_points)
-    engine = cfg.engine.value
-    metric = cfg.metric
-    use_pallas = bool(cfg.use_pallas)
-    p_total = bucket_pts.shape[0]
-    # XLA path: vmap small batches of partitions for utilization. Pallas
-    # path: strictly sequential (batch 1) — batching would vmap the
-    # pallas_calls, a lowering with no wins here (the sweeps already fill
-    # the chip) and extra risk on top of an on-device while_loop.
-    if use_pallas:
-        batch = 1
-    else:
-        batch = max(1, min(8, p_total // max(1, mesh_size(mesh))))
 
     def one(args):
         pts, msk = args
@@ -98,7 +102,15 @@ def _run_partitions(bucket_pts, bucket_mask, cfg: DBSCANConfig, mesh):
         return r.seed_labels, r.flags
 
     def block(pts_blk, msk_blk):
-        seeds, flags = lax.map(one, (pts_blk, msk_blk), batch_size=batch)
+        if batch is None:
+            # Pallas path: plain lax.map (scan of the unbatched body) — with
+            # batch_size set, lax.map lowers through vmap even at size 1,
+            # which would vmap the pallas_calls over the on-device
+            # while_loop; the sweeps already fill the chip, so keep it
+            # strictly sequential.
+            seeds, flags = lax.map(one, (pts_blk, msk_blk))
+        else:
+            seeds, flags = lax.map(one, (pts_blk, msk_blk), batch_size=batch)
         # Global core count via all-reduce over the mesh. Derivable on host,
         # but kept in the compiled step deliberately: it keeps one real ICI
         # collective in the production program (the comms-backend analog of
@@ -110,16 +122,43 @@ def _run_partitions(bucket_pts, bucket_mask, cfg: DBSCANConfig, mesh):
         return seeds, flags, ncore
 
     if mesh is None:
-        seeds, flags, ncore = jax.jit(block)(bucket_pts, bucket_mask)
-    else:
-        spec = PartitionSpec(PARTS_AXIS)
-        fn = jax.shard_map(
+        return jax.jit(block)
+    spec = PartitionSpec(PARTS_AXIS)
+    return jax.jit(
+        jax.shard_map(
             block,
             mesh=mesh,
             in_specs=(spec, spec),
             out_specs=(spec, spec, PartitionSpec()),
         )
-        seeds, flags, ncore = jax.jit(fn)(bucket_pts, bucket_mask)
+    )
+
+
+def _run_partitions(bucket_pts, bucket_mask, cfg: DBSCANConfig, mesh):
+    """Fan the local kernel out over the partition axis.
+
+    Inside each mesh shard, partitions are processed with lax.map (bounded
+    memory: one [B, B] adjacency at a time, `batch` of them in flight) —
+    the moral equivalent of one Spark executor looping its assigned tasks
+    (DBSCAN.scala:150-154), but compiled.
+    """
+    p_total = bucket_pts.shape[0]
+    # XLA path: vmap small batches of partitions for utilization. Pallas
+    # path: strictly sequential (batch=None -> unbatched lax.map).
+    if cfg.use_pallas:
+        batch = None
+    else:
+        batch = max(1, min(8, p_total // max(1, mesh_size(mesh))))
+    fn = _compiled_block(
+        float(cfg.eps),
+        int(cfg.min_points),
+        cfg.engine.value,
+        cfg.metric,
+        bool(cfg.use_pallas),
+        batch,
+        mesh,
+    )
+    seeds, flags, ncore = fn(bucket_pts, bucket_mask)
     return np.asarray(seeds), np.asarray(flags), int(ncore)
 
 
