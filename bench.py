@@ -10,9 +10,12 @@ same workload on XLA-CPU in a subprocess — a strictly stronger baseline than
 Spark-CPU's scalar JVM loops for this O(B^2)-per-partition algorithm.
 
 Env knobs: BENCH_N (points, default 200k), BENCH_MAXPP (max points per
-partition, default 2048), BENCH_CPU_N (baseline points, default min(N, 100k)),
-BENCH_PALLAS (1 = route the accelerator run through the streaming Pallas
-kernels; the CPU baseline always uses the XLA path).
+partition on the accelerator, default 32768 — large partitions amortize the
+halo duplication and host merge), BENCH_CPU_MAXPP (baseline partition size,
+default 2048 — the CPU's own sweet spot; the quadratic per-partition cost
+favors smaller partitions there), BENCH_CPU_N (baseline points, default
+min(N, 100k)), BENCH_PALLAS (1 = route the accelerator run through the
+streaming Pallas kernels; the CPU baseline always uses the XLA path).
 """
 
 import json
@@ -75,11 +78,12 @@ def child_cpu(data_path: str, out_path: str, maxpp: int) -> None:
 
 def main() -> None:
     n = int(os.environ.get("BENCH_N", "200000"))
-    maxpp = int(os.environ.get("BENCH_MAXPP", "2048"))
+    maxpp = int(os.environ.get("BENCH_MAXPP", "32768"))
+    cpu_maxpp = int(os.environ.get("BENCH_CPU_MAXPP", "2048"))
     cpu_n = int(os.environ.get("BENCH_CPU_N", str(min(n, 100000))))
 
     if len(sys.argv) >= 4 and sys.argv[1] == "--cpu-child":
-        child_cpu(sys.argv[2], sys.argv[3], maxpp)
+        child_cpu(sys.argv[2], sys.argv[3], cpu_maxpp)
         return
 
     import jax

@@ -134,21 +134,26 @@ def _compiled_block(
     )
 
 
-def _run_partitions(bucket_pts, bucket_mask, cfg: DBSCANConfig, mesh):
-    """Fan the local kernel out over the partition axis.
+def _dispatch_partitions(bucket_pts, bucket_mask, cfg: DBSCANConfig, mesh):
+    """Fan the local kernel out over the partition axis (async dispatch).
 
     Inside each mesh shard, partitions are processed with lax.map (bounded
     memory: one [B, B] adjacency at a time, `batch` of them in flight) —
     the moral equivalent of one Spark executor looping its assigned tasks
-    (DBSCAN.scala:150-154), but compiled.
+    (DBSCAN.scala:150-154), but compiled. Returns device arrays without
+    blocking so successive bucket groups overlap on the device queue.
     """
     p_total = bucket_pts.shape[0]
-    # XLA path: vmap small batches of partitions for utilization. Pallas
-    # path: strictly sequential (batch=None -> unbatched lax.map).
+    # XLA path: vmap small batches of partitions for utilization, capped so
+    # the batched [batch, B, B] f32 intermediates stay within a fixed HBM
+    # budget (~1.2G elements ~ 5 GB) — wide buckets run narrower batches.
+    # Pallas path: strictly sequential (batch=None -> unbatched lax.map).
     if cfg.use_pallas:
         batch = None
     else:
-        batch = max(1, min(8, p_total // max(1, mesh_size(mesh))))
+        b = bucket_pts.shape[1]
+        mem_cap = max(1, int(1.2e9) // (b * b))
+        batch = max(1, min(8, mem_cap, p_total // max(1, mesh_size(mesh))))
     fn = _compiled_block(
         float(cfg.eps),
         int(cfg.min_points),
@@ -158,8 +163,7 @@ def _run_partitions(bucket_pts, bucket_mask, cfg: DBSCANConfig, mesh):
         batch,
         mesh,
     )
-    seeds, flags, ncore = fn(bucket_pts, bucket_mask)
-    return np.asarray(seeds), np.asarray(flags), int(ncore)
+    return fn(bucket_pts, bucket_mask)
 
 
 def _local_ids_flat(
@@ -192,20 +196,28 @@ def _local_ids_flat(
     return loc, upart, uloc
 
 
-def _band_membership(points: np.ndarray, margins: binning.Margins) -> np.ndarray:
+def _band_membership(
+    points: np.ndarray,
+    margins: binning.Margins,
+    part_ids: np.ndarray,
+    point_idx: np.ndarray,
+) -> np.ndarray:
     """any-partition merge-band membership per original point:
     main.contains && !inner.almost_contains for some partition
-    (DBSCAN.scala:161-167)."""
+    (DBSCAN.scala:161-167).
+
+    Evaluated over the halo-duplication instance list rather than the full
+    [P, N] cross product: main is a subset of outer, so every (partition,
+    point) pair with main.contains already appears among the duplicated
+    instances — O(instances) single-rect checks instead of O(P*N).
+    """
     pts = np.asarray(points, dtype=np.float64)[:, :2]
     out = np.zeros(len(pts), dtype=bool)
-    # bound the [P, chunk] bool intermediate regardless of partition count
-    chunk = max(1, int(2**24 // max(1, margins.main.shape[0])))
-    for s in range(0, len(pts), chunk):
-        c = pts[s : s + chunk]
-        band = geo.contains_point(
-            margins.main[:, None, :], c[None, :, :]
-        ) & ~geo.almost_contains(margins.inner[:, None, :], c[None, :, :])
-        out[s : s + chunk] = band.any(axis=0)
+    p2 = pts[point_idx]
+    band = geo.contains_point(
+        margins.main[part_ids], p2
+    ) & ~geo.almost_contains(margins.inner[part_ids], p2)
+    out[point_idx[band]] = True
     return out
 
 
@@ -330,9 +342,15 @@ def train_arrays(
     p_true = margins.main.shape[0]
     n_core = 0
     inst_part_l, inst_ptidx_l, inst_seed_l, inst_flag_l = [], [], [], []
-    for g in groups:
-        seeds_g, flags_g, nc = _run_partitions(g.points, g.mask, cfg, mesh)
-        n_core += nc
+    # Dispatch every bucket group before blocking on any result: jax
+    # execution is async, so the device works through the groups while the
+    # host prepares/consumes the others.
+    pending = [
+        (g, _dispatch_partitions(g.points, g.mask, cfg, mesh)) for g in groups
+    ]
+    for g, (seeds_dev, flags_dev, nc) in pending:
+        seeds_g, flags_g = np.asarray(seeds_dev), np.asarray(flags_dev)
+        n_core += int(nc)
         rows, slots = np.nonzero(g.point_idx >= 0)
         inst_part_l.append(g.part_ids[rows])
         inst_ptidx_l.append(g.point_idx[rows, slots])
@@ -349,7 +367,7 @@ def train_arrays(
 
     # 7. merge: union clusters observed on the same halo point.
 
-    band_any = _band_membership(pts, margins)
+    band_any = _band_membership(pts, margins, part_ids, point_idx)
     cand = band_any[inst_ptidx]
 
     uf = UnionFind()

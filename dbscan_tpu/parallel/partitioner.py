@@ -12,8 +12,9 @@ of reference EvenSplitPartitioner.scala:26-211, with three TPU-era changes:
    on integer cell indices (one unit == one ``minimum_rectangle_size`` cell),
    where every cut, complement, and containment test is exact. See
    tests/test_partitioner.py::test_no_points_lost_to_fp_drift.
-2. All candidate-cut evaluation is vectorized: one [K, C] broadcast against
-   the cell stack instead of re-scanning the cell set per candidate cut (the
+2. Candidate-cut evaluation is O(cells + extent) per split: every cut count
+   comes from one per-axis histogram + prefix sum over the cells of the rect
+   being split, instead of re-scanning the cell set per candidate cut (the
    reference's hot spot, :105-123 + :175-181).
 3. The candidate order is DETERMINISTIC: x-cuts ascending, then y-cuts
    ascending, first-win on cost ties. The reference iterates a hash Set
@@ -69,6 +70,28 @@ def _points_in(cells: np.ndarray, counts: np.ndarray, rects: np.ndarray) -> np.n
         )  # [k, C]
         out[s : s + chunk] = inside @ counts
     return out
+
+
+def _candidate_counts(
+    rect: np.ndarray, cx: np.ndarray, cy: np.ndarray, w: np.ndarray
+) -> np.ndarray:
+    """Counts for every candidate sub-rectangle of `rect` in _possible_splits
+    order (x-cuts ascending, then y-cuts ascending), in O(C + extent) via
+    per-axis histograms + prefix sums instead of a [K, C] rescan.
+
+    cx/cy/w are the cells inside `rect` and their point counts. The candidate
+    at x-cut c spans [x, c) x [y, y2); a unit cell is fully inside iff
+    cx + 1 <= c, so its count is the prefix sum of the column histogram up to
+    c - x - 1 (all cells already satisfy the y bounds — they lie in rect).
+    Exact integer arithmetic throughout.
+    """
+    x, y, x2, y2 = (int(v) for v in rect)
+    bx = np.bincount(cx - x, weights=w, minlength=x2 - x).astype(np.int64)
+    by = np.bincount(cy - y, weights=w, minlength=y2 - y).astype(np.int64)
+    # cut c = x+1+j  ->  count = cumx[j], for j in [0, x2-x-2]
+    return np.concatenate(
+        [np.cumsum(bx)[: x2 - x - 1], np.cumsum(by)[: y2 - y - 1]]
+    )
 
 
 def _possible_splits(rect: np.ndarray) -> np.ndarray:
@@ -134,23 +157,36 @@ def partition_cells(
         dtype=np.int64,
     )
     total = int(counts.sum())
-    remaining: List[Tuple[np.ndarray, int]] = [(bounding, total)]
+    # Each entry carries the indices of its cells: splits partition the cell
+    # set exactly (unit cells never straddle an integer cut), so candidate
+    # evaluation only ever touches the cells of the rect being split.
+    remaining: List[Tuple[np.ndarray, int, np.ndarray]] = [
+        (bounding, total, np.arange(cells.shape[0]))
+    ]
     done: List[Tuple[np.ndarray, int]] = []
 
     while remaining:
-        rect, count = remaining.pop(0)
+        rect, count, idx = remaining.pop(0)
         if count > max_points_per_partition and _can_be_split(rect):
-            candidates = _possible_splits(rect)
-            cand_counts = _points_in(cells, counts, candidates)
+            x, y, x2, y2 = (int(v) for v in rect)
+            cx, cy, w = cells[idx, 0], cells[idx, 1], counts[idx]
+            cand_counts = _candidate_counts(rect, cx, cy, w)
             half = count // 2
             cost = np.abs(half - cand_counts)
             best = int(np.argmin(cost))  # first minimum: first-win on ties
-            split1 = candidates[best]
+            n_xcuts = x2 - x - 1
+            if best < n_xcuts:  # x-cut at c = x + 1 + best
+                split1 = np.array([x, y, x + 1 + best, y2], dtype=np.int64)
+                in1 = (cx - x) <= best
+            else:  # y-cut at c = y + 1 + (best - n_xcuts)
+                j = best - n_xcuts
+                split1 = np.array([x, y, x2, y + 1 + j], dtype=np.int64)
+                in1 = (cy - y) <= j
             split2 = _complement(split1, rect)
             c1 = int(cand_counts[best])
             c2 = count - c1  # exact: cells partition between the two halves
             # Depth-first, first half first (s1 :: s2 :: rest).
-            remaining[:0] = [(split1, c1), (split2, c2)]
+            remaining[:0] = [(split1, c1, idx[in1]), (split2, c2, idx[~in1])]
         else:
             if count > max_points_per_partition:
                 logger.warning(

@@ -124,3 +124,31 @@ def test_unsplittable_overfull_cell_emitted_as_is():
     parts = partitioner.partition(cells, counts, 10, 1.0)
     assert len(parts) == 1
     assert parts[0][1] == 50
+
+
+def test_candidate_counts_matches_broadcast_oracle(rng):
+    """The O(C + extent) histogram/prefix-sum candidate evaluation must agree
+    with the direct [K, C] containment broadcast (_points_in over
+    _possible_splits) for every candidate of random rects — the oracle is the
+    reference's pointsInRectangle semantics made literal."""
+    for _ in range(50):
+        w, h = rng.integers(2, 30, size=2)
+        x0, y0 = rng.integers(-40, 40, size=2)
+        rect = np.array([x0, y0, x0 + w, y0 + h], dtype=np.int64)
+        n_cells = int(rng.integers(1, 80))
+        cells = np.stack(
+            [
+                rng.integers(x0, x0 + w, size=n_cells),
+                rng.integers(y0, y0 + h, size=n_cells),
+            ],
+            axis=1,
+        ).astype(np.int64)
+        cells = np.unique(cells, axis=0)
+        counts = rng.integers(1, 1000, size=cells.shape[0]).astype(np.int64)
+        fast = partitioner._candidate_counts(
+            rect, cells[:, 0], cells[:, 1], counts
+        )
+        oracle = partitioner._points_in(
+            cells, counts, partitioner._possible_splits(rect)
+        )
+        np.testing.assert_array_equal(fast, oracle)
