@@ -73,6 +73,13 @@ class DBSCANConfig:
         (sublane*lane friendly) to bound recompilation across runs.
       use_pallas: route the per-partition kernel through the Pallas tiled
         implementation instead of plain XLA ops.
+      neighbor_backend: "auto" | "dense" | "banded" — how the per-partition
+        engine finds eps-neighbors. "dense" materializes the [B, B]
+        adjacency; "banded" sorts each partition by an eps-cell grid and
+        sweeps only the 3-row candidate windows (O(B * window),
+        dbscan_tpu/ops/banded.py; euclidean 2-D only). "auto" picks banded
+        for partitions large enough that the windows pay off. Ignored when
+        use_pallas is set.
     """
 
     eps: float
@@ -83,6 +90,7 @@ class DBSCANConfig:
     metric: str = "euclidean"
     bucket_multiple: int = 128
     use_pallas: bool = False
+    neighbor_backend: str = "auto"
 
     @property
     def eps_sq(self) -> float:
@@ -106,5 +114,15 @@ class DBSCANConfig:
         if self.bucket_multiple < 1:
             raise ValueError(
                 f"bucket_multiple must be >= 1, got {self.bucket_multiple}"
+            )
+        if self.neighbor_backend not in ("auto", "dense", "banded"):
+            raise ValueError(
+                'neighbor_backend must be "auto", "dense", or "banded", got '
+                f"{self.neighbor_backend!r}"
+            )
+        if self.neighbor_backend == "banded" and self.metric != "euclidean":
+            raise ValueError(
+                "neighbor_backend='banded' supports only the euclidean "
+                f"metric (eps-cell grids), got {self.metric!r}"
             )
         return self

@@ -92,6 +92,7 @@ def train(
     precision: Precision = Precision.F32,
     bucket_multiple: int = 128,
     use_pallas: bool = False,
+    neighbor_backend: str = "auto",
     mesh=None,
     config: Optional[DBSCANConfig] = None,
 ) -> DBSCANModel:
@@ -113,6 +114,7 @@ def train(
         precision=precision,
         bucket_multiple=bucket_multiple,
         use_pallas=use_pallas,
+        neighbor_backend=neighbor_backend,
     )
     out: TrainOutput = train_arrays(data, cfg, mesh=mesh)
     return DBSCANModel(

@@ -124,9 +124,27 @@ def cell_histogram_int(points, cell_size):
     inverse [N] mapping points to cell rows).
     """
     idx = cell_index(points, cell_size)
-    uniq, inverse, counts = np.unique(
-        idx, axis=0, return_inverse=True, return_counts=True
-    )
+    if idx.shape[0] == 0:
+        return (
+            np.empty((0, 2), np.int64),
+            np.empty(0, np.int64),
+            np.empty(0, np.int64),
+        )
+    # Composite 1-D key: np.unique(axis=0) goes through a void-view sort
+    # that is ~20x slower than a flat int64 sort at millions of points.
+    mn = idx.min(axis=0)
+    span_y = int(idx[:, 1].max()) - int(mn[1]) + 1
+    span_x = int(idx[:, 0].max()) - int(mn[0]) + 1
+    if span_x * span_y < 2**62:
+        key = (idx[:, 0] - mn[0]) * span_y + (idx[:, 1] - mn[1])
+        uk, inverse, counts = np.unique(
+            key, return_inverse=True, return_counts=True
+        )
+        uniq = np.stack([uk // span_y + mn[0], uk % span_y + mn[1]], axis=1)
+    else:  # astronomically sparse grid: fall back to the exact 2-D unique
+        uniq, inverse, counts = np.unique(
+            idx, axis=0, return_inverse=True, return_counts=True
+        )
     return uniq, counts.astype(np.int64), inverse.astype(np.int64)
 
 

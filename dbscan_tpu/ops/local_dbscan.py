@@ -163,11 +163,20 @@ def cluster_from_adjacency(
     return _finalize(mask, core, comp, core_nbr_seed, counts, engine)
 
 
-def _finalize(mask, core, comp, core_nbr_seed, counts, engine: str) -> LocalResult:
+def _finalize(
+    mask, core, comp, core_nbr_seed, counts, engine: str, own_idx=None
+) -> LocalResult:
     """Border/noise algebra + flag packing shared by all engine backends
-    (see module docstring items 3-4)."""
+    (see module docstring items 3-4).
+
+    own_idx: optional [N] int32 fold index per array position, for backends
+    whose arrays are not in fold order (the banded engine sorts by cell);
+    None means position == fold index.
+    """
     n = mask.shape[0]
-    idx = jnp.arange(n, dtype=jnp.int32)
+    idx = (
+        jnp.arange(n, dtype=jnp.int32) if own_idx is None else own_idx
+    )
     none = jnp.int32(SEED_NONE)
     has_core_nbr = core_nbr_seed != none
     if engine == "naive":

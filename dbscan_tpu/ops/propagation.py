@@ -22,7 +22,9 @@ from dbscan_tpu.ops.labels import SEED_NONE
 
 
 def min_label_fixed_point(
-    init: jnp.ndarray, neighbor_min: Callable[[jnp.ndarray], jnp.ndarray]
+    init: jnp.ndarray,
+    neighbor_min: Callable[[jnp.ndarray], jnp.ndarray],
+    pos_of_label: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Iterate ``labels -> min(labels, neighbor_min(labels), hop)`` to a fixed
     point.
@@ -31,6 +33,10 @@ def min_label_fixed_point(
       elsewhere).
     neighbor_min: labels -> [N] int32 per-row min of neighbor labels
       (SEED_NONE where no neighbor qualifies).
+    pos_of_label: optional [N] int32 mapping a LABEL VALUE to the array
+      position that carries it — for engines whose label values are not array
+      positions (the banded engine labels by original fold index while its
+      arrays live in cell-sorted order). None means values ARE positions.
 
     The pointer jump (``new[new]`` gather, chain-collapsing) keeps iteration
     count O(log diameter) instead of O(diameter) for chain-shaped clusters.
@@ -51,6 +57,8 @@ def min_label_fixed_point(
         labels, _, it = state
         new = jnp.minimum(labels, neighbor_min(labels))
         safe = jnp.clip(new, 0, n - 1)
+        if pos_of_label is not None:
+            safe = pos_of_label[safe]
         hop = jnp.where(new == none, none, new[safe])
         new = jnp.minimum(new, hop)
         return new, jnp.any(new != labels), it + 1

@@ -61,18 +61,56 @@ def duplicate_points(
     return part_ids[order].astype(np.int64), point_idx[order]
 
 
+def _ladder_width(c: int, bucket_multiple: int) -> int:
+    """Round a count up along a ~1.5x geometric ladder of bucket_multiple
+    multiples (q in 1, 1.5, 2, 3, 4, 6, ... when it divides evenly): area
+    waste bounded at ~2.25x worst-case vs exact, while widths recur across
+    runs so the compile cache stays small."""
+    c = max(1, int(c))
+    q_needed = math.ceil(c / bucket_multiple)
+    q = 1
+    while q < q_needed:
+        nq = q * 3 // 2 if (q & (q - 1)) == 0 else q * 4 // 3
+        q = nq if nq > q else q + 1  # progress even at q=1
+    return q * bucket_multiple
+
+
+class BandedExtras(NamedTuple):
+    """Cell-sorted block-slab metadata for the banded engine
+    (dbscan_tpu/ops/banded.py). All arrays are indexed by SORTED position;
+    B is a multiple of ops.banded.BANDED_BLOCK.
+
+    fold_idx: [P_pad, B] int32 original fold index per position (identity on
+    padding); pos_of_fold: [P_pad, B] int32 inverse permutation;
+    rel_starts/spans: [P_pad, B, 3] int32 per-point candidate runs (one per
+    neighboring cell row), starts relative to the row's block slab;
+    slab_starts: [P_pad, B // BANDED_BLOCK, 3] int32 absolute slab origins;
+    slab: static S >= every slab length (slab_start + S <= B).
+    """
+
+    fold_idx: np.ndarray
+    pos_of_fold: np.ndarray
+    rel_starts: np.ndarray
+    spans: np.ndarray
+    slab_starts: np.ndarray
+    slab: int
+
+
 class BucketGroup(NamedTuple):
     """One same-width slab of partitions (see :func:`bucketize_grouped`).
 
     points: [P_pad, B, D]; mask: [P_pad, B] validity; point_idx: [P_pad, B]
     original row index (-1 padding); part_ids: [P_pad] ORIGINAL partition id
-    per row, -1 on padding partitions.
+    per row, -1 on padding partitions. banded: window metadata when this
+    group runs the banded engine (points then sit in cell-sorted order),
+    None for the dense engine (fold order).
     """
 
     points: np.ndarray
     mask: np.ndarray
     point_idx: np.ndarray
     part_ids: np.ndarray
+    banded: BandedExtras = None
 
 
 def bucketize_grouped(
@@ -102,20 +140,9 @@ def bucketize_grouped(
     d = pts.shape[1]
     counts = np.bincount(part_ids, minlength=n_parts)
 
-    def width(c: int) -> int:
-        # 1.5x geometric ladder of bucket_multiple multiples
-        # (q in 1, 1.5, 2, 3, 4, 6, ... when it divides evenly): area waste
-        # bounded at ~2.25x worst-case vs exact, while widths recur across
-        # runs so the compile cache stays small.
-        c = max(1, int(c))
-        q_needed = math.ceil(c / bucket_multiple)
-        q = 1
-        while q < q_needed:
-            nq = q * 3 // 2 if (q & (q - 1)) == 0 else q * 4 // 3
-            q = nq if nq > q else q + 1  # progress even at q=1
-        return q * bucket_multiple
-
-    widths = np.array([width(c) for c in counts], dtype=np.int64)
+    widths = np.array(
+        [_ladder_width(c, bucket_multiple) for c in counts], dtype=np.int64
+    )
     starts = np.searchsorted(part_ids, np.arange(n_parts))
     slot_all = (
         np.arange(part_ids.size) - np.repeat(starts, counts)
@@ -144,5 +171,257 @@ def bucketize_grouped(
             mask[rows, slots] = True
             idx[rows, slots] = point_idx[gi]
         groups.append(BucketGroup(buf, mask, idx, pid))
+        max_b = max(max_b, b)
+    return groups, max_b
+
+
+# Cell size safety factor over eps: a pair the device's f32 distance test
+# could accept (true distance <= eps * (1 + few ulps)) must lie within the
+# 3x3 cell ring, so cells are built marginally larger than eps. 1e-5 covers
+# f32's ~1e-7/op rounding with orders of magnitude to spare, while growing
+# windows imperceptibly.
+CELL_SLACK = 1.0 + 1e-5
+
+# Partitions narrower than this always use the dense engine: at small B the
+# [B, B] sweep is already cheap and window bookkeeping is pure overhead.
+MIN_BANDED_BUCKET = 4096
+
+# At or above this width the dense engine is no longer an option at all — a
+# [B, B] f32 measure matrix at B = 65536 is 17 GB, past a v5e chip's HBM —
+# so auto ALWAYS routes such partitions through the banded engine. Below
+# it, measured crossover on v5e: the dense sweep's perfectly-tiled [B, B]
+# broadcasts beat the banded slab machinery unless the slabs shrink the
+# work by a margin larger than their per-block overheads (~an order of
+# magnitude).
+DENSE_MAX_BUCKET = 65536
+
+# Rows per block-slab tile in the banded engine; banded bucket widths are
+# padded to a multiple of this. Bigger blocks amortize the per-slab DMA
+# latency over more rows but widen the union slab S (waste ~6 cells'
+# occupancy); 1024 measured fastest on v5e at bench densities. Lives here
+# (host side) so the packer has no jax dependency; dbscan_tpu/ops/banded.py
+# imports it.
+BANDED_BLOCK = 1024
+
+
+def bucketize_banded(
+    points: np.ndarray,
+    part_ids: np.ndarray,
+    point_idx: np.ndarray,
+    n_parts: int,
+    eps: float,
+    outer: np.ndarray,
+    bucket_multiple: int = 128,
+    pad_parts_to: int = 1,
+    dtype=np.float32,
+    force: bool = False,
+) -> Tuple[list, int]:
+    """Pack partitions for the banded engine (dbscan_tpu/ops/banded.py).
+
+    Per partition: snap instances to an eps-sized grid anchored at the
+    partition's outer rect, sort by cell row-major (stable, so equal-cell
+    points keep fold order), and precompute each point's three contiguous
+    candidate runs — one per neighboring cell row — in the sorted order.
+    Runs are then grouped by blocks of BANDED_BLOCK consecutive rows: the
+    per-(block, cell row) union of runs is the contiguous SLAB the device
+    fetches with one dynamic_slice; the static slab bound S is the padded
+    max slab length. Partitions where 3*S gives no real saving over the
+    dense [B, B] sweep (or below MIN_BANDED_BUCKET, unless ``force``) fall
+    back to dense groups.
+
+    Groups by (width, S) for banded parts and width for dense parts; returns
+    (groups, max width) like :func:`bucketize_grouped`, with ``banded`` set
+    on the banded groups.
+    """
+    pts = np.asarray(points)
+    if pts.shape[1] != 2:
+        raise ValueError(f"banded bucketing is 2-D only, got D={pts.shape[1]}")
+    m_tot = part_ids.size
+    counts = np.bincount(part_ids, minlength=n_parts)
+    part_start = np.concatenate([[0], np.cumsum(counts)])[:-1]
+    widths_b = np.array(
+        [_ladder_width(c, bucket_multiple) for c in counts], dtype=np.int64
+    )
+
+    if m_tot == 0:
+        return bucketize_grouped(
+            points, part_ids, point_idx, n_parts, bucket_multiple,
+            pad_parts_to, dtype,
+        )
+
+    cell = float(eps) * CELL_SLACK
+    xy = np.asarray(pts, dtype=np.float64)[point_idx]
+    # Cells must be computed from the coordinates the DEVICE sees: under
+    # f32/bf16 the cast can move a point across a float64 cell boundary
+    # (quantization error scales with |coordinate|, far beyond CELL_SLACK's
+    # arithmetic-rounding margin), and a run built from the float64 cell
+    # would miss pairs the device's distance test accepts.
+    xy_dev = xy.astype(dtype).astype(np.float64)
+    ox = outer[part_ids, 0]
+    oy = outer[part_ids, 1]
+    cx = np.maximum(np.floor((xy_dev[:, 0] - ox) / cell), 0.0).astype(np.int64)
+    cy = np.maximum(np.floor((xy_dev[:, 1] - oy) / cell), 0.0).astype(np.int64)
+
+    # Segment maxima via reduceat (instances are sorted by partition);
+    # ufunc.at is a scalar Python-level loop — ~10s at 5M instances.
+    nz = counts > 0
+    segs = part_start[nz]
+    cxmax = np.zeros(n_parts, dtype=np.int64)
+    cymax = np.zeros(n_parts, dtype=np.int64)
+    if segs.size:
+        cxmax[nz] = np.maximum.reduceat(cx, segs)
+        cymax[nz] = np.maximum.reduceat(cy, segs)
+    stride = cxmax + 3  # cx + 2 < stride: row windows never wrap
+    key = cy * stride[part_ids] + cx
+    big = int((stride * (cymax + 2)).max()) + 1  # per-partition key space
+
+    # Stable sort by (partition, cell key): instances arrive in (partition,
+    # fold) order, so ties keep fold order inside each cell.
+    fold = np.arange(m_tot, dtype=np.int64) - part_start[part_ids]
+    order = np.lexsort((key, part_ids))
+    p_s = part_ids[order]
+    gkey_s = p_s * big + key[order]
+    cx_s, cy_s = cx[order], cy[order]
+    fold_s = fold[order]
+    ptidx_s = point_idx[order]
+    xy_s = xy[order]
+    slots_s = np.arange(m_tot, dtype=np.int64) - part_start[p_s]
+    stride_s = stride[p_s]
+    base_s = p_s * big
+    seg_start = part_start[p_s]
+
+    starts3 = np.empty((m_tot, 3), dtype=np.int64)
+    spans3 = np.empty((m_tot, 3), dtype=np.int64)
+    for k, dr in enumerate((-1, 0, 1)):
+        row = cy_s + dr
+        lo = base_s + row * stride_s + cx_s - 1
+        s = np.searchsorted(gkey_s, lo)
+        e = np.searchsorted(gkey_s, lo + 3)
+        # lo can undershoot the partition's key space (cx=0 or row=-1);
+        # clamp into this partition's segment so a neighboring partition's
+        # tail never leaks into the window.
+        s = np.maximum(s, seg_start)
+        e = np.maximum(e, s)
+        valid = (row >= 0) & (row <= cymax[p_s])
+        starts3[:, k] = np.where(valid, s - seg_start, 0)
+        spans3[:, k] = np.where(valid, e - s, 0)
+
+    # Banded bucket widths: the dense ladder width padded up to a multiple
+    # of the block size.
+    t = BANDED_BLOCK
+    widths_band = (widths_b + t - 1) // t * t
+    nb_of = widths_band // t  # blocks per partition
+    maxnb = int(nb_of.max())
+
+    # Per-(partition block, cell row) slab = union of the block rows' runs:
+    # min start / max end over valid runs.
+    blk_s = slots_s // t
+    bkey = p_s * maxnb + blk_s  # nondecreasing: p_s sorted, slots ascending
+    n_bkeys = n_parts * maxnb
+    bmin = np.zeros((n_bkeys, 3), dtype=np.int64)
+    bmax = np.zeros((n_bkeys, 3), dtype=np.int64)
+    run_valid = spans3 > 0
+    for k in range(3):
+        v = run_valid[:, k]
+        bk = bkey[v]
+        if bk.size == 0:
+            continue
+        st = starts3[v, k]
+        first = np.flatnonzero(np.r_[True, bk[1:] != bk[:-1]])
+        u = bk[first]
+        bmin[u, k] = np.minimum.reduceat(st, first)
+        bmax[u, k] = np.maximum.reduceat(st + spans3[v, k], first)
+
+    slab_need = (bmax - bmin).max(axis=1).reshape(n_parts, maxnb).max(axis=1)
+    win = np.minimum(
+        np.array([_ladder_width(s, 128) for s in slab_need], dtype=np.int64),
+        widths_band,  # slab can never exceed the bucket; ladder may overshoot
+    )
+
+    # Clamp slab origins so slab_start + S <= B; runs still fit (a clamped
+    # origin only moves left, and run ends are bounded by the bucket width).
+    part_of_bkey = np.repeat(np.arange(n_parts), maxnb)
+    sstart = np.clip(bmin, 0, (widths_band - win)[part_of_bkey][:, None])
+
+    if force:
+        use_banded = counts > 0
+    else:
+        use_banded = (
+            (counts > 0)
+            & (widths_band >= MIN_BANDED_BUCKET)
+            & (
+                (widths_band >= DENSE_MAX_BUCKET)  # dense cannot fit HBM
+                | (3 * win <= widths_band // 16)  # >=16x less sweep work
+            )
+        )
+
+    groups: list = []
+    max_b = 0
+
+    # Dense fallback partitions go through the plain packer. Instances of
+    # banded partitions are filtered out but n_parts keeps original ids;
+    # the resulting zero-count rows land in the smallest-width group with
+    # all-False masks and are skipped by the driver's instance scan.
+    if not use_banded.all():
+        dense_inst = ~use_banded[part_ids]
+        if dense_inst.any() or not use_banded.any():
+            dgroups, dmax = bucketize_grouped(
+                points,
+                part_ids[dense_inst],
+                point_idx[dense_inst],
+                n_parts,
+                bucket_multiple,
+                pad_parts_to,
+                dtype,
+            )
+            groups.extend(dgroups)
+            max_b = max(max_b, dmax)
+
+    banded_inst = use_banded[p_s]
+    # Per-instance run start within its slab; invalid runs (span 0) pin to 0
+    # rather than inheriting a meaningless negative offset.
+    rel3 = np.where(run_valid, starts3 - sstart[bkey], 0)
+    for b, w in sorted(
+        set(zip(widths_band[use_banded].tolist(), win[use_banded].tolist()))
+    ):
+        sel_parts = np.flatnonzero(
+            use_banded & (widths_band == b) & (win == w)
+        )
+        nb = b // t
+        p_pad = max(1, math.ceil(len(sel_parts) / pad_parts_to) * pad_parts_to)
+        buf = np.zeros((p_pad, b, 2), dtype=dtype)
+        mask = np.zeros((p_pad, b), dtype=bool)
+        idx = np.full((p_pad, b), -1, dtype=np.int64)
+        pid = np.full(p_pad, -1, dtype=np.int64)
+        pid[: len(sel_parts)] = sel_parts
+        iota = np.arange(b, dtype=np.int32)
+        fold_b = np.broadcast_to(iota, (p_pad, b)).copy()
+        pos_b = np.broadcast_to(iota, (p_pad, b)).copy()
+        st_b = np.zeros((p_pad, b, 3), dtype=np.int32)
+        sp_b = np.zeros((p_pad, b, 3), dtype=np.int32)
+        sl_b = np.zeros((p_pad, nb, 3), dtype=np.int32)
+
+        row_of_part = np.full(n_parts, -1, dtype=np.int64)
+        row_of_part[sel_parts] = np.arange(len(sel_parts))
+        gi = np.flatnonzero(banded_inst & (row_of_part[p_s] >= 0))
+        rows = row_of_part[p_s[gi]]
+        slots = slots_s[gi]
+        buf[rows, slots] = xy_s[gi].astype(dtype)
+        mask[rows, slots] = True
+        idx[rows, slots] = ptidx_s[gi]
+        fold_b[rows, slots] = fold_s[gi]
+        pos_b[rows, fold_s[gi]] = slots
+        st_b[rows, slots] = rel3[gi]
+        sp_b[rows, slots] = spans3[gi]
+        sl_b[: len(sel_parts)] = sstart[
+            sel_parts[:, None] * maxnb + np.arange(nb)[None, :]
+        ]
+
+        groups.append(
+            BucketGroup(
+                buf, mask, idx, pid,
+                BandedExtras(fold_b, pos_b, st_b, sp_b, sl_b, int(w)),
+            )
+        )
         max_b = max(max_b, b)
     return groups, max_b

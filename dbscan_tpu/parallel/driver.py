@@ -134,26 +134,89 @@ def _compiled_block(
     )
 
 
-def _dispatch_partitions(bucket_pts, bucket_mask, cfg: DBSCANConfig, mesh):
+@functools.lru_cache(maxsize=256)
+def _compiled_block_banded(
+    eps: float,
+    min_points: int,
+    engine: str,
+    slab: int,
+    batch: Optional[int],
+    mesh,
+):
+    """Jitted per-group executor for the banded engine
+    (dbscan_tpu/ops/banded.py); cached like :func:`_compiled_block`."""
+    from dbscan_tpu.ops.banded import banded_local_dbscan
+
+    def one(args):
+        pts, msk, fold, pos, rel, sp, sl = args
+        r = banded_local_dbscan(
+            pts, msk, fold, pos, rel, sp, sl, eps, min_points,
+            engine=engine, slab=slab,
+        )
+        return r.seed_labels, r.flags
+
+    def block(pts, msk, fold, pos, rel, sp, sl):
+        seeds, flags = lax.map(
+            one, (pts, msk, fold, pos, rel, sp, sl), batch_size=batch
+        )
+        ncore = jnp.sum(flags == CORE, dtype=jnp.int32)
+        if mesh is not None:
+            ncore = lax.psum(ncore, PARTS_AXIS)
+        return seeds, flags, ncore
+
+    if mesh is None:
+        return jax.jit(block)
+    spec = PartitionSpec(PARTS_AXIS)
+    return jax.jit(
+        jax.shard_map(
+            block,
+            mesh=mesh,
+            in_specs=(spec,) * 7,
+            out_specs=(spec, spec, PartitionSpec()),
+        )
+    )
+
+
+def _dispatch_partitions(group, cfg: DBSCANConfig, mesh):
     """Fan the local kernel out over the partition axis (async dispatch).
 
     Inside each mesh shard, partitions are processed with lax.map (bounded
-    memory: one [B, B] adjacency at a time, `batch` of them in flight) —
-    the moral equivalent of one Spark executor looping its assigned tasks
+    memory: one adjacency at a time, `batch` of them in flight) — the moral
+    equivalent of one Spark executor looping its assigned tasks
     (DBSCAN.scala:150-154), but compiled. Returns device arrays without
     blocking so successive bucket groups overlap on the device queue.
     """
-    p_total = bucket_pts.shape[0]
-    # XLA path: vmap small batches of partitions for utilization, capped so
-    # the batched [batch, B, B] f32 intermediates stay within a fixed HBM
-    # budget (~1.2G elements ~ 5 GB) — wide buckets run narrower batches.
-    # Pallas path: strictly sequential (batch=None -> unbatched lax.map).
+    p_total, b = group.points.shape[:2]
+    banded = group.banded
+    # vmap small batches of partitions for utilization, capped so the
+    # batched per-partition intermediates ([B, B] dense / [B, 3, W] banded)
+    # stay within a fixed HBM element budget — wide buckets run narrower
+    # batches. Pallas path: strictly sequential (batch=None -> unbatched
+    # lax.map).
     if cfg.use_pallas:
         batch = None
     else:
-        b = bucket_pts.shape[1]
-        mem_cap = max(1, int(1.2e9) // (b * b))
+        per_part = b * (3 * banded.slab) if banded is not None else b * b
+        mem_cap = max(1, int(1.2e9) // per_part)
         batch = max(1, min(8, mem_cap, p_total // max(1, mesh_size(mesh))))
+    if banded is not None:
+        fn = _compiled_block_banded(
+            float(cfg.eps),
+            int(cfg.min_points),
+            cfg.engine.value,
+            int(banded.slab),
+            batch,
+            mesh,
+        )
+        return fn(
+            group.points,
+            group.mask,
+            banded.fold_idx,
+            banded.pos_of_fold,
+            banded.rel_starts,
+            banded.spans,
+            banded.slab_starts,
+        )
     fn = _compiled_block(
         float(cfg.eps),
         int(cfg.min_points),
@@ -163,7 +226,7 @@ def _dispatch_partitions(bucket_pts, bucket_mask, cfg: DBSCANConfig, mesh):
         batch,
         mesh,
     )
-    return fn(bucket_pts, bucket_mask)
+    return fn(group.points, group.mask)
 
 
 def _local_ids_flat(
@@ -260,6 +323,7 @@ def train_arrays(
                 "n_partitions": 0,
                 "bucket_size": 0,
                 "n_bucket_groups": 0,
+                "n_banded_groups": 0,
                 "duplication_factor": 0.0,
                 "n_clusters": 0,
                 "n_core_instances": 0,
@@ -326,15 +390,35 @@ def train_arrays(
         "f64": np.float64,
         "bf16": ml_dtypes.bfloat16,
     }[cfg.precision.value]
-    groups, max_b = binning.bucketize_grouped(
-        kernel_cols,
-        part_ids,
-        point_idx,
-        n_parts=margins.main.shape[0],
-        bucket_multiple=cfg.bucket_multiple,
-        pad_parts_to=mesh_size(mesh),
-        dtype=dtype,
+    use_banded = (
+        cfg.neighbor_backend != "dense"
+        and not cfg.use_pallas
+        and cfg.metric == "euclidean"
+        and kernel_cols.shape[1] == 2
     )
+    if use_banded:
+        groups, max_b = binning.bucketize_banded(
+            kernel_cols,
+            part_ids,
+            point_idx,
+            n_parts=margins.main.shape[0],
+            eps=float(cfg.eps),
+            outer=margins.outer,
+            bucket_multiple=cfg.bucket_multiple,
+            pad_parts_to=mesh_size(mesh),
+            dtype=dtype,
+            force=cfg.neighbor_backend == "banded",
+        )
+    else:
+        groups, max_b = binning.bucketize_grouped(
+            kernel_cols,
+            part_ids,
+            point_idx,
+            n_parts=margins.main.shape[0],
+            bucket_multiple=cfg.bucket_multiple,
+            pad_parts_to=mesh_size(mesh),
+            dtype=dtype,
+        )
     t0 = _mark("bucketize_s", t0)
 
     # 5. per-partition clustering on device, one launch per bucket width
@@ -345,9 +429,7 @@ def train_arrays(
     # Dispatch every bucket group before blocking on any result: jax
     # execution is async, so the device works through the groups while the
     # host prepares/consumes the others.
-    pending = [
-        (g, _dispatch_partitions(g.points, g.mask, cfg, mesh)) for g in groups
-    ]
+    pending = [(g, _dispatch_partitions(g, cfg, mesh)) for g in groups]
     for g, (seeds_dev, flags_dev, nc) in pending:
         seeds_g, flags_g = np.asarray(seeds_dev), np.asarray(flags_dev)
         n_core += int(nc)
@@ -469,6 +551,7 @@ def train_arrays(
         "n_partitions": p_true,
         "bucket_size": int(max_b),
         "n_bucket_groups": len(groups),
+        "n_banded_groups": sum(1 for g in groups if g.banded is not None),
         "duplication_factor": float(len(part_ids)) / max(1, n),
         "n_clusters": n_clusters,
         "n_core_instances": n_core,

@@ -1,0 +1,172 @@
+"""Banded (block-slab) engine vs the dense engine: bit-exact equality.
+
+The banded engine (dbscan_tpu/ops/banded.py) must reproduce the dense
+engine's output EXACTLY — same difference-form f32 arithmetic, same
+border/noise algebra — for every geometry that stresses its machinery:
+cell-row straddles, empty cell rows, single-cell pileups, points on cell
+boundaries, multi-partition halo interplay, and both reference engines'
+border semantics. The packer invariants (every run fits its slab, inverse
+permutation consistency) are checked directly.
+"""
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import Engine, train
+from dbscan_tpu.parallel import binning
+
+
+def _equal_models(pts, eps, min_points, maxpp, engine, mesh=None):
+    kw = dict(
+        eps=eps,
+        min_points=min_points,
+        max_points_per_partition=maxpp,
+        engine=engine,
+        mesh=mesh,
+    )
+    md = train(pts, neighbor_backend="dense", **kw)
+    mb = train(pts, neighbor_backend="banded", **kw)
+    np.testing.assert_array_equal(md.clusters, mb.clusters)
+    np.testing.assert_array_equal(md.flags, mb.flags)
+    assert mb.stats["n_banded_groups"] >= 1
+    return mb
+
+
+GEOMETRIES = {
+    "blobs+noise": lambda rng: np.concatenate(
+        [rng.normal(c, 0.5, (700, 2)) for c in [(0, 0), (5, 5), (-4, 6)]]
+        + [rng.uniform(-8, 10, (300, 2))]
+    ),
+    "thin-horizontal-chain": lambda rng: np.stack(
+        [np.linspace(0, 40, 1500), rng.normal(0, 0.05, 1500)], axis=1
+    ),
+    "single-cell-pileup": lambda rng: rng.normal(0, 0.02, (1200, 2)),
+    "grid-boundary-points": lambda rng: np.concatenate(
+        [
+            # points exactly on multiples of eps (cell boundaries)
+            np.stack(
+                [
+                    rng.integers(0, 12, 600) * 0.3,
+                    rng.integers(0, 12, 600) * 0.3,
+                ],
+                axis=1,
+            ),
+            rng.uniform(0, 3.6, (600, 2)),
+        ]
+    ),
+    "sparse-rows": lambda rng: np.concatenate(
+        [
+            rng.normal((0, 0), 0.4, (800, 2)),
+            rng.normal((0, 7), 0.4, (800, 2)),  # empty cell rows between
+        ]
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(GEOMETRIES))
+@pytest.mark.parametrize("engine", [Engine.NAIVE, Engine.ARCHERY])
+def test_banded_equals_dense_single_partition(name, engine, rng):
+    pts = GEOMETRIES[name](rng)
+    _equal_models(pts, 0.3, 6, 10**9, engine)
+
+
+@pytest.mark.parametrize("engine", [Engine.NAIVE, Engine.ARCHERY])
+def test_banded_equals_dense_multi_partition(engine, rng):
+    pts = np.concatenate(
+        [rng.normal(c, 0.6, (1500, 2)) for c in [(0, 0), (6, 6), (-5, 7)]]
+        + [rng.uniform(-10, 12, (500, 2))]
+    )
+    m = _equal_models(pts, 0.3, 8, 700, engine)
+    assert m.stats["n_partitions"] > 4
+
+
+def test_banded_equals_dense_on_mesh(rng):
+    from dbscan_tpu.parallel.mesh import make_mesh
+
+    pts = np.concatenate(
+        [rng.normal(c, 0.5, (900, 2)) for c in [(0, 0), (7, 7), (-6, 8), (9, -7)]]
+    )
+    m = _equal_models(pts, 0.35, 8, 600, Engine.ARCHERY, mesh=make_mesh())
+    assert m.stats["n_partitions"] >= 8
+
+
+def test_banded_handles_empty_and_tiny():
+    m = train(
+        np.empty((0, 2)), eps=0.3, min_points=3,
+        max_points_per_partition=100, neighbor_backend="banded",
+    )
+    assert m.n_clusters == 0
+    m = train(
+        np.array([[0.0, 0.0], [0.05, 0.0], [10.0, 10.0]]),
+        eps=0.3, min_points=2, max_points_per_partition=100,
+        neighbor_backend="banded",
+    )
+    assert m.n_clusters == 1
+    assert (m.clusters > 0).sum() == 2
+
+
+def test_auto_routes_large_buckets_banded(rng):
+    """auto must choose banded where dense cannot fit HBM (B > 64k)."""
+    # 70k points in one partition -> bucket width > DENSE_MAX_BUCKET
+    pts = rng.uniform(0, 100, (70000, 2))
+    m = train(
+        pts, eps=0.5, min_points=4, max_points_per_partition=10**9,
+        neighbor_backend="auto",
+    )
+    assert m.stats["n_banded_groups"] == m.stats["n_bucket_groups"] == 1
+
+
+def test_packer_invariants(rng):
+    """Every run fits its slab; permutations are inverse pairs; every
+    instance lands exactly once."""
+    pts = np.concatenate(
+        [rng.normal(c, 0.5, (3000, 2)) for c in [(0, 0), (4, 4)]]
+    )
+    outer = np.array(
+        [[pts[:, 0].min() - 1, pts[:, 1].min() - 1,
+          pts[:, 0].max() + 1, pts[:, 1].max() + 1]]
+    )
+    part_ids = np.zeros(len(pts), np.int64)
+    point_idx = np.arange(len(pts), dtype=np.int64)
+    groups, _ = binning.bucketize_banded(
+        pts, part_ids, point_idx, 1, 0.3, outer, force=True
+    )
+    (g,) = groups
+    b = g.points.shape[1]
+    assert b % binning.BANDED_BLOCK == 0
+    ext = g.banded
+    nb = b // binning.BANDED_BLOCK
+    assert ext.slab_starts.shape == (g.points.shape[0], nb, 3)
+    # slab bounds
+    assert (ext.slab_starts >= 0).all()
+    assert (ext.slab_starts + ext.slab <= b).all()
+    # runs fit their slabs
+    assert (ext.rel_starts >= 0).all()
+    assert (ext.rel_starts + ext.spans <= ext.slab).all()
+    # inverse permutation
+    row = 0
+    fold = ext.fold_idx[row]
+    pos = ext.pos_of_fold[row]
+    np.testing.assert_array_equal(pos[fold], np.arange(b))
+    # instances: valid slots carry each original index exactly once
+    got = np.sort(g.point_idx[g.point_idx >= 0])
+    np.testing.assert_array_equal(got, point_idx)
+    # every true eps-pair is covered by some run of the query row
+    # (spot-check: counts from a brute-force subset)
+    sub = rng.choice(len(pts), 64, replace=False)
+    d2 = ((pts[sub, None, :] - pts[None, :, :]) ** 2).sum(-1)
+    want = (d2 <= 0.3 * 0.3).sum(axis=1)
+    from dbscan_tpu.ops.banded import banded_local_dbscan
+    import jax.numpy as jnp
+
+    r = banded_local_dbscan(
+        jnp.asarray(g.points[0]), jnp.asarray(g.mask[0]),
+        jnp.asarray(ext.fold_idx[0]), jnp.asarray(ext.pos_of_fold[0]),
+        jnp.asarray(ext.rel_starts[0]), jnp.asarray(ext.spans[0]),
+        jnp.asarray(ext.slab_starts[0]),
+        0.3, 6, engine="archery", slab=ext.slab,
+    )
+    counts = np.zeros(len(pts), np.int64)
+    valid = g.point_idx[0] >= 0
+    counts[g.point_idx[0][valid]] = np.asarray(r.counts)[valid]
+    np.testing.assert_array_equal(counts[sub], want)
