@@ -18,6 +18,29 @@ Public API mirrors the reference surface (DBSCAN.train -> model with
 labeled_points / partitions / predict) while staying idiomatic JAX.
 """
 
+import os as _os
+
+# Persistent XLA compilation cache: the banded/dense executors compile one
+# program per (bucket width, slab) shape — ~2 min of XLA time at 10M-point
+# scale — and identical shapes recur across processes (ladder widths are
+# quantized). Defers to any cache the user already configured (their env
+# var or a prior jax.config call); opt out with DBSCAN_TPU_NO_COMPILE_CACHE=1.
+if not _os.environ.get("DBSCAN_TPU_NO_COMPILE_CACHE"):
+    import jax as _jax
+
+    if (
+        not _os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        and _jax.config.jax_compilation_cache_dir is None
+    ):
+        _jax.config.update(
+            "jax_compilation_cache_dir",
+            _os.environ.get(
+                "DBSCAN_TPU_COMPILE_CACHE_DIR",
+                _os.path.expanduser("~/.cache/dbscan_tpu_xla"),
+            ),
+        )
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 from dbscan_tpu.config import DBSCANConfig, Engine, Precision
 from dbscan_tpu.ops.labels import CORE, BORDER, NOISE, NOT_FLAGGED, UNKNOWN
 from dbscan_tpu.models.dbscan import DBSCANModel, train

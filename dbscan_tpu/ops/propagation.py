@@ -20,6 +20,14 @@ from jax import lax
 
 from dbscan_tpu.ops.labels import SEED_NONE
 
+# Pointer jumps per neighbor-min sweep. A 1-D arbitrary-index gather on
+# TPU runs at ~40M elements/s (scalar-loop lowering) — ~a third of a full
+# neighbor-min sweep at bench densities — so extra jumps per sweep COST
+# more than the sweeps they save (4 unrolled jumps: +64% device time;
+# jump-to-convergence inner loop: +21%; both measured on v5e at 10M
+# points). One jump (the classic pointer-doubling step) is the optimum.
+_COMPRESS_JUMPS = 1
+
 
 def min_label_fixed_point(
     init: jnp.ndarray,
@@ -38,16 +46,31 @@ def min_label_fixed_point(
       positions (the banded engine labels by original fold index while its
       arrays live in cell-sorted order). None means values ARE positions.
 
-    The pointer jump (``new[new]`` gather, chain-collapsing) keeps iteration
-    count O(log diameter) instead of O(diameter) for chain-shaped clusters.
+    Each step runs one neighbor-min sweep (the expensive part — the
+    backends recompute their masked distance tests inside it) followed by
+    ``_COMPRESS_JUMPS`` pointer jumps (chain-collapsing ``new[new]``
+    gathers), keeping iteration count O(log diameter) instead of
+    O(diameter) for chain-shaped clusters — see the constant's comment for
+    why more jumps per sweep do not pay on TPU.
 
     The loop is hard-capped at n iterations: labels strictly decrease while
     unconverged, so n steps always suffice — and the cap guarantees the
-    on-device loop terminates even if a backend miscompiles the neighbor-min
-    (an unbounded device loop wedges the whole chip for every client).
+    on-device loop terminates even if a backend miscompiles the
+    neighbor-min (an unbounded device loop wedges the whole chip for every
+    client).
     """
     n = init.shape[0]
     none = jnp.int32(SEED_NONE)
+
+    def pos(labels):
+        safe = jnp.clip(labels, 0, n - 1)
+        return pos_of_label[safe] if pos_of_label is not None else safe
+
+    def compress(labels):
+        for _ in range(_COMPRESS_JUMPS):
+            hop = jnp.where(labels == none, none, labels[pos(labels)])
+            labels = jnp.minimum(labels, hop)
+        return labels
 
     def cond(state):
         _, changed, it = state
@@ -55,12 +78,7 @@ def min_label_fixed_point(
 
     def body(state):
         labels, _, it = state
-        new = jnp.minimum(labels, neighbor_min(labels))
-        safe = jnp.clip(new, 0, n - 1)
-        if pos_of_label is not None:
-            safe = pos_of_label[safe]
-        hop = jnp.where(new == none, none, new[safe])
-        new = jnp.minimum(new, hop)
+        new = compress(jnp.minimum(labels, neighbor_min(labels)))
         return new, jnp.any(new != labels), it + 1
 
     # One unrolled body step first: the while_loop carry must be

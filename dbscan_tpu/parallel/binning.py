@@ -61,6 +61,114 @@ def duplicate_points(
     return part_ids[order].astype(np.int64), point_idx[order]
 
 
+def duplicate_points_grid(
+    points: np.ndarray,
+    cells: np.ndarray,
+    inverse: np.ndarray,
+    rects_int: np.ndarray,
+    outer: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Grid-pruned eps-halo replication — same output as
+    :func:`duplicate_points`, O(N + boundary) instead of O(P * N).
+
+    A point can lie in partition p's outer rect (main grown by eps) only if
+    p owns a cell in the 3x3 ring around the point's own 2eps cell: the
+    eps-disk around any point of cell c stays inside c grown by eps, which
+    the ring covers with an eps margin to spare. So candidates come from a
+    cell -> owner lookup (9 per UNIQUE cell, not per point); the own cell's
+    owner always contains the point (cell c main_p c outer_p, with eps
+    margin dwarfing the snap function's worst-case ulp misassignment), and
+    only ring candidates with a different owner take the exact
+    outer-containment test — a boundary-band minority.
+
+    Args:
+      points: [N, >=2] float64.
+      cells: [C, 2] int64 unique occupied cell indices (cell_histogram_int).
+      inverse: [N] int64 row into `cells` per point.
+      rects_int: [P, 4] integer partition rects in cell units (half-open:
+        covering cells x..x2-1, y..y2-1).
+      outer: [P, 4] float grown rects (binning.Margins.outer).
+
+    Returns (part_ids [M], point_idx [M]) sorted by partition then point
+    order — bit-identical to duplicate_points.
+    """
+    pts = np.asarray(points, dtype=np.float64)[:, :2]
+    n = len(pts)
+    rects_int = np.asarray(rects_int, dtype=np.int64).reshape(-1, 4)
+    p_n = rects_int.shape[0]
+    if p_n <= 1 or n == 0:
+        return duplicate_points(pts, outer)
+    grid_cells = (int(rects_int[:, 2].max()) - int(rects_int[:, 0].min())) * (
+        int(rects_int[:, 3].max()) - int(rects_int[:, 1].min())
+    )
+    if grid_cells > 2**27:  # dense owner grid > 0.5 GB: sparse/huge-extent
+        return duplicate_points(pts, outer)  # data; bounded-memory fallback
+
+    gx0 = int(rects_int[:, 0].min())
+    gy0 = int(rects_int[:, 1].min())
+    gw = int(rects_int[:, 2].max()) - gx0
+    gh = int(rects_int[:, 3].max()) - gy0
+    owner = np.full((gw, gh), -1, dtype=np.int32)
+    for p in range(p_n):
+        x, y, x2, y2 = rects_int[p] - (gx0, gy0, gx0, gy0)
+        owner[x:x2, y:y2] = p
+
+    # Ring owners per UNIQUE cell. Neighbors are clamped to the grid: a
+    # clamped lookup can only repeat an in-grid owner (dedup absorbs it);
+    # out-of-grid cells are unowned so nothing is missed.
+    cx = np.clip(cells[:, 0] - gx0, 0, gw - 1)
+    cy = np.clip(cells[:, 1] - gy0, 0, gh - 1)
+    own = owner[cx, cy]  # [C]; every occupied cell is owned
+    ring = np.empty((len(cells), 8), dtype=np.int32)
+    k = 0
+    for dx in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            if dx == 0 and dy == 0:
+                continue
+            ring[:, k] = owner[
+                np.clip(cx + dx, 0, gw - 1), np.clip(cy + dy, 0, gh - 1)
+            ]
+            k += 1
+    # distinct foreign candidates per cell: sort the 8, drop repeats/own/-1
+    ring.sort(axis=1)
+    cand = (
+        (ring >= 0)
+        & (ring != own[:, None])
+        & np.c_[np.ones(len(cells), bool), ring[:, 1:] != ring[:, :-1]]
+    )
+    ccell, ck = np.nonzero(cand)  # candidate (cell row, ring slot) pairs
+
+    # Expand candidate (cell, partition) pairs to their points and run the
+    # exact inclusive containment test (only boundary-band cells get here).
+    part_base = own[inverse]  # [N] own-cell owner, in point order
+    if ccell.size:
+        order_pts = np.argsort(inverse.astype(np.int32), kind="stable")
+        cstart = np.searchsorted(inverse[order_pts], np.arange(len(cells) + 1))
+        ccount = cstart[ccell + 1] - cstart[ccell]
+        cpart = ring[ccell, ck]
+        pt = order_pts[
+            np.repeat(cstart[ccell], ccount)
+            + (
+                np.arange(ccount.sum(), dtype=np.int64)
+                - np.repeat(np.cumsum(ccount) - ccount, ccount)
+            )
+        ]
+        pp = np.repeat(cpart, ccount)
+        hit = geo.contains_point(outer[pp], pts[pt])
+        halo_part, halo_pt = pp[hit], pt[hit]
+    else:
+        halo_part = np.empty(0, np.int32)
+        halo_pt = np.empty(0, np.int64)
+
+    part_ids = np.concatenate([part_base.astype(np.int64), halo_part])
+    point_idx = np.concatenate([np.arange(n, dtype=np.int64), halo_pt])
+    okey = part_ids * n + point_idx
+    order = np.argsort(
+        okey.astype(np.int32) if p_n * n < 2**31 else okey, kind="stable"
+    )
+    return part_ids[order], point_idx[order]
+
+
 def _ladder_width(c: int, bucket_multiple: int) -> int:
     """Round a count up along a ~1.5x geometric ladder of bucket_multiple
     multiples (q in 1, 1.5, 2, 3, 4, 6, ... when it divides evenly): area
@@ -257,10 +365,11 @@ def bucketize_banded(
     # arithmetic-rounding margin), and a run built from the float64 cell
     # would miss pairs the device's distance test accepts.
     xy_dev = xy.astype(dtype).astype(np.float64)
+    inv_cell = 1.0 / cell
     ox = outer[part_ids, 0]
     oy = outer[part_ids, 1]
-    cx = np.maximum(np.floor((xy_dev[:, 0] - ox) / cell), 0.0).astype(np.int64)
-    cy = np.maximum(np.floor((xy_dev[:, 1] - oy) / cell), 0.0).astype(np.int64)
+    cx = np.maximum(np.floor((xy_dev[:, 0] - ox) * inv_cell), 0.0).astype(np.int64)
+    cy = np.maximum(np.floor((xy_dev[:, 1] - oy) * inv_cell), 0.0).astype(np.int64)
 
     # Segment maxima via reduceat (instances are sorted by partition);
     # ufunc.at is a scalar Python-level loop — ~10s at 5M instances.
@@ -272,39 +381,59 @@ def bucketize_banded(
         cxmax[nz] = np.maximum.reduceat(cx, segs)
         cymax[nz] = np.maximum.reduceat(cy, segs)
     stride = cxmax + 3  # cx + 2 < stride: row windows never wrap
-    key = cy * stride[part_ids] + cx
     big = int((stride * (cymax + 2)).max()) + 1  # per-partition key space
+    gkey = part_ids * big + cy * stride[part_ids] + cx
 
     # Stable sort by (partition, cell key): instances arrive in (partition,
-    # fold) order, so ties keep fold order inside each cell.
-    fold = np.arange(m_tot, dtype=np.int64) - part_start[part_ids]
-    order = np.lexsort((key, part_ids))
+    # fold) order, so ties keep fold order inside each cell. Stable argsort
+    # on one packed integer key radix-sorts in O(M) — measured 4x faster
+    # than np.lexsort on two keys; int32 keys shave another ~30%.
+    if n_parts * big < np.iinfo(np.int32).max:
+        gkey = gkey.astype(np.int32)
+    order = np.argsort(gkey, kind="stable")
     p_s = part_ids[order]
-    gkey_s = p_s * big + key[order]
-    cx_s, cy_s = cx[order], cy[order]
-    fold_s = fold[order]
+    gkey_s = gkey[order]
+    fold_s = (order - part_start[p_s]).astype(np.int64)
     ptidx_s = point_idx[order]
     xy_s = xy[order]
     slots_s = np.arange(m_tot, dtype=np.int64) - part_start[p_s]
-    stride_s = stride[p_s]
-    base_s = p_s * big
-    seg_start = part_start[p_s]
 
-    starts3 = np.empty((m_tot, 3), dtype=np.int64)
-    spans3 = np.empty((m_tot, 3), dtype=np.int64)
+    # Run boundaries per UNIQUE cell, not per instance: every instance in a
+    # cell shares the same three candidate runs, and the unique-cell count U
+    # is orders of magnitude below M — 6 searchsorted passes over U instead
+    # of M (measured ~60x cheaper at 10M points), then one U->M gather.
+    newcell = (
+        np.r_[True, gkey_s[1:] != gkey_s[:-1]]
+        if m_tot
+        else np.empty(0, dtype=bool)
+    )
+    cell_first = np.flatnonzero(newcell)  # [U] first sorted pos of each cell
+    ukey = gkey_s[cell_first].astype(np.int64)  # [U]
+    cell_rank = np.cumsum(newcell) - 1  # [M] -> index into cell_first/ukey
+    upart = p_s[cell_first]
+    ustride = stride[upart]
+    useg_start = part_start[upart]
+    useg_end = useg_start + counts[upart]
+    cell_pos = np.r_[cell_first, m_tot]  # [U+1] cell -> first sorted pos
+
+    ustarts3 = np.empty((len(ukey), 3), dtype=np.int64)
+    uspans3 = np.empty((len(ukey), 3), dtype=np.int64)
+    # cell key of the run start for row (cy + dr): ukey + dr*stride - 1;
+    # searchsorted over unique keys, mapped back to sorted positions via
+    # cell_pos. Row validity (0 <= cy+dr <= cymax) is equivalent to the
+    # segment clamp: out-of-grid rows produce empty runs inside [seg_start,
+    # seg_end) because no cell carries their key — except row overflow past
+    # the partition's key space, which the segment clamp catches.
     for k, dr in enumerate((-1, 0, 1)):
-        row = cy_s + dr
-        lo = base_s + row * stride_s + cx_s - 1
-        s = np.searchsorted(gkey_s, lo)
-        e = np.searchsorted(gkey_s, lo + 3)
-        # lo can undershoot the partition's key space (cx=0 or row=-1);
-        # clamp into this partition's segment so a neighboring partition's
-        # tail never leaks into the window.
-        s = np.maximum(s, seg_start)
-        e = np.maximum(e, s)
-        valid = (row >= 0) & (row <= cymax[p_s])
-        starts3[:, k] = np.where(valid, s - seg_start, 0)
-        spans3[:, k] = np.where(valid, e - s, 0)
+        lo = ukey + dr * ustride - 1
+        s = cell_pos[np.searchsorted(ukey, lo)]
+        e = cell_pos[np.searchsorted(ukey, lo + 3)]
+        s = np.clip(s, useg_start, useg_end)
+        e = np.clip(e, s, useg_end)
+        ustarts3[:, k] = s - useg_start
+        uspans3[:, k] = e - s
+    starts3 = ustarts3[cell_rank] if m_tot else np.empty((0, 3), np.int64)
+    spans3 = uspans3[cell_rank] if m_tot else np.empty((0, 3), np.int64)
 
     # Banded bucket widths: the dense ladder width padded up to a multiple
     # of the block size.

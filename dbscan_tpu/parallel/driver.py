@@ -354,7 +354,7 @@ def train_arrays(
     if spatial:
         # 1-2. cell histogram + spatial partitioning (driver-local metadata).
         t0 = time.perf_counter()
-        cells, counts, _ = geo.cell_histogram_int(pts, cell)
+        cells, counts, cell_inv = geo.cell_histogram_int(pts, cell)
         t0 = _mark("histogram_s", t0)
         parts = partitioner.partition_cells(
             cells, counts, cfg.max_points_per_partition
@@ -365,6 +365,7 @@ def train_arrays(
         # 3. margins.
         margins = binning.build_margins(rects_int, cell, cfg.eps)
     else:
+        rects_int = None
         lo = pts[:, :2].min(axis=0)
         hi = pts[:, :2].max(axis=0)
         main = np.array([[lo[0], lo[1], hi[0], hi[1]]], dtype=np.float64)
@@ -376,7 +377,12 @@ def train_arrays(
 
     # 4. halo duplication + static bucketing.
     t0 = time.perf_counter()
-    part_ids, point_idx = binning.duplicate_points(pts, margins.outer)
+    if rects_int is not None:
+        part_ids, point_idx = binning.duplicate_points_grid(
+            pts, cells, cell_inv, rects_int, margins.outer
+        )
+    else:
+        part_ids, point_idx = binning.duplicate_points(pts, margins.outer)
     t0 = _mark("duplicate_s", t0)
     if cfg.precision.value == "f64" and not jax.config.jax_enable_x64:
         raise ValueError(
@@ -390,10 +396,19 @@ def train_arrays(
         "f64": np.float64,
         "bf16": ml_dtypes.bfloat16,
     }[cfg.precision.value]
+    if cfg.neighbor_backend == "banded" and cfg.precision.value == "bf16":
+        raise ValueError(
+            "neighbor_backend='banded' requires f32/f64: bf16 rounds d2 by "
+            "~4e-3 relative — far past the banded grid's 1e-5 cell slack — "
+            "so pairs the bf16 distance test accepts can fall outside the "
+            "3x3 cell ring and be missed; use precision=F32 or the dense "
+            "backend"
+        )
     use_banded = (
         cfg.neighbor_backend != "dense"
         and not cfg.use_pallas
         and cfg.metric == "euclidean"
+        and cfg.precision.value != "bf16"
         and kernel_cols.shape[1] == 2
     )
     if use_banded:
@@ -424,22 +439,34 @@ def train_arrays(
     # 5. per-partition clustering on device, one launch per bucket width
     # (ascending; same widths recur across runs -> jit cache hits).
     p_true = margins.main.shape[0]
-    n_core = 0
-    inst_part_l, inst_ptidx_l, inst_seed_l, inst_flag_l = [], [], [], []
     # Dispatch every bucket group before blocking on any result: jax
     # execution is async, so the device works through the groups while the
-    # host prepares/consumes the others.
+    # host runs every device-INDEPENDENT phase below — instance tables, band
+    # membership, inner membership — and only then blocks on the labels.
     pending = [(g, _dispatch_partitions(g, cfg, mesh)) for g in groups]
-    for g, (seeds_dev, flags_dev, nc) in pending:
+
+    slotmaps = [np.nonzero(g.point_idx >= 0) for g, _ in pending]
+    inst_part = np.concatenate(
+        [g.part_ids[rows] for (g, _), (rows, _s) in zip(pending, slotmaps)]
+    ) if pending else np.empty(0, np.int64)
+    inst_ptidx = np.concatenate(
+        [g.point_idx[rows, slots] for (g, _), (rows, slots) in zip(pending, slotmaps)]
+    ) if pending else np.empty(0, np.int64)
+
+    # device-independent merge precomputation (overlaps the device window)
+    band_any = _band_membership(pts, margins, part_ids, point_idx)
+    cand = band_any[inst_ptidx]
+    pts_of_inst = pts[inst_ptidx][:, :2]
+    inst_inner = geo.almost_contains(margins.inner[inst_part], pts_of_inst)
+    t0 = _mark("overlap_host_s", t0)
+
+    n_core = 0
+    inst_seed_l, inst_flag_l = [], []
+    for (g, (seeds_dev, flags_dev, nc)), (rows, slots) in zip(pending, slotmaps):
         seeds_g, flags_g = np.asarray(seeds_dev), np.asarray(flags_dev)
         n_core += int(nc)
-        rows, slots = np.nonzero(g.point_idx >= 0)
-        inst_part_l.append(g.part_ids[rows])
-        inst_ptidx_l.append(g.point_idx[rows, slots])
         inst_seed_l.append(seeds_g[rows, slots])
         inst_flag_l.append(flags_g[rows, slots])
-    inst_part = np.concatenate(inst_part_l) if inst_part_l else np.empty(0, np.int64)
-    inst_ptidx = np.concatenate(inst_ptidx_l) if inst_ptidx_l else np.empty(0, np.int64)
     inst_seed = np.concatenate(inst_seed_l) if inst_seed_l else np.empty(0, np.int32)
     inst_flag = np.concatenate(inst_flag_l) if inst_flag_l else np.empty(0, np.int8)
     t0 = _mark("device_s", t0)
@@ -448,9 +475,6 @@ def train_arrays(
     inst_loc, upart, uloc = _local_ids_flat(inst_part, inst_seed, p_true, max_b)
 
     # 7. merge: union clusters observed on the same halo point.
-
-    band_any = _band_membership(pts, margins, part_ids, point_idx)
-    cand = band_any[inst_ptidx]
 
     uf = UnionFind()
     nz = cand & (inst_flag != NOISE)
@@ -501,9 +525,6 @@ def train_arrays(
     res_cluster = np.zeros(n, dtype=np.int32)
     res_flag = np.full(n, NOISE, dtype=np.int8)
     assigned = np.zeros(n, dtype=bool)
-
-    pts_of_inst = pts[inst_ptidx][:, :2]
-    inst_inner = geo.almost_contains(margins.inner[inst_part], pts_of_inst)
 
     # inner instances: at most one per point (mains have disjoint interiors)
     ii = np.flatnonzero(inst_inner)
