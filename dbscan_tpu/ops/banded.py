@@ -1,55 +1,52 @@
-"""Grid-banded local DBSCAN engine: O(B * slab) per partition, gather-free.
+"""Grid-banded local DBSCAN engine: 3 fixed sweeps + host cell components.
 
 The dense engine (ops/local_dbscan.py) materializes the full [B, B]
 eps-adjacency — the TPU-shaped replacement for the reference's O(n^2) linear
-scans (LocalDBSCANNaive.scala:72-78). That is optimal for small partitions
-but quadratic in compute AND memory, which caps usable partition sizes.
+scans (LocalDBSCANNaive.scala:72-78) — and finds components by iterated
+min-label propagation. That iteration is the scaling killer: blob-shaped
+partitions measured 18-49 sweeps, each recomputing the masked distance
+tiles, and TPU's slow arbitrary-index gathers (~40M elem/s) rule out
+cheap pointer-chasing between sweeps.
 
-This engine exploits the spatial structure DBSCAN itself is built on: snap
-points to an eps-sized grid and sort them by cell (row-major). Every
-eps-neighbor of a point then lies in the 3x3 surrounding cells, which in
-cell-sorted order form three contiguous runs — one per cell row. Runs are
-consumed BLOCK-WISE: for a block of BANDED_BLOCK consecutive sorted points,
-the union of their per-cell-row runs is (near-)contiguous, because cell-row
-boundaries in query space map to adjacent positions in candidate space. The
-host (dbscan_tpu/parallel/binning.py) measures the exact union slab per
-(block, cell row) and a static bound S >= every slab length; the device then
-processes each block as
+This engine removes the iteration instead of accelerating it. Points snap
+to a FINE grid of side eps/sqrt(2) (binning.FINE_CELL_FACTOR): any two
+points in one cell are then within eps, so all cores of a cell form a
+clique sharing ONE cluster — connected components collapse from the point
+graph to the (25x smaller) CELL graph, which the HOST solves exactly with
+scipy/C connected-components (dbscan_tpu/parallel/cellgraph.py). The
+device does only the pairwise-distance work, as a FIXED three sweeps:
 
-  3 x dynamic_slice(plane, slab_start, S)       <- contiguous DMA, no gather
-  dense [T, 3, S] difference tile on the VPU    <- compare vs eps^2
-  per-row validity from (rel_start, span)       <- mask inside the slab
+  sweep 1 (phase1): eps-neighbor counts -> core mask;
+  sweep 2 (phase1): per-core-point 25-bit mask over its 5x5 window cells —
+    bit set iff some core in that cell is eps-adjacent — the cell graph's
+    edge list, 1 int32 per point;
+  sweep 3 (phase2, after the host labels cells): min seed among
+    eps-adjacent cores per point, for the border algebra.
 
-instead of all-pairs [B, B]. Two deliberate non-choices, both measured on
-TPU v5e:
-
-- no per-row windowed GATHERS: XLA lowers 1-D gathers with arbitrary index
-  tensors to scalar loops (~40M elements/s — orders of magnitude under HBM
-  bandwidth); contiguous dynamic slices stream at full bandwidth;
-- no materialized adjacency: storing [B, 3, S] booleans makes every
-  propagation sweep HBM-bound on re-reading them; recomputing the masked
-  distance test fused into each sweep keeps all sweep traffic at
-  O(slab) loads per block and runs ~3x faster while using O(B) memory.
-
-Components use the shared min-label fixed point (ops/propagation.py) with
-the neighbor-min computed by the block-slab sweep over label planes, and the
-pointer jump routed through the sorted-position permutation. Border algebra
-is the dense path's _finalize — fold indices are carried explicitly since
-array order is cell-sorted, not fold order.
+Sweeps are block-slab passes over cell-sorted points: for a block of
+BANDED_BLOCK consecutive sorted points, each window row's candidate runs
+union into a (near-)contiguous slab the host measures exactly
+(dbscan_tpu/parallel/binning.py); the device fetches each slab with one
+contiguous dynamic_slice (no gathers — XLA lowers arbitrary 1-D gathers to
+scalar loops) and consumes it as a dense [T, 5, S] difference tile on the
+VPU, masking each row's true run with (rel_start, span).
 
 Correctness notes:
-- the host uses a cell size slightly LARGER than eps (binning.CELL_SLACK) so
-  any pair the f32 distance test could accept lies within the 3x3 ring even
-  under worst-case rounding;
+- label VALUES are original fold indices (reference numbering semantics,
+  LocalDBSCANNaive.scala:45-64) while label POSITIONS are cell-sorted;
+- clique edges asserted without a distance test are always consistent with
+  the dense engine's f32 arithmetic: intra-cell distance is at most
+  eps*(1-1e-5) while the difference-form rounding is ~1e-7 relative (bf16
+  is rejected upstream);
 - slabs may cover unrelated cells (padding, row straddles); each row masks
   its true run with (rel_start, span), so no pair is counted twice across
-  the three row-slabs and nothing outside the run contributes;
-- label VALUES are original fold indices (reference numbering semantics,
-  LocalDBSCANNaive.scala:45-64) while label POSITIONS are cell-sorted.
+  the row-slabs and nothing outside the runs contributes.
 
 Exactness vs the dense engine: the pairwise measure is the identical
-difference-form arithmetic (ops/distance.py euclidean D<=4 path), so in any
-fixed dtype the two engines produce bit-identical labels (tested).
+difference-form arithmetic (ops/distance.py euclidean D<=4 path) and the
+cell-graph components equal the point-graph components (clique + reach
+guarantees, binning.FINE_CELL_FACTOR), so in f32 the two engines produce
+bit-identical labels (tested).
 """
 
 from __future__ import annotations
@@ -62,101 +59,63 @@ from jax import lax
 
 from dbscan_tpu.ops.labels import SEED_NONE
 from dbscan_tpu.ops.local_dbscan import LocalResult, _finalize
-from dbscan_tpu.ops.propagation import min_label_fixed_point
 
-# Rows per block-slab tile; defined host-side (dbscan_tpu/parallel/
-# binning.py) next to the packer that must agree on it — see there for the
-# current value and its VMEM/DMA sizing rationale.
-from dbscan_tpu.parallel.binning import BANDED_BLOCK
+# Block/window geometry lives host-side next to the packer that must agree
+# on it.
+from dbscan_tpu.parallel.binning import BANDED_BLOCK, BANDED_ROWS, BANDED_WIN
 
 # Element budget for how many blocks one lax.map step may process at once
 # (vmapped): bounds the fused tile transients to ~1 GB while cutting the
-# sequential step count (per-step loop overhead measured ~20% at batch 32).
+# sequential step count.
 _BLOCK_BATCH_ELEMS = 1 << 28
 
 
 def _block_batch(slab: int) -> int:
-    return max(1, min(32, _BLOCK_BATCH_ELEMS // (BANDED_BLOCK * 3 * slab)))
+    return max(
+        1, min(32, _BLOCK_BATCH_ELEMS // (BANDED_BLOCK * BANDED_ROWS * slab))
+    )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("min_points", "engine", "slab")
-)
-def banded_local_dbscan(
-    points: jnp.ndarray,
-    mask: jnp.ndarray,
-    fold_idx: jnp.ndarray,
-    pos_of_fold: jnp.ndarray,
-    rel_starts: jnp.ndarray,
-    spans: jnp.ndarray,
-    slab_starts: jnp.ndarray,
-    eps: float,
-    min_points: int,
-    engine: str = "naive",
-    slab: int = 128,
-) -> LocalResult:
-    """Cluster one cell-sorted, padded partition in O(B * 3 * slab).
-
-    Args:
-      points: [B, 2] coordinates in CELL-SORTED order (padding at the tail);
-        B must be a multiple of BANDED_BLOCK.
-      mask: [B] validity.
-      fold_idx: [B] int32 original fold index per sorted position (padding
-        positions hold their own position).
-      pos_of_fold: [B] int32 inverse permutation: sorted position of fold
-        index f.
-      rel_starts: [B, 3] int32 run starts RELATIVE to the row's block slab,
-        one per neighboring cell row.
-      spans: [B, 3] int32 run lengths; 0 for out-of-grid rows.
-      slab_starts: [B // BANDED_BLOCK, 3] int32 absolute slab origins; host
-        guarantees slab_start + slab <= B and every run fits its slab.
-      eps: neighborhood radius (euclidean).
-      min_points: self-inclusive density threshold (static).
-      engine: "naive" | "archery" (static).
-      slab: static slab length S.
-
-    Returns a :class:`LocalResult` of [B] arrays in SORTED order; seed label
-    values are fold indices (densify with labels.seed_to_local_ids as usual).
-    """
-    if engine not in ("naive", "archery"):
-        raise ValueError(f"unknown engine {engine!r}")
+def _tile_machinery(points, mask, rel_starts, spans, slab_starts, eps, slab):
+    """Shared block/slab plumbing: returns (blocks pytree for lax.map,
+    slabs_of, tile_adj, nb) for [B]-plane sweeps."""
     b = points.shape[0]
     t = BANDED_BLOCK
     if b % t:
         raise ValueError(f"bucket width {b} not a multiple of {t}")
     nb = b // t
-    none = jnp.int32(SEED_NONE)
     eps2 = jnp.asarray(eps, dtype=points.dtype) ** 2
     offs = jnp.arange(slab, dtype=jnp.int32)
-    batch = _block_batch(slab)
     # Coordinate planes: slicing [..., 2]-shaped rows would pad the minor
     # dim to the 128-lane tile on TPU; [B] planes slice cleanly.
     px = points[:, 0]
     py = points[:, 1]
 
-    px_b = px.reshape(nb, t)
-    py_b = py.reshape(nb, t)
-    mask_b = mask.reshape(nb, t)
-    rel_b = rel_starts.reshape(nb, t, 3)
-    span_b = spans.reshape(nb, t, 3)
-    blocks = (px_b, py_b, mask_b, rel_b, span_b, slab_starts)
+    blocks = (
+        px.reshape(nb, t),
+        py.reshape(nb, t),
+        mask.reshape(nb, t),
+        rel_starts.reshape(nb, t, BANDED_ROWS),
+        spans.reshape(nb, t, BANDED_ROWS),
+        slab_starts,
+    )
 
     def slabs_of(plane, origins):
-        """[B] plane, [3] origins -> [3, S] slab rows (contiguous slices)."""
+        """[B] plane, [R] origins -> [R, S] slab rows (contiguous slices)."""
         return jnp.stack(
             [
                 lax.dynamic_slice(plane, (origins[k],), (slab,))
-                for k in range(3)
+                for k in range(BANDED_ROWS)
             ]
         )
 
     def tile_adj(bx, by, bm, brel, bspan, borig):
-        """The fused [T, 3, S] adjacency tile of one block (never stored
+        """The fused [T, R, S] adjacency tile of one block (never stored
         across sweeps — recomputed wherever it is consumed)."""
-        sx = slabs_of(px, borig)  # [3, S]
+        sx = slabs_of(px, borig)  # [R, S]
         sy = slabs_of(py, borig)
         sm = slabs_of(mask, borig)
-        dx = bx[:, None, None] - sx[None, :, :]  # [T, 3, S]
+        dx = bx[:, None, None] - sx[None, :, :]  # [T, R, S]
         dy = by[:, None, None] - sy[None, :, :]
         d2 = dx * dx + dy * dy
         inrun = (offs[None, None, :] >= brel[:, :, None]) & (
@@ -164,40 +123,115 @@ def banded_local_dbscan(
         )
         return inrun & sm[None, :, :] & (d2 <= eps2) & bm[:, None, None]
 
+    return blocks, slabs_of, tile_adj, nb
+
+
+@functools.partial(jax.jit, static_argnames=("min_points", "slab"))
+def banded_phase1(
+    points: jnp.ndarray,
+    mask: jnp.ndarray,
+    rel_starts: jnp.ndarray,
+    spans: jnp.ndarray,
+    slab_starts: jnp.ndarray,
+    cx: jnp.ndarray,
+    eps: float,
+    min_points: int,
+    slab: int = 128,
+):
+    """Sweeps 1+2: eps-neighbor counts and the window-cell edge bitmask.
+
+    Args:
+      points: [B, 2] coordinates in CELL-SORTED order (padding at the tail);
+        B a multiple of BANDED_BLOCK.
+      mask: [B] validity.
+      rel_starts/spans: [B, BANDED_ROWS] int32 run starts (relative to the
+        row's block slab) / lengths.
+      slab_starts: [B // BANDED_BLOCK, BANDED_ROWS] int32 absolute slab
+        origins; host guarantees slab_start + slab <= B and every run fits.
+      cx: [B] int32 fine-grid cell column per position.
+      eps, min_points: DBSCAN parameters (min_points static, self-inclusive).
+      slab: static slab length S.
+
+    Returns (counts [B] int32, core [B] bool, bits [B] int32) where bit
+    k*5+j of bits[i] is set iff point i is core and some CORE point in the
+    window cell (dy=k-2, dx=j-2) is eps-adjacent to it (bit 12 = own cell).
+    """
+    blocks, slabs_of, tile_adj, nb = _tile_machinery(
+        points, mask, rel_starts, spans, slab_starts, eps, slab
+    )
+    batch = _block_batch(slab)
+
     def count_block(args):
         return jnp.sum(tile_adj(*args), axis=(1, 2), dtype=jnp.int32)
 
-    counts = lax.map(count_block, blocks, batch_size=batch).reshape(b)
+    counts = lax.map(count_block, blocks, batch_size=batch).reshape(-1)
     core = (counts >= jnp.int32(min_points)) & mask
 
-    def windowed_min(labels):
-        """Per row: min label over adjacent neighbors ([B] -> [B])."""
+    cx_blocks = cx.reshape(nb, BANDED_BLOCK)
+    core_blocks = core.reshape(nb, BANDED_BLOCK)
 
-        def one(args):
-            bx, by, bm, brel, bspan, borig = args
-            adj = tile_adj(bx, by, bm, brel, bspan, borig)
-            sl = slabs_of(labels, borig)  # [3, S]
-            return jnp.min(
-                jnp.where(adj, sl[None, :, :], none), axis=(1, 2)
-            )
+    def bits_block(args):
+        bx, by, bm, brel, bspan, borig, bcx, bcore = args
+        adj = tile_adj(bx, by, bm, brel, bspan, borig)
+        score = slabs_of(core, borig)  # [R, S] col core mask
+        adj_cc = adj & score[None, :, :] & bcore[:, None, None]
+        scx = slabs_of(cx, borig)  # [R, S] col cell columns
+        # Window column slot of each candidate: 0..4 whenever adj is true
+        # (the run covers exactly cx-2..cx+2 of the row's window); the
+        # clip only disciplines junk at adj-false entries before the shift.
+        dxm = scx[None, :, :] - bcx[:, None, None] + 2
+        krow = jnp.arange(BANDED_ROWS, dtype=jnp.int32)[None, :, None]
+        shift = jnp.clip(krow * 5 + dxm, 0, BANDED_WIN - 1)
+        contrib = jnp.where(adj_cc, jnp.int32(1) << shift, jnp.int32(0))
+        return lax.reduce(
+            contrib, jnp.int32(0), lax.bitwise_or, (1, 2)
+        )
 
-        return lax.map(one, blocks, batch_size=batch).reshape(b)
+    bits = lax.map(
+        bits_block, (*blocks, cx_blocks, core_blocks), batch_size=batch
+    ).reshape(-1)
+    return counts, core, bits
 
-    # Components of the core-core adjacency: labels at non-core positions
-    # are SEED_NONE from init and never updated (neighbor-min masked to core
-    # rows), and SEED_NONE-valued neighbors are transparent to min() — so
-    # the windowed min over the full adjacency restricts itself to core-core
-    # edges exactly as the dense path's adj_cc does.
-    init = jnp.where(core, fold_idx, none)
 
-    def neighbor_min(labels):
-        return jnp.where(core, windowed_min(labels), none)
+@functools.partial(jax.jit, static_argnames=("engine", "slab"))
+def banded_phase2(
+    points: jnp.ndarray,
+    mask: jnp.ndarray,
+    fold_idx: jnp.ndarray,
+    core: jnp.ndarray,
+    counts: jnp.ndarray,
+    labels: jnp.ndarray,
+    rel_starts: jnp.ndarray,
+    spans: jnp.ndarray,
+    slab_starts: jnp.ndarray,
+    eps: float,
+    engine: str = "naive",
+    slab: int = 128,
+) -> LocalResult:
+    """Sweep 3: border algebra from the host-computed cell labels.
 
-    comp = min_label_fixed_point(init, neighbor_min, pos_of_label=pos_of_fold)
+    labels: [B] int32 — at CORE positions the component seed (min core fold
+    index of the point's cell component, from the host cell-graph pass);
+    SEED_NONE elsewhere. core/counts: phase1 outputs (device arrays are
+    passed straight back in — no retransfer).
 
-    # Min seed among eps-adjacent cores, for every point (border algebra).
-    core_nbr_seed = windowed_min(comp)
+    Returns a :class:`LocalResult` of [B] arrays in SORTED order; seed
+    label values are fold indices.
+    """
+    if engine not in ("naive", "archery"):
+        raise ValueError(f"unknown engine {engine!r}")
+    blocks, slabs_of, tile_adj, nb = _tile_machinery(
+        points, mask, rel_starts, spans, slab_starts, eps, slab
+    )
+    batch = _block_batch(slab)
+    none = jnp.int32(SEED_NONE)
 
+    def one(args):
+        adj = tile_adj(*args)
+        sl = slabs_of(labels, args[-1])  # [R, S]; NONE at non-core cols
+        return jnp.min(jnp.where(adj, sl[None, :, :], none), axis=(1, 2))
+
+    core_nbr_seed = lax.map(one, blocks, batch_size=batch).reshape(-1)
     return _finalize(
-        mask, core, comp, core_nbr_seed, counts, engine, own_idx=fold_idx
+        mask, core, labels, core_nbr_seed, counts, engine, own_idx=fold_idx
     )

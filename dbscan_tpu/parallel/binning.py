@@ -186,22 +186,26 @@ def _ladder_width(c: int, bucket_multiple: int) -> int:
 class BandedExtras(NamedTuple):
     """Cell-sorted block-slab metadata for the banded engine
     (dbscan_tpu/ops/banded.py). All arrays are indexed by SORTED position;
-    B is a multiple of ops.banded.BANDED_BLOCK.
+    B is a multiple of BANDED_BLOCK.
 
     fold_idx: [P_pad, B] int32 original fold index per position (identity on
-    padding); pos_of_fold: [P_pad, B] int32 inverse permutation;
-    rel_starts/spans: [P_pad, B, 3] int32 per-point candidate runs (one per
-    neighboring cell row), starts relative to the row's block slab;
-    slab_starts: [P_pad, B // BANDED_BLOCK, 3] int32 absolute slab origins;
-    slab: static S >= every slab length (slab_start + S <= B).
+    padding); rel_starts/spans: [P_pad, B, BANDED_ROWS] int32 per-point
+    candidate runs (one per window cell row), starts relative to the row's
+    block slab; slab_starts: [P_pad, B // BANDED_BLOCK, BANDED_ROWS] int32
+    absolute slab origins; slab: static S >= every slab length (slab_start +
+    S <= B); cx: [P_pad, B] int32 fine-grid cell column per position (for
+    the device's window-slot arithmetic); cell_gid: [P_pad, B] int64 HOST
+    array — global cell id per position (-1 padding), consumed by the
+    cell-graph components pass, never shipped to the device.
     """
 
     fold_idx: np.ndarray
-    pos_of_fold: np.ndarray
     rel_starts: np.ndarray
     spans: np.ndarray
     slab_starts: np.ndarray
     slab: int
+    cx: np.ndarray
+    cell_gid: np.ndarray
 
 
 class BucketGroup(NamedTuple):
@@ -283,33 +287,63 @@ def bucketize_grouped(
     return groups, max_b
 
 
-# Cell size safety factor over eps: a pair the device's f32 distance test
-# could accept (true distance <= eps * (1 + few ulps)) must lie within the
-# 3x3 cell ring, so cells are built marginally larger than eps. 1e-5 covers
-# f32's ~1e-7/op rounding with orders of magnitude to spare, while growing
-# windows imperceptibly.
-CELL_SLACK = 1.0 + 1e-5
+# Fine grid for the banded engine: cell side s = eps * FINE_CELL_FACTOR is
+# chosen so that
+#   (a) CLIQUE: any two points in one cell satisfy the device's distance
+#       test — max intra-cell distance is s*sqrt(2) = eps*(1 - 1e-5), and
+#       the 1e-5 margin dwarfs the f32 difference-form rounding (~1e-7
+#       relative; cells are computed from the same f32-cast coordinates the
+#       device measures). All cores of a cell therefore share ONE cluster,
+#       which is what lets connected components run per-CELL on the host
+#       instead of per-point on the device;
+#   (b) REACH: any pair the device test accepts lies within +-2 cells on
+#       each axis — acceptance implies lattice distance <= eps*(1+~1e-6),
+#       and two cells reach 2s = 1.414*eps*(1-1e-5).
+# bf16 is rejected upstream (driver): its ~4e-3 rounding swamps both margins.
+FINE_CELL_FACTOR = (1.0 - 1e-5) / float(np.sqrt(2.0))
 
-# Partitions narrower than this always use the dense engine: at small B the
-# [B, B] sweep is already cheap and window bookkeeping is pure overhead.
-MIN_BANDED_BUCKET = 4096
+# Window geometry: candidate cells for a point are the 5x5 ring around its
+# cell — BANDED_ROWS contiguous runs (one per cell row dy in [-2, 2]), each
+# 5 cells wide. BANDED_WIN is the per-point cell-connectivity bitmask width
+# (bit k*5+j = "some core in the window cell at (dy=k-2, dx=j-2) is
+# eps-adjacent to this core point"); bit 12 is the point's own cell.
+BANDED_ROWS = 5
+BANDED_WIN = BANDED_ROWS * BANDED_ROWS
 
-# At or above this width the dense engine is no longer an option at all — a
-# [B, B] f32 measure matrix at B = 65536 is 17 GB, past a v5e chip's HBM —
-# so auto ALWAYS routes such partitions through the banded engine. Below
-# it, measured crossover on v5e: the dense sweep's perfectly-tiled [B, B]
-# broadcasts beat the banded slab machinery unless the slabs shrink the
-# work by a margin larger than their per-block overheads (~an order of
-# magnitude).
+# At or above this width partitions route to the banded engine; below it
+# the dense engine wins. Two forces meet here (both measured on v5e): a
+# [B, B] f32 measure matrix no longer fits HBM at B = 65536 (16 GB), and
+# below that width the banded path's fixed costs — two dispatch phases,
+# the host cell-components round trip, the fine-grid packing — exceed the
+# dense engine's whole single-launch runtime (~0.7s vs ~1.4-2.4s at
+# 12k-32k widths) even though dense iterates its label propagation.
 DENSE_MAX_BUCKET = 65536
 
 # Rows per block-slab tile in the banded engine; banded bucket widths are
 # padded to a multiple of this. Bigger blocks amortize the per-slab DMA
-# latency over more rows but widen the union slab S (waste ~6 cells'
-# occupancy); 1024 measured fastest on v5e at bench densities. Lives here
-# (host side) so the packer has no jax dependency; dbscan_tpu/ops/banded.py
-# imports it.
-BANDED_BLOCK = 1024
+# latency over more rows but widen the union slab S; with the fine grid a
+# block spans ~4x more cells than the old eps-grid at equal occupancy, so
+# the block is half the old 1024. Lives here (host side) so the packer has
+# no jax dependency; dbscan_tpu/ops/banded.py imports it.
+BANDED_BLOCK = 512
+
+
+class CellGraphMeta(NamedTuple):
+    """Host-side cell-graph metadata shared by every banded group of one
+    train() call (cells are numbered globally across partitions).
+
+    wintab: [U, BANDED_WIN] int32 — global cell id of each 5x5-window
+      neighbor per cell (-1 where no occupied cell exists there); slot
+      k*5+j is (dy=k-2, dx=j-2), slot 12 the cell itself. Edges never
+      cross partitions (window keys carry the partition offset and are
+      partition-verified).
+    cell_part: [U] int32 partition id per cell.
+    n_cells: U.
+    """
+
+    wintab: np.ndarray
+    cell_part: np.ndarray
+    n_cells: int
 
 
 def bucketize_banded(
@@ -323,23 +357,25 @@ def bucketize_banded(
     pad_parts_to: int = 1,
     dtype=np.float32,
     force: bool = False,
-) -> Tuple[list, int]:
+) -> Tuple[list, int, "CellGraphMeta"]:
     """Pack partitions for the banded engine (dbscan_tpu/ops/banded.py).
 
-    Per partition: snap instances to an eps-sized grid anchored at the
-    partition's outer rect, sort by cell row-major (stable, so equal-cell
-    points keep fold order), and precompute each point's three contiguous
-    candidate runs — one per neighboring cell row — in the sorted order.
-    Runs are then grouped by blocks of BANDED_BLOCK consecutive rows: the
-    per-(block, cell row) union of runs is the contiguous SLAB the device
-    fetches with one dynamic_slice; the static slab bound S is the padded
-    max slab length. Partitions where 3*S gives no real saving over the
-    dense [B, B] sweep (or below MIN_BANDED_BUCKET, unless ``force``) fall
-    back to dense groups.
+    Per partition: snap instances to the FINE grid (eps/sqrt(2) cells, see
+    FINE_CELL_FACTOR), sort by cell row-major (stable, so equal-cell points
+    keep fold order), and precompute each point's five contiguous candidate
+    runs — one per window cell row — in the sorted order. Runs are grouped
+    by blocks of BANDED_BLOCK consecutive rows: the per-(block, row) union
+    of runs is the contiguous SLAB the device fetches with one
+    dynamic_slice; the static slab bound S is the padded max slab length.
+    Partitions below DENSE_MAX_BUCKET (unless ``force``) fall back to
+    dense groups.
 
-    Groups by (width, S) for banded parts and width for dense parts; returns
-    (groups, max width) like :func:`bucketize_grouped`, with ``banded`` set
-    on the banded groups.
+    Also numbers every occupied (partition, cell) pair globally and builds
+    the 5x5 window-neighbor table the host cell-graph connected-components
+    pass consumes (see dbscan_tpu/parallel/cellgraph.py).
+
+    Returns (groups sorted with dense first, max width, CellGraphMeta);
+    ``banded`` is set on the banded groups.
     """
     pts = np.asarray(points)
     if pts.shape[1] != 2:
@@ -351,19 +387,27 @@ def bucketize_banded(
         [_ladder_width(c, bucket_multiple) for c in counts], dtype=np.int64
     )
 
-    if m_tot == 0:
-        return bucketize_grouped(
+    empty_meta = CellGraphMeta(
+        np.empty((0, BANDED_WIN), np.int32), np.empty(0, np.int32), 0
+    )
+    widths_band_all = (widths_b + BANDED_BLOCK - 1) // BANDED_BLOCK * BANDED_BLOCK
+    if m_tot == 0 or not (
+        force or bool((widths_band_all >= DENSE_MAX_BUCKET).any())
+    ):
+        # nothing will route banded: skip the whole fine-grid pass
+        groups, max_b = bucketize_grouped(
             points, part_ids, point_idx, n_parts, bucket_multiple,
             pad_parts_to, dtype,
         )
+        return groups, max_b, empty_meta
 
-    cell = float(eps) * CELL_SLACK
+    cell = float(eps) * FINE_CELL_FACTOR
     xy = np.asarray(pts, dtype=np.float64)[point_idx]
-    # Cells must be computed from the coordinates the DEVICE sees: under
-    # f32/bf16 the cast can move a point across a float64 cell boundary
-    # (quantization error scales with |coordinate|, far beyond CELL_SLACK's
-    # arithmetic-rounding margin), and a run built from the float64 cell
-    # would miss pairs the device's distance test accepts.
+    # Cells must be computed from the coordinates the DEVICE sees: under f32
+    # the cast can move a point across a float64 cell boundary (quantization
+    # error scales with |coordinate|, far beyond the arithmetic-rounding
+    # margins), and a run built from the float64 cell would miss pairs the
+    # device's distance test accepts.
     xy_dev = xy.astype(dtype).astype(np.float64)
     inv_cell = 1.0 / cell
     ox = outer[part_ids, 0]
@@ -371,8 +415,7 @@ def bucketize_banded(
     cx = np.maximum(np.floor((xy_dev[:, 0] - ox) * inv_cell), 0.0).astype(np.int64)
     cy = np.maximum(np.floor((xy_dev[:, 1] - oy) * inv_cell), 0.0).astype(np.int64)
 
-    # Segment maxima via reduceat (instances are sorted by partition);
-    # ufunc.at is a scalar Python-level loop — ~10s at 5M instances.
+    # Segment maxima via reduceat (instances are sorted by partition).
     nz = counts > 0
     segs = part_start[nz]
     cxmax = np.zeros(n_parts, dtype=np.int64)
@@ -380,14 +423,13 @@ def bucketize_banded(
     if segs.size:
         cxmax[nz] = np.maximum.reduceat(cx, segs)
         cymax[nz] = np.maximum.reduceat(cy, segs)
-    stride = cxmax + 3  # cx + 2 < stride: row windows never wrap
-    big = int((stride * (cymax + 2)).max()) + 1  # per-partition key space
+    stride = cxmax + 5  # cx + 4 < stride: row windows never wrap
+    big = int((stride * (cymax + 3)).max()) + 1  # per-partition key space
     gkey = part_ids * big + cy * stride[part_ids] + cx
 
     # Stable sort by (partition, cell key): instances arrive in (partition,
     # fold) order, so ties keep fold order inside each cell. Stable argsort
-    # on one packed integer key radix-sorts in O(M) — measured 4x faster
-    # than np.lexsort on two keys; int32 keys shave another ~30%.
+    # on one packed integer key radix-sorts in O(M); int32 keys when they fit.
     if n_parts * big < np.iinfo(np.int32).max:
         gkey = gkey.astype(np.int32)
     order = np.argsort(gkey, kind="stable")
@@ -396,44 +438,68 @@ def bucketize_banded(
     fold_s = (order - part_start[p_s]).astype(np.int64)
     ptidx_s = point_idx[order]
     xy_s = xy[order]
+    cx_s = cx[order]
     slots_s = np.arange(m_tot, dtype=np.int64) - part_start[p_s]
 
-    # Run boundaries per UNIQUE cell, not per instance: every instance in a
-    # cell shares the same three candidate runs, and the unique-cell count U
-    # is orders of magnitude below M — 6 searchsorted passes over U instead
-    # of M (measured ~60x cheaper at 10M points), then one U->M gather.
-    newcell = (
-        np.r_[True, gkey_s[1:] != gkey_s[:-1]]
-        if m_tot
-        else np.empty(0, dtype=bool)
-    )
-    cell_first = np.flatnonzero(newcell)  # [U] first sorted pos of each cell
+    # Unique occupied cells (globally numbered: sorted by partition then
+    # row-major key) and per-instance cell rank.
+    newcell = np.r_[True, gkey_s[1:] != gkey_s[:-1]]
+    cell_first = np.flatnonzero(newcell)  # [U] first sorted pos per cell
     ukey = gkey_s[cell_first].astype(np.int64)  # [U]
-    cell_rank = np.cumsum(newcell) - 1  # [M] -> index into cell_first/ukey
+    cell_rank = np.cumsum(newcell) - 1  # [M] global cell id per instance
     upart = p_s[cell_first]
     ustride = stride[upart]
     useg_start = part_start[upart]
     useg_end = useg_start + counts[upart]
     cell_pos = np.r_[cell_first, m_tot]  # [U+1] cell -> first sorted pos
+    u_n = len(ukey)
 
-    ustarts3 = np.empty((len(ukey), 3), dtype=np.int64)
-    uspans3 = np.empty((len(ukey), 3), dtype=np.int64)
-    # cell key of the run start for row (cy + dr): ukey + dr*stride - 1;
-    # searchsorted over unique keys, mapped back to sorted positions via
-    # cell_pos. Row validity (0 <= cy+dr <= cymax) is equivalent to the
-    # segment clamp: out-of-grid rows produce empty runs inside [seg_start,
-    # seg_end) because no cell carries their key — except row overflow past
-    # the partition's key space, which the segment clamp catches.
-    for k, dr in enumerate((-1, 0, 1)):
-        lo = ukey + dr * ustride - 1
-        s = cell_pos[np.searchsorted(ukey, lo)]
-        e = cell_pos[np.searchsorted(ukey, lo + 3)]
-        s = np.clip(s, useg_start, useg_end)
-        e = np.clip(e, s, useg_end)
-        ustarts3[:, k] = s - useg_start
-        uspans3[:, k] = e - s
-    starts3 = ustarts3[cell_rank] if m_tot else np.empty((0, 3), np.int64)
-    spans3 = uspans3[cell_rank] if m_tot else np.empty((0, 3), np.int64)
+    # Run boundaries per UNIQUE cell (instances in a cell share them): the
+    # run for window row dy spans cell keys [key + dy*stride - 2,
+    # key + dy*stride + 2]. Out-of-grid rows resolve to empty runs via the
+    # segment clamps (no cell carries their key inside the segment; key-
+    # space headroom keeps row overflow inside this partition's range).
+    # Everything below stays in UNIQUE-CELL space (U entries) as long as
+    # possible — per-instance [M, 5] intermediates at 10M+ points dominated
+    # this function's runtime before.
+    ustarts = np.empty((u_n, BANDED_ROWS), dtype=np.int32)
+    uspans = np.empty((u_n, BANDED_ROWS), dtype=np.int32)
+    si_c = np.empty((u_n, BANDED_ROWS), dtype=np.int64)  # cell-space run
+    ei_c = np.empty((u_n, BANDED_ROWS), dtype=np.int64)  # bounds, for wintab
+    for k, dr in enumerate((-2, -1, 0, 1, 2)):
+        lo = ukey + dr * ustride - 2
+        si = np.searchsorted(ukey, lo)
+        ei = np.searchsorted(ukey, lo + 5)
+        si_c[:, k] = si
+        ei_c[:, k] = ei
+        s = np.clip(cell_pos[si], useg_start, useg_end)
+        e = np.clip(cell_pos[ei], s, useg_end)
+        ustarts[:, k] = s - useg_start
+        uspans[:, k] = e - s
+
+    # 5x5 window-neighbor cell table for the host cell graph, recovered
+    # from the run bounds by GATHER (the cells of run k are consecutive
+    # unique-cell indices si..ei-1 with keys in [lo, lo+5)): ~10x cheaper
+    # than 25 searchsorted passes. A run can alias into a NEIGHBORING
+    # partition's key space when the window pokes past the grid edge, so a
+    # hit requires both the in-window offset and the same partition.
+    wintab = np.full((u_n, BANDED_WIN), -1, dtype=np.int32)
+    off5 = np.arange(5, dtype=np.int64)
+    for k, dr in enumerate((-2, -1, 0, 1, 2)):
+        lo = ukey + dr * ustride - 2
+        idx = si_c[:, k, None] + off5[None, :]  # [U, 5] candidate cells
+        inrun = idx < ei_c[:, k, None]
+        idx_c = np.minimum(idx, u_n - 1)
+        offs = ukey[idx_c] - lo[:, None]
+        ok = (
+            inrun
+            & (offs >= 0)
+            & (offs < 5)
+            & (upart[idx_c] == upart[:, None])
+        )
+        rr, cc = np.nonzero(ok)
+        wintab[rr, k * 5 + offs[rr, cc]] = idx_c[rr, cc].astype(np.int32)
+    meta = CellGraphMeta(wintab, upart.astype(np.int32), u_n)
 
     # Banded bucket widths: the dense ladder width padded up to a multiple
     # of the block size.
@@ -442,24 +508,35 @@ def bucketize_banded(
     nb_of = widths_band // t  # blocks per partition
     maxnb = int(nb_of.max())
 
-    # Per-(partition block, cell row) slab = union of the block rows' runs:
-    # min start / max end over valid runs.
-    blk_s = slots_s // t
-    bkey = p_s * maxnb + blk_s  # nondecreasing: p_s sorted, slots ascending
+    # Per-(partition block, window row) slab = union of the block rows'
+    # runs, computed per CELL x spanned-block (a cell's instances are a
+    # contiguous slot range, so it touches ceil(len/t)+1 blocks; total
+    # expansion ~ U + number of blocks, not M).
     n_bkeys = n_parts * maxnb
-    bmin = np.zeros((n_bkeys, 3), dtype=np.int64)
-    bmax = np.zeros((n_bkeys, 3), dtype=np.int64)
-    run_valid = spans3 > 0
-    for k in range(3):
-        v = run_valid[:, k]
-        bk = bkey[v]
+    slot0 = cell_pos[:-1] - useg_start  # [U] first slot of cell
+    slot1 = cell_pos[1:] - 1 - useg_start  # [U] last slot (cells nonempty)
+    b0 = slot0 // t
+    nspan = slot1 // t - b0 + 1
+    rows_e = np.repeat(np.arange(u_n), nspan)
+    boff = np.arange(len(rows_e), dtype=np.int64) - np.repeat(
+        np.cumsum(nspan) - nspan, nspan
+    )
+    bkey_e = upart[rows_e] * maxnb + b0[rows_e] + boff  # nondecreasing
+    bmin = np.zeros((n_bkeys, BANDED_ROWS), dtype=np.int64)
+    bmax = np.zeros((n_bkeys, BANDED_ROWS), dtype=np.int64)
+    uvalid = uspans > 0
+    for k in range(BANDED_ROWS):
+        v = uvalid[rows_e, k]
+        bk = bkey_e[v]
         if bk.size == 0:
             continue
-        st = starts3[v, k]
+        st = ustarts[rows_e[v], k].astype(np.int64)
         first = np.flatnonzero(np.r_[True, bk[1:] != bk[:-1]])
         u = bk[first]
         bmin[u, k] = np.minimum.reduceat(st, first)
-        bmax[u, k] = np.maximum.reduceat(st + spans3[v, k], first)
+        bmax[u, k] = np.maximum.reduceat(
+            st + uspans[rows_e[v], k], first
+        )
 
     slab_need = (bmax - bmin).max(axis=1).reshape(n_parts, maxnb).max(axis=1)
     win = np.minimum(
@@ -472,17 +549,7 @@ def bucketize_banded(
     part_of_bkey = np.repeat(np.arange(n_parts), maxnb)
     sstart = np.clip(bmin, 0, (widths_band - win)[part_of_bkey][:, None])
 
-    if force:
-        use_banded = counts > 0
-    else:
-        use_banded = (
-            (counts > 0)
-            & (widths_band >= MIN_BANDED_BUCKET)
-            & (
-                (widths_band >= DENSE_MAX_BUCKET)  # dense cannot fit HBM
-                | (3 * win <= widths_band // 16)  # >=16x less sweep work
-            )
-        )
+    use_banded = (counts > 0) & (force | (widths_band >= DENSE_MAX_BUCKET))
 
     groups: list = []
     max_b = 0
@@ -507,9 +574,7 @@ def bucketize_banded(
             max_b = max(max_b, dmax)
 
     banded_inst = use_banded[p_s]
-    # Per-instance run start within its slab; invalid runs (span 0) pin to 0
-    # rather than inheriting a meaningless negative offset.
-    rel3 = np.where(run_valid, starts3 - sstart[bkey], 0)
+    sstart32 = sstart.astype(np.int32)
     for b, w in sorted(
         set(zip(widths_band[use_banded].tolist(), win[use_banded].tolist()))
     ):
@@ -525,10 +590,11 @@ def bucketize_banded(
         pid[: len(sel_parts)] = sel_parts
         iota = np.arange(b, dtype=np.int32)
         fold_b = np.broadcast_to(iota, (p_pad, b)).copy()
-        pos_b = np.broadcast_to(iota, (p_pad, b)).copy()
-        st_b = np.zeros((p_pad, b, 3), dtype=np.int32)
-        sp_b = np.zeros((p_pad, b, 3), dtype=np.int32)
-        sl_b = np.zeros((p_pad, nb, 3), dtype=np.int32)
+        st_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=np.int32)
+        sp_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=np.int32)
+        sl_b = np.zeros((p_pad, nb, BANDED_ROWS), dtype=np.int32)
+        cx_b = np.zeros((p_pad, b), dtype=np.int32)
+        cgid_b = np.full((p_pad, b), -1, dtype=np.int64)
 
         row_of_part = np.full(n_parts, -1, dtype=np.int64)
         row_of_part[sel_parts] = np.arange(len(sel_parts))
@@ -539,9 +605,16 @@ def bucketize_banded(
         mask[rows, slots] = True
         idx[rows, slots] = ptidx_s[gi]
         fold_b[rows, slots] = fold_s[gi]
-        pos_b[rows, fold_s[gi]] = slots
-        st_b[rows, slots] = rel3[gi]
-        sp_b[rows, slots] = spans3[gi]
+        # Per-instance run start within its slab (invalid runs pin to 0
+        # rather than inheriting a meaningless negative offset); gathered
+        # from unique-cell space only for this group's instances.
+        cr = cell_rank[gi]
+        sp_i = uspans[cr]
+        st_i = ustarts[cr] - sstart32[p_s[gi] * maxnb + slots_s[gi] // t]
+        st_b[rows, slots] = np.where(sp_i > 0, st_i, 0)
+        sp_b[rows, slots] = sp_i
+        cx_b[rows, slots] = cx_s[gi]
+        cgid_b[rows, slots] = cell_rank[gi]
         sl_b[: len(sel_parts)] = sstart[
             sel_parts[:, None] * maxnb + np.arange(nb)[None, :]
         ]
@@ -549,8 +622,8 @@ def bucketize_banded(
         groups.append(
             BucketGroup(
                 buf, mask, idx, pid,
-                BandedExtras(fold_b, pos_b, st_b, sp_b, sl_b, int(w)),
+                BandedExtras(fold_b, st_b, sp_b, sl_b, int(w), cx_b, cgid_b),
             )
         )
         max_b = max(max_b, b)
-    return groups, max_b
+    return groups, max_b, meta

@@ -1,0 +1,130 @@
+"""Host connected-components over the fine-grid cell graph.
+
+The banded engine's phase-1 sweep returns, per core point, a 25-bit mask
+of window cells containing an eps-adjacent core (ops/banded.py). Because
+every cell's cores form a clique (binning.FINE_CELL_FACTOR), cluster
+connectivity collapses to the CELL graph: nodes are the globally-numbered
+occupied cells (binning.CellGraphMeta), edges come from OR-ing the bitmasks
+over each cell's points and expanding through the window-neighbor table.
+Components — and the per-component seed, the minimum core fold index, which
+reproduces the reference's sequential cluster numbering
+(LocalDBSCANNaive.scala:45-64) — are solved here on the host in exact
+integer arithmetic, replacing the device-side label-propagation iteration
+entirely.
+
+This pass is a distributed-DBSCAN analog of the reference's driver-side
+graph work (DBSCANGraph.scala:70-87): tiny metadata, host-friendly, off the
+accelerator's critical path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from dbscan_tpu.ops.labels import SEED_NONE
+from dbscan_tpu.parallel.binning import BANDED_WIN, BucketGroup, CellGraphMeta
+
+_INF = np.iinfo(np.int64).max
+
+
+def _connected_components(n: int, u: np.ndarray, v: np.ndarray) -> np.ndarray:
+    """Component id per node of an undirected graph given edge arrays."""
+    if len(u) == 0:
+        return np.arange(n, dtype=np.int64)
+    try:
+        import scipy.sparse as sp
+        from scipy.sparse.csgraph import connected_components
+
+        g = sp.coo_matrix(
+            (np.ones(len(u), dtype=np.int8), (u, v)), shape=(n, n)
+        )
+        return connected_components(g, directed=False)[1].astype(np.int64)
+    except ImportError:
+        # Vectorized min-label + pointer jumping; host gathers are fast
+        # (unlike TPU), so this converges in O(log diameter) cheap rounds.
+        comp = np.arange(n, dtype=np.int64)
+        while True:
+            nxt = comp.copy()
+            np.minimum.at(nxt, u, comp[v])
+            np.minimum.at(nxt, v, comp[u])
+            nxt = nxt[nxt]
+            if (nxt == comp).all():
+                return comp
+            comp = nxt
+
+
+def compute_cell_labels(
+    banded_results: Sequence[Tuple[BucketGroup, np.ndarray, np.ndarray]],
+    meta: CellGraphMeta,
+) -> List[np.ndarray]:
+    """Labels for every banded group from its phase-1 outputs.
+
+    banded_results: per banded group (group, core [P, B] bool, bits [P, B]
+    int32) — phase-1 outputs pulled to host.
+    meta: the CellGraphMeta from bucketize_banded.
+
+    Returns one [P, B] int32 array per input group: at CORE positions the
+    component seed (min core fold index over the cell component), SEED_NONE
+    elsewhere — exactly the `labels` input of ops.banded.banded_phase2.
+    """
+    n_cells = meta.n_cells
+    cell_fold_min = np.full(n_cells, _INF, dtype=np.int64)
+    edges_u: List[np.ndarray] = []
+    edges_v: List[np.ndarray] = []
+    win_iota = np.arange(BANDED_WIN)
+
+    for g, core, bits in banded_results:
+        ext = g.banded
+        flat_cg = ext.cell_gid.reshape(-1)
+        valid = flat_cg >= 0
+        cg = flat_cg[valid]
+        if cg.size == 0:
+            continue
+        # cell runs are contiguous in the flattened row-major view (each
+        # row is cell-sorted; a cell never spans rows/partitions)
+        first = np.flatnonzero(np.r_[True, cg[1:] != cg[:-1]])
+        ucell = cg[first]
+        orbits = np.bitwise_or.reduceat(bits.reshape(-1)[valid], first)
+        nzm = orbits != 0
+        if nzm.any():
+            src = ucell[nzm]
+            unp = (orbits[nzm][:, None] >> win_iota) & 1
+            ei, ej = np.nonzero(unp)
+            edges_u.append(src[ei])
+            # bits are only set where an adjacent core exists, so the
+            # window cell is occupied: wintab hit guaranteed (>= 0)
+            edges_v.append(meta.wintab[src[ei], ej].astype(np.int64))
+        corev = core.reshape(-1)[valid]
+        if corev.any():
+            cgc = cg[corev]
+            folds = ext.fold_idx.reshape(-1)[valid][corev].astype(np.int64)
+            f2 = np.flatnonzero(np.r_[True, cgc[1:] != cgc[:-1]])
+            # each cell lives in exactly one group: plain assignment
+            cell_fold_min[cgc[f2]] = np.minimum.reduceat(folds, f2)
+
+    u = np.concatenate(edges_u) if edges_u else np.empty(0, np.int64)
+    v = np.concatenate(edges_v) if edges_v else np.empty(0, np.int64)
+    comp = _connected_components(n_cells, u, v)
+
+    # seed per component = min cell_fold_min over member cells (coreless
+    # cells hold _INF and are never read back at a core position)
+    seed_of_cell = np.full(n_cells, _INF, dtype=np.int64)
+    if n_cells:
+        order = np.argsort(comp, kind="stable")
+        cs = comp[order]
+        f3 = np.flatnonzero(np.r_[True, cs[1:] != cs[:-1]])
+        compmin = np.minimum.reduceat(cell_fold_min[order], f3)
+        seed_of_cell[order] = np.repeat(
+            compmin, np.diff(np.r_[f3, n_cells])
+        )
+
+    out: List[np.ndarray] = []
+    for g, core, bits in banded_results:
+        ext = g.banded
+        labels = np.full(ext.cell_gid.shape, SEED_NONE, dtype=np.int32)
+        sel = core & (ext.cell_gid >= 0)
+        labels[sel] = seed_of_cell[ext.cell_gid[sel]].astype(np.int32)
+        out.append(labels)
+    return out

@@ -47,7 +47,7 @@ from dbscan_tpu.config import DBSCANConfig
 from dbscan_tpu.ops import geometry as geo
 from dbscan_tpu.ops.labels import CORE, NOISE, SEED_NONE
 from dbscan_tpu.ops.local_dbscan import local_dbscan
-from dbscan_tpu.parallel import binning, partitioner
+from dbscan_tpu.parallel import binning, cellgraph, partitioner
 from dbscan_tpu.parallel.graph import UnionFind
 from dbscan_tpu.parallel.mesh import PARTS_AXIS, mesh_size
 
@@ -67,6 +67,8 @@ def clear_compile_cache() -> None:
     executables they retain). For long-lived processes sweeping many
     configurations or meshes."""
     _compiled_block.cache_clear()
+    _compiled_banded_p1.cache_clear()
+    _compiled_banded_p2.cache_clear()
 
 
 @functools.lru_cache(maxsize=256)
@@ -135,30 +137,68 @@ def _compiled_block(
 
 
 @functools.lru_cache(maxsize=256)
-def _compiled_block_banded(
+def _compiled_banded_p1(
     eps: float,
     min_points: int,
+    slab: int,
+    batch: Optional[int],
+    mesh,
+):
+    """Jitted per-group phase-1 executor for the banded engine (counts +
+    core + cell-edge bitmask sweeps, dbscan_tpu/ops/banded.py); cached like
+    :func:`_compiled_block`."""
+    from dbscan_tpu.ops.banded import banded_phase1
+
+    def one(args):
+        pts, msk, rel, sp, sl, cx = args
+        return banded_phase1(
+            pts, msk, rel, sp, sl, cx, eps, min_points, slab=slab
+        )
+
+    def block(pts, msk, rel, sp, sl, cx):
+        return lax.map(one, (pts, msk, rel, sp, sl, cx), batch_size=batch)
+
+    if mesh is None:
+        return jax.jit(block)
+    spec = PartitionSpec(PARTS_AXIS)
+    return jax.jit(
+        jax.shard_map(
+            block,
+            mesh=mesh,
+            in_specs=(spec,) * 6,
+            out_specs=(spec, spec, spec),
+        )
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_banded_p2(
+    eps: float,
     engine: str,
     slab: int,
     batch: Optional[int],
     mesh,
 ):
-    """Jitted per-group executor for the banded engine
-    (dbscan_tpu/ops/banded.py); cached like :func:`_compiled_block`."""
-    from dbscan_tpu.ops.banded import banded_local_dbscan
+    """Jitted per-group phase-2 executor for the banded engine (border
+    algebra from host cell labels); cached like :func:`_compiled_block`."""
+    from dbscan_tpu.ops.banded import banded_phase2
 
     def one(args):
-        pts, msk, fold, pos, rel, sp, sl = args
-        r = banded_local_dbscan(
-            pts, msk, fold, pos, rel, sp, sl, eps, min_points,
-            engine=engine, slab=slab,
+        pts, msk, fold, core, counts, labels, rel, sp, sl = args
+        r = banded_phase2(
+            pts, msk, fold, core, counts, labels, rel, sp, sl,
+            eps, engine=engine, slab=slab,
         )
         return r.seed_labels, r.flags
 
-    def block(pts, msk, fold, pos, rel, sp, sl):
+    def block(pts, msk, fold, core, counts, labels, rel, sp, sl):
         seeds, flags = lax.map(
-            one, (pts, msk, fold, pos, rel, sp, sl), batch_size=batch
+            one, (pts, msk, fold, core, counts, labels, rel, sp, sl),
+            batch_size=batch,
         )
+        # Global core count via all-reduce over the mesh: keeps one real
+        # ICI collective in the banded production program so multichip
+        # dryruns validate the communication path.
         ncore = jnp.sum(flags == CORE, dtype=jnp.int32)
         if mesh is not None:
             ncore = lax.psum(ncore, PARTS_AXIS)
@@ -171,14 +211,26 @@ def _compiled_block_banded(
         jax.shard_map(
             block,
             mesh=mesh,
-            in_specs=(spec,) * 7,
+            in_specs=(spec,) * 9,
             out_specs=(spec, spec, PartitionSpec()),
         )
     )
 
 
+def _banded_batch(group, mesh) -> int:
+    """Partitions per vmapped lax.map step for a banded group: bound the
+    [T, R, S]-tile transients to a fixed HBM element budget."""
+    from dbscan_tpu.parallel.binning import BANDED_ROWS
+
+    p_total, b = group.points.shape[:2]
+    per_part = b * (BANDED_ROWS * group.banded.slab)
+    mem_cap = max(1, int(1.2e9) // per_part)
+    return max(1, min(8, mem_cap, p_total // max(1, mesh_size(mesh))))
+
+
 def _dispatch_partitions(group, cfg: DBSCANConfig, mesh):
-    """Fan the local kernel out over the partition axis (async dispatch).
+    """Fan the dense/pallas local kernel out over the partition axis (async
+    dispatch).
 
     Inside each mesh shard, partitions are processed with lax.map (bounded
     memory: one adjacency at a time, `batch` of them in flight) — the moral
@@ -187,36 +239,15 @@ def _dispatch_partitions(group, cfg: DBSCANConfig, mesh):
     blocking so successive bucket groups overlap on the device queue.
     """
     p_total, b = group.points.shape[:2]
-    banded = group.banded
     # vmap small batches of partitions for utilization, capped so the
-    # batched per-partition intermediates ([B, B] dense / [B, 3, W] banded)
-    # stay within a fixed HBM element budget — wide buckets run narrower
-    # batches. Pallas path: strictly sequential (batch=None -> unbatched
-    # lax.map).
+    # batched per-partition [B, B] intermediates stay within a fixed HBM
+    # element budget — wide buckets run narrower batches. Pallas path:
+    # strictly sequential (batch=None -> unbatched lax.map).
     if cfg.use_pallas:
         batch = None
     else:
-        per_part = b * (3 * banded.slab) if banded is not None else b * b
-        mem_cap = max(1, int(1.2e9) // per_part)
+        mem_cap = max(1, int(1.2e9) // (b * b))
         batch = max(1, min(8, mem_cap, p_total // max(1, mesh_size(mesh))))
-    if banded is not None:
-        fn = _compiled_block_banded(
-            float(cfg.eps),
-            int(cfg.min_points),
-            cfg.engine.value,
-            int(banded.slab),
-            batch,
-            mesh,
-        )
-        return fn(
-            group.points,
-            group.mask,
-            banded.fold_idx,
-            banded.pos_of_fold,
-            banded.rel_starts,
-            banded.spans,
-            banded.slab_starts,
-        )
     fn = _compiled_block(
         float(cfg.eps),
         int(cfg.min_points),
@@ -227,6 +258,42 @@ def _dispatch_partitions(group, cfg: DBSCANConfig, mesh):
         mesh,
     )
     return fn(group.points, group.mask)
+
+
+def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh):
+    """Async phase-1 dispatch for one banded group: (counts, core, bits)."""
+    ext = group.banded
+    fn = _compiled_banded_p1(
+        float(cfg.eps),
+        int(cfg.min_points),
+        int(ext.slab),
+        _banded_batch(group, mesh),
+        mesh,
+    )
+    return fn(
+        group.points, group.mask, ext.rel_starts, ext.spans,
+        ext.slab_starts, ext.cx,
+    )
+
+
+def _dispatch_banded_p2(group, cfg: DBSCANConfig, mesh, core, counts, labels):
+    """Async phase-2 dispatch: border algebra from host cell labels.
+
+    core/counts are the phase-1 DEVICE arrays (no retransfer); labels is
+    the host [P, B] int32 from cellgraph.compute_cell_labels.
+    """
+    ext = group.banded
+    fn = _compiled_banded_p2(
+        float(cfg.eps),
+        cfg.engine.value,
+        int(ext.slab),
+        _banded_batch(group, mesh),
+        mesh,
+    )
+    return fn(
+        group.points, group.mask, ext.fold_idx, core, counts, labels,
+        ext.rel_starts, ext.spans, ext.slab_starts,
+    )
 
 
 def _local_ids_flat(
@@ -399,10 +466,10 @@ def train_arrays(
     if cfg.neighbor_backend == "banded" and cfg.precision.value == "bf16":
         raise ValueError(
             "neighbor_backend='banded' requires f32/f64: bf16 rounds d2 by "
-            "~4e-3 relative — far past the banded grid's 1e-5 cell slack — "
-            "so pairs the bf16 distance test accepts can fall outside the "
-            "3x3 cell ring and be missed; use precision=F32 or the dense "
-            "backend"
+            "~4e-3 relative — far past the fine grid's 1e-5 margins "
+            "(binning.FINE_CELL_FACTOR) — breaking both the same-cell "
+            "clique guarantee and the 5x5-window coverage of accepted "
+            "pairs; use precision=F32 or the dense backend"
         )
     use_banded = (
         cfg.neighbor_backend != "dense"
@@ -411,8 +478,9 @@ def train_arrays(
         and cfg.precision.value != "bf16"
         and kernel_cols.shape[1] == 2
     )
+    cellmeta = None
     if use_banded:
-        groups, max_b = binning.bucketize_banded(
+        groups, max_b, cellmeta = binning.bucketize_banded(
             kernel_cols,
             part_ids,
             point_idx,
@@ -443,7 +511,14 @@ def train_arrays(
     # execution is async, so the device works through the groups while the
     # host runs every device-INDEPENDENT phase below — instance tables, band
     # membership, inner membership — and only then blocks on the labels.
-    pending = [(g, _dispatch_partitions(g, cfg, mesh)) for g in groups]
+    # Banded groups go out as phase 1 (counts/core/cell-edge bits); their
+    # phase 2 follows after the host cell-components pass.
+    pending = []
+    for g in groups:
+        if g.banded is None:
+            pending.append((g, _dispatch_partitions(g, cfg, mesh)))
+        else:
+            pending.append((g, _dispatch_banded_p1(g, cfg, mesh)))
 
     slotmaps = [np.nonzero(g.point_idx >= 0) for g, _ in pending]
     inst_part = np.concatenate(
@@ -459,6 +534,29 @@ def train_arrays(
     pts_of_inst = pts[inst_ptidx][:, :2]
     inst_inner = geo.almost_contains(margins.inner[inst_part], pts_of_inst)
     t0 = _mark("overlap_host_s", t0)
+
+    # host cell-graph components for the banded groups (blocks on their
+    # phase 1), then phase-2 dispatch — the reference's driver-side graph
+    # pass (DBSCANGraph.scala:70-87) transplanted to per-partition scale
+    if cellmeta is not None:
+        b_idx = [i for i, (g, _) in enumerate(pending) if g.banded is not None]
+        if b_idx:
+            p1_np = [
+                (
+                    pending[i][0],
+                    np.asarray(pending[i][1][1]),
+                    np.asarray(pending[i][1][2]),
+                )
+                for i in b_idx
+            ]
+            labels_list = cellgraph.compute_cell_labels(p1_np, cellmeta)
+            for i, labels in zip(b_idx, labels_list):
+                g, (counts_d, core_d, _bits) = pending[i]
+                pending[i] = (
+                    g,
+                    _dispatch_banded_p2(g, cfg, mesh, core_d, counts_d, labels),
+                )
+    t0 = _mark("cellcc_s", t0)
 
     n_core = 0
     inst_seed_l, inst_flag_l = [], []

@@ -128,7 +128,7 @@ def test_packer_invariants(rng):
     )
     part_ids = np.zeros(len(pts), np.int64)
     point_idx = np.arange(len(pts), dtype=np.int64)
-    groups, _ = binning.bucketize_banded(
+    groups, _, meta = binning.bucketize_banded(
         pts, part_ids, point_idx, 1, 0.3, outer, force=True
     )
     (g,) = groups
@@ -136,37 +136,44 @@ def test_packer_invariants(rng):
     assert b % binning.BANDED_BLOCK == 0
     ext = g.banded
     nb = b // binning.BANDED_BLOCK
-    assert ext.slab_starts.shape == (g.points.shape[0], nb, 3)
+    assert ext.slab_starts.shape == (g.points.shape[0], nb, binning.BANDED_ROWS)
     # slab bounds
     assert (ext.slab_starts >= 0).all()
     assert (ext.slab_starts + ext.slab <= b).all()
     # runs fit their slabs
     assert (ext.rel_starts >= 0).all()
     assert (ext.rel_starts + ext.spans <= ext.slab).all()
-    # inverse permutation
-    row = 0
-    fold = ext.fold_idx[row]
-    pos = ext.pos_of_fold[row]
-    np.testing.assert_array_equal(pos[fold], np.arange(b))
+    # fold indices are a permutation on each row
+    np.testing.assert_array_equal(np.sort(ext.fold_idx[0]), np.arange(b))
     # instances: valid slots carry each original index exactly once
     got = np.sort(g.point_idx[g.point_idx >= 0])
     np.testing.assert_array_equal(got, point_idx)
+    # window table: every occupied cell sees itself at the center slot
+    assert meta.n_cells == int(ext.cell_gid.max()) + 1
+    np.testing.assert_array_equal(
+        meta.wintab[:, binning.BANDED_WIN // 2], np.arange(meta.n_cells)
+    )
     # every true eps-pair is covered by some run of the query row
-    # (spot-check: counts from a brute-force subset)
+    # (spot-check: counts from a brute-force subset against phase 1)
     sub = rng.choice(len(pts), 64, replace=False)
     d2 = ((pts[sub, None, :] - pts[None, :, :]) ** 2).sum(-1)
     want = (d2 <= 0.3 * 0.3).sum(axis=1)
-    from dbscan_tpu.ops.banded import banded_local_dbscan
+    from dbscan_tpu.ops.banded import banded_phase1
     import jax.numpy as jnp
 
-    r = banded_local_dbscan(
+    counts_dev, core_dev, bits_dev = banded_phase1(
         jnp.asarray(g.points[0]), jnp.asarray(g.mask[0]),
-        jnp.asarray(ext.fold_idx[0]), jnp.asarray(ext.pos_of_fold[0]),
         jnp.asarray(ext.rel_starts[0]), jnp.asarray(ext.spans[0]),
-        jnp.asarray(ext.slab_starts[0]),
-        0.3, 6, engine="archery", slab=ext.slab,
+        jnp.asarray(ext.slab_starts[0]), jnp.asarray(ext.cx[0]),
+        0.3, 6, slab=ext.slab,
     )
     counts = np.zeros(len(pts), np.int64)
     valid = g.point_idx[0] >= 0
-    counts[g.point_idx[0][valid]] = np.asarray(r.counts)[valid]
+    counts[g.point_idx[0][valid]] = np.asarray(counts_dev)[valid]
     np.testing.assert_array_equal(counts[sub], want)
+    # a core point always reports its own cell in the edge bitmask
+    bits = np.asarray(bits_dev)
+    core = np.asarray(core_dev)
+    center = 1 << (binning.BANDED_WIN // 2)
+    assert ((bits[core] & center) == center).all()
+    assert (bits[~core] == 0).all()
