@@ -9,9 +9,10 @@ The reference publishes no numbers (BASELINE.md); the baseline here is the
 same workload on XLA-CPU in a subprocess — a strictly stronger baseline than
 Spark-CPU's scalar JVM loops for this O(B^2)-per-partition algorithm.
 
-Env knobs: BENCH_N (points, default 200k), BENCH_MAXPP (max points per
-partition on the accelerator, default 32768 — large partitions amortize the
-halo duplication and host merge), BENCH_CPU_MAXPP (baseline partition size,
+Env knobs: BENCH_N (points, default 1M), BENCH_MAXPP (max points per
+partition on the accelerator, default 262144 — large partitions route the
+fine-grid banded engine and amortize the halo duplication and host merge;
+measured fastest at 1M on v5e), BENCH_CPU_MAXPP (baseline partition size,
 default 2048 — the CPU's own sweet spot; the quadratic per-partition cost
 favors smaller partitions there), BENCH_CPU_N (baseline points, default
 min(N, 100k)), BENCH_PALLAS (1 = route the accelerator run through the
@@ -77,8 +78,8 @@ def child_cpu(data_path: str, out_path: str, maxpp: int) -> None:
 
 
 def main() -> None:
-    n = int(os.environ.get("BENCH_N", "200000"))
-    maxpp = int(os.environ.get("BENCH_MAXPP", "32768"))
+    n = int(os.environ.get("BENCH_N", "1000000"))
+    maxpp = int(os.environ.get("BENCH_MAXPP", "262144"))
     cpu_maxpp = int(os.environ.get("BENCH_CPU_MAXPP", "2048"))
     cpu_n = int(os.environ.get("BENCH_CPU_N", str(min(n, 100000))))
 

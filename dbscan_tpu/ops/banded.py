@@ -1,4 +1,4 @@
-"""Grid-banded local DBSCAN engine: 3 fixed sweeps + host cell components.
+"""Grid-banded local DBSCAN engine: 2 fixed sweeps + host cell components.
 
 The dense engine (ops/local_dbscan.py) materializes the full [B, B]
 eps-adjacency — the TPU-shaped replacement for the reference's O(n^2) linear
@@ -14,14 +14,15 @@ points in one cell are then within eps, so all cores of a cell form a
 clique sharing ONE cluster — connected components collapse from the point
 graph to the (25x smaller) CELL graph, which the HOST solves exactly with
 scipy/C connected-components (dbscan_tpu/parallel/cellgraph.py). The
-device does only the pairwise-distance work, as a FIXED three sweeps:
+device does only the pairwise-distance work, as a FIXED two sweeps:
 
-  sweep 1 (phase1): eps-neighbor counts -> core mask;
-  sweep 2 (phase1): per-core-point 25-bit mask over its 5x5 window cells —
-    bit set iff some core in that cell is eps-adjacent — the cell graph's
-    edge list, 1 int32 per point;
-  sweep 3 (phase2, after the host labels cells): min seed among
-    eps-adjacent cores per point, for the border algebra.
+  sweep 1: eps-neighbor counts -> core mask;
+  sweep 2: per-point 25-bit mask over its 5x5 window cells — bit set iff
+    some CORE in that cell is eps-adjacent — 1 int32 per point. Core rows'
+    bits are the cell graph's edge list; non-core rows' bits give each
+    candidate border point its min adjacent-core seed (all cores of a cell
+    share one seed), so labels, flags, and the whole border algebra
+    finalize on the host with no further device pass.
 
 Sweeps are block-slab passes over cell-sorted points: for a block of
 BANDED_BLOCK consecutive sorted points, each window row's candidate runs
@@ -56,9 +57,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-
-from dbscan_tpu.ops.labels import SEED_NONE
-from dbscan_tpu.ops.local_dbscan import LocalResult, _finalize
 
 # Block/window geometry lives host-side next to the packer that must agree
 # on it.
@@ -153,8 +151,13 @@ def banded_phase1(
       slab: static slab length S.
 
     Returns (counts [B] int32, core [B] bool, bits [B] int32) where bit
-    k*5+j of bits[i] is set iff point i is core and some CORE point in the
-    window cell (dy=k-2, dx=j-2) is eps-adjacent to it (bit 12 = own cell).
+    k*5+j of bits[i] is set iff some CORE point in the window cell
+    (dy=k-2, dx=j-2) is eps-adjacent to point i (bit 12 = own cell; for a
+    core point that bit is always set via self-adjacency). Bits are
+    computed for EVERY valid row: core rows' bits are the cell graph's
+    edge list (host masks to core rows before building edges), non-core
+    rows' bits drive the border algebra — min seed over set bits — so no
+    third sweep is needed (dbscan_tpu/parallel/cellgraph.py).
     """
     blocks, slabs_of, tile_adj, nb = _tile_machinery(
         points, mask, rel_starts, spans, slab_starts, eps, slab
@@ -168,13 +171,12 @@ def banded_phase1(
     core = (counts >= jnp.int32(min_points)) & mask
 
     cx_blocks = cx.reshape(nb, BANDED_BLOCK)
-    core_blocks = core.reshape(nb, BANDED_BLOCK)
 
     def bits_block(args):
-        bx, by, bm, brel, bspan, borig, bcx, bcore = args
+        bx, by, bm, brel, bspan, borig, bcx = args
         adj = tile_adj(bx, by, bm, brel, bspan, borig)
         score = slabs_of(core, borig)  # [R, S] col core mask
-        adj_cc = adj & score[None, :, :] & bcore[:, None, None]
+        adj_cc = adj & score[None, :, :]
         scx = slabs_of(cx, borig)  # [R, S] col cell columns
         # Window column slot of each candidate: 0..4 whenever adj is true
         # (the run covers exactly cx-2..cx+2 of the row's window); the
@@ -188,50 +190,6 @@ def banded_phase1(
         )
 
     bits = lax.map(
-        bits_block, (*blocks, cx_blocks, core_blocks), batch_size=batch
+        bits_block, (*blocks, cx_blocks), batch_size=batch
     ).reshape(-1)
     return counts, core, bits
-
-
-@functools.partial(jax.jit, static_argnames=("engine", "slab"))
-def banded_phase2(
-    points: jnp.ndarray,
-    mask: jnp.ndarray,
-    fold_idx: jnp.ndarray,
-    core: jnp.ndarray,
-    counts: jnp.ndarray,
-    labels: jnp.ndarray,
-    rel_starts: jnp.ndarray,
-    spans: jnp.ndarray,
-    slab_starts: jnp.ndarray,
-    eps: float,
-    engine: str = "naive",
-    slab: int = 128,
-) -> LocalResult:
-    """Sweep 3: border algebra from the host-computed cell labels.
-
-    labels: [B] int32 — at CORE positions the component seed (min core fold
-    index of the point's cell component, from the host cell-graph pass);
-    SEED_NONE elsewhere. core/counts: phase1 outputs (device arrays are
-    passed straight back in — no retransfer).
-
-    Returns a :class:`LocalResult` of [B] arrays in SORTED order; seed
-    label values are fold indices.
-    """
-    if engine not in ("naive", "archery"):
-        raise ValueError(f"unknown engine {engine!r}")
-    blocks, slabs_of, tile_adj, nb = _tile_machinery(
-        points, mask, rel_starts, spans, slab_starts, eps, slab
-    )
-    batch = _block_batch(slab)
-    none = jnp.int32(SEED_NONE)
-
-    def one(args):
-        adj = tile_adj(*args)
-        sl = slabs_of(labels, args[-1])  # [R, S]; NONE at non-core cols
-        return jnp.min(jnp.where(adj, sl[None, :, :], none), axis=(1, 2))
-
-    core_nbr_seed = lax.map(one, blocks, batch_size=batch).reshape(-1)
-    return _finalize(
-        mask, core, labels, core_nbr_seed, counts, engine, own_idx=fold_idx
-    )

@@ -116,6 +116,29 @@ def cell_index(points, cell_size):
     return np.trunc(shifted / cell_size).astype(np.int64)
 
 
+def group_by_int_key(key, max_key=None):
+    """Group integer keys: (uniq [U] int64 ascending, inverse [N] int64,
+    counts [U] int64) via ONE stable argsort — numpy's stable sort radix-
+    sorts integers, measured several times faster than np.unique(+inverse)
+    at 10M+ elements. ``max_key`` (an exclusive upper bound, keys assumed
+    nonnegative) enables the int32 fast path."""
+    key = np.asarray(key)
+    if key.size == 0:
+        empty = np.empty(0, np.int64)
+        return empty, empty.copy(), empty.copy()
+    if max_key is not None and max_key < np.iinfo(np.int32).max:
+        key = key.astype(np.int32)
+    order = np.argsort(key, kind="stable")
+    ks = key[order]
+    newu = np.r_[True, ks[1:] != ks[:-1]]
+    firsts = np.flatnonzero(newu)
+    uniq = ks[firsts].astype(np.int64)
+    inverse = np.empty(len(ks), dtype=np.int64)
+    inverse[order] = np.cumsum(newu) - 1
+    counts = np.diff(np.r_[firsts, len(ks)])
+    return uniq, inverse, counts
+
+
 def cell_histogram_int(points, cell_size):
     """Unique integer cells + counts (the aggregateByKey pass,
     DBSCAN.scala:91-97, in exact arithmetic).
@@ -137,9 +160,7 @@ def cell_histogram_int(points, cell_size):
     span_x = int(idx[:, 0].max()) - int(mn[0]) + 1
     if span_x * span_y < 2**62:
         key = (idx[:, 0] - mn[0]) * span_y + (idx[:, 1] - mn[1])
-        uk, inverse, counts = np.unique(
-            key, return_inverse=True, return_counts=True
-        )
+        uk, inverse, counts = group_by_int_key(key, max_key=span_x * span_y)
         uniq = np.stack([uk // span_y + mn[0], uk % span_y + mn[1]], axis=1)
     else:  # astronomically sparse grid: fall back to the exact 2-D unique
         uniq, inverse, counts = np.unique(

@@ -1,20 +1,24 @@
-"""Host connected-components over the fine-grid cell graph.
+"""Host finalize for the banded engine: cell components + border algebra.
 
-The banded engine's phase-1 sweep returns, per core point, a 25-bit mask
-of window cells containing an eps-adjacent core (ops/banded.py). Because
-every cell's cores form a clique (binning.FINE_CELL_FACTOR), cluster
-connectivity collapses to the CELL graph: nodes are the globally-numbered
-occupied cells (binning.CellGraphMeta), edges come from OR-ing the bitmasks
-over each cell's points and expanding through the window-neighbor table.
-Components — and the per-component seed, the minimum core fold index, which
-reproduces the reference's sequential cluster numbering
-(LocalDBSCANNaive.scala:45-64) — are solved here on the host in exact
-integer arithmetic, replacing the device-side label-propagation iteration
-entirely.
+The banded engine's device sweeps return, per point, a core mask and a
+25-bit mask of window cells containing an eps-adjacent core
+(ops/banded.py). Because every cell's cores form a clique
+(binning.FINE_CELL_FACTOR), everything after the distance work happens
+here on the host, exactly and vectorized:
+
+1. cluster connectivity collapses to the CELL graph — nodes are the
+   globally-numbered occupied cells (binning.CellGraphMeta), edges come
+   from OR-ing CORE rows' bitmasks over each cell and expanding through
+   the window-neighbor table — solved with scipy/C connected components;
+2. the per-component seed is the minimum core fold index, reproducing the
+   reference's sequential cluster numbering (LocalDBSCANNaive.scala:45-64);
+3. border/noise algebra (the dense engine's ``_finalize``, both reference
+   engines' semantics): a non-core point's min adjacent-core seed is the
+   min seed over its set bits — no third device sweep.
 
 This pass is a distributed-DBSCAN analog of the reference's driver-side
-graph work (DBSCANGraph.scala:70-87): tiny metadata, host-friendly, off the
-accelerator's critical path.
+graph work (DBSCANGraph.scala:70-87): tiny metadata, host-friendly, off
+the accelerator's critical path.
 """
 
 from __future__ import annotations
@@ -23,7 +27,7 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
-from dbscan_tpu.ops.labels import SEED_NONE
+from dbscan_tpu.ops.labels import BORDER, CORE, NOISE, NOT_FLAGGED, SEED_NONE
 from dbscan_tpu.parallel.binning import BANDED_WIN, BucketGroup, CellGraphMeta
 
 _INF = np.iinfo(np.int64).max
@@ -55,20 +59,26 @@ def _connected_components(n: int, u: np.ndarray, v: np.ndarray) -> np.ndarray:
             comp = nxt
 
 
-def compute_cell_labels(
+def finalize_from_bits(
     banded_results: Sequence[Tuple[BucketGroup, np.ndarray, np.ndarray]],
     meta: CellGraphMeta,
-) -> List[np.ndarray]:
-    """Labels for every banded group from its phase-1 outputs.
+    engine: str,
+) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Seed labels + flags for every banded group from its device outputs.
 
     banded_results: per banded group (group, core [P, B] bool, bits [P, B]
-    int32) — phase-1 outputs pulled to host.
+    int32) — device sweep outputs pulled to host.
     meta: the CellGraphMeta from bucketize_banded.
+    engine: "naive" | "archery" (border-adoption semantics, see
+    ops/local_dbscan.py).
 
-    Returns one [P, B] int32 array per input group: at CORE positions the
-    component seed (min core fold index over the cell component), SEED_NONE
-    elsewhere — exactly the `labels` input of ops.banded.banded_phase2.
+    Returns one (seed_labels [P, B] int32, flags [P, B] int8) pair per
+    input group, in SORTED position order with fold-index label values —
+    exactly what the device phase-2 sweep used to produce, bit-identical
+    to the dense engine's output in f32.
     """
+    if engine not in ("naive", "archery"):
+        raise ValueError(f"unknown engine {engine!r}")
     n_cells = meta.n_cells
     cell_fold_min = np.full(n_cells, _INF, dtype=np.int64)
     edges_u: List[np.ndarray] = []
@@ -83,10 +93,14 @@ def compute_cell_labels(
         if cg.size == 0:
             continue
         # cell runs are contiguous in the flattened row-major view (each
-        # row is cell-sorted; a cell never spans rows/partitions)
+        # row is cell-sorted; a cell never spans rows/partitions). Edges
+        # come from CORE rows only — non-core rows' bits are border
+        # candidates, not connectivity.
+        corev = core.reshape(-1)[valid]
+        ebits = np.where(corev, bits.reshape(-1)[valid], 0)
         first = np.flatnonzero(np.r_[True, cg[1:] != cg[:-1]])
         ucell = cg[first]
-        orbits = np.bitwise_or.reduceat(bits.reshape(-1)[valid], first)
+        orbits = np.bitwise_or.reduceat(ebits, first)
         nzm = orbits != 0
         if nzm.any():
             src = ucell[nzm]
@@ -96,7 +110,6 @@ def compute_cell_labels(
             # bits are only set where an adjacent core exists, so the
             # window cell is occupied: wintab hit guaranteed (>= 0)
             edges_v.append(meta.wintab[src[ei], ej].astype(np.int64))
-        corev = core.reshape(-1)[valid]
         if corev.any():
             cgc = cg[corev]
             folds = ext.fold_idx.reshape(-1)[valid][corev].astype(np.int64)
@@ -120,11 +133,37 @@ def compute_cell_labels(
             compmin, np.diff(np.r_[f3, n_cells])
         )
 
-    out: List[np.ndarray] = []
+    out: List[Tuple[np.ndarray, np.ndarray]] = []
     for g, core, bits in banded_results:
         ext = g.banded
-        labels = np.full(ext.cell_gid.shape, SEED_NONE, dtype=np.int32)
-        sel = core & (ext.cell_gid >= 0)
-        labels[sel] = seed_of_cell[ext.cell_gid[sel]].astype(np.int32)
-        out.append(labels)
+        shape = ext.cell_gid.shape
+        seeds = np.full(shape, SEED_NONE, dtype=np.int32)
+        flags = np.full(shape, NOT_FLAGGED, dtype=np.int8)
+        valid = ext.cell_gid >= 0
+        flags[valid] = NOISE
+        csel = core & valid
+        seeds[csel] = seed_of_cell[ext.cell_gid[csel]].astype(np.int32)
+        flags[csel] = CORE
+
+        # border algebra (dense _finalize semantics): min adjacent-core
+        # seed = min seed over the set bits' window cells
+        nsel = valid & ~core & (bits != 0)
+        if nsel.any():
+            b = bits[nsel]
+            unp = ((b[:, None] >> win_iota) & 1).astype(bool)
+            wt = meta.wintab[ext.cell_gid[nsel]]  # [K, 25]
+            cand = np.where(
+                unp, seed_of_cell[np.maximum(wt, 0)], _INF
+            )
+            nbr_seed = cand.min(axis=1)  # < _INF: some bit is set
+            if engine == "naive":
+                # adopted only if the adopting expansion precedes the
+                # point's own fold visit (LocalDBSCANNaive.scala:108-111)
+                border = nbr_seed < ext.fold_idx[nsel]
+            else:
+                border = np.ones(len(nbr_seed), dtype=bool)
+            rows = np.flatnonzero(nsel.reshape(-1))[border]
+            seeds.reshape(-1)[rows] = nbr_seed[border].astype(np.int32)
+            flags.reshape(-1)[rows] = BORDER
+        out.append((seeds, flags))
     return out

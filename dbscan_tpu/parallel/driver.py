@@ -68,7 +68,6 @@ def clear_compile_cache() -> None:
     configurations or meshes."""
     _compiled_block.cache_clear()
     _compiled_banded_p1.cache_clear()
-    _compiled_banded_p2.cache_clear()
 
 
 @functools.lru_cache(maxsize=256)
@@ -156,7 +155,17 @@ def _compiled_banded_p1(
         )
 
     def block(pts, msk, rel, sp, sl, cx):
-        return lax.map(one, (pts, msk, rel, sp, sl, cx), batch_size=batch)
+        counts, core, bits = lax.map(
+            one, (pts, msk, rel, sp, sl, cx), batch_size=batch
+        )
+        # Global core count via all-reduce over the mesh: keeps one real
+        # ICI collective in the banded production program (the dense path
+        # has its own, _compiled_block) so multichip dryruns validate the
+        # communication path even for all-banded workloads.
+        ncore = jnp.sum(core, dtype=jnp.int32)
+        if mesh is not None:
+            ncore = lax.psum(ncore, PARTS_AXIS)
+        return counts, core, bits, ncore
 
     if mesh is None:
         return jax.jit(block)
@@ -166,53 +175,7 @@ def _compiled_banded_p1(
             block,
             mesh=mesh,
             in_specs=(spec,) * 6,
-            out_specs=(spec, spec, spec),
-        )
-    )
-
-
-@functools.lru_cache(maxsize=256)
-def _compiled_banded_p2(
-    eps: float,
-    engine: str,
-    slab: int,
-    batch: Optional[int],
-    mesh,
-):
-    """Jitted per-group phase-2 executor for the banded engine (border
-    algebra from host cell labels); cached like :func:`_compiled_block`."""
-    from dbscan_tpu.ops.banded import banded_phase2
-
-    def one(args):
-        pts, msk, fold, core, counts, labels, rel, sp, sl = args
-        r = banded_phase2(
-            pts, msk, fold, core, counts, labels, rel, sp, sl,
-            eps, engine=engine, slab=slab,
-        )
-        return r.seed_labels, r.flags
-
-    def block(pts, msk, fold, core, counts, labels, rel, sp, sl):
-        seeds, flags = lax.map(
-            one, (pts, msk, fold, core, counts, labels, rel, sp, sl),
-            batch_size=batch,
-        )
-        # Global core count via all-reduce over the mesh: keeps one real
-        # ICI collective in the banded production program so multichip
-        # dryruns validate the communication path.
-        ncore = jnp.sum(flags == CORE, dtype=jnp.int32)
-        if mesh is not None:
-            ncore = lax.psum(ncore, PARTS_AXIS)
-        return seeds, flags, ncore
-
-    if mesh is None:
-        return jax.jit(block)
-    spec = PartitionSpec(PARTS_AXIS)
-    return jax.jit(
-        jax.shard_map(
-            block,
-            mesh=mesh,
-            in_specs=(spec,) * 9,
-            out_specs=(spec, spec, PartitionSpec()),
+            out_specs=(spec, spec, spec, PartitionSpec()),
         )
     )
 
@@ -276,54 +239,37 @@ def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh):
     )
 
 
-def _dispatch_banded_p2(group, cfg: DBSCANConfig, mesh, core, counts, labels):
-    """Async phase-2 dispatch: border algebra from host cell labels.
-
-    core/counts are the phase-1 DEVICE arrays (no retransfer); labels is
-    the host [P, B] int32 from cellgraph.compute_cell_labels.
-    """
-    ext = group.banded
-    fn = _compiled_banded_p2(
-        float(cfg.eps),
-        cfg.engine.value,
-        int(ext.slab),
-        _banded_batch(group, mesh),
-        mesh,
-    )
-    return fn(
-        group.points, group.mask, ext.fold_idx, core, counts, labels,
-        ext.rel_starts, ext.spans, ext.slab_starts,
-    )
-
-
 def _local_ids_flat(
     inst_part: np.ndarray, inst_seed: np.ndarray, n_parts: int, max_b: int
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Dense 1-based per-partition cluster ids from flat per-instance seed
     labels.
 
     Returns (loc [M] int32 local ids with 0 for noise, uniq_part [K],
-    uniq_loc [K]) where (uniq_part, uniq_loc) enumerate all distinct
-    non-noise (partition, local id) pairs sorted by partition then id — the
-    deterministic ordering we feed the global-id assignment (reference
-    localClusterIds, DBSCAN.scala:194-200). Seed row-index order IS the
-    reference's fold order, so dense-ranking seeds per partition reproduces
-    its sequential numbering.
+    uniq_loc [K], labeled [M] bool, inv [L] ranks into the unique table for
+    the labeled instances) where (uniq_part, uniq_loc) enumerate all
+    distinct non-noise (partition, local id) pairs sorted by partition then
+    id — the deterministic ordering we feed the global-id assignment
+    (reference localClusterIds, DBSCAN.scala:194-200). Seed row-index order
+    IS the reference's fold order, so dense-ranking seeds per partition
+    reproduces its sequential numbering. `inv` lets the caller map labeled
+    instances straight to per-unique-cluster tables (global ids) without
+    re-searching.
     """
     labeled = inst_seed != SEED_NONE
     loc = np.zeros(len(inst_part), dtype=np.int32)
-    key = np.where(
-        labeled, inst_part.astype(np.int64) * (max_b + 1) + inst_seed, -1
-    )
-    flat = key[labeled]
-    if flat.size == 0:
-        return loc, np.empty(0, np.int64), np.empty(0, np.int32)
-    u = np.unique(flat)
+    key = inst_part[labeled] * np.int64(max_b + 1) + inst_seed[labeled]
+    if key.size == 0:
+        return (
+            loc, np.empty(0, np.int64), np.empty(0, np.int32), labeled,
+            np.empty(0, np.int64),
+        )
+    u, inv, _ = geo.group_by_int_key(key, max_key=n_parts * (max_b + 1))
     upart = u // (max_b + 1)
     first = np.searchsorted(upart, np.arange(n_parts))
     uloc = (np.arange(len(u)) - first[upart] + 1).astype(np.int32)
-    loc[labeled] = uloc[np.searchsorted(u, flat)]
-    return loc, upart, uloc
+    loc[labeled] = uloc[inv]
+    return loc, upart, uloc, labeled, inv
 
 
 def _band_membership(
@@ -535,9 +481,10 @@ def train_arrays(
     inst_inner = geo.almost_contains(margins.inner[inst_part], pts_of_inst)
     t0 = _mark("overlap_host_s", t0)
 
-    # host cell-graph components for the banded groups (blocks on their
-    # phase 1), then phase-2 dispatch — the reference's driver-side graph
-    # pass (DBSCANGraph.scala:70-87) transplanted to per-partition scale
+    # host finalize for the banded groups (blocks on their device sweeps):
+    # cell-graph components, seeds, and the full border algebra — the
+    # reference's driver-side graph pass (DBSCANGraph.scala:70-87)
+    # transplanted to per-partition scale (parallel/cellgraph.py)
     if cellmeta is not None:
         b_idx = [i for i, (g, _) in enumerate(pending) if g.banded is not None]
         if b_idx:
@@ -549,12 +496,14 @@ def train_arrays(
                 )
                 for i in b_idx
             ]
-            labels_list = cellgraph.compute_cell_labels(p1_np, cellmeta)
-            for i, labels in zip(b_idx, labels_list):
-                g, (counts_d, core_d, _bits) = pending[i]
+            finalized = cellgraph.finalize_from_bits(
+                p1_np, cellmeta, cfg.engine.value
+            )
+            for i, (seeds_np, flags_np) in zip(b_idx, finalized):
+                g = pending[i][0]
                 pending[i] = (
                     g,
-                    _dispatch_banded_p2(g, cfg, mesh, core_d, counts_d, labels),
+                    (seeds_np, flags_np, int((flags_np == CORE).sum())),
                 )
     t0 = _mark("cellcc_s", t0)
 
@@ -570,7 +519,9 @@ def train_arrays(
     t0 = _mark("device_s", t0)
 
     # 6. local ids + deterministic cluster enumeration.
-    inst_loc, upart, uloc = _local_ids_flat(inst_part, inst_seed, p_true, max_b)
+    inst_loc, upart, uloc, labeled_inst, inst_urank = _local_ids_flat(
+        inst_part, inst_seed, p_true, max_b
+    )
 
     # 7. merge: union clusters observed on the same halo point.
 
@@ -608,16 +559,11 @@ def train_arrays(
         (mapping[key] for key in ordered), dtype=np.int64, count=len(ordered)
     )
 
-    # per-instance global id (0 for noise)
+    # per-instance global id (0 for noise): labeled instances carry their
+    # rank into the unique table already (no re-search)
     inst_gid = np.zeros(len(inst_part), dtype=np.int32)
-    labeled_inst = inst_loc > 0
-    if labeled_inst.any():
-        # key into the sorted unique (part, loc) table
-        b = max_b
-        ukey = upart * (b + 2) + uloc
-        ikey = inst_part[labeled_inst] * (b + 2) + inst_loc[labeled_inst]
-        pos = np.searchsorted(ukey, ikey)
-        inst_gid[labeled_inst] = gid_of_u[pos]
+    if inst_urank.size:
+        inst_gid[labeled_inst] = gid_of_u[inst_urank]
 
     # 8. relabel + dedup into per-point outputs.
     res_cluster = np.zeros(n, dtype=np.int32)

@@ -176,4 +176,11 @@ def test_packer_invariants(rng):
     core = np.asarray(core_dev)
     center = 1 << (binning.BANDED_WIN // 2)
     assert ((bits[core] & center) == center).all()
-    assert (bits[~core] == 0).all()
+    # bits are computed for every valid row (non-core rows feed the border
+    # algebra): a row reports a nonzero mask iff it has an eps-adjacent core
+    full = np.zeros(len(pts), np.int64)
+    full[g.point_idx[0][valid]] = bits[valid]
+    core_full = np.zeros(len(pts), bool)
+    core_full[g.point_idx[0][valid]] = core[valid]
+    has_core_nbr = (d2 <= 0.3 * 0.3) @ core_full > 0
+    assert ((full[sub] != 0) == has_core_nbr).all()
