@@ -402,13 +402,15 @@ def bucketize_banded(
         return groups, max_b, empty_meta
 
     cell = float(eps) * FINE_CELL_FACTOR
-    xy = np.asarray(pts, dtype=np.float64)[point_idx]
     # Cells must be computed from the coordinates the DEVICE sees: under f32
     # the cast can move a point across a float64 cell boundary (quantization
     # error scales with |coordinate|, far beyond the arithmetic-rounding
     # margins), and a run built from the float64 cell would miss pairs the
-    # device's distance test accepts.
-    xy_dev = xy.astype(dtype).astype(np.float64)
+    # device's distance test accepts. Cast the whole [N, 2] input once and
+    # gather in the device dtype — the gathered array IS the group-buffer
+    # payload, so the per-group astype disappears too.
+    xy_store = np.asarray(pts, dtype=dtype)[point_idx]
+    xy_dev = xy_store.astype(np.float64)
     inv_cell = 1.0 / cell
     ox = outer[part_ids, 0]
     oy = outer[part_ids, 1]
@@ -437,7 +439,7 @@ def bucketize_banded(
     gkey_s = gkey[order]
     fold_s = (order - part_start[p_s]).astype(np.int64)
     ptidx_s = point_idx[order]
-    xy_s = xy[order]
+    xy_s = xy_store[order]
     cx_s = cx[order]
     slots_s = np.arange(m_tot, dtype=np.int64) - part_start[p_s]
 
@@ -601,7 +603,7 @@ def bucketize_banded(
         gi = np.flatnonzero(banded_inst & (row_of_part[p_s] >= 0))
         rows = row_of_part[p_s[gi]]
         slots = slots_s[gi]
-        buf[rows, slots] = xy_s[gi].astype(dtype)
+        buf[rows, slots] = xy_s[gi]
         mask[rows, slots] = True
         idx[rows, slots] = ptidx_s[gi]
         fold_b[rows, slots] = fold_s[gi]

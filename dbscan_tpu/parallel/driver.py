@@ -272,6 +272,51 @@ def _local_ids_flat(
     return loc, upart, uloc, labeled, inv
 
 
+def _classify_instances(
+    pts: np.ndarray,
+    cells: np.ndarray,
+    cell_inv: np.ndarray,
+    rects_int: np.ndarray,
+    margins: binning.Margins,
+    inst_part: np.ndarray,
+    inst_ptidx: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Merge-band membership per point + inner membership per instance,
+    resolved per 2eps-CELL wherever the cell decides it outright.
+
+    With inner = main shrunk by eps and cells of side 2eps, a cell whose
+    indices sit >= 1 inside the partition's integer rect on every side is
+    STRICTLY interior to inner for all its points, with half a cell of
+    float slack (2eps*(x+1) - (main.x + eps) = eps >> ulp): its instances
+    are inner and never band, no float test needed. Only the boundary-ring
+    cells (a perimeter minority) take the exact per-point containment
+    tests (DBSCAN.scala:161-167, :304-315). Returns (band_any [N] bool,
+    inst_inner [M] bool aligned with inst_part/inst_ptidx).
+    """
+    icell = cell_inv[inst_ptidx]
+    ccx = cells[icell, 0]
+    ccy = cells[icell, 1]
+    r = rects_int[inst_part]  # [M, 4] int
+    interior = (
+        (ccx >= r[:, 0] + 1)
+        & (ccx <= r[:, 2] - 2)
+        & (ccy >= r[:, 1] + 1)
+        & (ccy <= r[:, 3] - 2)
+    )
+    inst_inner = interior.copy()
+    band_any = np.zeros(len(pts), dtype=bool)
+    ring = np.flatnonzero(~interior)
+    if ring.size:
+        rp = inst_part[ring]
+        ri = inst_ptidx[ring]
+        p2 = pts[ri][:, :2]
+        inn = geo.almost_contains(margins.inner[rp], p2)
+        inst_inner[ring] = inn
+        inband = geo.contains_point(margins.main[rp], p2) & ~inn
+        band_any[ri[inband]] = True
+    return band_any, inst_inner
+
+
 def _band_membership(
     points: np.ndarray,
     margins: binning.Margins,
@@ -475,10 +520,16 @@ def train_arrays(
     ) if pending else np.empty(0, np.int64)
 
     # device-independent merge precomputation (overlaps the device window)
-    band_any = _band_membership(pts, margins, part_ids, point_idx)
+    if rects_int is not None:
+        band_any, inst_inner = _classify_instances(
+            pts, cells, cell_inv, rects_int, margins, inst_part, inst_ptidx
+        )
+    else:
+        band_any = _band_membership(pts, margins, part_ids, point_idx)
+        inst_inner = geo.almost_contains(
+            margins.inner[inst_part], pts[inst_ptidx][:, :2]
+        )
     cand = band_any[inst_ptidx]
-    pts_of_inst = pts[inst_ptidx][:, :2]
-    inst_inner = geo.almost_contains(margins.inner[inst_part], pts_of_inst)
     t0 = _mark("overlap_host_s", t0)
 
     # host finalize for the banded groups (blocks on their device sweeps):

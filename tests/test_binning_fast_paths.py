@@ -1,0 +1,99 @@
+"""Equivalence tests for the optimized host fast paths.
+
+Each optimization here replaced a slower exact formulation and must be
+BIT-IDENTICAL to it: grid-pruned halo duplication vs the brute-force
+containment product, and the radix group-by-key vs np.unique.
+"""
+
+import numpy as np
+import pytest
+
+from dbscan_tpu.ops import geometry as geo
+from dbscan_tpu.parallel import binning, partitioner
+
+
+def _setup(pts, eps, maxpp):
+    cell = 2 * eps
+    cells, counts, inv = geo.cell_histogram_int(pts, cell)
+    parts = partitioner.partition_cells(cells, counts, maxpp)
+    rects_int = np.stack([r for r, _ in parts])
+    margins = binning.build_margins(rects_int, cell, eps)
+    return cells, inv, rects_int, margins
+
+
+CASES = {
+    "blobs": (0.3, 250),
+    "tight-eps": (0.05, 100),
+    "coarse": (1.0, 64),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_duplicate_points_grid_matches_bruteforce(name, rng):
+    eps, maxpp = CASES[name]
+    pts = np.concatenate(
+        [rng.normal(rng.uniform(-15, 15, 2), rng.uniform(0.2, 1.5), (1500, 2))
+         for _ in range(4)]
+    )
+    # exercise the snap quirk: exact negative multiples of the cell size
+    pts[:20] = np.round(pts[:20] / (2 * eps)) * (2 * eps)
+    cells, inv, rects_int, margins = _setup(pts, eps, maxpp)
+    ref_p, ref_i = binning.duplicate_points(pts, margins.outer)
+    got_p, got_i = binning.duplicate_points_grid(
+        pts, cells, inv, rects_int, margins.outer
+    )
+    np.testing.assert_array_equal(ref_p, got_p)
+    np.testing.assert_array_equal(ref_i, got_i)
+
+
+def test_duplicate_points_grid_single_partition(rng):
+    pts = rng.normal(0, 0.5, (500, 2))
+    cells, inv, rects_int, margins = _setup(pts, 0.3, 10**9)
+    got_p, got_i = binning.duplicate_points_grid(
+        pts, cells, inv, rects_int, margins.outer
+    )
+    np.testing.assert_array_equal(got_p, np.zeros(len(pts), np.int64))
+    np.testing.assert_array_equal(got_i, np.arange(len(pts)))
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_classify_instances_matches_exact(name, rng):
+    """The integer-cell interior shortcut must reproduce the exact
+    band/inner formulation bit-for-bit (off-by-ones here misclassify only
+    boundary-ring points, which end-to-end tests can miss)."""
+    from dbscan_tpu.parallel.driver import _band_membership, _classify_instances
+
+    eps, maxpp = CASES[name]
+    pts = np.concatenate(
+        [rng.normal(rng.uniform(-15, 15, 2), rng.uniform(0.2, 1.5), (1500, 2))
+         for _ in range(4)]
+    )
+    pts[:20] = np.round(pts[:20] / (2 * eps)) * (2 * eps)
+    cells, inv, rects_int, margins = _setup(pts, eps, maxpp)
+    part_ids, point_idx = binning.duplicate_points_grid(
+        pts, cells, inv, rects_int, margins.outer
+    )
+    band_fast, inner_fast = _classify_instances(
+        pts, cells, inv, rects_int, margins, part_ids, point_idx
+    )
+    band_ref = _band_membership(pts, margins, part_ids, point_idx)
+    inner_ref = geo.almost_contains(
+        margins.inner[part_ids], pts[point_idx][:, :2]
+    )
+    np.testing.assert_array_equal(band_fast, band_ref)
+    np.testing.assert_array_equal(inner_fast, inner_ref)
+
+
+def test_group_by_int_key_matches_unique(rng):
+    for max_key, dtype in [(10**4, np.int64), (2**40, np.int64)]:
+        key = rng.integers(0, max_key, size=50_000).astype(dtype)
+        uniq, inverse, counts = geo.group_by_int_key(key, max_key=max_key)
+        ref_u, ref_inv, ref_c = np.unique(
+            key, return_inverse=True, return_counts=True
+        )
+        np.testing.assert_array_equal(uniq, ref_u)
+        np.testing.assert_array_equal(inverse, ref_inv)
+        np.testing.assert_array_equal(counts, ref_c)
+    # empty input
+    u, i, c = geo.group_by_int_key(np.empty(0, np.int64))
+    assert u.size == i.size == c.size == 0
