@@ -169,6 +169,17 @@ def duplicate_points_grid(
     return part_ids[order], point_idx[order]
 
 
+def _segment_indices(seg_starts: np.ndarray, seg_counts: np.ndarray) -> np.ndarray:
+    """Concatenated index ranges [start, start+count) per segment —
+    O(sum counts), the slice-based replacement for per-group O(M)
+    membership scans in the packers."""
+    total = int(seg_counts.sum())
+    if total == 0:
+        return np.empty(0, np.int64)
+    off = np.repeat(seg_starts - (np.cumsum(seg_counts) - seg_counts), seg_counts)
+    return np.arange(total, dtype=np.int64) + off
+
+
 def _ladder_width(c: int, bucket_multiple: int) -> int:
     """Round a count up along a ~1.5x geometric ladder of bucket_multiple
     multiples (q in 1, 1.5, 2, 3, 4, 6, ... when it divides evenly): area
@@ -273,11 +284,12 @@ def bucketize_grouped(
         pid = np.full(p_pad, -1, dtype=np.int64)
         pid[: len(sel_parts)] = sel_parts
         if part_ids.size:
-            row_of_part = np.full(n_parts, -1, dtype=np.int64)
-            row_of_part[sel_parts] = np.arange(len(sel_parts))
-            in_group = row_of_part[part_ids] >= 0
-            gi = np.flatnonzero(in_group)
-            rows = row_of_part[part_ids[gi]]
+            # each partition's instances are one contiguous range of the
+            # (partition-sorted) instance list: index by slices, NOT by an
+            # O(M) membership scan per group (that made packing scale with
+            # groups x instances)
+            gi = _segment_indices(starts[sel_parts], counts[sel_parts])
+            rows = np.repeat(np.arange(len(sel_parts)), counts[sel_parts])
             slots = slot_all[gi]
             buf[rows, slots] = pts[point_idx[gi]].astype(dtype)
             mask[rows, slots] = True
@@ -575,7 +587,6 @@ def bucketize_banded(
             groups.extend(dgroups)
             max_b = max(max_b, dmax)
 
-    banded_inst = use_banded[p_s]
     sstart32 = sstart.astype(np.int32)
     for b, w in sorted(
         set(zip(widths_band[use_banded].tolist(), win[use_banded].tolist()))
@@ -598,10 +609,10 @@ def bucketize_banded(
         cx_b = np.zeros((p_pad, b), dtype=np.int32)
         cgid_b = np.full((p_pad, b), -1, dtype=np.int64)
 
-        row_of_part = np.full(n_parts, -1, dtype=np.int64)
-        row_of_part[sel_parts] = np.arange(len(sel_parts))
-        gi = np.flatnonzero(banded_inst & (row_of_part[p_s] >= 0))
-        rows = row_of_part[p_s[gi]]
+        # slice each partition's contiguous instance range (instances are
+        # partition-sorted) — no O(M) membership scan per group
+        gi = _segment_indices(part_start[sel_parts], counts[sel_parts])
+        rows = np.repeat(np.arange(len(sel_parts)), counts[sel_parts])
         slots = slots_s[gi]
         buf[rows, slots] = xy_s[gi]
         mask[rows, slots] = True

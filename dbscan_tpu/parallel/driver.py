@@ -309,7 +309,7 @@ def _classify_instances(
     if ring.size:
         rp = inst_part[ring]
         ri = inst_ptidx[ring]
-        p2 = pts[ri][:, :2]
+        p2 = pts[ri, :2]  # index both axes at once: no [M, D] intermediate
         inn = geo.almost_contains(margins.inner[rp], p2)
         inst_inner[ring] = inn
         inband = geo.contains_point(margins.main[rp], p2) & ~inn
@@ -527,7 +527,7 @@ def train_arrays(
     else:
         band_any = _band_membership(pts, margins, part_ids, point_idx)
         inst_inner = geo.almost_contains(
-            margins.inner[inst_part], pts[inst_ptidx][:, :2]
+            margins.inner[inst_part], pts[inst_ptidx, :2]
         )
     cand = band_any[inst_ptidx]
     t0 = _mark("overlap_host_s", t0)

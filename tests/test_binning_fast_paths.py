@@ -85,7 +85,7 @@ def test_classify_instances_matches_exact(name, rng):
 
 
 def test_group_by_int_key_matches_unique(rng):
-    for max_key, dtype in [(10**4, np.int64), (2**40, np.int64)]:
+    for max_key, dtype in [(10**4, np.int32), (10**4, np.int64), (2**40, np.int64)]:
         key = rng.integers(0, max_key, size=50_000).astype(dtype)
         uniq, inverse, counts = geo.group_by_int_key(key, max_key=max_key)
         ref_u, ref_inv, ref_c = np.unique(
