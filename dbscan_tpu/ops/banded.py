@@ -193,3 +193,70 @@ def banded_phase1(
         bits_block, (*blocks, cx_blocks), batch_size=batch
     ).reshape(-1)
     return counts, core, bits
+
+
+# Block length of the device-side segmented-OR scan (and the alignment the
+# packer's group sizes already satisfy: BANDED_BLOCK is a multiple of it).
+SCAN_BLOCK = 512
+
+
+@jax.jit
+def banded_postpass(cores, bitses, segflags):
+    """Device-side compaction of the banded phase-1 outputs.
+
+    The link from device to host runs at ~15 MB/s with ~0.5 s latency per
+    pull (TPU-over-tunnel), so pulling the raw per-slot (core, bits) arrays
+    — 5 bytes/slot across every group — dominated the whole pipeline at
+    10M+ points. This pass reduces what crosses the link to three compact
+    artifacts, leaving the big arrays resident in HBM:
+
+      1. ``core_packed``: the concatenated core mask bit-packed 8x
+         (np.unpackbits-compatible big-endian weights; jnp.packbits itself
+         lowers to seconds-slow code here, a dot with bit weights doesn't);
+      2. ``srb``: a BLOCK-LOCAL segmented bitwise-OR scan of the core rows'
+         window bitmasks — segments are fine-grid cells (``segflags`` marks
+         cell starts), with an implicit reset every SCAN_BLOCK slots. The
+         scan value at a cell's last slot ORs its core members back to
+         max(cell start, block start); the host combines the few cells that
+         span blocks by also gathering the intervening block-end slots
+         (parallel/cellgraph.py::cell_layout). Block-local Hillis-Steele
+         unrolls to log2(SCAN_BLOCK) elementwise steps — milliseconds,
+         where lax.associative_scan over the flat array took minutes;
+      3. ``bits_flat``: the concatenated raw bitmasks, kept on DEVICE as
+         the source for a targeted gather of border-candidate rows only.
+
+    Args:
+      cores: tuple of [P, B] bool phase-1 core masks (one per group).
+      bitses: tuple of [P, B] int32 phase-1 window bitmasks.
+      segflags: tuple of [P*B] bool cell-start flags in flat row-major
+        order (host-computed from the packer's cell ids).
+
+    Returns (core_packed [M/8] uint8, srb [M] int32, bits_flat [M] int32)
+    over the flat concatenation of all groups (M is a multiple of
+    SCAN_BLOCK: every group's P*B is).
+    """
+    core_flat = jnp.concatenate([c.reshape(-1) for c in cores])
+    bits_flat = jnp.concatenate([b.reshape(-1) for b in bitses])
+    f = jnp.concatenate(list(segflags)).reshape(-1, SCAN_BLOCK)
+    v = jnp.where(core_flat, bits_flat, 0).reshape(-1, SCAN_BLOCK)
+    d = 1
+    while d < SCAN_BLOCK:
+        fp = jnp.pad(f, ((0, 0), (d, 0)), constant_values=True)[:, :SCAN_BLOCK]
+        vp = jnp.pad(v, ((0, 0), (d, 0)))[:, :SCAN_BLOCK]
+        v = jnp.where(f, v, v | vp)
+        f = f | fp
+        d *= 2
+    w = jnp.array([128, 64, 32, 16, 8, 4, 2, 1], jnp.int32)
+    packed = (
+        (core_flat.reshape(-1, 8).astype(jnp.int32) * w)
+        .sum(axis=1)
+        .astype(jnp.uint8)
+    )
+    return packed, v.reshape(-1), bits_flat
+
+
+@jax.jit
+def gather_flat(src, idx):
+    """One-array device gather: compact readout of ``idx`` positions from a
+    resident flat array (indices host-padded; out-of-range clamps)."""
+    return src[idx]

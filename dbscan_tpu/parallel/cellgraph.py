@@ -59,6 +59,202 @@ def _connected_components(n: int, u: np.ndarray, v: np.ndarray) -> np.ndarray:
             comp = nxt
 
 
+def cell_layout(groups: Sequence[BucketGroup]) -> dict:
+    """Flat-concat layout metadata for the compact-transfer path.
+
+    Over the flat row-major concatenation of the given banded groups'
+    [P, B] buffers, computes (all host-side, from the packer's cell ids):
+    ``segflags`` per group ([P*B] bool, True where a new cell run starts —
+    the device scan's segment resets), ``starts`` per group (positions of
+    cell starts within the group's flat view, for min-reduceat), ``bases``
+    per group (flat offset), and the per-cell OR readout plan: the device
+    scan resets every SCAN_BLOCK slots, so a cell spanning blocks k0..k1
+    needs its partial ORs gathered at each intervening block's last slot
+    plus its own end slot — ``or_pos`` [G] flat gather positions grouped
+    per cell, ``or_starts`` [U'] reduceat offsets into it, ``or_gid`` [U']
+    the cell id per run. Cells are contiguous in the cell-sorted layout and
+    never span rows, so run boundaries are exactly the id-change positions.
+    """
+    from dbscan_tpu.ops.banded import SCAN_BLOCK
+
+    segflags, starts_l, bases, valid_l = [], [], [], []
+    st_all, en_all, gid_all = [], [], []
+    base = 0
+    for g in groups:
+        cg = g.banded.cell_gid.reshape(-1)
+        m = cg.size
+        prev = np.empty(m, dtype=np.int64)
+        prev[0] = -2
+        prev[1:] = cg[:-1]
+        flags = cg != prev
+        segflags.append(flags)
+        valid = cg >= 0
+        valid_l.append(valid)
+        st = np.flatnonzero(flags & valid)
+        nxt = np.empty(m, dtype=np.int64)
+        nxt[-1] = -2
+        nxt[:-1] = cg[1:]
+        en = np.flatnonzero(valid & (cg != nxt))
+        starts_l.append(st)
+        st_all.append(st + base)
+        en_all.append(en + base)
+        gid_all.append(cg[en])
+        bases.append(base)
+        base += m
+    if st_all:
+        st_f = np.concatenate(st_all)
+        en_f = np.concatenate(en_all)
+        gid = np.concatenate(gid_all)
+    else:
+        st_f = en_f = gid = np.empty(0, np.int64)
+    # per-cell gather runs: block ends of k0..k1-1, then the cell end
+    nsp = en_f // SCAN_BLOCK - st_f // SCAN_BLOCK + 1
+    or_starts = np.concatenate([[0], np.cumsum(nsp)])[:-1]
+    total_g = int(nsp.sum())
+    rel = np.arange(total_g, dtype=np.int64) - np.repeat(or_starts, nsp)
+    or_pos = np.minimum(
+        (np.repeat(st_f // SCAN_BLOCK, nsp) + rel + 1) * SCAN_BLOCK - 1,
+        np.repeat(en_f, nsp),
+    )
+    return {
+        "segflags": segflags,
+        "starts": starts_l,
+        "bases": bases,
+        "total": base,
+        "validflat": (
+            np.concatenate(valid_l) if valid_l else np.empty(0, bool)
+        ),
+        "or_pos": or_pos,
+        "or_starts": or_starts,
+        "or_gid": gid,
+    }
+
+
+def finalize_compact(
+    groups: Sequence[BucketGroup],
+    layout: dict,
+    meta: CellGraphMeta,
+    engine: str,
+    core_flat: np.ndarray,
+    or_vals: np.ndarray,
+    border_pos: np.ndarray,
+    border_bits: np.ndarray,
+) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Seed labels + flags from the COMPACT device pulls (see
+    ops/banded.py::banded_postpass) — same outputs as
+    :func:`finalize_from_bits`, but from M/8 + U + K transferred elements
+    instead of 5 bytes per slot.
+
+    core_flat: [M] bool unpacked core mask over the flat concat;
+    or_vals: [G] int32 scan values gathered at ``layout["or_pos"]`` (the
+    per-cell partial ORs, combined here via reduceat);
+    border_pos/border_bits: flat positions and raw bitmasks of the valid
+    non-core slots (the border candidates).
+    """
+    if engine not in ("naive", "archery"):
+        raise ValueError(f"unknown engine {engine!r}")
+    n_cells = meta.n_cells
+    win_iota = np.arange(BANDED_WIN)
+
+    cellor_by_gid = np.zeros(n_cells, dtype=np.int64)
+    if len(or_vals):
+        cellor_by_gid[layout["or_gid"]] = np.bitwise_or.reduceat(
+            or_vals.astype(np.int64), layout["or_starts"]
+        )
+
+    # cell -> min core fold (the cluster seed value should that cell's
+    # component win): min-reduceat over each group's flat folds, INF at
+    # non-core slots; segments [start_i, start_{i+1}) may cross padding
+    # slots, which hold INF and never win.
+    cell_fold_min = np.full(n_cells, _INF, dtype=np.int64)
+    for g, st, base in zip(groups, layout["starts"], layout["bases"]):
+        if st.size == 0:
+            continue
+        cg = g.banded.cell_gid.reshape(-1)
+        folds = np.where(
+            core_flat[base : base + cg.size],
+            g.banded.fold_idx.reshape(-1).astype(np.int64),
+            _INF,
+        )
+        cell_fold_min[cg[st]] = np.minimum.reduceat(folds, st)
+
+    # cell-graph edges from the per-cell OR masks (core rows only, by
+    # construction of the device scan's input).
+    src = np.flatnonzero(cellor_by_gid)
+    if src.size:
+        unp = (cellor_by_gid[src][:, None] >> win_iota) & 1
+        ei, ej = np.nonzero(unp)
+        u = src[ei]
+        v = meta.wintab[u, ej].astype(np.int64)
+    else:
+        u = np.empty(0, np.int64)
+        v = np.empty(0, np.int64)
+    comp = _connected_components(n_cells, u, v)
+
+    seed_of_cell = np.full(n_cells, _INF, dtype=np.int64)
+    if n_cells:
+        order = np.argsort(comp, kind="stable")
+        cs = comp[order]
+        f3 = np.flatnonzero(np.r_[True, cs[1:] != cs[:-1]])
+        compmin = np.minimum.reduceat(cell_fold_min[order], f3)
+        seed_of_cell[order] = np.repeat(compmin, np.diff(np.r_[f3, n_cells]))
+
+    # border algebra on the candidate rows only (engine semantics as in
+    # finalize_from_bits).
+    bsel = border_bits != 0
+    bpos = border_pos[bsel]
+    bbits = border_bits[bsel]
+    if bpos.size:
+        # group of each candidate via the flat bases
+        gidx = (
+            np.searchsorted(
+                np.asarray(layout["bases"] + [layout["total"]]), bpos, "right"
+            )
+            - 1
+        )
+        cg_b = np.empty(len(bpos), dtype=np.int64)
+        fold_b = np.empty(len(bpos), dtype=np.int64)
+        for i, (g, base) in enumerate(zip(groups, layout["bases"])):
+            sel = gidx == i
+            if not sel.any():
+                continue
+            loc = bpos[sel] - base
+            cg_b[sel] = g.banded.cell_gid.reshape(-1)[loc]
+            fold_b[sel] = g.banded.fold_idx.reshape(-1)[loc]
+        unp = ((bbits[:, None] >> win_iota) & 1).astype(bool)
+        wt = meta.wintab[cg_b]
+        cand = np.where(unp, seed_of_cell[np.maximum(wt, 0)], _INF)
+        nbr_seed = cand.min(axis=1)
+        if engine == "naive":
+            adopted = nbr_seed < fold_b
+        else:
+            adopted = np.ones(len(nbr_seed), dtype=bool)
+        bpos = bpos[adopted]
+        bseed = nbr_seed[adopted]
+    else:
+        bseed = np.empty(0, np.int64)
+
+    out: List[Tuple[np.ndarray, np.ndarray]] = []
+    for g, base in zip(groups, layout["bases"]):
+        shape = g.banded.cell_gid.shape
+        m = shape[0] * shape[1]
+        cg = g.banded.cell_gid.reshape(-1)
+        valid = cg >= 0
+        seeds = np.full(m, SEED_NONE, dtype=np.int32)
+        flags = np.full(m, NOT_FLAGGED, dtype=np.int8)
+        flags[valid] = NOISE
+        csel = valid & core_flat[base : base + m]
+        seeds[csel] = seed_of_cell[cg[csel]].astype(np.int32)
+        flags[csel] = CORE
+        insel = (bpos >= base) & (bpos < base + m)
+        if insel.any():
+            loc = bpos[insel] - base
+            seeds[loc] = bseed[insel].astype(np.int32)
+            flags[loc] = BORDER
+        out.append((seeds.reshape(shape), flags.reshape(shape)))
+    return out
+
+
 def finalize_from_bits(
     banded_results: Sequence[Tuple[BucketGroup, np.ndarray, np.ndarray]],
     meta: CellGraphMeta,

@@ -239,6 +239,16 @@ def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh):
     )
 
 
+def _pad_idx(pos: np.ndarray) -> np.ndarray:
+    """Pad a flat gather-index vector up the bucket ladder so the device
+    gather compiles once per rung, not per data-dependent count (padding
+    gathers position 0; callers slice the pull back to the true length)."""
+    k = binning._ladder_width(max(1, len(pos)), 4096)
+    out = np.zeros(k, dtype=np.int32)
+    out[: len(pos)] = pos
+    return out
+
+
 def _local_ids_flat(
     inst_part: np.ndarray, inst_seed: np.ndarray, n_parts: int, max_b: int
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
@@ -510,6 +520,36 @@ def train_arrays(
             pending.append((g, _dispatch_partitions(g, cfg, mesh)))
         else:
             pending.append((g, _dispatch_banded_p1(g, cfg, mesh)))
+    t0 = _mark("dispatch_s", t0)
+
+    # Compact-transfer path (single-chip): the device link runs at ~15 MB/s
+    # down with ~0.5 s/pull latency, so instead of pulling every group's
+    # [P, B] core+bits (5 B/slot), dispatch a device post-pass that packs
+    # the core mask 8x and scans per-cell OR masks, keeping the raw bits in
+    # HBM for a targeted border-candidate gather (ops/banded.py
+    # ::banded_postpass). Under a mesh the outputs are sharded and the full
+    # pull path below stays in effect.
+    compact = None
+    if cellmeta is not None and mesh is None:
+        b_idx = [i for i, (g, _) in enumerate(pending) if g.banded is not None]
+        if b_idx:
+            from dbscan_tpu.ops.banded import banded_postpass, gather_flat
+
+            bgroups = [pending[i][0] for i in b_idx]
+            layout = cellgraph.cell_layout(bgroups)
+            core_packed, srb, bits_flat = banded_postpass(
+                tuple(pending[i][1][1] for i in b_idx),
+                tuple(pending[i][1][2] for i in b_idx),
+                tuple(jnp.asarray(f) for f in layout["segflags"]),
+            )
+            core_packed.copy_to_host_async()
+            orvals_dev = gather_flat(
+                srb, jnp.asarray(_pad_idx(layout["or_pos"]))
+            )
+            orvals_dev.copy_to_host_async()
+            compact = (b_idx, bgroups, layout, core_packed, bits_flat, orvals_dev)
+            del srb
+    t0 = _mark("postdispatch_s", t0)
 
     slotmaps = [np.nonzero(g.point_idx >= 0) for g, _ in pending]
     inst_part = np.concatenate(
@@ -536,7 +576,31 @@ def train_arrays(
     # cell-graph components, seeds, and the full border algebra — the
     # reference's driver-side graph pass (DBSCANGraph.scala:70-87)
     # transplanted to per-partition scale (parallel/cellgraph.py)
-    if cellmeta is not None:
+    if compact is not None:
+        b_idx, bgroups, layout, core_packed, bits_flat, orvals_dev = compact
+        total = layout["total"]
+        tc = time.perf_counter()
+        core_host = np.asarray(core_packed)
+        tc = _mark("cellcc_pull_core_s", tc)
+        core_flat = np.unpackbits(core_host, count=total).astype(bool)
+        border_pos = np.flatnonzero(layout["validflat"] & ~core_flat)
+        bbits_dev = gather_flat(bits_flat, jnp.asarray(_pad_idx(border_pos)))
+        bbits_dev.copy_to_host_async()
+        tc = _mark("cellcc_borderidx_s", tc)
+        or_vals = np.asarray(orvals_dev)[: len(layout["or_pos"])]
+        border_bits = np.asarray(bbits_dev)[: len(border_pos)]
+        tc = _mark("cellcc_pull_rest_s", tc)
+        finalized = cellgraph.finalize_compact(
+            bgroups, layout, cellmeta, cfg.engine.value, core_flat,
+            or_vals, border_pos, border_bits,
+        )
+        _mark("cellcc_host_s", tc)
+        for i, (seeds_np, flags_np) in zip(b_idx, finalized):
+            g = pending[i][0]
+            pending[i] = (
+                g, (seeds_np, flags_np, int((flags_np == CORE).sum()))
+            )
+    elif cellmeta is not None:
         b_idx = [i for i, (g, _) in enumerate(pending) if g.banded is not None]
         if b_idx:
             p1_np = [
