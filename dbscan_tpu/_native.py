@@ -1,0 +1,315 @@
+"""Loader + numpy-fallback wrappers for the native host kernels.
+
+The driver's host phases (binning, packing, merge) are the pipeline
+bottleneck on the 1-vCPU deployment host; ``native/hostops.cpp`` provides
+fused single-pass C++ versions of the hottest primitives. This module
+builds the shared library on first use with the system ``g++`` (cached
+next to the source, keyed on mtime), binds it via ctypes, and exposes
+numpy-identical wrappers that silently fall back to numpy when the
+toolchain or library is unavailable (or when ``DBSCAN_TPU_NATIVE=0``).
+
+No pybind11 in the image, hence ctypes over raw C ABI; every wrapper's
+output is bit-identical to its numpy fallback (tests/test_native.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO, "native", "hostops.cpp")
+_SO = os.path.join(_REPO, "native", "build", "hostops.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+_I64P = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_U32P = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+_U64P = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    # compile to a per-pid temp then rename: os.replace is atomic, so a
+    # concurrent importer can never dlopen a half-written library
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-march=native",
+        "-o", tmp, _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("native hostops build failed (%s); using numpy", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None (numpy fallbacks apply)."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    if os.environ.get("DBSCAN_TPU_NATIVE", "1") == "0" or not os.path.exists(
+        _SRC
+    ):
+        _lib_failed = True
+        return None
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
+        _SRC
+    ):
+        if not _build():
+            _lib_failed = True
+            return None
+    try:
+        L = ctypes.CDLL(_SO)
+        L.radix_argsort_u32.argtypes = [_U32P, ctypes.c_int64, _I64P]
+        L.radix_argsort_u64.argtypes = [_U64P, ctypes.c_int64, _I64P]
+        L.group_by_u32.argtypes = [
+            _U32P, ctypes.c_int64, _I64P, _I64P, _U32P, _I64P,
+        ]
+        L.group_by_u32.restype = ctypes.c_int64
+        L.group_by_u64.argtypes = [
+            _U64P, ctypes.c_int64, _I64P, _I64P, _U64P, _I64P,
+        ]
+        L.group_by_u64.restype = ctypes.c_int64
+        _F64P = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        _U8P = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        L.classify_instances.argtypes = [
+            _F64P, ctypes.c_int64, _I64P, _I64P, _I64P, _F64P, _F64P,
+            _I64P, _I64P, ctypes.c_int64, _U8P, _U8P,
+        ]
+        L.fine_cells.argtypes = [
+            _F64P, ctypes.c_int64, _I64P, _I64P, _F64P, ctypes.c_double,
+            ctypes.c_int64, ctypes.c_uint8, _I64P, _I64P, _I64P, _I64P,
+        ]
+        _I32P = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        _F32P = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        pack_common = [
+            _I64P, ctypes.c_int64, ctypes.c_int64, _I64P, _I64P, _I64P,
+            _F64P, ctypes.c_int64, _I64P, _I64P, _I64P, _I32P, _I32P,
+            _I32P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ]
+        pack_outs = [_U8P, _I64P, _I32P, _I32P, _I32P, _I32P, _I64P]
+        L.pack_banded_group_f32.argtypes = pack_common + [_F32P] + pack_outs
+        L.pack_banded_group_f64.argtypes = pack_common + [_F64P] + pack_outs
+        L.cell_runs.argtypes = [
+            _I64P, ctypes.c_int64, _U8P, _U8P, _I64P, _I64P, _I64P,
+        ]
+        L.cell_runs.restype = ctypes.c_int64
+    except OSError as e:
+        logger.warning("native hostops load failed (%s); using numpy", e)
+        _lib_failed = True
+        return None
+    _lib = L
+    return _lib
+
+
+def argsort_ints(keys: np.ndarray) -> np.ndarray:
+    """Stable argsort of a NONNEGATIVE integer array — drop-in for
+    ``np.argsort(keys, kind="stable")`` at the driver's sort sites (all of
+    which construct nonnegative packed keys by design)."""
+    keys = np.ascontiguousarray(keys)
+    L = lib()
+    if L is None or keys.size == 0:
+        return np.argsort(keys, kind="stable")
+    order = np.empty(keys.size, dtype=np.int64)
+    if keys.dtype in (np.int32, np.uint32):
+        L.radix_argsort_u32(keys.view(np.uint32), keys.size, order)
+    elif keys.dtype in (np.int64, np.uint64):
+        L.radix_argsort_u64(keys.view(np.uint64), keys.size, order)
+    else:
+        return np.argsort(keys, kind="stable")
+    return order
+
+
+def classify_instances(
+    pts: np.ndarray,
+    cells: np.ndarray,
+    cell_inv: np.ndarray,
+    rects_int: np.ndarray,
+    inner: np.ndarray,
+    main_r: np.ndarray,
+    inst_part: np.ndarray,
+    inst_ptidx: np.ndarray,
+):
+    """Fused native _classify_instances pass. Returns (band_any [N] bool,
+    inst_inner [M] bool) or None when the native library is unavailable
+    (caller runs the numpy formulation)."""
+    L = lib()
+    if L is None:
+        return None
+    pts = np.ascontiguousarray(pts, dtype=np.float64)
+    m = len(inst_part)
+    band_any = np.zeros(len(pts), dtype=np.uint8)
+    inst_inner = np.zeros(m, dtype=np.uint8)
+    L.classify_instances(
+        pts, pts.shape[1],
+        np.ascontiguousarray(cells, dtype=np.int64),
+        np.ascontiguousarray(cell_inv, dtype=np.int64),
+        np.ascontiguousarray(rects_int, dtype=np.int64),
+        np.ascontiguousarray(inner, dtype=np.float64),
+        np.ascontiguousarray(main_r, dtype=np.float64),
+        np.ascontiguousarray(inst_part, dtype=np.int64),
+        np.ascontiguousarray(inst_ptidx, dtype=np.int64),
+        m, band_any, inst_inner,
+    )
+    return band_any.view(bool), inst_inner.view(bool)
+
+
+def fine_cells(
+    pts: np.ndarray,
+    point_idx: np.ndarray,
+    part_ids: np.ndarray,
+    outer: np.ndarray,
+    inv_cell: float,
+    n_parts: int,
+    is_f32: bool,
+):
+    """Fused fine-grid cell assignment (bucketize_banded's gather + cast +
+    snap + reduceat-maxima block). Returns (cx [M], cy [M], cxmax [P],
+    cymax [P]) int64 arrays, or None when the native library is
+    unavailable."""
+    L = lib()
+    if L is None:
+        return None
+    pts = np.ascontiguousarray(pts, dtype=np.float64)
+    m = len(point_idx)
+    cx = np.empty(m, dtype=np.int64)
+    cy = np.empty(m, dtype=np.int64)
+    cxmax = np.zeros(n_parts, dtype=np.int64)
+    cymax = np.zeros(n_parts, dtype=np.int64)
+    L.fine_cells(
+        pts, pts.shape[1],
+        np.ascontiguousarray(point_idx, dtype=np.int64),
+        np.ascontiguousarray(part_ids, dtype=np.int64),
+        np.ascontiguousarray(outer, dtype=np.float64),
+        float(inv_cell), m, 1 if is_f32 else 0, cx, cy, cxmax, cymax,
+    )
+    return cx, cy, cxmax, cymax
+
+
+def pack_banded_group(
+    sel_parts: np.ndarray,
+    p_pad: int,
+    part_start: np.ndarray,
+    counts: np.ndarray,
+    order: np.ndarray,
+    pts: np.ndarray,
+    point_idx: np.ndarray,
+    cx_s: np.ndarray,
+    cell_rank: np.ndarray,
+    ustarts: np.ndarray,
+    uspans: np.ndarray,
+    sstart: np.ndarray,
+    maxnb: int,
+    tblock: int,
+    b: int,
+    dtype,
+):
+    """Fused banded group packing: one sequential native pass fills all
+    eight group buffers (see native/hostops.cpp). Returns (buf, mask, idx,
+    fold, st, sp, cx, cgid) or None when the native library is
+    unavailable."""
+    L = lib()
+    if L is None or dtype not in (np.float32, np.float64):
+        return None
+    if ustarts.shape[1] != 5 or uspans.shape[1] != 5:
+        raise ValueError(
+            "native packer is compiled for BANDED_ROWS == 5 window rows; "
+            f"got run tables of width {ustarts.shape[1]}"
+        )
+    pts = np.ascontiguousarray(pts, dtype=np.float64)
+    buf = np.empty((p_pad, b, 2), dtype=dtype)
+    mask = np.empty((p_pad, b), dtype=np.uint8)
+    idx = np.empty((p_pad, b), dtype=np.int64)
+    fold = np.empty((p_pad, b), dtype=np.int32)
+    st = np.empty((p_pad, b, 5), dtype=np.int32)
+    sp = np.empty((p_pad, b, 5), dtype=np.int32)
+    cxb = np.empty((p_pad, b), dtype=np.int32)
+    cgid = np.empty((p_pad, b), dtype=np.int64)
+    fn = (
+        L.pack_banded_group_f32
+        if dtype == np.float32
+        else L.pack_banded_group_f64
+    )
+    fn(
+        np.ascontiguousarray(sel_parts, dtype=np.int64),
+        len(sel_parts), p_pad,
+        np.ascontiguousarray(part_start, dtype=np.int64),
+        np.ascontiguousarray(counts, dtype=np.int64),
+        np.ascontiguousarray(order, dtype=np.int64),
+        pts, pts.shape[1],
+        np.ascontiguousarray(point_idx, dtype=np.int64),
+        np.ascontiguousarray(cx_s, dtype=np.int64),
+        np.ascontiguousarray(cell_rank, dtype=np.int64),
+        np.ascontiguousarray(ustarts, dtype=np.int32),
+        np.ascontiguousarray(uspans, dtype=np.int32),
+        np.ascontiguousarray(sstart, dtype=np.int32),
+        maxnb, tblock, b,
+        buf, mask, idx, fold, st, sp, cxb, cgid,
+    )
+    return buf, mask.view(bool), idx, fold, st, sp, cxb, cgid
+
+
+def cell_runs(cg: np.ndarray):
+    """Fused cell-run extraction over a flat cell-id array. Returns
+    (segflags [m] bool, valid [m] bool, starts [U], ends [U], gids [U])
+    or None when the native library is unavailable."""
+    L = lib()
+    if L is None:
+        return None
+    cg = np.ascontiguousarray(cg, dtype=np.int64)
+    m = cg.size
+    segflags = np.empty(m, dtype=np.uint8)
+    valid = np.empty(m, dtype=np.uint8)
+    st = np.empty(m, dtype=np.int64)
+    en = np.empty(m, dtype=np.int64)
+    gid = np.empty(m, dtype=np.int64)
+    u = L.cell_runs(cg, m, segflags, valid, st, en, gid)
+    return segflags.view(bool), valid.view(bool), st[:u], en[:u], gid[:u]
+
+
+def group_by_ints(keys: np.ndarray):
+    """Fused group-by of nonnegative integer keys.
+
+    Returns (uniq [U] ascending, inverse [N] dense rank per element,
+    counts [U], order [N] stable sort order) — the native superset of
+    ops/geometry.py::group_by_int_key (which discards ``order``). None if
+    the native library is unavailable (caller falls back to numpy).
+    """
+    keys = np.ascontiguousarray(keys)
+    L = lib()
+    if L is None:
+        return None
+    n = keys.size
+    order = np.empty(n, dtype=np.int64)
+    inverse = np.empty(n, dtype=np.int64)
+    uniq = np.empty(n, dtype=keys.dtype)
+    counts = np.empty(n, dtype=np.int64)
+    if keys.dtype in (np.int32, np.uint32):
+        u = L.group_by_u32(
+            keys.view(np.uint32), n, order, inverse,
+            uniq.view(np.uint32), counts,
+        )
+    elif keys.dtype in (np.int64, np.uint64):
+        u = L.group_by_u64(
+            keys.view(np.uint64), n, order, inverse,
+            uniq.view(np.uint64), counts,
+        )
+    else:
+        return None
+    return uniq[:u], inverse, counts[:u], order

@@ -128,6 +128,16 @@ def group_by_int_key(key, max_key=None):
         return empty, empty.copy(), empty.copy()
     if max_key is not None and max_key < np.iinfo(np.int32).max:
         key = key.astype(np.int32)
+    from dbscan_tpu import _native
+
+    # the native radix path sorts unsigned: nonnegative keys only (a
+    # one-pass min costs ~ms and keeps the ascending-uniq contract when a
+    # caller ever passes raw negative cell indices)
+    if key.min() >= 0:
+        native = _native.group_by_ints(key)
+        if native is not None:
+            uniq, inverse, counts, _ = native
+            return uniq.astype(np.int64), inverse, counts
     order = np.argsort(key, kind="stable")
     ks = key[order]
     newu = np.r_[True, ks[1:] != ks[:-1]]

@@ -15,6 +15,7 @@ from typing import NamedTuple, Tuple
 
 import numpy as np
 
+from dbscan_tpu import _native
 from dbscan_tpu.ops import geometry as geo
 
 
@@ -163,8 +164,8 @@ def duplicate_points_grid(
     part_ids = np.concatenate([part_base.astype(np.int64), halo_part])
     point_idx = np.concatenate([np.arange(n, dtype=np.int64), halo_pt])
     okey = part_ids * n + point_idx
-    order = np.argsort(
-        okey.astype(np.int32) if p_n * n < 2**31 else okey, kind="stable"
+    order = _native.argsort_ints(
+        okey.astype(np.int32) if p_n * n < 2**31 else okey
     )
     return part_ids[order], point_idx[order]
 
@@ -226,7 +227,10 @@ class BucketGroup(NamedTuple):
     original row index (-1 padding); part_ids: [P_pad] ORIGINAL partition id
     per row, -1 on padding partitions. banded: window metadata when this
     group runs the banded engine (points then sit in cell-sorted order),
-    None for the dense engine (fold order).
+    None for the dense engine (fold order). row_counts: [P_pad] valid-slot
+    count per row — valid slots are always the prefix 0..count-1, so the
+    driver derives its instance maps arithmetically instead of scanning
+    the [P_pad, B] masks.
     """
 
     points: np.ndarray
@@ -234,6 +238,7 @@ class BucketGroup(NamedTuple):
     point_idx: np.ndarray
     part_ids: np.ndarray
     banded: BandedExtras = None
+    row_counts: np.ndarray = None
 
 
 def bucketize_grouped(
@@ -294,7 +299,9 @@ def bucketize_grouped(
             buf[rows, slots] = pts[point_idx[gi]].astype(dtype)
             mask[rows, slots] = True
             idx[rows, slots] = point_idx[gi]
-        groups.append(BucketGroup(buf, mask, idx, pid))
+        rc = np.zeros(p_pad, dtype=np.int64)
+        rc[: len(sel_parts)] = counts[sel_parts]
+        groups.append(BucketGroup(buf, mask, idx, pid, row_counts=rc))
         max_b = max(max_b, b)
     return groups, max_b
 
@@ -418,25 +425,52 @@ def bucketize_banded(
     # the cast can move a point across a float64 cell boundary (quantization
     # error scales with |coordinate|, far beyond the arithmetic-rounding
     # margins), and a run built from the float64 cell would miss pairs the
-    # device's distance test accepts. Cast the whole [N, 2] input once and
-    # gather in the device dtype — the gathered array IS the group-buffer
-    # payload, so the per-group astype disappears too.
-    xy_store = np.asarray(pts, dtype=dtype)[point_idx]
-    xy_dev = xy_store.astype(np.float64)
+    # device's distance test accepts.
     inv_cell = 1.0 / cell
-    ox = outer[part_ids, 0]
-    oy = outer[part_ids, 1]
-    cx = np.maximum(np.floor((xy_dev[:, 0] - ox) * inv_cell), 0.0).astype(np.int64)
-    cy = np.maximum(np.floor((xy_dev[:, 1] - oy) * inv_cell), 0.0).astype(np.int64)
+    # one contiguous float64 view shared by every native call below (the
+    # wrappers' ascontiguousarray then no-ops instead of copying per group)
+    pts64 = (
+        np.ascontiguousarray(pts, dtype=np.float64)
+        if dtype in (np.float32, np.float64)
+        else None
+    )
+    native = (
+        _native.fine_cells(
+            pts64, point_idx, part_ids, outer, inv_cell, n_parts,
+            dtype == np.float32,
+        )
+        if pts64 is not None
+        else None
+    )
+    if native is not None:
+        # fused pass: cast + snap + per-partition maxima in one sweep; the
+        # group packer below reads coordinates straight from `pts` with the
+        # same cast, so the [M, 2] device-dtype gather disappears entirely
+        cx, cy, cxmax, cymax = native
+        xy_store = None
+    else:
+        # Cast the whole [N, 2] input once and gather in the device dtype —
+        # the gathered array IS the group-buffer payload, so the per-group
+        # astype disappears too.
+        xy_store = np.asarray(pts, dtype=dtype)[point_idx]
+        xy_dev = xy_store.astype(np.float64)
+        ox = outer[part_ids, 0]
+        oy = outer[part_ids, 1]
+        cx = np.maximum(
+            np.floor((xy_dev[:, 0] - ox) * inv_cell), 0.0
+        ).astype(np.int64)
+        cy = np.maximum(
+            np.floor((xy_dev[:, 1] - oy) * inv_cell), 0.0
+        ).astype(np.int64)
 
-    # Segment maxima via reduceat (instances are sorted by partition).
-    nz = counts > 0
-    segs = part_start[nz]
-    cxmax = np.zeros(n_parts, dtype=np.int64)
-    cymax = np.zeros(n_parts, dtype=np.int64)
-    if segs.size:
-        cxmax[nz] = np.maximum.reduceat(cx, segs)
-        cymax[nz] = np.maximum.reduceat(cy, segs)
+        # Segment maxima via reduceat (instances are sorted by partition).
+        nz = counts > 0
+        segs = part_start[nz]
+        cxmax = np.zeros(n_parts, dtype=np.int64)
+        cymax = np.zeros(n_parts, dtype=np.int64)
+        if segs.size:
+            cxmax[nz] = np.maximum.reduceat(cx, segs)
+            cymax[nz] = np.maximum.reduceat(cy, segs)
     stride = cxmax + 5  # cx + 4 < stride: row windows never wrap
     big = int((stride * (cymax + 3)).max()) + 1  # per-partition key space
     gkey = part_ids * big + cy * stride[part_ids] + cx
@@ -446,14 +480,15 @@ def bucketize_banded(
     # on one packed integer key radix-sorts in O(M); int32 keys when they fit.
     if n_parts * big < np.iinfo(np.int32).max:
         gkey = gkey.astype(np.int32)
-    order = np.argsort(gkey, kind="stable")
-    p_s = part_ids[order]
+    order = _native.argsort_ints(gkey)
     gkey_s = gkey[order]
-    fold_s = (order - part_start[p_s]).astype(np.int64)
-    ptidx_s = point_idx[order]
-    xy_s = xy_store[order]
     cx_s = cx[order]
-    slots_s = np.arange(m_tot, dtype=np.int64) - part_start[p_s]
+    if native is None:
+        p_s = part_ids[order]
+        fold_s = (order - part_start[p_s]).astype(np.int64)
+        ptidx_s = point_idx[order]
+        xy_s = xy_store[order]
+        slots_s = np.arange(m_tot, dtype=np.int64) - part_start[p_s]
 
     # Unique occupied cells (globally numbered: sorted by partition then
     # row-major key) and per-instance cell rank.
@@ -461,7 +496,7 @@ def bucketize_banded(
     cell_first = np.flatnonzero(newcell)  # [U] first sorted pos per cell
     ukey = gkey_s[cell_first].astype(np.int64)  # [U]
     cell_rank = np.cumsum(newcell) - 1  # [M] global cell id per instance
-    upart = p_s[cell_first]
+    upart = part_ids[order[cell_first]]
     ustride = stride[upart]
     useg_start = part_start[upart]
     useg_end = useg_start + counts[upart]
@@ -596,46 +631,61 @@ def bucketize_banded(
         )
         nb = b // t
         p_pad = max(1, math.ceil(len(sel_parts) / pad_parts_to) * pad_parts_to)
-        buf = np.zeros((p_pad, b, 2), dtype=dtype)
-        mask = np.zeros((p_pad, b), dtype=bool)
-        idx = np.full((p_pad, b), -1, dtype=np.int64)
         pid = np.full(p_pad, -1, dtype=np.int64)
         pid[: len(sel_parts)] = sel_parts
-        iota = np.arange(b, dtype=np.int32)
-        fold_b = np.broadcast_to(iota, (p_pad, b)).copy()
-        st_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=np.int32)
-        sp_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=np.int32)
         sl_b = np.zeros((p_pad, nb, BANDED_ROWS), dtype=np.int32)
-        cx_b = np.zeros((p_pad, b), dtype=np.int32)
-        cgid_b = np.full((p_pad, b), -1, dtype=np.int64)
-
-        # slice each partition's contiguous instance range (instances are
-        # partition-sorted) — no O(M) membership scan per group
-        gi = _segment_indices(part_start[sel_parts], counts[sel_parts])
-        rows = np.repeat(np.arange(len(sel_parts)), counts[sel_parts])
-        slots = slots_s[gi]
-        buf[rows, slots] = xy_s[gi]
-        mask[rows, slots] = True
-        idx[rows, slots] = ptidx_s[gi]
-        fold_b[rows, slots] = fold_s[gi]
-        # Per-instance run start within its slab (invalid runs pin to 0
-        # rather than inheriting a meaningless negative offset); gathered
-        # from unique-cell space only for this group's instances.
-        cr = cell_rank[gi]
-        sp_i = uspans[cr]
-        st_i = ustarts[cr] - sstart32[p_s[gi] * maxnb + slots_s[gi] // t]
-        st_b[rows, slots] = np.where(sp_i > 0, st_i, 0)
-        sp_b[rows, slots] = sp_i
-        cx_b[rows, slots] = cx_s[gi]
-        cgid_b[rows, slots] = cell_rank[gi]
         sl_b[: len(sel_parts)] = sstart[
             sel_parts[:, None] * maxnb + np.arange(nb)[None, :]
         ]
+        packed = (
+            _native.pack_banded_group(
+                sel_parts, p_pad, part_start, counts, order, pts64,
+                point_idx, cx_s, cell_rank, ustarts, uspans, sstart32,
+                maxnb, t, b, dtype,
+            )
+            if native is not None
+            else None
+        )
+        if packed is not None:
+            buf, mask, idx, fold_b, st_b, sp_b, cx_b, cgid_b = packed
+        else:
+            buf = np.zeros((p_pad, b, 2), dtype=dtype)
+            mask = np.zeros((p_pad, b), dtype=bool)
+            idx = np.full((p_pad, b), -1, dtype=np.int64)
+            iota = np.arange(b, dtype=np.int32)
+            fold_b = np.broadcast_to(iota, (p_pad, b)).copy()
+            st_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=np.int32)
+            sp_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=np.int32)
+            cx_b = np.zeros((p_pad, b), dtype=np.int32)
+            cgid_b = np.full((p_pad, b), -1, dtype=np.int64)
 
+            # slice each partition's contiguous instance range (instances
+            # are partition-sorted) — no O(M) membership scan per group
+            gi = _segment_indices(part_start[sel_parts], counts[sel_parts])
+            rows = np.repeat(np.arange(len(sel_parts)), counts[sel_parts])
+            slots = slots_s[gi]
+            buf[rows, slots] = xy_s[gi]
+            mask[rows, slots] = True
+            idx[rows, slots] = ptidx_s[gi]
+            fold_b[rows, slots] = fold_s[gi]
+            # Per-instance run start within its slab (invalid runs pin to
+            # 0 rather than inheriting a meaningless negative offset);
+            # gathered from unique-cell space for this group's instances.
+            cr = cell_rank[gi]
+            sp_i = uspans[cr]
+            st_i = ustarts[cr] - sstart32[p_s[gi] * maxnb + slots_s[gi] // t]
+            st_b[rows, slots] = np.where(sp_i > 0, st_i, 0)
+            sp_b[rows, slots] = sp_i
+            cx_b[rows, slots] = cx_s[gi]
+            cgid_b[rows, slots] = cell_rank[gi]
+
+        rc = np.zeros(p_pad, dtype=np.int64)
+        rc[: len(sel_parts)] = counts[sel_parts]
         groups.append(
             BucketGroup(
                 buf, mask, idx, pid,
                 BandedExtras(fold_b, st_b, sp_b, sl_b, int(w), cx_b, cgid_b),
+                row_counts=rc,
             )
         )
         max_b = max(max_b, b)

@@ -77,28 +77,35 @@ def cell_layout(groups: Sequence[BucketGroup]) -> dict:
     """
     from dbscan_tpu.ops.banded import SCAN_BLOCK
 
+    from dbscan_tpu import _native
+
     segflags, starts_l, bases, valid_l = [], [], [], []
     st_all, en_all, gid_all = [], [], []
     base = 0
     for g in groups:
         cg = g.banded.cell_gid.reshape(-1)
         m = cg.size
-        prev = np.empty(m, dtype=np.int64)
-        prev[0] = -2
-        prev[1:] = cg[:-1]
-        flags = cg != prev
+        native = _native.cell_runs(cg)
+        if native is not None:
+            flags, valid, st, en, gid = native
+        else:
+            prev = np.empty(m, dtype=np.int64)
+            prev[0] = -2
+            prev[1:] = cg[:-1]
+            flags = cg != prev
+            valid = cg >= 0
+            st = np.flatnonzero(flags & valid)
+            nxt = np.empty(m, dtype=np.int64)
+            nxt[-1] = -2
+            nxt[:-1] = cg[1:]
+            en = np.flatnonzero(valid & (cg != nxt))
+            gid = cg[en]
         segflags.append(flags)
-        valid = cg >= 0
         valid_l.append(valid)
-        st = np.flatnonzero(flags & valid)
-        nxt = np.empty(m, dtype=np.int64)
-        nxt[-1] = -2
-        nxt[:-1] = cg[1:]
-        en = np.flatnonzero(valid & (cg != nxt))
         starts_l.append(st)
         st_all.append(st + base)
         en_all.append(en + base)
-        gid_all.append(cg[en])
+        gid_all.append(gid)
         bases.append(base)
         base += m
     if st_all:

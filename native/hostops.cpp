@@ -1,0 +1,361 @@
+// Native host kernels for the tpu-dbscan driver's CPU-bound phases.
+//
+// The reference's host-side work runs on the JVM inside Spark's driver and
+// executors (DBSCAN.scala:91-106, :179-285); ours runs in-process around the
+// TPU dispatch. At 10M+ points the numpy formulation of these phases is
+// multi-pass and allocation-heavy; the kernels here are single-pass, fused
+// loops over the same data. Single-threaded by design: the deployment host
+// for the driver is a 1-vCPU machine, so threads would only add overhead.
+//
+// Exposed via a tiny C ABI loaded with ctypes (dbscan_tpu/_native.py); every
+// entry point has a numpy fallback, and outputs are bit-identical to the
+// numpy path (asserted by tests/test_native.py).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// Stable LSD radix argsort of NONNEGATIVE integer keys, 8-bit digits.
+// All per-digit histograms are gathered in one pre-pass so passes whose
+// digit is constant across the array (the common case for small key
+// spaces in wide types) are skipped entirely.
+template <typename K>
+void radix_argsort_impl(const K* keys, int64_t n, int64_t* order) {
+  constexpr int NB = static_cast<int>(sizeof(K));
+  if (n <= 0) return;
+  std::vector<int64_t> hist(static_cast<size_t>(NB) * 256, 0);
+  for (int64_t i = 0; i < n; ++i) {
+    K k = keys[i];
+    for (int b = 0; b < NB; ++b) {
+      hist[static_cast<size_t>(b) * 256 + ((k >> (8 * b)) & 0xFF)]++;
+    }
+  }
+  std::vector<K> kbuf1(keys, keys + n), kbuf2(n);
+  std::vector<int64_t> obuf1(n), obuf2(n);
+  for (int64_t i = 0; i < n; ++i) obuf1[i] = i;
+  K* ks = kbuf1.data();
+  K* kd = kbuf2.data();
+  int64_t* os = obuf1.data();
+  int64_t* od = obuf2.data();
+  for (int b = 0; b < NB; ++b) {
+    int64_t* h = &hist[static_cast<size_t>(b) * 256];
+    bool trivial = false;
+    for (int v = 0; v < 256; ++v) {
+      if (h[v] == n) {
+        trivial = true;
+        break;
+      }
+    }
+    if (trivial) continue;
+    int64_t offs[256];
+    int64_t acc = 0;
+    for (int v = 0; v < 256; ++v) {
+      offs[v] = acc;
+      acc += h[v];
+    }
+    const int sh = 8 * b;
+    for (int64_t i = 0; i < n; ++i) {
+      const int v = static_cast<int>((ks[i] >> sh) & 0xFF);
+      const int64_t p = offs[v]++;
+      kd[p] = ks[i];
+      od[p] = os[i];
+    }
+    K* tk = ks;
+    ks = kd;
+    kd = tk;
+    int64_t* to = os;
+    os = od;
+    od = to;
+  }
+  std::memcpy(order, os, static_cast<size_t>(n) * sizeof(int64_t));
+}
+
+// Fused group-by of nonnegative keys: stable sort order, dense rank per
+// input element, unique keys and their counts — the native counterpart of
+// ops/geometry.py::group_by_int_key (one sort + one linear pass instead of
+// argsort / fancy-gather / diff / cumsum numpy round trips).
+template <typename K>
+int64_t group_by_impl(const K* keys, int64_t n, int64_t* order,
+                      int64_t* inverse, K* uniq, int64_t* counts) {
+  if (n <= 0) return 0;
+  radix_argsort_impl<K>(keys, n, order);
+  int64_t u = -1;
+  K prev = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const K k = keys[order[i]];
+    if (u < 0 || k != prev) {
+      ++u;
+      uniq[u] = k;
+      counts[u] = 0;
+      prev = k;
+    }
+    counts[u]++;
+    inverse[order[i]] = u;
+  }
+  return u + 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+void radix_argsort_u32(const uint32_t* keys, int64_t n, int64_t* order) {
+  radix_argsort_impl<uint32_t>(keys, n, order);
+}
+
+void radix_argsort_u64(const uint64_t* keys, int64_t n, int64_t* order) {
+  radix_argsort_impl<uint64_t>(keys, n, order);
+}
+
+int64_t group_by_u32(const uint32_t* keys, int64_t n, int64_t* order,
+                     int64_t* inverse, uint32_t* uniq, int64_t* counts) {
+  return group_by_impl<uint32_t>(keys, n, order, inverse, uniq, counts);
+}
+
+int64_t group_by_u64(const uint64_t* keys, int64_t n, int64_t* order,
+                     int64_t* inverse, uint64_t* uniq, int64_t* counts) {
+  return group_by_impl<uint64_t>(keys, n, order, inverse, uniq, counts);
+}
+
+// Fused merge-band / inner-membership classification
+// (parallel/driver.py::_classify_instances): one pass over the halo
+// instance list replacing five [M]-wide numpy gathers plus the
+// boundary-ring float tests (DBSCAN.scala:161-167, :304-315). A cell
+// whose integer indices sit >= 1 inside the partition rect on every side
+// is strictly interior to inner (cells are 2eps wide, inner = main
+// shrunk by eps); only boundary-ring instances take the exact float
+// containment tests.
+void classify_instances(
+    const double* pts,        // [N, D] row-major; first two columns used
+    int64_t pts_stride,       // D (elements per row)
+    const int64_t* cells,     // [C, 2] unique cell indices
+    const int64_t* cell_inv,  // [N] cell row per point
+    const int64_t* rects,     // [P, 4] integer partition rects
+    const double* inner,      // [P, 4] float inner rects
+    const double* main_r,     // [P, 4] float main rects
+    const int64_t* inst_part, // [M]
+    const int64_t* inst_pt,   // [M]
+    int64_t m,
+    uint8_t* band_any,        // [N] out (must be zeroed by caller)
+    uint8_t* inst_inner       // [M] out
+) {
+  for (int64_t j = 0; j < m; ++j) {
+    const int64_t p = inst_part[j];
+    const int64_t i = inst_pt[j];
+    const int64_t c = cell_inv[i];
+    const int64_t ccx = cells[2 * c];
+    const int64_t ccy = cells[2 * c + 1];
+    const int64_t* r = rects + 4 * p;
+    const bool interior = ccx >= r[0] + 1 && ccx <= r[2] - 2 &&
+                          ccy >= r[1] + 1 && ccy <= r[3] - 2;
+    if (interior) {
+      inst_inner[j] = 1;
+      continue;
+    }
+    const double px = pts[pts_stride * i];
+    const double py = pts[pts_stride * i + 1];
+    const double* in = inner + 4 * p;
+    const bool inn =
+        in[0] < px && px < in[2] && in[1] < py && py < in[3];
+    inst_inner[j] = inn ? 1 : 0;
+    if (!inn) {
+      const double* mn = main_r + 4 * p;
+      if (mn[0] <= px && px <= mn[2] && mn[1] <= py && py <= mn[3]) {
+        band_any[i] = 1;
+      }
+    }
+  }
+}
+
+// Fused fine-grid cell assignment for the banded packer
+// (parallel/binning.py::bucketize_banded): per halo instance, cast the
+// point to the device dtype (when f32 — cells must be computed from the
+// coordinates the DEVICE sees), snap to the fine grid of the owning
+// partition's outer rect, and fold per-partition cx/cy maxima — one pass
+// replacing a gather + cast + four [M]-wide numpy passes + reduceat.
+// cxmax/cymax must be zero-initialized by the caller.
+void fine_cells(
+    const double* pts,         // [N, D] row-major
+    int64_t pts_stride,        // D
+    const int64_t* point_idx,  // [M]
+    const int64_t* part_ids,   // [M]
+    const double* outer,       // [P, 4] grown rects
+    double inv_cell,
+    int64_t m,
+    uint8_t is_f32,            // device dtype is float32
+    int64_t* cx,               // [M] out
+    int64_t* cy,               // [M] out
+    int64_t* cxmax,            // [P] out (zeroed by caller)
+    int64_t* cymax             // [P] out (zeroed by caller)
+) {
+  for (int64_t j = 0; j < m; ++j) {
+    const int64_t pi = point_idx[j];
+    const int64_t p = part_ids[j];
+    double xd = pts[pts_stride * pi];
+    double yd = pts[pts_stride * pi + 1];
+    if (is_f32) {
+      xd = static_cast<double>(static_cast<float>(xd));
+      yd = static_cast<double>(static_cast<float>(yd));
+    }
+    double fx = std::floor((xd - outer[4 * p]) * inv_cell);
+    double fy = std::floor((yd - outer[4 * p + 1]) * inv_cell);
+    const int64_t cxi = fx > 0.0 ? static_cast<int64_t>(fx) : 0;
+    const int64_t cyi = fy > 0.0 ? static_cast<int64_t>(fy) : 0;
+    cx[j] = cxi;
+    cy[j] = cyi;
+    if (cxi > cxmax[p]) cxmax[p] = cxi;
+    if (cyi > cymax[p]) cymax[p] = cyi;
+  }
+}
+
+// Fused cell-run extraction (parallel/cellgraph.py::cell_layout): one
+// pass over a group's flat cell-id array yielding the device scan's
+// segment-start flags, the validity mask, and the compacted (start, end,
+// id) run table — cells are contiguous runs, padding is -1. Returns the
+// number of runs; st/en/gid need capacity for m entries.
+int64_t cell_runs(const int64_t* cg, int64_t m, uint8_t* segflags,
+                  uint8_t* valid, int64_t* st, int64_t* en, int64_t* gid) {
+  int64_t u = 0;
+  int64_t prev = -2;
+  for (int64_t i = 0; i < m; ++i) {
+    const int64_t c = cg[i];
+    const bool flag = c != prev;
+    segflags[i] = flag ? 1 : 0;
+    valid[i] = c >= 0 ? 1 : 0;
+    if (flag) {
+      if (prev >= 0) en[u - 1] = i - 1;
+      if (c >= 0) {
+        st[u] = i;
+        gid[u] = c;
+        ++u;
+      }
+    }
+    prev = c;
+  }
+  if (prev >= 0) en[u - 1] = m - 1;
+  return u;
+}
+
+}  // extern "C"
+
+namespace {
+
+// Fused banded group packer (parallel/binning.py::bucketize_banded's
+// per-group block): writes all eight [P_g, B, ...] device/host buffers in
+// ONE sequential pass over the group's sorted instance ranges, with the
+// sort indirection applied on the fly — replacing ~10 fancy-indexed numpy
+// scatters (plus their np.full initializations) per group. Instances of
+// partition p occupy sorted positions [part_start[p], part_start[p] +
+// counts[p]) and slots 0..count-1 of row g, so padding is a pure suffix
+// fill per row. Buffers may arrive uninitialized (np.empty).
+template <typename T>
+void pack_banded_group_impl(
+    const int64_t* sel_parts,  // [G] original partition id per row
+    int64_t n_sel, int64_t p_pad,
+    const int64_t* part_start, // [P] first sorted position per partition
+    const int64_t* counts,     // [P]
+    const int64_t* order,      // [M] sort order (sorted pos -> instance)
+    const double* pts,         // [N, D]
+    int64_t pts_stride,
+    const int64_t* point_idx,  // [M] instance -> original point row
+    const int64_t* cx_s,       // [M] fine cx in SORTED order
+    const int64_t* cell_rank,  // [M] global cell id in SORTED order
+    const int32_t* ustarts,    // [U, 5] per-cell run starts
+    const int32_t* uspans,     // [U, 5] per-cell run lengths
+    const int32_t* sstart,     // [P * maxnb, 5] slab origins
+    int64_t maxnb, int64_t tblock, int64_t b,
+    T* buf,                    // [p_pad, b, 2] out
+    uint8_t* mask,             // [p_pad, b] out
+    int64_t* idx,              // [p_pad, b] out
+    int32_t* fold_b,           // [p_pad, b] out
+    int32_t* st_b,             // [p_pad, b, 5] out
+    int32_t* sp_b,             // [p_pad, b, 5] out
+    int32_t* cx_b,             // [p_pad, b] out
+    int64_t* cgid_b            // [p_pad, b] out
+) {
+  for (int64_t g = 0; g < p_pad; ++g) {
+    const int64_t p = g < n_sel ? sel_parts[g] : -1;
+    const int64_t cnt = p >= 0 ? counts[p] : 0;
+    const int64_t s0 = p >= 0 ? part_start[p] : 0;
+    T* rbuf = buf + g * b * 2;
+    uint8_t* rmask = mask + g * b;
+    int64_t* ridx = idx + g * b;
+    int32_t* rfold = fold_b + g * b;
+    int32_t* rst = st_b + g * b * 5;
+    int32_t* rsp = sp_b + g * b * 5;
+    int32_t* rcx = cx_b + g * b;
+    int64_t* rcgid = cgid_b + g * b;
+    for (int64_t s = 0; s < cnt; ++s) {
+      const int64_t gi = s0 + s;            // sorted position
+      const int64_t inst = order[gi];       // original instance row
+      const int64_t pi = point_idx[inst];
+      rbuf[2 * s] = static_cast<T>(pts[pts_stride * pi]);
+      rbuf[2 * s + 1] = static_cast<T>(pts[pts_stride * pi + 1]);
+      rmask[s] = 1;
+      ridx[s] = pi;
+      rfold[s] = static_cast<int32_t>(inst - s0);
+      const int64_t cr = cell_rank[gi];
+      const int32_t* ss = sstart + (p * maxnb + s / tblock) * 5;
+      for (int k = 0; k < 5; ++k) {
+        const int32_t sp = uspans[5 * cr + k];
+        rsp[5 * s + k] = sp;
+        rst[5 * s + k] =
+            sp > 0 ? ustarts[5 * cr + k] - ss[k] : 0;
+      }
+      rcx[s] = static_cast<int32_t>(cx_s[gi]);
+      rcgid[s] = cr;
+    }
+    for (int64_t s = cnt; s < b; ++s) {
+      rbuf[2 * s] = static_cast<T>(0);
+      rbuf[2 * s + 1] = static_cast<T>(0);
+      rmask[s] = 0;
+      ridx[s] = -1;
+      rfold[s] = static_cast<int32_t>(s);
+      for (int k = 0; k < 5; ++k) {
+        rsp[5 * s + k] = 0;
+        rst[5 * s + k] = 0;
+      }
+      rcx[s] = 0;
+      rcgid[s] = -1;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void pack_banded_group_f32(
+    const int64_t* sel_parts, int64_t n_sel, int64_t p_pad,
+    const int64_t* part_start, const int64_t* counts, const int64_t* order,
+    const double* pts, int64_t pts_stride, const int64_t* point_idx,
+    const int64_t* cx_s, const int64_t* cell_rank, const int32_t* ustarts,
+    const int32_t* uspans, const int32_t* sstart, int64_t maxnb,
+    int64_t tblock, int64_t b, float* buf, uint8_t* mask, int64_t* idx,
+    int32_t* fold_b, int32_t* st_b, int32_t* sp_b, int32_t* cx_b,
+    int64_t* cgid_b) {
+  pack_banded_group_impl<float>(
+      sel_parts, n_sel, p_pad, part_start, counts, order, pts, pts_stride,
+      point_idx, cx_s, cell_rank, ustarts, uspans, sstart, maxnb, tblock, b,
+      buf, mask, idx, fold_b, st_b, sp_b, cx_b, cgid_b);
+}
+
+void pack_banded_group_f64(
+    const int64_t* sel_parts, int64_t n_sel, int64_t p_pad,
+    const int64_t* part_start, const int64_t* counts, const int64_t* order,
+    const double* pts, int64_t pts_stride, const int64_t* point_idx,
+    const int64_t* cx_s, const int64_t* cell_rank, const int32_t* ustarts,
+    const int32_t* uspans, const int32_t* sstart, int64_t maxnb,
+    int64_t tblock, int64_t b, double* buf, uint8_t* mask, int64_t* idx,
+    int32_t* fold_b, int32_t* st_b, int32_t* sp_b, int32_t* cx_b,
+    int64_t* cgid_b) {
+  pack_banded_group_impl<double>(
+      sel_parts, n_sel, p_pad, part_start, counts, order, pts, pts_stride,
+      point_idx, cx_s, cell_rank, ustarts, uspans, sstart, maxnb, tblock, b,
+      buf, mask, idx, fold_b, st_b, sp_b, cx_b, cgid_b);
+}
+
+}  // extern "C"

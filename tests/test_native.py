@@ -1,0 +1,160 @@
+"""Native host-kernel parity: every ctypes wrapper must be bit-identical
+to its numpy fallback (dbscan_tpu/_native.py builds native/hostops.cpp on
+first use; when the toolchain is missing the wrappers fall back silently,
+and these tests then assert the fallback against itself — still valid)."""
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import _native
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(1234)
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.uint32, np.uint64])
+@pytest.mark.parametrize("n,hi", [(0, 10), (1, 1), (1000, 7), (100_000, 2**20)])
+def test_argsort_matches_numpy_stable(rng, dtype, n, hi):
+    keys = rng.integers(0, hi, size=n).astype(dtype)
+    got = _native.argsort_ints(keys)
+    want = np.argsort(keys, kind="stable")
+    np.testing.assert_array_equal(got, want)
+
+
+def test_argsort_wide_keys(rng):
+    keys = rng.integers(0, 2**62, size=50_000).astype(np.int64)
+    np.testing.assert_array_equal(
+        _native.argsort_ints(keys), np.argsort(keys, kind="stable")
+    )
+
+
+def test_argsort_many_duplicates(rng):
+    keys = rng.integers(0, 3, size=100_000).astype(np.int32)
+    np.testing.assert_array_equal(
+        _native.argsort_ints(keys), np.argsort(keys, kind="stable")
+    )
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64])
+def test_group_by_matches_numpy(rng, dtype):
+    keys = rng.integers(0, 5000, size=200_000).astype(dtype)
+    res = _native.group_by_ints(keys)
+    if res is None:
+        pytest.skip("native library unavailable")
+    uniq, inverse, counts, order = res
+    w_uniq, w_inv, w_counts = np.unique(
+        keys, return_inverse=True, return_counts=True
+    )
+    np.testing.assert_array_equal(uniq, w_uniq)
+    np.testing.assert_array_equal(inverse, w_inv)
+    np.testing.assert_array_equal(counts, w_counts)
+    np.testing.assert_array_equal(order, np.argsort(keys, kind="stable"))
+
+
+def test_group_by_int_key_uses_native(rng):
+    from dbscan_tpu.ops import geometry as geo
+
+    keys = rng.integers(0, 997, size=150_000)
+    uniq, inverse, counts = geo.group_by_int_key(keys, max_key=1000)
+    w_uniq, w_inv, w_counts = np.unique(
+        keys, return_inverse=True, return_counts=True
+    )
+    np.testing.assert_array_equal(uniq, w_uniq)
+    np.testing.assert_array_equal(inverse, w_inv)
+    np.testing.assert_array_equal(counts, w_counts)
+
+
+def test_classify_instances_matches_numpy(rng, monkeypatch):
+    from dbscan_tpu.config import DBSCANConfig
+    from dbscan_tpu.ops import geometry as geo
+    from dbscan_tpu.parallel import binning, partitioner
+    from dbscan_tpu.parallel.driver import _classify_instances
+
+    pts = np.concatenate(
+        [
+            rng.normal(c, 0.6, size=(3000, 2))
+            for c in rng.uniform(-8, 8, size=(6, 2))
+        ]
+    )
+    cfg = DBSCANConfig(eps=0.4, min_points=5, max_points_per_partition=2000)
+    cell = cfg.minimum_rectangle_size
+    cells, counts, cell_inv = geo.cell_histogram_int(pts, cell)
+    parts = partitioner.partition_cells(cells, counts, 2000)
+    rects_int = np.stack([r for r, _ in parts])
+    margins = binning.build_margins(rects_int, cell, cfg.eps)
+    part_ids, point_idx = binning.duplicate_points(pts, margins.outer)
+
+    got = _classify_instances(
+        pts, cells, cell_inv, rects_int, margins, part_ids, point_idx
+    )
+    # numpy reference: force the fallback path
+    monkeypatch.setattr(_native, "_lib", None)
+    monkeypatch.setattr(_native, "_lib_failed", True)
+    want = _classify_instances(
+        pts, cells, cell_inv, rects_int, margins, part_ids, point_idx
+    )
+    monkeypatch.setattr(_native, "_lib_failed", False)
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+    assert got[1].any() and got[0].any()
+
+
+def test_bucketize_banded_native_matches_numpy(rng, monkeypatch):
+    from dbscan_tpu.ops import geometry as geo
+    from dbscan_tpu.parallel import binning, partitioner
+
+    pts = np.concatenate(
+        [
+            rng.normal(c, 0.5, size=(4000, 2))
+            for c in rng.uniform(-6, 6, size=(4, 2))
+        ]
+    )
+    eps = 0.35
+    cell = 2 * eps
+    cells, counts, cell_inv = geo.cell_histogram_int(pts, cell)
+    parts = partitioner.partition_cells(cells, counts, 6000)
+    rects_int = np.stack([r for r, _ in parts])
+    margins = binning.build_margins(rects_int, cell, eps)
+    part_ids, point_idx = binning.duplicate_points(pts, margins.outer)
+
+    def run():
+        return binning.bucketize_banded(
+            pts, part_ids, point_idx, n_parts=len(parts), eps=eps,
+            outer=margins.outer, dtype=np.float32, force=True,
+        )
+
+    g_nat, mb_nat, meta_nat = run()
+    monkeypatch.setattr(_native, "_lib", None)
+    monkeypatch.setattr(_native, "_lib_failed", True)
+    g_np, mb_np, meta_np = run()
+    monkeypatch.setattr(_native, "_lib_failed", False)
+
+    assert mb_nat == mb_np and meta_nat.n_cells == meta_np.n_cells
+    np.testing.assert_array_equal(meta_nat.wintab, meta_np.wintab)
+    assert len(g_nat) == len(g_np)
+    for a, b in zip(g_nat, g_np):
+        np.testing.assert_array_equal(a.points, b.points)
+        np.testing.assert_array_equal(a.mask, b.mask)
+        np.testing.assert_array_equal(a.point_idx, b.point_idx)
+        np.testing.assert_array_equal(a.part_ids, b.part_ids)
+        assert (a.banded is None) == (b.banded is None)
+        if a.banded is not None:
+            for f in a.banded._fields:
+                np.testing.assert_array_equal(
+                    getattr(a.banded, f), getattr(b.banded, f), err_msg=f
+                )
+
+
+def test_env_gate(monkeypatch, rng):
+    monkeypatch.setenv("DBSCAN_TPU_NATIVE", "0")
+    monkeypatch.setattr(_native, "_lib", None)
+    monkeypatch.setattr(_native, "_lib_failed", False)
+    assert _native.lib() is None
+    keys = rng.integers(0, 100, size=1000).astype(np.int64)
+    np.testing.assert_array_equal(
+        _native.argsort_ints(keys), np.argsort(keys, kind="stable")
+    )
+    monkeypatch.setattr(_native, "_lib", None)
+    monkeypatch.setattr(_native, "_lib_failed", False)
