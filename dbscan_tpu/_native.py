@@ -32,8 +32,13 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_failed = False
 
 _I64P = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_I32P = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_I8P = np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS")
+_U8P = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 _U32P = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
 _U64P = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+_F32P = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_F64P = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
 
 
 def _build() -> bool:
@@ -41,9 +46,11 @@ def _build() -> bool:
     # compile to a per-pid temp then rename: os.replace is atomic, so a
     # concurrent importer can never dlopen a half-written library
     tmp = f"{_SO}.{os.getpid()}.tmp"
+    # no -march=native: the kernels are memory-bound (nothing here
+    # vectorizes past baseline), and a cached .so must not SIGILL when the
+    # checkout moves to an older CPU (container images, shared volumes)
     cmd = [
-        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-march=native",
-        "-o", tmp, _SRC,
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -76,18 +83,32 @@ def lib() -> Optional[ctypes.CDLL]:
             return None
     try:
         L = ctypes.CDLL(_SO)
-        L.radix_argsort_u32.argtypes = [_U32P, ctypes.c_int64, _I64P]
-        L.radix_argsort_u64.argtypes = [_U64P, ctypes.c_int64, _I64P]
+        L.radix_argsort_u32.argtypes = [_U32P, ctypes.c_int64, _I32P]
+        L.radix_argsort_u64.argtypes = [_U64P, ctypes.c_int64, _I32P]
         L.group_by_u32.argtypes = [
-            _U32P, ctypes.c_int64, _I64P, _I64P, _U32P, _I64P,
+            _U32P, ctypes.c_int64, _I32P, _I32P, _U32P, _I64P,
         ]
         L.group_by_u32.restype = ctypes.c_int64
         L.group_by_u64.argtypes = [
-            _U64P, ctypes.c_int64, _I64P, _I64P, _U64P, _I64P,
+            _U64P, ctypes.c_int64, _I32P, _I32P, _U64P, _I64P,
         ]
         L.group_by_u64.restype = ctypes.c_int64
-        _F64P = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
-        _U8P = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        L.prefix_maps.argtypes = [_I64P, ctypes.c_int64, _I32P, _I32P]
+        L.repeat_i64.argtypes = [_I64P, _I64P, ctypes.c_int64, _I64P]
+        L.extract_prefix_i64.argtypes = [
+            _I64P, _I64P, ctypes.c_int64, ctypes.c_int64, _I64P,
+        ]
+        L.extract_prefix_i32.argtypes = [
+            _I32P, _I64P, ctypes.c_int64, ctypes.c_int64, _I32P,
+        ]
+        L.extract_prefix_i8.argtypes = [
+            _I8P, _I64P, ctypes.c_int64, ctypes.c_int64, _I8P,
+        ]
+        L.cell_keys.argtypes = [
+            _F64P, ctypes.c_int64, ctypes.c_int64, ctypes.c_double,
+            _U64P, _I64P,
+        ]
+        L.cell_keys.restype = ctypes.c_int64
         L.classify_instances.argtypes = [
             _F64P, ctypes.c_int64, _I64P, _I64P, _I64P, _F64P, _F64P,
             _I64P, _I64P, ctypes.c_int64, _U8P, _U8P,
@@ -96,16 +117,28 @@ def lib() -> Optional[ctypes.CDLL]:
             _F64P, ctypes.c_int64, _I64P, _I64P, _F64P, ctypes.c_double,
             ctypes.c_int64, ctypes.c_uint8, _I64P, _I64P, _I64P, _I64P,
         ]
-        _I32P = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
-        _F32P = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        _U16P = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")
         pack_common = [
             _I64P, ctypes.c_int64, ctypes.c_int64, _I64P, _I64P, _I64P,
             _F64P, ctypes.c_int64, _I64P, _I64P, _I64P, _I32P, _I32P,
             _I32P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ]
-        pack_outs = [_U8P, _I64P, _I32P, _I32P, _I32P, _I32P, _I64P]
-        L.pack_banded_group_f32.argtypes = pack_common + [_F32P] + pack_outs
-        L.pack_banded_group_f64.argtypes = pack_common + [_F64P] + pack_outs
+
+        def pack_outs(run_p):
+            return [_U8P, _I64P, _I32P, run_p, run_p, _I32P, _I64P]
+
+        L.pack_banded_group_f32.argtypes = (
+            pack_common + [_F32P] + pack_outs(_I32P)
+        )
+        L.pack_banded_group_f64.argtypes = (
+            pack_common + [_F64P] + pack_outs(_I32P)
+        )
+        L.pack_banded_group_f32_u16.argtypes = (
+            pack_common + [_F32P] + pack_outs(_U16P)
+        )
+        L.pack_banded_group_f64_u16.argtypes = (
+            pack_common + [_F64P] + pack_outs(_U16P)
+        )
         L.cell_runs.argtypes = [
             _I64P, ctypes.c_int64, _U8P, _U8P, _I64P, _I64P, _I64P,
         ]
@@ -121,12 +154,13 @@ def lib() -> Optional[ctypes.CDLL]:
 def argsort_ints(keys: np.ndarray) -> np.ndarray:
     """Stable argsort of a NONNEGATIVE integer array — drop-in for
     ``np.argsort(keys, kind="stable")`` at the driver's sort sites (all of
-    which construct nonnegative packed keys by design)."""
+    which construct nonnegative packed keys by design). Returns int32
+    indices (every caller's array length fits; half the sort traffic)."""
     keys = np.ascontiguousarray(keys)
     L = lib()
-    if L is None or keys.size == 0:
+    if L is None or keys.size == 0 or keys.size >= 2**31:
         return np.argsort(keys, kind="stable")
-    order = np.empty(keys.size, dtype=np.int64)
+    order = np.empty(keys.size, dtype=np.int32)
     if keys.dtype in (np.int32, np.uint32):
         L.radix_argsort_u32(keys.view(np.uint32), keys.size, order)
     elif keys.dtype in (np.int64, np.uint64):
@@ -134,6 +168,70 @@ def argsort_ints(keys: np.ndarray) -> np.ndarray:
     else:
         return np.argsort(keys, kind="stable")
     return order
+
+
+def prefix_maps(counts: np.ndarray):
+    """(rows, slots) int32 maps for the packers' prefix-slot layout, or
+    None when the native library is unavailable."""
+    L = lib()
+    if L is None:
+        return None
+    counts = np.ascontiguousarray(counts, dtype=np.int64)
+    total = int(counts.sum())
+    rows = np.empty(total, dtype=np.int32)
+    slots = np.empty(total, dtype=np.int32)
+    L.prefix_maps(counts, len(counts), rows, slots)
+    return rows, slots
+
+
+def repeat_i64(vals: np.ndarray, counts: np.ndarray):
+    """np.repeat(vals, counts) for int64 vals, or None if unavailable."""
+    L = lib()
+    if L is None:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    counts = np.ascontiguousarray(counts, dtype=np.int64)
+    out = np.empty(int(counts.sum()), dtype=np.int64)
+    L.repeat_i64(vals, counts, len(counts), out)
+    return out
+
+
+def extract_prefix(src: np.ndarray, counts: np.ndarray):
+    """Gather each row's valid prefix from a [P, B] buffer into one flat
+    array (the packers' layout invariant), or None if unavailable."""
+    L = lib()
+    if L is None:
+        return None
+    counts = np.ascontiguousarray(counts, dtype=np.int64)
+    p, b = src.shape
+    out = np.empty(int(counts.sum()), dtype=src.dtype)
+    src = np.ascontiguousarray(src)
+    if src.dtype == np.int64:
+        L.extract_prefix_i64(src, counts, p, b, out)
+    elif src.dtype == np.int32:
+        L.extract_prefix_i32(src, counts, p, b, out)
+    elif src.dtype in (np.int8, np.uint8, np.bool_):
+        L.extract_prefix_i8(src.view(np.int8), counts, p, b, out.view(np.int8))
+    else:
+        return None
+    return out
+
+
+def cell_keys(pts: np.ndarray, cell_size: float):
+    """Fused 2eps-grid snap + composite row-major key pass. Returns
+    (key [N] uint64, mnx, mny, span_x, span_y) or None when unavailable
+    or the span product would overflow the key space."""
+    L = lib()
+    if L is None:
+        return None
+    pts = np.ascontiguousarray(pts, dtype=np.float64)
+    n = len(pts)
+    key = np.empty(n, dtype=np.uint64)
+    bounds = np.empty(4, dtype=np.int64)
+    ok = L.cell_keys(pts, pts.shape[1], n, float(cell_size), key, bounds)
+    if not ok:
+        return None
+    return key, int(bounds[0]), int(bounds[1]), int(bounds[2]), int(bounds[3])
 
 
 def classify_instances(
@@ -219,11 +317,13 @@ def pack_banded_group(
     tblock: int,
     b: int,
     dtype,
+    run_dtype=np.int32,
 ):
     """Fused banded group packing: one sequential native pass fills all
-    eight group buffers (see native/hostops.cpp). Returns (buf, mask, idx,
-    fold, st, sp, cx, cgid) or None when the native library is
-    unavailable."""
+    eight group buffers (see native/hostops.cpp). ``run_dtype`` selects
+    the run-table element type (uint16 when the slab bound fits — halves
+    the largest device upload). Returns (buf, mask, idx, fold, st, sp,
+    cx, cgid) or None when the native library is unavailable."""
     L = lib()
     if L is None or dtype not in (np.float32, np.float64):
         return None
@@ -237,15 +337,16 @@ def pack_banded_group(
     mask = np.empty((p_pad, b), dtype=np.uint8)
     idx = np.empty((p_pad, b), dtype=np.int64)
     fold = np.empty((p_pad, b), dtype=np.int32)
-    st = np.empty((p_pad, b, 5), dtype=np.int32)
-    sp = np.empty((p_pad, b, 5), dtype=np.int32)
+    st = np.empty((p_pad, b, 5), dtype=run_dtype)
+    sp = np.empty((p_pad, b, 5), dtype=run_dtype)
     cxb = np.empty((p_pad, b), dtype=np.int32)
     cgid = np.empty((p_pad, b), dtype=np.int64)
-    fn = (
-        L.pack_banded_group_f32
-        if dtype == np.float32
-        else L.pack_banded_group_f64
-    )
+    fn = {
+        (np.float32, np.int32): L.pack_banded_group_f32,
+        (np.float64, np.int32): L.pack_banded_group_f64,
+        (np.float32, np.uint16): L.pack_banded_group_f32_u16,
+        (np.float64, np.uint16): L.pack_banded_group_f64_u16,
+    }[(np.dtype(dtype).type, np.dtype(run_dtype).type)]
     fn(
         np.ascontiguousarray(sel_parts, dtype=np.int64),
         len(sel_parts), p_pad,
@@ -293,11 +394,11 @@ def group_by_ints(keys: np.ndarray):
     """
     keys = np.ascontiguousarray(keys)
     L = lib()
-    if L is None:
+    if L is None or keys.size >= 2**31:
         return None
     n = keys.size
-    order = np.empty(n, dtype=np.int64)
-    inverse = np.empty(n, dtype=np.int64)
+    order = np.empty(n, dtype=np.int32)
+    inverse = np.empty(n, dtype=np.int32)
     uniq = np.empty(n, dtype=keys.dtype)
     counts = np.empty(n, dtype=np.int64)
     if keys.dtype in (np.int32, np.uint32):

@@ -82,6 +82,9 @@ def _tile_machinery(points, mask, rel_starts, spans, slab_starts, eps, slab):
     if b % t:
         raise ValueError(f"bucket width {b} not a multiple of {t}")
     nb = b // t
+    # run tables may arrive uint16 (half the upload); widen on device
+    rel_starts = rel_starts.astype(jnp.int32)
+    spans = spans.astype(jnp.int32)
     eps2 = jnp.asarray(eps, dtype=points.dtype) ** 2
     offs = jnp.arange(slab, dtype=jnp.int32)
     # Coordinate planes: slicing [..., 2]-shaped rows would pad the minor

@@ -117,11 +117,13 @@ def cell_index(points, cell_size):
 
 
 def group_by_int_key(key, max_key=None):
-    """Group integer keys: (uniq [U] int64 ascending, inverse [N] int64,
-    counts [U] int64) via ONE stable argsort — numpy's stable sort radix-
-    sorts integers, measured several times faster than np.unique(+inverse)
-    at 10M+ elements. ``max_key`` (an exclusive upper bound, keys assumed
-    nonnegative) enables the int32 fast path."""
+    """Group integer keys: (uniq [U] int64 ascending, inverse [N], counts
+    [U] int64) via ONE stable argsort — numpy's stable sort radix-sorts
+    integers, measured several times faster than np.unique(+inverse) at
+    10M+ elements. ``max_key`` (an exclusive upper bound, keys assumed
+    nonnegative) enables the int32 fast path. ``inverse`` is an index
+    array whose integer dtype varies (int32 on the native radix path,
+    int64 on the numpy fallback)."""
     key = np.asarray(key)
     if key.size == 0:
         empty = np.empty(0, np.int64)
@@ -130,10 +132,11 @@ def group_by_int_key(key, max_key=None):
         key = key.astype(np.int32)
     from dbscan_tpu import _native
 
-    # the native radix path sorts unsigned: nonnegative keys only (a
+    # the native radix path sorts unsigned: nonnegative keys only (the
     # one-pass min costs ~ms and keeps the ascending-uniq contract when a
-    # caller ever passes raw negative cell indices)
-    if key.min() >= 0:
+    # caller ever passes raw negative cell indices; skip it entirely when
+    # the library isn't loadable)
+    if _native.lib() is not None and key.min() >= 0:
         native = _native.group_by_ints(key)
         if native is not None:
             uniq, inverse, counts, _ = native
@@ -154,15 +157,31 @@ def cell_histogram_int(points, cell_size):
     DBSCAN.scala:91-97, in exact arithmetic).
 
     Returns (cells [C, 2] int64 lower-left indices, counts [C] int64,
-    inverse [N] mapping points to cell rows).
+    inverse [N] integer index array mapping points to cell rows — int32
+    on the native path, int64 on the numpy fallback).
     """
-    idx = cell_index(points, cell_size)
-    if idx.shape[0] == 0:
+    from dbscan_tpu import _native
+
+    pts2 = np.asarray(points, dtype=np.float64)[..., :2]
+    if pts2.shape[0] == 0:
         return (
             np.empty((0, 2), np.int64),
             np.empty(0, np.int64),
             np.empty(0, np.int64),
         )
+    nk = _native.cell_keys(pts2, cell_size)
+    if nk is not None:
+        # fused native pass: snap + bounds + composite key in one sweep
+        key, mnx, mny, _span_x, span_y = nk
+        res = _native.group_by_ints(key)
+        if res is not None:
+            uk, inverse, counts, _ = res
+            uk = uk.astype(np.int64)
+            uniq = np.stack(
+                [uk // span_y + mnx, uk % span_y + mny], axis=1
+            )
+            return uniq, counts, inverse
+    idx = cell_index(points, cell_size)
     # Composite 1-D key: np.unique(axis=0) goes through a void-view sort
     # that is ~20x slower than a flat int64 sort at millions of points.
     mn = idx.min(axis=0)

@@ -143,7 +143,7 @@ def duplicate_points_grid(
     # exact inclusive containment test (only boundary-band cells get here).
     part_base = own[inverse]  # [N] own-cell owner, in point order
     if ccell.size:
-        order_pts = np.argsort(inverse.astype(np.int32), kind="stable")
+        order_pts = _native.argsort_ints(inverse.astype(np.int32))
         cstart = np.searchsorted(inverse[order_pts], np.arange(len(cells) + 1))
         ccount = cstart[ccell + 1] - cstart[ccell]
         cpart = ring[ccell, ck]
@@ -249,6 +249,7 @@ def bucketize_grouped(
     bucket_multiple: int = 128,
     pad_parts_to: int = 1,
     dtype=np.float32,
+    on_group=None,
 ) -> Tuple[list, int]:
     """Pack partitions into SIZE-GROUPED static buffers.
 
@@ -302,6 +303,8 @@ def bucketize_grouped(
         rc = np.zeros(p_pad, dtype=np.int64)
         rc[: len(sel_parts)] = counts[sel_parts]
         groups.append(BucketGroup(buf, mask, idx, pid, row_counts=rc))
+        if on_group is not None:
+            on_group(groups[-1])
         max_b = max(max_b, b)
     return groups, max_b
 
@@ -376,6 +379,7 @@ def bucketize_banded(
     pad_parts_to: int = 1,
     dtype=np.float32,
     force: bool = False,
+    on_group=None,
 ) -> Tuple[list, int, "CellGraphMeta"]:
     """Pack partitions for the banded engine (dbscan_tpu/ops/banded.py).
 
@@ -392,6 +396,10 @@ def bucketize_banded(
     Also numbers every occupied (partition, cell) pair globally and builds
     the 5x5 window-neighbor table the host cell-graph connected-components
     pass consumes (see dbscan_tpu/parallel/cellgraph.py).
+
+    ``on_group``, when given, is invoked with each finished BucketGroup in
+    emission order — the driver uses it to dispatch device work while later
+    groups are still packing.
 
     Returns (groups sorted with dense first, max width, CellGraphMeta);
     ``banded`` is set on the banded groups.
@@ -416,7 +424,7 @@ def bucketize_banded(
         # nothing will route banded: skip the whole fine-grid pass
         groups, max_b = bucketize_grouped(
             points, part_ids, point_idx, n_parts, bucket_multiple,
-            pad_parts_to, dtype,
+            pad_parts_to, dtype, on_group=on_group,
         )
         return groups, max_b, empty_meta
 
@@ -600,6 +608,16 @@ def bucketize_banded(
 
     use_banded = (counts > 0) & (force | (widths_band >= DENSE_MAX_BUCKET))
 
+    # run tables ship as uint16 whenever every slab bound fits (starts are
+    # slab-relative < S, spans <= S): half the largest host->device upload;
+    # banded_phase1 widens to int32 after transfer. One run-wide choice so
+    # every group shares one jit signature.
+    run_dtype = (
+        np.uint16
+        if not use_banded.any() or int(win[use_banded].max()) < 2**16
+        else np.int32
+    )
+
     groups: list = []
     max_b = 0
 
@@ -618,6 +636,7 @@ def bucketize_banded(
                 bucket_multiple,
                 pad_parts_to,
                 dtype,
+                on_group=on_group,
             )
             groups.extend(dgroups)
             max_b = max(max_b, dmax)
@@ -641,7 +660,7 @@ def bucketize_banded(
             _native.pack_banded_group(
                 sel_parts, p_pad, part_start, counts, order, pts64,
                 point_idx, cx_s, cell_rank, ustarts, uspans, sstart32,
-                maxnb, t, b, dtype,
+                maxnb, t, b, dtype, run_dtype,
             )
             if native is not None
             else None
@@ -654,8 +673,8 @@ def bucketize_banded(
             idx = np.full((p_pad, b), -1, dtype=np.int64)
             iota = np.arange(b, dtype=np.int32)
             fold_b = np.broadcast_to(iota, (p_pad, b)).copy()
-            st_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=np.int32)
-            sp_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=np.int32)
+            st_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=run_dtype)
+            sp_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=run_dtype)
             cx_b = np.zeros((p_pad, b), dtype=np.int32)
             cgid_b = np.full((p_pad, b), -1, dtype=np.int64)
 
@@ -688,5 +707,7 @@ def bucketize_banded(
                 row_counts=rc,
             )
         )
+        if on_group is not None:
+            on_group(groups[-1])
         max_b = max(max_b, b)
     return groups, max_b, meta

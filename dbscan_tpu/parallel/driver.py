@@ -486,6 +486,21 @@ def train_arrays(
         and cfg.precision.value != "bf16"
         and kernel_cols.shape[1] == 2
     )
+    # Dispatch each group's device program the moment its buffers are
+    # packed (on_group): the first groups' sweeps run while later groups
+    # are still packing, pulling the device window forward under the
+    # packer instead of serializing behind it.
+    pending = []
+    dispatch_spent = [0.0]
+
+    def _on_group(g):
+        td = time.perf_counter()
+        if g.banded is None:
+            pending.append((g, _dispatch_partitions(g, cfg, mesh)))
+        else:
+            pending.append((g, _dispatch_banded_p1(g, cfg, mesh)))
+        dispatch_spent[0] += time.perf_counter() - td
+
     cellmeta = None
     if use_banded:
         groups, max_b, cellmeta = binning.bucketize_banded(
@@ -499,6 +514,7 @@ def train_arrays(
             pad_parts_to=mesh_size(mesh),
             dtype=dtype,
             force=cfg.neighbor_backend == "banded",
+            on_group=_on_group,
         )
     else:
         groups, max_b = binning.bucketize_grouped(
@@ -509,8 +525,13 @@ def train_arrays(
             bucket_multiple=cfg.bucket_multiple,
             pad_parts_to=mesh_size(mesh),
             dtype=dtype,
+            on_group=_on_group,
         )
-    t0 = _mark("bucketize_s", t0)
+    timings["dispatch_s"] = round(dispatch_spent[0], 6)
+    timings["bucketize_s"] = round(
+        time.perf_counter() - t0 - dispatch_spent[0], 6
+    )
+    t0 = time.perf_counter()
 
     # 5. per-partition clustering on device, one launch per bucket width
     # (ascending; same widths recur across runs -> jit cache hits).
@@ -521,13 +542,6 @@ def train_arrays(
     # membership, inner membership — and only then blocks on the labels.
     # Banded groups go out as phase 1 (counts/core/cell-edge bits); their
     # phase 2 follows after the host cell-components pass.
-    pending = []
-    for g in groups:
-        if g.banded is None:
-            pending.append((g, _dispatch_partitions(g, cfg, mesh)))
-        else:
-            pending.append((g, _dispatch_banded_p1(g, cfg, mesh)))
-    t0 = _mark("dispatch_s", t0)
 
     # Compact-transfer path (single-chip): the device link runs at ~15 MB/s
     # down with ~0.5 s/pull latency, so instead of pulling every group's
@@ -564,6 +578,9 @@ def train_arrays(
         # scanning the [P, B] buffer
         if g.row_counts is None:
             return np.nonzero(g.point_idx >= 0)
+        nat = _native.prefix_maps(g.row_counts)
+        if nat is not None:
+            return nat
         c = g.row_counts
         rows = np.repeat(np.arange(len(c)), c)
         slots = np.arange(int(c.sum()), dtype=np.int64) - np.repeat(
@@ -572,12 +589,30 @@ def train_arrays(
         return rows, slots
 
     slotmaps = [_slotmap(g) for g, _ in pending]
-    inst_part = np.concatenate(
-        [g.part_ids[rows] for (g, _), (rows, _s) in zip(pending, slotmaps)]
-    ) if pending else np.empty(0, np.int64)
-    inst_ptidx = np.concatenate(
-        [g.point_idx[rows, slots] for (g, _), (rows, slots) in zip(pending, slotmaps)]
-    ) if pending else np.empty(0, np.int64)
+
+    def _per_group_tables():
+        parts_l, ptidx_l = [], []
+        for (g, _), (rows, slots) in zip(pending, slotmaps):
+            nat = (
+                _native.repeat_i64(g.part_ids, g.row_counts)
+                if g.row_counts is not None
+                else None
+            )
+            if nat is not None:
+                parts_l.append(nat)
+                ptidx_l.append(_native.extract_prefix(g.point_idx, g.row_counts))
+            else:
+                parts_l.append(g.part_ids[rows])
+                ptidx_l.append(g.point_idx[rows, slots])
+        return parts_l, ptidx_l
+
+    if pending:
+        _parts_l, _ptidx_l = _per_group_tables()
+        inst_part = np.concatenate(_parts_l)
+        inst_ptidx = np.concatenate(_ptidx_l)
+    else:
+        inst_part = np.empty(0, np.int64)
+        inst_ptidx = np.empty(0, np.int64)
 
     # device-independent merge precomputation (overlaps the device window)
     if rects_int is not None:
@@ -647,8 +682,17 @@ def train_arrays(
     for (g, (seeds_dev, flags_dev, nc)), (rows, slots) in zip(pending, slotmaps):
         seeds_g, flags_g = np.asarray(seeds_dev), np.asarray(flags_dev)
         n_core += int(nc)
-        inst_seed_l.append(seeds_g[rows, slots])
-        inst_flag_l.append(flags_g[rows, slots])
+        es = (
+            _native.extract_prefix(seeds_g, g.row_counts)
+            if g.row_counts is not None
+            else None
+        )
+        if es is not None:
+            inst_seed_l.append(es)
+            inst_flag_l.append(_native.extract_prefix(flags_g, g.row_counts))
+        else:
+            inst_seed_l.append(seeds_g[rows, slots])
+            inst_flag_l.append(flags_g[rows, slots])
     inst_seed = np.concatenate(inst_seed_l) if inst_seed_l else np.empty(0, np.int32)
     inst_flag = np.concatenate(inst_flag_l) if inst_flag_l else np.empty(0, np.int8)
     t0 = _mark("device_s", t0)
@@ -728,8 +772,12 @@ def train_arrays(
     # DBSCAN.scala:257-267 — same global id either way)
     ci = np.flatnonzero(cand & ~inst_inner)
     if ci.size:
-        order = np.lexsort(
-            (inst_part[ci], inst_flag[ci], inst_ptidx[ci])
+        # packed single key replaces np.lexsort: primary point, then flag,
+        # then partition (flag < 4, partition < p_true; no overflow for
+        # any N * p_true < 2^61)
+        order = _native.argsort_ints(
+            (inst_ptidx[ci] * 4 + inst_flag[ci]) * np.int64(p_true)
+            + inst_part[ci]
         )
         ci = ci[order]
         keep = np.r_[True, inst_ptidx[ci][1:] != inst_ptidx[ci][:-1]]

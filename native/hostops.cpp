@@ -21,9 +21,10 @@ namespace {
 // Stable LSD radix argsort of NONNEGATIVE integer keys, 8-bit digits.
 // All per-digit histograms are gathered in one pre-pass so passes whose
 // digit is constant across the array (the common case for small key
-// spaces in wide types) are skipped entirely.
-template <typename K>
-void radix_argsort_impl(const K* keys, int64_t n, int64_t* order) {
+// spaces in wide types) are skipped entirely. OrderT is int32 whenever
+// n < 2^31 (the wrappers guarantee it) — half the ping-pong traffic.
+template <typename K, typename OrderT>
+void radix_argsort_impl(const K* keys, int64_t n, OrderT* order) {
   constexpr int NB = static_cast<int>(sizeof(K));
   if (n <= 0) return;
   std::vector<int64_t> hist(static_cast<size_t>(NB) * 256, 0);
@@ -34,12 +35,12 @@ void radix_argsort_impl(const K* keys, int64_t n, int64_t* order) {
     }
   }
   std::vector<K> kbuf1(keys, keys + n), kbuf2(n);
-  std::vector<int64_t> obuf1(n), obuf2(n);
-  for (int64_t i = 0; i < n; ++i) obuf1[i] = i;
+  std::vector<OrderT> obuf1(n), obuf2(n);
+  for (int64_t i = 0; i < n; ++i) obuf1[i] = static_cast<OrderT>(i);
   K* ks = kbuf1.data();
   K* kd = kbuf2.data();
-  int64_t* os = obuf1.data();
-  int64_t* od = obuf2.data();
+  OrderT* os = obuf1.data();
+  OrderT* od = obuf2.data();
   for (int b = 0; b < NB; ++b) {
     int64_t* h = &hist[static_cast<size_t>(b) * 256];
     bool trivial = false;
@@ -66,22 +67,22 @@ void radix_argsort_impl(const K* keys, int64_t n, int64_t* order) {
     K* tk = ks;
     ks = kd;
     kd = tk;
-    int64_t* to = os;
+    OrderT* to = os;
     os = od;
     od = to;
   }
-  std::memcpy(order, os, static_cast<size_t>(n) * sizeof(int64_t));
+  std::memcpy(order, os, static_cast<size_t>(n) * sizeof(OrderT));
 }
 
 // Fused group-by of nonnegative keys: stable sort order, dense rank per
 // input element, unique keys and their counts — the native counterpart of
 // ops/geometry.py::group_by_int_key (one sort + one linear pass instead of
 // argsort / fancy-gather / diff / cumsum numpy round trips).
-template <typename K>
-int64_t group_by_impl(const K* keys, int64_t n, int64_t* order,
-                      int64_t* inverse, K* uniq, int64_t* counts) {
+template <typename K, typename OrderT>
+int64_t group_by_impl(const K* keys, int64_t n, OrderT* order,
+                      OrderT* inverse, K* uniq, int64_t* counts) {
   if (n <= 0) return 0;
-  radix_argsort_impl<K>(keys, n, order);
+  radix_argsort_impl<K, OrderT>(keys, n, order);
   int64_t u = -1;
   K prev = 0;
   for (int64_t i = 0; i < n; ++i) {
@@ -93,7 +94,7 @@ int64_t group_by_impl(const K* keys, int64_t n, int64_t* order,
       prev = k;
     }
     counts[u]++;
-    inverse[order[i]] = u;
+    inverse[order[i]] = static_cast<OrderT>(u);
   }
   return u + 1;
 }
@@ -102,22 +103,120 @@ int64_t group_by_impl(const K* keys, int64_t n, int64_t* order,
 
 extern "C" {
 
-void radix_argsort_u32(const uint32_t* keys, int64_t n, int64_t* order) {
-  radix_argsort_impl<uint32_t>(keys, n, order);
+void radix_argsort_u32(const uint32_t* keys, int64_t n, int32_t* order) {
+  radix_argsort_impl<uint32_t, int32_t>(keys, n, order);
 }
 
-void radix_argsort_u64(const uint64_t* keys, int64_t n, int64_t* order) {
-  radix_argsort_impl<uint64_t>(keys, n, order);
+void radix_argsort_u64(const uint64_t* keys, int64_t n, int32_t* order) {
+  radix_argsort_impl<uint64_t, int32_t>(keys, n, order);
 }
 
-int64_t group_by_u32(const uint32_t* keys, int64_t n, int64_t* order,
-                     int64_t* inverse, uint32_t* uniq, int64_t* counts) {
-  return group_by_impl<uint32_t>(keys, n, order, inverse, uniq, counts);
+int64_t group_by_u32(const uint32_t* keys, int64_t n, int32_t* order,
+                     int32_t* inverse, uint32_t* uniq, int64_t* counts) {
+  return group_by_impl<uint32_t, int32_t>(keys, n, order, inverse, uniq,
+                                          counts);
 }
 
-int64_t group_by_u64(const uint64_t* keys, int64_t n, int64_t* order,
-                     int64_t* inverse, uint64_t* uniq, int64_t* counts) {
-  return group_by_impl<uint64_t>(keys, n, order, inverse, uniq, counts);
+int64_t group_by_u64(const uint64_t* keys, int64_t n, int32_t* order,
+                     int32_t* inverse, uint64_t* uniq, int64_t* counts) {
+  return group_by_impl<uint64_t, int32_t>(keys, n, order, inverse, uniq,
+                                          counts);
+}
+
+// Prefix-layout extraction helpers for the driver's instance tables
+// (valid slots are the per-row prefix 0..count-1 in every packed group):
+// (rows, slots) maps, count-repeated values, and prefix gathers from
+// [P, B] buffers — each one sequential pass.
+void prefix_maps(const int64_t* counts, int64_t p, int32_t* rows,
+                 int32_t* slots) {
+  int64_t o = 0;
+  for (int64_t r = 0; r < p; ++r) {
+    const int64_t c = counts[r];
+    for (int64_t s = 0; s < c; ++s) {
+      rows[o] = static_cast<int32_t>(r);
+      slots[o] = static_cast<int32_t>(s);
+      ++o;
+    }
+  }
+}
+
+void repeat_i64(const int64_t* vals, const int64_t* counts, int64_t p,
+                int64_t* out) {
+  int64_t o = 0;
+  for (int64_t r = 0; r < p; ++r) {
+    const int64_t v = vals[r];
+    const int64_t c = counts[r];
+    for (int64_t s = 0; s < c; ++s) out[o++] = v;
+  }
+}
+
+void extract_prefix_i64(const int64_t* src, const int64_t* counts,
+                        int64_t p, int64_t b, int64_t* out) {
+  int64_t o = 0;
+  for (int64_t r = 0; r < p; ++r) {
+    const int64_t c = counts[r];
+    std::memcpy(out + o, src + r * b, static_cast<size_t>(c) * 8);
+    o += c;
+  }
+}
+
+void extract_prefix_i32(const int32_t* src, const int64_t* counts,
+                        int64_t p, int64_t b, int32_t* out) {
+  int64_t o = 0;
+  for (int64_t r = 0; r < p; ++r) {
+    const int64_t c = counts[r];
+    std::memcpy(out + o, src + r * b, static_cast<size_t>(c) * 4);
+    o += c;
+  }
+}
+
+void extract_prefix_i8(const int8_t* src, const int64_t* counts, int64_t p,
+                       int64_t b, int8_t* out) {
+  int64_t o = 0;
+  for (int64_t r = 0; r < p; ++r) {
+    const int64_t c = counts[r];
+    std::memcpy(out + o, src + r * b, static_cast<size_t>(c));
+    o += c;
+  }
+}
+
+// Fused 2eps-grid key pass (ops/geometry.py::cell_histogram_int): snap
+// both coordinates with the reference's negative-shift quirk
+// (DBSCAN.scala:352-356), fold the index bounding box, and emit the
+// row-major composite key — one pass instead of four [N]-wide numpy
+// passes. Returns 0 and leaves key untouched if the span product would
+// overflow the key space (caller falls back).
+int64_t cell_keys(const double* pts, int64_t stride, int64_t n,
+                  double cell_size, uint64_t* key, int64_t* bounds) {
+  if (n <= 0) return 0;
+  std::vector<int64_t> ix(n), iy(n);
+  int64_t mnx = INT64_MAX, mny = INT64_MAX, mxx = INT64_MIN,
+          mxy = INT64_MIN;
+  for (int64_t i = 0; i < n; ++i) {
+    double x = pts[stride * i];
+    double y = pts[stride * i + 1];
+    if (x < 0) x -= cell_size;
+    if (y < 0) y -= cell_size;
+    const int64_t cx = static_cast<int64_t>(std::trunc(x / cell_size));
+    const int64_t cy = static_cast<int64_t>(std::trunc(y / cell_size));
+    ix[i] = cx;
+    iy[i] = cy;
+    if (cx < mnx) mnx = cx;
+    if (cy < mny) mny = cy;
+    if (cx > mxx) mxx = cx;
+    if (cy > mxy) mxy = cy;
+  }
+  const int64_t span_x = mxx - mnx + 1;
+  const int64_t span_y = mxy - mny + 1;
+  if (span_x > (int64_t(1) << 62) / span_y) return 0;
+  for (int64_t i = 0; i < n; ++i) {
+    key[i] = static_cast<uint64_t>((ix[i] - mnx) * span_y + (iy[i] - mny));
+  }
+  bounds[0] = mnx;
+  bounds[1] = mny;
+  bounds[2] = span_x;
+  bounds[3] = span_y;
+  return 1;
 }
 
 // Fused merge-band / inner-membership classification
@@ -250,8 +349,10 @@ namespace {
 // scatters (plus their np.full initializations) per group. Instances of
 // partition p occupy sorted positions [part_start[p], part_start[p] +
 // counts[p]) and slots 0..count-1 of row g, so padding is a pure suffix
-// fill per row. Buffers may arrive uninitialized (np.empty).
-template <typename T>
+// fill per row. Buffers may arrive uninitialized (np.empty). TS is the
+// run-table element type — uint16 whenever the slab bound fits (halves
+// the largest host-to-device upload; the device widens after transfer).
+template <typename T, typename TS>
 void pack_banded_group_impl(
     const int64_t* sel_parts,  // [G] original partition id per row
     int64_t n_sel, int64_t p_pad,
@@ -271,8 +372,8 @@ void pack_banded_group_impl(
     uint8_t* mask,             // [p_pad, b] out
     int64_t* idx,              // [p_pad, b] out
     int32_t* fold_b,           // [p_pad, b] out
-    int32_t* st_b,             // [p_pad, b, 5] out
-    int32_t* sp_b,             // [p_pad, b, 5] out
+    TS* st_b,                  // [p_pad, b, 5] out
+    TS* sp_b,                  // [p_pad, b, 5] out
     int32_t* cx_b,             // [p_pad, b] out
     int64_t* cgid_b            // [p_pad, b] out
 ) {
@@ -284,8 +385,8 @@ void pack_banded_group_impl(
     uint8_t* rmask = mask + g * b;
     int64_t* ridx = idx + g * b;
     int32_t* rfold = fold_b + g * b;
-    int32_t* rst = st_b + g * b * 5;
-    int32_t* rsp = sp_b + g * b * 5;
+    TS* rst = st_b + g * b * 5;
+    TS* rsp = sp_b + g * b * 5;
     int32_t* rcx = cx_b + g * b;
     int64_t* rcgid = cgid_b + g * b;
     for (int64_t s = 0; s < cnt; ++s) {
@@ -301,9 +402,9 @@ void pack_banded_group_impl(
       const int32_t* ss = sstart + (p * maxnb + s / tblock) * 5;
       for (int k = 0; k < 5; ++k) {
         const int32_t sp = uspans[5 * cr + k];
-        rsp[5 * s + k] = sp;
+        rsp[5 * s + k] = static_cast<TS>(sp);
         rst[5 * s + k] =
-            sp > 0 ? ustarts[5 * cr + k] - ss[k] : 0;
+            static_cast<TS>(sp > 0 ? ustarts[5 * cr + k] - ss[k] : 0);
       }
       rcx[s] = static_cast<int32_t>(cx_s[gi]);
       rcgid[s] = cr;
@@ -328,34 +429,29 @@ void pack_banded_group_impl(
 
 extern "C" {
 
-void pack_banded_group_f32(
-    const int64_t* sel_parts, int64_t n_sel, int64_t p_pad,
-    const int64_t* part_start, const int64_t* counts, const int64_t* order,
-    const double* pts, int64_t pts_stride, const int64_t* point_idx,
-    const int64_t* cx_s, const int64_t* cell_rank, const int32_t* ustarts,
-    const int32_t* uspans, const int32_t* sstart, int64_t maxnb,
-    int64_t tblock, int64_t b, float* buf, uint8_t* mask, int64_t* idx,
-    int32_t* fold_b, int32_t* st_b, int32_t* sp_b, int32_t* cx_b,
-    int64_t* cgid_b) {
-  pack_banded_group_impl<float>(
-      sel_parts, n_sel, p_pad, part_start, counts, order, pts, pts_stride,
-      point_idx, cx_s, cell_rank, ustarts, uspans, sstart, maxnb, tblock, b,
-      buf, mask, idx, fold_b, st_b, sp_b, cx_b, cgid_b);
-}
+#define DEFINE_PACK(SUFFIX, T, TS)                                          \
+  void pack_banded_group_##SUFFIX(                                          \
+      const int64_t* sel_parts, int64_t n_sel, int64_t p_pad,               \
+      const int64_t* part_start, const int64_t* counts,                     \
+      const int64_t* order, const double* pts, int64_t pts_stride,          \
+      const int64_t* point_idx, const int64_t* cx_s,                        \
+      const int64_t* cell_rank, const int32_t* ustarts,                     \
+      const int32_t* uspans, const int32_t* sstart, int64_t maxnb,          \
+      int64_t tblock, int64_t b, T* buf, uint8_t* mask, int64_t* idx,       \
+      int32_t* fold_b, TS* st_b, TS* sp_b, int32_t* cx_b,                   \
+      int64_t* cgid_b) {                                                    \
+    pack_banded_group_impl<T, TS>(                                          \
+        sel_parts, n_sel, p_pad, part_start, counts, order, pts,            \
+        pts_stride, point_idx, cx_s, cell_rank, ustarts, uspans, sstart,    \
+        maxnb, tblock, b, buf, mask, idx, fold_b, st_b, sp_b, cx_b,         \
+        cgid_b);                                                            \
+  }
 
-void pack_banded_group_f64(
-    const int64_t* sel_parts, int64_t n_sel, int64_t p_pad,
-    const int64_t* part_start, const int64_t* counts, const int64_t* order,
-    const double* pts, int64_t pts_stride, const int64_t* point_idx,
-    const int64_t* cx_s, const int64_t* cell_rank, const int32_t* ustarts,
-    const int32_t* uspans, const int32_t* sstart, int64_t maxnb,
-    int64_t tblock, int64_t b, double* buf, uint8_t* mask, int64_t* idx,
-    int32_t* fold_b, int32_t* st_b, int32_t* sp_b, int32_t* cx_b,
-    int64_t* cgid_b) {
-  pack_banded_group_impl<double>(
-      sel_parts, n_sel, p_pad, part_start, counts, order, pts, pts_stride,
-      point_idx, cx_s, cell_rank, ustarts, uspans, sstart, maxnb, tblock, b,
-      buf, mask, idx, fold_b, st_b, sp_b, cx_b, cgid_b);
-}
+DEFINE_PACK(f32, float, int32_t)
+DEFINE_PACK(f64, double, int32_t)
+DEFINE_PACK(f32_u16, float, uint16_t)
+DEFINE_PACK(f64_u16, double, uint16_t)
+
+#undef DEFINE_PACK
 
 }  // extern "C"
