@@ -143,6 +143,12 @@ def lib() -> Optional[ctypes.CDLL]:
             _I64P, ctypes.c_int64, _U8P, _U8P, _I64P, _I64P, _I64P,
         ]
         L.cell_runs.restype = ctypes.c_int64
+        L.build_inst_gid.argtypes = [
+            _U8P, _I32P, _I64P, ctypes.c_int64, _I32P,
+        ]
+        L.scatter_sel.argtypes = [
+            _I64P, _I64P, _I32P, _I8P, ctypes.c_int64, _I32P, _I8P, _U8P,
+        ]
     except OSError as e:
         logger.warning("native hostops load failed (%s); using numpy", e)
         _lib_failed = True
@@ -382,6 +388,47 @@ def cell_runs(cg: np.ndarray):
     gid = np.empty(m, dtype=np.int64)
     u = L.cell_runs(cg, m, segflags, valid, st, en, gid)
     return segflags.view(bool), valid.view(bool), st[:u], en[:u], gid[:u]
+
+
+def build_inst_gid(labeled: np.ndarray, urank: np.ndarray, gid_of_u: np.ndarray):
+    """Per-instance global cluster id (0 at unlabeled rows) in one sweep,
+    or None when the native library is unavailable."""
+    L = lib()
+    if L is None:
+        return None
+    m = labeled.size
+    gid = np.empty(m, dtype=np.int32)
+    L.build_inst_gid(
+        np.ascontiguousarray(labeled, dtype=np.uint8),
+        np.ascontiguousarray(urank, dtype=np.int32),
+        np.ascontiguousarray(gid_of_u, dtype=np.int64),
+        m, gid,
+    )
+    return gid
+
+
+def scatter_sel(
+    sel: np.ndarray,
+    inst_ptidx: np.ndarray,
+    inst_gid: np.ndarray,
+    inst_flag: np.ndarray,
+    res_cluster: np.ndarray,
+    res_flag: np.ndarray,
+    assigned: np.ndarray,
+) -> bool:
+    """Apply selected instances' (gid, flag) to the per-point outputs in
+    one sweep. Returns False when the native library is unavailable."""
+    L = lib()
+    if L is None:
+        return False
+    L.scatter_sel(
+        np.ascontiguousarray(sel, dtype=np.int64),
+        np.ascontiguousarray(inst_ptidx, dtype=np.int64),
+        np.ascontiguousarray(inst_gid, dtype=np.int32),
+        np.ascontiguousarray(inst_flag, dtype=np.int8),
+        len(sel), res_cluster, res_flag, assigned.view(np.uint8),
+    )
+    return True
 
 
 def group_by_ints(keys: np.ndarray):

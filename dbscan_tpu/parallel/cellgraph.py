@@ -148,9 +148,11 @@ def finalize_compact(
     border_bits: np.ndarray,
 ) -> List[Tuple[np.ndarray, np.ndarray]]:
     """Seed labels + flags from the COMPACT device pulls (see
-    ops/banded.py::banded_postpass) — same outputs as
-    :func:`finalize_from_bits`, but from M/8 + U + K transferred elements
-    instead of 5 bytes per slot.
+    ops/banded.py::banded_postpass) — the same label algebra as
+    :func:`finalize_from_bits`, from M/8 + U + K transferred elements
+    instead of 5 bytes per slot, returned FLAT: one (seeds [cnt], flags
+    [cnt]) pair per group covering only the valid slots in row-major
+    prefix order (the driver's instance order).
 
     core_flat: [M] bool unpacked core mask over the flat concat;
     or_vals: [G] int32 scan values gathered at ``layout["or_pos"]`` (the
@@ -241,24 +243,30 @@ def finalize_compact(
     else:
         bseed = np.empty(0, np.int64)
 
+    # FLAT per-group outputs: seeds/flags over the VALID slots only, in
+    # row-major prefix order — exactly the driver's instance order, so no
+    # [P, B] materialization and no re-extraction downstream.
     out: List[Tuple[np.ndarray, np.ndarray]] = []
     for g, base in zip(groups, layout["bases"]):
         shape = g.banded.cell_gid.shape
         m = shape[0] * shape[1]
         cg = g.banded.cell_gid.reshape(-1)
         valid = cg >= 0
-        seeds = np.full(m, SEED_NONE, dtype=np.int32)
-        flags = np.full(m, NOT_FLAGGED, dtype=np.int8)
-        flags[valid] = NOISE
-        csel = valid & core_flat[base : base + m]
-        seeds[csel] = seed_of_cell[cg[csel]].astype(np.int32)
-        flags[csel] = CORE
+        cg_v = cg[valid]
+        core_v = core_flat[base : base + m][valid]
+        seeds = np.where(
+            core_v, seed_of_cell[cg_v], np.int64(SEED_NONE)
+        ).astype(np.int32)
+        flags = np.where(core_v, CORE, NOISE).astype(np.int8)
         insel = (bpos >= base) & (bpos < base + m)
         if insel.any():
-            loc = bpos[insel] - base
+            # border candidates are valid non-core slots: map their flat
+            # positions to valid-prefix ranks
+            valid_rank = np.cumsum(valid) - 1
+            loc = valid_rank[bpos[insel] - base]
             seeds[loc] = bseed[insel].astype(np.int32)
             flags[loc] = BORDER
-        out.append((seeds.reshape(shape), flags.reshape(shape)))
+        out.append((seeds, flags))
     return out
 
 

@@ -588,11 +588,19 @@ def train_arrays(
         )
         return rows, slots
 
-    slotmaps = [_slotmap(g) for g, _ in pending]
+    # (rows, slots) maps are only needed by the numpy-fallback branches —
+    # with the native library loaded nothing ever indexes them — so build
+    # them lazily per group
+    _slotmap_cache: dict = {}
+
+    def _slotmap_of(i: int):
+        if i not in _slotmap_cache:
+            _slotmap_cache[i] = _slotmap(pending[i][0])
+        return _slotmap_cache[i]
 
     def _per_group_tables():
         parts_l, ptidx_l = [], []
-        for (g, _), (rows, slots) in zip(pending, slotmaps):
+        for i, (g, _) in enumerate(pending):
             nat = (
                 _native.repeat_i64(g.part_ids, g.row_counts)
                 if g.row_counts is not None
@@ -602,6 +610,7 @@ def train_arrays(
                 parts_l.append(nat)
                 ptidx_l.append(_native.extract_prefix(g.point_idx, g.row_counts))
             else:
+                rows, slots = _slotmap_of(i)
                 parts_l.append(g.part_ids[rows])
                 ptidx_l.append(g.point_idx[rows, slots])
         return parts_l, ptidx_l
@@ -679,9 +688,15 @@ def train_arrays(
 
     n_core = 0
     inst_seed_l, inst_flag_l = [], []
-    for (g, (seeds_dev, flags_dev, nc)), (rows, slots) in zip(pending, slotmaps):
+    for i, (g, (seeds_dev, flags_dev, nc)) in enumerate(pending):
         seeds_g, flags_g = np.asarray(seeds_dev), np.asarray(flags_dev)
         n_core += int(nc)
+        if seeds_g.ndim == 1:
+            # finalize_compact already emits flat valid-prefix arrays in
+            # instance order
+            inst_seed_l.append(seeds_g)
+            inst_flag_l.append(flags_g)
+            continue
         es = (
             _native.extract_prefix(seeds_g, g.row_counts)
             if g.row_counts is not None
@@ -691,6 +706,7 @@ def train_arrays(
             inst_seed_l.append(es)
             inst_flag_l.append(_native.extract_prefix(flags_g, g.row_counts))
         else:
+            rows, slots = _slotmap_of(i)
             inst_seed_l.append(seeds_g[rows, slots])
             inst_flag_l.append(flags_g[rows, slots])
     inst_seed = np.concatenate(inst_seed_l) if inst_seed_l else np.empty(0, np.int32)
@@ -752,9 +768,17 @@ def train_arrays(
 
     # per-instance global id (0 for noise): labeled instances carry their
     # rank into the unique table already (no re-search)
-    inst_gid = np.zeros(len(inst_part), dtype=np.int32)
-    if inst_urank.size:
-        inst_gid[labeled_inst] = gid_of_u[inst_urank]
+    gid_nat = (
+        _native.build_inst_gid(labeled_inst, inst_urank, gid_of_u)
+        if inst_urank.size
+        else None
+    )
+    if gid_nat is not None:
+        inst_gid = gid_nat
+    else:
+        inst_gid = np.zeros(len(inst_part), dtype=np.int32)
+        if inst_urank.size:
+            inst_gid[labeled_inst] = gid_of_u[inst_urank]
 
     # 8. relabel + dedup into per-point outputs.
     res_cluster = np.zeros(n, dtype=np.int32)
@@ -763,9 +787,12 @@ def train_arrays(
 
     # inner instances: at most one per point (mains have disjoint interiors)
     ii = np.flatnonzero(inst_inner)
-    res_cluster[inst_ptidx[ii]] = inst_gid[ii]
-    res_flag[inst_ptidx[ii]] = inst_flag[ii]
-    assigned[inst_ptidx[ii]] = True
+    if not _native.scatter_sel(
+        ii, inst_ptidx, inst_gid, inst_flag, res_cluster, res_flag, assigned
+    ):
+        res_cluster[inst_ptidx[ii]] = inst_gid[ii]
+        res_flag[inst_ptidx[ii]] = inst_flag[ii]
+        assigned[inst_ptidx[ii]] = True
 
     # merge-band instances: dedup by point, prefer Core > Border > Noise,
     # then lower partition id (deterministic; reference keeps last non-noise,
@@ -782,9 +809,13 @@ def train_arrays(
         ci = ci[order]
         keep = np.r_[True, inst_ptidx[ci][1:] != inst_ptidx[ci][:-1]]
         ck = ci[keep]
-        res_cluster[inst_ptidx[ck]] = inst_gid[ck]
-        res_flag[inst_ptidx[ck]] = inst_flag[ck]
-        assigned[inst_ptidx[ck]] = True
+        if not _native.scatter_sel(
+            ck, inst_ptidx, inst_gid, inst_flag, res_cluster, res_flag,
+            assigned,
+        ):
+            res_cluster[inst_ptidx[ck]] = inst_gid[ck]
+            res_flag[inst_ptidx[ck]] = inst_flag[ck]
+            assigned[inst_ptidx[ck]] = True
 
     if not assigned.all():
         # fp-edge fallback: label from any instance (first occurrence)

@@ -310,6 +310,41 @@ void fine_cells(
   }
 }
 
+// Fused relabel passes (parallel/driver.py train_arrays steps 6-8): the
+// per-instance global-id fill and the inner/band scatter into the
+// per-point outputs, each one sequential sweep instead of a chain of
+// boolean-mask gathers and fancy-indexed scatters.
+void build_inst_gid(const uint8_t* labeled,   // [M]
+                    const int32_t* urank,     // [L] ranks of labeled rows
+                    const int64_t* gid_of_u,  // [K]
+                    int64_t m, int32_t* gid   // [M] out
+) {
+  int64_t l = 0;
+  for (int64_t j = 0; j < m; ++j) {
+    gid[j] = labeled[j]
+                 ? static_cast<int32_t>(gid_of_u[urank[l++]])
+                 : 0;
+  }
+}
+
+void scatter_sel(const int64_t* sel,       // [S] instance rows to apply
+                 const int64_t* inst_pt,   // [M]
+                 const int32_t* inst_gid,  // [M]
+                 const int8_t* inst_flag,  // [M]
+                 int64_t s,
+                 int32_t* res_cluster,     // [N] out
+                 int8_t* res_flag,         // [N] out
+                 uint8_t* assigned         // [N] out
+) {
+  for (int64_t k = 0; k < s; ++k) {
+    const int64_t j = sel[k];
+    const int64_t pt = inst_pt[j];
+    res_cluster[pt] = inst_gid[j];
+    res_flag[pt] = inst_flag[j];
+    assigned[pt] = 1;
+  }
+}
+
 // Fused cell-run extraction (parallel/cellgraph.py::cell_layout): one
 // pass over a group's flat cell-id array yielding the device scan's
 // segment-start flags, the validity mask, and the compacted (start, end,
