@@ -143,6 +143,11 @@ def lib() -> Optional[ctypes.CDLL]:
             _I64P, ctypes.c_int64, _U8P, _U8P, _I64P, _I64P, _I64P,
         ]
         L.cell_runs.restype = ctypes.c_int64
+        L.halo_candidates.argtypes = [
+            _I64P, _I64P, ctypes.c_int64, _I64P, _I32P, _F64P,
+            ctypes.c_int64, _F64P, _I64P, _I64P,
+        ]
+        L.halo_candidates.restype = ctypes.c_int64
         L.build_inst_gid.argtypes = [
             _U8P, _I32P, _I64P, ctypes.c_int64, _I32P,
         ]
@@ -388,6 +393,37 @@ def cell_runs(cg: np.ndarray):
     gid = np.empty(m, dtype=np.int64)
     u = L.cell_runs(cg, m, segflags, valid, st, en, gid)
     return segflags.view(bool), valid.view(bool), st[:u], en[:u], gid[:u]
+
+
+def halo_candidates(
+    ccell: np.ndarray,
+    cpart: np.ndarray,
+    cstart: np.ndarray,
+    order_pts: np.ndarray,
+    pts: np.ndarray,
+    outer: np.ndarray,
+    capacity: int,
+):
+    """Expand candidate (cell, partition) pairs to their contained points
+    (grown-rect inclusive containment) in one pass. Returns (part [H],
+    pt [H]) or None when the native library is unavailable."""
+    L = lib()
+    if L is None:
+        return None
+    pts = np.ascontiguousarray(pts, dtype=np.float64)
+    out_part = np.empty(capacity, dtype=np.int64)
+    out_pt = np.empty(capacity, dtype=np.int64)
+    h = L.halo_candidates(
+        np.ascontiguousarray(ccell, dtype=np.int64),
+        np.ascontiguousarray(cpart, dtype=np.int64),
+        len(ccell),
+        np.ascontiguousarray(cstart, dtype=np.int64),
+        np.ascontiguousarray(order_pts, dtype=np.int32),
+        pts, pts.shape[1],
+        np.ascontiguousarray(outer, dtype=np.float64),
+        out_part, out_pt,
+    )
+    return out_part[:h], out_pt[:h]
 
 
 def build_inst_gid(labeled: np.ndarray, urank: np.ndarray, gid_of_u: np.ndarray):

@@ -204,7 +204,7 @@ SCAN_BLOCK = 512
 
 
 @jax.jit
-def banded_postpass(cores, bitses, segflags):
+def banded_postpass(cores, bitses, segflags, or_idx):
     """Device-side compaction of the banded phase-1 outputs.
 
     The link from device to host runs at ~15 MB/s with ~0.5 s latency per
@@ -233,10 +233,16 @@ def banded_postpass(cores, bitses, segflags):
       bitses: tuple of [P, B] int32 phase-1 window bitmasks.
       segflags: tuple of [P*B] bool cell-start flags in flat row-major
         order (host-computed from the packer's cell ids).
+      or_idx: [G] int32 flat positions to read the scan back at (the
+        per-cell OR gather plan, cellgraph.cell_layout) — gathered here
+        and BITCAST onto the tail of the packed-core pull so both
+        artifacts cross the link in ONE transfer (each pull costs ~0.5 s
+        of latency alone).
 
-    Returns (core_packed [M/8] uint8, srb [M] int32, bits_flat [M] int32)
-    over the flat concatenation of all groups (M is a multiple of
-    SCAN_BLOCK: every group's P*B is).
+    Returns (combo [M/8 + 4*G] uint8 — packed core bits followed by the
+    little-endian bytes of the gathered int32 scan values — and bits_flat
+    [M] int32, resident) over the flat concatenation of all groups (M is
+    a multiple of SCAN_BLOCK: every group's P*B is).
     """
     core_flat = jnp.concatenate([c.reshape(-1) for c in cores])
     bits_flat = jnp.concatenate([b.reshape(-1) for b in bitses])
@@ -255,7 +261,9 @@ def banded_postpass(cores, bitses, segflags):
         .sum(axis=1)
         .astype(jnp.uint8)
     )
-    return packed, v.reshape(-1), bits_flat
+    orvals = v.reshape(-1)[or_idx]
+    or_bytes = lax.bitcast_convert_type(orvals, jnp.uint8).reshape(-1)
+    return jnp.concatenate([packed, or_bytes]), bits_flat
 
 
 @jax.jit

@@ -143,20 +143,38 @@ def duplicate_points_grid(
     # exact inclusive containment test (only boundary-band cells get here).
     part_base = own[inverse]  # [N] own-cell owner, in point order
     if ccell.size:
-        order_pts = _native.argsort_ints(inverse.astype(np.int32))
-        cstart = np.searchsorted(inverse[order_pts], np.arange(len(cells) + 1))
-        ccount = cstart[ccell + 1] - cstart[ccell]
-        cpart = ring[ccell, ck]
-        pt = order_pts[
-            np.repeat(cstart[ccell], ccount)
-            + (
-                np.arange(ccount.sum(), dtype=np.int64)
-                - np.repeat(np.cumsum(ccount) - ccount, ccount)
+        cpart = ring[ccell, ck].astype(np.int64)
+        grouped = _native.group_by_ints(inverse.astype(np.int32))
+        if grouped is not None:
+            # radix group-by doubles as the cell-sorted point order +
+            # per-cell ranges (every histogram cell is occupied, so the
+            # unique keys are exactly 0..C-1)
+            _, _, per_cell, order_pts = grouped
+            cstart = np.concatenate([[0], np.cumsum(per_cell)])
+            nat = _native.halo_candidates(
+                ccell, cpart, cstart, order_pts, pts, outer,
+                int((cstart[ccell + 1] - cstart[ccell]).sum()),
             )
-        ]
-        pp = np.repeat(cpart, ccount)
-        hit = geo.contains_point(outer[pp], pts[pt])
-        halo_part, halo_pt = pp[hit], pt[hit]
+        else:
+            nat = None
+        if nat is not None:
+            halo_part, halo_pt = nat
+        else:
+            order_pts = _native.argsort_ints(inverse.astype(np.int32))
+            cstart = np.searchsorted(
+                inverse[order_pts], np.arange(len(cells) + 1)
+            )
+            ccount = cstart[ccell + 1] - cstart[ccell]
+            pt = order_pts[
+                np.repeat(cstart[ccell], ccount)
+                + (
+                    np.arange(ccount.sum(), dtype=np.int64)
+                    - np.repeat(np.cumsum(ccount) - ccount, ccount)
+                )
+            ]
+            pp = np.repeat(cpart, ccount)
+            hit = geo.contains_point(outer[pp], pts[pt])
+            halo_part, halo_pt = pp[hit], pt[hit]
     else:
         halo_part = np.empty(0, np.int32)
         halo_pt = np.empty(0, np.int64)

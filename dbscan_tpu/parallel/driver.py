@@ -558,18 +558,14 @@ def train_arrays(
 
             bgroups = [pending[i][0] for i in b_idx]
             layout = cellgraph.cell_layout(bgroups)
-            core_packed, srb, bits_flat = banded_postpass(
+            combo_dev, bits_flat = banded_postpass(
                 tuple(pending[i][1][1] for i in b_idx),
                 tuple(pending[i][1][2] for i in b_idx),
                 tuple(jnp.asarray(f) for f in layout["segflags"]),
+                jnp.asarray(_pad_idx(layout["or_pos"])),
             )
-            core_packed.copy_to_host_async()
-            orvals_dev = gather_flat(
-                srb, jnp.asarray(_pad_idx(layout["or_pos"]))
-            )
-            orvals_dev.copy_to_host_async()
-            compact = (b_idx, bgroups, layout, core_packed, bits_flat, orvals_dev)
-            del srb
+            combo_dev.copy_to_host_async()
+            compact = (b_idx, bgroups, layout, combo_dev, bits_flat)
     t0 = _mark("postdispatch_s", t0)
 
     def _slotmap(g):
@@ -641,17 +637,19 @@ def train_arrays(
     # reference's driver-side graph pass (DBSCANGraph.scala:70-87)
     # transplanted to per-partition scale (parallel/cellgraph.py)
     if compact is not None:
-        b_idx, bgroups, layout, core_packed, bits_flat, orvals_dev = compact
+        b_idx, bgroups, layout, combo_dev, bits_flat = compact
         total = layout["total"]
         tc = time.perf_counter()
-        core_host = np.asarray(core_packed)
+        combo_host = np.asarray(combo_dev)
         tc = _mark("cellcc_pull_core_s", tc)
-        core_flat = np.unpackbits(core_host, count=total).astype(bool)
+        core_flat = np.unpackbits(
+            combo_host[: total // 8], count=total
+        ).astype(bool)
+        or_vals = combo_host[total // 8 :].view("<i4")[: len(layout["or_pos"])]
         border_pos = np.flatnonzero(layout["validflat"] & ~core_flat)
         bbits_dev = gather_flat(bits_flat, jnp.asarray(_pad_idx(border_pos)))
         bbits_dev.copy_to_host_async()
         tc = _mark("cellcc_borderidx_s", tc)
-        or_vals = np.asarray(orvals_dev)[: len(layout["or_pos"])]
         border_bits = np.asarray(bbits_dev)[: len(border_pos)]
         tc = _mark("cellcc_pull_rest_s", tc)
         finalized = cellgraph.finalize_compact(

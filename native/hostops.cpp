@@ -345,6 +345,42 @@ void scatter_sel(const int64_t* sel,       // [S] instance rows to apply
   }
 }
 
+// Fused halo-candidate expansion (parallel/binning.py
+// ::duplicate_points_grid): for each candidate (cell, foreign partition)
+// pair, walk the cell's points (contiguous in the cell-sorted order) and
+// keep those inside the partition's grown rectangle — one pass replacing
+// the repeat/arange expansion plus the vectorized containment test.
+// Returns the number of hits; out buffers need capacity sum(cell sizes
+// over candidates).
+int64_t halo_candidates(
+    const int64_t* ccell,      // [K] candidate cell row
+    const int64_t* cpart,      // [K] candidate partition id
+    int64_t k,
+    const int64_t* cstart,     // [C+1] cell -> sorted-point range
+    const int32_t* order_pts,  // [N] cell-sorted point order
+    const double* pts,         // [N, D]
+    int64_t stride,
+    const double* outer,       // [P, 4] grown rects
+    int64_t* out_part, int64_t* out_pt) {
+  int64_t o = 0;
+  for (int64_t c = 0; c < k; ++c) {
+    const int64_t cell = ccell[c];
+    const int64_t p = cpart[c];
+    const double* r = outer + 4 * p;
+    for (int64_t s = cstart[cell]; s < cstart[cell + 1]; ++s) {
+      const int64_t pt = order_pts[s];
+      const double x = pts[stride * pt];
+      const double y = pts[stride * pt + 1];
+      if (r[0] <= x && x <= r[2] && r[1] <= y && y <= r[3]) {
+        out_part[o] = p;
+        out_pt[o] = pt;
+        ++o;
+      }
+    }
+  }
+  return o;
+}
+
 // Fused cell-run extraction (parallel/cellgraph.py::cell_layout): one
 // pass over a group's flat cell-id array yielding the device scan's
 // segment-start flags, the validity mask, and the compacted (start, end,

@@ -147,6 +147,34 @@ def test_bucketize_banded_native_matches_numpy(rng, monkeypatch):
                 )
 
 
+def test_full_train_native_matches_fallback(rng, monkeypatch):
+    """End-to-end: the whole distributed pipeline must produce identical
+    labels and flags with and without the native library (the strongest
+    parity statement — every native call site's fallback branch is the
+    same function of the same inputs)."""
+    from dbscan_tpu import Engine, train
+
+    pts = np.concatenate(
+        [
+            rng.normal(c, 0.5, size=(2500, 2))
+            for c in rng.uniform(-7, 7, size=(5, 2))
+        ]
+        + [rng.uniform(-9, 9, size=(800, 2))]
+    )
+    kw = dict(
+        eps=0.4, min_points=8, max_points_per_partition=1800,
+        engine=Engine.ARCHERY, neighbor_backend="banded",
+    )
+    m_nat = train(pts, **kw)
+    monkeypatch.setattr(_native, "_lib", None)
+    monkeypatch.setattr(_native, "_lib_failed", True)
+    m_np = train(pts, **kw)
+    monkeypatch.setattr(_native, "_lib_failed", False)
+    np.testing.assert_array_equal(m_nat.clusters, m_np.clusters)
+    np.testing.assert_array_equal(m_nat.flags, m_np.flags)
+    assert m_nat.n_clusters == m_np.n_clusters >= 1
+
+
 def test_env_gate(monkeypatch, rng):
     monkeypatch.setenv("DBSCAN_TPU_NATIVE", "0")
     monkeypatch.setattr(_native, "_lib", None)
