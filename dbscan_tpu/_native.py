@@ -392,7 +392,13 @@ def cell_runs(cg: np.ndarray):
     en = np.empty(m, dtype=np.int64)
     gid = np.empty(m, dtype=np.int64)
     u = L.cell_runs(cg, m, segflags, valid, st, en, gid)
-    return segflags.view(bool), valid.view(bool), st[:u], en[:u], gid[:u]
+    # copies, not views: a view of the full m-sized scratch would keep
+    # ~24 B per flat slot alive for the whole compact pass on the
+    # memory-constrained host
+    return (
+        segflags.view(bool), valid.view(bool),
+        st[:u].copy(), en[:u].copy(), gid[:u].copy(),
+    )
 
 
 def halo_candidates(

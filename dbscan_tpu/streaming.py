@@ -42,24 +42,56 @@ class _MinUnionFind:
     """Union-find over positive int stream ids where the component root is
     always the MINIMUM id — the "elder id wins" rule needs the canonical id
     to be deterministic, which weighted union does not guarantee. Tracks the
-    live-root count incrementally so callers never scan all ids ever made."""
+    live-root count incrementally so callers never scan all ids ever made.
+
+    Stream ids are allocated densely from 1 (:meth:`register_range`), so the
+    parent table is a flat numpy array: scalar find/union serve the (few)
+    identity-graph edges per update, while :meth:`find_many` resolves whole
+    label arrays by vectorized pointer jumping — O(log chain depth) numpy
+    rounds, no per-id Python loop."""
 
     def __init__(self):
-        self._parent: dict = {}
+        self._parent = np.arange(1, dtype=np.int64)  # slot 0 = noise, unused
         self.n_roots = 0
 
+    def register_range(self, start: int, count: int) -> np.ndarray:
+        """Register ids start..start+count-1 as fresh singleton roots;
+        returns them."""
+        end = start + count
+        if end > len(self._parent):
+            old = self._parent
+            grown = np.arange(max(end, 2 * len(old)), dtype=np.int64)
+            grown[: len(old)] = old
+            self._parent = grown
+        self.n_roots += count
+        return np.arange(start, end, dtype=np.int64)
+
     def find(self, x: int) -> int:
-        parent = self._parent
-        if x not in parent:
-            parent[x] = x
-            self.n_roots += 1
+        p = self._parent
+        if x >= len(p):  # never registered: a self-root, not counted
             return x
         root = x
-        while parent[root] != root:
-            root = parent[root]
-        while parent[x] != root:
-            parent[x], x = root, parent[x]
-        return root
+        while p[root] != root:
+            root = p[root]
+        while p[x] != root:
+            p[x], x = root, p[x]
+        return int(root)
+
+    def find_many(self, ids: np.ndarray) -> np.ndarray:
+        """Vectorized find over an id array (unregistered ids map to
+        themselves); compresses the touched paths."""
+        p = self._parent
+        out = np.asarray(ids, dtype=np.int64).copy()
+        inb = out < len(p)
+        r = p[out[inb]]
+        while True:
+            nxt = p[r]
+            if (nxt == r).all():
+                break
+            r = p[nxt]  # two jumps per numpy round
+        p[out[inb]] = r  # path compression straight to the root
+        out[inb] = r
+        return out
 
     def union(self, a: int, b: int) -> int:
         ra, rb = self.find(a), self.find(b)
@@ -128,12 +160,13 @@ class StreamingDBSCAN:
 
     def resolve(self, ids: np.ndarray) -> np.ndarray:
         """Map previously-emitted stream ids to their current canonical ids
-        (after later batches merged clusters)."""
+        (after later batches merged clusters). Vectorized — safe to call on
+        full label arrays of any size."""
         ids = np.asarray(ids)
         out = ids.copy()
-        for v in np.unique(ids):
-            if v > 0:
-                out[ids == v] = self._uf.find(int(v))
+        pos = ids > 0
+        if pos.any():
+            out[pos] = self._uf.find_many(ids[pos])
         return out
 
     def update(self, batch: np.ndarray) -> StreamUpdate:
@@ -153,28 +186,58 @@ class StreamingDBSCAN:
         batch_fl = out.flags[:b]
         win_cl = out.clusters[b:]
 
-        # carry identity: batch-local cluster id -> stream id
-        mapping: dict = {}
-        # window points vote first (elder ids win: union-by-min)
-        for local_id in np.unique(win_cl[win_cl > 0]):
-            members = [int(s) for s in np.unique(wids[win_cl == local_id])]
-            canon = self._uf.find(members[0])
-            for s in members[1:]:
-                canon = self._uf.union(canon, s)
-            mapping[int(local_id)] = canon
-        # re-canonicalize: a later cluster's union may have merged an id
-        # assigned earlier in this same update
-        mapping = {k: self._uf.find(v) for k, v in mapping.items()}
-        for local_id in np.unique(batch_cl[batch_cl > 0]):
-            if int(local_id) not in mapping:
-                sid = self._next_id
-                self._next_id += 1
-                self._uf.find(sid)  # register
-                mapping[int(local_id)] = sid
+        # carry identity: batch-local cluster id -> stream id, all in
+        # unique-cluster space (no per-id boolean masking over the batch:
+        # that was O(clusters * points), quadratic for dense streams)
+        b_pos = batch_cl > 0
+        uniq_b = np.unique(batch_cl[b_pos]).astype(np.int64)  # sorted
+        sid_of = np.zeros(len(uniq_b), dtype=np.int64)  # 0 = not yet mapped
+
+        # window points vote first (elder ids win: union-by-min): group the
+        # (local cluster, window stream id) pairs by one packed-key unique —
+        # the union loop below runs over identity-graph EDGES (distinct
+        # pairs), not window points
+        w_pos = win_cl > 0
+        wl = win_cl[w_pos].astype(np.int64)
+        ws = wids[w_pos].astype(np.int64)
+        if wl.size:
+            base = np.int64(self._next_id)  # every stream id < _next_id
+            uk = np.unique(wl * base + ws)
+            ul, us = np.divmod(uk, base)
+            starts = np.flatnonzero(np.r_[True, ul[1:] != ul[:-1]])
+            ends = np.r_[starts[1:], len(ul)]
+            # target slot in uniq_b per voted cluster (a window-only cluster
+            # with no batch member still gets its ids unioned)
+            tgt = np.searchsorted(uniq_b, ul[starts])
+            tgt_c = np.minimum(tgt, max(0, len(uniq_b) - 1))
+            in_batch = (
+                uniq_b[tgt_c] == ul[starts] if uniq_b.size
+                else np.zeros(len(starts), dtype=bool)
+            )
+            for i in range(len(starts)):
+                a, e = starts[i], ends[i]
+                canon = self._uf.find(int(us[a]))
+                for s in us[a + 1 : e]:
+                    canon = self._uf.union(canon, int(s))
+                if in_batch[i]:
+                    sid_of[tgt_c[i]] = canon
+            # re-canonicalize: a later cluster's union may have merged an id
+            # assigned earlier in this same update
+            got = sid_of > 0
+            if got.any():
+                sid_of[got] = self._uf.find_many(sid_of[got])
+        # clusters touching no window point get fresh sequential ids
+        fresh = sid_of == 0
+        n_new = int(fresh.sum())
+        if n_new:
+            sid_of[fresh] = self._uf.register_range(self._next_id, n_new)
+            self._next_id += n_new
 
         stream_cl = np.zeros(b, dtype=np.int64)
-        for local_id, sid in mapping.items():
-            stream_cl[batch_cl == local_id] = sid
+        if uniq_b.size:
+            stream_cl[b_pos] = sid_of[
+                np.searchsorted(uniq_b, batch_cl[b_pos])
+            ]
 
         # retain this batch's core points in the window skeleton
         core_mask = batch_fl == CORE
@@ -186,7 +249,7 @@ class StreamingDBSCAN:
         stats.update(
             n_updates=self._n_updates,
             window_points=int(len(wpts)),
-            batch_clusters=int(len(np.unique(batch_cl[batch_cl > 0]))),
+            batch_clusters=len(uniq_b),
         )
         return StreamUpdate(
             clusters=stream_cl,

@@ -96,3 +96,35 @@ def test_use_pallas_rejects_f64():
                 precision=Precision.F64,
             ),
         )
+
+
+def test_cosine_at_scale_fails_fast():
+    """VERDICT r1 guard: a non-spatial metric at a scale whose dense
+    [B, B] adjacency cannot fit HBM must raise a clear ValueError
+    IMMEDIATELY (before packing or device work), naming the limit and the
+    alternatives."""
+    import time
+
+    from dbscan_tpu.parallel.driver import DENSE_WIDTH_LIMIT
+
+    data = np.zeros((10_000_000, 2))
+    t0 = time.perf_counter()
+    with pytest.raises(ValueError, match=str(DENSE_WIDTH_LIMIT)):
+        train(data, eps=0.1, min_points=3, metric="cosine")
+    assert time.perf_counter() - t0 < 5.0  # fails fast, not after packing
+
+
+def test_dense_width_boundary():
+    """Widths under DENSE_WIDTH_LIMIT (incl. the 49152 ladder rung between
+    the old ad-hoc limit and the banded threshold) stay allowed — they were
+    dispatchable before the guard existed; the limit itself raises."""
+    from dbscan_tpu.parallel.driver import (
+        DENSE_WIDTH_LIMIT,
+        _check_dense_width,
+    )
+
+    _check_dense_width(4096, 4096)  # no raise
+    _check_dense_width(49152, 40000)  # no raise: ~9 GiB, previously worked
+    _check_dense_width(DENSE_WIDTH_LIMIT - 1, 40000)  # no raise
+    with pytest.raises(ValueError, match="Alternatives"):
+        _check_dense_width(DENSE_WIDTH_LIMIT, 65536)

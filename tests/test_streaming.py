@@ -89,3 +89,39 @@ def test_rejects_bad_batch(rng):
     s = StreamingDBSCAN(eps=0.5, min_points=3)
     with pytest.raises(ValueError, match=r"\[B, >=2\]"):
         s.update(np.zeros(5))
+
+
+def test_stress_many_clusters_large_batch(rng):
+    """>=100k points/batch, thousands of clusters: the identity-carry path
+    must stay vectorized (no per-cluster masking over the batch). Two
+    updates + a bulk resolve; wall time is the regression signal (the old
+    per-id loops took minutes at this scale)."""
+    import time
+
+    side = 45  # 2025 blob centers
+    centers = np.stack(
+        np.meshgrid(np.arange(side) * 10.0, np.arange(side) * 10.0),
+        axis=-1,
+    ).reshape(-1, 2)
+    per = 50  # 101_250 points per batch
+    batch = (
+        np.repeat(centers, per, axis=0)
+        + rng.normal(0, 0.3, (len(centers) * per, 2))
+    )
+    s = StreamingDBSCAN(
+        eps=1.5, min_points=5, max_points_per_partition=65536
+    )
+    t0 = time.perf_counter()
+    u1 = s.update(batch)
+    assert u1.n_stream_clusters == len(centers)
+    # second batch over the same regions: every cluster keeps its id
+    u2 = s.update(batch + rng.normal(0, 0.3, batch.shape))
+    assert u2.n_stream_clusters == len(centers)
+    assert set(np.unique(u2.clusters[u2.clusters > 0])) <= set(
+        np.unique(u1.clusters[u1.clusters > 0])
+    )
+    # bulk resolve over the full emitted label array
+    r = s.resolve(u1.clusters)
+    assert (r[u1.clusters > 0] > 0).all()
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 120, f"streaming stress took {elapsed:.0f}s"
