@@ -122,6 +122,7 @@ def lib() -> Optional[ctypes.CDLL]:
             _I64P, ctypes.c_int64, ctypes.c_int64, _I64P, _I64P, _I64P,
             _F64P, ctypes.c_int64, _I64P, _I64P, _I64P, _I32P, _I32P,
             _I32P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64,  # d_out payload columns
         ]
 
         def pack_outs(run_p):
@@ -329,12 +330,15 @@ def pack_banded_group(
     b: int,
     dtype,
     run_dtype=np.int32,
+    d_out: int = 2,
 ):
     """Fused banded group packing: one sequential native pass fills all
     eight group buffers (see native/hostops.cpp). ``run_dtype`` selects
     the run-table element type (uint16 when the slab bound fits — halves
-    the largest device upload). Returns (buf, mask, idx, fold, st, sp,
-    cx, cgid) or None when the native library is unavailable."""
+    the largest device upload); ``d_out`` the payload column count (2 for
+    planar coordinates, 3 for spherical-chord kernel coordinates).
+    Returns (buf, mask, idx, fold, st, sp, cx, cgid) or None when the
+    native library is unavailable."""
     L = lib()
     if L is None or dtype not in (np.float32, np.float64):
         return None
@@ -344,7 +348,9 @@ def pack_banded_group(
             f"got run tables of width {ustarts.shape[1]}"
         )
     pts = np.ascontiguousarray(pts, dtype=np.float64)
-    buf = np.empty((p_pad, b, 2), dtype=dtype)
+    if pts.shape[1] < d_out:
+        raise ValueError(f"payload wants {d_out} columns, pts has {pts.shape[1]}")
+    buf = np.empty((p_pad, b, d_out), dtype=dtype)
     mask = np.empty((p_pad, b), dtype=np.uint8)
     idx = np.empty((p_pad, b), dtype=np.int64)
     fold = np.empty((p_pad, b), dtype=np.int32)
@@ -371,7 +377,7 @@ def pack_banded_group(
         np.ascontiguousarray(ustarts, dtype=np.int32),
         np.ascontiguousarray(uspans, dtype=np.int32),
         np.ascontiguousarray(sstart, dtype=np.int32),
-        maxnb, tblock, b,
+        maxnb, tblock, b, d_out,
         buf, mask, idx, fold, st, sp, cxb, cgid,
     )
     return buf, mask.view(bool), idx, fold, st, sp, cxb, cgid

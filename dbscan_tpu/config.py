@@ -120,9 +120,13 @@ class DBSCANConfig:
                 'neighbor_backend must be "auto", "dense", or "banded", got '
                 f"{self.neighbor_backend!r}"
             )
-        if self.neighbor_backend == "banded" and self.metric != "euclidean":
+        if self.neighbor_backend == "banded" and self.metric not in (
+            "euclidean",
+            "haversine",
+        ):
             raise ValueError(
-                "neighbor_backend='banded' supports only the euclidean "
-                f"metric (eps-cell grids), got {self.metric!r}"
+                "neighbor_backend='banded' supports the euclidean metric "
+                "(eps-cell grids) and haversine (equirectangular grid + "
+                f"chord kernel, ops/sphere.py), got {self.metric!r}"
             )
         return self

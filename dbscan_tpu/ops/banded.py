@@ -87,14 +87,14 @@ def _tile_machinery(points, mask, rel_starts, spans, slab_starts, eps, slab):
     spans = spans.astype(jnp.int32)
     eps2 = jnp.asarray(eps, dtype=points.dtype) ** 2
     offs = jnp.arange(slab, dtype=jnp.int32)
-    # Coordinate planes: slicing [..., 2]-shaped rows would pad the minor
-    # dim to the 128-lane tile on TPU; [B] planes slice cleanly.
-    px = points[:, 0]
-    py = points[:, 1]
+    # Coordinate planes: slicing [..., D]-shaped rows would pad the minor
+    # dim to the 128-lane tile on TPU; [B] planes slice cleanly. D is 2 for
+    # planar runs, 3 for spherical-chord runs (ops/sphere.py) — the
+    # difference-form distance generalizes as a static unrolled sum.
+    planes = tuple(points[:, j] for j in range(points.shape[1]))
 
     blocks = (
-        px.reshape(nb, t),
-        py.reshape(nb, t),
+        tuple(pl.reshape(nb, t) for pl in planes),
         mask.reshape(nb, t),
         rel_starts.reshape(nb, t, BANDED_ROWS),
         spans.reshape(nb, t, BANDED_ROWS),
@@ -110,15 +110,15 @@ def _tile_machinery(points, mask, rel_starts, spans, slab_starts, eps, slab):
             ]
         )
 
-    def tile_adj(bx, by, bm, brel, bspan, borig):
+    def tile_adj(bpl, bm, brel, bspan, borig):
         """The fused [T, R, S] adjacency tile of one block (never stored
         across sweeps — recomputed wherever it is consumed)."""
-        sx = slabs_of(px, borig)  # [R, S]
-        sy = slabs_of(py, borig)
+        d2 = None
+        for pl, bp in zip(planes, bpl):
+            sl = slabs_of(pl, borig)  # [R, S]
+            df = bp[:, None, None] - sl[None, :, :]  # [T, R, S]
+            d2 = df * df if d2 is None else d2 + df * df
         sm = slabs_of(mask, borig)
-        dx = bx[:, None, None] - sx[None, :, :]  # [T, R, S]
-        dy = by[:, None, None] - sy[None, :, :]
-        d2 = dx * dx + dy * dy
         inrun = (offs[None, None, :] >= brel[:, :, None]) & (
             offs[None, None, :] < (brel + bspan)[:, :, None]
         )
@@ -142,8 +142,10 @@ def banded_phase1(
     """Sweeps 1+2: eps-neighbor counts and the window-cell edge bitmask.
 
     Args:
-      points: [B, 2] coordinates in CELL-SORTED order (padding at the tail);
-        B a multiple of BANDED_BLOCK.
+      points: [B, D] (D in {2, 3}) coordinates in CELL-SORTED order
+        (padding at the tail); B a multiple of BANDED_BLOCK. D == 3 is the
+        spherical-chord payload (ops/sphere.py) — cells/runs then live in
+        the projected grid space while distances are measured here.
       mask: [B] validity.
       rel_starts/spans: [B, BANDED_ROWS] int32 run starts (relative to the
         row's block slab) / lengths.
@@ -176,8 +178,8 @@ def banded_phase1(
     cx_blocks = cx.reshape(nb, BANDED_BLOCK)
 
     def bits_block(args):
-        bx, by, bm, brel, bspan, borig, bcx = args
-        adj = tile_adj(bx, by, bm, brel, bspan, borig)
+        bpl, bm, brel, bspan, borig, bcx = args
+        adj = tile_adj(bpl, bm, brel, bspan, borig)
         score = slabs_of(core, borig)  # [R, S] col core mask
         adj_cc = adj & score[None, :, :]
         scx = slabs_of(cx, borig)  # [R, S] col cell columns

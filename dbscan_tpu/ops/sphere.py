@@ -1,0 +1,181 @@
+"""Spherical geometry: the haversine metric's spatial decomposition.
+
+The reference is strictly 2-D euclidean — its distance is dx*dx + dy*dy on
+the raw coordinates (DBSCANPoint.scala:26-30) and its 2eps-grid
+decomposition snaps those coordinates directly (DBSCAN.scala:345-356).
+Great-circle workloads (lon/lat in degrees, eps in km) therefore ran as a
+SINGLE partition in round 1, which caps them at toy scale. This module
+supplies the metric-aware decomposition VERDICT r1 ranked first, split
+into two coordinate systems with exact, auditable error bounds:
+
+GRID SPACE — an equirectangular projection to kilometers::
+
+    x = R * lon_rad * cos_min,   y = R * lat_rad
+
+with ``cos_min`` the minimum cos(lat) over the data's latitude range. For
+any two data points, the projected euclidean distance NEVER exceeds the
+great-circle distance by more than a curvature term of relative size
+~(eps/(R*cos_min))^2 (proof sketch: hav >= 2R*sqrt(sin^2(dphi/2) +
+cos(phi1)cos(phi2) sin^2(dlambda/2)) >= proj * (1 - dmax^2/24) using
+sin x >= x(1 - x^2/6) and cos(phi_i) >= cos_min). So the existing
+integer-grid partitioner, eps-halo duplication, and merge-band machinery
+run UNCHANGED on projected coordinates with eps grown by a computed slack
+(``eps_spatial``): every pair the kernel can accept is covered by some
+partition's grown rectangle, exactly like the euclidean case
+(DBSCAN.scala:345-356 generalized).
+
+KERNEL SPACE — centered 3-D chord coordinates::
+
+    u = R * (cos(lat)cos(lon), cos(lat)sin(lon), sin(lat)) - centroid
+
+Chord length and great-circle distance are both strictly increasing in
+the central angle, so ``hav(p, q) <= eps  <=>  |u_p - u_q| <=
+chord_eps(eps) = 2R sin(eps / 2R)`` EXACTLY — the local engines run their
+euclidean machinery (difference-form f32, D <= 4) on [x, y, z] with a
+rescaled threshold: no transcendental per-pair math on the device, and no
+approximation in the accept test itself. Centering bounds the f32
+quantization of the stored coordinates by the dataset's chord radius
+instead of the earth's.
+
+The banded engine's fine grid lives in GRID space while its distance test
+runs in KERNEL space, so its two structural guarantees pick up the
+projection's distortion ratio ``r = cos_max / cos_min``:
+
+- CLIQUE (same fine cell => kernel accepts the pair) holds when the fine
+  grid is built from ``grid_eps = eps * (1 - slack) / r``;
+- REACH (kernel-accepted pair => within +-2 fine cells) then needs
+  ``r * (1 + slack) <= sqrt(2) * (1 - 1e-5) * (1 - slack)`` — satisfied
+  by every real geospatial dataset short of a ~49-degree latitude span
+  (``banded_ok``); wider spans fall back to the dense kernel per
+  partition, still spatially decomposed.
+
+Datasets the projection cannot serve — points within an eps margin of
+both sides of the antimeridian, or within eps of a pole — are detected
+and refused (:func:`embed` returns None) and the driver keeps round 1's
+single-partition behavior for them.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from dbscan_tpu.ops.distance import EARTH_RADIUS_KM
+
+# |lat| beyond this (degrees) is "near-pole": cos(lat) < 0.0098, the
+# equirectangular x-scale degenerates and lon spans blow up.
+MAX_ABS_LAT_DEG = 85.0
+
+# Reach headroom for the banded engine: r * (1 + slack) must stay under
+# sqrt(2) * (1 - 1e-5) * (1 - slack); require a 1e-3 margin on top.
+_REACH_LIMIT = float(np.sqrt(2.0)) * (1.0 - 1e-5) * (1.0 - 1e-3)
+
+
+class SphericalEmbedding(NamedTuple):
+    """Everything the driver needs to run the euclidean pipeline on
+    great-circle data. All lengths in km."""
+
+    proj: np.ndarray  # [N, 2] float64 equirectangular grid coordinates
+    chord: np.ndarray  # [N, 3] float64 centered chord kernel coordinates
+    eps_chord: float  # kernel accept threshold: 2R sin(eps / 2R)
+    eps_spatial: float  # halo/margin growth in grid space (>= eps)
+    grid_eps: float  # banded fine-grid scale (<= eps), clique-safe
+    cos_ratio: float  # r = cos_max / cos_min over the data's lat range
+    slack: float  # relative error budget behind the two eps above
+    banded_ok: bool  # reach constraint satisfied for the banded engine
+
+
+def chord_threshold(eps_km: float) -> float:
+    """Chord length equivalent to great-circle distance ``eps_km``."""
+    return float(
+        2.0 * EARTH_RADIUS_KM * np.sin(eps_km / (2.0 * EARTH_RADIUS_KM))
+    )
+
+
+def embed(
+    lonlat_deg: np.ndarray, eps_km: float, f32: bool = True
+) -> Optional[SphericalEmbedding]:
+    """Build the two-coordinate-system embedding, or None when the data
+    cannot be safely projected (antimeridian wrap, near-pole points, or an
+    eps so large the curvature slack collapses the margins).
+
+    lonlat_deg: [N, 2] (longitude, latitude) in degrees — the haversine
+    metric's column convention (ops/distance.py::_haversine).
+    f32: kernel coordinates will be cast to float32 (default precision);
+    sizes the quantization part of the slack budget.
+    """
+    ll = np.asarray(lonlat_deg, dtype=np.float64)[:, :2]
+    if len(ll) == 0:
+        return None
+    # normalize longitudes to [-180, 180): changes nothing for haversine
+    # (periodic in dlon) but gives the projection one consistent branch
+    lon = np.mod(ll[:, 0] + 180.0, 360.0) - 180.0
+    lat = ll[:, 1]
+    lat_min = float(lat.min())
+    lat_max = float(lat.max())
+    if max(abs(lat_min), abs(lat_max)) > MAX_ABS_LAT_DEG:
+        return None
+
+    r_earth = EARTH_RADIUS_KM
+    theta = eps_km / r_earth  # central angle of eps
+    cos_min = float(np.cos(np.deg2rad(max(abs(lat_min), abs(lat_max)))))
+    # margin (degrees of longitude) within which a point can reach across
+    # the antimeridian seam
+    seam_deg = np.rad2deg(theta / cos_min) * 1.01 + 1e-9
+    if float(lon.max()) > 180.0 - seam_deg and float(
+        lon.min()
+    ) < -180.0 + seam_deg:
+        return None
+
+    abs_lo = (
+        0.0
+        if lat_min <= 0.0 <= lat_max
+        else min(abs(lat_min), abs(lat_max))
+    )
+    cos_max = float(np.cos(np.deg2rad(abs_lo)))
+    ratio = cos_max / cos_min
+
+    lam = np.deg2rad(lon)
+    phi = np.deg2rad(lat)
+    proj = np.empty((len(ll), 2), dtype=np.float64)
+    proj[:, 0] = r_earth * cos_min * lam
+    proj[:, 1] = r_earth * phi
+    cp = np.cos(phi)
+    chord = np.empty((len(ll), 3), dtype=np.float64)
+    chord[:, 0] = r_earth * cp * np.cos(lam)
+    chord[:, 1] = r_earth * cp * np.sin(lam)
+    chord[:, 2] = r_earth * np.sin(phi)
+    chord -= chord.mean(axis=0)
+
+    eps_chord = chord_threshold(eps_km)
+    # Slack budget (relative):
+    # - curvature: the sin/asin second-order terms in both direction
+    #   bounds are < (dmax^2)/4 with dmax <= theta/cos_min the largest
+    #   angular separation of an acceptable pair;
+    # - quantization: centered kernel coordinates of magnitude E stored in
+    #   f32 perturb a distance by at most ~4E*2^-24 absolute (two
+    #   endpoints x three coordinates, difference form), taken relative
+    #   to eps_chord with a 1.5x cushion.
+    curv = (theta / cos_min) ** 2 / 4.0
+    extent = float(np.abs(chord).max()) if len(chord) else 0.0
+    quant = (6.0 * extent * 2.0**-24 / eps_chord) if f32 else (
+        6.0 * extent * 2.0**-53 / eps_chord
+    )
+    slack = curv + quant + 1e-9
+    if slack > 1e-2:  # margins no longer meaningfully conservative
+        return None
+
+    eps_spatial = eps_km * (1.0 + slack)
+    grid_eps = eps_km * (1.0 - slack) / ratio
+    banded_ok = ratio * (1.0 + slack) / (1.0 - slack) <= _REACH_LIMIT
+    return SphericalEmbedding(
+        proj=proj,
+        chord=chord,
+        eps_chord=eps_chord,
+        eps_spatial=eps_spatial,
+        grid_eps=grid_eps,
+        cos_ratio=ratio,
+        slack=slack,
+        banded_ok=banded_ok,
+    )

@@ -398,13 +398,24 @@ def bucketize_banded(
     dtype=np.float32,
     force: bool = False,
     on_group=None,
+    grid_points: np.ndarray = None,
 ) -> Tuple[list, int, "CellGraphMeta"]:
     """Pack partitions for the banded engine (dbscan_tpu/ops/banded.py).
 
     Per partition: snap instances to the FINE grid (eps/sqrt(2) cells, see
     FINE_CELL_FACTOR), sort by cell row-major (stable, so equal-cell points
     keep fold order), and precompute each point's five contiguous candidate
-    runs — one per window cell row — in the sorted order. Runs are grouped
+    runs — one per window cell row — in the sorted order.
+
+    ``grid_points``, when given, decouples the two coordinate systems: the
+    fine grid, windows, and runs are built from ``grid_points`` [N, 2]
+    (float64, no device cast — e.g. the equirectangular projection of
+    spherical data, ops/sphere.py) while the device buffers carry
+    ``points`` [N, D<=4] (e.g. 3-D chord coordinates) for the distance
+    sweeps; ``eps`` then is the GRID-space scale (sphere.grid_eps), whose
+    clique/reach margins versus the kernel threshold are the caller's
+    contract. Without it, both roles fall to ``points`` and cells are
+    computed from the f32-cast coordinates the device will see. Runs are grouped
     by blocks of BANDED_BLOCK consecutive rows: the per-(block, row) union
     of runs is the contiguous SLAB the device fetches with one
     dynamic_slice; the static slab bound S is the padded max slab length.
@@ -423,8 +434,22 @@ def bucketize_banded(
     ``banded`` is set on the banded groups.
     """
     pts = np.asarray(points)
-    if pts.shape[1] != 2:
-        raise ValueError(f"banded bucketing is 2-D only, got D={pts.shape[1]}")
+    gpts = None if grid_points is None else np.asarray(grid_points)
+    if gpts is None:
+        if pts.shape[1] != 2:
+            raise ValueError(
+                f"banded bucketing is 2-D only, got D={pts.shape[1]}"
+            )
+    else:
+        if gpts.shape[1] != 2:
+            raise ValueError(
+                f"grid_points must be [N, 2], got D={gpts.shape[1]}"
+            )
+        if pts.shape[1] > 4:
+            raise ValueError(
+                "banded kernel payload is limited to D<=4 (difference-form "
+                f"distance path), got D={pts.shape[1]}"
+            )
     m_tot = part_ids.size
     counts = np.bincount(part_ids, minlength=n_parts)
     part_start = np.concatenate([[0], np.cumsum(counts)])[:-1]
@@ -460,10 +485,16 @@ def bucketize_banded(
         if dtype in (np.float32, np.float64)
         else None
     )
+    # grid source: the payload coordinates themselves (f32-cast to match
+    # the device) or the separate grid projection (f64, never cast — the
+    # device measures in a different coordinate system entirely)
+    grid64 = (
+        pts64 if gpts is None else np.ascontiguousarray(gpts, np.float64)
+    )
     native = (
         _native.fine_cells(
-            pts64, point_idx, part_ids, outer, inv_cell, n_parts,
-            dtype == np.float32,
+            grid64, point_idx, part_ids, outer, inv_cell, n_parts,
+            dtype == np.float32 and gpts is None,
         )
         if pts64 is not None
         else None
@@ -475,11 +506,14 @@ def bucketize_banded(
         cx, cy, cxmax, cymax = native
         xy_store = None
     else:
-        # Cast the whole [N, 2] input once and gather in the device dtype —
+        # Cast the whole [N, D] input once and gather in the device dtype —
         # the gathered array IS the group-buffer payload, so the per-group
         # astype disappears too.
         xy_store = np.asarray(pts, dtype=dtype)[point_idx]
-        xy_dev = xy_store.astype(np.float64)
+        if gpts is None:
+            xy_dev = xy_store.astype(np.float64)
+        else:
+            xy_dev = np.asarray(gpts, dtype=np.float64)[point_idx]
         ox = outer[part_ids, 0]
         oy = outer[part_ids, 1]
         cx = np.maximum(
@@ -678,7 +712,7 @@ def bucketize_banded(
             _native.pack_banded_group(
                 sel_parts, p_pad, part_start, counts, order, pts64,
                 point_idx, cx_s, cell_rank, ustarts, uspans, sstart32,
-                maxnb, t, b, dtype, run_dtype,
+                maxnb, t, b, dtype, run_dtype, d_out=pts.shape[1],
             )
             if native is not None
             else None
@@ -686,7 +720,7 @@ def bucketize_banded(
         if packed is not None:
             buf, mask, idx, fold_b, st_b, sp_b, cx_b, cgid_b = packed
         else:
-            buf = np.zeros((p_pad, b, 2), dtype=dtype)
+            buf = np.zeros((p_pad, b, pts.shape[1]), dtype=dtype)
             mask = np.zeros((p_pad, b), dtype=bool)
             idx = np.full((p_pad, b), -1, dtype=np.int64)
             iota = np.arange(b, dtype=np.int32)

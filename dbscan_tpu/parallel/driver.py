@@ -78,8 +78,10 @@ def _check_dense_width(b: int, n: int) -> None:
         f"or over the dense-engine width limit of {DENSE_WIDTH_LIMIT} "
         "slots (a 17 GiB matrix does not fit a single chip's HBM). The "
         "dense kernel is the only engine for metrics without a spatial "
-        "decomposition. Alternatives: use metric='euclidean' (decomposes "
-        "spatially and scales via the banded engine); lower "
+        "decomposition. Alternatives: use metric='euclidean' or "
+        "metric='haversine' (for data clear of the poles and the "
+        "antimeridian seam, both decompose spatially and scale via the "
+        "banded engine); lower "
         "max_points_per_partition (spatial metrics only); or "
         "subsample/pre-partition the data so each train() call stays "
         f"under {DENSE_WIDTH_LIMIT} points per partition"
@@ -223,7 +225,9 @@ def _banded_batch(group, mesh) -> int:
     return max(1, min(8, mem_cap, p_total // max(1, mesh_size(mesh))))
 
 
-def _dispatch_partitions(group, cfg: DBSCANConfig, mesh):
+def _dispatch_partitions(
+    group, cfg: DBSCANConfig, mesh, kernel_eps=None, kernel_metric=None
+):
     """Fan the dense/pallas local kernel out over the partition axis (async
     dispatch).
 
@@ -232,6 +236,10 @@ def _dispatch_partitions(group, cfg: DBSCANConfig, mesh):
     equivalent of one Spark executor looping its assigned tasks
     (DBSCAN.scala:150-154), but compiled. Returns device arrays without
     blocking so successive bucket groups overlap on the device queue.
+
+    kernel_eps/kernel_metric override cfg's user-facing values when the
+    kernel measures in a different space than the user's metric (spherical
+    chord coordinates with a chord threshold, ops/sphere.py).
     """
     p_total, b = group.points.shape[:2]
     # vmap small batches of partitions for utilization, capped so the
@@ -252,10 +260,10 @@ def _dispatch_partitions(group, cfg: DBSCANConfig, mesh):
         mem_cap = max(1, int(1.2e9) // (b * b))
         batch = max(1, min(8, mem_cap, p_total // max(1, mesh_size(mesh))))
     fn = _compiled_block(
-        float(cfg.eps),
+        float(kernel_eps if kernel_eps is not None else cfg.eps),
         int(cfg.min_points),
         cfg.engine.value,
-        cfg.metric,
+        kernel_metric if kernel_metric is not None else cfg.metric,
         bool(cfg.use_pallas),
         batch,
         mesh,
@@ -263,11 +271,12 @@ def _dispatch_partitions(group, cfg: DBSCANConfig, mesh):
     return fn(group.points, group.mask)
 
 
-def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh):
-    """Async phase-1 dispatch for one banded group: (counts, core, bits)."""
+def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh, kernel_eps=None):
+    """Async phase-1 dispatch for one banded group: (counts, core, bits).
+    kernel_eps overrides cfg.eps when the payload is chord coordinates."""
     ext = group.banded
     fn = _compiled_banded_p1(
-        float(cfg.eps),
+        float(kernel_eps if kernel_eps is not None else cfg.eps),
         int(cfg.min_points),
         int(ext.slab),
         _banded_batch(group, mesh),
@@ -441,6 +450,7 @@ def train_arrays(
                 "duplication_factor": 0.0,
                 "n_clusters": 0,
                 "n_core_instances": 0,
+                "projected": False,
                 "timings": {},
             },
         )
@@ -454,16 +464,60 @@ def train_arrays(
         timings[phase] = round(now - t0, 6)
         return now
 
-    # The 2eps-grid spatial decomposition is Euclidean geometry on the first
-    # two coordinates (reference DBSCAN.scala:33-34, :345-356). Non-Euclidean
-    # metrics (haversine km, cosine on embeddings) have different units and
-    # neighborhoods that raw coordinate rectangles cannot bound, so they run
-    # as a single partition (the local kernel handles any metric/D);
-    # metric-aware spatial decomposition is future work.
+    # The 2eps-grid spatial decomposition is geometry on the first two
+    # coordinates (reference DBSCAN.scala:33-34, :345-356) — natively
+    # euclidean. The haversine metric joins it through the equirectangular
+    # projection + chord-coordinate embedding (ops/sphere.py): the grid,
+    # partitioner, halo, and merge run on projected km while the kernels
+    # measure exact great-circle-equivalent chord distances. Datasets the
+    # projection cannot serve (antimeridian wrap, near-pole, bf16) keep the
+    # single-partition path. Cosine/user metrics have no 2-D spatial
+    # structure at all and always run single-partition.
     spatial = cfg.metric == "euclidean"
     # Euclidean clusters on the first two columns only, like the reference;
-    # other metrics see every column.
+    # other metrics see every column (haversine reads lon/lat from the
+    # first two, ops/distance.py::_haversine).
     kernel_cols = pts[:, :2] if spatial else pts
+    kernel_eps = float(cfg.eps)
+    kernel_metric = cfg.metric
+    eps_spatial = float(cfg.eps)
+    grid_eps = float(cfg.eps)
+    sph = None
+    if (
+        cfg.metric == "haversine"
+        and not cfg.use_pallas
+        and cfg.precision.value in ("f32", "f64")
+    ):
+        from dbscan_tpu.ops import sphere
+
+        sph = sphere.embed(
+            pts, float(cfg.eps), f32=cfg.precision.value == "f32"
+        )
+        if cfg.neighbor_backend == "banded" and (
+            sph is None or not sph.banded_ok
+        ):
+            # honoring the force would break the banded engine's
+            # clique/reach guarantees — degrade loudly, not silently
+            logger.warning(
+                "neighbor_backend='banded' requested but this spherical "
+                "dataset cannot use it (%s); running the %s instead",
+                "projection refused: antimeridian/pole/slack"
+                if sph is None
+                else f"latitude span too wide: cos_ratio {sph.cos_ratio:.3f} "
+                "fails the reach margin",
+                "single-partition dense kernel"
+                if sph is None
+                else "spatially-decomposed dense kernel",
+            )
+        if sph is not None:
+            spatial = True
+            kernel_cols = sph.chord
+            kernel_eps = sph.eps_chord
+            kernel_metric = "euclidean"
+            eps_spatial = sph.eps_spatial
+            grid_eps = sph.grid_eps
+    # grid-space coordinates for histogram/partition/halo/merge geometry
+    grid_pts = sph.proj if sph is not None else pts
     if not spatial and not cfg.use_pallas:
         # single partition, dense engine: the whole dataset is one bucket
         _check_dense_width(binning._ladder_width(n, cfg.bucket_multiple), n)
@@ -471,7 +525,7 @@ def train_arrays(
     if spatial:
         # 1-2. cell histogram + spatial partitioning (driver-local metadata).
         t0 = time.perf_counter()
-        cells, counts, cell_inv = geo.cell_histogram_int(pts, cell)
+        cells, counts, cell_inv = geo.cell_histogram_int(grid_pts, cell)
         t0 = _mark("histogram_s", t0)
         parts = partitioner.partition_cells(
             cells, counts, cfg.max_points_per_partition
@@ -479,8 +533,9 @@ def train_arrays(
         _mark("partition_s", t0)
         rects_int = np.stack([r for r, _ in parts])
         logger.info("found %d partitions for %d points", len(parts), n)
-        # 3. margins.
-        margins = binning.build_margins(rects_int, cell, cfg.eps)
+        # 3. margins (grown by eps_spatial: eps plus the projection's
+        # slack budget — equals eps exactly for euclidean runs).
+        margins = binning.build_margins(rects_int, cell, eps_spatial)
     else:
         rects_int = None
         lo = pts[:, :2].min(axis=0)
@@ -496,7 +551,7 @@ def train_arrays(
     t0 = time.perf_counter()
     if rects_int is not None:
         part_ids, point_idx = binning.duplicate_points_grid(
-            pts, cells, cell_inv, rects_int, margins.outer
+            grid_pts, cells, cell_inv, rects_int, margins.outer
         )
     else:
         part_ids, point_idx = binning.duplicate_points(pts, margins.outer)
@@ -524,9 +579,15 @@ def train_arrays(
     use_banded = (
         cfg.neighbor_backend != "dense"
         and not cfg.use_pallas
-        and cfg.metric == "euclidean"
+        and kernel_metric == "euclidean"
         and cfg.precision.value != "bf16"
-        and kernel_cols.shape[1] == 2
+        and (
+            kernel_cols.shape[1] == 2
+            # spherical chord payload: requires the projection's reach
+            # margin (latitude spans past ~49 degrees fail it and run the
+            # dense kernel per partition — still spatially decomposed)
+            or (sph is not None and sph.banded_ok)
+        )
     )
     # Dispatch each group's device program the moment its buffers are
     # packed (on_group): the first groups' sweeps run while later groups
@@ -538,9 +599,11 @@ def train_arrays(
     def _on_group(g):
         td = time.perf_counter()
         if g.banded is None:
-            pending.append((g, _dispatch_partitions(g, cfg, mesh)))
+            pending.append(
+                (g, _dispatch_partitions(g, cfg, mesh, kernel_eps, kernel_metric))
+            )
         else:
-            pending.append((g, _dispatch_banded_p1(g, cfg, mesh)))
+            pending.append((g, _dispatch_banded_p1(g, cfg, mesh, kernel_eps)))
         dispatch_spent[0] += time.perf_counter() - td
 
     cellmeta = None
@@ -550,13 +613,14 @@ def train_arrays(
             part_ids,
             point_idx,
             n_parts=margins.main.shape[0],
-            eps=float(cfg.eps),
+            eps=grid_eps,
             outer=margins.outer,
             bucket_multiple=cfg.bucket_multiple,
             pad_parts_to=mesh_size(mesh),
             dtype=dtype,
             force=cfg.neighbor_backend == "banded",
             on_group=_on_group,
+            grid_points=None if sph is None else sph.proj,
         )
     else:
         groups, max_b = binning.bucketize_grouped(
@@ -673,7 +737,8 @@ def train_arrays(
     # device-independent merge precomputation (overlaps the device window)
     if rects_int is not None:
         band_any, inst_inner = _classify_instances(
-            pts, cells, cell_inv, rects_int, margins, inst_part, inst_ptidx
+            grid_pts, cells, cell_inv, rects_int, margins, inst_part,
+            inst_ptidx,
         )
     else:
         band_any = _band_membership(pts, margins, part_ids, point_idx)
@@ -899,6 +964,7 @@ def train_arrays(
         "duplication_factor": float(len(part_ids)) / max(1, n),
         "n_clusters": n_clusters,
         "n_core_instances": n_core,
+        "projected": sph is not None,  # spherical embedding in effect
         "timings": timings,
     }
     return TrainOutput(res_cluster, res_flag, partitions, n_clusters, stats)

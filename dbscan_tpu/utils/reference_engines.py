@@ -32,12 +32,28 @@ from dbscan_tpu.ops import geometry as geo
 from dbscan_tpu.ops.labels import BORDER, CORE, NOISE, NOT_FLAGGED
 
 
-def _fit(points: np.ndarray, eps: float, min_points: int, adopt_visited_noise: bool):
-    pts = np.asarray(points, dtype=np.float64)[:, :2]
+def _fit(
+    points: np.ndarray,
+    eps: float,
+    min_points: int,
+    adopt_visited_noise: bool,
+    metric: str = "euclidean",
+):
+    pts = np.asarray(points, dtype=np.float64)
     n = len(pts)
-    d2 = geo.pairwise_sq_dists(pts, pts)
-    eps_sq = float(eps) * float(eps)
-    nbr_lists = [np.flatnonzero(d2[i] <= eps_sq) for i in range(n)]
+    if metric == "euclidean":
+        pts2 = pts[:, :2]
+        d2 = geo.pairwise_sq_dists(pts2, pts2)
+        thr = float(eps) * float(eps)
+    else:
+        # float64 measure straight from the metric registry (the jnp
+        # formulas run fine on host numpy under x64 — test-only path)
+        from dbscan_tpu.ops.distance import get_metric
+
+        m = get_metric(metric)
+        d2 = np.asarray(m.pairwise(pts, pts), dtype=np.float64)
+        thr = float(m.threshold(eps))
+    nbr_lists = [np.flatnonzero(d2[i] <= thr) for i in range(n)]
 
     visited = np.zeros(n, dtype=bool)
     flags = np.full(n, NOT_FLAGGED, dtype=np.int8)
@@ -73,15 +89,23 @@ def _fit(points: np.ndarray, eps: float, min_points: int, adopt_visited_noise: b
     return cluster, flags
 
 
-def naive_fit(points, eps, min_points) -> Tuple[np.ndarray, np.ndarray]:
+def naive_fit(
+    points, eps, min_points, metric="euclidean"
+) -> Tuple[np.ndarray, np.ndarray]:
     """Oracle for the Naive engine (no adoption of visited noise)."""
-    return _fit(points, eps, min_points, adopt_visited_noise=False)
+    return _fit(
+        points, eps, min_points, adopt_visited_noise=False, metric=metric
+    )
 
 
-def archery_fit(points, eps, min_points) -> Tuple[np.ndarray, np.ndarray]:
+def archery_fit(
+    points, eps, min_points, metric="euclidean"
+) -> Tuple[np.ndarray, np.ndarray]:
     """Oracle for the Archery/textbook engine (visited noise adopted as
     Border), with exact d^2 <= eps^2 range queries (we do not reproduce the
     reference's Float-truncated R-tree bounding boxes,
     LocalDBSCANArchery.scala:118-124, which can drop boundary-exact
     neighbors by rounding)."""
-    return _fit(points, eps, min_points, adopt_visited_noise=True)
+    return _fit(
+        points, eps, min_points, adopt_visited_noise=True, metric=metric
+    )

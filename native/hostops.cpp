@@ -439,7 +439,9 @@ void pack_banded_group_impl(
     const int32_t* uspans,     // [U, 5] per-cell run lengths
     const int32_t* sstart,     // [P * maxnb, 5] slab origins
     int64_t maxnb, int64_t tblock, int64_t b,
-    T* buf,                    // [p_pad, b, 2] out
+    int64_t d_out,             // payload columns copied into buf (2 for
+                               // planar runs, 3 for spherical-chord runs)
+    T* buf,                    // [p_pad, b, d_out] out
     uint8_t* mask,             // [p_pad, b] out
     int64_t* idx,              // [p_pad, b] out
     int32_t* fold_b,           // [p_pad, b] out
@@ -452,7 +454,7 @@ void pack_banded_group_impl(
     const int64_t p = g < n_sel ? sel_parts[g] : -1;
     const int64_t cnt = p >= 0 ? counts[p] : 0;
     const int64_t s0 = p >= 0 ? part_start[p] : 0;
-    T* rbuf = buf + g * b * 2;
+    T* rbuf = buf + g * b * d_out;
     uint8_t* rmask = mask + g * b;
     int64_t* ridx = idx + g * b;
     int32_t* rfold = fold_b + g * b;
@@ -464,8 +466,9 @@ void pack_banded_group_impl(
       const int64_t gi = s0 + s;            // sorted position
       const int64_t inst = order[gi];       // original instance row
       const int64_t pi = point_idx[inst];
-      rbuf[2 * s] = static_cast<T>(pts[pts_stride * pi]);
-      rbuf[2 * s + 1] = static_cast<T>(pts[pts_stride * pi + 1]);
+      for (int64_t c = 0; c < d_out; ++c) {
+        rbuf[d_out * s + c] = static_cast<T>(pts[pts_stride * pi + c]);
+      }
       rmask[s] = 1;
       ridx[s] = pi;
       rfold[s] = static_cast<int32_t>(inst - s0);
@@ -481,8 +484,9 @@ void pack_banded_group_impl(
       rcgid[s] = cr;
     }
     for (int64_t s = cnt; s < b; ++s) {
-      rbuf[2 * s] = static_cast<T>(0);
-      rbuf[2 * s + 1] = static_cast<T>(0);
+      for (int64_t c = 0; c < d_out; ++c) {
+        rbuf[d_out * s + c] = static_cast<T>(0);
+      }
       rmask[s] = 0;
       ridx[s] = -1;
       rfold[s] = static_cast<int32_t>(s);
@@ -508,14 +512,14 @@ extern "C" {
       const int64_t* point_idx, const int64_t* cx_s,                        \
       const int64_t* cell_rank, const int32_t* ustarts,                     \
       const int32_t* uspans, const int32_t* sstart, int64_t maxnb,          \
-      int64_t tblock, int64_t b, T* buf, uint8_t* mask, int64_t* idx,       \
-      int32_t* fold_b, TS* st_b, TS* sp_b, int32_t* cx_b,                   \
+      int64_t tblock, int64_t b, int64_t d_out, T* buf, uint8_t* mask,      \
+      int64_t* idx, int32_t* fold_b, TS* st_b, TS* sp_b, int32_t* cx_b,     \
       int64_t* cgid_b) {                                                    \
     pack_banded_group_impl<T, TS>(                                          \
         sel_parts, n_sel, p_pad, part_start, counts, order, pts,            \
         pts_stride, point_idx, cx_s, cell_rank, ustarts, uspans, sstart,    \
-        maxnb, tblock, b, buf, mask, idx, fold_b, st_b, sp_b, cx_b,         \
-        cgid_b);                                                            \
+        maxnb, tblock, b, d_out, buf, mask, idx, fold_b, st_b, sp_b,        \
+        cx_b, cgid_b);                                                      \
   }
 
 DEFINE_PACK(f32, float, int32_t)
