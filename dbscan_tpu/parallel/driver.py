@@ -649,15 +649,19 @@ def train_arrays(
     # Banded groups go out as phase 1 (counts/core/cell-edge bits); their
     # phase 2 follows after the host cell-components pass.
 
-    # Compact-transfer path (single-chip): the device link runs at ~15 MB/s
-    # down with ~0.5 s/pull latency, so instead of pulling every group's
-    # [P, B] core+bits (5 B/slot), dispatch a device post-pass that packs
-    # the core mask 8x and scans per-cell OR masks, keeping the raw bits in
-    # HBM for a targeted border-candidate gather (ops/banded.py
-    # ::banded_postpass). Under a mesh the outputs are sharded and the full
-    # pull path below stays in effect.
+    # Compact-transfer path: the device link runs at ~15 MB/s down with
+    # ~0.5 s/pull latency, so instead of pulling every group's [P, B]
+    # core+bits (5 B/slot), dispatch a device post-pass that packs the core
+    # mask 8x and scans per-cell OR masks, keeping the raw bits in HBM for
+    # a targeted border-candidate gather (ops/banded.py::banded_postpass).
+    # Under a mesh the phase-1 outputs arrive sharded over the partition
+    # axis; the postpass is BLOCK-local (SCAN_BLOCK divides every shard's
+    # P*B slots), so GSPMD partitions the pack/scan along the same axis and
+    # only the small or_idx gather and the final combo pull cross shards —
+    # multi-chip runs keep the ~16x pull reduction instead of falling back
+    # to full [P, B] pulls (VERDICT r1 item 4).
     compact = None
-    if cellmeta is not None and mesh is None:
+    if cellmeta is not None:
         b_idx = [i for i, (g, _) in enumerate(pending) if g.banded is not None]
         if b_idx:
             from dbscan_tpu.ops.banded import banded_postpass, gather_flat
@@ -780,7 +784,7 @@ def train_arrays(
             )
     elif cellmeta is not None:
         b_idx = [i for i, (g, _) in enumerate(pending) if g.banded is not None]
-        if b_idx:  # mesh runs and >=2^31-slot runs: full [P, B] pulls
+        if b_idx:  # >=2^31-flat-slot runs only: full [P, B] pulls
             p1_np = [
                 (
                     pending[i][0],
