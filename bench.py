@@ -1,5 +1,5 @@
 """Benchmark harness: distributed DBSCAN throughput on the local accelerator
-vs a CPU baseline of the SAME pipeline (XLA-CPU), plus ARI cross-check.
+vs a CPU baseline of the SAME pipeline (XLA-CPU), plus ARI cross-checks.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": <Mpoints/s on accelerator>, "unit": "Mpoints/s",
@@ -7,7 +7,15 @@ Prints exactly ONE JSON line:
 
 The reference publishes no numbers (BASELINE.md); the baseline here is the
 same workload on XLA-CPU in a subprocess — a strictly stronger baseline than
-Spark-CPU's scalar JVM loops for this O(B^2)-per-partition algorithm.
+Spark-CPU's scalar JVM loops for this O(B^2)-per-partition algorithm (see
+BASELINE.md "honest-comparison note" for why, and why extrapolating its 100k
+rate overstates it).
+
+Correctness in the line itself:
+- ari_vs_cpu: accelerator vs XLA-CPU labels on the cpu_n-point subset;
+- ari_full: the TIMED full-N accelerator run's labels vs an independent
+  second full-N run at a different partitioning (maxpp/2 — different
+  bucket widths, halo routes, and merge order must reproduce the labels).
 
 Env knobs: BENCH_N (points, default 1M), BENCH_MAXPP (max points per
 partition on the accelerator, default 262144 — large partitions route the
@@ -16,7 +24,11 @@ measured fastest at 1M on v5e), BENCH_CPU_MAXPP (baseline partition size,
 default 2048 — the CPU's own sweet spot; the quadratic per-partition cost
 favors smaller partitions there), BENCH_CPU_N (baseline points, default
 min(N, 100k)), BENCH_PALLAS (1 = route the accelerator run through the
-streaming Pallas kernels; the CPU baseline always uses the XLA path).
+streaming Pallas kernels; the CPU baseline always uses the XLA path),
+BENCH_ANCHOR (1 = append the 10M-point engineered-structure euclidean
+anchor: exact expected cluster count + ARI vs construction,
+BENCH_ANCHOR_N to resize), BENCH_HAVERSINE (1 = append the 10M-point
+NYC-like haversine row, BENCH_HAV_N to resize).
 """
 
 import json
@@ -50,7 +62,50 @@ def make_data(n: int) -> np.ndarray:
     return pts
 
 
-def run_train(pts, maxpp, use_pallas=False, reps=1):
+def make_anchor(n: int, haversine: bool):
+    """Engineered separated-cluster workload: K hotspots with known
+    membership (the >=10M correctness anchor, VERDICT r1 item 5). Returns
+    (points, blob_of [n_blob], n_blob, K, eps). Separation/spread are set
+    so every blob is one cluster and blobs never bridge: spacing >= 10x
+    eps, sigma ~ 0.3x eps; K scales with N so per-blob counts stay far
+    above minPts (~5000/blob at the 10M reference size)."""
+    rng = np.random.default_rng(42)
+    k = min(2000, max(16, n // 2500))
+    gx = int(np.ceil(np.sqrt(k)))
+    n_noise = n // 1000
+    n_blob = n - n_noise
+    blob_of = rng.integers(0, k, n_blob)
+    pts = np.empty((n, 2))
+    if haversine:
+        km_lat = 111.0
+        km_lon = 111.0 * np.cos(np.deg2rad(40.75))
+        centers = np.stack(
+            np.meshgrid(
+                -74.3 + (np.arange(gx) + 0.5) * 1.1 / km_lon,
+                40.5 + (np.arange(gx) + 0.5) * 1.1 / km_lat,
+            ),
+            -1,
+        ).reshape(-1, 2)[:k]
+        pts[:n_blob, 0] = centers[blob_of, 0] + rng.normal(
+            0, 0.030 / km_lon, n_blob
+        )
+        pts[:n_blob, 1] = centers[blob_of, 1] + rng.normal(
+            0, 0.030 / km_lat, n_blob
+        )
+        pts[n_blob:, 0] = rng.uniform(-74.3, -73.7, n_noise)
+        pts[n_blob:, 1] = rng.uniform(40.5, 41.0, n_noise)
+        eps = 0.1  # km
+    else:
+        centers = np.stack(
+            np.meshgrid(np.arange(gx) * 4.0, np.arange(gx) * 4.0), -1
+        ).reshape(-1, 2)[:k]
+        pts[:n_blob] = centers[blob_of] + rng.normal(0, 0.1, (n_blob, 2))
+        pts[n_blob:] = rng.uniform(-2, gx * 4.0, (n_noise, 2))
+        eps = EPS
+    return pts, blob_of, n_blob, k, eps
+
+
+def run_train(pts, maxpp, use_pallas=False, reps=1, **extra):
     from dbscan_tpu import Engine, train
 
     kw = dict(
@@ -60,6 +115,7 @@ def run_train(pts, maxpp, use_pallas=False, reps=1):
         engine=Engine.ARCHERY,
         use_pallas=use_pallas,
     )
+    kw.update(extra)
     # compile warm-up on identical shapes, then best-of-reps timed runs:
     # the TPU is reached over a shared tunnel whose transfer latency
     # fluctuates by >3x between runs, so a single timing is a lottery —
@@ -80,6 +136,29 @@ def child_cpu(data_path: str, out_path: str, maxpp: int) -> None:
     pts = np.load(data_path)["pts"]
     model, dt = run_train(pts, maxpp)
     np.savez(out_path, clusters=model.clusters, seconds=dt, n=len(pts))
+
+
+def anchor_row(prefix: str, n: int, haversine: bool, maxpp: int) -> dict:
+    """One engineered-structure run: exact cluster count + construction
+    ARI are the correctness anchor at scale (no oracle fits >=10M). Same
+    timing discipline as the headline number (run_train: compile warm-up,
+    best-of-reps) so the row is hot and tunnel-jitter-resistant."""
+    from dbscan_tpu.utils.ari import adjusted_rand_index
+
+    pts, blob_of, n_blob, k, eps = make_anchor(n, haversine)
+    extra = {"eps": eps}
+    if haversine:
+        extra["metric"] = "haversine"
+    reps = int(os.environ.get("BENCH_ANCHOR_REPS", "2"))
+    model, dt = run_train(pts, maxpp, reps=reps, **extra)
+    ari = adjusted_rand_index(model.clusters[:n_blob], blob_of)
+    return {
+        f"{prefix}_n": n,
+        f"{prefix}_seconds": round(dt, 2),
+        f"{prefix}_clusters": model.n_clusters,
+        f"{prefix}_expect": k,
+        f"{prefix}_ari": round(float(ari), 6),
+    }
 
 
 def main() -> None:
@@ -110,12 +189,31 @@ def main() -> None:
         model, dt = run_train(pts, maxpp, use_pallas=use_pallas, reps=reps)
         throughput = len(pts) / dt / 1e6
 
+        from dbscan_tpu import Engine, train
+        from dbscan_tpu.utils.ari import adjusted_rand_index
+
+        # full-run label check: an INDEPENDENT second run of the whole
+        # dataset at a different partitioning (different bucket widths,
+        # halo routing, and merge order) must reproduce the timed run's
+        # labels — this is the ari_full of the run whose throughput is
+        # reported, not of a subset. The alt maxpp is guaranteed to
+        # differ (halve when possible, else double).
+        alt_model = train(
+            pts,
+            eps=EPS,
+            min_points=MIN_POINTS,
+            max_points_per_partition=(
+                maxpp // 2 if maxpp >= 4096 else maxpp * 2
+            ),
+            engine=Engine.ARCHERY,
+            use_pallas=use_pallas,
+        )
+        ari_full = adjusted_rand_index(model.clusters, alt_model.clusters)
+
         # correctness cross-check: cluster the SAME cpu_n-point subset on the
         # accelerator (clustering a subset of a larger run differs
         # legitimately near borders, so comparing against model.clusters[:n]
         # would understate agreement)
-        from dbscan_tpu import Engine, train
-
         sub_model = train(
             pts[:cpu_n],
             eps=EPS,
@@ -140,27 +238,41 @@ def main() -> None:
         cpu = np.load(out_path)
         cpu_throughput = float(cpu["n"]) / float(cpu["seconds"]) / 1e6
 
-    from dbscan_tpu.utils.ari import adjusted_rand_index
-
     ari = adjusted_rand_index(sub_model.clusters, cpu["clusters"])
 
-    print(
-        json.dumps(
-            {
-                "metric": "dbscan_2d_euclidean_throughput",
-                "value": round(throughput, 4),
-                "unit": "Mpoints/s",
-                "vs_baseline": round(throughput / max(cpu_throughput, 1e-12), 3),
-                "backend": backend,
-                "n_points": n,
-                "cpu_baseline_mpts": round(cpu_throughput, 4),
-                "ari_vs_cpu": round(float(ari), 6),
-                "n_clusters": model.n_clusters,
-                "n_partitions": model.stats["n_partitions"],
-                "seconds": round(dt, 3),
-            }
+    out = {
+        "metric": "dbscan_2d_euclidean_throughput",
+        "value": round(throughput, 4),
+        "unit": "Mpoints/s",
+        "vs_baseline": round(throughput / max(cpu_throughput, 1e-12), 3),
+        "backend": backend,
+        "n_points": n,
+        "cpu_baseline_mpts": round(cpu_throughput, 4),
+        "ari_vs_cpu": round(float(ari), 6),
+        "ari_full": round(float(ari_full), 6),
+        "n_clusters": model.n_clusters,
+        "n_partitions": model.stats["n_partitions"],
+        "seconds": round(dt, 3),
+    }
+    if os.environ.get("BENCH_ANCHOR", "0") == "1":
+        out.update(
+            anchor_row(
+                "anchor",
+                int(os.environ.get("BENCH_ANCHOR_N", "10000000")),
+                haversine=False,
+                maxpp=int(os.environ.get("BENCH_ANCHOR_MAXPP", "131072")),
+            )
         )
-    )
+    if os.environ.get("BENCH_HAVERSINE", "0") == "1":
+        out.update(
+            anchor_row(
+                "haversine",
+                int(os.environ.get("BENCH_HAV_N", "10000000")),
+                haversine=True,
+                maxpp=int(os.environ.get("BENCH_HAV_MAXPP", "131072")),
+            )
+        )
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
