@@ -451,6 +451,7 @@ def train_arrays(
                 "n_clusters": 0,
                 "n_core_instances": 0,
                 "projected": False,
+                "spill_tree": False,
                 "timings": {},
             },
         )
@@ -471,8 +472,8 @@ def train_arrays(
     # partitioner, halo, and merge run on projected km while the kernels
     # measure exact great-circle-equivalent chord distances. Datasets the
     # projection cannot serve (antimeridian wrap, near-pole, bf16) keep the
-    # single-partition path. Cosine/user metrics have no 2-D spatial
-    # structure at all and always run single-partition.
+    # single-partition path. Cosine decomposes through metric spill
+    # partitioning (below); other user metrics run single-partition.
     spatial = cfg.metric == "euclidean"
     # Euclidean clusters on the first two columns only, like the reference;
     # other metrics see every column (haversine reads lon/lat from the
@@ -518,7 +519,46 @@ def train_arrays(
             grid_eps = sph.grid_eps
     # grid-space coordinates for histogram/partition/halo/merge geometry
     grid_pts = sph.proj if sph is not None else pts
-    if not spatial and not cfg.use_pallas:
+
+    # Cosine: no 2-D grid exists, but the normalized vectors live on the
+    # unit hypersphere where cos_dist <= eps iff chord <= sqrt(2*eps) —
+    # a metric space where pivot distances obey the triangle inequality,
+    # so METRIC SPILL PARTITIONING (parallel/spill.py) supplies the
+    # decomposition with the same every-accepted-pair-shares-a-partition
+    # contract as the 2eps grid. Merge classification then comes from
+    # instance multiplicity, not rectangles.
+    rp = None
+    if cfg.metric == "cosine":
+        from dbscan_tpu.parallel import spill
+
+        t0 = time.perf_counter()
+        # normalize straight into f32 (the spill pass's working dtype):
+        # a 10M x 512 f64 intermediate would triple peak host memory
+        unit = np.ascontiguousarray(pts, dtype=np.float32)
+        unit /= np.maximum(
+            np.linalg.norm(unit, axis=1), np.float32(1e-30)
+        )[:, None]
+        # accepted pairs have measured cos_dist <= eps + q, where q is
+        # the kernel's measure quantization — the f32 matmul error grows
+        # with the contraction length D, so q scales with it (D * 2^-22
+        # is ~4x the worst-case rounding; bf16 keeps its own budget);
+        # halo in chord units plus the f32 pivot-distance rounding
+        if cfg.precision.value == "bf16":
+            q = 0.02
+        else:
+            q = max(1e-5, pts.shape[1] * 2.0**-22)
+        halo = float(np.sqrt(2.0 * (cfg.eps + q)) + 1e-6)
+        rp = spill.spill_partition(
+            unit, cfg.max_points_per_partition, halo
+        )
+        _mark("spill_partition_s", t0)
+        if rp[2]:
+            # oversized unsplittable leaves fail fast, pre-packing
+            cmax = int(np.bincount(rp[0], minlength=rp[2]).max())
+            _check_dense_width(
+                binning._ladder_width(cmax, cfg.bucket_multiple), cmax
+            )
+    if not spatial and rp is None and not cfg.use_pallas:
         # single partition, dense engine: the whole dataset is one bucket
         _check_dense_width(binning._ladder_width(n, cfg.bucket_multiple), n)
 
@@ -536,6 +576,9 @@ def train_arrays(
         # 3. margins (grown by eps_spatial: eps plus the projection's
         # slack budget — equals eps exactly for euclidean runs).
         margins = binning.build_margins(rects_int, cell, eps_spatial)
+    elif rp is not None:
+        rects_int = None
+        margins = None  # no rectangles in the spill-tree decomposition
     else:
         rects_int = None
         lo = pts[:, :2].min(axis=0)
@@ -546,10 +589,13 @@ def train_arrays(
             main=main,
             outer=geo.shrink(main, -cfg.eps),
         )
+    p_true = rp[2] if rp is not None else margins.main.shape[0]
 
     # 4. halo duplication + static bucketing.
     t0 = time.perf_counter()
-    if rects_int is not None:
+    if rp is not None:
+        part_ids, point_idx = rp[0], rp[1]  # spill tree already duplicated
+    elif rects_int is not None:
         part_ids, point_idx = binning.duplicate_points_grid(
             grid_pts, cells, cell_inv, rects_int, margins.outer
         )
@@ -612,7 +658,7 @@ def train_arrays(
             kernel_cols,
             part_ids,
             point_idx,
-            n_parts=margins.main.shape[0],
+            n_parts=p_true,
             eps=grid_eps,
             outer=margins.outer,
             bucket_multiple=cfg.bucket_multiple,
@@ -627,7 +673,7 @@ def train_arrays(
             kernel_cols,
             part_ids,
             point_idx,
-            n_parts=margins.main.shape[0],
+            n_parts=p_true,
             bucket_multiple=cfg.bucket_multiple,
             pad_parts_to=mesh_size(mesh),
             dtype=dtype,
@@ -641,7 +687,6 @@ def train_arrays(
 
     # 5. per-partition clustering on device, one launch per bucket width
     # (ascending; same widths recur across runs -> jit cache hits).
-    p_true = margins.main.shape[0]
     # Dispatch every bucket group before blocking on any result: jax
     # execution is async, so the device works through the groups while the
     # host runs every device-INDEPENDENT phase below — instance tables, band
@@ -739,7 +784,15 @@ def train_arrays(
         inst_ptidx = np.empty(0, np.int64)
 
     # device-independent merge precomputation (overlaps the device window)
-    if rects_int is not None:
+    if rp is not None:
+        # spill tree: a point with one instance is interior to its home
+        # leaf (any accepted neighbor in another leaf would have spilled
+        # it); a multi-instance point takes the reference's
+        # merge-candidate route (DBSCAN.scala:161-173) on every instance
+        multi = np.bincount(inst_ptidx, minlength=n) > 1
+        band_any = multi
+        inst_inner = (rp[3][inst_ptidx] == inst_part) & ~multi[inst_ptidx]
+    elif rects_int is not None:
         band_any, inst_inner = _classify_instances(
             grid_pts, cells, cell_inv, rects_int, margins, inst_part,
             inst_ptidx,
@@ -954,9 +1007,11 @@ def train_arrays(
             res_cluster[m_hit] = inst_gid[j]
             res_flag[m_hit] = inst_flag[j]
 
-    partitions = [
-        (i, margins.main[i]) for i in range(p_true)
-    ]
+    # spill-tree partitions have no rectangle representation
+    partitions = (
+        [] if margins is None
+        else [(i, margins.main[i]) for i in range(p_true)]
+    )
     timings["merge_s"] = round(time.perf_counter() - t0, 6)
     timings["total_s"] = round(time.perf_counter() - t_start, 6)
     stats = {
@@ -969,6 +1024,7 @@ def train_arrays(
         "n_clusters": n_clusters,
         "n_core_instances": n_core,
         "projected": sph is not None,  # spherical embedding in effect
+        "spill_tree": rp is not None,  # metric spill partitioning in effect
         "timings": timings,
     }
     return TrainOutput(res_cluster, res_flag, partitions, n_clusters, stats)

@@ -1,0 +1,218 @@
+"""Metric spill partitioning: spatial decomposition for high-dim metrics.
+
+The reference's decomposition is 2-D rectangles on a 2eps grid
+(EvenSplitPartitioner.scala:66-103 + the eps-halo growth,
+DBSCAN.scala:119,132-137) — meaningless for 512-d embeddings. This module
+supplies the high-dimensional analog with the SAME correctness contract:
+every point pair the kernel can accept ends up together in at least one
+partition, so the per-partition kernels + doubly-labeled merge
+(parallel/driver.py steps 5-9) reconstruct the global clustering exactly.
+
+Construction (recursive, multiway): pick ``m`` pivots by farthest-point
+traversal, assign each point to its nearest pivot (a Voronoi cell), and
+COPY each point into every cell whose pivot distance is within
+``d_min + 2*halo`` of its nearest (a spill partition). Coverage proof is
+the metric covering argument — for any pair p, q with dist(p, q) <= halo
+and q homed in cell c: by the triangle inequality
+``d_c(p) <= d_c(q) + halo = d_min(q) + halo <= d_min(p) + 2*halo``, so p
+is copied into c and the pair shares it. Recurse into each cell until
+``maxpp``. For the cosine metric the kernel-accepted pairs have
+cos_dist <= eps, i.e. chord = sqrt(2 * cos_dist) <= sqrt(2 * eps) on the
+normalized vectors, so ``halo = sqrt(2*eps)`` plus a slack covering the
+kernel's f32/bf16 quantization, and all pivot distances are chords —
+one matmul against the pivots per node.
+
+Why pivots instead of hyperplane cuts: projection onto one direction is
+1-Lipschitz, so a cut's halo must be the FULL chord width, while the
+data's 1-D projected spread contracts by ~sqrt(D) — in high dimensions
+with many clusters no 2*halo window is ever empty. Pivot distances
+don't contract: separated clusters keep their full chord separation to
+every pivot, so the spill band ``d_min + 2*halo`` stays inside the home
+cluster and duplication is ~zero for clusterable data. Farthest-point
+pivots keep pivots >> 2*halo apart wherever the data allows it (two
+pivots inside one cluster would duplicate that whole cluster into both
+cells).
+
+Sets that cannot be usefully split — every pivot within ~2*halo of every
+point (data concentrated inside ~one eps-ball, where DBSCAN structure is
+trivial anyway) — are emitted as oversized leaves, mirroring the
+reference's "Can't split" warning (EvenSplitPartitioner.scala:90); the
+driver's dense width guard decides whether those are payable.
+
+Unlike the 2-D grid path there are no rectangles, so the driver derives
+merge-band membership purely from instance multiplicity: a point with one
+instance is interior to its home leaf (an accepted neighbor in another
+leaf would have spilled it); a point with several instances takes the
+reference's merge-candidate route (DBSCAN.scala:161-173).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# A node whose spill pass duplicates more than this (instances / points)
+# is declared unsplittable after one re-pivot retry and becomes a leaf.
+MAX_DUP_FACTOR = 1.6
+# A child swallowing more than this fraction of its parent makes no
+# progress; counts as a failed split.
+MAX_CHILD_FRAC = 0.95
+_MAX_PIVOTS = 48
+
+
+def _farthest_pivots(rows: np.ndarray, m: int, rng) -> np.ndarray:
+    """Greedy max-min (farthest-point) pivot rows: start random, then
+    repeatedly take the point farthest from the chosen set. Keeps pivots
+    as far apart as the data allows — the property that stops two pivots
+    from landing inside one cluster and duplicating it wholesale."""
+    n = len(rows)
+    first = int(rng.integers(n))
+    piv = [first]
+    d2 = ((rows - rows[first]) ** 2).sum(axis=1)
+    for _ in range(m - 1):
+        nxt = int(np.argmax(d2))
+        if d2[nxt] <= 0.0:
+            break  # remaining points identical to a pivot
+        piv.append(nxt)
+        nd2 = ((rows - rows[nxt]) ** 2).sum(axis=1)
+        np.minimum(d2, nd2, out=d2)
+    return np.array(piv, dtype=np.int64)
+
+
+def _pivot_vectors(rows: np.ndarray, m: int, halo: float, rng) -> np.ndarray:
+    """Pivot VECTORS for one node: farthest-point seeds (max spread, but
+    they gravitate to outliers/noise) refined by two Lloyd steps
+    (nearest-pivot means, renormalized to the sphere) that pull each
+    pivot into the mass of its cell — cluster centers, not stragglers —
+    then MERGED so survivors are pairwise > 2*halo apart: two pivots
+    inside one 2*halo ball cannot separate anything (each other's cells
+    spill wholesale), they only multiply the duplication. The covering
+    proof only needs pivots to be points of the metric space, so
+    synthetic unit vectors are fine. Empty cells drop out."""
+    piv = _farthest_pivots(rows, m, rng)
+    if len(piv) < 2:
+        return rows[piv]
+    p = rows[piv]
+    for _ in range(2):
+        a = np.argmax(rows @ p.T, axis=1)  # nearest = max cosine sim
+        sums = np.zeros_like(p)
+        np.add.at(sums, a, rows)
+        norms = np.linalg.norm(sums, axis=1)
+        keep = norms > 1e-12
+        if keep.sum() < 2:
+            break
+        p = sums[keep] / norms[keep][:, None]
+    # greedy 2*halo separation filter (farthest-point seed order is lost
+    # after Lloyd, so re-derive: keep pivots in descending cell-mass
+    # order, dropping any within 2*halo chord of a kept one)
+    a = np.argmax(rows @ p.T, axis=1)
+    mass = np.bincount(a, minlength=len(p))
+    order = np.argsort(-mass)
+    kept: list = []
+    for j in order:
+        pj = p[j]
+        ok = True
+        for kidx in kept:
+            chord2 = float(((pj - p[kidx]) ** 2).sum())
+            if chord2 <= (2.0 * halo) ** 2:
+                ok = False
+                break
+        if ok:
+            kept.append(j)
+    return p[np.array(kept, dtype=np.int64)]
+
+
+def spill_partition(
+    unit: np.ndarray, maxpp: int, halo: float, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray, int, np.ndarray]:
+    """Build the spill partition over ``unit`` [N, D] (rows must be the
+    coordinates ``halo`` refers to — normalized vectors for cosine, so
+    distances are chords).
+
+    Returns (part_ids [M], point_idx [M], n_parts, home_of [N]) with the
+    instance list sorted by (partition, point index) — the layout the
+    packers require (binning.bucketize_grouped) — and ``home_of`` giving
+    each point's home leaf (its nearest-pivot chain; exactly one).
+    """
+    n = len(unit)
+    if n == 0:
+        return (
+            np.empty(0, np.int64),
+            np.empty(0, np.int64),
+            0,
+            np.empty(0, np.int32),
+        )
+    u32 = np.ascontiguousarray(unit, dtype=np.float32)
+    rng = np.random.default_rng(seed)
+    leaves = []  # (member point rows, home flags)
+    stack = [(np.arange(n, dtype=np.int64), np.ones(n, dtype=bool))]
+    while stack:
+        idx, home = stack.pop()
+        if len(idx) <= maxpp:
+            leaves.append((idx, home))
+            continue
+        rows = u32[idx]
+        split = None
+        for _ in range(2):  # one re-pivot retry
+            m = int(
+                min(_MAX_PIVOTS, max(4, -(-len(idx) // maxpp) * 2))
+            )
+            piv = _pivot_vectors(rows, m, halo, rng)
+            if len(piv) < 2:
+                break  # all points identical: unsplittable
+            # chord distances to pivots in one BLAS pass; f32 rounding is
+            # covered by the caller's slack inside `halo`
+            d = rows @ piv.T
+            np.clip(2.0 - 2.0 * d, 0.0, None, out=d)
+            np.sqrt(d, out=d)  # [len, m] chords
+            d_min = d.min(axis=1)
+            assign = np.argmin(d, axis=1)
+            member = d <= (d_min + 2.0 * halo)[:, None]  # [len, m]
+            sizes = member.sum(axis=0)
+            if (
+                float(sizes.sum()) / len(idx) <= MAX_DUP_FACTOR
+                and int(sizes.max()) <= MAX_CHILD_FRAC * len(idx)
+            ):
+                split = (assign, member)
+                break
+        if split is None:
+            logger.warning(
+                "spill: can't split %d points (every pivot set spills "
+                ">%.1fx or one cell keeps >%.0f%%); emitting an "
+                "oversized leaf",
+                len(idx),
+                MAX_DUP_FACTOR,
+                100 * MAX_CHILD_FRAC,
+            )
+            leaves.append((idx, home))
+            continue
+        assign, member = split
+        for c in range(member.shape[1]):
+            sel = member[:, c]
+            if not sel.any():
+                continue
+            stack.append((idx[sel], home[sel] & (assign[sel] == c)))
+
+    n_parts = len(leaves)
+    sizes = np.array([len(ix) for ix, _ in leaves], dtype=np.int64)
+    part_ids = np.repeat(np.arange(n_parts, dtype=np.int64), sizes)
+    point_idx = np.concatenate([ix for ix, _ in leaves])
+    home_flat = np.concatenate([h for _, h in leaves])
+    # sort instances within each partition by point index (packers need
+    # partition-major order; leaves are already contiguous)
+    off = 0
+    for s in sizes:
+        sl = slice(off, off + s)
+        o = np.argsort(point_idx[sl], kind="stable")
+        point_idx[sl] = point_idx[sl][o]
+        home_flat[sl] = home_flat[sl][o]
+        off += s
+    home_of = np.full(n, -1, dtype=np.int32)
+    home_of[point_idx[home_flat]] = part_ids[home_flat]
+    if (home_of < 0).any():  # every point has exactly one home leaf
+        raise AssertionError("spill: point with no home leaf")
+    return part_ids, point_idx, n_parts, home_of

@@ -1,5 +1,5 @@
 """Extended-metric tests: haversine and cosine through the full train() path
-(single-partition routing — the 2eps spatial decomposition is Euclidean-only),
+(haversine via the spherical embedding, cosine via metric spill partitioning),
 plus precision handling."""
 
 import numpy as np
@@ -128,3 +128,62 @@ def test_dense_width_boundary():
     _check_dense_width(DENSE_WIDTH_LIMIT - 1, 40000)  # no raise
     with pytest.raises(ValueError, match="Alternatives"):
         _check_dense_width(DENSE_WIDTH_LIMIT, 65536)
+
+
+def test_cosine_rp_tree_matches_oracle():
+    """Multi-leaf spill-tree cosine run reproduces the f64 cosine oracle
+    (ARI 1.0) — the decomposition must be invisible in the labels."""
+    from dbscan_tpu.utils.ari import adjusted_rand_index
+    from dbscan_tpu.utils.reference_engines import naive_fit
+
+    rng = np.random.default_rng(5)
+    d = 64
+    centers = rng.normal(size=(12, d))
+    blobs = [
+        c / np.linalg.norm(c) + 0.02 * rng.normal(size=(120, d))
+        for c in centers
+    ]
+    noise = rng.normal(size=(60, d))
+    data = np.concatenate(blobs + [noise])
+    model = train(
+        data, eps=0.02, min_points=8, max_points_per_partition=256,
+        metric="cosine",
+    )
+    assert model.stats["spill_tree"]
+    assert model.stats["n_partitions"] > 1
+    assert model.partitions == []  # no rectangle representation
+    ocl, ofl = naive_fit(data, 0.02, 8, metric="cosine")
+    assert adjusted_rand_index(model.clusters, ocl) == 1.0
+    np.testing.assert_array_equal(model.flags, ofl)
+
+
+def test_cosine_rp_tree_equals_single_leaf():
+    """Labels agree (ARI 1.0) between a forced-single-leaf run (huge
+    maxpp) and a many-leaf run of the same data."""
+    from dbscan_tpu.utils.ari import adjusted_rand_index
+
+    rng = np.random.default_rng(6)
+    d = 32
+    centers = rng.normal(size=(6, d))
+    data = np.concatenate(
+        [c + 0.02 * rng.normal(size=(200, d)) for c in centers]
+    )
+    kw = dict(eps=0.03, min_points=6, metric="cosine")
+    m1 = train(data, max_points_per_partition=100000, **kw)
+    assert m1.stats["n_partitions"] == 1
+    m2 = train(data, max_points_per_partition=128, **kw)
+    assert m2.stats["n_partitions"] > 4
+    assert adjusted_rand_index(m1.clusters, m2.clusters) == 1.0
+
+
+def test_cosine_degenerate_data_unsplittable_leaf():
+    """Identical points cannot be split (every cut spills everything):
+    the tree emits one oversized leaf and small N still runs fine."""
+    data = np.tile(np.array([[1.0, 2.0, 3.0]]), (500, 1))
+    model = train(
+        data, eps=0.1, min_points=3, max_points_per_partition=100,
+        metric="cosine",
+    )
+    assert model.stats["n_partitions"] == 1
+    assert model.n_clusters == 1
+    assert (model.clusters == 1).all()
