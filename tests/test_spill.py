@@ -1,0 +1,78 @@
+"""Metric spill partitioning (parallel/spill.py): the coverage contract,
+pivot hygiene, and degradation behavior — unit-level, no kernels."""
+
+import numpy as np
+
+from dbscan_tpu.parallel.spill import spill_partition
+
+
+def _leaf_sets(part_ids, point_idx, n_parts):
+    return [
+        set(point_idx[part_ids == p].tolist()) for p in range(n_parts)
+    ]
+
+
+def test_coverage_contract_fuzz(rng):
+    """THE correctness property: every pair within halo chord distance
+    shares at least one leaf — fuzzed over random cluster layouts."""
+    for trial in range(5):
+        d = int(rng.integers(4, 40))
+        k = int(rng.integers(3, 10))
+        c = rng.normal(size=(k, d))
+        c /= np.linalg.norm(c, axis=1, keepdims=True)
+        pts = np.repeat(c, 80, axis=0) + 0.05 * rng.normal(
+            size=(k * 80, d)
+        )
+        pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+        halo = 0.25
+        part_ids, point_idx, n_parts, home_of = spill_partition(
+            pts, maxpp=60, halo=halo, seed=trial
+        )
+        leaves = _leaf_sets(part_ids, point_idx, n_parts)
+        # membership per point for the pair check
+        member = [set() for _ in range(len(pts))]
+        for li, s in enumerate(leaves):
+            for p in s:
+                member[p].add(li)
+        chord = np.linalg.norm(
+            pts[:, None, :] - pts[None, :, :], axis=-1
+        )
+        close_i, close_j = np.nonzero(chord <= halo)
+        for i, j in zip(close_i, close_j):
+            assert member[i] & member[j], (
+                f"trial {trial}: pair ({i},{j}) at chord "
+                f"{chord[i, j]:.3f} <= {halo} shares no leaf"
+            )
+        # every point homed exactly once, in a leaf that contains it
+        assert (home_of >= 0).all()
+        for p, h in enumerate(home_of):
+            assert p in leaves[h]
+
+
+def test_instance_layout_partition_major(rng):
+    pts = rng.normal(size=(500, 8))
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+    part_ids, point_idx, n_parts, _ = spill_partition(
+        pts, maxpp=100, halo=0.2, seed=0
+    )
+    assert (np.diff(part_ids) >= 0).all()  # partition-major
+    for p in range(n_parts):  # point-sorted within each partition
+        sl = point_idx[part_ids == p]
+        assert (np.diff(sl) > 0).all()
+
+
+def test_degenerate_identical_points():
+    pts = np.tile([[0.6, 0.8]], (300, 1))
+    part_ids, point_idx, n_parts, home_of = spill_partition(
+        pts, maxpp=50, halo=0.1, seed=0
+    )
+    assert n_parts == 1  # unsplittable: one oversized leaf
+    assert len(point_idx) == 300
+    assert (home_of == 0).all()
+
+
+def test_empty():
+    part_ids, point_idx, n_parts, home_of = spill_partition(
+        np.empty((0, 4)), maxpp=10, halo=0.1
+    )
+    assert n_parts == 0 and len(part_ids) == 0 and len(home_of) == 0
