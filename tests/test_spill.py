@@ -76,3 +76,25 @@ def test_empty():
         np.empty((0, 4)), maxpp=10, halo=0.1
     )
     assert n_parts == 0 and len(part_ids) == 0 and len(home_of) == 0
+
+
+def test_cosine_spill_on_mesh(rng):
+    """Spill-partitioned cosine fans out over the device mesh like the
+    grid path: labels identical to the single-device run."""
+    from dbscan_tpu import train
+    from dbscan_tpu.parallel.mesh import make_mesh
+
+    d = 24
+    c = rng.normal(size=(8, d))
+    c /= np.linalg.norm(c, axis=1, keepdims=True)
+    data = np.repeat(c, 150, axis=0) + 0.01 * rng.normal(size=(1200, d))
+    kw = dict(
+        eps=0.02, min_points=6, max_points_per_partition=200,
+        metric="cosine",
+    )
+    m0 = train(data, **kw)
+    assert m0.stats["n_partitions"] >= 8
+    m1 = train(data, mesh=make_mesh(), **kw)
+    np.testing.assert_array_equal(m0.clusters, m1.clusters)
+    np.testing.assert_array_equal(m0.flags, m1.flags)
+    assert m0.n_clusters == 8
