@@ -17,8 +17,11 @@ DBSCANPoint.scala:26-30); this implements BASELINE.json configs[3]
 
 Memory is bounded by the [N, N] f32 gram (N = 20k -> 1.6 GB), not by the
 vocabulary size: D only affects how many feature blocks the scan walks.
-Single-partition by design — high-dimensional sparse space has no 2-D
-rectangle decomposition (see the spatial gate in parallel/driver.py).
+Single-partition by design — ample for the 20-Newsgroups-scale config
+this implements. (Dense cosine at larger N decomposes through metric
+spill partitioning, parallel/spill.py; extending the spill front-end to
+CSR input — sparse-dense pivot products + per-leaf gram — is the
+documented growth path past ~50k sparse rows.)
 """
 
 from __future__ import annotations

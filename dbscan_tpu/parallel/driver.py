@@ -77,12 +77,13 @@ def _check_dense_width(b: int, n: int) -> None:
         f"matrix (~{gib:.0f} GiB) for a partition holding {n} points — at "
         f"or over the dense-engine width limit of {DENSE_WIDTH_LIMIT} "
         "slots (a 17 GiB matrix does not fit a single chip's HBM). The "
-        "dense kernel is the only engine for metrics without a spatial "
-        "decomposition. Alternatives: use metric='euclidean' or "
-        "metric='haversine' (for data clear of the poles and the "
-        "antimeridian seam, both decompose spatially and scale via the "
-        "banded engine); lower "
-        "max_points_per_partition (spatial metrics only); or "
+        "dense kernel is the only engine for partitions this wide. "
+        "Euclidean and haversine decompose spatially and scale via the "
+        "banded engine; cosine decomposes via metric spill partitioning "
+        "— reaching this guard under cosine means the data could not be "
+        "split (nearly everything within ~one eps-ball: raise the "
+        "resolution by lowering eps, or subsample). For other metrics: "
+        "lower max_points_per_partition where a decomposition exists, or "
         "subsample/pre-partition the data so each train() call stays "
         f"under {DENSE_WIDTH_LIMIT} points per partition"
     )

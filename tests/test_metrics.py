@@ -126,7 +126,7 @@ def test_dense_width_boundary():
     _check_dense_width(4096, 4096)  # no raise
     _check_dense_width(49152, 40000)  # no raise: ~9 GiB, previously worked
     _check_dense_width(DENSE_WIDTH_LIMIT - 1, 40000)  # no raise
-    with pytest.raises(ValueError, match="Alternatives"):
+    with pytest.raises(ValueError, match="subsample"):
         _check_dense_width(DENSE_WIDTH_LIMIT, 65536)
 
 
