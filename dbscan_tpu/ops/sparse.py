@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dbscan_tpu.ops.labels import NOISE
 from dbscan_tpu.ops.local_dbscan import LocalResult, cluster_from_adjacency
 
 FEATURE_BLOCK = 4096
@@ -63,6 +64,13 @@ def _pack_csr(x_csr, feature_block: int) -> _PackedCSR:
     starts = np.searchsorted(block_of, np.arange(n_blocks))
     ends = np.r_[starts[1:], len(cols)]
     max_nnz = int((ends - starts).max()) if len(cols) else 1
+    # round the padded nnz width up a geometric ladder: the raw max is
+    # data-dependent per call, and jax.jit keys on traced shapes — the
+    # spill path grams hundreds of partitions, which would otherwise
+    # recompile the scan kernel for nearly every one
+    from dbscan_tpu.parallel.binning import _ladder_width
+
+    max_nnz = _ladder_width(max_nnz, 128)
     # pad slot: row 0 / col 0 / val 0 — scatters +0.0, a no-op
     r = np.zeros((n_blocks, max_nnz), dtype=np.int32)
     c = np.zeros((n_blocks, max_nnz), dtype=np.int32)
@@ -94,19 +102,19 @@ def _gram_from_packed(rows, cols, vals, n_rows: int, feature_block: int):
     return gram
 
 
-def sparse_cosine_gram(x_csr, feature_block: int = FEATURE_BLOCK) -> jnp.ndarray:
-    """Cosine-similarity gram matrix of a scipy CSR matrix, on device.
-
-    Rows are L2-normalized on the host (zero rows stay zero). Returns the
-    [N, N] f32 similarity.
-    """
+def _normalize_rows(x_csr):
+    """L2-normalized f64 CSR copy (zero rows stay zero)."""
     import scipy.sparse as sp
 
     x = sp.csr_matrix(x_csr, dtype=np.float64)
     norms = np.sqrt(np.asarray(x.multiply(x).sum(axis=1)).ravel())
     inv = np.where(norms > 0, 1.0 / np.maximum(norms, 1e-300), 0.0)
-    x = sp.diags(inv) @ x
-    packed = _pack_csr(x.tocsr(), feature_block)
+    return (sp.diags(inv) @ x).tocsr()
+
+
+def _gram_unit(x_unit_csr, feature_block: int) -> jnp.ndarray:
+    """Gram of ALREADY-normalized rows (= cosine similarity), on device."""
+    packed = _pack_csr(x_unit_csr, feature_block)
     return _gram_from_packed(
         jnp.asarray(packed.rows),
         jnp.asarray(packed.cols),
@@ -116,15 +124,23 @@ def sparse_cosine_gram(x_csr, feature_block: int = FEATURE_BLOCK) -> jnp.ndarray
     )
 
 
+def sparse_cosine_gram(x_csr, feature_block: int = FEATURE_BLOCK) -> jnp.ndarray:
+    """Cosine-similarity gram matrix of a scipy CSR matrix, on device.
+
+    Rows are L2-normalized on the host (zero rows stay zero). Returns the
+    [N, N] f32 similarity.
+    """
+    return _gram_unit(_normalize_rows(x_csr), feature_block)
+
+
 @functools.partial(jax.jit, static_argnames=("min_points", "engine"))
-def _cluster_gram(gram, eps, min_points: int, engine: str) -> LocalResult:
+def _cluster_gram(gram, eps, mask, min_points: int, engine: str) -> LocalResult:
     n = gram.shape[0]
     dist = 1.0 - gram
     adj = dist <= eps
     adj = adj | jnp.eye(n, dtype=bool)  # self-inclusive regardless of eps
-    return cluster_from_adjacency(
-        adj, jnp.ones(n, dtype=bool), min_points, engine
-    )
+    adj = adj & (mask[None, :] & mask[:, None])  # padding rows inert
+    return cluster_from_adjacency(adj, mask, min_points, engine)
 
 
 def sparse_cosine_dbscan(
@@ -133,16 +149,115 @@ def sparse_cosine_dbscan(
     min_points: int,
     engine: str = "archery",
     feature_block: int = FEATURE_BLOCK,
+    max_points_per_partition: int = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """DBSCAN over sparse rows with cosine distance (1 - similarity) <= eps.
 
     Returns (clusters [N] int32 with 0 = noise, flags [N] int8) in the
     package's standard label conventions. Zero rows (empty documents) have
     similarity 0 to everything — they cluster only if eps >= 1.
+
+    ``max_points_per_partition``, when set and exceeded by N, routes the
+    run through metric spill partitioning (parallel/spill.py — the CSR
+    rows ARE unit vectors, so pivot chords come from sparse-dense
+    products): per-leaf grams bounded at the partition size instead of
+    one [N, N] gram, merged by the driver's shared instance-table merge
+    (parallel/driver.py::finalize_merge). This lifts the single-gram cap
+    (~46k rows in 8 GiB) to arbitrary N for clusterable data.
     """
-    gram = sparse_cosine_gram(x_csr, feature_block)
-    res: LocalResult = _cluster_gram(gram, jnp.float32(eps), min_points, engine)
     from dbscan_tpu.ops.labels import seed_to_local_ids
 
-    clusters = seed_to_local_ids(np.asarray(res.seed_labels))
-    return clusters, np.asarray(res.flags)
+    x = _normalize_rows(x_csr)
+    n = x.shape[0]
+    if max_points_per_partition is None or n <= max_points_per_partition:
+        gram = _gram_unit(x, feature_block)
+        res: LocalResult = _cluster_gram(
+            gram,
+            jnp.float32(eps),
+            jnp.ones(n, dtype=bool),
+            min_points,
+            engine,
+        )
+        clusters = seed_to_local_ids(np.asarray(res.seed_labels))
+        return clusters, np.asarray(res.flags)
+
+    import scipy.sparse as sp
+
+    from dbscan_tpu.parallel.binning import _ladder_width
+    from dbscan_tpu.parallel.driver import _check_dense_width, finalize_merge
+    from dbscan_tpu.parallel.spill import spill_partition
+
+    # Zero rows (empty documents) are sim-0 to EVERYTHING: inside the
+    # spill partitioner each would be equidistant (chord sqrt(2)) to all
+    # pivots and get copied into every cell at every level, inflating
+    # duplication until nothing splits. For eps < 1 they are
+    # deterministically noise — strip them before partitioning and leave
+    # their output rows at (cluster 0, NOISE).
+    nz_rows = np.flatnonzero(np.diff(x.indptr) > 0)
+    if eps < 1.0 and len(nz_rows) < n:
+        clusters = np.zeros(n, dtype=np.int32)
+        flags = np.full(n, NOISE, dtype=np.int8)
+        if len(nz_rows):
+            sub_c, sub_f = sparse_cosine_dbscan(
+                x[nz_rows],
+                eps,
+                min_points,
+                engine=engine,
+                feature_block=feature_block,
+                max_points_per_partition=max_points_per_partition,
+            )
+            clusters[nz_rows] = sub_c
+            flags[nz_rows] = sub_f
+        return clusters, flags
+
+    # accepted pairs have measured cos_dist <= eps + q: the gram's f32
+    # scatter-accumulate rounds with the nnz-per-feature-block count;
+    # 1e-4 covers blocks to ~2^14 accumulated terms with margin
+    q = 1e-4
+    halo = float(np.sqrt(2.0 * (eps + q)) + 1e-6)
+    part_ids, point_idx, n_parts, home_of = spill_partition(
+        x.astype(np.float32), max_points_per_partition, halo
+    )
+    counts = np.bincount(part_ids, minlength=n_parts)
+    offsets = np.r_[0, np.cumsum(counts)]
+    widths = [_ladder_width(int(c), 128) for c in counts]
+    if widths:
+        _check_dense_width(max(widths), int(counts.max()))
+
+    seeds_l, flags_l = [], []
+    max_b = 0
+    for p in range(n_parts):
+        # instances are partition-major: O(1) slices, no per-leaf scan
+        rows_p = point_idx[offsets[p] : offsets[p + 1]]
+        w = widths[p]
+        max_b = max(max_b, w)
+        xp = x[rows_p]
+        if w > len(rows_p):  # pad to the ladder width (zero rows, masked)
+            xp = sp.vstack(
+                [xp, sp.csr_matrix((w - len(rows_p), x.shape[1]))]
+            ).tocsr()
+        gram = _gram_unit(xp, feature_block)
+        res = _cluster_gram(
+            gram,
+            jnp.float32(eps),
+            jnp.arange(w) < len(rows_p),
+            min_points,
+            engine,
+        )
+        seeds_l.append(np.asarray(res.seed_labels)[: len(rows_p)])
+        flags_l.append(np.asarray(res.flags)[: len(rows_p)])
+
+    inst_seed = (
+        np.concatenate(seeds_l) if seeds_l else np.empty(0, np.int32)
+    )
+    inst_flag = (
+        np.concatenate(flags_l) if flags_l else np.empty(0, np.int8)
+    )
+    multi = np.bincount(point_idx, minlength=n) > 1
+    cand = multi[point_idx]
+    inst_inner = (home_of[point_idx] == part_ids) & ~cand
+    clusters, flags, _ = finalize_merge(
+        part_ids, point_idx, inst_seed, inst_flag, cand, inst_inner,
+        n, n_parts, max_b,
+    )
+    return clusters, flags

@@ -408,6 +408,156 @@ def _band_membership(
     return out
 
 
+
+def finalize_merge(
+    inst_part: np.ndarray,
+    inst_ptidx: np.ndarray,
+    inst_seed: np.ndarray,
+    inst_flag: np.ndarray,
+    cand: np.ndarray,
+    inst_inner: np.ndarray,
+    n: int,
+    p_true: int,
+    max_b: int,
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Steps 6-9 of the reference pipeline (DBSCAN.scala:179-283) on flat
+    instance tables: deterministic per-partition cluster enumeration,
+    union-find over clusters sharing a merge-candidate point, global-id
+    assignment, and the inner/band relabel + dedup scatter into per-point
+    outputs. Returns (clusters [n] int32, flags [n] int8, n_clusters).
+
+    Inputs: per-instance (partition, point row, seed label, flag) plus the
+    merge classification — ``cand`` (instance participates in the merge
+    dedup) and ``inst_inner`` (instance authoritative for its point).
+    Shared by the grid/spill drivers (train_arrays) and the sparse cosine
+    front-end (ops/sparse.py), whose decompositions produce the same
+    instance-table shape.
+    """
+    # 6. local ids + deterministic cluster enumeration.
+    inst_loc, upart, uloc, labeled_inst, inst_urank = _local_ids_flat(
+        inst_part, inst_seed, p_true, max_b
+    )
+
+    # 7. merge: union clusters observed on the same halo point.
+
+    uf = UnionFind()
+    nz = cand & (inst_flag != NOISE)
+    if nz.any():
+        k = inst_ptidx[nz]
+        kp = inst_part[nz]
+        kl = inst_loc[nz]
+        order = _native.argsort_ints(k)
+        k, kp, kl = k[order], kp[order], kl[order]
+        starts = np.flatnonzero(np.r_[True, k[1:] != k[:-1]])
+        group_of = np.repeat(np.arange(len(starts)), np.diff(np.r_[starts, len(k)]))
+        first = starts[group_of]
+        rest = np.arange(len(k)) != first
+        # dedup to unique cluster-pair edges before the interpreted union
+        # loop: the instance count can be huge, the edge count is small.
+        # One packed int64 key instead of np.unique(axis=0) — the latter
+        # sorts a void view, measured ~10x slower at 10M instances.
+        base = np.int64(max_b + 2)
+        span = np.int64(p_true) * base
+        if span < np.int64(3_037_000_499):  # span**2 - 1 < 2**63: no wrap
+            ka = kp[first[rest]] * base + kl[first[rest]]
+            kb = kp[rest] * base + kl[rest]
+            uniq_e = np.unique(ka * span + kb)
+            ua, ub = np.divmod(uniq_e, span)
+            pairs = zip(*np.divmod(ua, base), *np.divmod(ub, base))
+        else:  # astronomically wide id space: exact 2-D dedup
+            pairs = np.unique(
+                np.stack(
+                    [kp[first[rest]], kl[first[rest]], kp[rest], kl[rest]],
+                    axis=1,
+                ),
+                axis=0,
+            )
+        for pa, la, pb, lb in pairs:
+            uf.union((int(pa), int(la)), (int(pb), int(lb)))
+
+    ordered = [(int(p), int(l)) for p, l in zip(upart, uloc)]
+    n_clusters, mapping = uf.assign_global_ids(ordered)
+    logger.info(
+        "Total Clusters: %d, Unique: %d", len(ordered), n_clusters
+    )
+
+    # global id per unique (part, loc), aligned with upart/uloc
+    gid_of_u = np.fromiter(
+        (mapping[key] for key in ordered), dtype=np.int64, count=len(ordered)
+    )
+
+    # per-instance global id (0 for noise): labeled instances carry their
+    # rank into the unique table already (no re-search)
+    gid_nat = (
+        _native.build_inst_gid(labeled_inst, inst_urank, gid_of_u)
+        if inst_urank.size
+        else None
+    )
+    if gid_nat is not None:
+        inst_gid = gid_nat
+    else:
+        inst_gid = np.zeros(len(inst_part), dtype=np.int32)
+        if inst_urank.size:
+            inst_gid[labeled_inst] = gid_of_u[inst_urank]
+
+    # 8. relabel + dedup into per-point outputs.
+    res_cluster = np.zeros(n, dtype=np.int32)
+    res_flag = np.full(n, NOISE, dtype=np.int8)
+    assigned = np.zeros(n, dtype=bool)
+
+    # inner instances: at most one per point (mains have disjoint interiors)
+    ii = np.flatnonzero(inst_inner)
+    if not _native.scatter_sel(
+        ii, inst_ptidx, inst_gid, inst_flag, res_cluster, res_flag, assigned
+    ):
+        res_cluster[inst_ptidx[ii]] = inst_gid[ii]
+        res_flag[inst_ptidx[ii]] = inst_flag[ii]
+        assigned[inst_ptidx[ii]] = True
+
+    # merge-band instances: dedup by point, prefer Core > Border > Noise,
+    # then lower partition id (deterministic; reference keeps last non-noise,
+    # DBSCAN.scala:257-267 — same global id either way)
+    ci = np.flatnonzero(cand & ~inst_inner)
+    if ci.size:
+        # packed single key replaces np.lexsort: primary point, then flag,
+        # then partition (flag < 4, partition < p_true; no overflow for
+        # any N * p_true < 2^61)
+        order = _native.argsort_ints(
+            (inst_ptidx[ci] * 4 + inst_flag[ci]) * np.int64(p_true)
+            + inst_part[ci]
+        )
+        ci = ci[order]
+        keep = np.r_[True, inst_ptidx[ci][1:] != inst_ptidx[ci][:-1]]
+        ck = ci[keep]
+        if not _native.scatter_sel(
+            ck, inst_ptidx, inst_gid, inst_flag, res_cluster, res_flag,
+            assigned,
+        ):
+            res_cluster[inst_ptidx[ck]] = inst_gid[ck]
+            res_flag[inst_ptidx[ck]] = inst_flag[ck]
+            assigned[inst_ptidx[ck]] = True
+
+    if not assigned.all():
+        # fp-edge fallback: label from any instance (first occurrence) —
+        # vectorized: one stray point at 100M scale must not trigger an
+        # interpreted O(instances) loop
+        missing = np.flatnonzero(~assigned)
+        logger.warning(
+            "%d points fell outside inner+band; using first instance",
+            len(missing),
+        )
+        if inst_ptidx.size:
+            uniq_pt, first_j = np.unique(inst_ptidx, return_index=True)
+            pos = np.searchsorted(uniq_pt, missing)
+            pos_c = np.minimum(pos, len(uniq_pt) - 1)
+            hit = uniq_pt[pos_c] == missing
+            m_hit = missing[hit]
+            j = first_j[pos_c[hit]]
+            res_cluster[m_hit] = inst_gid[j]
+            res_flag[m_hit] = inst_flag[j]
+    return res_cluster, res_flag, n_clusters
+
+
 def train_arrays(
     points: np.ndarray,
     cfg: DBSCANConfig,
@@ -885,128 +1035,13 @@ def train_arrays(
     inst_flag = np.concatenate(inst_flag_l) if inst_flag_l else np.empty(0, np.int8)
     t0 = _mark("device_s", t0)
 
-    # 6. local ids + deterministic cluster enumeration.
-    inst_loc, upart, uloc, labeled_inst, inst_urank = _local_ids_flat(
-        inst_part, inst_seed, p_true, max_b
+    # 6-9. local ids, cross-partition merge, relabel + dedup — shared with
+    # the sparse spill front-end (ops/sparse.py), which produces its own
+    # instance tables.
+    res_cluster, res_flag, n_clusters = finalize_merge(
+        inst_part, inst_ptidx, inst_seed, inst_flag, cand, inst_inner,
+        n, p_true, max_b,
     )
-
-    # 7. merge: union clusters observed on the same halo point.
-
-    uf = UnionFind()
-    nz = cand & (inst_flag != NOISE)
-    if nz.any():
-        k = inst_ptidx[nz]
-        kp = inst_part[nz]
-        kl = inst_loc[nz]
-        order = _native.argsort_ints(k)
-        k, kp, kl = k[order], kp[order], kl[order]
-        starts = np.flatnonzero(np.r_[True, k[1:] != k[:-1]])
-        group_of = np.repeat(np.arange(len(starts)), np.diff(np.r_[starts, len(k)]))
-        first = starts[group_of]
-        rest = np.arange(len(k)) != first
-        # dedup to unique cluster-pair edges before the interpreted union
-        # loop: the instance count can be huge, the edge count is small.
-        # One packed int64 key instead of np.unique(axis=0) — the latter
-        # sorts a void view, measured ~10x slower at 10M instances.
-        base = np.int64(max_b + 2)
-        span = np.int64(p_true) * base
-        if span < np.int64(3_037_000_499):  # span**2 - 1 < 2**63: no wrap
-            ka = kp[first[rest]] * base + kl[first[rest]]
-            kb = kp[rest] * base + kl[rest]
-            uniq_e = np.unique(ka * span + kb)
-            ua, ub = np.divmod(uniq_e, span)
-            pairs = zip(*np.divmod(ua, base), *np.divmod(ub, base))
-        else:  # astronomically wide id space: exact 2-D dedup
-            pairs = np.unique(
-                np.stack(
-                    [kp[first[rest]], kl[first[rest]], kp[rest], kl[rest]],
-                    axis=1,
-                ),
-                axis=0,
-            )
-        for pa, la, pb, lb in pairs:
-            uf.union((int(pa), int(la)), (int(pb), int(lb)))
-
-    ordered = [(int(p), int(l)) for p, l in zip(upart, uloc)]
-    n_clusters, mapping = uf.assign_global_ids(ordered)
-    logger.info(
-        "Total Clusters: %d, Unique: %d", len(ordered), n_clusters
-    )
-
-    # global id per unique (part, loc), aligned with upart/uloc
-    gid_of_u = np.fromiter(
-        (mapping[key] for key in ordered), dtype=np.int64, count=len(ordered)
-    )
-
-    # per-instance global id (0 for noise): labeled instances carry their
-    # rank into the unique table already (no re-search)
-    gid_nat = (
-        _native.build_inst_gid(labeled_inst, inst_urank, gid_of_u)
-        if inst_urank.size
-        else None
-    )
-    if gid_nat is not None:
-        inst_gid = gid_nat
-    else:
-        inst_gid = np.zeros(len(inst_part), dtype=np.int32)
-        if inst_urank.size:
-            inst_gid[labeled_inst] = gid_of_u[inst_urank]
-
-    # 8. relabel + dedup into per-point outputs.
-    res_cluster = np.zeros(n, dtype=np.int32)
-    res_flag = np.full(n, NOISE, dtype=np.int8)
-    assigned = np.zeros(n, dtype=bool)
-
-    # inner instances: at most one per point (mains have disjoint interiors)
-    ii = np.flatnonzero(inst_inner)
-    if not _native.scatter_sel(
-        ii, inst_ptidx, inst_gid, inst_flag, res_cluster, res_flag, assigned
-    ):
-        res_cluster[inst_ptidx[ii]] = inst_gid[ii]
-        res_flag[inst_ptidx[ii]] = inst_flag[ii]
-        assigned[inst_ptidx[ii]] = True
-
-    # merge-band instances: dedup by point, prefer Core > Border > Noise,
-    # then lower partition id (deterministic; reference keeps last non-noise,
-    # DBSCAN.scala:257-267 — same global id either way)
-    ci = np.flatnonzero(cand & ~inst_inner)
-    if ci.size:
-        # packed single key replaces np.lexsort: primary point, then flag,
-        # then partition (flag < 4, partition < p_true; no overflow for
-        # any N * p_true < 2^61)
-        order = _native.argsort_ints(
-            (inst_ptidx[ci] * 4 + inst_flag[ci]) * np.int64(p_true)
-            + inst_part[ci]
-        )
-        ci = ci[order]
-        keep = np.r_[True, inst_ptidx[ci][1:] != inst_ptidx[ci][:-1]]
-        ck = ci[keep]
-        if not _native.scatter_sel(
-            ck, inst_ptidx, inst_gid, inst_flag, res_cluster, res_flag,
-            assigned,
-        ):
-            res_cluster[inst_ptidx[ck]] = inst_gid[ck]
-            res_flag[inst_ptidx[ck]] = inst_flag[ck]
-            assigned[inst_ptidx[ck]] = True
-
-    if not assigned.all():
-        # fp-edge fallback: label from any instance (first occurrence) —
-        # vectorized: one stray point at 100M scale must not trigger an
-        # interpreted O(instances) loop
-        missing = np.flatnonzero(~assigned)
-        logger.warning(
-            "%d points fell outside inner+band; using first instance",
-            len(missing),
-        )
-        if inst_ptidx.size:
-            uniq_pt, first_j = np.unique(inst_ptidx, return_index=True)
-            pos = np.searchsorted(uniq_pt, missing)
-            pos_c = np.minimum(pos, len(uniq_pt) - 1)
-            hit = uniq_pt[pos_c] == missing
-            m_hit = missing[hit]
-            j = first_j[pos_c[hit]]
-            res_cluster[m_hit] = inst_gid[j]
-            res_flag[m_hit] = inst_flag[j]
 
     # spill-tree partitions have no rectangle representation
     partitions = (

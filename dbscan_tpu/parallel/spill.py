@@ -62,28 +62,97 @@ MAX_DUP_FACTOR = 1.6
 # progress; counts as a failed split.
 MAX_CHILD_FRAC = 0.95
 _MAX_PIVOTS = 48
+# Pivot selection (farthest-point + Lloyd) runs on at most this many
+# sampled rows per node; the exact membership pass still sees every row.
+_PIVOT_SAMPLE = 65536
 
 
-def _farthest_pivots(rows: np.ndarray, m: int, rng) -> np.ndarray:
-    """Greedy max-min (farthest-point) pivot rows: start random, then
+class _DenseOps:
+    """Unit-row primitives over a dense [N, D] f32 array. All chord
+    arithmetic goes through dot products (rows are unit, so
+    chord^2 = 2 - 2*dot), which is also the only form a sparse matrix
+    can supply — the one abstraction both storage layouts share.
+    ``take`` materializes a node's row subset ONCE; every per-node
+    primitive then works on that copy (row indices are node-local)."""
+
+    def __init__(self, x: np.ndarray):
+        self.x = np.ascontiguousarray(x, dtype=np.float32)
+        self.dim = self.x.shape[1]
+
+    def take(self, idx: np.ndarray) -> "_DenseOps":
+        return _DenseOps(self.x[idx])
+
+    def dot_all(self, vecs: np.ndarray) -> np.ndarray:
+        """[n_node, m] inner products against dense unit vectors."""
+        return self.x @ vecs.T
+
+    def dense_rows(self, rows: np.ndarray) -> np.ndarray:
+        return self.x[rows]
+
+    def cell_sums_all(self, assign: np.ndarray, m: int) -> np.ndarray:
+        sums = np.zeros((m, self.dim), dtype=np.float32)
+        np.add.at(sums, assign, self.x)
+        return sums
+
+
+class _SparseOps:
+    """Same primitives over a scipy CSR matrix (unit rows). Pivot vectors
+    stay dense ([m, D], m <= 48) — only row data is sparse."""
+
+    def __init__(self, x_csr):
+        import scipy.sparse as sp
+
+        self.x = sp.csr_matrix(x_csr, dtype=np.float32)
+        self.dim = self.x.shape[1]
+        self._sp = sp
+
+    def take(self, idx) -> "_SparseOps":
+        return _SparseOps(self.x[idx])
+
+    def dot_all(self, vecs):
+        return np.asarray(self.x @ vecs.T)
+
+    def dense_rows(self, rows):
+        return np.asarray(self.x[rows].todense(), dtype=np.float32)
+
+    def cell_sums_all(self, assign, m):
+        sel = self._sp.csr_matrix(
+            (
+                np.ones(self.x.shape[0], dtype=np.float32),
+                (assign, np.arange(self.x.shape[0])),
+            ),
+            shape=(m, self.x.shape[0]),
+        )
+        return np.asarray((sel @ self.x).todense(), dtype=np.float32)
+
+
+def _chords(sub, vecs: np.ndarray) -> np.ndarray:
+    """[n_node, m] chord distances to unit pivot vectors."""
+    d = 2.0 - 2.0 * sub.dot_all(vecs)
+    np.clip(d, 0.0, None, out=d)
+    np.sqrt(d, out=d)
+    return d
+
+
+def _farthest_pivots(sub, m: int, rng) -> np.ndarray:
+    """Greedy max-min (farthest-point) pivot VECTORS: start random, then
     repeatedly take the point farthest from the chosen set. Keeps pivots
     as far apart as the data allows — the property that stops two pivots
     from landing inside one cluster and duplicating it wholesale."""
-    n = len(rows)
-    first = int(rng.integers(n))
-    piv = [first]
-    d2 = ((rows - rows[first]) ** 2).sum(axis=1)
+    first = int(rng.integers(sub.x.shape[0]))
+    vecs = [sub.dense_rows(np.array([first]))[0]]
+    d = _chords(sub, np.stack(vecs))[:, 0]
     for _ in range(m - 1):
-        nxt = int(np.argmax(d2))
-        if d2[nxt] <= 0.0:
+        nxt = int(np.argmax(d))
+        if d[nxt] <= 0.0:
             break  # remaining points identical to a pivot
-        piv.append(nxt)
-        nd2 = ((rows - rows[nxt]) ** 2).sum(axis=1)
-        np.minimum(d2, nd2, out=d2)
-    return np.array(piv, dtype=np.int64)
+        vecs.append(sub.dense_rows(np.array([nxt]))[0])
+        nd = _chords(sub, vecs[-1][None, :])[:, 0]
+        np.minimum(d, nd, out=d)
+    return np.stack(vecs)
 
 
-def _pivot_vectors(rows: np.ndarray, m: int, halo: float, rng) -> np.ndarray:
+def _pivot_vectors(sub, m: int, halo: float, rng):
     """Pivot VECTORS for one node: farthest-point seeds (max spread, but
     they gravitate to outliers/noise) refined by two Lloyd steps
     (nearest-pivot means, renormalized to the sphere) that pull each
@@ -93,14 +162,12 @@ def _pivot_vectors(rows: np.ndarray, m: int, halo: float, rng) -> np.ndarray:
     spill wholesale), they only multiply the duplication. The covering
     proof only needs pivots to be points of the metric space, so
     synthetic unit vectors are fine. Empty cells drop out."""
-    piv = _farthest_pivots(rows, m, rng)
-    if len(piv) < 2:
-        return rows[piv]
-    p = rows[piv]
+    p = _farthest_pivots(sub, m, rng)
+    if len(p) < 2:
+        return p
     for _ in range(2):
-        a = np.argmax(rows @ p.T, axis=1)  # nearest = max cosine sim
-        sums = np.zeros_like(p)
-        np.add.at(sums, a, rows)
+        a = np.argmax(sub.dot_all(p), axis=1)  # nearest = max cos sim
+        sums = sub.cell_sums_all(a, len(p))
         norms = np.linalg.norm(sums, axis=1)
         keep = norms > 1e-12
         if keep.sum() < 2:
@@ -109,7 +176,7 @@ def _pivot_vectors(rows: np.ndarray, m: int, halo: float, rng) -> np.ndarray:
     # greedy 2*halo separation filter (farthest-point seed order is lost
     # after Lloyd, so re-derive: keep pivots in descending cell-mass
     # order, dropping any within 2*halo chord of a kept one)
-    a = np.argmax(rows @ p.T, axis=1)
+    a = np.argmax(sub.dot_all(p), axis=1)
     mass = np.bincount(a, minlength=len(p))
     order = np.argsort(-mass)
     kept: list = []
@@ -127,18 +194,25 @@ def _pivot_vectors(rows: np.ndarray, m: int, halo: float, rng) -> np.ndarray:
 
 
 def spill_partition(
-    unit: np.ndarray, maxpp: int, halo: float, seed: int = 0
+    unit, maxpp: int, halo: float, seed: int = 0
 ) -> Tuple[np.ndarray, np.ndarray, int, np.ndarray]:
     """Build the spill partition over ``unit`` [N, D] (rows must be the
-    coordinates ``halo`` refers to — normalized vectors for cosine, so
-    distances are chords).
+    UNIT-NORM coordinates ``halo`` refers to — normalized vectors for
+    cosine, so distances are chords computed from inner products). Takes
+    a dense ndarray or a scipy sparse matrix (CSR'd internally).
 
     Returns (part_ids [M], point_idx [M], n_parts, home_of [N]) with the
     instance list sorted by (partition, point index) — the layout the
     packers require (binning.bucketize_grouped) — and ``home_of`` giving
     each point's home leaf (its nearest-pivot chain; exactly one).
     """
-    n = len(unit)
+    if hasattr(unit, "tocsr"):  # scipy sparse input
+        n = unit.shape[0]
+        ops = _SparseOps(unit) if n else None
+    else:
+        unit = np.asarray(unit)
+        n = len(unit)
+        ops = _DenseOps(unit) if n else None
     if n == 0:
         return (
             np.empty(0, np.int64),
@@ -146,7 +220,6 @@ def spill_partition(
             0,
             np.empty(0, np.int32),
         )
-    u32 = np.ascontiguousarray(unit, dtype=np.float32)
     rng = np.random.default_rng(seed)
     leaves = []  # (member point rows, home flags)
     stack = [(np.arange(n, dtype=np.int64), np.ones(n, dtype=bool))]
@@ -155,20 +228,32 @@ def spill_partition(
         if len(idx) <= maxpp:
             leaves.append((idx, home))
             continue
-        rows = u32[idx]
+        sub = ops.take(idx)  # one subset materialization per node
         split = None
         for _ in range(2):  # one re-pivot retry
             m = int(
                 min(_MAX_PIVOTS, max(4, -(-len(idx) // maxpp) * 2))
             )
-            piv = _pivot_vectors(rows, m, halo, rng)
+            # pivot SELECTION runs on a sample: farthest-point + Lloyd
+            # cost ~m+4 node-wide matmuls, needed only for pivot quality
+            # — a 64k sample sees every cluster worth a pivot (smaller
+            # ones get theirs when recursion makes them a bigger
+            # fraction); the exact full-node pass below is just ONE
+            # matmul. Correctness never depends on pivot choice.
+            if len(idx) > _PIVOT_SAMPLE:
+                s_local = rng.choice(
+                    len(idx), _PIVOT_SAMPLE, replace=False
+                )
+                piv = _pivot_vectors(
+                    sub.take(np.sort(s_local)), m, halo, rng
+                )
+            else:
+                piv = _pivot_vectors(sub, m, halo, rng)
             if len(piv) < 2:
                 break  # all points identical: unsplittable
             # chord distances to pivots in one BLAS pass; f32 rounding is
             # covered by the caller's slack inside `halo`
-            d = rows @ piv.T
-            np.clip(2.0 - 2.0 * d, 0.0, None, out=d)
-            np.sqrt(d, out=d)  # [len, m] chords
+            d = _chords(sub, piv)  # [len, m]
             d_min = d.min(axis=1)
             assign = np.argmin(d, axis=1)
             member = d <= (d_min + 2.0 * halo)[:, None]  # [len, m]

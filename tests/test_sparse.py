@@ -77,3 +77,53 @@ def test_empty_rows_are_noise(rng):
     x[17, :] = 0
     clusters, flags = sparse_cosine_dbscan(x.tocsr(), eps=0.3, min_points=3)
     assert clusters[5] == 0 and clusters[17] == 0
+
+
+def test_sparse_spill_matches_single_gram(rng):
+    """Spill-partitioned sparse run reproduces the single-gram labels
+    (ARI 1.0) past the per-gram cap — the decomposition must be
+    invisible."""
+    import scipy.sparse as sp
+
+    from dbscan_tpu import sparse_cosine_dbscan
+    from dbscan_tpu.utils.ari import adjusted_rand_index
+
+    # k topic blocks: every doc of topic t shares a strong anchor column
+    # plus random terms from a t-specific vocabulary band -> same-topic
+    # cosine distance ~0.4, cross-topic ~1.0
+    k, per, vocab, nnz = 10, 120, 5000, 30
+    rows_l = []
+    for t in range(k):
+        base = t * (vocab // k)
+        for _ in range(per):
+            cols = base + 1 + rng.integers(0, vocab // k - 1, nnz)
+            row = np.zeros(vocab)
+            row[cols] = 1.0 + rng.random(nnz)
+            row[base] = 10.0  # topic anchor
+            rows_l.append(row)
+    x = sp.csr_matrix(np.stack(rows_l))
+    topic = np.repeat(np.arange(k), per)
+
+    c1, f1 = sparse_cosine_dbscan(x, eps=0.7, min_points=5)
+    c2, f2 = sparse_cosine_dbscan(
+        x, eps=0.7, min_points=5, max_points_per_partition=256
+    )
+    assert adjusted_rand_index(c1, topic) == 1.0
+    assert adjusted_rand_index(c2, c1) == 1.0
+    np.testing.assert_array_equal(f1, f2)
+
+
+def test_sparse_spill_zero_rows(rng):
+    """Zero rows (empty documents) stay noise through the spill path."""
+    import scipy.sparse as sp
+
+    from dbscan_tpu import sparse_cosine_dbscan
+
+    dense = np.zeros((300, 200))
+    dense[:250, :10] = 1.0 + rng.random((250, 10))  # one tight cluster
+    x = sp.csr_matrix(dense)  # rows 250..299 are empty
+    c, f = sparse_cosine_dbscan(
+        x, eps=0.3, min_points=5, max_points_per_partition=64
+    )
+    assert (c[250:] == 0).all()
+    assert len(set(c[:250]) - {0}) == 1
