@@ -103,13 +103,13 @@ def _gram_from_packed(rows, cols, vals, n_rows: int, feature_block: int):
 
 
 def _normalize_rows(x_csr):
-    """L2-normalized f64 CSR copy (zero rows stay zero)."""
+    """(L2-normalized f64 CSR copy, row norms); zero-norm rows stay zero."""
     import scipy.sparse as sp
 
     x = sp.csr_matrix(x_csr, dtype=np.float64)
     norms = np.sqrt(np.asarray(x.multiply(x).sum(axis=1)).ravel())
     inv = np.where(norms > 0, 1.0 / np.maximum(norms, 1e-300), 0.0)
-    return (sp.diags(inv) @ x).tocsr()
+    return (sp.diags(inv) @ x).tocsr(), norms
 
 
 def _gram_unit(x_unit_csr, feature_block: int) -> jnp.ndarray:
@@ -130,7 +130,7 @@ def sparse_cosine_gram(x_csr, feature_block: int = FEATURE_BLOCK) -> jnp.ndarray
     Rows are L2-normalized on the host (zero rows stay zero). Returns the
     [N, N] f32 similarity.
     """
-    return _gram_unit(_normalize_rows(x_csr), feature_block)
+    return _gram_unit(_normalize_rows(x_csr)[0], feature_block)
 
 
 @functools.partial(jax.jit, static_argnames=("min_points", "engine"))
@@ -150,6 +150,7 @@ def sparse_cosine_dbscan(
     engine: str = "archery",
     feature_block: int = FEATURE_BLOCK,
     max_points_per_partition: int = None,
+    stats_out: dict = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """DBSCAN over sparse rows with cosine distance (1 - similarity) <= eps.
 
@@ -164,12 +165,17 @@ def sparse_cosine_dbscan(
     one [N, N] gram, merged by the driver's shared instance-table merge
     (parallel/driver.py::finalize_merge). This lifts the single-gram cap
     (~46k rows in 8 GiB) to arbitrary N for clusterable data.
+
+    ``stats_out``, when given, is filled with run diagnostics
+    (n_partitions, duplication_factor).
     """
     from dbscan_tpu.ops.labels import seed_to_local_ids
 
-    x = _normalize_rows(x_csr)
+    x, norms = _normalize_rows(x_csr)
     n = x.shape[0]
     if max_points_per_partition is None or n <= max_points_per_partition:
+        if stats_out is not None:
+            stats_out.update(n_partitions=1, duplication_factor=1.0)
         gram = _gram_unit(x, feature_block)
         res: LocalResult = _cluster_gram(
             gram,
@@ -181,34 +187,64 @@ def sparse_cosine_dbscan(
         clusters = seed_to_local_ids(np.asarray(res.seed_labels))
         return clusters, np.asarray(res.flags)
 
+    # Zero-norm rows (empty documents, or all-explicit-zero rows) are
+    # sim-0 to EVERYTHING: inside the spill partitioner each would be
+    # equidistant (chord sqrt(2)) to all pivots and get copied into every
+    # cell at every level, inflating duplication until nothing splits.
+    # For eps < 1 they are deterministically noise — strip them before
+    # partitioning and leave their output rows at (cluster 0, NOISE).
+    nz_rows = np.flatnonzero(norms > 0)
+    if eps < 1.0 and len(nz_rows) < n:
+        clusters = np.zeros(n, dtype=np.int32)
+        flags = np.full(n, NOISE, dtype=np.int8)
+        if len(nz_rows):
+            sub_c, sub_f = _spill_sparse(
+                x[nz_rows], eps, min_points, engine, feature_block,
+                max_points_per_partition, stats_out,
+            )
+            clusters[nz_rows] = sub_c
+            flags[nz_rows] = sub_f
+        elif stats_out is not None:
+            stats_out.update(n_partitions=0, duplication_factor=0.0)
+        return clusters, flags
+    return _spill_sparse(
+        x, eps, min_points, engine, feature_block,
+        max_points_per_partition, stats_out,
+    )
+
+
+def _spill_sparse(
+    x,
+    eps: float,
+    min_points: int,
+    engine: str,
+    feature_block: int,
+    max_points_per_partition: int,
+    stats_out: dict = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Spill-partitioned sparse cosine run over PRE-NORMALIZED rows."""
     import scipy.sparse as sp
 
     from dbscan_tpu.parallel.binning import _ladder_width
     from dbscan_tpu.parallel.driver import _check_dense_width, finalize_merge
     from dbscan_tpu.parallel.spill import spill_partition
 
-    # Zero rows (empty documents) are sim-0 to EVERYTHING: inside the
-    # spill partitioner each would be equidistant (chord sqrt(2)) to all
-    # pivots and get copied into every cell at every level, inflating
-    # duplication until nothing splits. For eps < 1 they are
-    # deterministically noise — strip them before partitioning and leave
-    # their output rows at (cluster 0, NOISE).
-    nz_rows = np.flatnonzero(np.diff(x.indptr) > 0)
-    if eps < 1.0 and len(nz_rows) < n:
-        clusters = np.zeros(n, dtype=np.int32)
-        flags = np.full(n, NOISE, dtype=np.int8)
-        if len(nz_rows):
-            sub_c, sub_f = sparse_cosine_dbscan(
-                x[nz_rows],
-                eps,
-                min_points,
-                engine=engine,
-                feature_block=feature_block,
-                max_points_per_partition=max_points_per_partition,
-            )
-            clusters[nz_rows] = sub_c
-            flags[nz_rows] = sub_f
-        return clusters, flags
+    n = x.shape[0]
+    if n <= max_points_per_partition:
+        # reachable via the zero-row strip shrinking N under the cap
+        gram = _gram_unit(x, feature_block)
+        res = _cluster_gram(
+            gram, jnp.float32(eps), jnp.ones(n, dtype=bool), min_points,
+            engine,
+        )
+        from dbscan_tpu.ops.labels import seed_to_local_ids
+
+        if stats_out is not None:
+            stats_out.update(n_partitions=1, duplication_factor=1.0)
+        return (
+            seed_to_local_ids(np.asarray(res.seed_labels)),
+            np.asarray(res.flags),
+        )
 
     # accepted pairs have measured cos_dist <= eps + q: the gram's f32
     # scatter-accumulate rounds with the nnz-per-feature-block count;
@@ -223,6 +259,11 @@ def sparse_cosine_dbscan(
     widths = [_ladder_width(int(c), 128) for c in counts]
     if widths:
         _check_dense_width(max(widths), int(counts.max()))
+    if stats_out is not None:
+        stats_out.update(
+            n_partitions=n_parts,
+            duplication_factor=float(len(part_ids)) / max(1, n),
+        )
 
     seeds_l, flags_l = [], []
     max_b = 0

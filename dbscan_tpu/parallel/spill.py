@@ -10,17 +10,26 @@ partition, so the per-partition kernels + doubly-labeled merge
 
 Construction (recursive, multiway): pick ``m`` pivots by farthest-point
 traversal, assign each point to its nearest pivot (a Voronoi cell), and
-COPY each point into every cell whose pivot distance is within
-``d_min + 2*halo`` of its nearest (a spill partition). Coverage proof is
-the metric covering argument — for any pair p, q with dist(p, q) <= halo
-and q homed in cell c: by the triangle inequality
-``d_c(p) <= d_c(q) + halo = d_min(q) + halo <= d_min(p) + 2*halo``, so p
-is copied into c and the pair shares it. Recurse into each cell until
-``maxpp``. For the cosine metric the kernel-accepted pairs have
-cos_dist <= eps, i.e. chord = sqrt(2 * cos_dist) <= sqrt(2 * eps) on the
-normalized vectors, so ``halo = sqrt(2*eps)`` plus a slack covering the
-kernel's f32/bf16 quantization, and all pivot distances are chords —
-one matmul against the pivots per node.
+COPY each point into every cell c with ``d_c(p) <= r_c + halo``, where
+``r_c`` is the radius of c's ASSIGNED points (max pivot distance among
+points whose nearest pivot is c). Coverage proof is one triangle
+inequality — for any pair p, q with dist(p, q) <= halo and q assigned to
+cell c: ``d_c(p) <= d_c(q) + halo <= r_c + halo``, so p is copied into c
+and the pair shares it (inductively at every level down to q's home
+leaf). Recurse into each cell until ``maxpp``. For the cosine metric the
+kernel-accepted pairs have cos_dist <= eps, i.e. chord =
+sqrt(2 * cos_dist) <= sqrt(2 * eps) on the normalized vectors, so
+``halo = sqrt(2*eps)`` plus a slack covering the kernel's f32/bf16
+quantization, and all pivot distances are chords — one matmul against
+the pivots per node.
+
+The data-dependent ``r_c + halo`` band matters: the classic
+data-independent rule ``d_min + 2*halo`` is vacuous whenever 2*halo
+approaches the data diameter — exactly the nonnegative (TF-IDF) case,
+where every similarity is >= 0, the whole space fits in a sqrt(2)-chord
+ball, and 2*sqrt(2*eps) >= 0.89 for any useful eps. Cell radii track the
+ACTUAL cluster spread instead, so tight topics at near-orthogonal
+separation still split cleanly.
 
 Why pivots instead of hyperplane cuts: projection onto one direction is
 1-Lipschitz, so a cut's halo must be the FULL chord width, while the
@@ -56,12 +65,19 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 # A node whose spill pass duplicates more than this (instances / points)
-# is declared unsplittable after one re-pivot retry and becomes a leaf.
+# is declared unsplittable after the pivot-count escalation retries and
+# becomes a leaf.
 MAX_DUP_FACTOR = 1.6
 # A child swallowing more than this fraction of its parent makes no
 # progress; counts as a failed split.
 MAX_CHILD_FRAC = 0.95
-_MAX_PIVOTS = 48
+# Pivot-count ceiling per node; retries DOUBLE the pivot count (fewer
+# pivots than natural clusters merges clusters into one cell whose
+# radius swallows the node — more pivots is the fix, and the
+# halo-separation filter collapses any excess benignly), bounded by this
+# and by the [node, m] f32 distance matrix staying under ~2 GB.
+_MAX_PIVOTS = 192
+_MEMBER_BUDGET = 5 * 10**8  # elements of the [node, m] distance matrix
 # Pivot selection (farthest-point + Lloyd) runs on at most this many
 # sampled rows per node; the exact membership pass still sees every row.
 _PIVOT_SAMPLE = 65536
@@ -157,11 +173,12 @@ def _pivot_vectors(sub, m: int, halo: float, rng):
     they gravitate to outliers/noise) refined by two Lloyd steps
     (nearest-pivot means, renormalized to the sphere) that pull each
     pivot into the mass of its cell — cluster centers, not stragglers —
-    then MERGED so survivors are pairwise > 2*halo apart: two pivots
-    inside one 2*halo ball cannot separate anything (each other's cells
-    spill wholesale), they only multiply the duplication. The covering
-    proof only needs pivots to be points of the metric space, so
-    synthetic unit vectors are fine. Empty cells drop out."""
+    then MERGED so survivors are pairwise > halo apart: two pivots inside
+    one halo ball cannot separate anything (each other's cells sit inside
+    the spill bands and duplicate wholesale), they only multiply the
+    duplication. The covering proof only needs pivots to be points of
+    the metric space, so synthetic unit vectors are fine. Empty cells
+    drop out."""
     p = _farthest_pivots(sub, m, rng)
     if len(p) < 2:
         return p
@@ -173,9 +190,9 @@ def _pivot_vectors(sub, m: int, halo: float, rng):
         if keep.sum() < 2:
             break
         p = sums[keep] / norms[keep][:, None]
-    # greedy 2*halo separation filter (farthest-point seed order is lost
+    # greedy halo-separation filter (farthest-point seed order is lost
     # after Lloyd, so re-derive: keep pivots in descending cell-mass
-    # order, dropping any within 2*halo chord of a kept one)
+    # order, dropping any within halo chord of a kept one)
     a = np.argmax(sub.dot_all(p), axis=1)
     mass = np.bincount(a, minlength=len(p))
     order = np.argsort(-mass)
@@ -185,7 +202,7 @@ def _pivot_vectors(sub, m: int, halo: float, rng):
         ok = True
         for kidx in kept:
             chord2 = float(((pj - p[kidx]) ** 2).sum())
-            if chord2 <= (2.0 * halo) ** 2:
+            if chord2 <= halo * halo:
                 ok = False
                 break
         if ok:
@@ -230,9 +247,14 @@ def spill_partition(
             continue
         sub = ops.take(idx)  # one subset materialization per node
         split = None
-        for _ in range(2):  # one re-pivot retry
+        base_m = max(4, -(-len(idx) // maxpp) * 2)
+        for attempt in range(3):  # retries escalate the pivot count
             m = int(
-                min(_MAX_PIVOTS, max(4, -(-len(idx) // maxpp) * 2))
+                min(
+                    base_m << attempt,
+                    _MAX_PIVOTS,
+                    max(4, _MEMBER_BUDGET // max(1, len(idx))),
+                )
             )
             # pivot SELECTION runs on a sample: farthest-point + Lloyd
             # cost ~m+4 node-wide matmuls, needed only for pivot quality
@@ -254,9 +276,21 @@ def spill_partition(
             # chord distances to pivots in one BLAS pass; f32 rounding is
             # covered by the caller's slack inside `halo`
             d = _chords(sub, piv)  # [len, m]
-            d_min = d.min(axis=1)
             assign = np.argmin(d, axis=1)
-            member = d <= (d_min + 2.0 * halo)[:, None]  # [len, m]
+            d_min = d[np.arange(len(d)), assign]
+            # r_c: radius of each cell's ASSIGNED points; cells nobody is
+            # assigned to need no copies at all (-inf empties them)
+            r = np.full(d.shape[1], -np.inf)
+            np.maximum.at(r, assign, d_min)
+            # Both bands are supersets of the needed copy-set (every cell
+            # holding a point within halo of p), so their INTERSECTION is
+            # too: the radius band r_c + halo survives the nonnegative
+            # (TF-IDF) regime where 2*halo swamps the data diameter,
+            # while the classic d_min + 2*halo band caps cells whose
+            # radius was inflated by an assigned outlier.
+            member = (d <= (r[None, :] + halo)) & (
+                d <= (d_min + 2.0 * halo)[:, None]
+            )  # [len, m]
             sizes = member.sum(axis=0)
             if (
                 float(sizes.sum()) / len(idx) <= MAX_DUP_FACTOR

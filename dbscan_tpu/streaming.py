@@ -150,10 +150,14 @@ class StreamingDBSCAN:
         self._uf = _MinUnionFind()
         self._next_id = 1
         self._n_updates = 0
+        self._ncols = None  # clustering columns, fixed by the first batch
 
     def _window_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
         if not self._window:
-            return np.empty((0, 2), np.float64), np.empty(0, np.int64)
+            return (
+                np.empty((0, self._ncols or 2), np.float64),
+                np.empty(0, np.int64),
+            )
         pts = np.concatenate([p for p, _ in self._window])
         ids = np.concatenate([i for _, i in self._window])
         return pts, ids
@@ -174,10 +178,24 @@ class StreamingDBSCAN:
         batch = np.asarray(batch, dtype=np.float64)
         if batch.ndim != 2 or batch.shape[1] < 2:
             raise ValueError(f"batch must be [B, >=2], got {batch.shape}")
+        # euclidean clusters on the first two columns only (reference
+        # convention); other metrics (haversine lon/lat, cosine
+        # embeddings) consume every column, so the window skeleton must
+        # carry them all
+        ncols = 2 if self.config.metric == "euclidean" else batch.shape[1]
+        if self._ncols is None:
+            self._ncols = ncols
+        elif ncols != self._ncols:
+            raise ValueError(
+                f"batch has {ncols} clustering columns; this stream "
+                f"started with {self._ncols}"
+            )
         self._n_updates += 1
         wpts, wids = self._window_arrays()
         combined = (
-            np.concatenate([batch[:, :2], wpts]) if len(wpts) else batch[:, :2]
+            np.concatenate([batch[:, :ncols], wpts])
+            if len(wpts)
+            else batch[:, :ncols]
         )
         out = train_arrays(combined, self.config, mesh=self.mesh)
 
@@ -242,7 +260,7 @@ class StreamingDBSCAN:
         # retain this batch's core points in the window skeleton
         core_mask = batch_fl == CORE
         self._window.append(
-            (batch[core_mask][:, :2].copy(), stream_cl[core_mask].copy())
+            (batch[core_mask][:, :ncols].copy(), stream_cl[core_mask].copy())
         )
 
         stats = dict(out.stats)

@@ -90,7 +90,9 @@ def test_sparse_spill_matches_single_gram(rng):
 
     # k topic blocks: every doc of topic t shares a strong anchor column
     # plus random terms from a t-specific vocabulary band -> same-topic
-    # cosine distance ~0.4, cross-topic ~1.0
+    # cosine distance ~0.1, cross-topic ~1.0. Anchors keep topics tight
+    # so the spill bands (cell radius + chord(eps)) clear the
+    # near-orthogonal topic separation and the tree actually splits.
     k, per, vocab, nnz = 10, 120, 5000, 30
     rows_l = []
     for t in range(k):
@@ -99,15 +101,20 @@ def test_sparse_spill_matches_single_gram(rng):
             cols = base + 1 + rng.integers(0, vocab // k - 1, nnz)
             row = np.zeros(vocab)
             row[cols] = 1.0 + rng.random(nnz)
-            row[base] = 10.0  # topic anchor
+            row[base] = 20.0  # topic anchor
             rows_l.append(row)
     x = sp.csr_matrix(np.stack(rows_l))
     topic = np.repeat(np.arange(k), per)
 
-    c1, f1 = sparse_cosine_dbscan(x, eps=0.7, min_points=5)
+    c1, f1 = sparse_cosine_dbscan(x, eps=0.3, min_points=5)
+    stats: dict = {}
     c2, f2 = sparse_cosine_dbscan(
-        x, eps=0.7, min_points=5, max_points_per_partition=256
+        x, eps=0.3, min_points=5, max_points_per_partition=256,
+        stats_out=stats,
     )
+    # the decomposition must actually engage — this test is about the
+    # multi-leaf merge path, not a vacuous single-leaf fallback
+    assert stats["n_partitions"] > 1, stats
     assert adjusted_rand_index(c1, topic) == 1.0
     assert adjusted_rand_index(c2, c1) == 1.0
     np.testing.assert_array_equal(f1, f2)

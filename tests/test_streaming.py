@@ -125,3 +125,49 @@ def test_stress_many_clusters_large_batch(rng):
     assert (r[u1.clusters > 0] > 0).all()
     elapsed = time.perf_counter() - t0
     assert elapsed < 120, f"streaming stress took {elapsed:.0f}s"
+
+
+def test_streaming_haversine_identity(rng):
+    """Non-euclidean streaming: haversine micro-batches keep stream
+    identity across updates (the window skeleton rides the spherical
+    decomposition)."""
+    from dbscan_tpu import DBSCANConfig
+
+    s = StreamingDBSCAN(
+        eps=0.3, min_points=5,
+        config=DBSCANConfig(
+            eps=0.3, min_points=5, max_points_per_partition=500,
+            metric="haversine",
+        ),
+    )
+    nyc = np.array([-73.98, 40.75])
+    blob = nyc + rng.normal(0, 0.0008, (60, 2))
+    u1 = s.update(blob)
+    sid = np.unique(u1.clusters[u1.clusters > 0])
+    assert len(sid) == 1
+    u2 = s.update(nyc + rng.normal(0, 0.0008, (60, 2)))
+    np.testing.assert_array_equal(
+        np.unique(u2.clusters[u2.clusters > 0]), sid
+    )
+
+
+def test_streaming_cosine_uses_all_columns(rng):
+    """Cosine streaming consumes every column: two batches identical in
+    the first two dims but opposite in the third stay distinct ids."""
+    from dbscan_tpu import DBSCANConfig
+
+    s = StreamingDBSCAN(
+        eps=0.05, min_points=5,
+        config=DBSCANConfig(
+            eps=0.05, min_points=5, max_points_per_partition=500,
+            metric="cosine",
+        ),
+    )
+    base = rng.normal(size=(50, 2)) * 0.01 + np.array([1.0, 1.0])
+    up = np.concatenate([base, np.full((50, 1), 5.0)], axis=1)
+    down = np.concatenate([base, np.full((50, 1), -5.0)], axis=1)
+    u1 = s.update(up)
+    u2 = s.update(down)
+    id1 = set(np.unique(u1.clusters[u1.clusters > 0]))
+    id2 = set(np.unique(u2.clusters[u2.clusters > 0]))
+    assert id1 and id2 and not (id1 & id2)
