@@ -686,9 +686,8 @@ def train_arrays(
         # normalize straight into f32 (the spill pass's working dtype):
         # a 10M x 512 f64 intermediate would triple peak host memory
         unit = np.ascontiguousarray(pts, dtype=np.float32)
-        unit /= np.maximum(
-            np.linalg.norm(unit, axis=1), np.float32(1e-30)
-        )[:, None]
+        norms = np.linalg.norm(unit, axis=1)
+        unit /= np.maximum(norms, np.float32(1e-30))[:, None]
         # accepted pairs have measured cos_dist <= eps + q, where q is
         # the kernel's measure quantization — the f32 matmul error grows
         # with the contraction length D, so q scales with it (D * 2^-22
@@ -699,9 +698,32 @@ def train_arrays(
         else:
             q = max(1e-5, pts.shape[1] * 2.0**-22)
         halo = float(np.sqrt(2.0 * (cfg.eps + q)) + 1e-6)
-        rp = spill.spill_partition(
-            unit, cfg.max_points_per_partition, halo
-        )
+        # Zero-norm rows are sim-0 to everything — equidistant
+        # (chord sqrt(2)) to every pivot, so inside the tree each would
+        # be copied into every cell at every level. For eps < 1 they can
+        # have no neighbors outside their own kind, so they go to one
+        # dedicated leaf instead (the kernel still labels them there:
+        # all-noise at eps < 1 by the same distance).
+        zero_rows = np.flatnonzero(norms == 0)
+        if zero_rows.size and cfg.eps < 1.0 and zero_rows.size < n:
+            nz = np.flatnonzero(norms > 0)
+            zp, zi, zn, zh = spill.spill_partition(
+                unit[nz], cfg.max_points_per_partition, halo
+            )
+            home_full = np.full(n, zn, dtype=np.int32)
+            home_full[nz] = zh
+            rp = (
+                np.concatenate(
+                    [zp, np.full(zero_rows.size, zn, dtype=np.int64)]
+                ),
+                np.concatenate([nz[zi], zero_rows]),
+                zn + 1,
+                home_full,
+            )
+        else:
+            rp = spill.spill_partition(
+                unit, cfg.max_points_per_partition, halo
+            )
         _mark("spill_partition_s", t0)
         if rp[2]:
             # oversized unsplittable leaves fail fast, pre-packing

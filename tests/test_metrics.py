@@ -187,3 +187,27 @@ def test_cosine_degenerate_data_unsplittable_leaf():
     assert model.stats["n_partitions"] == 1
     assert model.n_clusters == 1
     assert (model.clusters == 1).all()
+
+
+def test_cosine_zero_rows_spill():
+    """Zero vectors in dense cosine input get a dedicated leaf (they are
+    sim-0 to everything and would otherwise spill into every cell) and
+    come out noise at eps < 1; real clusters are unaffected."""
+    rng = np.random.default_rng(9)
+    d = 16
+    c = rng.normal(size=(6, d))
+    c /= np.linalg.norm(c, axis=1, keepdims=True)
+    data = np.concatenate(
+        [
+            np.repeat(c, 100, axis=0)
+            + 0.01 * rng.normal(size=(600, d)),
+            np.zeros((80, d)),
+        ]
+    )
+    model = train(
+        data, eps=0.03, min_points=5, max_points_per_partition=128,
+        metric="cosine",
+    )
+    assert model.stats["n_partitions"] > 4
+    assert (model.clusters[600:] == 0).all()
+    assert model.n_clusters == 6
