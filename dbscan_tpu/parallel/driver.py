@@ -683,11 +683,6 @@ def train_arrays(
         from dbscan_tpu.parallel import spill
 
         t0 = time.perf_counter()
-        # normalize straight into f32 (the spill pass's working dtype):
-        # a 10M x 512 f64 intermediate would triple peak host memory
-        unit = np.ascontiguousarray(pts, dtype=np.float32)
-        norms = np.linalg.norm(unit, axis=1)
-        unit /= np.maximum(norms, np.float32(1e-30))[:, None]
         # accepted pairs have measured cos_dist <= eps + q, where q is
         # the kernel's measure quantization — the f32 matmul error grows
         # with the contraction length D, so q scales with it (D * 2^-22
@@ -698,32 +693,38 @@ def train_arrays(
         else:
             q = max(1e-5, pts.shape[1] * 2.0**-22)
         halo = float(np.sqrt(2.0 * (cfg.eps + q)) + 1e-6)
-        # Zero-norm rows are sim-0 to everything — equidistant
-        # (chord sqrt(2)) to every pivot, so inside the tree each would
-        # be copied into every cell at every level. For eps < 1 they can
-        # have no neighbors outside their own kind, so they go to one
-        # dedicated leaf instead (the kernel still labels them there:
-        # all-noise at eps < 1 by the same distance).
-        zero_rows = np.flatnonzero(norms == 0)
-        if zero_rows.size and cfg.eps < 1.0 and zero_rows.size < n:
-            nz = np.flatnonzero(norms > 0)
-            zp, zi, zn, zh = spill.spill_partition(
-                unit[nz], cfg.max_points_per_partition, halo
+        # Zero-norm rows are sim-0 (cos_dist exactly 1) to everything:
+        # inside the spill tree each would be equidistant to every pivot
+        # and get copied into every cell at every level. Whenever even
+        # the quantized kernel cannot accept a zero-to-nonzero pair
+        # (eps + q < 1), they are noise by fiat — run the pipeline on
+        # the nonzero rows alone and scatter the results back. Norms in
+        # f64 from the original data: an f32 norm would underflow tiny
+        # rows into false zeros (the kernel normalizes in higher
+        # precision and would find their neighbors).
+        norms64 = np.linalg.norm(pts, axis=1)
+        zeros = norms64 == 0.0
+        if zeros.any() and not zeros.all() and (cfg.eps + q) < 1.0:
+            sub = train_arrays(pts[~zeros], cfg, mesh=mesh)
+            clusters = np.zeros(n, dtype=np.int32)
+            flags = np.full(n, NOISE, dtype=np.int8)
+            nzi = np.flatnonzero(~zeros)
+            clusters[nzi] = sub.clusters
+            flags[nzi] = sub.flags
+            stats = dict(sub.stats)
+            stats["n_points"] = n
+            return TrainOutput(
+                clusters, flags, sub.partitions, sub.n_clusters, stats
             )
-            home_full = np.full(n, zn, dtype=np.int32)
-            home_full[nz] = zh
-            rp = (
-                np.concatenate(
-                    [zp, np.full(zero_rows.size, zn, dtype=np.int64)]
-                ),
-                np.concatenate([nz[zi], zero_rows]),
-                zn + 1,
-                home_full,
-            )
-        else:
-            rp = spill.spill_partition(
-                unit, cfg.max_points_per_partition, halo
-            )
+        # normalize straight into f32 (the spill pass's working dtype):
+        # a 10M x 512 f64 intermediate would triple peak host memory
+        unit = np.ascontiguousarray(pts, dtype=np.float32)
+        unit /= np.maximum(
+            np.linalg.norm(unit, axis=1), np.float32(1e-30)
+        )[:, None]
+        rp = spill.spill_partition(
+            unit, cfg.max_points_per_partition, halo
+        )
         _mark("spill_partition_s", t0)
         if rp[2]:
             # oversized unsplittable leaves fail fast, pre-packing
