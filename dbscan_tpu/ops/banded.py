@@ -68,10 +68,11 @@ from dbscan_tpu.parallel.binning import BANDED_BLOCK, BANDED_ROWS, BANDED_WIN
 _BLOCK_BATCH_ELEMS = 1 << 28
 
 
-def _block_batch(slab: int) -> int:
-    return max(
-        1, min(32, _BLOCK_BATCH_ELEMS // (BANDED_BLOCK * BANDED_ROWS * slab))
-    )
+def _block_batch(slab: int, n_planes: int = 2) -> int:
+    # the fused tile transients scale with the coordinate plane count
+    # (2 planar, 3 spherical-chord): halve the batch at D == 3
+    per_block = BANDED_BLOCK * BANDED_ROWS * slab * max(1, n_planes - 1)
+    return max(1, min(32, _BLOCK_BATCH_ELEMS // per_block))
 
 
 def _tile_machinery(points, mask, rel_starts, spans, slab_starts, eps, slab):
@@ -167,7 +168,7 @@ def banded_phase1(
     blocks, slabs_of, tile_adj, nb = _tile_machinery(
         points, mask, rel_starts, spans, slab_starts, eps, slab
     )
-    batch = _block_batch(slab)
+    batch = _block_batch(slab, points.shape[1])
 
     def count_block(args):
         return jnp.sum(tile_adj(*args), axis=(1, 2), dtype=jnp.int32)

@@ -217,11 +217,13 @@ def _compiled_banded_p1(
 
 def _banded_batch(group, mesh) -> int:
     """Partitions per vmapped lax.map step for a banded group: bound the
-    [T, R, S]-tile transients to a fixed HBM element budget."""
+    [T, R, S]-tile transients to a fixed HBM element budget (scaled by
+    the coordinate plane count — 3 for spherical-chord payloads)."""
     from dbscan_tpu.parallel.binning import BANDED_ROWS
 
     p_total, b = group.points.shape[:2]
-    per_part = b * (BANDED_ROWS * group.banded.slab)
+    planes = max(1, group.points.shape[2] - 1)
+    per_part = b * (BANDED_ROWS * group.banded.slab) * planes
     mem_cap = max(1, int(1.2e9) // per_part)
     return max(1, min(8, mem_cap, p_total // max(1, mesh_size(mesh))))
 
