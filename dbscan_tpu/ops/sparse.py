@@ -227,7 +227,11 @@ def _spill_sparse(
 
     from dbscan_tpu.parallel.binning import _ladder_width
     from dbscan_tpu.parallel.driver import _check_dense_width, finalize_merge
-    from dbscan_tpu.parallel.spill import spill_partition
+    from dbscan_tpu.parallel.spill import (
+        band_membership,
+        chord_halo,
+        spill_partition,
+    )
 
     n = x.shape[0]
     if n <= max_points_per_partition:
@@ -246,11 +250,10 @@ def _spill_sparse(
             np.asarray(res.flags),
         )
 
-    # accepted pairs have measured cos_dist <= eps + q: the gram's f32
-    # scatter-accumulate rounds with the nnz-per-feature-block count;
-    # 1e-4 covers blocks to ~2^14 accumulated terms with margin
-    q = 1e-4
-    halo = float(np.sqrt(2.0 * (eps + q)) + 1e-6)
+    # the gram's f32 scatter-accumulate rounds with the
+    # nnz-per-feature-block count; 1e-4 covers blocks to ~2^14
+    # accumulated terms with margin
+    halo = chord_halo(eps, 1e-4)
     part_ids, point_idx, n_parts, home_of = spill_partition(
         x.astype(np.float32), max_points_per_partition, halo
     )
@@ -294,9 +297,7 @@ def _spill_sparse(
     inst_flag = (
         np.concatenate(flags_l) if flags_l else np.empty(0, np.int8)
     )
-    multi = np.bincount(point_idx, minlength=n) > 1
-    cand = multi[point_idx]
-    inst_inner = (home_of[point_idx] == part_ids) & ~cand
+    cand, inst_inner = band_membership(part_ids, point_idx, home_of, n)
     clusters, flags, _ = finalize_merge(
         part_ids, point_idx, inst_seed, inst_flag, cand, inst_inner,
         n, n_parts, max_b,

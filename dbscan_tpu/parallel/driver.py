@@ -694,7 +694,7 @@ def train_arrays(
             q = 0.02
         else:
             q = max(1e-5, pts.shape[1] * 2.0**-22)
-        halo = float(np.sqrt(2.0 * (cfg.eps + q)) + 1e-6)
+        halo = spill.chord_halo(cfg.eps, q)
         # Zero-norm rows are sim-0 (cos_dist exactly 1) to everything:
         # inside the spill tree each would be equidistant to every pivot
         # and get copied into every cell at every level. Whenever even
@@ -961,13 +961,13 @@ def train_arrays(
 
     # device-independent merge precomputation (overlaps the device window)
     if rp is not None:
-        # spill tree: a point with one instance is interior to its home
-        # leaf (any accepted neighbor in another leaf would have spilled
-        # it); a multi-instance point takes the reference's
-        # merge-candidate route (DBSCAN.scala:161-173) on every instance
-        multi = np.bincount(inst_ptidx, minlength=n) > 1
-        band_any = multi
-        inst_inner = (rp[3][inst_ptidx] == inst_part) & ~multi[inst_ptidx]
+        from dbscan_tpu.parallel.spill import band_membership
+
+        cand_rp, inst_inner = band_membership(
+            inst_part, inst_ptidx, rp[3], n
+        )
+        band_any = np.zeros(n, dtype=bool)
+        band_any[inst_ptidx[cand_rp]] = True
     elif rects_int is not None:
         band_any, inst_inner = _classify_instances(
             grid_pts, cells, cell_inv, rects_int, margins, inst_part,

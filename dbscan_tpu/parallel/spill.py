@@ -142,6 +142,30 @@ class _SparseOps:
         return np.asarray((sel @ self.x).todense(), dtype=np.float32)
 
 
+def chord_halo(eps: float, quantization: float) -> float:
+    """Spill halo (chord units) for a cosine threshold: accepted pairs
+    have measured cos_dist <= eps + quantization, plus the f32
+    pivot-distance rounding as an absolute term."""
+    return float(np.sqrt(2.0 * (eps + quantization)) + 1e-6)
+
+
+def band_membership(
+    part_ids: np.ndarray,
+    point_idx: np.ndarray,
+    home_of: np.ndarray,
+    n: int,
+):
+    """Merge classification for spill instance tables: a point with one
+    instance is interior to its home leaf (an accepted neighbor in
+    another leaf would have spilled it); a multi-instance point takes
+    the reference's merge-candidate route on every instance
+    (DBSCAN.scala:161-173). Returns (cand [M], inst_inner [M])."""
+    multi = np.bincount(point_idx, minlength=n) > 1
+    cand = multi[point_idx]
+    inst_inner = (home_of[point_idx] == part_ids) & ~cand
+    return cand, inst_inner
+
+
 def _chords(sub, vecs: np.ndarray) -> np.ndarray:
     """[n_node, m] chord distances to unit pivot vectors."""
     d = 2.0 - 2.0 * sub.dot_all(vecs)
@@ -321,15 +345,12 @@ def spill_partition(
     part_ids = np.repeat(np.arange(n_parts, dtype=np.int64), sizes)
     point_idx = np.concatenate([ix for ix, _ in leaves])
     home_flat = np.concatenate([h for _, h in leaves])
-    # sort instances within each partition by point index (packers need
-    # partition-major order; leaves are already contiguous)
-    off = 0
-    for s in sizes:
-        sl = slice(off, off + s)
-        o = np.argsort(point_idx[sl], kind="stable")
-        point_idx[sl] = point_idx[sl][o]
-        home_flat[sl] = home_flat[sl][o]
-        off += s
+    # sort instances by (partition, point index) — the packers' layout —
+    # with one packed-key argsort (partition-major already holds, the
+    # key just orders points within each leaf)
+    order = np.argsort(part_ids * np.int64(n) + point_idx, kind="stable")
+    point_idx = point_idx[order]
+    home_flat = home_flat[order]
     home_of = np.full(n, -1, dtype=np.int32)
     home_of[point_idx[home_flat]] = part_ids[home_flat]
     if (home_of < 0).any():  # every point has exactly one home leaf
