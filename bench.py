@@ -161,6 +161,42 @@ def anchor_row(prefix: str, n: int, haversine: bool, maxpp: int) -> dict:
     }
 
 
+def _ensure_live_backend() -> None:
+    """The tunneled TPU plugin hangs JAX backend init (even under
+    JAX_PLATFORMS=cpu) whenever the tunnel is down — a bench invocation
+    would then block forever instead of producing its JSON line. Probe
+    device init in a killable subprocess; on failure re-exec with the
+    plugin path stripped so the run degrades to a real CPU measurement
+    (backend is reported in the output)."""
+    if os.environ.get("BENCH_NO_TPU_PROBE") == "1":
+        return
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=180,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        if proc.returncode == 0:
+            return
+        # fast-crashing plugin init (segfault/fatal raise) must also
+        # route to the fallback, not just a hang
+        sys.stderr.write(
+            f"bench: accelerator init failed (rc {proc.returncode}); "
+            "falling back to the CPU backend\n"
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(
+            "bench: accelerator init hung (tunnel down?); "
+            "falling back to the CPU backend\n"
+        )
+    env = dict(os.environ)
+    env["BENCH_NO_TPU_PROBE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO  # drop the device-plugin path
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
 def main() -> None:
     n = int(os.environ.get("BENCH_N", "1000000"))
     maxpp = int(os.environ.get("BENCH_MAXPP", "262144"))
@@ -170,6 +206,8 @@ def main() -> None:
     if len(sys.argv) >= 4 and sys.argv[1] == "--cpu-child":
         child_cpu(sys.argv[2], sys.argv[3], cpu_maxpp)
         return
+
+    _ensure_live_backend()
 
     import jax
 
