@@ -15,13 +15,14 @@ DBSCANPoint.scala:26-30); this implements BASELINE.json configs[3]
    and the shared engine tail (ops.local_dbscan.cluster_from_adjacency)
    produces labels/flags.
 
-Memory is bounded by the [N, N] f32 gram (N = 20k -> 1.6 GB), not by the
-vocabulary size: D only affects how many feature blocks the scan walks.
-Single-partition by design — ample for the 20-Newsgroups-scale config
-this implements. (Dense cosine at larger N decomposes through metric
-spill partitioning, parallel/spill.py; extending the spill front-end to
-CSR input — sparse-dense pivot products + per-leaf gram — is the
-documented growth path past ~50k sparse rows.)
+Memory is bounded by the largest gram, not by the vocabulary size: D
+only affects how many feature blocks the scan walks. A single [N, N]
+gram serves the 20-Newsgroups-scale config directly; past the
+single-gram cap, ``max_points_per_partition`` routes the run through
+metric spill partitioning (parallel/spill.py — CSR rows are unit
+vectors, so pivot chords come from sparse-dense products) with per-leaf
+grams bounded at the partition size and the driver's shared
+instance-table merge.
 """
 
 from __future__ import annotations
