@@ -572,6 +572,7 @@ def train_arrays(
     flags aligned with the input row order.
     """
     cfg = cfg.validate()
+    raw = np.asarray(points)
     if cfg.use_pallas and cfg.metric != "euclidean":
         raise ValueError(
             "use_pallas supports only the euclidean metric; got "
@@ -584,7 +585,14 @@ def train_arrays(
             f"the XLA bf16 kernel); got precision={cfg.precision.value!r} "
             "— use Precision.F32 or the XLA path"
         )
-    pts = np.asarray(points, dtype=np.float64)
+    # The geometry paths (grid snapping, rectangles, projections) need
+    # f64; the cosine spill path never does — its working arrays are the
+    # f32 unit rows — so float embedding inputs keep their own dtype
+    # instead of materializing a [N, 512] f64 copy (40 GB at 10M rows).
+    if cfg.metric == "cosine" and raw.dtype in (np.float32, np.float64):
+        pts = raw
+    else:
+        pts = np.asarray(raw, dtype=np.float64)  # no-op when already f64
     if pts.ndim != 2 or pts.shape[1] < 2:
         raise ValueError(f"points must be [N, >=2], got {pts.shape}")
     n = len(pts)
@@ -704,7 +712,10 @@ def train_arrays(
         # f64 from the original data: an f32 norm would underflow tiny
         # rows into false zeros (the kernel normalizes in higher
         # precision and would find their neighbors).
-        norms64 = np.linalg.norm(pts, axis=1)
+        # f64 accumulation without materializing an f64 copy: einsum
+        # upcasts per buffer block, so tiny f32 rows don't underflow
+        # into false zeros
+        norms64 = np.sqrt(np.einsum("ij,ij->i", pts, pts, dtype=np.float64))
         zeros = norms64 == 0.0
         if zeros.any() and not zeros.all() and (cfg.eps + q) < 1.0:
             sub = train_arrays(pts[~zeros], cfg, mesh=mesh)
@@ -719,8 +730,11 @@ def train_arrays(
                 clusters, flags, sub.partitions, sub.n_clusters, stats
             )
         # normalize straight into f32 (the spill pass's working dtype):
-        # a 10M x 512 f64 intermediate would triple peak host memory
-        unit = np.ascontiguousarray(pts, dtype=np.float32)
+        # a 10M x 512 f64 intermediate would triple peak host memory.
+        # copy=True: pts may alias the CALLER'S array (f32 inputs are
+        # passed through un-copied) and the in-place divide below must
+        # never touch it
+        unit = pts.astype(np.float32, copy=True)
         unit /= np.maximum(
             np.linalg.norm(unit, axis=1), np.float32(1e-30)
         )[:, None]

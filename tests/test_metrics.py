@@ -211,3 +211,28 @@ def test_cosine_zero_rows_spill():
     assert model.stats["n_partitions"] > 4
     assert (model.clusters[600:] == 0).all()
     assert model.n_clusters == 6
+
+
+def test_cosine_f32_input_no_upcast_equivalence():
+    """f32 embedding input keeps its dtype (no [N, D] f64 copy) and
+    produces labels identical to the same values passed as f64."""
+    rng = np.random.default_rng(11)
+    d = 32
+    c = rng.normal(size=(5, d))
+    data32 = (
+        np.repeat(c, 80, axis=0) + 0.02 * rng.normal(size=(400, d))
+    ).astype(np.float32)
+    kw = dict(
+        eps=0.03, min_points=5, max_points_per_partition=128,
+        metric="cosine",
+    )
+    snapshot = data32.copy()
+    data64 = data32.astype(np.float64)
+    m32 = train(data32, **kw)
+    # the pass-through must never mutate the caller's array (the spill
+    # path normalizes a copy, not the input)
+    np.testing.assert_array_equal(data32, snapshot)
+    m64 = train(data64, **kw)
+    np.testing.assert_array_equal(m32.clusters, m64.clusters)
+    np.testing.assert_array_equal(m32.flags, m64.flags)
+    assert m32.stats["n_partitions"] > 1
