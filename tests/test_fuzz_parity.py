@@ -1,0 +1,89 @@
+"""Randomized cross-metric parity fuzz: train() vs the f64 oracle over
+random configurations of all three decomposition paths (2eps grid,
+spherical embedding, metric spill). Trials whose data has any pair
+within a hairline of the eps boundary are re-rolled — the engine
+decides in f32, the oracle in f64, and a boundary-exact pair could flip
+legitimately; everything else must match exactly."""
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import Engine, train
+from dbscan_tpu.ops.distance import get_metric
+from dbscan_tpu.utils.ari import adjusted_rand_index
+from dbscan_tpu.utils.reference_engines import archery_fit, naive_fit
+
+
+def _boundary_clear(data, metric, eps, rel=2e-5):
+    # the engines' f32 arithmetic is good to ~1e-7 relative; a 2e-5
+    # exclusion window is 100x that while still letting most random
+    # datasets through
+    m = get_metric(metric)
+    d = np.asarray(m.pairwise(data, data), dtype=np.float64)
+    thr = float(m.threshold(eps))
+    return not (np.abs(d - thr) < rel * max(thr, 1e-12)).any()
+
+
+def _gen(rng, metric):
+    if metric == "euclidean":
+        k = int(rng.integers(2, 6))
+        centers = rng.uniform(-30, 30, (k, 2))
+        data = np.concatenate(
+            [rng.normal(c, rng.uniform(0.2, 0.6), (60, 2)) for c in centers]
+            + [rng.uniform(-35, 35, (30, 2))]
+        )
+        eps = float(rng.uniform(0.3, 0.8))
+    elif metric == "haversine":
+        k = int(rng.integers(2, 5))
+        lons = rng.uniform(-74.2, -73.6, k)
+        lats = rng.uniform(40.5, 41.0, k)
+        data = np.concatenate(
+            [
+                np.stack(
+                    [
+                        rng.normal(lo, 0.002, 60),
+                        rng.normal(la, 0.002, 60),
+                    ],
+                    axis=1,
+                )
+                for lo, la in zip(lons, lats)
+            ]
+        )
+        eps = float(rng.uniform(0.2, 0.5))
+    else:  # cosine
+        d = int(rng.integers(8, 48))
+        k = int(rng.integers(2, 6))
+        c = rng.normal(size=(k, d))
+        c /= np.linalg.norm(c, axis=1, keepdims=True)
+        data = np.repeat(c, 60, axis=0) + 0.02 * rng.normal(
+            size=(k * 60, d)
+        )
+        eps = float(rng.uniform(0.02, 0.06))
+    return data, eps
+
+
+@pytest.mark.parametrize("metric", ["euclidean", "haversine", "cosine"])
+def test_fuzz_parity(rng, metric):
+    done = 0
+    attempts = 0
+    while done < 4 and attempts < 20:
+        attempts += 1
+        data, eps = _gen(rng, metric)
+        if not _boundary_clear(data, metric, eps):
+            continue
+        min_points = int(rng.integers(3, 10))
+        maxpp = int(rng.choice([64, 128, 256]))
+        engine = rng.choice(["naive", "archery"])
+        model = train(
+            data, eps=eps, min_points=min_points,
+            max_points_per_partition=maxpp, metric=metric,
+            engine=Engine.NAIVE if engine == "naive" else Engine.ARCHERY,
+        )
+        oracle = naive_fit if engine == "naive" else archery_fit
+        ocl, ofl = oracle(data, eps, min_points, metric=metric)
+        assert adjusted_rand_index(model.clusters, ocl) == 1.0, (
+            metric, eps, min_points, maxpp, engine, done, attempts
+        )
+        np.testing.assert_array_equal(model.flags, ofl)
+        done += 1
+    assert done == 4, f"only {done} boundary-clear trials in {attempts}"
