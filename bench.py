@@ -28,7 +28,9 @@ streaming Pallas kernels; the CPU baseline always uses the XLA path),
 BENCH_ANCHOR (1 = append the 10M-point engineered-structure euclidean
 anchor: exact expected cluster count + ARI vs construction,
 BENCH_ANCHOR_N to resize), BENCH_HAVERSINE (1 = append the 10M-point
-NYC-like haversine row, BENCH_HAV_N to resize).
+NYC-like haversine row, BENCH_HAV_N to resize), BENCH_COSINE (1 =
+append the 1M-point 512-d embeddings row via metric spill partitioning,
+BENCH_COS_N / BENCH_COS_MAXPP to resize).
 """
 
 import json
@@ -62,21 +64,37 @@ def make_data(n: int) -> np.ndarray:
     return pts
 
 
-def make_anchor(n: int, haversine: bool):
+def make_anchor(n: int, kind: str):
     """Engineered separated-cluster workload: K hotspots with known
     membership (the >=10M correctness anchor, VERDICT r1 item 5). Returns
     (points, blob_of [n_blob], n_blob, K, eps). Separation/spread are set
     so every blob is one cluster and blobs never bridge: spacing >= 10x
     eps, sigma ~ 0.3x eps; K scales with N so per-blob counts stay far
-    above minPts (~5000/blob at the 10M reference size)."""
+    above minPts (~5000/blob at the 10M reference size). ``kind`` is
+    euclidean / haversine / cosine (cosine: 512-d unit-sphere blobs,
+    random-direction noise — sim ~0 to everything)."""
     rng = np.random.default_rng(42)
+    if kind == "cosine":
+        d = 512
+        k = min(1000, max(16, n // 1000))
+        n_noise = n // 1000
+        n_blob = n - n_noise
+        blob_of = rng.integers(0, k, n_blob)
+        centers = rng.normal(size=(k, d)).astype(np.float32)
+        centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+        # generate noise straight in f32: an f64 temporary would be
+        # ~41 GB at the 10M resize (the same copy the driver avoids)
+        pts = rng.standard_normal((n, d), dtype=np.float32)
+        pts[:n_blob] *= np.float32(0.002)
+        pts[:n_blob] += centers[blob_of]
+        return pts, blob_of, n_blob, k, 0.02
     k = min(2000, max(16, n // 2500))
     gx = int(np.ceil(np.sqrt(k)))
     n_noise = n // 1000
     n_blob = n - n_noise
     blob_of = rng.integers(0, k, n_blob)
     pts = np.empty((n, 2))
-    if haversine:
+    if kind == "haversine":
         km_lat = 111.0
         km_lon = 111.0 * np.cos(np.deg2rad(40.75))
         centers = np.stack(
@@ -138,17 +156,17 @@ def child_cpu(data_path: str, out_path: str, maxpp: int) -> None:
     np.savez(out_path, clusters=model.clusters, seconds=dt, n=len(pts))
 
 
-def anchor_row(prefix: str, n: int, haversine: bool, maxpp: int) -> dict:
+def anchor_row(prefix: str, n: int, kind: str, maxpp: int) -> dict:
     """One engineered-structure run: exact cluster count + construction
     ARI are the correctness anchor at scale (no oracle fits >=10M). Same
     timing discipline as the headline number (run_train: compile warm-up,
     best-of-reps) so the row is hot and tunnel-jitter-resistant."""
     from dbscan_tpu.utils.ari import adjusted_rand_index
 
-    pts, blob_of, n_blob, k, eps = make_anchor(n, haversine)
+    pts, blob_of, n_blob, k, eps = make_anchor(n, kind)
     extra = {"eps": eps}
-    if haversine:
-        extra["metric"] = "haversine"
+    if kind != "euclidean":
+        extra["metric"] = kind
     reps = int(os.environ.get("BENCH_ANCHOR_REPS", "2"))
     model, dt = run_train(pts, maxpp, reps=reps, **extra)
     ari = adjusted_rand_index(model.clusters[:n_blob], blob_of)
@@ -297,7 +315,7 @@ def main() -> None:
             anchor_row(
                 "anchor",
                 int(os.environ.get("BENCH_ANCHOR_N", "10000000")),
-                haversine=False,
+                kind="euclidean",
                 maxpp=int(os.environ.get("BENCH_ANCHOR_MAXPP", "131072")),
             )
         )
@@ -306,8 +324,17 @@ def main() -> None:
             anchor_row(
                 "haversine",
                 int(os.environ.get("BENCH_HAV_N", "10000000")),
-                haversine=True,
+                kind="haversine",
                 maxpp=int(os.environ.get("BENCH_HAV_MAXPP", "131072")),
+            )
+        )
+    if os.environ.get("BENCH_COSINE", "0") == "1":
+        out.update(
+            anchor_row(
+                "cosine",
+                int(os.environ.get("BENCH_COS_N", "1000000")),
+                kind="cosine",
+                maxpp=int(os.environ.get("BENCH_COS_MAXPP", "8192")),
             )
         )
     print(json.dumps(out))
