@@ -211,7 +211,16 @@ def _ensure_live_backend() -> None:
     env = dict(os.environ)
     env["BENCH_NO_TPU_PROBE"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO  # drop the device-plugin path
+    # drop only sitecustomize-bearing entries (the device-plugin path) from
+    # PYTHONPATH; keep anything else the user set
+    keep = [
+        p
+        for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p
+        and p != REPO
+        and not os.path.exists(os.path.join(p, "sitecustomize.py"))
+    ]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + keep)
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 

@@ -84,8 +84,7 @@ def _pack_csr(x_csr, feature_block: int) -> _PackedCSR:
     return _PackedCSR(r, c, v, n, n_blocks)
 
 
-@functools.partial(jax.jit, static_argnames=("n_rows", "feature_block"))
-def _gram_from_packed(rows, cols, vals, n_rows: int, feature_block: int):
+def _gram_scan(rows, cols, vals, n_rows: int, feature_block: int):
     """Accumulate X @ X.T over feature blocks: scatter-densify each
     [N, F_block] slab, one MXU matmul per block."""
 
@@ -101,6 +100,34 @@ def _gram_from_packed(rows, cols, vals, n_rows: int, feature_block: int):
     init = jnp.zeros((n_rows, n_rows), dtype=jnp.float32)
     gram, _ = jax.lax.scan(step, init, (rows, cols, vals))
     return gram
+
+
+@functools.partial(jax.jit, static_argnames=("n_rows", "feature_block"))
+def _gram_from_packed(rows, cols, vals, n_rows: int, feature_block: int):
+    return _gram_scan(rows, cols, vals, n_rows, feature_block)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("w", "feature_block", "min_points", "engine")
+)
+def _cluster_packed_batch(
+    rows, cols, vals, mask, eps, w: int, feature_block: int,
+    min_points: int, engine: str,
+) -> LocalResult:
+    """Gram + cluster a BATCH of same-width leaves in one dispatch:
+    [G, n_blocks, nnz] packed triples + [G, w] masks -> LocalResult with
+    [G, w] leading shape. One launch and one pull serve the whole batch —
+    the leaf-loop replacement for the tunnel's ~0.5 s/pull latency."""
+
+    def one(r, c, v, m):
+        gram = _gram_scan(r, c, v, w, feature_block)
+        dist = 1.0 - gram
+        adj = dist <= eps
+        adj = adj | jnp.eye(w, dtype=bool)
+        adj = adj & (m[None, :] & m[:, None])
+        return cluster_from_adjacency(adj, m, min_points, engine)
+
+    return jax.vmap(one)(rows, cols, vals, mask)
 
 
 def _normalize_rows(x_csr):
@@ -254,7 +281,10 @@ def _spill_sparse(
     # the gram's f32 scatter-accumulate rounds with the
     # nnz-per-feature-block count; 1e-4 covers blocks to ~2^14
     # accumulated terms with margin
-    halo = chord_halo(eps, 1e-4)
+    # the f32 chord error scales with the terms actually accumulated per
+    # row-pair dot — bounded by the max row nnz, NOT the vocabulary width
+    max_row_nnz = int(max(1, x.getnnz(axis=1).max())) if x.shape[0] else 1
+    halo = chord_halo(eps, 1e-4, dim=max_row_nnz)
     part_ids, point_idx, n_parts, home_of = spill_partition(
         x.astype(np.float32), max_points_per_partition, halo
     )
@@ -269,34 +299,94 @@ def _spill_sparse(
             duplication_factor=float(len(part_ids)) / max(1, n),
         )
 
-    seeds_l, flags_l = [], []
-    max_b = 0
+    # Same-ladder-width leaves batch into ONE vmapped gram+cluster
+    # dispatch (the dense driver's bucket-group pattern,
+    # parallel/driver.py dispatch-on-pack): each batch goes out the
+    # moment it is packed, the host keeps packing the next batch while
+    # the device works, and NO result is pulled until every batch is in
+    # flight. The per-leaf np.asarray barrier this replaces serialized
+    # host pack and device compute AND paid the tunnel's ~0.5 s pull
+    # latency once per leaf instead of once per batch.
+    by_width: dict = {}
     for p in range(n_parts):
-        # instances are partition-major: O(1) slices, no per-leaf scan
-        rows_p = point_idx[offsets[p] : offsets[p + 1]]
-        w = widths[p]
+        by_width.setdefault(widths[p], []).append(p)
+
+    # cap the dispatch's f32 elements by its LARGEST live buffer: the
+    # [G, w, w] gram stack for wide leaves, the [G, w, feature_block]
+    # scatter slab inside the vmapped scan for narrow ones (w < block).
+    # Small leaves still batch by the hundreds, the largest go out alone.
+    gram_budget = 1 << 26
+    pending = []  # (leaf ids, their true sizes, in-flight LocalResult)
+    max_b = 0
+    for w in sorted(by_width):
         max_b = max(max_b, w)
-        xp = x[rows_p]
-        if w > len(rows_p):  # pad to the ladder width (zero rows, masked)
-            xp = sp.vstack(
-                [xp, sp.csr_matrix((w - len(rows_p), x.shape[1]))]
-            ).tocsr()
-        gram = _gram_unit(xp, feature_block)
-        res = _cluster_gram(
-            gram,
-            jnp.float32(eps),
-            jnp.arange(w) < len(rows_p),
-            min_points,
-            engine,
-        )
-        seeds_l.append(np.asarray(res.seed_labels)[: len(rows_p)])
-        flags_l.append(np.asarray(res.flags)[: len(rows_p)])
+        leaf_ids = by_width[w]
+        gcap = max(1, gram_budget // (w * max(w, feature_block)))
+        for s in range(0, len(leaf_ids), gcap):
+            chunk = leaf_ids[s : s + gcap]
+            packs, sizes = [], []
+            for p in chunk:
+                # instances are partition-major: O(1) slices, no scan
+                rows_p = point_idx[offsets[p] : offsets[p + 1]]
+                sizes.append(len(rows_p))
+                xp = x[rows_p]
+                if w > len(rows_p):  # pad to ladder width (zero rows)
+                    xp = sp.vstack(
+                        [xp, sp.csr_matrix((w - len(rows_p), x.shape[1]))]
+                    ).tocsr()
+                packs.append(_pack_csr(xp, feature_block))
+            # common nnz width across the batch (each pack is already
+            # ladder-rounded, so the max recurs across runs); ladder the
+            # batch count too — jit keys on [G, ...], and a raw
+            # data-dependent remainder G would recompile per run. Padding
+            # slots are all-masked empty leaves (zero triples -> zero
+            # gram -> all noise, discarded).
+            nnz_w = max(pk.rows.shape[1] for pk in packs)
+            g = min(_ladder_width(len(packs), 1), gcap)
+            n_blocks = packs[0].n_blocks
+            rows_b = np.zeros((g, n_blocks, nnz_w), dtype=np.int32)
+            cols_b = np.zeros((g, n_blocks, nnz_w), dtype=np.int32)
+            vals_b = np.zeros((g, n_blocks, nnz_w), dtype=np.float32)
+            mask_b = np.zeros((g, w), dtype=bool)
+            for i, pk in enumerate(packs):
+                m = pk.rows.shape[1]
+                rows_b[i, :, :m] = pk.rows
+                cols_b[i, :, :m] = pk.cols
+                vals_b[i, :, :m] = pk.vals
+                mask_b[i, : sizes[i]] = True
+            res = _cluster_packed_batch(
+                jnp.asarray(rows_b),
+                jnp.asarray(cols_b),
+                jnp.asarray(vals_b),
+                jnp.asarray(mask_b),
+                jnp.float32(eps),
+                w,
+                feature_block,
+                min_points,
+                engine,
+            )
+            pending.append((chunk, sizes, res))
+
+    # pull every batch (device already done or draining), then reassemble
+    # in partition-major instance order for the shared merge
+    seeds_by_leaf = [None] * n_parts
+    flags_by_leaf = [None] * n_parts
+    for chunk, sizes, res in pending:
+        seeds = np.asarray(res.seed_labels)
+        flg = np.asarray(res.flags)
+        for i, p in enumerate(chunk):
+            seeds_by_leaf[p] = seeds[i, : sizes[i]]
+            flags_by_leaf[p] = flg[i, : sizes[i]]
 
     inst_seed = (
-        np.concatenate(seeds_l) if seeds_l else np.empty(0, np.int32)
+        np.concatenate(seeds_by_leaf)
+        if n_parts
+        else np.empty(0, np.int32)
     )
     inst_flag = (
-        np.concatenate(flags_l) if flags_l else np.empty(0, np.int8)
+        np.concatenate(flags_by_leaf)
+        if n_parts
+        else np.empty(0, np.int8)
     )
     cand, inst_inner = band_membership(part_ids, point_idx, home_of, n)
     clusters, flags, _ = finalize_merge(

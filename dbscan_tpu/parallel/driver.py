@@ -702,7 +702,7 @@ def train_arrays(
             q = 0.02
         else:
             q = max(1e-5, pts.shape[1] * 2.0**-22)
-        halo = spill.chord_halo(cfg.eps, q)
+        halo = spill.chord_halo(cfg.eps, q, dim=int(pts.shape[1]))
         # Zero-norm rows are sim-0 (cos_dist exactly 1) to everything:
         # inside the spill tree each would be equidistant to every pivot
         # and get copied into every cell at every level. Whenever even
@@ -725,7 +725,15 @@ def train_arrays(
             clusters[nzi] = sub.clusters
             flags[nzi] = sub.flags
             stats = dict(sub.stats)
+            # sub-run stats describe the nonzero subset; rescale the
+            # instance ratio to the full N and record the zero-norm rows
+            # routed to noise so the diagnostics stay consistent
+            if "duplication_factor" in stats:
+                stats["duplication_factor"] = float(
+                    stats["duplication_factor"] * (n - int(zeros.sum())) / n
+                )
             stats["n_points"] = n
+            stats["n_zero_norm_noise"] = int(zeros.sum())
             return TrainOutput(
                 clusters, flags, sub.partitions, sub.n_clusters, stats
             )

@@ -113,7 +113,7 @@ class _DenseOps:
 
 class _SparseOps:
     """Same primitives over a scipy CSR matrix (unit rows). Pivot vectors
-    stay dense ([m, D], m <= 48) — only row data is sparse."""
+    stay dense ([m, D], m <= _MAX_PIVOTS) — only row data is sparse."""
 
     def __init__(self, x_csr):
         import scipy.sparse as sp
@@ -142,11 +142,18 @@ class _SparseOps:
         return np.asarray((sel @ self.x).todense(), dtype=np.float32)
 
 
-def chord_halo(eps: float, quantization: float) -> float:
+def chord_halo(eps: float, quantization: float, dim: int = 0) -> float:
     """Spill halo (chord units) for a cosine threshold: accepted pairs
-    have measured cos_dist <= eps + quantization, plus the f32
-    pivot-distance rounding as an absolute term."""
-    return float(np.sqrt(2.0 * (eps + quantization)) + 1e-6)
+    have measured cos_dist <= eps + quantization, plus an absolute slack
+    covering the f32 pivot-chord rounding on the SPILL side. The kernel
+    quantization term does not cover that error: _chords accumulates up
+    to ~dim * 2^-24 dot error in its f32 matmul, and at chord c the
+    induced chord error is ~(dot error) / c — largest where it matters,
+    at the band boundary c ~ base halo. Scale the slack with
+    dim * 2^-24 / base (conservative: linear in dim, not sqrt)."""
+    base = float(np.sqrt(2.0 * (eps + quantization)))
+    slack = max(1e-6, dim * 2.0**-24 / max(base, 1e-3))
+    return base + slack
 
 
 def band_membership(
