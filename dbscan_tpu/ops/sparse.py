@@ -107,27 +107,14 @@ def _gram_from_packed(rows, cols, vals, n_rows: int, feature_block: int):
     return _gram_scan(rows, cols, vals, n_rows, feature_block)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("w", "feature_block", "min_points", "engine")
-)
-def _cluster_packed_batch(
-    rows, cols, vals, mask, eps, w: int, feature_block: int,
-    min_points: int, engine: str,
-) -> LocalResult:
-    """Gram + cluster a BATCH of same-width leaves in one dispatch:
-    [G, n_blocks, nnz] packed triples + [G, w] masks -> LocalResult with
-    [G, w] leading shape. One launch and one pull serve the whole batch —
-    the leaf-loop replacement for the tunnel's ~0.5 s/pull latency."""
-
-    def one(r, c, v, m):
-        gram = _gram_scan(r, c, v, w, feature_block)
-        dist = 1.0 - gram
-        adj = dist <= eps
-        adj = adj | jnp.eye(w, dtype=bool)
-        adj = adj & (m[None, :] & m[:, None])
-        return cluster_from_adjacency(adj, m, min_points, engine)
-
-    return jax.vmap(one)(rows, cols, vals, mask)
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _stash(buf, vals, offset):
+    """Write a leaf's padded result into the run-wide device accumulator
+    at a TRACED offset: one compiled kernel per (buffer len, leaf width)
+    pair — not per offset — and the buffer is donated, so the device
+    keeps one copy. This is how per-leaf results coalesce into a single
+    end-of-run pull instead of one ~0.5 s tunnel pull per leaf."""
+    return jax.lax.dynamic_update_slice(buf, vals, (offset,))
 
 
 def _normalize_rows(x_csr):
@@ -232,8 +219,17 @@ def sparse_cosine_dbscan(
             )
             clusters[nz_rows] = sub_c
             flags[nz_rows] = sub_f
+            if stats_out is not None and "duplication_factor" in stats_out:
+                # sub-run stats describe the nonzero subset; rescale the
+                # instance ratio to the full N (same convention as the
+                # dense driver's zero-norm strip, parallel/driver.py)
+                stats_out["duplication_factor"] = float(
+                    stats_out["duplication_factor"] * len(nz_rows) / n
+                )
         elif stats_out is not None:
             stats_out.update(n_partitions=0, duplication_factor=0.0)
+        if stats_out is not None:
+            stats_out["n_zero_norm_noise"] = int(n - len(nz_rows))
         return clusters, flags
     return _spill_sparse(
         x, eps, min_points, engine, feature_block,
@@ -299,94 +295,54 @@ def _spill_sparse(
             duplication_factor=float(len(part_ids)) / max(1, n),
         )
 
-    # Same-ladder-width leaves batch into ONE vmapped gram+cluster
-    # dispatch (the dense driver's bucket-group pattern,
-    # parallel/driver.py dispatch-on-pack): each batch goes out the
-    # moment it is packed, the host keeps packing the next batch while
-    # the device works, and NO result is pulled until every batch is in
-    # flight. The per-leaf np.asarray barrier this replaces serialized
-    # host pack and device compute AND paid the tunnel's ~0.5 s pull
-    # latency once per leaf instead of once per batch.
-    by_width: dict = {}
+    # Per-leaf gram+cluster dispatch with NO per-leaf pull: each leaf's
+    # padded result is stashed into one run-wide device buffer
+    # (dynamic_update_slice at a traced offset) and the host moves
+    # straight to packing the next leaf. Everything comes back in ONE
+    # pull at the end — the per-leaf np.asarray barrier this replaces
+    # serialized host pack and device compute AND paid the tunnel's
+    # ~0.5 s pull latency once per leaf. Leaf kernels keep their exact
+    # ladder shapes (jit cache) and per-leaf iteration counts.
+    slot_off = np.r_[0, np.cumsum(widths)].astype(np.int64)
+    total = _ladder_width(int(slot_off[-1]), 128)
+    seed_buf = jnp.zeros(total, dtype=jnp.int32)
+    flag_buf = jnp.zeros(total, dtype=jnp.int8)
+    max_b = max(widths)
     for p in range(n_parts):
-        by_width.setdefault(widths[p], []).append(p)
+        # instances are partition-major: O(1) slices, no per-leaf scan
+        rows_p = point_idx[offsets[p] : offsets[p + 1]]
+        w = widths[p]
+        xp = x[rows_p]
+        if w > len(rows_p):  # pad to the ladder width (zero rows, masked)
+            xp = sp.vstack(
+                [xp, sp.csr_matrix((w - len(rows_p), x.shape[1]))]
+            ).tocsr()
+        gram = _gram_unit(xp, feature_block)
+        res = _cluster_gram(
+            gram,
+            jnp.float32(eps),
+            jnp.arange(w) < len(rows_p),
+            min_points,
+            engine,
+        )
+        seed_buf = _stash(seed_buf, res.seed_labels, int(slot_off[p]))
+        flag_buf = _stash(flag_buf, res.flags, int(slot_off[p]))
 
-    # cap the dispatch's f32 elements by its LARGEST live buffer: the
-    # [G, w, w] gram stack for wide leaves, the [G, w, feature_block]
-    # scatter slab inside the vmapped scan for narrow ones (w < block).
-    # Small leaves still batch by the hundreds, the largest go out alone.
-    gram_budget = 1 << 26
-    pending = []  # (leaf ids, their true sizes, in-flight LocalResult)
-    max_b = 0
-    for w in sorted(by_width):
-        max_b = max(max_b, w)
-        leaf_ids = by_width[w]
-        gcap = max(1, gram_budget // (w * max(w, feature_block)))
-        for s in range(0, len(leaf_ids), gcap):
-            chunk = leaf_ids[s : s + gcap]
-            packs, sizes = [], []
-            for p in chunk:
-                # instances are partition-major: O(1) slices, no scan
-                rows_p = point_idx[offsets[p] : offsets[p + 1]]
-                sizes.append(len(rows_p))
-                xp = x[rows_p]
-                if w > len(rows_p):  # pad to ladder width (zero rows)
-                    xp = sp.vstack(
-                        [xp, sp.csr_matrix((w - len(rows_p), x.shape[1]))]
-                    ).tocsr()
-                packs.append(_pack_csr(xp, feature_block))
-            # common nnz width across the batch (each pack is already
-            # ladder-rounded, so the max recurs across runs); ladder the
-            # batch count too — jit keys on [G, ...], and a raw
-            # data-dependent remainder G would recompile per run. Padding
-            # slots are all-masked empty leaves (zero triples -> zero
-            # gram -> all noise, discarded).
-            nnz_w = max(pk.rows.shape[1] for pk in packs)
-            g = min(_ladder_width(len(packs), 1), gcap)
-            n_blocks = packs[0].n_blocks
-            rows_b = np.zeros((g, n_blocks, nnz_w), dtype=np.int32)
-            cols_b = np.zeros((g, n_blocks, nnz_w), dtype=np.int32)
-            vals_b = np.zeros((g, n_blocks, nnz_w), dtype=np.float32)
-            mask_b = np.zeros((g, w), dtype=bool)
-            for i, pk in enumerate(packs):
-                m = pk.rows.shape[1]
-                rows_b[i, :, :m] = pk.rows
-                cols_b[i, :, :m] = pk.cols
-                vals_b[i, :, :m] = pk.vals
-                mask_b[i, : sizes[i]] = True
-            res = _cluster_packed_batch(
-                jnp.asarray(rows_b),
-                jnp.asarray(cols_b),
-                jnp.asarray(vals_b),
-                jnp.asarray(mask_b),
-                jnp.float32(eps),
-                w,
-                feature_block,
-                min_points,
-                engine,
-            )
-            pending.append((chunk, sizes, res))
-
-    # pull every batch (device already done or draining), then reassemble
-    # in partition-major instance order for the shared merge
-    seeds_by_leaf = [None] * n_parts
-    flags_by_leaf = [None] * n_parts
-    for chunk, sizes, res in pending:
-        seeds = np.asarray(res.seed_labels)
-        flg = np.asarray(res.flags)
-        for i, p in enumerate(chunk):
-            seeds_by_leaf[p] = seeds[i, : sizes[i]]
-            flags_by_leaf[p] = flg[i, : sizes[i]]
-
-    inst_seed = (
-        np.concatenate(seeds_by_leaf)
-        if n_parts
-        else np.empty(0, np.int32)
+    # the single pull, then reassembly in partition-major instance order
+    # for the shared merge (each leaf's true size is counts[p])
+    seeds_all = np.asarray(seed_buf)
+    flags_all = np.asarray(flag_buf)
+    inst_seed = np.concatenate(
+        [
+            seeds_all[slot_off[p] : slot_off[p] + counts[p]]
+            for p in range(n_parts)
+        ]
     )
-    inst_flag = (
-        np.concatenate(flags_by_leaf)
-        if n_parts
-        else np.empty(0, np.int8)
+    inst_flag = np.concatenate(
+        [
+            flags_all[slot_off[p] : slot_off[p] + counts[p]]
+            for p in range(n_parts)
+        ]
     )
     cand, inst_inner = band_membership(part_ids, point_idx, home_of, n)
     clusters, flags, _ = finalize_merge(
