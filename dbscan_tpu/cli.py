@@ -74,6 +74,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="print run statistics as JSON to stdout",
     )
     p.add_argument(
+        "--checkpoint-dir",
+        help="persist the pre-merge state here; a re-run with the same "
+        "data and parameters resumes at the merge phase",
+    )
+    p.add_argument(
         "--platform", choices=["cpu", "tpu", "gpu"],
         help="pin the JAX platform (wins over JAX_PLATFORMS, which "
         "site-level plugin registration can override)",
@@ -124,6 +129,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         precision=Precision(args.precision),
         use_pallas=args.use_pallas,
         mesh=mesh,
+        checkpoint_dir=args.checkpoint_dir,
     )
     seconds = time.perf_counter() - t0
     log.info("clustered in %.3fs: %d clusters", seconds, model.n_clusters)

@@ -95,6 +95,7 @@ def train(
     neighbor_backend: str = "auto",
     mesh=None,
     config: Optional[DBSCANConfig] = None,
+    checkpoint_dir: Optional[str] = None,
 ) -> DBSCANModel:
     """Train a distributed DBSCAN model (reference DBSCAN.train,
     DBSCAN.scala:40-48).
@@ -104,6 +105,9 @@ def train(
     along into labeled_points.
     mesh: optional jax.sharding.Mesh to fan partitions out over devices;
     None = single device.
+    checkpoint_dir: when set, the expensive pre-merge state is persisted
+    there and a re-run with the same data/config resumes at the merge
+    phase (parallel/checkpoint.py — the Spark-lineage replacement).
     """
     cfg = config or DBSCANConfig(
         eps=eps,
@@ -116,7 +120,9 @@ def train(
         use_pallas=use_pallas,
         neighbor_backend=neighbor_backend,
     )
-    out: TrainOutput = train_arrays(data, cfg, mesh=mesh)
+    out: TrainOutput = train_arrays(
+        data, cfg, mesh=mesh, checkpoint_dir=checkpoint_dir
+    )
     return DBSCANModel(
         config=cfg,
         points=np.asarray(data),

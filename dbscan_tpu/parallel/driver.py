@@ -560,16 +560,51 @@ def finalize_merge(
     return res_cluster, res_flag, n_clusters
 
 
+def _resume_from_premerge(state: dict, t_start: float) -> TrainOutput:
+    """Finish a checkpointed run: the saved flat instance tables go straight
+    into finalize_merge — decomposition, packing, and the device phase are
+    skipped entirely (parallel/checkpoint.py has the recovery story).
+
+    The checkpoint's scalars ARE the fresh run's core stats dict (one
+    schema, saved verbatim); only n_clusters, the resume marker, and the
+    timings are added here."""
+    a, s = state["arrays"], state["scalars"]
+    res_cluster, res_flag, n_clusters = finalize_merge(
+        a["inst_part"], a["inst_ptidx"], a["inst_seed"], a["inst_flag"],
+        a["cand"], a["inst_inner"],
+        int(s["n_points"]), int(s["n_partitions"]), int(s["bucket_size"]),
+    )
+    rects = a["rects"]
+    partitions = [(i, rects[i]) for i in range(len(rects))]
+    now = time.perf_counter()
+    stats = {
+        **s,
+        "n_clusters": n_clusters,
+        "resumed_from_checkpoint": True,
+        "timings": {
+            "merge_s": round(now - t_start, 6),
+            "total_s": round(now - t_start, 6),
+        },
+    }
+    return TrainOutput(res_cluster, res_flag, partitions, n_clusters, stats)
+
+
 def train_arrays(
     points: np.ndarray,
     cfg: DBSCANConfig,
     mesh=None,
+    checkpoint_dir: Optional[str] = None,
 ) -> TrainOutput:
     """Run the full distributed pipeline on host arrays.
 
     points: [N, >=2]; only the first two columns participate in clustering
     (reference DBSCAN.scala:33-34). Returns per-point global cluster ids and
     flags aligned with the input row order.
+
+    checkpoint_dir: when set, the pre-merge state (partition rects + flat
+    per-partition seed tables) is written there once the device phase
+    completes, and a later call with the same data/config resumes straight
+    at the merge (parallel/checkpoint.py).
     """
     cfg = cfg.validate()
     raw = np.asarray(points)
@@ -620,6 +655,17 @@ def train_arrays(
     cell = cfg.minimum_rectangle_size
     timings: dict = {}
     t_start = time.perf_counter()
+
+    ckpt_fp = None
+    if checkpoint_dir is not None:
+        from dbscan_tpu.parallel import checkpoint as _ckpt
+
+        ckpt_fp = _ckpt.run_fingerprint(pts, cfg)
+        state = _ckpt.load_premerge(checkpoint_dir, ckpt_fp)
+        if state is not None:
+            logger.info("resuming from pre-merge checkpoint in %s",
+                        checkpoint_dir)
+            return _resume_from_premerge(state, t_start)
 
     def _mark(phase: str, t0: float) -> float:
         now = time.perf_counter()
@@ -718,7 +764,9 @@ def train_arrays(
         norms64 = np.sqrt(np.einsum("ij,ij->i", pts, pts, dtype=np.float64))
         zeros = norms64 == 0.0
         if zeros.any() and not zeros.all() and (cfg.eps + q) < 1.0:
-            sub = train_arrays(pts[~zeros], cfg, mesh=mesh)
+            sub = train_arrays(
+                pts[~zeros], cfg, mesh=mesh, checkpoint_dir=checkpoint_dir
+            )
             clusters = np.zeros(n, dtype=np.int32)
             flags = np.full(n, NOISE, dtype=np.int8)
             nzi = np.flatnonzero(~zeros)
@@ -1082,6 +1130,44 @@ def train_arrays(
     inst_flag = np.concatenate(inst_flag_l) if inst_flag_l else np.empty(0, np.int8)
     t0 = _mark("device_s", t0)
 
+    # core stats: one schema shared by the final output, the checkpoint
+    # scalars, and (verbatim) the resumed run's stats
+    core_stats = {
+        "n_points": n,
+        "n_partitions": int(p_true),
+        "bucket_size": int(max_b),
+        "n_bucket_groups": len(groups),
+        "n_banded_groups": sum(1 for g in groups if g.banded is not None),
+        "duplication_factor": float(len(part_ids)) / max(1, n),
+        "n_core_instances": int(n_core),
+        "projected": sph is not None,  # spherical embedding in effect
+        "spill_tree": rp is not None,  # metric spill partitioning in effect
+    }
+
+    if ckpt_fp is not None:
+        from dbscan_tpu.parallel import checkpoint as _ckpt
+
+        _ckpt.save_premerge(
+            checkpoint_dir,
+            ckpt_fp,
+            arrays={
+                "inst_part": inst_part,
+                "inst_ptidx": inst_ptidx,
+                "inst_seed": inst_seed,
+                "inst_flag": inst_flag,
+                "cand": cand,
+                "inst_inner": inst_inner,
+                "rects": (
+                    margins.main
+                    if margins is not None
+                    else np.empty((0, 4), np.float64)
+                ),
+            },
+            scalars=core_stats,
+        )
+        timings["checkpoint_s"] = round(time.perf_counter() - t0, 6)
+        t0 = time.perf_counter()
+
     # 6-9. local ids, cross-partition merge, relabel + dedup — shared with
     # the sparse spill front-end (ops/sparse.py), which produces its own
     # instance tables.
@@ -1097,17 +1183,5 @@ def train_arrays(
     )
     timings["merge_s"] = round(time.perf_counter() - t0, 6)
     timings["total_s"] = round(time.perf_counter() - t_start, 6)
-    stats = {
-        "n_points": n,
-        "n_partitions": p_true,
-        "bucket_size": int(max_b),
-        "n_bucket_groups": len(groups),
-        "n_banded_groups": sum(1 for g in groups if g.banded is not None),
-        "duplication_factor": float(len(part_ids)) / max(1, n),
-        "n_clusters": n_clusters,
-        "n_core_instances": n_core,
-        "projected": sph is not None,  # spherical embedding in effect
-        "spill_tree": rp is not None,  # metric spill partitioning in effect
-        "timings": timings,
-    }
+    stats = {**core_stats, "n_clusters": n_clusters, "timings": timings}
     return TrainOutput(res_cluster, res_flag, partitions, n_clusters, stats)

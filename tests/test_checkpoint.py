@@ -1,0 +1,161 @@
+"""Pre-merge checkpoint/resume (parallel/checkpoint.py).
+
+The reference has no checkpoint of its own — it relies on Spark lineage
+to recompute lost partitions (DBSCAN.scala:59-60). Our story: the flat
+instance tables are persisted once the device phase completes, and a
+killed run resumes straight at the merge. These tests pin:
+
+- round-trip: second run resumes (flag in stats) and reproduces labels;
+- kill/resume: a crash AFTER the checkpoint is written resumes WITHOUT
+  re-running decomposition or the device phase (both are monkeypatched
+  to explode on the resume run);
+- fingerprint safety: changed config or data ignores the checkpoint.
+"""
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import Engine, train
+from dbscan_tpu.parallel import checkpoint as ckpt
+from dbscan_tpu.parallel import driver
+
+
+def _blobs(rng, n_per=200):
+    centers = [(0, 0), (7, 7), (-6, 8), (8, -7)]
+    pts = np.concatenate(
+        [rng.normal(c, 0.4, (n_per, 2)) for c in centers]
+    )
+    rng.shuffle(pts)
+    return pts
+
+
+KW = dict(
+    eps=0.5, min_points=5, max_points_per_partition=128,
+    engine=Engine.ARCHERY,
+)
+
+
+def test_checkpoint_roundtrip(rng, tmp_path):
+    pts = _blobs(rng)
+    clean = train(pts, **KW)
+    first = train(pts, checkpoint_dir=str(tmp_path), **KW)
+    assert "resumed_from_checkpoint" not in first.stats
+    assert (tmp_path / "premerge.npz").exists()
+    assert (tmp_path / "manifest.json").exists()
+    second = train(pts, checkpoint_dir=str(tmp_path), **KW)
+    assert second.stats["resumed_from_checkpoint"] is True
+    np.testing.assert_array_equal(second.clusters, clean.clusters)
+    np.testing.assert_array_equal(second.flags, clean.flags)
+    assert second.n_clusters == clean.n_clusters == 4
+    # partition rectangles survive the round-trip
+    assert len(second.partitions) == len(clean.partitions)
+    for (i, r), (j, s) in zip(second.partitions, clean.partitions):
+        assert i == j
+        np.testing.assert_array_equal(r, s)
+
+
+def test_kill_after_device_phase_resumes_at_merge(rng, tmp_path, monkeypatch):
+    pts = _blobs(rng)
+    clean = train(pts, **KW)
+
+    # crash the first run INSIDE the merge — after the checkpoint write
+    real_merge = driver.finalize_merge
+
+    def dying_merge(*a, **kw):
+        raise KeyboardInterrupt("simulated kill during merge")
+
+    monkeypatch.setattr(driver, "finalize_merge", dying_merge)
+    with pytest.raises(KeyboardInterrupt):
+        train(pts, checkpoint_dir=str(tmp_path), **KW)
+    monkeypatch.setattr(driver, "finalize_merge", real_merge)
+
+    # the resume run must not touch decomposition or the device phase
+    from dbscan_tpu.parallel import binning
+
+    def explode(*a, **kw):  # pragma: no cover - failure path
+        raise AssertionError("resume re-ran a pre-merge phase")
+
+    monkeypatch.setattr(binning, "bucketize_grouped", explode)
+    monkeypatch.setattr(binning, "bucketize_banded", explode)
+    monkeypatch.setattr(binning, "duplicate_points", explode)
+    monkeypatch.setattr(binning, "duplicate_points_grid", explode)
+
+    resumed = train(pts, checkpoint_dir=str(tmp_path), **KW)
+    assert resumed.stats["resumed_from_checkpoint"] is True
+    np.testing.assert_array_equal(resumed.clusters, clean.clusters)
+    np.testing.assert_array_equal(resumed.flags, clean.flags)
+
+
+def test_config_change_invalidates_checkpoint(rng, tmp_path):
+    pts = _blobs(rng)
+    train(pts, checkpoint_dir=str(tmp_path), **KW)
+    kw2 = dict(KW, eps=0.45)
+    other = train(pts, checkpoint_dir=str(tmp_path), **kw2)
+    assert "resumed_from_checkpoint" not in other.stats
+    # and the new run OVERWROTE the checkpoint with its own state
+    fp2 = ckpt.run_fingerprint(
+        np.asarray(pts, dtype=np.float64),
+        driver.DBSCANConfig(
+            eps=0.45, min_points=5, max_points_per_partition=128,
+            engine=Engine.ARCHERY,
+        ).validate(),
+    )
+    assert ckpt.load_premerge(str(tmp_path), fp2) is not None
+
+
+def test_data_change_invalidates_checkpoint(rng, tmp_path):
+    pts = _blobs(rng)
+    train(pts, checkpoint_dir=str(tmp_path), **KW)
+    pts2 = pts.copy()
+    pts2[0] += 0.001  # first row is always hashed
+    other = train(pts2, checkpoint_dir=str(tmp_path), **KW)
+    assert "resumed_from_checkpoint" not in other.stats
+
+
+def test_torn_checkpoint_ignored(rng, tmp_path):
+    pts = _blobs(rng)
+    train(pts, checkpoint_dir=str(tmp_path), **KW)
+    # corrupt the npz: loader must fall back to a full recompute
+    (tmp_path / "premerge.npz").write_bytes(b"not a zipfile")
+    clean = train(pts, **KW)
+    redone = train(pts, checkpoint_dir=str(tmp_path), **KW)
+    assert "resumed_from_checkpoint" not in redone.stats
+    np.testing.assert_array_equal(redone.clusters, clean.clusters)
+
+
+def test_cross_file_torn_checkpoint_ignored(rng, tmp_path):
+    """rename is atomic per FILE: a crash between the npz replace and the
+    manifest replace can pair run B's arrays with run A's manifest. The
+    npz-embedded fingerprint must catch the mismatch."""
+    import numpy as np_
+
+    pts = _blobs(rng)
+    train(pts, checkpoint_dir=str(tmp_path), **KW)
+    # simulate run B's npz landing without its manifest: rewrite the npz
+    # with a different embedded fingerprint but keep A's manifest
+    with np_.load(tmp_path / "premerge.npz") as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["_fingerprint"] = np_.array("deadbeef")
+    with open(tmp_path / "premerge.npz", "wb") as f:
+        np_.savez(f, **arrays)
+    redone = train(pts, checkpoint_dir=str(tmp_path), **KW)
+    assert "resumed_from_checkpoint" not in redone.stats
+
+
+def test_checkpoint_spill_cosine(rng, tmp_path):
+    """The spill-tree front-end checkpoints too (no rectangles)."""
+    d = 24
+    c = rng.normal(size=(6, d))
+    c /= np.linalg.norm(c, axis=1, keepdims=True)
+    data = np.repeat(c, 150, axis=0) + 0.01 * rng.normal(size=(900, d))
+    kw = dict(
+        eps=0.02, min_points=5, max_points_per_partition=200,
+        metric="cosine",
+    )
+    clean = train(data, **kw)
+    train(data, checkpoint_dir=str(tmp_path), **kw)
+    resumed = train(data, checkpoint_dir=str(tmp_path), **kw)
+    assert resumed.stats["resumed_from_checkpoint"] is True
+    assert resumed.stats["spill_tree"] is True
+    assert resumed.partitions == []
+    np.testing.assert_array_equal(resumed.clusters, clean.clusters)
