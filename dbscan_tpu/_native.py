@@ -155,6 +155,15 @@ def lib() -> Optional[ctypes.CDLL]:
         L.scatter_sel.argtypes = [
             _I64P, _I64P, _I32P, _I8P, ctypes.c_int64, _I32P, _I8P, _U8P,
         ]
+        L.uf_assign_gids.argtypes = [
+            _I64P, _I64P, ctypes.c_int64, _I64P, ctypes.c_int64, _I64P,
+        ]
+        L.uf_assign_gids.restype = ctypes.c_int64
+        L.band_dedup.argtypes = [
+            _I64P, ctypes.c_int64, _I64P, _I8P, _I64P, ctypes.c_int64,
+            _I64P,
+        ]
+        L.band_dedup.restype = ctypes.c_int64
     except OSError as e:
         logger.warning("native hostops load failed (%s); using numpy", e)
         _lib_failed = True
@@ -477,6 +486,60 @@ def scatter_sel(
         len(sel), res_cluster, res_flag, assigned.view(np.uint8),
     )
     return True
+
+
+def uf_assign_gids(
+    edge_a: np.ndarray, edge_b: np.ndarray, node_keys: np.ndarray
+):
+    """Union-find over packed cluster-key edges + dense 1-based global-id
+    assignment in first-appearance order of ``node_keys`` (which must be
+    sorted ascending). Returns (n_clusters, gid_of_u [K] int64) or None
+    when the native library is unavailable or an edge endpoint is missing
+    from the node table (caller falls back to the Python union-find)."""
+    L = lib()
+    if L is None:
+        return None
+    node_keys = np.ascontiguousarray(node_keys, dtype=np.int64)
+    gid = np.empty(len(node_keys), dtype=np.int64)
+    nc = L.uf_assign_gids(
+        np.ascontiguousarray(edge_a, dtype=np.int64),
+        np.ascontiguousarray(edge_b, dtype=np.int64),
+        len(edge_a),
+        node_keys,
+        len(node_keys),
+        gid,
+    )
+    if nc < 0:
+        return None
+    return int(nc), gid
+
+
+def band_dedup(
+    ci: np.ndarray,
+    inst_ptidx: np.ndarray,
+    inst_flag: np.ndarray,
+    inst_part: np.ndarray,
+    p_true: int,
+):
+    """Keep one candidate instance per point — best flag, then lowest
+    partition (the finalize_merge band dedup) — in one fused pass.
+    Returns the kept instance rows, or None when the native library is
+    unavailable."""
+    L = lib()
+    if L is None:
+        return None
+    ci = np.ascontiguousarray(ci, dtype=np.int64)
+    ck = np.empty(len(ci), dtype=np.int64)
+    m = L.band_dedup(
+        ci,
+        len(ci),
+        np.ascontiguousarray(inst_ptidx, dtype=np.int64),
+        np.ascontiguousarray(inst_flag, dtype=np.int8),
+        np.ascontiguousarray(inst_part, dtype=np.int64),
+        p_true,
+        ck,
+    )
+    return ck[:m]
 
 
 def group_by_ints(keys: np.ndarray):

@@ -26,6 +26,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import zipfile
 from typing import Optional
 
 import numpy as np
@@ -119,6 +120,12 @@ def load_premerge(ckpt_dir: str, fingerprint: str) -> Optional[dict]:
             if str(z["_fingerprint"]) != fingerprint:
                 return None  # npz and manifest from different runs
             arrays = {k: z[k] for k in z.files if k != "_fingerprint"}
-    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+    except (
+        OSError,
+        ValueError,
+        KeyError,
+        json.JSONDecodeError,
+        zipfile.BadZipFile,  # truncated npz with intact zip magic
+    ):
         return None
     return {"arrays": arrays, "scalars": man["scalars"]}

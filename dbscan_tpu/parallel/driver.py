@@ -442,7 +442,10 @@ def finalize_merge(
 
     # 7. merge: union clusters observed on the same halo point.
 
-    uf = UnionFind()
+    base = np.int64(max_b + 2)
+    span = np.int64(p_true) * base
+    ua = ub = None  # packed edge endpoints (narrow-span fast path)
+    pairs = None  # unpacked (pa, la, pb, lb) edges (wide span / fallback)
     nz = cand & (inst_flag != NOISE)
     if nz.any():
         k = inst_ptidx[nz]
@@ -454,18 +457,15 @@ def finalize_merge(
         group_of = np.repeat(np.arange(len(starts)), np.diff(np.r_[starts, len(k)]))
         first = starts[group_of]
         rest = np.arange(len(k)) != first
-        # dedup to unique cluster-pair edges before the interpreted union
-        # loop: the instance count can be huge, the edge count is small.
-        # One packed int64 key instead of np.unique(axis=0) — the latter
-        # sorts a void view, measured ~10x slower at 10M instances.
-        base = np.int64(max_b + 2)
-        span = np.int64(p_true) * base
+        # dedup to unique cluster-pair edges before the union phase: the
+        # instance count can be huge, the edge count is small. One packed
+        # int64 key instead of np.unique(axis=0) — the latter sorts a void
+        # view, measured ~10x slower at 10M instances.
         if span < np.int64(3_037_000_499):  # span**2 - 1 < 2**63: no wrap
             ka = kp[first[rest]] * base + kl[first[rest]]
             kb = kp[rest] * base + kl[rest]
             uniq_e = np.unique(ka * span + kb)
             ua, ub = np.divmod(uniq_e, span)
-            pairs = zip(*np.divmod(ua, base), *np.divmod(ub, base))
         else:  # astronomically wide id space: exact 2-D dedup
             pairs = np.unique(
                 np.stack(
@@ -474,18 +474,37 @@ def finalize_merge(
                 ),
                 axis=0,
             )
+
+    # native union-find + global-id assignment over the packed edges: one
+    # C pass replacing the interpreted per-edge dict loop and the per-key
+    # assignment loop (reference DBSCAN.scala:206-222). node_keys are the
+    # unique (part, loc) table packed with the SAME base as the edges;
+    # upart asc + uloc 1..k within each part makes them sorted.
+    gid_of_u = None
+    n_clusters = 0
+    if pairs is None:
+        node_keys = upart * base + uloc
+        if ua is None:
+            ua = ub = np.empty(0, np.int64)
+        nat = _native.uf_assign_gids(ua, ub, node_keys)
+        if nat is not None:
+            n_clusters, gid_of_u = nat
+        else:
+            pairs = zip(*np.divmod(ua, base), *np.divmod(ub, base))
+    if gid_of_u is None:
+        uf = UnionFind()
         for pa, la, pb, lb in pairs:
             uf.union((int(pa), int(la)), (int(pb), int(lb)))
-
-    ordered = [(int(p), int(l)) for p, l in zip(upart, uloc)]
-    n_clusters, mapping = uf.assign_global_ids(ordered)
+        ordered = [(int(p), int(l)) for p, l in zip(upart, uloc)]
+        n_clusters, mapping = uf.assign_global_ids(ordered)
+        # global id per unique (part, loc), aligned with upart/uloc
+        gid_of_u = np.fromiter(
+            (mapping[key] for key in ordered),
+            dtype=np.int64,
+            count=len(ordered),
+        )
     logger.info(
-        "Total Clusters: %d, Unique: %d", len(ordered), n_clusters
-    )
-
-    # global id per unique (part, loc), aligned with upart/uloc
-    gid_of_u = np.fromiter(
-        (mapping[key] for key in ordered), dtype=np.int64, count=len(ordered)
+        "Total Clusters: %d, Unique: %d", len(upart), n_clusters
     )
 
     # per-instance global id (0 for noise): labeled instances carry their
@@ -523,14 +542,17 @@ def finalize_merge(
     if ci.size:
         # packed single key replaces np.lexsort: primary point, then flag,
         # then partition (flag < 4, partition < p_true; no overflow for
-        # any N * p_true < 2^61)
-        order = _native.argsort_ints(
-            (inst_ptidx[ci] * 4 + inst_flag[ci]) * np.int64(p_true)
-            + inst_part[ci]
-        )
-        ci = ci[order]
-        keep = np.r_[True, inst_ptidx[ci][1:] != inst_ptidx[ci][:-1]]
-        ck = ci[keep]
+        # any N * p_true < 2^61). The native call fuses the key build,
+        # the stable argsort, and the first-per-point sweep.
+        ck = _native.band_dedup(ci, inst_ptidx, inst_flag, inst_part, p_true)
+        if ck is None:
+            order = _native.argsort_ints(
+                (inst_ptidx[ci] * 4 + inst_flag[ci]) * np.int64(p_true)
+                + inst_part[ci]
+            )
+            ci = ci[order]
+            keep = np.r_[True, inst_ptidx[ci][1:] != inst_ptidx[ci][:-1]]
+            ck = ci[keep]
         if not _native.scatter_sel(
             ck, inst_ptidx, inst_gid, inst_flag, res_cluster, res_flag,
             assigned,

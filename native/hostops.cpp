@@ -11,9 +11,11 @@
 // entry point has a numpy fallback, and outputs are bit-identical to the
 // numpy path (asserted by tests/test_native.py).
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -97,6 +99,27 @@ int64_t group_by_impl(const K* keys, int64_t n, OrderT* order,
     inverse[order[i]] = static_cast<OrderT>(u);
   }
   return u + 1;
+}
+
+// band_dedup's sort + first-per-point sweep, templated on the argsort
+// order type (int32 below 2^31 candidates — half the sort traffic).
+template <typename OrderT>
+int64_t band_dedup_sweep(const std::vector<int64_t>& keys, const int64_t* ci,
+                         const int64_t* inst_pt, int64_t s,
+                         int64_t* ck_out) {
+  std::vector<OrderT> order(s);
+  radix_argsort_impl<int64_t, OrderT>(keys.data(), s, order.data());
+  int64_t m = 0;
+  int64_t prev_pt = -1;
+  for (int64_t j = 0; j < s; ++j) {
+    const int64_t i = ci[order[j]];
+    const int64_t pt = inst_pt[i];
+    if (pt != prev_pt) {
+      ck_out[m++] = i;
+      prev_pt = pt;
+    }
+  }
+  return m;
 }
 
 }  // namespace
@@ -407,6 +430,94 @@ int64_t cell_runs(const int64_t* cg, int64_t m, uint8_t* segflags,
   }
   if (prev >= 0) en[u - 1] = m - 1;
   return u;
+}
+
+// Fused band dedup (parallel/driver.py ::finalize_merge step 8): among
+// the candidate instances `ci`, keep ONE per point — best flag first
+// (Core=1 < Border=2 < Noise=3), then lowest partition id — via a stable
+// radix argsort of the same packed key the numpy path builds,
+// (pt * 4 + flag) * p_true + part, then a first-per-point sweep. One
+// call replacing three 13M-element key temporaries, the argsort, and
+// two fancy-indexed gathers. Writes the kept instance rows to ck_out
+// (capacity s) and returns their count.
+int64_t band_dedup(const int64_t* ci, int64_t s, const int64_t* inst_pt,
+                   const int8_t* inst_flag, const int64_t* inst_part,
+                   int64_t p_true, int64_t* ck_out) {
+  if (s <= 0) return 0;
+  std::vector<int64_t> keys(s);
+  for (int64_t j = 0; j < s; ++j) {
+    const int64_t i = ci[j];
+    keys[j] = (inst_pt[i] * 4 + inst_flag[i]) * p_true + inst_part[i];
+  }
+  if (s < (int64_t{1} << 31)) {
+    return band_dedup_sweep<int32_t>(keys, ci, inst_pt, s, ck_out);
+  }
+  return band_dedup_sweep<int64_t>(keys, ci, inst_pt, s, ck_out);
+}
+
+// Union-find + dense global-id assignment (parallel/driver.py
+// ::finalize_merge step 7; reference DBSCAN.scala:206-222): union the
+// packed cluster-key edge list, then walk the unique cluster table in its
+// deterministic (part, loc)-sorted order assigning 1-based ids in
+// first-appearance order of each component. Replaces the interpreted
+// per-edge dict union-find plus the per-key assignment loop — the last
+// O(edges + clusters) Python sections of the merge. node_keys must be
+// sorted ascending (the packed (part, loc) table is); edge endpoints are
+// looked up by binary search. Returns the number of unique clusters, or
+// -1 when an edge endpoint is missing from node_keys (caller falls back
+// to the Python path).
+int64_t uf_assign_gids(const int64_t* edge_a,    // [E] packed keys
+                       const int64_t* edge_b,    // [E]
+                       int64_t n_edges,
+                       const int64_t* node_keys,  // [K] sorted packed keys
+                       int64_t n_nodes,
+                       int64_t* gid_out           // [K] 1-based ids
+) {
+  std::vector<int64_t> parent(n_nodes), sz(n_nodes, 1);
+  for (int64_t i = 0; i < n_nodes; ++i) parent[i] = i;
+  auto lookup = [&](int64_t key) -> int64_t {
+    int64_t lo = 0, hi = n_nodes;
+    while (lo < hi) {
+      const int64_t mid = (lo + hi) >> 1;
+      if (node_keys[mid] < key) {
+        lo = mid + 1;
+      } else {
+        hi = mid;
+      }
+    }
+    return (lo < n_nodes && node_keys[lo] == key) ? lo : -1;
+  };
+  auto find = [&](int64_t x) -> int64_t {
+    int64_t root = x;
+    while (parent[root] != root) root = parent[root];
+    while (parent[x] != root) {
+      const int64_t nx = parent[x];
+      parent[x] = root;
+      x = nx;
+    }
+    return root;
+  };
+  for (int64_t e = 0; e < n_edges; ++e) {
+    const int64_t a = lookup(edge_a[e]);
+    const int64_t b = lookup(edge_b[e]);
+    if (a < 0 || b < 0) return -1;
+    int64_t ra = find(a);
+    int64_t rb = find(b);
+    if (ra == rb) continue;
+    if (sz[ra] < sz[rb]) std::swap(ra, rb);
+    parent[rb] = ra;
+    sz[ra] += sz[rb];
+  }
+  // sz is dead past the union phase: reuse it as the root -> gid table
+  // (0 = unseen) instead of a third allocation
+  std::fill(sz.begin(), sz.end(), 0);
+  int64_t next_id = 0;
+  for (int64_t i = 0; i < n_nodes; ++i) {
+    const int64_t r = find(i);
+    if (sz[r] == 0) sz[r] = ++next_id;
+    gid_out[i] = sz[r];
+  }
+  return next_id;
 }
 
 }  // extern "C"

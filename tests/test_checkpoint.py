@@ -123,6 +123,19 @@ def test_torn_checkpoint_ignored(rng, tmp_path):
     np.testing.assert_array_equal(redone.clusters, clean.clusters)
 
 
+def test_truncated_npz_ignored(rng, tmp_path):
+    """Truncation can keep the zip magic intact (np.load then raises
+    BadZipFile, not ValueError) — still a silent recompute, not a crash."""
+    pts = _blobs(rng)
+    clean = train(pts, **KW)
+    train(pts, checkpoint_dir=str(tmp_path), **KW)
+    raw = (tmp_path / "premerge.npz").read_bytes()
+    (tmp_path / "premerge.npz").write_bytes(raw[: len(raw) // 2])
+    redone = train(pts, checkpoint_dir=str(tmp_path), **KW)
+    assert "resumed_from_checkpoint" not in redone.stats
+    np.testing.assert_array_equal(redone.clusters, clean.clusters)
+
+
 def test_cross_file_torn_checkpoint_ignored(rng, tmp_path):
     """rename is atomic per FILE: a crash between the npz replace and the
     manifest replace can pair run B's arrays with run A's manifest. The

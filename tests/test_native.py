@@ -147,6 +147,78 @@ def test_bucketize_banded_native_matches_numpy(rng, monkeypatch):
                 )
 
 
+def test_band_dedup_matches_numpy(rng):
+    """Fused band dedup vs the numpy packed-key argsort path."""
+    for _ in range(20):
+        m = int(rng.integers(1, 5000))
+        p_true = int(rng.integers(1, 40))
+        inst_pt = rng.integers(0, max(1, m // 3), m).astype(np.int64)
+        inst_flag = rng.integers(1, 4, m).astype(np.int8)
+        inst_part = rng.integers(0, p_true, m).astype(np.int64)
+        ci = np.flatnonzero(rng.random(m) < 0.5).astype(np.int64)
+        nat = _native.band_dedup(ci, inst_pt, inst_flag, inst_part, p_true)
+        assert nat is not None
+        if ci.size == 0:
+            assert nat.size == 0
+            continue
+        key = (inst_pt[ci] * 4 + inst_flag[ci]) * np.int64(p_true) + inst_part[ci]
+        order = np.argsort(key, kind="stable")
+        cs = ci[order]
+        keep = np.r_[True, inst_pt[cs][1:] != inst_pt[cs][:-1]]
+        np.testing.assert_array_equal(nat, cs[keep])
+
+
+def test_uf_assign_gids_matches_python_unionfind(rng):
+    """Native union-find + global-id assignment vs the dict UnionFind on
+    randomized edge sets: identical ids (not just identical partitions —
+    the 1-based first-appearance numbering contract is part of parity,
+    reference DBSCAN.scala:206-222)."""
+    from dbscan_tpu.parallel.graph import UnionFind
+
+    for trial in range(20):
+        p_true = int(rng.integers(2, 9))
+        max_b = int(rng.integers(4, 40))
+        base = max_b + 2
+        # unique (part, loc) table: random subset, sorted by (part, loc)
+        all_keys = [
+            (p, loc)
+            for p in range(p_true)
+            for loc in range(1, int(rng.integers(1, max_b + 1)) + 1)
+        ]
+        if not all_keys:
+            continue
+        upart = np.array([p for p, _ in all_keys], dtype=np.int64)
+        uloc = np.array([loc for _, loc in all_keys], dtype=np.int32)
+        node_keys = upart * base + uloc
+        n_edges = int(rng.integers(0, 3 * len(all_keys)))
+        ei = rng.integers(0, len(all_keys), size=(n_edges, 2))
+        ua = node_keys[ei[:, 0]]
+        ub = node_keys[ei[:, 1]]
+
+        nat = _native.uf_assign_gids(ua, ub, node_keys)
+        assert nat is not None
+        nc_nat, gid_nat = nat
+
+        uf = UnionFind()
+        for a, b in ei:
+            uf.union(all_keys[a], all_keys[b])
+        nc_py, mapping = uf.assign_global_ids(all_keys)
+        gid_py = np.array([mapping[k] for k in all_keys], dtype=np.int64)
+
+        assert nc_nat == nc_py
+        np.testing.assert_array_equal(gid_nat, gid_py)
+
+    # missing endpoint -> fallback signal, not a wrong answer
+    assert (
+        _native.uf_assign_gids(
+            np.array([999999], np.int64),
+            np.array([0], np.int64),
+            np.array([0, 5, 9], np.int64),
+        )
+        is None
+    )
+
+
 def test_full_train_native_matches_fallback(rng, monkeypatch):
     """End-to-end: the whole distributed pipeline must produce identical
     labels and flags with and without the native library (the strongest
