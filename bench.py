@@ -251,8 +251,14 @@ def main() -> None:
         # (partitioner, merge) are CPU-bound, so a concurrently-running
         # CPU baseline would contaminate the timed run
         use_pallas = os.environ.get("BENCH_PALLAS", "0") == "1"
+        # the Pallas run rides the banded two-sweep structure
+        # (ops/pallas_banded.py); the auto dense/banded width threshold is
+        # tuned for the XLA engines, so force the banded route here
+        pallas_extra = {"neighbor_backend": "banded"} if use_pallas else {}
         reps = int(os.environ.get("BENCH_REPS", "3"))
-        model, dt = run_train(pts, maxpp, use_pallas=use_pallas, reps=reps)
+        model, dt = run_train(
+            pts, maxpp, use_pallas=use_pallas, reps=reps, **pallas_extra
+        )
         throughput = len(pts) / dt / 1e6
 
         from dbscan_tpu import Engine, train
@@ -273,6 +279,7 @@ def main() -> None:
             ),
             engine=Engine.ARCHERY,
             use_pallas=use_pallas,
+            **pallas_extra,
         )
         ari_full = adjusted_rand_index(model.clusters, alt_model.clusters)
 
@@ -287,6 +294,7 @@ def main() -> None:
             max_points_per_partition=maxpp,
             engine=Engine.ARCHERY,
             use_pallas=use_pallas,
+            **pallas_extra,
         )
 
         env = dict(os.environ)

@@ -156,7 +156,7 @@ def lib() -> Optional[ctypes.CDLL]:
             _I64P, _I64P, _I32P, _I8P, ctypes.c_int64, _I32P, _I8P, _U8P,
         ]
         L.uf_assign_gids.argtypes = [
-            _I64P, _I64P, ctypes.c_int64, _I64P, ctypes.c_int64, _I64P,
+            _I64P, _I64P, ctypes.c_int64, ctypes.c_int64, _I64P,
         ]
         L.uf_assign_gids.restype = ctypes.c_int64
         L.band_dedup.argtypes = [
@@ -488,25 +488,21 @@ def scatter_sel(
     return True
 
 
-def uf_assign_gids(
-    edge_a: np.ndarray, edge_b: np.ndarray, node_keys: np.ndarray
-):
-    """Union-find over packed cluster-key edges + dense 1-based global-id
-    assignment in first-appearance order of ``node_keys`` (which must be
-    sorted ascending). Returns (n_clusters, gid_of_u [K] int64) or None
-    when the native library is unavailable or an edge endpoint is missing
-    from the node table (caller falls back to the Python union-find)."""
+def uf_assign_gids(edge_a: np.ndarray, edge_b: np.ndarray, n_nodes: int):
+    """Union-find over rank-keyed cluster edges + dense 1-based global-id
+    assignment in node-rank order (= the unique table's deterministic
+    (part, loc) order). Returns (n_clusters, gid_of_u [K] int64) or None
+    when the native library is unavailable or an endpoint is out of range
+    (caller falls back to the Python union-find)."""
     L = lib()
     if L is None:
         return None
-    node_keys = np.ascontiguousarray(node_keys, dtype=np.int64)
-    gid = np.empty(len(node_keys), dtype=np.int64)
+    gid = np.empty(n_nodes, dtype=np.int64)
     nc = L.uf_assign_gids(
         np.ascontiguousarray(edge_a, dtype=np.int64),
         np.ascontiguousarray(edge_b, dtype=np.int64),
         len(edge_a),
-        node_keys,
-        len(node_keys),
+        n_nodes,
         gid,
     )
     if nc < 0:

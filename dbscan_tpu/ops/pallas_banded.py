@@ -1,0 +1,268 @@
+"""Pallas banded engine: the production two-sweep structure on Mosaic.
+
+Round 2 measured the original streaming Pallas path (ops/pallas_kernel.py)
+5x slower than the banded XLA engine at its best partition size — a
+structural loss, not a kernel-quality one: it iterates O(cluster diameter)
+min-label sweeps, each re-streaming every distance tile, while the banded
+engine (ops/banded.py) does a FIXED two sweeps over host-measured cell
+runs and solves connected components on the host cell graph. This module
+ports the banded structure itself into Pallas kernels, so the
+no-[B, B]-materialization path stops paying the re-sweeps:
+
+  kernel 1 (counts): per-point eps-neighbor counts over the 5 window-row
+    slabs -> core mask (threshold applied outside the kernel);
+  kernel 2 (bits): per-point 25-bit window-cell mask — bit k*5+dx set iff
+    some CORE point of window cell (k-2, dx-2) is eps-adjacent.
+
+The inputs are ops/banded.py's exact contract (cell-sorted points, per-row
+run tables, per-block slab origins from parallel/binning.py), and the
+outputs feed the same compact postpass + host cell-CC
+(parallel/cellgraph.py), so labels are bit-identical to the XLA banded
+engine (asserted by tests/test_pallas_banded.py).
+
+The Pallas-specific part is the slab fetch: slab origins are
+DATA-DEPENDENT (host-measured), which BlockSpec index maps cannot express
+— so origins ride in as a scalar-prefetch SMEM array and each kernel
+issues manual `make_async_copy` DMAs from the full HBM-resident planes
+into [R, S] VMEM scratch, overlapping the 5 window rows' fetches. Blocked
+views of the same arrays arrive through ordinary BlockSpecs. Run tables
+are fed [R, T]-transposed so the minor (lane) dimension is the block
+edge, not the 5-wide window.
+
+On non-TPU backends the kernels run in interpreter mode (how the CPU
+suite pins them bit-for-bit against ops/banded.py); Mosaic lowering is
+exercised on TPU via ``bench.py`` BENCH_PALLAS=1.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dbscan_tpu.parallel.binning import BANDED_BLOCK, BANDED_ROWS
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _start_slab_copies(ss_ref, i, full_arrays, slabs, sem, slab):
+    """Kick off the [R, S] slab DMAs for every (array, window row) pair and
+    return the descriptors to wait on. full_arrays[a] is an HBM-resident
+    [B] ref; slabs[a] its [R, S] VMEM scratch; sem is an (A, R) DMA
+    semaphore array."""
+    copies = []
+    for k in range(BANDED_ROWS):
+        start = ss_ref[i, k]
+        for a, (src, dst) in enumerate(zip(full_arrays, slabs)):
+            c = pltpu.make_async_copy(
+                src.at[pl.ds(start, slab)], dst.at[k], sem.at[a, k]
+            )
+            c.start()
+            copies.append(c)
+    return copies
+
+
+def _tile_adj(bl_planes, bm_row, brel, bspan, slabs, smask, offs, eps2, k):
+    """The [T, S] adjacency tile of window row k (recomputed per consumer,
+    never stored across sweeps — the banded engine's memory contract)."""
+    d2 = None
+    for bp, sl in zip(bl_planes, slabs):
+        df = bp[0][:, None] - sl[k][None, :]
+        d2 = df * df if d2 is None else d2 + df * df
+    rel_k = brel[0, k][:, None]
+    span_k = bspan[0, k][:, None]
+    inrun = (offs >= rel_k) & (offs < rel_k + span_k)
+    return (
+        inrun
+        & (smask[k][None, :] > 0)
+        & (d2 <= eps2)
+        & (bm_row[0][:, None] > 0)
+    )
+
+
+def _make_counts_kernel(d: int, slab: int):
+    t = BANDED_BLOCK
+
+    def kernel(ss_ref, eps2_ref, *refs):
+        bl_planes = refs[0:d]
+        bm = refs[d]
+        brel = refs[d + 1]
+        bspan = refs[d + 2]
+        full = refs[d + 3 : 2 * d + 4]  # d planes + mask, HBM-resident
+        out = refs[2 * d + 4]
+        slabs = refs[2 * d + 5 : 3 * d + 5]
+        smask = refs[3 * d + 5]
+        sem = refs[3 * d + 6]
+
+        i = pl.program_id(0)
+        for c in _start_slab_copies(
+            ss_ref, i, full, (*slabs, smask), sem, slab
+        ):
+            c.wait()
+        offs = jax.lax.broadcasted_iota(jnp.int32, (t, slab), 1)
+        eps2 = eps2_ref[0, 0]
+        acc = jnp.zeros((t,), jnp.int32)
+        for k in range(BANDED_ROWS):
+            adj = _tile_adj(
+                bl_planes, bm, brel, bspan, slabs, smask, offs, eps2, k
+            )
+            acc = acc + jnp.sum(adj.astype(jnp.int32), axis=1)
+        out[0] = acc
+
+    return kernel
+
+
+def _make_bits_kernel(d: int, slab: int):
+    t = BANDED_BLOCK
+
+    def kernel(ss_ref, eps2_ref, *refs):
+        bl_planes = refs[0:d]
+        bm = refs[d]
+        brel = refs[d + 1]
+        bspan = refs[d + 2]
+        bcx = refs[d + 3]
+        full = refs[d + 4 : 2 * d + 7]  # d planes + mask + cx + core
+        out = refs[2 * d + 7]
+        slabs = refs[2 * d + 8 : 3 * d + 8]
+        smask = refs[3 * d + 8]
+        scx = refs[3 * d + 9]
+        score = refs[3 * d + 10]
+        sem = refs[3 * d + 11]
+
+        i = pl.program_id(0)
+        for c in _start_slab_copies(
+            ss_ref, i, full, (*slabs, smask, scx, score), sem, slab
+        ):
+            c.wait()
+        offs = jax.lax.broadcasted_iota(jnp.int32, (t, slab), 1)
+        eps2 = eps2_ref[0, 0]
+        bits = jnp.zeros((t,), jnp.int32)
+        for k in range(BANDED_ROWS):
+            adj = _tile_adj(
+                bl_planes, bm, brel, bspan, slabs, smask, offs, eps2, k
+            )
+            adj_cc = adj & (score[k][None, :] > 0)
+            # window column slot: 0..4 whenever adj_cc is true (the run
+            # covers exactly cx-2..cx+2); a boolean any() per slot keeps
+            # the reduction a plain max — no bitwise-or reduce needed
+            dxm = scx[k][None, :] - bcx[0][:, None] + 2
+            for dx in range(5):
+                hit = jnp.any(adj_cc & (dxm == dx), axis=1)
+                bits = bits | (
+                    hit.astype(jnp.int32) << jnp.int32(k * 5 + dx)
+                )
+        out[0] = bits
+
+    return kernel
+
+
+def _block_spec(t):
+    return pl.BlockSpec((1, t), lambda i, ss: (i, 0))
+
+
+def _run_spec(t):
+    return pl.BlockSpec((1, BANDED_ROWS, t), lambda i, ss: (i, 0, 0))
+
+
+@functools.partial(jax.jit, static_argnames=("min_points", "slab"))
+def banded_phase1_pallas(
+    points: jnp.ndarray,
+    mask: jnp.ndarray,
+    rel_starts: jnp.ndarray,
+    spans: jnp.ndarray,
+    slab_starts: jnp.ndarray,
+    cx: jnp.ndarray,
+    eps: float,
+    min_points: int,
+    slab: int = 128,
+):
+    """Drop-in Pallas replacement for ops/banded.py::banded_phase1 (same
+    contract, same outputs: counts [B] i32, core [B] bool, bits [B] i32)."""
+    b, d = points.shape
+    t = BANDED_BLOCK
+    r = BANDED_ROWS
+    if b % t:
+        raise ValueError(f"bucket width {b} not a multiple of {t}")
+    nb = b // t
+
+    planes = tuple(points[:, j].astype(jnp.float32) for j in range(d))
+    m32 = mask.astype(jnp.int32)
+    # [B, R] run tables -> [nb, R, T]: lane dim = block edge
+    rel = rel_starts.astype(jnp.int32).reshape(nb, t, r).transpose(0, 2, 1)
+    spn = spans.astype(jnp.int32).reshape(nb, t, r).transpose(0, 2, 1)
+    ss = slab_starts.astype(jnp.int32)
+    eps2 = jnp.asarray(eps, jnp.float32).reshape(1, 1) ** 2
+
+    blocked_specs = [
+        pl.BlockSpec((1, 1), lambda i, ss: (0, 0), memory_space=pltpu.SMEM),
+        *[_block_spec(t) for _ in range(d + 1)],  # planes + mask
+        _run_spec(t),
+        _run_spec(t),
+    ]
+    blocked_args = [
+        eps2,
+        *[p.reshape(nb, t) for p in planes],
+        m32.reshape(nb, t),
+        rel,
+        spn,
+    ]
+
+    counts = pl.pallas_call(
+        _make_counts_kernel(d, slab),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nb,),
+            in_specs=[
+                *blocked_specs,
+                *[
+                    pl.BlockSpec(memory_space=pl.ANY)
+                    for _ in range(d + 1)
+                ],
+            ],
+            out_specs=_block_spec(t),
+            scratch_shapes=[
+                *[pltpu.VMEM((r, slab), jnp.float32) for _ in range(d)],
+                pltpu.VMEM((r, slab), jnp.int32),
+                pltpu.SemaphoreType.DMA((d + 1, r)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((nb, t), jnp.int32),
+        interpret=_interpret(),
+    )(ss, *blocked_args, *planes, m32).reshape(-1)
+
+    core = (counts >= jnp.int32(min_points)) & mask
+    cx32 = cx.astype(jnp.int32)
+    core32 = core.astype(jnp.int32)
+
+    bits = pl.pallas_call(
+        _make_bits_kernel(d, slab),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nb,),
+            in_specs=[
+                *blocked_specs,
+                _block_spec(t),  # cx blocked
+                *[
+                    pl.BlockSpec(memory_space=pl.ANY)
+                    for _ in range(d + 3)
+                ],
+            ],
+            out_specs=_block_spec(t),
+            scratch_shapes=[
+                *[pltpu.VMEM((r, slab), jnp.float32) for _ in range(d)],
+                pltpu.VMEM((r, slab), jnp.int32),  # mask slab
+                pltpu.VMEM((r, slab), jnp.int32),  # cx slab
+                pltpu.VMEM((r, slab), jnp.int32),  # core slab
+                pltpu.SemaphoreType.DMA((d + 3, r)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((nb, t), jnp.int32),
+        interpret=_interpret(),
+    )(ss, *blocked_args, cx32.reshape(nb, t), *planes, m32, cx32, core32)
+
+    return counts, core, bits.reshape(-1)

@@ -177,15 +177,22 @@ def _compiled_banded_p1(
     slab: int,
     batch: Optional[int],
     mesh,
+    use_pallas: bool = False,
 ):
     """Jitted per-group phase-1 executor for the banded engine (counts +
-    core + cell-edge bitmask sweeps, dbscan_tpu/ops/banded.py); cached like
+    core + cell-edge bitmask sweeps, dbscan_tpu/ops/banded.py — or their
+    Pallas ports, ops/pallas_banded.py); cached like
     :func:`_compiled_block`."""
-    from dbscan_tpu.ops.banded import banded_phase1
+    if use_pallas:
+        from dbscan_tpu.ops.pallas_banded import (
+            banded_phase1_pallas as phase1,
+        )
+    else:
+        from dbscan_tpu.ops.banded import banded_phase1 as phase1
 
     def one(args):
         pts, msk, rel, sp, sl, cx = args
-        return banded_phase1(
+        return phase1(
             pts, msk, rel, sp, sl, cx, eps, min_points, slab=slab
         )
 
@@ -211,6 +218,10 @@ def _compiled_banded_p1(
             mesh=mesh,
             in_specs=(spec,) * 6,
             out_specs=(spec, spec, spec, PartitionSpec()),
+            # pallas_call's out_shape carries no varying-mesh-axes
+            # annotation, so the vma checker rejects it under shard_map;
+            # the XLA path keeps the check
+            check_vma=not use_pallas,
         )
     )
 
@@ -282,8 +293,11 @@ def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh, kernel_eps=None):
         float(kernel_eps if kernel_eps is not None else cfg.eps),
         int(cfg.min_points),
         int(ext.slab),
-        _banded_batch(group, mesh),
+        # Pallas path: strictly sequential (no batch_size -> plain scan);
+        # lax.map's vmap lowering would vmap the pallas_calls' manual DMAs
+        None if cfg.use_pallas else _banded_batch(group, mesh),
         mesh,
+        use_pallas=bool(cfg.use_pallas),
     )
     return fn(
         group.points, group.mask, ext.rel_starts, ext.spans,
@@ -441,11 +455,14 @@ def finalize_merge(
     )
 
     # 7. merge: union clusters observed on the same halo point.
-
-    base = np.int64(max_b + 2)
-    span = np.int64(p_true) * base
-    ua = ub = None  # packed edge endpoints (narrow-span fast path)
-    pairs = None  # unpacked (pa, la, pb, lb) edges (wide span / fallback)
+    # Edges are keyed by dense RANK into the unique (part, loc) table —
+    # rank(part, loc) = first[part] + loc - 1 (the inverse of
+    # _local_ids_flat's numbering) — so the packed dedup key spans at
+    # most K^2 < 2^62 for ANY id space (no narrow/wide split), and the
+    # native union-find indexes its node arrays directly, no lookup.
+    n_uniq = len(upart)
+    first_of_part = np.searchsorted(upart, np.arange(p_true))
+    ua = ub = np.empty(0, np.int64)
     nz = cand & (inst_flag != NOISE)
     if nz.any():
         k = inst_ptidx[nz]
@@ -461,51 +478,29 @@ def finalize_merge(
         # instance count can be huge, the edge count is small. One packed
         # int64 key instead of np.unique(axis=0) — the latter sorts a void
         # view, measured ~10x slower at 10M instances.
-        if span < np.int64(3_037_000_499):  # span**2 - 1 < 2**63: no wrap
-            ka = kp[first[rest]] * base + kl[first[rest]]
-            kb = kp[rest] * base + kl[rest]
-            uniq_e = np.unique(ka * span + kb)
-            ua, ub = np.divmod(uniq_e, span)
-        else:  # astronomically wide id space: exact 2-D dedup
-            pairs = np.unique(
-                np.stack(
-                    [kp[first[rest]], kl[first[rest]], kp[rest], kl[rest]],
-                    axis=1,
-                ),
-                axis=0,
-            )
+        ranks = first_of_part[kp] + kl - 1
+        span = np.int64(max(1, n_uniq))
+        uniq_e = np.unique(ranks[first[rest]] * span + ranks[rest])
+        ua, ub = np.divmod(uniq_e, span)
 
-    # native union-find + global-id assignment over the packed edges: one
-    # C pass replacing the interpreted per-edge dict loop and the per-key
-    # assignment loop (reference DBSCAN.scala:206-222). node_keys are the
-    # unique (part, loc) table packed with the SAME base as the edges;
-    # upart asc + uloc 1..k within each part makes them sorted.
-    gid_of_u = None
-    n_clusters = 0
-    if pairs is None:
-        node_keys = upart * base + uloc
-        if ua is None:
-            ua = ub = np.empty(0, np.int64)
-        nat = _native.uf_assign_gids(ua, ub, node_keys)
-        if nat is not None:
-            n_clusters, gid_of_u = nat
-        else:
-            pairs = zip(*np.divmod(ua, base), *np.divmod(ub, base))
-    if gid_of_u is None:
+    # native union-find + global-id assignment over the rank edges: one C
+    # pass replacing the interpreted per-edge dict loop and the per-key
+    # assignment loop (reference DBSCAN.scala:206-222)
+    nat = _native.uf_assign_gids(ua, ub, n_uniq)
+    if nat is not None:
+        n_clusters, gid_of_u = nat
+    else:
         uf = UnionFind()
-        for pa, la, pb, lb in pairs:
-            uf.union((int(pa), int(la)), (int(pb), int(lb)))
-        ordered = [(int(p), int(l)) for p, l in zip(upart, uloc)]
-        n_clusters, mapping = uf.assign_global_ids(ordered)
-        # global id per unique (part, loc), aligned with upart/uloc
+        for a, b in zip(ua, ub):
+            uf.union(int(a), int(b))
+        n_clusters, mapping = uf.assign_global_ids(list(range(n_uniq)))
+        # global id per unique (part, loc) rank, aligned with upart/uloc
         gid_of_u = np.fromiter(
-            (mapping[key] for key in ordered),
+            (mapping[i] for i in range(n_uniq)),
             dtype=np.int64,
-            count=len(ordered),
+            count=n_uniq,
         )
-    logger.info(
-        "Total Clusters: %d, Unique: %d", len(upart), n_clusters
-    )
+    logger.info("Total Clusters: %d, Unique: %d", n_uniq, n_clusters)
 
     # per-instance global id (0 for noise): labeled instances carry their
     # rank into the unique table already (no re-search)
@@ -892,7 +887,6 @@ def train_arrays(
         )
     use_banded = (
         cfg.neighbor_backend != "dense"
-        and not cfg.use_pallas
         and kernel_metric == "euclidean"
         and cfg.precision.value != "bf16"
         and (
@@ -903,6 +897,10 @@ def train_arrays(
             or (sph is not None and sph.banded_ok)
         )
     )
+    # use_pallas now rides the banded structure (ops/pallas_banded.py —
+    # fixed two sweeps + host cell-CC, the round-2 verdict's fix for the
+    # O(diameter) re-sweep loss); neighbor_backend="dense" keeps the
+    # original streaming engine for force-dense expert runs.
     # Dispatch each group's device program the moment its buffers are
     # packed (on_group): the first groups' sweeps run while later groups
     # are still packing, pulling the device window forward under the

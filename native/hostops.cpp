@@ -457,36 +457,23 @@ int64_t band_dedup(const int64_t* ci, int64_t s, const int64_t* inst_pt,
 
 // Union-find + dense global-id assignment (parallel/driver.py
 // ::finalize_merge step 7; reference DBSCAN.scala:206-222): union the
-// packed cluster-key edge list, then walk the unique cluster table in its
-// deterministic (part, loc)-sorted order assigning 1-based ids in
+// rank-keyed cluster edge list, then walk the unique cluster table in
+// its deterministic (part, loc)-sorted order assigning 1-based ids in
 // first-appearance order of each component. Replaces the interpreted
 // per-edge dict union-find plus the per-key assignment loop — the last
-// O(edges + clusters) Python sections of the merge. node_keys must be
-// sorted ascending (the packed (part, loc) table is); edge endpoints are
-// looked up by binary search. Returns the number of unique clusters, or
-// -1 when an edge endpoint is missing from node_keys (caller falls back
+// O(edges + clusters) Python sections of the merge. Edge endpoints are
+// DENSE RANKS into the unique table (the caller derives them from its
+// numbering), so nodes are indexed directly. Returns the number of
+// unique clusters, or -1 on an out-of-range endpoint (caller falls back
 // to the Python path).
-int64_t uf_assign_gids(const int64_t* edge_a,    // [E] packed keys
-                       const int64_t* edge_b,    // [E]
+int64_t uf_assign_gids(const int64_t* edge_a,  // [E] node ranks
+                       const int64_t* edge_b,  // [E]
                        int64_t n_edges,
-                       const int64_t* node_keys,  // [K] sorted packed keys
                        int64_t n_nodes,
-                       int64_t* gid_out           // [K] 1-based ids
+                       int64_t* gid_out        // [K] 1-based ids
 ) {
   std::vector<int64_t> parent(n_nodes), sz(n_nodes, 1);
   for (int64_t i = 0; i < n_nodes; ++i) parent[i] = i;
-  auto lookup = [&](int64_t key) -> int64_t {
-    int64_t lo = 0, hi = n_nodes;
-    while (lo < hi) {
-      const int64_t mid = (lo + hi) >> 1;
-      if (node_keys[mid] < key) {
-        lo = mid + 1;
-      } else {
-        hi = mid;
-      }
-    }
-    return (lo < n_nodes && node_keys[lo] == key) ? lo : -1;
-  };
   auto find = [&](int64_t x) -> int64_t {
     int64_t root = x;
     while (parent[root] != root) root = parent[root];
@@ -498,9 +485,9 @@ int64_t uf_assign_gids(const int64_t* edge_a,    // [E] packed keys
     return root;
   };
   for (int64_t e = 0; e < n_edges; ++e) {
-    const int64_t a = lookup(edge_a[e]);
-    const int64_t b = lookup(edge_b[e]);
-    if (a < 0 || b < 0) return -1;
+    const int64_t a = edge_a[e];
+    const int64_t b = edge_b[e];
+    if (a < 0 || a >= n_nodes || b < 0 || b >= n_nodes) return -1;
     int64_t ra = find(a);
     int64_t rb = find(b);
     if (ra == rb) continue;

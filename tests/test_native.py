@@ -170,50 +170,35 @@ def test_band_dedup_matches_numpy(rng):
 
 def test_uf_assign_gids_matches_python_unionfind(rng):
     """Native union-find + global-id assignment vs the dict UnionFind on
-    randomized edge sets: identical ids (not just identical partitions —
-    the 1-based first-appearance numbering contract is part of parity,
-    reference DBSCAN.scala:206-222)."""
+    randomized rank-keyed edge sets: identical ids (not just identical
+    partitions — the 1-based first-appearance numbering contract is part
+    of parity, reference DBSCAN.scala:206-222)."""
     from dbscan_tpu.parallel.graph import UnionFind
 
-    for trial in range(20):
-        p_true = int(rng.integers(2, 9))
-        max_b = int(rng.integers(4, 40))
-        base = max_b + 2
-        # unique (part, loc) table: random subset, sorted by (part, loc)
-        all_keys = [
-            (p, loc)
-            for p in range(p_true)
-            for loc in range(1, int(rng.integers(1, max_b + 1)) + 1)
-        ]
-        if not all_keys:
-            continue
-        upart = np.array([p for p, _ in all_keys], dtype=np.int64)
-        uloc = np.array([loc for _, loc in all_keys], dtype=np.int32)
-        node_keys = upart * base + uloc
-        n_edges = int(rng.integers(0, 3 * len(all_keys)))
-        ei = rng.integers(0, len(all_keys), size=(n_edges, 2))
-        ua = node_keys[ei[:, 0]]
-        ub = node_keys[ei[:, 1]]
+    for _ in range(20):
+        n_nodes = int(rng.integers(1, 400))
+        n_edges = int(rng.integers(0, 3 * n_nodes))
+        ei = rng.integers(0, n_nodes, size=(n_edges, 2)).astype(np.int64)
 
-        nat = _native.uf_assign_gids(ua, ub, node_keys)
+        nat = _native.uf_assign_gids(ei[:, 0], ei[:, 1], n_nodes)
         assert nat is not None
         nc_nat, gid_nat = nat
 
         uf = UnionFind()
         for a, b in ei:
-            uf.union(all_keys[a], all_keys[b])
-        nc_py, mapping = uf.assign_global_ids(all_keys)
-        gid_py = np.array([mapping[k] for k in all_keys], dtype=np.int64)
+            uf.union(int(a), int(b))
+        nc_py, mapping = uf.assign_global_ids(list(range(n_nodes)))
+        gid_py = np.array(
+            [mapping[i] for i in range(n_nodes)], dtype=np.int64
+        )
 
         assert nc_nat == nc_py
         np.testing.assert_array_equal(gid_nat, gid_py)
 
-    # missing endpoint -> fallback signal, not a wrong answer
+    # out-of-range endpoint -> fallback signal, not a wrong answer
     assert (
         _native.uf_assign_gids(
-            np.array([999999], np.int64),
-            np.array([0], np.int64),
-            np.array([0, 5, 9], np.int64),
+            np.array([7], np.int64), np.array([0], np.int64), 3
         )
         is None
     )

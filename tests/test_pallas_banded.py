@@ -1,0 +1,111 @@
+"""Pallas banded engine (ops/pallas_banded.py) vs the XLA banded engine:
+bit-exact equality through the full pipeline.
+
+The Pallas port consumes the identical packer contract (cell-sorted
+points, run tables, slab origins) and feeds the identical compact
+postpass + host cell-CC, so clusters, flags, AND the core-instance count
+must match the XLA banded engine exactly on every geometry that stresses
+the machinery (interpret mode on CPU; Mosaic lowering is exercised on
+TPU by bench.py BENCH_PALLAS=1)."""
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import Engine, train
+
+GEOMETRIES = {
+    "blobs+noise": lambda rng: np.concatenate(
+        [rng.normal(c, 0.5, (700, 2)) for c in [(0, 0), (5, 5), (-4, 6)]]
+        + [rng.uniform(-8, 10, (300, 2))]
+    ),
+    "thin-chain": lambda rng: np.stack(
+        [np.linspace(0, 40, 1500), rng.normal(0, 0.05, 1500)], axis=1
+    ),
+    "single-cell-pileup": lambda rng: rng.normal(0, 0.02, (1200, 2)),
+    "boundary-points": lambda rng: np.concatenate(
+        [
+            np.stack(
+                [
+                    rng.integers(0, 12, 600) * 0.3,
+                    rng.integers(0, 12, 600) * 0.3,
+                ],
+                axis=1,
+            ),
+            rng.uniform(0, 3.6, (600, 2)),
+        ]
+    ),
+}
+
+
+def _equal(pts, rng_unused, engine, mesh=None, maxpp=10**9):
+    kw = dict(
+        eps=0.3,
+        min_points=6,
+        max_points_per_partition=maxpp,
+        engine=engine,
+        neighbor_backend="banded",
+        mesh=mesh,
+    )
+    mb = train(pts, **kw)
+    mp = train(pts, use_pallas=True, **kw)
+    assert mp.stats["n_banded_groups"] >= 1
+    np.testing.assert_array_equal(mb.clusters, mp.clusters)
+    np.testing.assert_array_equal(mb.flags, mp.flags)
+    assert mb.stats["n_core_instances"] == mp.stats["n_core_instances"]
+    return mp
+
+
+@pytest.mark.parametrize("name", sorted(GEOMETRIES))
+@pytest.mark.parametrize("engine", [Engine.NAIVE, Engine.ARCHERY])
+def test_pallas_banded_equals_xla_banded(name, engine, rng):
+    _equal(GEOMETRIES[name](rng), rng, engine)
+
+
+def test_pallas_banded_multi_partition(rng):
+    pts = np.concatenate(
+        [rng.normal(c, 0.6, (1500, 2)) for c in [(0, 0), (6, 6), (-5, 7)]]
+        + [rng.uniform(-10, 12, (500, 2))]
+    )
+    m = _equal(pts, rng, Engine.ARCHERY, maxpp=700)
+    assert m.stats["n_partitions"] > 4
+
+
+def test_pallas_banded_on_mesh(rng):
+    from dbscan_tpu.parallel.mesh import make_mesh
+
+    pts = np.concatenate(
+        [rng.normal(c, 0.6, (1200, 2)) for c in [(0, 0), (6, 6), (-5, 7)]]
+    )
+    _equal(pts, rng, Engine.ARCHERY, mesh=make_mesh(), maxpp=600)
+
+
+def test_pallas_auto_routes_banded_at_scale(rng, monkeypatch):
+    """With neighbor_backend='auto', large buckets route the Pallas run
+    through the banded structure (the round-3 reclassification) — not the
+    O(diameter) streaming engine. The auto threshold (DENSE_MAX_BUCKET,
+    65536) is lowered so the test exercises the routing at CI-sized N."""
+    from dbscan_tpu.parallel import binning, driver
+
+    monkeypatch.setattr(binning, "DENSE_MAX_BUCKET", 2048)
+    driver.clear_compile_cache()
+    pts = np.concatenate(
+        [rng.normal(c, 0.7, (4000, 2)) for c in [(0, 0), (9, 9)]]
+    )
+    mp = train(
+        pts,
+        eps=0.3,
+        min_points=6,
+        max_points_per_partition=10**9,
+        engine=Engine.ARCHERY,
+        use_pallas=True,
+    )
+    assert mp.stats["n_banded_groups"] >= 1
+    mb = train(
+        pts,
+        eps=0.3,
+        min_points=6,
+        max_points_per_partition=10**9,
+        engine=Engine.ARCHERY,
+        neighbor_backend="banded",
+    )
+    np.testing.assert_array_equal(mb.clusters, mp.clusters)
