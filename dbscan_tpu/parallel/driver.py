@@ -49,7 +49,7 @@ from dbscan_tpu.ops import geometry as geo
 from dbscan_tpu.ops.labels import CORE, NOISE, SEED_NONE
 from dbscan_tpu.ops.local_dbscan import local_dbscan
 from dbscan_tpu.parallel import binning, cellgraph, partitioner
-from dbscan_tpu.parallel.graph import UnionFind
+from dbscan_tpu.parallel.graph import uf_components
 from dbscan_tpu.parallel.mesh import PARTS_AXIS, mesh_size
 
 logger = logging.getLogger(__name__)
@@ -483,23 +483,11 @@ def finalize_merge(
         uniq_e = np.unique(ranks[first[rest]] * span + ranks[rest])
         ua, ub = np.divmod(uniq_e, span)
 
-    # native union-find + global-id assignment over the rank edges: one C
-    # pass replacing the interpreted per-edge dict loop and the per-key
-    # assignment loop (reference DBSCAN.scala:206-222)
-    nat = _native.uf_assign_gids(ua, ub, n_uniq)
-    if nat is not None:
-        n_clusters, gid_of_u = nat
-    else:
-        uf = UnionFind()
-        for a, b in zip(ua, ub):
-            uf.union(int(a), int(b))
-        n_clusters, mapping = uf.assign_global_ids(list(range(n_uniq)))
-        # global id per unique (part, loc) rank, aligned with upart/uloc
-        gid_of_u = np.fromiter(
-            (mapping[i] for i in range(n_uniq)),
-            dtype=np.int64,
-            count=n_uniq,
-        )
+    # union-find + global-id assignment over the rank edges (native with
+    # dict-UnionFind fallback): one pass replacing the interpreted
+    # per-edge loop and the per-key numbering loop (reference
+    # DBSCAN.scala:206-222); gid_of_u aligns with upart/uloc by rank
+    n_clusters, gid_of_u = uf_components(ua, ub, n_uniq)
     logger.info("Total Clusters: %d, Unique: %d", n_uniq, n_clusters)
 
     # per-instance global id (0 for noise): labeled instances carry their

@@ -77,6 +77,29 @@ class DBSCANGraph(Generic[T]):
         return set(self._nodes)
 
 
+def uf_components(edge_a, edge_b, n: int):
+    """Connected components over integer-rank edges: (n_comp, gid [n]
+    int64 1-based dense ids in first-appearance node order). Native
+    (hostops.cpp::uf_assign_gids) with the dict UnionFind fallback —
+    the one shape shared by the merge driver and the sparse prefix
+    pre-split."""
+    import numpy as np
+
+    from dbscan_tpu import _native
+
+    res = _native.uf_assign_gids(edge_a, edge_b, n)
+    if res is not None:
+        return res
+    uf = UnionFind()
+    for a, b in zip(edge_a, edge_b):
+        uf.union(int(a), int(b))
+    n_comp, mapping = uf.assign_global_ids(list(range(n)))
+    gids = np.fromiter(
+        (mapping[i] for i in range(n)), dtype=np.int64, count=n
+    )
+    return n_comp, gids
+
+
 class UnionFind(Generic[T]):
     """Weighted quick-union with path compression over hashable keys.
 

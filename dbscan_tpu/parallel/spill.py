@@ -243,8 +243,224 @@ def _pivot_vectors(sub, m: int, halo: float, rng):
     return p[np.array(kept, dtype=np.int64)]
 
 
+# Candidate-pair budget for prefix_components, in pairs-per-doc (counted
+# pre-dedup): past it the prefix index is too dense to verify cheaply
+# (stopword-heavy data) and the caller falls back to the pivot tree.
+# Expansion, dedup, and verification run in bounded chunks, so the budget
+# caps time, not memory.
+_PREFIX_PAIR_BUDGET = 256
+_PREFIX_CHUNK = 1 << 22  # candidate pairs per verify chunk
+
+
+def prefix_components(x_csr, t: float):
+    """Exact-cover pre-split for SPARSE unit rows: connected components of
+    the VERIFIED dot >= t graph, found via prefix filtering.
+
+    Symmetric prefix filter (the AllPairs/PPJoin bound, re-derived): fix
+    any global feature order and let prefix(x) be the head of x's
+    features (in that order) kept until the remaining tail norm drops
+    below ``t``. For a pair with dot(x, y) >= t, let f* be their FIRST
+    shared feature: every shared feature sits at-or-after f*, so
+    dot <= ||x at-or-after f*|| and dot <= ||y at-or-after f*|| — both
+    tails still carry norm >= t at f*, hence f* lies in BOTH prefixes.
+    So every qualifying pair appears inside some feature's prefix list —
+    the candidate pairs. Candidates are then VERIFIED with exact f64
+    dots before union (sharing a rare prefix feature is necessary, not
+    sufficient: blind unions percolate through incidental shares), which
+    makes the components exactly the dot >= t graph's components — the
+    finest partition no qualifying pair crosses, with ZERO halo
+    duplication. This splits the concentration regime (cluster count >>
+    pivot count, all cross distances ~equal) where the pivot tree
+    cannot.
+
+    The global order is rarest-feature-first (ascending document
+    frequency), keeping per-feature prefix lists small. If the candidate
+    pair count exceeds ``_PREFIX_PAIR_BUDGET * n`` (stopword-heavy
+    prefixes), returns None and the caller falls back to the pivot tree.
+    Returns (comp [N] int32 0-based dense ids, n_comp) otherwise; None
+    also when t <= 0 (prefixes would cover every feature).
+    """
+    if t <= 0.0:
+        return None
+    import scipy.sparse as sp
+
+    # f64 working copy: prefix sums and verification dots are computed
+    # exactly over the stored values (f32 inputs round the VALUES, which
+    # chord_halo's quantization slack already covers — the margins here
+    # only need to absorb rows being unit to ~1e-6, not exactly)
+    x = sp.csr_matrix(x_csr, dtype=np.float64)
+    n, d = x.shape
+    if n == 0 or x.nnz == 0:
+        return None
+    df = x.getnnz(axis=0)
+    rank = np.empty(d, dtype=np.int64)
+    rank[np.lexsort((np.arange(d), df))] = np.arange(d)
+    rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(x.indptr))
+    if n * d < 2**62:
+        order = np.argsort(rows * d + rank[x.indices], kind="stable")
+    else:  # astronomically wide: exact 2-key sort
+        order = np.lexsort((rank[x.indices], rows))
+    r_sorted = rows[order]
+    v2 = x.data[order] ** 2
+    # per-row sum of squares BEFORE each nnz position (global cumsum
+    # minus the row's starting cumsum); the prefix condition
+    # ||tail from i|| >= t is tested against the row's ACTUAL total
+    # (f32-normalized rows are unit only to ~1e-6), with a relative
+    # margin that chord_halo's slack dwarfs
+    cum0 = np.r_[0.0, np.cumsum(v2)]
+    row_start = np.searchsorted(r_sorted, np.arange(n))
+    row_end = np.searchsorted(r_sorted, np.arange(1, n + 1))
+    row_total = cum0[row_end] - cum0[row_start]
+    before = cum0[:-1] - cum0[row_start[r_sorted]]
+    tail = row_total[r_sorted] - before
+    keep = tail >= (t * t) * (1.0 - 1e-5)
+    pf = x.indices[order][keep]
+    pr = r_sorted[keep]
+    o2 = np.argsort(pf, kind="stable")
+    pf, pr = pf[o2], pr[o2]
+
+    # candidate pairs: all doc pairs within each feature's prefix list
+    bounds = np.flatnonzero(np.r_[True, pf[1:] != pf[:-1], True])
+    sizes = np.diff(bounds)
+    pairs_per_group = sizes * (sizes - 1) // 2
+    if int(pairs_per_group.sum()) > _PREFIX_PAIR_BUDGET * n:
+        return None
+
+    # expand -> dedup -> verify in bounded blocks: only PASSING edges
+    # (few) accumulate, so memory stays bounded by the block no matter
+    # the total candidate count — including within one oversized group,
+    # whose row-bands are expanded incrementally rather than via a full
+    # triu materialization. Cross-block duplicate edges are harmless to
+    # the union-find.
+    ea_l, eb_l = [], []
+    pa_l, pb_l = [], []
+    pending = 0
+
+    def _verify():
+        nonlocal pending
+        if not pa_l:
+            return
+        lo_ = np.concatenate(pa_l)
+        hi_ = np.concatenate(pb_l)
+        pa_l.clear()
+        pb_l.clear()
+        pending = 0
+        lo = np.minimum(lo_, hi_)
+        hi = np.maximum(lo_, hi_)
+        uniq = np.unique(lo * np.int64(n) + hi)
+        ua, ub = np.divmod(uniq, np.int64(n))
+        for s in range(0, len(ua), 1 << 18):
+            a = ua[s : s + (1 << 18)]
+            b = ub[s : s + (1 << 18)]
+            dots = np.asarray(x[a].multiply(x[b]).sum(axis=1)).ravel()
+            ok = dots >= t - 1e-9
+            ea_l.append(a[ok])
+            eb_l.append(b[ok])
+
+    def _pair_blocks(docs):
+        """All unordered pairs of ``docs``, yielded in <=_PREFIX_CHUNK
+        blocks (row-band expansion for oversized groups)."""
+        g = len(docs)
+        if g * (g - 1) // 2 <= _PREFIX_CHUNK:
+            ii, jj = np.triu_indices(g, k=1)
+            yield docs[ii], docs[jj]
+            return
+        i = 0
+        while i < g - 1:
+            take = max(1, _PREFIX_CHUNK // max(1, g - i - 1))
+            idx = np.arange(i, min(g - 1, i + take))
+            counts = g - idx - 1
+            ii = np.repeat(idx, counts)
+            run_start = np.repeat(np.r_[0, np.cumsum(counts)[:-1]], counts)
+            jj = np.repeat(idx + 1, counts) + (
+                np.arange(counts.sum()) - run_start
+            )
+            yield docs[ii], docs[jj]
+            i = idx[-1] + 1
+
+    for gi in range(len(sizes)):
+        if sizes[gi] < 2:
+            continue
+        for a_blk, b_blk in _pair_blocks(pr[bounds[gi] : bounds[gi + 1]]):
+            pa_l.append(a_blk)
+            pb_l.append(b_blk)
+            pending += len(a_blk)
+            if pending >= _PREFIX_CHUNK:
+                _verify()
+    _verify()
+    if not ea_l:
+        comp = np.arange(n, dtype=np.int32)
+        return comp, n
+    ea = np.concatenate(ea_l)
+    eb = np.concatenate(eb_l)
+
+    from dbscan_tpu.parallel.graph import uf_components
+
+    n_comp, gids = uf_components(ea, eb, n)
+    return (np.asarray(gids) - 1).astype(np.int32), int(n_comp)
+
+
+def _split_by_components(unit_csr, pc, maxpp: int, halo: float, seed: int):
+    """Assemble spill output across prefix components (ZERO duplicated
+    instances — no qualifying pair crosses components, and the halo's
+    slack margin means the quantized kernel cannot accept a cross-
+    component pair either, so whole components pack together freely).
+    Small components bin-pack into shared leaves of capacity maxpp
+    (size-descending next-fit: noise singletons would otherwise each
+    become a padded leaf); oversized components recurse through
+    spill_partition with part-id offsets. Keeps the (partition, point
+    index)-sorted instance layout the packers require."""
+    comp, n_comp = pc
+    n = unit_csr.shape[0]
+    order_c = np.argsort(comp, kind="stable")  # ascending rows per comp
+    bounds = np.searchsorted(comp[order_c], np.arange(n_comp + 1))
+    sizes = np.diff(bounds)
+
+    part_ids_l, point_idx_l = [], []
+    home = np.empty(n, dtype=np.int32)
+    p_off = 0
+    # bin-pack the fitting components, largest first
+    small = np.flatnonzero(sizes <= maxpp)
+    small = small[np.argsort(sizes[small], kind="stable")[::-1]]
+    bin_rows: list = []
+    bin_fill = 0
+    for c in small:
+        g = int(sizes[c])
+        if bin_fill and bin_fill + g > maxpp:
+            rows_b = np.sort(np.concatenate(bin_rows))
+            part_ids_l.append(np.full(len(rows_b), p_off, dtype=np.int64))
+            point_idx_l.append(rows_b)
+            home[rows_b] = p_off
+            p_off += 1
+            bin_rows, bin_fill = [], 0
+        bin_rows.append(order_c[bounds[c] : bounds[c + 1]])
+        bin_fill += g
+    if bin_rows:
+        rows_b = np.sort(np.concatenate(bin_rows))
+        part_ids_l.append(np.full(len(rows_b), p_off, dtype=np.int64))
+        point_idx_l.append(rows_b)
+        home[rows_b] = p_off
+        p_off += 1
+
+    for c in np.flatnonzero(sizes > maxpp):
+        rows_c = order_c[bounds[c] : bounds[c + 1]]
+        pid, pidx, np_sub, ho = spill_partition(
+            unit_csr[rows_c], maxpp, halo, seed, _presplit=False
+        )
+        part_ids_l.append(pid + p_off)
+        point_idx_l.append(rows_c[pidx])
+        home[rows_c] = ho + p_off
+        p_off += np_sub
+    return (
+        np.concatenate(part_ids_l),
+        np.concatenate(point_idx_l),
+        int(p_off),
+        home,
+    )
+
+
 def spill_partition(
-    unit, maxpp: int, halo: float, seed: int = 0
+    unit, maxpp: int, halo: float, seed: int = 0, _presplit: bool = True
 ) -> Tuple[np.ndarray, np.ndarray, int, np.ndarray]:
     """Build the spill partition over ``unit`` [N, D] (rows must be the
     UNIT-NORM coordinates ``halo`` refers to — normalized vectors for
@@ -257,7 +473,19 @@ def spill_partition(
     each point's home leaf (its nearest-pivot chain; exactly one).
     """
     if hasattr(unit, "tocsr"):  # scipy sparse input
+        unit = unit.tocsr()
         n = unit.shape[0]
+        if n > maxpp and _presplit:
+            # exact-cover pre-split: accepted pairs have true chord <=
+            # halo (chord_halo's construction), i.e. dot >= 1 - halo^2/2
+            # — the prefix-filter threshold. Oversized components skip
+            # straight to the pivot tree (_presplit=False): components
+            # are maximal connected sets of the verified dot >= t graph,
+            # which depends only on the vectors, so re-splitting a
+            # component can never succeed.
+            pc = prefix_components(unit, 1.0 - halo * halo / 2.0)
+            if pc is not None and pc[1] > 1:
+                return _split_by_components(unit, pc, maxpp, halo, seed)
         ops = _SparseOps(unit) if n else None
     else:
         unit = np.asarray(unit)

@@ -98,3 +98,134 @@ def test_cosine_spill_on_mesh(rng):
     np.testing.assert_array_equal(m0.clusters, m1.clusters)
     np.testing.assert_array_equal(m0.flags, m1.flags)
     assert m0.n_clusters == 8
+
+
+def _topic_csr(rng, n, d, k, nnz_center=40, noise_density=3):
+    """Concentrated sparse topics: k random centers, n/k docs each, tiny
+    per-doc random noise — the regime where every cross distance is
+    ~equal and the pivot tree cannot split (clusters >> pivots)."""
+    import scipy.sparse as sp
+
+    centers = sp.random(
+        k, d, density=nnz_center / d, random_state=int(rng.integers(1e6)),
+        format="csr", dtype=np.float64,
+    )
+    rows = centers[np.repeat(np.arange(k), n // k)]
+    noise = sp.random(
+        n, d, density=noise_density / d,
+        random_state=int(rng.integers(1e6)), format="csr",
+        dtype=np.float64,
+    )
+    return (rows + 0.05 * noise).tocsr(), np.repeat(np.arange(k), n // k)
+
+
+def test_prefix_components_split_concentration_regime(rng):
+    """clusters >> _MAX_PIVOTS on concentrated sparse data: the verified
+    prefix-filter components split what the pivot tree cannot — exact
+    recovery with ZERO duplication (components are exact covers)."""
+    import scipy.sparse as sp
+
+    from dbscan_tpu.ops.sparse import sparse_cosine_dbscan
+    from dbscan_tpu.parallel.spill import (
+        _MAX_PIVOTS,
+        chord_halo,
+        prefix_components,
+        spill_partition,
+    )
+    from dbscan_tpu.utils.ari import adjusted_rand_index
+
+    k = _MAX_PIVOTS + 58  # 250 clusters > 192 pivots
+    n, d = 5000, 8000
+    x, truth = _topic_csr(rng, n, d, k)
+    norms = np.sqrt(np.asarray(x.multiply(x).sum(axis=1)).ravel())
+    xu = (sp.diags(1.0 / norms) @ x).tocsr()
+    halo = chord_halo(0.05, 1e-4, dim=50)
+
+    pc = prefix_components(xu, 1.0 - halo * halo / 2.0)
+    assert pc is not None
+    comp, n_comp = pc
+    assert n_comp == k
+    # components = topics exactly
+    for c in range(n_comp):
+        assert len(np.unique(truth[comp == c])) == 1
+
+    pid, pidx, n_parts, home = spill_partition(xu, 512, halo)
+    assert len(pid) == n  # zero duplication
+    assert n_parts >= 2
+
+    c_out, f_out = sparse_cosine_dbscan(
+        x, eps=0.05, min_points=5, max_points_per_partition=512
+    )
+    assert len(np.unique(c_out[c_out > 0])) == k
+    assert adjusted_rand_index(c_out, truth) == 1.0
+
+
+def test_prefix_components_verified_edges_only():
+    """A shared feature INSIDE both prefixes must still not union docs
+    whose actual dot is below t — the percolation hazard the
+    verification pass exists for (a blind share-a-prefix-feature union
+    would merge these)."""
+    import scipy.sparse as sp
+
+    from dbscan_tpu.parallel.spill import prefix_components
+
+    # shared feature 9 (df=2) carries v^2 = 0.5 in both docs; unique
+    # features (df=1, rarer -> earlier in the global order) carry the
+    # rest. At t = 0.6: tail at feature 9 is 0.5 >= t^2*(1-eps) in both
+    # docs, so 9 sits in BOTH prefixes and the candidate pair IS
+    # generated — but dot = 0.5 < 0.6, so verification rejects it.
+    d = 10
+    row_a = np.zeros(d)
+    row_a[0] = np.sqrt(0.3)
+    row_a[1] = np.sqrt(0.2)
+    row_a[9] = np.sqrt(0.5)
+    row_b = np.zeros(d)
+    row_b[2] = np.sqrt(0.3)
+    row_b[3] = np.sqrt(0.2)
+    row_b[9] = np.sqrt(0.5)
+    x = sp.csr_matrix(np.stack([row_a, row_b]))
+    pc = prefix_components(x, 0.6)
+    assert pc is not None
+    comp, n_comp = pc
+    assert n_comp == 2  # candidate generated, verification rejected
+    # sanity: at a threshold the pair DOES meet, they union
+    pc2 = prefix_components(x, 0.4)
+    assert pc2 is not None and pc2[1] == 1
+
+
+def test_prefix_components_budget_bail(rng):
+    """Stopword-heavy prefixes exceed the pair budget -> None (pivot-tree
+    fallback), never a blowup."""
+    import scipy.sparse as sp
+
+    from dbscan_tpu.parallel import spill
+
+    n, d = 400, 50
+    dense = 0.9 * rng.random((n, d)) + 0.1  # every feature ~every doc
+    x = sp.csr_matrix(dense)
+    x = sp.diags(1.0 / np.linalg.norm(dense, axis=1)) @ x
+    old = spill._PREFIX_PAIR_BUDGET
+    try:
+        spill._PREFIX_PAIR_BUDGET = 4
+        assert spill.prefix_components(x.tocsr(), 0.5) is None
+    finally:
+        spill._PREFIX_PAIR_BUDGET = old
+
+
+def test_prefix_components_blocked_expansion_matches(rng, monkeypatch):
+    """Row-band pair expansion (oversized groups + tiny chunk budget)
+    must reach the same components as the one-shot triu path."""
+    import scipy.sparse as sp
+
+    from dbscan_tpu.parallel import spill
+
+    x, _ = _topic_csr(rng, 600, 2000, 20)
+    norms = np.sqrt(np.asarray(x.multiply(x).sum(axis=1)).ravel())
+    xu = (sp.diags(1.0 / norms) @ x).tocsr()
+    t = 1.0 - spill.chord_halo(0.05, 1e-4, dim=40) ** 2 / 2.0
+    ref = spill.prefix_components(xu, t)
+    monkeypatch.setattr(spill, "_PREFIX_CHUNK", 64)  # forces banding
+    blk = spill.prefix_components(xu, t)
+    assert ref is not None and blk is not None
+    assert ref[1] == blk[1]
+    np.testing.assert_array_equal(ref[0], blk[0])
