@@ -124,6 +124,55 @@ def make_anchor(n: int, kind: str):
     return pts, blob_of, n_blob, k, eps
 
 
+def make_sparse_anchor(n: int, vocab: int = 50_000, nnz: int = 60):
+    """Engineered sparse TF-IDF-like workload (BASELINE.json configs[3]):
+    k topic patterns of ~nnz weighted features, one per doc with
+    multiplicative jitter — known memberships, high intra-topic cosine,
+    ~orthogonal across topics. Built directly from COO arrays
+    (sp.random is ~100x slower at this size)."""
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(42)
+    k = max(16, n // 500)
+    feat = rng.integers(0, vocab, size=(k, nnz))
+    val = rng.random((k, nnz)) + 0.1
+    blob_of = rng.integers(0, k, n)
+    rows = np.repeat(np.arange(n), nnz)
+    cols = feat[blob_of].ravel()
+    vals = (val[blob_of] * rng.uniform(0.9, 1.1, (n, nnz))).ravel()
+    x = sp.coo_matrix((vals, (rows, cols)), shape=(n, vocab)).tocsr()
+    return x, blob_of, k
+
+
+def sparse_row(prefix: str, n: int, maxpp: int) -> dict:
+    """Engineered sparse-cosine run (the TF-IDF config): exact expected
+    cluster count + construction ARI + throughput, same warm-up/best-of
+    discipline as anchor_row."""
+    from dbscan_tpu.ops.sparse import sparse_cosine_dbscan
+    from dbscan_tpu.utils.ari import adjusted_rand_index
+
+    x, blob_of, k = make_sparse_anchor(n)
+    kw = dict(eps=0.05, min_points=5, max_points_per_partition=maxpp)
+    stats: dict = {}
+    sparse_cosine_dbscan(x, stats_out=stats, **kw)  # warm-up
+    reps = int(os.environ.get("BENCH_SPARSE_REPS", "1"))
+    dt = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        clusters, _flags = sparse_cosine_dbscan(x, **kw)
+        dt = min(dt, time.perf_counter() - t0)
+    ari = adjusted_rand_index(clusters, blob_of)
+    return {
+        f"{prefix}_n": n,
+        f"{prefix}_seconds": round(dt, 2),
+        f"{prefix}_clusters": int(len(np.unique(clusters[clusters > 0]))),
+        f"{prefix}_expect": k,
+        f"{prefix}_ari": round(float(ari), 6),
+        f"{prefix}_leaves": stats.get("n_partitions"),
+        f"{prefix}_dup": stats.get("duplication_factor"),
+    }
+
+
 def run_train(pts, maxpp, use_pallas=False, reps=1, **extra):
     from dbscan_tpu import Engine, train
 
@@ -379,6 +428,17 @@ def main() -> None:
             ),
             int(os.environ.get("BENCH_COS_MAXPP", "8192")),
         ),
+        (
+            "sparse",
+            "sparse",
+            "BENCH_SPARSE",
+            int(
+                os.environ.get(
+                    "BENCH_SPARSE_N", "30000" if on_cpu else "200000"
+                )
+            ),
+            int(os.environ.get("BENCH_SPARSE_MAXPP", "4096")),
+        ),
     ]
     # the budget must also bound a row that has not STARTED: predict each
     # row's cost from the headline run's measured rate (a slow-tunnel day
@@ -386,18 +446,28 @@ def main() -> None:
     # whose estimate does not fit the remaining budget
     headline_rate = n / max(dt, 1e-9)  # points/s, hot
     anchor_reps = int(os.environ.get("BENCH_ANCHOR_REPS", "2")) + 1  # +warmup
-    cost_factor = {"euclidean": 2.0, "haversine": 5.0, "cosine": 40.0}
+    sparse_reps = int(os.environ.get("BENCH_SPARSE_REPS", "1")) + 1
+    cost_factor = {
+        "euclidean": 2.0,
+        "haversine": 5.0,
+        "cosine": 40.0,
+        "sparse": 20.0,
+    }
     for prefix, kind, env_name, row_n, row_maxpp in anchor_rows:
         if os.environ.get(env_name, "1") == "0":
             continue
         remaining = budget - (time.monotonic() - t_rows)
-        est = anchor_reps * row_n / headline_rate * cost_factor[kind]
+        row_reps = sparse_reps if kind == "sparse" else anchor_reps
+        est = row_reps * row_n / headline_rate * cost_factor[kind]
         if remaining <= 0 or est > remaining:
             out[f"{prefix}_skipped"] = (
                 "time_budget" if remaining <= 0 else "est_over_budget"
             )
             continue
-        out.update(anchor_row(prefix, row_n, kind=kind, maxpp=row_maxpp))
+        if kind == "sparse":
+            out.update(sparse_row(prefix, row_n, maxpp=row_maxpp))
+        else:
+            out.update(anchor_row(prefix, row_n, kind=kind, maxpp=row_maxpp))
     print(json.dumps(out))
 
 

@@ -250,9 +250,13 @@ def _pivot_vectors(sub, m: int, halo: float, rng):
 # caps time, not memory.
 _PREFIX_PAIR_BUDGET = 256
 _PREFIX_CHUNK = 1 << 22  # candidate pairs per verify chunk
+# elevated budget for the last-resort retry inside the pivot tree
+# (when the tree itself failed to split, verification is the only
+# remaining move and is worth ~16x more pair work)
+_PREFIX_RETRY_BUDGET = 4096
 
 
-def prefix_components(x_csr, t: float):
+def prefix_components(x_csr, t: float, budget: int = None):
     """Exact-cover pre-split for SPARSE unit rows: connected components of
     the VERIFIED dot >= t graph, found via prefix filtering.
 
@@ -323,7 +327,9 @@ def prefix_components(x_csr, t: float):
     bounds = np.flatnonzero(np.r_[True, pf[1:] != pf[:-1], True])
     sizes = np.diff(bounds)
     pairs_per_group = sizes * (sizes - 1) // 2
-    if int(pairs_per_group.sum()) > _PREFIX_PAIR_BUDGET * n:
+    if budget is None:
+        budget = _PREFIX_PAIR_BUDGET
+    if int(pairs_per_group.sum()) > budget * n:
         return None
 
     # expand -> dedup -> verify in bounded blocks: only PASSING edges
@@ -400,50 +406,56 @@ def prefix_components(x_csr, t: float):
     return (np.asarray(gids) - 1).astype(np.int32), int(n_comp)
 
 
-def _split_by_components(unit_csr, pc, maxpp: int, halo: float, seed: int):
-    """Assemble spill output across prefix components (ZERO duplicated
-    instances — no qualifying pair crosses components, and the halo's
-    slack margin means the quantized kernel cannot accept a cross-
-    component pair either, so whole components pack together freely).
-    Small components bin-pack into shared leaves of capacity maxpp
-    (size-descending next-fit: noise singletons would otherwise each
-    become a padded leaf); oversized components recurse through
-    spill_partition with part-id offsets. Keeps the (partition, point
-    index)-sorted instance layout the packers require."""
-    comp, n_comp = pc
-    n = unit_csr.shape[0]
+def _component_bins(comp: np.ndarray, n_comp: int, maxpp: int):
+    """Group rows by component and bin-pack the fitting components into
+    shared groups of capacity maxpp (size-descending next-fit: noise
+    singletons would otherwise each become a padded leaf). Returns
+    (packed row-index arrays — each sorted ascending, whole components
+    only — and oversized components' row arrays). Packing whole
+    components together is sound: no qualifying pair crosses components,
+    and the halo's slack margin means the quantized kernel cannot accept
+    a cross-component pair either."""
     order_c = np.argsort(comp, kind="stable")  # ascending rows per comp
     bounds = np.searchsorted(comp[order_c], np.arange(n_comp + 1))
     sizes = np.diff(bounds)
+    packed, oversized = [], []
+    small = np.flatnonzero(sizes <= maxpp)
+    small = small[np.argsort(sizes[small], kind="stable")[::-1]]
+    cur: list = []
+    fill = 0
+    for c in small:
+        g = int(sizes[c])
+        if fill and fill + g > maxpp:
+            packed.append(np.sort(np.concatenate(cur)))
+            cur, fill = [], 0
+        cur.append(order_c[bounds[c] : bounds[c + 1]])
+        fill += g
+    if cur:
+        packed.append(np.sort(np.concatenate(cur)))
+    for c in np.flatnonzero(sizes > maxpp):
+        oversized.append(order_c[bounds[c] : bounds[c + 1]])
+    return packed, oversized
+
+
+def _split_by_components(unit_csr, pc, maxpp: int, halo: float, seed: int):
+    """Assemble spill output across prefix components (ZERO duplicated
+    instances): packed bins become leaves directly; oversized components
+    recurse through spill_partition with part-id offsets. Keeps the
+    (partition, point index)-sorted instance layout the packers
+    require."""
+    comp, n_comp = pc
+    n = unit_csr.shape[0]
+    packed, oversized = _component_bins(comp, n_comp, maxpp)
 
     part_ids_l, point_idx_l = [], []
     home = np.empty(n, dtype=np.int32)
     p_off = 0
-    # bin-pack the fitting components, largest first
-    small = np.flatnonzero(sizes <= maxpp)
-    small = small[np.argsort(sizes[small], kind="stable")[::-1]]
-    bin_rows: list = []
-    bin_fill = 0
-    for c in small:
-        g = int(sizes[c])
-        if bin_fill and bin_fill + g > maxpp:
-            rows_b = np.sort(np.concatenate(bin_rows))
-            part_ids_l.append(np.full(len(rows_b), p_off, dtype=np.int64))
-            point_idx_l.append(rows_b)
-            home[rows_b] = p_off
-            p_off += 1
-            bin_rows, bin_fill = [], 0
-        bin_rows.append(order_c[bounds[c] : bounds[c + 1]])
-        bin_fill += g
-    if bin_rows:
-        rows_b = np.sort(np.concatenate(bin_rows))
+    for rows_b in packed:
         part_ids_l.append(np.full(len(rows_b), p_off, dtype=np.int64))
         point_idx_l.append(rows_b)
         home[rows_b] = p_off
         p_off += 1
-
-    for c in np.flatnonzero(sizes > maxpp):
-        rows_c = order_c[bounds[c] : bounds[c + 1]]
+    for rows_c in oversized:
         pid, pidx, np_sub, ho = spill_partition(
             unit_csr[rows_c], maxpp, halo, seed, _presplit=False
         )
@@ -560,6 +572,34 @@ def spill_partition(
                 split = (assign, member)
                 break
         if split is None:
+            # last resort before an oversized leaf, sparse only: retry the
+            # verified prefix-filter pre-split at an ELEVATED pair budget.
+            # The cheap-budget pass at the top bails on dense prefix
+            # indexes because the pivot tree usually wins — but when the
+            # pivot tree itself just failed, paying for verification is
+            # the only remaining split. Components are exact covers, so
+            # they enter the stack as independent subtrees (no bands).
+            if isinstance(ops, _SparseOps):
+                pc = prefix_components(
+                    sub.x, 1.0 - halo * halo / 2.0,
+                    budget=_PREFIX_RETRY_BUDGET,
+                )
+                if pc is not None and pc[1] > 1:
+                    # same bin-packing as the top-level pre-split: packed
+                    # bins become leaves on the next pop; oversized
+                    # components keep descending (their own retry is a
+                    # cheap 1-component rediscovery, the tolerable cost
+                    # of keeping subsets retryable — a pivot band can
+                    # drop bridge docs and make a child splittable even
+                    # when its parent was one verified component)
+                    packed, oversized = _component_bins(
+                        pc[0], pc[1], maxpp
+                    )
+                    for rows_b in packed:
+                        stack.append((idx[rows_b], home[rows_b]))
+                    for rows_c in oversized:
+                        stack.append((idx[rows_c], home[rows_c]))
+                    continue
             logger.warning(
                 "spill: can't split %d points (every pivot set spills "
                 ">%.1fx or one cell keeps >%.0f%%); emitting an "

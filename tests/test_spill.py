@@ -229,3 +229,24 @@ def test_prefix_components_blocked_expansion_matches(rng, monkeypatch):
     assert ref is not None and blk is not None
     assert ref[1] == blk[1]
     np.testing.assert_array_equal(ref[0], blk[0])
+
+
+def test_prefix_retry_inside_pivot_tree(rng, monkeypatch):
+    """When the cheap-budget pre-split bails AND the pivot tree cannot
+    split (concentration regime), the tree retries prefix components at
+    the elevated budget instead of emitting an oversized leaf."""
+    import scipy.sparse as sp
+
+    from dbscan_tpu.parallel import spill
+
+    k = spill._MAX_PIVOTS + 58
+    n, d = 5000, 8000
+    x, truth = _topic_csr(rng, n, d, k)
+    norms = np.sqrt(np.asarray(x.multiply(x).sum(axis=1)).ravel())
+    xu = (sp.diags(1.0 / norms) @ x).tocsr()
+    halo = spill.chord_halo(0.05, 1e-4, dim=50)
+
+    monkeypatch.setattr(spill, "_PREFIX_PAIR_BUDGET", 0)  # force the bail
+    pid, pidx, n_parts, home = spill.spill_partition(xu, 512, halo)
+    assert n_parts >= 2  # retry split it — no oversized leaf
+    assert len(pid) == n  # components: zero duplication
