@@ -464,10 +464,18 @@ def main() -> None:
                 "time_budget" if remaining <= 0 else "est_over_budget"
             )
             continue
-        if kind == "sparse":
-            out.update(sparse_row(prefix, row_n, maxpp=row_maxpp))
-        else:
-            out.update(anchor_row(prefix, row_n, kind=kind, maxpp=row_maxpp))
+        # one failing row must not take down the whole capture (the JSON
+        # line with every other row is the round's official record)
+        try:
+            if kind == "sparse":
+                out.update(sparse_row(prefix, row_n, maxpp=row_maxpp))
+            else:
+                out.update(
+                    anchor_row(prefix, row_n, kind=kind, maxpp=row_maxpp)
+                )
+        except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+            sys.stderr.write(f"bench: {prefix} row failed: {e}\n")
+            out[f"{prefix}_failed"] = f"{type(e).__name__}: {e}"[:200]
     print(json.dumps(out))
 
 

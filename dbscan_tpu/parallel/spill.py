@@ -243,6 +243,131 @@ def _pivot_vectors(sub, m: int, halo: float, rng):
     return p[np.array(kept, dtype=np.int64)]
 
 
+# Leader-cover pre-split (dense concentration regime) bounds: leader cap
+# per node (the O(n * L * D) passes must stay host-affordable; the cap-hit
+# retry DOUBLES the cover radius), and a canopy-overlap budget in
+# covering-leaders-per-point — heavy overlap means the data is not
+# separated at this radius and larger radii only overlap more, so the
+# node returns to the pivot tree.
+_LEADER_CAP = 4096
+_LEADER_EDGE_BUDGET = 32
+_LEADER_CHUNK = 1 << 16
+
+
+def _greedy_leaders(sub: "_DenseOps", t: float, rng):
+    """Greedy metric cover of the node at radius ``t``: stream shuffled
+    batches, points farther than ``t`` from every existing leader become
+    leaders themselves (sequential within the batch so co-batched
+    near-duplicates collapse to one). Returns the [L, D] leader rows, or
+    None when L would exceed _LEADER_CAP. Batches grow adaptively while
+    no new leaders appear (coverage checks are one matmul) and shrink
+    back on discovery, keeping the sequential tail short."""
+    n = sub.x.shape[0]
+    order = rng.permutation(n)
+    buf = np.empty((_LEADER_CAP, sub.dim), dtype=np.float32)
+    nb = 0  # leaders stored in buf[:nb]
+    batch = 2048
+    s = 0
+    while s < n:
+        rows = order[s : s + batch]
+        s += len(rows)
+        vb = sub.x[rows]
+        if nb:
+            d = _chords_of(vb, buf[:nb])
+            unc = np.flatnonzero(d.min(axis=1) > t)
+        else:
+            unc = np.arange(len(vb))
+        if len(unc) == 0:
+            batch = min(batch * 2, _LEADER_CHUNK)
+            continue
+        batch = 2048
+        start = nb  # pre-batch leaders already filtered via d above
+        for i in unc:  # sequential: each may cover later candidates
+            v = vb[i]
+            if nb >= _LEADER_CAP:
+                return None
+            if nb > start:
+                dl = _chords_of(v[None, :], buf[start:nb])[0]
+                if float(dl.min()) <= t:
+                    continue
+            buf[nb] = v
+            nb += 1
+    return buf[:nb].copy()
+
+
+def _chords_of(rows: np.ndarray, vecs: np.ndarray) -> np.ndarray:
+    """[len(rows), len(vecs)] chord distances between unit-row blocks."""
+    d = 2.0 - 2.0 * (rows @ vecs.T)
+    np.clip(d, 0.0, None, out=d)
+    np.sqrt(d, out=d)
+    return d
+
+
+def leader_components(sub: "_DenseOps", halo: float, rng):
+    """Exact-cover pre-split for DENSE unit rows in the concentration
+    regime (cluster count >> pivot count, all cross-cluster chords
+    ~equal — e.g. hundreds of tight blobs at near-orthogonal directions,
+    where every pivot band spills wholesale). The dense counterpart of
+    ``prefix_components``.
+
+    Cover proof: greedy leaders at radius T guarantee every point is
+    within T of some leader. For any accepted pair p, q (chord <= halo)
+    and any leader L covering p: d(q, L) <= T + halo, so BOTH endpoints
+    lie in L's (T + halo)-canopy. Leaders whose (T + halo)-canopies share
+    a point are unioned, therefore p's and q's assigned leaders (their
+    nearest, both within d <= T <= T + halo of the shared canopy's
+    leader) land in one component — every accepted pair is intra-
+    component, components are exact covers, ZERO halo duplication.
+
+    Separated data keeps canopies disjoint across clusters, so the
+    components are the clusters (plus noise singletons). Heavily
+    overlapping data either exceeds the covering-leader budget or
+    collapses to one component — both return None and the node falls
+    back to the pivot tree / oversized-leaf route unchanged.
+    """
+    n = sub.x.shape[0]
+    for t_mult in (2.0, 4.0, 8.0):
+        t = t_mult * halo
+        if t + halo >= 1.9:  # canopies span the sphere: hopeless
+            break
+        leaders = _greedy_leaders(sub, t, rng)
+        if leaders is None:
+            continue  # cap exceeded: retry at a coarser radius
+        if len(leaders) < 2:
+            return None
+        band = t + halo
+        nearest = np.empty(n, dtype=np.int64)
+        ea_l, eb_l = [], []
+        over_budget = False
+        for s in range(0, n, _LEADER_CHUNK):
+            d = _chords_of(sub.x[s : s + _LEADER_CHUNK], leaders)
+            nearest[s : s + len(d)] = np.argmin(d, axis=1)
+            mask = d <= band
+            if int(mask.sum()) > _LEADER_EDGE_BUDGET * len(d):
+                over_budget = True
+                break
+            multi = mask.sum(axis=1) > 1
+            if multi.any():
+                rows, cols = np.nonzero(mask[multi])
+                row_change = np.r_[True, rows[1:] != rows[:-1]]
+                ea_l.append(cols[row_change][np.cumsum(row_change) - 1])
+                eb_l.append(cols)
+        if over_budget:
+            # canopies already overlap heavily; larger radii overlap more
+            return None
+        ea = np.concatenate(ea_l) if ea_l else np.empty(0, np.int64)
+        eb = np.concatenate(eb_l) if eb_l else np.empty(0, np.int64)
+
+        from dbscan_tpu.parallel.graph import uf_components
+
+        n_comp, gids = uf_components(ea, eb, len(leaders))
+        if n_comp < 2:
+            return None
+        comp = (np.asarray(gids)[nearest] - 1).astype(np.int32)
+        return comp, int(n_comp)
+    return None
+
+
 # Candidate-pair budget for prefix_components, in pairs-per-doc (counted
 # pre-dedup): past it the prefix index is too dense to verify cheaply
 # (stopword-heavy data) and the caller falls back to the pivot tree.
@@ -572,34 +697,41 @@ def spill_partition(
                 split = (assign, member)
                 break
         if split is None:
-            # last resort before an oversized leaf, sparse only: retry the
-            # verified prefix-filter pre-split at an ELEVATED pair budget.
-            # The cheap-budget pass at the top bails on dense prefix
-            # indexes because the pivot tree usually wins — but when the
-            # pivot tree itself just failed, paying for verification is
-            # the only remaining split. Components are exact covers, so
-            # they enter the stack as independent subtrees (no bands).
+            # last resort before an oversized leaf: an exact-cover
+            # component pre-split. Sparse retries the verified
+            # prefix-filter at an ELEVATED pair budget (the cheap-budget
+            # pass at the top bails on dense prefix indexes because the
+            # pivot tree usually wins — but when the pivot tree itself
+            # just failed, paying for verification is the only remaining
+            # split). Dense runs leader-cover components — the same
+            # concentration regime (cluster count >> pivot count, all
+            # cross distances ~equal) with no sparse features to filter
+            # on. Either way components are exact covers and enter the
+            # stack as independent subtrees (no bands); a re-entered
+            # oversized component either splits finer (progress) or
+            # rediscovers itself (n_comp == 1 -> None -> oversized
+            # leaf), so the recursion terminates.
             if isinstance(ops, _SparseOps):
                 pc = prefix_components(
                     sub.x, 1.0 - halo * halo / 2.0,
                     budget=_PREFIX_RETRY_BUDGET,
                 )
-                if pc is not None and pc[1] > 1:
-                    # same bin-packing as the top-level pre-split: packed
-                    # bins become leaves on the next pop; oversized
-                    # components keep descending (their own retry is a
-                    # cheap 1-component rediscovery, the tolerable cost
-                    # of keeping subsets retryable — a pivot band can
-                    # drop bridge docs and make a child splittable even
-                    # when its parent was one verified component)
-                    packed, oversized = _component_bins(
-                        pc[0], pc[1], maxpp
-                    )
-                    for rows_b in packed:
-                        stack.append((idx[rows_b], home[rows_b]))
-                    for rows_c in oversized:
-                        stack.append((idx[rows_c], home[rows_c]))
-                    continue
+            else:
+                pc = leader_components(sub, halo, rng)
+            if pc is not None and pc[1] > 1:
+                # same bin-packing as the top-level pre-split: packed
+                # bins become leaves on the next pop; oversized
+                # components keep descending (their own retry is a
+                # cheap 1-component rediscovery, the tolerable cost
+                # of keeping subsets retryable — a pivot band can
+                # drop bridge docs and make a child splittable even
+                # when its parent was one verified component)
+                packed, oversized = _component_bins(pc[0], pc[1], maxpp)
+                for rows_b in packed:
+                    stack.append((idx[rows_b], home[rows_b]))
+                for rows_c in oversized:
+                    stack.append((idx[rows_c], home[rows_c]))
+                continue
             logger.warning(
                 "spill: can't split %d points (every pivot set spills "
                 ">%.1fx or one cell keeps >%.0f%%); emitting an "

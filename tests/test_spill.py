@@ -250,3 +250,86 @@ def test_prefix_retry_inside_pivot_tree(rng, monkeypatch):
     pid, pidx, n_parts, home = spill.spill_partition(xu, 512, halo)
     assert n_parts >= 2  # retry split it — no oversized leaf
     assert len(pid) == n  # components: zero duplication
+
+
+def _dense_blobs(rng, k, per, d, sigma, n_noise=0):
+    """k tight unit-sphere blobs at random directions (+ optional
+    random-direction noise rows): the dense concentration regime —
+    every cross-blob chord ~sqrt(2)."""
+    c = rng.normal(size=(k, d))
+    c /= np.linalg.norm(c, axis=1, keepdims=True)
+    truth = np.repeat(np.arange(k), per)
+    pts = c[truth] + sigma * rng.normal(size=(k * per, d))
+    if n_noise:
+        pts = np.concatenate([pts, rng.normal(size=(n_noise, d))])
+        truth = np.concatenate([truth, np.full(n_noise, -1)])
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+    return pts.astype(np.float32), truth
+
+
+def test_leader_components_split_dense_concentration(rng):
+    """clusters >> _MAX_PIVOTS on concentrated DENSE data: leader-cover
+    components split what the pivot tree cannot (the dense counterpart
+    of the sparse prefix pre-split) — exact covers, ~zero duplication."""
+    from dbscan_tpu.parallel.spill import (
+        _MAX_PIVOTS,
+        _DenseOps,
+        chord_halo,
+        leader_components,
+        spill_partition,
+    )
+
+    k = _MAX_PIVOTS + 58  # 250 blobs > 192 pivots
+    pts, truth = _dense_blobs(rng, k, 16, 64, 0.005, n_noise=40)
+    halo = chord_halo(0.02, 1e-4, dim=64)
+
+    pc = leader_components(_DenseOps(pts), halo, np.random.default_rng(0))
+    assert pc is not None
+    comp, n_comp = pc
+    assert n_comp >= k  # blobs + noise singletons
+    for c in range(n_comp):  # no component mixes two blobs
+        t = truth[comp == c]
+        assert len(np.unique(t[t >= 0])) <= 1
+    for b in range(k):  # no blob splits across components
+        assert len(np.unique(comp[truth == b])) == 1
+
+    pid, pidx, n_parts, home = spill_partition(pts, 512, halo, seed=0)
+    assert n_parts >= 2  # the pivot tree alone cannot split this
+    assert len(pid) <= 1.05 * len(pts)  # components: ~zero duplication
+    assert (home >= 0).all()
+
+
+def test_leader_components_end_to_end_cosine(rng):
+    """Full train() through the dense leader pre-split: exact blob
+    recovery in the concentration regime (the BENCH_COSINE shape)."""
+    from dbscan_tpu import train
+    from dbscan_tpu.parallel.spill import _MAX_PIVOTS
+    from dbscan_tpu.utils.ari import adjusted_rand_index
+
+    k = _MAX_PIVOTS + 8
+    pts, truth = _dense_blobs(rng, k, 16, 64, 0.005, n_noise=30)
+    model = train(
+        pts,
+        eps=0.02,
+        min_points=5,
+        max_points_per_partition=512,
+        metric="cosine",
+    )
+    blob = truth >= 0
+    assert model.n_clusters == k, model.stats
+    assert adjusted_rand_index(model.clusters[blob], truth[blob]) == 1.0
+    assert model.stats["duplication_factor"] <= 1.05
+
+
+def test_leader_components_bails_on_connected_data(rng):
+    """A halo-connected cloud (uniform sphere, NN distance << halo) is
+    one component — leader_components returns None and the pivot tree
+    keeps the node."""
+    from dbscan_tpu.parallel.spill import _DenseOps, leader_components
+
+    pts = rng.normal(size=(3000, 3))
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+    out = leader_components(
+        _DenseOps(pts.astype(np.float32)), 0.25, np.random.default_rng(0)
+    )
+    assert out is None
