@@ -20,14 +20,20 @@ outputs feed the same compact postpass + host cell-CC
 (parallel/cellgraph.py), so labels are bit-identical to the XLA banded
 engine (asserted by tests/test_pallas_banded.py).
 
-The Pallas-specific part is the slab fetch: slab origins are
-DATA-DEPENDENT (host-measured), which BlockSpec index maps cannot express
-— so origins ride in as a scalar-prefetch SMEM array and each kernel
-issues manual `make_async_copy` DMAs from the full HBM-resident planes
-into [R, S] VMEM scratch, overlapping the 5 window rows' fetches. Blocked
-views of the same arrays arrive through ordinary BlockSpecs. Run tables
-are fed [R, T]-transposed so the minor (lane) dimension is the block
-edge, not the 5-wide window.
+Slab origins are DATA-DEPENDENT (host-measured), which Mosaic's tiling
+rules make hostile to in-kernel consumption: BlockSpec index maps cannot
+express them, and manual HBM->VMEM DMAs require the dynamic start be
+provably 1024-element aligned — paying for that alignment would widen
+every slab window several-fold. So the slab FETCH stays in XLA, which is
+exactly the kind of data-dependent gather it is good at: one advanced-
+indexing gather builds the [nb, R, S] slab tensors (a few percent of the
+bucket in bytes — S << B), and the Pallas kernels consume them through
+ordinary aligned BlockSpecs, fusing the 5-row adjacency sweep with its
+count/bit reductions so no [T, S] intermediate ever reaches HBM.
+
+Per-point blocked arrays ride as [nb, 1, T] (the (1, 1, T) block passes
+Mosaic's last-two-dims rule by dimension equality where a (1, T) block
+over [nb, T] fails the sublane-divisibility check).
 
 On non-TPU backends the kernels run in interpreter mode (how the CPU
 suite pins them bit-for-bit against ops/banded.py); Mosaic lowering is
@@ -50,21 +56,12 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _start_slab_copies(ss_ref, i, full_arrays, slabs, sem, slab):
-    """Kick off the [R, S] slab DMAs for every (array, window row) pair and
-    return the descriptors to wait on. full_arrays[a] is an HBM-resident
-    [B] ref; slabs[a] its [R, S] VMEM scratch; sem is an (A, R) DMA
-    semaphore array."""
-    copies = []
-    for k in range(BANDED_ROWS):
-        start = ss_ref[i, k]
-        for a, (src, dst) in enumerate(zip(full_arrays, slabs)):
-            c = pltpu.make_async_copy(
-                src.at[pl.ds(start, slab)], dst.at[k], sem.at[a, k]
-            )
-            c.start()
-            copies.append(c)
-    return copies
+# Rows of a block processed per inner grid step: every [TSUB, S]
+# intermediate of the unrolled 5-row sweep must fit VMEM at once, and at
+# the full BANDED_BLOCK=512 the compiler runs out for wide slabs. The
+# slab bundle's index map ignores the inner dim, so it stays resident
+# across a block's inner steps.
+TSUB = 128
 
 
 def _tile_adj(bl_planes, bm_row, brel, bspan, slabs, smask, offs, eps2, k):
@@ -72,38 +69,31 @@ def _tile_adj(bl_planes, bm_row, brel, bspan, slabs, smask, offs, eps2, k):
     never stored across sweeps — the banded engine's memory contract)."""
     d2 = None
     for bp, sl in zip(bl_planes, slabs):
-        df = bp[0][:, None] - sl[k][None, :]
+        df = bp[0, 0][:, None] - sl[0, k][None, :]
         d2 = df * df if d2 is None else d2 + df * df
     rel_k = brel[0, k][:, None]
     span_k = bspan[0, k][:, None]
     inrun = (offs >= rel_k) & (offs < rel_k + span_k)
     return (
         inrun
-        & (smask[k][None, :] > 0)
+        & (smask[0, k][None, :] > 0)
         & (d2 <= eps2)
-        & (bm_row[0][:, None] > 0)
+        & (bm_row[0, 0][:, None] > 0)
     )
 
 
 def _make_counts_kernel(d: int, slab: int):
-    t = BANDED_BLOCK
+    t = TSUB
 
-    def kernel(ss_ref, eps2_ref, *refs):
+    def kernel(eps2_ref, *refs):
         bl_planes = refs[0:d]
         bm = refs[d]
         brel = refs[d + 1]
         bspan = refs[d + 2]
-        full = refs[d + 3 : 2 * d + 4]  # d planes + mask, HBM-resident
+        slabs = refs[d + 3 : 2 * d + 3]
+        smask = refs[2 * d + 3]
         out = refs[2 * d + 4]
-        slabs = refs[2 * d + 5 : 3 * d + 5]
-        smask = refs[3 * d + 5]
-        sem = refs[3 * d + 6]
 
-        i = pl.program_id(0)
-        for c in _start_slab_copies(
-            ss_ref, i, full, (*slabs, smask), sem, slab
-        ):
-            c.wait()
         offs = jax.lax.broadcasted_iota(jnp.int32, (t, slab), 1)
         eps2 = eps2_ref[0, 0]
         acc = jnp.zeros((t,), jnp.int32)
@@ -112,33 +102,26 @@ def _make_counts_kernel(d: int, slab: int):
                 bl_planes, bm, brel, bspan, slabs, smask, offs, eps2, k
             )
             acc = acc + jnp.sum(adj.astype(jnp.int32), axis=1)
-        out[0] = acc
+        out[0, 0] = acc
 
     return kernel
 
 
 def _make_bits_kernel(d: int, slab: int):
-    t = BANDED_BLOCK
+    t = TSUB
 
-    def kernel(ss_ref, eps2_ref, *refs):
+    def kernel(eps2_ref, *refs):
         bl_planes = refs[0:d]
         bm = refs[d]
         brel = refs[d + 1]
         bspan = refs[d + 2]
         bcx = refs[d + 3]
-        full = refs[d + 4 : 2 * d + 7]  # d planes + mask + cx + core
+        slabs = refs[d + 4 : 2 * d + 4]
+        smask = refs[2 * d + 4]
+        scx = refs[2 * d + 5]
+        score = refs[2 * d + 6]
         out = refs[2 * d + 7]
-        slabs = refs[2 * d + 8 : 3 * d + 8]
-        smask = refs[3 * d + 8]
-        scx = refs[3 * d + 9]
-        score = refs[3 * d + 10]
-        sem = refs[3 * d + 11]
 
-        i = pl.program_id(0)
-        for c in _start_slab_copies(
-            ss_ref, i, full, (*slabs, smask, scx, score), sem, slab
-        ):
-            c.wait()
         offs = jax.lax.broadcasted_iota(jnp.int32, (t, slab), 1)
         eps2 = eps2_ref[0, 0]
         bits = jnp.zeros((t,), jnp.int32)
@@ -146,27 +129,43 @@ def _make_bits_kernel(d: int, slab: int):
             adj = _tile_adj(
                 bl_planes, bm, brel, bspan, slabs, smask, offs, eps2, k
             )
-            adj_cc = adj & (score[k][None, :] > 0)
+            adj_cc = adj & (score[0, k][None, :] > 0)
             # window column slot: 0..4 whenever adj_cc is true (the run
             # covers exactly cx-2..cx+2); a boolean any() per slot keeps
             # the reduction a plain max — no bitwise-or reduce needed
-            dxm = scx[k][None, :] - bcx[0][:, None] + 2
+            dxm = scx[0, k][None, :] - bcx[0, 0][:, None] + 2
             for dx in range(5):
                 hit = jnp.any(adj_cc & (dxm == dx), axis=1)
                 bits = bits | (
                     hit.astype(jnp.int32) << jnp.int32(k * 5 + dx)
                 )
-        out[0] = bits
+        out[0, 0] = bits
 
     return kernel
 
 
 def _block_spec(t):
-    return pl.BlockSpec((1, t), lambda i, ss: (i, 0))
+    # [nb * nsub, 1, t] layout: Mosaic requires the last two block dims
+    # to be (divisible by 8, divisible by 128) OR equal to the array dims
+    # — a (1, t) block over [rows, t] fails the sublane rule, while
+    # (1, 1, t) over [rows, 1, t] passes by equality. Grid is (nb, nsub):
+    # outer picks the block (and its slab), inner the t-row sub-block.
+    return pl.BlockSpec((1, 1, t), lambda i, j: (i * (BANDED_BLOCK // t) + j, 0, 0))
 
 
-def _run_spec(t):
-    return pl.BlockSpec((1, BANDED_ROWS, t), lambda i, ss: (i, 0, 0))
+def _slab_spec(slab):
+    # one [R, S] slab bundle per OUTER grid step; the index map ignores
+    # the inner dim so the bundle stays resident across a block's
+    # sub-steps. (R, S) equals the trailing array dims, satisfying the
+    # tiling rule.
+    return pl.BlockSpec((1, BANDED_ROWS, slab), lambda i, j: (i, 0, 0))
+
+
+def _gather_slabs(plane, ss, slab):
+    """[nb, R, S] slab tensor: plane[ss[i, k] + j]. XLA lowers this to a
+    gather — the data-dependent fetch Mosaic cannot cheaply express."""
+    idx = ss[:, :, None] + jnp.arange(slab, dtype=jnp.int32)[None, None, :]
+    return plane[idx]
 
 
 @functools.partial(jax.jit, static_argnames=("min_points", "slab"))
@@ -190,50 +189,53 @@ def banded_phase1_pallas(
         raise ValueError(f"bucket width {b} not a multiple of {t}")
     nb = b // t
 
+    nsub = t // TSUB
+    rows = nb * nsub
+
     planes = tuple(points[:, j].astype(jnp.float32) for j in range(d))
     m32 = mask.astype(jnp.int32)
-    # [B, R] run tables -> [nb, R, T]: lane dim = block edge
-    rel = rel_starts.astype(jnp.int32).reshape(nb, t, r).transpose(0, 2, 1)
-    spn = spans.astype(jnp.int32).reshape(nb, t, r).transpose(0, 2, 1)
+    # [B, R] run tables -> [rows, R, TSUB]: lane dim = sub-block edge
+    rel = (
+        rel_starts.astype(jnp.int32)
+        .reshape(rows, TSUB, r)
+        .transpose(0, 2, 1)
+    )
+    spn = (
+        spans.astype(jnp.int32).reshape(rows, TSUB, r).transpose(0, 2, 1)
+    )
     ss = slab_starts.astype(jnp.int32)
     eps2 = jnp.asarray(eps, jnp.float32).reshape(1, 1) ** 2
 
     blocked_specs = [
-        pl.BlockSpec((1, 1), lambda i, ss: (0, 0), memory_space=pltpu.SMEM),
-        *[_block_spec(t) for _ in range(d + 1)],  # planes + mask
-        _run_spec(t),
-        _run_spec(t),
+        pl.BlockSpec(
+            (1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM
+        ),
+        *[_block_spec(TSUB) for _ in range(d + 1)],  # planes + mask
+        pl.BlockSpec((1, r, TSUB), lambda i, j: (i * nsub + j, 0, 0)),
+        pl.BlockSpec((1, r, TSUB), lambda i, j: (i * nsub + j, 0, 0)),
     ]
     blocked_args = [
         eps2,
-        *[p.reshape(nb, t) for p in planes],
-        m32.reshape(nb, t),
+        *[p.reshape(rows, 1, TSUB) for p in planes],
+        m32.reshape(rows, 1, TSUB),
         rel,
         spn,
     ]
 
+    plane_slabs = [_gather_slabs(p, ss, slab) for p in planes]
+    mask_slab = _gather_slabs(m32, ss, slab)
+
     counts = pl.pallas_call(
         _make_counts_kernel(d, slab),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(nb,),
-            in_specs=[
-                *blocked_specs,
-                *[
-                    pl.BlockSpec(memory_space=pl.ANY)
-                    for _ in range(d + 1)
-                ],
-            ],
-            out_specs=_block_spec(t),
-            scratch_shapes=[
-                *[pltpu.VMEM((r, slab), jnp.float32) for _ in range(d)],
-                pltpu.VMEM((r, slab), jnp.int32),
-                pltpu.SemaphoreType.DMA((d + 1, r)),
-            ],
-        ),
-        out_shape=jax.ShapeDtypeStruct((nb, t), jnp.int32),
+        grid=(nb, nsub),
+        in_specs=[
+            *blocked_specs,
+            *[_slab_spec(slab) for _ in range(d + 1)],
+        ],
+        out_specs=_block_spec(TSUB),
+        out_shape=jax.ShapeDtypeStruct((rows, 1, TSUB), jnp.int32),
         interpret=_interpret(),
-    )(ss, *blocked_args, *planes, m32).reshape(-1)
+    )(*blocked_args, *plane_slabs, mask_slab).reshape(-1)
 
     core = (counts >= jnp.int32(min_points)) & mask
     cx32 = cx.astype(jnp.int32)
@@ -241,28 +243,22 @@ def banded_phase1_pallas(
 
     bits = pl.pallas_call(
         _make_bits_kernel(d, slab),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(nb,),
-            in_specs=[
-                *blocked_specs,
-                _block_spec(t),  # cx blocked
-                *[
-                    pl.BlockSpec(memory_space=pl.ANY)
-                    for _ in range(d + 3)
-                ],
-            ],
-            out_specs=_block_spec(t),
-            scratch_shapes=[
-                *[pltpu.VMEM((r, slab), jnp.float32) for _ in range(d)],
-                pltpu.VMEM((r, slab), jnp.int32),  # mask slab
-                pltpu.VMEM((r, slab), jnp.int32),  # cx slab
-                pltpu.VMEM((r, slab), jnp.int32),  # core slab
-                pltpu.SemaphoreType.DMA((d + 3, r)),
-            ],
-        ),
-        out_shape=jax.ShapeDtypeStruct((nb, t), jnp.int32),
+        grid=(nb, nsub),
+        in_specs=[
+            *blocked_specs,
+            _block_spec(TSUB),  # cx blocked
+            *[_slab_spec(slab) for _ in range(d + 3)],
+        ],
+        out_specs=_block_spec(TSUB),
+        out_shape=jax.ShapeDtypeStruct((rows, 1, TSUB), jnp.int32),
         interpret=_interpret(),
-    )(ss, *blocked_args, cx32.reshape(nb, t), *planes, m32, cx32, core32)
+    )(
+        *blocked_args,
+        cx32.reshape(rows, 1, TSUB),
+        *plane_slabs,
+        mask_slab,
+        _gather_slabs(cx32, ss, slab),
+        _gather_slabs(core32, ss, slab),
+    )
 
     return counts, core, bits.reshape(-1)

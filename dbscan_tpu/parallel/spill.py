@@ -183,6 +183,15 @@ def _chords(sub, vecs: np.ndarray) -> np.ndarray:
     return d
 
 
+def _chords_of(rows: np.ndarray, vecs: np.ndarray) -> np.ndarray:
+    """Same chord math over raw unit-row blocks (the greedy-leader path
+    slices node arrays directly instead of materializing sub-ops)."""
+    d = 2.0 - 2.0 * (rows @ vecs.T)
+    np.clip(d, 0.0, None, out=d)
+    np.sqrt(d, out=d)
+    return d
+
+
 def _farthest_pivots(sub, m: int, rng) -> np.ndarray:
     """Greedy max-min (farthest-point) pivot VECTORS: start random, then
     repeatedly take the point farthest from the chosen set. Keeps pivots
@@ -284,23 +293,15 @@ def _greedy_leaders(sub: "_DenseOps", t: float, rng):
         start = nb  # pre-batch leaders already filtered via d above
         for i in unc:  # sequential: each may cover later candidates
             v = vb[i]
-            if nb >= _LEADER_CAP:
-                return None
             if nb > start:
                 dl = _chords_of(v[None, :], buf[start:nb])[0]
                 if float(dl.min()) <= t:
                     continue
+            if nb >= _LEADER_CAP:  # only an actual append can overflow
+                return None
             buf[nb] = v
             nb += 1
     return buf[:nb].copy()
-
-
-def _chords_of(rows: np.ndarray, vecs: np.ndarray) -> np.ndarray:
-    """[len(rows), len(vecs)] chord distances between unit-row blocks."""
-    d = 2.0 - 2.0 * (rows @ vecs.T)
-    np.clip(d, 0.0, None, out=d)
-    np.sqrt(d, out=d)
-    return d
 
 
 def leader_components(sub: "_DenseOps", halo: float, rng):
