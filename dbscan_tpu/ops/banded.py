@@ -70,9 +70,29 @@ _BLOCK_BATCH_ELEMS = 1 << 28
 
 def _block_batch(slab: int, n_planes: int = 2) -> int:
     # the fused tile transients scale with the coordinate plane count
-    # (2 planar, 3 spherical-chord): halve the batch at D == 3
-    per_block = BANDED_BLOCK * BANDED_ROWS * slab * max(1, n_planes - 1)
+    # (2 planar, 3 spherical-chord): halve the batch at D == 3. The
+    # per-step transient is one SLAB CHUNK, not the full slab.
+    sc = slab // _slab_chunks(slab)
+    per_block = BANDED_BLOCK * BANDED_ROWS * sc * max(1, n_planes - 1)
     return max(1, min(32, _BLOCK_BATCH_ELEMS // per_block))
+
+
+# Per-op slab chunk target: a [T, R, S] f32 tile at S ~ 200k is ~2 GB
+# PER BUFFER — at the TPU runtime's per-buffer ceiling and, together
+# with the pipeline's resident arrays, past the chip's HBM. Sweeps
+# consume the slab in <= ~49k-wide chunks (ladder widths are 3*2^k, so
+# a small integer divisor always exists), accumulating counts / OR-ing
+# bits — bit-identical, bounded transients at any slab width.
+_SLAB_CHUNK_TARGET = 49152
+
+
+def _slab_chunks(slab: int) -> int:
+    m = 1
+    while slab % m or slab // m > _SLAB_CHUNK_TARGET:
+        m += 1
+        if m > slab:
+            return 1
+    return m
 
 
 def _tile_machinery(points, mask, rel_starts, spans, slab_starts, eps, slab):
@@ -87,7 +107,8 @@ def _tile_machinery(points, mask, rel_starts, spans, slab_starts, eps, slab):
     rel_starts = rel_starts.astype(jnp.int32)
     spans = spans.astype(jnp.int32)
     eps2 = jnp.asarray(eps, dtype=points.dtype) ** 2
-    offs = jnp.arange(slab, dtype=jnp.int32)
+    sc = slab // _slab_chunks(slab)
+    offs = jnp.arange(sc, dtype=jnp.int32)
     # Coordinate planes: slicing [..., D]-shaped rows would pad the minor
     # dim to the 128-lane tile on TPU; [B] planes slice cleanly. D is 2 for
     # planar runs, 3 for spherical-chord runs (ops/sphere.py) — the
@@ -102,30 +123,35 @@ def _tile_machinery(points, mask, rel_starts, spans, slab_starts, eps, slab):
         slab_starts,
     )
 
-    def slabs_of(plane, origins):
-        """[B] plane, [R] origins -> [R, S] slab rows (contiguous slices)."""
+    def slabs_of(plane, origins, c0):
+        """[B] plane, [R] origins, chunk offset -> [R, SC] slab-chunk
+        rows (contiguous slices)."""
         return jnp.stack(
             [
-                lax.dynamic_slice(plane, (origins[k],), (slab,))
+                lax.dynamic_slice(plane, (origins[k] + c0,), (sc,))
                 for k in range(BANDED_ROWS)
             ]
         )
 
-    def tile_adj(bpl, bm, brel, bspan, borig):
-        """The fused [T, R, S] adjacency tile of one block (never stored
-        across sweeps — recomputed wherever it is consumed)."""
+    def tile_adj(bpl, bm, brel, bspan, borig, c0):
+        """The fused [T, R, SC] adjacency tile of one block's slab chunk
+        (never stored across sweeps — recomputed wherever consumed).
+        ``offs + c0`` are slab-relative positions, the frame of the run
+        tables, so a run spanning chunks contributes exactly its
+        per-chunk segments."""
+        co = offs + c0
         d2 = None
         for pl, bp in zip(planes, bpl):
-            sl = slabs_of(pl, borig)  # [R, S]
-            df = bp[:, None, None] - sl[None, :, :]  # [T, R, S]
+            sl = slabs_of(pl, borig, c0)  # [R, SC]
+            df = bp[:, None, None] - sl[None, :, :]  # [T, R, SC]
             d2 = df * df if d2 is None else d2 + df * df
-        sm = slabs_of(mask, borig)
-        inrun = (offs[None, None, :] >= brel[:, :, None]) & (
-            offs[None, None, :] < (brel + bspan)[:, :, None]
+        sm = slabs_of(mask, borig, c0)
+        inrun = (co[None, None, :] >= brel[:, :, None]) & (
+            co[None, None, :] < (brel + bspan)[:, :, None]
         )
         return inrun & sm[None, :, :] & (d2 <= eps2) & bm[:, None, None]
 
-    return blocks, slabs_of, tile_adj, nb
+    return blocks, slabs_of, tile_adj, nb, _slab_chunks(slab), sc
 
 
 @functools.partial(jax.jit, static_argnames=("min_points", "slab"))
@@ -165,13 +191,22 @@ def banded_phase1(
     rows' bits drive the border algebra — min seed over set bits — so no
     third sweep is needed (dbscan_tpu/parallel/cellgraph.py).
     """
-    blocks, slabs_of, tile_adj, nb = _tile_machinery(
+    blocks, slabs_of, tile_adj, nb, n_chunks, sc = _tile_machinery(
         points, mask, rel_starts, spans, slab_starts, eps, slab
     )
     batch = _block_batch(slab, points.shape[1])
+    t = BANDED_BLOCK
 
     def count_block(args):
-        return jnp.sum(tile_adj(*args), axis=(1, 2), dtype=jnp.int32)
+        def one_chunk(ci, acc):
+            return acc + jnp.sum(
+                tile_adj(*args, ci * sc), axis=(1, 2), dtype=jnp.int32
+            )
+        if n_chunks == 1:
+            return one_chunk(0, jnp.zeros((t,), jnp.int32))
+        return lax.fori_loop(
+            0, n_chunks, one_chunk, jnp.zeros((t,), jnp.int32)
+        )
 
     counts = lax.map(count_block, blocks, batch_size=batch).reshape(-1)
     core = (counts >= jnp.int32(min_points)) & mask
@@ -180,19 +215,31 @@ def banded_phase1(
 
     def bits_block(args):
         bpl, bm, brel, bspan, borig, bcx = args
-        adj = tile_adj(bpl, bm, brel, bspan, borig)
-        score = slabs_of(core, borig)  # [R, S] col core mask
-        adj_cc = adj & score[None, :, :]
-        scx = slabs_of(cx, borig)  # [R, S] col cell columns
-        # Window column slot of each candidate: 0..4 whenever adj is true
-        # (the run covers exactly cx-2..cx+2 of the row's window); the
-        # clip only disciplines junk at adj-false entries before the shift.
-        dxm = scx[None, :, :] - bcx[:, None, None] + 2
-        krow = jnp.arange(BANDED_ROWS, dtype=jnp.int32)[None, :, None]
-        shift = jnp.clip(krow * 5 + dxm, 0, BANDED_WIN - 1)
-        contrib = jnp.where(adj_cc, jnp.int32(1) << shift, jnp.int32(0))
-        return lax.reduce(
-            contrib, jnp.int32(0), lax.bitwise_or, (1, 2)
+
+        def one_chunk(ci, acc):
+            c0 = ci * sc
+            adj = tile_adj(bpl, bm, brel, bspan, borig, c0)
+            score = slabs_of(core, borig, c0)  # [R, SC] col core mask
+            adj_cc = adj & score[None, :, :]
+            scx = slabs_of(cx, borig, c0)  # [R, SC] col cell columns
+            # Window column slot of each candidate: 0..4 whenever adj is
+            # true (the run covers exactly cx-2..cx+2 of the row's
+            # window); the clip only disciplines junk at adj-false
+            # entries before the shift.
+            dxm = scx[None, :, :] - bcx[:, None, None] + 2
+            krow = jnp.arange(BANDED_ROWS, dtype=jnp.int32)[None, :, None]
+            shift = jnp.clip(krow * 5 + dxm, 0, BANDED_WIN - 1)
+            contrib = jnp.where(
+                adj_cc, jnp.int32(1) << shift, jnp.int32(0)
+            )
+            return acc | lax.reduce(
+                contrib, jnp.int32(0), lax.bitwise_or, (1, 2)
+            )
+
+        if n_chunks == 1:
+            return one_chunk(0, jnp.zeros((t,), jnp.int32))
+        return lax.fori_loop(
+            0, n_chunks, one_chunk, jnp.zeros((t,), jnp.int32)
         )
 
     bits = lax.map(

@@ -358,6 +358,18 @@ BANDED_WIN = BANDED_ROWS * BANDED_ROWS
 # dense engine's whole single-launch runtime (~0.7s vs ~1.4-2.4s at
 # 12k-32k widths) even though dense iterates its label propagation.
 DENSE_MAX_BUCKET = 65536
+# Spatial-path routing threshold, deliberately BELOW the hard width
+# limit: a dense bucket between these widths is payable alone (a 49152
+# tile is ~10 GB), but not alongside a banded pipeline's resident
+# buffers on the same 16 GB chip — observed as TPU worker death at 100M
+# points, where un-splittable single-cell pileups produce exactly such
+# buckets next to hundreds of banded groups. Banded handles these
+# widths at parity (measured 3.05 s banded vs 3.15 s dense-era routing
+# at 1M/maxpp 32768), so spatial workloads route them banded. Paths
+# with no spatial decomposition (cosine leaves, force-dense) still use
+# the full DENSE_MAX_BUCKET limit — they run without a banded pipeline
+# beside them.
+BANDED_ROUTE_BUCKET = 32768
 
 # Rows per block-slab tile in the banded engine; banded bucket widths are
 # padded to a multiple of this. Bigger blocks amortize the per-slab DMA
@@ -419,7 +431,7 @@ def bucketize_banded(
     by blocks of BANDED_BLOCK consecutive rows: the per-(block, row) union
     of runs is the contiguous SLAB the device fetches with one
     dynamic_slice; the static slab bound S is the padded max slab length.
-    Partitions below DENSE_MAX_BUCKET (unless ``force``) fall back to
+    Partitions below BANDED_ROUTE_BUCKET (unless ``force``) fall back to
     dense groups.
 
     Also numbers every occupied (partition, cell) pair globally and builds
@@ -462,7 +474,7 @@ def bucketize_banded(
     )
     widths_band_all = (widths_b + BANDED_BLOCK - 1) // BANDED_BLOCK * BANDED_BLOCK
     if m_tot == 0 or not (
-        force or bool((widths_band_all >= DENSE_MAX_BUCKET).any())
+        force or bool((widths_band_all >= BANDED_ROUTE_BUCKET).any())
     ):
         # nothing will route banded: skip the whole fine-grid pass
         groups, max_b = bucketize_grouped(
@@ -658,7 +670,7 @@ def bucketize_banded(
     part_of_bkey = np.repeat(np.arange(n_parts), maxnb)
     sstart = np.clip(bmin, 0, (widths_band - win)[part_of_bkey][:, None])
 
-    use_banded = (counts > 0) & (force | (widths_band >= DENSE_MAX_BUCKET))
+    use_banded = (counts > 0) & (force | (widths_band >= BANDED_ROUTE_BUCKET))
 
     # run tables ship as uint16 whenever every slab bound fits (starts are
     # slab-relative < S, spans <= S): half the largest host->device upload;

@@ -54,8 +54,26 @@ from dbscan_tpu.parallel.mesh import PARTS_AXIS, mesh_size
 
 logger = logging.getLogger(__name__)
 
-# Widest bucket the dense engine may materialize, aligned with the banded
-# routing threshold (binning.DENSE_MAX_BUCKET): a [B, B] f32 measure matrix
+# Slot budget per compact-postpass chunk: the postpass flat-concatenates
+# its groups' [P, B] buffers, and any single device buffer must stay
+# under 2^31 bytes (TPU runtime per-buffer limit; the int32 bits array
+# is 4 bytes/slot). 2^28 slots = 1 GB of bits, half the hard ceiling.
+_COMPACT_CHUNK_SLOTS = 1 << 28
+# Dispatched-but-unretired slot budget (dispatch backpressure): queued
+# programs pin ~25 B of input per padded slot in HBM; 2^27 slots keeps
+# the input window ~3 GB, leaving room for the resident phase-1 outputs
+# (5 B/slot across ALL groups) and postpass transients on a 16 GB chip.
+# Env-overridable for debugging (1 = fully synchronous dispatch, so a
+# device fault raises at the offending group's dispatch site).
+import os as _os
+
+_INFLIGHT_SLOTS = int(
+    _os.environ.get("DBSCAN_INFLIGHT_SLOTS", str(1 << 27))
+)
+
+# Widest bucket the dense engine may materialize
+# (binning.DENSE_MAX_BUCKET — NOT the spatial routing threshold, which is
+# the deliberately lower binning.BANDED_ROUTE_BUCKET): a [B, B] f32 measure matrix
 # no longer fits a v5e chip's HBM at B = 65536 (17 GiB), and euclidean
 # workloads at or past that width route to the banded engine instead. So a
 # dense bucket REACHING this width means a path with no spatial
@@ -207,7 +225,10 @@ def _compiled_banded_p1(
         ncore = jnp.sum(core, dtype=jnp.int32)
         if mesh is not None:
             ncore = lax.psum(ncore, PARTS_AXIS)
-        return counts, core, bits, ncore
+        # counts are consumed on-device (core = counts >= minPts) and
+        # nothing downstream reads them — returning them would pin
+        # 4 B/slot of HBM across every banded group until the postpass
+        return core, bits, ncore
 
     if mesh is None:
         return jax.jit(block)
@@ -217,7 +238,7 @@ def _compiled_banded_p1(
             block,
             mesh=mesh,
             in_specs=(spec,) * 6,
-            out_specs=(spec, spec, spec, PartitionSpec()),
+            out_specs=(spec, spec, PartitionSpec()),
             # pallas_call's out_shape carries no varying-mesh-axes
             # annotation, so the vma checker rejects it under shard_map;
             # the XLA path keeps the check
@@ -286,9 +307,18 @@ def _dispatch_partitions(
 
 
 def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh, kernel_eps=None):
-    """Async phase-1 dispatch for one banded group: (counts, core, bits).
-    kernel_eps overrides cfg.eps when the payload is chord coordinates."""
+    """Async phase-1 dispatch for one banded group: (core, bits, ncore)
+    — per-slot counts are consumed on-device and deliberately not
+    returned (they would pin 4 B/slot across every group, see
+    _compiled_banded_p1). kernel_eps overrides cfg.eps when the payload
+    is chord coordinates."""
     ext = group.banded
+    logger.debug(
+        "banded group dispatch: points %s slab %d batch %s",
+        group.points.shape,
+        int(ext.slab),
+        _banded_batch(group, mesh),
+    )
     fn = _compiled_banded_p1(
         float(kernel_eps if kernel_eps is not None else cfg.eps),
         int(cfg.min_points),
@@ -895,15 +925,31 @@ def train_arrays(
     # packer instead of serializing behind it.
     pending = []
     dispatch_spent = [0.0]
+    # Dispatch backpressure: every queued-but-unexecuted program pins its
+    # input buffers (points/mask/run tables, ~25 B per padded slot) in
+    # HBM, so letting the packer run arbitrarily far ahead of the device
+    # exhausts the 16 GB chip at ~300M slots (observed: the TPU worker
+    # dies outright at 100M points, any maxpp). Track dispatched-not-yet-
+    # retired slots and block on the OLDEST group's output once the
+    # window exceeds the budget — the sliding window keeps pack/compute
+    # overlap while bounding residency.
+    inflight: list = []  # (slots, output leaf to block on)
+    inflight_slots = [0]
 
     def _on_group(g):
         td = time.perf_counter()
         if g.banded is None:
-            pending.append(
-                (g, _dispatch_partitions(g, cfg, mesh, kernel_eps, kernel_metric))
-            )
+            out = _dispatch_partitions(g, cfg, mesh, kernel_eps, kernel_metric)
         else:
-            pending.append((g, _dispatch_banded_p1(g, cfg, mesh, kernel_eps)))
+            out = _dispatch_banded_p1(g, cfg, mesh, kernel_eps)
+        pending.append((g, out))
+        sz = g.mask.shape[0] * g.mask.shape[1]
+        inflight.append((sz, out[0]))
+        inflight_slots[0] += sz
+        while len(inflight) > 1 and inflight_slots[0] > _INFLIGHT_SLOTS:
+            osz, oout = inflight.pop(0)
+            jax.block_until_ready(oout)
+            inflight_slots[0] -= osz
         dispatch_spent[0] += time.perf_counter() - td
 
     cellmeta = None
@@ -960,30 +1006,47 @@ def train_arrays(
     # multi-chip runs keep the ~16x pull reduction instead of falling back
     # to full [P, B] pulls (VERDICT r1 item 4).
     compact = None
-    if cellmeta is not None:
+    if cellmeta is not None and _os.environ.get("DBSCAN_NO_COMPACT") != "1":
         b_idx = [i for i, (g, _) in enumerate(pending) if g.banded is not None]
         if b_idx:
             from dbscan_tpu.ops.banded import banded_postpass, gather_flat
 
-            bgroups = [pending[i][0] for i in b_idx]
-            # _pad_idx ships int32 gather indices: past 2^31 flat slots they
-            # would wrap silently, so such runs (~1B+ points in banded
-            # groups) take the full-pull path below instead — checked from
-            # the buffer shapes BEFORE paying for the layout build
-            n_slots = sum(
-                pending[i][0].mask.shape[0] * pending[i][0].mask.shape[1]
-                for i in b_idx
-            )
-        if b_idx and n_slots < 2**31:
-            layout = cellgraph.cell_layout(bgroups)
-            combo_dev, bits_flat = banded_postpass(
-                tuple(pending[i][1][1] for i in b_idx),
-                tuple(pending[i][1][2] for i in b_idx),
-                tuple(jnp.asarray(f) for f in layout["segflags"]),
-                jnp.asarray(_pad_idx(layout["or_pos"])),
-            )
-            combo_dev.copy_to_host_async()
-            compact = (b_idx, bgroups, layout, combo_dev, bits_flat)
+            # The postpass concatenates its groups into flat [M]-slot
+            # device arrays; a single buffer must stay under 2^31 BYTES
+            # (the TPU runtime's per-buffer addressing limit — exceeding
+            # it kills the worker outright, observed at ~500M slots where
+            # the int32 bits_flat crosses 2 GB). Chunk the groups so each
+            # chunk's slot total fits, run the postpass per chunk, and
+            # merge the pulled artifacts host-side with rebased layout
+            # offsets — finalize_compact is global-cell-id based and a
+            # partition lives in exactly one group, so no cell edge
+            # crosses chunks and one merged finalize is exact. Per-chunk
+            # int32 gather indices (_pad_idx) are safe by the same cap.
+            cap = _COMPACT_CHUNK_SLOTS
+            chunks: list = []
+            cur: list = []
+            cur_slots = 0
+            for i in b_idx:
+                sz = pending[i][0].mask.shape[0] * pending[i][0].mask.shape[1]
+                if cur and cur_slots + sz > cap:
+                    chunks.append(cur)
+                    cur, cur_slots = [], 0
+                cur.append(i)
+                cur_slots += sz
+            if cur:
+                chunks.append(cur)
+            compact = []
+            for ch in chunks:
+                ch_groups = [pending[i][0] for i in ch]
+                layout = cellgraph.cell_layout(ch_groups)
+                combo_dev, bits_flat = banded_postpass(
+                    tuple(pending[i][1][0] for i in ch),
+                    tuple(pending[i][1][1] for i in ch),
+                    tuple(jnp.asarray(f) for f in layout["segflags"]),
+                    jnp.asarray(_pad_idx(layout["or_pos"])),
+                )
+                combo_dev.copy_to_host_async()
+                compact.append((ch, ch_groups, layout, combo_dev, bits_flat))
     t0 = _mark("postdispatch_s", t0)
 
     def _slotmap(g):
@@ -1063,40 +1126,97 @@ def train_arrays(
     # cell-graph components, seeds, and the full border algebra — the
     # reference's driver-side graph pass (DBSCANGraph.scala:70-87)
     # transplanted to per-partition scale (parallel/cellgraph.py)
-    if compact is not None:
-        b_idx, bgroups, layout, combo_dev, bits_flat = compact
-        total = layout["total"]
+    if compact:
+        # Pull each chunk's combo, then merge into ONE flat space (chunk
+        # bases stack in order) so the per-group label algebra runs once:
+        # group-local ``starts`` need no rebase, ``bases``/``or_starts``/
+        # border positions shift by the running chunk offsets.
         tc = time.perf_counter()
-        combo_host = np.asarray(combo_dev)
-        tc = _mark("cellcc_pull_core_s", tc)
-        core_flat = np.unpackbits(
-            combo_host[: total // 8], count=total
-        ).astype(bool)
-        or_vals = combo_host[total // 8 :].view("<i4")[: len(layout["or_pos"])]
-        border_pos = np.flatnonzero(layout["validflat"] & ~core_flat)
-        bbits_dev = gather_flat(bits_flat, jnp.asarray(_pad_idx(border_pos)))
-        bbits_dev.copy_to_host_async()
-        tc = _mark("cellcc_borderidx_s", tc)
-        border_bits = np.asarray(bbits_dev)[: len(border_pos)]
+        m_bidx: list = []
+        m_groups: list = []
+        m_starts: list = []
+        m_bases: list = []
+        m_orgid: list = []
+        m_orstarts: list = []
+        core_l, orv_l = [], []
+        bpos_l, bbits_pend = [], []
+        base_off = 0
+        or_off = 0
+        t_borderidx = 0.0
+        for ch, ch_groups, layout, combo_dev, bits_flat in compact:
+            total = layout["total"]
+            combo_host = np.asarray(combo_dev)
+            core_ch = np.unpackbits(
+                combo_host[: total // 8], count=total
+            ).astype(bool)
+            tb = time.perf_counter()
+            orv_l.append(
+                combo_host[total // 8 :].view("<i4")[
+                    : len(layout["or_pos"])
+                ]
+            )
+            bpos_ch = np.flatnonzero(layout["validflat"] & ~core_ch)
+            bbits_dev = gather_flat(
+                bits_flat, jnp.asarray(_pad_idx(bpos_ch))
+            )
+            bbits_dev.copy_to_host_async()
+            t_borderidx += time.perf_counter() - tb
+            core_l.append(core_ch)
+            bpos_l.append(bpos_ch + base_off)
+            bbits_pend.append((bbits_dev, len(bpos_ch)))
+            m_bidx.extend(ch)
+            m_groups.extend(ch_groups)
+            m_starts.extend(layout["starts"])
+            m_bases.extend(b + base_off for b in layout["bases"])
+            m_orgid.append(layout["or_gid"])
+            m_orstarts.append(layout["or_starts"] + or_off)
+            base_off += total
+            or_off += len(layout["or_pos"])
+        core_flat = (
+            np.concatenate(core_l) if len(core_l) > 1 else core_l[0]
+        )
+        or_vals = np.concatenate(orv_l) if len(orv_l) > 1 else orv_l[0]
+        border_pos = (
+            np.concatenate(bpos_l) if len(bpos_l) > 1 else bpos_l[0]
+        )
+        m_layout = {
+            "starts": m_starts,
+            "bases": m_bases,
+            "total": base_off,
+            "or_gid": np.concatenate(m_orgid),
+            "or_starts": np.concatenate(m_orstarts),
+        }
+        # keep the phase timings disjoint: the loop above interleaves
+        # combo pulls with the border-index segments reported separately
+        timings["cellcc_pull_core_s"] = round(
+            time.perf_counter() - tc - t_borderidx, 6
+        )
+        timings["cellcc_borderidx_s"] = round(t_borderidx, 6)
+        tc = time.perf_counter()
+        border_bits = np.concatenate(
+            [np.asarray(d)[:k] for d, k in bbits_pend]
+        )
         tc = _mark("cellcc_pull_rest_s", tc)
         finalized = cellgraph.finalize_compact(
-            bgroups, layout, cellmeta, cfg.engine.value, core_flat,
+            m_groups, m_layout, cellmeta, cfg.engine.value, core_flat,
             or_vals, border_pos, border_bits,
         )
         _mark("cellcc_host_s", tc)
-        for i, (seeds_np, flags_np) in zip(b_idx, finalized):
+        for i, (seeds_np, flags_np) in zip(m_bidx, finalized):
             g = pending[i][0]
             pending[i] = (
                 g, (seeds_np, flags_np, int((flags_np == CORE).sum()))
             )
     elif cellmeta is not None:
         b_idx = [i for i, (g, _) in enumerate(pending) if g.banded is not None]
-        if b_idx:  # >=2^31-flat-slot runs only: full [P, B] pulls
+        if b_idx:  # DBSCAN_NO_COMPACT=1 debug runs only: full [P, B]
+            # pulls (every size goes through the chunked compact path
+            # otherwise)
             p1_np = [
                 (
                     pending[i][0],
+                    np.asarray(pending[i][1][0]),
                     np.asarray(pending[i][1][1]),
-                    np.asarray(pending[i][1][2]),
                 )
                 for i in b_idx
             ]

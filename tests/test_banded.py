@@ -184,3 +184,69 @@ def test_packer_invariants(rng):
     core_full[g.point_idx[0][valid]] = core[valid]
     has_core_nbr = (d2 <= 0.3 * 0.3) @ core_full > 0
     assert ((full[sub] != 0) == has_core_nbr).all()
+
+
+def test_compact_postpass_chunking_matches_single_chunk(rng, monkeypatch):
+    """The compact postpass splits its groups into slot-budgeted chunks
+    (single device buffers are capped at 2^31 bytes on TPU); a tiny cap
+    forcing many chunks must reproduce the one-chunk labels exactly —
+    the host-side layout merge is bit-transparent."""
+    from dbscan_tpu.parallel import driver
+
+    pts = np.concatenate(
+        [rng.normal(c, 0.5, (2500, 2)) for c in [(0, 0), (7, 7), (-6, 8), (8, -7)]]
+        + [rng.uniform(-10, 12, (1000, 2))]
+    )
+    kw = dict(
+        eps=0.35,
+        min_points=8,
+        max_points_per_partition=2048,
+        engine=Engine.ARCHERY,
+        neighbor_backend="banded",
+    )
+    ref = train(pts, **kw)
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 4096)  # many chunks
+    chunked = train(pts, **kw)
+    np.testing.assert_array_equal(ref.clusters, chunked.clusters)
+    np.testing.assert_array_equal(ref.flags, chunked.flags)
+    assert chunked.stats["n_banded_groups"] >= 2  # several groups split up
+
+
+def test_slab_chunked_sweeps_match_unchunked(rng, monkeypatch):
+    """Wide slabs are consumed in bounded chunks (transients at ~200k-wide
+    slabs hit the TPU per-buffer ceiling); a tiny chunk target must
+    reproduce the unchunked labels bit-for-bit, including runs that span
+    chunk boundaries."""
+    from dbscan_tpu.ops import banded as banded_mod
+
+    pts = np.concatenate(
+        [rng.normal(c, 0.4, (3000, 2)) for c in [(0, 0), (4, 4)]]
+        + [rng.uniform(-3, 7, (800, 2))]
+    )
+    kw = dict(
+        eps=0.35,
+        min_points=8,
+        max_points_per_partition=8192,
+        engine=Engine.ARCHERY,
+        neighbor_backend="banded",
+    )
+    from dbscan_tpu.parallel import driver as driver_mod
+
+    import jax
+
+    ref = train(pts, **kw)
+    # Both cache layers would replay the unchunked program: the driver's
+    # lru-cached executors AND banded_phase1's own jax.jit trace cache
+    # (same shapes + static slab -> cache hit even through a fresh
+    # driver executor). Clear everything so the monkeypatched target is
+    # actually read at retrace.
+    monkeypatch.setattr(banded_mod, "_SLAB_CHUNK_TARGET", 128)
+    driver_mod.clear_compile_cache()
+    jax.clear_caches()
+    try:
+        chunked = train(pts, **kw)
+    finally:
+        driver_mod.clear_compile_cache()
+        jax.clear_caches()
+    np.testing.assert_array_equal(ref.clusters, chunked.clusters)
+    np.testing.assert_array_equal(ref.flags, chunked.flags)
