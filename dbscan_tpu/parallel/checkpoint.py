@@ -129,3 +129,82 @@ def load_premerge(ckpt_dir: str, fingerprint: str) -> Optional[dict]:
     ):
         return None
     return {"arrays": arrays, "scalars": man["scalars"]}
+
+
+# --- phase-1 chunk checkpoints (resumable device phase) ---------------
+#
+# The tunneled TPU worker can die mid-run (observed: consistently after
+# ~15-25 min of continuous device work at 100M points), and the premerge
+# checkpoint above only exists once EVERY group's device work finished.
+# These per-chunk artifacts close that gap: the driver's eager compact
+# path saves each chunk's pulled postpass output (packed core bits +
+# or-values + border bitmasks — a few dozen MB per ~2^28-slot chunk) as
+# it lands, and a resumed run re-packs (deterministic), skips device
+# dispatch for groups covered by saved chunks, and recomputes only the
+# groups after the last saved chunk. This is the elastic-recovery story
+# the reference delegates wholesale to Spark lineage (DBSCAN.scala:59-60)
+# — except a replay here is a file read, not a recompute.
+
+_P1_PREFIX = "p1chunk"
+
+
+def _p1_path(ckpt_dir: str, ci: int) -> str:
+    return os.path.join(ckpt_dir, f"{_P1_PREFIX}{ci:04d}.npz")
+
+
+def save_p1_chunk(
+    ckpt_dir: str,
+    fingerprint: str,
+    ci: int,
+    sig: str,
+    shapes: np.ndarray,
+    arrays: dict,
+) -> None:
+    """Atomically persist one pulled compact chunk. ``sig`` digests the
+    chunk's group composition; ``shapes`` is [n_groups, 3] int64
+    (P, B, slab) — the loader exposes it so the resuming driver can skip
+    matching group dispatches BEFORE the chunk re-forms."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = _p1_path(ckpt_dir, ci)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(
+            f,
+            _fingerprint=np.array(fingerprint),
+            _sig=np.array(sig),
+            _shapes=shapes,
+            **arrays,
+        )
+    os.replace(tmp, path)
+
+
+def load_p1_chunks(ckpt_dir: str, fingerprint: str) -> list:
+    """Load the consecutive prefix of saved chunks matching
+    ``fingerprint`` (chunk ci is only usable if every chunk before it
+    loaded — the driver skips dispatches in emission order). Returns a
+    list of dicts {sig, shapes, arrays}; empty on any mismatch."""
+    out = []
+    ci = 0
+    while True:
+        path = _p1_path(ckpt_dir, ci)
+        if not os.path.exists(path):
+            break
+        try:
+            with np.load(path) as z:
+                if str(z["_fingerprint"]) != fingerprint:
+                    break
+                out.append(
+                    {
+                        "sig": str(z["_sig"]),
+                        "shapes": z["_shapes"],
+                        "arrays": {
+                            k: z[k]
+                            for k in z.files
+                            if not k.startswith("_")
+                        },
+                    }
+                )
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+            break
+        ci += 1
+    return out

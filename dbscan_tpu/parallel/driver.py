@@ -33,6 +33,7 @@ fixes):
 from __future__ import annotations
 
 import functools
+import hashlib
 import logging
 import time
 from typing import List, NamedTuple, Optional, Tuple
@@ -936,20 +937,187 @@ def train_arrays(
     inflight: list = []  # (slots, output leaf to block on)
     inflight_slots = [0]
 
+    # Eager compact chunking (+ the resumable device phase): banded
+    # groups accumulate into slot-budgeted chunks AS THEY PACK; when a
+    # chunk fills, its postpass dispatches immediately and the PREVIOUS
+    # chunk is pulled (one-chunk pipeline: that pull has the newer
+    # chunk's phase-1 window executing behind it). Each pulled chunk is
+    # a few dozen MB of final artifacts — with a checkpoint_dir they
+    # persist at once, so a mid-device-phase worker death (observed on
+    # the tunneled TPU after ~15-25 min of continuous work) costs at
+    # most one chunk of recompute: the resumed run re-packs
+    # (deterministic), skips dispatch for groups covered by saved
+    # chunks, and picks up where the chunks stop. cell_layout needs only
+    # per-group tables, so none of this waits for packing to finish.
+    compact_on = (
+        use_banded and _os.environ.get("DBSCAN_NO_COMPACT") != "1"
+    )
+    if compact_on:
+        from dbscan_tpu.ops.banded import banded_postpass, gather_flat
+    eager = {
+        "cur": [],  # pending indices of the open chunk's banded groups
+        "cur_slots": 0,
+        "cur_ord0": 0,  # banded ordinal of the open chunk's first group
+        "records": [],  # per-chunk dicts (live or checkpoint-loaded)
+        "b_ord": 0,  # banded-group emission ordinal
+        "pull_spent": 0.0,
+    }
+    p1_loaded: list = []
+    p1_exp: list = []  # (chunk idx, (P, B, slab)) per banded ordinal
+    if compact_on and ckpt_fp is not None:
+        from dbscan_tpu.parallel import checkpoint as _ckpt_p1
+
+        p1_loaded = _ckpt_p1.load_p1_chunks(checkpoint_dir, ckpt_fp)
+        for lci, lc in enumerate(p1_loaded):
+            for row in lc["shapes"]:
+                p1_exp.append((lci, tuple(int(v) for v in row)))
+
+    def _chunk_sig(ch, ord0):
+        # salted with the chunk's starting banded ordinal: shapes are
+        # ladder-quantized (repeats are common), so a budget change
+        # shifting chunk boundaries could otherwise re-form a
+        # shape-identical chunk over DIFFERENT groups and silently apply
+        # the wrong saved results
+        h = hashlib.sha256()
+        h.update(f"ord{ord0}|".encode())
+        for i in ch:
+            g = pending[i][0]
+            h.update(
+                f"{g.points.shape}|{int(g.banded.slab)}|".encode()
+            )
+        return h.hexdigest()
+
+    def _redispatch(i):
+        """Re-dispatch a group whose checkpoint skip turned out invalid
+        (chunk composition diverged — e.g. a changed chunk budget)."""
+        g = pending[i][0]
+        out = _dispatch_banded_p1(g, cfg, mesh, kernel_eps)
+        pending[i] = (g, out)
+        jax.block_until_ready(out[0])
+
+    def _pull_record(rec):
+        """Block on a live chunk's postpass, compute its border gather,
+        and (with a checkpoint_dir) persist the artifacts."""
+        if "combo_host" in rec:
+            return
+        tp = time.perf_counter()
+        layout = rec["layout"]
+        total = layout["total"]
+        combo_host = np.asarray(rec.pop("combo_dev"))
+        core_ch = np.unpackbits(
+            combo_host[: total // 8], count=total
+        ).astype(bool)
+        bpos = np.flatnonzero(layout["validflat"] & ~core_ch)
+        bb_dev = gather_flat(
+            rec.pop("bits_flat"), jnp.asarray(_pad_idx(bpos))
+        )
+        bbits = np.asarray(bb_dev)[: len(bpos)]
+        rec["combo_host"] = combo_host
+        rec["core_ch"] = core_ch
+        rec["bpos"] = bpos
+        rec["bbits"] = bbits
+        eager["pull_spent"] += time.perf_counter() - tp
+        if ckpt_fp is not None:
+            from dbscan_tpu.parallel import checkpoint as _ckpt_p1
+
+            shapes = np.array(
+                [
+                    (
+                        pending[i][0].points.shape[0],
+                        pending[i][0].points.shape[1],
+                        int(pending[i][0].banded.slab),
+                    )
+                    for i in rec["ch"]
+                ],
+                dtype=np.int64,
+            )
+            _ckpt_p1.save_p1_chunk(
+                checkpoint_dir,
+                ckpt_fp,
+                rec["ci"],
+                rec["sig"],
+                shapes,
+                {"combo": combo_host, "bbits": bbits},
+            )
+
+    def _flush_chunk():
+        ch = eager["cur"]
+        if not ch:
+            return
+        eager["cur"] = []
+        eager["cur_slots"] = 0
+        ci = len(eager["records"])
+        sig = _chunk_sig(ch, eager.get("cur_ord0", 0))
+        ch_groups = [pending[i][0] for i in ch]
+        rec = {"ch": ch, "ci": ci, "sig": sig, "groups": ch_groups}
+        skipped = [i for i in ch if pending[i][1] is None]
+        loaded = p1_loaded[ci] if ci < len(p1_loaded) else None
+        if (
+            skipped
+            and loaded is not None
+            and loaded["sig"] == sig
+            and len(skipped) == len(ch)
+        ):
+            # checkpoint hit: the chunk re-formed exactly as saved
+            rec["combo_host"] = loaded["arrays"]["combo"]
+            rec["bbits"] = loaded["arrays"]["bbits"]
+        else:
+            for i in skipped:  # divergence: recompute what was skipped
+                _redispatch(i)
+            layout = cellgraph.cell_layout(ch_groups)
+            combo_dev, bits_flat = banded_postpass(
+                tuple(pending[i][1][0] for i in ch),
+                tuple(pending[i][1][1] for i in ch),
+                tuple(jnp.asarray(f) for f in layout["segflags"]),
+                jnp.asarray(_pad_idx(layout["or_pos"])),
+            )
+            combo_dev.copy_to_host_async()
+            rec["layout"] = layout
+            rec["combo_dev"] = combo_dev
+            rec["bits_flat"] = bits_flat
+        eager["records"].append(rec)
+        if len(eager["records"]) >= 2:
+            _pull_record(eager["records"][-2])
+
     def _on_group(g):
         td = time.perf_counter()
         if g.banded is None:
             out = _dispatch_partitions(g, cfg, mesh, kernel_eps, kernel_metric)
+        elif compact_on:
+            k = eager["b_ord"]
+            eager["b_ord"] += 1
+            exp = p1_exp[k] if k < len(p1_exp) else None
+            shape = (
+                g.points.shape[0],
+                g.points.shape[1],
+                int(g.banded.slab),
+            )
+            if exp is not None and exp[1] == shape:
+                out = None  # covered by a saved chunk: skip the device
+            else:
+                out = _dispatch_banded_p1(g, cfg, mesh, kernel_eps)
         else:
             out = _dispatch_banded_p1(g, cfg, mesh, kernel_eps)
         pending.append((g, out))
-        sz = g.mask.shape[0] * g.mask.shape[1]
-        inflight.append((sz, out[0]))
-        inflight_slots[0] += sz
-        while len(inflight) > 1 and inflight_slots[0] > _INFLIGHT_SLOTS:
-            osz, oout = inflight.pop(0)
-            jax.block_until_ready(oout)
-            inflight_slots[0] -= osz
+        if out is not None:
+            sz = g.mask.shape[0] * g.mask.shape[1]
+            inflight.append((sz, out[0]))
+            inflight_slots[0] += sz
+            while len(inflight) > 1 and inflight_slots[0] > _INFLIGHT_SLOTS:
+                osz, oout = inflight.pop(0)
+                jax.block_until_ready(oout)
+                inflight_slots[0] -= osz
+        if g.banded is not None and compact_on:
+            sz_g = g.mask.shape[0] * g.mask.shape[1]
+            # close the open chunk BEFORE an overflowing group joins: the
+            # cap bounds the chunk's concatenated device buffers, so a
+            # chunk may only exceed it when a SINGLE group does
+            if eager["cur"] and eager["cur_slots"] + sz_g > _COMPACT_CHUNK_SLOTS:
+                _flush_chunk()
+            if not eager["cur"]:
+                eager["cur_ord0"] = eager["b_ord"] - 1
+            eager["cur"].append(len(pending) - 1)
+            eager["cur_slots"] += sz_g
         dispatch_spent[0] += time.perf_counter() - td
 
     cellmeta = None
@@ -979,7 +1147,9 @@ def train_arrays(
             dtype=dtype,
             on_group=_on_group,
         )
-    timings["dispatch_s"] = round(dispatch_spent[0], 6)
+    timings["dispatch_s"] = round(
+        dispatch_spent[0] - eager["pull_spent"], 6
+    )
     timings["bucketize_s"] = round(
         time.perf_counter() - t0 - dispatch_spent[0], 6
     )
@@ -1005,49 +1175,28 @@ def train_arrays(
     # only the small or_idx gather and the final combo pull cross shards —
     # multi-chip runs keep the ~16x pull reduction instead of falling back
     # to full [P, B] pulls (VERDICT r1 item 4).
-    compact = None
-    if cellmeta is not None and _os.environ.get("DBSCAN_NO_COMPACT") != "1":
-        b_idx = [i for i, (g, _) in enumerate(pending) if g.banded is not None]
-        if b_idx:
-            from dbscan_tpu.ops.banded import banded_postpass, gather_flat
-
-            # The postpass concatenates its groups into flat [M]-slot
-            # device arrays; a single buffer must stay under 2^31 BYTES
-            # (the TPU runtime's per-buffer addressing limit — exceeding
-            # it kills the worker outright, observed at ~500M slots where
-            # the int32 bits_flat crosses 2 GB). Chunk the groups so each
-            # chunk's slot total fits, run the postpass per chunk, and
-            # merge the pulled artifacts host-side with rebased layout
-            # offsets — finalize_compact is global-cell-id based and a
-            # partition lives in exactly one group, so no cell edge
-            # crosses chunks and one merged finalize is exact. Per-chunk
-            # int32 gather indices (_pad_idx) are safe by the same cap.
-            cap = _COMPACT_CHUNK_SLOTS
-            chunks: list = []
-            cur: list = []
-            cur_slots = 0
-            for i in b_idx:
-                sz = pending[i][0].mask.shape[0] * pending[i][0].mask.shape[1]
-                if cur and cur_slots + sz > cap:
-                    chunks.append(cur)
-                    cur, cur_slots = [], 0
-                cur.append(i)
-                cur_slots += sz
-            if cur:
-                chunks.append(cur)
-            compact = []
-            for ch in chunks:
-                ch_groups = [pending[i][0] for i in ch]
-                layout = cellgraph.cell_layout(ch_groups)
-                combo_dev, bits_flat = banded_postpass(
-                    tuple(pending[i][1][0] for i in ch),
-                    tuple(pending[i][1][1] for i in ch),
-                    tuple(jnp.asarray(f) for f in layout["segflags"]),
-                    jnp.asarray(_pad_idx(layout["or_pos"])),
-                )
-                combo_dev.copy_to_host_async()
-                compact.append((ch, ch_groups, layout, combo_dev, bits_flat))
+    # The postpass concatenates its groups into flat [M]-slot device
+    # arrays; a single buffer must stay under 2^31 BYTES (the TPU
+    # runtime's per-buffer addressing limit — exceeding it kills the
+    # worker outright, observed at ~500M slots where the int32 bits_flat
+    # crosses 2 GB). The eager machinery above already chunked the
+    # groups under that cap during packing; here the tail chunk flushes
+    # and the pulled artifacts get merged host-side with rebased layout
+    # offsets — finalize_compact is global-cell-id based and a partition
+    # lives in exactly one group, so no cell edge crosses chunks and one
+    # merged finalize is exact. Per-chunk int32 gather indices
+    # (_pad_idx) are safe by the same cap.
+    if compact_on and cellmeta is not None:
+        _pull_before_tail = eager["pull_spent"]
+        _flush_chunk()
+        _tail_pull = eager["pull_spent"] - _pull_before_tail
+    else:
+        _tail_pull = 0.0
+    compact = eager["records"] or None
     t0 = _mark("postdispatch_s", t0)
+    timings["postdispatch_s"] = round(
+        timings["postdispatch_s"] - _tail_pull, 6
+    )
 
     def _slotmap(g):
         # valid slots are the per-row prefix 0..count-1 (binning packers'
@@ -1127,11 +1276,16 @@ def train_arrays(
     # reference's driver-side graph pass (DBSCANGraph.scala:70-87)
     # transplanted to per-partition scale (parallel/cellgraph.py)
     if compact:
-        # Pull each chunk's combo, then merge into ONE flat space (chunk
-        # bases stack in order) so the per-group label algebra runs once:
-        # group-local ``starts`` need no rebase, ``bases``/``or_starts``/
-        # border positions shift by the running chunk offsets.
+        # Pull any chunks still on the device (the eager pipeline leaves
+        # the last one live), then merge every chunk into ONE flat space
+        # (chunk bases stack in order) so the per-group label algebra
+        # runs once: group-local ``starts`` need no rebase,
+        # ``bases``/``or_starts``/border positions shift by the running
+        # chunk offsets. Checkpoint-loaded chunks re-derive their layout
+        # and border positions from the re-packed groups + saved combo
+        # (both deterministic).
         tc = time.perf_counter()
+        pull0 = eager["pull_spent"]
         m_bidx: list = []
         m_groups: list = []
         m_starts: list = []
@@ -1139,33 +1293,36 @@ def train_arrays(
         m_orgid: list = []
         m_orstarts: list = []
         core_l, orv_l = [], []
-        bpos_l, bbits_pend = [], []
+        bpos_l, bbits_l = [], []
         base_off = 0
         or_off = 0
-        t_borderidx = 0.0
-        for ch, ch_groups, layout, combo_dev, bits_flat in compact:
+        for rec in compact:
+            _pull_record(rec)
+            layout = rec.get("layout")
+            if layout is None:  # checkpoint-loaded chunk
+                layout = cellgraph.cell_layout(rec["groups"])
             total = layout["total"]
-            combo_host = np.asarray(combo_dev)
-            core_ch = np.unpackbits(
-                combo_host[: total // 8], count=total
-            ).astype(bool)
-            tb = time.perf_counter()
+            combo_host = rec["combo_host"]
+            core_ch = rec.get("core_ch")
+            if core_ch is None:
+                core_ch = np.unpackbits(
+                    combo_host[: total // 8], count=total
+                ).astype(bool)
+            bpos_ch = rec.get("bpos")
+            if bpos_ch is None:
+                bpos_ch = np.flatnonzero(
+                    layout["validflat"] & ~core_ch
+                )
             orv_l.append(
                 combo_host[total // 8 :].view("<i4")[
                     : len(layout["or_pos"])
                 ]
             )
-            bpos_ch = np.flatnonzero(layout["validflat"] & ~core_ch)
-            bbits_dev = gather_flat(
-                bits_flat, jnp.asarray(_pad_idx(bpos_ch))
-            )
-            bbits_dev.copy_to_host_async()
-            t_borderidx += time.perf_counter() - tb
             core_l.append(core_ch)
             bpos_l.append(bpos_ch + base_off)
-            bbits_pend.append((bbits_dev, len(bpos_ch)))
-            m_bidx.extend(ch)
-            m_groups.extend(ch_groups)
+            bbits_l.append(rec["bbits"])
+            m_bidx.extend(rec["ch"])
+            m_groups.extend(rec["groups"])
             m_starts.extend(layout["starts"])
             m_bases.extend(b + base_off for b in layout["bases"])
             m_orgid.append(layout["or_gid"])
@@ -1186,15 +1343,16 @@ def train_arrays(
             "or_gid": np.concatenate(m_orgid),
             "or_starts": np.concatenate(m_orstarts),
         }
-        # keep the phase timings disjoint: the loop above interleaves
-        # combo pulls with the border-index segments reported separately
+        # pulls that happened before this loop (packing-window + tail
+        # flush, snapshotted as pull0 at loop start) are reported here —
+        # dispatch_s/postdispatch_s excluded them — and the loop's own
+        # wall already contains ITS pulls exactly once
         timings["cellcc_pull_core_s"] = round(
-            time.perf_counter() - tc - t_borderidx, 6
+            time.perf_counter() - tc + pull0, 6
         )
-        timings["cellcc_borderidx_s"] = round(t_borderidx, 6)
         tc = time.perf_counter()
-        border_bits = np.concatenate(
-            [np.asarray(d)[:k] for d, k in bbits_pend]
+        border_bits = (
+            np.concatenate(bbits_l) if len(bbits_l) > 1 else bbits_l[0]
         )
         tc = _mark("cellcc_pull_rest_s", tc)
         finalized = cellgraph.finalize_compact(

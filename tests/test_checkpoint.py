@@ -172,3 +172,96 @@ def test_checkpoint_spill_cosine(rng, tmp_path):
     assert resumed.stats["spill_tree"] is True
     assert resumed.partitions == []
     np.testing.assert_array_equal(resumed.clusters, clean.clusters)
+
+
+def _varied_blobs(rng):
+    """Blobs at very different densities: partitions land on several
+    bucket-ladder rungs, so the packer emits MULTIPLE groups (chunking
+    is group-granular — one uniform group can never split)."""
+    sizes = [80, 200, 500, 1200, 300, 900]
+    centers = [(0, 0), (8, 8), (-7, 9), (9, -8), (-9, -9), (16, 2)]
+    pts = np.concatenate(
+        [rng.normal(c, 0.4, (s, 2)) for c, s in zip(centers, sizes)]
+    )
+    rng.shuffle(pts)
+    return pts
+
+
+def test_device_phase_chunks_resume_without_redispatch(
+    rng, tmp_path, monkeypatch
+):
+    """Resumable DEVICE phase: a run killed mid-device-work leaves its
+    pulled compact chunks on disk; the resumed run re-packs, skips
+    device dispatch for every group a saved chunk covers, and produces
+    identical labels. (The premerge checkpoint only helps once ALL
+    device work finished — chunks close the gap for worker deaths
+    during it, the failure mode of the tunneled TPU at 100M points.)"""
+    pts = _varied_blobs(rng)
+    kw = dict(
+        eps=0.5, min_points=5, max_points_per_partition=256,
+        engine=Engine.ARCHERY, neighbor_backend="banded",
+    )
+    clean = train(pts, **kw)
+
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 512)  # many chunks
+    ck = tmp_path / "ck"
+    first = train(pts, checkpoint_dir=str(ck), **kw)
+    np.testing.assert_array_equal(clean.clusters, first.clusters)
+    chunk_files = sorted(ck.glob("p1chunk*.npz"))
+    assert len(chunk_files) >= 2  # the tiny budget really chunked
+
+    # simulate "killed before premerge was written": drop premerge so the
+    # resume path must come from the chunks
+    for f in ck.glob("premerge.npz"):
+        f.unlink()
+    for f in ck.glob("manifest.json"):
+        f.unlink()
+
+    calls = []
+    real = driver._dispatch_banded_p1
+
+    def counting(group, *a, **k):
+        calls.append(group.points.shape)
+        return real(group, *a, **k)
+
+    monkeypatch.setattr(driver, "_dispatch_banded_p1", counting)
+    resumed = train(pts, checkpoint_dir=str(ck), **kw)
+    np.testing.assert_array_equal(clean.clusters, resumed.clusters)
+    np.testing.assert_array_equal(clean.flags, resumed.flags)
+    assert calls == []  # every banded group came from a saved chunk
+
+    # partial coverage: drop the LAST chunk -> only its groups re-dispatch
+    # (the resumed run above wrote a fresh premerge — remove it again so
+    # this resume exercises the chunk path, not the premerge shortcut)
+    for f in ck.glob("premerge.npz"):
+        f.unlink()
+    for f in ck.glob("manifest.json"):
+        f.unlink()
+    chunk_files = sorted(ck.glob("p1chunk*.npz"))
+    chunk_files[-1].unlink()
+    calls.clear()
+    partial = train(pts, checkpoint_dir=str(ck), **kw)
+    np.testing.assert_array_equal(clean.clusters, partial.clusters)
+    assert len(calls) >= 1  # the uncovered tail really recomputed
+
+
+def test_device_phase_chunk_budget_change_recomputes(
+    rng, tmp_path, monkeypatch
+):
+    """A changed chunk budget re-forms different chunk compositions; the
+    saved chunks must not be misapplied — skipped groups re-dispatch and
+    labels stay exact."""
+    pts = _varied_blobs(rng)
+    kw = dict(
+        eps=0.5, min_points=5, max_points_per_partition=256,
+        engine=Engine.ARCHERY, neighbor_backend="banded",
+    )
+    clean = train(pts, **kw)
+    ck = tmp_path / "ck"
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 512)
+    train(pts, checkpoint_dir=str(ck), **kw)
+    for f in ck.glob("premerge.npz"):
+        f.unlink()
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 2048)  # new shape
+    resumed = train(pts, checkpoint_dir=str(ck), **kw)
+    np.testing.assert_array_equal(clean.clusters, resumed.clusters)

@@ -82,11 +82,12 @@ def test_pallas_banded_on_mesh(rng):
 def test_pallas_auto_routes_banded_at_scale(rng, monkeypatch):
     """With neighbor_backend='auto', large buckets route the Pallas run
     through the banded structure (the round-3 reclassification) — not the
-    O(diameter) streaming engine. The auto threshold (DENSE_MAX_BUCKET,
-    65536) is lowered so the test exercises the routing at CI-sized N."""
+    O(diameter) streaming engine. The auto threshold
+    (BANDED_ROUTE_BUCKET, 32768) is lowered so the test exercises the
+    routing at CI-sized N."""
     from dbscan_tpu.parallel import binning, driver
 
-    monkeypatch.setattr(binning, "DENSE_MAX_BUCKET", 2048)
+    monkeypatch.setattr(binning, "BANDED_ROUTE_BUCKET", 2048)
     driver.clear_compile_cache()
     pts = np.concatenate(
         [rng.normal(c, 0.7, (4000, 2)) for c in [(0, 0), (9, 9)]]
