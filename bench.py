@@ -36,6 +36,7 @@ rows past it emit "<row>_skipped": "time_budget" instead of running).
 
 import json
 import os
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -229,6 +230,28 @@ def anchor_row(prefix: str, n: int, kind: str, maxpp: int) -> dict:
     }
 
 
+def _reexec_cpu(why: str, cleanup_dir: str = None) -> None:
+    """Replace this process with a CPU-backend re-run of the same argv.
+    Shared by the init-probe fallback and the mid-run death fallback —
+    the PYTHONPATH filter (drop only sitecustomize-bearing plugin paths,
+    keep user entries) must stay identical in both."""
+    sys.stderr.write(f"bench: {why}; re-running on the CPU backend\n")
+    if cleanup_dir is not None:  # execve skips context-manager exits
+        shutil.rmtree(cleanup_dir, ignore_errors=True)
+    env = dict(os.environ)
+    env["BENCH_NO_TPU_PROBE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    keep = [
+        p
+        for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p
+        and p != REPO
+        and not os.path.exists(os.path.join(p, "sitecustomize.py"))
+    ]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + keep)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
 def _ensure_live_backend() -> None:
     """The tunneled TPU plugin hangs JAX backend init (even under
     JAX_PLATFORMS=cpu) whenever the tunnel is down — a bench invocation
@@ -249,29 +272,9 @@ def _ensure_live_backend() -> None:
             return
         # fast-crashing plugin init (segfault/fatal raise) must also
         # route to the fallback, not just a hang
-        sys.stderr.write(
-            f"bench: accelerator init failed (rc {proc.returncode}); "
-            "falling back to the CPU backend\n"
-        )
+        _reexec_cpu(f"accelerator init failed (rc {proc.returncode})")
     except subprocess.TimeoutExpired:
-        sys.stderr.write(
-            "bench: accelerator init hung (tunnel down?); "
-            "falling back to the CPU backend\n"
-        )
-    env = dict(os.environ)
-    env["BENCH_NO_TPU_PROBE"] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    # drop only sitecustomize-bearing entries (the device-plugin path) from
-    # PYTHONPATH; keep anything else the user set
-    keep = [
-        p
-        for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
-        if p
-        and p != REPO
-        and not os.path.exists(os.path.join(p, "sitecustomize.py"))
-    ]
-    env["PYTHONPATH"] = os.pathsep.join([REPO] + keep)
-    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+        _reexec_cpu("accelerator init hung (tunnel down?)")
 
 
 def main() -> None:
@@ -291,6 +294,8 @@ def main() -> None:
     backend = jax.default_backend()
     pts = make_data(n)
 
+
+
     with tempfile.TemporaryDirectory() as tmp:
         data_path = os.path.join(tmp, "data.npz")
         out_path = os.path.join(tmp, "cpu.npz")
@@ -305,9 +310,19 @@ def main() -> None:
         # tuned for the XLA engines, so force the banded route here
         pallas_extra = {"neighbor_backend": "banded"} if use_pallas else {}
         reps = int(os.environ.get("BENCH_REPS", "3"))
-        model, dt = run_train(
-            pts, maxpp, use_pallas=use_pallas, reps=reps, **pallas_extra
-        )
+        try:
+            model, dt = run_train(
+                pts, maxpp, use_pallas=use_pallas, reps=reps, **pallas_extra
+            )
+        except Exception as e:  # noqa: BLE001
+            if backend == "cpu":
+                raise
+            # worker died MID-RUN (init was fine): degrade the whole
+            # capture to a real CPU measurement, not a missing JSON line
+            _reexec_cpu(
+                f"accelerator died mid-headline ({type(e).__name__})",
+                cleanup_dir=tmp,
+            )
         throughput = len(pts) / dt / 1e6
 
         from dbscan_tpu import Engine, train
@@ -319,32 +334,42 @@ def main() -> None:
         # labels — this is the ari_full of the run whose throughput is
         # reported, not of a subset. The alt maxpp is guaranteed to
         # differ (halve when possible, else double).
-        alt_model = train(
-            pts,
-            eps=EPS,
-            min_points=MIN_POINTS,
-            max_points_per_partition=(
-                maxpp // 2 if maxpp >= 4096 else maxpp * 2
-            ),
-            engine=Engine.ARCHERY,
-            use_pallas=use_pallas,
-            **pallas_extra,
-        )
+        try:
+            alt_model = train(
+                pts,
+                eps=EPS,
+                min_points=MIN_POINTS,
+                max_points_per_partition=(
+                    maxpp // 2 if maxpp >= 4096 else maxpp * 2
+                ),
+                engine=Engine.ARCHERY,
+                use_pallas=use_pallas,
+                **pallas_extra,
+            )
+            # correctness cross-check: cluster the SAME cpu_n-point subset
+            # on the accelerator (clustering a subset of a larger run
+            # differs legitimately near borders, so comparing against
+            # model.clusters[:n] would understate agreement)
+            sub_model = train(
+                pts[:cpu_n],
+                eps=EPS,
+                min_points=MIN_POINTS,
+                max_points_per_partition=maxpp,
+                engine=Engine.ARCHERY,
+                use_pallas=use_pallas,
+                **pallas_extra,
+            )
+        except Exception as e:  # noqa: BLE001
+            if backend == "cpu":
+                raise
+            _reexec_cpu(
+                f"accelerator died mid-cross-check ({type(e).__name__})",
+                cleanup_dir=tmp,
+            )
+        # host-side scoring stays OUTSIDE the try: a host failure here
+        # (e.g. MemoryError in ARI at huge N) must surface, not trigger
+        # a CPU re-exec that discards the finished device measurement
         ari_full = adjusted_rand_index(model.clusters, alt_model.clusters)
-
-        # correctness cross-check: cluster the SAME cpu_n-point subset on the
-        # accelerator (clustering a subset of a larger run differs
-        # legitimately near borders, so comparing against model.clusters[:n]
-        # would understate agreement)
-        sub_model = train(
-            pts[:cpu_n],
-            eps=EPS,
-            min_points=MIN_POINTS,
-            max_points_per_partition=maxpp,
-            engine=Engine.ARCHERY,
-            use_pallas=use_pallas,
-            **pallas_extra,
-        )
 
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"

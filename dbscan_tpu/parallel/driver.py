@@ -55,11 +55,15 @@ from dbscan_tpu.parallel.mesh import PARTS_AXIS, mesh_size
 
 logger = logging.getLogger(__name__)
 
-# Slot budget per compact-postpass chunk: the postpass flat-concatenates
-# its groups' [P, B] buffers, and any single device buffer must stay
-# under 2^31 bytes (TPU runtime per-buffer limit; the int32 bits array
-# is 4 bytes/slot). 2^28 slots = 1 GB of bits, half the hard ceiling.
-_COMPACT_CHUNK_SLOTS = 1 << 28
+# Slot budget per compact-postpass chunk. Two constraints meet here:
+# any single device buffer must stay under 2^31 bytes (TPU runtime
+# per-buffer limit; the int32 bits array is 4 bytes/slot -> hard cap
+# 2^29 slots), and the chunk is ALSO the checkpoint/restart granularity
+# of the resumable device phase — a 100M-point run holds ~270M slots,
+# so a near-limit budget would put the whole run in one chunk and a
+# worker death would save nothing. 2^26 slots (~256 MB of bits) keeps
+# several restart points per big run for a few extra ~10 s pulls.
+_COMPACT_CHUNK_SLOTS = 1 << 26
 # Dispatched-but-unretired slot budget (dispatch backpressure): queued
 # programs pin ~25 B of input per padded slot in HBM; 2^27 slots keeps
 # the input window ~3 GB, leaving room for the resident phase-1 outputs
@@ -1076,7 +1080,13 @@ def train_arrays(
             rec["combo_dev"] = combo_dev
             rec["bits_flat"] = bits_flat
         eager["records"].append(rec)
-        if len(eager["records"]) >= 2:
+        # pipeline by default (pull chunk i-1 while chunk i's phase-1
+        # work executes); DBSCAN_EAGER_PULL=1 pulls each chunk at its
+        # own flush — resilience over overlap, for retry loops on a
+        # worker that keeps dying before the delayed pull lands
+        if _os.environ.get("DBSCAN_EAGER_PULL") == "1":
+            _pull_record(rec)
+        elif len(eager["records"]) >= 2:
             _pull_record(eager["records"][-2])
 
     def _on_group(g):
