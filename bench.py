@@ -294,8 +294,6 @@ def main() -> None:
     backend = jax.default_backend()
     pts = make_data(n)
 
-
-
     with tempfile.TemporaryDirectory() as tmp:
         data_path = os.path.join(tmp, "data.npz")
         out_path = os.path.join(tmp, "cpu.npz")
@@ -314,7 +312,10 @@ def main() -> None:
             model, dt = run_train(
                 pts, maxpp, use_pallas=use_pallas, reps=reps, **pallas_extra
             )
-        except Exception as e:  # noqa: BLE001
+        except jax.errors.JaxRuntimeError as e:
+            # device-runtime deaths only: a deterministic host/config
+            # error must surface, not trigger an hours-long CPU rerun
+            # that hits it again
             if backend == "cpu":
                 raise
             # worker died MID-RUN (init was fine): degrade the whole
@@ -359,7 +360,7 @@ def main() -> None:
                 use_pallas=use_pallas,
                 **pallas_extra,
             )
-        except Exception as e:  # noqa: BLE001
+        except jax.errors.JaxRuntimeError as e:
             if backend == "cpu":
                 raise
             _reexec_cpu(

@@ -159,11 +159,16 @@ def save_p1_chunk(
     sig: str,
     shapes: np.ndarray,
     arrays: dict,
+    budget: int = 0,
 ) -> None:
     """Atomically persist one pulled compact chunk. ``sig`` digests the
     chunk's group composition; ``shapes`` is [n_groups, 3] int64
     (P, B, slab) — the loader exposes it so the resuming driver can skip
-    matching group dispatches BEFORE the chunk re-forms."""
+    matching group dispatches BEFORE the chunk re-forms. ``budget`` is
+    the chunk-slot budget the chunks were formed under: the loader
+    rejects chunks from a different budget OUTRIGHT (their compositions
+    cannot re-form, and per-group skips followed by signature-mismatch
+    redispatch would serialize the whole device phase)."""
     os.makedirs(ckpt_dir, exist_ok=True)
     path = _p1_path(ckpt_dir, ci)
     tmp = path + ".tmp"
@@ -173,16 +178,20 @@ def save_p1_chunk(
             _fingerprint=np.array(fingerprint),
             _sig=np.array(sig),
             _shapes=shapes,
+            _budget=np.int64(budget),
             **arrays,
         )
     os.replace(tmp, path)
 
 
-def load_p1_chunks(ckpt_dir: str, fingerprint: str) -> list:
+def load_p1_chunks(
+    ckpt_dir: str, fingerprint: str, budget: int = 0
+) -> list:
     """Load the consecutive prefix of saved chunks matching
-    ``fingerprint`` (chunk ci is only usable if every chunk before it
-    loaded — the driver skips dispatches in emission order). Returns a
-    list of dicts {sig, shapes, arrays}; empty on any mismatch."""
+    ``fingerprint`` AND ``budget`` (chunk ci is only usable if every
+    chunk before it loaded — the driver skips dispatches in emission
+    order). Returns a list of dicts {sig, shapes, arrays}; empty on any
+    mismatch."""
     out = []
     ci = 0
     while True:
@@ -192,6 +201,8 @@ def load_p1_chunks(ckpt_dir: str, fingerprint: str) -> list:
         try:
             with np.load(path) as z:
                 if str(z["_fingerprint"]) != fingerprint:
+                    break
+                if int(z["_budget"]) != int(budget):
                     break
                 out.append(
                     {

@@ -35,6 +35,7 @@ from __future__ import annotations
 import functools
 import hashlib
 import logging
+import os as _os
 import time
 from typing import List, NamedTuple, Optional, Tuple
 
@@ -63,15 +64,17 @@ logger = logging.getLogger(__name__)
 # so a near-limit budget would put the whole run in one chunk and a
 # worker death would save nothing. 2^26 slots (~256 MB of bits) keeps
 # several restart points per big run for a few extra ~10 s pulls.
-_COMPACT_CHUNK_SLOTS = 1 << 26
+# Env-overridable: retry loops on a dying worker shrink it further so
+# partial progress lands earlier.
+_COMPACT_CHUNK_SLOTS = int(
+    _os.environ.get("DBSCAN_COMPACT_CHUNK_SLOTS", str(1 << 26))
+)
 # Dispatched-but-unretired slot budget (dispatch backpressure): queued
 # programs pin ~25 B of input per padded slot in HBM; 2^27 slots keeps
 # the input window ~3 GB, leaving room for the resident phase-1 outputs
 # (5 B/slot across ALL groups) and postpass transients on a 16 GB chip.
 # Env-overridable for debugging (1 = fully synchronous dispatch, so a
 # device fault raises at the offending group's dispatch site).
-import os as _os
-
 _INFLIGHT_SLOTS = int(
     _os.environ.get("DBSCAN_INFLIGHT_SLOTS", str(1 << 27))
 )
@@ -971,7 +974,9 @@ def train_arrays(
     if compact_on and ckpt_fp is not None:
         from dbscan_tpu.parallel import checkpoint as _ckpt_p1
 
-        p1_loaded = _ckpt_p1.load_p1_chunks(checkpoint_dir, ckpt_fp)
+        p1_loaded = _ckpt_p1.load_p1_chunks(
+            checkpoint_dir, ckpt_fp, budget=_COMPACT_CHUNK_SLOTS
+        )
         for lci, lc in enumerate(p1_loaded):
             for row in lc["shapes"]:
                 p1_exp.append((lci, tuple(int(v) for v in row)))
@@ -1042,6 +1047,7 @@ def train_arrays(
                 rec["sig"],
                 shapes,
                 {"combo": combo_host, "bbits": bbits},
+                budget=_COMPACT_CHUNK_SLOTS,
             )
 
     def _flush_chunk():

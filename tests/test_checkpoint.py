@@ -265,3 +265,22 @@ def test_device_phase_chunk_budget_change_recomputes(
     monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 2048)  # new shape
     resumed = train(pts, checkpoint_dir=str(ck), **kw)
     np.testing.assert_array_equal(clean.clusters, resumed.clusters)
+
+
+def test_device_phase_eager_pull_mode(rng, tmp_path, monkeypatch):
+    """DBSCAN_EAGER_PULL=1 (pull each chunk at its own flush — the
+    resilience-first mode for retry loops) produces identical labels,
+    and chunks do get saved."""
+    pts = _varied_blobs(rng)
+    kw = dict(
+        eps=0.5, min_points=5, max_points_per_partition=256,
+        engine=Engine.ARCHERY, neighbor_backend="banded",
+    )
+    clean = train(pts, **kw)
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 512)
+    monkeypatch.setenv("DBSCAN_EAGER_PULL", "1")
+    ck = tmp_path / "ck"
+    eager = train(pts, checkpoint_dir=str(ck), **kw)
+    np.testing.assert_array_equal(clean.clusters, eager.clusters)
+    np.testing.assert_array_equal(clean.flags, eager.flags)
+    assert len(list(ck.glob("p1chunk*.npz"))) >= 2
