@@ -86,12 +86,29 @@ def _block_batch(slab: int, n_planes: int = 2) -> int:
 _SLAB_CHUNK_TARGET = 49152
 
 
-def _slab_chunks(slab: int) -> int:
-    m = 1
-    while slab % m or slab // m > _SLAB_CHUNK_TARGET:
+def _slab_chunks(slab: int, target: int | None = None) -> int:
+    """Number of even chunks the [*, S] sweeps consume the slab in, the
+    largest chunk width <= ``target`` (default: module _SLAB_CHUNK_TARGET;
+    the Pallas engine passes its own VMEM-sized target)."""
+    if target is None:
+        target = _SLAB_CHUNK_TARGET
+    if slab <= target:
+        return 1
+    # Packer slab widths ride a q*128 ladder with q in {2^k, 3*2^k}
+    # (binning._ladder_width), so a divisor landing the chunk under the
+    # target always exists and sits within a ~2x band of the ideal chunk
+    # count. Scan only that band, and FAIL if the invariant is broken —
+    # a silent full-slab fallback would reintroduce the >2^31-byte
+    # transient this chunking exists to prevent.
+    m = -(-slab // target)  # smallest count whose chunk fits
+    while m <= 4 * (-(-slab // target)) and slab % m:
         m += 1
-        if m > slab:
-            return 1
+    if slab % m:
+        raise AssertionError(
+            f"slab width {slab} has no divisor with chunk <= "
+            f"{target}: the packer's ladder-width invariant "
+            "(q*128, q in 2^k / 3*2^k) was broken upstream"
+        )
     return m
 
 

@@ -29,7 +29,11 @@ exactly the kind of data-dependent gather it is good at: one advanced-
 indexing gather builds the [nb, R, S] slab tensors (a few percent of the
 bucket in bytes — S << B), and the Pallas kernels consume them through
 ordinary aligned BlockSpecs, fusing the 5-row adjacency sweep with its
-count/bit reductions so no [T, S] intermediate ever reaches HBM.
+count/bit reductions so no [T, S] intermediate ever reaches HBM. Wide
+slabs are additionally walked in ladder-divisor chunks by a third grid
+dimension (_PALLAS_SLAB_CHUNK) so the per-step [TSUB, SC] transients fit
+VMEM at ANY production slab width — the same chunking contract as
+banded.py's _slab_chunks, accumulated across chunk steps.
 
 Per-point blocked arrays ride as [nb, 1, T] (the (1, 1, T) block passes
 Mosaic's last-two-dims rule by dimension equality where a (1, T) block
@@ -49,6 +53,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dbscan_tpu.ops.banded import _slab_chunks
 from dbscan_tpu.parallel.binning import BANDED_BLOCK, BANDED_ROWS
 
 
@@ -56,12 +61,21 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-# Rows of a block processed per inner grid step: every [TSUB, S]
+# Rows of a block processed per inner grid step: every [TSUB, SC]
 # intermediate of the unrolled 5-row sweep must fit VMEM at once, and at
 # the full BANDED_BLOCK=512 the compiler runs out for wide slabs. The
 # slab bundle's index map ignores the inner dim, so it stays resident
 # across a block's inner steps.
 TSUB = 128
+
+# Slab-chunk width target for the THIRD grid dimension: production slabs
+# reach ~196k elements, and a [TSUB, S] f32 sweep intermediate at that
+# width is ~100 MB — far past VMEM. Kernels consume the slab in even
+# ladder-divisor chunks of at most this width (the [TSUB, 4096] f32
+# transients are ~2 MB each; the resident [R, 4096] bundles ~80 KB per
+# plane), accumulating counts/bits across chunk steps exactly like
+# banded.py's _slab_chunks sweeps — bit-identical at any slab width.
+_PALLAS_SLAB_CHUNK = 4096
 
 
 def _tile_adj(bl_planes, bm_row, brel, bspan, slabs, smask, offs, eps2, k):
@@ -82,7 +96,7 @@ def _tile_adj(bl_planes, bm_row, brel, bspan, slabs, smask, offs, eps2, k):
     )
 
 
-def _make_counts_kernel(d: int, slab: int):
+def _make_counts_kernel(d: int, sc: int):
     t = TSUB
 
     def kernel(eps2_ref, *refs):
@@ -94,7 +108,11 @@ def _make_counts_kernel(d: int, slab: int):
         smask = refs[2 * d + 3]
         out = refs[2 * d + 4]
 
-        offs = jax.lax.broadcasted_iota(jnp.int32, (t, slab), 1)
+        # grid dim 2 walks the slab in sc-wide chunks; offsets are GLOBAL
+        # slab positions so the run-window test (rel/span live in slab
+        # coordinates) is unchanged by the chunking
+        base = pl.program_id(2) * sc
+        offs = base + jax.lax.broadcasted_iota(jnp.int32, (t, sc), 1)
         eps2 = eps2_ref[0, 0]
         acc = jnp.zeros((t,), jnp.int32)
         for k in range(BANDED_ROWS):
@@ -102,12 +120,22 @@ def _make_counts_kernel(d: int, slab: int):
                 bl_planes, bm, brel, bspan, slabs, smask, offs, eps2, k
             )
             acc = acc + jnp.sum(adj.astype(jnp.int32), axis=1)
-        out[0, 0] = acc
+
+        # out's index map ignores the (fastest-varying) chunk dim, so the
+        # block stays resident: initialize on the first chunk, accumulate
+        # across the rest
+        @pl.when(pl.program_id(2) == 0)
+        def _init():
+            out[0, 0] = acc
+
+        @pl.when(pl.program_id(2) != 0)
+        def _acc():
+            out[0, 0] = out[0, 0] + acc
 
     return kernel
 
 
-def _make_bits_kernel(d: int, slab: int):
+def _make_bits_kernel(d: int, sc: int):
     t = TSUB
 
     def kernel(eps2_ref, *refs):
@@ -122,7 +150,8 @@ def _make_bits_kernel(d: int, slab: int):
         score = refs[2 * d + 6]
         out = refs[2 * d + 7]
 
-        offs = jax.lax.broadcasted_iota(jnp.int32, (t, slab), 1)
+        base = pl.program_id(2) * sc
+        offs = base + jax.lax.broadcasted_iota(jnp.int32, (t, sc), 1)
         eps2 = eps2_ref[0, 0]
         bits = jnp.zeros((t,), jnp.int32)
         for k in range(BANDED_ROWS):
@@ -139,7 +168,14 @@ def _make_bits_kernel(d: int, slab: int):
                 bits = bits | (
                     hit.astype(jnp.int32) << jnp.int32(k * 5 + dx)
                 )
-        out[0, 0] = bits
+
+        @pl.when(pl.program_id(2) == 0)
+        def _init():
+            out[0, 0] = bits
+
+        @pl.when(pl.program_id(2) != 0)
+        def _acc():
+            out[0, 0] = out[0, 0] | bits
 
     return kernel
 
@@ -148,17 +184,22 @@ def _block_spec(t):
     # [nb * nsub, 1, t] layout: Mosaic requires the last two block dims
     # to be (divisible by 8, divisible by 128) OR equal to the array dims
     # — a (1, t) block over [rows, t] fails the sublane rule, while
-    # (1, 1, t) over [rows, 1, t] passes by equality. Grid is (nb, nsub):
-    # outer picks the block (and its slab), inner the t-row sub-block.
-    return pl.BlockSpec((1, 1, t), lambda i, j: (i * (BANDED_BLOCK // t) + j, 0, 0))
+    # (1, 1, t) over [rows, 1, t] passes by equality. Grid is
+    # (nb, nsub, ns): outer picks the block (and its slab), middle the
+    # t-row sub-block, inner (fastest) the slab chunk — which this map
+    # ignores, so per-point blocks stay resident across chunk steps.
+    return pl.BlockSpec(
+        (1, 1, t), lambda i, j, s: (i * (BANDED_BLOCK // t) + j, 0, 0)
+    )
 
 
-def _slab_spec(slab):
-    # one [R, S] slab bundle per OUTER grid step; the index map ignores
-    # the inner dim so the bundle stays resident across a block's
-    # sub-steps. (R, S) equals the trailing array dims, satisfying the
-    # tiling rule.
-    return pl.BlockSpec((1, BANDED_ROWS, slab), lambda i, j: (i, 0, 0))
+def _slab_spec(sc):
+    # one [R, SC] chunk of a block's slab bundle per inner (fastest) grid
+    # step; each (block, sub-row) pair re-walks the chunks and Mosaic
+    # pipelines the fetches. Tiling rule: R equals the array dim; SC is a
+    # ladder divisor — a multiple of 128 whenever ns > 1, and equal to
+    # the array dim S when ns == 1.
+    return pl.BlockSpec((1, BANDED_ROWS, sc), lambda i, j, s: (i, 0, s))
 
 
 def _gather_slabs(plane, ss, slab):
@@ -191,6 +232,8 @@ def banded_phase1_pallas(
 
     nsub = t // TSUB
     rows = nb * nsub
+    ns = _slab_chunks(slab, _PALLAS_SLAB_CHUNK)
+    sc = slab // ns
 
     planes = tuple(points[:, j].astype(jnp.float32) for j in range(d))
     m32 = mask.astype(jnp.int32)
@@ -208,11 +251,11 @@ def banded_phase1_pallas(
 
     blocked_specs = [
         pl.BlockSpec(
-            (1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM
+            (1, 1), lambda i, j, s: (0, 0), memory_space=pltpu.SMEM
         ),
         *[_block_spec(TSUB) for _ in range(d + 1)],  # planes + mask
-        pl.BlockSpec((1, r, TSUB), lambda i, j: (i * nsub + j, 0, 0)),
-        pl.BlockSpec((1, r, TSUB), lambda i, j: (i * nsub + j, 0, 0)),
+        pl.BlockSpec((1, r, TSUB), lambda i, j, s: (i * nsub + j, 0, 0)),
+        pl.BlockSpec((1, r, TSUB), lambda i, j, s: (i * nsub + j, 0, 0)),
     ]
     blocked_args = [
         eps2,
@@ -226,11 +269,11 @@ def banded_phase1_pallas(
     mask_slab = _gather_slabs(m32, ss, slab)
 
     counts = pl.pallas_call(
-        _make_counts_kernel(d, slab),
-        grid=(nb, nsub),
+        _make_counts_kernel(d, sc),
+        grid=(nb, nsub, ns),
         in_specs=[
             *blocked_specs,
-            *[_slab_spec(slab) for _ in range(d + 1)],
+            *[_slab_spec(sc) for _ in range(d + 1)],
         ],
         out_specs=_block_spec(TSUB),
         out_shape=jax.ShapeDtypeStruct((rows, 1, TSUB), jnp.int32),
@@ -242,12 +285,12 @@ def banded_phase1_pallas(
     core32 = core.astype(jnp.int32)
 
     bits = pl.pallas_call(
-        _make_bits_kernel(d, slab),
-        grid=(nb, nsub),
+        _make_bits_kernel(d, sc),
+        grid=(nb, nsub, ns),
         in_specs=[
             *blocked_specs,
             _block_spec(TSUB),  # cx blocked
-            *[_slab_spec(slab) for _ in range(d + 3)],
+            *[_slab_spec(sc) for _ in range(d + 3)],
         ],
         out_specs=_block_spec(TSUB),
         out_shape=jax.ShapeDtypeStruct((rows, 1, TSUB), jnp.int32),

@@ -65,10 +65,27 @@ logger = logging.getLogger(__name__)
 # worker death would save nothing. 2^26 slots (~256 MB of bits) keeps
 # several restart points per big run for a few extra ~10 s pulls.
 # Env-overridable: retry loops on a dying worker shrink it further so
-# partial progress lands earlier.
-_COMPACT_CHUNK_SLOTS = int(
+# partial progress lands earlier. Clamped to [2^16, 2^28]: at 2^29
+# slots the int32 bits array alone reaches 2^31 bytes — AT the
+# per-buffer ceiling, the exact kill the chunking exists to prevent —
+# so the cap sits one doubling below it; and the value tags saved
+# chunks, so one bad override would also invalidate every prior
+# checkpoint of the run.
+_requested_chunk_slots = int(
     _os.environ.get("DBSCAN_COMPACT_CHUNK_SLOTS", str(1 << 26))
 )
+_COMPACT_CHUNK_SLOTS = min(1 << 28, max(1 << 16, _requested_chunk_slots))
+if _COMPACT_CHUNK_SLOTS != _requested_chunk_slots:
+    # chunks are budget-stamped, so an altered value is also a clean
+    # recompute of any prior checkpoints — say so instead of silently
+    # discarding them
+    logger.warning(
+        "DBSCAN_COMPACT_CHUNK_SLOTS=%d clamped to %d (allowed range "
+        "2^16..2^28); saved chunks stamped with the requested value "
+        "will not be resumed",
+        _requested_chunk_slots,
+        _COMPACT_CHUNK_SLOTS,
+    )
 # Dispatched-but-unretired slot budget (dispatch backpressure): queued
 # programs pin ~25 B of input per padded slot in HBM; 2^27 slots keeps
 # the input window ~3 GB, leaving room for the resident phase-1 outputs

@@ -340,11 +340,22 @@ def leader_components(sub: "_DenseOps", halo: float, rng):
         nearest = np.empty(n, dtype=np.int64)
         ea_l, eb_l = [], []
         over_budget = False
-        for s in range(0, n, _LEADER_CHUNK):
-            d = _chords_of(sub.x[s : s + _LEADER_CHUNK], leaders)
+        # bound the [chunk, L] chord transient to ~64 MiB however many
+        # leaders landed (at the 4096 cap a fixed 2^16 chunk would be a
+        # 1 GiB host allocation — scale rows inversely with L instead)
+        chunk = max(1024, min(_LEADER_CHUNK, (1 << 24) // max(1, len(leaders))))
+        # the edge budget is judged CUMULATIVELY against the total row
+        # allowance, not per chunk: a per-chunk test would get noisier as
+        # the chunk shrinks (one locally dense window tripping it), while
+        # the cumulative form accepts/rejects independently of chunk size
+        # and still exits early once the whole-node allowance is blown
+        edges_seen = 0
+        for s in range(0, n, chunk):
+            d = _chords_of(sub.x[s : s + chunk], leaders)
             nearest[s : s + len(d)] = np.argmin(d, axis=1)
             mask = d <= band
-            if int(mask.sum()) > _LEADER_EDGE_BUDGET * len(d):
+            edges_seen += int(mask.sum())
+            if edges_seen > _LEADER_EDGE_BUDGET * n:
                 over_budget = True
                 break
             multi = mask.sum(axis=1) > 1
