@@ -160,10 +160,13 @@ def sparse_row(prefix: str, n: int, maxpp: int) -> dict:
     dt = float("inf")
     for _ in range(max(1, reps)):
         t0 = time.perf_counter()
-        clusters, _flags = sparse_cosine_dbscan(x, **kw)
-        dt = min(dt, time.perf_counter() - t0)
+        rep_stats: dict = {}
+        clusters, _flags = sparse_cosine_dbscan(x, stats_out=rep_stats, **kw)
+        dt_rep = time.perf_counter() - t0
+        if dt_rep < dt:  # phase split of the hot run being reported
+            dt, stats = dt_rep, rep_stats
     ari = adjusted_rand_index(clusters, blob_of)
-    return {
+    out = {
         f"{prefix}_n": n,
         f"{prefix}_seconds": round(dt, 2),
         f"{prefix}_clusters": int(len(np.unique(clusters[clusters > 0]))),
@@ -171,7 +174,151 @@ def sparse_row(prefix: str, n: int, maxpp: int) -> dict:
         f"{prefix}_ari": round(float(ari), 6),
         f"{prefix}_leaves": stats.get("n_partitions"),
         f"{prefix}_dup": stats.get("duplication_factor"),
+        f"{prefix}_phases": _phases(stats),
     }
+    cpu_n = int(os.environ.get("BENCH_SPARSE_CPU_N", "30000"))
+    out.update(_row_cpu_baseline(prefix, "sparse", cpu_n, n / dt))
+    return out
+
+
+# Single-chip TPU v5e MXU peak (bf16). The banded sweeps are f32
+# VECTOR work (difference-form distances on the VPU), so their MFU
+# against the matrix-unit peak is structurally small — the figure
+# grounds the throughput claim in hardware terms and shows whether the
+# kernel or the host is the ceiling, not that the MXU is saturated.
+V5E_BF16_PEAK = 197e12
+
+
+def _phases(stats, top=8) -> dict:
+    """Condense stats['timings'] to the `top` largest phases + total."""
+    t = dict(stats.get("timings") or {})
+    total = t.pop("total_s", 0.0)
+    keys = sorted((k for k in t if t[k] > 0), key=lambda k: -t[k])[:top]
+    out = {k: round(t[k], 2) for k in keys}
+    out["total_s"] = round(total, 2)
+    return out
+
+
+def _mfu_fields(prefix: str, pts, maxpp: int, **extra) -> dict:
+    """One instrumented hot run (DBSCAN_TIME_DEVICE=1: synchronous banded
+    dispatch, no pack/compute overlap — never the timed run) isolating the
+    device sweep window; reports the counted sweep-FLOP rate vs chip peak
+    (VERDICT r3 item 3). Empty when the run had no banded groups."""
+    from dbscan_tpu import Engine, train
+
+    kw = dict(
+        eps=EPS,
+        min_points=MIN_POINTS,
+        max_points_per_partition=maxpp,
+        engine=Engine.ARCHERY,
+    )
+    kw.update(extra)
+    os.environ["DBSCAN_TIME_DEVICE"] = "1"
+    try:
+        model = train(pts, **kw)
+    finally:
+        os.environ.pop("DBSCAN_TIME_DEVICE", None)
+    sync = model.stats["timings"].get("banded_p1_sync_s")
+    flops = model.stats.get("banded_sweep_flops")
+    if not sync or not flops:
+        return {}
+    rate = flops / sync
+    return {
+        f"{prefix}_sweep_flops": int(flops),
+        f"{prefix}_device_sweep_s": round(sync, 3),
+        f"{prefix}_sweep_tflops": round(rate / 1e12, 3),
+        f"{prefix}_mfu_vs_bf16_peak": round(rate / V5E_BF16_PEAK, 5),
+    }
+
+
+def _row_cpu_baseline(prefix: str, kind: str, cpu_n: int, row_rate: float) -> dict:
+    """XLA-CPU subprocess baseline for a cosine/sparse row (the euclid
+    headline's `cpu_baseline_mpts` pattern, VERDICT r3 item 2a): same
+    workload generator, same pipeline, CPU backend, at `cpu_n` points —
+    the rate comparison extrapolates exactly as BASELINE.md's
+    honest-comparison note documents."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return {}  # the row itself IS a CPU measurement
+    if os.environ.get("BENCH_ROW_BASELINES", "1") == "0":
+        return {}
+    child = {"cosine": "--cos-child", "sparse": "--sparse-child"}[kind]
+    # the child runs on the host CPU, but its wall still counts against
+    # the capture's budget — cap it at a fraction of BENCH_BUDGET_S so a
+    # slow baseline cannot starve the rows that follow
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    timeout_s = int(
+        os.environ.get(
+            "BENCH_ROW_BASELINE_TIMEOUT_S", str(int(min(1800, 0.4 * budget)))
+        )
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        out_path = os.path.join(tmp, "out.npz")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable, os.path.abspath(__file__), child,
+                    str(cpu_n), out_path,
+                ],
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE,
+                timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            # the accelerator row is already measured — a hung baseline
+            # must degrade THIS comparison, not discard the row
+            return {f"{prefix}_baseline_failed": f"timeout>{timeout_s}s"}
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr.decode(errors="replace")[-2000:])
+            return {f"{prefix}_baseline_failed": int(proc.returncode)}
+        res = np.load(out_path)
+    cpu_rate = float(res["n"]) / float(res["seconds"])
+    return {
+        f"{prefix}_cpu_baseline_n": int(res["n"]),
+        f"{prefix}_cpu_baseline_mpts": round(cpu_rate / 1e6, 5),
+        f"{prefix}_vs_baseline": round(row_rate / max(cpu_rate, 1e-12), 3),
+    }
+
+
+def child_cos_cpu(cpu_n: int, out_path: str) -> None:
+    """CPU-backend cosine baseline child: same generator/config as the
+    cosine anchor row, warm-up + one timed run."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from dbscan_tpu import train
+
+    pts, _blob_of, _n_blob, _k, eps = make_anchor(cpu_n, "cosine")
+    maxpp = int(os.environ.get("BENCH_COS_MAXPP", "8192"))
+    kw = dict(
+        eps=eps, min_points=MIN_POINTS, metric="cosine",
+        max_points_per_partition=maxpp,
+    )
+    train(pts, **kw)
+    t0 = time.perf_counter()
+    train(pts, **kw)
+    np.savez(out_path, seconds=time.perf_counter() - t0, n=cpu_n)
+
+
+def child_sparse_cpu(cpu_n: int, out_path: str) -> None:
+    """CPU-backend sparse baseline child: same generator/config as the
+    sparse row, warm-up + one timed run."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from dbscan_tpu.ops.sparse import sparse_cosine_dbscan
+
+    x, _blob_of, _k = make_sparse_anchor(cpu_n)
+    maxpp = int(os.environ.get("BENCH_SPARSE_MAXPP", "4096"))
+    kw = dict(eps=0.05, min_points=5, max_points_per_partition=maxpp)
+    sparse_cosine_dbscan(x, **kw)
+    t0 = time.perf_counter()
+    sparse_cosine_dbscan(x, **kw)
+    np.savez(out_path, seconds=time.perf_counter() - t0, n=cpu_n)
 
 
 def run_train(pts, maxpp, use_pallas=False, reps=1, **extra):
@@ -191,10 +338,13 @@ def run_train(pts, maxpp, use_pallas=False, reps=1, **extra):
     # the minimum is the reproducible peak-throughput figure
     train(pts, **kw)
     dt = float("inf")
+    model = None
     for _ in range(max(1, reps)):
         t0 = time.perf_counter()
-        model = train(pts, **kw)
-        dt = min(dt, time.perf_counter() - t0)
+        m = train(pts, **kw)
+        dt_rep = time.perf_counter() - t0
+        if dt_rep < dt:  # keep the BEST rep's model: its phase split is
+            model, dt = m, dt_rep  # the one describing the reported wall
     return model, dt
 
 
@@ -221,13 +371,28 @@ def anchor_row(prefix: str, n: int, kind: str, maxpp: int) -> dict:
     reps = int(os.environ.get("BENCH_ANCHOR_REPS", "2"))
     model, dt = run_train(pts, maxpp, reps=reps, **extra)
     ari = adjusted_rand_index(model.clusters[:n_blob], blob_of)
-    return {
+    out = {
         f"{prefix}_n": n,
         f"{prefix}_seconds": round(dt, 2),
         f"{prefix}_clusters": model.n_clusters,
         f"{prefix}_expect": k,
         f"{prefix}_ari": round(float(ari), 6),
+        f"{prefix}_phases": _phases(model.stats),
     }
+    if kind == "euclidean" and os.environ.get("BENCH_MFU", "1") == "1":
+        import jax
+
+        if jax.default_backend() != "cpu":
+            # supplementary instrumented run: a worker death here must
+            # not discard the completed primary measurement above
+            try:
+                out.update(_mfu_fields(prefix, pts, maxpp, **extra))
+            except Exception as e:  # noqa: BLE001 — recorded, not fatal
+                out[f"{prefix}_mfu_failed"] = f"{type(e).__name__}"[:80]
+    if kind == "cosine":
+        cpu_n = int(os.environ.get("BENCH_COS_CPU_N", "50000"))
+        out.update(_row_cpu_baseline(prefix, kind, cpu_n, n / dt))
+    return out
 
 
 def _reexec_cpu(why: str, cleanup_dir: str = None) -> None:
@@ -285,6 +450,12 @@ def main() -> None:
 
     if len(sys.argv) >= 4 and sys.argv[1] == "--cpu-child":
         child_cpu(sys.argv[2], sys.argv[3], cpu_maxpp)
+        return
+    if len(sys.argv) >= 4 and sys.argv[1] == "--cos-child":
+        child_cos_cpu(int(sys.argv[2]), sys.argv[3])
+        return
+    if len(sys.argv) >= 4 and sys.argv[1] == "--sparse-child":
+        child_sparse_cpu(int(sys.argv[2]), sys.argv[3])
         return
 
     _ensure_live_backend()
@@ -402,7 +573,18 @@ def main() -> None:
         "n_clusters": model.n_clusters,
         "n_partitions": model.stats["n_partitions"],
         "seconds": round(dt, 3),
+        "phases": _phases(model.stats),
     }
+    if backend != "cpu" and os.environ.get("BENCH_MFU", "1") == "1":
+        try:
+            out.update(
+                _mfu_fields(
+                    "headline", pts, maxpp,
+                    use_pallas=use_pallas, **pallas_extra,
+                )
+            )
+        except Exception as e:  # noqa: BLE001 — supplementary, not fatal
+            out["headline_mfu_failed"] = f"{type(e).__name__}"[:80]
     # Engineered-structure anchor rows (euclid / haversine / cosine) are ON
     # by default so the driver-side capture witnesses all three metric
     # paths, at backend-aware sizes: full scale on the accelerator, small
@@ -443,17 +625,9 @@ def main() -> None:
                 )
             ),
         ),
-        (
-            "cosine",
-            "cosine",
-            "BENCH_COSINE",
-            int(
-                os.environ.get(
-                    "BENCH_COS_N", "50000" if on_cpu else "1000000"
-                )
-            ),
-            int(os.environ.get("BENCH_COS_MAXPP", "8192")),
-        ),
+        # sparse BEFORE cosine (VERDICT r3 item 8): cosine is the budget
+        # eater, and three rounds of driver captures ended with
+        # "sparse_skipped" because it ran last
         (
             "sparse",
             "sparse",
@@ -464,6 +638,17 @@ def main() -> None:
                 )
             ),
             int(os.environ.get("BENCH_SPARSE_MAXPP", "4096")),
+        ),
+        (
+            "cosine",
+            "cosine",
+            "BENCH_COSINE",
+            int(
+                os.environ.get(
+                    "BENCH_COS_N", "50000" if on_cpu else "1000000"
+                )
+            ),
+            int(os.environ.get("BENCH_COS_MAXPP", "8192")),
         ),
     ]
     # the budget must also bound a row that has not STARTED: predict each
@@ -484,7 +669,14 @@ def main() -> None:
             continue
         remaining = budget - (time.monotonic() - t_rows)
         row_reps = sparse_reps if kind == "sparse" else anchor_reps
+        # euclid adds one instrumented MFU run; cosine/sparse add a CPU
+        # baseline child (bounded by its own budget-derived timeout, so
+        # estimate half of that bound)
+        if kind == "euclidean" and os.environ.get("BENCH_MFU", "1") == "1":
+            row_reps += 1
         est = row_reps * row_n / headline_rate * cost_factor[kind]
+        if kind in ("cosine", "sparse") and not on_cpu:
+            est += min(1800, 0.4 * budget) / 2
         if remaining <= 0 or est > remaining:
             out[f"{prefix}_skipped"] = (
                 "time_budget" if remaining <= 0 else "est_over_budget"

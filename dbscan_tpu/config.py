@@ -80,6 +80,20 @@ class DBSCANConfig:
         dbscan_tpu/ops/banded.py; euclidean 2-D only). "auto" picks banded
         for partitions large enough that the windows pay off. Ignored when
         use_pallas is set.
+      auto_maxpp: when the densest single 2eps cell holds so many points
+        that max_points_per_partition under-fits it (the partitioner
+        cannot cut inside a cell, so partitions degenerate to near-single-
+        cell rectangles whose eps-halo bands duplicate heavily — measured
+        dup 2.37 on a 50M hotspot run at maxpp=32768), raise the
+        EFFECTIVE partition bound to a small multiple of that pileup
+        (capped, reported in stats["effective_maxpp"]). The cluster
+        structure is partitioning-independent (global ids renumber with
+        partition order; pinned up-to-permutation by the cross-maxpp
+        tests), so this is purely a layout/perf adjustment — but it does
+        change partition counts/shapes, so it is opt-in; the under-fit
+        regime is always WARNED about either way (reference analog: the
+        silent cannot-split-further path,
+        EvenSplitPartitioner.scala:85-92).
     """
 
     eps: float
@@ -91,6 +105,7 @@ class DBSCANConfig:
     bucket_multiple: int = 128
     use_pallas: bool = False
     neighbor_backend: str = "auto"
+    auto_maxpp: bool = False
 
     @property
     def eps_sq(self) -> float:

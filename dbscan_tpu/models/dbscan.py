@@ -93,6 +93,7 @@ def train(
     bucket_multiple: int = 128,
     use_pallas: bool = False,
     neighbor_backend: str = "auto",
+    auto_maxpp: bool = False,
     mesh=None,
     config: Optional[DBSCANConfig] = None,
     checkpoint_dir: Optional[str] = None,
@@ -119,6 +120,7 @@ def train(
         bucket_multiple=bucket_multiple,
         use_pallas=use_pallas,
         neighbor_backend=neighbor_backend,
+        auto_maxpp=auto_maxpp,
     )
     out: TrainOutput = train_arrays(
         data, cfg, mesh=mesh, checkpoint_dir=checkpoint_dir

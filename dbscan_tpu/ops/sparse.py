@@ -257,6 +257,9 @@ def _spill_sparse(
         spill_partition,
     )
 
+    import time as _time
+
+    t_start = _time.perf_counter()
     n = x.shape[0]
     if n <= max_points_per_partition:
         # reachable via the zero-row strip shrinking N under the cap
@@ -284,6 +287,7 @@ def _spill_sparse(
     part_ids, point_idx, n_parts, home_of = spill_partition(
         x.astype(np.float32), max_points_per_partition, halo
     )
+    t_spill = _time.perf_counter()
     counts = np.bincount(part_ids, minlength=n_parts)
     offsets = np.r_[0, np.cumsum(counts)]
     widths = [_ladder_width(int(c), 128) for c in counts]
@@ -327,11 +331,13 @@ def _spill_sparse(
         )
         seed_buf = _stash(seed_buf, res.seed_labels, int(slot_off[p]))
         flag_buf = _stash(flag_buf, res.flags, int(slot_off[p]))
+    t_leaves = _time.perf_counter()
 
     # the single pull, then reassembly in partition-major instance order
     # for the shared merge (each leaf's true size is counts[p])
     seeds_all = np.asarray(seed_buf)
     flags_all = np.asarray(flag_buf)
+    t_pull = _time.perf_counter()
     inst_seed = np.concatenate(
         [
             seeds_all[slot_off[p] : slot_off[p] + counts[p]]
@@ -349,4 +355,15 @@ def _spill_sparse(
         part_ids, point_idx, inst_seed, inst_flag, cand, inst_inner,
         n, n_parts, max_b,
     )
+    if stats_out is not None:
+        # phase split in the driver's timings idiom: where the wall goes
+        # (spill tree / host gram packing + leaf dispatch / the single
+        # result pull / host merge) so a slow row is attributable
+        stats_out["timings"] = {
+            "spill_partition_s": round(t_spill - t_start, 6),
+            "leaf_pack_dispatch_s": round(t_leaves - t_spill, 6),
+            "pull_s": round(t_pull - t_leaves, 6),
+            "merge_s": round(_time.perf_counter() - t_pull, 6),
+            "total_s": round(_time.perf_counter() - t_start, 6),
+        }
     return clusters, flags

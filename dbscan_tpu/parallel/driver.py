@@ -360,6 +360,53 @@ def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh, kernel_eps=None):
     )
 
 
+# auto_maxpp heuristic (VERDICT r3 item 7): effective bound >= this
+# multiple of the densest 2eps-cell pileup, capped at the known-good
+# production bucket width. K=4 keeps several hot cells per partition, so
+# halo bands stop dominating the partition area (the dup-2.37 regime).
+_MAXPP_PILEUP_K = 4
+_MAXPP_AUTO_CAP = 262144
+
+
+def _effective_maxpp(cfg: DBSCANConfig, counts: np.ndarray) -> int:
+    """Partition bound actually handed to the partitioner. The partitioner
+    cannot cut inside a 2eps cell (EvenSplitPartitioner.scala:85-92 hits
+    the same wall silently), so when the densest cell under-fits the
+    requested bound the partitions degenerate to near-single-cell
+    rectangles and the eps-halo duplication explodes. Raise the effective
+    bound to K x that pileup (capped), loudly; labels are partitioning-
+    independent so only layout/perf changes."""
+    maxpp = cfg.max_points_per_partition
+    if len(counts) == 0:
+        return maxpp
+    cmax = int(counts.max())
+    # the degenerate regime starts where a partition cannot even hold TWO
+    # of the densest cells — below that, layouts still amortize their halo
+    # over several hot cells and neither the warning nor the raise applies
+    if maxpp >= 2 * cmax:
+        return maxpp
+    floor = min(_MAXPP_AUTO_CAP, _MAXPP_PILEUP_K * cmax)
+    if floor <= maxpp:
+        return maxpp
+    if not cfg.auto_maxpp:
+        logger.warning(
+            "max_points_per_partition=%d under-fits the densest 2eps "
+            "cell (%d points): partitions degenerate toward single-cell "
+            "rectangles and eps-halo duplication grows (measured 2.4x "
+            "instance blow-up in this regime); pass auto_maxpp=True or "
+            "raise max_points_per_partition toward %d",
+            maxpp, cmax, floor,
+        )
+        return maxpp
+    logger.warning(
+        "max_points_per_partition=%d under-fits the densest 2eps cell "
+        "(%d points): raising the effective bound to %d to keep halo "
+        "duplication bounded (auto_maxpp=False keeps the requested bound)",
+        maxpp, cmax, floor,
+    )
+    return floor
+
+
 def _pad_idx(pos: np.ndarray) -> np.ndarray:
     """Pad a flat gather-index vector up the bucket ladder so the device
     gather compiles once per rung, not per data-dependent count (padding
@@ -868,14 +915,14 @@ def train_arrays(
         # single partition, dense engine: the whole dataset is one bucket
         _check_dense_width(binning._ladder_width(n, cfg.bucket_multiple), n)
 
+    maxpp_eff = cfg.max_points_per_partition
     if spatial:
         # 1-2. cell histogram + spatial partitioning (driver-local metadata).
         t0 = time.perf_counter()
         cells, counts, cell_inv = geo.cell_histogram_int(grid_pts, cell)
         t0 = _mark("histogram_s", t0)
-        parts = partitioner.partition_cells(
-            cells, counts, cfg.max_points_per_partition
-        )
+        maxpp_eff = _effective_maxpp(cfg, counts)
+        parts = partitioner.partition_cells(cells, counts, maxpp_eff)
         _mark("partition_s", t0)
         rects_int = np.stack([r for r, _ in parts])
         logger.info("found %d partitions for %d points", len(parts), n)
@@ -950,6 +997,14 @@ def train_arrays(
     # packer instead of serializing behind it.
     pending = []
     dispatch_spent = [0.0]
+    # DBSCAN_TIME_DEVICE=1: block synchronously on each banded phase-1
+    # dispatch and accumulate the pure device-execution window into
+    # timings["banded_p1_sync_s"]. This sacrifices pack/compute overlap
+    # (do NOT enable on a timed run) but isolates the sweep-kernel time
+    # the MFU accounting divides by — with async dispatch the device
+    # window hides under host phases and cannot be attributed.
+    time_device = _os.environ.get("DBSCAN_TIME_DEVICE") == "1"
+    sync_spent = [0.0]
     # Dispatch backpressure: every queued-but-unexecuted program pins its
     # input buffers (points/mask/run tables, ~25 B per padded slot) in
     # HBM, so letting the packer run arbitrarily far ahead of the device
@@ -1131,6 +1186,10 @@ def train_arrays(
                 out = _dispatch_banded_p1(g, cfg, mesh, kernel_eps)
         else:
             out = _dispatch_banded_p1(g, cfg, mesh, kernel_eps)
+        if time_device and g.banded is not None and out is not None:
+            ts = time.perf_counter()
+            jax.block_until_ready(out[0])
+            sync_spent[0] += time.perf_counter() - ts
         pending.append((g, out))
         if out is not None:
             sz = g.mask.shape[0] * g.mask.shape[1]
@@ -1181,11 +1240,13 @@ def train_arrays(
             on_group=_on_group,
         )
     timings["dispatch_s"] = round(
-        dispatch_spent[0] - eager["pull_spent"], 6
+        dispatch_spent[0] - eager["pull_spent"] - sync_spent[0], 6
     )
     timings["bucketize_s"] = round(
         time.perf_counter() - t0 - dispatch_spent[0], 6
     )
+    if time_device:
+        timings["banded_p1_sync_s"] = round(sync_spent[0], 6)
     t0 = time.perf_counter()
 
     # 5. per-partition clustering on device, one launch per bucket width
@@ -1449,6 +1510,24 @@ def train_arrays(
     inst_flag = np.concatenate(inst_flag_l) if inst_flag_l else np.empty(0, np.int8)
     t0 = _mark("device_s", t0)
 
+    # Arithmetic work the banded sweeps execute, counted from the exact
+    # dispatched shapes (padded slots — what the device actually runs):
+    # per (point slot, window row, slab element) each sweep computes D
+    # differences, D squares, D-1 adds and 1 compare (~3D flops,
+    # window/mask logic excluded — a conservative count), and phase 1 is
+    # two sweeps (counts + bits). Divided by the isolated device window
+    # (timings["banded_p1_sync_s"] under DBSCAN_TIME_DEVICE=1) this
+    # grounds the bench's MFU figure (VERDICT r3 item 3).
+    banded_sweep_flops = 0
+    for g in groups:
+        if g.banded is not None:
+            p_g, b_g = g.points.shape[:2]
+            d_g = g.points.shape[2]
+            banded_sweep_flops += (
+                2 * p_g * b_g * binning.BANDED_ROWS
+                * int(g.banded.slab) * 3 * d_g
+            )
+
     # core stats: one schema shared by the final output, the checkpoint
     # scalars, and (verbatim) the resumed run's stats
     core_stats = {
@@ -1457,6 +1536,8 @@ def train_arrays(
         "bucket_size": int(max_b),
         "n_bucket_groups": len(groups),
         "n_banded_groups": sum(1 for g in groups if g.banded is not None),
+        "banded_sweep_flops": int(banded_sweep_flops),
+        "effective_maxpp": int(maxpp_eff),
         "duplication_factor": float(len(part_ids)) / max(1, n),
         "n_core_instances": int(n_core),
         "projected": sph is not None,  # spherical embedding in effect

@@ -152,3 +152,62 @@ def test_candidate_counts_matches_broadcast_oracle(rng):
             cells, counts, partitioner._possible_splits(rect)
         )
         np.testing.assert_array_equal(fast, oracle)
+
+
+def test_effective_maxpp_heuristic():
+    """auto_maxpp (VERDICT r3 item 7): when the densest 2eps cell
+    under-fits the requested bound, the effective bound rises to
+    K x pileup (capped) under auto_maxpp=True and stays put (warned)
+    under the default."""
+    from dbscan_tpu.config import DBSCANConfig
+    from dbscan_tpu.parallel import driver
+
+    counts = np.array([10, 25000, 300], dtype=np.int64)
+    base = dict(eps=0.3, min_points=10)
+    off = DBSCANConfig(max_points_per_partition=32768, **base)
+    assert driver._effective_maxpp(off, counts) == 32768
+    on = DBSCANConfig(
+        max_points_per_partition=32768, auto_maxpp=True, **base
+    )
+    assert driver._effective_maxpp(on, counts) == 4 * 25000
+    # already-fitting bound: untouched either way
+    big = DBSCANConfig(
+        max_points_per_partition=200000, auto_maxpp=True, **base
+    )
+    assert driver._effective_maxpp(big, counts) == 200000
+    # cap: a monster pileup cannot push the bound past the known-good
+    # production bucket width
+    huge = np.array([1_000_000], dtype=np.int64)
+    assert driver._effective_maxpp(on, huge) == driver._MAXPP_AUTO_CAP
+    assert driver._effective_maxpp(off, np.empty(0, np.int64)) == 32768
+
+
+def test_auto_maxpp_labels_unchanged(rng):
+    """Raising the effective bound only changes the partition layout:
+    the cluster STRUCTURE must match the default run exactly (global ids
+    renumber with partition enumeration order, as in the reference's
+    localClusterIds fold — so equality is up to label permutation).
+    NAIVE engine: its order-free algebra is exactly partitioning-
+    invariant; Archery's visited-noise adoption is order-dependent near
+    seams (a border point adjacent to two clusters may be adopted by
+    either), so it only agrees up to those adoptions."""
+    from dbscan_tpu import Engine, train
+    from dbscan_tpu.utils.ari import exact_match_up_to_permutation
+
+    pts = np.concatenate(
+        [rng.normal(c, 0.05, (1500, 2)) for c in [(0, 0), (3, 3), (6, 0)]]
+        + [rng.uniform(-1, 7, (500, 2))]
+    )
+    kw = dict(eps=0.3, min_points=6, engine=Engine.NAIVE)
+    m_off = train(pts, max_points_per_partition=400, **kw)
+    m_on = train(
+        pts, max_points_per_partition=400, auto_maxpp=True, **kw
+    )
+    assert m_on.stats["effective_maxpp"] > 400
+    assert m_on.stats["n_partitions"] <= m_off.stats["n_partitions"]
+    assert exact_match_up_to_permutation(m_off.clusters, m_on.clusters)
+    np.testing.assert_array_equal(m_off.flags, m_on.flags)
+    assert (
+        m_on.stats["duplication_factor"]
+        <= m_off.stats["duplication_factor"]
+    )
