@@ -671,12 +671,29 @@ def main() -> None:
         row_reps = sparse_reps if kind == "sparse" else anchor_reps
         # euclid adds one instrumented MFU run; cosine/sparse add a CPU
         # baseline child (bounded by its own budget-derived timeout, so
-        # estimate half of that bound)
-        if kind == "euclidean" and os.environ.get("BENCH_MFU", "1") == "1":
+        # estimate half of that bound) — charge only sub-runs that will
+        # actually execute
+        if (
+            kind == "euclidean"
+            and not on_cpu
+            and os.environ.get("BENCH_MFU", "1") == "1"
+        ):
             row_reps += 1
         est = row_reps * row_n / headline_rate * cost_factor[kind]
-        if kind in ("cosine", "sparse") and not on_cpu:
-            est += min(1800, 0.4 * budget) / 2
+        if (
+            kind in ("cosine", "sparse")
+            and not on_cpu
+            and os.environ.get("BENCH_ROW_BASELINES", "1") != "0"
+        ):
+            est += (
+                float(
+                    os.environ.get(
+                        "BENCH_ROW_BASELINE_TIMEOUT_S",
+                        str(min(1800, 0.4 * budget)),
+                    )
+                )
+                / 2
+            )
         if remaining <= 0 or est > remaining:
             out[f"{prefix}_skipped"] = (
                 "time_budget" if remaining <= 0 else "est_over_budget"

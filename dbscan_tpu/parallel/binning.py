@@ -11,6 +11,7 @@ compiled kernel handles every partition — no dynamic shapes under jit.
 from __future__ import annotations
 
 import math
+import os
 from typing import NamedTuple, Tuple
 
 import numpy as np
@@ -706,72 +707,89 @@ def bucketize_banded(
             max_b = max(max_b, dmax)
 
     sstart32 = sstart.astype(np.int32)
+    # Cap the slots per emitted group: one (width, win) class at 100M
+    # scale would otherwise pack into a single enormous group, making the
+    # group both the dispatch unit AND the compact-chunk/checkpoint
+    # granularity — minutes of continuous device work before the first
+    # restart point can even form (the round-3 worker-endurance campaign
+    # failed exactly there, zero chunks saved). Splitting a class into
+    # slot-bounded groups keeps jit signatures shared (same b/w), bounds
+    # the per-dispatch HBM residency, and lets retry loops shrink the
+    # restart granularity with DBSCAN_GROUP_SLOTS alongside
+    # DBSCAN_COMPACT_CHUNK_SLOTS. Labels are group-batching independent
+    # (cell ids are global; the postpass and finalize are per-partition).
+    group_slot_cap = int(os.environ.get("DBSCAN_GROUP_SLOTS", str(1 << 26)))
     for b, w in sorted(
         set(zip(widths_band[use_banded].tolist(), win[use_banded].tolist()))
     ):
-        sel_parts = np.flatnonzero(
+        sel_class = np.flatnonzero(
             use_banded & (widths_band == b) & (win == w)
         )
-        nb = b // t
-        p_pad = max(1, math.ceil(len(sel_parts) / pad_parts_to) * pad_parts_to)
-        pid = np.full(p_pad, -1, dtype=np.int64)
-        pid[: len(sel_parts)] = sel_parts
-        sl_b = np.zeros((p_pad, nb, BANDED_ROWS), dtype=np.int32)
-        sl_b[: len(sel_parts)] = sstart[
-            sel_parts[:, None] * maxnb + np.arange(nb)[None, :]
-        ]
-        packed = (
-            _native.pack_banded_group(
-                sel_parts, p_pad, part_start, counts, order, pts64,
-                point_idx, cx_s, cell_rank, ustarts, uspans, sstart32,
-                maxnb, t, b, dtype, run_dtype, d_out=pts.shape[1],
+        per_group = max(1, group_slot_cap // b)
+        if per_group > pad_parts_to:  # align to the mesh pad where possible
+            per_group = per_group // pad_parts_to * pad_parts_to
+        for s0 in range(0, len(sel_class), per_group):
+            sel_parts = sel_class[s0 : s0 + per_group]
+            nb = b // t
+            p_pad = max(1, math.ceil(len(sel_parts) / pad_parts_to) * pad_parts_to)
+            pid = np.full(p_pad, -1, dtype=np.int64)
+            pid[: len(sel_parts)] = sel_parts
+            sl_b = np.zeros((p_pad, nb, BANDED_ROWS), dtype=np.int32)
+            sl_b[: len(sel_parts)] = sstart[
+                sel_parts[:, None] * maxnb + np.arange(nb)[None, :]
+            ]
+            packed = (
+                _native.pack_banded_group(
+                    sel_parts, p_pad, part_start, counts, order, pts64,
+                    point_idx, cx_s, cell_rank, ustarts, uspans, sstart32,
+                    maxnb, t, b, dtype, run_dtype, d_out=pts.shape[1],
+                )
+                if native is not None
+                else None
             )
-            if native is not None
-            else None
-        )
-        if packed is not None:
-            buf, mask, idx, fold_b, st_b, sp_b, cx_b, cgid_b = packed
-        else:
-            buf = np.zeros((p_pad, b, pts.shape[1]), dtype=dtype)
-            mask = np.zeros((p_pad, b), dtype=bool)
-            idx = np.full((p_pad, b), -1, dtype=np.int64)
-            iota = np.arange(b, dtype=np.int32)
-            fold_b = np.broadcast_to(iota, (p_pad, b)).copy()
-            st_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=run_dtype)
-            sp_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=run_dtype)
-            cx_b = np.zeros((p_pad, b), dtype=np.int32)
-            cgid_b = np.full((p_pad, b), -1, dtype=np.int64)
+            if packed is not None:
+                buf, mask, idx, fold_b, st_b, sp_b, cx_b, cgid_b = packed
+            else:
+                buf = np.zeros((p_pad, b, pts.shape[1]), dtype=dtype)
+                mask = np.zeros((p_pad, b), dtype=bool)
+                idx = np.full((p_pad, b), -1, dtype=np.int64)
+                iota = np.arange(b, dtype=np.int32)
+                fold_b = np.broadcast_to(iota, (p_pad, b)).copy()
+                st_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=run_dtype)
+                sp_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=run_dtype)
+                cx_b = np.zeros((p_pad, b), dtype=np.int32)
+                cgid_b = np.full((p_pad, b), -1, dtype=np.int64)
 
-            # slice each partition's contiguous instance range (instances
-            # are partition-sorted) — no O(M) membership scan per group
-            gi = _segment_indices(part_start[sel_parts], counts[sel_parts])
-            rows = np.repeat(np.arange(len(sel_parts)), counts[sel_parts])
-            slots = slots_s[gi]
-            buf[rows, slots] = xy_s[gi]
-            mask[rows, slots] = True
-            idx[rows, slots] = ptidx_s[gi]
-            fold_b[rows, slots] = fold_s[gi]
-            # Per-instance run start within its slab (invalid runs pin to
-            # 0 rather than inheriting a meaningless negative offset);
-            # gathered from unique-cell space for this group's instances.
-            cr = cell_rank[gi]
-            sp_i = uspans[cr]
-            st_i = ustarts[cr] - sstart32[p_s[gi] * maxnb + slots_s[gi] // t]
-            st_b[rows, slots] = np.where(sp_i > 0, st_i, 0)
-            sp_b[rows, slots] = sp_i
-            cx_b[rows, slots] = cx_s[gi]
-            cgid_b[rows, slots] = cell_rank[gi]
+                # slice each partition's contiguous instance range (instances
+                # are partition-sorted) — no O(M) membership scan per group
+                gi = _segment_indices(part_start[sel_parts], counts[sel_parts])
+                rows = np.repeat(np.arange(len(sel_parts)), counts[sel_parts])
+                slots = slots_s[gi]
+                buf[rows, slots] = xy_s[gi]
+                mask[rows, slots] = True
+                idx[rows, slots] = ptidx_s[gi]
+                fold_b[rows, slots] = fold_s[gi]
+                # Per-instance run start within its slab (invalid runs pin to
+                # 0 rather than inheriting a meaningless negative offset);
+                # gathered from unique-cell space for this group's instances.
+                cr = cell_rank[gi]
+                sp_i = uspans[cr]
+                st_i = ustarts[cr] - sstart32[p_s[gi] * maxnb + slots_s[gi] // t]
+                st_b[rows, slots] = np.where(sp_i > 0, st_i, 0)
+                sp_b[rows, slots] = sp_i
+                cx_b[rows, slots] = cx_s[gi]
+                cgid_b[rows, slots] = cell_rank[gi]
 
-        rc = np.zeros(p_pad, dtype=np.int64)
-        rc[: len(sel_parts)] = counts[sel_parts]
-        groups.append(
-            BucketGroup(
-                buf, mask, idx, pid,
-                BandedExtras(fold_b, st_b, sp_b, sl_b, int(w), cx_b, cgid_b),
-                row_counts=rc,
+            rc = np.zeros(p_pad, dtype=np.int64)
+            rc[: len(sel_parts)] = counts[sel_parts]
+            groups.append(
+                BucketGroup(
+                    buf, mask, idx, pid,
+                    BandedExtras(fold_b, st_b, sp_b, sl_b, int(w), cx_b, cgid_b),
+                    row_counts=rc,
+                )
             )
-        )
-        if on_group is not None:
-            on_group(groups[-1])
-        max_b = max(max_b, b)
+            if on_group is not None:
+                on_group(groups[-1])
+            max_b = max(max_b, b)
     return groups, max_b, meta

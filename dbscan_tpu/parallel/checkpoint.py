@@ -64,6 +64,14 @@ def run_fingerprint(pts: np.ndarray, cfg) -> str:
                 "neighbor_backend": cfg.neighbor_backend,
                 "bucket_multiple": cfg.bucket_multiple,
                 "use_pallas": cfg.use_pallas,
+                # changes the bound handed to the partitioner, hence the
+                # whole layout the saved state encodes
+                "auto_maxpp": getattr(cfg, "auto_maxpp", False),
+                # changes group batching, hence the p1-chunk composition
+                # the ordinal-salted chunk signatures describe; shapes are
+                # ladder-quantized so sigs alone can collide across
+                # layouts — key the whole checkpoint space on it instead
+                "group_slots": os.environ.get("DBSCAN_GROUP_SLOTS", ""),
             },
             sort_keys=True,
         ).encode()

@@ -51,6 +51,7 @@ from dbscan_tpu.ops import geometry as geo
 from dbscan_tpu.ops.labels import CORE, NOISE, SEED_NONE
 from dbscan_tpu.ops.local_dbscan import local_dbscan
 from dbscan_tpu.parallel import binning, cellgraph, partitioner
+from dbscan_tpu.parallel import mesh as mesh_mod
 from dbscan_tpu.parallel.graph import uf_components
 from dbscan_tpu.parallel.mesh import PARTS_AXIS, mesh_size
 
@@ -328,7 +329,10 @@ def _dispatch_partitions(
         batch,
         mesh,
     )
-    return fn(group.points, group.mask)
+    return fn(
+        mesh_mod.shard_host_array(mesh, group.points),
+        mesh_mod.shard_host_array(mesh, group.mask),
+    )
 
 
 def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh, kernel_eps=None):
@@ -355,8 +359,13 @@ def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh, kernel_eps=None):
         use_pallas=bool(cfg.use_pallas),
     )
     return fn(
-        group.points, group.mask, ext.rel_starts, ext.spans,
-        ext.slab_starts, ext.cx,
+        *(
+            mesh_mod.shard_host_array(mesh, a)
+            for a in (
+                group.points, group.mask, ext.rel_starts, ext.spans,
+                ext.slab_starts, ext.cx,
+            )
+        )
     )
 
 
@@ -385,9 +394,9 @@ def _effective_maxpp(cfg: DBSCANConfig, counts: np.ndarray) -> int:
     # over several hot cells and neither the warning nor the raise applies
     if maxpp >= 2 * cmax:
         return maxpp
+    # under-fit regime detected: ALWAYS say so (the config contract),
+    # whatever the raise decision below turns out to be
     floor = min(_MAXPP_AUTO_CAP, _MAXPP_PILEUP_K * cmax)
-    if floor <= maxpp:
-        return maxpp
     if not cfg.auto_maxpp:
         logger.warning(
             "max_points_per_partition=%d under-fits the densest 2eps "
@@ -396,6 +405,15 @@ def _effective_maxpp(cfg: DBSCANConfig, counts: np.ndarray) -> int:
             "instance blow-up in this regime); pass auto_maxpp=True or "
             "raise max_points_per_partition toward %d",
             maxpp, cmax, floor,
+        )
+        return maxpp
+    if floor <= maxpp:
+        logger.warning(
+            "densest 2eps cell holds %d points — more than half of "
+            "max_points_per_partition=%d — and auto_maxpp cannot raise "
+            "the bound further (cap %d): halo duplication may grow with "
+            "near-single-cell partitions",
+            cmax, maxpp, _MAXPP_AUTO_CAP,
         )
         return maxpp
     logger.warning(
@@ -765,6 +783,18 @@ def train_arrays(
 
     ckpt_fp = None
     if checkpoint_dir is not None:
+        if mesh_mod.multiprocess():
+            # per-chunk skip/hit decisions are process-local state, but
+            # the miss branch issues cross-process collectives — hosts
+            # with divergent checkpoint contents would deadlock in them;
+            # and every process writing the same files races. Fail fast
+            # until a coordinator-mediated scheme exists.
+            raise ValueError(
+                "checkpoint_dir is not supported in multi-process runs: "
+                "checkpoint state must be identical on every host or the "
+                "resume-skip control flow desynchronizes the collective "
+                "sequence; run checkpointed jobs single-process"
+            )
         from dbscan_tpu.parallel import checkpoint as _ckpt
 
         ckpt_fp = _ckpt.run_fingerprint(pts, cfg)
@@ -1005,6 +1035,7 @@ def train_arrays(
     # window hides under host phases and cannot be attributed.
     time_device = _os.environ.get("DBSCAN_TIME_DEVICE") == "1"
     sync_spent = [0.0]
+    flops_spent = [0]
     # Dispatch backpressure: every queued-but-unexecuted program pins its
     # input buffers (points/mask/run tables, ~25 B per padded slot) in
     # HBM, so letting the packer run arbitrarily far ahead of the device
@@ -1073,6 +1104,10 @@ def train_arrays(
         (chunk composition diverged — e.g. a changed chunk budget)."""
         g = pending[i][0]
         out = _dispatch_banded_p1(g, cfg, mesh, kernel_eps)
+        flops_spent[0] += (
+            2 * g.points.shape[0] * g.points.shape[1] * binning.BANDED_ROWS
+            * int(g.banded.slab) * 3 * g.points.shape[2]
+        )
         pending[i] = (g, out)
         jax.block_until_ready(out[0])
 
@@ -1084,15 +1119,16 @@ def train_arrays(
         tp = time.perf_counter()
         layout = rec["layout"]
         total = layout["total"]
-        combo_host = np.asarray(rec.pop("combo_dev"))
+        combo_host = mesh_mod.pull_to_host(rec.pop("combo_dev"))
         core_ch = np.unpackbits(
             combo_host[: total // 8], count=total
         ).astype(bool)
         bpos = np.flatnonzero(layout["validflat"] & ~core_ch)
         bb_dev = gather_flat(
-            rec.pop("bits_flat"), jnp.asarray(_pad_idx(bpos))
+            rec.pop("bits_flat"),
+            mesh_mod.replicate_host_array(_pad_idx(bpos)),
         )
-        bbits = np.asarray(bb_dev)[: len(bpos)]
+        bbits = mesh_mod.pull_to_host(bb_dev)[: len(bpos)]
         rec["combo_host"] = combo_host
         rec["core_ch"] = core_ch
         rec["bpos"] = bpos
@@ -1150,10 +1186,15 @@ def train_arrays(
             combo_dev, bits_flat = banded_postpass(
                 tuple(pending[i][1][0] for i in ch),
                 tuple(pending[i][1][1] for i in ch),
-                tuple(jnp.asarray(f) for f in layout["segflags"]),
-                jnp.asarray(_pad_idx(layout["or_pos"])),
+                tuple(
+                    mesh_mod.replicate_host_array(f)
+                    for f in layout["segflags"]
+                ),
+                mesh_mod.replicate_host_array(_pad_idx(layout["or_pos"])),
             )
-            combo_dev.copy_to_host_async()
+            if not mesh_mod.multiprocess():
+                # local-shard async copy; cross-host pulls gather instead
+                combo_dev.copy_to_host_async()
             rec["layout"] = layout
             rec["combo_dev"] = combo_dev
             rec["bits_flat"] = bits_flat
@@ -1161,8 +1202,15 @@ def train_arrays(
         # pipeline by default (pull chunk i-1 while chunk i's phase-1
         # work executes); DBSCAN_EAGER_PULL=1 pulls each chunk at its
         # own flush — resilience over overlap, for retry loops on a
-        # worker that keeps dying before the delayed pull lands
-        if _os.environ.get("DBSCAN_EAGER_PULL") == "1":
+        # worker that keeps dying before the delayed pull lands.
+        # Multi-process: forced OFF — pulls issue cross-process
+        # collectives, and an env var set on only some hosts would
+        # desynchronize the collective order (the checkpointing it
+        # serves is single-process anyway)
+        if (
+            _os.environ.get("DBSCAN_EAGER_PULL") == "1"
+            and not mesh_mod.multiprocess()
+        ):
             _pull_record(rec)
         elif len(eager["records"]) >= 2:
             _pull_record(eager["records"][-2])
@@ -1186,6 +1234,15 @@ def train_arrays(
                 out = _dispatch_banded_p1(g, cfg, mesh, kernel_eps)
         else:
             out = _dispatch_banded_p1(g, cfg, mesh, kernel_eps)
+        if g.banded is not None and out is not None:
+            # sweep-FLOP accounting covers DISPATCHED groups only — a
+            # checkpoint-covered skip ran nothing, and counting it would
+            # overstate the MFU figure on resumed runs
+            p_g, b_g = g.points.shape[:2]
+            flops_spent[0] += (
+                2 * p_g * b_g * binning.BANDED_ROWS
+                * int(g.banded.slab) * 3 * g.points.shape[2]
+            )
         if time_device and g.banded is not None and out is not None:
             ts = time.perf_counter()
             jax.block_until_ready(out[0])
@@ -1467,8 +1524,8 @@ def train_arrays(
             p1_np = [
                 (
                     pending[i][0],
-                    np.asarray(pending[i][1][0]),
-                    np.asarray(pending[i][1][1]),
+                    mesh_mod.pull_to_host(pending[i][1][0]),
+                    mesh_mod.pull_to_host(pending[i][1][1]),
                 )
                 for i in b_idx
             ]
@@ -1486,7 +1543,8 @@ def train_arrays(
     n_core = 0
     inst_seed_l, inst_flag_l = [], []
     for i, (g, (seeds_dev, flags_dev, nc)) in enumerate(pending):
-        seeds_g, flags_g = np.asarray(seeds_dev), np.asarray(flags_dev)
+        seeds_g = mesh_mod.pull_to_host(seeds_dev)
+        flags_g = mesh_mod.pull_to_host(flags_dev)
         n_core += int(nc)
         if seeds_g.ndim == 1:
             # finalize_compact already emits flat valid-prefix arrays in
@@ -1510,23 +1568,16 @@ def train_arrays(
     inst_flag = np.concatenate(inst_flag_l) if inst_flag_l else np.empty(0, np.int8)
     t0 = _mark("device_s", t0)
 
-    # Arithmetic work the banded sweeps execute, counted from the exact
-    # dispatched shapes (padded slots — what the device actually runs):
-    # per (point slot, window row, slab element) each sweep computes D
+    # Arithmetic work the banded sweeps executed, accumulated at dispatch
+    # (_on_group) from the exact dispatched shapes (padded slots — what
+    # the device actually ran; checkpoint-covered skips excluded): per
+    # (point slot, window row, slab element) each sweep computes D
     # differences, D squares, D-1 adds and 1 compare (~3D flops,
     # window/mask logic excluded — a conservative count), and phase 1 is
     # two sweeps (counts + bits). Divided by the isolated device window
     # (timings["banded_p1_sync_s"] under DBSCAN_TIME_DEVICE=1) this
     # grounds the bench's MFU figure (VERDICT r3 item 3).
-    banded_sweep_flops = 0
-    for g in groups:
-        if g.banded is not None:
-            p_g, b_g = g.points.shape[:2]
-            d_g = g.points.shape[2]
-            banded_sweep_flops += (
-                2 * p_g * b_g * binning.BANDED_ROWS
-                * int(g.banded.slab) * 3 * d_g
-            )
+    banded_sweep_flops = flops_spent[0]
 
     # core stats: one schema shared by the final output, the checkpoint
     # scalars, and (verbatim) the resumed run's stats

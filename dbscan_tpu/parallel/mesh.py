@@ -13,9 +13,70 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 PARTS_AXIS = "parts"
+
+
+def multiprocess() -> bool:
+    """True when this JAX runtime spans multiple processes (DCN job)."""
+    return jax.process_count() > 1
+
+
+def shard_host_array(mesh: Optional[Mesh], x):
+    """Host (numpy) array -> device input for a partition-sharded jit.
+
+    Single-process: return the array unchanged (jit device-puts it; this
+    is the zero-overhead path every existing call rides). Multi-process:
+    a numpy array cannot feed a jit whose sharding spans non-addressable
+    devices, so build a global jax.Array — every process packs the SAME
+    full array deterministically, and each contributes exactly its
+    addressable shards via the callback (the slice is taken from the
+    replicated host copy, so no cross-host data movement happens here).
+    This is the Spark-executor data plane inverted: instead of the driver
+    shipping partitions to executors, every host derives the global
+    layout and keeps only its slice on its devices.
+    """
+    if mesh is None or not multiprocess():
+        return x
+    sharding = NamedSharding(mesh, PartitionSpec(PARTS_AXIS))
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+
+def replicate_host_array(x):
+    """Host array -> replicated jit input.
+
+    Multi-process: return the numpy array UNCHANGED — every process
+    passes the identical (deterministically derived) value and jit
+    treats it as replicated; a jnp.asarray here would commit it to one
+    process's local device and clash with global-array co-inputs.
+    Single-process: jnp.asarray, which starts the host->device transfer
+    early (the existing async-dispatch behavior).
+    """
+    if multiprocess():
+        return x
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)
+
+
+def pull_to_host(x) -> np.ndarray:
+    """Device output -> full numpy array on EVERY host.
+
+    Single-process: plain np.asarray (the existing pull path, including
+    donated/committed arrays). Multi-process: shards of a global array
+    are only locally addressable, so gather them across hosts first
+    (DCN allgather via multihost_utils) — the host-side phases (cell-CC,
+    merge) run replicated on every process, which keeps them
+    deterministic and identical to the single-process result.
+    """
+    if isinstance(x, np.ndarray) or not multiprocess():
+        return np.asarray(x)
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
 def make_mesh(devices: Optional[Sequence] = None) -> Mesh:

@@ -250,3 +250,35 @@ def test_slab_chunked_sweeps_match_unchunked(rng, monkeypatch):
         jax.clear_caches()
     np.testing.assert_array_equal(ref.clusters, chunked.clusters)
     np.testing.assert_array_equal(ref.flags, chunked.flags)
+
+
+def test_group_slot_cap_label_transparent(rng, monkeypatch):
+    """DBSCAN_GROUP_SLOTS splits a (width, win) class into slot-bounded
+    groups (the restart-granularity lever the 100M campaign needs); the
+    batching must be invisible to results — same labels, flags, core
+    count — while producing strictly more banded groups."""
+    from dbscan_tpu import Engine, train
+    from dbscan_tpu.parallel import driver as driver_mod
+
+    pts = np.concatenate(
+        [rng.normal(c, 0.6, (1200, 2)) for c in [(0, 0), (6, 6), (-5, 7)]]
+        + [rng.uniform(-10, 12, (600, 2))]
+    )
+    kw = dict(
+        eps=0.3,
+        min_points=6,
+        max_points_per_partition=700,
+        engine=Engine.ARCHERY,
+        neighbor_backend="banded",
+    )
+    ref = train(pts, **kw)
+    monkeypatch.setenv("DBSCAN_GROUP_SLOTS", "1024")  # ~1 partition/group
+    driver_mod.clear_compile_cache()
+    try:
+        split = train(pts, **kw)
+    finally:
+        driver_mod.clear_compile_cache()
+    assert split.stats["n_banded_groups"] > ref.stats["n_banded_groups"]
+    np.testing.assert_array_equal(ref.clusters, split.clusters)
+    np.testing.assert_array_equal(ref.flags, split.flags)
+    assert ref.stats["n_core_instances"] == split.stats["n_core_instances"]

