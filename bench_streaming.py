@@ -1,0 +1,135 @@
+"""Streaming micro-batch benchmark (BASELINE.json configs[4]).
+
+Prints ONE JSON line: steady-state micro-batch throughput through
+StreamingDBSCAN.update on the live backend, jit-cache reuse evidence
+(XLA compile count per batch, via jax_log_compiles), and identity
+stability (engineered persistent blobs must keep their stream ids
+across every update).
+
+Workload: K persistent hotspots + per-batch noise, all batches the same
+size so the static bucket ladder (parallel/binning.py) repeats shapes —
+steady-state updates must hit the jit cache (0 compiles) after the first
+batch compiles the rungs.
+
+Env knobs: BENCH_STREAM_BATCH (points per micro-batch, default 200k),
+BENCH_STREAM_BATCHES (default 10), BENCH_STREAM_MAXPP (default 65536),
+BENCH_STREAM_WINDOW (default 3).
+"""
+
+import json
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+EPS = 0.35
+MIN_POINTS = 10
+K = 64
+
+
+def make_batch(rng, n: int):
+    """One micro-batch: 90% points from the K persistent hotspots (known
+    membership), 10% fresh uniform noise."""
+    gx = int(np.ceil(np.sqrt(K)))
+    centers = np.stack(
+        np.meshgrid(np.arange(gx) * 4.0, np.arange(gx) * 4.0), -1
+    ).reshape(-1, 2)[:K]
+    n_blob = n * 9 // 10
+    blob_of = rng.integers(0, K, n_blob)
+    pts = np.concatenate(
+        [
+            centers[blob_of] + rng.normal(0, 0.1, (n_blob, 2)),
+            rng.uniform(-2, gx * 4.0, (n - n_blob, 2)),
+        ]
+    )
+    return pts, blob_of, n_blob
+
+
+class _CompileCounter(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.count = 0
+
+    def emit(self, record):
+        if "Compiling" in record.getMessage():
+            self.count += 1
+
+
+def main() -> None:
+    batch_n = int(os.environ.get("BENCH_STREAM_BATCH", "200000"))
+    n_batches = int(os.environ.get("BENCH_STREAM_BATCHES", "10"))
+    maxpp = int(os.environ.get("BENCH_STREAM_MAXPP", "65536"))
+    window = int(os.environ.get("BENCH_STREAM_WINDOW", "3"))
+
+    import jax
+
+    jax.config.update("jax_log_compiles", True)
+    counter = _CompileCounter()
+    logging.getLogger("jax._src.dispatch").addHandler(counter)
+    logging.getLogger("jax._src.interpreters.pxla").addHandler(counter)
+
+    from dbscan_tpu.streaming import StreamingDBSCAN
+
+    rng = np.random.default_rng(7)
+    stream = StreamingDBSCAN(
+        EPS, MIN_POINTS, max_points_per_partition=maxpp, window=window
+    )
+
+    walls, compiles, blob_ids = [], [], []
+    stable = True
+    for b in range(n_batches):
+        pts, blob_of, n_blob = make_batch(rng, batch_n)
+        c0 = counter.count
+        t0 = time.perf_counter()
+        upd = stream.update(pts)
+        walls.append(time.perf_counter() - t0)
+        compiles.append(counter.count - c0)
+        # identity stability: each hotspot's majority stream id (resolved
+        # through the union-find) must never change once assigned
+        labels = stream.resolve(upd.clusters[:n_blob])
+        ids_now = np.zeros(K, dtype=np.int64)
+        for k in range(K):
+            lk = labels[blob_of == k]
+            lk = lk[lk > 0]
+            if len(lk):
+                ids_now[k] = np.bincount(lk).argmax()
+        if blob_ids:
+            prev = blob_ids[-1]
+            both = (prev > 0) & (ids_now > 0)
+            if not np.array_equal(
+                stream.resolve(prev[both]), stream.resolve(ids_now[both])
+            ):
+                stable = False
+        blob_ids.append(ids_now)
+
+    # steady state = batches that hit the jit cache completely (the first
+    # `window` batches keep growing the window skeleton, which changes
+    # the padded N and compiles new ladder rungs until it saturates)
+    steady = [w for w, c in zip(walls, compiles) if c == 0] or walls[-1:]
+    steady_s = float(np.median(steady))
+    out = {
+        "metric": "dbscan_streaming_microbatch_throughput",
+        "value": round(batch_n / steady_s / 1e6, 4),
+        "unit": "Mpoints/s",
+        "backend": jax.default_backend(),
+        "batch_points": batch_n,
+        "n_batches": n_batches,
+        "window": window,
+        "maxpp": maxpp,
+        "batch_walls_s": [round(w, 3) for w in walls],
+        "compiles_per_batch": compiles,
+        "steady_state_compiles": int(sum(compiles[2:])),
+        "identity_stable": bool(stable),
+        "first_batch_s": round(walls[0], 3),
+        "steady_batch_s": round(steady_s, 3),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

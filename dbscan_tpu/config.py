@@ -94,6 +94,14 @@ class DBSCANConfig:
         regime is always WARNED about either way (reference analog: the
         silent cannot-split-further path,
         EvenSplitPartitioner.scala:85-92).
+      static_partition_pad: pad each bucket group's PARTITION axis up a
+        geometric ladder instead of to the exact mesh multiple. A
+        data-dependent partition count mints a fresh jit signature per
+        run; the ladder makes group shapes recur, which is what lets
+        streaming micro-batches (streaming.py, which sets this) hit the
+        compile cache at steady state. Costs up to ~1.5x padded (masked,
+        cheaply skipped but still swept) partitions per group, so
+        one-shot batch runs keep it off.
     """
 
     eps: float
@@ -106,6 +114,7 @@ class DBSCANConfig:
     use_pallas: bool = False
     neighbor_backend: str = "auto"
     auto_maxpp: bool = False
+    static_partition_pad: bool = False
 
     @property
     def eps_sq(self) -> float:

@@ -96,7 +96,40 @@ def _tile_adj(bl_planes, bm_row, brel, bspan, slabs, smask, offs, eps2, k):
     )
 
 
-def _make_counts_kernel(d: int, sc: int):
+def _accumulate(out, acc_ref, val, nsub: int, ns: int, combine):
+    """Chunk-accumulation plumbing shared by both kernels, grid
+    (nb, ns, nsub) with the slab-chunk dim s in the MIDDLE: a fetched
+    [R, SC] chunk stays resident across a block's nsub sub-row steps
+    (the big operand moves once per chunk, not once per sub-row). The
+    running value can NOT live in the output ref — out blocks for a
+    given (block, sub-row) are revisited non-consecutively across s, and
+    Mosaic's output pipelining only preserves consecutively-revisited
+    blocks (confirmed on-chip: ref-accumulation here produced corrupt
+    bits). Instead a persistent [nsub, T] VMEM scratch holds one running
+    row per sub-row, addressed with STATICALLY unrolled predication
+    (pl.when on the sub-row id — nsub is 4; dynamic sublane starts are
+    the thing Mosaic makes expensive), and the final chunk writes the
+    scratch row through to the out block."""
+    s = pl.program_id(1)
+    j = pl.program_id(2)
+    for jj in range(nsub):
+
+        @pl.when(j == jj)
+        def _one_row():
+            @pl.when(s == 0)
+            def _init():
+                acc_ref[jj] = val
+
+            @pl.when(s != 0)
+            def _acc():
+                acc_ref[jj] = combine(acc_ref[jj], val)
+
+            @pl.when(s == ns - 1)
+            def _emit():
+                out[0, 0] = acc_ref[jj]
+
+
+def _make_counts_kernel(d: int, sc: int, nsub: int, ns: int):
     t = TSUB
 
     def kernel(eps2_ref, *refs):
@@ -107,11 +140,11 @@ def _make_counts_kernel(d: int, sc: int):
         slabs = refs[d + 3 : 2 * d + 3]
         smask = refs[2 * d + 3]
         out = refs[2 * d + 4]
+        acc_ref = refs[2 * d + 5]
 
-        # grid dim 2 walks the slab in sc-wide chunks; offsets are GLOBAL
-        # slab positions so the run-window test (rel/span live in slab
-        # coordinates) is unchanged by the chunking
-        base = pl.program_id(2) * sc
+        # offsets are GLOBAL slab positions so the run-window test
+        # (rel/span live in slab coordinates) is unchanged by chunking
+        base = pl.program_id(1) * sc
         offs = base + jax.lax.broadcasted_iota(jnp.int32, (t, sc), 1)
         eps2 = eps2_ref[0, 0]
         acc = jnp.zeros((t,), jnp.int32)
@@ -120,22 +153,12 @@ def _make_counts_kernel(d: int, sc: int):
                 bl_planes, bm, brel, bspan, slabs, smask, offs, eps2, k
             )
             acc = acc + jnp.sum(adj.astype(jnp.int32), axis=1)
-
-        # out's index map ignores the (fastest-varying) chunk dim, so the
-        # block stays resident: initialize on the first chunk, accumulate
-        # across the rest
-        @pl.when(pl.program_id(2) == 0)
-        def _init():
-            out[0, 0] = acc
-
-        @pl.when(pl.program_id(2) != 0)
-        def _acc():
-            out[0, 0] = out[0, 0] + acc
+        _accumulate(out, acc_ref, acc, nsub, ns, lambda a, b: a + b)
 
     return kernel
 
 
-def _make_bits_kernel(d: int, sc: int):
+def _make_bits_kernel(d: int, sc: int, nsub: int, ns: int):
     t = TSUB
 
     def kernel(eps2_ref, *refs):
@@ -149,8 +172,9 @@ def _make_bits_kernel(d: int, sc: int):
         scx = refs[2 * d + 5]
         score = refs[2 * d + 6]
         out = refs[2 * d + 7]
+        acc_ref = refs[2 * d + 8]
 
-        base = pl.program_id(2) * sc
+        base = pl.program_id(1) * sc
         offs = base + jax.lax.broadcasted_iota(jnp.int32, (t, sc), 1)
         eps2 = eps2_ref[0, 0]
         bits = jnp.zeros((t,), jnp.int32)
@@ -168,14 +192,7 @@ def _make_bits_kernel(d: int, sc: int):
                 bits = bits | (
                     hit.astype(jnp.int32) << jnp.int32(k * 5 + dx)
                 )
-
-        @pl.when(pl.program_id(2) == 0)
-        def _init():
-            out[0, 0] = bits
-
-        @pl.when(pl.program_id(2) != 0)
-        def _acc():
-            out[0, 0] = out[0, 0] | bits
+        _accumulate(out, acc_ref, bits, nsub, ns, lambda a, b: a | b)
 
     return kernel
 
@@ -185,21 +202,23 @@ def _block_spec(t):
     # to be (divisible by 8, divisible by 128) OR equal to the array dims
     # — a (1, t) block over [rows, t] fails the sublane rule, while
     # (1, 1, t) over [rows, 1, t] passes by equality. Grid is
-    # (nb, nsub, ns): outer picks the block (and its slab), middle the
-    # t-row sub-block, inner (fastest) the slab chunk — which this map
-    # ignores, so per-point blocks stay resident across chunk steps.
+    # (nb, ns, nsub): outer picks the block (and its slab), middle the
+    # slab chunk, inner (fastest) the t-row sub-block — per-point blocks
+    # are tiny ([1, 1, T]), so their per-chunk refetches cost ~nothing,
+    # while the big [R, SC] chunk stays resident across the sub-rows.
     return pl.BlockSpec(
-        (1, 1, t), lambda i, j, s: (i * (BANDED_BLOCK // t) + j, 0, 0)
+        (1, 1, t), lambda i, s, j: (i * (BANDED_BLOCK // t) + j, 0, 0)
     )
 
 
 def _slab_spec(sc):
-    # one [R, SC] chunk of a block's slab bundle per inner (fastest) grid
-    # step; each (block, sub-row) pair re-walks the chunks and Mosaic
-    # pipelines the fetches. Tiling rule: R equals the array dim; SC is a
-    # ladder divisor — a multiple of 128 whenever ns > 1, and equal to
-    # the array dim S when ns == 1.
-    return pl.BlockSpec((1, BANDED_ROWS, sc), lambda i, j, s: (i, 0, s))
+    # one [R, SC] chunk of a block's slab bundle per MIDDLE grid step;
+    # the index map ignores the fastest (sub-row) dim, so a fetched
+    # chunk is consumed by every sub-row before the next chunk loads.
+    # Tiling rule: R equals the array dim; SC is a ladder divisor — a
+    # multiple of 128 whenever ns > 1, and equal to the array dim S when
+    # ns == 1.
+    return pl.BlockSpec((1, BANDED_ROWS, sc), lambda i, s, j: (i, 0, s))
 
 
 def _gather_slabs(plane, ss, slab):
@@ -251,11 +270,11 @@ def banded_phase1_pallas(
 
     blocked_specs = [
         pl.BlockSpec(
-            (1, 1), lambda i, j, s: (0, 0), memory_space=pltpu.SMEM
+            (1, 1), lambda i, s, j: (0, 0), memory_space=pltpu.SMEM
         ),
         *[_block_spec(TSUB) for _ in range(d + 1)],  # planes + mask
-        pl.BlockSpec((1, r, TSUB), lambda i, j, s: (i * nsub + j, 0, 0)),
-        pl.BlockSpec((1, r, TSUB), lambda i, j, s: (i * nsub + j, 0, 0)),
+        pl.BlockSpec((1, r, TSUB), lambda i, s, j: (i * nsub + j, 0, 0)),
+        pl.BlockSpec((1, r, TSUB), lambda i, s, j: (i * nsub + j, 0, 0)),
     ]
     blocked_args = [
         eps2,
@@ -269,14 +288,15 @@ def banded_phase1_pallas(
     mask_slab = _gather_slabs(m32, ss, slab)
 
     counts = pl.pallas_call(
-        _make_counts_kernel(d, sc),
-        grid=(nb, nsub, ns),
+        _make_counts_kernel(d, sc, nsub, ns),
+        grid=(nb, ns, nsub),
         in_specs=[
             *blocked_specs,
             *[_slab_spec(sc) for _ in range(d + 1)],
         ],
         out_specs=_block_spec(TSUB),
         out_shape=jax.ShapeDtypeStruct((rows, 1, TSUB), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((nsub, TSUB), jnp.int32)],
         interpret=_interpret(),
     )(*blocked_args, *plane_slabs, mask_slab).reshape(-1)
 
@@ -285,8 +305,8 @@ def banded_phase1_pallas(
     core32 = core.astype(jnp.int32)
 
     bits = pl.pallas_call(
-        _make_bits_kernel(d, sc),
-        grid=(nb, nsub, ns),
+        _make_bits_kernel(d, sc, nsub, ns),
+        grid=(nb, ns, nsub),
         in_specs=[
             *blocked_specs,
             _block_spec(TSUB),  # cx blocked
@@ -294,6 +314,7 @@ def banded_phase1_pallas(
         ],
         out_specs=_block_spec(TSUB),
         out_shape=jax.ShapeDtypeStruct((rows, 1, TSUB), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((nsub, TSUB), jnp.int32)],
         interpret=_interpret(),
     )(
         *blocked_args,

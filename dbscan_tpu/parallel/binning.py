@@ -260,6 +260,17 @@ class BucketGroup(NamedTuple):
     row_counts: np.ndarray = None
 
 
+def _pad_parts(n_sel: int, pad_parts_to: int, ladder: bool) -> int:
+    """Partition-axis padding for one group: the exact mesh multiple by
+    default, or a ladder width of it when the caller wants RECURRING
+    group shapes (streaming micro-batches: a data-dependent partition
+    count would mint a fresh jit signature per batch; the ladder bounds
+    distinct shapes logarithmically at <= ~1.5x padded-partition waste)."""
+    if ladder:
+        return _ladder_width(max(1, n_sel), pad_parts_to)
+    return max(1, math.ceil(n_sel / pad_parts_to) * pad_parts_to)
+
+
 def bucketize_grouped(
     points: np.ndarray,
     part_ids: np.ndarray,
@@ -269,6 +280,7 @@ def bucketize_grouped(
     pad_parts_to: int = 1,
     dtype=np.float32,
     on_group=None,
+    pad_parts_ladder: bool = False,
 ) -> Tuple[list, int]:
     """Pack partitions into SIZE-GROUPED static buffers.
 
@@ -302,7 +314,7 @@ def bucketize_grouped(
     max_b = 0
     for b in sorted(set(widths.tolist())):
         sel_parts = np.flatnonzero(widths == b)
-        p_pad = max(1, math.ceil(len(sel_parts) / pad_parts_to) * pad_parts_to)
+        p_pad = _pad_parts(len(sel_parts), pad_parts_to, pad_parts_ladder)
         buf = np.zeros((p_pad, b, d), dtype=dtype)
         mask = np.zeros((p_pad, b), dtype=bool)
         idx = np.full((p_pad, b), -1, dtype=np.int64)
@@ -412,6 +424,7 @@ def bucketize_banded(
     force: bool = False,
     on_group=None,
     grid_points: np.ndarray = None,
+    pad_parts_ladder: bool = False,
 ) -> Tuple[list, int, "CellGraphMeta"]:
     """Pack partitions for the banded engine (dbscan_tpu/ops/banded.py).
 
@@ -481,6 +494,7 @@ def bucketize_banded(
         groups, max_b = bucketize_grouped(
             points, part_ids, point_idx, n_parts, bucket_multiple,
             pad_parts_to, dtype, on_group=on_group,
+            pad_parts_ladder=pad_parts_ladder,
         )
         return groups, max_b, empty_meta
 
@@ -702,6 +716,7 @@ def bucketize_banded(
                 pad_parts_to,
                 dtype,
                 on_group=on_group,
+                pad_parts_ladder=pad_parts_ladder,
             )
             groups.extend(dgroups)
             max_b = max(max_b, dmax)
@@ -731,7 +746,9 @@ def bucketize_banded(
         for s0 in range(0, len(sel_class), per_group):
             sel_parts = sel_class[s0 : s0 + per_group]
             nb = b // t
-            p_pad = max(1, math.ceil(len(sel_parts) / pad_parts_to) * pad_parts_to)
+            p_pad = _pad_parts(
+                len(sel_parts), pad_parts_to, pad_parts_ladder
+            )
             pid = np.full(p_pad, -1, dtype=np.int64)
             pid[: len(sel_parts)] = sel_parts
             sl_b = np.zeros((p_pad, nb, BANDED_ROWS), dtype=np.int32)

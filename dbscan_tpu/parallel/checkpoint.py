@@ -67,11 +67,19 @@ def run_fingerprint(pts: np.ndarray, cfg) -> str:
                 # changes the bound handed to the partitioner, hence the
                 # whole layout the saved state encodes
                 "auto_maxpp": getattr(cfg, "auto_maxpp", False),
-                # changes group batching, hence the p1-chunk composition
-                # the ordinal-salted chunk signatures describe; shapes are
-                # ladder-quantized so sigs alone can collide across
-                # layouts — key the whole checkpoint space on it instead
-                "group_slots": os.environ.get("DBSCAN_GROUP_SLOTS", ""),
+                # both change group batching/padding, hence the p1-chunk
+                # composition the ordinal-salted chunk signatures
+                # describe; shapes are ladder-quantized so sigs alone can
+                # collide across layouts — key the whole checkpoint space
+                # on them instead. group_slots is NORMALIZED to the int
+                # binning actually uses so equivalent spellings (unset vs
+                # the explicit default) keep their checkpoints.
+                "static_partition_pad": getattr(
+                    cfg, "static_partition_pad", False
+                ),
+                "group_slots": int(
+                    os.environ.get("DBSCAN_GROUP_SLOTS", str(1 << 26))
+                ),
             },
             sort_keys=True,
         ).encode()

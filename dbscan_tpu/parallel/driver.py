@@ -398,14 +398,25 @@ def _effective_maxpp(cfg: DBSCANConfig, counts: np.ndarray) -> int:
     # whatever the raise decision below turns out to be
     floor = min(_MAXPP_AUTO_CAP, _MAXPP_PILEUP_K * cmax)
     if not cfg.auto_maxpp:
-        logger.warning(
-            "max_points_per_partition=%d under-fits the densest 2eps "
-            "cell (%d points): partitions degenerate toward single-cell "
-            "rectangles and eps-halo duplication grows (measured 2.4x "
-            "instance blow-up in this regime); pass auto_maxpp=True or "
-            "raise max_points_per_partition toward %d",
-            maxpp, cmax, floor,
-        )
+        if floor > maxpp:
+            logger.warning(
+                "max_points_per_partition=%d under-fits the densest "
+                "2eps cell (%d points): partitions degenerate toward "
+                "single-cell rectangles and eps-halo duplication grows "
+                "(measured 2.4x instance blow-up in this regime); pass "
+                "auto_maxpp=True or raise max_points_per_partition "
+                "toward %d",
+                maxpp, cmax, floor,
+            )
+        else:
+            # nothing to raise toward — same message the auto path gives
+            logger.warning(
+                "densest 2eps cell holds %d points — more than half of "
+                "max_points_per_partition=%d — and no larger bound "
+                "would help (cap %d): halo duplication may grow with "
+                "near-single-cell partitions",
+                cmax, maxpp, _MAXPP_AUTO_CAP,
+            )
         return maxpp
     if floor <= maxpp:
         logger.warning(
@@ -423,6 +434,19 @@ def _effective_maxpp(cfg: DBSCANConfig, counts: np.ndarray) -> int:
         maxpp, cmax, floor,
     )
     return floor
+
+
+def _group_flops(g) -> int:
+    """Arithmetic work of one banded group's two phase-1 sweeps, from its
+    exact dispatched (padded) shapes: per (point slot, window row, slab
+    element) each sweep computes D differences, D squares, D-1 adds and 1
+    compare (~3D flops; window/mask logic excluded — a conservative
+    count). Feeds the MFU accounting (VERDICT r3 item 3)."""
+    p_g, b_g = g.points.shape[:2]
+    return (
+        2 * p_g * b_g * binning.BANDED_ROWS
+        * int(g.banded.slab) * 3 * g.points.shape[2]
+    )
 
 
 def _pad_idx(pos: np.ndarray) -> np.ndarray:
@@ -1104,12 +1128,12 @@ def train_arrays(
         (chunk composition diverged — e.g. a changed chunk budget)."""
         g = pending[i][0]
         out = _dispatch_banded_p1(g, cfg, mesh, kernel_eps)
-        flops_spent[0] += (
-            2 * g.points.shape[0] * g.points.shape[1] * binning.BANDED_ROWS
-            * int(g.banded.slab) * 3 * g.points.shape[2]
-        )
+        flops_spent[0] += _group_flops(g)
         pending[i] = (g, out)
+        ts = time.perf_counter()
         jax.block_until_ready(out[0])
+        if time_device:  # keep the MFU ratio honest on diverged resumes
+            sync_spent[0] += time.perf_counter() - ts
 
     def _pull_record(rec):
         """Block on a live chunk's postpass, compute its border gather,
@@ -1238,11 +1262,7 @@ def train_arrays(
             # sweep-FLOP accounting covers DISPATCHED groups only — a
             # checkpoint-covered skip ran nothing, and counting it would
             # overstate the MFU figure on resumed runs
-            p_g, b_g = g.points.shape[:2]
-            flops_spent[0] += (
-                2 * p_g * b_g * binning.BANDED_ROWS
-                * int(g.banded.slab) * 3 * g.points.shape[2]
-            )
+            flops_spent[0] += _group_flops(g)
         if time_device and g.banded is not None and out is not None:
             ts = time.perf_counter()
             jax.block_until_ready(out[0])
@@ -1284,6 +1304,7 @@ def train_arrays(
             force=cfg.neighbor_backend == "banded",
             on_group=_on_group,
             grid_points=None if sph is None else sph.proj,
+            pad_parts_ladder=cfg.static_partition_pad,
         )
     else:
         groups, max_b = binning.bucketize_grouped(
@@ -1295,6 +1316,7 @@ def train_arrays(
             pad_parts_to=mesh_size(mesh),
             dtype=dtype,
             on_group=_on_group,
+            pad_parts_ladder=cfg.static_partition_pad,
         )
     timings["dispatch_s"] = round(
         dispatch_spent[0] - eager["pull_spent"] - sync_spent[0], 6
@@ -1568,15 +1590,10 @@ def train_arrays(
     inst_flag = np.concatenate(inst_flag_l) if inst_flag_l else np.empty(0, np.int8)
     t0 = _mark("device_s", t0)
 
-    # Arithmetic work the banded sweeps executed, accumulated at dispatch
-    # (_on_group) from the exact dispatched shapes (padded slots — what
-    # the device actually ran; checkpoint-covered skips excluded): per
-    # (point slot, window row, slab element) each sweep computes D
-    # differences, D squares, D-1 adds and 1 compare (~3D flops,
-    # window/mask logic excluded — a conservative count), and phase 1 is
-    # two sweeps (counts + bits). Divided by the isolated device window
-    # (timings["banded_p1_sync_s"] under DBSCAN_TIME_DEVICE=1) this
-    # grounds the bench's MFU figure (VERDICT r3 item 3).
+    # sweep work the device actually ran (_group_flops per dispatched
+    # group, checkpoint-covered skips excluded); divided by the isolated
+    # window (timings["banded_p1_sync_s"] under DBSCAN_TIME_DEVICE=1)
+    # this grounds the bench's MFU figure
     banded_sweep_flops = flops_spent[0]
 
     # core stats: one schema shared by the final output, the checkpoint

@@ -137,6 +137,10 @@ class StreamingDBSCAN:
             engine=engine,
             precision=precision,
             use_pallas=use_pallas,
+            # micro-batches must HIT the jit cache at steady state: ladder-
+            # pad the per-group partition axis so data-dependent partition
+            # counts stop minting fresh shapes every update
+            static_partition_pad=True,
         )
         self.config.validate()
         self.window = int(window)
