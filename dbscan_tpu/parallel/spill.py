@@ -183,6 +183,28 @@ def _chords(sub, vecs: np.ndarray) -> np.ndarray:
     return d
 
 
+def _membership(d: np.ndarray, halo: float):
+    """Spill membership from a [n, m] chord matrix: (assign, d_min, r,
+    member). ``r_c`` is the radius of each cell's ASSIGNED points (cells
+    nobody is assigned to need no copies at all — -inf empties them).
+    Both bands are supersets of the needed copy-set (every cell holding a
+    point within halo of p), so their INTERSECTION is too: the radius
+    band ``r_c + halo`` survives the nonnegative (TF-IDF) regime where
+    2*halo swamps the data diameter, while the classic ``d_min + 2*halo``
+    band caps cells whose radius was inflated by an assigned outlier.
+    ONE implementation shared by the exact full-node pass and the sampled
+    rejection screen — the screen's only-rejects-what-the-exact-pass-
+    rejects invariant depends on the two using the same band formula."""
+    assign = np.argmin(d, axis=1)
+    d_min = d[np.arange(len(d)), assign]
+    r = np.full(d.shape[1], -np.inf)
+    np.maximum.at(r, assign, d_min)
+    member = (d <= (r[None, :] + halo)) & (
+        d <= (d_min + 2.0 * halo)[:, None]
+    )
+    return assign, d_min, r, member
+
+
 def _chords_of(rows: np.ndarray, vecs: np.ndarray) -> np.ndarray:
     """Same chord math over raw unit-row blocks (the greedy-leader path
     slices node arrays directly instead of materializing sub-ops)."""
@@ -672,35 +694,40 @@ def spill_partition(
             # ones get theirs when recursion makes them a bigger
             # fraction); the exact full-node pass below is just ONE
             # matmul. Correctness never depends on pivot choice.
+            sub_s = None
             if len(idx) > _PIVOT_SAMPLE:
                 s_local = rng.choice(
                     len(idx), _PIVOT_SAMPLE, replace=False
                 )
-                piv = _pivot_vectors(
-                    sub.take(np.sort(s_local)), m, halo, rng
-                )
+                sub_s = sub.take(np.sort(s_local))
+                piv = _pivot_vectors(sub_s, m, halo, rng)
             else:
                 piv = _pivot_vectors(sub, m, halo, rng)
             if len(piv) < 2:
                 break  # all points identical: unsplittable
+            # Cheap rejection screen on the SAME sample before paying the
+            # full-node matmul: in the concentration regime (cluster
+            # count >> pivots, all cross distances ~equal) every
+            # escalation attempt fails, and without the screen each
+            # failure costs a full [n_node, m] pass — measured as the
+            # dominant share of the cosine anchor's spill time. The
+            # sample UNDERESTIMATES duplication (radii from a subset only
+            # shrink the bands), so with the 1.15 margin it only rejects
+            # attempts the exact pass would reject too; anything the
+            # screen lets through is still decided by the exact full-node
+            # pass below — correctness and split quality are unchanged.
+            if sub_s is not None:
+                _, _, _, mem_s = _membership(_chords(sub_s, piv), halo)
+                if (
+                    float(mem_s.sum()) / mem_s.shape[0]
+                    > 1.15 * MAX_DUP_FACTOR
+                ):
+                    continue  # escalate without the full-node pass
             # chord distances to pivots in one BLAS pass; f32 rounding is
             # covered by the caller's slack inside `halo`
-            d = _chords(sub, piv)  # [len, m]
-            assign = np.argmin(d, axis=1)
-            d_min = d[np.arange(len(d)), assign]
-            # r_c: radius of each cell's ASSIGNED points; cells nobody is
-            # assigned to need no copies at all (-inf empties them)
-            r = np.full(d.shape[1], -np.inf)
-            np.maximum.at(r, assign, d_min)
-            # Both bands are supersets of the needed copy-set (every cell
-            # holding a point within halo of p), so their INTERSECTION is
-            # too: the radius band r_c + halo survives the nonnegative
-            # (TF-IDF) regime where 2*halo swamps the data diameter,
-            # while the classic d_min + 2*halo band caps cells whose
-            # radius was inflated by an assigned outlier.
-            member = (d <= (r[None, :] + halo)) & (
-                d <= (d_min + 2.0 * halo)[:, None]
-            )  # [len, m]
+            assign, _d_min, _r, member = _membership(
+                _chords(sub, piv), halo
+            )
             sizes = member.sum(axis=0)
             if (
                 float(sizes.sum()) / len(idx) <= MAX_DUP_FACTOR
