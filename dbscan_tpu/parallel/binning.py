@@ -258,6 +258,12 @@ class BucketGroup(NamedTuple):
     part_ids: np.ndarray
     banded: BandedExtras = None
     row_counts: np.ndarray = None
+    # CANONICAL banded emission ordinal (position in the deterministic
+    # (width, win, partition-range) order), or None for dense groups.
+    # Resumed runs may EMIT banded groups rotated (uncovered first, see
+    # bucketize_banded resume_prefix) — checkpoint chunk identity keys on
+    # this ordinal, not on arrival order.
+    ordinal: int = None
 
 
 def _pad_parts(n_sel: int, pad_parts_to: int, ladder: bool) -> int:
@@ -425,6 +431,7 @@ def bucketize_banded(
     on_group=None,
     grid_points: np.ndarray = None,
     pad_parts_ladder: bool = False,
+    resume_prefix: int = 0,
 ) -> Tuple[list, int, "CellGraphMeta"]:
     """Pack partitions for the banded engine (dbscan_tpu/ops/banded.py).
 
@@ -734,6 +741,16 @@ def bucketize_banded(
     # DBSCAN_COMPACT_CHUNK_SLOTS. Labels are group-batching independent
     # (cell ids are global; the postpass and finalize are per-partition).
     group_slot_cap = int(os.environ.get("DBSCAN_GROUP_SLOTS", str(1 << 26)))
+    # Canonical emission plan: deterministic (width, win, partition-range)
+    # order. The canonical ORDINAL of each entry — not arrival order — is
+    # what the driver's chunk-checkpoint signatures key on, so a resumed
+    # run may emit a ROTATION of this plan: the checkpoint-covered prefix
+    # [0, resume_prefix) packs LAST (its device work is skipped anyway)
+    # and uncovered groups reach the device within seconds of the fine-
+    # grid pass instead of minutes — the difference between a retry leg
+    # landing a new restart point and dying during re-pack (the 100M
+    # campaign's observed failure mode on a degraded worker).
+    plan = []
     for b, w in sorted(
         set(zip(widths_band[use_banded].tolist(), win[use_banded].tolist()))
     ):
@@ -744,69 +761,76 @@ def bucketize_banded(
         if per_group > pad_parts_to:  # align to the mesh pad where possible
             per_group = per_group // pad_parts_to * pad_parts_to
         for s0 in range(0, len(sel_class), per_group):
-            sel_parts = sel_class[s0 : s0 + per_group]
-            nb = b // t
-            p_pad = _pad_parts(
-                len(sel_parts), pad_parts_to, pad_parts_ladder
+            plan.append((b, w, sel_class[s0 : s0 + per_group]))
+    emit = list(range(len(plan)))
+    if resume_prefix:
+        rp_ = min(int(resume_prefix), len(plan))
+        emit = emit[rp_:] + emit[:rp_]
+    for k in emit:
+        b, w, sel_parts = plan[k]
+        nb = b // t
+        p_pad = _pad_parts(
+            len(sel_parts), pad_parts_to, pad_parts_ladder
+        )
+        pid = np.full(p_pad, -1, dtype=np.int64)
+        pid[: len(sel_parts)] = sel_parts
+        sl_b = np.zeros((p_pad, nb, BANDED_ROWS), dtype=np.int32)
+        sl_b[: len(sel_parts)] = sstart[
+            sel_parts[:, None] * maxnb + np.arange(nb)[None, :]
+        ]
+        packed = (
+            _native.pack_banded_group(
+                sel_parts, p_pad, part_start, counts, order, pts64,
+                point_idx, cx_s, cell_rank, ustarts, uspans, sstart32,
+                maxnb, t, b, dtype, run_dtype, d_out=pts.shape[1],
             )
-            pid = np.full(p_pad, -1, dtype=np.int64)
-            pid[: len(sel_parts)] = sel_parts
-            sl_b = np.zeros((p_pad, nb, BANDED_ROWS), dtype=np.int32)
-            sl_b[: len(sel_parts)] = sstart[
-                sel_parts[:, None] * maxnb + np.arange(nb)[None, :]
-            ]
-            packed = (
-                _native.pack_banded_group(
-                    sel_parts, p_pad, part_start, counts, order, pts64,
-                    point_idx, cx_s, cell_rank, ustarts, uspans, sstart32,
-                    maxnb, t, b, dtype, run_dtype, d_out=pts.shape[1],
-                )
-                if native is not None
-                else None
-            )
-            if packed is not None:
-                buf, mask, idx, fold_b, st_b, sp_b, cx_b, cgid_b = packed
-            else:
-                buf = np.zeros((p_pad, b, pts.shape[1]), dtype=dtype)
-                mask = np.zeros((p_pad, b), dtype=bool)
-                idx = np.full((p_pad, b), -1, dtype=np.int64)
-                iota = np.arange(b, dtype=np.int32)
-                fold_b = np.broadcast_to(iota, (p_pad, b)).copy()
-                st_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=run_dtype)
-                sp_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=run_dtype)
-                cx_b = np.zeros((p_pad, b), dtype=np.int32)
-                cgid_b = np.full((p_pad, b), -1, dtype=np.int64)
+            if native is not None
+            else None
+        )
+        if packed is not None:
+            buf, mask, idx, fold_b, st_b, sp_b, cx_b, cgid_b = packed
+        else:
+            buf = np.zeros((p_pad, b, pts.shape[1]), dtype=dtype)
+            mask = np.zeros((p_pad, b), dtype=bool)
+            idx = np.full((p_pad, b), -1, dtype=np.int64)
+            iota = np.arange(b, dtype=np.int32)
+            fold_b = np.broadcast_to(iota, (p_pad, b)).copy()
+            st_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=run_dtype)
+            sp_b = np.zeros((p_pad, b, BANDED_ROWS), dtype=run_dtype)
+            cx_b = np.zeros((p_pad, b), dtype=np.int32)
+            cgid_b = np.full((p_pad, b), -1, dtype=np.int64)
 
-                # slice each partition's contiguous instance range (instances
-                # are partition-sorted) — no O(M) membership scan per group
-                gi = _segment_indices(part_start[sel_parts], counts[sel_parts])
-                rows = np.repeat(np.arange(len(sel_parts)), counts[sel_parts])
-                slots = slots_s[gi]
-                buf[rows, slots] = xy_s[gi]
-                mask[rows, slots] = True
-                idx[rows, slots] = ptidx_s[gi]
-                fold_b[rows, slots] = fold_s[gi]
-                # Per-instance run start within its slab (invalid runs pin to
-                # 0 rather than inheriting a meaningless negative offset);
-                # gathered from unique-cell space for this group's instances.
-                cr = cell_rank[gi]
-                sp_i = uspans[cr]
-                st_i = ustarts[cr] - sstart32[p_s[gi] * maxnb + slots_s[gi] // t]
-                st_b[rows, slots] = np.where(sp_i > 0, st_i, 0)
-                sp_b[rows, slots] = sp_i
-                cx_b[rows, slots] = cx_s[gi]
-                cgid_b[rows, slots] = cell_rank[gi]
+            # slice each partition's contiguous instance range (instances
+            # are partition-sorted) — no O(M) membership scan per group
+            gi = _segment_indices(part_start[sel_parts], counts[sel_parts])
+            rows = np.repeat(np.arange(len(sel_parts)), counts[sel_parts])
+            slots = slots_s[gi]
+            buf[rows, slots] = xy_s[gi]
+            mask[rows, slots] = True
+            idx[rows, slots] = ptidx_s[gi]
+            fold_b[rows, slots] = fold_s[gi]
+            # Per-instance run start within its slab (invalid runs pin to
+            # 0 rather than inheriting a meaningless negative offset);
+            # gathered from unique-cell space for this group's instances.
+            cr = cell_rank[gi]
+            sp_i = uspans[cr]
+            st_i = ustarts[cr] - sstart32[p_s[gi] * maxnb + slots_s[gi] // t]
+            st_b[rows, slots] = np.where(sp_i > 0, st_i, 0)
+            sp_b[rows, slots] = sp_i
+            cx_b[rows, slots] = cx_s[gi]
+            cgid_b[rows, slots] = cell_rank[gi]
 
-            rc = np.zeros(p_pad, dtype=np.int64)
-            rc[: len(sel_parts)] = counts[sel_parts]
-            groups.append(
-                BucketGroup(
-                    buf, mask, idx, pid,
-                    BandedExtras(fold_b, st_b, sp_b, sl_b, int(w), cx_b, cgid_b),
-                    row_counts=rc,
-                )
+        rc = np.zeros(p_pad, dtype=np.int64)
+        rc[: len(sel_parts)] = counts[sel_parts]
+        groups.append(
+            BucketGroup(
+                buf, mask, idx, pid,
+                BandedExtras(fold_b, st_b, sp_b, sl_b, int(w), cx_b, cgid_b),
+                row_counts=rc,
+                ordinal=k,
             )
-            if on_group is not None:
-                on_group(groups[-1])
-            max_b = max(max_b, b)
+        )
+        if on_group is not None:
+            on_group(groups[-1])
+        max_b = max(max_b, b)
     return groups, max_b, meta

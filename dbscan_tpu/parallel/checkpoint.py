@@ -168,6 +168,16 @@ def _p1_path(ckpt_dir: str, ci: int) -> str:
     return os.path.join(ckpt_dir, f"{_P1_PREFIX}{ci:04d}.npz")
 
 
+def invalidate_p1_chunk(ckpt_dir: str, ci: int) -> None:
+    """Remove a stale saved chunk (its composition diverged from the
+    current emission plan) so future legs' consecutive-prefix load
+    truncates there instead of re-diverging on every resume."""
+    try:
+        os.unlink(_p1_path(ckpt_dir, ci))
+    except OSError:
+        pass
+
+
 def save_p1_chunk(
     ckpt_dir: str,
     fingerprint: str,

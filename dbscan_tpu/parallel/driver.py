@@ -1091,13 +1091,12 @@ def train_arrays(
     eager = {
         "cur": [],  # pending indices of the open chunk's banded groups
         "cur_slots": 0,
-        "cur_ord0": 0,  # banded ordinal of the open chunk's first group
+        "cur_ord0": 0,  # CANONICAL ordinal of the open chunk's first group
         "records": [],  # per-chunk dicts (live or checkpoint-loaded)
-        "b_ord": 0,  # banded-group emission ordinal
         "pull_spent": 0.0,
     }
     p1_loaded: list = []
-    p1_exp: list = []  # (chunk idx, (P, B, slab)) per banded ordinal
+    p1_exp: list = []  # (chunk idx, (P, B, slab)) per CANONICAL ordinal
     if compact_on and ckpt_fp is not None:
         from dbscan_tpu.parallel import checkpoint as _ckpt_p1
 
@@ -1107,6 +1106,25 @@ def train_arrays(
         for lci, lc in enumerate(p1_loaded):
             for row in lc["shapes"]:
                 p1_exp.append((lci, tuple(int(v) for v in row)))
+    # Pre-seed one placeholder record per saved chunk. Covered groups are
+    # routed here by CANONICAL ordinal as they arrive — which, on a
+    # resumed run, is LAST: binning emits a rotation of its canonical
+    # plan (resume_prefix) so uncovered groups reach the device within
+    # seconds of the fine-grid pass instead of after minutes of re-pack.
+    # A placeholder completes (checkpoint arrays adopted, or divergence
+    # recomputed) once all its groups have arrived.
+    for lci, lc in enumerate(p1_loaded):
+        eager["records"].append(
+            {
+                "ch": [],
+                "ci": lci,
+                "pending_loaded": lc,
+                "expect": len(lc["shapes"]),
+                "ord0": next(
+                    k for k, (c, _s) in enumerate(p1_exp) if c == lci
+                ),
+            }
+        )
 
     def _chunk_sig(ch, ord0):
         # salted with the chunk's starting banded ordinal: shapes are
@@ -1138,8 +1156,8 @@ def train_arrays(
     def _pull_record(rec):
         """Block on a live chunk's postpass, compute its border gather,
         and (with a checkpoint_dir) persist the artifacts."""
-        if "combo_host" in rec:
-            return
+        if "combo_host" in rec or "pending_loaded" in rec or "dropped" in rec:
+            return  # done, placeholder still collecting, or re-chunked
         tp = time.perf_counter()
         layout = rec["layout"]
         total = layout["total"]
@@ -1182,6 +1200,68 @@ def train_arrays(
                 budget=_COMPACT_CHUNK_SLOTS,
             )
 
+    def _run_postpass(rec):
+        """Dispatch a record's compact postpass from its (now complete)
+        groups, redispatching any checkpoint-skipped ones first."""
+        ch = rec["ch"]
+        for i in ch:
+            if pending[i][1] is None:
+                _redispatch(i)
+        layout = cellgraph.cell_layout(rec["groups"])
+        combo_dev, bits_flat = banded_postpass(
+            tuple(pending[i][1][0] for i in ch),
+            tuple(pending[i][1][1] for i in ch),
+            tuple(
+                mesh_mod.replicate_host_array(f)
+                for f in layout["segflags"]
+            ),
+            mesh_mod.replicate_host_array(_pad_idx(layout["or_pos"])),
+        )
+        if not mesh_mod.multiprocess():
+            # local-shard async copy; cross-host pulls gather instead
+            combo_dev.copy_to_host_async()
+        rec["layout"] = layout
+        rec["combo_dev"] = combo_dev
+        rec["bits_flat"] = bits_flat
+
+    def _complete_placeholder(rec):
+        """All of a saved chunk's groups have arrived: verify the ordinal-
+        salted composition signature and adopt the checkpointed artifacts.
+        On divergence (changed plan slipping past the fingerprint) the
+        saved composition is STALE: its stale file is invalidated so
+        future legs' prefix load truncates there, and its groups re-enter
+        the normal budgeted accumulation — reusing the stale composition
+        for a recompute could concatenate past the chunk slot cap (the
+        2^31-byte per-buffer kill) and would hold every diverged chunk's
+        postpass buffers resident at once instead of the one-behind
+        pipeline."""
+        lc = rec.pop("pending_loaded")
+        rec.pop("expect", None)
+        rec["groups"] = [pending[i][0] for i in rec["ch"]]
+        rec["sig"] = _chunk_sig(rec["ch"], rec["ord0"])
+        covered = all(pending[i][1] is None for i in rec["ch"])
+        if covered and lc["sig"] == rec["sig"]:
+            rec["combo_host"] = lc["arrays"]["combo"]
+            rec["bbits"] = lc["arrays"]["bbits"]
+            return
+        rec["dropped"] = True
+        if ckpt_fp is not None:
+            from dbscan_tpu.parallel import checkpoint as _ckpt_p1
+
+            _ckpt_p1.invalidate_p1_chunk(checkpoint_dir, rec["ci"])
+        for i in rec["ch"]:
+            g_i = pending[i][0]
+            sz_g = g_i.mask.shape[0] * g_i.mask.shape[1]
+            if (
+                eager["cur"]
+                and eager["cur_slots"] + sz_g > _COMPACT_CHUNK_SLOTS
+            ):
+                _flush_chunk()
+            if not eager["cur"]:
+                eager["cur_ord0"] = g_i.ordinal
+            eager["cur"].append(i)
+            eager["cur_slots"] += sz_g
+
     def _flush_chunk():
         ch = eager["cur"]
         if not ch:
@@ -1192,36 +1272,7 @@ def train_arrays(
         sig = _chunk_sig(ch, eager.get("cur_ord0", 0))
         ch_groups = [pending[i][0] for i in ch]
         rec = {"ch": ch, "ci": ci, "sig": sig, "groups": ch_groups}
-        skipped = [i for i in ch if pending[i][1] is None]
-        loaded = p1_loaded[ci] if ci < len(p1_loaded) else None
-        if (
-            skipped
-            and loaded is not None
-            and loaded["sig"] == sig
-            and len(skipped) == len(ch)
-        ):
-            # checkpoint hit: the chunk re-formed exactly as saved
-            rec["combo_host"] = loaded["arrays"]["combo"]
-            rec["bbits"] = loaded["arrays"]["bbits"]
-        else:
-            for i in skipped:  # divergence: recompute what was skipped
-                _redispatch(i)
-            layout = cellgraph.cell_layout(ch_groups)
-            combo_dev, bits_flat = banded_postpass(
-                tuple(pending[i][1][0] for i in ch),
-                tuple(pending[i][1][1] for i in ch),
-                tuple(
-                    mesh_mod.replicate_host_array(f)
-                    for f in layout["segflags"]
-                ),
-                mesh_mod.replicate_host_array(_pad_idx(layout["or_pos"])),
-            )
-            if not mesh_mod.multiprocess():
-                # local-shard async copy; cross-host pulls gather instead
-                combo_dev.copy_to_host_async()
-            rec["layout"] = layout
-            rec["combo_dev"] = combo_dev
-            rec["bits_flat"] = bits_flat
+        _run_postpass(rec)
         eager["records"].append(rec)
         # pipeline by default (pull chunk i-1 while chunk i's phase-1
         # work executes); DBSCAN_EAGER_PULL=1 pulls each chunk at its
@@ -1244,9 +1295,10 @@ def train_arrays(
         if g.banded is None:
             out = _dispatch_partitions(g, cfg, mesh, kernel_eps, kernel_metric)
         elif compact_on:
-            k = eager["b_ord"]
-            eager["b_ord"] += 1
-            exp = p1_exp[k] if k < len(p1_exp) else None
+            k = g.ordinal  # CANONICAL ordinal (arrival may be rotated)
+            exp = (
+                p1_exp[k] if k is not None and k < len(p1_exp) else None
+            )
             shape = (
                 g.points.shape[0],
                 g.points.shape[1],
@@ -1277,16 +1329,29 @@ def train_arrays(
                 jax.block_until_ready(oout)
                 inflight_slots[0] -= osz
         if g.banded is not None and compact_on:
-            sz_g = g.mask.shape[0] * g.mask.shape[1]
-            # close the open chunk BEFORE an overflowing group joins: the
-            # cap bounds the chunk's concatenated device buffers, so a
-            # chunk may only exceed it when a SINGLE group does
-            if eager["cur"] and eager["cur_slots"] + sz_g > _COMPACT_CHUNK_SLOTS:
-                _flush_chunk()
-            if not eager["cur"]:
-                eager["cur_ord0"] = eager["b_ord"] - 1
-            eager["cur"].append(len(pending) - 1)
-            eager["cur_slots"] += sz_g
+            k = g.ordinal
+            if k is not None and k < len(p1_exp):
+                # belongs to a saved chunk's composition (even on a shape
+                # mismatch — the signature check at completion decides
+                # adopt-vs-recompute): route to its placeholder record
+                rec = eager["records"][p1_exp[k][0]]
+                rec["ch"].append(len(pending) - 1)
+                if len(rec["ch"]) == rec["expect"]:
+                    _complete_placeholder(rec)
+            else:
+                sz_g = g.mask.shape[0] * g.mask.shape[1]
+                # close the open chunk BEFORE an overflowing group joins:
+                # the cap bounds the chunk's concatenated device buffers,
+                # so a chunk may only exceed it when a SINGLE group does
+                if (
+                    eager["cur"]
+                    and eager["cur_slots"] + sz_g > _COMPACT_CHUNK_SLOTS
+                ):
+                    _flush_chunk()
+                if not eager["cur"]:
+                    eager["cur_ord0"] = k
+                eager["cur"].append(len(pending) - 1)
+                eager["cur_slots"] += sz_g
         dispatch_spent[0] += time.perf_counter() - td
 
     cellmeta = None
@@ -1305,6 +1370,10 @@ def train_arrays(
             on_group=_on_group,
             grid_points=None if sph is None else sph.proj,
             pad_parts_ladder=cfg.static_partition_pad,
+            # rotate emission so checkpoint-covered groups pack LAST and
+            # uncovered device work starts within seconds (retry legs on
+            # a dying worker must reach a NEW restart point fast)
+            resume_prefix=len(p1_exp),
         )
     else:
         groups, max_b = binning.bucketize_grouped(
@@ -1362,6 +1431,27 @@ def train_arrays(
     if compact_on and cellmeta is not None:
         _pull_before_tail = eager["pull_spent"]
         _flush_chunk()
+        # defensive: a placeholder that never filled (the emission plan
+        # diverged from the saved one — e.g. a changed group-slot cap
+        # slipping past the fingerprint) re-chunks whatever arrived via
+        # the divergence path instead of deadlocking the finalize; its
+        # stale file is invalidated either way
+        for _rec in eager["records"]:
+            if "pending_loaded" in _rec:
+                if _rec["ch"]:
+                    _complete_placeholder(_rec)
+                elif ckpt_fp is not None:
+                    from dbscan_tpu.parallel import checkpoint as _ckpt_p1
+
+                    _ckpt_p1.invalidate_p1_chunk(
+                        checkpoint_dir, _rec["ci"]
+                    )
+        _flush_chunk()  # divergence re-chunking may have reopened `cur`
+        eager["records"] = [
+            r
+            for r in eager["records"]
+            if "pending_loaded" not in r and "dropped" not in r
+        ]
         _tail_pull = eager["pull_spent"] - _pull_before_tail
     else:
         _tail_pull = 0.0

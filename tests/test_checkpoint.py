@@ -284,3 +284,43 @@ def test_device_phase_eager_pull_mode(rng, tmp_path, monkeypatch):
     np.testing.assert_array_equal(clean.clusters, eager.clusters)
     np.testing.assert_array_equal(clean.flags, eager.flags)
     assert len(list(ck.glob("p1chunk*.npz"))) >= 2
+
+
+def test_device_phase_sig_divergence_rechunks(rng, tmp_path, monkeypatch):
+    """A saved chunk whose composition signature no longer matches (a
+    stale/corrupt checkpoint) must NOT be adopted: its groups re-enter
+    the normal budgeted chunking (r4 rotation machinery), labels stay
+    exact, and the stale file is invalidated so future legs' prefix
+    load truncates instead of re-diverging every resume."""
+    pts = _varied_blobs(rng)
+    kw = dict(
+        eps=0.5, min_points=5, max_points_per_partition=256,
+        engine=Engine.ARCHERY, neighbor_backend="banded",
+    )
+    clean = train(pts, **kw)
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 512)
+    ck = tmp_path / "ck"
+    train(pts, checkpoint_dir=str(ck), **kw)
+    for f in ck.glob("premerge.npz"):
+        f.unlink()
+    for f in ck.glob("manifest.json"):
+        f.unlink()
+    n_chunks = len(list(ck.glob("p1chunk*.npz")))
+    assert n_chunks >= 2
+
+    # poison every saved sig: each placeholder must take the divergence
+    # path (re-chunk + invalidate), never adopt stale artifacts
+    from dbscan_tpu.parallel import checkpoint as ckpt_mod
+
+    real_load = ckpt_mod.load_p1_chunks
+
+    def poisoned(*a, **k):
+        out = real_load(*a, **k)
+        for lc in out:
+            lc["sig"] = "poisoned-" + lc["sig"][:8]
+        return out
+
+    monkeypatch.setattr(ckpt_mod, "load_p1_chunks", poisoned)
+    resumed = train(pts, checkpoint_dir=str(ck), **kw)
+    np.testing.assert_array_equal(clean.clusters, resumed.clusters)
+    np.testing.assert_array_equal(clean.flags, resumed.flags)
