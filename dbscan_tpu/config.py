@@ -77,9 +77,12 @@ class DBSCANConfig:
         engine finds eps-neighbors. "dense" materializes the [B, B]
         adjacency; "banded" sorts each partition by an eps-cell grid and
         sweeps only the 3-row candidate windows (O(B * window),
-        dbscan_tpu/ops/banded.py; euclidean 2-D only). "auto" picks banded
-        for partitions large enough that the windows pay off. Ignored when
-        use_pallas is set.
+        dbscan_tpu/ops/banded.py; euclidean 2-D grids, plus haversine via
+        the equirectangular grid + chord kernel). "auto" picks banded for
+        partitions large enough that the windows pay off. With use_pallas,
+        euclidean runs may use any backend (large buckets ride the banded
+        Pallas port either way), while haversine REQUIRES "banded" — the
+        dense streaming Pallas kernel is 2-D-only.
       auto_maxpp: when the densest single 2eps cell holds so many points
         that max_points_per_partition under-fits it (the partitioner
         cannot cut inside a cell, so partitions degenerate to near-single-

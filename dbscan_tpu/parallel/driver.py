@@ -757,10 +757,27 @@ def train_arrays(
     """
     cfg = cfg.validate()
     raw = np.asarray(points)
-    if cfg.use_pallas and cfg.metric != "euclidean":
+    if cfg.use_pallas and cfg.metric not in ("euclidean", "haversine"):
         raise ValueError(
-            "use_pallas supports only the euclidean metric; got "
+            "use_pallas supports the euclidean metric (any backend) and "
+            "haversine (banded route only); got "
             f"{cfg.metric!r}"
+        )
+    if (
+        cfg.use_pallas
+        and cfg.metric == "haversine"
+        and cfg.neighbor_backend != "banded"
+    ):
+        # the banded Pallas port's difference-form distance is D-generic
+        # (handles the 3-plane chord payload), but the streaming dense
+        # kernel is 2-D-only — small buckets on the auto/dense routes
+        # would crash at trace time deep in the dense kernel; raise the
+        # clearer error here, before any host work
+        raise ValueError(
+            "use_pallas with metric='haversine' requires "
+            "neighbor_backend='banded' (the banded Pallas port consumes "
+            "the 3-plane chord payload; the dense streaming kernel is "
+            "2-D-only)"
         )
     if cfg.use_pallas and cfg.precision.value != "f32":
         raise ValueError(
@@ -852,28 +869,40 @@ def train_arrays(
     eps_spatial = float(cfg.eps)
     grid_eps = float(cfg.eps)
     sph = None
-    if (
-        cfg.metric == "haversine"
-        and not cfg.use_pallas
-        and cfg.precision.value in ("f32", "f64")
-    ):
+    if cfg.metric == "haversine" and cfg.precision.value in ("f32", "f64"):
         from dbscan_tpu.ops import sphere
 
         sph = sphere.embed(
             pts, float(cfg.eps), f32=cfg.precision.value == "f32"
         )
-        if cfg.neighbor_backend == "banded" and (
-            sph is None or not sph.banded_ok
-        ):
+        banded_refused = sph is None or not sph.banded_ok
+        refusal_reason = (
+            "projection refused: antimeridian/pole/slack"
+            if sph is None
+            else (
+                f"latitude span too wide: cos_ratio {sph.cos_ratio:.3f} "
+                "fails the reach margin"
+                if banded_refused
+                else ""
+            )
+        )
+        if cfg.use_pallas and banded_refused:
+            # the upfront guard pinned haversine+pallas to the banded
+            # route; with the projection refusing it there is no Pallas
+            # kernel that can run this dataset (the dense fallback would
+            # crash at trace time) — fail clearly before any host work
+            raise ValueError(
+                "use_pallas with metric='haversine' needs the spherical "
+                f"banded route, but this dataset cannot use it "
+                f"({refusal_reason}); drop use_pallas for this data"
+            )
+        if cfg.neighbor_backend == "banded" and banded_refused:
             # honoring the force would break the banded engine's
             # clique/reach guarantees — degrade loudly, not silently
             logger.warning(
                 "neighbor_backend='banded' requested but this spherical "
                 "dataset cannot use it (%s); running the %s instead",
-                "projection refused: antimeridian/pole/slack"
-                if sph is None
-                else f"latitude span too wide: cos_ratio {sph.cos_ratio:.3f} "
-                "fails the reach margin",
+                refusal_reason,
                 "single-partition dense kernel"
                 if sph is None
                 else "spatially-decomposed dense kernel",

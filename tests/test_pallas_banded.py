@@ -147,3 +147,41 @@ def test_pallas_auto_routes_banded_at_scale(rng, monkeypatch):
         neighbor_backend="banded",
     )
     np.testing.assert_array_equal(mb.clusters, mp.clusters)
+
+
+def test_pallas_banded_haversine_chord(rng):
+    """The spherical route feeds the banded engines a 3-plane CHORD
+    payload (ops/sphere.py) with the grid built from the equirectangular
+    projection; the Pallas port's difference-form distance generalizes
+    over D as a static unrolled sum and must stay bit-identical to the
+    XLA engine there too (D=3 exercises the plane loop beyond the 2-D
+    geometries above)."""
+    # lon/lat degrees in a ~100 km box; eps in km
+    lon0, lat0 = -74.0, 40.7
+    pts = np.stack(
+        [
+            lon0 + rng.uniform(0, 0.9, 3000),
+            lat0 + rng.uniform(0, 0.7, 3000),
+        ],
+        axis=1,
+    )
+    centers = np.stack(
+        [lon0 + np.array([0.2, 0.6]), lat0 + np.array([0.2, 0.5])], axis=1
+    )
+    blobs = np.concatenate(
+        [c + rng.normal(0, 0.01, (1500, 2)) for c in centers]
+    )
+    pts = np.concatenate([pts, blobs])
+    kw = dict(
+        eps=1.0,  # km
+        min_points=8,
+        max_points_per_partition=10**9,
+        engine=Engine.ARCHERY,
+        metric="haversine",
+        neighbor_backend="banded",
+    )
+    mb = train(pts, **kw)
+    mp = train(pts, use_pallas=True, **kw)
+    assert mp.stats["n_banded_groups"] >= 1
+    np.testing.assert_array_equal(mb.clusters, mp.clusters)
+    np.testing.assert_array_equal(mb.flags, mp.flags)
