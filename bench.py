@@ -217,11 +217,15 @@ def _mfu_fields(prefix: str, pts, maxpp: int, **extra) -> dict:
         engine=Engine.ARCHERY,
     )
     kw.update(extra)
+    prev_td = os.environ.get("DBSCAN_TIME_DEVICE")
     os.environ["DBSCAN_TIME_DEVICE"] = "1"
     try:
         model = train(pts, **kw)
     finally:
-        os.environ.pop("DBSCAN_TIME_DEVICE", None)
+        if prev_td is None:
+            os.environ.pop("DBSCAN_TIME_DEVICE", None)
+        else:
+            os.environ["DBSCAN_TIME_DEVICE"] = prev_td
     sync = model.stats["timings"].get("banded_p1_sync_s")
     flops = model.stats.get("banded_sweep_flops")
     if not sync or not flops:

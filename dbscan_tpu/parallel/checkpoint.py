@@ -170,12 +170,36 @@ def _p1_path(ckpt_dir: str, ci: int) -> str:
 
 def invalidate_p1_chunk(ckpt_dir: str, ci: int) -> None:
     """Remove a stale saved chunk (its composition diverged from the
-    current emission plan) so future legs' consecutive-prefix load
-    truncates there instead of re-diverging on every resume."""
+    current emission plan) AND every saved chunk above it, so future
+    legs' consecutive-prefix load truncates cleanly at ``ci``: the
+    loader only consumes a consecutive prefix, so higher-index files
+    left behind the gap are unreachable — and if a later leg's saves
+    ever filled the gap, the stale survivors would load as placeholders
+    whose signatures cannot match, cascading divergences. Note the
+    CURRENT leg's post-divergence saves land at indices above the old
+    placeholder count (chunk ids count all records), so they too sit
+    behind the gap and are lost to the next leg; the numbering heals
+    only on the next leg, which restarts at the truncation point.
+    Divergence is the rare path (a changed plan slipping past the
+    fingerprint — never a fixed-settings retry loop), so that one-leg
+    recompute is accepted over renumbering saved files, whose order
+    must stay aligned with the canonical ordinal prefix."""
     try:
-        os.unlink(_p1_path(ckpt_dir, ci))
+        names = os.listdir(ckpt_dir)
     except OSError:
-        pass
+        return
+    for name in names:
+        if not (name.startswith(_P1_PREFIX) and name.endswith(".npz")):
+            continue
+        try:
+            idx = int(name[len(_P1_PREFIX) : -len(".npz")])
+        except ValueError:
+            continue
+        if idx >= ci:
+            try:
+                os.unlink(os.path.join(ckpt_dir, name))
+            except OSError:
+                pass
 
 
 def save_p1_chunk(

@@ -518,6 +518,7 @@ def prefix_components(x_csr, t: float, budget: int = None):
             if np.array_equal(rr, r):
                 parent[ids] = r  # path-compress the queried ids: long
                 return r  # chains would otherwise re-walk every screen
+            r = rr
 
     def _union_edges(a, b):
         for xi, yi in zip(a.tolist(), b.tolist()):

@@ -333,3 +333,44 @@ def test_leader_components_bails_on_connected_data(rng):
         _DenseOps(pts.astype(np.float32)), 0.25, np.random.default_rng(0)
     )
     assert out is None
+
+
+def test_prefix_components_cross_flush_chain_converges(monkeypatch):
+    """Regression (ADVICE r4 high): a component merged ACROSS verify
+    flushes leaves a depth-2 parent chain (3->2 in flush one, then
+    2->0 in flush two); _roots must walk it to the root instead of
+    spinning forever on the unadvanced frontier."""
+    import queue
+    import threading
+
+    import scipy.sparse as sp
+
+    from dbscan_tpu.parallel import spill
+
+    # rows: x0={f1}, x1={f2} (singleton), x2={f0,f1}/sqrt2, x3={f0}.
+    # feature f0's prefix list -> pair (2,3) in the FIRST flush;
+    # f1's -> pair (0,2) in the SECOND (chunk=1 flushes per group).
+    s = 1.0 / np.sqrt(2.0)
+    x = sp.csr_matrix(
+        (
+            np.array([1.0, 1.0, s, s, 1.0]),
+            (np.array([0, 1, 2, 2, 3]), np.array([1, 2, 0, 1, 0])),
+        ),
+        shape=(4, 3),
+    )
+    monkeypatch.setattr(spill, "_PREFIX_CHUNK", 1)
+    out = queue.Queue()
+    # daemon thread, not an executor: on regression the worker spins
+    # forever, and an executor's shutdown/atexit join would hang the
+    # whole suite instead of letting this assertion fail
+    th = threading.Thread(
+        target=lambda: out.put(spill.prefix_components(x, 0.5)),
+        daemon=True,
+    )
+    th.start()
+    th.join(timeout=60)
+    assert not th.is_alive(), "prefix_components hung (pre-fix _roots spin)"
+    comp, n_comp = out.get_nowait()
+    assert n_comp == 2
+    assert comp[0] == comp[2] == comp[3]
+    assert comp[1] != comp[0]
