@@ -329,6 +329,202 @@ def child_sparse_cpu(cpu_n: int, out_path: str) -> None:
     np.savez(out_path, seconds=time.perf_counter() - t0, n=cpu_n)
 
 
+def child_m100(ckpt_dir: str, out_path: str) -> None:
+    """One leg of the 100M exact-recovery campaign: generate the
+    deterministic euclid anchor, run train(checkpoint_dir=...) so every
+    pulled compact chunk persists as a restart point, score exact
+    recovery, and write the result npz. A TPU-worker death kills this
+    process; the parent (m100_row) counts banked chunks and relaunches.
+    Reference analog: the partition-bounded scaling contract,
+    DBSCAN.scala:53-56, where Spark lineage replays lost partitions."""
+    n = int(os.environ.get("BENCH_100M_N", "100000000"))
+    maxpp = int(os.environ.get("BENCH_100M_MAXPP", "131072"))
+    pts, blob_of, n_blob, k, eps = make_anchor(n, "euclidean")
+    from dbscan_tpu import Engine, train
+    from dbscan_tpu.utils.ari import adjusted_rand_index
+
+    t0 = time.perf_counter()
+    model = train(
+        pts,
+        eps=eps,
+        min_points=MIN_POINTS,
+        max_points_per_partition=maxpp,
+        engine=Engine.ARCHERY,
+        checkpoint_dir=ckpt_dir,
+    )
+    dt = time.perf_counter() - t0
+    ari = adjusted_rand_index(model.clusters[:n_blob], blob_of)
+    tmp = out_path + ".tmp.npz"
+    with open(tmp, "wb") as f:
+        np.savez(
+            f,
+            seconds=dt,
+            clusters=model.n_clusters,
+            expect=k,
+            ari=float(ari),
+            dup=float(model.stats.get("duplication_factor", 0.0)),
+            n_partitions=int(model.stats.get("n_partitions", 0)),
+            resumed=bool(model.stats.get("resumed_from_checkpoint", False)),
+        )
+    os.replace(tmp, out_path)
+
+
+def _chunks_written_since(ckpt_dir: str, since: float) -> int:
+    """How many p1chunk files were (re)written at-or-after ``since``
+    (an epoch timestamp) — the leg-progress signal for the retry loop."""
+    fresh = 0
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return 0
+    for name in names:
+        if name.startswith("p1chunk") and name.endswith(".npz"):
+            try:
+                if os.path.getmtime(os.path.join(ckpt_dir, name)) >= since:
+                    fresh += 1
+            except OSError:
+                pass
+    return fresh
+
+
+def m100_row(prefix: str = "m100") -> dict:
+    """The 100M campaign as a HARNESS row (VERDICT r4 item 1): a bounded
+    retry-resume loop around child_m100 legs — one fresh subprocess per
+    leg so a dead TPU backend can never wedge the capture — banking
+    phase-1 chunk checkpoints across legs and reporting partial progress
+    (chunks_done/chunks_total from the driver's plan-derived
+    progress.json) even when every leg dies at the tunneled worker's
+    ~4-25-min endurance limit. Runs LAST so a worker death cannot take
+    the other rows with it. Knobs: BENCH_100M_{N,MAXPP,CKPT,LEGS,
+    BUDGET_S,LEG_TIMEOUT_S,REST_S}."""
+    from dbscan_tpu.parallel import checkpoint as ckpt_mod
+
+    ckpt_dir = os.environ.get("BENCH_100M_CKPT", "/tmp/ckpt100m")
+    max_legs = int(os.environ.get("BENCH_100M_LEGS", "3"))
+    budget = float(os.environ.get("BENCH_100M_BUDGET_S", "1500"))
+    leg_timeout = float(os.environ.get("BENCH_100M_LEG_TIMEOUT_S", "3600"))
+    rest = float(os.environ.get("BENCH_100M_REST_S", "45"))
+    os.makedirs(ckpt_dir, exist_ok=True)
+    out_path = os.path.join(ckpt_dir, "leg_result.npz")
+    try:  # a stale result from an older campaign must not count
+        os.unlink(out_path)
+    except OSError:
+        pass
+    env = dict(os.environ)
+    # resume compatibility is keyed on these (chunk files are budget-
+    # stamped; group_slots is in the run fingerprint) — default to the
+    # campaign's proven fine-grained restart config, but an operator
+    # override wins
+    env.setdefault("DBSCAN_EAGER_PULL", "1")
+    env.setdefault("DBSCAN_COMPACT_CHUNK_SLOTS", "8388608")
+    env.setdefault("DBSCAN_GROUP_SLOTS", "8388608")
+    # a config change (N, maxpp, chunk/group slots) makes every banked
+    # chunk unloadable (fingerprint/budget mismatch at load) but NOT
+    # invisible: stale files would inflate chunks_done and mask real
+    # progress from the stall detector. The campaign key captures every
+    # knob the fingerprint depends on (the anchor data is seed-
+    # deterministic), so a mismatch wipes the dir clean.
+    campaign_key = {
+        "n": int(os.environ.get("BENCH_100M_N", "100000000")),
+        "maxpp": int(os.environ.get("BENCH_100M_MAXPP", "131072")),
+        "chunk_slots": env["DBSCAN_COMPACT_CHUNK_SLOTS"],
+        "group_slots": env["DBSCAN_GROUP_SLOTS"],
+    }
+    key_path = os.path.join(ckpt_dir, "campaign.json")
+    try:
+        with open(key_path) as f:
+            prior_key = json.load(f)
+    except (OSError, ValueError):
+        prior_key = None
+    if prior_key != campaign_key:
+        if prior_key is not None:
+            ckpt_mod.invalidate_p1_chunk(ckpt_dir, 0)
+            for stale in ("progress.json", "premerge.npz", "manifest.json"):
+                try:
+                    os.unlink(os.path.join(ckpt_dir, stale))
+                except OSError:
+                    pass
+        with open(key_path, "w") as f:
+            json.dump(campaign_key, f)
+    t0 = time.monotonic()
+    legs = 0
+    result = None
+    last_err = ""
+    stall = 0
+    while legs < max_legs:
+        remaining = budget - (time.monotonic() - t0)
+        if legs and remaining <= 0:
+            break
+        leg_start = time.time()
+        legs += 1
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.abspath(__file__),
+                    "--m100-child",
+                    ckpt_dir,
+                    out_path,
+                ],
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE,
+                # honor the campaign budget even against a WEDGED (not
+                # crashed) worker: a leg never outlives the remaining
+                # budget by more than the floor that lets it reach its
+                # first restart points (~10 min incl. datagen + re-pack)
+                timeout=min(leg_timeout, max(remaining, 600.0)),
+            )
+            if proc.returncode == 0 and os.path.exists(out_path):
+                with np.load(out_path) as z:
+                    result = {k: z[k].item() for k in z.files}
+                break
+            tail = proc.stderr.decode(errors="replace")[-300:]
+            last_err = f"rc {proc.returncode}: {tail}".strip()
+        except subprocess.TimeoutExpired:
+            last_err = "leg timeout"
+        # two consecutive legs with zero new restart points means the
+        # worker is killing us before any progress — stop burning budget.
+        # Progress = a chunk file WRITTEN during this leg (mtime-based:
+        # resumed legs overwrite indices in place, so a bare count
+        # cannot see progress past stale higher-index files)
+        if not _chunks_written_since(ckpt_dir, leg_start):
+            stall += 1
+            if stall >= 2:
+                break
+        else:
+            stall = 0
+        if legs < max_legs:
+            time.sleep(rest)
+    chunks_done = ckpt_mod.count_p1_chunks(ckpt_dir)
+    progress = ckpt_mod.read_progress(ckpt_dir)
+    out = {
+        f"{prefix}_n": int(os.environ.get("BENCH_100M_N", "100000000")),
+        f"{prefix}_legs": legs,
+        f"{prefix}_chunks_done": chunks_done,
+        f"{prefix}_chunks_total": progress.get("chunks_total"),
+        f"{prefix}_wall_s": round(time.monotonic() - t0, 1),
+        f"{prefix}_complete": bool(result),
+    }
+    if result:
+        out.update(
+            {
+                f"{prefix}_seconds": round(result["seconds"], 1),
+                f"{prefix}_clusters": int(result["clusters"]),
+                f"{prefix}_expect": int(result["expect"]),
+                f"{prefix}_ari": round(result["ari"], 6),
+                f"{prefix}_dup": round(result["dup"], 3),
+                f"{prefix}_resumed": bool(result["resumed"]),
+                f"{prefix}_mpts": round(
+                    out[f"{prefix}_n"] / result["seconds"] / 1e6, 4
+                ),
+            }
+        )
+    elif last_err:
+        out[f"{prefix}_last_error"] = last_err[:200]
+    return out
+
+
 def run_train(pts, maxpp, use_pallas=False, reps=1, **extra):
     from dbscan_tpu import Engine, train
 
@@ -472,6 +668,9 @@ def main() -> None:
         return
     if len(sys.argv) >= 4 and sys.argv[1] == "--sparse-child":
         child_sparse_cpu(int(sys.argv[2]), sys.argv[3])
+        return
+    if len(sys.argv) >= 4 and sys.argv[1] == "--m100-child":
+        child_m100(sys.argv[2], sys.argv[3])
         return
 
     _ensure_live_backend()
@@ -732,7 +931,65 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — recorded, not swallowed
             sys.stderr.write(f"bench: {prefix} row failed: {e}\n")
             out[f"{prefix}_failed"] = f"{type(e).__name__}: {e}"[:200]
+    # the 100M retry-resume campaign runs LAST and only on a live
+    # accelerator: its legs can kill the tunneled worker, and every
+    # other row must already be banked when that happens. Its legs are
+    # subprocesses, so a worker death degrades to partial-progress
+    # fields, never a lost capture.
+    if os.environ.get("BENCH_100M", "0" if on_cpu else "1") == "1":
+        try:
+            out.update(m100_row())
+        except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+            sys.stderr.write(f"bench: m100 row failed: {e}\n")
+            out["m100_failed"] = f"{type(e).__name__}: {e}"[:200]
+    # full record FIRST, compact summary line LAST: the driver captures a
+    # bounded tail window, and r4's attribution fields pushed the single
+    # JSON line past it (BENCH_r04.json "parsed": null) — the machine-
+    # readable headline must be the final thing on stdout
     print(json.dumps(out))
+    print(json.dumps(_compact_summary(out)))
+
+
+_COMPACT_SUFFIXES = (
+    "_seconds",
+    "_vs_baseline",
+    "_ari",
+    "_skipped",
+    "_failed",
+    "_mpts",
+    "_chunks_done",
+    "_chunks_total",
+    "_legs",
+    "_complete",
+)
+
+
+def _compact_summary(out: dict) -> dict:
+    """The tail-window-sized record: headline scalars plus each row's
+    seconds/ARI/vs_baseline (and skip/fail/progress markers) only — no
+    phase splits, no attribution fields."""
+    compact = {
+        k: out[k]
+        for k in (
+            "metric",
+            "value",
+            "unit",
+            "vs_baseline",
+            "backend",
+            "n_points",
+            "seconds",
+            "ari_full",
+            "ari_vs_cpu",
+            "n_clusters",
+        )
+        if k in out
+    }
+    for k, v in out.items():
+        if k in compact:
+            continue
+        if k.endswith(_COMPACT_SUFFIXES):
+            compact[k] = v
+    return compact
 
 
 if __name__ == "__main__":

@@ -432,6 +432,7 @@ def bucketize_banded(
     grid_points: np.ndarray = None,
     pad_parts_ladder: bool = False,
     resume_prefix: int = 0,
+    on_plan=None,
 ) -> Tuple[list, int, "CellGraphMeta"]:
     """Pack partitions for the banded engine (dbscan_tpu/ops/banded.py).
 
@@ -762,6 +763,17 @@ def bucketize_banded(
             per_group = per_group // pad_parts_to * pad_parts_to
         for s0 in range(0, len(sel_class), per_group):
             plan.append((b, w, sel_class[s0 : s0 + per_group]))
+    if on_plan is not None:
+        # the full canonical plan, BEFORE any packing: (padded partition
+        # count, bucket width) per banded group — enough for a caller to
+        # pre-compute chunk-checkpoint totals (slots = p_pad * b) minutes
+        # before the first restart point could land
+        on_plan(
+            [
+                (_pad_parts(len(sp_), pad_parts_to, pad_parts_ladder), b)
+                for b, _w, sp_ in plan
+            ]
+        )
     emit = list(range(len(plan)))
     if resume_prefix:
         rp_ = min(int(resume_prefix), len(plan))

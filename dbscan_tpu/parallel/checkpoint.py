@@ -269,3 +269,43 @@ def load_p1_chunks(
             break
         ci += 1
     return out
+
+
+# --- campaign progress sidecar ----------------------------------------
+#
+# A retry-resume harness (bench.py::m100_row) needs two numbers a dead
+# leg cannot report: how many restart points exist on disk, and how many
+# the full run will need. The driver writes the plan-derived total here
+# the moment binning's canonical emission plan is known (minutes before
+# the first chunk could land); chunks_done is just the consecutive file
+# prefix — files behind a gap never resume (see load_p1_chunks).
+
+_PROGRESS = "progress.json"
+
+
+def write_progress(ckpt_dir: str, **fields) -> None:
+    """Atomically persist campaign-progress metadata (plan totals)."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, _PROGRESS)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(fields, f)
+    os.replace(tmp, path)
+
+
+def read_progress(ckpt_dir: str) -> dict:
+    try:
+        with open(os.path.join(ckpt_dir, _PROGRESS)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def count_p1_chunks(ckpt_dir: str) -> int:
+    """Length of the consecutive p1chunk file prefix — the number of
+    restart points a resuming leg can actually consume (fingerprint and
+    budget are verified at load time, not here)."""
+    ci = 0
+    while os.path.exists(_p1_path(ckpt_dir, ci)):
+        ci += 1
+    return ci

@@ -1383,6 +1383,36 @@ def train_arrays(
                 eager["cur_slots"] += sz_g
         dispatch_spent[0] += time.perf_counter() - td
 
+    def _on_plan(entries):
+        """Mirror _flush_chunk's accumulation over the canonical plan to
+        pre-compute how many chunk checkpoints the full run needs, and
+        persist it (progress.json) so a retry-resume harness can report
+        chunks_done/chunks_total even when every leg dies mid-device-
+        phase. Exact, not an estimate: saved chunks were formed by this
+        same rule in canonical order, so a resumed leg's new chunks pick
+        up at the same boundaries."""
+        total = 0
+        chunks = 0
+        cur = 0
+        for p_pad, b in entries:
+            sz = p_pad * b
+            total += sz
+            if cur and cur + sz > _COMPACT_CHUNK_SLOTS:
+                chunks += 1
+                cur = 0
+            cur += sz
+        if cur:
+            chunks += 1
+        from dbscan_tpu.parallel import checkpoint as _ckpt_p1
+
+        _ckpt_p1.write_progress(
+            checkpoint_dir,
+            chunks_total=chunks,
+            planned_groups=len(entries),
+            planned_slots=total,
+            chunk_budget=_COMPACT_CHUNK_SLOTS,
+        )
+
     cellmeta = None
     if use_banded:
         groups, max_b, cellmeta = binning.bucketize_banded(
@@ -1403,6 +1433,11 @@ def train_arrays(
             # uncovered device work starts within seconds (retry legs on
             # a dying worker must reach a NEW restart point fast)
             resume_prefix=len(p1_exp),
+            on_plan=(
+                _on_plan
+                if (compact_on and checkpoint_dir is not None)
+                else None
+            ),
         )
     else:
         groups, max_b = binning.bucketize_grouped(
