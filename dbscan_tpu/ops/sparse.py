@@ -84,9 +84,13 @@ def _pack_csr(x_csr, feature_block: int) -> _PackedCSR:
     return _PackedCSR(r, c, v, n, n_blocks)
 
 
-def _gram_scan(rows, cols, vals, n_rows: int, feature_block: int):
+def _gram_scan(rows, cols, vals, n_rows: int, feature_block: int,
+               varying_axis: str = None):
     """Accumulate X @ X.T over feature blocks: scatter-densify each
-    [N, F_block] slab, one MXU matmul per block."""
+    [N, F_block] slab, one MXU matmul per block. ``varying_axis``: set
+    to the mesh axis name when tracing inside shard_map — the scan
+    carry's zero init must be marked device-varying to match the
+    varying inputs (jax >= 0.9 shard_map type discipline)."""
 
     def step(gram, triple):
         r, c, v = triple
@@ -98,6 +102,8 @@ def _gram_scan(rows, cols, vals, n_rows: int, feature_block: int):
         return gram, None
 
     init = jnp.zeros((n_rows, n_rows), dtype=jnp.float32)
+    if varying_axis is not None:
+        init = jax.lax.pcast(init, varying_axis, to="varying")
     gram, _ = jax.lax.scan(step, init, (rows, cols, vals))
     return gram
 
@@ -115,6 +121,21 @@ def _stash(buf, vals, offset):
     keeps one copy. This is how per-leaf results coalesce into a single
     end-of-run pull instead of one ~0.5 s tunnel pull per leaf."""
     return jax.lax.dynamic_update_slice(buf, vals, (offset,))
+
+
+def _padded_leaf(x, rows_p, w: int):
+    """One leaf's CSR slice padded to its ladder width with zero rows
+    (masked downstream). Shared by the sequential stash loop and the
+    mesh batch dispatch: the two paths' bit-for-bit parity depends on
+    packing IDENTICAL leaf matrices."""
+    import scipy.sparse as sp
+
+    xp = x[rows_p]
+    if w > len(rows_p):
+        xp = sp.vstack(
+            [xp, sp.csr_matrix((w - len(rows_p), x.shape[1]))]
+        ).tocsr()
+    return xp
 
 
 def _normalize_rows(x_csr):
@@ -148,14 +169,63 @@ def sparse_cosine_gram(x_csr, feature_block: int = FEATURE_BLOCK) -> jnp.ndarray
     return _gram_unit(_normalize_rows(x_csr)[0], feature_block)
 
 
-@functools.partial(jax.jit, static_argnames=("min_points", "engine"))
-def _cluster_gram(gram, eps, mask, min_points: int, engine: str) -> LocalResult:
+def _cluster_gram_body(gram, eps, mask, min_points: int, engine: str) -> LocalResult:
     n = gram.shape[0]
     dist = 1.0 - gram
     adj = dist <= eps
     adj = adj | jnp.eye(n, dtype=bool)  # self-inclusive regardless of eps
     adj = adj & (mask[None, :] & mask[:, None])  # padding rows inert
     return cluster_from_adjacency(adj, mask, min_points, engine)
+
+
+@functools.partial(jax.jit, static_argnames=("min_points", "engine"))
+def _cluster_gram(gram, eps, mask, min_points: int, engine: str) -> LocalResult:
+    return _cluster_gram_body(gram, eps, mask, min_points, engine)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_leaf_batch(
+    w: int, feature_block: int, min_points: int, engine: str, mesh
+):
+    """Jitted mesh-sharded executor for a batch of SAME-WIDTH sparse
+    leaves: [K, nb, mn] packed-CSR scan inputs -> per-leaf gram ->
+    cluster, with the leaf axis sharded over the 'parts' mesh axis (one
+    leaf per device per batch) — the sparse analog of the dense driver's
+    _compiled_block (parallel/driver.py). Cached per (width, engine,
+    mesh); jit re-specializes on the ladder-quantized nnz width."""
+    from jax import lax
+    from jax.sharding import PartitionSpec
+
+    from dbscan_tpu.ops.labels import CORE
+    from dbscan_tpu.parallel.mesh import PARTS_AXIS
+
+    def block(rows, cols, vals, mask, eps):
+        def one(args):
+            r, c, v, m = args
+            gram = _gram_scan(
+                r, c, v, w, feature_block, varying_axis=PARTS_AXIS
+            )
+            res = _cluster_gram_body(gram, eps, m, min_points, engine)
+            return res.seed_labels, res.flags
+
+        seeds, flags = lax.map(one, (rows, cols, vals, mask))
+        # global core count all-reduce: keeps one real ICI collective in
+        # the sparse production program, mirroring _compiled_block — so
+        # multichip dryruns validate the communication path for sparse
+        ncore = jnp.sum(flags == CORE, dtype=jnp.int32)
+        ncore = lax.psum(ncore, PARTS_AXIS)
+        return seeds, flags, ncore
+
+    assert mesh is not None  # only the multi-device dispatch builds this
+    spec = PartitionSpec(PARTS_AXIS)
+    return jax.jit(
+        jax.shard_map(
+            block,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, spec, PartitionSpec()),
+            out_specs=(spec, spec, PartitionSpec()),
+        )
+    )
 
 
 def sparse_cosine_dbscan(
@@ -166,6 +236,7 @@ def sparse_cosine_dbscan(
     feature_block: int = FEATURE_BLOCK,
     max_points_per_partition: int = None,
     stats_out: dict = None,
+    mesh=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """DBSCAN over sparse rows with cosine distance (1 - similarity) <= eps.
 
@@ -215,7 +286,7 @@ def sparse_cosine_dbscan(
         if len(nz_rows):
             sub_c, sub_f = _spill_sparse(
                 x[nz_rows], eps, min_points, engine, feature_block,
-                max_points_per_partition, stats_out,
+                max_points_per_partition, stats_out, mesh=mesh,
             )
             clusters[nz_rows] = sub_c
             flags[nz_rows] = sub_f
@@ -233,7 +304,7 @@ def sparse_cosine_dbscan(
         return clusters, flags
     return _spill_sparse(
         x, eps, min_points, engine, feature_block,
-        max_points_per_partition, stats_out,
+        max_points_per_partition, stats_out, mesh=mesh,
     )
 
 
@@ -245,8 +316,15 @@ def _spill_sparse(
     feature_block: int,
     max_points_per_partition: int,
     stats_out: dict = None,
+    mesh=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Spill-partitioned sparse cosine run over PRE-NORMALIZED rows."""
+    """Spill-partitioned sparse cosine run over PRE-NORMALIZED rows.
+
+    With a multi-device ``mesh``, same-width leaves dispatch in batches
+    of mesh-size through the shard_map'd leaf-batch kernel (one leaf per
+    device per batch) instead of the sequential stash loop — the sparse
+    route's scale-out story, matching the dense driver's partition-axis
+    sharding."""
     import scipy.sparse as sp
 
     from dbscan_tpu.parallel.binning import _ladder_width
@@ -309,35 +387,43 @@ def _spill_sparse(
     # ladder shapes (jit cache) and per-leaf iteration counts.
     slot_off = np.r_[0, np.cumsum(widths)].astype(np.int64)
     total = _ladder_width(int(slot_off[-1]), 128)
-    seed_buf = jnp.zeros(total, dtype=jnp.int32)
-    flag_buf = jnp.zeros(total, dtype=jnp.int8)
     max_b = max(widths)
-    for p in range(n_parts):
-        # instances are partition-major: O(1) slices, no per-leaf scan
-        rows_p = point_idx[offsets[p] : offsets[p + 1]]
-        w = widths[p]
-        xp = x[rows_p]
-        if w > len(rows_p):  # pad to the ladder width (zero rows, masked)
-            xp = sp.vstack(
-                [xp, sp.csr_matrix((w - len(rows_p), x.shape[1]))]
-            ).tocsr()
-        gram = _gram_unit(xp, feature_block)
-        res = _cluster_gram(
-            gram,
-            jnp.float32(eps),
-            jnp.arange(w) < len(rows_p),
-            min_points,
-            engine,
-        )
-        seed_buf = _stash(seed_buf, res.seed_labels, int(slot_off[p]))
-        flag_buf = _stash(flag_buf, res.flags, int(slot_off[p]))
-    t_leaves = _time.perf_counter()
+    from dbscan_tpu.parallel.mesh import mesh_size as _mesh_size
 
-    # the single pull, then reassembly in partition-major instance order
-    # for the shared merge (each leaf's true size is counts[p])
-    seeds_all = np.asarray(seed_buf)
-    flags_all = np.asarray(flag_buf)
-    t_pull = _time.perf_counter()
+    if mesh is not None and _mesh_size(mesh) > 1:
+        # scale-out route: same-width leaves dispatch in mesh-size
+        # batches through the shard_map'd leaf-batch kernel, one leaf
+        # per device per batch (results pulled per batch)
+        seeds_all, flags_all = _mesh_leaf_dispatch(
+            x, point_idx, offsets, counts, widths, slot_off, total,
+            eps, min_points, engine, feature_block, mesh,
+        )
+        t_leaves = _time.perf_counter()
+        t_pull = t_leaves
+    else:
+        seed_buf = jnp.zeros(total, dtype=jnp.int32)
+        flag_buf = jnp.zeros(total, dtype=jnp.int8)
+        for p in range(n_parts):
+            # instances are partition-major: O(1) slices, no per-leaf scan
+            rows_p = point_idx[offsets[p] : offsets[p + 1]]
+            w = widths[p]
+            gram = _gram_unit(_padded_leaf(x, rows_p, w), feature_block)
+            res = _cluster_gram(
+                gram,
+                jnp.float32(eps),
+                jnp.arange(w) < len(rows_p),
+                min_points,
+                engine,
+            )
+            seed_buf = _stash(seed_buf, res.seed_labels, int(slot_off[p]))
+            flag_buf = _stash(flag_buf, res.flags, int(slot_off[p]))
+        t_leaves = _time.perf_counter()
+
+        # the single pull, then reassembly in partition-major instance
+        # order for the shared merge (each leaf's true size is counts[p])
+        seeds_all = np.asarray(seed_buf)
+        flags_all = np.asarray(flag_buf)
+        t_pull = _time.perf_counter()
     inst_seed = np.concatenate(
         [
             seeds_all[slot_off[p] : slot_off[p] + counts[p]]
@@ -367,3 +453,71 @@ def _spill_sparse(
             "total_s": round(_time.perf_counter() - t_start, 6),
         }
     return clusters, flags
+
+
+def _mesh_leaf_dispatch(
+    x, point_idx, offsets, counts, widths, slot_off, total,
+    eps, min_points, engine, feature_block, mesh,
+):
+    """Pack and dispatch same-width leaves in mesh-size batches through
+    :func:`_compiled_leaf_batch`; returns slot-packed host seed/flag
+    arrays (the same layout the sequential stash loop produces).
+
+    Dispatch is ASYNC: every batch is enqueued before any result is
+    pulled, so host packing of batch i+1 overlaps device compute of
+    batch i (the property the sequential path's device stash exists
+    for) and the pulls at the end see already-finished work."""
+    from collections import defaultdict
+
+    from dbscan_tpu.parallel import mesh as mesh_mod
+
+    m = mesh_mod.mesh_size(mesh)
+    seeds_all = np.zeros(total, dtype=np.int32)
+    flags_all = np.zeros(total, dtype=np.int8)
+    by_w = defaultdict(list)
+    for p, w in enumerate(widths):
+        by_w[int(w)].append(p)
+    # replicated scalar, NOT a locally-committed jnp value: in a multi-
+    # process mesh the batch inputs are global arrays, and a device-
+    # committed eps would clash at jit time (see mesh.replicate_host_array)
+    ej = mesh_mod.replicate_host_array(np.float32(eps))
+    inflight = []  # (batch leaf ids, width, seeds_dev, flags_dev)
+    for w, plist in sorted(by_w.items()):
+        fn = _compiled_leaf_batch(w, feature_block, min_points, engine, mesh)
+        for s0 in range(0, len(plist), m):
+            batch = plist[s0 : s0 + m]
+            packs, masks = [], []
+            for p in batch:
+                rows_p = point_idx[offsets[p] : offsets[p + 1]]
+                packs.append(
+                    _pack_csr(_padded_leaf(x, rows_p, w), feature_block)
+                )
+                masks.append(np.arange(w) < len(rows_p))
+            nb = packs[0].n_blocks
+            mn = max(pk.rows.shape[1] for pk in packs)
+            # short batches pad with empty leaves (all-False mask) so the
+            # leading axis always equals the mesh size — one jit shape
+            rows_b = np.zeros((m, nb, mn), dtype=np.int32)
+            cols_b = np.zeros((m, nb, mn), dtype=np.int32)
+            vals_b = np.zeros((m, nb, mn), dtype=np.float32)
+            mask_b = np.zeros((m, w), dtype=bool)
+            for i, pk in enumerate(packs):
+                rows_b[i, :, : pk.rows.shape[1]] = pk.rows
+                cols_b[i, :, : pk.cols.shape[1]] = pk.cols
+                vals_b[i, :, : pk.vals.shape[1]] = pk.vals
+                mask_b[i] = masks[i]
+            seeds_dev, flags_dev, _ = fn(
+                mesh_mod.shard_host_array(mesh, rows_b),
+                mesh_mod.shard_host_array(mesh, cols_b),
+                mesh_mod.shard_host_array(mesh, vals_b),
+                mesh_mod.shard_host_array(mesh, mask_b),
+                ej,
+            )
+            inflight.append((batch, w, seeds_dev, flags_dev))
+    for batch, w, seeds_dev, flags_dev in inflight:
+        seeds = mesh_mod.pull_to_host(seeds_dev)
+        flags = mesh_mod.pull_to_host(flags_dev)
+        for i, p in enumerate(batch):
+            seeds_all[slot_off[p] : slot_off[p] + w] = seeds[i]
+            flags_all[slot_off[p] : slot_off[p] + w] = flags[i]
+    return seeds_all, flags_all

@@ -147,6 +147,9 @@ def clear_compile_cache() -> None:
     configurations or meshes."""
     _compiled_block.cache_clear()
     _compiled_banded_p1.cache_clear()
+    from dbscan_tpu.ops.sparse import _compiled_leaf_batch
+
+    _compiled_leaf_batch.cache_clear()
 
 
 @functools.lru_cache(maxsize=256)
