@@ -338,7 +338,7 @@ def child_m100(ckpt_dir: str, out_path: str) -> None:
     Reference analog: the partition-bounded scaling contract,
     DBSCAN.scala:53-56, where Spark lineage replays lost partitions."""
     n = int(os.environ.get("BENCH_100M_N", "100000000"))
-    maxpp = int(os.environ.get("BENCH_100M_MAXPP", "131072"))
+    maxpp = int(os.environ.get("BENCH_100M_MAXPP", "262144"))
     pts, blob_of, n_blob, k, eps = make_anchor(n, "euclidean")
     from dbscan_tpu import Engine, train
     from dbscan_tpu.utils.ari import adjusted_rand_index
@@ -426,7 +426,7 @@ def m100_row(prefix: str = "m100") -> dict:
     # deterministic), so a mismatch wipes the dir clean.
     campaign_key = {
         "n": int(os.environ.get("BENCH_100M_N", "100000000")),
-        "maxpp": int(os.environ.get("BENCH_100M_MAXPP", "131072")),
+        "maxpp": int(os.environ.get("BENCH_100M_MAXPP", "262144")),
         "chunk_slots": env["DBSCAN_COMPACT_CHUNK_SLOTS"],
         "group_slots": env["DBSCAN_GROUP_SLOTS"],
     }

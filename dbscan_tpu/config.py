@@ -118,6 +118,13 @@ class DBSCANConfig:
     neighbor_backend: str = "auto"
     auto_maxpp: bool = False
     static_partition_pad: bool = False
+    # Monotone shape-ratchet state for streaming micro-batches (see
+    # binning._ratchet): a mutable dict the SAME config object carries
+    # across updates — rungs pinned here only grow, so steady-state
+    # batches reuse exact jit signatures. None (default) disables; owned
+    # and installed by streaming.StreamingDBSCAN. Excluded from the
+    # checkpoint fingerprint (streaming runs don't checkpoint).
+    shape_floors: dict = dataclasses.field(default=None, compare=False)
 
     @property
     def eps_sq(self) -> float:

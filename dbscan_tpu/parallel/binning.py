@@ -200,6 +200,24 @@ def _segment_indices(seg_starts: np.ndarray, seg_counts: np.ndarray) -> np.ndarr
     return np.arange(total, dtype=np.int64) + off
 
 
+def _ratchet(floors, key, val: int, cap: int = None) -> int:
+    """Monotone shape ratchet for streaming micro-batches: pin ``val``
+    up to the largest value ever used under ``key`` (and remember the
+    result). Data-dependent rungs fluctuate batch-to-batch across ladder
+    boundaries, minting fresh jit signatures forever; the ratchet makes
+    every pinned dimension monotone, so after warm-up each batch reuses
+    EXACT shapes and steady-state compiles reach zero. ``cap`` bounds
+    values that must not exceed a structural limit (slab <= bucket
+    width). No-op when ``floors`` is None (batch runs)."""
+    if floors is None:
+        return val
+    v = max(int(val), int(floors.get(key, 0)))
+    if cap is not None:
+        v = min(v, int(cap))
+    floors[key] = max(int(floors.get(key, 0)), v)
+    return v
+
+
 def _ladder_width(c: int, bucket_multiple: int) -> int:
     """Round a count up along a ~1.5x geometric ladder of bucket_multiple
     multiples (q in 1, 1.5, 2, 3, 4, 6, ... when it divides evenly): area
@@ -287,6 +305,7 @@ def bucketize_grouped(
     dtype=np.float32,
     on_group=None,
     pad_parts_ladder: bool = False,
+    shape_floors=None,
 ) -> Tuple[list, int]:
     """Pack partitions into SIZE-GROUPED static buffers.
 
@@ -320,7 +339,11 @@ def bucketize_grouped(
     max_b = 0
     for b in sorted(set(widths.tolist())):
         sel_parts = np.flatnonzero(widths == b)
-        p_pad = _pad_parts(len(sel_parts), pad_parts_to, pad_parts_ladder)
+        p_pad = _ratchet(
+            shape_floors,
+            ("gparts", int(b)),
+            _pad_parts(len(sel_parts), pad_parts_to, pad_parts_ladder),
+        )
         buf = np.zeros((p_pad, b, d), dtype=dtype)
         mask = np.zeros((p_pad, b), dtype=bool)
         idx = np.full((p_pad, b), -1, dtype=np.int64)
@@ -433,6 +456,7 @@ def bucketize_banded(
     pad_parts_ladder: bool = False,
     resume_prefix: int = 0,
     on_plan=None,
+    shape_floors=None,
 ) -> Tuple[list, int, "CellGraphMeta"]:
     """Pack partitions for the banded engine (dbscan_tpu/ops/banded.py).
 
@@ -649,6 +673,23 @@ def bucketize_banded(
     # of the block size.
     t = BANDED_BLOCK
     widths_band = (widths_b + t - 1) // t * t
+    if shape_floors is not None:
+        # Uniform streaming width: banded-eligible partitions all share
+        # ONE ratcheted width class. Per-partition ladder widths
+        # fluctuate across micro-batches (49152 <-> 65536 at the top
+        # rungs), and every distinct width mints its own phase-1
+        # signature AND a distinct chunk-postpass group-shape multiset —
+        # the combinatorial compile source the ratchet alone cannot pin.
+        # Costs bounded masked padding (<= the ladder step, ~1.33x) in
+        # exchange for a single recurring signature family.
+        eligible = (widths_b > 0) & (
+            force | (widths_band >= BANDED_ROUTE_BUCKET)
+        )
+        if eligible.any():
+            uw = _ratchet(
+                shape_floors, "buw", int(widths_band[eligible].max())
+            )
+            widths_band = np.where(eligible, uw, widths_band)
     nb_of = widths_band // t  # blocks per partition
     maxnb = int(nb_of.max())
 
@@ -687,6 +728,17 @@ def bucketize_banded(
         np.array([_ladder_width(s, 128) for s in slab_need], dtype=np.int64),
         widths_band,  # slab can never exceed the bucket; ladder may overshoot
     )
+    if shape_floors is not None:
+        # per-width slab pin (slab is part of the (width, slab) group
+        # class AND a static jit arg of the phase-1 executor): ratchet it
+        # so micro-batch density fluctuations stop re-minting signatures
+        for i in range(n_parts):
+            win[i] = _ratchet(
+                shape_floors,
+                ("slab", int(widths_band[i])),
+                int(win[i]),
+                cap=int(widths_band[i]),
+            )
 
     # Clamp slab origins so slab_start + S <= B; runs still fit (a clamped
     # origin only moves left, and run ends are bounded by the bucket width).
@@ -781,8 +833,10 @@ def bucketize_banded(
     for k in emit:
         b, w, sel_parts = plan[k]
         nb = b // t
-        p_pad = _pad_parts(
-            len(sel_parts), pad_parts_to, pad_parts_ladder
+        p_pad = _ratchet(
+            shape_floors,
+            ("bparts", int(b), int(w)),
+            _pad_parts(len(sel_parts), pad_parts_to, pad_parts_ladder),
         )
         pid = np.full(p_pad, -1, dtype=np.int64)
         pid[: len(sel_parts)] = sel_parts

@@ -452,11 +452,17 @@ def _group_flops(g) -> int:
     )
 
 
-def _pad_idx(pos: np.ndarray) -> np.ndarray:
+def _pad_idx(pos: np.ndarray, shape_floors=None) -> np.ndarray:
     """Pad a flat gather-index vector up the bucket ladder so the device
     gather compiles once per rung, not per data-dependent count (padding
-    gathers position 0; callers slice the pull back to the true length)."""
-    k = binning._ladder_width(max(1, len(pos)), 4096)
+    gathers position 0; callers slice the pull back to the true length).
+    With shape_floors (streaming), the rung ratchets monotonically so
+    steady-state batches reuse ONE gather signature."""
+    k = binning._ratchet(
+        shape_floors,
+        "gather",
+        binning._ladder_width(max(1, len(pos)), 4096),
+    )
     out = np.zeros(k, dtype=np.int32)
     out[: len(pos)] = pos
     return out
@@ -1200,7 +1206,9 @@ def train_arrays(
         bpos = np.flatnonzero(layout["validflat"] & ~core_ch)
         bb_dev = gather_flat(
             rec.pop("bits_flat"),
-            mesh_mod.replicate_host_array(_pad_idx(bpos)),
+            mesh_mod.replicate_host_array(
+                _pad_idx(bpos, getattr(cfg, "shape_floors", None))
+            ),
         )
         bbits = mesh_mod.pull_to_host(bb_dev)[: len(bpos)]
         rec["combo_host"] = combo_host
@@ -1441,6 +1449,7 @@ def train_arrays(
                 if (compact_on and checkpoint_dir is not None)
                 else None
             ),
+            shape_floors=getattr(cfg, "shape_floors", None),
         )
     else:
         groups, max_b = binning.bucketize_grouped(
@@ -1453,6 +1462,7 @@ def train_arrays(
             dtype=dtype,
             on_group=_on_group,
             pad_parts_ladder=cfg.static_partition_pad,
+            shape_floors=getattr(cfg, "shape_floors", None),
         )
     timings["dispatch_s"] = round(
         dispatch_spent[0] - eager["pull_spent"] - sync_spent[0], 6

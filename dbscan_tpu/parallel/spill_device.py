@@ -1,0 +1,387 @@
+"""Device-accelerated spill-tree passes (dense cosine decomposition).
+
+The spill tree's host cost is NOT one big matmul — it is hundreds of
+sample-sized BLAS passes (farthest-point traversal, Lloyd refinement,
+the sampled rejection screen, greedy leader cover, canopy membership),
+measured at ~2/3 of the cosine anchor's wall on the single-core host
+(VERDICT r4 item 2). This module runs those passes on the accelerator:
+the node's rows are uploaded ONCE (bf16), every sequential traversal
+becomes a `lax.while_loop` of matvecs against the resident rows, and
+only small results cross the link — pivot vectors [m, D], assignment
+bytes [n], packed membership bits [n*m/8], a leader adjacency [L, L].
+
+Precision contract: rows are stored bf16 (halves the upload — the
+tunnel's ~60 MB/s uplink is the binding resource, see BASELINE.md), and
+every band comparison the COVERAGE PROOF depends on is inflated by an
+explicit `slack` bound on the bf16 chord error (2*2^-9 dot error for
+unit rows -> chord error <= sqrt(2*2^-8) at small chords). Inflating a
+band is one-sided: the copy-sets/canopies only GROW, so no accepted
+pair is ever missed — quantization costs duplication, never
+correctness. Pivot SELECTION and the rejection screen need no slack at
+all (pivot choice never affects correctness; the screen only decides
+whether to escalate, and the exact full-node pass re-decides).
+
+Reference analog: none — the reference's decomposition is 2-D
+rectangles on a driver-local grid (EvenSplitPartitioner.scala:66-103);
+this is the high-dimensional counterpart's hot path moved to the chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# chord-error bound for bf16-stored unit rows: |dot error| <= 2*2^-9
+# (+f32 accumulation, negligible at D<=4096); chord = sqrt(2-2dot) moves
+# worst at small chords by sqrt(2 * 2 * 2^-9) ~ 0.0885
+BF16_CHORD_SLACK = float(np.sqrt(2.0 * 2.0 * 2.0**-9)) + 1e-4
+_LEADER_CAP = 4096  # mirrors spill._LEADER_CAP
+
+
+class DeviceNodeOps:
+    """One spill node's rows resident on the accelerator.
+
+    Drop-in companion to spill._DenseOps for the device-accelerated
+    passes; built lazily by the tree driver only when a usable non-CPU
+    backend exists (or when forced for tests). ``take`` gathers a child
+    subset ON DEVICE from the parent's resident rows — a child upload is
+    an int32 index vector, ~500x smaller than its rows."""
+
+    def __init__(self, x, n: int, dim: int):
+        self.x = x  # [n, D] bf16 device array
+        self.n = n
+        self.dim = dim
+
+    @classmethod
+    def from_host(cls, x_host: np.ndarray):
+        import jax.numpy as jnp
+        import ml_dtypes
+
+        xb = np.asarray(x_host, dtype=ml_dtypes.bfloat16)
+        return cls(jnp.asarray(xb), x_host.shape[0], x_host.shape[1])
+
+    def take(self, idx: np.ndarray) -> "DeviceNodeOps":
+        import jax.numpy as jnp
+
+        return DeviceNodeOps(
+            _gather_fn()(self.x, jnp.asarray(np.asarray(idx, np.int32))),
+            len(idx),
+            self.dim,
+        )
+
+
+@functools.lru_cache(maxsize=1)
+def _gather_fn():
+    import jax
+
+    return jax.jit(lambda x, idx: x[idx])
+
+
+def _jax():
+    import jax
+    import jax.numpy as jnp
+
+    return jax, jnp
+
+
+def _ladder8(m: int, cap: int = 192) -> int:
+    """Quantize a pivot count up the shared geometric ladder (multiple
+    8, capped): device kernels are keyed on the count, and the raw
+    data-dependent values would mint a fresh XLA compile per spill-tree
+    node. Extra pivots are harmless — selection quality only, and the
+    halo-separation filter drops any excess."""
+    from dbscan_tpu.parallel.binning import _ladder_width
+
+    return min(_ladder_width(m, 8), cap)
+
+
+@functools.lru_cache(maxsize=32)
+def _farthest_lloyd_fn(m: int, dim: int, cap_iters: int = 2):
+    """Jitted farthest-point seeding + ``cap_iters`` Lloyd steps.
+
+    Farthest-point is the host algorithm verbatim: start from row
+    ``seed0``, repeatedly take the row maximizing the running min-chord.
+    Lloyd: assign to nearest pivot (max dot), renormalized cell means.
+    Returns ([m, D] f32 pivots, [m] bool valid) — empty cells invalid.
+    """
+    jax, jnp = _jax()
+
+    def fn(x, seed0):
+        n = x.shape[0]
+        xf = x.astype(jnp.float32)
+
+        def fp_body(i, st):
+            piv, dmin = st
+            j = jnp.argmax(dmin)
+            row = xf[j]
+            piv = piv.at[i].set(row)
+            d = 2.0 - 2.0 * (xf @ row)
+            dmin = jnp.minimum(dmin, jnp.maximum(d, 0.0))
+            return piv, dmin
+
+        piv0 = jnp.zeros((m, dim), jnp.float32)
+        d0 = jnp.full((n,), jnp.inf, jnp.float32)
+        # seed exactly like the host: first pivot is the seed row, the
+        # rest follow the farthest-point recurrence
+        piv0 = piv0.at[0].set(xf[seed0])
+        d0 = jnp.maximum(2.0 - 2.0 * (xf @ xf[seed0]), 0.0)
+        piv, _ = jax.lax.fori_loop(1, m, fp_body, (piv0, d0))
+
+        def lloyd(_, piv):
+            a = jnp.argmax(xf @ piv.T, axis=1)
+            sums = jax.ops.segment_sum(xf, a, num_segments=m)
+            norms = jnp.linalg.norm(sums, axis=1, keepdims=True)
+            newp = sums / jnp.maximum(norms, 1e-12)
+            # empty/degenerate cells keep their previous vector; the
+            # host drops them — the valid mask below reproduces that
+            return jnp.where(norms > 1e-12, newp, piv)
+
+        piv = jax.lax.fori_loop(0, cap_iters, lloyd, piv)
+        a = jnp.argmax(xf @ piv.T, axis=1)
+        mass = jax.ops.segment_sum(
+            jnp.ones((n,), jnp.int32), a, num_segments=m
+        )
+        return piv, mass
+
+    return jax.jit(fn)
+
+
+def pivot_vectors_device(sub: DeviceNodeOps, m: int, halo: float, rng):
+    """Device counterpart of spill._pivot_vectors: farthest-point seeds
+    + 2 Lloyd steps on the resident rows, then the host's greedy
+    halo-separation filter on the pulled [m, D] pivots (O(m^2), host).
+    Pivot choice never affects correctness (spill.py module docstring),
+    so bf16 rows need no slack here."""
+    if sub.n < 2:
+        return np.zeros((0, sub.dim), np.float32)
+    fn = _farthest_lloyd_fn(_ladder8(int(m)), int(sub.dim))
+    seed0 = int(rng.integers(sub.n))
+    piv, mass = fn(sub.x, seed0)
+    piv = np.asarray(piv, dtype=np.float32)
+    mass = np.asarray(mass)
+    keep = mass > 0
+    piv, mass = piv[keep], mass[keep]
+    if len(piv) < 2:
+        return piv
+    from dbscan_tpu.parallel.spill import halo_separation_filter
+
+    return halo_separation_filter(piv, mass, halo)
+
+
+@functools.lru_cache(maxsize=32)
+def _membership_fn(dim: int):
+    """Jitted full-node membership pass. Returns (assign u8, member
+    bits packed along the pivot axis, band-hit counts per cell, d_min).
+
+    The band formula mirrors spill._membership exactly — intersection
+    of the radius band ``r_c + halo`` and the classic ``d_min + 2*halo``
+    — with ``slack`` added where the bf16 chord error could SHRINK a
+    band (r from underestimated d_min, d overestimated): bands only
+    grow, so the copy-set stays a superset of the host-f32 one.
+    """
+    jax, jnp = _jax()
+
+    def fn(x, piv, n_valid, halo, slack):
+        xf = x.astype(jnp.float32)
+        d = 2.0 - 2.0 * (xf @ piv.T)
+        d = jnp.sqrt(jnp.maximum(d, 0.0))
+        m = d.shape[1]
+        # pivots are ladder-padded so the kernel compiles once per rung,
+        # not per data-dependent count; padded columns can never win
+        d = jnp.where(jnp.arange(m)[None, :] < n_valid, d, jnp.inf)
+        assign = jnp.argmin(d, axis=1)
+        dmin = jnp.take_along_axis(d, assign[:, None], axis=1)[:, 0]
+        r = jax.ops.segment_max(
+            dmin, assign, num_segments=m, indices_are_sorted=False
+        )
+        # segment_max of an empty segment is -inf: exactly the host's
+        # "cells nobody is assigned to need no copies" convention.
+        # Host formula verbatim (spill._membership), each band +2*slack:
+        # measured d overestimates by <= slack while measured r (or the
+        # point's own d_min) underestimates by <= slack, so the true-
+        # distance copy-set condition implies the inflated measured one.
+        member = (d <= (r + halo + 2.0 * slack)[None, :]) & (
+            d <= (dmin + 2.0 * halo + 2.0 * slack)[:, None]
+        )
+        sizes = member.sum(axis=0, dtype=jnp.int32)
+        packed = jnp.packbits(member, axis=1)
+        return assign.astype(jnp.uint8), packed, sizes, dmin
+
+    return jax.jit(fn)
+
+
+def membership_device(sub: DeviceNodeOps, piv: np.ndarray, halo: float):
+    """(assign, member) for the full node, computed on device; pulls
+    [n] assign bytes + packed member bits. Matches spill._membership's
+    bands inflated by BF16_CHORD_SLACK (superset copy-sets)."""
+    import jax.numpy as jnp
+
+    fn = _membership_fn(int(sub.dim))
+    m = piv.shape[0]
+    m_pad = _ladder8(max(m, 1), cap=max(192, m))
+    piv_pad = np.zeros((m_pad, piv.shape[1]), dtype=np.float32)
+    piv_pad[:m] = piv
+    assign, packed, sizes, _ = fn(
+        sub.x,
+        jnp.asarray(piv_pad),
+        jnp.int32(m),
+        jnp.float32(halo),
+        jnp.float32(BF16_CHORD_SLACK),
+    )
+    member = np.unpackbits(
+        np.asarray(packed), axis=1, count=m_pad
+    ).astype(bool)[:, :m]
+    return np.asarray(assign).astype(np.int64), member
+
+
+def screen_dup_device(sub: DeviceNodeOps, piv: np.ndarray, halo: float):
+    """Sampled rejection screen: (dup per point, cell count). Pulls two
+    scalars. No slack — the screen only chooses whether to escalate."""
+    import jax.numpy as jnp
+
+    fn = _membership_fn(int(sub.dim))
+    m = piv.shape[0]
+    m_pad = _ladder8(max(m, 1), cap=max(192, m))
+    piv_pad = np.zeros((m_pad, piv.shape[1]), dtype=np.float32)
+    piv_pad[:m] = piv
+    _, _, sizes, _ = fn(
+        sub.x,
+        jnp.asarray(piv_pad),
+        jnp.int32(m),
+        jnp.float32(halo),
+        jnp.float32(0.0),
+    )
+    sizes = np.asarray(sizes)[:m]
+    return float(sizes.sum()) / max(1, sub.n), m
+
+
+@functools.lru_cache(maxsize=8)
+def _greedy_leaders_fn(dim: int, cap: int):
+    """Jitted greedy metric cover: walk the permutation, every row
+    farther than ``t`` (minus slack: bf16 could OVERestimate a distance
+    and mint a leader the host would skip — extra leaders are harmless,
+    but a MISSED cover is not, so the coverage test uses t + slack
+    nowhere and the canopy band carries the slack instead; here the
+    sequential semantics match the host exactly up to quantization) from
+    every previous leader becomes a leader. One matvec per leader.
+    Returns (leader rows [cap, D] f32, count, overflowed)."""
+    jax, jnp = _jax()
+
+    def fn(x, perm, t):
+        n = x.shape[0]
+        xf = x.astype(jnp.float32)[perm]
+
+        def cond(st):
+            _, nb, dmin, overflow = st
+            return (~overflow) & (dmin.max() > t)
+
+        def body(st):
+            buf, nb, dmin, _ = st
+            j = jnp.argmax(dmin > t)  # FIRST uncovered in perm order
+            row = xf[j]
+            d = jnp.maximum(2.0 - 2.0 * (xf @ row), 0.0)
+            dmin = jnp.minimum(dmin, d)
+            buf = buf.at[jnp.minimum(nb, cap - 1)].set(row)
+            return buf, nb + 1, dmin, nb + 1 > cap
+
+        # jnp.argmax(bool) returns 0 on all-False; guard via cond on max
+        buf0 = jnp.zeros((cap, dim), jnp.float32)
+        d0 = jnp.full((n,), jnp.inf, jnp.float32)
+        buf, nb, _, overflow = jax.lax.while_loop(
+            cond, body, (buf0, jnp.int32(0), d0, jnp.bool_(False))
+        )
+        return buf, nb, overflow
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=32)
+def _canopy_fn(dim: int):
+    """Jitted canopy pass: nearest leader per point, leader-leader
+    canopy-overlap adjacency (M^T M of the banded membership — a point
+    in two canopies connects them; clique vs the host's star edges, same
+    components), and the total membership count for the edge budget."""
+    jax, jnp = _jax()
+
+    def fn(x, leaders, n_valid, band):
+        xf = x.astype(jnp.float32)
+        d = 2.0 - 2.0 * (xf @ leaders.T)
+        d = jnp.sqrt(jnp.maximum(d, 0.0))
+        # leaders ladder-padded (one compile per rung); padded columns
+        # sit at +inf so they never cover or win nearest
+        lmask = jnp.arange(d.shape[1])[None, :] < n_valid
+        d = jnp.where(lmask, d, jnp.inf)
+        nearest = jnp.argmin(d, axis=1)
+        mf = (d <= band).astype(jnp.float32)
+        adj = (mf.T @ mf) > 0.0
+        # per-leader counts, summed on the host in f64: a single on-
+        # device f32 total loses integer precision past 2^24 and int32
+        # overflows at n*L ~ 4e9; each column count <= n < 2^24 is exact
+        return nearest.astype(jnp.int32), adj, mf.sum(axis=0)
+
+    return jax.jit(fn)
+
+
+def leader_components_device(
+    sub: DeviceNodeOps, halo: float, rng, edge_budget: int
+):
+    """Device counterpart of spill.leader_components: greedy cover at
+    escalating radii, canopy-overlap union, exact-cover components.
+    The canopy band carries BF16_CHORD_SLACK on BOTH the cover radius
+    (a true distance may exceed the measured-under-t by slack) and the
+    accepted-pair halo — the cover proof's triangle inequality then
+    holds for TRUE distances, so components remain exact covers."""
+    from dbscan_tpu.parallel.graph import uf_components
+
+    n = sub.n
+    for t_mult in (2.0, 4.0, 8.0):
+        t = t_mult * halo
+        if t + halo >= 1.9:
+            break
+        import jax.numpy as jnp
+
+        fn = _greedy_leaders_fn(int(sub.dim), _LEADER_CAP)
+        perm = rng.permutation(n).astype(np.int32)
+        buf, nb, overflow = fn(sub.x, jnp.asarray(perm), jnp.float32(t))
+        if bool(overflow):
+            continue  # cap exceeded: retry at a coarser radius
+        nb = int(nb)
+        if nb < 2:
+            return None
+        band = t + halo + 2.0 * BF16_CHORD_SLACK
+        cfn = _canopy_fn(int(sub.dim))
+        l_pad = _ladder8(nb, cap=_LEADER_CAP)
+        nearest, adj, col_counts = cfn(
+            sub.x,
+            jnp.asarray(np.asarray(buf)[:l_pad]),
+            jnp.int32(nb),
+            jnp.float32(band),
+        )
+        total = float(
+            np.asarray(col_counts, dtype=np.float64)[:nb].sum()
+        )
+        if total > edge_budget * n:
+            return None  # canopies overlap heavily; larger radii more so
+        adj = np.asarray(adj)[:nb, :nb]
+        ea, eb = np.nonzero(np.triu(adj, k=1))
+        n_comp, gids = uf_components(
+            ea.astype(np.int64), eb.astype(np.int64), nb
+        )
+        if n_comp < 2:
+            return None
+        comp = (np.asarray(gids)[np.asarray(nearest)] - 1).astype(np.int32)
+        return comp, int(n_comp)
+    return None
+
+
+def device_available() -> bool:
+    """True when a non-CPU jax backend is initialized/initializable —
+    the gate the spill tree uses before routing passes here. Import
+    errors and dead backends degrade to the host path silently."""
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu"
+    except Exception:  # noqa: BLE001 — any failure means "no device"
+        return False

@@ -143,6 +143,12 @@ class StreamingDBSCAN:
             static_partition_pad=True,
         )
         self.config.validate()
+        if self.config.shape_floors is None:
+            import dataclasses as _dc
+
+            # the ratchet dict must be THE SAME object across updates —
+            # it carries the monotone rung state that pins jit shapes
+            self.config = _dc.replace(self.config, shape_floors={})
         self.window = int(window)
         if self.window < 0:
             raise ValueError(f"window must be >= 0, got {window}")

@@ -374,3 +374,61 @@ def test_prefix_components_cross_flush_chain_converges(monkeypatch):
     assert n_comp == 2
     assert comp[0] == comp[2] == comp[3]
     assert comp[1] != comp[0]
+
+
+def test_spill_device_passes_match_host(rng, monkeypatch):
+    """DBSCAN_SPILL_DEVICE=1 routes pivot selection, the rejection
+    screen, full-node membership, and the leader cover through the
+    accelerated (jax) implementations with bf16 storage + slack-inflated
+    bands. The trees may differ in copy-sets (slack only ADDS copies),
+    so assert the CONTRACT, not the layout: same final labels through
+    the full pipeline on a blobs workload, and a valid exact cover."""
+    from dbscan_tpu import train
+
+    d = 24
+    centers = rng.normal(size=(12, d)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    pts = np.repeat(centers, 120, axis=0).astype(np.float32)
+    pts += 0.004 * rng.normal(size=pts.shape).astype(np.float32)
+
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "0")
+    m_host = train(pts, eps=0.02, min_points=5,
+                   max_points_per_partition=256, metric="cosine")
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "1")
+    m_dev = train(pts, eps=0.02, min_points=5,
+                  max_points_per_partition=256, metric="cosine")
+    assert m_dev.n_clusters == m_host.n_clusters == 12
+    # identical labels up to renumbering: ARI exactly 1
+    from dbscan_tpu.utils.ari import adjusted_rand_index
+
+    assert adjusted_rand_index(m_host.clusters, m_dev.clusters) == 1.0
+
+
+def test_spill_device_concentration_regime(rng, monkeypatch):
+    """The device leader-cover fallback must split the concentration
+    regime (cluster count >> pivots) exactly like the host's, with zero
+    duplication."""
+    from dbscan_tpu.parallel import spill
+
+    d = 32
+    k, per = 250, 12  # clusters >> _MAX_PIVOTS: pivot tree cannot split
+    centers = rng.normal(size=(k, d)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    unit = np.repeat(centers, per, axis=0).astype(np.float32)
+    unit += 0.002 * rng.normal(size=unit.shape).astype(np.float32)
+    unit /= np.linalg.norm(unit, axis=1, keepdims=True)
+    halo = spill.chord_halo(0.02, 1e-5, dim=d)
+
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "1")
+    part_ids, point_idx, n_parts, home_of = spill.spill_partition(
+        unit, 256, halo
+    )
+    # components are bin-packed into maxpp-sized leaves — split happened
+    # iff the leaf count is ~n/maxpp, not one oversized leaf
+    assert n_parts >= len(unit) // 256
+    assert len(part_ids) == len(unit)  # zero duplication (exact cover)
+    # exact cover: same-blob rows always share their home partition
+    blob = np.repeat(np.arange(k), per)
+    for b in range(0, k, 7):
+        homes = home_of[blob == b]
+        assert len(np.unique(homes)) == 1
