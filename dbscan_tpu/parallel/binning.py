@@ -306,6 +306,7 @@ def bucketize_grouped(
     on_group=None,
     pad_parts_ladder: bool = False,
     shape_floors=None,
+    fill_payload: bool = True,
 ) -> Tuple[list, int]:
     """Pack partitions into SIZE-GROUPED static buffers.
 
@@ -344,7 +345,12 @@ def bucketize_grouped(
             ("gparts", int(b)),
             _pad_parts(len(sel_parts), pad_parts_to, pad_parts_ladder),
         )
-        buf = np.zeros((p_pad, b, d), dtype=dtype)
+        # resident-payload mode (fill_payload False): the device already
+        # holds the full [N, D] row array, so the group ships only its
+        # gather indices + mask — ~500x less upload for 512-d payloads
+        buf = (
+            np.zeros((p_pad, b, d), dtype=dtype) if fill_payload else None
+        )
         mask = np.zeros((p_pad, b), dtype=bool)
         idx = np.full((p_pad, b), -1, dtype=np.int64)
         pid = np.full(p_pad, -1, dtype=np.int64)
@@ -357,7 +363,8 @@ def bucketize_grouped(
             gi = _segment_indices(starts[sel_parts], counts[sel_parts])
             rows = np.repeat(np.arange(len(sel_parts)), counts[sel_parts])
             slots = slot_all[gi]
-            buf[rows, slots] = pts[point_idx[gi]].astype(dtype)
+            if fill_payload:
+                buf[rows, slots] = pts[point_idx[gi]].astype(dtype)
             mask[rows, slots] = True
             idx[rows, slots] = point_idx[gi]
         rc = np.zeros(p_pad, dtype=np.int64)

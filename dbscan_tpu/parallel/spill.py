@@ -692,7 +692,8 @@ def _spill_device_enabled() -> bool:
 
 
 def spill_partition(
-    unit, maxpp: int, halo: float, seed: int = 0, _presplit: bool = True
+    unit, maxpp: int, halo: float, seed: int = 0, _presplit: bool = True,
+    device_ops=None,
 ) -> Tuple[np.ndarray, np.ndarray, int, np.ndarray]:
     """Build the spill partition over ``unit`` [N, D] (rows must be the
     UNIT-NORM coordinates ``halo`` refers to — normalized vectors for
@@ -737,15 +738,23 @@ def spill_partition(
     # device failure permanently degrades THIS run to the host path.
     sdev = None
     dev_root = None
-    if isinstance(ops, _DenseOps) and n > maxpp and _spill_device_enabled():
-        try:
+    if isinstance(ops, _DenseOps) and n > maxpp:
+        if device_ops is not None:
+            # caller-provided resident rows (the driver reuses the SAME
+            # upload for the leaf-payload gather dispatch)
             from dbscan_tpu.parallel import spill_device as _sdev_mod
 
-            dev_root = _sdev_mod.DeviceNodeOps.from_host(ops.x)
+            dev_root = device_ops
             sdev = _sdev_mod
-        except Exception as e:  # noqa: BLE001 — degrade, don't die
-            logger.warning("spill: device passes unavailable (%s)", e)
-            dev_root = None
+        elif _spill_device_enabled():
+            try:
+                from dbscan_tpu.parallel import spill_device as _sdev_mod
+
+                dev_root = _sdev_mod.DeviceNodeOps.from_host(ops.x)
+                sdev = _sdev_mod
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                logger.warning("spill: device passes unavailable (%s)", e)
+                dev_root = None
     leaves = []  # (member point rows, home flags)
     stack = [(np.arange(n, dtype=np.int64), np.ones(n, dtype=bool))]
     while stack:
