@@ -1,0 +1,299 @@
+"""Scalar-prefetch Pallas banded engine: no XLA slab gather.
+
+The base Pallas banded port (ops/pallas_banded.py) loses 1.5-2.1x to the
+XLA engine at production sizes because Mosaic's static BlockSpec index
+maps cannot express the DATA-DEPENDENT slab origins, forcing an XLA
+gather to materialize [nb, R, S] slab tensors (points + mask + cx +
+core) before the kernels run. This module is the VERDICT r4 item-7
+attempt at Mosaic's intended mechanism for data-dependent tiling:
+``PrefetchScalarGridSpec`` index maps that read per-(block, window-row)
+slab origins from scalar-prefetch (SMEM) operands, so each kernel step
+DMAs its slab chunk STRAIGHT from the flat per-point arrays in HBM —
+the gather disappears entirely.
+
+Alignment contract: Mosaic block indices address whole blocks, so slab
+origins are aligned DOWN to the slab-chunk width on the host
+(``orig_blk = ss // sc``) and the chunk walk is extended by one chunk
+(``ns + 1``) to keep covering the original [ss, ss + slab) window. The
+cost is the alignment padding the r4 verdict asked to measure: at most
+one extra chunk per (block, row) sweep, i.e. a factor (ns + 1) / ns of
+slab traffic (~1.05-2x depending on slab width), plus positions below
+the true origin that the run-window test rejects. Run tables stay in
+ORIGINAL slab coordinates: the kernel reconstructs absolute positions
+from the aligned origin and compares against absolute run starts
+(``ss + rel``), so acceptance is bit-identical to ops/banded.py — the
+widened window only adds rejected candidates.
+
+Outputs are bit-identical to ops/banded.py::banded_phase1 (pinned by
+tests/test_pallas_banded.py in interpreter mode); on-chip measurement
+rides ``bench.py`` BENCH_PALLAS=1 with DBSCAN_PALLAS_SP=1.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dbscan_tpu.ops.banded import _slab_chunks
+from dbscan_tpu.ops.pallas_banded import (
+    TSUB,
+    _PALLAS_SLAB_CHUNK,
+    _accumulate,
+    _interpret,
+)
+from dbscan_tpu.parallel.binning import BANDED_BLOCK, BANDED_ROWS
+
+
+def _sp_block_spec(t, nsub):
+    # per-point [rows, 1, T] blocks; index map must accept the two
+    # scalar-prefetch refs PrefetchScalarGridSpec appends
+    return pl.BlockSpec(
+        (1, 1, t), lambda i, s, j, orig, ss: (i * nsub + j, 0, 0)
+    )
+
+
+def _sp_row_spec(sc, k):
+    # one [1, SC] chunk of window row k's slab, addressed DIRECTLY in
+    # the flat [1, B_pad] array at the aligned dynamic block origin —
+    # this line is the whole point of the module: the index map reads
+    # the data-dependent origin from SMEM, no gathered tensor exists
+    return pl.BlockSpec(
+        (1, sc), lambda i, s, j, orig, ss: (0, orig[i, k] + s)
+    )
+
+
+def _sp_eps_spec():
+    return pl.BlockSpec(
+        (1, 1), lambda i, s, j, orig, ss: (0, 0), memory_space=pltpu.SMEM
+    )
+
+
+def _sp_tile_adj(
+    orig_ref, ss_ref, bl_planes, bm, brel, bspan, prow_k, mrow_k,
+    offs_rel, eps2, i, s, sc, k,
+):
+    """[T, SC] adjacency tile of window row k from direct row slices.
+    Positions are ABSOLUTE (aligned origin + chunk offset), runs are
+    absolute (original origin + relative start) — acceptance identical
+    to the gathered path, the alignment delta only shifts the frame."""
+    pos = (orig_ref[i, k] + s) * sc + offs_rel
+    start = ss_ref[i, k] + brel[0, k][:, None]
+    inrun = (pos >= start) & (pos < start + bspan[0, k][:, None])
+    d2 = None
+    for bp, sl in zip(bl_planes, prow_k):
+        df = bp[0, 0][:, None] - sl[0, :][None, :]
+        d2 = df * df if d2 is None else d2 + df * df
+    return (
+        inrun
+        & (mrow_k[0, :][None, :] > 0)
+        & (d2 <= eps2)
+        & (bm[0, 0][:, None] > 0)
+    )
+
+
+def _make_counts_kernel_sp(d: int, sc: int, nsub: int, ns: int):
+    t = TSUB
+    r = BANDED_ROWS
+
+    def kernel(orig_ref, ss_ref, eps2_ref, *refs):
+        bl_planes = refs[0:d]
+        bm = refs[d]
+        brel = refs[d + 1]
+        bspan = refs[d + 2]
+        k0 = d + 3
+        prows = refs[k0 : k0 + d * r]  # plane-major: p0k0..p0k4, p1k0..
+        mrows = refs[k0 + d * r : k0 + (d + 1) * r]
+        out = refs[-2]
+        acc_ref = refs[-1]
+        i = pl.program_id(0)
+        s = pl.program_id(1)
+        offs_rel = jax.lax.broadcasted_iota(jnp.int32, (t, sc), 1)
+        eps2 = eps2_ref[0, 0]
+        acc = jnp.zeros((t,), jnp.int32)
+        for k in range(r):
+            adj = _sp_tile_adj(
+                orig_ref, ss_ref, bl_planes, bm, brel, bspan,
+                [prows[p * r + k] for p in range(d)], mrows[k],
+                offs_rel, eps2, i, s, sc, k,
+            )
+            acc = acc + jnp.sum(adj.astype(jnp.int32), axis=1)
+        _accumulate(out, acc_ref, acc, nsub, ns, lambda a, b: a + b)
+
+    return kernel
+
+
+def _make_bits_kernel_sp(d: int, sc: int, nsub: int, ns: int):
+    t = TSUB
+    r = BANDED_ROWS
+
+    def kernel(orig_ref, ss_ref, eps2_ref, *refs):
+        bl_planes = refs[0:d]
+        bm = refs[d]
+        brel = refs[d + 1]
+        bspan = refs[d + 2]
+        bcx = refs[d + 3]
+        k0 = d + 4
+        prows = refs[k0 : k0 + d * r]
+        mrows = refs[k0 + d * r : k0 + (d + 1) * r]
+        cxrows = refs[k0 + (d + 1) * r : k0 + (d + 2) * r]
+        corows = refs[k0 + (d + 2) * r : k0 + (d + 3) * r]
+        out = refs[-2]
+        acc_ref = refs[-1]
+        i = pl.program_id(0)
+        s = pl.program_id(1)
+        offs_rel = jax.lax.broadcasted_iota(jnp.int32, (t, sc), 1)
+        eps2 = eps2_ref[0, 0]
+        bits = jnp.zeros((t,), jnp.int32)
+        for k in range(r):
+            adj = _sp_tile_adj(
+                orig_ref, ss_ref, bl_planes, bm, brel, bspan,
+                [prows[p * r + k] for p in range(d)], mrows[k],
+                offs_rel, eps2, i, s, sc, k,
+            )
+            adj_cc = adj & (corows[k][0, :][None, :] > 0)
+            dxm = cxrows[k][0, :][None, :] - bcx[0, 0][:, None] + 2
+            for dx in range(5):
+                hit = jnp.any(adj_cc & (dxm == dx), axis=1)
+                bits = bits | (
+                    hit.astype(jnp.int32) << jnp.int32(k * 5 + dx)
+                )
+        _accumulate(out, acc_ref, bits, nsub, ns, lambda a, b: a | b)
+
+    return kernel
+
+
+def _flat_pad(a, sc):
+    """[B] -> [1, B + sc] (zero tail): an aligned-down origin plus the
+    extended chunk walk reads at most sc past the clamped origin+slab."""
+    return jnp.pad(a, (0, sc)).reshape(1, -1)
+
+
+@functools.partial(jax.jit, static_argnames=("min_points", "slab"))
+def banded_phase1_pallas_sp(
+    points: jnp.ndarray,
+    mask: jnp.ndarray,
+    rel_starts: jnp.ndarray,
+    spans: jnp.ndarray,
+    slab_starts: jnp.ndarray,
+    cx: jnp.ndarray,
+    eps: float,
+    min_points: int,
+    slab: int = 128,
+):
+    """Drop-in replacement for banded_phase1 via scalar-prefetch tiling
+    (same contract/outputs: counts [B] i32, core [B] bool, bits [B] i32).
+    """
+    b, d = points.shape
+    t = BANDED_BLOCK
+    r = BANDED_ROWS
+    if b % t:
+        raise ValueError(f"bucket width {b} not a multiple of {t}")
+    nb = b // t
+    nsub = t // TSUB
+    rows = nb * nsub
+    ns0 = _slab_chunks(slab, _PALLAS_SLAB_CHUNK)
+    sc = slab // ns0
+    ns = ns0 + 1  # one extra chunk covers the alignment shift
+
+    planes = tuple(points[:, j].astype(jnp.float32) for j in range(d))
+    m32 = mask.astype(jnp.int32)
+    rel = (
+        rel_starts.astype(jnp.int32)
+        .reshape(rows, TSUB, r)
+        .transpose(0, 2, 1)
+    )
+    spn = (
+        spans.astype(jnp.int32).reshape(rows, TSUB, r).transpose(0, 2, 1)
+    )
+    ss = slab_starts.astype(jnp.int32)
+    orig_blk = ss // jnp.int32(sc)  # aligned-down origin, block units
+    eps2 = jnp.asarray(eps, jnp.float32).reshape(1, 1) ** 2
+
+    blocked_specs = [
+        _sp_eps_spec(),
+        *[_sp_block_spec(TSUB, nsub) for _ in range(d + 1)],
+        pl.BlockSpec(
+            (1, r, TSUB), lambda i, s, j, orig, sr: (i * nsub + j, 0, 0)
+        ),
+        pl.BlockSpec(
+            (1, r, TSUB), lambda i, s, j, orig, sr: (i * nsub + j, 0, 0)
+        ),
+    ]
+    blocked_args = [
+        eps2,
+        *[p.reshape(rows, 1, TSUB) for p in planes],
+        m32.reshape(rows, 1, TSUB),
+        rel,
+        spn,
+    ]
+    plane_flat = [_flat_pad(p, sc) for p in planes]
+    mask_flat = _flat_pad(m32, sc)
+
+    grid = (nb, ns, nsub)
+    counts = pl.pallas_call(
+        _make_counts_kernel_sp(d, sc, nsub, ns),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                *blocked_specs,
+                *[
+                    _sp_row_spec(sc, k)
+                    for _p in range(d)
+                    for k in range(r)
+                ],
+                *[_sp_row_spec(sc, k) for k in range(r)],
+            ],
+            out_specs=_sp_block_spec(TSUB, nsub),
+            scratch_shapes=[pltpu.VMEM((nsub, TSUB), jnp.int32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((rows, 1, TSUB), jnp.int32),
+        interpret=_interpret(),
+    )(
+        orig_blk, ss, *blocked_args,
+        *[pf for pf in plane_flat for _k in range(r)],
+        *[mask_flat for _k in range(r)],
+    ).reshape(-1)
+
+    core = (counts >= jnp.int32(min_points)) & mask
+    cx32 = cx.astype(jnp.int32)
+    core32 = core.astype(jnp.int32)
+    cx_flat = _flat_pad(cx32, sc)
+    core_flat = _flat_pad(core32, sc)
+
+    bits = pl.pallas_call(
+        _make_bits_kernel_sp(d, sc, nsub, ns),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                *blocked_specs,
+                _sp_block_spec(TSUB, nsub),  # cx blocked
+                *[
+                    _sp_row_spec(sc, k)
+                    for _p in range(d)
+                    for k in range(r)
+                ],
+                *[_sp_row_spec(sc, k) for k in range(r)],
+                *[_sp_row_spec(sc, k) for k in range(r)],
+                *[_sp_row_spec(sc, k) for k in range(r)],
+            ],
+            out_specs=_sp_block_spec(TSUB, nsub),
+            scratch_shapes=[pltpu.VMEM((nsub, TSUB), jnp.int32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((rows, 1, TSUB), jnp.int32),
+        interpret=_interpret(),
+    )(
+        orig_blk, ss, *blocked_args,
+        cx32.reshape(rows, 1, TSUB),
+        *[pf for pf in plane_flat for _k in range(r)],
+        *[mask_flat for _k in range(r)],
+        *[cx_flat for _k in range(r)],
+        *[core_flat for _k in range(r)],
+    )
+
+    return counts, core, bits.reshape(-1)

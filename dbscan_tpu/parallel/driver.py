@@ -226,12 +226,18 @@ def _compiled_banded_p1(
     batch: Optional[int],
     mesh,
     use_pallas: bool = False,
+    pallas_sp: bool = False,
 ):
     """Jitted per-group phase-1 executor for the banded engine (counts +
     core + cell-edge bitmask sweeps, dbscan_tpu/ops/banded.py — or their
-    Pallas ports, ops/pallas_banded.py); cached like
+    Pallas ports: ops/pallas_banded.py, or the scalar-prefetch variant
+    ops/pallas_banded_sp.py under DBSCAN_PALLAS_SP=1); cached like
     :func:`_compiled_block`."""
-    if use_pallas:
+    if use_pallas and pallas_sp:
+        from dbscan_tpu.ops.pallas_banded_sp import (
+            banded_phase1_pallas_sp as phase1,
+        )
+    elif use_pallas:
         from dbscan_tpu.ops.pallas_banded import (
             banded_phase1_pallas as phase1,
         )
@@ -437,6 +443,10 @@ def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh, kernel_eps=None):
         None if cfg.use_pallas else _banded_batch(group, mesh),
         mesh,
         use_pallas=bool(cfg.use_pallas),
+        pallas_sp=(
+            bool(cfg.use_pallas)
+            and _os.environ.get("DBSCAN_PALLAS_SP") == "1"
+        ),
     )
     return fn(
         *(
@@ -542,8 +552,11 @@ def _group_bytes(g) -> int:
     d = g.points.shape[2]
     dt = g.points.dtype.itemsize
     nb = b_g // binning.BANDED_BLOCK
+    # per slab element across both sweeps: counts reads d planes (dt) +
+    # mask (1 B); bits re-reads those plus cx (4 B) + core (1 B)
     reads = (
-        2 * p_g * nb * binning.BANDED_ROWS * int(g.banded.slab) * d * dt
+        p_g * nb * binning.BANDED_ROWS * int(g.banded.slab)
+        * (2 * d * dt + 7)
     )
     writes = p_g * b_g * (4 + 1 + 4)
     return reads + writes

@@ -185,3 +185,18 @@ def test_pallas_banded_haversine_chord(rng):
     assert mp.stats["n_banded_groups"] >= 1
     np.testing.assert_array_equal(mb.clusters, mp.clusters)
     np.testing.assert_array_equal(mb.flags, mp.flags)
+
+
+@pytest.mark.parametrize("geometry", sorted(GEOMETRIES))
+def test_scalar_prefetch_variant_bit_exact(geometry, rng, monkeypatch):
+    """DBSCAN_PALLAS_SP=1 routes phase 1 through the scalar-prefetch
+    kernels (ops/pallas_banded_sp.py — no XLA slab gather, origins read
+    from SMEM inside the BlockSpec index maps). Labels, flags, and core
+    counts must equal the XLA banded engine bit-for-bit: the alignment
+    shift only widens the candidate window with positions the run test
+    rejects."""
+    monkeypatch.setenv("DBSCAN_PALLAS_SP", "1")
+    # no cache clearing needed: pallas_sp is part of the executor cache
+    # key, so SP and non-SP programs can never collide
+    pts = GEOMETRIES[geometry](rng)
+    _equal(pts, rng, Engine.ARCHERY)
