@@ -1089,7 +1089,11 @@ def train_arrays(
         # into false zeros
         norms64 = np.sqrt(np.einsum("ij,ij->i", pts, pts, dtype=np.float64))
         zeros = norms64 == 0.0
-        if zeros.any() and not zeros.all() and (cfg.eps + q) < 1.0:
+        if zeros.any() and (cfg.eps + q) < 1.0:
+            # zeros.all() included: the nonzero sub-run is then empty and
+            # every row is noise by fiat — the all-constant-zero input
+            # otherwise runs the full spill tree on all-equidistant
+            # (chord sqrt(2)) unit vectors, its worst case
             sub = train_arrays(
                 pts[~zeros], cfg, mesh=mesh, checkpoint_dir=checkpoint_dir
             )

@@ -774,6 +774,7 @@ def spill_partition(
         # host subset materialization only when some pass will need it
         sub = ops.take(idx) if dev_sub is None else None
         split = None
+        degenerate = False
         base_m = max(4, -(-len(idx) // maxpp) * 2)
         for attempt in range(3):  # retries escalate the pivot count
             m = int(
@@ -819,7 +820,35 @@ def spill_partition(
                 else:
                     piv = _pivot_vectors(sub, m, halo, rng)
             if len(piv) < 2:
-                break  # all points identical: unsplittable
+                # All pivots collapsed inside one halo ball. For DENSE
+                # nodes one exact [n, 1] pass settles the node: if every
+                # point is within halo of the surviving pivot, pairwise
+                # chords are <= 2*halo <= T + halo, so EVERY leader
+                # canopy in leader_components contains every point and
+                # the cover is provably ONE component — skip the
+                # O(n * leaders) fallback and emit the oversized leaf
+                # now (the dense-width guard then fails fast,
+                # pre-packing). Nodes with points beyond halo keep the
+                # fallback: a leader cover can still split them. Sparse
+                # keeps its prefix retry either way: chord <= halo pairs
+                # of a 2*halo-diameter node can still form >1 component.
+                if isinstance(ops, _DenseOps) and len(piv) == 1:
+                    # chunked exact-f32 matvec: no full-node row gather
+                    # (a resident-mode 1M x 512 node would otherwise pay
+                    # a ~2 GB host copy on this bail path)
+                    v = piv[0]
+                    min_dot = np.inf
+                    # rows-per-chunk scaled by width: ~64 MiB transient
+                    # regardless of D (same cap leader_components uses)
+                    step = max(1024, (1 << 24) // max(1, ops.dim))
+                    for s0 in range(0, len(idx), step):
+                        rows = idx[s0 : s0 + step]
+                        min_dot = min(
+                            min_dot, float(ops.x[rows].dot(v).min())
+                        )
+                    if 2.0 - 2.0 * min_dot <= halo * halo:
+                        degenerate = True
+                break  # unsplittable by pivots
             # Cheap rejection screen on the SAME sample before paying the
             # full-node matmul: in the concentration regime (cluster
             # count >> pivots, all cross distances ~equal) every
@@ -895,6 +924,14 @@ def spill_partition(
             ):
                 split = (assign, member)
                 break
+        if degenerate:
+            logger.warning(
+                "spill: %d points sit inside one halo ball "
+                "(all-duplicates regime); emitting an oversized leaf",
+                len(idx),
+            )
+            leaves.append((idx, home))
+            continue
         if split is None:
             # last resort before an oversized leaf: an exact-cover
             # component pre-split. Sparse retries the verified

@@ -101,17 +101,44 @@ def test_use_pallas_rejects_f64():
 def test_cosine_at_scale_fails_fast():
     """VERDICT r1 guard: a non-spatial metric at a scale whose dense
     [B, B] adjacency cannot fit HBM must raise a clear ValueError
-    IMMEDIATELY (before packing or device work), naming the limit and the
-    alternatives."""
+    FAST (before packing or device work), naming the limit and the
+    alternatives. Identical nonzero rows are the unsplittable worst
+    case: the spill tree must detect the one-halo-ball node from a
+    single exact pass, not via the leader-cover fallback."""
     import time
 
     from dbscan_tpu.parallel.driver import DENSE_WIDTH_LIMIT
 
-    data = np.zeros((10_000_000, 2))
+    data = np.ones((4_000_000, 2))
     t0 = time.perf_counter()
     with pytest.raises(ValueError, match=str(DENSE_WIDTH_LIMIT)):
         train(data, eps=0.1, min_points=3, metric="cosine")
-    assert time.perf_counter() - t0 < 5.0  # fails fast, not after packing
+    # fails in seconds (degenerate bail), not the minutes a 4M-wide
+    # pack / fallback walk would cost; margin absorbs cold-init +
+    # co-running load
+    assert time.perf_counter() - t0 < 15.0
+
+
+def test_cosine_all_zero_rows_are_noise():
+    """All-constant-zero input: every row is zero-norm, so (when eps + q
+    cannot bridge zero-to-nonzero pairs) the whole dataset is noise by
+    fiat — resolved through the zero-norm routing WITHOUT running the
+    spill tree on all-equidistant zero vectors (which cannot split and
+    would otherwise walk every fallback before failing)."""
+    import time
+
+    from dbscan_tpu.ops.labels import NOISE
+
+    data = np.zeros((4_000_000, 2))
+    t0 = time.perf_counter()
+    model = train(data, eps=0.1, min_points=3, metric="cosine")
+    # same margin rationale as the fails-fast test: cold-init +
+    # co-running load must not flake a bound guarding a minutes-class
+    # regression
+    assert time.perf_counter() - t0 < 15.0
+    assert model.n_clusters == 0
+    assert (model.flags == NOISE).all()
+    assert model.stats["n_zero_norm_noise"] == 4_000_000
 
 
 def test_dense_width_boundary():
