@@ -37,6 +37,7 @@ import hashlib
 import logging
 import os as _os
 import time
+import weakref
 from typing import List, NamedTuple, Optional, Tuple
 
 import jax
@@ -857,6 +858,65 @@ def _resume_from_premerge(state: dict, t_start: float) -> TrainOutput:
     return TrainOutput(res_cluster, res_flag, partitions, n_clusters, stats)
 
 
+# Resident-payload reuse across train() calls (one entry: the latest
+# dataset). The metric-spill payload upload is the measured wall floor of
+# the cosine route on a remote-attached chip (1.02 GB bf16 at 1M x 512 ~=
+# 31 s over the shared tunnel, BASELINE.md), and DBSCAN's primary
+# workflow re-clusters the SAME dataset under different eps/min_points —
+# so the device copy is cached for the lifetime of the caller's input
+# array. Keyed by object identity + a FULL-COVERAGE content checksum
+# (one vectorized memory pass, ~0.3 s at 2 GB): identity catches reuse,
+# the checksum catches any value change anywhere in a reused array (the
+# one aliasing class is a value-preserving byte permutation within one
+# 64 KiB window — not a realistic mutation of numeric data); gc of the
+# input evicts via weakref so the cache can never outlive the data it
+# mirrors. Opt out with DBSCAN_RESIDENT_CACHE=0.
+_RESIDENT_CACHE: dict = {}
+
+
+def _pts_fingerprint(pts: np.ndarray) -> bytes:
+    h = hashlib.sha1()
+    h.update(str((pts.shape, pts.dtype.str)).encode())
+    buf = np.ascontiguousarray(pts).view(np.uint8).reshape(-1)
+    n8 = (buf.size // 8) * 8
+    if n8:
+        w = buf[:n8].view(np.uint64)
+        # per-64KiB-chunk xor AND wraparound sum: every chunk whose
+        # bytes change flips at least one digest word
+        chunk = 8192  # u64 words = 64 KiB
+        pad = (-w.size) % chunk
+        if pad:
+            w = np.concatenate([w, np.zeros(pad, np.uint64)])
+        w = w.reshape(-1, chunk)
+        h.update(np.bitwise_xor.reduce(w, axis=1).tobytes())
+        with np.errstate(over="ignore"):
+            h.update(np.add.reduce(w, axis=1).tobytes())
+    h.update(buf[n8:].tobytes())
+    return h.digest()
+
+
+def _resident_payload_cached(pts: np.ndarray, unit: np.ndarray, sdev):
+    """Device-resident bf16 rows for ``unit``, reusing the previous
+    upload when ``pts`` is the same (unmutated) array object."""
+    if _os.environ.get("DBSCAN_RESIDENT_CACHE", "1") != "1":
+        return sdev.DeviceNodeOps.from_host(unit)
+    key = id(pts)
+    fp = _pts_fingerprint(pts)
+    ent = _RESIDENT_CACHE.get(key)
+    if ent is not None:
+        ref, ent_fp, ops = ent
+        if ref() is pts and ent_fp == fp:
+            return ops
+    ops = sdev.DeviceNodeOps.from_host(unit)
+    try:
+        ref = weakref.ref(pts, lambda _r, k=key: _RESIDENT_CACHE.pop(k, None))
+    except TypeError:  # un-weakref-able input: keep the prior entry
+        return ops
+    _RESIDENT_CACHE.clear()  # one entry: the latest dataset
+    _RESIDENT_CACHE[key] = (ref, fp, ops)
+    return ops
+
+
 def train_arrays(
     points: np.ndarray,
     cfg: DBSCANConfig,
@@ -1128,7 +1188,7 @@ def train_arrays(
             try:
                 from dbscan_tpu.parallel import spill_device as _sdev
 
-                resident_ops = _sdev.DeviceNodeOps.from_host(unit)
+                resident_ops = _resident_payload_cached(pts, unit, _sdev)
             except Exception as e:  # noqa: BLE001 — host path fallback
                 logger.warning(
                     "cosine resident payload unavailable (%s)", e

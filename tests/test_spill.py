@@ -432,3 +432,60 @@ def test_spill_device_concentration_regime(rng, monkeypatch):
     for b in range(0, k, 7):
         homes = home_of[blob == b]
         assert len(np.unique(homes)) == 1
+
+
+def test_resident_payload_cache_reuse_and_mutation(rng, monkeypatch):
+    """The device-resident spill payload is reused across train() calls
+    on the SAME (unmutated) input array — the upload is the measured
+    wall floor of the cosine route on a remote-attached chip — and a
+    mutated array re-uploads (results must track the new data)."""
+    from dbscan_tpu import train
+    from dbscan_tpu.parallel import driver, spill_device
+
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "1")
+    driver._RESIDENT_CACHE.clear()
+    uploads = {"n": 0}
+    orig = spill_device.DeviceNodeOps.from_host.__func__
+
+    def counting(cls, x):
+        uploads["n"] += 1
+        return orig(cls, x)
+
+    monkeypatch.setattr(
+        spill_device.DeviceNodeOps, "from_host", classmethod(counting)
+    )
+
+    d, k, per = 16, 8, 400
+    centers = rng.normal(size=(k, d)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    pts = np.repeat(centers, per, axis=0).astype(np.float32)
+    pts += 0.002 * rng.normal(size=pts.shape).astype(np.float32)
+
+    kw = dict(eps=0.05, min_points=5, metric="cosine",
+              max_points_per_partition=512)
+    m1 = train(pts, **kw)
+    first = uploads["n"]
+    assert first >= 1
+    m2 = train(pts, **kw)  # same array object, unchanged: reuse
+    assert uploads["n"] == first
+    assert np.array_equal(m1.clusters, m2.clusters)
+
+    # in-place mutation must be detected (full-coverage checksum):
+    # fresh upload — mutate rows AWAY from the start so a sparse
+    # sampling scheme could not have caught it by luck
+    pts[per + 3 : per + 7] = centers[1] + 0.002 * rng.normal(
+        size=(4, d)
+    ).astype(np.float32)
+    train(pts, **kw)
+    second = uploads["n"]
+    assert second > first
+
+    # a DIFFERENT array object (equal content) also re-uploads
+    pts2 = pts.copy()
+    m3 = train(pts2, **kw)
+    third = uploads["n"]
+    assert third > second
+    m4 = train(pts2, **kw)  # and then reuses ITS entry
+    assert uploads["n"] == third
+    assert np.array_equal(m3.clusters, m4.clusters)
+    driver._RESIDENT_CACHE.clear()
