@@ -272,13 +272,20 @@ def _greedy_leaders_fn(dim: int, cap: int):
         n = x.shape[0]
         xf = x.astype(jnp.float32)[perm]
 
+        # dmin carries SQUARED chords (no per-iteration [n] sqrt);
+        # coverage therefore tests against t^2 — comparing chord^2
+        # against the LINEAR t would regress the cover radius to
+        # sqrt(t), under-mint leaders, and void the canopy exact-cover
+        # proof for data with spread in (t, sqrt(t))
+        t2 = t * t
+
         def cond(st):
             _, nb, dmin, overflow = st
-            return (~overflow) & (dmin.max() > t)
+            return (~overflow) & (dmin.max() > t2)
 
         def body(st):
             buf, nb, dmin, _ = st
-            j = jnp.argmax(dmin > t)  # FIRST uncovered in perm order
+            j = jnp.argmax(dmin > t2)  # FIRST uncovered in perm order
             row = xf[j]
             d = jnp.maximum(2.0 - 2.0 * (xf @ row), 0.0)
             dmin = jnp.minimum(dmin, d)
@@ -335,8 +342,18 @@ def leader_components_device(
     from dbscan_tpu.parallel.graph import uf_components
 
     n = sub.n
+    t_prev = None
     for t_mult in (2.0, 4.0, 8.0):
-        t = t_mult * halo
+        # bf16 floor on the cover radius: a covered point's MEASURED
+        # chord to its leader can read as high as the slack (a self-
+        # chord under bf16 is not 0), so a minting radius below the
+        # slack could never terminate — and the proof only needs SOME
+        # radius, so the floor costs nothing but leader density
+        t = max(t_mult * halo, BF16_CHORD_SLACK)
+        if t == t_prev:
+            continue  # floor clamped this rung too: same radius
+            # already overflowed, a rerun cannot end differently
+        t_prev = t
         if t + halo >= 1.9:
             break
         import jax.numpy as jnp
@@ -349,6 +366,9 @@ def leader_components_device(
         nb = int(nb)
         if nb < 2:
             return None
+        # true cover radius <= t + slack (measured <= t); both
+        # endpoints of an accepted pair then MEASURE within
+        # t + halo + 2*slack of the covering leader
         band = t + halo + 2.0 * BF16_CHORD_SLACK
         cfn = _canopy_fn(int(sub.dim))
         l_pad = _ladder8(nb, cap=_LEADER_CAP)

@@ -489,3 +489,64 @@ def test_resident_payload_cache_reuse_and_mutation(rng, monkeypatch):
     assert uploads["n"] == third
     assert np.array_equal(m3.clusters, m4.clusters)
     driver._RESIDENT_CACHE.clear()
+
+
+def test_device_greedy_cover_radius_units():
+    """The device greedy cover stores SQUARED chords; coverage must
+    compare them against t^2, not the linear t — the latter silently
+    regresses the cover radius to sqrt(t) and under-mints leaders on
+    any data whose spread falls in (t, sqrt(t)), voiding the canopy
+    exact-cover proof. Points on an arc with consecutive chords just
+    over t (t chosen above the bf16 slack so measurement noise cannot
+    flip the test) are the sharp probe: every point must become a
+    leader; the old chord^2 > t compare kept roughly every other."""
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    from dbscan_tpu.parallel import spill_device as sdev
+
+    t = 0.2
+    assert t > sdev.BF16_CHORD_SLACK
+    th = np.arange(12) * 0.2525  # consecutive chords ~0.252 > t
+    x = np.zeros((12, 8), np.float32)
+    x[:, 0] = np.cos(th)
+    x[:, 1] = np.sin(th)
+    fn = sdev._greedy_leaders_fn(8, 4096)
+    perm = np.arange(12, dtype=np.int32)  # identity: walk the arc
+    xb = jnp.asarray(x.astype(ml_dtypes.bfloat16))
+    buf, nb, overflow = fn(xb, jnp.asarray(perm), jnp.float32(t))
+    assert not bool(overflow)
+    # host-reference greedy walk at LINEAR radius t over the same order
+    kept = [x[0]]
+    for i in range(1, 12):
+        ch = np.sqrt(
+            np.clip(2.0 - 2.0 * (x[i] @ np.stack(kept).T), 0.0, None)
+        )
+        if float(ch.min()) > t:
+            kept.append(x[i])
+    assert int(nb) == len(kept) == 12
+
+
+def test_device_greedy_cover_bf16_floor_terminates(rng):
+    """A minting radius below the bf16 slack could never terminate (a
+    covered point's measured self-chord is not 0 under bf16):
+    leader_components_device must floor the radius at the slack and
+    return a valid cover instead of spinning to the cap."""
+    from dbscan_tpu.parallel import spill_device as sdev
+
+    d = 8
+    c = rng.normal(size=(3, d)).astype(np.float32)
+    c /= np.linalg.norm(c, axis=1, keepdims=True)
+    x = np.repeat(c, 200, axis=0)
+    x += 0.001 * rng.normal(size=x.shape).astype(np.float32)
+    x /= np.linalg.norm(x, axis=1, keepdims=True)
+    ops = sdev.DeviceNodeOps.from_host(x)
+    # halo far below the slack: the unfixed radius never terminates
+    r = sdev.leader_components_device(
+        ops, 0.004, np.random.default_rng(0), 32
+    )
+    assert r is not None
+    comp, n_comp = r
+    assert n_comp == 3
+    for blob in range(3):
+        assert len(np.unique(comp[blob * 200 : (blob + 1) * 200])) == 1
