@@ -550,3 +550,40 @@ def test_device_greedy_cover_bf16_floor_terminates(rng):
     assert n_comp == 3
     for blob in range(3):
         assert len(np.unique(comp[blob * 200 : (blob + 1) * 200])) == 1
+
+
+def test_resident_cache_reapplies_zero_norm_screen(rng, monkeypatch):
+    """The zero-norm noise screen is CONFIG-dependent (fires only when
+    eps + q < 1), so a cache entry built under a screen-bypassing
+    config must not let a later small-eps call on the same array skip
+    it: zero rows must still route to noise with the stat recorded."""
+    from dbscan_tpu import train
+    from dbscan_tpu.ops.labels import NOISE
+    from dbscan_tpu.parallel import driver
+
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "1")
+    driver._RESIDENT_CACHE.clear()
+    d, k, per = 16, 4, 300
+    centers = rng.normal(size=(k, d)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    pts = np.repeat(centers, per, axis=0).astype(np.float32)
+    pts += 0.002 * rng.normal(size=pts.shape).astype(np.float32)
+    pts[:17] = 0.0  # zero-norm rows
+
+    # call 1: eps large enough that eps + q >= 1 bypasses the screen
+    # (zero rows legitimately join clusters at that radius), building
+    # a cache entry WITH zero rows present
+    m1 = train(pts, eps=0.999, min_points=5, metric="cosine",
+               max_points_per_partition=512)
+    assert len(driver._RESIDENT_CACHE) == 1
+    assert "n_zero_norm_noise" not in m1.stats
+
+    # call 2, same array, small eps: the screen applies — the cache
+    # hit must NOT skip it
+    m2 = train(pts, eps=0.05, min_points=5, metric="cosine",
+               max_points_per_partition=512)
+    assert m2.stats.get("n_zero_norm_noise") == 17
+    assert (m2.clusters[:17] == 0).all()
+    assert (m2.flags[:17] == NOISE).all()
+    assert m2.n_clusters == k
+    driver._RESIDENT_CACHE.clear()
