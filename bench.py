@@ -127,6 +127,16 @@ def make_anchor(n: int, kind: str):
     return pts, blob_of, n_blob, k, eps
 
 
+# Anchor-generator version, part of make_anchor_cached's key. The key
+# already embeds a hash of make_anchor's OWN source, but that hash is
+# blind to edits outside the function body — a helper it starts calling,
+# a module constant it reads (EPS), a numpy RNG behavior change after an
+# upgrade. Bump this alongside ANY generator-affecting change the source
+# hash cannot see, so a budgeted campaign can never be handed a stale
+# workload from before the edit (ADVICE r5 low).
+ANCHOR_GENERATOR_VERSION = "1"
+
+
 def make_anchor_cached(n: int, kind: str):
     """make_anchor with an on-disk cache (the arrays are seed-
     deterministic, so the cache is pure). The 100M campaign regenerates
@@ -145,7 +155,9 @@ def make_anchor_cached(n: int, kind: str):
     src_h = hashlib.sha1(
         inspect.getsource(make_anchor).encode()
     ).hexdigest()[:10]
-    base = os.path.join(cache_root, f"{kind}_{n}_{src_h}")
+    base = os.path.join(
+        cache_root, f"{kind}_{n}_v{ANCHOR_GENERATOR_VERSION}_{src_h}"
+    )
     meta_p, pts_p, blob_p = (
         base + "_meta.npz",
         base + "_pts.npy",

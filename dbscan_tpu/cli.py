@@ -79,6 +79,17 @@ def build_parser() -> argparse.ArgumentParser:
         "data and parameters resumes at the merge phase",
     )
     p.add_argument(
+        "--fault-retries", type=int, default=3,
+        help="bounded retries per supervised device dispatch before the "
+        "degradation decision (default 3; DBSCAN_FAULT_RETRIES overrides)",
+    )
+    p.add_argument(
+        "--no-fault-cpu-fallback", action="store_true",
+        help="abort on a retries-exhausted device fault instead of "
+        "degrading the failing group to the CPU engine (the abort still "
+        "flushes the current compact chunk first)",
+    )
+    p.add_argument(
         "--platform", choices=["cpu", "tpu", "gpu"],
         help="pin the JAX platform (wins over JAX_PLATFORMS, which "
         "site-level plugin registration can override)",
@@ -128,11 +139,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         metric=args.metric,
         precision=Precision(args.precision),
         use_pallas=args.use_pallas,
+        fault_max_retries=args.fault_retries,
+        fault_cpu_fallback=not args.no_fault_cpu_fallback,
         mesh=mesh,
         checkpoint_dir=args.checkpoint_dir,
     )
     seconds = time.perf_counter() - t0
     log.info("clustered in %.3fs: %d clusters", seconds, model.n_clusters)
+
+    # supervised-dispatch fault summary (dbscan_tpu/faults.py): say when
+    # the run survived device faults — a degraded-but-complete run looks
+    # identical from the labels alone, and an operator retrying a flaky
+    # worker needs the retry/fallback counts to see it
+    fa = model.stats.get("faults") or {}
+    if fa.get("retries") or fa.get("fallbacks"):
+        log.warning(
+            "device faults survived: %d retried dispatch(es), %d "
+            "group(s) degraded to CPU, %d budget halving(s), %.2fs "
+            "backoff",
+            fa.get("retries", 0),
+            fa.get("fallbacks", 0),
+            fa.get("budget_halvings", 0),
+            fa.get("backoff_s", 0.0),
+        )
 
     if args.output:
         io_mod.save_labeled(

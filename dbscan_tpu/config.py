@@ -105,6 +105,25 @@ class DBSCANConfig:
         compile cache at steady state. Costs up to ~1.5x padded (masked,
         cheaply skipped but still swept) partitions per group, so
         one-shot batch runs keep it off.
+      fault_max_retries: bounded retries per supervised device dispatch
+        (dbscan_tpu/faults.py): a transient device fault re-runs the
+        dispatch up to this many extra times with exponential backoff
+        before the degradation decision. The reference has no in-process
+        story at all — Spark lineage replays the whole partition
+        (DBSCAN.scala:59-60); here a flaky dispatch costs one group's
+        retry. Env override DBSCAN_FAULT_RETRIES.
+      fault_backoff_base_s: base of the exponential backoff between
+        retries (doubles per attempt, deterministic jitter on top,
+        capped at fault_backoff_max_s). Env override
+        DBSCAN_FAULT_BACKOFF_S.
+      fault_backoff_max_s: backoff ceiling per retry.
+      fault_cpu_fallback: when a dispatch exhausts its retries, run
+        THAT group on the CPU local_dbscan engine (labels identical —
+        same algebra, host backend) instead of aborting the run. Off:
+        retries-exhausted faults raise, after the driver flushes the
+        current compact chunk so the abort still resumes from the last
+        completed group. Forced off in multi-process runs (a one-host
+        degradation would desynchronize the collective sequence).
     """
 
     eps: float
@@ -118,6 +137,13 @@ class DBSCANConfig:
     neighbor_backend: str = "auto"
     auto_maxpp: bool = False
     static_partition_pad: bool = False
+    # Supervised-dispatch fault policy (dbscan_tpu/faults.py). Excluded
+    # from the checkpoint fingerprint: retries/degradation never change
+    # the instance tables (the CPU engine computes the same algebra).
+    fault_max_retries: int = 3
+    fault_backoff_base_s: float = 0.05
+    fault_backoff_max_s: float = 2.0
+    fault_cpu_fallback: bool = True
     # Monotone shape-ratchet state for streaming micro-batches (see
     # binning._ratchet): a mutable dict the SAME config object carries
     # across updates — rungs pinned here only grow, so steady-state
@@ -148,6 +174,17 @@ class DBSCANConfig:
         if self.bucket_multiple < 1:
             raise ValueError(
                 f"bucket_multiple must be >= 1, got {self.bucket_multiple}"
+            )
+        if self.fault_max_retries < 0:
+            raise ValueError(
+                "fault_max_retries must be >= 0, got "
+                f"{self.fault_max_retries}"
+            )
+        if self.fault_backoff_base_s < 0 or self.fault_backoff_max_s < 0:
+            raise ValueError(
+                "fault backoff seconds must be >= 0, got "
+                f"base={self.fault_backoff_base_s} "
+                f"max={self.fault_backoff_max_s}"
             )
         if self.neighbor_backend not in ("auto", "dense", "banded"):
             raise ValueError(

@@ -94,6 +94,8 @@ def train(
     use_pallas: bool = False,
     neighbor_backend: str = "auto",
     auto_maxpp: bool = False,
+    fault_max_retries: int = 3,
+    fault_cpu_fallback: bool = True,
     mesh=None,
     config: Optional[DBSCANConfig] = None,
     checkpoint_dir: Optional[str] = None,
@@ -109,6 +111,10 @@ def train(
     checkpoint_dir: when set, the expensive pre-merge state is persisted
     there and a re-run with the same data/config resumes at the merge
     phase (parallel/checkpoint.py — the Spark-lineage replacement).
+    fault_max_retries/fault_cpu_fallback: supervised-dispatch policy
+    (dbscan_tpu/faults.py) — bounded retries per device dispatch, and
+    whether a retries-exhausted group degrades to the CPU engine
+    instead of aborting the run.
     """
     cfg = config or DBSCANConfig(
         eps=eps,
@@ -121,6 +127,8 @@ def train(
         use_pallas=use_pallas,
         neighbor_backend=neighbor_backend,
         auto_maxpp=auto_maxpp,
+        fault_max_retries=fault_max_retries,
+        fault_cpu_fallback=fault_cpu_fallback,
     )
     out: TrainOutput = train_arrays(
         data, cfg, mesh=mesh, checkpoint_dir=checkpoint_dir

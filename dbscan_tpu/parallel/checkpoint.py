@@ -293,6 +293,17 @@ def write_progress(ckpt_dir: str, **fields) -> None:
     os.replace(tmp, path)
 
 
+def note_abort(ckpt_dir: str, **fields) -> None:
+    """Merge abort metadata (the supervised-dispatch site/ordinal that
+    exhausted its retries, dbscan_tpu/faults.py) into progress.json so a
+    retry-resume harness can report WHERE a dead leg stopped — the
+    driver's abort path flushes its compact chunk and records this just
+    before the fatal fault propagates."""
+    prog = read_progress(ckpt_dir)
+    prog.update(fields)
+    write_progress(ckpt_dir, **prog)
+
+
 def read_progress(ckpt_dir: str) -> dict:
     try:
         with open(os.path.join(ckpt_dir, _PROGRESS)) as f:

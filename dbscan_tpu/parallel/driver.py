@@ -32,6 +32,7 @@ fixes):
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import hashlib
 import logging
@@ -46,7 +47,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec
 
-from dbscan_tpu import _native
+from dbscan_tpu import _native, faults
 from dbscan_tpu.config import DBSCANConfig
 from dbscan_tpu.ops import geometry as geo
 from dbscan_tpu.ops.labels import CORE, NOISE, SEED_NONE
@@ -92,8 +93,12 @@ if _COMPACT_CHUNK_SLOTS != _requested_chunk_slots:
 # programs pin ~25 B of input per padded slot in HBM; 2^27 slots keeps
 # the input window ~3 GB, leaving room for the resident phase-1 outputs
 # (5 B/slot across ALL groups) and postpass transients on a 16 GB chip.
-# Env-overridable for debugging (1 = fully synchronous dispatch, so a
-# device fault raises at the offending group's dispatch site).
+# Env-overridable for debugging (1 = fully synchronous dispatch).
+# Device faults no longer abort the run at whichever site happens to
+# observe them: every dispatch runs under faults.supervised (bounded
+# retry/backoff, per-group CPU degradation), and a retries-exhausted
+# fault flushes the current compact chunk before raising, so even the
+# abort path resumes from the last completed group.
 _INFLIGHT_SLOTS = int(
     _os.environ.get("DBSCAN_INFLIGHT_SLOTS", str(1 << 27))
 )
@@ -353,18 +358,132 @@ def _compiled_block_resident(
     )
 
 
+def _cpu_fallback_allowed(cfg: DBSCANConfig) -> bool:
+    """Per-group CPU degradation is a process-local decision: in a
+    multi-process job one host degrading while the others dispatch
+    would desynchronize the collective sequence, so it is forced off
+    there (the retry/backoff path still applies everywhere)."""
+    return bool(
+        getattr(cfg, "fault_cpu_fallback", True)
+        and not mesh_mod.multiprocess()
+    )
+
+
+def _cpu_dispatch_group(
+    group, cfg: DBSCANConfig, mesh, kernel_eps=None, kernel_metric=None,
+    resident_unit=None,
+):
+    """Per-group CPU degradation for the dense/resident kernel family:
+    the SAME ``local_dbscan`` algebra, one partition at a time, pinned
+    to the host jax CPU backend. Labels are identical by construction
+    (one engine, another backend; the Pallas variant's XLA parity is
+    pinned by tests), so a degraded run's output equals the healthy
+    run's. Results re-enter the dispatch output layout (sharded like a
+    device dispatch would have produced) so downstream pulls stay
+    oblivious."""
+    eps = float(kernel_eps if kernel_eps is not None else cfg.eps)
+    metric = kernel_metric if kernel_metric is not None else cfg.metric
+    msk = np.asarray(group.mask)
+    if group.points is None:
+        import ml_dtypes
+
+        # resident gather path: reproduce the device's bf16-stored rows
+        # rounded into f32 (the quantization the spill halo was widened
+        # for) so the degraded group measures what the device would have
+        idx = np.where(group.point_idx >= 0, group.point_idx, 0)
+        pts = (
+            np.asarray(resident_unit)[idx]
+            .astype(ml_dtypes.bfloat16)
+            .astype(np.float32)
+        )
+    else:
+        pts = np.asarray(group.points)
+    cpu = jax.devices("cpu")[0]
+    seeds = np.empty(msk.shape, np.int32)
+    flags = np.empty(msk.shape, np.int8)
+    with jax.default_device(cpu):
+        for p in range(msk.shape[0]):
+            r = local_dbscan(
+                jnp.asarray(pts[p]),
+                jnp.asarray(msk[p]),
+                eps,
+                int(cfg.min_points),
+                engine=cfg.engine.value,
+                metric=metric,
+                use_pallas=False,
+            )
+            seeds[p] = np.asarray(r.seed_labels)
+            flags[p] = np.asarray(r.flags)
+    ncore = np.int32((flags == CORE).sum())
+    return (
+        mesh_mod.shard_host_array(mesh, seeds),
+        mesh_mod.shard_host_array(mesh, flags),
+        ncore,
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _cpu_banded_p1_fn(eps: float, min_points: int, slab: int):
+    """Jitted single-partition banded phase-1 for the CPU degradation
+    path (compiles once per config on the host backend)."""
+    from dbscan_tpu.ops.banded import banded_phase1
+
+    def one(pts, msk, rel, sp, sl, cx):
+        return banded_phase1(
+            pts, msk, rel, sp, sl, cx, eps, min_points, slab=slab
+        )
+
+    return jax.jit(one)
+
+
+def _cpu_dispatch_banded_p1(group, cfg: DBSCANConfig, mesh, kernel_eps=None):
+    """Per-group CPU degradation for the banded family: the XLA
+    ``banded_phase1`` sweeps partition-by-partition on the host backend
+    (the Pallas ports are device-only; their XLA parity is pinned by
+    tests). Output re-enters the (core, bits, ncore) dispatch layout."""
+    ext = group.banded
+    eps = float(kernel_eps if kernel_eps is not None else cfg.eps)
+    fn = _cpu_banded_p1_fn(eps, int(cfg.min_points), int(ext.slab))
+    cpu = jax.devices("cpu")[0]
+    cores, bitses = [], []
+    with jax.default_device(cpu):
+        for p in range(group.mask.shape[0]):
+            _counts, core_p, bits_p = fn(
+                jnp.asarray(group.points[p]),
+                jnp.asarray(group.mask[p]),
+                jnp.asarray(ext.rel_starts[p]),
+                jnp.asarray(ext.spans[p]),
+                jnp.asarray(ext.slab_starts[p]),
+                jnp.asarray(ext.cx[p]),
+            )
+            cores.append(np.asarray(core_p))
+            bitses.append(np.asarray(bits_p))
+    core = np.stack(cores)
+    bits = np.stack(bitses)
+    return (
+        mesh_mod.shard_host_array(mesh, core),
+        mesh_mod.shard_host_array(mesh, bits),
+        np.int32(core.sum()),
+    )
+
+
 def _dispatch_partitions(
     group, cfg: DBSCANConfig, mesh, kernel_eps=None, kernel_metric=None,
-    resident_x=None,
+    resident_x=None, resident_unit=None,
 ):
     """Fan the dense/pallas local kernel out over the partition axis (async
-    dispatch).
+    dispatch), under fault supervision (dbscan_tpu/faults.py): transient
+    device faults retry with backoff, RESOURCE_EXHAUSTED halves the
+    lax.map batch budget before retrying, and a persistent fault degrades
+    THIS group to the CPU ``local_dbscan`` engine instead of aborting.
 
     Inside each mesh shard, partitions are processed with lax.map (bounded
     memory: one adjacency at a time, `batch` of them in flight) — the moral
     equivalent of one Spark executor looping its assigned tasks
     (DBSCAN.scala:150-154), but compiled. Returns device arrays without
-    blocking so successive bucket groups overlap on the device queue.
+    blocking so successive bucket groups overlap on the device queue
+    (supervision blocks per group only when a fault spec is active —
+    faults.sync_mode).
 
     kernel_eps/kernel_metric override cfg's user-facing values when the
     kernel measures in a different space than the user's metric (spherical
@@ -388,37 +507,50 @@ def _dispatch_partitions(
         )
         mem_cap = max(1, int(1.2e9) // (b * b))
         batch = max(1, min(8, mem_cap, p_total // max(1, mesh_size(mesh))))
+    eps = float(kernel_eps if kernel_eps is not None else cfg.eps)
+    metric = kernel_metric if kernel_metric is not None else cfg.metric
     if group.points is None:
         # resident-payload gather dispatch (cosine spill route): the
         # payload upload already happened once, for the spill phase
-        fn = _compiled_block_resident(
-            float(kernel_eps if kernel_eps is not None else cfg.eps),
-            int(cfg.min_points),
-            cfg.engine.value,
-            kernel_metric if kernel_metric is not None else cfg.metric,
-            batch,
-            mesh,
-        )
         idx32 = np.where(
             group.point_idx >= 0, group.point_idx, 0
         ).astype(np.int32)
-        return fn(
-            resident_x,
-            mesh_mod.shard_host_array(mesh, idx32),
-            mesh_mod.shard_host_array(mesh, group.mask),
+
+        def attempt(budget):
+            fn = _compiled_block_resident(
+                eps, int(cfg.min_points), cfg.engine.value, metric,
+                budget, mesh,
+            )
+            return fn(
+                resident_x,
+                mesh_mod.shard_host_array(mesh, idx32),
+                mesh_mod.shard_host_array(mesh, group.mask),
+            )
+
+    else:
+
+        def attempt(budget):
+            fn = _compiled_block(
+                eps, int(cfg.min_points), cfg.engine.value, metric,
+                bool(cfg.use_pallas), budget, mesh,
+            )
+            return fn(
+                mesh_mod.shard_host_array(mesh, group.points),
+                mesh_mod.shard_host_array(mesh, group.mask),
+            )
+
+    fallback = None
+    if _cpu_fallback_allowed(cfg):
+        fallback = lambda: _cpu_dispatch_group(  # noqa: E731
+            group, cfg, mesh, kernel_eps, kernel_metric, resident_unit
         )
-    fn = _compiled_block(
-        float(kernel_eps if kernel_eps is not None else cfg.eps),
-        int(cfg.min_points),
-        cfg.engine.value,
-        kernel_metric if kernel_metric is not None else cfg.metric,
-        bool(cfg.use_pallas),
-        batch,
-        mesh,
-    )
-    return fn(
-        mesh_mod.shard_host_array(mesh, group.points),
-        mesh_mod.shard_host_array(mesh, group.mask),
+    return faults.supervised(
+        faults.SITE_DISPATCH,
+        attempt,
+        policy=faults.RetryPolicy.from_config(cfg),
+        budget=batch,
+        fallback=fallback,
+        label=f"[{p_total}, {b}]",
     )
 
 
@@ -427,7 +559,9 @@ def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh, kernel_eps=None):
     — per-slot counts are consumed on-device and deliberately not
     returned (they would pin 4 B/slot across every group, see
     _compiled_banded_p1). kernel_eps overrides cfg.eps when the payload
-    is chord coordinates."""
+    is chord coordinates. Supervised like _dispatch_partitions:
+    transient faults retry, RESOURCE_EXHAUSTED halves the batch budget,
+    persistent faults degrade the group to the CPU banded sweeps."""
     ext = group.banded
     logger.debug(
         "banded group dispatch: points %s slab %d batch %s",
@@ -435,28 +569,44 @@ def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh, kernel_eps=None):
         int(ext.slab),
         _banded_batch(group, mesh),
     )
-    fn = _compiled_banded_p1(
-        float(kernel_eps if kernel_eps is not None else cfg.eps),
-        int(cfg.min_points),
-        int(ext.slab),
-        # Pallas path: strictly sequential (no batch_size -> plain scan);
-        # lax.map's vmap lowering would vmap the pallas_calls' manual DMAs
-        None if cfg.use_pallas else _banded_batch(group, mesh),
-        mesh,
-        use_pallas=bool(cfg.use_pallas),
-        pallas_sp=(
-            bool(cfg.use_pallas)
-            and _os.environ.get("DBSCAN_PALLAS_SP") == "1"
-        ),
-    )
-    return fn(
-        *(
-            mesh_mod.shard_host_array(mesh, a)
-            for a in (
-                group.points, group.mask, ext.rel_starts, ext.spans,
-                ext.slab_starts, ext.cx,
+
+    def attempt(budget):
+        fn = _compiled_banded_p1(
+            float(kernel_eps if kernel_eps is not None else cfg.eps),
+            int(cfg.min_points),
+            int(ext.slab),
+            budget,
+            mesh,
+            use_pallas=bool(cfg.use_pallas),
+            pallas_sp=(
+                bool(cfg.use_pallas)
+                and _os.environ.get("DBSCAN_PALLAS_SP") == "1"
+            ),
+        )
+        return fn(
+            *(
+                mesh_mod.shard_host_array(mesh, a)
+                for a in (
+                    group.points, group.mask, ext.rel_starts, ext.spans,
+                    ext.slab_starts, ext.cx,
+                )
             )
         )
+
+    fallback = None
+    if _cpu_fallback_allowed(cfg):
+        fallback = lambda: _cpu_dispatch_banded_p1(  # noqa: E731
+            group, cfg, mesh, kernel_eps
+        )
+    return faults.supervised(
+        faults.SITE_BANDED,
+        attempt,
+        policy=faults.RetryPolicy.from_config(cfg),
+        # Pallas path: strictly sequential (no batch_size -> plain scan);
+        # lax.map's vmap lowering would vmap the pallas_calls' manual DMAs
+        budget=None if cfg.use_pallas else _banded_batch(group, mesh),
+        fallback=fallback,
+        label=f"{group.points.shape}",
     )
 
 
@@ -867,13 +1017,33 @@ def _resume_from_premerge(state: dict, t_start: float) -> TrainOutput:
 # of the dataset, retained while the entry lives) are cached for the
 # lifetime of the caller's input array. Keyed by object identity + a
 # FULL-COVERAGE content checksum
-# (one vectorized memory pass, ~0.3 s at 2 GB): identity catches reuse,
-# the checksum catches any value change anywhere in a reused array (the
-# one aliasing class is a value-preserving byte permutation within one
-# 64 KiB window — not a realistic mutation of numeric data); gc of the
+# (one memory pass in 8 MiB-bounded blocks, ~0.3 s at 2 GB): identity
+# catches reuse,
+# the checksum catches any value change anywhere in a reused array —
+# including in-window reorders (the per-position multipliers below make
+# each 64 KiB window's reduction position-sensitive); gc of the
 # input evicts via weakref so the cache can never outlive the data it
 # mirrors. Opt out with DBSCAN_RESIDENT_CACHE=0.
 _RESIDENT_CACHE: dict = {}
+
+
+# Odd per-position multipliers for the fingerprint's 64 KiB windows:
+# multiplying each u64 word by an odd (hence invertible mod 2^64)
+# index-derived constant before the xor/sum reductions makes them
+# POSITION-SENSITIVE — swapping two words within one window changes the
+# digest (w_i*m_i ^ w_j*m_j != w_j*m_i ^ w_i*m_j for w_i != w_j except
+# on measure-zero coincidences the sum lane independently breaks), so a
+# value-preserving in-window row swap can no longer silently reuse a
+# stale resident payload (ADVICE r5 medium).
+_FP_CHUNK = 8192  # u64 words = 64 KiB
+_FP_MULT = (
+    (np.arange(_FP_CHUNK, dtype=np.uint64) << np.uint64(1))
+    + np.uint64(1)
+) * np.uint64(0x9E3779B97F4A7C15) | np.uint64(1)
+_FP_BLOCK = 128  # chunks multiplied at a time: bounds the u64 product
+# temporary at _FP_BLOCK * 64 KiB = 8 MiB regardless of input size (a
+# full-size product would double host memory for GB-scale embeddings
+# on every cache lookup)
 
 
 def _pts_fingerprint(pts: np.ndarray) -> bytes:
@@ -883,16 +1053,26 @@ def _pts_fingerprint(pts: np.ndarray) -> bytes:
     n8 = (buf.size // 8) * 8
     if n8:
         w = buf[:n8].view(np.uint64)
-        # per-64KiB-chunk xor AND wraparound sum: every chunk whose
-        # bytes change flips at least one digest word
-        chunk = 8192  # u64 words = 64 KiB
-        pad = (-w.size) % chunk
-        if pad:
-            w = np.concatenate([w, np.zeros(pad, np.uint64)])
-        w = w.reshape(-1, chunk)
-        h.update(np.bitwise_xor.reduce(w, axis=1).tobytes())
+        # per-64KiB-chunk position-weighted xor AND wraparound sum:
+        # every chunk whose bytes change (or reorder) flips at least
+        # one digest word
+        n_chunks = -(-w.size // _FP_CHUNK)
+        xors = np.empty(n_chunks, np.uint64)
+        sums = np.empty(n_chunks, np.uint64)
         with np.errstate(over="ignore"):
-            h.update(np.add.reduce(w, axis=1).tobytes())
+            for start in range(0, n_chunks, _FP_BLOCK):
+                stop = min(start + _FP_BLOCK, n_chunks)
+                blk = w[start * _FP_CHUNK : stop * _FP_CHUNK]
+                pad = (-blk.size) % _FP_CHUNK
+                if pad:
+                    blk = np.concatenate(
+                        [blk, np.zeros(pad, np.uint64)]
+                    )
+                prod = blk.reshape(-1, _FP_CHUNK) * _FP_MULT[None, :]
+                xors[start:stop] = np.bitwise_xor.reduce(prod, axis=1)
+                sums[start:stop] = np.add.reduce(prod, axis=1)
+        h.update(xors.tobytes())
+        h.update(sums.tobytes())
     h.update(buf[n8:].tobytes())
     return h.digest()
 
@@ -1031,6 +1211,9 @@ def train_arrays(
     cell = cfg.minimum_rectangle_size
     timings: dict = {}
     t_start = time.perf_counter()
+    # failure accounting is process-global (spill/stream sites share it);
+    # this run reports the delta it caused
+    fault_snap = faults.counters.snapshot()
 
     ckpt_fp = None
     if checkpoint_dir is not None:
@@ -1136,6 +1319,7 @@ def train_arrays(
     # instance multiplicity, not rectangles.
     rp = None
     resident_ops = None
+    resident_unit = None  # host unit rows backing the resident payload
     if cfg.metric == "cosine":
         from dbscan_tpu.parallel import spill
 
@@ -1270,6 +1454,10 @@ def train_arrays(
                         halo = spill.chord_halo(
                             cfg.eps, q, dim=int(pts.shape[1])
                         )
+        if resident_ops is not None:
+            # the CPU degradation path for resident-gather groups
+            # rebuilds each partition's rows from the host unit copy
+            resident_unit = unit
         rp = spill.spill_partition(
             unit, cfg.max_points_per_partition, halo,
             device_ops=resident_ops,
@@ -1476,6 +1664,8 @@ def train_arrays(
         and (with a checkpoint_dir) persist the artifacts."""
         if "combo_host" in rec or "pending_loaded" in rec or "dropped" in rec:
             return  # done, placeholder still collecting, or re-chunked
+        if "combo_dev" not in rec:
+            return  # a prior pull died mid-record (abort-path re-walk)
         tp = time.perf_counter()
         layout = rec["layout"]
         total = layout["total"]
@@ -1610,14 +1800,74 @@ def train_arrays(
         elif len(eager["records"]) >= 2:
             _pull_record(eager["records"][-2])
 
+    def _abort_flush(site, ordinal, msg):
+        """A device fault with no degradation path is about to abort the
+        run. Before it propagates, bank a restart point at the LAST
+        COMPLETED GROUP: close the open compact chunk and pull+persist
+        every live chunk, so the resumed leg restarts after the last
+        healthy group rather than at the last chunk boundary.
+        Best-effort — the original fault re-raises regardless (and if
+        the worker is truly dead, the inner flush fails too; whatever
+        chunks were already pulled stay persisted)."""
+        if not (compact_on and ckpt_fp is not None):
+            return
+        # record the abort FIRST (host-only, survives a dead worker),
+        # then best-effort flush — on a truly dead backend the flush's
+        # own device ops fail and only the already-pulled chunks remain
+        try:
+            from dbscan_tpu.parallel import checkpoint as _ckpt_ab
+
+            _ckpt_ab.note_abort(
+                checkpoint_dir,
+                aborted_site=site,
+                aborted_ordinal=int(ordinal),
+                abort_error=msg[:200],
+            )
+        except Exception:  # noqa: BLE001 — the fault itself must win
+            logger.exception("abort-path progress note failed")
+        try:
+            _flush_chunk()
+            for rec in eager["records"]:
+                _pull_record(rec)
+        except Exception:  # noqa: BLE001 — the fault itself must win
+            logger.exception(
+                "abort-path chunk flush failed (restart point may be "
+                "one chunk stale)"
+            )
+
+    @contextlib.contextmanager
+    def _abort_guard():
+        """Abort-path coverage for a slice of the device phase. Two
+        fault shapes arrive here: a retries-exhausted supervised
+        dispatch raises faults.FatalDeviceFault at its dispatch site,
+        while a REAL async device fault normally surfaces at a
+        consuming pull (_pull_record / the tail flush) as a raw
+        device-runtime error — jax dispatch is asynchronous, so the
+        dispatch-site wrapper cannot see it. Either way, bank a
+        restart point before the fault propagates; non-device errors
+        (faults.classify -> None) pass through untouched."""
+        try:
+            yield
+        except faults.FatalDeviceFault as e:
+            _abort_flush(e.site, e.ordinal, str(e))
+            raise
+        except Exception as e:  # noqa: BLE001 — classify() filters
+            if faults.classify(e) is None:
+                raise
+            _abort_flush("pull", -1, f"{type(e).__name__}: {e}")
+            raise
+
     def _on_group(g):
         td = time.perf_counter()
         if g.banded is None:
             out = _dispatch_partitions(
                 g, cfg, mesh, kernel_eps, kernel_metric,
                 resident_x=(
-                    resident_ops.x if resident_ops is not None else None
+                    resident_ops.x
+                    if resident_ops is not None
+                    else None
                 ),
+                resident_unit=resident_unit,
             )
         elif compact_on:
             k = g.ordinal  # CANONICAL ordinal (arrival may be rotated)
@@ -1711,46 +1961,51 @@ def train_arrays(
         )
 
     cellmeta = None
-    if use_banded:
-        groups, max_b, cellmeta = binning.bucketize_banded(
-            kernel_cols,
-            part_ids,
-            point_idx,
-            n_parts=p_true,
-            eps=grid_eps,
-            outer=margins.outer,
-            bucket_multiple=cfg.bucket_multiple,
-            pad_parts_to=mesh_size(mesh),
-            dtype=dtype,
-            force=cfg.neighbor_backend == "banded",
-            on_group=_on_group,
-            grid_points=None if sph is None else sph.proj,
-            pad_parts_ladder=cfg.static_partition_pad,
-            # rotate emission so checkpoint-covered groups pack LAST and
-            # uncovered device work starts within seconds (retry legs on
-            # a dying worker must reach a NEW restart point fast)
-            resume_prefix=len(p1_exp),
-            on_plan=(
-                _on_plan
-                if (compact_on and checkpoint_dir is not None)
-                else None
-            ),
-            shape_floors=getattr(cfg, "shape_floors", None),
-        )
-    else:
-        groups, max_b = binning.bucketize_grouped(
-            kernel_cols,
-            part_ids,
-            point_idx,
-            n_parts=p_true,
-            bucket_multiple=cfg.bucket_multiple,
-            pad_parts_to=mesh_size(mesh),
-            dtype=dtype,
-            on_group=_on_group,
-            pad_parts_ladder=cfg.static_partition_pad,
-            shape_floors=getattr(cfg, "shape_floors", None),
-            fill_payload=resident_ops is None,
-        )
+    # the guard spans every dispatch AND the pipelined pulls the
+    # _on_group callbacks issue (_flush_chunk -> _pull_record): async
+    # device faults surface at those pulls, not at the dispatch sites
+    with _abort_guard():
+        if use_banded:
+            groups, max_b, cellmeta = binning.bucketize_banded(
+                kernel_cols,
+                part_ids,
+                point_idx,
+                n_parts=p_true,
+                eps=grid_eps,
+                outer=margins.outer,
+                bucket_multiple=cfg.bucket_multiple,
+                pad_parts_to=mesh_size(mesh),
+                dtype=dtype,
+                force=cfg.neighbor_backend == "banded",
+                on_group=_on_group,
+                grid_points=None if sph is None else sph.proj,
+                pad_parts_ladder=cfg.static_partition_pad,
+                # rotate emission so checkpoint-covered groups pack LAST
+                # and uncovered device work starts within seconds (retry
+                # legs on a dying worker must reach a NEW restart point
+                # fast)
+                resume_prefix=len(p1_exp),
+                on_plan=(
+                    _on_plan
+                    if (compact_on and checkpoint_dir is not None)
+                    else None
+                ),
+                shape_floors=getattr(cfg, "shape_floors", None),
+            )
+        else:
+            groups, max_b = binning.bucketize_grouped(
+                kernel_cols,
+                part_ids,
+                point_idx,
+                n_parts=p_true,
+                bucket_multiple=cfg.bucket_multiple,
+                pad_parts_to=mesh_size(mesh),
+                dtype=dtype,
+                on_group=_on_group,
+                pad_parts_ladder=cfg.static_partition_pad,
+                shape_floors=getattr(cfg, "shape_floors", None),
+                fill_payload=resident_ops is None,
+            )
     timings["dispatch_s"] = round(
         dispatch_spent[0] - eager["pull_spent"] - sync_spent[0], 6
     )
@@ -1794,23 +2049,27 @@ def train_arrays(
     # (_pad_idx) are safe by the same cap.
     if compact_on and cellmeta is not None:
         _pull_before_tail = eager["pull_spent"]
-        _flush_chunk()
-        # defensive: a placeholder that never filled (the emission plan
-        # diverged from the saved one — e.g. a changed group-slot cap
-        # slipping past the fingerprint) re-chunks whatever arrived via
-        # the divergence path instead of deadlocking the finalize; its
-        # stale file is invalidated either way
-        for _rec in eager["records"]:
-            if "pending_loaded" in _rec:
-                if _rec["ch"]:
-                    _complete_placeholder(_rec)
-                elif ckpt_fp is not None:
-                    from dbscan_tpu.parallel import checkpoint as _ckpt_p1
+        with _abort_guard():
+            _flush_chunk()
+            # defensive: a placeholder that never filled (the emission
+            # plan diverged from the saved one — e.g. a changed
+            # group-slot cap slipping past the fingerprint) re-chunks
+            # whatever arrived via the divergence path instead of
+            # deadlocking the finalize; its stale file is invalidated
+            # either way
+            for _rec in eager["records"]:
+                if "pending_loaded" in _rec:
+                    if _rec["ch"]:
+                        _complete_placeholder(_rec)
+                    elif ckpt_fp is not None:
+                        from dbscan_tpu.parallel import (
+                            checkpoint as _ckpt_p1,
+                        )
 
-                    _ckpt_p1.invalidate_p1_chunk(
-                        checkpoint_dir, _rec["ci"]
-                    )
-        _flush_chunk()  # divergence re-chunking may have reopened `cur`
+                        _ckpt_p1.invalidate_p1_chunk(
+                            checkpoint_dir, _rec["ci"]
+                        )
+            _flush_chunk()  # divergence re-chunk may have reopened `cur`
         eager["records"] = [
             r
             for r in eager["records"]
@@ -1924,7 +2183,11 @@ def train_arrays(
         base_off = 0
         or_off = 0
         for rec in compact:
-            _pull_record(rec)
+            # the last chunk is usually still live here; its pull is
+            # the final place an async device fault can surface with
+            # earlier chunks' artifacts worth banking
+            with _abort_guard():
+                _pull_record(rec)
             layout = rec.get("layout")
             if layout is None:  # checkpoint-loaded chunk
                 layout = cellgraph.cell_layout(rec["groups"])
@@ -2051,6 +2314,13 @@ def train_arrays(
     banded_sweep_flops = flops_spent[0]
     banded_sweep_bytes = bytes_spent[0]
 
+    # supervised-dispatch accounting for THIS run (delta over the
+    # process-global counters): attempts/retries/fallbacks plus the
+    # total backoff wall, surfaced in stats["faults"] and mirrored into
+    # timings (backoff is wall the run really spent sleeping)
+    fault_stats = faults.counters.delta(fault_snap)
+    timings["fault_backoff_s"] = fault_stats["backoff_s"]
+
     # core stats: one schema shared by the final output, the checkpoint
     # scalars, and (verbatim) the resumed run's stats
     core_stats = {
@@ -2066,6 +2336,7 @@ def train_arrays(
         "n_core_instances": int(n_core),
         "projected": sph is not None,  # spherical embedding in effect
         "spill_tree": rp is not None,  # metric spill partitioning in effect
+        "faults": fault_stats,
     }
 
     if ckpt_fp is not None:

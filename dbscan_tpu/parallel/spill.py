@@ -63,6 +63,8 @@ from typing import Tuple
 
 import numpy as np
 
+from dbscan_tpu import faults
+
 logger = logging.getLogger(__name__)
 
 # A node whose spill pass duplicates more than this (instances / points)
@@ -805,12 +807,17 @@ def spill_partition(
                         if s_local is not None
                         else None
                     )
-                    piv = sdev.pivot_vectors_device(
-                        dev_s if dev_s is not None else dev_sub,
-                        m, halo, rng,
+                    piv = faults.supervised(
+                        faults.SITE_SPILL,
+                        lambda _b: sdev.pivot_vectors_device(
+                            dev_s if dev_s is not None else dev_sub,
+                            m, halo, rng,
+                        ),
+                        label="pivots",
                     )
                 except Exception as e:  # noqa: BLE001 — degrade to host
                     logger.warning("spill: device pivots failed (%s)", e)
+                    faults.note_degrade()
                     dev_root = dev_sub = dev_s = None
                     sub = ops.take(idx)
             if piv is None:
@@ -863,13 +870,18 @@ def spill_partition(
             if sub_s is not None or dev_s is not None:
                 if dev_s is not None:
                     try:
-                        screen_dup, screen_m = sdev.screen_dup_device(
-                            dev_s, piv, halo
+                        screen_dup, screen_m = faults.supervised(
+                            faults.SITE_SPILL,
+                            lambda _b: sdev.screen_dup_device(
+                                dev_s, piv, halo
+                            ),
+                            label="screen",
                         )
                     except Exception as e:  # noqa: BLE001
                         logger.warning(
                             "spill: device screen failed (%s); host", e
                         )
+                        faults.note_degrade()
                         dev_root = dev_sub = dev_s = None
                         sub = ops.take(idx)
                         sub_s = sub.take(np.sort(s_local))
@@ -904,13 +916,18 @@ def spill_partition(
             # caller's slack inside `halo`
             if dev_sub is not None:
                 try:
-                    assign, member = sdev.membership_device(
-                        dev_sub, piv, halo
+                    assign, member = faults.supervised(
+                        faults.SITE_SPILL,
+                        lambda _b: sdev.membership_device(
+                            dev_sub, piv, halo
+                        ),
+                        label="membership",
                     )
                 except Exception as e:  # noqa: BLE001
                     logger.warning(
                         "spill: device membership failed (%s); host", e
                     )
+                    faults.note_degrade()
                     dev_root = dev_sub = None
                     sub = ops.take(idx)
             if dev_sub is None:
@@ -954,13 +971,18 @@ def spill_partition(
                 )
             elif dev_sub is not None:
                 try:
-                    pc = sdev.leader_components_device(
-                        dev_sub, halo, rng, _LEADER_EDGE_BUDGET
+                    pc = faults.supervised(
+                        faults.SITE_SPILL,
+                        lambda _b: sdev.leader_components_device(
+                            dev_sub, halo, rng, _LEADER_EDGE_BUDGET
+                        ),
+                        label="leader-cover",
                     )
                 except Exception as e:  # noqa: BLE001
                     logger.warning(
                         "spill: device leader cover failed (%s); host", e
                     )
+                    faults.note_degrade()
                     dev_root = dev_sub = None
                     pc = leader_components(ops.take(idx), halo, rng)
             else:

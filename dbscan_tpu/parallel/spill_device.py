@@ -32,6 +32,8 @@ import functools
 
 import numpy as np
 
+from dbscan_tpu import faults
+
 # chord-error bound for bf16-stored unit rows: |dot error| <= 2*2^-9
 # (+f32 accumulation, negligible at D<=4096); chord = sqrt(2-2dot) moves
 # worst at small chords by sqrt(2 * 2 * 2^-9) ~ 0.0885
@@ -59,13 +61,27 @@ class DeviceNodeOps:
         import ml_dtypes
 
         xb = np.asarray(x_host, dtype=ml_dtypes.bfloat16)
-        return cls(jnp.asarray(xb), x_host.shape[0], x_host.shape[1])
+        # supervised upload: the bf16 payload is the biggest single
+        # transfer of the cosine route (~1 GB at 1M x 512 over the
+        # tunnel) and exactly where a flaky link faults — retry with
+        # backoff before the caller degrades the run to host BLAS
+        x_dev = faults.supervised(
+            faults.SITE_SPILL,
+            lambda _b: jnp.asarray(xb),
+            label="payload-upload",
+        )
+        return cls(x_dev, x_host.shape[0], x_host.shape[1])
 
     def take(self, idx: np.ndarray) -> "DeviceNodeOps":
         import jax.numpy as jnp
 
+        idx32 = jnp.asarray(np.asarray(idx, np.int32))
         return DeviceNodeOps(
-            _gather_fn()(self.x, jnp.asarray(np.asarray(idx, np.int32))),
+            faults.supervised(
+                faults.SITE_SPILL,
+                lambda _b: _gather_fn()(self.x, idx32),
+                label="child-gather",
+            ),
             len(idx),
             self.dim,
         )
@@ -383,6 +399,12 @@ def leader_components_device(
     from dbscan_tpu.parallel.graph import uf_components
 
     n = sub.n
+    # ONE permutation shared by every escalation rung: the greedy walk
+    # is a deterministic function of (perm, t), so the t == t_prev skip
+    # below is provably futile — a same-radius rerun with the same perm
+    # must overflow identically. (Per-rung draws would make that claim
+    # false: a different walk order could stay under _LEADER_CAP.)
+    perm = rng.permutation(n).astype(np.int32)
     t_prev = None
     for t_mult in (2.0, 4.0, 8.0):
         # bf16 floor on the cover radius: a covered point's MEASURED
@@ -392,15 +414,14 @@ def leader_components_device(
         # radius, so the floor costs nothing but leader density
         t = max(t_mult * halo, BF16_CHORD_SLACK)
         if t == t_prev:
-            continue  # floor clamped this rung too: same radius
-            # already overflowed, a rerun cannot end differently
+            continue  # floor clamped this rung too: same radius, same
+            # permutation — the rerun provably overflows the same way
         t_prev = t
         if t + halo >= 1.9:
             break
         import jax.numpy as jnp
 
         fn = _greedy_leaders_fn(int(sub.dim), _LEADER_CAP)
-        perm = rng.permutation(n).astype(np.int32)
         buf, nb, overflow = fn(sub.x, jnp.asarray(perm), jnp.float32(t))
         if bool(overflow):
             continue  # cap exceeded: retry at a coarser radius

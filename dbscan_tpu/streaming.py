@@ -33,9 +33,10 @@ from typing import Deque, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from dbscan_tpu import faults
 from dbscan_tpu.config import DBSCANConfig, Engine, Precision
 from dbscan_tpu.ops.labels import CORE
-from dbscan_tpu.parallel.driver import train_arrays
+from dbscan_tpu.parallel.driver import _cpu_fallback_allowed, train_arrays
 
 
 class _MinUnionFind:
@@ -172,6 +173,20 @@ class StreamingDBSCAN:
         ids = np.concatenate([i for _, i in self._window])
         return pts, ids
 
+    def _cpu_update_fallback(self, combined: np.ndarray):
+        """Degradation thunk for one micro-batch: the same batch
+        pipeline pinned to the host jax CPU backend (labels identical —
+        one algebra, another backend), so a persistently-faulting
+        device costs latency, not the stream's cluster identities."""
+
+        def run():
+            import jax
+
+            with jax.default_device(jax.devices("cpu")[0]):
+                return train_arrays(combined, self.config, mesh=None)
+
+        return run
+
     def resolve(self, ids: np.ndarray) -> np.ndarray:
         """Map previously-emitted stream ids to their current canonical ids
         (after later batches merged clusters). Vectorized — safe to call on
@@ -207,7 +222,29 @@ class StreamingDBSCAN:
             if len(wpts)
             else batch[:, :ncols]
         )
-        out = train_arrays(combined, self.config, mesh=self.mesh)
+        # Per-batch supervision (dbscan_tpu/faults.py): the inner
+        # dispatches carry their own group-granular retry/degradation;
+        # this outer wrapper covers faults that surface at pull/merge
+        # time instead. train_arrays is a pure function of host state,
+        # so a whole-batch retry is idempotent; the CPU degradation
+        # re-runs the batch pinned to the host backend — stream
+        # identities survive a dead device instead of dying with it.
+        fault_snap = faults.counters.snapshot()
+        out = faults.supervised(
+            faults.SITE_STREAM,
+            lambda _b: train_arrays(combined, self.config, mesh=self.mesh),
+            policy=faults.RetryPolicy.from_config(self.config),
+            # same gate as the driver's per-group degradation: in a
+            # multi-process job one host re-running the batch on CPU
+            # while the others issue mesh collectives would desync the
+            # collective sequence — forced off there
+            fallback=(
+                self._cpu_update_fallback(combined)
+                if _cpu_fallback_allowed(self.config)
+                else None
+            ),
+            label=f"update {self._n_updates}",
+        )
 
         b = len(batch)
         batch_cl = out.clusters[:b]
@@ -278,6 +315,9 @@ class StreamingDBSCAN:
             n_updates=self._n_updates,
             window_points=int(len(wpts)),
             batch_clusters=len(uniq_b),
+            # whole-update fault delta: the inner train_arrays delta
+            # misses batch-level retries/degradations this wrapper took
+            faults=faults.counters.delta(fault_snap),
         )
         return StreamUpdate(
             clusters=stream_cl,

@@ -286,6 +286,70 @@ def test_device_phase_eager_pull_mode(rng, tmp_path, monkeypatch):
     assert len(list(ck.glob("p1chunk*.npz"))) >= 2
 
 
+def _dummy_chunk(ck, fp, ci, budget=512):
+    """Minimal well-formed p1chunk file at index ``ci``."""
+    ckpt.save_p1_chunk(
+        str(ck), fp, ci, f"sig{ci}",
+        np.array([[4, 512, 8]], dtype=np.int64),
+        {"combo": np.zeros(8, np.uint8), "bbits": np.zeros((1, 2), np.uint64)},
+        budget=budget,
+    )
+
+
+def test_p1_chunk_truncated_mid_prefix_stops_load(rng, tmp_path):
+    """A torn (truncated, zip magic intact) chunk file mid-prefix must
+    truncate the loadable prefix THERE — never crash, never skip past
+    the tear: chunks are only usable in consecutive emission order."""
+    ck = tmp_path / "ck"
+    for ci in range(3):
+        _dummy_chunk(ck, "fp", ci)
+    raw = (ck / "p1chunk0001.npz").read_bytes()
+    (ck / "p1chunk0001.npz").write_bytes(raw[: len(raw) // 2])
+    loaded = ckpt.load_p1_chunks(str(ck), "fp", budget=512)
+    assert len(loaded) == 1  # chunk 0 only; 2 is unreachable behind the tear
+    assert loaded[0]["sig"] == "sig0"
+    # count_p1_chunks counts FILES (restart-point estimate for the
+    # campaign harness); the verified load is the stricter gate
+    assert ckpt.count_p1_chunks(str(ck)) == 3
+
+
+def test_p1_chunk_budget_mismatch_rejected_outright(rng, tmp_path):
+    """Chunks formed under a different slot budget cannot re-form the
+    same compositions — the loader must reject the whole set, not hand
+    back per-group skips that then redispatch serially."""
+    ck = tmp_path / "ck"
+    for ci in range(2):
+        _dummy_chunk(ck, "fp", ci, budget=512)
+    assert len(ckpt.load_p1_chunks(str(ck), "fp", budget=512)) == 2
+    assert ckpt.load_p1_chunks(str(ck), "fp", budget=2048) == []
+    # fingerprint mismatch: same outright rejection
+    assert ckpt.load_p1_chunks(str(ck), "other-fp", budget=512) == []
+
+
+def test_invalidate_p1_chunk_gap_semantics(rng, tmp_path):
+    """invalidate_p1_chunk(ci) removes ci AND everything above it — a
+    gap would make higher-index files unreachable now and actively
+    dangerous later (a future leg's saves filling the gap would let
+    stale survivors load as signature-mismatched placeholders)."""
+    ck = tmp_path / "ck"
+    for ci in range(4):
+        _dummy_chunk(ck, "fp", ci)
+    ckpt.invalidate_p1_chunk(str(ck), 1)
+    assert sorted(p.name for p in ck.glob("p1chunk*.npz")) == [
+        "p1chunk0000.npz"
+    ]
+    assert ckpt.count_p1_chunks(str(ck)) == 1
+    # pre-existing gap: invalidation still clears every file >= ci
+    _dummy_chunk(ck, "fp", 1)
+    _dummy_chunk(ck, "fp", 3)  # gap at 2
+    ckpt.invalidate_p1_chunk(str(ck), 1)
+    assert sorted(p.name for p in ck.glob("p1chunk*.npz")) == [
+        "p1chunk0000.npz"
+    ]
+    # invalidating a missing dir is a no-op, not a crash
+    ckpt.invalidate_p1_chunk(str(tmp_path / "nope"), 0)
+
+
 def test_device_phase_sig_divergence_rechunks(rng, tmp_path, monkeypatch):
     """A saved chunk whose composition signature no longer matches (a
     stale/corrupt checkpoint) must NOT be adopted: its groups re-enter

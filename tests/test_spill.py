@@ -491,6 +491,43 @@ def test_resident_payload_cache_reuse_and_mutation(rng, monkeypatch):
     driver._RESIDENT_CACHE.clear()
 
 
+def test_fingerprint_in_window_row_swap_misses(rng, monkeypatch):
+    """Resident-cache checksum must be POSITION-sensitive inside each
+    64 KiB window (ADVICE r5 medium): swapping two rows that share a
+    window is value-preserving for an order-insensitive xor/sum
+    reduction, and a silent hit would reuse stale unit rows — labels
+    would map to the OLD row order. Pins both the raw fingerprint and
+    the cache-lookup miss."""
+    from dbscan_tpu.parallel import driver
+
+    # 16-col f64 rows are 128 B: 512 rows per 64 KiB window, so rows 3
+    # and 7 share the FIRST window
+    pts = rng.normal(size=(1024, 16))
+    fp0 = driver._pts_fingerprint(pts)
+    swapped = pts.copy()
+    swapped[[3, 7]] = swapped[[7, 3]]
+    assert driver._pts_fingerprint(swapped) != fp0
+    swapped[[3, 7]] = swapped[[7, 3]]  # swap back: fingerprint restores
+    assert driver._pts_fingerprint(swapped) == fp0
+
+    # cache level: an entry built for the array must MISS after an
+    # in-place in-window swap (and the miss returns the new fingerprint)
+    monkeypatch.setenv("DBSCAN_RESIDENT_CACHE", "1")
+    driver._RESIDENT_CACHE.clear()
+    import weakref
+
+    driver._RESIDENT_CACHE[id(pts)] = (
+        weakref.ref(pts), fp0, pts, object(), False,
+    )
+    hit, _fp = driver._resident_payload_lookup(pts)
+    assert hit is not None
+    pts[[3, 7]] = pts[[7, 3]]
+    miss, fp_new = driver._resident_payload_lookup(pts)
+    assert miss is None
+    assert fp_new is not None and fp_new != fp0
+    driver._RESIDENT_CACHE.clear()
+
+
 def test_device_greedy_cover_radius_units():
     """The device greedy cover stores SQUARED chords; coverage must
     compare them against t^2, not the linear t — the latter silently
