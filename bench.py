@@ -636,8 +636,30 @@ def m100_row(prefix: str = "m100") -> dict:
     return out
 
 
+def _rep_obs_fields(delta: dict, dt: float) -> dict:
+    """Per-rep observability fields from an obs counter delta: the
+    upload/compute wall split and the resident-cache hot/cold tag that
+    turn the cosine capture swing (5-60 s same-day, VERDICT r5) into
+    two labeled distributions. ``upload_s`` is the host wall spent in
+    the resident-payload upload (0.0 on a cache-hit rep — and for
+    metrics with no resident payload); ``compute_s`` is the rest of the
+    rep's wall. ``resident_hot`` appears only when the rep touched the
+    resident cache at all (cosine resident mode)."""
+    upload_s = float(delta.get("transfer.payload_upload_s", 0.0))
+    out = {
+        "upload_s": round(upload_s, 3),
+        "compute_s": round(max(0.0, dt - upload_s), 3),
+        "upload_bytes": int(delta.get("transfer.payload_upload_bytes", 0)),
+    }
+    hits = int(delta.get("resident_cache.hits", 0))
+    misses = int(delta.get("resident_cache.misses", 0))
+    if hits or misses:
+        out["resident_hot"] = hits > 0 and misses == 0
+    return out
+
+
 def run_train(pts, maxpp, use_pallas=False, reps=1, **extra):
-    from dbscan_tpu import Engine, train
+    from dbscan_tpu import Engine, obs, train
 
     kw = dict(
         eps=EPS,
@@ -652,15 +674,33 @@ def run_train(pts, maxpp, use_pallas=False, reps=1, **extra):
     # fluctuates by >3x between runs, so a single timing is a lottery —
     # the minimum is the reproducible peak-throughput figure
     train(pts, **kw)
+    # in-memory obs registry (no trace file unless DBSCAN_TRACE is set):
+    # per-rep counter deltas label each timed rep resident-hot/cold and
+    # split its upload wall from compute — the disabled-path hooks the
+    # pipeline already carries become live for pennies (a few hundred
+    # counter bumps per run, vs seconds-scale walls)
+    st = obs.enable()
+    # suspend the trace file during the timed loop: train() flushes the
+    # CUMULATIVE trace at every return, and serializing the warm-up +
+    # all prior reps' spans inside a timed rep would bias the very
+    # walls (and compute_s) this instrumentation exists to clean up
+    trace_path, st.trace_path = st.trace_path, None
     dt = float("inf")
     model = None
-    for _ in range(max(1, reps)):
-        t0 = time.perf_counter()
-        m = train(pts, **kw)
-        dt_rep = time.perf_counter() - t0
-        if dt_rep < dt:  # keep the BEST rep's model: its phase split is
-            model, dt = m, dt_rep  # the one describing the reported wall
-    return model, dt
+    rep_obs: dict = {}
+    try:
+        for _ in range(max(1, reps)):
+            snap = obs.counters()
+            t0 = time.perf_counter()
+            m = train(pts, **kw)
+            dt_rep = time.perf_counter() - t0
+            if dt_rep < dt:  # keep the BEST rep's model: its phase split
+                model, dt = m, dt_rep  # describes the reported wall
+                rep_obs = _rep_obs_fields(obs.counters_delta(snap), dt_rep)
+    finally:
+        st.trace_path = trace_path
+        obs.flush()  # one untimed write covering all reps
+    return model, dt, rep_obs
 
 
 def child_cpu(data_path: str, out_path: str, maxpp: int) -> None:
@@ -668,7 +708,7 @@ def child_cpu(data_path: str, out_path: str, maxpp: int) -> None:
 
     jax.config.update("jax_platforms", "cpu")
     pts = np.load(data_path)["pts"]
-    model, dt = run_train(pts, maxpp)
+    model, dt, _rep_obs = run_train(pts, maxpp)
     np.savez(out_path, clusters=model.clusters, seconds=dt, n=len(pts))
 
 
@@ -692,7 +732,7 @@ def anchor_row(prefix: str, n: int, kind: str, maxpp: int) -> dict:
             "1" if kind == "cosine" else "2",
         )
     )
-    model, dt = run_train(pts, maxpp, reps=reps, **extra)
+    model, dt, rep_obs = run_train(pts, maxpp, reps=reps, **extra)
     ari = adjusted_rand_index(model.clusters[:n_blob], blob_of)
     out = {
         f"{prefix}_n": n,
@@ -701,6 +741,10 @@ def anchor_row(prefix: str, n: int, kind: str, maxpp: int) -> dict:
         f"{prefix}_expect": k,
         f"{prefix}_ari": round(float(ari), 6),
         f"{prefix}_phases": _phases(model.stats),
+        # hot/cold + upload/compute split of the BEST rep (obs counters):
+        # the cosine wall is only comparable across captures once each
+        # rep says whether it paid the resident-payload upload
+        **{f"{prefix}_{k2}": v for k2, v in rep_obs.items()},
     }
     if kind == "euclidean" and os.environ.get("BENCH_MFU", "1") == "1":
         import jax
@@ -806,7 +850,7 @@ def main() -> None:
         pallas_extra = {"neighbor_backend": "banded"} if use_pallas else {}
         reps = int(os.environ.get("BENCH_REPS", "3"))
         try:
-            model, dt = run_train(
+            model, dt, rep_obs = run_train(
                 pts, maxpp, use_pallas=use_pallas, reps=reps, **pallas_extra
             )
         except jax.errors.JaxRuntimeError as e:
@@ -900,6 +944,7 @@ def main() -> None:
         "n_partitions": model.stats["n_partitions"],
         "seconds": round(dt, 3),
         "phases": _phases(model.stats),
+        **rep_obs,  # upload/compute split (+ resident_hot when cosine)
     }
     if backend != "cpu" and os.environ.get("BENCH_MFU", "1") == "1":
         try:
@@ -1072,6 +1117,10 @@ _COMPACT_SUFFIXES = (
     "_chunks_total",
     "_legs",
     "_complete",
+    # hot/cold rep tag (dbscan_tpu/obs): a compact line whose cosine
+    # wall cannot be read without knowing whether the rep paid the
+    # payload upload must carry the tag too
+    "_resident_hot",
 )
 
 

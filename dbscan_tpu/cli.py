@@ -12,7 +12,8 @@ Usage:
       --eps 0.3 --min-points 10 [--max-points-per-partition 250] \
       [--engine naive|archery] [--metric euclidean|haversine|cosine] \
       [--precision f32|f64|bf16] [--use-pallas] [--mesh-devices N] \
-      [--stats] [--log-level INFO]
+      [--stats] [--trace trace.json] [--metrics-summary] \
+      [--log-level INFO]
 """
 
 from __future__ import annotations
@@ -94,6 +95,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="pin the JAX platform (wins over JAX_PLATFORMS, which "
         "site-level plugin registration can override)",
     )
+    p.add_argument(
+        "--trace", metavar="PATH",
+        help="write a span trace of the run to PATH: Chrome-trace JSON "
+        "(chrome://tracing / Perfetto) by default, JSONL records when "
+        "PATH ends in .jsonl (equivalent env: DBSCAN_TRACE=PATH)",
+    )
+    p.add_argument(
+        "--metrics-summary", action="store_true",
+        help="print the top spans and counters after the run (enables "
+        "the in-memory observability registry even without --trace)",
+    )
     p.add_argument("--log-level", default="WARNING")
     return p
 
@@ -109,6 +121,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
     log = logging.getLogger("dbscan_tpu.cli")
+
+    if args.trace or args.metrics_summary:
+        from dbscan_tpu import obs
+
+        obs.enable(trace_path=args.trace)
 
     points = io_mod.load_points(args.input, args.input_format, args.delimiter)
     log.info("loaded %d points (%d columns) from %s", len(points), points.shape[1], args.input)
@@ -162,6 +179,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             fa.get("budget_halvings", 0),
             fa.get("backoff_s", 0.0),
         )
+
+    # observability summary (dbscan_tpu/obs): where the run's wall went
+    # — the span/counter analog of the fault block above, printed as
+    # text next to it (the machine-readable record stays the trace file)
+    if args.trace or args.metrics_summary:
+        from dbscan_tpu import obs
+
+        if args.trace:
+            written = obs.flush()
+            log.info("trace written to %s", written)
+        if args.metrics_summary:
+            summ = obs.summary(top=10)
+            print("== metrics summary ==")
+            print("top spans (total_s x count):")
+            for name, cnt, total in summ["spans"]:
+                print(f"  {name:<28} {total:>10.3f}s x {cnt}")
+            print("counters:")
+            for name, value in sorted(summ["counters"].items()):
+                if isinstance(value, float):
+                    value = round(value, 6)
+                print(f"  {name:<28} {value}")
 
     if args.output:
         io_mod.save_labeled(

@@ -54,6 +54,8 @@ from typing import Callable, Optional, Tuple
 
 import numpy as np
 
+from dbscan_tpu import obs
+
 logger = logging.getLogger(__name__)
 
 # fault kinds (also the spec grammar's kind tokens)
@@ -397,6 +399,7 @@ def supervised(
     while True:
         attempts += 1
         counters.attempts += 1
+        obs.count("faults.attempts")
         try:
             reg.check(site, ordinal, global_ordinal, attempt)
             out = attempt_fn(budget)
@@ -411,6 +414,7 @@ def supervised(
                 raise
             if isinstance(e, FaultInjected):
                 counters.injected += 1
+                obs.count("faults.injected")
             last = e
             if kind == PERSISTENT:
                 # every attempt would fail identically: stop burning
@@ -431,6 +435,13 @@ def supervised(
             ):
                 budget = max(1, budget // 2)
                 counters.budget_halvings += 1
+                obs.count("faults.budget_halvings")
+                obs.event(
+                    "fault.budget_halved",
+                    site=site,
+                    ordinal=ordinal,
+                    budget=budget,
+                )
                 logger.warning(
                     "%s: RESOURCE_EXHAUSTED — halving batch budget to "
                     "%d before retry",
@@ -442,6 +453,17 @@ def supervised(
             delay = pol.backoff(attempt, rng)
             counters.retries += 1
             counters.backoff_s += delay
+            obs.count("faults.retries")
+            obs.count("faults.backoff_s", delay)
+            obs.event(
+                "fault.retry",
+                site=site,
+                ordinal=ordinal,
+                kind=kind,
+                attempt=attempt + 1,
+                delay_s=round(delay, 6),
+                error=f"{type(e).__name__}"[:80],
+            )
             logger.warning(
                 "%s attempt %d/%d failed (%s: %s); retrying in %.2fs",
                 what,
@@ -456,6 +478,14 @@ def supervised(
             attempt += 1
     if fallback is not None:
         counters.fallbacks += 1
+        obs.count("faults.fallbacks")
+        obs.event(
+            "fault.fallback",
+            site=site,
+            ordinal=ordinal,
+            attempts=attempts,
+            error=f"{type(last).__name__}"[:80],
+        )
         logger.warning(
             "%s failed after %d attempt(s) (%s: %s); degrading this "
             "group to the CPU engine",
@@ -465,6 +495,13 @@ def supervised(
             last,
         )
         return fallback()
+    obs.event(
+        "fault.fatal",
+        site=site,
+        ordinal=ordinal,
+        attempts=attempts,
+        error=f"{type(last).__name__}"[:80],
+    )
     raise FatalDeviceFault(site, ordinal, attempts, last)
 
 
@@ -474,3 +511,5 @@ def note_degrade() -> None:
     to tear down), so it counts the degrade itself after
     :func:`supervised` exhausts the retries."""
     counters.fallbacks += 1
+    obs.count("faults.fallbacks")
+    obs.event("fault.degrade_host")

@@ -1,0 +1,213 @@
+"""Trace export: Chrome-trace (chrome://tracing / Perfetto) and JSONL.
+
+One exporter consumes the span registry (obs/trace.py) and the metrics
+registry (obs/metrics.py) and writes either format, decided by the
+target path's extension (``.jsonl`` -> JSONL records, anything else ->
+a Chrome-trace JSON object). Writes are atomic (tmp + rename) so a run
+killed mid-flush leaves the previous trace intact — the same discipline
+as the checkpoint writers (parallel/checkpoint.py).
+
+Chrome-trace schema (the subset Perfetto's JSON importer consumes):
+
+- complete spans: ``{"ph": "X", "name", "cat", "ts", "dur", "pid",
+  "tid", "args"}`` with ``ts``/``dur`` in MICROSECONDS relative to the
+  tracer's time base;
+- instant events (fault retries, cache decisions): ``{"ph": "i",
+  "s": "t"}`` attached to the thread that observed them;
+- counters: one final ``{"ph": "C"}`` sample per counter name (the
+  registry keeps totals, not a time series — the trace shows the run's
+  end state, the spans show where the time went).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+
+def _jsonable(v):
+    """Coerce numpy scalars/arrays and other exotica into JSON types —
+    span args come straight from hot loops that pass whatever they have."""
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, (str, bool)) or v is None:
+        return v
+    if isinstance(v, int):
+        return v
+    if isinstance(v, float):
+        return round(v, 9)
+    try:  # numpy scalars
+        import numpy as np
+
+        if isinstance(v, np.integer):
+            return int(v)
+        if isinstance(v, np.floating):
+            return round(float(v), 9)
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+    except Exception:  # noqa: BLE001 — exporter must never raise on args
+        pass
+    return str(v)
+
+
+def chrome_trace(tracer, metrics=None) -> dict:
+    """Build the Chrome-trace object from a tracer (+ optional metrics
+    registry). Events are ordered by start time — the span registry
+    appends at END time (obs/trace.py), so the export layer re-sorts."""
+    pid = os.getpid()
+    base = tracer.t0
+    events = []
+    t_last = 0.0
+    for sp in tracer.snapshot_spans():
+        t1 = sp.t1 if sp.t1 is not None else sp.t0
+        ts = (sp.t0 - base) * 1e6
+        t_last = max(t_last, (t1 - base) * 1e6)
+        events.append(
+            {
+                "name": sp.name,
+                "cat": "dbscan",
+                "ph": "X",
+                "ts": ts,
+                "dur": max(0.0, (t1 - sp.t0) * 1e6),
+                "pid": pid,
+                "tid": sp.tid,
+                "args": _jsonable(dict(sp.args, depth=sp.depth)),
+            }
+        )
+        for name, t, args in sp.events:
+            events.append(
+                {
+                    "name": name,
+                    "cat": "dbscan",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": (t - base) * 1e6,
+                    "pid": pid,
+                    "tid": sp.tid,
+                    "args": _jsonable(args),
+                }
+            )
+    for name, t, args in getattr(tracer, "instants", ()):
+        events.append(
+            {
+                "name": name,
+                "cat": "dbscan",
+                "ph": "i",
+                "s": "p",
+                "ts": (t - base) * 1e6,
+                "pid": pid,
+                "tid": 0,
+                "args": _jsonable(args),
+            }
+        )
+    events.sort(key=lambda e: e["ts"])
+    if metrics is not None:
+        for name, value in sorted(metrics.counters().items()):
+            events.append(
+                {
+                    "name": name,
+                    "cat": "dbscan",
+                    "ph": "C",
+                    "ts": t_last,
+                    "pid": pid,
+                    "args": {"value": _jsonable(value)},
+                }
+            )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            # epoch anchor: ts are perf_counter-relative; this pins the
+            # trace to wall-clock time for cross-process correlation
+            "epoch0": tracer.epoch0,
+            # >0 means the retention bound (DBSCAN_TRACE_MAX_SPANS)
+            # dropped the oldest spans — the trace is a tail, not a whole
+            "dropped_spans": getattr(tracer, "dropped_spans", 0),
+            "gauges": _jsonable(metrics.gauges()) if metrics else {},
+        },
+    }
+
+
+def jsonl_records(tracer, metrics=None):
+    """Yield one flat JSON-able dict per span / instant / counter —
+    the grep-able format for harnesses that don't want a trace UI."""
+    base = tracer.t0
+    for sp in tracer.snapshot_spans():
+        t1 = sp.t1 if sp.t1 is not None else sp.t0
+        yield {
+            "type": "span",
+            "name": sp.name,
+            "t0_s": round(sp.t0 - base, 9),
+            "dur_s": round(max(0.0, t1 - sp.t0), 9),
+            "depth": sp.depth,
+            "tid": sp.tid,
+            "args": _jsonable(sp.args),
+            "events": [
+                {
+                    "name": n,
+                    "t_s": round(t - base, 9),
+                    "args": _jsonable(a),
+                }
+                for n, t, a in sp.events
+            ],
+        }
+    for name, t, args in getattr(tracer, "instants", ()):
+        yield {
+            "type": "instant",
+            "name": name,
+            "t_s": round(t - base, 9),
+            "args": _jsonable(args),
+        }
+    if metrics is not None:
+        for name, value in sorted(metrics.counters().items()):
+            yield {"type": "counter", "name": name, "value": _jsonable(value)}
+        for name, value in sorted(metrics.gauges().items()):
+            yield {"type": "gauge", "name": name, "value": _jsonable(value)}
+    dropped = getattr(tracer, "dropped_spans", 0)
+    if dropped:
+        yield {"type": "dropped_spans", "value": dropped}
+
+
+def _atomic_write(path: str, text: str) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def write_chrome_trace(path: str, tracer, metrics=None) -> str:
+    _atomic_write(path, json.dumps(chrome_trace(tracer, metrics)))
+    return path
+
+
+def write_jsonl(path: str, tracer, metrics=None) -> str:
+    lines = [json.dumps(r) for r in jsonl_records(tracer, metrics)]
+    _atomic_write(path, "\n".join(lines) + ("\n" if lines else ""))
+    return path
+
+
+def write(path: str, tracer, metrics=None) -> str:
+    """Format by extension: ``.jsonl`` -> JSONL, else Chrome trace."""
+    if path.endswith(".jsonl"):
+        return write_jsonl(path, tracer, metrics)
+    return write_chrome_trace(path, tracer, metrics)
+
+
+def span_summary(tracer, top: Optional[int] = 10) -> list:
+    """Aggregate finished spans by name: (name, count, total seconds),
+    sorted by total wall descending — the ``--metrics-summary`` body."""
+    agg: dict = {}
+    for sp in tracer.snapshot_spans():
+        t1 = sp.t1 if sp.t1 is not None else sp.t0
+        c, t = agg.get(sp.name, (0, 0.0))
+        agg[sp.name] = (c + 1, t + max(0.0, t1 - sp.t0))
+    rows = sorted(
+        ((name, c, round(t, 6)) for name, (c, t) in agg.items()),
+        key=lambda r: -r[2],
+    )
+    return rows[:top] if top else rows

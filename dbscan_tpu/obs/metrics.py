@@ -1,0 +1,68 @@
+"""Process-global counters and gauges for the distributed pipeline.
+
+The reference's only numbers are the driver-side println taps it ships
+commented-in (DBSCAN.scala:139,202); Spark's real accounting lives in
+executor metrics. Our analog is one flat registry of dotted-name
+counters (monotone adds) and gauges (set-last-wins), shared by every
+subsystem so one snapshot describes a whole run:
+
+- ``transfer.*`` — host<->device traffic: payload/dispatch upload bytes
+  and the measured upload/pull walls (mesh.pull_to_host, the spill
+  payload upload, the dispatch fan-outs);
+- ``resident_cache.*`` — hits/misses of the driver's resident-payload
+  cache (the hot/cold split behind the 5-60 s cosine capture swing);
+- ``checkpoint.*`` — compact chunk flushes/saves/loads and their bytes;
+- ``faults.*`` — the supervised-dispatch accounting, field-for-field
+  the same names as :class:`dbscan_tpu.faults.FaultCounters` (which
+  stays the AUTHORITATIVE per-run figure via ``stats["faults"]``; these
+  counters are process-cumulative and exist so the trace, the stats
+  dict, and the metrics summary can be cross-checked).
+
+Callers never talk to this class directly — the ``dbscan_tpu.obs``
+module-level hooks (``obs.count`` / ``obs.gauge``) carry the single
+disabled-path truthiness check; the registry only exists while
+observability is enabled.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class MetricsRegistry:
+    """Flat dotted-name counters + gauges, lock-protected (the driver's
+    pulls and the packer callbacks can run from different threads)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict = {}
+        self._gauges: dict = {}
+
+    def count(self, name: str, value=1) -> None:
+        """Add ``value`` (int or float) to counter ``name``."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def gauge(self, name: str, value) -> None:
+        """Set gauge ``name`` to ``value`` (last write wins)."""
+        with self._lock:
+            self._gauges[name] = value
+
+    def counters(self) -> dict:
+        with self._lock:
+            return dict(self._counters)
+
+    def gauges(self) -> dict:
+        with self._lock:
+            return dict(self._gauges)
+
+    def snapshot(self) -> dict:
+        """Counter snapshot for delta accounting (gauges excluded:
+        set-last-wins values have no meaningful delta)."""
+        return self.counters()
+
+    def delta(self, snap: dict) -> dict:
+        """Per-run counter delta against a prior :meth:`snapshot`
+        (counters are monotone, so every delta is >= 0)."""
+        cur = self.counters()
+        return {k: v - snap.get(k, 0) for k, v in cur.items()}

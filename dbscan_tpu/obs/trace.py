@@ -1,0 +1,213 @@
+"""Nested wall-clock span tracing for the distributed pipeline.
+
+The reference's only observability is driver-side println taps it ships
+commented-in (DBSCAN.scala:139,202 — they collect the whole dataset to
+the driver); Spark's real story is the event-log UI. Our analog is a
+process-global span registry that the export layer (obs/export.py)
+writes as JSONL or a Chrome-trace file (chrome://tracing / Perfetto),
+built for the question VERDICT r5 could not answer: *where did the
+time go* when the same capture swings 5-60 s (resident-payload upload
+hot/cold) or a 100M leg dies mid-device-phase.
+
+Design constraints (enforced by tests/test_obs.py):
+
+- The DISABLED path is a strict no-op: ``obs.span(...)`` returns one
+  shared :data:`NOOP_SPAN` after a single truthiness check, nothing is
+  appended anywhere, no file is ever touched. Tracing must be safe to
+  leave wired through every hot call site.
+- Spans nest by thread-local stack (``depth`` is recorded at entry);
+  phases that already measure themselves (driver ``timings``) register
+  RETROACTIVE spans via :meth:`Tracer.add_span` so the trace and the
+  stats dict can never disagree about a phase's wall.
+- Optional device-sync boundaries reuse the ``DBSCAN_TIME_DEVICE=1``
+  convention (bench.py's MFU instrumentation): when enabled, a span
+  that registered device outputs via :meth:`Span.sync` blocks on them
+  at exit, so the span covers device execution instead of the async
+  dispatch. Off by default — blocking sacrifices pack/compute overlap,
+  exactly like the driver's ``banded_p1_sync_s`` instrumentation.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+
+class Span:
+    """One wall-clock span: context manager AND the finished record.
+
+    ``events`` holds (name, t, args) instants attached while the span
+    was open — the bridge carrying fault retries/degradations
+    (dbscan_tpu/faults.py) into the trace as visible marks.
+    """
+
+    __slots__ = (
+        "name", "t0", "t1", "depth", "tid", "args", "events",
+        "_tracer", "_sync",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict):
+        self.name = name
+        self.args = args
+        self.t0 = time.perf_counter()
+        self.t1 = None
+        self.depth = 0
+        self.tid = threading.get_ident()
+        self.events: list = []
+        self._tracer = tracer
+        self._sync = None
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.end()
+
+    def event(self, name: str, **args) -> None:
+        """Attach an instant event (fault retry, budget halving, cache
+        decision) to this span at the current time."""
+        self.events.append((name, time.perf_counter(), args))
+
+    def sync(self, value) -> None:
+        """Register device outputs to block on at span end — only when
+        the tracer runs with device-sync boundaries (DBSCAN_TIME_DEVICE
+        convention); a plain async span otherwise."""
+        self._sync = value
+
+    def end(self) -> None:
+        if self.t1 is not None:
+            return  # idempotent: with-block exit after an explicit end()
+        # drop the sync handle unconditionally: finished spans live in
+        # the registry, and a retained reference would pin the device
+        # buffers (the ~1 GB resident payload!) for the process lifetime
+        sync, self._sync = self._sync, None
+        if sync is not None and self._tracer.device_sync:
+            import jax
+
+            jax.block_until_ready(sync)
+        self.t1 = time.perf_counter()
+        self._tracer._finish(self)
+
+
+class _NoopSpan:
+    """The shared disabled-path span: every method a no-op, one
+    instance for the whole process (no allocation per call site)."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        return None
+
+    def event(self, name: str, **args) -> None:
+        return None
+
+    def sync(self, value) -> None:
+        return None
+
+    def end(self) -> None:
+        return None
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Process-global span registry.
+
+    Finished spans accumulate in :attr:`spans` (appended at END time —
+    the export layer orders by start time); open spans live only on the
+    per-thread stack, so an abandoned span (exception unwound past a
+    manual ``end()``) costs a stack entry, never a torn record.
+    """
+
+    def __init__(self, device_sync: bool = False):
+        self.device_sync = bool(device_sync)
+        self.spans: list = []
+        self.instants: list = []  # (name, t, args) outside any span
+        # retention bound: a long-lived traced stream (one train() per
+        # batch, forever) must not grow memory or flush cost without
+        # bound — past the cap the OLDEST half is dropped (the tail of
+        # the trace is the interesting part of a live process) and the
+        # drop is surfaced via `dropped_spans` in the export
+        self.max_spans = max(
+            1024, int(os.environ.get("DBSCAN_TRACE_MAX_SPANS", "200000"))
+        )
+        self.dropped_spans = 0
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        # time bases for export: perf_counter deltas are the durations,
+        # epoch0 anchors them to wall-clock time for cross-process reads
+        self.t0 = time.perf_counter()
+        self.epoch0 = time.time()
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+    def span(self, name: str, args: dict) -> Span:
+        sp = Span(self, name, args)
+        st = self._stack()
+        sp.depth = len(st)
+        st.append(sp)
+        return sp
+
+    def _finish(self, sp: Span) -> None:
+        st = self._stack()
+        if st and st[-1] is sp:
+            st.pop()
+        elif sp in st:  # out-of-order end (exception unwound children)
+            st.remove(sp)
+        with self._lock:
+            self.spans.append(sp)
+            self._trim_locked()
+
+    def _trim_locked(self) -> None:
+        if len(self.spans) > self.max_spans:
+            cut = len(self.spans) // 2  # amortized O(1) per append
+            self.dropped_spans += cut
+            del self.spans[:cut]
+
+    def add_span(
+        self,
+        name: str,
+        t0: float,
+        t1: float,
+        args: Optional[dict] = None,
+        events: Optional[list] = None,
+    ) -> Span:
+        """Register a RETROACTIVE span from explicit perf_counter
+        bounds — the bridge for phases that already time themselves
+        (driver ``timings``): the trace records the exact same window
+        the stats dict reports."""
+        sp = Span(self, name, args or {})
+        sp.t0 = float(t0)
+        sp.t1 = float(t1)
+        sp.depth = len(self._stack())
+        if events:
+            sp.events.extend(events)
+        with self._lock:
+            self.spans.append(sp)
+            self._trim_locked()
+        return sp
+
+    def instant(self, name: str, args: dict) -> None:
+        """A free-standing instant event: attaches to the innermost open
+        span when one exists, else to the process-level list."""
+        st = self._stack()
+        if st:
+            st[-1].event(name, **args)
+        else:
+            with self._lock:
+                self.instants.append((name, time.perf_counter(), args))
+
+    def snapshot_spans(self) -> list:
+        with self._lock:
+            return list(self.spans)

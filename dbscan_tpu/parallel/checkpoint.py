@@ -26,10 +26,13 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
 import zipfile
 from typing import Optional
 
 import numpy as np
+
+from dbscan_tpu import obs
 
 _FORMAT_VERSION = 1
 _NPZ = "premerge.npz"
@@ -99,11 +102,17 @@ def save_premerge(
     npz: rename is atomic per file, not across the npz/manifest pair, so
     a crash between the two replaces could otherwise pair one run's
     arrays with another run's manifest — the loader cross-checks."""
+    t0 = time.perf_counter()
     os.makedirs(ckpt_dir, exist_ok=True)
     npz_tmp = os.path.join(ckpt_dir, _NPZ + ".tmp")
     with open(npz_tmp, "wb") as f:
         np.savez(f, _fingerprint=np.array(fingerprint), **arrays)
     os.replace(npz_tmp, os.path.join(ckpt_dir, _NPZ))
+    obs.count(
+        "checkpoint.premerge_bytes",
+        int(sum(a.nbytes for a in arrays.values())),
+    )
+    obs.add_span("checkpoint.save_premerge", t0, time.perf_counter())
     man_tmp = os.path.join(ckpt_dir, _MANIFEST + ".tmp")
     with open(man_tmp, "w") as f:
         json.dump(
@@ -219,6 +228,7 @@ def save_p1_chunk(
     rejects chunks from a different budget OUTRIGHT (their compositions
     cannot re-form, and per-group skips followed by signature-mismatch
     redispatch would serialize the whole device phase)."""
+    t0 = time.perf_counter()
     os.makedirs(ckpt_dir, exist_ok=True)
     path = _p1_path(ckpt_dir, ci)
     tmp = path + ".tmp"
@@ -232,6 +242,14 @@ def save_p1_chunk(
             **arrays,
         )
     os.replace(tmp, path)
+    obs.count("checkpoint.chunks_saved")
+    obs.count(
+        "checkpoint.chunk_bytes",
+        int(sum(a.nbytes for a in arrays.values())),
+    )
+    obs.add_span(
+        "checkpoint.save_p1_chunk", t0, time.perf_counter(), chunk=int(ci)
+    )
 
 
 def load_p1_chunks(
@@ -268,6 +286,8 @@ def load_p1_chunks(
         except (OSError, ValueError, KeyError, zipfile.BadZipFile):
             break
         ci += 1
+    if out:
+        obs.count("checkpoint.chunks_loaded", len(out))
     return out
 
 

@@ -9,11 +9,14 @@ Multi-host (DCN) extends the same mesh via jax.distributed initialization.
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from dbscan_tpu import obs
 
 PARTS_AXIS = "parts"
 
@@ -70,13 +73,27 @@ def pull_to_host(x) -> np.ndarray:
     merge) run replicated on every process, which keeps them
     deterministic and identical to the single-process result.
     """
-    if isinstance(x, np.ndarray) or not multiprocess():
-        return np.asarray(x)
-    if getattr(x, "is_fully_addressable", True):
-        return np.asarray(x)
-    from jax.experimental import multihost_utils
+    if isinstance(x, np.ndarray):
+        return x  # already host-side: no transfer to account
+    st = obs.state()
+    t0 = time.perf_counter() if st is not None else 0.0
+    if not multiprocess() or getattr(x, "is_fully_addressable", True):
+        arr = np.asarray(x)
+    else:
+        from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        arr = np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    if st is not None:
+        # the measured wall includes any device wait np.asarray blocked
+        # on (async dispatch retires here), not pure link time — that
+        # is exactly the "pull" cost the driver's timings charge too
+        t1 = time.perf_counter()
+        st.metrics.count("transfer.d2h_bytes", int(arr.nbytes))
+        st.metrics.count("transfer.d2h_s", t1 - t0)
+        st.tracer.add_span(
+            "transfer.pull", t0, t1, {"bytes": int(arr.nbytes)}
+        )
+    return arr
 
 
 def make_mesh(devices: Optional[Sequence] = None) -> Mesh:

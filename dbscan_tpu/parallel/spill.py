@@ -63,7 +63,7 @@ from typing import Tuple
 
 import numpy as np
 
-from dbscan_tpu import faults
+from dbscan_tpu import faults, obs
 
 logger = logging.getLogger(__name__)
 
@@ -807,14 +807,17 @@ def spill_partition(
                         if s_local is not None
                         else None
                     )
-                    piv = faults.supervised(
-                        faults.SITE_SPILL,
-                        lambda _b: sdev.pivot_vectors_device(
-                            dev_s if dev_s is not None else dev_sub,
-                            m, halo, rng,
-                        ),
-                        label="pivots",
-                    )
+                    with obs.span(
+                        "spill.pivots", node=int(len(idx)), m=int(m)
+                    ):
+                        piv = faults.supervised(
+                            faults.SITE_SPILL,
+                            lambda _b: sdev.pivot_vectors_device(
+                                dev_s if dev_s is not None else dev_sub,
+                                m, halo, rng,
+                            ),
+                            label="pivots",
+                        )
                 except Exception as e:  # noqa: BLE001 — degrade to host
                     logger.warning("spill: device pivots failed (%s)", e)
                     faults.note_degrade()
@@ -870,13 +873,16 @@ def spill_partition(
             if sub_s is not None or dev_s is not None:
                 if dev_s is not None:
                     try:
-                        screen_dup, screen_m = faults.supervised(
-                            faults.SITE_SPILL,
-                            lambda _b: sdev.screen_dup_device(
-                                dev_s, piv, halo
-                            ),
-                            label="screen",
-                        )
+                        with obs.span(
+                            "spill.screen", node=int(len(idx))
+                        ):
+                            screen_dup, screen_m = faults.supervised(
+                                faults.SITE_SPILL,
+                                lambda _b: sdev.screen_dup_device(
+                                    dev_s, piv, halo
+                                ),
+                                label="screen",
+                            )
                     except Exception as e:  # noqa: BLE001
                         logger.warning(
                             "spill: device screen failed (%s); host", e
@@ -916,13 +922,16 @@ def spill_partition(
             # caller's slack inside `halo`
             if dev_sub is not None:
                 try:
-                    assign, member = faults.supervised(
-                        faults.SITE_SPILL,
-                        lambda _b: sdev.membership_device(
-                            dev_sub, piv, halo
-                        ),
-                        label="membership",
-                    )
+                    with obs.span(
+                        "spill.membership", node=int(len(idx))
+                    ):
+                        assign, member = faults.supervised(
+                            faults.SITE_SPILL,
+                            lambda _b: sdev.membership_device(
+                                dev_sub, piv, halo
+                            ),
+                            label="membership",
+                        )
                 except Exception as e:  # noqa: BLE001
                     logger.warning(
                         "spill: device membership failed (%s); host", e
@@ -971,13 +980,16 @@ def spill_partition(
                 )
             elif dev_sub is not None:
                 try:
-                    pc = faults.supervised(
-                        faults.SITE_SPILL,
-                        lambda _b: sdev.leader_components_device(
-                            dev_sub, halo, rng, _LEADER_EDGE_BUDGET
-                        ),
-                        label="leader-cover",
-                    )
+                    with obs.span(
+                        "spill.leader_cover", node=int(len(idx))
+                    ):
+                        pc = faults.supervised(
+                            faults.SITE_SPILL,
+                            lambda _b: sdev.leader_components_device(
+                                dev_sub, halo, rng, _LEADER_EDGE_BUDGET
+                            ),
+                            label="leader-cover",
+                        )
                 except Exception as e:  # noqa: BLE001
                     logger.warning(
                         "spill: device leader cover failed (%s); host", e

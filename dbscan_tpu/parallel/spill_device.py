@@ -29,10 +29,11 @@ this is the high-dimensional counterpart's hot path moved to the chip.
 from __future__ import annotations
 
 import functools
+import time
 
 import numpy as np
 
-from dbscan_tpu import faults
+from dbscan_tpu import faults, obs
 
 # chord-error bound for bf16-stored unit rows: |dot error| <= 2*2^-9
 # (+f32 accumulation, negligible at D<=4096); chord = sqrt(2-2dot) moves
@@ -64,27 +65,44 @@ class DeviceNodeOps:
         # supervised upload: the bf16 payload is the biggest single
         # transfer of the cosine route (~1 GB at 1M x 512 over the
         # tunnel) and exactly where a flaky link faults — retry with
-        # backoff before the caller degrades the run to host BLAS
-        x_dev = faults.supervised(
-            faults.SITE_SPILL,
-            lambda _b: jnp.asarray(xb),
-            label="payload-upload",
-        )
+        # backoff before the caller degrades the run to host BLAS.
+        # The span/counters below are what lets bench.py split a timed
+        # rep's upload_s from its compute_s (hot vs cold resident cache)
+        t0 = time.perf_counter()
+        with obs.span(
+            "spill.payload_upload", bytes=int(xb.nbytes), rows=int(len(xb))
+        ) as sp:
+            x_dev = faults.supervised(
+                faults.SITE_SPILL,
+                lambda _b: jnp.asarray(xb),
+                label="payload-upload",
+            )
+            sp.sync(x_dev)
+        # counted AFTER the span closes so a device-sync boundary
+        # (DBSCAN_TIME_DEVICE=1) folds the blocking wait into upload_s
+        obs.count("transfer.h2d_bytes", int(xb.nbytes))
+        obs.count("transfer.payload_upload_bytes", int(xb.nbytes))
+        obs.timed_count("transfer.payload_upload_s", t0)
         return cls(x_dev, x_host.shape[0], x_host.shape[1])
 
     def take(self, idx: np.ndarray) -> "DeviceNodeOps":
         import jax.numpy as jnp
 
-        idx32 = jnp.asarray(np.asarray(idx, np.int32))
-        return DeviceNodeOps(
-            faults.supervised(
-                faults.SITE_SPILL,
-                lambda _b: _gather_fn()(self.x, idx32),
-                label="child-gather",
-            ),
-            len(idx),
-            self.dim,
-        )
+        idx_np = np.asarray(idx, np.int32)
+        # the child's upload is the index vector, not its rows —
+        # exactly the transfer saving the resident design buys
+        obs.count("transfer.h2d_bytes", int(idx_np.nbytes))
+        idx32 = jnp.asarray(idx_np)
+        with obs.span("spill.child_gather", rows=int(len(idx))):
+            return DeviceNodeOps(
+                faults.supervised(
+                    faults.SITE_SPILL,
+                    lambda _b: _gather_fn()(self.x, idx32),
+                    label="child-gather",
+                ),
+                len(idx),
+                self.dim,
+            )
 
 
 @functools.lru_cache(maxsize=1)

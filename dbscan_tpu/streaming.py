@@ -33,7 +33,7 @@ from typing import Deque, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from dbscan_tpu import faults
+from dbscan_tpu import faults, obs
 from dbscan_tpu.config import DBSCANConfig, Engine, Precision
 from dbscan_tpu.ops.labels import CORE
 from dbscan_tpu.parallel.driver import _cpu_fallback_allowed, train_arrays
@@ -230,21 +230,30 @@ class StreamingDBSCAN:
         # re-runs the batch pinned to the host backend — stream
         # identities survive a dead device instead of dying with it.
         fault_snap = faults.counters.snapshot()
-        out = faults.supervised(
-            faults.SITE_STREAM,
-            lambda _b: train_arrays(combined, self.config, mesh=self.mesh),
-            policy=faults.RetryPolicy.from_config(self.config),
-            # same gate as the driver's per-group degradation: in a
-            # multi-process job one host re-running the batch on CPU
-            # while the others issue mesh collectives would desync the
-            # collective sequence — forced off there
-            fallback=(
-                self._cpu_update_fallback(combined)
-                if _cpu_fallback_allowed(self.config)
-                else None
-            ),
-            label=f"update {self._n_updates}",
-        )
+        obs.ensure_env()
+        with obs.span(
+            "stream.update",
+            update=int(self._n_updates),
+            batch=int(len(batch)),
+            window_points=int(len(wpts)),
+        ):
+            out = faults.supervised(
+                faults.SITE_STREAM,
+                lambda _b: train_arrays(
+                    combined, self.config, mesh=self.mesh
+                ),
+                policy=faults.RetryPolicy.from_config(self.config),
+                # same gate as the driver's per-group degradation: in a
+                # multi-process job one host re-running the batch on CPU
+                # while the others issue mesh collectives would desync
+                # the collective sequence — forced off there
+                fallback=(
+                    self._cpu_update_fallback(combined)
+                    if _cpu_fallback_allowed(self.config)
+                    else None
+                ),
+                label=f"update {self._n_updates}",
+            )
 
         b = len(batch)
         batch_cl = out.clusters[:b]
@@ -319,6 +328,10 @@ class StreamingDBSCAN:
             # misses batch-level retries/degradations this wrapper took
             faults=faults.counters.delta(fault_snap),
         )
+        # the inner train_arrays flushed BEFORE this update's outer span
+        # closed; re-flush so the trace file always contains the last
+        # complete stream.update span
+        obs.flush()
         return StreamUpdate(
             clusters=stream_cl,
             flags=batch_fl,

@@ -1,0 +1,468 @@
+"""Observability subsystem (dbscan_tpu/obs/): spans, counters, export.
+
+Design constraints pinned here (obs/__init__.py module contract):
+
+- the DISABLED path is a strict no-op — one truthiness check per call
+  site, the shared NOOP_SPAN, no registry growth, no file ever touched
+  — plus an overhead guard comparing a small train() against a build
+  whose tracing hooks are monkeypatched away entirely;
+- spans nest by thread-local stack and the Chrome-trace export is
+  valid Perfetto-loadable JSON (ph/ts/dur fields, microsecond times);
+- the fault-accounting bridge: under the deterministic injection suite
+  (``DBSCAN_FAULT_SPEC``), the obs ``faults.*`` counter delta equals
+  ``stats["faults"]`` field-for-field and the trace carries the retry
+  events — the three views (stats, timings, trace) can never disagree,
+  with stats["faults"] the documented authoritative per-run figure.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import Engine, faults, obs, train
+from dbscan_tpu.obs.trace import NOOP_SPAN
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs(monkeypatch):
+    """Every test starts (and leaves) the process with observability
+    disabled and no trace env, so state never leaks across tests."""
+    monkeypatch.delenv("DBSCAN_TRACE", raising=False)
+    obs.disable()
+    yield
+    obs.disable()
+
+
+def _blobs(n_per=300):
+    rng = np.random.default_rng(0)
+    centers = [(0, 0), (8, 8), (-7, 9), (9, -8)]
+    pts = np.concatenate(
+        [rng.normal(c, 0.4, (n_per, 2)) for c in centers]
+    )
+    rng.shuffle(pts)
+    return pts
+
+
+KW = dict(
+    eps=0.5, min_points=5, max_points_per_partition=256,
+    engine=Engine.ARCHERY,
+)
+
+
+# --- disabled path is a strict no-op ----------------------------------
+
+
+def test_disabled_hooks_are_noops(tmp_path):
+    assert obs.state() is None and not obs.active()
+    sp = obs.span("anything", a=1)
+    assert sp is NOOP_SPAN
+    # the shared span swallows the whole protocol without allocating
+    with sp as s:
+        s.event("x", k=2)
+        s.sync(object())
+    sp.end()
+    assert obs.add_span("x", 0.0, 1.0) is None
+    obs.event("x", a=1)
+    obs.count("c", 5)
+    obs.gauge("g", 7)
+    obs.timed_count("t", time.perf_counter())
+    assert obs.counters() == {}
+    assert obs.counters_delta({}) == {}
+    assert obs.flush() is None
+    assert obs.write(str(tmp_path / "never.json")) is None
+    assert not list(tmp_path.iterdir())  # no file was ever touched
+    assert obs.state() is None  # and no registry ever materialized
+    summ = obs.summary()
+    assert summ == {
+        "enabled": False, "spans": [], "counters": {}, "gauges": {}
+    }
+
+
+def test_disabled_train_leaves_no_state(tmp_path):
+    """A full pipeline run with observability off must not create the
+    registry, and DBSCAN_TRACE unset must not create any file."""
+    train(_blobs(100), **KW)
+    assert obs.state() is None
+    assert not list(tmp_path.iterdir())
+
+
+def test_ensure_env_activates_only_when_set(monkeypatch, tmp_path):
+    obs.ensure_env()
+    assert obs.state() is None
+    path = str(tmp_path / "t.json")
+    monkeypatch.setenv("DBSCAN_TRACE", path)
+    obs.ensure_env()
+    st = obs.state()
+    assert st is not None and st.trace_path == path
+
+
+# --- span mechanics ---------------------------------------------------
+
+
+def test_span_nesting_depth_and_finish_order():
+    obs.enable()
+    with obs.span("outer", level=0) as outer:
+        with obs.span("inner") as inner:
+            obs.event("mark", k=1)
+        with obs.span("inner2"):
+            pass
+    spans = obs.state().tracer.snapshot_spans()
+    by_name = {s.name: s for s in spans}
+    assert by_name["outer"].depth == 0
+    assert by_name["inner"].depth == 1
+    assert by_name["inner2"].depth == 1
+    # registry appends at END time: children land before their parent
+    assert [s.name for s in spans] == ["inner", "inner2", "outer"]
+    assert by_name["inner"].t0 >= by_name["outer"].t0
+    assert by_name["outer"].t1 >= by_name["inner2"].t1
+    # the instant attached to the innermost open span at event time
+    assert [e[0] for e in by_name["inner"].events] == ["mark"]
+    assert inner is by_name["inner"] and outer is by_name["outer"]
+
+
+def test_span_end_idempotent_and_retroactive_spans():
+    obs.enable()
+    sp = obs.span("s")
+    sp.end()
+    t1 = sp.t1
+    sp.end()  # second end must not move the boundary or re-register
+    assert sp.t1 == t1
+    assert len(obs.state().tracer.snapshot_spans()) == 1
+    r = obs.add_span("retro", 1.0, 2.5, phase="merge")
+    assert r.t0 == 1.0 and r.t1 == 2.5 and r.args == {"phase": "merge"}
+
+
+def test_span_end_releases_sync_handle():
+    """The sync handle must be dropped at end() even WITHOUT device-sync
+    boundaries: finished spans live in the registry for the process
+    lifetime, and a retained reference would pin the device buffers
+    (the ~1 GB resident payload) against reclamation."""
+    obs.enable()
+    assert obs.state().tracer.device_sync is False
+    payload = object()
+    with obs.span("s") as sp:
+        sp.sync(payload)
+    assert sp._sync is None
+
+
+def test_span_retention_bound(monkeypatch):
+    """Past DBSCAN_TRACE_MAX_SPANS the oldest half is dropped and the
+    drop is reported in the export — a long-lived traced stream must
+    not grow memory or flush cost without bound."""
+    from dbscan_tpu.obs import export
+
+    obs.enable()
+    tracer = obs.state().tracer
+    tracer.max_spans = 1024  # floor enforced by Tracer.__init__
+    for i in range(1024 + 1):
+        obs.add_span(f"s{i}", float(i), float(i) + 0.5)
+    assert len(tracer.spans) <= 1024
+    assert tracer.dropped_spans > 0
+    # the TAIL survives (the interesting part of a live process)
+    assert tracer.spans[-1].name == "s1024"
+    trace = export.chrome_trace(tracer)
+    assert trace["otherData"]["dropped_spans"] == tracer.dropped_spans
+    recs = list(export.jsonl_records(tracer))
+    assert recs[-1] == {
+        "type": "dropped_spans", "value": tracer.dropped_spans
+    }
+
+
+def test_process_level_instants_outside_spans():
+    obs.enable()
+    obs.event("free", a=1)
+    assert [i[0] for i in obs.state().tracer.instants] == ["free"]
+
+
+def test_counters_and_delta():
+    obs.enable()
+    obs.count("a")
+    obs.count("a", 2)
+    obs.count("b", 0.5)
+    obs.gauge("g", 42)
+    snap = obs.counters()
+    assert snap == {"a": 3, "b": 0.5}
+    obs.count("a", 4)
+    assert obs.counters_delta(snap) == {"a": 4, "b": 0.0}
+    assert obs.summary()["gauges"] == {"g": 42}
+
+
+def test_enable_idempotent_adopts_trace_path(tmp_path):
+    st = obs.enable()
+    obs.count("k")
+    path = str(tmp_path / "late.json")
+    st2 = obs.enable(trace_path=path)
+    assert st2 is st and st.trace_path == path
+    assert obs.counters() == {"k": 1}  # registries survived the re-enable
+
+
+# --- export -----------------------------------------------------------
+
+
+def test_chrome_trace_is_valid_perfetto_json(tmp_path):
+    path = str(tmp_path / "trace.json")
+    obs.enable(trace_path=path)
+    with obs.span("parent", n=3):
+        with obs.span("child"):
+            obs.event("retry", attempt=1)
+    obs.count("transfer.h2d_bytes", 1024)
+    out = obs.flush()
+    assert out == path
+    with open(path) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    assert isinstance(evs, list) and evs
+    for e in evs:
+        assert e["ph"] in ("X", "i", "C")
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert "name" in e and "pid" in e
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"parent", "child"}
+    for e in xs:
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+    # events are start-time ordered: parent precedes child
+    assert [e["name"] for e in xs] == ["parent", "child"]
+    instants = [e for e in evs if e["ph"] == "i"]
+    assert instants and instants[0]["name"] == "retry"
+    counters = [e for e in evs if e["ph"] == "C"]
+    assert {c["name"] for c in counters} == {"transfer.h2d_bytes"}
+    assert counters[0]["args"]["value"] == 1024
+
+
+def test_jsonl_export(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable(trace_path=path)
+    with obs.span("a"):
+        pass
+    obs.count("c", 2)
+    obs.flush()
+    with open(path) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    kinds = {r["type"] for r in records}
+    assert kinds == {"span", "counter"}
+    span_rec = next(r for r in records if r["type"] == "span")
+    assert span_rec["name"] == "a" and span_rec["dur_s"] >= 0
+
+
+def test_trace_args_coerce_numpy(tmp_path):
+    path = str(tmp_path / "np.json")
+    obs.enable(trace_path=path)
+    with obs.span(
+        "np", n=np.int64(7), f=np.float32(0.5), shape=(np.int32(2), 3)
+    ):
+        pass
+    obs.flush()
+    with open(path) as f:
+        trace = json.load(f)  # must not raise on numpy scalars
+    args = trace["traceEvents"][0]["args"]
+    assert args["n"] == 7 and args["shape"] == [2, 3]
+
+
+# --- pipeline integration ---------------------------------------------
+
+
+def test_small_train_writes_trace_via_env(monkeypatch, tmp_path):
+    """DBSCAN_TRACE=path on a real train(): the file exists, loads as a
+    Chrome trace, and carries the driver phase spans, the dispatch
+    spans, and the root `train` span — the stats timings and the trace
+    describe the same run."""
+    path = str(tmp_path / "run.json")
+    monkeypatch.setenv("DBSCAN_TRACE", path)
+    out = train(_blobs(), **KW)
+    assert os.path.exists(path)
+    with open(path) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert "train" in names
+    assert "driver.histogram" in names
+    assert names & {"dispatch.banded", "dispatch.dense", "dispatch.resident"}
+    # the phase spans carry the timings key they mirror
+    hist = next(e for e in evs if e["name"] == "driver.histogram")
+    assert hist["args"]["timings_key"] == "histogram_s"
+    assert "histogram_s" in out.stats["timings"]
+    # transfer accounting saw the dispatch uploads and the label pulls
+    counters = {e["name"]: e["args"]["value"] for e in evs if e["ph"] == "C"}
+    assert counters.get("transfer.h2d_bytes", 0) > 0
+    assert counters.get("transfer.d2h_bytes", 0) > 0
+
+
+def test_streaming_update_span(monkeypatch, tmp_path):
+    from dbscan_tpu import StreamingDBSCAN
+
+    path = str(tmp_path / "stream.json")
+    monkeypatch.setenv("DBSCAN_TRACE", path)
+    s = StreamingDBSCAN(eps=0.5, min_points=5, max_points_per_partition=128)
+    s.update(_blobs(60))
+    s.update(_blobs(60))
+    with open(path) as f:
+        trace = json.load(f)
+    ups = [
+        e for e in trace["traceEvents"] if e["name"] == "stream.update"
+    ]
+    assert len(ups) == 2
+    assert [e["args"]["update"] for e in ups] == [1, 2]
+
+
+# --- fault-accounting bridge (the consistency satellite) --------------
+
+
+@pytest.mark.faults
+def test_fault_counters_agree_with_stats(monkeypatch):
+    """Under injected faults the obs counter delta, stats['faults'],
+    and the trace's retry events all describe the same run —
+    stats['faults'] being the authoritative per-run figure."""
+    monkeypatch.setenv("DBSCAN_FAULT_BACKOFF_S", "0")
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "dispatch#0:TRANSIENT*2")
+    faults.reset_registry()
+    obs.enable()
+    snap = obs.counters()
+    pts = _blobs()
+    out = train(pts, neighbor_backend="dense", **KW)
+    delta = obs.counters_delta(snap)
+    fa = out.stats["faults"]
+    assert fa["retries"] == 2 and fa["injected"] == 2
+    for field in (
+        "attempts", "retries", "fallbacks", "budget_halvings", "injected"
+    ):
+        assert delta.get(f"faults.{field}", 0) == fa[field], field
+    assert abs(delta.get("faults.backoff_s", 0.0) - fa["backoff_s"]) < 1e-9
+    # timings mirrors the authoritative backoff figure exactly
+    assert out.stats["timings"]["fault_backoff_s"] == fa["backoff_s"]
+    # the retry events rode the trace (attached to the dispatch span)
+    retries = [
+        e
+        for sp in obs.state().tracer.snapshot_spans()
+        for e in sp.events
+        if e[0] == "fault.retry"
+    ] + [
+        i for i in obs.state().tracer.instants if i[0] == "fault.retry"
+    ]
+    assert len(retries) == 2
+    assert all(e[2]["site"] == "dispatch" for e in retries)
+    # and the per-run delta instant matches stats["faults"]
+    run_deltas = [
+        i for i in obs.state().tracer.instants if i[0] == "faults.run_delta"
+    ]
+    assert run_deltas and run_deltas[-1][2] == fa
+    faults.reset_registry()
+
+
+@pytest.mark.faults
+def test_fallback_event_present(monkeypatch):
+    monkeypatch.setenv("DBSCAN_FAULT_BACKOFF_S", "0")
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "dispatch#0:PERSISTENT")
+    faults.reset_registry()
+    obs.enable()
+    snap = obs.counters()
+    out = train(_blobs(), neighbor_backend="dense", **KW)
+    delta = obs.counters_delta(snap)
+    assert out.stats["faults"]["fallbacks"] == 1
+    assert delta.get("faults.fallbacks", 0) == 1
+    evs = [
+        e
+        for sp in obs.state().tracer.snapshot_spans()
+        for e in sp.events
+        if e[0] == "fault.fallback"
+    ]
+    assert len(evs) == 1 and evs[0][2]["site"] == "dispatch"
+    faults.reset_registry()
+
+
+# --- bench integration -------------------------------------------------
+
+
+def test_bench_rep_fields_split_upload_from_compute():
+    import bench
+
+    pts = _blobs(150)
+    model, dt, rep_obs = bench.run_train(
+        pts, 256, reps=1, eps=0.5, min_points=5
+    )
+    assert model is not None and dt > 0
+    assert rep_obs["upload_s"] >= 0.0
+    assert rep_obs["compute_s"] >= 0.0
+    # fields are rounded to 1 ms, so allow that much slack
+    assert rep_obs["upload_s"] + rep_obs["compute_s"] <= dt + 2e-3
+    # euclidean never touches the resident cache: no hot/cold tag
+    assert "resident_hot" not in rep_obs
+
+
+def test_bench_rep_fields_tag_resident_cache(monkeypatch):
+    """Cosine resident mode: a cold rep (miss) then a hot rep (hit) —
+    the tag bench.py stamps on every timed rep."""
+    import bench
+
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "1")  # resident on CPU
+    rng = np.random.default_rng(1)
+    pts = rng.normal(size=(600, 16)).astype(np.float32)
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+    obs.enable()
+    kw = dict(
+        eps=0.05, min_points=4, max_points_per_partition=128,
+        metric="cosine",
+    )
+    snap = obs.counters()
+    t0 = time.perf_counter()
+    train(pts, **kw)  # cold: builds + caches the resident payload
+    cold = bench._rep_obs_fields(
+        obs.counters_delta(snap), time.perf_counter() - t0
+    )
+    snap = obs.counters()
+    t0 = time.perf_counter()
+    train(pts, **kw)  # hot: identity + checksum hit
+    hot = bench._rep_obs_fields(
+        obs.counters_delta(snap), time.perf_counter() - t0
+    )
+    assert cold["resident_hot"] is False
+    assert cold["upload_bytes"] > 0
+    assert hot["resident_hot"] is True
+    assert hot["upload_bytes"] == 0 and hot["upload_s"] == 0.0
+
+
+# --- overhead guard ---------------------------------------------------
+
+
+def _min_wall(fn, reps=5):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def test_disabled_overhead_under_5pct(monkeypatch):
+    """The disabled path must add <5% wall to a small train() versus a
+    build with the tracing code absent (every module-level hook
+    monkeypatched to a bare no-op). Min-of-reps on a warmed pipeline:
+    the disabled hooks are single truthiness checks, so anything past
+    noise indicates a hook doing real work while disabled."""
+    pts = _blobs(150)
+
+    def run():
+        train(pts, **KW)
+
+    run()  # warm the jit caches so neither side pays compilation
+    assert obs.state() is None
+    with_hooks = _min_wall(run)
+    noop = lambda *a, **k: None  # noqa: E731
+    for name in (
+        "add_span", "event", "count", "gauge", "timed_count",
+        "ensure_env", "flush",
+    ):
+        monkeypatch.setattr(obs, name, noop)
+    # span stubs must still satisfy the with-statement protocol; the
+    # shared NOOP_SPAN is exactly the allocation-free stand-in
+    monkeypatch.setattr(obs, "span", lambda *a, **k: obs.NOOP_SPAN)
+    monkeypatch.setattr(obs, "state", lambda: None)
+    without_hooks = _min_wall(run)
+    assert with_hooks <= without_hooks * 1.05 + 0.010, (
+        f"disabled-path overhead: {with_hooks:.4f}s vs "
+        f"{without_hooks:.4f}s hook-free"
+    )
