@@ -1098,12 +1098,65 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — recorded, not swallowed
             sys.stderr.write(f"bench: m100 row failed: {e}\n")
             out["m100_failed"] = f"{type(e).__name__}: {e}"[:200]
+    # BENCH_HISTORY=path: gate this capture against the PRIOR history,
+    # then append it only when green (dbscan_tpu/obs/bench_history.py +
+    # obs/regress.py — same ingest/gate the root BENCH_*.json files go
+    # through). Gate-before-append matters twice over: appending first
+    # would put the fresh numbers inside their own baseline (diluting
+    # the median), and a regressed capture, once ingested, widens the
+    # history's spread until the noise-aware threshold covers the
+    # regression for every later run. A flagged capture stays on stdout
+    # as usual — ingest it manually after investigation
+    # (`python -m dbscan_tpu.obs.bench_history <file>`).
+    # Best-effort: a history IO failure must never cost the capture.
+    hist_path = os.environ.get("BENCH_HISTORY")
+    if hist_path:
+        try:
+            _history_gate_append(out, hist_path)
+        except Exception as e:  # noqa: BLE001 — recorded, not fatal
+            sys.stderr.write(f"bench: history append failed: {e}\n")
     # full record FIRST, compact summary line LAST: the driver captures a
     # bounded tail window, and r4's attribution fields pushed the single
     # JSON line past it (BENCH_r04.json "parsed": null) — the machine-
     # readable headline must be the final thing on stdout
     print(json.dumps(out))
     print(json.dumps(_compact_summary(out)))
+
+
+def _history_gate_append(out: dict, hist_path: str) -> bool:
+    """Gate one capture against the PRIOR bench history and append its
+    normalized records only when green; returns True when appended.
+    Gate-before-append is load-bearing: appending first would put the
+    fresh numbers inside their own baseline (diluting the median), and
+    a regressed capture, once ingested, widens the history's spread
+    until the noise-aware threshold covers the regression for every
+    later run. A flagged capture stays on stdout as usual — ingest it
+    manually after investigation
+    (`python -m dbscan_tpu.obs.bench_history <file>`)."""
+    from dbscan_tpu.obs import bench_history
+    from dbscan_tpu.obs import regress as obs_regress
+
+    records = bench_history.normalize_capture(
+        out, f"bench_live_{int(time.time())}", bench_history.git_rev()
+    )
+    verdict = obs_regress.compare(
+        records, bench_history.load_history(hist_path)
+    )
+    if verdict["regressions"]:
+        for e in verdict["regressions"]:
+            sys.stderr.write(
+                f"bench: {obs_regress.format_regression(e)}\n"
+            )
+        sys.stderr.write(
+            f"bench: capture NOT appended to {hist_path} "
+            "(regression gate failed)\n"
+        )
+        return False
+    added, _ = bench_history.append_records(records, hist_path)
+    sys.stderr.write(
+        f"bench: {added} record(s) appended to {hist_path}\n"
+    )
+    return True
 
 
 _COMPACT_SUFFIXES = (

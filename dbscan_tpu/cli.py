@@ -122,11 +122,38 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     log = logging.getLogger("dbscan_tpu.cli")
 
-    if args.trace or args.metrics_summary:
+    # observability enable/disable is exception-safe: whatever the body
+    # raises, the finally block flushes the trace captured SO FAR (a
+    # partial trace of a crashed run is exactly when you want one) and
+    # disables — but only a state WE created, so an in-process caller
+    # (test harness, notebook) that enabled obs first keeps its live
+    # registry (the no-clobber contract in obs/__init__.py).
+    obs_on = bool(args.trace or args.metrics_summary)
+    was_active = False
+    if obs_on:
         from dbscan_tpu import obs
 
+        # if a harness already enabled obs, this call only adopts the
+        # --trace path — and the finally block must then leave the
+        # harness's registries alive (we disable only what WE enabled)
+        was_active = obs.active()
         obs.enable(trace_path=args.trace)
+    try:
+        return _run(args, log)
+    finally:
+        if obs_on:
+            from dbscan_tpu import obs
 
+            try:
+                written = obs.flush()
+                if written:
+                    log.info("trace written to %s", written)
+            finally:
+                if not was_active:
+                    obs.disable()
+
+
+def _run(args, log) -> int:
     points = io_mod.load_points(args.input, args.input_format, args.delimiter)
     log.info("loaded %d points (%d columns) from %s", len(points), points.shape[1], args.input)
 
@@ -182,23 +209,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     # observability summary (dbscan_tpu/obs): where the run's wall went
     # — the span/counter analog of the fault block above, printed as
-    # text next to it (the machine-readable record stays the trace file)
-    if args.trace or args.metrics_summary:
+    # text next to it (the machine-readable record stays the trace
+    # file, which main()'s finally block flushes even on error)
+    if args.metrics_summary:
         from dbscan_tpu import obs
 
-        if args.trace:
-            written = obs.flush()
-            log.info("trace written to %s", written)
-        if args.metrics_summary:
-            summ = obs.summary(top=10)
-            print("== metrics summary ==")
-            print("top spans (total_s x count):")
-            for name, cnt, total in summ["spans"]:
-                print(f"  {name:<28} {total:>10.3f}s x {cnt}")
-            print("counters:")
-            for name, value in sorted(summ["counters"].items()):
-                if isinstance(value, float):
-                    value = round(value, 6)
+        summ = obs.summary(top=10)
+        print("== metrics summary ==")
+        print("top spans (total_s x count):")
+        for name, cnt, total in summ["spans"]:
+            print(f"  {name:<28} {total:>10.3f}s x {cnt}")
+        print("counters:")
+        for name, value in sorted(summ["counters"].items()):
+            if isinstance(value, float):
+                value = round(value, 6)
+            print(f"  {name:<28} {value}")
+        gauges = summ.get("gauges") or {}
+        if gauges:
+            print("gauges:")
+            for name, value in sorted(gauges.items()):
                 print(f"  {name:<28} {value}")
 
     if args.output:

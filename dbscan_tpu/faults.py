@@ -55,6 +55,7 @@ from typing import Callable, Optional, Tuple
 import numpy as np
 
 from dbscan_tpu import obs
+from dbscan_tpu.obs import memory as _obs_memory
 
 logger = logging.getLogger(__name__)
 
@@ -436,11 +437,23 @@ def supervised(
                 budget = max(1, budget // 2)
                 counters.budget_halvings += 1
                 obs.count("faults.budget_halvings")
+                # record the HBM occupancy that (presumably) triggered
+                # the exhaustion: until now the halving was blind — a
+                # capture could not say whether the chip was really at
+                # its limit or the fault was fragmentation/transients.
+                # None (and omitted) when obs is off or the backend has
+                # no allocator stats.
+                hbm = _obs_memory.sample("fault.resource_exhausted")
                 obs.event(
                     "fault.budget_halved",
                     site=site,
                     ordinal=ordinal,
                     budget=budget,
+                    **(
+                        {"hbm_bytes_in_use": int(hbm)}
+                        if hbm is not None
+                        else {}
+                    ),
                 )
                 logger.warning(
                     "%s: RESOURCE_EXHAUSTED — halving batch budget to "

@@ -109,9 +109,16 @@ def enable(
     ``device_sync``: spans that registered device outputs block on them
     at exit (defaults to the ``DBSCAN_TIME_DEVICE=1`` convention).
 
-    Re-enabling an already-live state only ADOPTS a trace path it did
-    not have — the registries persist, so a harness's in-memory enable
-    and a later env activation share one timeline."""
+    IDEMPOTENCE / RESET SEMANTICS (the contract cli.py and the
+    harnesses rely on): re-enabling an already-live state is a no-op
+    that only ADOPTS a trace path it did not have — the registries
+    persist, so a harness's in-memory enable and a later env activation
+    share one timeline. The ONLY reset is an explicit
+    :func:`disable` followed by :func:`enable`: that starts a fresh
+    timeline (new tracer time base, empty counter/gauge registries,
+    no trace path). Nothing resets implicitly — nested enables from a
+    CLI flag, an env activation, and a test harness can interleave in
+    any order without clobbering each other's spans."""
     global _state
     with _lock:
         if _state is None:
@@ -128,7 +135,10 @@ def enable(
 
 
 def disable() -> None:
-    """Drop the state WITHOUT writing (tests; symmetric with enable)."""
+    """Drop the state WITHOUT writing (symmetric with enable; callers
+    that want the trace must :func:`flush` first — cli.py's finally
+    block does exactly that). A later :func:`enable` starts a FRESH
+    timeline: disable+enable is the documented reset."""
     global _state
     with _lock:
         _state = None

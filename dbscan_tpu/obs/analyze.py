@@ -1,0 +1,405 @@
+"""Offline trace analyzer: per-phase rollups, critical-path (self-time)
+attribution, transfer-bandwidth tables, hot/cold resident-cache splits,
+and memory watermarks — from a PR-2 trace file alone.
+
+`obs/export.py` writes two formats (Chrome-trace JSON and JSONL) and
+until now nothing in the repo CONSUMED them: answering "where did the
+time go" meant loading the file into Perfetto by hand, and questions
+Perfetto cannot answer from our schema (self-time per span name across
+the run, upload bandwidth, hot-vs-cold `train` walls) went unanswered.
+This module reads either format back and prints the rollups the VERDICT
+rounds kept asking for::
+
+    python -m dbscan_tpu.obs.analyze trace.json [--top N] [--json]
+
+Self-time model: spans are nested intervals per thread (the tracer's
+thread-local stack guarantees proper nesting for live spans;
+retroactive `driver.*` bridges enclose the dispatch spans emitted
+inside their window). A span's self time is its wall minus the wall of
+spans nested strictly inside it on the same thread — the quantity that
+makes "cellcc_s is 70% of the run" actionable by splitting the pull
+wait from the host algebra. A span that OVERLAPS but is not contained
+(possible only for hand-built traces; the tracer never emits one)
+charges its full wall to the span it starts inside.
+
+Programmatic API: :func:`load_trace` -> :func:`analyze` -> report dict
+(exact numbers, test surface) -> :func:`render` -> text.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+_RESIDENT_MARKS = ("resident_cache.hit", "resident_cache.miss")
+
+
+def load_trace(path: str) -> dict:
+    """Read a trace file (format by content, not extension: a JSON
+    object with ``traceEvents`` is a Chrome trace, anything else is
+    tried as JSONL) into the normalized form :func:`analyze` consumes:
+    ``{"spans", "instants", "counters", "gauges", "dropped_spans"}``
+    with span times in SECONDS relative to the tracer base."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, dict) and "traceEvents" in obj:
+        return _from_chrome(obj)
+    return _from_jsonl(text)
+
+
+def _from_chrome(obj: dict) -> dict:
+    spans, instants, counters = [], [], {}
+    for e in obj.get("traceEvents", []):
+        ph = e.get("ph")
+        if ph == "X":
+            args = dict(e.get("args") or {})
+            depth = args.pop("depth", 0)
+            spans.append(
+                {
+                    "name": e["name"],
+                    "t0": float(e["ts"]) / 1e6,
+                    "dur": float(e.get("dur", 0.0)) / 1e6,
+                    "depth": depth,
+                    "tid": e.get("tid", 0),
+                    "args": args,
+                    "events": [],
+                }
+            )
+        elif ph == "i":
+            instants.append(
+                {
+                    "name": e["name"],
+                    "t": float(e["ts"]) / 1e6,
+                    "args": dict(e.get("args") or {}),
+                }
+            )
+        elif ph == "C":
+            counters[e["name"]] = (e.get("args") or {}).get("value", 0)
+    other = obj.get("otherData") or {}
+    return {
+        "spans": spans,
+        "instants": instants,
+        "counters": counters,
+        "gauges": dict(other.get("gauges") or {}),
+        "dropped_spans": int(other.get("dropped_spans", 0)),
+    }
+
+
+def _from_jsonl(text: str) -> dict:
+    spans, instants, counters, gauges = [], [], {}, {}
+    dropped = 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        r = json.loads(line)
+        t = r.get("type")
+        if t == "span":
+            spans.append(
+                {
+                    "name": r["name"],
+                    "t0": float(r["t0_s"]),
+                    "dur": float(r["dur_s"]),
+                    "depth": r.get("depth", 0),
+                    "tid": r.get("tid", 0),
+                    "args": r.get("args") or {},
+                    "events": r.get("events") or [],
+                }
+            )
+        elif t == "instant":
+            instants.append(
+                {
+                    "name": r["name"],
+                    "t": float(r["t_s"]),
+                    "args": r.get("args") or {},
+                }
+            )
+        elif t == "counter":
+            counters[r["name"]] = r["value"]
+        elif t == "gauge":
+            gauges[r["name"]] = r["value"]
+        elif t == "dropped_spans":
+            dropped = int(r["value"])
+    return {
+        "spans": spans,
+        "instants": instants,
+        "counters": counters,
+        "gauges": gauges,
+        "dropped_spans": dropped,
+    }
+
+
+def _annotate_self_times(spans: list) -> None:
+    """Set ``self_s`` on every span: wall minus walls nested strictly
+    inside it on the same thread (stack sweep over start-sorted
+    intervals; ties open the longer span first so a parent sharing its
+    child's start still encloses it)."""
+    by_tid: dict = {}
+    for sp in spans:
+        by_tid.setdefault(sp["tid"], []).append(sp)
+    for sps in by_tid.values():
+        sps.sort(key=lambda s: (s["t0"], -s["dur"]))
+        stack: list = []
+        for sp in sps:
+            sp["_child_s"] = 0.0
+            while stack and sp["t0"] >= (
+                stack[-1]["t0"] + stack[-1]["dur"] - 1e-9
+            ):
+                stack.pop()
+            if stack:
+                stack[-1]["_child_s"] += sp["dur"]
+            stack.append(sp)
+    for sp in spans:
+        sp["self_s"] = round(
+            max(0.0, sp["dur"] - sp.pop("_child_s", 0.0)), 9
+        )
+
+
+def _phase_rollup(spans: list) -> list:
+    agg: dict = {}
+    for sp in spans:
+        row = agg.setdefault(
+            sp["name"],
+            {"name": sp["name"], "count": 0, "total_s": 0.0,
+             "self_s": 0.0, "max_s": 0.0},
+        )
+        row["count"] += 1
+        row["total_s"] += sp["dur"]
+        row["self_s"] += sp["self_s"]
+        row["max_s"] = max(row["max_s"], sp["dur"])
+    rows = sorted(agg.values(), key=lambda r: -r["self_s"])
+    for r in rows:
+        r["total_s"] = round(r["total_s"], 6)
+        r["self_s"] = round(r["self_s"], 6)
+        r["max_s"] = round(r["max_s"], 6)
+        r["mean_s"] = round(r["total_s"] / r["count"], 6)
+    return rows
+
+
+def _bandwidth(counters: dict, spans: list) -> list:
+    """Transfer table rows: (direction, bytes, seconds or None, MB/s or
+    None). h2d dispatch bytes have no measured wall of their own (the
+    dispatch is async); the payload upload and the d2h pulls carry
+    walls, so those rows get a rate."""
+
+    def row(name, nbytes, secs):
+        mbps = (
+            round(nbytes / secs / 1e6, 3)
+            if secs and nbytes
+            else None
+        )
+        return {
+            "name": name,
+            "bytes": int(nbytes),
+            "seconds": round(float(secs), 6) if secs else None,
+            "mb_per_s": mbps,
+        }
+
+    rows = []
+    h2d = counters.get("transfer.h2d_bytes", 0)
+    if h2d:
+        rows.append(row("h2d (dispatch inputs, async)", h2d, None))
+    up_b = counters.get("transfer.payload_upload_bytes", 0)
+    up_s = counters.get("transfer.payload_upload_s", 0.0)
+    if up_b or up_s:
+        rows.append(row("h2d payload upload", up_b, up_s))
+    d2h = counters.get("transfer.d2h_bytes", 0)
+    d2h_s = counters.get("transfer.d2h_s", 0.0)
+    if d2h or d2h_s:
+        rows.append(row("d2h pulls (incl. device wait)", d2h, d2h_s))
+    pull_b = pull_s = 0.0
+    for sp in spans:
+        if sp["name"] == "transfer.pull":
+            pull_b += sp["args"].get("bytes", 0)
+            pull_s += sp["dur"]
+    if pull_b:
+        rows.append(row("d2h pull spans", pull_b, pull_s))
+    return rows
+
+
+def _resident_split(data: dict) -> dict:
+    """Hot/cold `train` walls: classify each root train span by the
+    resident-cache hit/miss marks inside its window (a miss anywhere in
+    the window = cold — that run paid the payload upload)."""
+    marks = [
+        (i["t"], i["name"])
+        for i in data["instants"]
+        if i["name"] in _RESIDENT_MARKS
+    ]
+    for sp in data["spans"]:
+        for ev in sp["events"]:
+            name = ev["name"] if isinstance(ev, dict) else ev[0]
+            t = ev["t_s"] if isinstance(ev, dict) else ev[1]
+            if name in _RESIDENT_MARKS:
+                marks.append((t, name))
+    hot, cold = [], []
+    for sp in data["spans"]:
+        if sp["name"] != "train":
+            continue
+        t0, t1 = sp["t0"], sp["t0"] + sp["dur"]
+        window = [n for t, n in marks if t0 - 1e-9 <= t <= t1 + 1e-9]
+        if "resident_cache.miss" in window:
+            cold.append(round(sp["dur"], 6))
+        elif "resident_cache.hit" in window:
+            hot.append(round(sp["dur"], 6))
+    out = {
+        "hits": int(data["counters"].get("resident_cache.hits", 0)),
+        "misses": int(data["counters"].get("resident_cache.misses", 0)),
+        "hot_walls_s": sorted(hot),
+        "cold_walls_s": sorted(cold),
+    }
+    for key, walls in (("hot", hot), ("cold", cold)):
+        if walls:
+            out[f"{key}_mean_s"] = round(sum(walls) / len(walls), 6)
+            out[f"{key}_min_s"] = round(min(walls), 6)
+    return out
+
+
+def analyze(data: dict, top: Optional[int] = None) -> dict:
+    """Full report from normalized trace data (see module doc). Exact
+    and deterministic — the test surface asserts on these numbers."""
+    spans = data["spans"]
+    _annotate_self_times(spans)
+    phases = _phase_rollup(spans)
+    counters = data["counters"]
+    return {
+        "n_spans": len(spans),
+        "dropped_spans": data["dropped_spans"],
+        "phases": phases[:top] if top else phases,
+        "bandwidth": _bandwidth(counters, spans),
+        "resident": _resident_split(data),
+        "memory": {
+            k: v for k, v in sorted(data["gauges"].items())
+            if k.startswith("memory.")
+        },
+        "compiles": {
+            k: v for k, v in sorted(counters.items())
+            if k.startswith("compiles.")
+        },
+        "faults": {
+            k: v for k, v in sorted(counters.items())
+            if k.startswith("faults.")
+        },
+    }
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1000 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1000.0
+    return f"{n:.1f}GB"
+
+
+def render(report: dict) -> str:
+    out = []
+    out.append(
+        f"== trace: {report['n_spans']} spans"
+        + (
+            f" (oldest {report['dropped_spans']} dropped by retention)"
+            if report["dropped_spans"]
+            else ""
+        )
+    )
+    out.append("")
+    out.append("-- critical path (self-time attribution) --")
+    out.append(
+        f"{'span':<28} {'count':>6} {'self_s':>10} {'total_s':>10} "
+        f"{'mean_s':>10} {'max_s':>10}"
+    )
+    for r in report["phases"]:
+        out.append(
+            f"{r['name']:<28} {r['count']:>6} {r['self_s']:>10.3f} "
+            f"{r['total_s']:>10.3f} {r['mean_s']:>10.3f} "
+            f"{r['max_s']:>10.3f}"
+        )
+    if report["bandwidth"]:
+        out.append("")
+        out.append("-- transfers --")
+        out.append(
+            f"{'direction':<32} {'bytes':>10} {'seconds':>10} "
+            f"{'MB/s':>8}"
+        )
+        for r in report["bandwidth"]:
+            secs = f"{r['seconds']:.3f}" if r["seconds"] else "-"
+            rate = f"{r['mb_per_s']:.1f}" if r["mb_per_s"] else "-"
+            out.append(
+                f"{r['name']:<32} {_fmt_bytes(r['bytes']):>10} "
+                f"{secs:>10} {rate:>8}"
+            )
+    res = report["resident"]
+    if res["hits"] or res["misses"] or res["hot_walls_s"] or res["cold_walls_s"]:
+        out.append("")
+        out.append("-- resident cache (hot/cold train walls) --")
+        out.append(f"hits={res['hits']} misses={res['misses']}")
+        if res["hot_walls_s"]:
+            out.append(
+                f"hot  runs: n={len(res['hot_walls_s'])} "
+                f"mean={res['hot_mean_s']:.3f}s "
+                f"min={res['hot_min_s']:.3f}s"
+            )
+        if res["cold_walls_s"]:
+            out.append(
+                f"cold runs: n={len(res['cold_walls_s'])} "
+                f"mean={res['cold_mean_s']:.3f}s "
+                f"min={res['cold_min_s']:.3f}s"
+            )
+    if report["memory"]:
+        out.append("")
+        out.append("-- memory watermarks --")
+        for k, v in report["memory"].items():
+            out.append(f"{k:<36} {_fmt_bytes(v):>12}")
+    if report["compiles"]:
+        out.append("")
+        out.append("-- compiles --")
+        for k, v in report["compiles"].items():
+            v = round(v, 3) if isinstance(v, float) else v
+            out.append(f"{k:<36} {v:>12}")
+    if report["faults"]:
+        out.append("")
+        out.append("-- faults --")
+        for k, v in report["faults"].items():
+            v = round(v, 6) if isinstance(v, float) else v
+            out.append(f"{k:<36} {v:>12}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dbscan_tpu.obs.analyze",
+        description="Analyze a DBSCAN_TRACE capture (Chrome JSON or "
+        "JSONL): phase rollups, self-time attribution, bandwidth, "
+        "hot/cold splits, memory watermarks.",
+    )
+    p.add_argument("trace", help="trace file written by obs (--trace / DBSCAN_TRACE)")
+    p.add_argument(
+        "--top", type=int, default=20,
+        help="rows in the self-time table (default 20; 0 = all)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="print the full report as JSON instead of tables",
+    )
+    args = p.parse_args(argv)
+    try:
+        data = load_trace(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"analyze: cannot read {args.trace}: {e}", file=sys.stderr)
+        return 2
+    report = analyze(data, top=args.top or None)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,347 @@
+"""Bench-capture history: one normalized, append-only record stream.
+
+The repo root has accumulated 20+ ``BENCH_*.json`` / ``MULTICHIP_*.json``
+captures in at least four ad-hoc shapes (flat metric objects, prefixed
+row objects, ``{"tail": ...}`` driver wrappers whose JSON lines live
+inside a log string, probe records with ``rows``), and the only way to
+see the perf trajectory across rounds was to eyeball them. This module
+ingests every shape into ONE schema, appended to ``bench/history.jsonl``::
+
+    python -m dbscan_tpu.obs.bench_history BENCH_*.json MULTICHIP_*.json
+
+Record schema (one JSON object per line; append-only — re-ingesting a
+file skips records already present)::
+
+    {"metric": str,          # e.g. "anchor_seconds", "value"
+     "value": float,
+     "unit": str | null,     # "s", "Mpoints/s", ... when known
+     "backend": str,         # "tpu" / "cpu" / "multichip" / "unknown"
+     "resident_hot": bool | null,  # PR-2 hot/cold tag when the capture
+                              # carried it — hot and cold walls are
+                              # different populations (PARITY.md) and
+                              # the regress gate never mixes them
+     "rev": str,             # git rev at ingest time ("unknown" ok)
+     "source": str}          # capture filename the record came from
+
+Which numeric keys become records: ``value`` (named by the capture's
+own ``metric`` string), plus scalar keys ending in ``_seconds`` /
+``_s`` / ``_mpts`` / ``_vs_baseline`` (and bare ``seconds`` /
+``vs_baseline``) — the walls and throughputs the regress gate knows a
+better-direction for. Cluster counts, ARIs, and shape diagnostics stay
+in the raw captures; the history is the PERF trajectory.
+
+The regress gate (:mod:`dbscan_tpu.obs.regress`) compares a fresh
+capture against this history with a noise-aware threshold.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Iterable, List, Optional, Tuple
+
+DEFAULT_HISTORY = os.path.join("bench", "history.jsonl")
+
+# scalar keys promoted to history records: exact names + suffixes
+_EXACT_KEYS = ("value", "seconds", "vs_baseline")
+_SUFFIXES = ("_seconds", "_s", "_mpts", "_vs_baseline")
+# numeric-but-not-perf keys the suffix rule would otherwise catch
+_EXCLUDE = ("backoff_s",)
+
+REQUIRED_KEYS = ("metric", "value", "source")
+
+
+def git_rev(cwd: Optional[str] = None) -> str:
+    try:
+        return (
+            subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=cwd,
+                capture_output=True,
+                timeout=10,
+            )
+            .stdout.decode()
+            .strip()
+            or "unknown"
+        )
+    except Exception:  # noqa: BLE001 — rev is best-effort metadata
+        return "unknown"
+
+
+def _unit_for(metric: str, obj: dict) -> Optional[str]:
+    if metric == "value":
+        return obj.get("unit")
+    if metric.endswith(("_seconds", "_s")) or metric == "seconds":
+        return "s"
+    if metric.endswith("_mpts"):
+        return "Mpoints/s"
+    return None
+
+
+def _resident_tag(metric: str, obj: dict):
+    """The hot/cold tag covering ``metric``, when the capture carries
+    one. Every ``{prefix}_resident_hot`` key in the capture tags ALL of
+    that row's metrics (``{prefix}_seconds``, ``{prefix}_mpts``,
+    ``{prefix}_vs_baseline``, ``{prefix}_compute_s``, ...) — a
+    vs_baseline derived from a hot/cold wall is just as bimodal as the
+    wall itself; headline ``seconds``/``value``/``vs_baseline`` read the
+    unprefixed tag. False (a COLD rep) is a tag, not a missing tag:
+    every check below is ``is not None``, never truthiness — dropping
+    False would gate cold walls against the untagged population."""
+    for key, v in obj.items():
+        if v is None or not key.endswith("_resident_hot"):
+            continue
+        prefix = key[: -len("_resident_hot")]
+        if metric == prefix or metric.startswith(prefix + "_"):
+            return bool(v)
+    if metric in ("seconds", "value", "vs_baseline"):
+        tag = obj.get("resident_hot")
+        if tag is None:
+            tag = obj.get("_resident_hot")
+        return bool(tag) if tag is not None else None
+    return None
+
+
+def _is_perf_key(key: str, value) -> bool:
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return False
+    if key in _EXCLUDE or key.endswith(_EXCLUDE):
+        return False
+    return key in _EXACT_KEYS or key.endswith(_SUFFIXES)
+
+
+def _records_from_metric_obj(obj: dict, source: str, rev: str) -> list:
+    backend = obj.get("backend", "unknown")
+    out = []
+    for key in sorted(obj.keys()):
+        value = obj[key]
+        if not _is_perf_key(key, value):
+            continue
+        metric = obj["metric"] if key == "value" and "metric" in obj else key
+        out.append(
+            {
+                "metric": metric,
+                "value": float(value),
+                "unit": _unit_for(key, obj),
+                "backend": backend,
+                "resident_hot": _resident_tag(key, obj),
+                "rev": rev,
+                "source": source,
+            }
+        )
+    return out
+
+
+def _objects_in_text(text: str) -> list:
+    """Every JSON object found in free text (driver ``tail`` strings):
+    one per line that parses as a dict."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            o = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(o, dict):
+            out.append(o)
+    return out
+
+
+def normalize_capture(obj: dict, source: str, rev: str = "unknown") -> list:
+    """One capture object (any of the historical shapes) -> normalized
+    records. Dict-shape dispatch:
+
+    - driver wrapper (``tail``/``parsed``): recurse into the parsed
+      record and every JSON line embedded in the tail;
+    - multichip dryrun (``n_devices``+``ok``): one ``multichip_ok``
+      record (the dryrun has no timing worth trending);
+    - probe record (``rows`` list of dicts): each row's perf keys;
+    - anything else: the perf keys of the object itself.
+    """
+    records: list = []
+    if "n_devices" in obj and "ok" in obj:
+        # multichip dryruns also carry a `tail` log: this branch must
+        # win over the wrapper branch
+        return [
+            {
+                "metric": "multichip_ok",
+                "value": 1.0 if obj.get("ok") else 0.0,
+                "unit": None,
+                "backend": f"multichip{obj.get('n_devices', 0)}",
+                "resident_hot": None,
+                "rev": rev,
+                "source": source,
+            }
+        ]
+    if "tail" in obj and isinstance(obj.get("tail"), str):
+        parsed = obj.get("parsed")
+        seen_texts = set()
+        if isinstance(parsed, dict):
+            records += normalize_capture(parsed, source, rev)
+            seen_texts.add(json.dumps(parsed, sort_keys=True))
+        for sub in _objects_in_text(obj["tail"]):
+            key = json.dumps(sub, sort_keys=True)
+            if key in seen_texts:
+                continue
+            seen_texts.add(key)
+            records += normalize_capture(sub, source, rev)
+        return records
+    rows = obj.get("rows") or obj.get("runs")
+    if isinstance(rows, list) and rows and isinstance(rows[0], dict):
+        for row in rows:
+            records += _records_from_metric_obj(
+                {**{k: v for k, v in obj.items() if k != "rows"}, **row},
+                source,
+                rev,
+            )
+        return records
+    return _records_from_metric_obj(obj, source, rev)
+
+
+def parse_capture_file(path: str, rev: str = "unknown") -> list:
+    """All normalized records from one capture file: whole-file JSON if
+    it parses (including pretty-printed objects), else per-line JSON."""
+    with open(path) as f:
+        text = f.read()
+    source = os.path.basename(path)
+    try:
+        obj = json.loads(text)
+        objs = [obj] if isinstance(obj, dict) else []
+    except ValueError:
+        objs = _objects_in_text(text)
+    records: list = []
+    seen = set()
+    for o in objs:
+        for r in normalize_capture(o, source, rev):
+            # a capture file may carry the same figure twice (bench.py
+            # prints the full record AND the compact summary line);
+            # one history record per distinct figure
+            k = _dedup_key(r)
+            if k not in seen:
+                seen.add(k)
+                records.append(r)
+    return records
+
+
+def _dedup_key(r: dict) -> Tuple:
+    return (
+        r.get("source"),
+        r.get("metric"),
+        r.get("value"),
+        r.get("resident_hot"),
+        r.get("backend"),
+    )
+
+
+def load_history(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def append_records(records: Iterable[dict], path: str) -> Tuple[int, int]:
+    """Append records not already present (by source+metric+value+tag);
+    returns (added, skipped). Append-only by design: history lines are
+    never rewritten, so concurrent benches can only ever add."""
+    existing = {_dedup_key(r) for r in load_history(path)}
+    added = skipped = 0
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        for r in records:
+            if _dedup_key(r) in existing:
+                skipped += 1
+                continue
+            existing.add(_dedup_key(r))
+            f.write(json.dumps(r) + "\n")
+            added += 1
+    return added, skipped
+
+
+def ingest(
+    paths: Iterable[str],
+    out_path: str = DEFAULT_HISTORY,
+    rev: Optional[str] = None,
+) -> Tuple[int, int]:
+    """Parse every capture file and append its records to the history;
+    returns (added, skipped)."""
+    if rev is None:
+        rev = git_rev()
+    records: list = []
+    for p in paths:
+        records += parse_capture_file(p, rev)
+    return append_records(records, out_path)
+
+
+def append_capture(
+    obj: dict, path: str, source: str, rev: Optional[str] = None
+) -> int:
+    """Normalize one in-memory capture (bench.py's ``out`` dict) and
+    append it; returns records added. The bench harness calls this when
+    ``BENCH_HISTORY`` is set, so every local capture lands in the same
+    trend the regress gate reads."""
+    if rev is None:
+        rev = git_rev()
+    added, _ = append_records(normalize_capture(obj, source, rev), path)
+    return added
+
+
+def check_schema(records: List[dict]) -> List[str]:
+    """Validate history records; returns error strings (empty = ok)."""
+    errors = []
+    for i, r in enumerate(records):
+        for k in REQUIRED_KEYS:
+            if k not in r:
+                errors.append(f"record {i}: missing key {k!r}")
+        if "value" in r and (
+            isinstance(r["value"], bool)
+            or not isinstance(r["value"], (int, float))
+        ):
+            errors.append(
+                f"record {i}: value must be a number, got "
+                f"{type(r['value']).__name__}"
+            )
+        if "metric" in r and not isinstance(r["metric"], str):
+            errors.append(f"record {i}: metric must be a string")
+    return errors
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m dbscan_tpu.obs.bench_history",
+        description="Ingest BENCH_*/MULTICHIP_* captures into the "
+        "normalized append-only bench history.",
+    )
+    p.add_argument("captures", nargs="+", help="capture JSON files")
+    p.add_argument(
+        "--out", default=DEFAULT_HISTORY,
+        help=f"history file to append to (default {DEFAULT_HISTORY})",
+    )
+    p.add_argument("--rev", help="git rev to stamp (default: ask git)")
+    args = p.parse_args(argv)
+    try:
+        added, skipped = ingest(args.captures, args.out, rev=args.rev)
+    except (OSError, ValueError) as e:
+        print(f"bench_history: {e}", file=sys.stderr)
+        return 2
+    print(
+        f"bench_history: {added} record(s) appended to {args.out}"
+        + (f" ({skipped} already present)" if skipped else "")
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

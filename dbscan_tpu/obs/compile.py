@@ -1,0 +1,136 @@
+"""jit/retrace accounting: make recompile storms measurable.
+
+The banded dispatch's whole shape discipline — the ~1.5x width ladder
+(`binning._ladder_width`), the streaming shape ratchet
+(`binning._ratchet`), the module-level `functools.lru_cache` around the
+jit builders (`driver._compiled_block` et al.) — exists to keep XLA
+compiles rare: a fresh jit signature per micro-batch turns a 50 ms
+steady-state step into a seconds-scale recompile forever. But nothing
+MEASURED it: a regression that quietly re-traced every dispatch (a
+cache-key bug, a data-dependent shape sneaking past the ladder) was
+invisible until someone eyeballed walls. This module wraps the hot
+jitted entry points so cache misses become counters and spans:
+
+- :func:`tracked_call` runs one call of a jitted function and, when the
+  function's trace-cache grew (``fn._cache_size()``), records the call
+  as a compile: ``compiles.total`` / ``compiles.<family>`` /
+  ``compiles.wall_s`` counters plus a retroactive ``compile.<family>``
+  span over the call (on a cache miss the trace+lower+compile wall IS
+  the call wall up to the async dispatch tail — documented
+  approximation);
+- :func:`warn_on_recompile_storm` logs (once per family per process)
+  when one dispatch family compiles more than
+  ``DBSCAN_COMPILE_STORM_THRESHOLD`` times (default 12) — the failure
+  mode the shape ratchet is designed to prevent, now visible the moment
+  it regresses.
+
+Contract: the DISABLED path costs one truthiness check and calls the
+function straight through — no cache-size probe, no counter. Jits
+without ``_cache_size`` (older/exotic wrappers) degrade to
+pass-through. Per-family counts are process-global; :func:`reset` for
+tests.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+import dbscan_tpu.obs as obs
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_family_compiles: dict = {}
+_storm_warned: set = set()
+
+
+def storm_threshold() -> int:
+    """Compiles per family past which :func:`warn_on_recompile_storm`
+    fires (``DBSCAN_COMPILE_STORM_THRESHOLD``; <=0 disables). Default
+    12: a batch run legitimately compiles each family a handful of
+    times (one per ladder rung in the data), a storm compiles per
+    dispatch."""
+    return int(os.environ.get("DBSCAN_COMPILE_STORM_THRESHOLD", "12"))
+
+
+def _cache_size(fn):
+    try:
+        return fn._cache_size()
+    except Exception:  # noqa: BLE001 — wrapper without the API
+        return None
+
+
+def tracked_call(family: str, fn, *args):
+    """Call ``fn(*args)`` with compile accounting (see module doc).
+    Strict pass-through when obs is disabled."""
+    st = obs.state()
+    if st is None:
+        return fn(*args)
+    before = _cache_size(fn)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    if before is not None:
+        after = _cache_size(fn)
+        if after is not None and after > before:
+            note_compile(family, t0, time.perf_counter())
+    return out
+
+
+def note_compile(family: str, t0: float = None, t1: float = None) -> None:
+    """Record one compile of ``family`` (counters + compile-wall span
+    when bounds are given) and run the storm check."""
+    obs.count("compiles.total")
+    obs.count(f"compiles.{family}")
+    if t0 is not None and t1 is not None:
+        obs.count("compiles.wall_s", t1 - t0)
+        obs.add_span(f"compile.{family}", t0, t1, family=family)
+    with _lock:
+        n = _family_compiles.get(family, 0) + 1
+        _family_compiles[family] = n
+    warn_on_recompile_storm(family, n)
+
+
+def warn_on_recompile_storm(family: str, n: int = None) -> bool:
+    """Log (once per family per process) when ``family`` has compiled
+    more than the storm threshold; returns True when the family is in
+    storm. The warning carries the ratchet-raise count so a streaming
+    storm points straight at the shape that kept moving."""
+    if n is None:
+        with _lock:
+            n = _family_compiles.get(family, 0)
+    thr = storm_threshold()
+    if thr <= 0 or n <= thr:
+        return False
+    with _lock:
+        if family in _storm_warned:
+            return True
+        _storm_warned.add(family)
+    counters = obs.counters()
+    obs.event("compiles.storm", family=family, compiles=n, threshold=thr)
+    logger.warning(
+        "recompile storm: dispatch family %r compiled %d times this "
+        "process (threshold %d) — a data-dependent shape is defeating "
+        "the width ladder / shape ratchet (%s ratchet raises observed); "
+        "steady state should reuse cached signatures",
+        family,
+        n,
+        thr,
+        counters.get("compiles.ratchet_raises", 0),
+    )
+    return True
+
+
+def family_compiles() -> dict:
+    """Snapshot of per-family compile counts (process-global)."""
+    with _lock:
+        return dict(_family_compiles)
+
+
+def reset() -> None:
+    """Drop per-family counts and storm-warned latches (tests)."""
+    with _lock:
+        _family_compiles.clear()
+        _storm_warned.clear()

@@ -105,7 +105,12 @@ def chrome_trace(tracer, metrics=None) -> dict:
         )
     events.sort(key=lambda e: e["ts"])
     if metrics is not None:
-        for name, value in sorted(metrics.counters().items()):
+        # gauges ride as counter samples too (not just otherData): the
+        # memory watermarks must be visible in the Perfetto counter
+        # track AND readable by obs/analyze.py from either format alone
+        for name, value in sorted(metrics.counters().items()) + sorted(
+            metrics.gauges().items()
+        ):
             events.append(
                 {
                     "name": name,

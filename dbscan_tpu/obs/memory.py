@@ -1,0 +1,118 @@
+"""Device-memory (HBM) watermark sampling for the observability layer.
+
+`faults.py` reacts to RESOURCE_EXHAUSTED blindly: it halves the dispatch
+budget without ever recording HOW FULL the chip actually was when the
+allocator gave up — so a capture showing repeated halvings cannot say
+whether the run was genuinely at the 16 GB ceiling or a fragmentation /
+transient-pileup artifact the inflight-window budget should have
+prevented. This module closes that gap: :func:`sample` reads
+``device.memory_stats()`` (the PJRT allocator's live view — populated on
+TPU/GPU, ``None`` on CPU backends) and records ``memory.*`` gauges, and
+the driver/spill/fault call sites invoke it at the moments that move HBM
+(dispatch fan-outs, the resident payload upload, a RESOURCE_EXHAUSTED
+halving).
+
+Contract (same as every obs hook, pinned by tests/test_obs.py):
+
+- DISABLED path is a strict no-op — one truthiness check of the
+  process-global obs state, no device call is ever made;
+- backends without allocator stats (CPU) degrade to a no-op AFTER the
+  state check: one ``memory_stats()`` probe per device per process
+  decides availability, then the sampler short-circuits for the process
+  lifetime (``_AVAILABLE`` latch) so hot paths never re-probe.
+
+Gauges written per sample (set-last-wins; the PEAK ones are made
+monotone here, since the registry's gauges have no max semantics):
+
+- ``memory.bytes_in_use`` — summed live allocator bytes across devices;
+- ``memory.peak_bytes_in_use`` — high-water mark: max of the
+  allocator's own ``peak_bytes_in_use`` and every sample this process
+  took (monotone per process; :func:`reset_peak` for tests);
+- ``memory.bytes_limit`` — summed allocator capacity, when reported;
+- ``memory.at.<site>`` — bytes_in_use at the last sample taken at that
+  call site (``dispatch.dense``, ``dispatch.banded``,
+  ``spill.payload_upload``, ``fault.resource_exhausted``, ...): the
+  span-boundary occupancy the analyzer's watermark table reads.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import dbscan_tpu.obs as obs
+
+# availability latch: None = not probed yet; False = no device reports
+# allocator stats (CPU backend) — sampler short-circuits forever;
+# True = at least one device reports stats.
+_AVAILABLE = None
+_peak_seen = 0
+_lock = threading.Lock()
+
+
+def device_memory_stats() -> dict:
+    """Live per-device allocator stats: ``{"tpu:0": {...}, ...}`` for
+    every device whose ``memory_stats()`` reports (TPU/GPU PJRT
+    backends); ``{}`` where unavailable (CPU) or before jax loads."""
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception:  # noqa: BLE001 — sampler must never raise
+        return {}
+    out = {}
+    for d in devices:
+        try:
+            st = d.memory_stats()
+        except Exception:  # noqa: BLE001 — backend without the API
+            st = None
+        if st:
+            out[f"{d.platform}:{d.id}"] = st
+    return out
+
+
+def available() -> bool:
+    """True when some device reports allocator stats (probed once)."""
+    global _AVAILABLE
+    if _AVAILABLE is None:
+        _AVAILABLE = bool(device_memory_stats())
+    return _AVAILABLE
+
+
+def sample(site: str):
+    """Record the ``memory.*`` gauges from the live allocator state;
+    returns summed bytes_in_use, or None when obs is disabled or no
+    device reports stats (CPU). One obs-state truthiness check when
+    disabled; one latched boolean when stats are unavailable."""
+    st = obs.state()
+    if st is None:
+        return None
+    if not available():
+        return None
+    stats = device_memory_stats()
+    if not stats:
+        return None
+    in_use = sum(int(s.get("bytes_in_use", 0)) for s in stats.values())
+    peak_rep = sum(
+        int(s.get("peak_bytes_in_use", 0)) for s in stats.values()
+    )
+    limit = sum(int(s.get("bytes_limit", 0)) for s in stats.values())
+    global _peak_seen
+    with _lock:
+        _peak_seen = max(_peak_seen, peak_rep, in_use)
+        peak = _peak_seen
+    st.metrics.gauge("memory.bytes_in_use", in_use)
+    st.metrics.gauge("memory.peak_bytes_in_use", peak)
+    if limit:
+        st.metrics.gauge("memory.bytes_limit", limit)
+    st.metrics.gauge(f"memory.at.{site}", in_use)
+    st.metrics.count("memory.samples")
+    return in_use
+
+
+def reset_peak() -> None:
+    """Drop the process high-water mark AND re-probe availability on
+    the next sample (tests swap fake backends in and out)."""
+    global _peak_seen, _AVAILABLE
+    with _lock:
+        _peak_seen = 0
+    _AVAILABLE = None

@@ -1,0 +1,235 @@
+"""Bench regression gate: compare a fresh capture against the history.
+
+The ROADMAP's "as fast as the hardware allows" was un-checkable: a PR
+that doubled ``anchor_seconds`` would sail through CI because nothing
+compared captures across rounds. This gate closes the loop::
+
+    python -m dbscan_tpu.obs.regress --capture fresh.json \
+        [--history bench/history.jsonl] [--threshold 0.25]
+    python -m dbscan_tpu.obs.regress --check-schema
+
+Exit codes: 0 = no regression, 1 = regression detected, 2 = usage /
+schema / IO error — so CI and a local ``python bench.py && python -m
+dbscan_tpu.obs.regress --capture ...`` both gate on it directly.
+
+Noise-aware threshold: for each comparable metric the gate matches
+history records on (metric, backend, resident_hot) — hot and cold
+resident-cache walls are DIFFERENT populations (PR 2's tag; a cold
+cosine rep legitimately runs ~10x the hot wall, and mixing them would
+either mask real regressions or flag every cold rep) — and computes the
+history's median and relative spread ((max-min)/median). The effective
+threshold is ``max(--threshold, spread)``: a metric whose history
+already swings 3x across captures (the tunnel-latency lottery) cannot
+flag at 25%, while a stable metric flags at the requested bound.
+Direction comes from the metric name: ``*_seconds``/``*_s`` regress
+UP, ``*_mpts``/``*_vs_baseline``/throughput headline regress DOWN;
+metrics with no known direction are skipped (reported, not gated).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+from typing import List, Optional
+
+from dbscan_tpu.obs import bench_history
+
+LOWER_BETTER = "lower"
+HIGHER_BETTER = "higher"
+
+
+def direction(metric: str, unit: Optional[str] = None) -> Optional[str]:
+    """Which way ``metric`` regresses: walls regress up, throughputs
+    regress down, everything else is not gate-able."""
+    if metric.endswith(("_seconds", "_s")) or metric == "seconds":
+        return LOWER_BETTER
+    if metric.endswith(("_mpts", "_vs_baseline", "_throughput")) or metric in (
+        "vs_baseline",
+    ):
+        return HIGHER_BETTER
+    if unit in ("Mpoints/s",):
+        return HIGHER_BETTER
+    return None
+
+
+def compare(
+    fresh: List[dict],
+    history: List[dict],
+    threshold: float = 0.25,
+    min_samples: int = 2,
+) -> dict:
+    """Gate ``fresh`` records against ``history``; returns
+    ``{"regressions": [...], "ok": [...], "skipped": [...]}`` where each
+    entry carries the metric, values, and the effective threshold."""
+    regressions, ok, skipped = [], [], []
+    for rec in fresh:
+        metric = rec["metric"]
+        dirn = direction(metric, rec.get("unit"))
+        if dirn is None:
+            skipped.append({"metric": metric, "reason": "no_direction"})
+            continue
+        base = [
+            h["value"]
+            for h in history
+            if h.get("metric") == metric
+            and h.get("backend") == rec.get("backend")
+            and h.get("resident_hot") == rec.get("resident_hot")
+            and h.get("source") != rec.get("source")
+        ]
+        if len(base) < min_samples:
+            skipped.append(
+                {
+                    "metric": metric,
+                    "reason": f"history_n={len(base)}<{min_samples}",
+                }
+            )
+            continue
+        med = statistics.median(base)
+        if med <= 0:
+            skipped.append({"metric": metric, "reason": "median<=0"})
+            continue
+        spread = (max(base) - min(base)) / med
+        eff = max(threshold, spread)
+        value = rec["value"]
+        if dirn == LOWER_BETTER:
+            bad = value > med * (1.0 + eff)
+            delta = value / med - 1.0
+        else:
+            bad = value < med / (1.0 + eff)
+            delta = med / max(value, 1e-300) - 1.0
+        entry = {
+            "metric": metric,
+            "value": value,
+            "median": round(med, 6),
+            "n": len(base),
+            "direction": dirn,
+            "delta": round(delta, 4),
+            "threshold": round(eff, 4),
+            "resident_hot": rec.get("resident_hot"),
+            "backend": rec.get("backend"),
+        }
+        (regressions if bad else ok).append(entry)
+    return {"regressions": regressions, "ok": ok, "skipped": skipped}
+
+
+def format_regression(e: dict) -> str:
+    """One regression entry as a human line — the ONE rendering of a
+    verdict, shared with bench.py's BENCH_HISTORY gate so the formats
+    (and the 'allowed' effective-threshold figure) cannot drift."""
+    return (
+        f"REGRESSION {e['metric']}: {e['value']} vs median "
+        f"{e['median']} (n={e['n']}, {e['delta']:+.1%} worse, "
+        f"allowed {e['threshold']:.1%}"
+        + (
+            f", resident_hot={e['resident_hot']}"
+            if e["resident_hot"] is not None
+            else ""
+        )
+        + ")"
+    )
+
+
+def _render(result: dict) -> str:
+    lines = []
+    for e in result["regressions"]:
+        lines.append(format_regression(e))
+    for e in result["ok"]:
+        lines.append(
+            f"ok         {e['metric']}: {e['value']} vs median "
+            f"{e['median']} (n={e['n']}, allowed {e['threshold']:.1%})"
+        )
+    for e in result["skipped"]:
+        lines.append(f"skip       {e['metric']}: {e['reason']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dbscan_tpu.obs.regress",
+        description="Noise-aware bench regression gate over the "
+        "normalized capture history.",
+    )
+    p.add_argument(
+        "--history", default=bench_history.DEFAULT_HISTORY,
+        help="history file (default bench/history.jsonl)",
+    )
+    p.add_argument(
+        "--capture",
+        help="fresh capture to gate (any historical BENCH_* shape, or "
+        "a bench.py output record)",
+    )
+    p.add_argument(
+        "--threshold", type=float, default=0.25,
+        help="minimum relative regression to flag (default 0.25; "
+        "raised per metric to the history's own spread)",
+    )
+    p.add_argument(
+        "--min-samples", type=int, default=2,
+        help="history samples needed before a metric gates (default 2)",
+    )
+    p.add_argument(
+        "--check-schema", action="store_true",
+        help="validate the history file's record schema and exit",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="print the comparison result as JSON",
+    )
+    args = p.parse_args(argv)
+
+    try:
+        history = bench_history.load_history(args.history)
+    except (OSError, ValueError) as e:
+        print(f"regress: cannot read {args.history}: {e}", file=sys.stderr)
+        return 2
+
+    if args.check_schema:
+        if not history:
+            print(
+                f"regress: no history at {args.history} (ingest captures "
+                "with python -m dbscan_tpu.obs.bench_history first)",
+                file=sys.stderr,
+            )
+            return 2
+        errors = bench_history.check_schema(history)
+        if errors:
+            for err in errors[:20]:
+                print(f"regress: schema: {err}", file=sys.stderr)
+            return 2
+        print(
+            f"regress: schema ok — {len(history)} record(s), "
+            f"{len({r['metric'] for r in history})} metric(s) in "
+            f"{args.history}"
+        )
+        return 0
+
+    if not args.capture:
+        p.error("--capture is required (or use --check-schema)")
+    try:
+        fresh = bench_history.parse_capture_file(args.capture)
+    except (OSError, ValueError) as e:
+        print(f"regress: cannot read {args.capture}: {e}", file=sys.stderr)
+        return 2
+    if not fresh:
+        print(
+            f"regress: no perf records found in {args.capture}",
+            file=sys.stderr,
+        )
+        return 2
+
+    result = compare(
+        fresh, history,
+        threshold=args.threshold,
+        min_samples=args.min_samples,
+    )
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(_render(result))
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

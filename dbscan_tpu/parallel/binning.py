@@ -16,7 +16,7 @@ from typing import NamedTuple, Tuple
 
 import numpy as np
 
-from dbscan_tpu import _native
+from dbscan_tpu import _native, obs
 from dbscan_tpu.ops import geometry as geo
 
 
@@ -211,10 +211,18 @@ def _ratchet(floors, key, val: int, cap: int = None) -> int:
     width). No-op when ``floors`` is None (batch runs)."""
     if floors is None:
         return val
-    v = max(int(val), int(floors.get(key, 0)))
+    prev = int(floors.get(key, 0))
+    v = max(int(val), prev)
     if cap is not None:
         v = min(v, int(cap))
-    floors[key] = max(int(floors.get(key, 0)), v)
+    if prev and v > prev:
+        # a post-warm-up floor raise mints a fresh jit signature — the
+        # exact event a steady-state recompile storm is made of; the
+        # counter lets obs/compile.py's storm warning (and the trace)
+        # attribute a storm to the shape that kept moving
+        obs.count("compiles.ratchet_raises")
+        obs.event("binning.ratchet_raise", key=key, to=v)
+    floors[key] = max(prev, v)
     return v
 
 

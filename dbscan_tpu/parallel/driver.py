@@ -49,6 +49,8 @@ from jax.sharding import PartitionSpec
 
 from dbscan_tpu import _native, faults, obs
 from dbscan_tpu.config import DBSCANConfig
+from dbscan_tpu.obs import compile as obs_compile
+from dbscan_tpu.obs import memory as obs_memory
 from dbscan_tpu.ops import geometry as geo
 from dbscan_tpu.ops.labels import CORE, NOISE, SEED_NONE
 from dbscan_tpu.ops.local_dbscan import local_dbscan
@@ -521,7 +523,9 @@ def _dispatch_partitions(
                 eps, int(cfg.min_points), cfg.engine.value, metric,
                 budget, mesh,
             )
-            return fn(
+            return obs_compile.tracked_call(
+                "dispatch.resident",
+                fn,
                 resident_x,
                 mesh_mod.shard_host_array(mesh, idx32),
                 mesh_mod.shard_host_array(mesh, group.mask),
@@ -534,7 +538,9 @@ def _dispatch_partitions(
                 eps, int(cfg.min_points), cfg.engine.value, metric,
                 bool(cfg.use_pallas), budget, mesh,
             )
-            return fn(
+            return obs_compile.tracked_call(
+                "dispatch.dense",
+                fn,
                 mesh_mod.shard_host_array(mesh, group.points),
                 mesh_mod.shard_host_array(mesh, group.mask),
             )
@@ -571,6 +577,11 @@ def _dispatch_partitions(
         # async dispatch: without a device-sync boundary the span covers
         # the host-side dispatch wall only (DBSCAN_TIME_DEVICE=1 blocks)
         sp.sync(out[0])
+    # HBM watermark at the dispatch boundary (no-op when obs disabled
+    # or the backend has no allocator stats — CPU)
+    obs_memory.sample(
+        "dispatch.resident" if group.points is None else "dispatch.dense"
+    )
     return out
 
 
@@ -603,14 +614,16 @@ def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh, kernel_eps=None):
                 and _os.environ.get("DBSCAN_PALLAS_SP") == "1"
             ),
         )
-        return fn(
+        return obs_compile.tracked_call(
+            "dispatch.banded_p1",
+            fn,
             *(
                 mesh_mod.shard_host_array(mesh, a)
                 for a in (
                     group.points, group.mask, ext.rel_starts, ext.spans,
                     ext.slab_starts, ext.cx,
                 )
-            )
+            ),
         )
 
     fallback = None
@@ -646,6 +659,7 @@ def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh, kernel_eps=None):
             label=f"{group.points.shape}",
         )
         sp.sync(out[0])
+    obs_memory.sample("dispatch.banded")
     return out
 
 
@@ -1742,7 +1756,9 @@ def train_arrays(
             combo_host[: total // 8], count=total
         ).astype(bool)
         bpos = np.flatnonzero(layout["validflat"] & ~core_ch)
-        bb_dev = gather_flat(
+        bb_dev = obs_compile.tracked_call(
+            "cellcc.gather",
+            gather_flat,
             rec.pop("bits_flat"),
             mesh_mod.replicate_host_array(
                 _pad_idx(bpos, getattr(cfg, "shape_floors", None))
@@ -1794,7 +1810,9 @@ def train_arrays(
             if pending[i][1] is None:
                 _redispatch(i)
         layout = cellgraph.cell_layout(rec["groups"])
-        combo_dev, bits_flat = banded_postpass(
+        combo_dev, bits_flat = obs_compile.tracked_call(
+            "cellcc.postpass",
+            banded_postpass,
             tuple(pending[i][1][0] for i in ch),
             tuple(pending[i][1][1] for i in ch),
             tuple(

@@ -34,6 +34,8 @@ import time
 import numpy as np
 
 from dbscan_tpu import faults, obs
+from dbscan_tpu.obs import compile as obs_compile
+from dbscan_tpu.obs import memory as obs_memory
 
 # chord-error bound for bf16-stored unit rows: |dot error| <= 2*2^-9
 # (+f32 accumulation, negligible at D<=4096); chord = sqrt(2-2dot) moves
@@ -83,6 +85,11 @@ class DeviceNodeOps:
         obs.count("transfer.h2d_bytes", int(xb.nbytes))
         obs.count("transfer.payload_upload_bytes", int(xb.nbytes))
         obs.timed_count("transfer.payload_upload_s", t0)
+        # HBM occupancy right after the biggest single allocation of
+        # the cosine route lands — the watermark that says whether the
+        # resident payload is what pushes a later dispatch into
+        # RESOURCE_EXHAUSTED
+        obs_memory.sample("spill.payload_upload")
         return cls(x_dev, x_host.shape[0], x_host.shape[1])
 
     def take(self, idx: np.ndarray) -> "DeviceNodeOps":
@@ -97,7 +104,9 @@ class DeviceNodeOps:
             return DeviceNodeOps(
                 faults.supervised(
                     faults.SITE_SPILL,
-                    lambda _b: _gather_fn()(self.x, idx32),
+                    lambda _b: obs_compile.tracked_call(
+                        "spill.gather", _gather_fn(), self.x, idx32
+                    ),
                     label="child-gather",
                 ),
                 len(idx),

@@ -425,6 +425,303 @@ def test_bench_rep_fields_tag_resident_cache(monkeypatch):
     assert hot["upload_bytes"] == 0 and hot["upload_s"] == 0.0
 
 
+# --- memory watermarks (obs/memory.py) --------------------------------
+
+
+_FAKE_STATS = {
+    "tpu:0": {
+        "bytes_in_use": 1_000_000,
+        "peak_bytes_in_use": 3_000_000,
+        "bytes_limit": 16_000_000_000,
+    },
+    "tpu:1": {"bytes_in_use": 500_000, "peak_bytes_in_use": 600_000},
+}
+
+
+@pytest.fixture
+def fake_hbm(monkeypatch):
+    from dbscan_tpu.obs import memory
+
+    stats = {k: dict(v) for k, v in _FAKE_STATS.items()}
+    monkeypatch.setattr(memory, "device_memory_stats", lambda: stats)
+    memory.reset_peak()  # drop the availability latch + peak
+    yield stats
+    memory.reset_peak()
+
+
+def test_memory_sample_disabled_is_noop(fake_hbm):
+    from dbscan_tpu.obs import memory
+
+    assert obs.state() is None
+    assert memory.sample("anywhere") is None
+
+
+def test_memory_sample_gauges_and_peak(fake_hbm):
+    from dbscan_tpu.obs import memory
+
+    obs.enable()
+    assert memory.sample("dispatch.dense") == 1_500_000
+    g = obs.summary()["gauges"]
+    assert g["memory.bytes_in_use"] == 1_500_000
+    # peak = max(allocator-reported peaks, observed in-use)
+    assert g["memory.peak_bytes_in_use"] == 3_600_000
+    assert g["memory.bytes_limit"] == 16_000_000_000
+    assert g["memory.at.dispatch.dense"] == 1_500_000
+    # the process watermark is monotone even when in-use drops
+    fake_hbm["tpu:0"]["bytes_in_use"] = 100
+    fake_hbm["tpu:0"]["peak_bytes_in_use"] = 0
+    fake_hbm["tpu:1"]["peak_bytes_in_use"] = 0
+    memory.sample("spill.payload_upload")
+    g = obs.summary()["gauges"]
+    assert g["memory.bytes_in_use"] == 500_100
+    assert g["memory.peak_bytes_in_use"] == 3_600_000
+    assert g["memory.at.spill.payload_upload"] == 500_100
+    assert obs.counters()["memory.samples"] == 2
+
+
+def test_memory_unavailable_backend_latches(monkeypatch):
+    """CPU backends (memory_stats() -> None) degrade to a no-op after
+    ONE probe: the sampler must not re-walk jax.devices() per dispatch."""
+    from dbscan_tpu.obs import memory
+
+    calls = {"n": 0}
+
+    def probe():
+        calls["n"] += 1
+        return {}
+
+    monkeypatch.setattr(memory, "device_memory_stats", probe)
+    memory.reset_peak()
+    obs.enable()
+    assert memory.sample("a") is None
+    assert memory.sample("b") is None
+    assert calls["n"] == 1  # second sample hit the latch
+    assert "memory.bytes_in_use" not in obs.summary()["gauges"]
+    memory.reset_peak()
+
+
+@pytest.mark.faults
+def test_budget_halving_records_hbm_occupancy(monkeypatch, fake_hbm):
+    """A RESOURCE_EXHAUSTED halving event carries the observed HBM
+    occupancy (the figure faults.py used to react to blindly)."""
+    monkeypatch.setenv("DBSCAN_FAULT_BACKOFF_S", "0")
+    monkeypatch.setenv(
+        "DBSCAN_FAULT_SPEC", "dispatch#0:RESOURCE_EXHAUSTED*1"
+    )
+    faults.reset_registry()
+    obs.enable()
+    out = train(_blobs(), neighbor_backend="dense", **KW)
+    assert out.stats["faults"]["budget_halvings"] == 1
+    halved = [
+        e
+        for sp in obs.state().tracer.snapshot_spans()
+        for e in sp.events
+        if e[0] == "fault.budget_halved"
+    ] + [
+        i
+        for i in obs.state().tracer.instants
+        if i[0] == "fault.budget_halved"
+    ]
+    assert len(halved) == 1
+    assert halved[0][2]["hbm_bytes_in_use"] == 1_500_000
+    assert (
+        obs.summary()["gauges"]["memory.at.fault.resource_exhausted"]
+        == 1_500_000
+    )
+    faults.reset_registry()
+
+
+# --- compile accounting (obs/compile.py) ------------------------------
+
+
+def test_tracked_call_counts_cache_misses():
+    import jax
+    import jax.numpy as jnp
+
+    from dbscan_tpu.obs import compile as obs_compile
+
+    obs_compile.reset()
+    fn = jax.jit(lambda x: x + 1)
+    # disabled: strict pass-through, nothing counted
+    assert obs.state() is None
+    obs_compile.tracked_call("t.fam", fn, jnp.ones(3))
+    obs.enable()
+    snap = obs.counters()
+    assert "compiles.total" not in snap
+    # warm shape: cache hit, no compile recorded
+    obs_compile.tracked_call("t.fam", fn, jnp.ones(3))
+    assert "compiles.total" not in obs.counters()
+    # fresh shape: cache miss -> counters + a compile-wall span
+    obs_compile.tracked_call("t.fam", fn, jnp.ones(7))
+    c = obs.counters()
+    assert c["compiles.total"] == 1 and c["compiles.t.fam"] == 1
+    assert c["compiles.wall_s"] > 0
+    names = [s.name for s in obs.state().tracer.snapshot_spans()]
+    assert "compile.t.fam" in names
+    assert obs_compile.family_compiles()["t.fam"] == 1
+    obs_compile.reset()
+
+
+def test_recompile_storm_warns_once(monkeypatch, caplog):
+    import jax
+    import jax.numpy as jnp
+
+    from dbscan_tpu.obs import compile as obs_compile
+
+    monkeypatch.setenv("DBSCAN_COMPILE_STORM_THRESHOLD", "2")
+    obs_compile.reset()
+    obs.enable()
+    fn = jax.jit(lambda x: x * 2)
+    with caplog.at_level("WARNING", logger="dbscan_tpu.obs.compile"):
+        for n in range(3, 8):  # 5 distinct shapes -> 5 compiles
+            obs_compile.tracked_call("storm.fam", fn, jnp.ones(n))
+    storms = [r for r in caplog.records if "recompile storm" in r.message]
+    assert len(storms) == 1  # warned once, not per compile
+    assert obs_compile.family_compiles()["storm.fam"] == 5
+    assert obs_compile.warn_on_recompile_storm("storm.fam") is True
+    assert obs_compile.warn_on_recompile_storm("quiet.fam") is False
+    obs_compile.reset()
+
+
+def test_small_train_records_compile_accounting():
+    """A cold-cache train() under obs records at least one dispatch
+    compile; an identical rerun records none (the lru_cache + jit cache
+    reuse the signature)."""
+    from dbscan_tpu.obs import compile as obs_compile
+    from dbscan_tpu.parallel import driver
+
+    driver.clear_compile_cache()
+    obs_compile.reset()
+    obs.enable()
+    pts = _blobs(100)
+    train(pts, **KW)
+    c = obs.counters()
+    assert c.get("compiles.total", 0) >= 1
+    snap = obs.counters()
+    train(pts, **KW)
+    delta = obs.counters_delta(snap)
+    assert delta.get("compiles.total", 0) == 0
+    obs_compile.reset()
+
+
+# --- export footers carry gauges (both formats) -----------------------
+
+
+def test_gauges_in_both_export_footers(tmp_path):
+    obs.enable()
+    obs.count("some.counter", 3)
+    obs.gauge("memory.peak_bytes_in_use", 12345)
+    jl = str(tmp_path / "t.jsonl")
+    ch = str(tmp_path / "t.json")
+    obs.write(jl)
+    obs.write(ch)
+    with open(jl) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    gauges = [r for r in records if r["type"] == "gauge"]
+    assert gauges == [
+        {"type": "gauge", "name": "memory.peak_bytes_in_use",
+         "value": 12345}
+    ]
+    with open(ch) as f:
+        trace = json.load(f)
+    assert trace["otherData"]["gauges"] == {
+        "memory.peak_bytes_in_use": 12345
+    }
+    cs = {
+        e["name"]: e["args"]["value"]
+        for e in trace["traceEvents"]
+        if e["ph"] == "C"
+    }
+    # gauges ride the counter track too (Perfetto visibility +
+    # analyze.py can read watermarks from either format alone)
+    assert cs["memory.peak_bytes_in_use"] == 12345
+    assert cs["some.counter"] == 3
+
+
+# --- cli enable/disable exception safety ------------------------------
+
+
+def _write_csv(tmp_path):
+    import numpy as np
+
+    path = tmp_path / "pts.csv"
+    pts = _blobs(60)
+    np.savetxt(path, pts, delimiter=",")
+    return str(path)
+
+
+def test_cli_disables_obs_and_flushes_on_error(monkeypatch, tmp_path):
+    """--trace/--metrics-summary enable obs; an exception in the run
+    must still flush the partial trace AND disable — no live registry
+    may leak into the caller's next run (the regression the try/finally
+    exists for)."""
+    import dbscan_tpu
+    from dbscan_tpu import cli
+
+    trace = str(tmp_path / "crash.json")
+
+    def boom(*a, **k):
+        obs.count("reached.train", 1)
+        raise RuntimeError("injected train failure")
+
+    monkeypatch.setattr(dbscan_tpu, "train", boom)
+    with pytest.raises(RuntimeError, match="injected train failure"):
+        cli.main(
+            [
+                "--input", _write_csv(tmp_path),
+                "--eps", "0.5", "--min-points", "5",
+                "--trace", trace,
+            ]
+        )
+    assert obs.state() is None  # disabled on the error path
+    with open(trace) as f:  # and the partial trace was flushed
+        t = json.load(f)
+    cs = {e["name"] for e in t["traceEvents"] if e["ph"] == "C"}
+    assert "reached.train" in cs
+
+
+def test_cli_leaves_harness_obs_state_alive(tmp_path, capsys):
+    """cli.main must disable only a state IT created: a harness that
+    enabled obs first keeps its registries (and accumulated counters)
+    across a cli invocation — the no-clobber contract in
+    obs/__init__.py."""
+    from dbscan_tpu import cli
+
+    st = obs.enable()
+    obs.count("harness.counter", 7)
+    rc = cli.main(
+        [
+            "--input", _write_csv(tmp_path),
+            "--eps", "0.5", "--min-points", "5",
+            "--max-points-per-partition", "128",
+            "--metrics-summary",
+        ]
+    )
+    assert rc == 0
+    assert obs.state() is st  # same registry, still live
+    assert obs.counters()["harness.counter"] == 7
+    assert "== metrics summary ==" in capsys.readouterr().out
+
+
+def test_cli_disables_obs_on_success(monkeypatch, tmp_path):
+    from dbscan_tpu import cli
+
+    trace = str(tmp_path / "ok.json")
+    rc = cli.main(
+        [
+            "--input", _write_csv(tmp_path),
+            "--eps", "0.5", "--min-points", "5",
+            "--max-points-per-partition", "128",
+            "--trace", trace,
+        ]
+    )
+    assert rc == 0
+    assert obs.state() is None
+    with open(trace) as f:
+        t = json.load(f)
+    assert any(e["name"] == "train" for e in t["traceEvents"])
+
+
 # --- overhead guard ---------------------------------------------------
 
 

@@ -1,0 +1,529 @@
+"""Trace analyzer + bench-history regression gate (dbscan_tpu/obs/).
+
+Two consumer surfaces pinned here:
+
+- `obs/analyze.py` on a HAND-BUILT synthetic trace with known
+  self-times and byte counters: the critical-path and bandwidth tables
+  must come out exactly (no tolerance — the fixture's arithmetic is the
+  spec);
+- `obs/bench_history.py` + `obs/regress.py`: every historical capture
+  shape normalizes, ingest is append-only/dedup-on-reingest, the gate
+  flags an injected 2x slowdown (exit 1) and stays green on identical
+  numbers (exit 0), hot/cold resident populations never mix, and the
+  noise-aware threshold widens to the history's own spread;
+- the `python -m` console entry points run as subprocesses on the
+  fixture trace and the committed `bench/history.jsonl` — the tier-1
+  smoke keeping the CLIs from rotting.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dbscan_tpu.obs import analyze, bench_history, regress
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- synthetic trace fixture ------------------------------------------
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+def _span(name, t0, dur, tid=1, depth=0, args=None, events=None):
+    return {
+        "type": "span", "name": name, "t0_s": t0, "dur_s": dur,
+        "depth": depth, "tid": tid, "args": args or {},
+        "events": events or [],
+    }
+
+
+@pytest.fixture
+def synthetic_trace(tmp_path):
+    """Nested spans with known self-times:
+
+    tid 1: root [0, 10]
+             phase.a [1, 4]  (contains phase.a.inner [2, 3])
+             phase.b [5, 7]
+             transfer.pull [7.5, 8.0] bytes=5e6
+    tid 2: worker [0, 2]  (separate thread: no nesting vs tid 1)
+
+    Exact self-times: root 4.5, phase.a 2.0, phase.b 2.0,
+    phase.a.inner 1.0, transfer.pull 0.5, worker 2.0.
+    """
+    records = [
+        _span("root", 0.0, 10.0),
+        _span("phase.a", 1.0, 3.0, depth=1),
+        _span("phase.a.inner", 2.0, 1.0, depth=2),
+        _span("phase.b", 5.0, 2.0, depth=1),
+        _span("transfer.pull", 7.5, 0.5, depth=1,
+              args={"bytes": 5_000_000}),
+        _span("worker", 0.0, 2.0, tid=2),
+        {"type": "instant", "name": "resident_cache.miss", "t_s": 0.5,
+         "args": {}},
+        {"type": "counter", "name": "transfer.h2d_bytes",
+         "value": 1_000_000},
+        {"type": "counter", "name": "transfer.payload_upload_bytes",
+         "value": 2_000_000},
+        {"type": "counter", "name": "transfer.payload_upload_s",
+         "value": 1.0},
+        {"type": "counter", "name": "transfer.d2h_bytes",
+         "value": 5_000_000},
+        {"type": "counter", "name": "transfer.d2h_s", "value": 0.5},
+        {"type": "counter", "name": "compiles.total", "value": 3},
+        {"type": "gauge", "name": "memory.peak_bytes_in_use",
+         "value": 1_234_567},
+        {"type": "gauge", "name": "memory.at.dispatch.dense",
+         "value": 1_000_000},
+    ]
+    return _write_jsonl(tmp_path / "trace.jsonl", records)
+
+
+def test_critical_path_table_exact(synthetic_trace):
+    report = analyze.analyze(analyze.load_trace(synthetic_trace))
+    rows = {r["name"]: r for r in report["phases"]}
+    assert rows["root"]["self_s"] == 4.5
+    assert rows["root"]["total_s"] == 10.0
+    assert rows["phase.a"]["self_s"] == 2.0
+    assert rows["phase.a.inner"]["self_s"] == 1.0
+    assert rows["phase.b"]["self_s"] == 2.0
+    assert rows["transfer.pull"]["self_s"] == 0.5
+    # the second thread's span never nests under tid 1's root
+    assert rows["worker"]["self_s"] == 2.0
+    # ordered by self-time descending; ties keep first-seen order
+    assert [r["name"] for r in report["phases"]] == [
+        "root", "phase.a", "phase.b", "worker", "phase.a.inner",
+        "transfer.pull",
+    ]
+    assert rows["phase.a"]["count"] == 1
+    assert rows["phase.a"]["mean_s"] == 3.0
+    assert rows["phase.a"]["max_s"] == 3.0
+
+
+def test_bandwidth_table_exact(synthetic_trace):
+    report = analyze.analyze(analyze.load_trace(synthetic_trace))
+    bw = {r["name"]: r for r in report["bandwidth"]}
+    assert bw["h2d (dispatch inputs, async)"]["bytes"] == 1_000_000
+    assert bw["h2d (dispatch inputs, async)"]["mb_per_s"] is None
+    up = bw["h2d payload upload"]
+    assert (up["bytes"], up["seconds"], up["mb_per_s"]) == (
+        2_000_000, 1.0, 2.0,
+    )
+    d2h = bw["d2h pulls (incl. device wait)"]
+    assert (d2h["bytes"], d2h["seconds"], d2h["mb_per_s"]) == (
+        5_000_000, 0.5, 10.0,
+    )
+    pulls = bw["d2h pull spans"]
+    assert (pulls["bytes"], pulls["seconds"], pulls["mb_per_s"]) == (
+        5_000_000, 0.5, 10.0,
+    )
+
+
+def test_memory_and_compiles_sections(synthetic_trace):
+    report = analyze.analyze(analyze.load_trace(synthetic_trace))
+    assert report["memory"] == {
+        "memory.at.dispatch.dense": 1_000_000,
+        "memory.peak_bytes_in_use": 1_234_567,
+    }
+    assert report["compiles"] == {"compiles.total": 3}
+
+
+def test_resident_hot_cold_split(tmp_path):
+    """Two train runs in one trace: the one whose window holds a miss
+    mark is cold, the one holding a hit mark is hot."""
+    records = [
+        _span("train", 0.0, 60.0),
+        _span("train", 100.0, 5.0),
+        {"type": "instant", "name": "resident_cache.miss", "t_s": 0.1,
+         "args": {}},
+        {"type": "instant", "name": "resident_cache.hit", "t_s": 100.1,
+         "args": {}},
+        {"type": "counter", "name": "resident_cache.hits", "value": 1},
+        {"type": "counter", "name": "resident_cache.misses", "value": 1},
+    ]
+    path = _write_jsonl(tmp_path / "hc.jsonl", records)
+    res = analyze.analyze(analyze.load_trace(path))["resident"]
+    assert res["hits"] == 1 and res["misses"] == 1
+    assert res["cold_walls_s"] == [60.0]
+    assert res["hot_walls_s"] == [5.0]
+    assert res["cold_mean_s"] == 60.0 and res["hot_mean_s"] == 5.0
+
+
+def test_chrome_and_jsonl_loaders_agree(tmp_path):
+    """The same run exported in both formats analyzes identically."""
+    from dbscan_tpu import obs
+
+    obs.disable()
+    obs.enable()
+    try:
+        with obs.span("outer"):
+            with obs.span("inner"):
+                pass
+        obs.count("transfer.h2d_bytes", 4096)
+        obs.gauge("memory.peak_bytes_in_use", 777)
+        jl = str(tmp_path / "t.jsonl")
+        ch = str(tmp_path / "t.json")
+        obs.write(jl)
+        obs.write(ch)
+    finally:
+        obs.disable()
+    rep_j = analyze.analyze(analyze.load_trace(jl))
+    rep_c = analyze.analyze(analyze.load_trace(ch))
+    names_j = [r["name"] for r in rep_j["phases"]]
+    names_c = [r["name"] for r in rep_c["phases"]]
+    assert set(names_j) == set(names_c) == {"outer", "inner"}
+    assert rep_j["memory"] == rep_c["memory"] == {
+        "memory.peak_bytes_in_use": 777
+    }
+    assert (
+        rep_j["bandwidth"][0]["bytes"]
+        == rep_c["bandwidth"][0]["bytes"]
+        == 4096
+    )
+
+
+def test_analyze_cli_smoke(synthetic_trace):
+    """Tier-1 smoke for the console entry point: the module must run
+    as `python -m dbscan_tpu.obs.analyze` on a fixture trace."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dbscan_tpu.obs.analyze", synthetic_trace],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "critical path" in proc.stdout
+    assert "root" in proc.stdout
+    assert "memory.peak_bytes_in_use" in proc.stdout
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "dbscan_tpu.obs.analyze",
+            synthetic_trace, "--json",
+        ],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)["n_spans"] == 6
+
+
+def test_analyze_missing_file_exits_2(tmp_path):
+    assert analyze.main([str(tmp_path / "nope.json")]) == 2
+
+
+# --- bench history: normalization + ingest ----------------------------
+
+
+def _capture(tmp_path, name, obj):
+    path = tmp_path / name
+    path.write_text(json.dumps(obj))
+    return str(path)
+
+
+BASE_CAPTURE = {
+    "metric": "dbscan_2d_euclidean_throughput",
+    "value": 0.75,
+    "unit": "Mpoints/s",
+    "backend": "tpu",
+    "seconds": 1.3,
+    "anchor_seconds": 32.0,
+    "n_clusters": 48,  # not a perf key: must NOT become a record
+    "cosine_seconds": 5.1,
+    "cosine_resident_hot": True,
+}
+
+
+def test_resident_hot_false_is_preserved(tmp_path):
+    """resident_hot=False (a COLD rep) is a tag, not a missing tag: a
+    falsy-coalescing bug here would gate cold walls against the
+    untagged population."""
+    path = _capture(
+        tmp_path, "BENCH_COLDTAG.json",
+        {"backend": "tpu", "seconds": 55.0, "resident_hot": False,
+         "cosine_seconds": 60.0, "cosine_resident_hot": False},
+    )
+    recs = {r["metric"]: r for r in bench_history.parse_capture_file(path)}
+    assert recs["seconds"]["resident_hot"] is False
+    assert recs["cosine_seconds"]["resident_hot"] is False
+
+
+def test_resident_tag_covers_all_row_metrics(tmp_path):
+    """One {prefix}_resident_hot tag covers EVERY metric of that row —
+    vs_baseline and the upload/compute splits are derived from the same
+    bimodal wall as the seconds figure."""
+    path = _capture(
+        tmp_path, "BENCH_ROWTAG.json",
+        {"backend": "tpu", "cosine_seconds": 8.6,
+         "cosine_vs_baseline": 24.1, "cosine_compute_s": 8.4,
+         "cosine_resident_hot": True, "anchor_seconds": 32.0},
+    )
+    recs = {r["metric"]: r for r in bench_history.parse_capture_file(path)}
+    assert recs["cosine_seconds"]["resident_hot"] is True
+    assert recs["cosine_vs_baseline"]["resident_hot"] is True
+    assert recs["cosine_compute_s"]["resident_hot"] is True
+    assert recs["anchor_seconds"]["resident_hot"] is None
+
+
+def test_normalize_metric_capture(tmp_path):
+    path = _capture(tmp_path, "BENCH_X.json", BASE_CAPTURE)
+    recs = bench_history.parse_capture_file(path, rev="abc123")
+    by_metric = {r["metric"]: r for r in recs}
+    assert set(by_metric) == {
+        "dbscan_2d_euclidean_throughput", "seconds", "anchor_seconds",
+        "cosine_seconds",
+    }
+    head = by_metric["dbscan_2d_euclidean_throughput"]
+    assert head["value"] == 0.75 and head["unit"] == "Mpoints/s"
+    assert head["backend"] == "tpu" and head["rev"] == "abc123"
+    assert head["source"] == "BENCH_X.json"
+    assert by_metric["anchor_seconds"]["unit"] == "s"
+    assert by_metric["anchor_seconds"]["resident_hot"] is None
+    # the hot/cold tag rides the metric it covers
+    assert by_metric["cosine_seconds"]["resident_hot"] is True
+
+
+def test_normalize_wrapper_and_multichip(tmp_path):
+    wrapper = {
+        "n": 2, "cmd": "python bench.py", "rc": 0,
+        "parsed": {"metric": "m", "value": 2.0, "unit": "Mpoints/s",
+                   "backend": "tpu", "seconds": 3.5},
+        "tail": 'noise\n{"metric": "m", "value": 1.0, "unit": '
+                '"Mpoints/s", "backend": "tpu", "seconds": 4.0}\n'
+                "not json {\n",
+    }
+    path = _capture(tmp_path, "BENCH_W.json", wrapper)
+    recs = bench_history.parse_capture_file(path)
+    vals = sorted(
+        r["value"] for r in recs if r["metric"] == "seconds"
+    )
+    assert vals == [3.5, 4.0]  # parsed record + embedded tail line
+    mc = _capture(
+        tmp_path, "MULTICHIP_X.json",
+        {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+         "tail": "..."},
+    )
+    recs = bench_history.parse_capture_file(mc)
+    assert recs == [
+        {
+            "metric": "multichip_ok", "value": 1.0, "unit": None,
+            "backend": "multichip8", "resident_hot": None,
+            "rev": "unknown", "source": "MULTICHIP_X.json",
+        }
+    ]
+
+
+def test_ingest_append_only_dedup(tmp_path):
+    cap = _capture(tmp_path, "BENCH_A.json", BASE_CAPTURE)
+    hist = str(tmp_path / "history.jsonl")
+    added, skipped = bench_history.ingest([cap], hist, rev="r1")
+    assert added == 4 and skipped == 0
+    # re-ingest: nothing appended, nothing rewritten
+    before = open(hist).read()
+    added, skipped = bench_history.ingest([cap], hist, rev="r1")
+    assert added == 0 and skipped == 4
+    assert open(hist).read() == before
+    assert not bench_history.check_schema(bench_history.load_history(hist))
+
+
+# --- regression gate --------------------------------------------------
+
+
+def _mk_history(tmp_path, sources):
+    """History from several capture dicts (one source each)."""
+    hist = str(tmp_path / "history.jsonl")
+    for i, obj in enumerate(sources):
+        cap = _capture(tmp_path, f"BENCH_H{i}.json", obj)
+        bench_history.ingest([cap], hist, rev=f"r{i}")
+    return hist
+
+
+def test_regress_green_on_identical_red_on_2x(tmp_path):
+    hist = _mk_history(
+        tmp_path,
+        [
+            {"backend": "tpu", "anchor_seconds": 32.0, "value": 0.75,
+             "metric": "thr", "unit": "Mpoints/s"},
+            {"backend": "tpu", "anchor_seconds": 33.0, "value": 0.73,
+             "metric": "thr", "unit": "Mpoints/s"},
+            {"backend": "tpu", "anchor_seconds": 31.5, "value": 0.76,
+             "metric": "thr", "unit": "Mpoints/s"},
+        ],
+    )
+    same = _capture(
+        tmp_path, "BENCH_FRESH.json",
+        {"backend": "tpu", "anchor_seconds": 32.2, "value": 0.75,
+         "metric": "thr", "unit": "Mpoints/s"},
+    )
+    assert regress.main(["--history", hist, "--capture", same]) == 0
+    slow = _capture(
+        tmp_path, "BENCH_SLOW.json",
+        {"backend": "tpu", "anchor_seconds": 64.0, "value": 0.75,
+         "metric": "thr", "unit": "Mpoints/s"},
+    )
+    assert regress.main(["--history", hist, "--capture", slow]) == 1
+    # throughput regressing DOWN flags too
+    slow_thr = _capture(
+        tmp_path, "BENCH_SLOWTHR.json",
+        {"backend": "tpu", "anchor_seconds": 32.0, "value": 0.3,
+         "metric": "thr", "unit": "Mpoints/s"},
+    )
+    assert regress.main(["--history", hist, "--capture", slow_thr]) == 1
+
+
+def test_regress_hot_cold_populations_never_mix(tmp_path):
+    """A cold cosine wall ~10x the hot wall is NOT a regression when
+    the history's cold population says so — and a 2x slowdown within
+    the cold population still flags."""
+    hot = {"backend": "tpu", "cosine_seconds": 5.0,
+           "cosine_resident_hot": True}
+    cold = {"backend": "tpu", "cosine_seconds": 55.0,
+            "cosine_resident_hot": False}
+    hist = _mk_history(
+        tmp_path,
+        [hot, cold,
+         {**hot, "cosine_seconds": 5.2},
+         {**cold, "cosine_seconds": 58.0}],
+    )
+    fresh_cold = _capture(
+        tmp_path, "BENCH_COLD.json",
+        {"backend": "tpu", "cosine_seconds": 56.0,
+         "cosine_resident_hot": False},
+    )
+    assert regress.main(["--history", hist, "--capture", fresh_cold]) == 0
+    slow_cold = _capture(
+        tmp_path, "BENCH_COLDSLOW.json",
+        {"backend": "tpu", "cosine_seconds": 113.0,
+         "cosine_resident_hot": False},
+    )
+    assert regress.main(["--history", hist, "--capture", slow_cold]) == 1
+
+
+def test_regress_noise_aware_threshold(tmp_path):
+    """A metric whose history already swings 2x cannot flag at 25%: the
+    effective threshold widens to the observed spread."""
+    hist = _mk_history(
+        tmp_path,
+        [{"backend": "tpu", "cosine_seconds": 10.0},
+         {"backend": "tpu", "cosine_seconds": 30.0},
+         {"backend": "tpu", "cosine_seconds": 20.0}],
+    )
+    fresh = _capture(
+        tmp_path, "BENCH_N.json",
+        {"backend": "tpu", "cosine_seconds": 29.0},  # +45% over median
+    )
+    assert regress.main(["--history", hist, "--capture", fresh]) == 0
+    way_out = _capture(
+        tmp_path, "BENCH_N2.json",
+        {"backend": "tpu", "cosine_seconds": 80.0},  # past spread too
+    )
+    assert regress.main(["--history", hist, "--capture", way_out]) == 1
+
+
+def test_regress_min_samples_and_backend_isolation(tmp_path):
+    hist = _mk_history(
+        tmp_path, [{"backend": "tpu", "anchor_seconds": 32.0}]
+    )
+    # one sample < min 2 -> skipped, not gated
+    fresh = _capture(
+        tmp_path, "BENCH_S.json",
+        {"backend": "tpu", "anchor_seconds": 500.0},
+    )
+    assert regress.main(["--history", hist, "--capture", fresh]) == 0
+    # a cpu capture never gates against tpu history
+    cpu = _capture(
+        tmp_path, "BENCH_CPU.json",
+        {"backend": "cpu", "anchor_seconds": 500.0},
+    )
+    assert regress.main(["--history", hist, "--capture", cpu]) == 0
+
+
+def test_regress_check_schema_catches_bad_records(tmp_path):
+    hist = str(tmp_path / "history.jsonl")
+    with open(hist, "w") as f:
+        f.write(json.dumps({"metric": "m", "value": 1.0, "source": "s"}))
+        f.write("\n")
+        f.write(json.dumps({"metric": "m", "value": "fast"}))
+        f.write("\n")
+    assert regress.main(["--history", hist, "--check-schema"]) == 2
+    assert regress.main(
+        ["--history", str(tmp_path / "absent.jsonl"), "--check-schema"]
+    ) == 2
+
+
+def test_regress_cli_smoke_on_committed_history():
+    """Tier-1 smoke: the committed bench/history.jsonl (ingested from
+    the root BENCH_*/MULTICHIP_* captures) passes --check-schema via
+    the real console entry point."""
+    hist = os.path.join(REPO, "bench", "history.jsonl")
+    assert os.path.exists(hist), (
+        "bench/history.jsonl missing — re-ingest with "
+        "python -m dbscan_tpu.obs.bench_history BENCH_*.json "
+        "MULTICHIP_*.json"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "dbscan_tpu.obs.regress",
+            "--check-schema", "--history", hist,
+        ],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "schema ok" in proc.stdout
+
+
+def test_bench_history_gate_before_append(tmp_path):
+    """bench.py's BENCH_HISTORY hook gates against the PRIOR history
+    and refuses to ingest a regressed capture — one bad run must not
+    enter its own baseline and widen the noise threshold over itself."""
+    import bench
+
+    hist = _mk_history(
+        tmp_path,
+        [{"backend": "tpu", "anchor_seconds": 32.0},
+         {"backend": "tpu", "anchor_seconds": 33.0}],
+    )
+    before = open(hist).read()
+    assert (
+        bench._history_gate_append(
+            {"backend": "tpu", "anchor_seconds": 64.0}, hist
+        )
+        is False
+    )
+    assert open(hist).read() == before  # nothing ingested
+    assert (
+        bench._history_gate_append(
+            {"backend": "tpu", "anchor_seconds": 32.5}, hist
+        )
+        is True
+    )
+    new = bench_history.load_history(hist)
+    assert any(
+        r["metric"] == "anchor_seconds" and r["value"] == 32.5
+        for r in new
+    )
+
+
+def test_regress_gate_against_committed_history(tmp_path):
+    """Acceptance criterion end-to-end: against the INGESTED history, a
+    real capture's numbers pass and a synthetic 2x slowdown of the same
+    capture exits nonzero."""
+    hist = os.path.join(REPO, "bench", "history.jsonl")
+    src = os.path.join(REPO, "BENCH_TPU_r05.json")
+    if not (os.path.exists(hist) and os.path.exists(src)):
+        pytest.skip("committed history/captures not present")
+    assert regress.main(["--history", hist, "--capture", src]) == 0
+    obj = json.loads(open(src).readline())
+    for k in list(obj):
+        if k.endswith("_seconds") or k == "seconds":
+            obj[k] = obj[k] * 2
+    slow = _capture(tmp_path, "BENCH_2X.json", obj)
+    assert regress.main(["--history", hist, "--capture", slow]) == 1
