@@ -20,12 +20,14 @@ labeled_points / partitions / predict) while staying idiomatic JAX.
 
 import os as _os
 
+from dbscan_tpu.config import env as _env
+
 # Persistent XLA compilation cache: the banded/dense executors compile one
 # program per (bucket width, slab) shape — ~2 min of XLA time at 10M-point
 # scale — and identical shapes recur across processes (ladder widths are
 # quantized). Defers to any cache the user already configured (their env
 # var or a prior jax.config call); opt out with DBSCAN_TPU_NO_COMPILE_CACHE=1.
-if not _os.environ.get("DBSCAN_TPU_NO_COMPILE_CACHE"):
+if not _env("DBSCAN_TPU_NO_COMPILE_CACHE"):
     import jax as _jax
 
     if (
@@ -34,10 +36,7 @@ if not _os.environ.get("DBSCAN_TPU_NO_COMPILE_CACHE"):
     ):
         _jax.config.update(
             "jax_compilation_cache_dir",
-            _os.environ.get(
-                "DBSCAN_TPU_COMPILE_CACHE_DIR",
-                _os.path.expanduser("~/.cache/dbscan_tpu_xla"),
-            ),
+            _os.path.expanduser(_env("DBSCAN_TPU_COMPILE_CACHE_DIR")),
         )
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 

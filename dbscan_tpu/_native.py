@@ -70,9 +70,9 @@ def lib() -> Optional[ctypes.CDLL]:
     global _lib, _lib_failed
     if _lib is not None or _lib_failed:
         return _lib
-    if os.environ.get("DBSCAN_TPU_NATIVE", "1") == "0" or not os.path.exists(
-        _SRC
-    ):
+    from dbscan_tpu.config import env as _env
+
+    if not _env("DBSCAN_TPU_NATIVE") or not os.path.exists(_SRC):
         _lib_failed = True
         return None
     if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(

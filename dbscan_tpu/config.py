@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import os
 
 
 class Engine(str, enum.Enum):
@@ -201,3 +202,203 @@ class DBSCANConfig:
                 f"chord kernel, ops/sphere.py), got {self.metric!r}"
             )
         return self
+
+
+# --- environment-variable registry ------------------------------------
+#
+# Every ``DBSCAN_*`` environment read in the package goes through
+# :func:`env` against this table — the one place a knob's name, type,
+# default, and doc live. The static analyzer (``dbscan_tpu.lint``, rule
+# family ``env-*``) rejects any direct ``os.environ``/``os.getenv`` read
+# of a ``DBSCAN_*`` name outside this module and any :func:`env` call
+# naming an undeclared variable, and requires every declared name to
+# have its table row in PARITY.md (regenerate that table with
+# ``python -m dbscan_tpu.lint --env-table``).
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    """One declared environment knob.
+
+    ``kind``: "bool" (true iff the value is 1/true/yes/on,
+    case-insensitive; anything else including empty is false),
+    "int", "float", or "str". ``default`` is the parsed-type value
+    used when the variable is unset (may be None for pure-optional
+    strings like DBSCAN_TRACE).
+    """
+
+    name: str
+    kind: str
+    default: object
+    doc: str
+
+
+_TRUE = ("1", "true", "yes", "on")
+
+
+def _env_table(*rows: EnvVar) -> dict:
+    return {r.name: r for r in rows}
+
+
+ENV_VARS = _env_table(
+    EnvVar(
+        "DBSCAN_TPU_NATIVE", "bool", True,
+        "Enable the compiled native host runtime (_native.py); 0 forces "
+        "the numpy fallbacks.",
+    ),
+    EnvVar(
+        "DBSCAN_TPU_NO_COMPILE_CACHE", "bool", False,
+        "Opt out of the persistent XLA compilation cache the package "
+        "configures at import.",
+    ),
+    EnvVar(
+        "DBSCAN_TPU_COMPILE_CACHE_DIR", "str",
+        "~/.cache/dbscan_tpu_xla",
+        "Directory for the persistent XLA compilation cache (used only "
+        "when no cache is already configured).",
+    ),
+    EnvVar(
+        "DBSCAN_GROUP_SLOTS", "int", 1 << 26,
+        "Padded-slot budget per dispatch group (binning packer and the "
+        "checkpoint chunk tags).",
+    ),
+    EnvVar(
+        "DBSCAN_COMPACT_CHUNK_SLOTS", "int", 1 << 26,
+        "Padded slots per compact phase-1 device chunk; clamped to "
+        "[2^16, 2^28] (driver warns on clamp). Saved chunks are stamped "
+        "with the value, so changing it invalidates prior checkpoints.",
+    ),
+    EnvVar(
+        "DBSCAN_INFLIGHT_SLOTS", "int", 1 << 27,
+        "Dispatched-but-unretired slot budget (dispatch backpressure); "
+        "1 = fully synchronous dispatch.",
+    ),
+    EnvVar(
+        "DBSCAN_PALLAS_SP", "bool", False,
+        "Route banded phase 1 through the scalar-prefetch Pallas "
+        "kernels (ops/pallas_banded_sp.py).",
+    ),
+    EnvVar(
+        "DBSCAN_RESIDENT_CACHE", "bool", True,
+        "Resident-payload device cache across runs (driver); 0 disables "
+        "— every run re-uploads its payload.",
+    ),
+    EnvVar(
+        "DBSCAN_TIME_DEVICE", "bool", False,
+        "Spans/timings block on device outputs at phase boundaries so "
+        "walls attribute to the dispatch that did the work.",
+    ),
+    EnvVar(
+        "DBSCAN_NO_COMPACT", "bool", False,
+        "Disable the compact phase-1 chunk path for banded runs "
+        "(debugging aid).",
+    ),
+    EnvVar(
+        "DBSCAN_EAGER_PULL", "bool", False,
+        "Pull each compact chunk to host as soon as it flushes instead "
+        "of at the postdispatch tail.",
+    ),
+    EnvVar(
+        "DBSCAN_SPILL_DEVICE", "str", "auto",
+        "Spill-tree device passes: 1 forces the accelerator path, 0 "
+        "forces host BLAS, auto uses the device when a non-CPU backend "
+        "is live.",
+    ),
+    EnvVar(
+        "DBSCAN_COMPILE_STORM_THRESHOLD", "int", 12,
+        "Compiles per dispatch family past which obs/compile.py logs a "
+        "once-per-family recompile-storm warning; <=0 disables.",
+    ),
+    EnvVar(
+        "DBSCAN_TRACE", "str", None,
+        "Path that activates observability at the pipeline entry points "
+        "and receives the trace (Chrome JSON, or JSONL for .jsonl).",
+    ),
+    EnvVar(
+        "DBSCAN_TRACE_MAX_SPANS", "int", 200000,
+        "Span retention bound: past it the tracer drops the OLDEST half "
+        "and reports dropped_spans in the export.",
+    ),
+    EnvVar(
+        "DBSCAN_FAULT_SPEC", "str", "",
+        "Deterministic fault-injection spec, semicolon-separated "
+        "site#ordinal:KIND[*count] clauses (faults.parse_fault_spec).",
+    ),
+    EnvVar(
+        "DBSCAN_FAULT_RETRIES", "int", 3,
+        "Override of DBSCANConfig.fault_max_retries for every "
+        "supervised dispatch site.",
+    ),
+    EnvVar(
+        "DBSCAN_FAULT_BACKOFF_S", "float", 0.05,
+        "Override of DBSCANConfig.fault_backoff_base_s (exponential "
+        "backoff base seconds).",
+    ),
+    EnvVar(
+        "DBSCAN_FAULT_SEED", "int", 0,
+        "Seed for the deterministic backoff jitter.",
+    ),
+    EnvVar(
+        "DBSCAN_FAULT_SYNC", "bool", False,
+        "Force supervised dispatches to block on their outputs so async "
+        "device faults attribute to the dispatch site.",
+    ),
+)
+
+
+def env(name: str, default: object = None):
+    """Typed read of a declared ``DBSCAN_*`` environment variable.
+
+    ``default`` (when not None) overrides the table default for callers
+    whose fallback is contextual (e.g. a DBSCANConfig field). Raises
+    KeyError on an undeclared name — adding the table row (and its
+    PARITY.md line) IS the registration step the linter enforces.
+    """
+    spec = ENV_VARS[name]
+    raw = os.environ.get(name)
+    if default is None:
+        default = spec.default
+    if raw is None or raw.strip() == "":
+        # exported-but-empty means "use the default", matching the
+        # pre-registry call sites (an empty DBSCAN_TPU_NATIVE must not
+        # silently disable the native runtime)
+        return default
+    if spec.kind == "bool":
+        return raw.strip().lower() in _TRUE
+    try:
+        if spec.kind == "int":
+            return int(raw)
+        if spec.kind == "float":
+            return float(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"{name}={raw!r} is not a valid {spec.kind}: {e}"
+        ) from None
+    return raw
+
+
+def parity_env_table() -> str:
+    """The PARITY.md environment-variable table, generated from
+    :data:`ENV_VARS` (``python -m dbscan_tpu.lint --env-table``
+    prints it)."""
+    lines = [
+        "| Variable | Type | Default | Effect |",
+        "|---|---|---|---|",
+    ]
+    for name in sorted(ENV_VARS):
+        v = ENV_VARS[name]
+        if v.default is None:
+            default = "unset"
+        elif v.kind == "bool":
+            default = "on" if v.default else "off"
+        elif (
+            v.kind == "int"
+            and v.default >= 1 << 16
+            and v.default & (v.default - 1) == 0
+        ):
+            default = f"2^{v.default.bit_length() - 1}"
+        else:
+            default = str(v.default)
+        lines.append(f"| `{name}` | {v.kind} | {default} | {v.doc} |")
+    return "\n".join(lines)
+

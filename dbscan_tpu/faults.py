@@ -46,7 +46,6 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import os
 import re
 import time
 import zlib
@@ -54,7 +53,7 @@ from typing import Callable, Optional, Tuple
 
 import numpy as np
 
-from dbscan_tpu import obs
+from dbscan_tpu import config, obs
 from dbscan_tpu.obs import memory as _obs_memory
 
 logger = logging.getLogger(__name__)
@@ -213,7 +212,7 @@ def get_registry() -> FaultRegistry:
     (re-parsed — with fresh ordinal counters — whenever the env value
     changes, so tests can monkeypatch the spec per test)."""
     global _registry, _registry_spec
-    spec = os.environ.get("DBSCAN_FAULT_SPEC", "")
+    spec = config.env("DBSCAN_FAULT_SPEC")
     if _registry is None or spec != _registry_spec:
         _registry = FaultRegistry(spec)
         _registry_spec = spec
@@ -320,22 +319,22 @@ class RetryPolicy:
         ``cfg`` may be None (sites with no config in scope): dataclass
         defaults apply, env overrides still win."""
         retries = int(
-            os.environ.get(
+            config.env(
                 "DBSCAN_FAULT_RETRIES",
-                str(getattr(cfg, "fault_max_retries", 3)),
+                default=getattr(cfg, "fault_max_retries", 3),
             )
         )
         base = float(
-            os.environ.get(
+            config.env(
                 "DBSCAN_FAULT_BACKOFF_S",
-                str(getattr(cfg, "fault_backoff_base_s", 0.05)),
+                default=getattr(cfg, "fault_backoff_base_s", 0.05),
             )
         )
         return cls(
             max_retries=max(0, retries),
             backoff_base_s=max(0.0, base),
             backoff_max_s=float(getattr(cfg, "fault_backoff_max_s", 2.0)),
-            seed=int(os.environ.get("DBSCAN_FAULT_SEED", "0")),
+            seed=int(config.env("DBSCAN_FAULT_SEED")),
         )
 
     def backoff(self, attempt: int, rng: np.random.Generator) -> float:
@@ -358,7 +357,7 @@ def sync_mode(registry: Optional[FaultRegistry] = None) -> bool:
     faults surface AT the dispatch site (group-granular retry): any
     fault spec active, or ``DBSCAN_FAULT_SYNC=1``."""
     reg = registry if registry is not None else get_registry()
-    return reg.active or os.environ.get("DBSCAN_FAULT_SYNC") == "1"
+    return reg.active or bool(config.env("DBSCAN_FAULT_SYNC"))
 
 
 def supervised(

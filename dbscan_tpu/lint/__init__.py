@@ -1,0 +1,105 @@
+"""graftlint: AST-based static analysis for TPU hazards and telemetry
+contracts.
+
+Four rule families over the package source (no execution of the linted
+code; the schema/env cross-checks import the DECLARED registries —
+:mod:`dbscan_tpu.obs.schema` and ``config.ENV_VARS`` — not the linted
+files)::
+
+    python -m dbscan_tpu.lint [--format text|json] [paths...]
+
+- **host-sync** (``host-sync-item`` / ``host-sync-cast`` /
+  ``host-sync-asarray``): implicit device->host syncs in functions
+  reachable from a jit site (lint/callgraph.py builds the trace-time
+  call graph);
+- **recompile** (``jit-in-loop`` / ``jit-scalar-arg`` /
+  ``dtype-drift``): patterns that mint fresh jit signatures or upcast
+  f32 kernels;
+- **telemetry-schema** (``schema-counter`` / ``schema-gauge`` /
+  ``schema-span`` / ``schema-event`` / ``schema-dynamic`` /
+  ``schema-family``): every emitted telemetry name must be declared in
+  ``obs/schema.py``;
+- **env-registry** (``env-direct-read`` / ``env-undeclared`` /
+  ``env-parity``): every ``DBSCAN_*`` read goes through
+  ``config.env`` against the declared table, which PARITY.md mirrors.
+
+Suppress a finding on its line with a REQUIRED reason::
+
+    x = arr.item()  # graftlint: disable=host-sync-item  single scalar at run end
+
+Exit codes: 0 clean, 1 findings, 2 usage/IO error — the same contract
+``tests/test_lint.py`` pins and CI gates on.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+from dbscan_tpu.lint.core import (  # noqa: F401
+    Finding,
+    Package,
+    load_package,
+    run_rules,
+)
+
+#: rule id -> one-line description (the --list-rules catalog)
+RULES = {
+    "host-sync-item": ".item() in jit-reachable code (device->host sync)",
+    "host-sync-cast": "float()/int()/bool() on an array expression in "
+    "jit-reachable code",
+    "host-sync-asarray": "np.asarray/np.array on a traced array in "
+    "jit-reachable code",
+    "jit-in-loop": "jax.jit(...) constructed inside a loop body",
+    "jit-scalar-arg": "Python scalar/tuple literal passed positionally "
+    "to a jit with no statics",
+    "dtype-drift": "float64 dtype literal in f32/bf16 kernel code "
+    "(ops/, spill_device.py)",
+    "schema-counter": "emitted counter name not declared in obs/schema.py",
+    "schema-gauge": "emitted gauge name not declared in obs/schema.py",
+    "schema-span": "emitted span name not declared in obs/schema.py",
+    "schema-event": "emitted event name not declared in obs/schema.py",
+    "schema-dynamic": "dynamic telemetry name whose literal prefix "
+    "matches nothing declared",
+    "schema-family": "compile family / memory site literal not in the "
+    "schema generator sets",
+    "env-direct-read": "os.environ read of a DBSCAN_* name outside "
+    "config.py",
+    "env-undeclared": "config.env() of a name missing from "
+    "config.ENV_VARS",
+    "env-parity": "declared env var missing from PARITY.md",
+    "suppress-no-reason": "graftlint suppression without a reason text",
+    "suppress-unknown-rule": "graftlint suppression naming an unknown "
+    "rule id",
+    "parse-error": "file does not parse",
+}
+
+
+def _rule_fns():
+    from dbscan_tpu.lint import envvars, hostsync, recompile, telemetry
+
+    return (hostsync.check, recompile.check, telemetry.check, envvars.check)
+
+
+def lint_paths(paths: Iterable[str]) -> Tuple[List[Finding], int]:
+    """Lint files/directories; returns (findings, files_scanned)."""
+    pkg = load_package(paths)
+    findings = run_rules(pkg, _rule_fns(), RULES)
+    # drop exact duplicates (a nested reachable function is visited via
+    # its parent's body walk too)
+    seen = set()
+    uniq = []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(f)
+    return uniq, len(pkg.files)
+
+
+def lint_package() -> Tuple[List[Finding], int]:
+    """Lint the installed dbscan_tpu package directory."""
+    import os
+
+    import dbscan_tpu
+
+    return lint_paths([os.path.dirname(os.path.abspath(dbscan_tpu.__file__))])

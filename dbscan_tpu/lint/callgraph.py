@@ -1,0 +1,465 @@
+"""Package call graph: jit roots, trace-time reachability, and jit
+call-site metadata.
+
+What counts as a jit root (a function whose body runs under tracing):
+
+- ``@jax.jit`` / ``@jit`` decorated functions;
+- ``@functools.partial(jax.jit, static_argnums=/static_argnames=...)``
+  (and the bare ``partial`` spelling);
+- functions WRAPPED at a call site — ``jax.jit(block)``,
+  ``jax.jit(jax.shard_map(block, ...))`` (the shard_map/vmap/pmap
+  wrapper is transparent), ``jax.jit(lambda ...)``. Name lookup is
+  scope-aware: the repo's builder idiom defines a local ``block``/``fn``
+  per builder, so ``jax.jit(fn)`` resolves through the lexical scope
+  chain, not a flat module table;
+
+plus everything transitively called from a root through names the
+import maps and scope chains can resolve WITHIN the linted file set
+(jnp./lax. calls resolve nowhere and stop the walk, by design). The
+reachable set is what the host-sync rules scan: a ``.item()`` there
+either breaks under trace or silently syncs the host when the helper
+is also used outside jit — both reportable.
+
+Also exported for runtime use: :func:`tracked_call_sites` maps every
+``obs_compile.tracked_call("<family>", ...)`` literal to its file:line,
+which `obs/compile.py` folds into the recompile-storm warning so the
+log names the dispatch site, not just the family.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+_JIT_NAMES = ("jit",)  # attribute or bare name
+_WRAPPER_ATTRS = ("shard_map", "pmap", "vmap", "checkpoint", "remat")
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` as an expression."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_NAMES
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    return False
+
+
+def _jit_statics(call: ast.Call) -> bool:
+    """Whether a ``jax.jit(...)`` / ``partial(jax.jit, ...)`` call names
+    static_argnums/static_argnames."""
+    return any(
+        kw.arg in ("static_argnums", "static_argnames")
+        for kw in call.keywords
+        if kw.arg
+    )
+
+
+def _is_partial(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute):
+        return node.attr == "partial"
+    if isinstance(node, ast.Name):
+        return node.id == "partial"
+    return False
+
+
+class FuncInfo:
+    """One function definition in the linted set."""
+
+    def __init__(
+        self,
+        module: "ModuleInfo",
+        node: ast.AST,
+        qualname: str,
+        scope_node: ast.AST,
+    ):
+        self.module = module
+        self.node = node  # FunctionDef | AsyncFunctionDef | Lambda
+        self.qualname = qualname
+        self.scope_node = scope_node  # enclosing module/function node
+        self.is_jit_root = False
+        self.jit_has_statics = False
+        self.static_params: Set[str] = set()
+        self.jit_site: Optional[Tuple[str, int]] = None
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+    @property
+    def path(self) -> str:
+        return self.module.path
+
+
+class ModuleInfo:
+    """Per-module function index, lexical scope tables, import maps."""
+
+    def __init__(self, path: str, modname: str, tree: ast.Module):
+        self.path = path
+        self.modname = modname
+        self.tree = tree
+        #: module-level simple-name table (outermost def wins)
+        self.functions: Dict[str, FuncInfo] = {}
+        #: id(scope node) -> {simple name -> FuncInfo} for every scope
+        self.scopes: Dict[int, Dict[str, FuncInfo]] = {id(tree): {}}
+        self.all_functions: List[FuncInfo] = []
+        self.import_alias: Dict[str, str] = {}  # alias -> module dotted
+        self.from_names: Dict[str, Tuple[str, str]] = {}  # name -> (mod, orig)
+
+    def resolve_scoped(
+        self, name: str, scope_chain: List[ast.AST]
+    ) -> Optional[FuncInfo]:
+        """Look ``name`` up through the lexical scope chain (innermost
+        first), falling back to the module table."""
+        for scope in reversed(scope_chain):
+            info = self.scopes.get(id(scope), {}).get(name)
+            if info is not None:
+                return info
+        return self.functions.get(name)
+
+
+class CallGraph:
+    def __init__(self):
+        self.modules: Dict[str, ModuleInfo] = {}  # path -> module
+        self.by_modname: Dict[str, ModuleInfo] = {}
+        self.reachable: Set[int] = set()  # id(FuncInfo.node)
+        self.func_of_node: Dict[int, FuncInfo] = {}
+        #: names bound to jitted callables (decorated functions and
+        #: ``g = jax.jit(f)`` assignments): (module path, name) ->
+        #: has_statics — the recompile scalar-arg rule's lookup table
+        self.jitted_names: Dict[Tuple[str, str], bool] = {}
+
+    def func_for(self, node: ast.AST) -> Optional[FuncInfo]:
+        return self.func_of_node.get(id(node))
+
+    def in_reachable(self, node: ast.AST) -> bool:
+        return id(node) in self.reachable
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name by walking up through __init__.py packages;
+    a bare file (fixtures) is just its stem."""
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(path)
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        d = os.path.dirname(d)
+    parts.reverse()
+    if parts[-1] == "__init__":
+        parts.pop()
+    return ".".join(parts)
+
+
+def _resolve_relative(modname: str, level: int, target: str) -> str:
+    """Resolve ``from ..a import b`` inside module ``modname``."""
+    base = modname.split(".")
+    base = base[: max(0, len(base) - level)]
+    if target:
+        base = base + target.split(".")
+    return ".".join(base)
+
+
+def _index_module(path: str, tree: ast.Module) -> ModuleInfo:
+    mod = ModuleInfo(path, module_name_for(path), tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mod.import_alias[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            src = node.module or ""
+            if node.level:
+                src = _resolve_relative(mod.modname, node.level, src)
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                mod.from_names[a.asname or a.name] = (src, a.name)
+
+    def visit(node, scope_node, prefix):
+        # one walker: a new lexical scope opens ONLY at a function def;
+        # classes qualify names but defs inside if/try/loop bodies (and
+        # class bodies) register into the enclosing scope_node's table
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                info = FuncInfo(
+                    mod, child, f"{mod.modname}.{q}", scope_node
+                )
+                mod.scopes.setdefault(id(scope_node), {}).setdefault(
+                    child.name, info
+                )
+                mod.functions.setdefault(child.name, info)
+                mod.all_functions.append(info)
+                visit(child, child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                # methods are not bare-name callable: park them in the
+                # class node's (unreachable) scope table
+                visit(child, child, f"{prefix}{child.name}.")
+            else:
+                visit(child, scope_node, prefix)
+
+    visit(tree, tree, "")
+    return mod
+
+
+def _static_params(fn_node, call: Optional[ast.Call]) -> Set[str]:
+    """Parameter names marked static on the jit wrapping, resolved
+    against the function's positional signature for static_argnums."""
+    if call is None:
+        return set()
+    out: Set[str] = set()
+    args = [a.arg for a in fn_node.args.args] if hasattr(fn_node, "args") else []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.add(el.value)
+        elif kw.arg == "static_argnums":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                    if 0 <= el.value < len(args):
+                        out.add(args[el.value])
+    return out
+
+
+def _unwrap_jit_target(call: ast.Call) -> Optional[ast.AST]:
+    """The expression jax.jit ultimately compiles: unwraps transparent
+    wrappers (shard_map/vmap/pmap/partial) down to a Name or Lambda."""
+    if not call.args:
+        return None
+    target = call.args[0]
+    depth = 0
+    while isinstance(target, ast.Call) and depth < 6:
+        f = target.func
+        attr = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if attr in _WRAPPER_ATTRS or attr == "partial":
+            if not target.args:
+                return None
+            target = target.args[0]
+            depth += 1
+            continue
+        break
+    return target
+
+
+class _JitSiteVisitor(ast.NodeVisitor):
+    """Scope-tracking pass that marks call-site jit wrappings."""
+
+    def __init__(self, cg: CallGraph, mod: ModuleInfo):
+        self.cg = cg
+        self.mod = mod
+        self.scope_chain: List[ast.AST] = [mod.tree]
+
+    def _enter(self, node):
+        self.scope_chain.append(node)
+        self.generic_visit(node)
+        self.scope_chain.pop()
+
+    visit_FunctionDef = _enter
+    visit_AsyncFunctionDef = _enter
+
+    def visit_Call(self, node: ast.Call):
+        if _is_jax_jit(node.func):
+            has_statics = _jit_statics(node)
+            target = _unwrap_jit_target(node)
+            if isinstance(target, ast.Lambda):
+                info = FuncInfo(
+                    self.mod,
+                    target,
+                    f"{self.mod.modname}.<lambda>",
+                    self.scope_chain[-1],
+                )
+                info.is_jit_root = True
+                info.jit_has_statics = has_statics
+                info.jit_site = (self.mod.path, node.lineno)
+                self.cg.func_of_node[id(target)] = info
+                self.mod.all_functions.append(info)
+            elif isinstance(target, ast.Name):
+                info = self.mod.resolve_scoped(target.id, self.scope_chain)
+                if info is not None:
+                    info.is_jit_root = True
+                    info.jit_has_statics = (
+                        info.jit_has_statics or has_statics
+                    )
+                    info.static_params |= _static_params(info.node, node)
+                    info.jit_site = info.jit_site or (
+                        self.mod.path,
+                        node.lineno,
+                    )
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        if isinstance(node.value, ast.Call) and _is_jax_jit(
+            node.value.func
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.cg.jitted_names[(self.mod.path, t.id)] = (
+                        _jit_statics(node.value)
+                    )
+        self.generic_visit(node)
+
+
+def _mark_jit_roots(cg: CallGraph) -> None:
+    for mod in cg.modules.values():
+        # decorated roots
+        for info in mod.all_functions:
+            node = info.node
+            for dec in getattr(node, "decorator_list", []):
+                if _is_jax_jit(dec):
+                    info.is_jit_root = True
+                    info.jit_site = (mod.path, dec.lineno)
+                elif (
+                    isinstance(dec, ast.Call)
+                    and _is_partial(dec.func)
+                    and dec.args
+                    and _is_jax_jit(dec.args[0])
+                ):
+                    info.is_jit_root = True
+                    info.jit_has_statics = _jit_statics(dec)
+                    info.static_params = _static_params(node, dec)
+                    info.jit_site = (mod.path, dec.lineno)
+                elif isinstance(dec, ast.Call) and _is_jax_jit(dec.func):
+                    info.is_jit_root = True
+                    info.jit_has_statics = _jit_statics(dec)
+                    info.static_params = _static_params(node, dec)
+                    info.jit_site = (mod.path, dec.lineno)
+            if info.is_jit_root:
+                cg.jitted_names[(mod.path, info.name)] = info.jit_has_statics
+        _JitSiteVisitor(cg, mod).visit(mod.tree)
+
+
+def _scope_chain_of(info: FuncInfo) -> List[ast.AST]:
+    """Rebuild the lexical chain module -> ... -> info.node by walking
+    scope_node links."""
+    chain: List[ast.AST] = [info.node]
+    node = info.scope_node
+    mod = info.module
+    guard = 0
+    while node is not None and guard < 32:
+        chain.append(node)
+        if node is mod.tree:
+            break
+        owner = mod.tree
+        found = None
+        for f in mod.all_functions:
+            if f.node is node:
+                found = f.scope_node
+                break
+        node = found if found is not None else owner
+        guard += 1
+    chain.reverse()
+    return chain
+
+
+def resolve_call(
+    cg: CallGraph, info: FuncInfo, call: ast.Call
+) -> Optional[FuncInfo]:
+    """Resolve a call expression inside ``info`` to a FuncInfo in the
+    linted set, via the lexical scope chain, from-imports, and module
+    aliases. Unresolvable calls (jnp.*, builtins) return None and stop
+    the walk there."""
+    mod = info.module
+    f = call.func
+    if isinstance(f, ast.Name):
+        target = mod.resolve_scoped(f.id, _scope_chain_of(info))
+        if target is not None:
+            return target
+        tgt = mod.from_names.get(f.id)
+        if tgt is not None:
+            m2 = cg.by_modname.get(tgt[0])
+            if m2 is not None:
+                return m2.functions.get(tgt[1])
+        return None
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        alias = f.value.id
+        modname = mod.import_alias.get(alias)
+        if modname is None and alias in mod.from_names:
+            src, orig = mod.from_names[alias]
+            modname = f"{src}.{orig}"
+        if modname is not None:
+            m2 = cg.by_modname.get(modname)
+            if m2 is not None:
+                return m2.functions.get(f.attr)
+    return None
+
+
+def _walk_reachable(cg: CallGraph) -> None:
+    stack = [
+        info
+        for mod in cg.modules.values()
+        for info in mod.all_functions
+        if info.is_jit_root
+    ]
+    while stack:
+        info = stack.pop()
+        if id(info.node) in cg.reachable:
+            continue
+        cg.reachable.add(id(info.node))
+        cg.func_of_node.setdefault(id(info.node), info)
+        body = getattr(info.node, "body", None)
+        nodes = body if isinstance(body, list) else [info.node.body]
+        for stmt in nodes:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    callee = resolve_call(cg, info, node)
+                    if callee is not None and id(callee.node) not in cg.reachable:
+                        stack.append(callee)
+
+
+def build(pkg) -> CallGraph:
+    """Build the call graph for a parsed :class:`core.Package`."""
+    cg = CallGraph()
+    for src in pkg.files:
+        if src.tree is None:
+            continue
+        mod = _index_module(src.path, src.tree)
+        cg.modules[src.path] = mod
+        cg.by_modname[mod.modname] = mod
+        for info in mod.all_functions:
+            cg.func_of_node[id(info.node)] = info
+    _mark_jit_roots(cg)
+    _walk_reachable(cg)
+    return cg
+
+
+def tracked_call_sites(
+    package_dir: Optional[str] = None,
+) -> Dict[str, List[Tuple[str, int]]]:
+    """Static map of ``tracked_call("<family>", ...)`` literals to their
+    (file, line) call sites, for the recompile-storm warning. Best
+    effort: unreadable/unparseable files are skipped."""
+    if package_dir is None:
+        import dbscan_tpu
+
+        package_dir = os.path.dirname(os.path.abspath(dbscan_tpu.__file__))
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for root, dirs, names in os.walk(package_dir):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for n in sorted(names):
+            if not n.endswith(".py"):
+                continue
+            path = os.path.join(root, n)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (OSError, SyntaxError, ValueError):
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                attr = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None
+                )
+                if attr not in ("tracked_call", "note_compile"):
+                    continue
+                if node.args and isinstance(node.args[0], ast.Constant) and (
+                    isinstance(node.args[0].value, str)
+                ):
+                    out.setdefault(node.args[0].value, []).append(
+                        (os.path.relpath(path, package_dir), node.lineno)
+                    )
+    return out

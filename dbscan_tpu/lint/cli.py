@@ -1,0 +1,88 @@
+"""graftlint command line: ``python -m dbscan_tpu.lint``.
+
+Exit-code contract (pinned by tests/test_lint.py, gate-able in CI like
+``obs.regress --check-schema``): 0 = clean, 1 = findings (one rule id +
+file:line per line in text mode), 2 = usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from dbscan_tpu import lint as lint_mod
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dbscan_tpu.lint",
+        description="graftlint: AST-based static analysis for TPU "
+        "hazards (host-sync, recompile) and declared-contract drift "
+        "(telemetry schema, env-var registry).",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to lint (default: the installed "
+        "dbscan_tpu package)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (default text: path:line:col: rule message)",
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalog and exit",
+    )
+    p.add_argument(
+        "--env-table",
+        action="store_true",
+        help="print the PARITY.md env-var table generated from "
+        "config.ENV_VARS and exit (paste it over the PARITY section "
+        "when the registry changes)",
+    )
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(lint_mod.RULES):
+            print(f"{rule:<24} {lint_mod.RULES[rule]}")
+        return 0
+    if args.env_table:
+        from dbscan_tpu.config import parity_env_table
+
+        print(parity_env_table())
+        return 0
+
+    try:
+        if args.paths:
+            findings, n_files = lint_mod.lint_paths(args.paths)
+        else:
+            findings, n_files = lint_mod.lint_package()
+    except FileNotFoundError as e:
+        print(f"graftlint: no such path: {e}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "files_scanned": n_files,
+                    "findings": [f.to_dict() for f in findings],
+                }
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        print(
+            f"graftlint: {len(findings)} finding(s) in {n_files} file(s)",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0

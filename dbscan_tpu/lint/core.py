@@ -1,0 +1,221 @@
+"""graftlint engine: file collection, findings, suppressions, runner.
+
+The rule modules (:mod:`hostsync`, :mod:`recompile`, :mod:`telemetry`,
+:mod:`envvars`) are pure functions ``(Package) -> list[Finding]`` over
+a parsed :class:`Package`; this module owns everything around them —
+reading sources, per-line ``# graftlint: disable=RULE  <reason>``
+suppressions (the reason text is REQUIRED; a bare disable keeps the
+finding and adds a ``suppress-no-reason`` one), and deterministic
+ordering of the output.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, anchored to a source position."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# matches "graftlint: disable=<rule>[,<rule>]  <reason>" in a comment
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=(?P<rules>[a-z0-9_,-]+)(?P<reason>.*)$"
+)
+
+
+@dataclasses.dataclass
+class Suppression:
+    rules: Tuple[str, ...]
+    reason: str
+    line: int
+
+
+class SourceFile:
+    """One parsed source file: text, AST, and its suppression table."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except SyntaxError as e:
+            self.parse_error = e
+        self.suppressions: Dict[int, Suppression] = {}
+        self._scan_suppressions()
+
+    def _scan_suppressions(self) -> None:
+        # tokenize so a "# graftlint:" inside a string literal is not a
+        # suppression; fall back to the regex per line on token errors
+        comments: List[Tuple[int, str]] = []
+        try:
+            import io
+
+            for tok in tokenize.generate_tokens(
+                io.StringIO(self.text).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    comments.append((tok.start[0], tok.string))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            comments = [
+                (i + 1, ln) for i, ln in enumerate(self.lines) if "#" in ln
+            ]
+        for line_no, comment in comments:
+            m = _SUPPRESS_RE.search(comment)
+            if m is None:
+                continue
+            rules = tuple(
+                r.strip() for r in m.group("rules").split(",") if r.strip()
+            )
+            self.suppressions[line_no] = Suppression(
+                rules=rules,
+                reason=m.group("reason").strip(),
+                line=line_no,
+            )
+
+
+class Package:
+    """The linted file set plus the cross-file indexes rules consume.
+
+    ``callgraph`` is attached lazily by the runner (built once, shared
+    by the host-sync and recompile families).
+    """
+
+    def __init__(self, files: List[SourceFile]):
+        self.files = files
+        self.callgraph = None  # set by run_rules
+
+    def by_path(self, path: str) -> Optional[SourceFile]:
+        for f in self.files:
+            if f.path == path:
+                return f
+        return None
+
+
+def collect_files(paths: Iterable[str]) -> List[str]:
+    """Expand files/directories into a sorted .py file list (skips
+    __pycache__ and hidden directories)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d
+                    for d in dirs
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        out.append(os.path.join(root, n))
+        else:
+            raise FileNotFoundError(p)
+    # stable, deduplicated
+    seen = set()
+    uniq = []
+    for p in out:
+        rp = os.path.normpath(p)
+        if rp not in seen:
+            seen.add(rp)
+            uniq.append(rp)
+    return uniq
+
+
+def load_package(paths: Iterable[str]) -> Package:
+    files = []
+    for p in collect_files(paths):
+        with open(p, encoding="utf-8") as f:
+            files.append(SourceFile(p, f.read()))
+    return Package(files)
+
+
+def apply_suppressions(
+    pkg: Package, findings: List[Finding], known_rules: Iterable[str]
+) -> List[Finding]:
+    """Drop findings covered by a same-line suppression WITH a reason;
+    emit ``suppress-no-reason`` / ``suppress-unknown-rule`` findings for
+    malformed suppressions."""
+    known = set(known_rules)
+    out: List[Finding] = []
+    for f in findings:
+        src = pkg.by_path(f.path)
+        sup = src.suppressions.get(f.line) if src else None
+        if sup and (f.rule in sup.rules or "all" in sup.rules):
+            if sup.reason:
+                continue  # properly suppressed
+        out.append(f)
+    for src in pkg.files:
+        for sup in src.suppressions.values():
+            if not sup.reason:
+                out.append(
+                    Finding(
+                        "suppress-no-reason",
+                        src.path,
+                        sup.line,
+                        0,
+                        "suppression requires a reason: "
+                        "# graftlint: disable=RULE  <why this is intended>",
+                    )
+                )
+            for r in sup.rules:
+                if r != "all" and r not in known:
+                    out.append(
+                        Finding(
+                            "suppress-unknown-rule",
+                            src.path,
+                            sup.line,
+                            0,
+                            f"unknown rule id {r!r} in suppression",
+                        )
+                    )
+    return out
+
+
+def run_rules(pkg: Package, rule_fns, known_rules) -> List[Finding]:
+    """Run every rule family over the package, then apply suppressions
+    and sort (path, line, col, rule). Unparseable files surface as
+    ``parse-error`` findings rather than crashing the run."""
+    findings: List[Finding] = []
+    for src in pkg.files:
+        if src.parse_error is not None:
+            e = src.parse_error
+            findings.append(
+                Finding(
+                    "parse-error",
+                    src.path,
+                    e.lineno or 1,
+                    e.offset or 0,
+                    f"cannot parse: {e.msg}",
+                )
+            )
+    from dbscan_tpu.lint import callgraph as cg
+
+    pkg.callgraph = cg.build(pkg)
+    for fn in rule_fns:
+        findings.extend(fn(pkg))
+    findings = apply_suppressions(pkg, findings, known_rules)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,168 @@
+"""env-registry rules: every ``DBSCAN_*`` environment read goes through
+the declared table in :mod:`dbscan_tpu.config`.
+
+- ``env-direct-read``: ``os.environ.get``/``os.getenv``/
+  ``os.environ[...]`` of a ``DBSCAN_*`` literal anywhere but
+  ``config.py`` — route it through ``config.env`` so the name, type,
+  default, and doc live in one place;
+- ``env-undeclared``: a ``config.env("DBSCAN_X")`` call naming a
+  variable missing from ``config.ENV_VARS`` — declaring the table row
+  IS the registration;
+- ``env-parity``: a declared variable whose generated table ROW
+  (``| `NAME` | ...``) is missing from PARITY.md — a plain substring
+  check would be satisfied by prose mentions or by longer names that
+  contain this one (``DBSCAN_TRACE`` inside ``DBSCAN_TRACE_MAX_SPANS``),
+  so the row marker is what's required (regenerate with
+  ``python -m dbscan_tpu.lint --env-table``). Only checked when the
+  linted set includes the real package (fixture runs in temp dirs
+  skip it).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from dbscan_tpu.lint.core import Finding, Package
+
+_ENV_FN_NAMES = ("env", "_env")
+_CONFIG_RECEIVERS = ("config", "config_mod", "_config")
+
+
+def _declared_names():
+    from dbscan_tpu.config import ENV_VARS
+
+    return ENV_VARS
+
+
+def _environ_read_name(node: ast.AST) -> Optional[ast.AST]:
+    """The name-argument expression of a direct environment read, or
+    None when ``node`` is not one."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "getenv":
+                return node.args[0] if node.args else None
+            if f.attr == "get" and isinstance(f.value, ast.Attribute) and (
+                f.value.attr == "environ"
+            ):
+                return node.args[0] if node.args else None
+            if f.attr == "get" and isinstance(f.value, ast.Name) and (
+                f.value.id == "environ"
+            ):
+                return node.args[0] if node.args else None
+        elif isinstance(f, ast.Name) and f.id == "getenv":
+            return node.args[0] if node.args else None
+    elif isinstance(node, ast.Subscript):
+        v = node.value
+        is_environ = (
+            isinstance(v, ast.Attribute) and v.attr == "environ"
+        ) or (isinstance(v, ast.Name) and v.id == "environ")
+        if is_environ:
+            return node.slice
+    return None
+
+
+def _dbscan_literal(expr: Optional[ast.AST]) -> Optional[str]:
+    if (
+        isinstance(expr, ast.Constant)
+        and isinstance(expr.value, str)
+        and expr.value.startswith("DBSCAN")
+    ):
+        return expr.value
+    return None
+
+
+def _is_config_env_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in _ENV_FN_NAMES
+    if isinstance(f, ast.Attribute) and f.attr in _ENV_FN_NAMES:
+        return isinstance(f.value, ast.Name) and (
+            f.value.id in _CONFIG_RECEIVERS
+        )
+    return False
+
+
+def _find_parity(start_dirs) -> Optional[str]:
+    for d in start_dirs:
+        d = os.path.abspath(d)
+        for _ in range(6):
+            cand = os.path.join(d, "PARITY.md")
+            if os.path.exists(cand):
+                return cand
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    return None
+
+
+def check(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    declared = _declared_names()
+    lints_real_config = False
+    for src in pkg.files:
+        if src.tree is None:
+            continue
+        is_config = os.path.basename(src.path) == "config.py" and (
+            "dbscan_tpu" in os.path.abspath(src.path).split(os.sep)
+        )
+        if is_config:
+            lints_real_config = True
+        for node in ast.walk(src.tree):
+            name_expr = (
+                _environ_read_name(node)
+                if not is_config
+                else None
+            )
+            name = _dbscan_literal(name_expr)
+            if name is not None:
+                findings.append(
+                    Finding(
+                        "env-direct-read",
+                        src.path,
+                        node.lineno,
+                        node.col_offset,
+                        f"direct environment read of {name!r}; route it "
+                        "through dbscan_tpu.config.env so the knob is "
+                        "declared once (name/type/default/doc)",
+                    )
+                )
+                continue
+            if isinstance(node, ast.Call) and _is_config_env_call(node):
+                name = _dbscan_literal(node.args[0] if node.args else None)
+                if name is not None and name not in declared:
+                    findings.append(
+                        Finding(
+                            "env-undeclared",
+                            src.path,
+                            node.lineno,
+                            node.col_offset,
+                            f"{name!r} is not declared in "
+                            "config.ENV_VARS — add the table row (and "
+                            "its PARITY.md line)",
+                        )
+                    )
+    if lints_real_config:
+        parity = _find_parity(
+            [os.path.dirname(f.path) for f in pkg.files]
+        )
+        if parity is not None:
+            with open(parity, encoding="utf-8") as f:
+                text = f.read()
+            for name in sorted(declared):
+                if f"| `{name}` |" not in text:
+                    findings.append(
+                        Finding(
+                            "env-parity",
+                            parity,
+                            1,
+                            0,
+                            f"declared env var {name!r} has no table row "
+                            "in PARITY.md — regenerate the table with "
+                            "python -m dbscan_tpu.lint --env-table",
+                        )
+                    )
+    return findings

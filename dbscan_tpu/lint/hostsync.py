@@ -1,0 +1,177 @@
+"""host-sync rules: implicit device->host synchronization in trace-time
+code.
+
+Scope: functions in the jit-reachable set (lint/callgraph.py). Inside
+traced code a ``.item()`` / ``float(arr)`` / ``np.asarray(tracer)``
+either fails at trace time (so it lurks in a branch the tests never
+trace) or — when the same helper is also called outside jit — silently
+drags a device sync into a hot path the driver believes is async.
+
+- ``host-sync-item``: any ``X.item()`` call;
+- ``host-sync-cast``: ``float()/int()/bool()`` applied to an array
+  expression (a ``jnp.*``/``lax.*`` call result, a name assigned from
+  one, or a non-static parameter of a jit root). ``len(...)`` and
+  ``x.shape[...]`` operands are exempt — those are Python ints under
+  trace;
+- ``host-sync-asarray``: ``np.asarray``/``np.array`` applied to an
+  array expression (literal-built arrays are fine).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from dbscan_tpu.lint.core import Finding, Package
+
+_ARRAY_MODULES = ("jnp", "lax", "jax")
+_CASTS = ("float", "int", "bool")
+_NP_NAMES = ("np", "numpy")
+
+
+def _root_name(expr: ast.AST):
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _is_array_call(expr: ast.AST) -> bool:
+    """A call into jnp./lax./jax.* — its result is a traced array."""
+    if not isinstance(expr, ast.Call):
+        return False
+    f = expr.func
+    root = _root_name(f) if isinstance(f, ast.Attribute) else None
+    return root in _ARRAY_MODULES
+
+
+def _shape_or_len(expr: ast.AST) -> bool:
+    """``x.shape[i]`` / ``len(x)`` / ``x.ndim`` — ints under trace."""
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name):
+        if expr.func.id == "len":
+            return True
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in (
+            "shape",
+            "ndim",
+            "size",
+        ):
+            return True
+    return False
+
+
+class _FnScanner(ast.NodeVisitor):
+    def __init__(self, src_path: str, fn_info, findings: List[Finding]):
+        self.path = src_path
+        self.findings = findings
+        self.array_names: Set[str] = set()
+        node = fn_info.node
+        if fn_info.is_jit_root and hasattr(node, "args"):
+            params = {a.arg for a in node.args.args}
+            params |= {a.arg for a in node.args.kwonlyargs}
+            self.array_names |= params - fn_info.static_params
+        # seed assigned-from-jnp names (single forward pass is enough
+        # for straight-line kernel code)
+        for stmt in ast.walk(node):
+            if isinstance(stmt, ast.Assign) and _is_array_call(stmt.value):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.array_names.add(t.id)
+            elif isinstance(stmt, ast.AugAssign) and _is_array_call(
+                stmt.value
+            ):
+                if isinstance(stmt.target, ast.Name):
+                    self.array_names.add(stmt.target.id)
+
+    def _arrayish(self, expr: ast.AST) -> bool:
+        if _is_array_call(expr):
+            return True
+        if isinstance(expr, (ast.Attribute, ast.Subscript, ast.Name)):
+            return _root_name(expr) in self.array_names
+        if isinstance(expr, ast.BinOp):
+            return self._arrayish(expr.left) or self._arrayish(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self._arrayish(expr.operand)
+        if isinstance(expr, ast.Call) and isinstance(
+            expr.func, ast.Attribute
+        ):
+            # method on an array expression (x.sum(), x.astype(...))
+            return self._arrayish(expr.func.value)
+        return False
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        # X.item()
+        if isinstance(f, ast.Attribute) and f.attr == "item" and not node.args:
+            self.findings.append(
+                Finding(
+                    "host-sync-item",
+                    self.path,
+                    node.lineno,
+                    node.col_offset,
+                    ".item() forces a device->host sync in jit-reachable "
+                    "code; return the array and pull at the driver "
+                    "boundary instead",
+                )
+            )
+        # float(E) / int(E) / bool(E)
+        elif (
+            isinstance(f, ast.Name)
+            and f.id in _CASTS
+            and len(node.args) == 1
+        ):
+            arg = node.args[0]
+            if (
+                self._arrayish(arg)
+                and not _shape_or_len(arg)
+                and not isinstance(arg, ast.Constant)
+            ):
+                self.findings.append(
+                    Finding(
+                        "host-sync-cast",
+                        self.path,
+                        node.lineno,
+                        node.col_offset,
+                        f"{f.id}() on an array expression host-syncs "
+                        "under jit; keep it as a 0-d array (or mark the "
+                        "argument static)",
+                    )
+                )
+        # np.asarray / np.array on array values
+        elif (
+            isinstance(f, ast.Attribute)
+            and f.attr in ("asarray", "array")
+            and isinstance(f.value, ast.Name)
+            and f.value.id in _NP_NAMES
+            and node.args
+        ):
+            arg = node.args[0]
+            if self._arrayish(arg):
+                self.findings.append(
+                    Finding(
+                        "host-sync-asarray",
+                        self.path,
+                        node.lineno,
+                        node.col_offset,
+                        f"np.{f.attr}() on a traced array fails (or "
+                        "host-syncs) in jit-reachable code; use "
+                        "jnp.asarray or hoist the conversion to the host "
+                        "boundary",
+                    )
+                )
+        self.generic_visit(node)
+
+
+def check(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    cg = pkg.callgraph
+    seen = set()
+    for mod in cg.modules.values():
+        for info in mod.all_functions:
+            if not cg.in_reachable(info.node) or id(info.node) in seen:
+                continue
+            seen.add(id(info.node))
+            scanner = _FnScanner(mod.path, info, findings)
+            body = getattr(info.node, "body", [])
+            for stmt in body if isinstance(body, list) else [body]:
+                scanner.visit(stmt)
+    return findings

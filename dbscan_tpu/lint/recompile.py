@@ -1,0 +1,177 @@
+"""recompile rules: patterns that mint fresh jit signatures (the storm
+``obs/compile.py`` can only observe after the fact).
+
+- ``jit-in-loop``: ``jax.jit(...)`` invoked lexically inside a
+  ``for``/``while`` body — every iteration builds a fresh wrapper with
+  an empty trace cache. Hoist the jit (module level or an lru_cache
+  builder keyed on the static config, the driver's idiom).
+- ``jit-scalar-arg``: a call to a KNOWN jitted callable passing a
+  Python scalar or tuple literal positionally while the jit declared
+  no static_argnums/static_argnames — tuples fail at trace, scalars
+  retrace per dtype and silently defeat weak-type reuse when mixed.
+- ``dtype-drift``: float64 dtype literals in kernel code (``ops/`` and
+  ``parallel/spill_device.py``): ``jnp.float64`` references, string
+  ``"float64"`` dtypes flowing into ``jnp.*``/``astype`` calls. The
+  kernels are f32/bf16 by design (config.Precision); a float64 constant
+  either upcasts a kernel (2x HBM, MXU off the fast path) or retraces
+  against the f32 signature. Host-side ``np.*`` float64 (grid
+  coordinates, merge precision) is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from dbscan_tpu.lint.core import Finding, Package
+from dbscan_tpu.lint.callgraph import _is_jax_jit
+
+_SCALARS = (int, float, bool, str)
+
+
+def _kernel_file(path: str) -> bool:
+    parts = os.path.normpath(path).split(os.sep)
+    return "ops" in parts or os.path.basename(path) == "spill_device.py"
+
+
+def _check_jit_in_loop(mod, findings: List[Finding]) -> None:
+    class LoopVisitor(ast.NodeVisitor):
+        def __init__(self):
+            self.loop_depth = 0
+
+        def _loop(self, node):
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        visit_For = _loop
+        visit_While = _loop
+        visit_AsyncFor = _loop
+
+        def visit_Call(self, node: ast.Call):
+            if self.loop_depth > 0 and _is_jax_jit(node.func):
+                findings.append(
+                    Finding(
+                        "jit-in-loop",
+                        mod.path,
+                        node.lineno,
+                        node.col_offset,
+                        "jax.jit called inside a loop body builds a fresh "
+                        "wrapper (empty trace cache) every iteration; "
+                        "hoist it to module level or an lru_cache builder",
+                    )
+                )
+            self.generic_visit(node)
+
+    LoopVisitor().visit(mod.tree)
+
+
+def _is_scalar_or_tuple_literal(arg: ast.AST) -> bool:
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, _SCALARS):
+        return True
+    if isinstance(arg, ast.Tuple):
+        return True
+    if isinstance(arg, ast.UnaryOp) and isinstance(
+        arg.operand, ast.Constant
+    ):
+        return True
+    return False
+
+
+def _check_scalar_args(pkg: Package, findings: List[Finding]) -> None:
+    cg = pkg.callgraph
+    for mod in cg.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            has_statics = None
+            f = node.func
+            if isinstance(f, ast.Name):
+                key = (mod.path, f.id)
+                if key in cg.jitted_names:
+                    has_statics = cg.jitted_names[key]
+                else:
+                    tgt = mod.from_names.get(f.id)
+                    if tgt is not None:
+                        m2 = cg.by_modname.get(tgt[0])
+                        info = (
+                            m2.functions.get(tgt[1]) if m2 is not None else None
+                        )
+                        if info is not None and info.is_jit_root:
+                            has_statics = info.jit_has_statics
+            if has_statics is not False:
+                continue  # unknown callee, or statics declared
+            for i, arg in enumerate(node.args):
+                if _is_scalar_or_tuple_literal(arg):
+                    findings.append(
+                        Finding(
+                            "jit-scalar-arg",
+                            mod.path,
+                            arg.lineno,
+                            arg.col_offset,
+                            f"positional arg {i} is a Python "
+                            "scalar/tuple literal passed to a jitted "
+                            "function with no static_argnums/"
+                            "static_argnames — tuples fail at trace, "
+                            "scalars defeat signature reuse; declare it "
+                            "static or pass an array",
+                        )
+                    )
+
+
+def _check_dtype_drift(mod, findings: List[Finding]) -> None:
+    if not _kernel_file(mod.path):
+        return
+
+    def flag(node, what):
+        findings.append(
+            Finding(
+                "dtype-drift",
+                mod.path,
+                node.lineno,
+                node.col_offset,
+                f"{what} in kernel code: the device kernels are f32/bf16 "
+                "(config.Precision); a float64 constant upcasts or "
+                "retraces the kernel — use the configured dtype",
+            )
+        )
+
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr == "float64"
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("jnp",)
+        ):
+            flag(node, "jnp.float64")
+        elif isinstance(node, ast.Call):
+            f = node.func
+            is_jnp_call = (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "jnp"
+            )
+            is_astype = isinstance(f, ast.Attribute) and f.attr == "astype"
+            if not (is_jnp_call or is_astype):
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Constant) and arg.value == "float64":
+                    flag(arg, '"float64" dtype literal')
+                elif (
+                    isinstance(arg, ast.Attribute)
+                    and arg.attr == "float64"
+                    and isinstance(arg.value, ast.Name)
+                    and arg.value.id in ("np", "numpy", "jnp")
+                ):
+                    flag(arg, f"{arg.value.id}.float64 dtype")
+
+
+def check(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    cg = pkg.callgraph
+    for mod in cg.modules.values():
+        _check_jit_in_loop(mod, findings)
+        _check_dtype_drift(mod, findings)
+    _check_scalar_args(pkg, findings)
+    return findings

@@ -1,0 +1,184 @@
+"""telemetry-schema rules: every emitted counter/gauge/span/event name
+must be declared in :mod:`dbscan_tpu.obs.schema`.
+
+The obs framework modules (``obs/__init__.py``, ``obs/trace.py``,
+``obs/metrics.py``, ``obs/export.py``) forward caller-supplied names
+and are exempt; everywhere else the linter resolves the name argument
+of each emission call:
+
+- string literal -> exact membership (``schema-counter`` /
+  ``schema-gauge`` / ``schema-span`` / ``schema-event`` on a miss);
+- f-string / ``"prefix" + expr`` -> the literal head must prefix some
+  declared name of that kind (``schema-dynamic`` on a miss, also
+  raised when there is no literal head at all);
+- conditional expressions check both arms;
+- ``tracked_call``/``note_compile`` family literals must be in
+  ``schema.COMPILE_FAMILIES`` and ``obs.memory.sample`` site literals
+  in ``schema.MEMORY_SITES`` (``schema-family``) — that is what makes
+  the dynamic ``compiles.<family>`` / ``memory.at.<site>`` expansions
+  exactly as pinned as the exact names.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from dbscan_tpu.lint.core import Finding, Package
+from dbscan_tpu.obs import schema
+
+_EXEMPT_SUFFIXES = (
+    "obs/__init__.py",
+    "obs/trace.py",
+    "obs/metrics.py",
+    "obs/export.py",
+)
+
+#: method name -> telemetry kind, guarded by the receiver check below
+_OBS_METHODS = {
+    "count": "counter",
+    "timed_count": "counter",
+    "gauge": "gauge",
+    "span": "span",
+    "add_span": "span",
+    "event": "event",
+}
+_REGISTRY_METHODS = {
+    "metrics": {"count": "counter", "gauge": "gauge"},
+    "tracer": {"span": "span", "add_span": "span", "instant": "event"},
+}
+_MEMORY_RECEIVERS = ("obs_memory", "_obs_memory", "memory")
+
+
+def _emission_kind(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    recv = f.value
+    if isinstance(recv, ast.Name) and recv.id == "obs":
+        return _OBS_METHODS.get(f.attr)
+    if isinstance(recv, ast.Attribute):
+        table = _REGISTRY_METHODS.get(recv.attr)
+        if table is not None:
+            return table.get(f.attr)
+    return None
+
+
+def _literal_or_prefix(expr: ast.AST) -> List[Tuple[Optional[str], bool]]:
+    """Resolve a name expression to [(text, is_exact)] alternatives;
+    ``(None, False)`` marks an unresolvable dynamic name."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [(expr.value, True)]
+    if isinstance(expr, ast.JoinedStr):
+        head = ""
+        for part in expr.values:
+            if isinstance(part, ast.Constant) and isinstance(
+                part.value, str
+            ):
+                head += part.value
+            else:
+                break
+        return [(head or None, False)]
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left = _literal_or_prefix(expr.left)
+        if len(left) == 1 and left[0][0] is not None:
+            return [(left[0][0], False)]
+        return [(None, False)]
+    if isinstance(expr, ast.IfExp):
+        return _literal_or_prefix(expr.body) + _literal_or_prefix(
+            expr.orelse
+        )
+    return [(None, False)]
+
+
+def check(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in pkg.files:
+        if src.tree is None:
+            continue
+        norm = src.path.replace("\\", "/")
+        if any(norm.endswith(sfx) for sfx in _EXEMPT_SUFFIXES):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            attr = f.attr if isinstance(f, ast.Attribute) else None
+            # compile-family and memory-site literal checks
+            if attr in ("tracked_call", "note_compile") and node.args:
+                for name, exact in _literal_or_prefix(node.args[0]):
+                    if (
+                        exact
+                        and name not in schema.COMPILE_FAMILIES
+                    ):
+                        findings.append(
+                            Finding(
+                                "schema-family",
+                                src.path,
+                                node.lineno,
+                                node.col_offset,
+                                f"compile family {name!r} is not in "
+                                "obs.schema.COMPILE_FAMILIES",
+                            )
+                        )
+                continue
+            if (
+                attr == "sample"
+                and isinstance(f.value, ast.Name)
+                and f.value.id in _MEMORY_RECEIVERS
+                and node.args
+            ):
+                for name, exact in _literal_or_prefix(node.args[0]):
+                    if exact and name not in schema.MEMORY_SITES:
+                        findings.append(
+                            Finding(
+                                "schema-family",
+                                src.path,
+                                node.lineno,
+                                node.col_offset,
+                                f"memory sample site {name!r} is not in "
+                                "obs.schema.MEMORY_SITES",
+                            )
+                        )
+                continue
+            kind = _emission_kind(node)
+            if kind is None or not node.args:
+                continue
+            for name, exact in _literal_or_prefix(node.args[0]):
+                if exact:
+                    if not schema.is_declared(kind, name):
+                        findings.append(
+                            Finding(
+                                f"schema-{kind}",
+                                src.path,
+                                node.lineno,
+                                node.col_offset,
+                                f"{kind} name {name!r} is not declared in "
+                                "dbscan_tpu/obs/schema.py — declare it "
+                                "(with a doc line) or fix the emission",
+                            )
+                        )
+                elif name is None:
+                    findings.append(
+                        Finding(
+                            "schema-dynamic",
+                            src.path,
+                            node.lineno,
+                            node.col_offset,
+                            f"dynamic {kind} name with no literal head "
+                            "cannot be checked against the schema; "
+                            "anchor it with a literal prefix",
+                        )
+                    )
+                elif not schema.prefix_declared(kind, name):
+                    findings.append(
+                        Finding(
+                            "schema-dynamic",
+                            src.path,
+                            node.lineno,
+                            node.col_offset,
+                            f"dynamic {kind} name prefix {name!r} matches "
+                            "no declared name in dbscan_tpu/obs/schema.py",
+                        )
+                    )
+    return findings

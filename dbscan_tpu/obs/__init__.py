@@ -37,11 +37,11 @@ wired through every hot call site.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Optional
 
+from dbscan_tpu import config
 from dbscan_tpu.obs import export as export_mod
 from dbscan_tpu.obs.metrics import MetricsRegistry
 from dbscan_tpu.obs.trace import NOOP_SPAN, Span, Tracer  # noqa: F401
@@ -123,7 +123,7 @@ def enable(
     with _lock:
         if _state is None:
             if device_sync is None:
-                device_sync = os.environ.get("DBSCAN_TIME_DEVICE") == "1"
+                device_sync = bool(config.env("DBSCAN_TIME_DEVICE"))
             _state = ObsState(
                 Tracer(device_sync=bool(device_sync)),
                 MetricsRegistry(),
@@ -149,7 +149,7 @@ def ensure_env() -> None:
     pipeline entry points; one env lookup when disabled, one truthiness
     check when already live."""
     if _state is None:
-        path = os.environ.get("DBSCAN_TRACE")
+        path = config.env("DBSCAN_TRACE")
         if path:
             enable(trace_path=path)
 

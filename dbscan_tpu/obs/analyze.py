@@ -33,7 +33,26 @@ import json
 import sys
 from typing import Optional
 
-_RESIDENT_MARKS = ("resident_cache.hit", "resident_cache.miss")
+from dbscan_tpu.obs import schema
+
+# consumer-side names come from the declared schema — deleting one
+# there breaks this module at import, not silently at report time
+_RESIDENT_MARKS = schema.RESIDENT_MARKS
+_TRANSFER_KEYS = (
+    "transfer.h2d_bytes",
+    "transfer.payload_upload_bytes",
+    "transfer.payload_upload_s",
+    "transfer.d2h_bytes",
+    "transfer.d2h_s",
+)
+for _k in _TRANSFER_KEYS:
+    assert schema.is_declared("counter", _k), _k
+for _k in _RESIDENT_MARKS:
+    assert schema.is_declared("event", _k), _k
+assert schema.is_declared("counter", "resident_cache.hits")
+assert schema.is_declared("counter", "resident_cache.misses")
+assert schema.is_declared("span", "transfer.pull")
+del _k
 
 
 def load_trace(path: str) -> dict:
@@ -276,15 +295,15 @@ def analyze(data: dict, top: Optional[int] = None) -> dict:
         "resident": _resident_split(data),
         "memory": {
             k: v for k, v in sorted(data["gauges"].items())
-            if k.startswith("memory.")
+            if k.startswith(schema.PREFIX_MEMORY)
         },
         "compiles": {
             k: v for k, v in sorted(counters.items())
-            if k.startswith("compiles.")
+            if k.startswith(schema.PREFIX_COMPILES)
         },
         "faults": {
             k: v for k, v in sorted(counters.items())
-            if k.startswith("faults.")
+            if k.startswith(schema.PREFIX_FAULTS)
         },
     }
 

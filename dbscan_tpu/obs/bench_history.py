@@ -44,11 +44,15 @@ from typing import Iterable, List, Optional, Tuple
 
 DEFAULT_HISTORY = os.path.join("bench", "history.jsonl")
 
+from dbscan_tpu.obs import schema
+
 # scalar keys promoted to history records: exact names + suffixes
 _EXACT_KEYS = ("value", "seconds", "vs_baseline")
 _SUFFIXES = ("_seconds", "_s", "_mpts", "_vs_baseline")
-# numeric-but-not-perf keys the suffix rule would otherwise catch
-_EXCLUDE = ("backoff_s",)
+# numeric-but-not-perf keys the suffix rule would otherwise catch —
+# declared with the telemetry schema (the keys are fault-counter
+# deltas riding bench rows, so the exclusion must track the schema)
+_EXCLUDE = schema.BENCH_EXCLUDE_SUFFIXES
 
 REQUIRED_KEYS = ("metric", "value", "source")
 

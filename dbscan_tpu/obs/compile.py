@@ -34,17 +34,20 @@ tests.
 from __future__ import annotations
 
 import logging
-import os
+import sys
 import threading
 import time
 
 import dbscan_tpu.obs as obs
+from dbscan_tpu import config
 
 logger = logging.getLogger(__name__)
 
 _lock = threading.Lock()
 _family_compiles: dict = {}
+_family_sites: dict = {}  # family -> "file:line" of the last miss call
 _storm_warned: set = set()
+_static_sites: dict = None  # lazy lint.callgraph metadata
 
 
 def storm_threshold() -> int:
@@ -53,7 +56,7 @@ def storm_threshold() -> int:
     12: a batch run legitimately compiles each family a handful of
     times (one per ladder rung in the data), a storm compiles per
     dispatch."""
-    return int(os.environ.get("DBSCAN_COMPILE_STORM_THRESHOLD", "12"))
+    return int(config.env("DBSCAN_COMPILE_STORM_THRESHOLD"))
 
 
 def _cache_size(fn):
@@ -75,11 +78,41 @@ def tracked_call(family: str, fn, *args):
     if before is not None:
         after = _cache_size(fn)
         if after is not None and after > before:
-            note_compile(family, t0, time.perf_counter())
+            # only on a detected miss (cold path by definition): capture
+            # the dispatch call site so a storm warning can say WHERE
+            # the signatures are being minted, not just which family
+            frame = sys._getframe(1)
+            site = f"{frame.f_code.co_filename}:{frame.f_lineno}"
+            note_compile(family, t0, time.perf_counter(), site=site)
     return out
 
 
-def note_compile(family: str, t0: float = None, t1: float = None) -> None:
+def _known_sites(family: str) -> str:
+    """Call-site attribution for ``family``: the runtime-observed site
+    of the last miss when one exists, else the static
+    ``lint.callgraph.tracked_call_sites`` metadata (decorated/wrapped
+    dispatches that route through :func:`note_compile` directly)."""
+    with _lock:
+        site = _family_sites.get(family)
+    if site:
+        return site
+    global _static_sites
+    if _static_sites is None:
+        try:
+            from dbscan_tpu.lint.callgraph import tracked_call_sites
+
+            _static_sites = tracked_call_sites()
+        except Exception:  # noqa: BLE001 — metadata is best-effort
+            _static_sites = {}
+    sites = _static_sites.get(family)
+    if sites:
+        return ", ".join(f"{f}:{ln}" for f, ln in sites[:3])
+    return "unknown call site"
+
+
+def note_compile(
+    family: str, t0: float = None, t1: float = None, site: str = None
+) -> None:
     """Record one compile of ``family`` (counters + compile-wall span
     when bounds are given) and run the storm check."""
     obs.count("compiles.total")
@@ -90,6 +123,8 @@ def note_compile(family: str, t0: float = None, t1: float = None) -> None:
     with _lock:
         n = _family_compiles.get(family, 0) + 1
         _family_compiles[family] = n
+        if site:
+            _family_sites[family] = site
     warn_on_recompile_storm(family, n)
 
 
@@ -109,15 +144,23 @@ def warn_on_recompile_storm(family: str, n: int = None) -> bool:
             return True
         _storm_warned.add(family)
     counters = obs.counters()
-    obs.event("compiles.storm", family=family, compiles=n, threshold=thr)
+    site = _known_sites(family)
+    obs.event(
+        "compiles.storm",
+        family=family,
+        compiles=n,
+        threshold=thr,
+        call_site=site,
+    )
     logger.warning(
         "recompile storm: dispatch family %r compiled %d times this "
-        "process (threshold %d) — a data-dependent shape is defeating "
-        "the width ladder / shape ratchet (%s ratchet raises observed); "
-        "steady state should reuse cached signatures",
+        "process (threshold %d) at %s — a data-dependent shape is "
+        "defeating the width ladder / shape ratchet (%s ratchet raises "
+        "observed); steady state should reuse cached signatures",
         family,
         n,
         thr,
+        site,
         counters.get("compiles.ratchet_raises", 0),
     )
     return True
@@ -130,7 +173,8 @@ def family_compiles() -> dict:
 
 
 def reset() -> None:
-    """Drop per-family counts and storm-warned latches (tests)."""
+    """Drop per-family counts, sites, and storm-warned latches (tests)."""
     with _lock:
         _family_compiles.clear()
+        _family_sites.clear()
         _storm_warned.clear()

@@ -34,7 +34,7 @@ import statistics
 import sys
 from typing import List, Optional
 
-from dbscan_tpu.obs import bench_history
+from dbscan_tpu.obs import bench_history, schema
 
 LOWER_BETTER = "lower"
 HIGHER_BETTER = "higher"
@@ -186,6 +186,14 @@ def main(argv=None) -> int:
         return 2
 
     if args.check_schema:
+        # the declared telemetry registry is part of the gated contract:
+        # a malformed obs/schema.py edit fails the same CI command that
+        # validates the bench history
+        schema_errors = schema.self_check()
+        if schema_errors:
+            for err in schema_errors[:20]:
+                print(f"regress: obs schema: {err}", file=sys.stderr)
+            return 2
         if not history:
             print(
                 f"regress: no history at {args.history} (ingest captures "

@@ -1,0 +1,210 @@
+"""Declared telemetry schema: the single source of truth for every
+counter, gauge, span, and event name the package emits.
+
+PR 2/3 coupled producers (driver/spill/faults/checkpoint emit sites)
+and consumers (`obs/analyze.py` rollup sections, `obs/regress.py`,
+`obs/bench_history.py`, PARITY.md's trace-schema table) through
+free-form dotted strings — a renamed counter silently emptied an
+analyzer section, exactly the cross-component contract drift the MR-
+DBSCAN merge phase cannot afford between partition producers and the
+global merge. This module pins the contract in one importable place:
+
+- producers are checked STATICALLY: ``dbscan_tpu.lint`` extracts every
+  emitted name from the AST and fails on any name not declared here
+  (rule family ``schema-*``);
+- consumers import the names/prefixes they read back, so a deletion
+  here breaks them at import/test time rather than silently;
+- ``tests/test_obs.py`` asserts every name observed at RUNTIME is
+  declared, so deleting an emitted name from this file fails both the
+  linter and the test suite (the acceptance contract).
+
+Dynamic names are declared through their generator sets: compile
+accounting emits ``compiles.<family>`` / ``compile.<family>`` for
+``family`` in :data:`COMPILE_FAMILIES`, memory sampling emits
+``memory.at.<site>`` for ``site`` in :data:`MEMORY_SITES`, and the
+driver's ``_mark`` bridge emits ``driver.<phase>`` for ``phase`` in
+:data:`DRIVER_PHASES`. The linter cross-checks the literal family/site
+arguments at the ``tracked_call``/``note_compile``/``memory.sample``
+call sites against these tuples, so the expansion is just as pinned as
+the exact names.
+
+Import-light on purpose (stdlib only): the linter and the offline
+analyzers import this without touching jax.
+"""
+
+from __future__ import annotations
+
+# --- generator sets for dynamic name families -------------------------
+
+#: jit dispatch families tracked by obs/compile.py `tracked_call`:
+#: each emits counter ``compiles.<family>`` and span ``compile.<family>``.
+COMPILE_FAMILIES = (
+    "dispatch.dense",
+    "dispatch.resident",
+    "dispatch.banded_p1",
+    "cellcc.postpass",
+    "cellcc.gather",
+    "spill.gather",
+)
+
+#: HBM watermark sample sites (obs/memory.py `sample`): each emits
+#: gauge ``memory.at.<site>``.
+MEMORY_SITES = (
+    "dispatch.dense",
+    "dispatch.resident",
+    "dispatch.banded",
+    "spill.payload_upload",
+    "fault.resource_exhausted",
+)
+
+#: driver `_mark` phases (timings keys sans ``_s``): each emits span
+#: ``driver.<phase>`` over the exact window ``stats["timings"]`` reports.
+DRIVER_PHASES = (
+    "spill_partition",
+    "histogram",
+    "partition",
+    "duplicate",
+    "postdispatch",
+    "overlap_host",
+    "cellcc_pull_rest",
+    "cellcc_host",
+    "cellcc",
+    "device",
+)
+
+# --- exact names ------------------------------------------------------
+
+COUNTERS = {
+    "transfer.h2d_bytes": "host->device bytes fanned out by the dispatches",
+    "transfer.d2h_bytes": "device->host bytes pulled (mesh.pull_to_host)",
+    "transfer.d2h_s": "measured d2h pull wall (includes device wait)",
+    "transfer.payload_upload_bytes": "spill resident-payload upload bytes",
+    "transfer.payload_upload_s": "measured payload-upload wall",
+    "resident_cache.hits": "resident-payload cache hits (hot runs)",
+    "resident_cache.misses": "resident-payload cache misses (cold runs)",
+    "checkpoint.chunk_flushes": "compact p1 chunks flushed by the driver",
+    "checkpoint.chunk_pulls": "compact p1 chunks pulled back to host",
+    "checkpoint.chunks_saved": "p1 chunks written by checkpoint.save",
+    "checkpoint.chunks_loaded": "p1 chunks read back on resume",
+    "checkpoint.chunk_bytes": "bytes across saved p1 chunk arrays",
+    "checkpoint.premerge_bytes": "bytes across saved pre-merge arrays",
+    "faults.attempts": "supervised dispatch attempts started",
+    "faults.retries": "attempts re-run after a supervised failure",
+    "faults.fallbacks": "groups/steps degraded to the CPU path",
+    "faults.budget_halvings": "RESOURCE_EXHAUSTED budget reductions",
+    "faults.injected": "injected (vs real) faults observed",
+    "faults.backoff_s": "total backoff slept between retries",
+    "compiles.total": "jit trace-cache misses observed (all families)",
+    "compiles.wall_s": "summed wall of the cache-miss calls",
+    "compiles.ratchet_raises": "streaming shape-floor raises post-warm-up",
+    "memory.samples": "HBM watermark samples taken",
+}
+
+GAUGES = {
+    "memory.bytes_in_use": "summed live allocator bytes at last sample",
+    "memory.peak_bytes_in_use": "process high-water mark (monotone)",
+    "memory.bytes_limit": "summed allocator capacity when reported",
+}
+
+SPANS = {
+    "train": "root span over one distributed train run",
+    "train.resume": "checkpoint-resume short-circuit of a train run",
+    "dispatch.dense": "dense kernel group fan-out (host dispatch wall)",
+    "dispatch.resident": "resident kernel group fan-out",
+    "dispatch.banded": "banded phase-1 group fan-out",
+    "spill.payload_upload": "spill resident payload upload",
+    "spill.pivots": "spill-tree pivot selection pass",
+    "spill.screen": "spill-tree rejection screen pass",
+    "spill.membership": "spill-tree full-node membership pass",
+    "spill.leader_cover": "spill-tree leader cover pass",
+    "spill.child_gather": "spill-tree child row gather",
+    "compact.flush_chunk": "compact p1 chunk flush to device",
+    "compact.pull_chunk": "compact p1 chunk pull to host",
+    "checkpoint.save_premerge": "pre-merge checkpoint write",
+    "checkpoint.save_p1_chunk": "p1 chunk checkpoint write",
+    "transfer.pull": "device->host pull (bytes in args)",
+    "stream.update": "streaming micro-batch update step",
+}
+
+EVENTS = {
+    "resident_cache.hit": "resident cache hit mark (hot/cold split)",
+    "resident_cache.miss": "resident cache miss mark (hot/cold split)",
+    "binning.ratchet_raise": "streaming shape floor moved post-warm-up",
+    "compiles.storm": "recompile-storm threshold crossed for a family",
+    "fault.retry": "supervised dispatch retry scheduled",
+    "fault.budget_halved": "RESOURCE_EXHAUSTED halved a dispatch budget",
+    "fault.fallback": "group degraded to the CPU engine",
+    "fault.fatal": "supervised dispatch exhausted retries, aborting",
+    "fault.degrade_host": "caller-counted host degradation (spill tree)",
+    "faults.run_delta": "per-run fault-counter delta (= stats['faults'])",
+}
+
+for _f in COMPILE_FAMILIES:
+    COUNTERS[f"compiles.{_f}"] = f"cache misses of the {_f} dispatch"
+    SPANS[f"compile.{_f}"] = f"trace+lower+compile wall of a {_f} miss"
+for _s in MEMORY_SITES:
+    GAUGES[f"memory.at.{_s}"] = f"HBM occupancy at the last {_s} sample"
+for _p in DRIVER_PHASES:
+    SPANS[f"driver.{_p}"] = f"driver phase window (timings['{_p}_s'])"
+del _f, _s, _p
+
+KINDS = {
+    "counter": COUNTERS,
+    "gauge": GAUGES,
+    "span": SPANS,
+    "event": EVENTS,
+}
+
+# --- consumer-side groupings (imported by obs/analyze.py et al.) ------
+
+#: analyzer report sections keyed by counter/gauge name prefix
+PREFIX_MEMORY = "memory."
+PREFIX_COMPILES = "compiles."
+PREFIX_FAULTS = "faults."
+
+#: the hot/cold classification marks obs/analyze.py reads back
+RESIDENT_MARKS = ("resident_cache.hit", "resident_cache.miss")
+
+#: counter-delta keys that LOOK like perf walls but are not
+#: run-comparable (bench_history's suffix rule must not promote them):
+#: ``backoff_s`` is fault-retry sleep, a robustness figure, not a wall.
+BENCH_EXCLUDE_SUFFIXES = ("backoff_s",)
+
+
+def names(kind: str) -> frozenset:
+    """All declared names of ``kind`` ('counter'/'gauge'/'span'/'event')."""
+    return frozenset(KINDS[kind])
+
+
+def is_declared(kind: str, name: str) -> bool:
+    """Exact-name membership check for one telemetry kind."""
+    return name in KINDS[kind]
+
+
+def prefix_declared(kind: str, prefix: str) -> bool:
+    """True when some declared name of ``kind`` starts with ``prefix`` —
+    the check the linter applies to dynamic emissions (f-strings /
+    concatenations) whose literal head is all it can see."""
+    return any(n.startswith(prefix) for n in KINDS[kind])
+
+
+def self_check() -> list:
+    """Structural validation of the registry itself; returns error
+    strings (empty = ok). Run by ``obs.regress --check-schema`` so the
+    CI gate also covers a malformed registry edit."""
+    errors = []
+    for kind, table in KINDS.items():
+        for name, doc in table.items():
+            if not isinstance(name, str) or not name:
+                errors.append(f"{kind} {name!r}: names must be strings")
+            elif name != name.strip() or " " in name:
+                errors.append(f"{kind} {name!r}: no whitespace in names")
+            if not doc or not isinstance(doc, str):
+                errors.append(f"{kind} {name!r}: missing doc string")
+    overlap = set(COUNTERS) & set(GAUGES)
+    if overlap:
+        errors.append(f"counter/gauge name collision: {sorted(overlap)}")
+    for fam in COMPILE_FAMILIES:
+        if "." not in fam:
+            errors.append(f"compile family {fam!r}: must be dotted")
+    return errors

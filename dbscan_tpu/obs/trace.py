@@ -29,10 +29,11 @@ Design constraints (enforced by tests/test_obs.py):
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Optional
+
+from dbscan_tpu import config
 
 
 class Span:
@@ -135,7 +136,7 @@ class Tracer:
         # the trace is the interesting part of a live process) and the
         # drop is surfaced via `dropped_spans` in the export
         self.max_spans = max(
-            1024, int(os.environ.get("DBSCAN_TRACE_MAX_SPANS", "200000"))
+            1024, int(config.env("DBSCAN_TRACE_MAX_SPANS"))
         )
         self.dropped_spans = 0
         self._lock = threading.Lock()

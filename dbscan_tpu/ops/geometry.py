@@ -231,7 +231,11 @@ def cell_histogram(points, cell_size):
     cell_index [N] int64 mapping each point to its row in `cells`).
     """
     idx, counts, inverse = cell_histogram_int(points, cell_size)
-    cells = np.concatenate([idx, idx + 1], axis=-1).astype(np.float64) * cell_size
+    cells = (
+        np.concatenate([idx, idx + 1], axis=-1)
+        .astype(np.float64)  # graftlint: disable=dtype-drift  host grid corners are f64 by design (reference merge precision), never shipped to a kernel
+        * cell_size
+    )
     return cells, counts, inverse
 
 

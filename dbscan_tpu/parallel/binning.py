@@ -16,7 +16,7 @@ from typing import NamedTuple, Tuple
 
 import numpy as np
 
-from dbscan_tpu import _native, obs
+from dbscan_tpu import _native, config, obs
 from dbscan_tpu.ops import geometry as geo
 
 
@@ -808,7 +808,7 @@ def bucketize_banded(
     # restart granularity with DBSCAN_GROUP_SLOTS alongside
     # DBSCAN_COMPACT_CHUNK_SLOTS. Labels are group-batching independent
     # (cell ids are global; the postpass and finalize are per-partition).
-    group_slot_cap = int(os.environ.get("DBSCAN_GROUP_SLOTS", str(1 << 26)))
+    group_slot_cap = int(config.env("DBSCAN_GROUP_SLOTS"))
     # Canonical emission plan: deterministic (width, win, partition-range)
     # order. The canonical ORDINAL of each entry — not arrival order — is
     # what the driver's chunk-checkpoint signatures key on, so a resumed

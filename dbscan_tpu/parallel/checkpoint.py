@@ -32,7 +32,7 @@ from typing import Optional
 
 import numpy as np
 
-from dbscan_tpu import obs
+from dbscan_tpu import config, obs
 
 _FORMAT_VERSION = 1
 _NPZ = "premerge.npz"
@@ -80,9 +80,7 @@ def run_fingerprint(pts: np.ndarray, cfg) -> str:
                 "static_partition_pad": getattr(
                     cfg, "static_partition_pad", False
                 ),
-                "group_slots": int(
-                    os.environ.get("DBSCAN_GROUP_SLOTS", str(1 << 26))
-                ),
+                "group_slots": int(config.env("DBSCAN_GROUP_SLOTS")),
             },
             sort_keys=True,
         ).encode()

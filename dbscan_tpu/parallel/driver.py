@@ -48,6 +48,7 @@ from jax import lax
 from jax.sharding import PartitionSpec
 
 from dbscan_tpu import _native, faults, obs
+from dbscan_tpu import config as config_mod
 from dbscan_tpu.config import DBSCANConfig
 from dbscan_tpu.obs import compile as obs_compile
 from dbscan_tpu.obs import memory as obs_memory
@@ -76,9 +77,7 @@ logger = logging.getLogger(__name__)
 # so the cap sits one doubling below it; and the value tags saved
 # chunks, so one bad override would also invalidate every prior
 # checkpoint of the run.
-_requested_chunk_slots = int(
-    _os.environ.get("DBSCAN_COMPACT_CHUNK_SLOTS", str(1 << 26))
-)
+_requested_chunk_slots = int(config_mod.env("DBSCAN_COMPACT_CHUNK_SLOTS"))
 _COMPACT_CHUNK_SLOTS = min(1 << 28, max(1 << 16, _requested_chunk_slots))
 if _COMPACT_CHUNK_SLOTS != _requested_chunk_slots:
     # chunks are budget-stamped, so an altered value is also a clean
@@ -101,9 +100,7 @@ if _COMPACT_CHUNK_SLOTS != _requested_chunk_slots:
 # retry/backoff, per-group CPU degradation), and a retries-exhausted
 # fault flushes the current compact chunk before raising, so even the
 # abort path resumes from the last completed group.
-_INFLIGHT_SLOTS = int(
-    _os.environ.get("DBSCAN_INFLIGHT_SLOTS", str(1 << 27))
-)
+_INFLIGHT_SLOTS = int(config_mod.env("DBSCAN_INFLIGHT_SLOTS"))
 
 # Widest bucket the dense engine may materialize
 # (binning.DENSE_MAX_BUCKET — NOT the spatial routing threshold, which is
@@ -611,7 +608,7 @@ def _dispatch_banded_p1(group, cfg: DBSCANConfig, mesh, kernel_eps=None):
             use_pallas=bool(cfg.use_pallas),
             pallas_sp=(
                 bool(cfg.use_pallas)
-                and _os.environ.get("DBSCAN_PALLAS_SP") == "1"
+                and config_mod.env("DBSCAN_PALLAS_SP")
             ),
         )
         return obs_compile.tracked_call(
@@ -1144,7 +1141,7 @@ def _resident_payload_lookup(pts: np.ndarray):
     zero-norm noise screen is config-dependent (it only fires when
     eps + q < 1), so the CALLER must re-apply it on a hit rather than
     assume the prior call's config decided it."""
-    if _os.environ.get("DBSCAN_RESIDENT_CACHE", "1") != "1":
+    if not config_mod.env("DBSCAN_RESIDENT_CACHE"):
         return None, None
     ent = _RESIDENT_CACHE.get(id(pts))
     if ent is None:
@@ -1170,7 +1167,7 @@ def _resident_payload_cached(
     dataset for the entry's lifetime (the documented price of the
     sweep fast path; `DBSCAN_RESIDENT_CACHE=0` disables the cache
     entirely)."""
-    if _os.environ.get("DBSCAN_RESIDENT_CACHE", "1") != "1":
+    if not config_mod.env("DBSCAN_RESIDENT_CACHE"):
         return sdev.DeviceNodeOps.from_host(unit)
     key = id(pts)
     if fp is None:
@@ -1643,7 +1640,7 @@ def train_arrays(
     # (do NOT enable on a timed run) but isolates the sweep-kernel time
     # the MFU accounting divides by — with async dispatch the device
     # window hides under host phases and cannot be attributed.
-    time_device = _os.environ.get("DBSCAN_TIME_DEVICE") == "1"
+    time_device = bool(config_mod.env("DBSCAN_TIME_DEVICE"))
     sync_spent = [0.0]
     flops_spent = [0]
     bytes_spent = [0]
@@ -1670,9 +1667,7 @@ def train_arrays(
     # (deterministic), skips dispatch for groups covered by saved
     # chunks, and picks up where the chunks stop. cell_layout needs only
     # per-group tables, so none of this waits for packing to finish.
-    compact_on = (
-        use_banded and _os.environ.get("DBSCAN_NO_COMPACT") != "1"
-    )
+    compact_on = use_banded and not config_mod.env("DBSCAN_NO_COMPACT")
     if compact_on:
         from dbscan_tpu.ops.banded import banded_postpass, gather_flat
     eager = {
@@ -1891,7 +1886,7 @@ def train_arrays(
         # desynchronize the collective order (the checkpointing it
         # serves is single-process anyway)
         if (
-            _os.environ.get("DBSCAN_EAGER_PULL") == "1"
+            config_mod.env("DBSCAN_EAGER_PULL")
             and not mesh_mod.multiprocess()
         ):
             _pull_record(rec)

@@ -1,0 +1,574 @@
+"""graftlint (dbscan_tpu/lint/): fixture pairs per rule family, the
+repo-wide lint-clean pin, suppression semantics, and the CLI contract.
+
+The repo-wide test is the enforcement point of this PR's contracts:
+``python -m dbscan_tpu.lint dbscan_tpu/`` exits 0, so any emission of
+an undeclared telemetry name, any direct ``DBSCAN_*`` environ read, and
+any trace-reachable host sync fails tier-1 CI the moment it lands.
+Every bad-snippet fixture asserts the exact rule id AND line so the
+findings stay actionable; every good-snippet twin pins the rule's
+false-positive boundary.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from dbscan_tpu import lint as lint_mod
+from dbscan_tpu.lint import callgraph as cg_mod
+from dbscan_tpu.lint.cli import main as lint_main
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "dbscan_tpu")
+
+
+def _lint_source(tmp_path, source, name="snippet.py", subdir=None):
+    d = tmp_path if subdir is None else tmp_path / subdir
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / name
+    p.write_text(textwrap.dedent(source))
+    findings, _ = lint_mod.lint_paths([str(p)])
+    return findings, str(p)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# --- host-sync family -------------------------------------------------
+
+
+def test_hostsync_item_in_jit_root(tmp_path):
+    findings, path = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def root(x):
+            s = jnp.sum(x)
+            return s.item()
+        """,
+    )
+    assert _rules(findings) == ["host-sync-item"]
+    assert findings[0].path == path and findings[0].line == 8
+
+
+def test_hostsync_item_transitively_reachable(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def helper(v):
+            return v.item()
+
+        @jax.jit
+        def root(x):
+            return helper(jnp.sum(x))
+        """,
+    )
+    assert _rules(findings) == ["host-sync-item"]
+    assert findings[0].line == 6  # reported in the helper, not the root
+
+
+def test_hostsync_item_clean_outside_jit(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def host_pull(x):
+            return jnp.sum(x).item()
+        """,
+    )
+    assert findings == []  # not reachable from any jit site
+
+
+def test_hostsync_cast_on_array_expression(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def root(x):
+            return float(jnp.sum(x))
+        """,
+    )
+    assert _rules(findings) == ["host-sync-cast"]
+
+
+def test_hostsync_cast_shape_and_static_are_exempt(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def root(x, n):
+            pad = int(n) + int(x.shape[0])
+            return jnp.pad(x, (0, pad))
+        """,
+    )
+    assert findings == []  # static param + shape arithmetic stay clean
+
+
+def test_hostsync_asarray_on_traced_value(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def root(x):
+            return np.asarray(x)
+        """,
+    )
+    assert _rules(findings) == ["host-sync-asarray"]
+
+
+def test_hostsync_asarray_literal_is_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+        import jax.numpy as jnp
+
+        @jax.jit
+        def root(x):
+            return x + jnp.asarray(np.asarray([1.0, 2.0]))
+        """,
+    )
+    assert findings == []
+
+
+# --- recompile family -------------------------------------------------
+
+
+def test_jit_in_loop_flags(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+
+        def run(xs):
+            out = []
+            for x in xs:
+                f = jax.jit(lambda a: a + 1)
+                out.append(f(x))
+            return out
+        """,
+    )
+    assert "jit-in-loop" in _rules(findings)
+
+
+def test_jit_hoisted_out_of_loop_is_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+
+        f = jax.jit(lambda a: a + 1)
+
+        def run(xs):
+            return [f(x) for x in xs]
+        """,
+    )
+    assert findings == []
+
+
+def test_jit_scalar_arg_without_statics(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def g(x, n):
+            return x * n
+
+        def call(x):
+            return g(x, 3)
+        """,
+    )
+    assert _rules(findings) == ["jit-scalar-arg"]
+    assert findings[0].line == 9
+
+
+def test_jit_scalar_arg_with_statics_is_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def g(x, n):
+            return x * n
+
+        def call(x):
+            return g(x, 3)
+        """,
+    )
+    assert findings == []
+
+
+def test_dtype_drift_in_kernel_path(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def kern(x):
+            return jnp.asarray(x, dtype="float64")
+        """,
+        name="kern.py",
+        subdir="ops",
+    )
+    assert _rules(findings) == ["dtype-drift"]
+
+
+def test_dtype_f32_kernel_and_host_f64_are_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def kern(x):
+            return jnp.asarray(x, dtype=jnp.float32)
+
+        def host_grid(c):
+            return np.asarray(c, dtype=np.float64)
+        """,
+        name="kern2.py",
+        subdir="ops",
+    )
+    assert findings == []  # host np.* f64 is exempt by design
+
+
+# --- telemetry-schema family ------------------------------------------
+
+
+def test_schema_undeclared_counter(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        from dbscan_tpu import obs
+
+        def emit():
+            obs.count("nonexistent.counter")
+        """,
+    )
+    assert _rules(findings) == ["schema-counter"]
+    assert findings[0].line == 5
+
+
+def test_schema_declared_names_are_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        from dbscan_tpu import obs
+
+        def emit(fam):
+            obs.count("transfer.h2d_bytes", 128)
+            obs.gauge("memory.bytes_in_use", 1)
+            obs.event("fault.retry", site="dispatch")
+            with obs.span("spill.pivots", node=3):
+                pass
+            obs.count(f"compiles.{fam}")
+        """,
+    )
+    assert findings == []
+
+
+def test_schema_dynamic_prefix_must_match(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        from dbscan_tpu import obs
+
+        def emit(fam):
+            obs.count(f"zzz.{fam}")
+        """,
+    )
+    assert _rules(findings) == ["schema-dynamic"]
+
+
+def test_schema_family_literal_checked(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        from dbscan_tpu.obs import compile as obs_compile
+
+        def dispatch(fn, x):
+            return obs_compile.tracked_call("not.a.family", fn, x)
+        """,
+    )
+    assert _rules(findings) == ["schema-family"]
+
+
+def test_schema_known_family_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        from dbscan_tpu.obs import compile as obs_compile
+
+        def dispatch(fn, x):
+            return obs_compile.tracked_call("dispatch.dense", fn, x)
+        """,
+    )
+    assert findings == []
+
+
+def test_deleting_declared_counter_breaks_lint(tmp_path, monkeypatch):
+    """The acceptance contract: remove an emitted counter from
+    obs/schema.py and the linter flags the emission site."""
+    from dbscan_tpu.obs import schema
+
+    src = """
+    from dbscan_tpu import obs
+
+    def emit():
+        obs.count("transfer.h2d_bytes", 128)
+    """
+    findings, _ = _lint_source(tmp_path, src)
+    assert findings == []
+    monkeypatch.delitem(schema.COUNTERS, "transfer.h2d_bytes")
+    findings, _ = _lint_source(tmp_path, src, name="snippet2.py")
+    assert _rules(findings) == ["schema-counter"]
+
+
+# --- env-registry family ----------------------------------------------
+
+
+def test_env_direct_read_flags(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import os
+
+        def knobs():
+            a = os.environ.get("DBSCAN_SOMETHING", "1")
+            b = os.getenv("DBSCAN_OTHER")
+            c = os.environ["DBSCAN_THIRD"]
+            return a, b, c
+        """,
+    )
+    assert _rules(findings) == ["env-direct-read"] * 3
+    assert [f.line for f in findings] == [5, 6, 7]
+
+
+def test_env_accessor_of_declared_name_is_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        from dbscan_tpu import config
+
+        def knob():
+            return config.env("DBSCAN_GROUP_SLOTS")
+        """,
+    )
+    assert findings == []
+
+
+def test_env_undeclared_name_flags(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        from dbscan_tpu.config import env
+
+        def knob():
+            return env("DBSCAN_NOT_A_REAL_KNOB")
+        """,
+    )
+    assert _rules(findings) == ["env-undeclared"]
+
+
+def test_non_dbscan_env_reads_ignored(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import os
+
+        def other():
+            return os.environ.get("JAX_PLATFORMS", "")
+        """,
+    )
+    assert findings == []
+
+
+def test_every_declared_env_var_documented_in_parity():
+    """Row-marker check, not substring: DBSCAN_TRACE inside the
+    DBSCAN_TRACE_MAX_SPANS row (or a prose mention) must not satisfy
+    the missing-row case."""
+    from dbscan_tpu.config import ENV_VARS
+
+    with open(os.path.join(REPO, "PARITY.md"), encoding="utf-8") as f:
+        text = f.read()
+    missing = [n for n in ENV_VARS if f"| `{n}` |" not in text]
+    assert missing == []
+
+
+def test_env_parity_detects_deleted_table_row(tmp_path):
+    """Deleting one variable's table row from PARITY.md fires
+    env-parity even though the name still appears elsewhere in the
+    file (the substring false-negative the row marker exists for)."""
+    import shutil
+
+    pkg_copy = tmp_path / "dbscan_tpu"
+    shutil.copytree(PKG, pkg_copy, ignore=shutil.ignore_patterns("__pycache__"))
+    with open(os.path.join(REPO, "PARITY.md"), encoding="utf-8") as f:
+        lines = f.read().splitlines(keepends=True)
+    kept = [ln for ln in lines if not ln.startswith("| `DBSCAN_TRACE` |")]
+    assert len(kept) == len(lines) - 1
+    (tmp_path / "PARITY.md").write_text("".join(kept))
+    findings, _ = lint_mod.lint_paths([str(pkg_copy / "config.py")])
+    parity = [f for f in findings if f.rule == "env-parity"]
+    assert [
+        f for f in parity if "'DBSCAN_TRACE'" in f.message
+    ], parity
+
+
+# --- suppressions -----------------------------------------------------
+
+_SUPPRESSIBLE = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def root(x):
+    return float(jnp.sum(x)){comment}
+"""
+
+
+def test_suppression_with_reason_silences(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        _SUPPRESSIBLE.format(
+            comment="  # graftlint: disable=host-sync-cast  scalar loss"
+        ),
+    )
+    assert findings == []
+
+
+def test_suppression_without_reason_keeps_finding(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        _SUPPRESSIBLE.format(
+            comment="  # graftlint: disable=host-sync-cast"
+        ),
+    )
+    assert sorted(_rules(findings)) == [
+        "host-sync-cast",
+        "suppress-no-reason",
+    ]
+
+
+def test_suppression_unknown_rule_flags(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        x = 1  # graftlint: disable=not-a-rule  because reasons
+        """,
+    )
+    assert _rules(findings) == ["suppress-unknown-rule"]
+
+
+# --- repo-wide pins ---------------------------------------------------
+
+
+def test_whole_package_is_lint_clean():
+    """THE tier-1 gate: zero findings over dbscan_tpu/ (suppressions
+    with reasons are the only allowed escape, and they are visible in
+    the diff)."""
+    findings, n_files = lint_mod.lint_paths([PKG])
+    assert n_files > 40
+    assert [f.render() for f in findings] == []
+
+
+def test_lint_package_self_lints_the_linter():
+    findings, n_files = lint_mod.lint_paths(
+        [os.path.join(PKG, "lint")]
+    )
+    assert n_files >= 7
+    assert [f.render() for f in findings] == []
+
+
+def test_tracked_call_sites_metadata():
+    sites = cg_mod.tracked_call_sites(PKG)
+    assert "dispatch.dense" in sites
+    files = {f for f, _ in sites["dispatch.dense"]}
+    assert files == {os.path.join("parallel", "driver.py")}
+    # every statically visible family is a declared one
+    from dbscan_tpu.obs import schema
+
+    assert set(sites) <= set(schema.COMPILE_FAMILIES)
+
+
+# --- CLI contract -----------------------------------------------------
+
+
+def test_cli_exit_codes_and_text_format(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import os\nv = os.environ.get('DBSCAN_X')\n"
+    )
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert lint_main([str(good)]) == 0
+    capsys.readouterr()
+    assert lint_main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "env-direct-read" in out and "bad.py:2:" in out
+    assert lint_main([str(tmp_path / "missing.py")]) == 2
+
+
+def test_cli_json_output_schema(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nv = os.environ.get('DBSCAN_X')\n")
+    assert lint_main(["--format", "json", str(bad)]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert set(payload) == {"files_scanned", "findings"}
+    assert payload["files_scanned"] == 1
+    (finding,) = payload["findings"]
+    assert set(finding) == {"rule", "path", "line", "col", "message"}
+    assert finding["rule"] == "env-direct-read"
+    assert finding["line"] == 2
+    assert finding["rule"] in lint_mod.RULES
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("host-sync-item", "jit-scalar-arg", "schema-counter",
+                 "env-direct-read"):
+        assert rule in out
+
+
+def test_console_entrypoint_gates_repo():
+    """The CI command verbatim: python -m dbscan_tpu.lint dbscan_tpu/
+    exits 0 on the repo."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dbscan_tpu.lint", PKG],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -583,6 +583,72 @@ def test_recompile_storm_warns_once(monkeypatch, caplog):
     obs_compile.reset()
 
 
+def test_storm_warning_names_call_site(monkeypatch, caplog):
+    """The PR-4 bugfix: the recompile-storm warning names the offending
+    call-site file:line (runtime frame of the tracked_call), so a storm
+    points at the dispatch that mints signatures, not just a family."""
+    import jax
+    import jax.numpy as jnp
+
+    from dbscan_tpu.obs import compile as obs_compile
+
+    monkeypatch.setenv("DBSCAN_COMPILE_STORM_THRESHOLD", "2")
+    obs_compile.reset()
+    obs.enable()
+    fn = jax.jit(lambda x: x * 3)
+    with caplog.at_level("WARNING", logger="dbscan_tpu.obs.compile"):
+        for n in range(3, 8):
+            obs_compile.tracked_call("site.fam", fn, jnp.ones(n))
+    storms = [r for r in caplog.records if "recompile storm" in r.message]
+    assert len(storms) == 1
+    assert "test_obs.py:" in storms[0].getMessage()
+    obs_compile.reset()
+
+
+def test_storm_site_falls_back_to_static_callgraph():
+    """With no runtime miss observed for a family, the storm attribution
+    uses the linter's static tracked_call metadata (file:line of the
+    dispatch call sites in the package source)."""
+    from dbscan_tpu.obs import compile as obs_compile
+
+    obs_compile.reset()
+    site = obs_compile._known_sites("dispatch.dense")
+    assert "parallel" in site and "driver.py:" in site
+    assert obs_compile._known_sites("no.such.family") == "unknown call site"
+    obs_compile.reset()
+
+
+def test_all_runtime_telemetry_names_are_declared(monkeypatch):
+    """obs/schema.py is the single source of truth: every counter,
+    gauge, span, and event name a real run (with fault retries
+    injected) emits is declared there. Deleting an emitted name from
+    the schema fails this test at runtime and the linter
+    (tests/test_lint.py) statically."""
+    from dbscan_tpu.obs import schema
+
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "dispatch#0:TRANSIENT*1")
+    monkeypatch.setenv("DBSCAN_FAULT_BACKOFF_S", "0")
+    faults.reset_registry()
+    try:
+        obs.enable()
+        train(_blobs(), **KW)
+        st = obs.state()
+        for name in st.metrics.counters():
+            assert schema.is_declared("counter", name), name
+        for name in st.metrics.gauges():
+            assert schema.is_declared("gauge", name), name
+        for name in {sp.name for sp in st.tracer.spans}:
+            assert schema.is_declared("span", name), name
+        event_names = {
+            ev[0] for sp in st.tracer.spans for ev in sp.events
+        } | {name for (name, _t, _a) in st.tracer.instants}
+        assert event_names  # the injected fault guarantees fault.retry
+        for name in event_names:
+            assert schema.is_declared("event", name), name
+    finally:
+        faults.reset_registry()
+
+
 def test_small_train_records_compile_accounting():
     """A cold-cache train() under obs records at least one dispatch
     compile; an identical rerun records none (the lru_cache + jit cache
