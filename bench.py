@@ -697,6 +697,14 @@ def run_train(pts, maxpp, use_pallas=False, reps=1, **extra):
             if dt_rep < dt:  # keep the BEST rep's model: its phase split
                 model, dt = m, dt_rep  # describes the reported wall
                 rep_obs = _rep_obs_fields(obs.counters_delta(snap), dt_rep)
+                # pull-pipeline overlap share, straight from the rep's
+                # stats (pipeline.delta_totals is the ONE place the
+                # ratio is computed); absent on serial
+                # (DBSCAN_PULL_PIPELINE=0) reps, which therefore never
+                # gate against pipelined history
+                pull = m.stats.get("pull")
+                if pull and pull.get("busy_s", 0) > 0:
+                    rep_obs["pull_overlap_ratio"] = pull["overlap_ratio"]
     finally:
         st.trace_path = trace_path
         obs.flush()  # one untimed write covering all reps
@@ -1174,6 +1182,9 @@ _COMPACT_SUFFIXES = (
     # wall cannot be read without knowing whether the rep paid the
     # payload upload must carry the tag too
     "_resident_hot",
+    # pull-pipeline overlap share (parallel/pipeline.py): rides the
+    # compact line so tail-only captures still feed the regress gate
+    "_pull_overlap_ratio",
 )
 
 
@@ -1194,6 +1205,7 @@ def _compact_summary(out: dict) -> dict:
             "ari_full",
             "ari_vs_cpu",
             "n_clusters",
+            "pull_overlap_ratio",
         )
         if k in out
     }

@@ -299,6 +299,25 @@ ENV_VARS = _env_table(
         "of at the postdispatch tail.",
     ),
     EnvVar(
+        "DBSCAN_PULL_PIPELINE", "bool", True,
+        "Pipelined pull engine (parallel/pipeline.py): D2H transfers "
+        "and host finalize run on a background worker, overlapping "
+        "remaining device dispatch; 0 restores the serial pull paths "
+        "byte-for-byte.",
+    ),
+    EnvVar(
+        "DBSCAN_PULL_INFLIGHT", "int", 2,
+        "Pull-pipeline depth: compact chunks with copy_to_host_async "
+        "issued ahead of the host finalize (the pull.inflight gauge "
+        "never exceeds it).",
+    ),
+    EnvVar(
+        "DBSCAN_PULL_INFLIGHT_BYTES", "int", 1 << 30,
+        "Byte budget across in-flight pipelined pulls, so HBM-resident "
+        "chunks are not all materialized host-side at once (a single "
+        "oversized chunk still runs, alone).",
+    ),
+    EnvVar(
         "DBSCAN_SPILL_DEVICE", "str", "auto",
         "Spill-tree device passes: 1 forces the accelerator path, 0 "
         "forces host BLAS, auto uses the device when a non-CPU backend "

@@ -47,6 +47,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import re
+import threading
 import time
 import zlib
 from typing import Callable, Optional, Tuple
@@ -69,7 +70,8 @@ SITE_DISPATCH = "dispatch"  # dense/resident kernel group fan-out
 SITE_BANDED = "banded"  # banded phase-1 group fan-out
 SITE_SPILL = "spill"  # spill-tree device ops (spill_device.py)
 SITE_STREAM = "stream"  # streaming per-batch update step
-_SITES = (SITE_DISPATCH, SITE_BANDED, SITE_SPILL, SITE_STREAM, "*")
+SITE_PULL = "pull"  # pipelined compact-chunk pull (parallel/pipeline.py)
+_SITES = (SITE_DISPATCH, SITE_BANDED, SITE_SPILL, SITE_STREAM, SITE_PULL, "*")
 
 
 class FaultInjected(Exception):
@@ -173,6 +175,12 @@ class FaultRegistry:
     def __init__(self, spec: str = ""):
         self.clauses = parse_fault_spec(spec)
         self._counts: dict = {}
+        # pull-site supervision runs on the pipeline worker while the
+        # dispatch sites run on the main thread; ordinal consumption is
+        # a read-modify-write, so it must be locked or a mixed
+        # pull+dispatch spec could lose updates and shift every later
+        # global ("*") ordinal
+        self._lock = threading.Lock()
 
     @property
     def active(self) -> bool:
@@ -182,10 +190,11 @@ class FaultRegistry:
         """Consume one dispatch ordinal at ``site``; returns (per-site
         ordinal, global ordinal) — the latter is what ``*`` clauses
         match."""
-        n = self._counts.get(site, 0)
-        self._counts[site] = n + 1
-        g = self._counts.get("*", 0)
-        self._counts["*"] = g + 1
+        with self._lock:
+            n = self._counts.get(site, 0)
+            self._counts[site] = n + 1
+            g = self._counts.get("*", 0)
+            self._counts["*"] = g + 1
         return n, g
 
     def check(
@@ -226,9 +235,28 @@ def reset_registry() -> None:
     _registry_spec = None
 
 
+def pull_site_active() -> bool:
+    """True when the active fault spec names the ``pull`` site
+    explicitly. The pipelined pull wraps its job in :func:`supervised`
+    ONLY then: an unconditional wrap would consume registry ordinals
+    for every chunk pull and shift the global (``*``-clause) ordinal
+    stream every existing spec was written against — and interleave it
+    nondeterministically, since pull ordinals are consumed on the
+    engine worker while dispatch ordinals are consumed on the main
+    thread. Real (un-injected) async device faults keep today's path
+    either way: they surface at the consuming wait and hit the
+    driver's abort guard."""
+    return any(c.site == SITE_PULL for c in get_registry().clauses)
+
+
 class FaultCounters:
     """Structured failure accounting, accumulated process-wide; callers
-    snapshot at run start and report the delta (one run's counters)."""
+    snapshot at run start and report the delta (one run's counters).
+    Increments go through :meth:`add` — supervised pull jobs run on the
+    pipeline worker concurrently with main-thread dispatches, and an
+    unlocked ``+=`` could lose updates and break the documented
+    field-for-field equality with the (locked) obs ``faults.*``
+    counters."""
 
     _FIELDS = (
         "attempts",
@@ -240,22 +268,33 @@ class FaultCounters:
     )
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.reset()
 
     def reset(self) -> None:
-        self.attempts = 0  # supervised attempts started
-        self.retries = 0  # attempts re-run after a supervised failure
-        self.fallbacks = 0  # groups/steps degraded to the CPU path
-        self.budget_halvings = 0  # RESOURCE_EXHAUSTED budget reductions
-        self.injected = 0  # injected (vs real) faults observed
-        self.backoff_s = 0.0  # total backoff slept
+        with self._lock:
+            self.attempts = 0  # supervised attempts started
+            self.retries = 0  # attempts re-run after supervised failure
+            self.fallbacks = 0  # groups/steps degraded to the CPU path
+            self.budget_halvings = 0  # RESOURCE_EXHAUSTED reductions
+            self.injected = 0  # injected (vs real) faults observed
+            self.backoff_s = 0.0  # total backoff slept
+
+    def add(self, field: str, value=1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + value)
 
     def snapshot(self) -> dict:
-        return {f: getattr(self, f) for f in self._FIELDS}
+        with self._lock:
+            return {f: getattr(self, f) for f in self._FIELDS}
 
     def delta(self, snap: dict) -> dict:
+        # diff against a LOCKED snapshot: a raw field-by-field read
+        # could tear across a worker-thread add (retries moved,
+        # backoff_s not yet) and break the field-for-field equality
+        # with the obs faults.* counters
         out = {
-            f: getattr(self, f) - snap.get(f, 0) for f in self._FIELDS
+            f: v - snap.get(f, 0) for f, v in self.snapshot().items()
         }
         out["backoff_s"] = round(out["backoff_s"], 6)
         return out
@@ -398,7 +437,7 @@ def supervised(
     attempt = 0
     while True:
         attempts += 1
-        counters.attempts += 1
+        counters.add("attempts")
         obs.count("faults.attempts")
         try:
             reg.check(site, ordinal, global_ordinal, attempt)
@@ -413,7 +452,7 @@ def supervised(
             if kind is None:
                 raise
             if isinstance(e, FaultInjected):
-                counters.injected += 1
+                counters.add("injected")
                 obs.count("faults.injected")
             last = e
             if kind == PERSISTENT:
@@ -434,7 +473,7 @@ def supervised(
                 and budget > 1
             ):
                 budget = max(1, budget // 2)
-                counters.budget_halvings += 1
+                counters.add("budget_halvings")
                 obs.count("faults.budget_halvings")
                 # record the HBM occupancy that (presumably) triggered
                 # the exhaustion: until now the halving was blind — a
@@ -463,8 +502,8 @@ def supervised(
             if rng is None:
                 rng = _site_seed(pol, site, ordinal)
             delay = pol.backoff(attempt, rng)
-            counters.retries += 1
-            counters.backoff_s += delay
+            counters.add("retries")
+            counters.add("backoff_s", delay)
             obs.count("faults.retries")
             obs.count("faults.backoff_s", delay)
             obs.event(
@@ -489,7 +528,7 @@ def supervised(
                 time.sleep(delay)
             attempt += 1
     if fallback is not None:
-        counters.fallbacks += 1
+        counters.add("fallbacks")
         obs.count("faults.fallbacks")
         obs.event(
             "fault.fallback",
@@ -522,6 +561,6 @@ def note_degrade() -> None:
     tree keeps its own device->host fallback structure (per-node state
     to tear down), so it counts the degrade itself after
     :func:`supervised` exhausts the retries."""
-    counters.fallbacks += 1
+    counters.add("fallbacks")
     obs.count("faults.fallbacks")
     obs.event("fault.degrade_host")

@@ -42,7 +42,11 @@ HIGHER_BETTER = "higher"
 
 def direction(metric: str, unit: Optional[str] = None) -> Optional[str]:
     """Which way ``metric`` regresses: walls regress up, throughputs
-    regress down, everything else is not gate-able."""
+    (and the pull-pipeline overlap ratio) regress down, everything else
+    is not gate-able."""
+    if metric.endswith("_overlap_ratio"):
+        # overlap lost = pulls back on the critical path: regresses DOWN
+        return HIGHER_BETTER
     if metric.endswith(("_seconds", "_s")) or metric == "seconds":
         return LOWER_BETTER
     if metric.endswith(("_mpts", "_vs_baseline", "_throughput")) or metric in (

@@ -98,12 +98,17 @@ COUNTERS = {
     "compiles.wall_s": "summed wall of the cache-miss calls",
     "compiles.ratchet_raises": "streaming shape-floor raises post-warm-up",
     "memory.samples": "HBM watermark samples taken",
+    "pull.wait_s": "consumer seconds actually blocked on pipelined pulls",
+    "pull.overlap_s": "pull/finalize seconds hidden behind other work",
+    "pull.busy_s": "total pipelined pull+finalize wall (worker seconds)",
+    "pull.bytes": "bytes routed through the pull pipeline (size hints)",
 }
 
 GAUGES = {
     "memory.bytes_in_use": "summed live allocator bytes at last sample",
     "memory.peak_bytes_in_use": "process high-water mark (monotone)",
     "memory.bytes_limit": "summed allocator capacity when reported",
+    "pull.inflight": "pull-pipeline jobs started and not yet finished",
 }
 
 SPANS = {
@@ -113,6 +118,7 @@ SPANS = {
     "dispatch.resident": "resident kernel group fan-out",
     "dispatch.banded": "banded phase-1 group fan-out",
     "spill.payload_upload": "spill resident payload upload",
+    "spill.partition": "spill-tree build over one (sub)dataset",
     "spill.pivots": "spill-tree pivot selection pass",
     "spill.screen": "spill-tree rejection screen pass",
     "spill.membership": "spill-tree full-node membership pass",
@@ -120,6 +126,7 @@ SPANS = {
     "spill.child_gather": "spill-tree child row gather",
     "compact.flush_chunk": "compact p1 chunk flush to device",
     "compact.pull_chunk": "compact p1 chunk pull to host",
+    "pull.chunk": "one pull-pipeline job (transfer + host finalize)",
     "checkpoint.save_premerge": "pre-merge checkpoint write",
     "checkpoint.save_p1_chunk": "p1 chunk checkpoint write",
     "transfer.pull": "device->host pull (bytes in args)",

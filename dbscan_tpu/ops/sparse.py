@@ -466,10 +466,15 @@ def _mesh_leaf_dispatch(
     Dispatch is ASYNC: every batch is enqueued before any result is
     pulled, so host packing of batch i+1 overlaps device compute of
     batch i (the property the sequential path's device stash exists
-    for) and the pulls at the end see already-finished work."""
+    for) and the pulls at the end see already-finished work. With the
+    pull engine live (parallel/pipeline.py; single-process only — these
+    pulls are collectives under a multi-process mesh) each batch's pull
+    + slot scatter additionally runs on the worker WHILE later batches
+    are still being packed, instead of all serially at the end."""
     from collections import defaultdict
 
     from dbscan_tpu.parallel import mesh as mesh_mod
+    from dbscan_tpu.parallel import pipeline as pipe_mod
 
     m = mesh_mod.mesh_size(mesh)
     seeds_all = np.zeros(total, dtype=np.int32)
@@ -481,6 +486,18 @@ def _mesh_leaf_dispatch(
     # process mesh the batch inputs are global arrays, and a device-
     # committed eps would clash at jit time (see mesh.replicate_host_array)
     ej = mesh_mod.replicate_host_array(np.float32(eps))
+    pull_pipe = pipe_mod.get_engine()
+
+    def _land(batch, w, seeds_dev, flags_dev):
+        """Pull one leaf batch and scatter it into its slots (disjoint
+        across batches, so worker-side writes never race)."""
+        seeds = mesh_mod.pull_to_host(seeds_dev)
+        flags = mesh_mod.pull_to_host(flags_dev)
+        for i, p in enumerate(batch):
+            seeds_all[slot_off[p] : slot_off[p] + w] = seeds[i]
+            flags_all[slot_off[p] : slot_off[p] + w] = flags[i]
+
+    jobs = []
     inflight = []  # (batch leaf ids, width, seeds_dev, flags_dev)
     for w, plist in sorted(by_w.items()):
         fn = _compiled_leaf_batch(w, feature_block, min_points, engine, mesh)
@@ -513,11 +530,28 @@ def _mesh_leaf_dispatch(
                 mesh_mod.shard_host_array(mesh, mask_b),
                 ej,
             )
-            inflight.append((batch, w, seeds_dev, flags_dev))
+            if pull_pipe is not None:
+                jobs.append(
+                    (
+                        pull_pipe.submit(
+                            functools.partial(
+                                _land, batch, w, seeds_dev, flags_dev
+                            ),
+                            bytes_hint=int(
+                                getattr(seeds_dev, "nbytes", 0)
+                            )
+                            + int(getattr(flags_dev, "nbytes", 0)),
+                            label=f"leafbatch{len(jobs)}",
+                        ),
+                        (batch, w, seeds_dev, flags_dev),
+                    )
+                )
+            else:
+                inflight.append((batch, w, seeds_dev, flags_dev))
+    for job, args in jobs:
+        # settle = wait + brake-on-fault + serial _land for a job a
+        # concurrent abort cancelled (its buffers are untouched)
+        pull_pipe.settle(job, functools.partial(_land, *args))
     for batch, w, seeds_dev, flags_dev in inflight:
-        seeds = mesh_mod.pull_to_host(seeds_dev)
-        flags = mesh_mod.pull_to_host(flags_dev)
-        for i, p in enumerate(batch):
-            seeds_all[slot_off[p] : slot_off[p] + w] = seeds[i]
-            flags_all[slot_off[p] : slot_off[p] + w] = flags[i]
+        _land(batch, w, seeds_dev, flags_dev)
     return seeds_all, flags_all

@@ -57,6 +57,7 @@ from dbscan_tpu.ops.labels import CORE, NOISE, SEED_NONE
 from dbscan_tpu.ops.local_dbscan import local_dbscan
 from dbscan_tpu.parallel import binning, cellgraph, partitioner
 from dbscan_tpu.parallel import mesh as mesh_mod
+from dbscan_tpu.parallel import pipeline as pipe_mod
 from dbscan_tpu.parallel.graph import uf_components
 from dbscan_tpu.parallel.mesh import PARTS_AXIS, mesh_size
 
@@ -1634,6 +1635,15 @@ def train_arrays(
     # packer instead of serializing behind it.
     pending = []
     dispatch_spent = [0.0]
+    # Pipelined pull engine (parallel/pipeline.py): D2H pulls + the host
+    # finalize that consumes them run on a background worker, bounded by
+    # DBSCAN_PULL_INFLIGHT/_BYTES, so transfers overlap host algebra and
+    # remaining device dispatch. None under DBSCAN_PULL_PIPELINE=0 (every
+    # serial code path below is then byte-for-byte the pre-pipeline one)
+    # and in multi-process runs (pulls are collectives whose issue order
+    # must stay deterministic on the main thread).
+    pull_pipe = pipe_mod.get_engine()
+    pull_snap = pull_pipe.totals() if pull_pipe is not None else None
     # DBSCAN_TIME_DEVICE=1: block synchronously on each banded phase-1
     # dispatch and accumulate the pure device-execution window into
     # timings["banded_p1_sync_s"]. This sacrifices pack/compute overlap
@@ -1736,9 +1746,16 @@ def train_arrays(
         if time_device:  # keep the MFU ratio honest on diverged resumes
             sync_spent[0] += time.perf_counter() - ts
 
-    def _pull_record(rec):
+    def _pull_record(rec, account=True):
         """Block on a live chunk's postpass, compute its border gather,
-        and (with a checkpoint_dir) persist the artifacts."""
+        and (with a checkpoint_dir) persist the artifacts. The record is
+        NOT mutated until every pull succeeded, so a failed attempt can
+        re-enter (faults.supervised retry on the pipeline worker, or the
+        abort path's serial re-walk) and re-run from the top.
+        ``account=False`` on the pipeline worker: the main thread charges
+        only the time it actually BLOCKED to ``pull_spent`` — the
+        timings algebra (dispatch_s/cellcc_pull_core_s) subtracts pull
+        stalls, and a pull that overlapped other work stalled nothing."""
         if "combo_host" in rec or "pending_loaded" in rec or "dropped" in rec:
             return  # done, placeholder still collecting, or re-chunked
         if "combo_dev" not in rec:
@@ -1746,7 +1763,7 @@ def train_arrays(
         tp = time.perf_counter()
         layout = rec["layout"]
         total = layout["total"]
-        combo_host = mesh_mod.pull_to_host(rec.pop("combo_dev"))
+        combo_host = mesh_mod.pull_to_host(rec["combo_dev"])
         core_ch = np.unpackbits(
             combo_host[: total // 8], count=total
         ).astype(bool)
@@ -1754,7 +1771,7 @@ def train_arrays(
         bb_dev = obs_compile.tracked_call(
             "cellcc.gather",
             gather_flat,
-            rec.pop("bits_flat"),
+            rec["bits_flat"],
             mesh_mod.replicate_host_array(
                 _pad_idx(bpos, getattr(cfg, "shape_floors", None))
             ),
@@ -1764,7 +1781,10 @@ def train_arrays(
         rec["core_ch"] = core_ch
         rec["bpos"] = bpos
         rec["bbits"] = bbits
-        eager["pull_spent"] += time.perf_counter() - tp
+        rec.pop("combo_dev")
+        rec.pop("bits_flat")
+        if account:
+            eager["pull_spent"] += time.perf_counter() - tp
         obs.count("checkpoint.chunk_pulls")
         obs.add_span(
             "compact.pull_chunk",
@@ -1816,12 +1836,63 @@ def train_arrays(
             ),
             mesh_mod.replicate_host_array(_pad_idx(layout["or_pos"])),
         )
-        if not mesh_mod.multiprocess():
-            # local-shard async copy; cross-host pulls gather instead
+        if not mesh_mod.multiprocess() and pull_pipe is None:
+            # local-shard async copy; cross-host pulls gather instead.
+            # Pipelined runs defer this to the job's start hook so the
+            # DBSCAN_PULL_INFLIGHT_BYTES budget bounds how many chunks
+            # are host-materialized at once
             combo_dev.copy_to_host_async()
         rec["layout"] = layout
         rec["combo_dev"] = combo_dev
         rec["bits_flat"] = bits_flat
+
+    def _submit_pull(rec):
+        """Hand a freshly-flushed chunk's pull + host finalize to the
+        pipeline worker. When the active fault spec names the ``pull``
+        site, the job runs under faults.supervised so retry/halving
+        happens ON the worker — a failed pull re-enters the pipeline
+        job, not the raw call (the record is untouched until success,
+        see _pull_record)."""
+        layout = rec["layout"]
+        combo_dev = rec["combo_dev"]
+        # host-side footprint estimate: the packed combo buffer plus the
+        # unpacked core bools plus a border-gather worst case
+        hint = int(getattr(combo_dev, "nbytes", 0)) + 2 * int(
+            layout["total"]
+        )
+        if faults.pull_site_active():
+            def work(rec=rec):
+                faults.supervised(
+                    faults.SITE_PULL,
+                    lambda _b: _pull_record(rec, account=False),
+                    label=f"chunk {rec['ci']}",
+                )
+        else:
+            def work(rec=rec):
+                _pull_record(rec, account=False)
+        rec["pull_job"] = pull_pipe.submit(
+            work,
+            on_start=combo_dev.copy_to_host_async,
+            bytes_hint=hint,
+            label=f"chunk{rec['ci']}",
+        )
+
+    def _consume_pull(rec):
+        """Settle a record at a consuming site: block on its pipeline
+        job when one exists (charging only the blocked wall to
+        pull_spent — that is the stall the timings algebra subtracts),
+        re-raising any worker fault HERE so _abort_guard banks earlier
+        chunks' artifacts exactly as on the serial path; then the
+        serial _pull_record covers every non-pipelined case (no-op when
+        the job already landed the artifacts)."""
+        job = rec.pop("pull_job", None)
+        if job is not None:
+            tw = time.perf_counter()
+            try:
+                pull_pipe.settle(job)
+            finally:
+                eager["pull_spent"] += time.perf_counter() - tw
+        _pull_record(rec)
 
     def _complete_placeholder(rec):
         """All of a saved chunk's groups have arrived: verify the ordinal-
@@ -1877,19 +1948,25 @@ def train_arrays(
         ):
             _run_postpass(rec)
         eager["records"].append(rec)
-        # pipeline by default (pull chunk i-1 while chunk i's phase-1
-        # work executes); DBSCAN_EAGER_PULL=1 pulls each chunk at its
-        # own flush — resilience over overlap, for retry loops on a
-        # worker that keeps dying before the delayed pull lands.
-        # Multi-process: forced OFF — pulls issue cross-process
-        # collectives, and an env var set on only some hosts would
-        # desynchronize the collective order (the checkpointing it
-        # serves is single-process anyway)
+        # DBSCAN_EAGER_PULL=1 pulls each chunk serially at its own flush
+        # — resilience over overlap, for retry loops on a worker that
+        # keeps dying before a delayed pull lands. Multi-process: forced
+        # OFF — pulls issue cross-process collectives, and an env var
+        # set on only some hosts would desynchronize the collective
+        # order (the checkpointing it serves is single-process anyway).
+        # Otherwise the pull engine takes the chunk: its D2H + host
+        # finalize run on the worker, bounded-depth ahead, overlapping
+        # the remaining dispatch. The abort path cancels not-yet-started
+        # jobs and settles serially (_abort_flush), so submits stop once
+        # an abort began. With no engine, the serial one-behind pipeline
+        # (pull chunk i-1 while chunk i's phase-1 window executes).
         if (
             config_mod.env("DBSCAN_EAGER_PULL")
             and not mesh_mod.multiprocess()
         ):
             _pull_record(rec)
+        elif pull_pipe is not None and not eager.get("aborting"):
+            _submit_pull(rec)
         elif len(eager["records"]) >= 2:
             _pull_record(eager["records"][-2])
 
@@ -1919,8 +1996,25 @@ def train_arrays(
         except Exception:  # noqa: BLE001 — the fault itself must win
             logger.exception("abort-path progress note failed")
         try:
+            # stop feeding the pipeline and settle serially: cancelled
+            # jobs never ran, so their records are untouched and the
+            # serial _pull_record below re-pulls them; completed jobs
+            # already banked (and checkpointed) their artifacts on the
+            # worker — exactly the "earlier chunks' work is never
+            # wasted" guarantee the serial abort path gives
+            eager["aborting"] = True
+            if pull_pipe is not None:
+                pull_pipe.quiesce()
             _flush_chunk()
             for rec in eager["records"]:
+                job = rec.pop("pull_job", None)
+                if job is not None:
+                    try:
+                        pull_pipe.wait(job)
+                    except Exception:  # noqa: BLE001 — settle the rest
+                        logger.exception(
+                            "abort-path pipelined pull failed"
+                        )
                 _pull_record(rec)
         except Exception:  # noqa: BLE001 — the fault itself must win
             logger.exception(
@@ -1942,13 +2036,28 @@ def train_arrays(
         try:
             yield
         except faults.FatalDeviceFault as e:
+            _halt_pipeline()
             _abort_flush(e.site, e.ordinal, str(e))
             raise
         except Exception as e:  # noqa: BLE001 — classify() filters
             if faults.classify(e) is None:
                 raise
+            _halt_pipeline()
             _abort_flush("pull", -1, f"{type(e).__name__}: {e}")
             raise
+
+    def _halt_pipeline():
+        """A device fault is about to abort the run: stop feeding the
+        pull engine and settle its in-flight job, whether or not a
+        checkpoint_dir exists (the process-global engine must not carry
+        this run's jobs into the next one). Cancelled jobs leave their
+        records untouched; _abort_flush's serial re-walk covers them."""
+        eager["aborting"] = True
+        if pull_pipe is not None:
+            try:
+                pull_pipe.quiesce()
+            except Exception:  # noqa: BLE001 — the fault itself must win
+                logger.exception("pull-pipeline quiesce failed")
 
     def _on_group(g):
         td = time.perf_counter()
@@ -2278,9 +2387,10 @@ def train_arrays(
         for rec in compact:
             # the last chunk is usually still live here; its pull is
             # the final place an async device fault can surface with
-            # earlier chunks' artifacts worth banking
+            # earlier chunks' artifacts worth banking (a pipelined
+            # worker fault re-raises at this wait — same guard)
             with _abort_guard():
-                _pull_record(rec)
+                _consume_pull(rec)
             layout = rec.get("layout")
             if layout is None:  # checkpoint-loaded chunk
                 layout = cellgraph.cell_layout(rec["groups"])
@@ -2374,28 +2484,52 @@ def train_arrays(
 
     n_core = 0
     inst_seed_l, inst_flag_l = [], []
-    for i, (g, (seeds_dev, flags_dev, nc)) in enumerate(pending):
+
+    def _group_rows(i, g, seeds_dev, flags_dev):
+        """Pull one group's seed/flag buffers and extract the valid
+        prefix rows. On the pull worker (pipelined) group k+1's
+        transfer/device-wait overlaps group k's host extraction; the
+        serial path runs it inline, exactly the pre-pipeline loop."""
         seeds_g = mesh_mod.pull_to_host(seeds_dev)
         flags_g = mesh_mod.pull_to_host(flags_dev)
-        n_core += int(nc)
         if seeds_g.ndim == 1:
             # finalize_compact already emits flat valid-prefix arrays in
             # instance order
-            inst_seed_l.append(seeds_g)
-            inst_flag_l.append(flags_g)
-            continue
+            return seeds_g, flags_g
         es = (
             _native.extract_prefix(seeds_g, g.row_counts)
             if g.row_counts is not None
             else None
         )
         if es is not None:
-            inst_seed_l.append(es)
-            inst_flag_l.append(_native.extract_prefix(flags_g, g.row_counts))
+            return es, _native.extract_prefix(flags_g, g.row_counts)
+        rows, slots = _slotmap_of(i)
+        return seeds_g[rows, slots], flags_g[rows, slots]
+
+    group_jobs = None
+    if pull_pipe is not None and pending:
+        group_jobs = [
+            pull_pipe.submit(
+                functools.partial(_group_rows, i, g, sd, fd),
+                bytes_hint=int(getattr(sd, "nbytes", 0))
+                + int(getattr(fd, "nbytes", 0)),
+                label=f"group{i}",
+            )
+            for i, (g, (sd, fd, _nc)) in enumerate(pending)
+        ]
+    for i, (g, (seeds_dev, flags_dev, nc)) in enumerate(pending):
+        n_core += int(nc)
+        if group_jobs is not None:
+            # settle = wait + brake-on-fault + serial fallback for a
+            # job a concurrent abort cancelled (buffers untouched)
+            es, ef = pull_pipe.settle(
+                group_jobs[i],
+                functools.partial(_group_rows, i, g, seeds_dev, flags_dev),
+            )
         else:
-            rows, slots = _slotmap_of(i)
-            inst_seed_l.append(seeds_g[rows, slots])
-            inst_flag_l.append(flags_g[rows, slots])
+            es, ef = _group_rows(i, g, seeds_dev, flags_dev)
+        inst_seed_l.append(es)
+        inst_flag_l.append(ef)
     inst_seed = np.concatenate(inst_seed_l) if inst_seed_l else np.empty(0, np.int32)
     inst_flag = np.concatenate(inst_flag_l) if inst_flag_l else np.empty(0, np.int8)
     t0 = _mark("device_s", t0)
@@ -2481,6 +2615,12 @@ def train_arrays(
     timings["merge_s"] = round(t_end - t0, 6)
     timings["total_s"] = round(t_end - t_start, 6)
     stats = {**core_stats, "n_clusters": n_clusters, "timings": timings}
+    if pull_pipe is not None:
+        # this run's pull-pipeline accounting (engine totals are
+        # process-cumulative; the delta is the per-run figure, the same
+        # snapshot/delta discipline as stats["faults"]). overlap_ratio
+        # is what bench stamps as pull_overlap_ratio.
+        stats["pull"] = pipe_mod.delta_totals(pull_snap, pull_pipe.totals())
     obs.add_span(
         "train",
         t_start,

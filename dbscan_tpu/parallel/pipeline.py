@@ -1,0 +1,410 @@
+"""Pipelined device->host pull engine: overlap D2H transfers with host
+finalize and device compute.
+
+Why this module exists: on the flagship 10M-point anchor run the
+dominant phase was no longer device compute — ``cellcc_pull_core_s``
+reached 16.4 s of 34.5 s wall (BENCH_TPU_r05c.json) because the driver
+pulled compact chunks ONE AT A TIME, blocking on each D2H transfer
+while the host-side unpack/layout algebra that follows it sat idle.
+Parallel-DBSCAN systems win by keeping every pipeline stage busy (Wang
+et al., arXiv:1912.06255; Prokopenko et al., arXiv:2103.05162); this is
+the transfer-stage counterpart of the driver's existing pack/compute
+overlap.
+
+Shape: a bounded-depth producer/consumer pipeline with ONE background
+worker.
+
+- Producers (:meth:`PullEngine.submit`) enqueue *jobs*: a host
+  ``work()`` callable (the pull + the host finalize that consumes it)
+  plus an optional ``on_start()`` hook (``copy_to_host_async()`` for
+  device buffers, so the transfer is in flight before the worker
+  reaches the job). Submission never blocks.
+- The worker STARTS up to ``DBSCAN_PULL_INFLIGHT`` jobs ahead —
+  byte-budgeted by ``DBSCAN_PULL_INFLIGHT_BYTES`` so HBM-resident
+  chunks are not all materialized host-side at once — and EXECUTES
+  jobs strictly in submission order (the host finalize is sequential
+  algebra; ordering is what makes pipelined and serial runs
+  label-for-label identical).
+- Consumers (:meth:`PullEngine.wait`) block until their job finishes
+  and re-raise the job's exception AT THE CONSUMING SITE — exactly
+  where an async device fault surfaces on the serial path, so the
+  driver's ``_abort_guard`` banks earlier chunks' artifacts unchanged.
+
+Fault composition: the engine runs whatever callable it is given, so a
+caller that wraps its work in :func:`dbscan_tpu.faults.supervised`
+(the driver does, when a ``pull``-site fault clause is active) gets
+retry/halving ON the worker — a failed pull re-enters the pipeline job,
+not the raw call. Jobs the abort path cancels before they start leave
+their record untouched, so the serial abort-flush re-pull is always
+safe.
+
+Observability (declared in :mod:`dbscan_tpu.obs.schema`): a
+``pull.inflight`` gauge (started-but-unfinished jobs — bounded by the
+configured depth), ``pull.wait_s`` (consumer seconds actually blocked)
+and ``pull.overlap_s`` (worker seconds hidden behind other work)
+counters, ``pull.busy_s``/``pull.bytes`` totals, and one ``pull.chunk``
+span per job. The same figures accumulate in engine-internal
+:meth:`PullEngine.totals` (independent of obs being enabled) so the
+driver can stamp ``stats["pull"]`` and bench can derive
+``pull_overlap_ratio`` without a live trace.
+
+Off-switch: ``DBSCAN_PULL_PIPELINE=0`` makes :func:`get_engine` return
+None and every call site keeps its original serial code path
+byte-for-byte. Multi-process runs also get None: pulls there are
+cross-host collectives whose issue order must stay deterministic on the
+main thread (see mesh.pull_to_host).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from dbscan_tpu import config, obs
+
+logger = logging.getLogger(__name__)
+
+#: totals keys (engine-internal accounting, mirrored as pull.* counters)
+_TOTAL_KEYS = ("jobs", "wait_s", "busy_s", "overlap_s", "bytes")
+
+
+class PullJob:
+    """One submitted pull: transfer + host finalize, executed on the
+    engine worker. ``wait`` on the owning engine blocks for it."""
+
+    __slots__ = (
+        "work", "on_start", "bytes_hint", "label",
+        "result", "error", "busy_s", "cancelled", "consumed", "_done",
+    )
+
+    def __init__(
+        self,
+        work: Callable[[], object],
+        on_start: Optional[Callable[[], None]],
+        bytes_hint: int,
+        label: str,
+    ):
+        self.work = work
+        self.on_start = on_start
+        self.bytes_hint = max(0, int(bytes_hint))
+        self.label = label
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.busy_s = 0.0
+        self.cancelled = False
+        self.consumed = False
+        self._done = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class PullEngine:
+    """Single-worker bounded-depth pull pipeline (module docstring)."""
+
+    def __init__(self, inflight: int = 2, inflight_bytes: int = 1 << 30):
+        self.inflight = max(1, int(inflight))
+        self.inflight_bytes = max(1, int(inflight_bytes))
+        self._cv = threading.Condition()
+        self._pending: deque = deque()  # submitted, on_start not yet run
+        self._ready: deque = deque()  # started, not yet executed
+        self._executing: Optional[PullJob] = None
+        self._started = 0  # started (ready + executing) job count
+        self._started_bytes = 0
+        self._shutdown = False
+        self._worker: Optional[threading.Thread] = None
+        self._totals = {k: 0 if k in ("jobs", "bytes") else 0.0
+                        for k in _TOTAL_KEYS}
+        self._totals["inflight_peak"] = 0
+
+    # --- producer side -------------------------------------------------
+
+    def submit(
+        self,
+        work: Callable[[], object],
+        *,
+        on_start: Optional[Callable[[], None]] = None,
+        bytes_hint: int = 0,
+        label: str = "",
+    ) -> PullJob:
+        """Enqueue one job; never blocks. Jobs execute strictly in
+        submission order on the worker."""
+        job = PullJob(work, on_start, bytes_hint, label)
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("pull engine is shut down")
+            self._pending.append(job)
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._loop, name="dbscan-pull", daemon=True
+                )
+                self._worker.start()
+            # start-ahead from the SUBMITTING thread too: the worker
+            # cannot issue async copies while it is blocked inside a
+            # pull, and the whole point of the depth window is that the
+            # next chunk's D2H is in flight BEHIND the executing one
+            to_start = self._start_ready_locked()
+            self._cv.notify_all()
+        self._run_start_hooks(to_start)
+        return job
+
+    # --- consumer side -------------------------------------------------
+
+    def wait(self, job: PullJob):
+        """Block until ``job`` finishes; returns its result or re-raises
+        its exception at THIS (consuming) call site. A cancelled job
+        returns None with its record untouched — the caller's serial
+        fallback still applies. Idempotent accounting: only the first
+        wait on a job contributes to wait/overlap totals."""
+        t0 = time.perf_counter()
+        job._done.wait()
+        waited = time.perf_counter() - t0
+        first = False
+        with self._cv:
+            if not job.consumed:
+                job.consumed = True
+                first = True
+                overlap = max(0.0, job.busy_s - waited)
+                self._totals["wait_s"] += waited
+                self._totals["overlap_s"] += overlap
+        if first:
+            obs.count("pull.wait_s", waited)
+            obs.count("pull.overlap_s", overlap)
+        if job.error is not None:
+            raise job.error
+        return job.result
+
+    def settle(self, job: PullJob, serial_fallback=None):
+        """Consume one job at its ordering point — the ONE place the
+        wait/quiesce/cancelled contract lives, shared by every
+        consumer. Waits for the job; on a worker fault, brakes the
+        worker first (quiesce — it must not race ahead on a doomed
+        run's remaining jobs) and re-raises HERE, the consuming site.
+        A job cancelled by a concurrent abort left its inputs
+        untouched, so ``serial_fallback()`` (when given) runs the work
+        inline. Returns the job's result, or the fallback's."""
+        try:
+            out = self.wait(job)
+        except Exception:
+            self.quiesce()
+            raise
+        if job.cancelled and serial_fallback is not None:
+            return serial_fallback()
+        return out
+
+    def drain(self) -> None:
+        """Block until every submitted job has finished (results are NOT
+        consumed; exceptions stay on their jobs for wait())."""
+        with self._cv:
+            jobs = list(self._pending) + list(self._ready)
+            if self._executing is not None:
+                jobs.append(self._executing)
+        for j in jobs:
+            j._done.wait()
+
+    def quiesce(self) -> int:
+        """Abort-path brake: cancel every job that has not begun
+        executing (their records stay untouched — serial re-pull safe)
+        and block until the in-flight one finishes. Returns the number
+        of cancelled jobs."""
+        with self._cv:
+            dropped = list(self._pending) + list(self._ready)
+            self._pending.clear()
+            # started-but-unexecuted jobs already ran on_start (the async
+            # copy is in flight) but their work never runs: releasing the
+            # byte window here keeps the invariants for later jobs
+            for j in self._ready:
+                self._started -= 1
+                self._started_bytes -= j.bytes_hint
+            self._ready.clear()
+            for j in dropped:
+                j.cancelled = True
+                j._done.set()
+            while self._executing is not None:
+                self._cv.wait()
+        self._set_inflight_gauge()
+        return len(dropped)
+
+    def close(self) -> None:
+        """Stop the worker (cancels everything not yet executing)."""
+        self.quiesce()
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+    # --- accounting ----------------------------------------------------
+
+    def totals(self) -> dict:
+        """Cumulative engine accounting (independent of obs): jobs,
+        wait_s, busy_s, overlap_s, bytes, inflight_peak."""
+        with self._cv:
+            return dict(self._totals)
+
+    def _set_inflight_gauge(self) -> None:
+        with self._cv:
+            n = self._started
+            if n > self._totals["inflight_peak"]:
+                self._totals["inflight_peak"] = n
+        obs.gauge("pull.inflight", n)
+
+    # --- worker --------------------------------------------------------
+
+    def _start_ready_locked(self) -> list:
+        """Move pending jobs into the started window while the depth and
+        byte budgets allow (the first job of an empty window always
+        fits, so an oversized single chunk cannot deadlock). Returns the
+        jobs whose on_start must run (outside the lock)."""
+        to_start = []
+        while self._pending:
+            nxt = self._pending[0]
+            if self._started >= self.inflight:
+                break
+            if (
+                self._started > 0
+                and self._started_bytes + nxt.bytes_hint
+                > self.inflight_bytes
+            ):
+                break
+            self._pending.popleft()
+            self._started += 1
+            self._started_bytes += nxt.bytes_hint
+            self._ready.append(nxt)
+            to_start.append(nxt)
+        return to_start
+
+    def _run_start_hooks(self, to_start: list) -> None:
+        """Run on_start (the async D2H copy kick) for freshly-started
+        jobs, outside the lock. Each job is moved to the started window
+        exactly once (under the lock), so its hook runs exactly once —
+        from whichever thread moved it."""
+        for j in to_start:
+            if j.on_start is not None:
+                try:
+                    j.on_start()
+                except Exception as e:  # noqa: BLE001 — surfaces at wait
+                    logger.debug("pull on_start failed: %s", e)
+        if to_start:
+            self._set_inflight_gauge()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while True:
+                    if self._shutdown:
+                        return
+                    to_start = self._start_ready_locked()
+                    if to_start or self._ready:
+                        break
+                    self._cv.wait()
+            self._run_start_hooks(to_start)
+            with self._cv:
+                if not self._ready:
+                    continue
+                job = self._ready.popleft()
+                self._executing = job
+            t0 = time.perf_counter()
+            try:
+                job.result = job.work()
+            except BaseException as e:  # noqa: BLE001 — re-raised at wait
+                job.error = e
+            job.busy_s = time.perf_counter() - t0
+            with self._cv:
+                self._executing = None
+                self._started -= 1
+                self._started_bytes -= job.bytes_hint
+                self._totals["jobs"] += 1
+                self._totals["busy_s"] += job.busy_s
+                self._totals["bytes"] += job.bytes_hint
+                self._cv.notify_all()
+            # telemetry BEFORE the done event (a consumer that returned
+            # from wait() must find the job's counters/span already
+            # emitted), shielded so a failing hook can never strand the
+            # waiter
+            try:
+                obs.count("pull.busy_s", job.busy_s)
+                if job.bytes_hint:
+                    obs.count("pull.bytes", job.bytes_hint)
+                obs.add_span(
+                    "pull.chunk",
+                    t0,
+                    t0 + job.busy_s,
+                    label=job.label,
+                    bytes=int(job.bytes_hint),
+                    failed=job.error is not None,
+                )
+                self._set_inflight_gauge()
+            except Exception:  # noqa: BLE001 — never strand a waiter
+                logger.exception("pull telemetry emission failed")
+            job._done.set()
+
+
+# --- process-global engine --------------------------------------------
+
+_engine: Optional[PullEngine] = None
+_engine_key = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> Optional[PullEngine]:
+    """The process pull engine for the CURRENT env configuration, or
+    None when pipelining must not run:
+
+    - ``DBSCAN_PULL_PIPELINE=0`` — the hard off-switch; every call site
+      then keeps its original serial code path byte-for-byte;
+    - multi-process runs — pulls are cross-host collectives whose issue
+      order must stay deterministic on the main thread.
+
+    The engine is rebuilt (old worker drained and stopped) whenever the
+    knob values change, so tests can monkeypatch the env per test."""
+    global _engine, _engine_key
+    key = (
+        bool(config.env("DBSCAN_PULL_PIPELINE")),
+        int(config.env("DBSCAN_PULL_INFLIGHT")),
+        int(config.env("DBSCAN_PULL_INFLIGHT_BYTES")),
+    )
+    with _engine_lock:
+        if not key[0]:
+            if _engine is not None:
+                _engine.close()
+                _engine = None
+                _engine_key = None
+            return None
+        from dbscan_tpu.parallel import mesh as mesh_mod
+
+        if mesh_mod.multiprocess():
+            return None
+        if _engine is None or _engine_key != key:
+            if _engine is not None:
+                _engine.close()
+            _engine = PullEngine(inflight=key[1], inflight_bytes=key[2])
+            _engine_key = key
+        return _engine
+
+
+def reset_engine() -> None:
+    """Stop and drop the process engine (tests)."""
+    global _engine, _engine_key
+    with _engine_lock:
+        if _engine is not None:
+            _engine.close()
+        _engine = None
+        _engine_key = None
+
+
+def delta_totals(snap: Optional[dict], now: Optional[dict]) -> dict:
+    """One run's pull accounting: difference of two :meth:`totals`
+    snapshots, seconds rounded (the shape ``stats["pull"]`` reports)."""
+    snap = snap or {}
+    now = now or {}
+    out = {}
+    for k in _TOTAL_KEYS:
+        v = now.get(k, 0) - snap.get(k, 0)
+        out[k] = round(v, 6) if isinstance(v, float) else int(v)
+    out["overlap_ratio"] = round(
+        min(1.0, out["overlap_s"] / out["busy_s"]), 4
+    ) if out["busy_s"] > 0 else 0.0
+    return out

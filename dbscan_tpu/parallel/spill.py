@@ -297,6 +297,12 @@ _LEADER_EDGE_BUDGET = 32
 _LEADER_CHUNK = 1 << 16
 
 
+# uncovered candidates resolved per pairwise block in the host greedy
+# cover: bounds the [k, k] chord matrix at ~1 MB while keeping the
+# per-candidate BLAS calls batched away
+_LEADER_RESOLVE = 512
+
+
 def _greedy_leaders(sub: "_DenseOps", t: float, rng):
     """Greedy metric cover of the node at radius ``t``: stream shuffled
     batches, points farther than ``t`` from every existing leader become
@@ -304,7 +310,17 @@ def _greedy_leaders(sub: "_DenseOps", t: float, rng):
     near-duplicates collapse to one). Returns the [L, D] leader rows, or
     None when L would exceed _LEADER_CAP. Batches grow adaptively while
     no new leaders appear (coverage checks are one matmul) and shrink
-    back on discovery, keeping the sequential tail short."""
+    back on discovery, keeping the sequential tail short.
+
+    The in-batch greedy is resolved in BLOCKS (the host counterpart of
+    the device cover's [K, K] resolution, spill_device._make_cover):
+    each ``_LEADER_RESOLVE``-candidate block pays one matmul against the
+    leaders this batch minted so far plus one [k, k] pairwise pass, and
+    the sequential walk then runs over the precomputed matrix — the
+    per-candidate [1, L] BLAS calls the old inner loop issued (one
+    device-shaped sync per point in the worst case) collapse into two
+    batched passes per block, with decisions identical to the
+    one-at-a-time walk."""
     n = sub.x.shape[0]
     order = rng.permutation(n)
     buf = np.empty((_LEADER_CAP, sub.dim), dtype=np.float32)
@@ -325,16 +341,30 @@ def _greedy_leaders(sub: "_DenseOps", t: float, rng):
             continue
         batch = 2048
         start = nb  # pre-batch leaders already filtered via d above
-        for i in unc:  # sequential: each may cover later candidates
-            v = vb[i]
+        for s2 in range(0, len(unc), _LEADER_RESOLVE):
+            blk = vb[unc[s2 : s2 + _LEADER_RESOLVE]]
             if nb > start:
-                dl = _chords_of(v[None, :], buf[start:nb])[0]
-                if float(dl.min()) <= t:
+                # drop candidates covered by leaders minted earlier in
+                # THIS batch (exactly the walk's first check), one
+                # batched pass instead of one matvec per candidate
+                alive = (
+                    _chords_of(blk, buf[start:nb]).min(axis=1) > t
+                )
+                blk = blk[alive]
+            if not len(blk):
+                continue
+            pair = _chords_of(blk, blk)
+            kept: list = []
+            for j in range(len(blk)):
+                # identical to the sequential walk: candidate j drops
+                # iff an EARLIER in-block keeper covers it
+                if kept and float(pair[j, kept].min()) <= t:
                     continue
-            if nb >= _LEADER_CAP:  # only an actual append can overflow
-                return None
-            buf[nb] = v
-            nb += 1
+                if nb >= _LEADER_CAP:  # only a real append overflows
+                    return None
+                buf[nb] = blk[j]
+                nb += 1
+                kept.append(j)
     return buf[:nb].copy()
 
 
@@ -734,6 +764,20 @@ def spill_partition(
             np.empty(0, np.int32),
         )
     rng = np.random.default_rng(seed)
+    # Root span over this (sub)tree build: spill_partition_s is ~97% of
+    # the cosine wall on TPU, and the sub-spans below (spill.pivots /
+    # spill.screen / spill.membership / spill.leader_cover — now emitted
+    # on the HOST paths too, not only the device ones) are what lets
+    # obs.analyze attribute the remainder for the next optimization PR.
+    with obs.span("spill.partition", n=int(n), maxpp=int(maxpp)):
+        return _spill_tree(
+            unit, ops, n, maxpp, halo, seed, rng, device_ops
+        )
+
+
+def _spill_tree(unit, ops, n, maxpp, halo, seed, rng, device_ops):
+    """The recursive pivot-tree build behind :func:`spill_partition`
+    (split out so the root span wraps exactly the tree work)."""
     # Device-resident rows for the accelerated passes (dense only): one
     # bf16 upload of the WHOLE array; every node below gathers its subset
     # on device from it (a child upload is an int32 index vector). Any
@@ -824,11 +868,15 @@ def spill_partition(
                     dev_root = dev_sub = dev_s = None
                     sub = ops.take(idx)
             if piv is None:
-                if s_local is not None:
-                    sub_s = sub.take(np.sort(s_local))
-                    piv = _pivot_vectors(sub_s, m, halo, rng)
-                else:
-                    piv = _pivot_vectors(sub, m, halo, rng)
+                with obs.span(
+                    "spill.pivots", node=int(len(idx)), m=int(m),
+                    host=True,
+                ):
+                    if s_local is not None:
+                        sub_s = sub.take(np.sort(s_local))
+                        piv = _pivot_vectors(sub_s, m, halo, rng)
+                    else:
+                        piv = _pivot_vectors(sub, m, halo, rng)
             if len(piv) < 2:
                 # All pivots collapsed inside one halo ball. For DENSE
                 # nodes one exact [n, 1] pass settles the node: if every
@@ -897,9 +945,12 @@ def spill_partition(
                         screen_dup = float(mem_s.sum()) / mem_s.shape[0]
                         screen_m = mem_s.shape[1]
                 else:
-                    _, _, _, mem_s = _membership(
-                        _chords(sub_s, piv), halo
-                    )
+                    with obs.span(
+                        "spill.screen", node=int(len(idx)), host=True
+                    ):
+                        _, _, _, mem_s = _membership(
+                            _chords(sub_s, piv), halo
+                        )
                     screen_dup = float(mem_s.sum()) / mem_s.shape[0]
                     screen_m = mem_s.shape[1]
                 if screen_dup > 1.15 * MAX_DUP_FACTOR:
@@ -940,9 +991,12 @@ def spill_partition(
                     dev_root = dev_sub = None
                     sub = ops.take(idx)
             if dev_sub is None:
-                assign, _d_min, _r, member = _membership(
-                    _chords(sub, piv), halo
-                )
+                with obs.span(
+                    "spill.membership", node=int(len(idx)), host=True
+                ):
+                    assign, _d_min, _r, member = _membership(
+                        _chords(sub, piv), halo
+                    )
             sizes = member.sum(axis=0)
             if (
                 float(sizes.sum()) / len(idx) <= MAX_DUP_FACTOR
@@ -996,9 +1050,19 @@ def spill_partition(
                     )
                     faults.note_degrade()
                     dev_root = dev_sub = None
-                    pc = leader_components(ops.take(idx), halo, rng)
+                    with obs.span(
+                        "spill.leader_cover",
+                        node=int(len(idx)),
+                        host=True,
+                    ):
+                        pc = leader_components(
+                            ops.take(idx), halo, rng
+                        )
             else:
-                pc = leader_components(sub, halo, rng)
+                with obs.span(
+                    "spill.leader_cover", node=int(len(idx)), host=True
+                ):
+                    pc = leader_components(sub, halo, rng)
             if pc is not None and pc[1] > 1:
                 # same bin-packing as the top-level pre-split: packed
                 # bins become leaves on the next pop; oversized

@@ -201,6 +201,13 @@ def pivot_vectors_device(sub: DeviceNodeOps, m: int, halo: float, rng):
     fn = _farthest_lloyd_fn(_ladder8(int(m)), int(sub.dim))
     seed0 = int(rng.integers(sub.n))
     piv, mass = fn(sub.x, seed0)
+    # ONE host sync for both outputs (device_get on the pair) instead of
+    # two sequential np.asarray round-trips — per NODE this is small,
+    # but the tree calls this once per escalation attempt per node and
+    # the tunnel charges ~latency per sync, not per byte
+    import jax
+
+    piv, mass = jax.device_get((piv, mass))
     piv = np.asarray(piv, dtype=np.float32)
     mass = np.asarray(mass)
     keep = mass > 0
@@ -302,28 +309,27 @@ def screen_dup_device(sub: DeviceNodeOps, piv: np.ndarray, halo: float):
 _COVER_BLOCK = 512
 
 
-@functools.lru_cache(maxsize=8)
-def _greedy_leaders_fn(dim: int, cap: int):
-    """Jitted greedy metric cover: walk the permutation, every row
-    farther than ``t`` (minus slack: bf16 could OVERestimate a distance
-    and mint a leader the host would skip — extra leaders are harmless,
-    but a MISSED cover is not, so the coverage test uses t + slack
-    nowhere and the canopy band carries the slack instead; the
-    sequential walk semantics match the host exactly up to
-    quantization/reduction order). BLOCKED: each while-iteration takes
-    the first K uncovered candidates in perm order, resolves the
-    in-block greedy (a candidate covered by an earlier in-block pick
-    drops — identical to the one-at-a-time walk) with one [K, K]
-    pairwise pass + a K-step scan, and updates coverage with ONE
-    [n, K] matmul — ~L/K iterations instead of L (measured 5.7 s ->
-    sub-second at L=2000, n=1M, D=512). Returns (leader rows
-    [cap, D] f32, count, overflowed)."""
-    jax, jnp = _jax()
+def _make_cover(jax, jnp, dim: int, cap: int):
+    """The greedy-cover loop body shared by the single-radius function
+    (kept for targeted tests) and the fused escalation ladder: walk the
+    permutation, every row farther than ``t`` from all leaders becomes
+    one (minus slack: bf16 could OVERestimate a distance and mint a
+    leader the host would skip — extra leaders are harmless, but a
+    MISSED cover is not, so the coverage test uses t + slack nowhere and
+    the canopy band carries the slack instead; the sequential walk
+    semantics match the host exactly up to quantization/reduction
+    order). BLOCKED: each while-iteration takes the first K uncovered
+    candidates in perm order, resolves the in-block greedy (a candidate
+    covered by an earlier in-block pick drops — identical to the
+    one-at-a-time walk) with one [K, K] pairwise pass + a K-step scan,
+    and updates coverage with ONE [n, K] matmul — ~L/K iterations
+    instead of L (measured 5.7 s -> sub-second at L=2000, n=1M,
+    D=512). Returns ``cover(xf, t) -> (buf [cap+1, D], nb, overflow)``
+    over pre-permuted f32 rows."""
     K = _COVER_BLOCK
 
-    def fn(x, perm, t):
-        n = x.shape[0]
-        xf = x.astype(jnp.float32)[perm]
+    def cover(xf, t):
+        n = xf.shape[0]
 
         # dmin carries SQUARED chords (no per-iteration [n] sqrt);
         # coverage therefore tests against t^2 — comparing chord^2
@@ -382,7 +388,63 @@ def _greedy_leaders_fn(dim: int, cap: int):
         buf, nb, _, overflow = jax.lax.while_loop(
             cond, body, (buf0, jnp.int32(0), d0, jnp.bool_(False))
         )
+        return buf, nb, overflow
+
+    return cover
+
+
+@functools.lru_cache(maxsize=8)
+def _greedy_leaders_fn(dim: int, cap: int):
+    """Jitted single-radius greedy cover (see :func:`_make_cover`);
+    returns (leader rows [cap, D] f32, count, overflowed)."""
+    jax, jnp = _jax()
+    cover = _make_cover(jax, jnp, dim, cap)
+
+    def fn(x, perm, t):
+        xf = x.astype(jnp.float32)[perm]
+        buf, nb, overflow = cover(xf, t)
         return buf[:cap], nb, overflow
+
+    return jax.jit(fn)
+
+
+#: fixed rung-ladder width of the fused cover (the escalation list is
+#: at most (2, 4, 8) x halo; shorter deduped ladders pad by repeating
+#: the last rung, which the `r < n_rungs` loop bound never evaluates)
+_LADDER_RUNGS = 3
+
+
+@functools.lru_cache(maxsize=8)
+def _greedy_leaders_ladder_fn(dim: int, cap: int):
+    """Jitted FUSED escalation ladder: run the greedy cover at rung
+    ``ts[0]``; while it overflows the cap, rerun at the next rung — all
+    on device, so the whole ladder costs ONE dispatch and ONE host sync
+    instead of one per rung (each rung's overflow check was a ~0.5 s
+    round-trip on the tunneled TPU). ``ts`` is the host-deduped [3]
+    radius ladder (bf16 floor + the 1.9 canopy cutoff applied on the
+    host, exactly the per-rung loop it replaces), ``n_rungs`` the live
+    prefix length. Returns (leader rows [cap, D], count, overflowed,
+    rung index used)."""
+    jax, jnp = _jax()
+    cover = _make_cover(jax, jnp, dim, cap)
+
+    def fn(x, perm, ts, n_rungs):
+        xf = x.astype(jnp.float32)[perm]
+
+        def outer_cond(st):
+            r, _, _, overflow = st
+            return (r < n_rungs) & overflow
+
+        def outer_body(st):
+            r, _, _, _ = st
+            buf, nb, overflow = cover(xf, ts[r])
+            return r + jnp.int32(1), buf, nb, overflow
+
+        buf0, nb0, ov0 = cover(xf, ts[0])
+        r, buf, nb, overflow = jax.lax.while_loop(
+            outer_cond, outer_body, (jnp.int32(1), buf0, nb0, ov0)
+        )
+        return buf[:cap], nb, overflow, r - 1
 
     return jax.jit(fn)
 
@@ -427,61 +489,75 @@ def leader_components_device(
 
     n = sub.n
     # ONE permutation shared by every escalation rung: the greedy walk
-    # is a deterministic function of (perm, t), so the t == t_prev skip
-    # below is provably futile — a same-radius rerun with the same perm
+    # is a deterministic function of (perm, t), so the t == t_prev dedup
+    # below is provably sound — a same-radius rerun with the same perm
     # must overflow identically. (Per-rung draws would make that claim
     # false: a different walk order could stay under _LEADER_CAP.)
     perm = rng.permutation(n).astype(np.int32)
+    # Host-side rung ladder, exactly the per-rung loop this replaces:
+    # bf16 floor on the cover radius (a covered point's MEASURED chord
+    # to its leader can read as high as the slack — a self-chord under
+    # bf16 is not 0 — so a minting radius below the slack could never
+    # terminate; the proof only needs SOME radius, so the floor costs
+    # nothing but leader density), clamped duplicates dropped, and the
+    # 1.9 canopy cutoff ending the ladder.
+    rungs = []
     t_prev = None
     for t_mult in (2.0, 4.0, 8.0):
-        # bf16 floor on the cover radius: a covered point's MEASURED
-        # chord to its leader can read as high as the slack (a self-
-        # chord under bf16 is not 0), so a minting radius below the
-        # slack could never terminate — and the proof only needs SOME
-        # radius, so the floor costs nothing but leader density
         t = max(t_mult * halo, BF16_CHORD_SLACK)
         if t == t_prev:
-            continue  # floor clamped this rung too: same radius, same
-            # permutation — the rerun provably overflows the same way
+            continue
         t_prev = t
         if t + halo >= 1.9:
             break
-        import jax.numpy as jnp
+        rungs.append(t)
+    if not rungs:
+        return None
+    import jax.numpy as jnp
 
-        fn = _greedy_leaders_fn(int(sub.dim), _LEADER_CAP)
-        buf, nb, overflow = fn(sub.x, jnp.asarray(perm), jnp.float32(t))
-        if bool(overflow):
-            continue  # cap exceeded: retry at a coarser radius
-        nb = int(nb)
-        if nb < 2:
-            return None
-        # true cover radius <= t + slack (measured <= t); both
-        # endpoints of an accepted pair then MEASURE within
-        # t + halo + 2*slack of the covering leader
-        band = t + halo + 2.0 * BF16_CHORD_SLACK
-        cfn = _canopy_fn(int(sub.dim))
-        l_pad = _ladder8(nb, cap=_LEADER_CAP)
-        nearest, adj, col_counts = cfn(
-            sub.x,
-            jnp.asarray(np.asarray(buf)[:l_pad]),
-            jnp.int32(nb),
-            jnp.float32(band),
-        )
-        total = float(
-            np.asarray(col_counts, dtype=np.float64)[:nb].sum()
-        )
-        if total > edge_budget * n:
-            return None  # canopies overlap heavily; larger radii more so
-        adj = np.asarray(adj)[:nb, :nb]
-        ea, eb = np.nonzero(np.triu(adj, k=1))
-        n_comp, gids = uf_components(
-            ea.astype(np.int64), eb.astype(np.int64), nb
-        )
-        if n_comp < 2:
-            return None
-        comp = (np.asarray(gids)[np.asarray(nearest)] - 1).astype(np.int32)
-        return comp, int(n_comp)
-    return None
+    # The whole escalation runs FUSED on device: one dispatch, one host
+    # sync for up to three rungs, instead of a blocking overflow check
+    # per rung (the per-rung host round-trips were the dominant
+    # fixed cost of this pass on the tunneled TPU). Pad the ladder by
+    # repeating the last rung — the `r < n_rungs` bound never runs pads.
+    ts = np.full(_LADDER_RUNGS, rungs[-1], dtype=np.float32)
+    ts[: len(rungs)] = rungs
+    fn = _greedy_leaders_ladder_fn(int(sub.dim), _LEADER_CAP)
+    buf, nb, overflow, used = fn(
+        sub.x, jnp.asarray(perm), jnp.asarray(ts), jnp.int32(len(rungs))
+    )
+    if bool(overflow):
+        return None  # every rung exceeded the cap
+    nb = int(nb)
+    if nb < 2:
+        return None
+    t = float(rungs[int(used)])
+    # true cover radius <= t + slack (measured <= t); both
+    # endpoints of an accepted pair then MEASURE within
+    # t + halo + 2*slack of the covering leader
+    band = t + halo + 2.0 * BF16_CHORD_SLACK
+    cfn = _canopy_fn(int(sub.dim))
+    l_pad = _ladder8(nb, cap=_LEADER_CAP)
+    nearest, adj, col_counts = cfn(
+        sub.x,
+        jnp.asarray(np.asarray(buf)[:l_pad]),
+        jnp.int32(nb),
+        jnp.float32(band),
+    )
+    total = float(
+        np.asarray(col_counts, dtype=np.float64)[:nb].sum()
+    )
+    if total > edge_budget * n:
+        return None  # canopies overlap heavily; larger radii more so
+    adj = np.asarray(adj)[:nb, :nb]
+    ea, eb = np.nonzero(np.triu(adj, k=1))
+    n_comp, gids = uf_components(
+        ea.astype(np.int64), eb.astype(np.int64), nb
+    )
+    if n_comp < 2:
+        return None
+    comp = (np.asarray(gids)[np.asarray(nearest)] - 1).astype(np.int32)
+    return comp, int(n_comp)
 
 
 def device_available() -> bool:

@@ -230,6 +230,16 @@ class StreamingDBSCAN:
         # re-runs the batch pinned to the host backend — stream
         # identities survive a dead device instead of dying with it.
         fault_snap = faults.counters.snapshot()
+        # The per-batch pulls ride the process-global pull engine
+        # (parallel/pipeline.py), whose worker persists across updates —
+        # steady-state micro-batches pay no per-update thread spawn.
+        # Snapshot/delta gives the WHOLE update's pull accounting,
+        # including any batch-level supervised retry this wrapper takes
+        # (mirrors the faults delta below).
+        from dbscan_tpu.parallel import pipeline as pipe_mod
+
+        pull_pipe = pipe_mod.get_engine()
+        pull_snap = pull_pipe.totals() if pull_pipe is not None else None
         obs.ensure_env()
         with obs.span(
             "stream.update",
@@ -328,6 +338,10 @@ class StreamingDBSCAN:
             # misses batch-level retries/degradations this wrapper took
             faults=faults.counters.delta(fault_snap),
         )
+        if pull_pipe is not None:
+            stats["pull"] = pipe_mod.delta_totals(
+                pull_snap, pull_pipe.totals()
+            )
         # the inner train_arrays flushed BEFORE this update's outer span
         # closed; re-flush so the trace file always contains the last
         # complete stream.update span

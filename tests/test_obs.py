@@ -621,23 +621,35 @@ def test_storm_site_falls_back_to_static_callgraph():
 def test_all_runtime_telemetry_names_are_declared(monkeypatch):
     """obs/schema.py is the single source of truth: every counter,
     gauge, span, and event name a real run (with fault retries
-    injected) emits is declared there. Deleting an emitted name from
-    the schema fails this test at runtime and the linter
+    injected, and with the PULL PIPELINE live so the pull.* family is
+    exercised too) emits is declared there. Deleting an emitted name
+    from the schema fails this test at runtime and the linter
     (tests/test_lint.py) statically."""
     from dbscan_tpu.obs import schema
+    from dbscan_tpu.parallel import pipeline as pipe_mod
 
     monkeypatch.setenv("DBSCAN_FAULT_SPEC", "dispatch#0:TRANSIENT*1")
     monkeypatch.setenv("DBSCAN_FAULT_BACKOFF_S", "0")
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
     faults.reset_registry()
+    pipe_mod.reset_engine()
     try:
         obs.enable()
         train(_blobs(), **KW)
         st = obs.state()
-        for name in st.metrics.counters():
+        counters = st.metrics.counters()
+        # the pipelined train really emitted its pull telemetry (the
+        # engine worker drains before train returns, so the counters
+        # are complete by now)
+        assert counters.get("pull.busy_s", 0) > 0
+        assert "pull.inflight" in st.metrics.gauges()
+        for name in counters:
             assert schema.is_declared("counter", name), name
         for name in st.metrics.gauges():
             assert schema.is_declared("gauge", name), name
-        for name in {sp.name for sp in st.tracer.spans}:
+        span_names = {sp.name for sp in st.tracer.spans}
+        assert "pull.chunk" in span_names
+        for name in span_names:
             assert schema.is_declared("span", name), name
         event_names = {
             ev[0] for sp in st.tracer.spans for ev in sp.events
@@ -647,6 +659,7 @@ def test_all_runtime_telemetry_names_are_declared(monkeypatch):
             assert schema.is_declared("event", name), name
     finally:
         faults.reset_registry()
+        pipe_mod.reset_engine()
 
 
 def test_small_train_records_compile_accounting():

@@ -378,6 +378,31 @@ def test_regress_green_on_identical_red_on_2x(tmp_path):
     assert regress.main(["--history", hist, "--capture", slow_thr]) == 1
 
 
+def test_regress_gates_pull_overlap_ratio(tmp_path):
+    """The pull-pipeline overlap ratio rides the history like the walls
+    (suffix `_overlap_ratio`, unit `ratio`) and regresses DOWN: a
+    capture whose pulls fell back onto the critical path flags."""
+    rows = [
+        {"backend": "tpu", "anchor_pull_overlap_ratio": v}
+        for v in (0.82, 0.78, 0.85)
+    ]
+    hist = _mk_history(tmp_path, rows)
+    recs = bench_history.load_history(hist)
+    mine = [r for r in recs if r["metric"] == "anchor_pull_overlap_ratio"]
+    assert len(mine) == 3 and all(r["unit"] == "ratio" for r in mine)
+    assert regress.direction("anchor_pull_overlap_ratio") == "higher"
+    same = _capture(
+        tmp_path, "BENCH_OV_OK.json",
+        {"backend": "tpu", "anchor_pull_overlap_ratio": 0.80},
+    )
+    assert regress.main(["--history", hist, "--capture", same]) == 0
+    lost = _capture(
+        tmp_path, "BENCH_OV_BAD.json",
+        {"backend": "tpu", "anchor_pull_overlap_ratio": 0.15},
+    )
+    assert regress.main(["--history", hist, "--capture", lost]) == 1
+
+
 def test_regress_hot_cold_populations_never_mix(tmp_path):
     """A cold cosine wall ~10x the hot wall is NOT a regression when
     the history's cold population says so — and a 2x slowdown within

@@ -1,0 +1,427 @@
+"""Pipelined pull engine (dbscan_tpu/parallel/pipeline.py).
+
+Pins, per the PR acceptance bar:
+
+- pipeline/serial LABEL-FOR-LABEL equivalence on every engine family
+  (banded, dense, cosine spill, streaming) — ``DBSCAN_PULL_PIPELINE=0``
+  restores the serial pull paths, so both settings must produce exactly
+  the same clusters and flags;
+- bounded inflight: the engine never starts more jobs than
+  ``DBSCAN_PULL_INFLIGHT`` (and never exceeds the
+  ``DBSCAN_PULL_INFLIGHT_BYTES`` budget beyond one job), pinned as a
+  property of the engine itself;
+- fault injection mid-pull (``pull#N`` clauses in ``DBSCAN_FAULT_SPEC``):
+  a transient pull fault retries ON the worker and keeps label parity; a
+  persistent one aborts with completed chunks' artifacts banked and
+  ``checkpoint.note_abort`` recording the ``pull`` site — and the healed
+  resume completes from them;
+- determinism: chunk completion order (pipeline depth) does not affect
+  the merged labels.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import Engine, faults, train
+from dbscan_tpu.parallel import driver
+from dbscan_tpu.parallel import pipeline as pipe_mod
+
+pytestmark = pytest.mark.pipeline
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    """Every test starts with a virgin fault registry and no process
+    engine left over from another test's env (the engine is keyed on
+    the pull knobs; dropping it forces a clean rebuild)."""
+    monkeypatch.setenv("DBSCAN_FAULT_BACKOFF_S", "0")
+    faults.reset_registry()
+    pipe_mod.reset_engine()
+    yield
+    faults.reset_registry()
+    pipe_mod.reset_engine()
+
+
+def _blobs(seed=0):
+    rng = np.random.default_rng(seed)
+    sizes = [80, 200, 500, 1200, 300, 900]
+    centers = [(0, 0), (8, 8), (-7, 9), (9, -8), (-9, -9), (16, 2)]
+    pts = np.concatenate(
+        [rng.normal(c, 0.4, (s, 2)) for c, s in zip(centers, sizes)]
+    )
+    rng.shuffle(pts)
+    return pts
+
+
+def _cosine_rows(seed=3, k=6, per=150, d=24):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, d)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    x = np.repeat(centers, per, axis=0)
+    x += 0.01 * rng.normal(size=x.shape).astype(np.float32)
+    return x
+
+
+KW_BANDED = dict(
+    eps=0.5, min_points=5, max_points_per_partition=256,
+    engine=Engine.ARCHERY, neighbor_backend="banded",
+)
+KW_DENSE = dict(
+    eps=0.5, min_points=5, max_points_per_partition=256,
+    engine=Engine.ARCHERY, neighbor_backend="dense",
+)
+
+
+def _assert_parity(a, b):
+    np.testing.assert_array_equal(a.clusters, b.clusters)
+    np.testing.assert_array_equal(a.flags, b.flags)
+
+
+# --- engine-level properties ------------------------------------------
+
+
+def test_engine_runs_jobs_in_order_and_returns_results():
+    eng = pipe_mod.PullEngine(inflight=3)
+    try:
+        seen = []
+        jobs = [
+            eng.submit(lambda i=i: seen.append(i) or i * i, label=f"j{i}")
+            for i in range(16)
+        ]
+        out = [eng.wait(j) for j in jobs]
+        assert out == [i * i for i in range(16)]
+        assert seen == list(range(16))  # strict submission order
+        t = eng.totals()
+        assert t["jobs"] == 16 and t["busy_s"] >= 0.0
+    finally:
+        eng.close()
+
+
+def test_engine_reraises_at_wait_site():
+    eng = pipe_mod.PullEngine(inflight=2)
+    try:
+        ok = eng.submit(lambda: "fine")
+        boom = eng.submit(lambda: (_ for _ in ()).throw(ValueError("x")))
+        after = eng.submit(lambda: "still runs")
+        assert eng.wait(ok) == "fine"
+        with pytest.raises(ValueError, match="x"):
+            eng.wait(boom)
+        # a failed job never blocks later jobs (ordering, not fate,
+        # is what the pipeline guarantees)
+        assert eng.wait(after) == "still runs"
+    finally:
+        eng.close()
+
+
+def test_engine_bounded_inflight_depth():
+    """Property: started-but-unfinished jobs never exceed the depth.
+    The first job blocks, so everything the worker is ALLOWED to start
+    ahead gets started; the peak must be exactly the configured depth."""
+    eng = pipe_mod.PullEngine(inflight=2, inflight_bytes=1 << 40)
+    gate = threading.Event()
+    started = []
+
+    def mk(i):
+        return eng.submit(
+            lambda: gate.wait(5),
+            on_start=lambda i=i: started.append(i),
+            bytes_hint=10,
+            label=f"b{i}",
+        )
+
+    jobs = [mk(i) for i in range(8)]
+    try:
+        deadline = time.time() + 5
+        while len(started) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.1)  # give the worker a chance to (wrongly) overrun
+        assert len(started) == 2  # depth bound: job 0 executing, 1 ahead
+        gate.set()
+        for j in jobs:
+            eng.wait(j)
+        assert eng.totals()["inflight_peak"] <= 2
+        assert started == list(range(8))  # starts follow submission order
+    finally:
+        gate.set()
+        eng.close()
+
+
+def test_engine_bounded_inflight_bytes():
+    """Byte budget: a second job whose hint would exceed the budget is
+    not started while the first is in flight — but a single oversized
+    job always runs (alone), so no budget can deadlock the pipeline."""
+    eng = pipe_mod.PullEngine(inflight=8, inflight_bytes=100)
+    gate = threading.Event()
+    started = []
+    jobs = [
+        eng.submit(
+            lambda: gate.wait(5),
+            on_start=lambda i=i: started.append(i),
+            bytes_hint=60,
+            label=f"b{i}",
+        )
+        for i in range(4)
+    ]
+    try:
+        deadline = time.time() + 5
+        while not started and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.1)
+        assert started == [0]  # 60 + 60 > 100: second not started
+        gate.set()
+        for j in jobs:
+            eng.wait(j)
+        # oversized single job: runs alone despite hint > budget
+        big = eng.submit(lambda: "ran", bytes_hint=10**9)
+        assert eng.wait(big) == "ran"
+    finally:
+        gate.set()
+        eng.close()
+
+
+def test_engine_drain_settles_all_jobs_without_consuming():
+    """drain() blocks until every submitted job finished, but does NOT
+    consume results or swallow errors — wait() after a drain returns
+    instantly with the stored result/exception."""
+    eng = pipe_mod.PullEngine(inflight=2)
+    try:
+        jobs = [eng.submit(lambda i=i: i + 1) for i in range(6)]
+        bad = eng.submit(lambda: (_ for _ in ()).throw(RuntimeError("kept")))
+        eng.drain()
+        assert all(j.done for j in jobs) and bad.done
+        assert [eng.wait(j) for j in jobs] == list(range(1, 7))
+        with pytest.raises(RuntimeError, match="kept"):
+            eng.wait(bad)
+    finally:
+        eng.close()
+
+
+def test_engine_quiesce_cancels_pending_jobs():
+    eng = pipe_mod.PullEngine(inflight=1)
+    gate = threading.Event()
+    entered = threading.Event()
+    ran = []
+
+    def first_work():
+        entered.set()
+        gate.wait(5)
+        ran.append(0)
+
+    first = eng.submit(first_work)
+    rest = [eng.submit(lambda i=i: ran.append(i)) for i in range(1, 6)]
+    assert entered.wait(5)  # the first job is executing (and blocked)
+    # quiesce cancels everything not yet executing, then waits for the
+    # executing job — release the gate from a side thread so the wait
+    # can complete
+    dropped = [None]
+    t = threading.Thread(target=lambda: dropped.__setitem__(
+        0, eng.quiesce()))
+    t.start()
+    deadline = time.time() + 5
+    while not all(j.cancelled for j in rest) and time.time() < deadline:
+        time.sleep(0.01)
+    assert all(j.cancelled for j in rest)  # none of them ever ran
+    gate.set()
+    t.join(timeout=5)
+    assert dropped[0] == len(rest)
+    for j in rest:
+        assert eng.wait(j) is None  # record untouched, no error
+    eng.wait(first)
+    assert ran == [0]  # the executing job always finishes; cancelled
+    eng.close()  # jobs never run
+
+
+def test_get_engine_respects_off_switch(monkeypatch):
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "0")
+    assert pipe_mod.get_engine() is None
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
+    monkeypatch.setenv("DBSCAN_PULL_INFLIGHT", "3")
+    eng = pipe_mod.get_engine()
+    assert eng is not None and eng.inflight == 3
+    # same knobs -> same engine; changed knobs -> rebuilt
+    assert pipe_mod.get_engine() is eng
+    monkeypatch.setenv("DBSCAN_PULL_INFLIGHT", "5")
+    eng2 = pipe_mod.get_engine()
+    assert eng2 is not eng and eng2.inflight == 5
+
+
+# --- pipeline/serial label equivalence, all engine families -----------
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [KW_BANDED, KW_DENSE],
+    ids=["banded", "dense"],
+)
+def test_pipeline_serial_label_parity(monkeypatch, kw):
+    pts = _blobs()
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "0")
+    serial = train(pts, **kw)
+    assert "pull" not in serial.stats  # serial path reports no engine
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
+    piped = train(pts, **kw)
+    _assert_parity(piped, serial)
+    assert piped.stats["pull"]["jobs"] > 0
+
+
+def test_pipeline_serial_label_parity_cosine(monkeypatch):
+    x = _cosine_rows()
+    kw = dict(
+        eps=0.02, min_points=5, max_points_per_partition=128,
+        metric="cosine",
+    )
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "0")
+    serial = train(x, **kw)
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
+    piped = train(x, **kw)
+    _assert_parity(piped, serial)
+
+
+def test_pipeline_serial_label_parity_streaming(monkeypatch):
+    from dbscan_tpu.streaming import StreamingDBSCAN
+
+    def batches():
+        r = np.random.default_rng(7)
+        for i in range(3):
+            c = np.array([[0.0, 0.0], [5.0, 5.0]]) + i * 0.1
+            yield np.concatenate(
+                [r.normal(c[0], 0.3, (150, 2)), r.normal(c[1], 0.3, (150, 2))]
+            )
+
+    def run():
+        s = StreamingDBSCAN(
+            eps=0.5, min_points=5, max_points_per_partition=128
+        )
+        return [s.update(b) for b in batches()]
+
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "0")
+    serial = run()
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
+    piped = run()
+    for a, b in zip(serial, piped):
+        np.testing.assert_array_equal(a.clusters, b.clusters)
+        np.testing.assert_array_equal(a.flags, b.flags)
+    # the per-update stats carry the whole-update pull delta
+    assert all("pull" in u.stats for u in piped)
+    assert sum(u.stats["pull"]["jobs"] for u in piped) > 0
+
+
+def test_chunk_completion_order_does_not_affect_labels(monkeypatch):
+    """Determinism: pipeline depth (how far transfers run ahead, hence
+    chunk COMPLETION order vs the host algebra) must not change merged
+    labels. Small chunk budget -> many chunks so depth matters."""
+    pts = _blobs()
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 512)
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "0")
+    ref = train(pts, **KW_BANDED)
+    for depth in ("1", "3", "8"):
+        monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
+        monkeypatch.setenv("DBSCAN_PULL_INFLIGHT", depth)
+        out = train(pts, **KW_BANDED)
+        _assert_parity(out, ref)
+        assert out.stats["pull"]["jobs"] >= 3  # many chunks really rode it
+
+
+def test_inflight_gauge_bounded_in_real_run(monkeypatch):
+    """End-to-end property: the pull.inflight gauge a pipelined train
+    leaves behind never exceeded the configured depth (the engine peak
+    is recorded continuously, so the peak pin covers the whole run)."""
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 512)
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
+    monkeypatch.setenv("DBSCAN_PULL_INFLIGHT", "2")
+    train(_blobs(), **KW_BANDED)
+    eng = pipe_mod.get_engine()
+    assert eng is not None
+    assert 1 <= eng.totals()["inflight_peak"] <= 2
+
+
+# --- fault injection mid-pull -----------------------------------------
+
+
+def test_transient_pull_fault_retries_on_worker(monkeypatch):
+    """A pull#N TRANSIENT clause fires inside the pipelined pull job:
+    faults.supervised retries it ON the worker (the job re-enters the
+    pipeline, not the raw call) and the run completes with labels equal
+    to the fault-free run."""
+    pts = _blobs()
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 512)
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
+    clean = train(pts, **KW_BANDED)
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "pull#1:TRANSIENT*2")
+    faults.reset_registry()
+    faulted = train(pts, **KW_BANDED)
+    _assert_parity(faulted, clean)
+    fa = faulted.stats["faults"]
+    assert fa["retries"] == 2 and fa["injected"] == 2
+
+
+def test_persistent_pull_fault_banks_chunks_and_resumes(
+    tmp_path, monkeypatch
+):
+    """A persistent mid-pull fault aborts the run, but chunks whose
+    pipelined pulls completed are banked (persisted) and the abort site
+    is recorded as ``pull`` — then a healed resume completes from them
+    with full label parity."""
+    pts = _blobs()
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
+    clean = train(pts, **KW_BANDED)
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 512)
+    ck = tmp_path / "ck"
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "pull#1:PERSISTENT")
+    faults.reset_registry()
+    with pytest.raises(faults.FatalDeviceFault) as ei:
+        train(pts, checkpoint_dir=str(ck), **KW_BANDED)
+    assert ei.value.site == "pull"
+    assert len(list(ck.glob("p1chunk*.npz"))) >= 1  # chunk 0 banked
+
+    from dbscan_tpu.parallel import checkpoint as ckpt_mod
+
+    prog = ckpt_mod.read_progress(str(ck))
+    assert prog["aborted_site"] == "pull"
+
+    monkeypatch.delenv("DBSCAN_FAULT_SPEC")
+    faults.reset_registry()
+    resumed = train(pts, checkpoint_dir=str(ck), **KW_BANDED)
+    _assert_parity(resumed, clean)
+
+
+def test_pull_site_supervision_is_opt_in(monkeypatch):
+    """Specs that do not name the pull site must not have their global
+    (``*``) ordinal stream shifted by pull jobs: the pipelined pull
+    wraps in faults.supervised only when a pull clause is active."""
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "dispatch#0:TRANSIENT")
+    faults.reset_registry()
+    assert not faults.pull_site_active()
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "pull#0:TRANSIENT")
+    faults.reset_registry()
+    assert faults.pull_site_active()
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 512)
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "banded#1:TRANSIENT")
+    faults.reset_registry()
+    snap = faults.counters.snapshot()
+    out = train(_blobs(), **KW_BANDED)
+    # no pull ordinals were consumed: the run's supervised attempts are
+    # exactly the dispatch-site ones (1 injected retry), so the global
+    # ordinal stream existing * specs rely on is unchanged
+    assert out.stats["faults"]["injected"] == 1
+    assert faults.counters.delta(snap)["attempts"] == out.stats[
+        "faults"
+    ]["attempts"]
+
+
+# --- stats surface ----------------------------------------------------
+
+
+def test_pull_stats_shape(monkeypatch):
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
+    out = train(_blobs(), **KW_BANDED)
+    p = out.stats["pull"]
+    assert set(p) == {
+        "jobs", "wait_s", "busy_s", "overlap_s", "bytes", "overlap_ratio",
+    }
+    assert p["jobs"] > 0 and p["busy_s"] >= 0.0
+    assert 0.0 <= p["overlap_ratio"] <= 1.0
