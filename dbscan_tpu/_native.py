@@ -18,6 +18,7 @@ import ctypes
 import logging
 import os
 import subprocess
+import threading
 from typing import Optional
 
 import numpy as np
@@ -30,6 +31,11 @@ _SO = os.path.join(_REPO, "native", "build", "hostops.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_failed = False
+# The lazy load is reached from BOTH the main thread and the pull-engine
+# worker (extract_prefix under _group_rows jobs): unguarded, two threads
+# could race the build/dlopen and bind argtypes on a half-initialized
+# handle. Double-checked: the fast path stays a plain read.
+_load_lock = threading.Lock()
 
 _I64P = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _I32P = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
@@ -66,10 +72,20 @@ def _build() -> bool:
 
 
 def lib() -> Optional[ctypes.CDLL]:
-    """The loaded native library, or None (numpy fallbacks apply)."""
-    global _lib, _lib_failed
+    """The loaded native library, or None (numpy fallbacks apply).
+    Thread-safe: the main thread and the pull-engine worker both land
+    here; the settled fast path is one unlocked read of the latch."""
     if _lib is not None or _lib_failed:
         return _lib
+    with _load_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        return _load_locked()
+
+
+def _load_locked() -> Optional[ctypes.CDLL]:
+    """Build/load/bind under ``_load_lock`` (caller holds it)."""
+    global _lib, _lib_failed
     from dbscan_tpu.config import env as _env
 
     if not _env("DBSCAN_TPU_NATIVE") or not os.path.exists(_SRC):

@@ -362,6 +362,19 @@ ENV_VARS = _env_table(
         "Force supervised dispatches to block on their outputs so async "
         "device faults attribute to the dispatch site.",
     ),
+    EnvVar(
+        "DBSCAN_TSAN", "bool", False,
+        "graftcheck runtime thread sanitizer (lint/tsan.py): registered "
+        "locks and shared-state sites record cross-thread access "
+        "locksets and lock-acquisition order; races/inversions surface "
+        "in tsan.report()/assert_clean().",
+    ),
+    EnvVar(
+        "DBSCAN_TSAN_REPORT", "str", None,
+        "With DBSCAN_TSAN=1: path receiving the sanitizer's JSON report "
+        "at process exit (how the tier-1 rerun of the pipeline/fault "
+        "suites is asserted race-free from outside the process).",
+    ),
 )
 
 

@@ -47,7 +47,6 @@ from __future__ import annotations
 import dataclasses
 import logging
 import re
-import threading
 import time
 import zlib
 from typing import Callable, Optional, Tuple
@@ -55,6 +54,7 @@ from typing import Callable, Optional, Tuple
 import numpy as np
 
 from dbscan_tpu import config, obs
+from dbscan_tpu.lint import tsan as _tsan
 from dbscan_tpu.obs import memory as _obs_memory
 
 logger = logging.getLogger(__name__)
@@ -180,7 +180,7 @@ class FaultRegistry:
         # a read-modify-write, so it must be locked or a mixed
         # pull+dispatch spec could lose updates and shift every later
         # global ("*") ordinal
-        self._lock = threading.Lock()
+        self._lock = _tsan.lock("faults.registry")
 
     @property
     def active(self) -> bool:
@@ -191,6 +191,7 @@ class FaultRegistry:
         ordinal, global ordinal) — the latter is what ``*`` clauses
         match."""
         with self._lock:
+            _tsan.access("faults.registry")
             n = self._counts.get(site, 0)
             self._counts[site] = n + 1
             g = self._counts.get("*", 0)
@@ -214,25 +215,37 @@ class FaultRegistry:
 
 _registry: Optional[FaultRegistry] = None
 _registry_spec: Optional[str] = None
+# get_registry runs on the pull-engine worker too (supervised pull
+# jobs): the check-then-rebuild of the singleton is a read-modify-write,
+# and an unguarded race could hand the two threads DIFFERENT registries
+# whose ordinal streams both start at 0 — double-firing one-shot fault
+# clauses. Found by graftcheck race-unlocked-shared (PR 6).
+_registry_lock = _tsan.lock("faults.registry_state")
 
 
 def get_registry() -> FaultRegistry:
     """The process registry for the CURRENT ``DBSCAN_FAULT_SPEC`` value
     (re-parsed — with fresh ordinal counters — whenever the env value
-    changes, so tests can monkeypatch the spec per test)."""
+    changes, so tests can monkeypatch the spec per test). Thread-safe:
+    the worker's supervised pull jobs land here concurrently with the
+    main thread's dispatches."""
     global _registry, _registry_spec
     spec = config.env("DBSCAN_FAULT_SPEC")
-    if _registry is None or spec != _registry_spec:
-        _registry = FaultRegistry(spec)
-        _registry_spec = spec
-    return _registry
+    with _registry_lock:
+        _tsan.access("faults.registry_state")
+        if _registry is None or spec != _registry_spec:
+            _registry = FaultRegistry(spec)
+            _registry_spec = spec
+        return _registry
 
 
 def reset_registry() -> None:
     """Drop the registry (ordinal counters restart at 0 on next use)."""
     global _registry, _registry_spec
-    _registry = None
-    _registry_spec = None
+    with _registry_lock:
+        _tsan.access("faults.registry_state")
+        _registry = None
+        _registry_spec = None
 
 
 def pull_site_active() -> bool:
@@ -268,7 +281,7 @@ class FaultCounters:
     )
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _tsan.lock("faults.counters")
         self.reset()
 
     def reset(self) -> None:
@@ -282,10 +295,12 @@ class FaultCounters:
 
     def add(self, field: str, value=1) -> None:
         with self._lock:
+            _tsan.access("faults.counters")
             setattr(self, field, getattr(self, field) + value)
 
     def snapshot(self) -> dict:
         with self._lock:
+            _tsan.access("faults.counters", write=False)
             return {f: getattr(self, f) for f in self._FIELDS}
 
     def delta(self, snap: dict) -> dict:

@@ -1,12 +1,13 @@
-"""graftlint: AST-based static analysis for TPU hazards and telemetry
-contracts.
+"""graftlint + graftcheck: AST-based static analysis for TPU hazards,
+telemetry contracts, and concurrency/collective safety.
 
-Four rule families over the package source (no execution of the linted
+Six rule families over the package source (no execution of the linted
 code; the schema/env cross-checks import the DECLARED registries —
 :mod:`dbscan_tpu.obs.schema` and ``config.ENV_VARS`` — not the linted
 files)::
 
-    python -m dbscan_tpu.lint [--format text|json] [paths...]
+    python -m dbscan_tpu.lint [--format text|json] [--rules GLOBS]
+                              [--baseline PATH] [paths...]
 
 - **host-sync** (``host-sync-item`` / ``host-sync-cast`` /
   ``host-sync-asarray``): implicit device->host syncs in functions
@@ -21,7 +22,17 @@ files)::
   ``obs/schema.py``;
 - **env-registry** (``env-direct-read`` / ``env-undeclared`` /
   ``env-parity``): every ``DBSCAN_*`` read goes through
-  ``config.env`` against the declared table, which PARITY.md mirrors.
+  ``config.env`` against the declared table, which PARITY.md mirrors;
+- **races** (``race-unlocked-shared`` / ``race-lock-order`` /
+  ``race-sync-under-lock`` — graftcheck, lint/races.py): shared-state
+  discipline on the PullEngine worker slice (lint/callgraph.py's
+  ``walk_worker``), the whole-repo lock-acquisition-order graph, and
+  device syncs under locks — validated at runtime by the opt-in thread
+  sanitizer (``DBSCAN_TSAN=1``, lint/tsan.py);
+- **collectives** (``collective-in-branch`` /
+  ``collective-axis-undeclared`` / ``pull-in-collective`` — graftcheck,
+  lint/collectives.py): divergence/axis/pull hazards inside
+  ``shard_map``/``pjit`` bodies, gating the multichip scale-out work.
 
 Suppress a finding on its line with a REQUIRED reason::
 
@@ -67,6 +78,17 @@ RULES = {
     "env-undeclared": "config.env() of a name missing from "
     "config.ENV_VARS",
     "env-parity": "declared env var missing from PARITY.md",
+    "race-unlocked-shared": "unlocked write to shared state from the "
+    "pull-engine worker slice",
+    "race-lock-order": "lock-acquisition-order cycle (or non-reentrant "
+    "self-reacquire) in the whole-repo lock graph",
+    "race-sync-under-lock": "blocking device sync while holding a lock",
+    "collective-in-branch": "collective under a divergence-capable "
+    "conditional inside a shard_map/pjit body",
+    "collective-axis-undeclared": "collective axis name not declared by "
+    "any Mesh in the linted set",
+    "pull-in-collective": "host pull reachable from a shard_map/pjit "
+    "collective region",
     "suppress-no-reason": "graftlint suppression without a reason text",
     "suppress-unknown-rule": "graftlint suppression naming an unknown "
     "rule id",
@@ -75,9 +97,23 @@ RULES = {
 
 
 def _rule_fns():
-    from dbscan_tpu.lint import envvars, hostsync, recompile, telemetry
+    from dbscan_tpu.lint import (
+        collectives,
+        envvars,
+        hostsync,
+        races,
+        recompile,
+        telemetry,
+    )
 
-    return (hostsync.check, recompile.check, telemetry.check, envvars.check)
+    return (
+        hostsync.check,
+        recompile.check,
+        telemetry.check,
+        envvars.check,
+        races.check,
+        collectives.check,
+    )
 
 
 def lint_paths(paths: Iterable[str]) -> Tuple[List[Finding], int]:

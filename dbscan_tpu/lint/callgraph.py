@@ -1,5 +1,5 @@
-"""Package call graph: jit roots, trace-time reachability, and jit
-call-site metadata.
+"""Package call graph: jit roots, trace-time reachability, worker-slice
+reachability (graftcheck), and jit call-site metadata.
 
 What counts as a jit root (a function whose body runs under tracing):
 
@@ -24,6 +24,32 @@ Also exported for runtime use: :func:`tracked_call_sites` maps every
 ``obs_compile.tracked_call("<family>", ...)`` literal to its file:line,
 which `obs/compile.py` folds into the recompile-storm warning so the
 log names the dispatch site, not just the family.
+
+graftcheck extensions (PR 6) — the race/collective rule families need
+more resolving power than the jit walk:
+
+- **classes and methods**: every ``ClassDef`` gets a :class:`ClassInfo`
+  with its method table, the attribute types its ``__init__`` pins
+  (``self.x = <annotated param>`` / ``self.x = ClassName(...)``), and
+  its lock/thread-local attributes (``self._lock = threading.Lock()``,
+  ``self._cv = tsan.condition(...)``, ``self._tls = threading.local()``)
+  — the tables the race rules consult for "provably under a lock";
+- **instance typing**: a lightweight flow pass (:func:`local_types` /
+  :func:`expr_type`) resolves ``x = ClassName(...)``, module-level
+  singletons (``counters = FaultCounters()``), annotated module globals
+  (``_state: Optional[ObsState]``), attribute chains through the class
+  attr-type tables, and calls through return annotations
+  (``def get_registry() -> FaultRegistry``) — which is what lets the
+  worker walk follow ``obs.count`` into ``st.metrics.count`` and
+  ``reg.next_ordinal`` into ``FaultRegistry.next_ordinal``;
+- **the worker slice** (:func:`walk_worker`, ``cg.worker_reachable``):
+  every function reachable from a PullEngine worker callable — the
+  ``work``/``on_start`` arguments of ``<engine>.submit(...)`` calls
+  (receivers assigned from ``get_engine()``/``PullEngine(...)``) and
+  ``threading.Thread(target=...)`` targets — walked with CALLABLE
+  ARGUMENTS propagated (``supervised(site, lambda _b: ...)`` puts the
+  lambda on the worker), because that code runs concurrently with the
+  main thread and is what the ``race-*`` rules scan.
 """
 
 from __future__ import annotations
@@ -81,6 +107,7 @@ class FuncInfo:
         self.jit_has_statics = False
         self.static_params: Set[str] = set()
         self.jit_site: Optional[Tuple[str, int]] = None
+        self.owner_class: Optional["ClassInfo"] = None  # method owner
 
     @property
     def name(self) -> str:
@@ -89,6 +116,33 @@ class FuncInfo:
     @property
     def path(self) -> str:
         return self.module.path
+
+
+class ClassInfo:
+    """One class definition: method table plus the attribute facts the
+    graftcheck race rules consult (attr types, lock attrs, thread-local
+    attrs)."""
+
+    def __init__(self, module: "ModuleInfo", node: ast.ClassDef, qualname: str):
+        self.module = module
+        self.node = node
+        self.qualname = qualname
+        self.methods: Dict[str, FuncInfo] = {}
+        #: self.<attr> -> ClassInfo, from __init__ assignments of
+        #: annotated params / direct ClassName(...) constructions
+        self.attr_types: Dict[str, "ClassInfo"] = {}
+        #: self.<attr> assigned threading.Lock/RLock/Condition() or
+        #: tsan.lock/rlock/condition(...) — holding one of these is the
+        #: "provably locked" evidence the race rules accept
+        self.lock_attrs: Set[str] = set()
+        #: lock attrs whose constructor is reentrant (RLock/tsan.rlock)
+        self.rlock_attrs: Set[str] = set()
+        #: self.<attr> assigned threading.local() — per-thread, exempt
+        self.tls_attrs: Set[str] = set()
+
+    @property
+    def name(self) -> str:
+        return self.node.name
 
 
 class ModuleInfo:
@@ -105,6 +159,21 @@ class ModuleInfo:
         self.all_functions: List[FuncInfo] = []
         self.import_alias: Dict[str, str] = {}  # alias -> module dotted
         self.from_names: Dict[str, Tuple[str, str]] = {}  # name -> (mod, orig)
+        self.classes: Dict[str, ClassInfo] = {}
+        #: module-global name -> ClassInfo for names bound to an
+        #: instance (``counters = FaultCounters()``), including ones
+        #: assigned through ``global`` inside functions
+        self.instance_types: Dict[str, ClassInfo] = {}
+        #: module-level AnnAssign types (``_state: Optional[ObsState]``)
+        self.global_types: Dict[str, ClassInfo] = {}
+        #: module-level string constants (``PARTS_AXIS = "parts"``)
+        self.constants: Dict[str, str] = {}
+        #: every module-global binding name (top-level assignments plus
+        #: any name a function declares ``global``) — the shared-state
+        #: roots the race rules watch
+        self.module_globals: Set[str] = set()
+        #: module-global locks: name -> reentrant? (threading/tsan ctors)
+        self.lock_globals: Dict[str, bool] = {}
 
     def resolve_scoped(
         self, name: str, scope_chain: List[ast.AST]
@@ -128,12 +197,29 @@ class CallGraph:
         #: ``g = jax.jit(f)`` assignments): (module path, name) ->
         #: has_statics — the recompile scalar-arg rule's lookup table
         self.jitted_names: Dict[Tuple[str, str], bool] = {}
+        #: id(FuncInfo.node) reachable from PullEngine worker callables
+        self.worker_reachable: Set[int] = set()
+        self.worker_roots: List[FuncInfo] = []
+        self._types_cache: Dict[int, Dict[str, ClassInfo]] = {}
 
     def func_for(self, node: ast.AST) -> Optional[FuncInfo]:
         return self.func_of_node.get(id(node))
 
     def in_reachable(self, node: ast.AST) -> bool:
         return id(node) in self.reachable
+
+    def in_worker(self, node: ast.AST) -> bool:
+        return id(node) in self.worker_reachable
+
+    def worker_funcs(self):
+        """Worker-slice FuncInfos in a stable (path, lineno) order."""
+        out = [
+            self.func_of_node[i]
+            for i in self.worker_reachable
+            if i in self.func_of_node
+        ]
+        out.sort(key=lambda f: (f.path, getattr(f.node, "lineno", 0)))
+        return out
 
 
 def module_name_for(path: str) -> str:
@@ -175,7 +261,7 @@ def _index_module(path: str, tree: ast.Module) -> ModuleInfo:
                     continue
                 mod.from_names[a.asname or a.name] = (src, a.name)
 
-    def visit(node, scope_node, prefix):
+    def visit(node, scope_node, prefix, owner_cls=None):
         # one walker: a new lexical scope opens ONLY at a function def;
         # classes qualify names but defs inside if/try/loop bodies (and
         # class bodies) register into the enclosing scope_node's table
@@ -185,6 +271,9 @@ def _index_module(path: str, tree: ast.Module) -> ModuleInfo:
                 info = FuncInfo(
                     mod, child, f"{mod.modname}.{q}", scope_node
                 )
+                if owner_cls is not None:
+                    info.owner_class = owner_cls
+                    owner_cls.methods.setdefault(child.name, info)
                 mod.scopes.setdefault(id(scope_node), {}).setdefault(
                     child.name, info
                 )
@@ -194,12 +283,416 @@ def _index_module(path: str, tree: ast.Module) -> ModuleInfo:
             elif isinstance(child, ast.ClassDef):
                 # methods are not bare-name callable: park them in the
                 # class node's (unreachable) scope table
-                visit(child, child, f"{prefix}{child.name}.")
+                cls = ClassInfo(
+                    mod, child, f"{mod.modname}.{prefix}{child.name}"
+                )
+                mod.classes.setdefault(child.name, cls)
+                visit(child, child, f"{prefix}{child.name}.", owner_cls=cls)
             else:
-                visit(child, scope_node, prefix)
+                visit(child, scope_node, prefix, owner_cls)
 
     visit(tree, tree, "")
+    _index_globals(mod)
     return mod
+
+
+def terminal_name(expr: ast.AST) -> Optional[str]:
+    """The callee-ish terminal identifier of an expression — the attr
+    of an Attribute, the id of a Name, else None. The ONE extraction
+    every analyzer applies to call targets (do not re-spell it)."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def walk_scope(root: ast.AST):
+    """``ast.walk`` bounded to one lexical scope: yields ``root`` and
+    its descendants but does NOT descend into nested function/lambda/
+    class definitions. Per-function analyses (local bindings, lock
+    facts, type seeding) must use this — a nested def's locals,
+    ``global`` declarations, and calls belong to the NESTED scope, and
+    attributing them to the enclosing function produces both false
+    negatives (a nested local shadowing a module global) and false
+    positives (a nested def's lock acquisition charged to the parent)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                 ast.ClassDef),
+            ):
+                continue
+            stack.append(child)
+
+
+_LOCK_CTORS = {"Lock": False, "RLock": True, "Condition": False}
+_TSAN_LOCK_CTORS = {"lock": False, "rlock": True, "condition": False}
+
+
+def _lock_ctor(value: ast.AST) -> Optional[bool]:
+    """Is ``value`` a lock construction? Returns reentrancy (True for
+    RLock/tsan.rlock), or None when it is not a lock constructor.
+    Recognized: ``threading.Lock/RLock/Condition()`` (any receiver
+    spelling, bare from-imports too) and the graftcheck runtime's
+    ``tsan.lock/rlock/condition("site")`` wrappers."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    attr = terminal_name(f)
+    if attr in _LOCK_CTORS:
+        return _LOCK_CTORS[attr]
+    if attr in _TSAN_LOCK_CTORS and isinstance(f, ast.Attribute):
+        recv = f.value
+        if isinstance(recv, ast.Name) and "tsan" in recv.id:
+            return _TSAN_LOCK_CTORS[attr]
+    return None
+
+
+def _is_tls_ctor(value: ast.AST) -> bool:
+    """``threading.local()`` (or bare ``local()`` from-import)."""
+    if not isinstance(value, ast.Call):
+        return False
+    f = value.func
+    attr = terminal_name(f)
+    return attr == "local"
+
+
+def _index_globals(mod: ModuleInfo) -> None:
+    """Module-global binding facts: top-level names, string constants,
+    lock globals, and names any function rebinds via ``global``."""
+    for stmt in mod.tree.body:
+        targets = []
+        value = None
+        if isinstance(stmt, ast.Assign):
+            targets = [t for t in stmt.targets if isinstance(t, ast.Name)]
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            targets = [stmt.target]
+            value = stmt.value
+        elif isinstance(stmt, ast.AugAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            targets = [stmt.target]
+        for t in targets:
+            mod.module_globals.add(t.id)
+            reentrant = _lock_ctor(value) if value is not None else None
+            if reentrant is not None:
+                mod.lock_globals[t.id] = reentrant
+            elif isinstance(value, ast.Constant) and isinstance(
+                value.value, str
+            ):
+                mod.constants[t.id] = value.value
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Global):
+            mod.module_globals.update(node.names)
+
+
+def resolve_class(
+    cg: CallGraph, mod: ModuleInfo, expr: ast.AST
+) -> Optional[ClassInfo]:
+    """Resolve a class-valued expression (the func of a construction
+    call, or a bare annotation name) to a ClassInfo in the linted set."""
+    if isinstance(expr, ast.Name):
+        cls = mod.classes.get(expr.id)
+        if cls is not None:
+            return cls
+        tgt = mod.from_names.get(expr.id)
+        if tgt is not None:
+            m2 = cg.by_modname.get(tgt[0])
+            if m2 is not None:
+                return m2.classes.get(tgt[1])
+        return None
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        modname = mod.import_alias.get(expr.value.id)
+        if modname is None and expr.value.id in mod.from_names:
+            src, orig = mod.from_names[expr.value.id]
+            modname = f"{src}.{orig}"
+        if modname is not None:
+            m2 = cg.by_modname.get(modname)
+            if m2 is not None:
+                return m2.classes.get(expr.attr)
+    return None
+
+
+def resolve_annotation(
+    cg: CallGraph, mod: ModuleInfo, ann: Optional[ast.AST]
+) -> Optional[ClassInfo]:
+    """Type annotation -> ClassInfo: plain names, dotted names, string
+    annotations, and ``Optional[X]`` wrappers."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        text = ann.value.strip()
+        if text.startswith("Optional[") and text.endswith("]"):
+            text = text[len("Optional[") : -1]
+        text = text.strip("\"' ")
+        try:
+            ann = ast.parse(text, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.Subscript):
+        head = ann.value
+        headname = terminal_name(head)
+        if headname == "Optional":
+            return resolve_annotation(cg, mod, ann.slice)
+        return None
+    return resolve_class(cg, mod, ann)
+
+
+def _index_class_attrs(cg: CallGraph) -> None:
+    """Second pass (needs every module indexed for cross-module class
+    resolution): fill each class's attr_types / lock_attrs / tls_attrs
+    from ``self.x = ...`` assignments in its methods."""
+    for mod in cg.modules.values():
+        for cls in mod.classes.values():
+            for meth in cls.methods.values():
+                params = {}
+                args = getattr(meth.node, "args", None)
+                if args is not None:
+                    for a in list(args.args) + list(args.kwonlyargs):
+                        if a.annotation is not None:
+                            params[a.arg] = a.annotation
+                for node in ast.walk(meth.node):
+                    tgt = None
+                    value = None
+                    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                        tgt, value = node.targets[0], node.value
+                    elif isinstance(node, ast.AnnAssign):
+                        tgt, value = node.target, node.value
+                    if not (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        continue
+                    attr = tgt.attr
+                    if value is None:
+                        if isinstance(node, ast.AnnAssign):
+                            t = resolve_annotation(cg, mod, node.annotation)
+                            if t is not None:
+                                cls.attr_types.setdefault(attr, t)
+                        continue
+                    reentrant = _lock_ctor(value)
+                    if reentrant is not None:
+                        cls.lock_attrs.add(attr)
+                        if reentrant:
+                            cls.rlock_attrs.add(attr)
+                        continue
+                    if _is_tls_ctor(value):
+                        cls.tls_attrs.add(attr)
+                        continue
+                    if isinstance(value, ast.Call):
+                        t = resolve_class(cg, mod, value.func)
+                        if t is not None:
+                            cls.attr_types.setdefault(attr, t)
+                    elif isinstance(value, ast.Name) and value.id in params:
+                        t = resolve_annotation(cg, mod, params[value.id])
+                        if t is not None:
+                            cls.attr_types.setdefault(attr, t)
+
+
+def _index_instance_globals(cg: CallGraph) -> None:
+    """Module-global instance types: ``name = ClassName(...)`` anywhere
+    the name is module-global (top level, or rebound via ``global`` the
+    way the lazy singletons — ``_registry``, ``_engine``, ``_state`` —
+    are), plus module-level annotated globals."""
+    for mod in cg.modules.values():
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                t = resolve_annotation(cg, mod, stmt.annotation)
+                if t is not None:
+                    mod.global_types.setdefault(stmt.target.id, t)
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            name = node.targets[0].id
+            if name not in mod.module_globals:
+                continue
+            t = resolve_class(cg, mod, node.value.func)
+            if t is not None:
+                mod.instance_types.setdefault(name, t)
+
+
+def local_types(cg: CallGraph, info: FuncInfo) -> Dict[str, ClassInfo]:
+    """Best-effort name -> ClassInfo typing inside one function:
+    annotated params, ``self``/``cls``, and simple local assignments
+    (two passes so ``st = _state; m = st.metrics`` chains resolve).
+    Cached per function node."""
+    cached = cg._types_cache.get(id(info.node))
+    if cached is not None:
+        return cached
+    types: Dict[str, ClassInfo] = {}
+    cg._types_cache[id(info.node)] = types  # pre-publish (cycles)
+    args = getattr(info.node, "args", None)
+    if args is not None:
+        for a in list(args.args) + list(args.kwonlyargs):
+            t = resolve_annotation(cg, info.module, a.annotation)
+            if t is not None:
+                types[a.arg] = t
+    if info.owner_class is not None:
+        types.setdefault("self", info.owner_class)
+        types.setdefault("cls", info.owner_class)
+    for _ in range(2):
+        for node in walk_scope(info.node):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                t = expr_type(cg, info, node.value, types)
+                if t is not None:
+                    types[node.targets[0].id] = t
+    return types
+
+
+def expr_type(
+    cg: CallGraph,
+    info: FuncInfo,
+    expr: ast.AST,
+    types: Optional[Dict[str, ClassInfo]] = None,
+) -> Optional[ClassInfo]:
+    """Type of an expression, where the lightweight inference can tell:
+    typed locals, module singletons (own and via module alias / from-
+    import), class attr chains, constructor calls, and calls to
+    functions with resolvable return annotations."""
+    mod = info.module
+    if types is None:
+        types = local_types(cg, info)
+    if isinstance(expr, ast.Name):
+        t = types.get(expr.id)
+        if t is not None:
+            return t
+        t = mod.instance_types.get(expr.id) or mod.global_types.get(expr.id)
+        if t is not None:
+            return t
+        tgt = mod.from_names.get(expr.id)
+        if tgt is not None:
+            m2 = cg.by_modname.get(tgt[0])
+            if m2 is not None:
+                return m2.instance_types.get(tgt[1]) or m2.global_types.get(
+                    tgt[1]
+                )
+        return None
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if isinstance(base, ast.Name):
+            modname = mod.import_alias.get(base.id)
+            if modname is None and base.id in mod.from_names:
+                src, orig = mod.from_names[base.id]
+                modname = f"{src}.{orig}"
+            if modname is not None:
+                m2 = cg.by_modname.get(modname)
+                if m2 is not None:
+                    t = m2.instance_types.get(expr.attr) or m2.global_types.get(
+                        expr.attr
+                    )
+                    if t is not None:
+                        return t
+        bt = expr_type(cg, info, base, types)
+        if bt is not None:
+            return bt.attr_types.get(expr.attr)
+        return None
+    if isinstance(expr, ast.Call):
+        cls = resolve_class(cg, mod, expr.func)
+        if cls is not None:
+            return cls
+        callee = resolve_callable(cg, info, expr.func, types)
+        if callee is not None:
+            ret = getattr(callee.node, "returns", None)
+            return resolve_annotation(cg, callee.module, ret)
+    return None
+
+
+def resolve_callable(
+    cg: CallGraph,
+    info: FuncInfo,
+    expr: ast.AST,
+    types: Optional[Dict[str, ClassInfo]] = None,
+) -> Optional[FuncInfo]:
+    """Resolve a callable EXPRESSION inside ``info`` — superset of
+    :func:`resolve_call`'s func handling, adding method resolution
+    (``self.m`` / typed-object ``x.m`` / module-singleton
+    ``faults.counters.add``) and ``functools.partial`` unwrapping."""
+    mod = info.module
+    if isinstance(expr, ast.Name):
+        target = mod.resolve_scoped(expr.id, _scope_chain_of(info))
+        if target is not None:
+            return target
+        tgt = mod.from_names.get(expr.id)
+        if tgt is not None:
+            m2 = cg.by_modname.get(tgt[0])
+            if m2 is not None:
+                return m2.functions.get(tgt[1])
+        return None
+    if isinstance(expr, ast.Attribute):
+        recv = expr.value
+        # plain module-alias function call (the resolve_call case)
+        if isinstance(recv, ast.Name):
+            alias = recv.id
+            modname = mod.import_alias.get(alias)
+            if modname is None and alias in mod.from_names:
+                src, orig = mod.from_names[alias]
+                modname = f"{src}.{orig}"
+            if modname is not None:
+                m2 = cg.by_modname.get(modname)
+                if m2 is not None:
+                    fn = m2.functions.get(expr.attr)
+                    if fn is not None:
+                        return fn
+        # method on a typed receiver (self, typed local, singleton,
+        # attr chain)
+        bt = expr_type(cg, info, recv, types)
+        if bt is not None:
+            return bt.methods.get(expr.attr)
+    return None
+
+
+def callable_argument(
+    cg: CallGraph,
+    info: FuncInfo,
+    expr: ast.AST,
+    types: Optional[Dict[str, ClassInfo]] = None,
+) -> Optional[FuncInfo]:
+    """A callable passed AS AN ARGUMENT (worker submit / Thread target /
+    higher-order call): resolves Names/attributes to functions, unwraps
+    ``functools.partial(f, ...)``, and synthesizes a FuncInfo for a
+    Lambda so its body joins the walk."""
+    if isinstance(expr, ast.Lambda):
+        existing = cg.func_for(expr)
+        if existing is not None:
+            return existing
+        fi = FuncInfo(
+            info.module,
+            expr,
+            f"{info.qualname}.<lambda>",
+            info.node,
+        )
+        cg.func_of_node[id(expr)] = fi
+        info.module.all_functions.append(fi)
+        return fi
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        attr = terminal_name(f)
+        if attr == "partial" and expr.args:
+            return callable_argument(cg, info, expr.args[0], types)
+        return None
+    if isinstance(expr, (ast.Name, ast.Attribute)):
+        return resolve_callable(cg, info, expr, types)
+    return None
 
 
 def _static_params(fn_node, call: Optional[ast.Call]) -> Set[str]:
@@ -231,9 +724,7 @@ def _unwrap_jit_target(call: ast.Call) -> Optional[ast.AST]:
     depth = 0
     while isinstance(target, ast.Call) and depth < 6:
         f = target.func
-        attr = f.attr if isinstance(f, ast.Attribute) else (
-            f.id if isinstance(f, ast.Name) else None
-        )
+        attr = terminal_name(f)
         if attr in _WRAPPER_ATTRS or attr == "partial":
             if not target.args:
                 return None
@@ -409,6 +900,165 @@ def _walk_reachable(cg: CallGraph) -> None:
                         stack.append(callee)
 
 
+_ENGINE_CTORS = ("get_engine", "PullEngine")
+
+
+class DispatchSiteVisitor(ast.NodeVisitor):
+    """Scope-tracking base for call sites that hand callables to
+    ANOTHER execution context (worker submits, Thread targets,
+    shard_map/pjit wrappings): subclasses implement
+    :meth:`candidate_exprs` returning the callable expressions of a
+    matched call; resolution (incl. the synthetic module-level context)
+    is shared here so a fix to context handling lands in every
+    root-finder at once."""
+
+    def __init__(self, cg: CallGraph, mod: ModuleInfo):
+        self.cg = cg
+        self.mod = mod
+        self.scope_chain: List[ast.AST] = [mod.tree]
+        self.roots: List[FuncInfo] = []
+
+    def _enter(self, node):
+        self.scope_chain.append(node)
+        self.generic_visit(node)
+        self.scope_chain.pop()
+
+    visit_FunctionDef = _enter
+    visit_AsyncFunctionDef = _enter
+
+    def candidate_exprs(self, node: ast.Call) -> list:
+        raise NotImplementedError
+
+    def context_info(self) -> Optional[FuncInfo]:
+        for scope in reversed(self.scope_chain):
+            fi = self.cg.func_for(scope)
+            if fi is not None:
+                return fi
+        return None
+
+    def _add(self, expr: ast.AST) -> None:
+        ctx = self.context_info()
+        if ctx is None:
+            # module-level dispatch site: synthesize a module context
+            ctx = FuncInfo(
+                self.mod, self.mod.tree, f"{self.mod.modname}.<module>",
+                self.mod.tree,
+            )
+        fi = callable_argument(self.cg, ctx, expr)
+        if fi is not None:
+            self.roots.append(fi)
+
+    def visit_Call(self, node: ast.Call):
+        for expr in self.candidate_exprs(node):
+            self._add(expr)
+        self.generic_visit(node)
+
+
+class _WorkerRootVisitor(DispatchSiteVisitor):
+    """Worker-dispatch sites: ``.submit`` calls on pull-engine
+    receivers and ``threading.Thread(target=...)`` constructions."""
+
+    def __init__(self, cg: CallGraph, mod: ModuleInfo, engine_names):
+        super().__init__(cg, mod)
+        self.engine_names = engine_names
+
+    def candidate_exprs(self, node: ast.Call) -> list:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "submit":
+            recv = f.value
+            recv_name = recv.id if isinstance(recv, ast.Name) else None
+            recv_type = None
+            ctx = self.context_info()
+            if ctx is not None:
+                recv_type = expr_type(self.cg, ctx, recv)
+            if (recv_name in self.engine_names) or (
+                recv_type is not None and recv_type.name == "PullEngine"
+            ):
+                return list(node.args[:1]) + [
+                    kw.value
+                    for kw in node.keywords
+                    if kw.arg in ("work", "on_start")
+                ]
+            return []
+        if terminal_name(f) == "Thread":
+            return [
+                kw.value for kw in node.keywords if kw.arg == "target"
+            ]
+        return []
+
+
+def _find_worker_roots(cg: CallGraph) -> List[FuncInfo]:
+    roots: List[FuncInfo] = []
+    for mod in cg.modules.values():
+        engine_names = set()
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            f = node.value.func
+            attr = terminal_name(f)
+            if attr in _ENGINE_CTORS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        engine_names.add(t.id)
+        v = _WorkerRootVisitor(cg, mod, engine_names)
+        v.visit(mod.tree)
+        roots.extend(v.roots)
+    return roots
+
+
+def reach_closure(
+    cg: CallGraph, roots, include_nested_defs: bool = False
+) -> Dict[int, FuncInfo]:
+    """Transitive closure over resolvable calls WITH callable-argument
+    propagation (a lambda handed to ``faults.supervised`` runs even
+    though supervised's ``attempt_fn(budget)`` call is unresolvable) —
+    the ONE traversal shared by the worker slice and the collective
+    regions, so a propagation fix lands in both. With
+    ``include_nested_defs``, lexically nested defs of a reached
+    function join too (trace-time helpers in shard_map bodies)."""
+    out: Dict[int, FuncInfo] = {}
+    stack = list(roots)
+    while stack:
+        info = stack.pop()
+        if id(info.node) in out:
+            continue
+        out[id(info.node)] = info
+        cg.func_of_node.setdefault(id(info.node), info)
+        if include_nested_defs:
+            for mod_info in info.module.all_functions:
+                if mod_info.scope_node is info.node and id(
+                    mod_info.node
+                ) not in out:
+                    stack.append(mod_info)
+        types = local_types(cg, info)
+        body = getattr(info.node, "body", None)
+        nodes = body if isinstance(body, list) else [info.node.body]
+        for stmt in nodes:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = resolve_callable(cg, info, node.func, types)
+                if callee is not None and id(callee.node) not in out:
+                    stack.append(callee)
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    fi = callable_argument(cg, info, arg, types)
+                    if fi is not None and id(fi.node) not in out:
+                        stack.append(fi)
+    return out
+
+
+def walk_worker(cg: CallGraph) -> None:
+    """Mark ``cg.worker_reachable``: everything callable from the
+    PullEngine worker roots (see :func:`reach_closure`)."""
+    cg.worker_roots = _find_worker_roots(cg)
+    cg.worker_reachable = set(reach_closure(cg, cg.worker_roots))
+
+
 def build(pkg) -> CallGraph:
     """Build the call graph for a parsed :class:`core.Package`."""
     cg = CallGraph()
@@ -420,8 +1070,11 @@ def build(pkg) -> CallGraph:
         cg.by_modname[mod.modname] = mod
         for info in mod.all_functions:
             cg.func_of_node[id(info.node)] = info
+    _index_class_attrs(cg)
+    _index_instance_globals(cg)
     _mark_jit_roots(cg)
     _walk_reachable(cg)
+    walk_worker(cg)
     return cg
 
 
@@ -451,9 +1104,7 @@ def tracked_call_sites(
                 if not isinstance(node, ast.Call):
                     continue
                 fn = node.func
-                attr = fn.attr if isinstance(fn, ast.Attribute) else (
-                    fn.id if isinstance(fn, ast.Name) else None
-                )
+                attr = terminal_name(fn)
                 if attr not in ("tracked_call", "note_compile"):
                     continue
                 if node.args and isinstance(node.args[0], ast.Constant) and (

@@ -1,25 +1,107 @@
-"""graftlint command line: ``python -m dbscan_tpu.lint``.
+"""graftlint/graftcheck command line: ``python -m dbscan_tpu.lint``.
 
 Exit-code contract (pinned by tests/test_lint.py, gate-able in CI like
-``obs.regress --check-schema``): 0 = clean, 1 = findings (one rule id +
-file:line per line in text mode), 2 = usage/IO error.
+``obs.regress --check-schema``), IDENTICAL with and without
+``--rules``/``--baseline``:
+
+- **0** — clean: no findings after the ``--rules`` filter and the
+  ``--baseline`` subtraction. With ``--baseline`` this means "no NEW
+  findings": baselined ones are suppressed but re-counted in the
+  summary line.
+- **1** — findings (text mode prints one ``path:line:col: rule
+  message`` per line; with ``--baseline``, only the new ones).
+- **2** — usage/IO error: missing lint path, unreadable/invalid
+  baseline file, or a ``--rules`` filter that matches no known rule
+  (a typo'd glob silently gating nothing would be a broken CI gate).
+
+``--rules GLOBS`` runs the full analysis but keeps only findings whose
+rule id matches one of the comma-separated fnmatch globs (e.g.
+``--rules 'race-*,collective-*'``) — how CI can gate new rule families
+strictly while older ones are still being burned down.
+
+``--baseline PATH`` subtracts previously recorded findings (matched on
+rule + normalized path + message as a MULTISET — line numbers excluded
+so unrelated edits don't resurrect them, occurrence-counted so a new
+duplicate of a baselined finding still fails) and exits by the
+remainder: the incremental-adoption gate. Create/refresh the file with ``--write-baseline PATH`` (writes
+the CURRENT post-filter findings and exits 0).
 """
 
 from __future__ import annotations
 
 import argparse
+import fnmatch
 import json
 import sys
 
 from dbscan_tpu import lint as lint_mod
 
+_BASELINE_VERSION = 1
+
+
+def _norm_path(path: str) -> str:
+    """Repo-portable finding path for baseline keys: relative to the
+    cwd when underneath it, else absolute — so a baseline written by
+    ``... dbscan_tpu/`` (relative findings) matches one consumed by a
+    no-args run (absolute findings) from the same directory."""
+    import os
+
+    ap = os.path.abspath(path)
+    rp = os.path.relpath(ap)
+    return ap if rp.startswith("..") else rp
+
+
+def _baseline_key(f) -> tuple:
+    # line/col excluded deliberately: a baseline must survive unrelated
+    # edits above the finding; rule+normalized path+message is stable
+    return (f.rule, _norm_path(f.path), f.message)
+
+
+def _write_baseline(path: str, findings) -> None:
+    payload = {
+        "version": _BASELINE_VERSION,
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": _norm_path(f.path),
+                "message": f.message,
+            }
+            for f in findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def _read_baseline(path: str) -> dict:
+    """Baseline as a MULTISET (key -> count): one baselined occurrence
+    must not suppress newly introduced duplicates of the same finding
+    in the same file (their keys are identical by design — line numbers
+    are excluded for edit-stability)."""
+    with open(path, encoding="utf-8") as fh:
+        payload = json.load(fh)
+    if not isinstance(payload, dict) or "findings" not in payload:
+        raise ValueError("not a graftlint baseline (missing 'findings')")
+    out: dict = {}
+    for row in payload["findings"]:
+        key = (row["rule"], _norm_path(row["path"]), row["message"])
+        out[key] = out.get(key, 0) + 1
+    return out
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m dbscan_tpu.lint",
-        description="graftlint: AST-based static analysis for TPU "
-        "hazards (host-sync, recompile) and declared-contract drift "
-        "(telemetry schema, env-var registry).",
+        description="graftlint/graftcheck: AST-based static analysis "
+        "for TPU hazards (host-sync, recompile), declared-contract "
+        "drift (telemetry schema, env-var registry), and "
+        "concurrency/collective safety (races, collectives).",
+        epilog="Exit codes: 0 clean (no new findings under --baseline), "
+        "1 findings, 2 usage/IO error (bad path, unreadable baseline, "
+        "or a --rules glob matching no known rule). The contract is "
+        "identical with and without --rules/--baseline, so CI can gate "
+        "on any combination.",
     )
     p.add_argument(
         "paths",
@@ -32,6 +114,26 @@ def main(argv=None) -> int:
         choices=("text", "json"),
         default="text",
         help="output format (default text: path:line:col: rule message)",
+    )
+    p.add_argument(
+        "--rules",
+        metavar="GLOBS",
+        help="comma-separated fnmatch globs over rule ids; only "
+        "matching findings count (e.g. 'race-*,collective-*'); a "
+        "pattern matching no known rule is exit 2",
+    )
+    p.add_argument(
+        "--baseline",
+        metavar="PATH",
+        help="subtract findings recorded in this baseline file "
+        "(rule+path+message match); exit 0 means NO NEW findings; a "
+        "missing/invalid file is exit 2",
+    )
+    p.add_argument(
+        "--write-baseline",
+        metavar="PATH",
+        help="write the current (post --rules) findings to PATH as a "
+        "baseline and exit 0",
     )
     p.add_argument(
         "--list-rules",
@@ -49,13 +151,25 @@ def main(argv=None) -> int:
 
     if args.list_rules:
         for rule in sorted(lint_mod.RULES):
-            print(f"{rule:<24} {lint_mod.RULES[rule]}")
+            print(f"{rule:<28} {lint_mod.RULES[rule]}")
         return 0
     if args.env_table:
         from dbscan_tpu.config import parity_env_table
 
         print(parity_env_table())
         return 0
+
+    globs = None
+    if args.rules:
+        globs = [g.strip() for g in args.rules.split(",") if g.strip()]
+        for g in globs:
+            if not fnmatch.filter(lint_mod.RULES, g):
+                print(
+                    f"graftlint: --rules glob {g!r} matches no known "
+                    "rule (see --list-rules)",
+                    file=sys.stderr,
+                )
+                return 2
 
     try:
         if args.paths:
@@ -69,11 +183,58 @@ def main(argv=None) -> int:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
 
+    if globs is not None:
+        findings = [
+            f
+            for f in findings
+            if any(fnmatch.fnmatch(f.rule, g) for g in globs)
+        ]
+
+    if args.write_baseline:
+        try:
+            _write_baseline(args.write_baseline, findings)
+        except OSError as e:
+            print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+        print(
+            f"graftlint: baseline of {len(findings)} finding(s) "
+            f"written to {args.write_baseline}",
+            file=sys.stderr,
+        )
+        return 0
+
+    n_baselined = 0
+    if args.baseline:
+        try:
+            known = _read_baseline(args.baseline)
+        except (
+            OSError,
+            ValueError,
+            KeyError,
+            TypeError,
+            json.JSONDecodeError,
+        ) as e:
+            print(
+                f"graftlint: cannot read baseline {args.baseline}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+        kept = []
+        for f in findings:
+            key = _baseline_key(f)
+            if known.get(key, 0) > 0:
+                known[key] -= 1
+                n_baselined += 1
+            else:
+                kept.append(f)
+        findings = kept
+
     if args.format == "json":
         print(
             json.dumps(
                 {
                     "files_scanned": n_files,
+                    "baselined": n_baselined,
                     "findings": [f.to_dict() for f in findings],
                 }
             )
@@ -81,8 +242,12 @@ def main(argv=None) -> int:
     else:
         for f in findings:
             print(f.render())
+        extra = (
+            f" ({n_baselined} baselined)" if args.baseline else ""
+        )
         print(
-            f"graftlint: {len(findings)} finding(s) in {n_files} file(s)",
+            f"graftlint: {len(findings)} finding(s){extra} in "
+            f"{n_files} file(s)",
             file=sys.stderr,
         )
     return 1 if findings else 0

@@ -1,0 +1,297 @@
+"""graftcheck collective-safety rules: the hazards that deadlock every
+chip at once.
+
+These gate ROADMAP item 1 (true multi-chip scale-out): a mis-placed
+collective inside a ``shard_map``/``pjit`` body does not crash one
+process — it hangs ALL of them, because the other chips sit inside the
+matching collective forever. The reference's driver-side merge sidesteps
+executor coordination entirely (MR-DBSCAN, DBSCAN.scala); device-
+parallel DBSCAN has to get it right (Prokopenko et al. 2103.05162), so
+we machine-check it before the multichip PR lands, not after it hangs an
+8-chip run.
+
+**Collective regions**: functions passed to ``shard_map``/``pjit``
+(directly, via ``functools.partial``, or as lambdas), their lexically
+nested defs, and everything transitively called — with callable
+arguments propagated (``lax.map(one, ...)`` runs ``one`` under the same
+trace).
+
+- ``collective-in-branch``: a collective (``psum``/``all_gather``/
+  ``ppermute``/...) under an ``if``/``while`` whose test can DIVERGE
+  across processes — it references a traced parameter of the enclosing
+  region function, an array-op result, or a per-process host source
+  (``process_index``, environment reads, ``random``/``time``). A
+  conditional on uniform host config (a closure over the builder's
+  ``mesh`` argument — the repo idiom) is fine: every process traces the
+  same branch. Divergent tests mean some processes issue the collective
+  and others never do: deadlock.
+- ``collective-axis-undeclared``: the collective's ``axis_name``
+  resolves to a literal that is not among the mesh axis names declared
+  anywhere in the linted set (``Mesh(devices, ("parts",))`` /
+  ``axis_names=`` — module string constants like ``PARTS_AXIS`` are
+  resolved through imports). A typo'd axis fails at trace time only on
+  the multichip path nobody runs in CI. Skipped entirely when the
+  linted set declares no mesh (fixture snippets).
+- ``pull-in-collective``: a host pull (``pull_to_host`` /
+  ``copy_to_host_async`` / ``device_get``) reachable from a collective
+  region — the static form of the "pull engine forces itself off in
+  multi-process runs" invariant: pulls from inside the region would
+  interleave cross-host collectives nondeterministically per process.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from dbscan_tpu.lint.callgraph import DispatchSiteVisitor, terminal_name
+from dbscan_tpu.lint.core import Finding, Package
+
+_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "psum_scatter", "pbroadcast",
+}
+_REGION_WRAPPERS = ("shard_map", "pjit")
+_PULLS = {"pull_to_host", "copy_to_host_async", "device_get"}
+_DIVERGENT_CALLS = {
+    "process_index", "getenv", "environ", "random", "randint", "time",
+    "perf_counter", "urandom", "uniform",
+}
+_ARRAY_MODULES = ("jnp", "lax", "jax")
+
+
+def _collective_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    attr = terminal_name(f)
+    return attr if attr in _COLLECTIVES else None
+
+
+class _RegionRootVisitor(DispatchSiteVisitor):
+    """shard_map/pjit wrapping sites, on the shared
+    :class:`callgraph.DispatchSiteVisitor` machinery."""
+
+    def candidate_exprs(self, node: ast.Call) -> list:
+        if terminal_name(node.func) in _REGION_WRAPPERS:
+            return list(node.args[:1])
+        return []
+
+
+def _region_roots(cg) -> List:
+    """FuncInfos passed to shard_map/pjit anywhere in the linted set."""
+    roots = []
+    for mod in cg.modules.values():
+        v = _RegionRootVisitor(cg, mod)
+        v.visit(mod.tree)
+        roots.extend(v.roots)
+    return roots
+
+
+def _region_funcs(cg) -> Dict[int, object]:
+    """Transitive closure of the collective regions: roots + nested
+    defs (trace-time helpers) + resolvable callees + callable
+    arguments — the shared :func:`callgraph.reach_closure` traversal."""
+    from dbscan_tpu.lint import callgraph as cg_mod
+
+    return cg_mod.reach_closure(
+        cg, _region_roots(cg), include_nested_defs=True
+    )
+
+
+def _params_of(node) -> Set[str]:
+    args = getattr(node, "args", None)
+    if args is None:
+        return set()
+    names = {
+        a.arg
+        for a in list(args.args)
+        + list(args.kwonlyargs)
+        + list(args.posonlyargs)
+    }
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+def _divergent_test(test: ast.AST, traced_params: Set[str]) -> Optional[str]:
+    """Why this branch test can diverge across processes, or None when
+    it is (as far as the analysis can tell) uniform host config."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in traced_params:
+            return f"references traced parameter {node.id!r}"
+        if isinstance(node, ast.Call):
+            f = node.func
+            attr = terminal_name(f)
+            if attr in _DIVERGENT_CALLS:
+                return f"calls per-process source {attr!r}()"
+            if isinstance(f, ast.Attribute):
+                root = f.value
+                while isinstance(root, (ast.Attribute, ast.Subscript)):
+                    root = root.value
+                if isinstance(root, ast.Name) and (
+                    root.id in _ARRAY_MODULES
+                ):
+                    return f"computes on traced arrays ({root.id}.{f.attr})"
+        if isinstance(node, ast.Subscript):
+            v = node.value
+            if isinstance(v, ast.Attribute) and v.attr == "environ":
+                return "reads the process environment"
+    return None
+
+
+def _resolve_axis(cg, mod, expr) -> List[str]:
+    """Axis-name literals an axis argument resolves to ([] when it
+    cannot be resolved — no finding then)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for el in expr.elts:
+            out.extend(_resolve_axis(cg, mod, el))
+        return out
+    if isinstance(expr, ast.Name):
+        if expr.id in mod.constants:
+            return [mod.constants[expr.id]]
+        tgt = mod.from_names.get(expr.id)
+        if tgt is not None:
+            m2 = cg.by_modname.get(tgt[0])
+            if m2 is not None and tgt[1] in m2.constants:
+                return [m2.constants[tgt[1]]]
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        modname = mod.import_alias.get(expr.value.id)
+        if modname is not None:
+            m2 = cg.by_modname.get(modname)
+            if m2 is not None and expr.attr in m2.constants:
+                return [m2.constants[expr.attr]]
+    return []
+
+
+def _declared_axes(cg) -> Tuple[Set[str], bool]:
+    """(axis names declared by Mesh constructions in the linted set,
+    any-mesh-seen)."""
+    axes: Set[str] = set()
+    seen = False
+    for mod in cg.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            attr = terminal_name(f)
+            if attr not in ("Mesh", "make_mesh", "AbstractMesh"):
+                continue
+            seen = True
+            cands = []
+            if len(node.args) >= 2:
+                cands.append(node.args[1])
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    cands.append(kw.value)
+            for c in cands:
+                axes.update(_resolve_axis(cg, mod, c))
+    return axes, seen
+
+
+def check(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    cg = pkg.callgraph
+    region = _region_funcs(cg)
+    if not region:
+        return findings
+    axes, mesh_seen = _declared_axes(cg)
+
+    for info in region.values():
+        mod = info.module
+        traced = _params_of(info.node)
+
+        def walk(node, branch_reason, info=info, mod=mod, traced=traced):
+            if node is not info.node and isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+            ):
+                return  # separate region entries
+            reason = branch_reason
+            if isinstance(node, (ast.If, ast.While)):
+                r = _divergent_test(node.test, traced)
+                if r is not None:
+                    reason = reason or r
+            if isinstance(node, ast.Call):
+                cname = _collective_name(node)
+                if cname is not None:
+                    if reason is not None:
+                        findings.append(
+                            Finding(
+                                "collective-in-branch",
+                                mod.path,
+                                node.lineno,
+                                node.col_offset,
+                                f"collective {cname!r} under a "
+                                f"conditional that {reason}: processes "
+                                "taking different branches deadlock "
+                                "every chip in the matching collective "
+                                "— hoist the collective out of the "
+                                "branch or make the branch "
+                                "data-independent (lax.cond with both "
+                                "sides collective-free, or uniform "
+                                "host config)",
+                            )
+                        )
+                    axis_exprs = []
+                    if len(node.args) >= 2:
+                        axis_exprs.append(node.args[1])
+                    for kw in node.keywords:
+                        if kw.arg == "axis_name":
+                            axis_exprs.append(kw.value)
+                    if mesh_seen:
+                        for expr in axis_exprs:
+                            for name in _resolve_axis(cg, mod, expr):
+                                if name not in axes:
+                                    findings.append(
+                                        Finding(
+                                            "collective-axis-undeclared",
+                                            mod.path,
+                                            node.lineno,
+                                            node.col_offset,
+                                            f"collective {cname!r} "
+                                            f"names axis {name!r}, "
+                                            "which no Mesh declaration "
+                                            "in the linted set provides "
+                                            "— a typo'd axis only "
+                                            "fails on the multichip "
+                                            "path (declared axes are "
+                                            "deliberately not listed "
+                                            "here: baselines match on "
+                                            "message text, and a new "
+                                            "unrelated mesh axis must "
+                                            "not resurrect baselined "
+                                            "findings)",
+                                        )
+                                    )
+                else:
+                    f = node.func
+                    attr = terminal_name(f)
+                    if attr in _PULLS:
+                        findings.append(
+                            Finding(
+                                "pull-in-collective",
+                                mod.path,
+                                node.lineno,
+                                node.col_offset,
+                                f"host pull {attr!r} reachable from a "
+                                "shard_map/pjit collective region: in "
+                                "a multi-process run this interleaves "
+                                "cross-host transfers with the "
+                                "collective sequence "
+                                "nondeterministically — pull at the "
+                                "driver boundary instead (the pull "
+                                "pipeline already disables itself "
+                                "there; keep pulls out of the region)",
+                            )
+                        )
+            for child in ast.iter_child_nodes(node):
+                walk(child, reason, info, mod, traced)
+
+        body = getattr(info.node, "body", [])
+        for stmt in body if isinstance(body, list) else [body]:
+            walk(stmt, None)
+    return findings

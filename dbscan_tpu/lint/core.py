@@ -1,8 +1,9 @@
 """graftlint engine: file collection, findings, suppressions, runner.
 
 The rule modules (:mod:`hostsync`, :mod:`recompile`, :mod:`telemetry`,
-:mod:`envvars`) are pure functions ``(Package) -> list[Finding]`` over
-a parsed :class:`Package`; this module owns everything around them —
+:mod:`envvars`, and the graftcheck families :mod:`races` /
+:mod:`collectives`) are pure functions ``(Package) -> list[Finding]``
+over a parsed :class:`Package`; this module owns everything around them —
 reading sources, per-line ``# graftlint: disable=RULE  <reason>``
 suppressions (the reason text is REQUIRED; a bare disable keeps the
 finding and adds a ``suppress-no-reason`` one), and deterministic

@@ -1,0 +1,676 @@
+"""graftcheck race rules: shared-state discipline on the PullEngine
+worker slice, plus whole-repo lock hygiene.
+
+PR 5 made the codebase genuinely concurrent: the pull-pipeline worker
+(``parallel/pipeline.py``) runs pulls, host finalize, and
+fault-supervised retries off the main thread against process-global
+state (``faults.counters``, the fault registry, the obs registries).
+These rules machine-check the discipline that code relies on:
+
+- ``race-unlocked-shared`` — a WRITE to module-global state (or to
+  ``self`` attributes of a lock-owning class) from a function reachable
+  from a PullEngine worker callable (``callgraph.walk_worker``), not
+  lexically inside a ``with <lock>`` block and not thread-local.
+  Scope notes (the rule's designed false-positive boundary, pinned by
+  the fixture tests): writes through parameters/locals are exempt —
+  objects handed TO the worker (PullJob records, chunk record dicts)
+  are ownership-transferred, ordered by the job's completion event,
+  and the runtime sanitizer (``lint/tsan.py``) is the layer that
+  watches those; ``__init__`` bodies are exempt (object not yet
+  shared); attributes reached through a ``threading.local()`` attr are
+  exempt; a function whose name ends in ``_locked`` asserts "caller
+  holds the lock" (the repo's existing convention —
+  ``PullEngine._start_ready_locked``, ``Tracer._trim_locked``) and its
+  body is treated as locked — an assertion the runtime sanitizer
+  checks for real, since the lockset it records at the shared access
+  is empty if a caller ever breaks the convention.
+- ``race-lock-order`` — a cycle in the whole-repo lock-acquisition-
+  order graph. Lock identities are RESOLVED (module-global lock
+  constructions and ``self.<attr> = threading.Lock()/tsan.lock(...)``
+  class attrs); edges come from lexically nested ``with`` blocks AND
+  from calls, inside a ``with L:`` body, to functions whose transitive
+  acquisition set is known (so ``with A: helper()`` where helper takes
+  B still yields A->B). A ``with L:`` body re-acquiring non-reentrant
+  L is reported under the same rule (self-deadlock).
+- ``race-sync-under-lock`` — a blocking device sync
+  (``jax.block_until_ready`` / ``device_get`` / ``pull_to_host`` /
+  ``.item()``) lexically inside a ``with <lock>`` body, anywhere in the
+  repo: a multi-second device wait while holding a lock the pull worker
+  or a telemetry hook needs is a stall (or deadlock) amplifier.
+
+"Provably under a lock" accepts: a with-item that resolves to a known
+lock (see ``callgraph._lock_ctor``) or whose terminal name looks like
+one (``*lock``/``*cv``/``*cond``/``*mutex``) — name-based items guard
+protection checks but are excluded from the ORDER graph, which only
+trusts resolved identities.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from dbscan_tpu.lint.core import Finding, Package
+
+_LOCK_NAME_RE = re.compile(r"(^|_)(lock|locks|lk|cv|cond|condition|mutex)$")
+
+#: method names that mutate their receiver in place
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "pop", "popitem",
+    "clear", "remove", "discard", "setdefault", "appendleft", "popleft",
+    "extendleft",
+}
+
+#: blocking device syncs (race-sync-under-lock)
+_SYNC_ATTRS = {"block_until_ready", "device_get", "pull_to_host", "item"}
+
+
+def _root_name(expr: ast.AST) -> Optional[str]:
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _attr_chain(expr: ast.AST) -> List[str]:
+    """Attribute names along the access path (outermost last)."""
+    out: List[str] = []
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        if isinstance(expr, ast.Attribute):
+            out.append(expr.attr)
+        expr = expr.value
+    out.reverse()
+    return out
+
+
+def _lock_identity(cg, info, expr) -> Optional[Tuple[str, bool]]:
+    """Resolved lock identity (id, reentrant) for a with-item/lock
+    expression, or None. Identities: ``<modname>.<global>`` for module
+    locks, ``<ClassQual>.<attr>`` for instance locks."""
+    mod = info.module
+    if isinstance(expr, ast.Name):
+        if expr.id in mod.lock_globals:
+            return (f"{mod.modname}.{expr.id}", mod.lock_globals[expr.id])
+        tgt = mod.from_names.get(expr.id)
+        if tgt is not None:
+            m2 = cg.by_modname.get(tgt[0])
+            if m2 is not None and tgt[1] in m2.lock_globals:
+                return (
+                    f"{m2.modname}.{tgt[1]}",
+                    m2.lock_globals[tgt[1]],
+                )
+        return None
+    if isinstance(expr, ast.Attribute):
+        from dbscan_tpu.lint import callgraph as cg_mod
+
+        bt = cg_mod.expr_type(cg, info, expr.value)
+        if bt is not None and expr.attr in bt.lock_attrs:
+            return (
+                f"{bt.qualname}.{expr.attr}",
+                expr.attr in bt.rlock_attrs,
+            )
+        # module-alias global lock: pipe_mod._engine_lock
+        if isinstance(expr.value, ast.Name):
+            modname = mod.import_alias.get(expr.value.id)
+            if modname is None and expr.value.id in mod.from_names:
+                src, orig = mod.from_names[expr.value.id]
+                modname = f"{src}.{orig}"
+            if modname is not None:
+                m2 = cg.by_modname.get(modname)
+                if m2 is not None and expr.attr in m2.lock_globals:
+                    return (
+                        f"{m2.modname}.{expr.attr}",
+                        m2.lock_globals[expr.attr],
+                    )
+    return None
+
+
+def _lockish(cg, info, expr) -> bool:
+    """Does this with-item look like a lock at all (resolved identity
+    OR a lock-looking terminal name)? Used for protection checks."""
+    if _lock_identity(cg, info, expr) is not None:
+        return True
+    name = None
+    if isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Attribute):
+        name = expr.attr
+    return name is not None and _LOCK_NAME_RE.search(name) is not None
+
+
+# --- race-unlocked-shared ---------------------------------------------
+
+
+class _SharedWriteScanner(ast.NodeVisitor):
+    def __init__(self, cg, info, findings: List[Finding]):
+        self.cg = cg
+        self.info = info
+        self.mod = info.module
+        self.findings = findings
+        # the `_locked` suffix is the repo's caller-holds-the-lock
+        # convention; the runtime sanitizer validates it (empty lockset
+        # at the access = a caller broke it)
+        self.lock_depth = 1 if info.name.endswith("_locked") else 0
+        node = info.node
+        # names bound locally (params + local assignments): writes
+        # through them are ownership-transfer, not shared-state, and a
+        # local that shadows a module global is local
+        self.local_binds: Set[str] = set()
+        args = getattr(node, "args", None)
+        if args is not None:
+            for a in (
+                list(args.args)
+                + list(args.kwonlyargs)
+                + list(args.posonlyargs)
+            ):
+                self.local_binds.add(a.arg)
+            if args.vararg:
+                self.local_binds.add(args.vararg.arg)
+            if args.kwarg:
+                self.local_binds.add(args.kwarg.arg)
+        self.global_decls: Set[str] = set()
+        # scope-bounded: a nested def's locals/`global` declarations
+        # must not shadow-exempt (or spuriously globalize) the
+        # enclosing function's writes
+        from dbscan_tpu.lint.callgraph import walk_scope
+
+        for n in walk_scope(node):
+            if isinstance(n, ast.Global):
+                self.global_decls.update(n.names)
+            elif isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        self.local_binds.add(t.id)
+            elif isinstance(n, ast.AnnAssign) and isinstance(
+                n.target, ast.Name
+            ):
+                self.local_binds.add(n.target.id)
+            elif isinstance(n, ast.NamedExpr) and isinstance(
+                n.target, ast.Name
+            ):
+                self.local_binds.add(n.target.id)
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                if isinstance(n.target, ast.Name):
+                    self.local_binds.add(n.target.id)
+                elif isinstance(n.target, ast.Tuple):
+                    for el in n.target.elts:
+                        if isinstance(el, ast.Name):
+                            self.local_binds.add(el.id)
+            elif isinstance(n, ast.withitem) and isinstance(
+                n.optional_vars, ast.Name
+            ):
+                self.local_binds.add(n.optional_vars.id)
+        self.local_binds -= self.global_decls
+
+    def _skip_nested(self, node):
+        # nested defs/lambdas are scanned on their own when reachable
+        # (walk_worker pushes resolved callees and callable arguments),
+        # each with its OWN lock context: a closure defined inside a
+        # `with lock:` block does not run under that lock
+        return None
+
+    visit_FunctionDef = _skip_nested
+    visit_AsyncFunctionDef = _skip_nested
+    visit_Lambda = _skip_nested
+
+    def visit_With(self, node: ast.With):
+        locked = any(
+            _lockish(self.cg, self.info, item.context_expr)
+            for item in node.items
+        )
+        for item in node.items:
+            self.visit(item)
+        if locked:
+            self.lock_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if locked:
+            self.lock_depth -= 1
+
+    visit_AsyncWith = visit_With
+
+    def _flag(self, node, what: str) -> None:
+        self.findings.append(
+            Finding(
+                "race-unlocked-shared",
+                self.mod.path,
+                node.lineno,
+                node.col_offset,
+                f"{what} on the pull-engine worker slice without a lock: "
+                "this function runs concurrently with the main thread "
+                "(reachable from a PullEngine work()/on_start callable); "
+                "guard the access with a threading.Lock (register it via "
+                "lint.tsan.lock for the runtime sanitizer) or make the "
+                "state thread-local",
+            )
+        )
+
+    def _module_shared(self, root: str) -> bool:
+        """Is ``root`` module-global mutable state (not shadowed by a
+        local binding, not itself a lock)?"""
+        if root in self.local_binds:
+            return False
+        mod = self.mod
+        if root in mod.lock_globals:
+            return False
+        if root in mod.module_globals:
+            return True
+        tgt = mod.from_names.get(root)
+        if tgt is not None:
+            m2 = self.cg.by_modname.get(tgt[0])
+            if m2 is not None and tgt[1] in m2.module_globals:
+                return tgt[1] not in m2.lock_globals
+        return False
+
+    def _self_shared(self, expr: ast.AST) -> bool:
+        """A write rooted at ``self`` in a method of a lock-owning class
+        (the class declares shared mutable state by owning a lock);
+        exempt __init__ (not yet shared), lock attrs themselves, and
+        anything reached through a threading.local() attribute."""
+        owner = self.info.owner_class
+        if owner is None or not owner.lock_attrs:
+            return False
+        if self.info.name == "__init__":
+            return False
+        chain = _attr_chain(expr)
+        if not chain:
+            return False
+        if any(a in owner.tls_attrs for a in chain):
+            return False
+        if chain[0] in owner.lock_attrs:
+            return False
+        return True
+
+    def _check_store(self, target: ast.AST) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._check_store(el)
+            return
+        if self.lock_depth > 0:
+            return
+        if isinstance(target, ast.Name):
+            if target.id in self.global_decls:
+                self._flag(
+                    target, f"write to module global {target.id!r}"
+                )
+            return
+        if isinstance(target, (ast.Attribute, ast.Subscript)):
+            root = _root_name(target)
+            if root is None:
+                return
+            if root == "self":
+                if self._self_shared(target):
+                    self._flag(
+                        target,
+                        "write to shared attribute "
+                        f"'self.{'.'.join(_attr_chain(target))}' of a "
+                        "lock-owning class",
+                    )
+            elif self._module_shared(root):
+                self._flag(
+                    target,
+                    f"write through module global {root!r}",
+                )
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._check_store(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._check_store(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._check_store(node.target)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        for t in node.targets:
+            self._check_store(t)
+        self.generic_visit(node)
+
+    def _custom_method(self, recv: ast.AST, attr: str) -> bool:
+        """Receiver is an instance of a linted class that defines
+        ``attr`` as a method (``counters.add(...)``): the method body is
+        scanned on its own, so the call site is not a container
+        mutation."""
+        from dbscan_tpu.lint import callgraph as cg_mod
+
+        t = cg_mod.expr_type(self.cg, self.info, recv)
+        return t is not None and attr in t.methods
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if (
+            self.lock_depth == 0
+            and isinstance(f, ast.Attribute)
+            and f.attr in _MUTATORS
+            and not self._custom_method(f.value, f.attr)
+        ):
+            root = _root_name(f.value)
+            if root == "self":
+                if self._self_shared(f.value):
+                    self._flag(
+                        node,
+                        f".{f.attr}() mutation of shared attribute "
+                        f"'self.{'.'.join(_attr_chain(f.value))}'",
+                    )
+            elif root is not None and self._module_shared(root):
+                self._flag(
+                    node,
+                    f".{f.attr}() mutation through module global "
+                    f"{root!r}",
+                )
+        elif (
+            self.lock_depth == 0
+            and isinstance(f, ast.Name)
+            and f.id == "setattr"
+            and node.args
+        ):
+            obj = node.args[0]
+            root = _root_name(obj)
+            if root == "self":
+                owner = self.info.owner_class
+                if (
+                    owner is not None
+                    and owner.lock_attrs
+                    and self.info.name != "__init__"
+                ):
+                    self._flag(node, "setattr() on shared self")
+            elif root is not None and self._module_shared(root):
+                self._flag(
+                    node, f"setattr() through module global {root!r}"
+                )
+        self.generic_visit(node)
+
+
+def _check_unlocked_shared(pkg: Package, findings: List[Finding]) -> None:
+    cg = pkg.callgraph
+    seen: Set[int] = set()
+    for info in cg.worker_funcs():
+        if id(info.node) in seen:
+            continue
+        seen.add(id(info.node))
+        scanner = _SharedWriteScanner(cg, info, findings)
+        body = getattr(info.node, "body", [])
+        for stmt in body if isinstance(body, list) else [body]:
+            scanner.visit(stmt)
+
+
+# --- race-lock-order ---------------------------------------------------
+
+
+def _function_lock_facts(cg, info):
+    """(direct_acquires, with_edges, call_sites_under_lock) for one
+    function. with_edges are (outer_id, inner_id, node) from lexical
+    nesting; call_sites_under_lock are (outer_id, callee FuncInfo,
+    node) for later transitive-edge expansion. Also detects
+    self-reacquisition of a non-reentrant lock."""
+    from dbscan_tpu.lint import callgraph as cg_mod
+
+    direct: Set[str] = set()
+    edges: List[Tuple[str, str, ast.AST]] = []
+    calls: List[Tuple[str, object, ast.AST]] = []
+    self_deadlocks: List[Tuple[str, ast.AST]] = []
+    types = cg_mod.local_types(cg, info)
+
+    def walk(node, held: Tuple[Tuple[str, bool], ...]):
+        if node is not info.node and isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+             ast.ClassDef),
+        ):
+            return  # nested defs have their own facts
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in node.items:
+                ident = _lock_identity(cg, info, item.context_expr)
+                if ident is not None:
+                    acquired.append(ident)
+            for ident, reentrant in acquired:
+                direct.add(ident)
+                for outer, outer_re in held:
+                    if outer == ident:
+                        if not (reentrant and outer_re):
+                            self_deadlocks.append((ident, node))
+                    else:
+                        edges.append((outer, ident, node))
+            new_held = held + tuple(acquired)
+            for item in node.items:
+                walk(item, held)
+            for stmt in node.body:
+                walk(stmt, new_held)
+            return
+        if isinstance(node, ast.Call) and held:
+            callee = cg_mod.resolve_callable(cg, info, node.func, types)
+            if callee is not None:
+                for outer, _re in held:
+                    calls.append((outer, callee, node))
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    walk(info.node, ())
+    return direct, edges, calls, self_deadlocks
+
+
+def _check_lock_order(pkg: Package, findings: List[Finding]) -> None:
+    cg = pkg.callgraph
+    facts: Dict[int, tuple] = {}
+    all_funcs = []
+    for mod in cg.modules.values():
+        for info in mod.all_functions:
+            if id(info.node) in facts:
+                continue
+            facts[id(info.node)] = _function_lock_facts(cg, info)
+            all_funcs.append(info)
+
+    # transitive acquisition sets (fixed point over the call graph)
+    from dbscan_tpu.lint import callgraph as cg_mod
+
+    trans: Dict[int, Set[str]] = {
+        nid: set(f[0]) for nid, f in facts.items()
+    }
+    callees: Dict[int, Set[int]] = {}
+    for info in all_funcs:
+        types = cg_mod.local_types(cg, info)
+        outs: Set[int] = set()
+        # scope-bounded: a call INSIDE a nested def is the nested
+        # scope's acquisition, not this function's — attributing it
+        # here would invent lock-order edges for closures that are
+        # merely constructed (not run) under a lock
+        for node in cg_mod.walk_scope(info.node):
+            if isinstance(node, ast.Call):
+                callee = cg_mod.resolve_callable(
+                    cg, info, node.func, types
+                )
+                if callee is not None and id(callee.node) in facts:
+                    outs.add(id(callee.node))
+        callees[id(info.node)] = outs
+    for _ in range(24):  # bounded fixed point
+        changed = False
+        for nid, outs in callees.items():
+            cur = trans[nid]
+            before = len(cur)
+            for o in outs:
+                cur |= trans.get(o, set())
+            changed = changed or len(cur) != before
+        if not changed:
+            break
+
+    # edge graph: lexical nesting + locks acquired by calls under a lock
+    graph: Dict[Tuple[str, str], Tuple[str, int, int]] = {}
+    self_dead: List[Tuple[str, str, int, int]] = []
+    reentrant_locks: Dict[str, bool] = {}
+    for mod in cg.modules.values():
+        for n, r in mod.lock_globals.items():
+            reentrant_locks[f"{mod.modname}.{n}"] = r
+        for cls in mod.classes.values():
+            for a in cls.lock_attrs:
+                reentrant_locks[f"{cls.qualname}.{a}"] = (
+                    a in cls.rlock_attrs
+                )
+    for info in all_funcs:
+        direct, edges, calls, dead = facts[id(info.node)]
+        for outer, inner, node in edges:
+            graph.setdefault(
+                (outer, inner), (info.path, node.lineno, node.col_offset)
+            )
+        for outer, callee, node in calls:
+            for inner in trans.get(id(callee.node), ()):
+                if inner != outer:
+                    graph.setdefault(
+                        (outer, inner),
+                        (info.path, node.lineno, node.col_offset),
+                    )
+                elif not reentrant_locks.get(inner, False):
+                    # call-transitive re-acquire of a held non-reentrant
+                    # lock: `with L: helper()` where helper takes L —
+                    # the same guaranteed deadlock as lexical nesting
+                    self_dead.append(
+                        (inner, info.path, node.lineno, node.col_offset)
+                    )
+        for ident, node in dead:
+            self_dead.append(
+                (ident, info.path, node.lineno, node.col_offset)
+            )
+
+    for ident, path, line, col in self_dead:
+        findings.append(
+            Finding(
+                "race-lock-order",
+                path,
+                line,
+                col,
+                f"non-reentrant lock {ident!r} re-acquired while already "
+                "held (self-deadlock); use an RLock or restructure so "
+                "the inner acquisition happens outside the outer block",
+            )
+        )
+
+    # cycle detection over the order graph
+    adj: Dict[str, Set[str]] = {}
+    for a, b in graph:
+        adj.setdefault(a, set()).add(b)
+    in_cycle: Set[Tuple[str, str]] = set()
+    for a, b in graph:
+        # is a reachable from b? then a->b closes a cycle
+        stack, seen = [b], set()
+        while stack:
+            n = stack.pop()
+            if n == a:
+                in_cycle.add((a, b))
+                break
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(adj.get(n, ()))
+    for a, b in sorted(in_cycle):
+        path, line, col = graph[(a, b)]
+        findings.append(
+            Finding(
+                "race-lock-order",
+                path,
+                line,
+                col,
+                f"lock-order cycle: {a!r} is acquired before {b!r} here, "
+                "but the reverse order also exists in the repo — two "
+                "threads taking the two paths deadlock; pick one global "
+                "order and restructure the other site",
+            )
+        )
+
+
+# --- race-sync-under-lock ----------------------------------------------
+
+
+def _check_sync_under_lock(pkg: Package, findings: List[Finding]) -> None:
+    cg = pkg.callgraph
+    for mod in cg.modules.values():
+        for info in mod.all_functions:
+
+            def walk(node, depth, info=info):
+                if node is not info.node and isinstance(
+                    node,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    return
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    d = depth
+                    if any(
+                        _lockish(cg, info, item.context_expr)
+                        for item in node.items
+                    ):
+                        d = depth + 1
+                    for item in node.items:
+                        walk(item, depth, info)
+                    for stmt in node.body:
+                        walk(stmt, d, info)
+                    return
+                if (
+                    depth > 0
+                    and isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_ATTRS
+                    and not (node.func.attr == "item" and node.args)
+                ):
+                    findings.append(
+                        Finding(
+                            "race-sync-under-lock",
+                            mod.path,
+                            node.lineno,
+                            node.col_offset,
+                            f"blocking device sync "
+                            f"'.{node.func.attr}()' while holding a "
+                            "lock: a multi-second device wait under a "
+                            "lock stalls (or deadlocks against) every "
+                            "thread that needs it — move the sync "
+                            "outside the locked region",
+                        )
+                    )
+                for child in ast.iter_child_nodes(node):
+                    walk(child, depth, info)
+
+            depth0 = 1 if info.name.endswith("_locked") else 0
+            body = getattr(info.node, "body", [])
+            for stmt in body if isinstance(body, list) else [body]:
+                walk(stmt, depth0)
+
+
+def check(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    _check_unlocked_shared(pkg, findings)
+    _check_lock_order(pkg, findings)
+    _check_sync_under_lock(pkg, findings)
+    return findings
+
+
+# --- the static worker-slice model (consumed by the tsan tests) -------
+
+
+def worker_tsan_sites(pkg: Package) -> Set[str]:
+    """Site-name literals of every ``tsan.access("<site>", ...)`` hook
+    located in a worker-reachable function — the STATIC model of the
+    shared state the pull worker may touch. tests/test_tsan.py asserts
+    the runtime sanitizer's observed worker access set is contained in
+    this (divergence = the static model went stale = test failure)."""
+    cg = pkg.callgraph
+    sites: Set[str] = set()
+    for info in cg.worker_funcs():
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr == "access"
+                and isinstance(f.value, ast.Name)
+                and "tsan" in f.value.id
+            ):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) and (
+                isinstance(node.args[0].value, str)
+            ):
+                sites.add(node.args[0].value)
+    return sites

@@ -1,0 +1,447 @@
+"""graftcheck runtime thread sanitizer: validate the static race model
+against a real run.
+
+The static rules (``lint/races.py``) reason about locks lexically; this
+module watches the same discipline AT RUNTIME, so the two check each
+other: the repo's shared-state sites register their locks through
+:func:`lock`/:func:`rlock`/:func:`condition` and mark their guarded
+accesses with :func:`access`, and when ``DBSCAN_TSAN=1`` (or a test
+calls :func:`enable`) the sanitizer records
+
+- **per-site locksets** (Eraser-style): the intersection of locks held
+  across every access to a site. A site touched by two threads whose
+  lockset intersection is empty, with at least one write, is a race —
+  including a caller that broke the ``_locked``-suffix convention the
+  static rule trusts;
+- **lock-acquisition order**: an edge A->B whenever B is acquired with
+  A held; observing both A->B and B->A is a lock-order inversion (the
+  dynamic twin of ``race-lock-order``);
+- **cross-thread access maps**: which thread roles touched which site —
+  ``tests/test_tsan.py`` asserts the pull worker's observed set is
+  contained in the static worker-slice model
+  (``lint.races.worker_tsan_sites``), so model drift fails tier-1.
+
+Overhead contract: the DISABLED path is one module-global truthiness
+check per hook (same discipline as ``dbscan_tpu.obs``); the lock
+wrappers delegate to real ``threading`` primitives and never allocate
+when disabled. The wrappers are installed unconditionally (they cost a
+Python-level indirection only on paths that already take a lock), so
+:func:`enable` works mid-process — locks constructed before enable
+still record.
+
+Ownership-transfer state (PullJob results, chunk record dicts) is
+deliberately NOT tsan-monitored: its safety argument is the job
+completion event's happens-before edge, not a lock, and a lockset
+checker would mis-flag it. PARITY.md documents that contract.
+
+Reports: :func:`report` (dict), :func:`assert_clean` (raises on
+races/inversions), and — under ``DBSCAN_TSAN_REPORT=path`` — an atexit
+JSON dump, which is how the tier-1 rerun of the pipeline/fault suites
+asserts an empty race report from outside the process. :func:`
+emit_telemetry` publishes the declared ``tsan.*`` counters/events when
+obs is enabled.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+from typing import Optional
+
+from dbscan_tpu import config
+
+_rt: Optional["TsanRuntime"] = None
+
+
+class TsanRuntime:
+    """Process-global sanitizer state (see module docstring)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()  # raw: invisible to itself
+        self._tls = threading.local()  # per-thread held-lock stack
+        self.accesses: dict = {}  # site -> record
+        self.edges: dict = {}  # (a, b) -> count
+        self.races: list = []
+        self.inversions: list = []
+        self.acquires = 0
+        self.naccesses = 0
+        # already-published telemetry watermark (emit_telemetry emits
+        # deltas, so periodic publication never double-counts)
+        self._emitted = {"accesses": 0, "acquires": 0, "races": 0,
+                         "inversions": 0}
+
+    # --- per-thread held stack ----------------------------------------
+
+    def _held(self) -> list:
+        st = getattr(self._tls, "held", None)
+        if st is None:
+            st = []
+            self._tls.held = st
+        return st
+
+    # --- lock hooks ----------------------------------------------------
+
+    def note_acquire(self, site: str) -> None:
+        held = self._held()
+        tname = threading.current_thread().name
+        with self._mu:
+            self.acquires += 1
+            for h in held:
+                if h == site:
+                    continue  # reentrant re-acquire of the same site
+                edge = (h, site)
+                if edge not in self.edges and (site, h) in self.edges:
+                    self.inversions.append(
+                        {
+                            "locks": sorted((h, site)),
+                            "thread": tname,
+                            "order_here": [h, site],
+                        }
+                    )
+                self.edges[edge] = self.edges.get(edge, 0) + 1
+        held.append(site)
+
+    def note_release(self, site: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == site:
+                del held[i]
+                return
+
+    # --- shared-state access hooks ------------------------------------
+
+    def note_access(self, site: str, write: bool) -> None:
+        held = frozenset(self._held())
+        tname = threading.current_thread().name
+        with self._mu:
+            self.naccesses += 1
+            rec = self.accesses.get(site)
+            if rec is None:
+                rec = {
+                    "threads": set(),
+                    "lockset": None,  # None until the first access
+                    "writes": 0,
+                    "reads": 0,
+                    "raced": False,
+                }
+                self.accesses[site] = rec
+            rec["threads"].add(tname)
+            rec["writes" if write else "reads"] += 1
+            if rec["lockset"] is None:
+                rec["lockset"] = set(held)
+            else:
+                rec["lockset"] &= held
+            if (
+                not rec["raced"]
+                and len(rec["threads"]) > 1
+                and not rec["lockset"]
+                and rec["writes"] > 0
+            ):
+                rec["raced"] = True
+                self.races.append(
+                    {
+                        "site": site,
+                        "threads": sorted(rec["threads"]),
+                        "writes": rec["writes"],
+                        "reads": rec["reads"],
+                    }
+                )
+
+    # --- reporting -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "enabled": True,
+                "accesses": {
+                    site: {
+                        "threads": sorted(rec["threads"]),
+                        "lockset": sorted(rec["lockset"] or ()),
+                        "writes": rec["writes"],
+                        "reads": rec["reads"],
+                    }
+                    for site, rec in sorted(self.accesses.items())
+                },
+                "order_edges": [
+                    {"from": a, "to": b, "count": n}
+                    for (a, b), n in sorted(self.edges.items())
+                ],
+                "races": list(self.races),
+                "lock_inversions": list(self.inversions),
+                "acquires": self.acquires,
+                "naccesses": self.naccesses,
+            }
+
+
+def _empty_report() -> dict:
+    # built fresh per call: a caller mutating its report (aggregation)
+    # must never corrupt the disabled-path constant
+    return {
+        "enabled": False,
+        "accesses": {},
+        "order_edges": [],
+        "races": [],
+        "lock_inversions": [],
+        "acquires": 0,
+        "naccesses": 0,
+    }
+
+
+# --- lock wrappers -----------------------------------------------------
+
+
+class TsanLock:
+    """Recording wrapper over a ``threading`` lock. Delegation only —
+    one ``_rt`` truthiness check per operation when disabled."""
+
+    __slots__ = ("site", "_lk")
+
+    def __init__(self, site: str, lk):
+        self.site = site
+        self._lk = lk
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lk.acquire(blocking, timeout)
+        if ok:
+            rt = _rt
+            if rt is not None:
+                rt.note_acquire(self.site)
+        return ok
+
+    def release(self) -> None:
+        rt = _rt
+        if rt is not None:
+            rt.note_release(self.site)
+        self._lk.release()
+
+    def locked(self) -> bool:
+        probe = getattr(self._lk, "locked", None)
+        if probe is not None:
+            return probe()
+        # RLock has no locked() before Python 3.12; _is_owned is the
+        # stdlib-internal equivalent threading.Condition itself uses
+        return self._lk._is_owned()
+
+    def __enter__(self) -> "TsanLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.release()
+
+
+class TsanCondition:
+    """Recording wrapper over ``threading.Condition``. ``wait`` releases
+    the lock, so the held-stack mirrors that (release on wait entry,
+    re-acquire on wake)."""
+
+    __slots__ = ("site", "_cond")
+
+    def __init__(self, site: str):
+        self.site = site
+        self._cond = threading.Condition()
+
+    def __enter__(self) -> "TsanCondition":
+        self._cond.__enter__()
+        rt = _rt
+        if rt is not None:
+            rt.note_acquire(self.site)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        rt = _rt
+        if rt is not None:
+            rt.note_release(self.site)
+        return self._cond.__exit__(exc_type, exc, tb)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._cond.acquire(blocking, timeout)
+        if ok:
+            rt = _rt
+            if rt is not None:
+                rt.note_acquire(self.site)
+        return ok
+
+    def release(self) -> None:
+        rt = _rt
+        if rt is not None:
+            rt.note_release(self.site)
+        self._cond.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        rt = _rt
+        if rt is not None:
+            rt.note_release(self.site)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            rt = _rt
+            if rt is not None:
+                rt.note_acquire(self.site)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        rt = _rt
+        if rt is not None:
+            rt.note_release(self.site)
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            rt = _rt
+            if rt is not None:
+                rt.note_acquire(self.site)
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+
+# --- public API --------------------------------------------------------
+
+
+def lock(site: str) -> TsanLock:
+    """A (non-reentrant) lock registered under ``site``."""
+    return TsanLock(site, threading.Lock())
+
+
+def rlock(site: str) -> TsanLock:
+    """A reentrant lock registered under ``site``."""
+    return TsanLock(site, threading.RLock())
+
+
+def condition(site: str) -> TsanCondition:
+    """A condition variable registered under ``site``."""
+    return TsanCondition(site)
+
+
+def access(site: str, write: bool = True) -> None:
+    """Mark one access to the shared state behind ``site`` — call it
+    INSIDE the locked region so the recorded lockset carries the guard.
+    One truthiness check when the sanitizer is off."""
+    rt = _rt
+    if rt is not None:
+        rt.note_access(site, write)
+
+
+def enabled() -> bool:
+    return _rt is not None
+
+
+def enable() -> TsanRuntime:
+    """Turn the sanitizer on (idempotent); returns the runtime."""
+    global _rt
+    if _rt is None:
+        _rt = TsanRuntime()
+    return _rt
+
+
+def disable() -> None:
+    global _rt
+    _rt = None
+
+
+def reset() -> None:
+    """Fresh runtime if enabled (drop recorded state, keep recording)."""
+    global _rt
+    if _rt is not None:
+        _rt = TsanRuntime()
+
+
+def report() -> dict:
+    """The current sanitizer report (a disabled sanitizer reports
+    ``enabled: False`` with empty tables)."""
+    rt = _rt
+    if rt is None:
+        return _empty_report()
+    return rt.snapshot()
+
+
+def assert_clean() -> None:
+    """Raise AssertionError when the run recorded any race or
+    lock-order inversion (the test-suite gate)."""
+    rep = report()
+    problems = rep["races"] + rep["lock_inversions"]
+    if problems:
+        raise AssertionError(
+            "thread sanitizer found "
+            f"{len(rep['races'])} race(s) and "
+            f"{len(rep['lock_inversions'])} lock inversion(s): "
+            + json.dumps(problems, indent=2)
+        )
+
+
+def worker_sites(thread_prefix: str = "dbscan-pull") -> set:
+    """Sites touched by pull-engine worker threads in the live run —
+    the observed half of the static-model containment test."""
+    rep = report()
+    return {
+        site
+        for site, rec in rep["accesses"].items()
+        if any(t.startswith(thread_prefix) for t in rec["threads"])
+    }
+
+
+def emit_telemetry() -> None:
+    """Publish the declared ``tsan.*`` counters/events (no-op unless
+    both the sanitizer and obs are enabled). Emits DELTAS since the
+    last call, so periodic publication from a long-lived harness never
+    double-counts and never re-emits a race/inversion event."""
+    rt = _rt
+    if rt is None:
+        return
+    from dbscan_tpu import obs
+
+    if not obs.active():
+        return
+    rep = rt.snapshot()
+    with rt._mu:
+        done = dict(rt._emitted)
+        rt._emitted = {
+            "accesses": rep["naccesses"],
+            "acquires": rep["acquires"],
+            "races": len(rep["races"]),
+            "inversions": len(rep["lock_inversions"]),
+        }
+    obs.count("tsan.accesses", rep["naccesses"] - done["accesses"])
+    obs.count("tsan.acquires", rep["acquires"] - done["acquires"])
+    obs.count("tsan.races", len(rep["races"]) - done["races"])
+    obs.count(
+        "tsan.lock_inversions",
+        len(rep["lock_inversions"]) - done["inversions"],
+    )
+    for r in rep["races"][done["races"]:]:
+        obs.event("tsan.race", site=r["site"], threads=",".join(r["threads"]))
+    for inv in rep["lock_inversions"][done["inversions"]:]:
+        obs.event("tsan.lock_inversion", locks=",".join(inv["locks"]))
+
+
+def write_report(path: str) -> str:
+    """Write the JSON report atomically; returns the path. Also
+    publishes the pending ``tsan.*`` telemetry deltas first (the one
+    product call site — the ``DBSCAN_TSAN_REPORT`` atexit hook — so a
+    sanitized run with obs enabled carries its tsan counters/events in
+    the trace, not only in the JSON file)."""
+    emit_telemetry()
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(report(), f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def _env_init() -> None:
+    """Activate from the environment at import: ``DBSCAN_TSAN=1`` turns
+    recording on; ``DBSCAN_TSAN_REPORT=path`` additionally dumps the
+    JSON report at process exit (how the tier-1 subprocess rerun of the
+    pipeline/fault suites is asserted race-free from outside)."""
+    if config.env("DBSCAN_TSAN"):
+        enable()
+        path = config.env("DBSCAN_TSAN_REPORT")
+        if path:
+            atexit.register(write_report, path)
+
+
+_env_init()

@@ -37,11 +37,11 @@ wired through every hot call site.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Optional
 
 from dbscan_tpu import config
+from dbscan_tpu.lint import tsan as _tsan
 from dbscan_tpu.obs import export as export_mod
 from dbscan_tpu.obs.metrics import MetricsRegistry
 from dbscan_tpu.obs.trace import NOOP_SPAN, Span, Tracer  # noqa: F401
@@ -86,7 +86,7 @@ class ObsState:
 
 
 _state: Optional[ObsState] = None
-_lock = threading.Lock()
+_lock = _tsan.lock("obs.state")
 
 
 def state() -> Optional[ObsState]:
@@ -121,6 +121,7 @@ def enable(
     any order without clobbering each other's spans."""
     global _state
     with _lock:
+        _tsan.access("obs.state")
         if _state is None:
             if device_sync is None:
                 device_sync = bool(config.env("DBSCAN_TIME_DEVICE"))
@@ -141,6 +142,7 @@ def disable() -> None:
     timeline: disable+enable is the documented reset."""
     global _state
     with _lock:
+        _tsan.access("obs.state")
         _state = None
 
 

@@ -35,15 +35,15 @@ from __future__ import annotations
 
 import logging
 import sys
-import threading
 import time
 
 import dbscan_tpu.obs as obs
 from dbscan_tpu import config
+from dbscan_tpu.lint import tsan as _tsan
 
 logger = logging.getLogger(__name__)
 
-_lock = threading.Lock()
+_lock = _tsan.lock("obs.compile")
 _family_compiles: dict = {}
 _family_sites: dict = {}  # family -> "file:line" of the last miss call
 _storm_warned: set = set()
@@ -93,17 +93,27 @@ def _known_sites(family: str) -> str:
     ``lint.callgraph.tracked_call_sites`` metadata (decorated/wrapped
     dispatches that route through :func:`note_compile` directly)."""
     with _lock:
+        _tsan.access("obs.compile", write=False)
         site = _family_sites.get(family)
     if site:
         return site
     global _static_sites
     if _static_sites is None:
+        # build OUTSIDE the lock (it walks the source tree), publish
+        # under it: tracked_call_sites is deterministic, so a racing
+        # duplicate build is wasted work, not wrong data — but the
+        # unguarded global write was a worker-slice race finding
+        # (graftcheck race-unlocked-shared, PR 6)
         try:
             from dbscan_tpu.lint.callgraph import tracked_call_sites
 
-            _static_sites = tracked_call_sites()
+            built = tracked_call_sites()
         except Exception:  # noqa: BLE001 — metadata is best-effort
-            _static_sites = {}
+            built = {}
+        with _lock:
+            _tsan.access("obs.compile")
+            if _static_sites is None:
+                _static_sites = built
     sites = _static_sites.get(family)
     if sites:
         return ", ".join(f"{f}:{ln}" for f, ln in sites[:3])
@@ -121,6 +131,7 @@ def note_compile(
         obs.count("compiles.wall_s", t1 - t0)
         obs.add_span(f"compile.{family}", t0, t1, family=family)
     with _lock:
+        _tsan.access("obs.compile")
         n = _family_compiles.get(family, 0) + 1
         _family_compiles[family] = n
         if site:

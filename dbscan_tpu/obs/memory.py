@@ -37,16 +37,15 @@ monotone here, since the registry's gauges have no max semantics):
 
 from __future__ import annotations
 
-import threading
-
 import dbscan_tpu.obs as obs
+from dbscan_tpu.lint import tsan as _tsan
 
 # availability latch: None = not probed yet; False = no device reports
 # allocator stats (CPU backend) — sampler short-circuits forever;
 # True = at least one device reports stats.
 _AVAILABLE = None
 _peak_seen = 0
-_lock = threading.Lock()
+_lock = _tsan.lock("obs.memory")
 
 
 def device_memory_stats() -> dict:
@@ -71,11 +70,21 @@ def device_memory_stats() -> dict:
 
 
 def available() -> bool:
-    """True when some device reports allocator stats (probed once)."""
+    """True when some device reports allocator stats (probed once).
+    The probe latch is written under ``_lock``: the sampler runs from
+    supervised retries on the pull-engine worker too, and the unguarded
+    latch write was a worker-slice race finding (graftcheck
+    race-unlocked-shared, PR 6). Settled fast path: one plain read."""
     global _AVAILABLE
-    if _AVAILABLE is None:
-        _AVAILABLE = bool(device_memory_stats())
-    return _AVAILABLE
+    latched = _AVAILABLE
+    if latched is None:
+        probed = bool(device_memory_stats())
+        with _lock:
+            _tsan.access("obs.memory")
+            if _AVAILABLE is None:
+                _AVAILABLE = probed
+            latched = _AVAILABLE
+    return latched
 
 
 def sample(site: str):
@@ -98,6 +107,7 @@ def sample(site: str):
     limit = sum(int(s.get("bytes_limit", 0)) for s in stats.values())
     global _peak_seen
     with _lock:
+        _tsan.access("obs.memory")
         _peak_seen = max(_peak_seen, peak_rep, in_use)
         peak = _peak_seen
     st.metrics.gauge("memory.bytes_in_use", in_use)
@@ -114,5 +124,6 @@ def reset_peak() -> None:
     the next sample (tests swap fake backends in and out)."""
     global _peak_seen, _AVAILABLE
     with _lock:
+        _tsan.access("obs.memory")
         _peak_seen = 0
-    _AVAILABLE = None
+        _AVAILABLE = None

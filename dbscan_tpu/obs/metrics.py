@@ -26,7 +26,7 @@ observability is enabled.
 
 from __future__ import annotations
 
-import threading
+from dbscan_tpu.lint import tsan as _tsan
 
 
 class MetricsRegistry:
@@ -34,26 +34,30 @@ class MetricsRegistry:
     pulls and the packer callbacks can run from different threads)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _tsan.lock("obs.metrics")
         self._counters: dict = {}
         self._gauges: dict = {}
 
     def count(self, name: str, value=1) -> None:
         """Add ``value`` (int or float) to counter ``name``."""
         with self._lock:
+            _tsan.access("obs.metrics")
             self._counters[name] = self._counters.get(name, 0) + value
 
     def gauge(self, name: str, value) -> None:
         """Set gauge ``name`` to ``value`` (last write wins)."""
         with self._lock:
+            _tsan.access("obs.metrics")
             self._gauges[name] = value
 
     def counters(self) -> dict:
         with self._lock:
+            _tsan.access("obs.metrics", write=False)
             return dict(self._counters)
 
     def gauges(self) -> dict:
         with self._lock:
+            _tsan.access("obs.metrics", write=False)
             return dict(self._gauges)
 
     def snapshot(self) -> dict:

@@ -102,6 +102,11 @@ COUNTERS = {
     "pull.overlap_s": "pull/finalize seconds hidden behind other work",
     "pull.busy_s": "total pipelined pull+finalize wall (worker seconds)",
     "pull.bytes": "bytes routed through the pull pipeline (size hints)",
+    "tsan.accesses": "shared-state accesses the thread sanitizer saw",
+    "tsan.acquires": "registered-lock acquisitions the sanitizer saw",
+    "tsan.races": "lockset races detected (empty-intersection, "
+    "multi-thread, written sites)",
+    "tsan.lock_inversions": "lock-acquisition-order inversions observed",
 }
 
 GAUGES = {
@@ -144,6 +149,8 @@ EVENTS = {
     "fault.fatal": "supervised dispatch exhausted retries, aborting",
     "fault.degrade_host": "caller-counted host degradation (spill tree)",
     "faults.run_delta": "per-run fault-counter delta (= stats['faults'])",
+    "tsan.race": "thread sanitizer race record (site + thread roles)",
+    "tsan.lock_inversion": "thread sanitizer lock-order inversion record",
 }
 
 for _f in COMPILE_FAMILIES:

@@ -34,6 +34,7 @@ import time
 from typing import Optional
 
 from dbscan_tpu import config
+from dbscan_tpu.lint import tsan as _tsan
 
 
 class Span:
@@ -139,7 +140,7 @@ class Tracer:
             1024, int(config.env("DBSCAN_TRACE_MAX_SPANS"))
         )
         self.dropped_spans = 0
-        self._lock = threading.Lock()
+        self._lock = _tsan.lock("obs.trace")
         self._tls = threading.local()
         # time bases for export: perf_counter deltas are the durations,
         # epoch0 anchors them to wall-clock time for cross-process reads
@@ -167,6 +168,7 @@ class Tracer:
         elif sp in st:  # out-of-order end (exception unwound children)
             st.remove(sp)
         with self._lock:
+            _tsan.access("obs.trace")
             self.spans.append(sp)
             self._trim_locked()
 
@@ -195,6 +197,7 @@ class Tracer:
         if events:
             sp.events.extend(events)
         with self._lock:
+            _tsan.access("obs.trace")
             self.spans.append(sp)
             self._trim_locked()
         return sp
@@ -207,8 +210,10 @@ class Tracer:
             st[-1].event(name, **args)
         else:
             with self._lock:
+                _tsan.access("obs.trace")
                 self.instants.append((name, time.perf_counter(), args))
 
     def snapshot_spans(self) -> list:
         with self._lock:
+            _tsan.access("obs.trace", write=False)
             return list(self.spans)

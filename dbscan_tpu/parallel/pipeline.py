@@ -64,6 +64,7 @@ from collections import deque
 from typing import Callable, Optional
 
 from dbscan_tpu import config, obs
+from dbscan_tpu.lint import tsan as _tsan
 
 logger = logging.getLogger(__name__)
 
@@ -109,7 +110,7 @@ class PullEngine:
     def __init__(self, inflight: int = 2, inflight_bytes: int = 1 << 30):
         self.inflight = max(1, int(inflight))
         self.inflight_bytes = max(1, int(inflight_bytes))
-        self._cv = threading.Condition()
+        self._cv = _tsan.condition("pipeline.engine")
         self._pending: deque = deque()  # submitted, on_start not yet run
         self._ready: deque = deque()  # started, not yet executed
         self._executing: Optional[PullJob] = None
@@ -135,6 +136,7 @@ class PullEngine:
         submission order on the worker."""
         job = PullJob(work, on_start, bytes_hint, label)
         with self._cv:
+            _tsan.access("pipeline.engine")
             if self._shutdown:
                 raise RuntimeError("pull engine is shut down")
             self._pending.append(job)
@@ -165,6 +167,7 @@ class PullEngine:
         waited = time.perf_counter() - t0
         first = False
         with self._cv:
+            _tsan.access("pipeline.engine")
             if not job.consumed:
                 job.consumed = True
                 first = True
@@ -200,6 +203,7 @@ class PullEngine:
         """Block until every submitted job has finished (results are NOT
         consumed; exceptions stay on their jobs for wait())."""
         with self._cv:
+            _tsan.access("pipeline.engine", write=False)
             jobs = list(self._pending) + list(self._ready)
             if self._executing is not None:
                 jobs.append(self._executing)
@@ -212,6 +216,7 @@ class PullEngine:
         and block until the in-flight one finishes. Returns the number
         of cancelled jobs."""
         with self._cv:
+            _tsan.access("pipeline.engine")
             dropped = list(self._pending) + list(self._ready)
             self._pending.clear()
             # started-but-unexecuted jobs already ran on_start (the async
@@ -233,6 +238,7 @@ class PullEngine:
         """Stop the worker (cancels everything not yet executing)."""
         self.quiesce()
         with self._cv:
+            _tsan.access("pipeline.engine")
             self._shutdown = True
             self._cv.notify_all()
 
@@ -242,10 +248,12 @@ class PullEngine:
         """Cumulative engine accounting (independent of obs): jobs,
         wait_s, busy_s, overlap_s, bytes, inflight_peak."""
         with self._cv:
+            _tsan.access("pipeline.engine", write=False)
             return dict(self._totals)
 
     def _set_inflight_gauge(self) -> None:
         with self._cv:
+            _tsan.access("pipeline.engine")
             n = self._started
             if n > self._totals["inflight_peak"]:
                 self._totals["inflight_peak"] = n
@@ -293,6 +301,7 @@ class PullEngine:
     def _loop(self) -> None:
         while True:
             with self._cv:
+                _tsan.access("pipeline.engine")
                 while True:
                     if self._shutdown:
                         return
@@ -302,6 +311,7 @@ class PullEngine:
                     self._cv.wait()
             self._run_start_hooks(to_start)
             with self._cv:
+                _tsan.access("pipeline.engine")
                 if not self._ready:
                     continue
                 job = self._ready.popleft()
@@ -313,6 +323,7 @@ class PullEngine:
                 job.error = e
             job.busy_s = time.perf_counter() - t0
             with self._cv:
+                _tsan.access("pipeline.engine")
                 self._executing = None
                 self._started -= 1
                 self._started_bytes -= job.bytes_hint
@@ -346,7 +357,7 @@ class PullEngine:
 
 _engine: Optional[PullEngine] = None
 _engine_key = None
-_engine_lock = threading.Lock()
+_engine_lock = _tsan.lock("pipeline.engine_state")
 
 
 def get_engine() -> Optional[PullEngine]:
@@ -367,6 +378,7 @@ def get_engine() -> Optional[PullEngine]:
         int(config.env("DBSCAN_PULL_INFLIGHT_BYTES")),
     )
     with _engine_lock:
+        _tsan.access("pipeline.engine_state")
         if not key[0]:
             if _engine is not None:
                 _engine.close()
@@ -389,6 +401,7 @@ def reset_engine() -> None:
     """Stop and drop the process engine (tests)."""
     global _engine, _engine_key
     with _engine_lock:
+        _tsan.access("pipeline.engine_state")
         if _engine is not None:
             _engine.close()
         _engine = None

@@ -444,6 +444,535 @@ def test_env_parity_detects_deleted_table_row(tmp_path):
     ], parity
 
 
+# --- race family (graftcheck) -----------------------------------------
+
+_RACE_PRELUDE = """
+        import threading
+
+        from dbscan_tpu.parallel.pipeline import get_engine
+
+        TOTALS = {"n": 0}
+        LOCK = threading.Lock()
+        eng = get_engine()
+"""
+
+
+def test_race_unlocked_shared_on_worker_callable(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        _RACE_PRELUDE
+        + """
+        def work():
+            TOTALS["n"] += 1
+
+        eng.submit(work)
+        """,
+    )
+    assert _rules(findings) == ["race-unlocked-shared"]
+    assert findings[0].line == 11
+
+
+def test_race_unlocked_shared_clean_under_lock(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        _RACE_PRELUDE
+        + """
+        def work():
+            with LOCK:
+                TOTALS["n"] += 1
+
+        eng.submit(work)
+        """,
+    )
+    assert findings == []
+
+
+def test_race_thread_target_is_a_worker_root(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import threading
+
+        N = 0
+
+        def tick():
+            global N
+            N += 1
+
+        t = threading.Thread(target=tick)
+        """,
+    )
+    assert _rules(findings) == ["race-unlocked-shared"]
+
+
+def test_race_closure_defined_under_lock_runs_unlocked(tmp_path):
+    """A closure DEFINED inside a `with lock:` block does not run under
+    that lock — its body is scanned with its own (empty) lock context,
+    so the unlocked write still flags (exactly once)."""
+    findings, _ = _lint_source(
+        tmp_path,
+        _RACE_PRELUDE
+        + """
+        def work():
+            with LOCK:
+                def cb():
+                    TOTALS["n"] += 1
+            cb()
+
+        eng.submit(work)
+        """,
+    )
+    assert _rules(findings) == ["race-unlocked-shared"]
+
+
+def test_race_nested_def_local_does_not_shadow_exempt(tmp_path):
+    """A nested def binding a local named like the module global must
+    not exempt the ENCLOSING function's unlocked shared write (the
+    binding scans are scope-bounded)."""
+    findings, _ = _lint_source(
+        tmp_path,
+        _RACE_PRELUDE
+        + """
+        def work():
+            def unrelated():
+                TOTALS = {}
+                return TOTALS
+
+            TOTALS["n"] += 1
+
+        eng.submit(work)
+        """,
+    )
+    assert _rules(findings) == ["race-unlocked-shared"]
+
+
+def test_race_nested_global_decl_does_not_leak_out(tmp_path):
+    """A `global N` inside a nested def must not make the enclosing
+    function's plain local write look like a module-global write."""
+    findings, _ = _lint_source(
+        tmp_path,
+        _RACE_PRELUDE
+        + """
+        N = 0
+
+        def work():
+            def bump():
+                global N
+                with LOCK:
+                    N += 1
+
+            N = 1  # plain LOCAL in work: not the module global
+            bump()
+
+        eng.submit(work)
+        """,
+    )
+    assert findings == []
+
+
+def test_race_lock_order_closure_built_under_lock_is_clean(tmp_path):
+    """Constructing (not running) a closure under a lock must not
+    charge the closure's lock acquisitions to the builder — no
+    invented lock-order cycle."""
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def helper_a():
+            with A:
+                pass
+
+        def make_later():
+            def cb():
+                helper_a()
+
+            return cb
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def clean():
+            with B:
+                cb = make_later()  # builds, never runs helper_a
+            return cb
+        """,
+    )
+    assert findings == []
+
+
+def test_race_not_flagged_off_the_worker_slice(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        N = 0
+
+        def main_thread_only():
+            global N
+            N += 1
+        """,
+    )
+    assert findings == []  # same write, but nothing dispatches it
+
+
+def test_race_param_rooted_writes_are_ownership_transfer(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        _RACE_PRELUDE
+        + """
+        def work(rec):
+            rec["out"] = 1  # handed-off record: exempt by design
+
+        eng.submit(work)
+        """,
+    )
+    assert findings == []
+
+
+def test_race_lock_order_cycle(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with B:
+                with A:
+                    pass
+        """,
+    )
+    assert set(_rules(findings)) == {"race-lock-order"}
+    assert len(findings) == 2  # both edges of the cycle
+
+
+def test_race_lock_order_cycle_through_a_call(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def helper():
+            with B:
+                pass
+
+        def f():
+            with A:
+                helper()
+
+        def g():
+            with B:
+                with A:
+                    pass
+        """,
+    )
+    assert "race-lock-order" in _rules(findings)
+
+
+def test_race_lock_order_consistent_is_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with A:
+                with B:
+                    pass
+        """,
+    )
+    assert findings == []
+
+
+def test_race_lock_order_self_deadlock(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import threading
+
+        A = threading.Lock()
+
+        def f():
+            with A:
+                with A:
+                    pass
+        """,
+    )
+    assert _rules(findings) == ["race-lock-order"]
+    assert "re-acquired" in findings[0].message
+
+
+def test_race_lock_order_call_transitive_self_deadlock(tmp_path):
+    """`with L: helper()` where helper itself takes non-reentrant L is
+    the same guaranteed deadlock as lexical nesting and must flag."""
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import threading
+
+        A = threading.Lock()
+
+        def helper():
+            with A:
+                pass
+
+        def f():
+            with A:
+                helper()
+        """,
+    )
+    assert _rules(findings) == ["race-lock-order"]
+
+
+def test_race_annotated_local_shadows_module_global(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        _RACE_PRELUDE
+        + """
+        cache = {}
+
+        def work():
+            cache: dict = {}
+            cache["k"] = 1  # annotated LOCAL, not the module global
+
+        eng.submit(work)
+        """,
+    )
+    assert findings == []
+
+
+def test_race_rlock_reacquire_is_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import threading
+
+        A = threading.RLock()
+
+        def f():
+            with A:
+                with A:
+                    pass
+        """,
+    )
+    assert findings == []
+
+
+def test_race_sync_under_lock(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import threading
+        import jax
+
+        L = threading.Lock()
+
+        def f(x):
+            with L:
+                jax.block_until_ready(x)
+            return x
+        """,
+    )
+    assert _rules(findings) == ["race-sync-under-lock"]
+
+
+def test_race_sync_outside_lock_is_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import threading
+        import jax
+
+        L = threading.Lock()
+
+        def f(x):
+            with L:
+                y = x
+            jax.block_until_ready(y)
+            return y
+        """,
+    )
+    assert findings == []
+
+
+# --- collective family (graftcheck) -----------------------------------
+
+
+def test_collective_in_branch_on_traced_param(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        from jax import lax
+
+        def block(x):
+            if x[0] > 0:
+                x = lax.psum(x, "i")
+            return x
+
+        f = jax.shard_map(block, mesh=None, in_specs=None, out_specs=None)
+        """,
+    )
+    assert _rules(findings) == ["collective-in-branch"]
+    assert findings[0].line == 7
+
+
+def test_collective_under_uniform_host_config_is_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        from jax import lax
+
+        def make(mesh):
+            def block(x):
+                y = x.sum()
+                if mesh is not None:
+                    y = lax.psum(y, "i")
+                return y
+
+            return jax.shard_map(
+                block, mesh=mesh, in_specs=None, out_specs=None
+            )
+        """,
+    )
+    assert findings == []  # closure over the builder's mesh is uniform
+
+
+def test_collective_axis_undeclared(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+        from jax import lax
+        from jax.sharding import Mesh
+
+        AXIS = "parts"
+        mesh = Mesh(np.empty(1, object), (AXIS,))
+
+        def block(x):
+            return lax.psum(x, "chips")
+
+        f = jax.shard_map(block, mesh=mesh, in_specs=None, out_specs=None)
+        """,
+    )
+    assert _rules(findings) == ["collective-axis-undeclared"]
+
+
+def test_collective_axis_resolved_constant_is_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+        from jax import lax
+        from jax.sharding import Mesh
+
+        AXIS = "parts"
+        mesh = Mesh(np.empty(1, object), (AXIS,))
+
+        def block(x):
+            return lax.psum(x, AXIS)
+
+        f = jax.shard_map(block, mesh=mesh, in_specs=None, out_specs=None)
+        """,
+    )
+    assert findings == []
+
+
+def test_pull_in_collective(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+
+        def helper(x):
+            return jax.device_get(x)
+
+        def block(x):
+            return helper(x)
+
+        f = jax.shard_map(block, mesh=None, in_specs=None, out_specs=None)
+        """,
+    )
+    assert _rules(findings) == ["pull-in-collective"]
+    assert findings[0].line == 5  # in the helper, via the region walk
+
+
+def test_pull_outside_collective_is_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+
+        def block(x):
+            return x + 1
+
+        f = jax.shard_map(block, mesh=None, in_specs=None, out_specs=None)
+
+        def driver(x):
+            return jax.device_get(f(x))
+        """,
+    )
+    assert findings == []
+
+
+def test_worker_slice_model_covers_the_pull_paths():
+    """Pin the callgraph's worker slice on the real package: the pull
+    finalize, the sparse leaf lander, the engine loop, and fault
+    supervision are all on it, and every tsan site they touch is in the
+    static model the containment test consumes."""
+    from dbscan_tpu.lint import races
+    from dbscan_tpu.lint.core import load_package, run_rules
+
+    pkg = load_package([PKG])
+    run_rules(pkg, (), lint_mod.RULES)
+    names = {f.qualname for f in pkg.callgraph.worker_funcs()}
+    for expected in (
+        "dbscan_tpu.parallel.driver.train_arrays._pull_record",
+        "dbscan_tpu.ops.sparse._mesh_leaf_dispatch._land",
+        "dbscan_tpu.parallel.pipeline.PullEngine._loop",
+        "dbscan_tpu.faults.supervised",
+        "dbscan_tpu.faults.get_registry",
+        "dbscan_tpu.obs.metrics.MetricsRegistry.count",
+        "dbscan_tpu._native.lib",
+    ):
+        assert expected in names, expected
+    sites = races.worker_tsan_sites(pkg)
+    assert {
+        "faults.counters",
+        "faults.registry",
+        "faults.registry_state",
+        "obs.metrics",
+        "obs.trace",
+        "pipeline.engine",
+    } <= sites
+
+
 # --- suppressions -----------------------------------------------------
 
 _SUPPRESSIBLE = """
@@ -543,13 +1072,69 @@ def test_cli_json_output_schema(tmp_path, capsys):
     bad.write_text("import os\nv = os.environ.get('DBSCAN_X')\n")
     assert lint_main(["--format", "json", str(bad)]) == 1
     payload = json.loads(capsys.readouterr().out)
-    assert set(payload) == {"files_scanned", "findings"}
+    assert set(payload) == {"files_scanned", "baselined", "findings"}
     assert payload["files_scanned"] == 1
+    assert payload["baselined"] == 0
     (finding,) = payload["findings"]
     assert set(finding) == {"rule", "path", "line", "col", "message"}
     assert finding["rule"] == "env-direct-read"
     assert finding["line"] == 2
     assert finding["rule"] in lint_mod.RULES
+
+
+def test_cli_rules_filter(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nv = os.environ.get('DBSCAN_X')\n")
+    # matching family still fails ...
+    assert lint_main(["--rules", "env-*", str(bad)]) == 1
+    capsys.readouterr()
+    # ... a disjoint family filter passes the same file ...
+    assert lint_main(["--rules", "race-*,collective-*", str(bad)]) == 0
+    capsys.readouterr()
+    # ... and a glob matching no known rule is a usage error (a typo'd
+    # filter must not silently gate nothing)
+    assert lint_main(["--rules", "nope-*", str(bad)]) == 2
+
+
+def test_cli_baseline_gates_new_findings_only(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nv = os.environ.get('DBSCAN_X')\n")
+    base = tmp_path / "baseline.json"
+    # record the existing debt ...
+    assert lint_main(["--write-baseline", str(base), str(bad)]) == 0
+    capsys.readouterr()
+    # ... baselined findings no longer fail ...
+    assert lint_main(["--baseline", str(base), str(bad)]) == 0
+    err = capsys.readouterr().err
+    assert "(1 baselined)" in err
+    # ... a NEW finding does (and baselined stays suppressed), even on
+    # a shifted line (baseline matches rule+path+message, not line)
+    bad.write_text(
+        "import os\n# pushed down\nv = os.environ.get('DBSCAN_X')\n"
+        "w = os.getenv('DBSCAN_Y')\n"
+    )
+    assert lint_main(["--baseline", str(base), str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "DBSCAN_Y" in out and "DBSCAN_X" not in out
+    # a missing baseline file is exit 2, not a silent full run
+    assert lint_main(["--baseline", str(tmp_path / "nope.json"),
+                      str(bad)]) == 2
+
+
+def test_cli_baseline_is_a_multiset(tmp_path, capsys):
+    """One baselined occurrence must not absorb a NEWLY ADDED duplicate
+    of the same finding (same rule+path+message, different line)."""
+    bad = tmp_path / "dup.py"
+    bad.write_text("import os\nv = os.getenv('DBSCAN_X')\n")
+    base = tmp_path / "baseline.json"
+    assert lint_main(["--write-baseline", str(base), str(bad)]) == 0
+    capsys.readouterr()
+    bad.write_text(
+        "import os\nv = os.getenv('DBSCAN_X')\nw = os.getenv('DBSCAN_X')\n"
+    )
+    assert lint_main(["--baseline", str(base), str(bad)]) == 1
+    err = capsys.readouterr().err
+    assert "1 finding(s) (1 baselined)" in err
 
 
 def test_cli_list_rules(capsys):

@@ -1,0 +1,366 @@
+"""graftcheck runtime thread sanitizer (dbscan_tpu/lint/tsan.py).
+
+Pins, per the PR acceptance bar:
+
+- the sanitizer's detectors themselves: lockset races (two threads, no
+  common lock, at least one write), lock-order inversions, condition
+  wait/reacquire bookkeeping, and the strict disabled-path no-op;
+- the races the static rules surfaced and this PR FIXED stay fixed:
+  ``faults.get_registry`` / ``_native.lib`` / ``obs.memory.available``
+  singletons hammered from many threads return one object each and
+  record no race under the live sanitizer (regression tests);
+- the static/dynamic contract: a real pipelined banded train run under
+  the sanitizer records a worker access set CONTAINED IN the static
+  worker-slice model (``lint.races.worker_tsan_sites``) — divergence
+  means the static model went stale and IS the test failure;
+- the tier-1 rerun: the pipeline + fault suites pass under
+  ``DBSCAN_TSAN=1`` with an EMPTY race/inversion report
+  (``DBSCAN_TSAN_REPORT`` JSON, asserted from outside the process).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import Engine, faults, obs, train
+from dbscan_tpu.lint import tsan
+from dbscan_tpu.parallel import pipeline as pipe_mod
+
+pytestmark = pytest.mark.tsan
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "dbscan_tpu")
+
+
+@pytest.fixture
+def rt():
+    """A fresh, enabled sanitizer runtime; always disabled after."""
+    tsan.enable()
+    tsan.reset()
+    yield tsan
+    tsan.disable()
+
+
+def _in_threads(n, fn):
+    errs = []
+
+    def run(i):
+        try:
+            fn(i)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert errs == []
+
+
+# --- detector unit tests ----------------------------------------------
+
+
+def test_disabled_path_is_noop():
+    tsan.disable()
+    assert not tsan.enabled()
+    tsan.access("nothing.recorded")  # must not raise or allocate state
+    rep = tsan.report()
+    assert rep["enabled"] is False
+    assert rep["accesses"] == {} and rep["races"] == []
+    tsan.assert_clean()  # empty report is clean
+
+
+def test_unsynchronized_cross_thread_write_is_a_race(rt):
+    _in_threads(2, lambda i: tsan.access("t.bare"))
+    rep = tsan.report()
+    assert [r["site"] for r in rep["races"]] == ["t.bare"]
+    assert len(rep["races"][0]["threads"]) == 2
+    with pytest.raises(AssertionError, match="t.bare"):
+        tsan.assert_clean()
+
+
+def test_lock_protected_access_is_clean(rt):
+    lk = tsan.lock("t.lk")
+
+    def body(i):
+        with lk:
+            tsan.access("t.guarded")
+
+    _in_threads(4, body)
+    rep = tsan.report()
+    assert rep["races"] == []
+    assert rep["accesses"]["t.guarded"]["lockset"] == ["t.lk"]
+    assert len(rep["accesses"]["t.guarded"]["threads"]) == 4
+
+
+def test_single_thread_unlocked_is_not_a_race(rt):
+    for _ in range(5):
+        tsan.access("t.solo")
+    assert tsan.report()["races"] == []
+
+
+def test_read_only_cross_thread_is_not_a_race(rt):
+    _in_threads(3, lambda i: tsan.access("t.ro", write=False))
+    assert tsan.report()["races"] == []
+
+
+def test_broken_locked_suffix_convention_is_caught(rt):
+    """The static rule trusts `_locked`-suffix helpers; the sanitizer
+    is the layer that catches a caller breaking the convention — the
+    access records an empty lockset and races once a second thread
+    arrives."""
+    lk = tsan.lock("t.outer")
+
+    def good(i):
+        with lk:
+            tsan.access("t.conv")
+
+    def bad(i):
+        tsan.access("t.conv")  # forgot the lock
+
+    _in_threads(2, good)
+    assert tsan.report()["races"] == []
+    _in_threads(1, bad)  # same (main-spawned) thread names differ
+    rep = tsan.report()
+    assert [r["site"] for r in rep["races"]] == ["t.conv"]
+
+
+def test_lock_order_inversion_detected(rt):
+    a, b = tsan.lock("t.A"), tsan.lock("t.B")
+    with a:
+        with b:
+            pass
+    assert tsan.report()["lock_inversions"] == []
+    with b:
+        with a:
+            pass
+    inv = tsan.report()["lock_inversions"]
+    assert len(inv) == 1 and inv[0]["locks"] == ["t.A", "t.B"]
+    with pytest.raises(AssertionError):
+        tsan.assert_clean()
+
+
+def test_condition_wait_releases_and_reacquires(rt):
+    cv = tsan.condition("t.cv")
+    hit = []
+
+    def waiter(i):
+        with cv:
+            tsan.access("t.cv_state")
+            cv.wait(timeout=5)
+            tsan.access("t.cv_state")
+            hit.append(i)
+
+    t = threading.Thread(target=waiter, args=(0,))
+    t.start()
+    import time
+
+    time.sleep(0.05)
+    with cv:
+        tsan.access("t.cv_state")
+        cv.notify_all()
+    t.join()
+    rep = tsan.report()
+    assert hit == [0]
+    assert rep["races"] == []
+    # both sides held the cv at every access
+    assert rep["accesses"]["t.cv_state"]["lockset"] == ["t.cv"]
+    assert rep["lock_inversions"] == []
+
+
+def test_report_write_and_reset(rt, tmp_path):
+    tsan.access("t.x")
+    path = tsan.write_report(str(tmp_path / "rep.json"))
+    rep = json.load(open(path))
+    assert rep["enabled"] and "t.x" in rep["accesses"]
+    tsan.reset()
+    assert tsan.report()["accesses"] == {}
+
+
+def test_emitted_telemetry_names_are_declared(rt):
+    from dbscan_tpu.obs import schema
+
+    _in_threads(2, lambda i: tsan.access("t.bad"))
+    obs.disable()
+    st = obs.enable()
+    try:
+        tsan.emit_telemetry()
+        counters = st.metrics.counters()
+        assert counters["tsan.races"] == 1
+        assert counters["tsan.accesses"] >= 2
+        for name in counters:
+            if name.startswith("tsan."):
+                assert schema.is_declared("counter", name), name
+    finally:
+        obs.disable()
+
+
+# --- regression tests for the races the static rules surfaced ----------
+
+
+def test_get_registry_is_one_object_across_threads(rt, monkeypatch):
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "dispatch#0:TRANSIENT")
+    faults.reset_registry()
+    got = []
+    barrier = threading.Barrier(8)
+
+    def grab(i):
+        barrier.wait()
+        got.append(faults.get_registry())
+
+    _in_threads(8, grab)
+    assert len({id(r) for r in got}) == 1
+    assert got[0].active
+    rep = tsan.report()
+    assert rep["races"] == []
+    assert rep["accesses"]["faults.registry_state"]["lockset"] == [
+        "faults.registry_state"
+    ]
+    faults.reset_registry()
+
+
+def test_native_lib_load_is_single_and_clean(rt):
+    from dbscan_tpu import _native
+
+    got = []
+    barrier = threading.Barrier(8)
+
+    def grab(i):
+        barrier.wait()
+        got.append(_native.lib())
+
+    _in_threads(8, grab)
+    assert len({id(x) for x in got}) == 1
+    assert tsan.report()["races"] == []
+
+
+def test_memory_available_latch_is_clean(rt):
+    from dbscan_tpu.obs import memory as obs_memory
+
+    obs_memory.reset_peak()
+    barrier = threading.Barrier(8)
+
+    def probe(i):
+        barrier.wait()
+        obs_memory.available()
+
+    _in_threads(8, probe)
+    assert tsan.report()["races"] == []
+    obs_memory.reset_peak()
+
+
+def test_fault_counters_concurrent_adds_exact_and_clean(rt):
+    snap = faults.counters.snapshot()
+    _in_threads(8, lambda i: [faults.counters.add("attempts")
+                              for _ in range(250)])
+    delta = faults.counters.delta(snap)
+    assert delta["attempts"] == 2000
+    rep = tsan.report()
+    assert rep["races"] == []
+    assert rep["accesses"]["faults.counters"]["lockset"] == [
+        "faults.counters"
+    ]
+
+
+# --- the static/dynamic contract --------------------------------------
+
+
+def _blobs(seed=0):
+    rng = np.random.default_rng(seed)
+    pts = np.concatenate(
+        [
+            rng.normal(c, 0.4, (s, 2))
+            for c, s in zip(
+                [(0, 0), (8, 8), (-7, 9), (9, -8)], [200, 500, 900, 400]
+            )
+        ]
+    )
+    rng.shuffle(pts)
+    return pts
+
+
+def test_worker_access_set_contained_in_static_model(rt, monkeypatch):
+    """THE acceptance contract: run a real pipelined banded train under
+    the sanitizer (obs enabled so the telemetry registries record too),
+    then assert every site the pull worker touched is in the static
+    worker-slice model. A new worker-side shared-state touch without a
+    model update fails here."""
+    from dbscan_tpu import lint as lint_mod
+    from dbscan_tpu.lint import races
+    from dbscan_tpu.lint.core import load_package, run_rules
+
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
+    faults.reset_registry()
+    pipe_mod.reset_engine()  # rebuild the engine under the live sanitizer
+    obs.disable()
+    obs.enable()
+    try:
+        out = train(
+            _blobs(),
+            eps=0.5,
+            min_points=5,
+            max_points_per_partition=256,
+            engine=Engine.ARCHERY,
+            neighbor_backend="banded",
+        )
+        assert out.stats["pull"]["jobs"] > 0, "run was not pipelined"
+    finally:
+        obs.disable()
+        pipe_mod.reset_engine()
+    observed = tsan.worker_sites()
+    assert observed, "worker recorded no tsan sites"
+    assert "pipeline.engine" in observed
+    pkg = load_package([PKG])
+    run_rules(pkg, (), lint_mod.RULES)
+    model = races.worker_tsan_sites(pkg)
+    assert observed <= model, (
+        f"worker touched sites outside the static model: "
+        f"{sorted(observed - model)} (model: {sorted(model)})"
+    )
+    tsan.assert_clean()
+
+
+def test_pipeline_and_fault_suites_race_free_under_tsan(tmp_path):
+    """Tier-1 rerun of the pipeline + fault suites with DBSCAN_TSAN=1:
+    the suites must pass AND the atexit JSON report must show zero
+    races and zero lock-order inversions."""
+    report = tmp_path / "tsan_report.json"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "DBSCAN_TSAN": "1",
+        "DBSCAN_TSAN_REPORT": str(report),
+    }
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest",
+            os.path.join(REPO, "tests", "test_pipeline.py"),
+            os.path.join(REPO, "tests", "test_faults.py"),
+            "-q", "-m", "not slow", "-p", "no:cacheprovider",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=420,
+    )
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    rep = json.loads(report.read_text())
+    assert rep["enabled"] is True
+    assert rep["races"] == [], rep["races"]
+    assert rep["lock_inversions"] == [], rep["lock_inversions"]
+    # the suites exercised real cross-thread traffic, not a no-op run
+    assert rep["naccesses"] > 100
+    worker_threads = {
+        t
+        for site in rep["accesses"].values()
+        for t in site["threads"]
+        if t.startswith("dbscan-pull")
+    }
+    assert worker_threads, "no pull-worker activity recorded"
