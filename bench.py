@@ -660,6 +660,7 @@ def _rep_obs_fields(delta: dict, dt: float) -> dict:
 
 def run_train(pts, maxpp, use_pallas=False, reps=1, **extra):
     from dbscan_tpu import Engine, obs, train
+    from dbscan_tpu.lint import shapecheck
 
     kw = dict(
         eps=EPS,
@@ -669,46 +670,79 @@ def run_train(pts, maxpp, use_pallas=False, reps=1, **extra):
         use_pallas=use_pallas,
     )
     kw.update(extra)
-    # compile warm-up on identical shapes, then best-of-reps timed runs:
-    # the TPU is reached over a shared tunnel whose transfer latency
-    # fluctuates by >3x between runs, so a single timing is a lottery —
-    # the minimum is the reproducible peak-throughput figure
-    train(pts, **kw)
-    # in-memory obs registry (no trace file unless DBSCAN_TRACE is set):
-    # per-rep counter deltas label each timed rep resident-hot/cold and
-    # split its upload wall from compute — the disabled-path hooks the
-    # pipeline already carries become live for pennies (a few hundred
-    # counter bumps per run, vs seconds-scale walls)
-    st = obs.enable()
-    # suspend the trace file during the timed loop: train() flushes the
-    # CUMULATIVE trace at every return, and serializing the warm-up +
-    # all prior reps' spans inside a timed rep would bias the very
-    # walls (and compute_s) this instrumentation exists to clean up
-    trace_path, st.trace_path = st.trace_path, None
-    dt = float("inf")
-    model = None
-    rep_obs: dict = {}
+    # graftshape cross-check rides every bench run: a pure-Python
+    # unification per dispatch (microseconds against the walls timed
+    # here) buys the hbm_pred_ratio gate — observed HBM peak vs the
+    # static model's predicted envelope — on backends with allocator
+    # stats. Enabled/disabled exception-safely (cli.py's obs discipline,
+    # PR 3): a raising warm-up or rep must not leave the checker on for
+    # callers that had it off.
+    sc_was_on = shapecheck.enabled()
+    shapecheck.enable()
     try:
-        for _ in range(max(1, reps)):
-            snap = obs.counters()
-            t0 = time.perf_counter()
-            m = train(pts, **kw)
-            dt_rep = time.perf_counter() - t0
-            if dt_rep < dt:  # keep the BEST rep's model: its phase split
-                model, dt = m, dt_rep  # describes the reported wall
-                rep_obs = _rep_obs_fields(obs.counters_delta(snap), dt_rep)
-                # pull-pipeline overlap share, straight from the rep's
-                # stats (pipeline.delta_totals is the ONE place the
-                # ratio is computed); absent on serial
-                # (DBSCAN_PULL_PIPELINE=0) reps, which therefore never
-                # gate against pipelined history
-                pull = m.stats.get("pull")
-                if pull and pull.get("busy_s", 0) > 0:
-                    rep_obs["pull_overlap_ratio"] = pull["overlap_ratio"]
+        # compile warm-up on identical shapes, then best-of-reps timed
+        # runs: the TPU is reached over a shared tunnel whose transfer
+        # latency fluctuates by >3x between runs, so a single timing is
+        # a lottery — the minimum is the reproducible peak-throughput
+        # figure
+        train(pts, **kw)
+        # in-memory obs registry (no trace file unless DBSCAN_TRACE is
+        # set): per-rep counter deltas label each timed rep
+        # resident-hot/cold and split its upload wall from compute —
+        # the disabled-path hooks the pipeline already carries become
+        # live for pennies (a few hundred counter bumps per run, vs
+        # seconds-scale walls)
+        st = obs.enable()
+        # suspend the trace file during the timed loop: train() flushes
+        # the CUMULATIVE trace at every return, and serializing the
+        # warm-up + all prior reps' spans inside a timed rep would bias
+        # the very walls (and compute_s) this instrumentation exists to
+        # clean up
+        trace_path, st.trace_path = st.trace_path, None
+        dt = float("inf")
+        model = None
+        rep_obs: dict = {}
+        try:
+            for _ in range(max(1, reps)):
+                snap = obs.counters()
+                t0 = time.perf_counter()
+                m = train(pts, **kw)
+                dt_rep = time.perf_counter() - t0
+                if dt_rep < dt:  # keep the BEST rep's model: its phase
+                    model, dt = m, dt_rep  # split describes the wall
+                    rep_obs = _rep_obs_fields(
+                        obs.counters_delta(snap), dt_rep
+                    )
+                    # pull-pipeline overlap share, straight from the
+                    # rep's stats (pipeline.delta_totals is the ONE
+                    # place the ratio is computed); absent on serial
+                    # (DBSCAN_PULL_PIPELINE=0) reps, which therefore
+                    # never gate against pipelined history
+                    pull = m.stats.get("pull")
+                    if pull and pull.get("busy_s", 0) > 0:
+                        rep_obs["pull_overlap_ratio"] = (
+                            pull["overlap_ratio"]
+                        )
+        finally:
+            st.trace_path = trace_path
+            obs.flush()  # one untimed write covering all reps
+        # observed HBM peak vs the static model's predicted envelope:
+        # the graftshape containment figure (obs/regress.py hard-gates
+        # it at <= 1.0 — an observed peak above the prediction means
+        # the static model stopped being an upper bound). Both sides
+        # come from THIS run's shapecheck runtime: the allocator's own
+        # peak_bytes_in_use is process-monotone, so a second run_train
+        # in the same process would inherit the first run's peak and
+        # spuriously break the cap. Absent on stat-less backends (CPU)
+        # and when no tracked dispatch ran.
+        predicted = shapecheck.predicted_peak()
+        observed = shapecheck.observed_peak()
+        if predicted and observed:
+            rep_obs["hbm_pred_ratio"] = round(observed / predicted, 4)
+        return model, dt, rep_obs
     finally:
-        st.trace_path = trace_path
-        obs.flush()  # one untimed write covering all reps
-    return model, dt, rep_obs
+        if not sc_was_on:
+            shapecheck.disable()
 
 
 def child_cpu(data_path: str, out_path: str, maxpp: int) -> None:
@@ -1185,6 +1219,9 @@ _COMPACT_SUFFIXES = (
     # pull-pipeline overlap share (parallel/pipeline.py): rides the
     # compact line so tail-only captures still feed the regress gate
     "_pull_overlap_ratio",
+    # graftshape containment figure (lint/shapecheck.py): observed HBM
+    # peak / statically predicted peak, hard-capped <= 1.0 by regress
+    "_hbm_pred_ratio",
 )
 
 
@@ -1206,6 +1243,7 @@ def _compact_summary(out: dict) -> dict:
             "ari_vs_cpu",
             "n_clusters",
             "pull_overlap_ratio",
+            "hbm_pred_ratio",
         )
         if k in out
     }

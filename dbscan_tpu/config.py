@@ -363,6 +363,22 @@ ENV_VARS = _env_table(
         "device faults attribute to the dispatch site.",
     ),
     EnvVar(
+        "DBSCAN_SHAPECHECK", "bool", False,
+        "graftshape runtime cross-check (lint/shapecheck.py): every "
+        "tracked dispatch validates its concrete arg shapes/dtypes "
+        "against the static symbolic model (lint/shapes.py "
+        "FAMILY_MODELS) and, where allocator stats exist, its HBM "
+        "growth against the static footprint prediction; violations "
+        "surface in shapecheck.report()/assert_clean().",
+    ),
+    EnvVar(
+        "DBSCAN_SHAPECHECK_REPORT", "str", None,
+        "With DBSCAN_SHAPECHECK=1: path receiving the cross-check's "
+        "JSON report at process exit (how the tier-1 rerun of the "
+        "distributed/streaming suites is asserted violation-free from "
+        "outside the process).",
+    ),
+    EnvVar(
         "DBSCAN_TSAN", "bool", False,
         "graftcheck runtime thread sanitizer (lint/tsan.py): registered "
         "locks and shared-state sites record cross-thread access "

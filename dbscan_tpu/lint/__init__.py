@@ -13,9 +13,8 @@ files)::
   ``host-sync-asarray``): implicit device->host syncs in functions
   reachable from a jit site (lint/callgraph.py builds the trace-time
   call graph);
-- **recompile** (``jit-in-loop`` / ``jit-scalar-arg`` /
-  ``dtype-drift``): patterns that mint fresh jit signatures or upcast
-  f32 kernels;
+- **recompile** (``jit-in-loop`` / ``jit-scalar-arg``): patterns that
+  mint fresh jit signatures;
 - **telemetry-schema** (``schema-counter`` / ``schema-gauge`` /
   ``schema-span`` / ``schema-event`` / ``schema-dynamic`` /
   ``schema-family``): every emitted telemetry name must be declared in
@@ -32,7 +31,16 @@ files)::
 - **collectives** (``collective-in-branch`` /
   ``collective-axis-undeclared`` / ``pull-in-collective`` — graftcheck,
   lint/collectives.py): divergence/axis/pull hazards inside
-  ``shard_map``/``pjit`` bodies, gating the multichip scale-out work.
+  ``shard_map``/``pjit`` bodies, gating the multichip scale-out work;
+- **shapes** (``shape-mismatch`` / ``shape-unratcheted-dim`` /
+  ``dtype-flow-drift`` / ``hbm-over-budget`` / ``shard-indivisible`` —
+  graftshape, lint/shapes.py over the lint/absint.py symbolic
+  interpreter): provable shape conflicts, data-dependent dims entering
+  jit without a ratchet, explicit-f64 value flow into kernels
+  (supersedes ``dtype-drift`` — kept as an alias, :data:`ALIASES`),
+  and the per-dispatch-family HBM envelope / shard-divisibility gates
+  — validated at runtime by the opt-in shape cross-check
+  (``DBSCAN_SHAPECHECK=1``, lint/shapecheck.py).
 
 Suppress a finding on its line with a REQUIRED reason::
 
@@ -63,8 +71,6 @@ RULES = {
     "jit-in-loop": "jax.jit(...) constructed inside a loop body",
     "jit-scalar-arg": "Python scalar/tuple literal passed positionally "
     "to a jit with no statics",
-    "dtype-drift": "float64 dtype literal in f32/bf16 kernel code "
-    "(ops/, spill_device.py)",
     "schema-counter": "emitted counter name not declared in obs/schema.py",
     "schema-gauge": "emitted gauge name not declared in obs/schema.py",
     "schema-span": "emitted span name not declared in obs/schema.py",
@@ -89,11 +95,36 @@ RULES = {
     "any Mesh in the linted set",
     "pull-in-collective": "host pull reachable from a shard_map/pjit "
     "collective region",
+    "shape-mismatch": "provable broadcast/concat/reshape/dot shape "
+    "conflict under symbolic dims",
+    "shape-unratcheted-dim": "data-dependent leading dim enters a jit "
+    "boundary without a shape ratchet",
+    "dtype-flow-drift": "explicit float64 reaches device code in "
+    "kernel files via value flow (supersedes dtype-drift)",
+    "hbm-over-budget": "worst-case dispatch footprint exceeds the "
+    "device HBM budget under the declared knobs",
+    "shard-indivisible": "shard_map input dim not divisible by its "
+    "mesh axis size",
     "suppress-no-reason": "graftlint suppression without a reason text",
     "suppress-unknown-rule": "graftlint suppression naming an unknown "
     "rule id",
     "parse-error": "file does not parse",
 }
+
+#: retired rule id -> its successor. An alias keeps old ``--rules``
+#: globs, baselines, and suppressions working: findings are emitted
+#: under the CANONICAL (new) id, but a glob/baseline/suppression
+#: naming the alias matches them too (cli.py / core.py consult this).
+ALIASES = {
+    # dtype-drift was the literal-only scan (PR 4); dtype-flow-drift is
+    # its flow-based superset (lint/shapes.py, this PR)
+    "dtype-drift": "dtype-flow-drift",
+}
+
+
+def canonical_rule(rule: str) -> str:
+    """Resolve a (possibly retired) rule id to its current one."""
+    return ALIASES.get(rule, rule)
 
 
 def _rule_fns():
@@ -103,6 +134,7 @@ def _rule_fns():
         hostsync,
         races,
         recompile,
+        shapes,
         telemetry,
     )
 
@@ -113,13 +145,14 @@ def _rule_fns():
         envvars.check,
         races.check,
         collectives.check,
+        shapes.check,
     )
 
 
 def lint_paths(paths: Iterable[str]) -> Tuple[List[Finding], int]:
     """Lint files/directories; returns (findings, files_scanned)."""
     pkg = load_package(paths)
-    findings = run_rules(pkg, _rule_fns(), RULES)
+    findings = run_rules(pkg, _rule_fns(), RULES, ALIASES)
     # drop exact duplicates (a nested reachable function is visited via
     # its parent's body walk too)
     seen = set()

@@ -1,0 +1,1323 @@
+"""graftshape abstract-interpretation core: symbolic dims, dtypes, and
+the byte algebra the shape/HBM rules and the runtime cross-check share.
+
+The reference paper's whole partitioning discipline is a memory budget
+(rectangles sized so no executor exceeds ``maxPointsPerPartition``);
+our port enforces it dynamically — padding ratchets, RESOURCE_EXHAUSTED
+budget halving — which means a shape or HBM contract violation is
+discovered by a recompile storm or an OOM on hardware. This module is
+the static half of closing that gap: a small symbolic domain
+
+- **dims** (:class:`E`): linear-ish integer expressions ``k + sum(c *
+  prod(syms))`` over named :class:`Sym` dimensions. Symbols carry a
+  ``source`` tag (``"data"`` for values derived from array contents /
+  lengths, ``"ratchet"`` for values that passed through a sanctioned
+  padding function) — the tag the ``shape-unratcheted-dim`` rule reads.
+- **values** (:class:`Arr` / :class:`IntVal` / :class:`Lit` /
+  :class:`Tup` / :data:`UNKNOWN`): abstract results of expressions,
+  with numpy-vs-jnp provenance (``Arr.device``) and explicit-float64
+  provenance (``Arr.explicit_f64``) for the dtype-flow rule.
+- **an interpreter** (:class:`Interp`): one abstract pass over a
+  function body that models the jnp/np surface the kernels actually
+  use (creation ops, broadcasting, concat/stack, dot, reshape,
+  reductions, astype, ``.shape`` flow) and reports provable conflicts
+  through a findings callback. Conservative by construction: a dim it
+  cannot prove concrete unifies with anything, so every emitted
+  finding is a real arithmetic impossibility, not a modeling guess.
+- **unification + byte algebra**: :func:`unify_dim` binds model
+  symbols against observed concrete dims (solving single-unknown
+  monomials like ``512*NB`` against an observed ``1024``), and
+  :func:`nbytes` / :meth:`E.evaluate` turn symbolic shapes into the
+  footprint predictions ``lint/shapes.py`` gates statically and
+  ``lint/shapecheck.py`` asserts at runtime.
+
+Stdlib-only on purpose (ast + math): the linter and the runtime
+cross-check import this without touching jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: set True by tests so interpreter bugs surface as test failures
+#: instead of being swallowed by the per-function guard in shapes.py
+STRICT = False
+
+_sym_counter = itertools.count()
+
+
+class Sym:
+    """One symbolic dimension. ``source`` tags provenance: ``"data"``
+    (derived from array contents or a data-dependent count — the dims
+    the ratchet rule watches), ``"ratchet"`` (passed through a
+    sanctioned padding function), or None (model/parameter symbols)."""
+
+    __slots__ = ("name", "source")
+
+    def __init__(self, name: str, source: Optional[str] = None):
+        self.name = name
+        self.source = source
+
+    def __repr__(self):
+        return self.name
+
+
+def fresh(prefix: str = "d", source: Optional[str] = None) -> Sym:
+    return Sym(f"{prefix}{next(_sym_counter)}", source)
+
+
+class E:
+    """Normalized integer expression: ``k + sum(coeff * prod(syms))``.
+    ``terms`` is a tuple of ``(coeff, (Sym, ...))`` with the symbol
+    tuple sorted by name; construction folds constants and merges like
+    terms, so structural equality is semantic equality for this form."""
+
+    __slots__ = ("k", "terms")
+
+    def __init__(self, k: int = 0, terms: Tuple = ()):
+        self.k = int(k)
+        self.terms = terms
+
+    # --- constructors -------------------------------------------------
+
+    @staticmethod
+    def of(x) -> "E":
+        if isinstance(x, E):
+            return x
+        if isinstance(x, Sym):
+            return E(0, ((1, (x,)),))
+        if isinstance(x, (int, bool)):
+            return E(int(x))
+        raise TypeError(f"not a dim: {x!r}")
+
+    @staticmethod
+    def _norm(k: int, raw: List[Tuple[int, Tuple[Sym, ...]]]) -> "E":
+        acc: Dict[Tuple[Sym, ...], int] = {}
+        for c, syms in raw:
+            if c == 0:
+                continue
+            key = tuple(sorted(syms, key=lambda s: (s.name, id(s))))
+            acc[key] = acc.get(key, 0) + c
+        terms = tuple(
+            (c, syms)
+            for syms, c in sorted(
+                acc.items(), key=lambda kv: [s.name for s in kv[0]]
+            )
+            if c != 0
+        )
+        return E(k, terms)
+
+    def __add__(self, other) -> "E":
+        o = E.of(other)
+        return E._norm(self.k + o.k, list(self.terms) + list(o.terms))
+
+    def __mul__(self, other) -> "E":
+        o = E.of(other)
+        raw: List[Tuple[int, Tuple[Sym, ...]]] = []
+        k = self.k * o.k
+        for c, syms in self.terms:
+            if o.k:
+                raw.append((c * o.k, syms))
+        for c, syms in o.terms:
+            if self.k:
+                raw.append((c * self.k, syms))
+        for c1, s1 in self.terms:
+            for c2, s2 in o.terms:
+                raw.append((c1 * c2, s1 + s2))
+        return E._norm(k, raw)
+
+    def __sub__(self, other) -> "E":
+        return self + (E.of(other) * E(-1))
+
+    # --- queries ------------------------------------------------------
+
+    def const(self) -> Optional[int]:
+        """The concrete value when the expression has no symbols."""
+        return self.k if not self.terms else None
+
+    def syms(self) -> List[Sym]:
+        out = []
+        for _c, syms in self.terms:
+            for s in syms:
+                if s not in out:
+                    out.append(s)
+        return out
+
+    def evaluate(self, env: Dict[str, int]) -> Optional[int]:
+        """Concrete value under ``env`` (symbol name -> int); None when
+        any symbol is unbound."""
+        total = self.k
+        for c, syms in self.terms:
+            p = c
+            for s in syms:
+                v = env.get(s.name)
+                if v is None:
+                    return None
+                p *= v
+            total += p
+        return total
+
+    def substitute(self, env: Dict[str, int]) -> "E":
+        """Partial evaluation: bound symbols fold away."""
+        out = E(self.k)
+        for c, syms in self.terms:
+            coeff = c
+            rest: List[Sym] = []
+            for s in syms:
+                v = env.get(s.name)
+                if v is None:
+                    rest.append(s)
+                else:
+                    coeff *= v
+            out = out + (E(coeff) if not rest else E(0, ((coeff, tuple(rest)),)))
+        return out
+
+    def render(self) -> str:
+        parts = []
+        for c, syms in self.terms:
+            body = "*".join(s.name for s in syms)
+            parts.append(body if c == 1 else f"{c}*{body}")
+        if self.k or not parts:
+            parts.append(str(self.k))
+        return " + ".join(parts)
+
+    def __repr__(self):
+        return f"E({self.render()})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, E)
+            and self.k == other.k
+            and self.terms == other.terms
+        )
+
+    def __hash__(self):
+        return hash((self.k, self.terms))
+
+
+def dim_of(x) -> E:
+    """ints / Syms / Es as a normalized :class:`E`."""
+    return E.of(x)
+
+
+def unify_dim(model, observed: int, subst: Dict[str, int]) -> bool:
+    """Unify a model dim against an observed concrete dim, extending
+    ``subst`` (symbol name -> int) in place.
+
+    Returns False only on a PROVABLE conflict: a fully-bound model dim
+    that differs from the observation, or a single-unknown monomial
+    (``512*NB`` vs an observed 1000) with no nonnegative integer
+    solution — the shard-block-division case. A model dim with 2+
+    unbound symbols cannot be refuted by one observation and unifies.
+    """
+    e = E.of(model).substitute(subst)
+    c = e.const()
+    if c is not None:
+        return c == int(observed)
+    free = e.syms()
+    if len(free) == 1 and len(e.terms) == 1 and len(e.terms[0][1]) == 1:
+        coeff = e.terms[0][0]
+        rem = int(observed) - e.k
+        if coeff == 0 or rem % coeff != 0 or rem // coeff < 0:
+            return False
+        subst[free[0].name] = rem // coeff
+        return True
+    return True  # under-determined: not refutable from one dim
+
+
+# --- dtypes ------------------------------------------------------------
+
+DTYPE_BYTES = {
+    "bool": 1, "i8": 1, "u8": 1, "i16": 2, "u16": 2,
+    "bf16": 2, "f16": 2, "i32": 4, "u32": 4, "f32": 4,
+    "i64": 8, "u64": 8, "f64": 8,
+}
+
+_DTYPE_NAMES = {
+    "float64": "f64", "double": "f64", "float32": "f32", "float16": "f16",
+    "bfloat16": "bf16", "int64": "i64", "int32": "i32", "int16": "i16",
+    "int8": "i8", "uint64": "u64", "uint32": "u32", "uint16": "u16",
+    "uint8": "u8", "bool": "bool", "bool_": "bool",
+}
+
+FLOATS = ("bf16", "f16", "f32", "f64")
+INTS = ("i8", "u8", "i16", "u16", "i32", "u32", "i64", "u64")
+
+
+def dtype_name(raw: str) -> Optional[str]:
+    """Canonical short dtype name for a numpy/jnp spelling."""
+    return _DTYPE_NAMES.get(str(raw))
+
+
+def promote(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    """Simplified jax promotion: higher float wins, floats beat ints,
+    ints beat bool; unknown stays unknown."""
+    if a is None or b is None:
+        return None
+    for lat in (("f64", "f32", "f16", "bf16"),):
+        for d in lat:
+            if a == d or b == d:
+                return d
+    if a in INTS or b in INTS:
+        ia = INTS.index(a) if a in INTS else -1
+        ib = INTS.index(b) if b in INTS else -1
+        return INTS[max(ia, ib)]
+    return a
+
+
+def nbytes(shape: Tuple, dtype: Optional[str]) -> Optional[E]:
+    """Symbolic byte count of an array; None when rank or dtype is
+    unknown."""
+    if shape is None:
+        return None
+    size = DTYPE_BYTES.get(dtype or "", None)
+    if size is None:
+        return None
+    total = E(size)
+    for d in shape:
+        total = total * E.of(d)
+    return total
+
+
+# --- abstract values ---------------------------------------------------
+
+
+class _Unknown:
+    def __repr__(self):
+        return "UNKNOWN"
+
+
+UNKNOWN = _Unknown()
+
+
+class Arr:
+    """Abstract array: ``shape`` is a tuple of dims (:class:`E`) or
+    None when the rank itself is unknown; ``device`` tags jnp-produced
+    values; ``explicit_f64`` marks values whose float64-ness was
+    EXPLICITLY requested (np.float64 ctor, dtype=float64, astype) —
+    the only f64 the dtype-flow rule reports (numpy's silent f64
+    defaults are host idiom, not drift)."""
+
+    __slots__ = ("shape", "dtype", "device", "explicit_f64", "weak")
+
+    def __init__(
+        self, shape=None, dtype=None, device=False,
+        explicit_f64=False, weak=False,
+    ):
+        self.shape = (
+            None if shape is None else tuple(E.of(d) for d in shape)
+        )
+        self.dtype = dtype
+        self.device = device
+        self.explicit_f64 = explicit_f64
+        self.weak = weak
+
+    def rank(self) -> Optional[int]:
+        return None if self.shape is None else len(self.shape)
+
+    def with_(self, **kw) -> "Arr":
+        out = Arr(
+            self.shape, self.dtype, self.device, self.explicit_f64,
+            self.weak,
+        )
+        for k, v in kw.items():
+            setattr(out, k, v)
+        return out
+
+    def __repr__(self):
+        dims = (
+            "?" if self.shape is None
+            else ",".join(d.render() for d in self.shape)
+        )
+        return f"Arr[{dims}]{self.dtype or '?'}"
+
+
+class IntVal:
+    """A Python int whose VALUE is a (possibly symbolic) dimension —
+    the bridge that lets ``n = len(x); jnp.zeros((n, 4))`` carry x's
+    leading dim (and its data/ratchet provenance) into a shape."""
+
+    __slots__ = ("e",)
+
+    def __init__(self, e):
+        self.e = E.of(e)
+
+    def __repr__(self):
+        return f"IntVal({self.e.render()})"
+
+
+class Lit:
+    """A Python literal (str/float/bool/None) — ints use IntVal."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __repr__(self):
+        return f"Lit({self.v!r})"
+
+
+class Tup:
+    __slots__ = ("items",)
+
+    def __init__(self, items):
+        self.items = list(items)
+
+    def __repr__(self):
+        return f"Tup({self.items})"
+
+
+class DTypeVal:
+    """A dtype OBJECT (``jnp.float64``, ``np.int32``) flowing as a
+    value — what astype/dtype= arguments carry."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self):
+        return f"DTypeVal({self.name})"
+
+
+def broadcast(a: Tuple, b: Tuple) -> Tuple[Optional[Tuple], Optional[Tuple]]:
+    """Numpy broadcasting over two dim tuples. Returns (result_shape,
+    conflict) where conflict is the offending (dim_a, dim_b) pair when
+    two CONCRETE dims disagree and neither is 1; symbolic dims unify
+    (the longer/other dim wins for the result)."""
+    out: List[E] = []
+    ra, rb = list(a)[::-1], list(b)[::-1]
+    for i in range(max(len(ra), len(rb))):
+        da = ra[i] if i < len(ra) else E(1)
+        db = rb[i] if i < len(rb) else E(1)
+        ca, cb = da.const(), db.const()
+        if ca == 1:
+            out.append(db)
+        elif cb == 1:
+            out.append(da)
+        elif ca is not None and cb is not None and ca != cb:
+            return None, (da, db)
+        else:
+            # equal constants, or at least one symbolic: keep the more
+            # informative dim (a concrete one if present)
+            out.append(da if ca is not None else db)
+    return tuple(out[::-1]), None
+
+
+# --- the interpreter ---------------------------------------------------
+
+_NP_MODULES = ("numpy",)
+_JNP_MODULES = ("jax.numpy",)
+
+_CREATION = ("zeros", "ones", "empty", "full")
+_REDUCERS = (
+    "sum", "max", "min", "mean", "prod", "any", "all", "argmax",
+    "argmin", "count_nonzero",
+)
+_DATA_DEPENDENT = (
+    # calls whose RESULT LENGTH depends on array contents: the dims the
+    # shape ratchet exists to pin before they reach a jit signature
+    "flatnonzero", "nonzero", "unique", "where_single", "bincount",
+    "searchsorted_none",
+)
+
+
+class Interp:
+    """One abstract pass over a function body.
+
+    Parameters:
+      emit: ``emit(rule, node, message)`` findings sink.
+      module_aliases: import-alias map (``{"jnp": "jax.numpy"}``) from
+        the enclosing module, used to classify receivers as numpy/jnp;
+        the conventional names work without it.
+      intrinsics: ``{callable_terminal_name: handler(interp, node,
+        args, kwargs) -> AVal}`` — how shapes.py injects the repo's
+        idioms (``_ratchet``, ``shard_host_array``, ...).
+      kernel: True inside kernel code (ops/, spill_device.py): enables
+        the dtype-flow-drift checks.
+    """
+
+    def __init__(
+        self,
+        emit: Callable,
+        module_aliases: Optional[Dict[str, str]] = None,
+        intrinsics: Optional[Dict[str, Callable]] = None,
+        kernel: bool = False,
+        on_call: Optional[Callable] = None,
+    ):
+        self.emit = emit
+        self.aliases = module_aliases or {}
+        self.intrinsics = intrinsics or {}
+        self.kernel = kernel
+        #: optional ``on_call(interp, node, name, args, kwargs)`` —
+        #: shapes.py's window onto every evaluated call (jit-boundary
+        #: ratchet checks, HBM checks on constructed arrays)
+        self.on_call = on_call
+        self.env: Dict[str, object] = {}
+        self._flagged: set = set()  # (rule, lineno) dedup within one run
+
+    # --- receiver classification --------------------------------------
+
+    def _mod_kind(self, name: str) -> Optional[str]:
+        """'np' / 'jnp' / None for a receiver name."""
+        target = self.aliases.get(name, "")
+        if target in _JNP_MODULES or name == "jnp":
+            return "jnp"
+        if target in _NP_MODULES or name in ("np", "numpy"):
+            return "np"
+        return None
+
+    # --- entry points --------------------------------------------------
+
+    def run(self, fn_node: ast.AST, params: Dict[str, object]) -> None:
+        """Interpret one function body with ``params`` pre-bound.
+        Lambda bodies (a bare expression) evaluate directly."""
+        self.env = dict(params)
+        body = getattr(fn_node, "body", None)
+        if isinstance(body, list):
+            for stmt in body:
+                self.stmt(stmt)
+        elif body is not None:
+            self.expr(body)
+
+    # --- statements -----------------------------------------------------
+
+    def stmt(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Assign):
+            val = self.expr(node.value)
+            for t in node.targets:
+                self._bind(t, val)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._bind(node.target, self.expr(node.value))
+        elif isinstance(node, ast.AugAssign):
+            cur = (
+                self.env.get(node.target.id, UNKNOWN)
+                if isinstance(node.target, ast.Name)
+                else UNKNOWN
+            )
+            new = self._binop(cur, self.expr(node.value), node.op, node)
+            self._bind(node.target, new)
+        elif isinstance(node, ast.Expr):
+            self.expr(node.value)
+        elif isinstance(node, ast.Return):
+            if node.value is not None:
+                self.expr(node.value)
+        elif isinstance(node, (ast.If, ast.While)):
+            self.expr(node.test)
+            for s in node.body:
+                self.stmt(s)
+            for s in getattr(node, "orelse", []):
+                self.stmt(s)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            self.expr(node.iter)
+            self._bind(node.target, UNKNOWN)
+            for s in node.body + node.orelse:
+                self.stmt(s)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self.expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, UNKNOWN)
+            for s in node.body:
+                self.stmt(s)
+        elif isinstance(node, ast.Try):
+            for s in (
+                node.body
+                + node.orelse
+                + node.finalbody
+                + [s for h in node.handlers for s in h.body]
+            ):
+                self.stmt(s)
+        # nested defs/classes are their own scopes: skipped on purpose
+
+    def _bind(self, target: ast.AST, val) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = val
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            items = (
+                val.items
+                if isinstance(val, Tup) and len(val.items) == len(target.elts)
+                else [UNKNOWN] * len(target.elts)
+            )
+            for t, v in zip(target.elts, items):
+                self._bind(t, v)
+        # attribute/subscript targets: no store tracking
+
+    # --- expressions ----------------------------------------------------
+
+    def expr(self, node: ast.AST):
+        try:
+            return self._expr(node)
+        except Exception:
+            if STRICT:
+                raise
+            return UNKNOWN
+
+    def _expr(self, node: ast.AST):
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return Lit(node.value)
+            if isinstance(node.value, int):
+                return IntVal(node.value)
+            return Lit(node.value)
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, UNKNOWN)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return Tup([self.expr(e) for e in node.elts])
+        if isinstance(node, ast.Attribute):
+            return self._attribute(node)
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node)
+        if isinstance(node, ast.BinOp):
+            return self._binop(
+                self.expr(node.left), self.expr(node.right), node.op, node
+            )
+        if isinstance(node, ast.UnaryOp):
+            v = self.expr(node.operand)
+            if isinstance(v, IntVal) and isinstance(node.op, ast.USub):
+                return IntVal(v.e * E(-1))
+            return v
+        if isinstance(node, ast.Compare):
+            for c in [node.left] + list(node.comparators):
+                self.expr(c)
+            left = self.expr(node.left)
+            if isinstance(left, Arr):
+                return left.with_(dtype="bool", explicit_f64=False)
+            return UNKNOWN
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self.expr(v)
+            return UNKNOWN
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.IfExp):
+            self.expr(node.test)
+            a = self.expr(node.body)
+            b = self.expr(node.orelse)
+            return a if repr(a) == repr(b) else UNKNOWN
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            # evaluate the element once with loop targets unknown, so
+            # calls inside comprehensions are still modeled
+            for gen in node.generators:
+                self.expr(gen.iter)
+                self._bind(gen.target, UNKNOWN)
+            self.expr(node.elt)
+            return UNKNOWN
+        if isinstance(node, ast.Lambda):
+            return UNKNOWN
+        if isinstance(node, ast.JoinedStr):
+            return Lit("")
+        return UNKNOWN
+
+    # --- attributes -----------------------------------------------------
+
+    def _attribute(self, node: ast.Attribute):
+        attr = node.attr
+        if isinstance(node.value, ast.Name):
+            kind = self._mod_kind(node.value.id)
+            if kind is not None:
+                dn = dtype_name(attr)
+                if dn is not None:
+                    return DTypeVal(dn)
+                return UNKNOWN
+        base = self.expr(node.value)
+        if isinstance(base, Arr):
+            if attr == "shape":
+                if base.shape is None:
+                    return UNKNOWN
+                return Tup([IntVal(d) for d in base.shape])
+            if attr == "ndim":
+                return (
+                    UNKNOWN if base.shape is None
+                    else IntVal(len(base.shape))
+                )
+            if attr == "size":
+                if base.shape is None:
+                    return UNKNOWN
+                total = E(1)
+                for d in base.shape:
+                    total = total * d
+                return IntVal(total)
+            if attr == "dtype":
+                return (
+                    DTypeVal(base.dtype) if base.dtype else UNKNOWN
+                )
+            if attr == "T":
+                return base.with_(
+                    shape=(
+                        None if base.shape is None
+                        else tuple(reversed(base.shape))
+                    )
+                )
+        return UNKNOWN
+
+    # --- subscripts -----------------------------------------------------
+
+    def _subscript(self, node: ast.Subscript):
+        base = self.expr(node.value)
+        if isinstance(base, Tup):
+            idx = self.expr(node.slice)
+            if isinstance(idx, IntVal):
+                c = idx.e.const()
+                if c is not None and -len(base.items) <= c < len(base.items):
+                    return base.items[c]
+            return UNKNOWN
+        if isinstance(base, Arr) and base.shape is not None:
+            sl = node.slice
+            idx = self.expr(sl)
+            if isinstance(sl, ast.Slice):
+                return self._slice1(base, sl)
+            if isinstance(idx, IntVal):
+                # integer index drops the leading dim
+                return base.with_(shape=base.shape[1:])
+            if isinstance(idx, Arr) and idx.shape is not None:
+                if idx.dtype == "bool":
+                    # boolean mask: data-dependent result length
+                    return base.with_(
+                        shape=(E.of(fresh("m", "data")),) + base.shape[1:]
+                    )
+                return base.with_(shape=idx.shape + base.shape[1:])
+            if isinstance(sl, ast.Tuple):
+                shape = list(base.shape)
+                out: List[E] = []
+                i = 0
+                for el in sl.elts:
+                    if isinstance(el, ast.Slice):
+                        if i < len(shape):
+                            d = self._slice_dim(shape[i], el)
+                            out.append(d)
+                        i += 1
+                    elif (
+                        isinstance(el, ast.Constant) and el.value is None
+                    ):
+                        out.append(E(1))
+                    elif isinstance(el, ast.Constant) and el.value is Ellipsis:
+                        # ellipsis: give up on precise tracking
+                        return base.with_(shape=None)
+                    else:
+                        ev = self.expr(el)
+                        if isinstance(ev, Arr) and ev.shape is not None:
+                            out.extend(ev.shape)
+                        i += 1
+                out.extend(shape[i:])
+                return base.with_(shape=tuple(out))
+            return base.with_(shape=None)
+        return UNKNOWN
+
+    def _slice_dim(self, dim: E, sl: ast.Slice) -> E:
+        if sl.lower is None and sl.upper is None:
+            return dim
+        if sl.lower is None and sl.step is None:
+            up = self.expr(sl.upper)
+            if isinstance(up, IntVal):
+                return up.e  # x[:n] -> n (clamp ignored: upper bound)
+        return E.of(fresh("s"))
+
+    def _slice1(self, base: Arr, sl: ast.Slice) -> Arr:
+        return base.with_(
+            shape=(self._slice_dim(base.shape[0], sl),) + base.shape[1:]
+        )
+
+    # --- operators ------------------------------------------------------
+
+    def _binop(self, left, right, op, node):
+        if isinstance(left, IntVal) and isinstance(right, IntVal):
+            if isinstance(op, ast.Add):
+                return IntVal(left.e + right.e)
+            if isinstance(op, ast.Sub):
+                return IntVal(left.e - right.e)
+            if isinstance(op, ast.Mult):
+                return IntVal(left.e * right.e)
+            if isinstance(op, ast.FloorDiv):
+                lc, rc = left.e.const(), right.e.const()
+                if lc is not None and rc not in (None, 0):
+                    return IntVal(lc // rc)
+                return IntVal(E.of(fresh("q", self._prov(left.e))))
+            if isinstance(
+                op, (ast.Mod, ast.Pow, ast.LShift, ast.RShift,
+                     ast.BitOr, ast.BitAnd, ast.BitXor)
+            ):
+                lc, rc = left.e.const(), right.e.const()
+                if lc is not None and rc is not None:
+                    try:
+                        ops = {
+                            ast.Mod: lambda a, b: a % b,
+                            ast.Pow: lambda a, b: a**b,
+                            ast.LShift: lambda a, b: a << b,
+                            ast.RShift: lambda a, b: a >> b,
+                            ast.BitOr: lambda a, b: a | b,
+                            ast.BitAnd: lambda a, b: a & b,
+                            ast.BitXor: lambda a, b: a ^ b,
+                        }
+                        return IntVal(ops[type(op)](lc, rc))
+                    except (ZeroDivisionError, OverflowError):
+                        return UNKNOWN
+            return UNKNOWN
+        if isinstance(left, Arr) or isinstance(right, Arr):
+            a = left if isinstance(left, Arr) else right
+            b = right if isinstance(left, Arr) else left
+            if isinstance(b, Arr):
+                shape = None
+                if a.shape is not None and b.shape is not None:
+                    shape, conflict = broadcast(a.shape, b.shape)
+                    if conflict is not None:
+                        self._emit(
+                            "shape-mismatch",
+                            node,
+                            "operands cannot broadcast: dim "
+                            f"{conflict[0].render()} vs "
+                            f"{conflict[1].render()} (shapes "
+                            f"[{','.join(d.render() for d in a.shape)}] "
+                            f"and "
+                            f"[{','.join(d.render() for d in b.shape)}])",
+                        )
+                        shape = None
+                self._dtype_flow(node, a, b)
+                return Arr(
+                    shape,
+                    promote(a.dtype, b.dtype),
+                    a.device or b.device,
+                    a.explicit_f64 or b.explicit_f64,
+                )
+            # array op scalar
+            self._dtype_flow(node, a, b)
+            dt = a.dtype
+            exp = a.explicit_f64
+            if self._is_explicit_f64(b):
+                dt, exp = "f64", True
+            return Arr(a.shape, dt, a.device, exp)
+        return UNKNOWN
+
+    @staticmethod
+    def _prov(e: E) -> Optional[str]:
+        for s in e.syms():
+            if s.source == "data":
+                return "data"
+        for s in e.syms():
+            if s.source == "ratchet":
+                return "ratchet"
+        return None
+
+    # --- dtype flow -----------------------------------------------------
+
+    @staticmethod
+    def _is_explicit_f64(v) -> bool:
+        # the explicit_f64 flag is maintained as an invariant: set only
+        # by explicit-f64 sources, cleared when a cast/comparison moves
+        # the value off f64 — so the flag alone decides, even when the
+        # dtype itself got lost through an unmodeled op
+        if isinstance(v, Arr):
+            return v.explicit_f64
+        if isinstance(v, DTypeVal):
+            return v.name == "f64"
+        return False
+
+    def _dtype_flow(self, node, a, b) -> None:
+        """A device array meeting an EXPLICIT f64 value in kernel code:
+        the flow half of dtype-flow-drift (the call-boundary half lives
+        in :meth:`_call`)."""
+        if not self.kernel:
+            return
+        dev = (isinstance(a, Arr) and a.device) or (
+            isinstance(b, Arr) and b.device
+        )
+        if not dev:
+            return
+        for v in (a, b):
+            if self._is_explicit_f64(v) and not (
+                isinstance(v, Arr) and v.device
+            ):
+                self._emit(
+                    "dtype-flow-drift",
+                    node,
+                    "explicit float64 value flows into device "
+                    "arithmetic: the kernels are f32/bf16 "
+                    "(config.Precision); a float64 operand upcasts or "
+                    "retraces — cast with the configured dtype",
+                )
+
+    def _emit(self, rule: str, node: ast.AST, msg: str) -> None:
+        key = (rule, getattr(node, "lineno", 0))
+        if key in self._flagged:
+            return
+        self._flagged.add(key)
+        self.emit(rule, node, msg)
+
+    # --- calls ----------------------------------------------------------
+
+    def _shape_from(self, v) -> Optional[Tuple]:
+        if isinstance(v, Tup):
+            dims = []
+            for it in v.items:
+                if isinstance(it, IntVal):
+                    dims.append(it.e)
+                else:
+                    dims.append(E.of(fresh("d")))
+            return tuple(dims)
+        if isinstance(v, IntVal):
+            return (v.e,)
+        return None
+
+    def _dtype_from(self, v) -> Tuple[Optional[str], bool]:
+        """(dtype, explicit) from a dtype-position argument."""
+        if isinstance(v, DTypeVal):
+            return v.name, True
+        if isinstance(v, Lit) and isinstance(v.v, str):
+            dn = dtype_name(v.v)
+            return dn, dn is not None
+        return None, False
+
+    def _call(self, node: ast.Call):
+        f = node.func
+        args = [self.expr(a) for a in node.args]
+        kwargs = {kw.arg: self.expr(kw.value) for kw in node.keywords if kw.arg}
+        for kw in node.keywords:
+            if kw.arg is None:
+                self.expr(kw.value)
+
+        # terminal callee name + receiver classification
+        if isinstance(f, ast.Attribute):
+            name = f.attr
+            recv_kind = (
+                self._mod_kind(f.value.id)
+                if isinstance(f.value, ast.Name)
+                else None
+            )
+            recv_val = None if recv_kind else self.expr(f.value)
+        elif isinstance(f, ast.Name):
+            name = f.id
+            recv_kind = None
+            recv_val = None
+            bound = self.env.get(name)
+            # a name bound to a modeled callable object (shapes.py's
+            # JitFn): give it the call
+            handler = getattr(bound, "absint_call", None)
+            if handler is not None:
+                return handler(self, node, args, kwargs)
+        else:
+            return UNKNOWN
+
+        if self.on_call is not None:
+            self.on_call(self, node, name, args, kwargs)
+        if name in self.intrinsics:
+            return self.intrinsics[name](self, node, args, kwargs)
+
+        # builtins ------------------------------------------------------
+        if recv_kind is None and recv_val is None:
+            if name == "len" and args:
+                a = args[0]
+                if isinstance(a, Arr) and a.shape:
+                    return IntVal(a.shape[0])
+                if isinstance(a, Tup):
+                    return IntVal(len(a.items))
+                return IntVal(E.of(fresh("n", "data")))
+            if name in ("int", "round") and args:
+                a = args[0]
+                if isinstance(a, IntVal):
+                    return a
+                return IntVal(E.of(fresh("n", self._arg_prov(a))))
+            if name in ("min", "max") and len(args) >= 2:
+                if all(isinstance(a, IntVal) for a in args):
+                    cs = [a.e.const() for a in args]
+                    if all(c is not None for c in cs):
+                        return IntVal(min(cs) if name == "min" else max(cs))
+                    return IntVal(
+                        E.of(fresh("n", self._prov(args[0].e) or
+                                   self._prov(args[1].e)))
+                    )
+            return UNKNOWN
+
+        # array methods -------------------------------------------------
+        if recv_val is not None:
+            if isinstance(recv_val, Arr):
+                return self._array_method(node, recv_val, name, args, kwargs)
+            return UNKNOWN
+
+        # np./jnp. functions --------------------------------------------
+        device = recv_kind == "jnp"
+        if self.kernel and device:
+            # call-boundary half of dtype-flow-drift: explicit f64
+            # VALUES or dtype literals entering a jnp call
+            for v in list(args) + list(kwargs.values()):
+                if self._is_explicit_f64(v) or (
+                    isinstance(v, Lit) and v.v == "float64"
+                ):
+                    self._emit(
+                        "dtype-flow-drift",
+                        node,
+                        f"float64 reaches device op jnp.{name}: the "
+                        "kernels are f32/bf16 (config.Precision); a "
+                        "float64 input upcasts or retraces — use the "
+                        "configured dtype",
+                    )
+                    break
+        return self._np_call(node, name, device, args, kwargs)
+
+    @staticmethod
+    def _arg_prov(a) -> Optional[str]:
+        if isinstance(a, IntVal):
+            return Interp._prov(a.e)
+        if isinstance(a, Arr):
+            return "data"
+        return None
+
+    def _array_method(self, node, arr: Arr, name, args, kwargs):
+        if name == "astype" and args:
+            dn, explicit = self._dtype_from(args[0])
+            if (
+                self.kernel
+                and arr.device
+                and dn == "f64"
+                and explicit
+            ):
+                self._emit(
+                    "dtype-flow-drift",
+                    node,
+                    "astype(float64) on a device array in kernel code: "
+                    "the kernels are f32/bf16 (config.Precision) — use "
+                    "the configured dtype",
+                )
+            return arr.with_(
+                dtype=dn or arr.dtype,
+                explicit_f64=(dn == "f64" and explicit),
+            )
+        if name == "reshape":
+            shape_arg = (
+                args[0]
+                if len(args) == 1 and isinstance(args[0], (Tup, IntVal))
+                else Tup(args)
+            )
+            return self._reshape(node, arr, shape_arg)
+        if name in _REDUCERS:
+            return self._reduce(arr, args, kwargs, name)
+        if name in ("copy", "block_until_ready", "clip", "round"):
+            return arr
+        if name == "item":
+            return UNKNOWN
+        if name in ("tolist", "flatten", "ravel"):
+            if name in ("flatten", "ravel") and arr.shape is not None:
+                total = E(1)
+                for d in arr.shape:
+                    total = total * d
+                return arr.with_(shape=(total,))
+            return UNKNOWN
+        return UNKNOWN
+
+    def _reshape(self, node, arr: Arr, shape_val):
+        target = self._shape_from(shape_val)
+        if target is None:
+            return arr.with_(shape=None)
+        # resolve a single -1 when the source size is fully concrete
+        dims = list(target)
+        holes = [
+            i for i, d in enumerate(dims)
+            if d.const() is not None and d.const() == -1
+        ]
+        if holes and arr.shape is not None:
+            total = E(1)
+            for d in arr.shape:
+                total = total * d
+            tc = total.const()
+            rest = E(1)
+            for i, d in enumerate(dims):
+                if i != holes[0]:
+                    rest = rest * d
+            rc = rest.const()
+            if len(holes) == 1 and tc is not None and rc not in (None, 0):
+                if tc % rc == 0:
+                    dims[holes[0]] = E(tc // rc)
+                else:
+                    self._emit(
+                        "shape-mismatch",
+                        node,
+                        f"reshape cannot fold {tc} elements into "
+                        f"blocks of {rc}",
+                    )
+                    return arr.with_(shape=None)
+            else:
+                dims[holes[0]] = E.of(fresh("r"))
+        elif holes:
+            dims[holes[0]] = E.of(fresh("r"))
+        # fully-concrete sanity check
+        if arr.shape is not None and not holes:
+            total = E(1)
+            for d in arr.shape:
+                total = total * d
+            tgt = E(1)
+            for d in dims:
+                tgt = tgt * d
+            tc, gc = total.const(), tgt.const()
+            if tc is not None and gc is not None and tc != gc:
+                self._emit(
+                    "shape-mismatch",
+                    node,
+                    f"reshape of {tc} elements to a {gc}-element shape",
+                )
+                return arr.with_(shape=None)
+        return arr.with_(shape=tuple(dims))
+
+    def _reduce(self, arr: Arr, args, kwargs, name):
+        int_out = name in ("argmax", "argmin", "count_nonzero")
+        bool_out = name in ("any", "all")
+        dtype = "i64" if int_out else ("bool" if bool_out else arr.dtype)
+        axis = kwargs.get("axis")
+        if axis is None and args:
+            axis = args[0] if isinstance(args[0], IntVal) else None
+        if axis is None:
+            # full reduction: a scalar whose VALUE is data-dependent
+            if name in ("sum", "count_nonzero", "argmax", "argmin") and (
+                arr.dtype in INTS or arr.dtype == "bool" or True
+            ):
+                return IntVal(E.of(fresh("n", "data")))
+            return Arr((), dtype, arr.device, arr.explicit_f64)
+        if (
+            isinstance(axis, IntVal)
+            and axis.e.const() is not None
+            and arr.shape is not None
+        ):
+            ax = axis.e.const()
+            if -len(arr.shape) <= ax < len(arr.shape):
+                shape = list(arr.shape)
+                shape.pop(ax)
+                return Arr(
+                    tuple(shape), dtype, arr.device, arr.explicit_f64
+                )
+        return Arr(None, dtype, arr.device, arr.explicit_f64)
+
+    def _np_call(self, node, name, device, args, kwargs):
+        exp64 = False
+        dt, explicit = self._dtype_from(kwargs.get("dtype", UNKNOWN))
+        if dt is None:
+            # positional dtype (np.zeros(shape, np.float32))
+            for a in args[1:]:
+                dt, explicit = self._dtype_from(a)
+                if dt is not None:
+                    break
+        exp64 = dt == "f64" and explicit
+
+        if name in _CREATION or name in ("zeros_like", "ones_like",
+                                         "full_like", "empty_like"):
+            if name.endswith("_like") and args and isinstance(args[0], Arr):
+                src = args[0]
+                return Arr(
+                    src.shape, dt or src.dtype, device, exp64
+                )
+            shape = self._shape_from(args[0]) if args else None
+            if dt is None:
+                dt = "f32" if device else "f64"
+                explicit = False
+            return Arr(shape, dt, device, exp64)
+        if name == "arange":
+            if args and isinstance(args[0], IntVal) and len(args) == 1:
+                return Arr((args[0].e,), dt or "i64", device, exp64)
+            return Arr((E.of(fresh("n")),), dt or "i64", device, exp64)
+        if name in ("asarray", "array", "ascontiguousarray"):
+            if args and isinstance(args[0], Arr):
+                src = args[0]
+                return Arr(
+                    src.shape,
+                    dt or src.dtype,
+                    device or src.device,
+                    exp64 or (src.explicit_f64 and dt is None),
+                )
+            if args and isinstance(args[0], Tup):
+                return Arr(
+                    (E(len(args[0].items)),), dt, device, exp64
+                )
+            return Arr(None, dt, device, exp64)
+        if name in ("float64", "float32", "float16", "bfloat16", "int32",
+                    "int64", "int16", "int8", "uint8", "uint16", "uint32",
+                    "uint64"):
+            dn = dtype_name(name)
+            return Arr((), dn, device, dn == "f64")
+        if name in ("concatenate", "stack", "hstack", "vstack",
+                    "column_stack"):
+            return self._concat(node, name, args, kwargs, device)
+        if name in ("dot", "matmul"):
+            return self._dot(node, args, device)
+        if name == "where" and len(args) == 3:
+            shape = None
+            arrs = [a for a in args if isinstance(a, Arr)]
+            if len(arrs) >= 2:
+                cur = arrs[0]
+                for other in arrs[1:]:
+                    if cur.shape is None or other.shape is None:
+                        cur = cur.with_(shape=None)
+                        continue
+                    s, conflict = broadcast(cur.shape, other.shape)
+                    if conflict is not None:
+                        self._emit(
+                            "shape-mismatch",
+                            node,
+                            "where operands cannot broadcast: dim "
+                            f"{conflict[0].render()} vs "
+                            f"{conflict[1].render()}",
+                        )
+                        s = None
+                    cur = cur.with_(shape=s)
+                shape = cur.shape
+            dts = [a.dtype for a in arrs[1:] if a.dtype] or [None]
+            out_dt = dts[0]
+            for d in dts[1:]:
+                out_dt = promote(out_dt, d)
+            return Arr(shape, out_dt, device,
+                       any(a.explicit_f64 for a in arrs[1:]))
+        if name == "where" and len(args) == 1:
+            return Arr((E.of(fresh("m", "data")),), "i64", device)
+        if name in ("flatnonzero",):
+            return Arr((E.of(fresh("m", "data")),), "i64", device)
+        if name in ("nonzero",):
+            return UNKNOWN
+        if name in ("unique", "bincount"):
+            return Arr((E.of(fresh("u", "data")),), "i64", device)
+        if name in ("broadcast_to",) and len(args) >= 2:
+            shape = self._shape_from(args[1])
+            src = args[0] if isinstance(args[0], Arr) else None
+            return Arr(shape, src.dtype if src else None, device,
+                       src.explicit_f64 if src else False)
+        if name in ("reshape",) and len(args) >= 2 and isinstance(
+            args[0], Arr
+        ):
+            return self._reshape(node, args[0], args[1])
+        if name in _REDUCERS and args and isinstance(args[0], Arr):
+            return self._reduce(args[0], args[1:], kwargs, name)
+        if name in ("abs", "sqrt", "exp", "log", "floor", "ceil", "clip",
+                    "maximum", "minimum", "mod", "power", "square", "sign",
+                    "logical_and", "logical_or", "logical_not", "isfinite",
+                    "sin", "cos", "tan", "arcsin", "arctan2", "radians"):
+            arrs = [a for a in args if isinstance(a, Arr)]
+            if len(arrs) == 2 and name in ("maximum", "minimum", "mod",
+                                           "power", "arctan2",
+                                           "logical_and", "logical_or"):
+                return self._binop(arrs[0], arrs[1], ast.Add(), node)
+            if arrs:
+                a = arrs[0]
+                if name in ("logical_and", "logical_or", "logical_not",
+                            "isfinite"):
+                    return a.with_(dtype="bool", explicit_f64=False)
+                return a
+            return UNKNOWN
+        if name in ("repeat", "tile", "pad", "cumsum", "sort", "argsort",
+                    "take", "searchsorted", "einsum", "unpackbits",
+                    "packbits", "lexsort", "split"):
+            # modeled weakly on purpose: result shapes are data/arg
+            # dependent in ways the rules do not need
+            src = next((a for a in args if isinstance(a, Arr)), None)
+            if name == "cumsum" and src is not None:
+                return src
+            if src is not None:
+                return src.with_(shape=None)
+            return UNKNOWN
+        return UNKNOWN
+
+    def _concat(self, node, name, args, kwargs, device):
+        seq = args[0] if args else None
+        if not isinstance(seq, Tup):
+            return Arr(None, None, device)
+        arrs = [a for a in seq.items if isinstance(a, Arr)]
+        if len(arrs) != len(seq.items) or not arrs:
+            return Arr(None, None, device)
+        axis_v = kwargs.get("axis") or (
+            args[1] if len(args) > 1 else None
+        )
+        axis = 0
+        if isinstance(axis_v, IntVal) and axis_v.e.const() is not None:
+            axis = axis_v.e.const()
+        dt = arrs[0].dtype
+        exp = any(a.explicit_f64 for a in arrs)
+        for a in arrs[1:]:
+            dt = promote(dt, a.dtype)
+        if name == "stack":
+            base = arrs[0].shape
+            for a in arrs[1:]:
+                if base is None or a.shape is None:
+                    base = None
+                    break
+                for d1, d2 in zip(base, a.shape):
+                    c1, c2 = d1.const(), d2.const()
+                    if c1 is not None and c2 is not None and c1 != c2:
+                        self._emit(
+                            "shape-mismatch",
+                            node,
+                            f"stack of unequal shapes: dim {c1} vs {c2}",
+                        )
+                        base = None
+                        break
+                if base is None:
+                    break
+            if base is None:
+                return Arr(None, dt, device, exp)
+            return Arr((E(len(arrs)),) + tuple(base), dt, device, exp)
+        # concatenate family
+        shapes = [a.shape for a in arrs]
+        if any(s is None for s in shapes):
+            return Arr(None, dt, device, exp)
+        rank = len(shapes[0])
+        if any(len(s) != rank for s in shapes[1:]):
+            self._emit(
+                "shape-mismatch",
+                node,
+                "concatenate of arrays with different ranks: "
+                + " vs ".join(str(len(s)) for s in shapes),
+            )
+            return Arr(None, dt, device, exp)
+        if not (-rank <= axis < rank):
+            return Arr(None, dt, device, exp)
+        out: List[E] = []
+        for i in range(rank):
+            if i == axis % rank:
+                total = E(0)
+                for s in shapes:
+                    total = total + s[i]
+                out.append(total)
+                continue
+            dim = shapes[0][i]
+            for s in shapes[1:]:
+                c1, c2 = dim.const(), s[i].const()
+                if c1 is not None and c2 is not None and c1 != c2:
+                    self._emit(
+                        "shape-mismatch",
+                        node,
+                        f"concatenate: off-axis dim {c1} vs {c2} "
+                        f"(axis {axis})",
+                    )
+                    return Arr(None, dt, device, exp)
+                if c1 is None:
+                    dim = s[i]
+            out.append(dim)
+        return Arr(tuple(out), dt, device, exp)
+
+    def _dot(self, node, args, device):
+        arrs = [a for a in args if isinstance(a, Arr)]
+        if len(arrs) != 2:
+            return Arr(None, None, device)
+        a, b = arrs
+        if a.shape is None or b.shape is None or not a.shape or not b.shape:
+            return Arr(None, promote(a.dtype, b.dtype), device)
+        ka = a.shape[-1]
+        kb = b.shape[-2] if len(b.shape) >= 2 else b.shape[0]
+        c1, c2 = ka.const(), kb.const()
+        if c1 is not None and c2 is not None and c1 != c2:
+            self._emit(
+                "shape-mismatch",
+                node,
+                f"dot/matmul contraction mismatch: {c1} vs {c2} "
+                f"(shapes [{','.join(d.render() for d in a.shape)}] "
+                f"x [{','.join(d.render() for d in b.shape)}])",
+            )
+            return Arr(None, promote(a.dtype, b.dtype), device)
+        out = tuple(a.shape[:-1]) + (
+            tuple(b.shape[:-2]) + (b.shape[-1],)
+            if len(b.shape) >= 2
+            else ()
+        )
+        return Arr(
+            out, promote(a.dtype, b.dtype), device,
+            a.explicit_f64 or b.explicit_f64,
+        )

@@ -25,6 +25,15 @@ so unrelated edits don't resurrect them, occurrence-counted so a new
 duplicate of a baselined finding still fails) and exits by the
 remainder: the incremental-adoption gate. Create/refresh the file with ``--write-baseline PATH`` (writes
 the CURRENT post-filter findings and exits 0).
+
+Retired rule ids (``lint.ALIASES``, e.g. ``dtype-drift`` ->
+``dtype-flow-drift``) stay valid everywhere a rule id appears: a glob
+or baseline naming the old id matches the successor's findings, so
+renaming a rule never silently un-gates a CI pipeline.
+
+``--format sarif`` emits SARIF 2.1.0 (one run, one result per finding)
+so the 0/1/2 exit contract can surface as inline annotations in CI
+code-scanning UIs; exit semantics are identical to text/json.
 """
 
 from __future__ import annotations
@@ -53,8 +62,11 @@ def _norm_path(path: str) -> str:
 
 def _baseline_key(f) -> tuple:
     # line/col excluded deliberately: a baseline must survive unrelated
-    # edits above the finding; rule+normalized path+message is stable
-    return (f.rule, _norm_path(f.path), f.message)
+    # edits above the finding; rule+normalized path+message is stable.
+    # Rule ids canonicalize through lint.ALIASES; rows RECORDED under a
+    # retired id additionally match message-agnostically (_read_baseline
+    # wildcards their message), since the successor's messages differ.
+    return (lint_mod.canonical_rule(f.rule), _norm_path(f.path), f.message)
 
 
 def _write_baseline(path: str, findings) -> None:
@@ -78,16 +90,87 @@ def _read_baseline(path: str) -> dict:
     """Baseline as a MULTISET (key -> count): one baselined occurrence
     must not suppress newly introduced duplicates of the same finding
     in the same file (their keys are identical by design — line numbers
-    are excluded for edit-stability)."""
+    are excluded for edit-stability).
+
+    Rows recorded under a RETIRED rule id (lint.ALIASES) key on
+    rule+path with the message WILDCARDED: the successor rule emits
+    different message text by design, so exact-message matching would
+    resurrect every baselined old-rule finding the moment the rename
+    ships. Rows under current ids keep the exact rule+path+message
+    multiset semantics."""
     with open(path, encoding="utf-8") as fh:
         payload = json.load(fh)
     if not isinstance(payload, dict) or "findings" not in payload:
         raise ValueError("not a graftlint baseline (missing 'findings')")
     out: dict = {}
     for row in payload["findings"]:
-        key = (row["rule"], _norm_path(row["path"]), row["message"])
+        retired = row["rule"] in lint_mod.ALIASES
+        key = (
+            lint_mod.canonical_rule(row["rule"]),
+            _norm_path(row["path"]),
+            None if retired else row["message"],
+        )
         out[key] = out.get(key, 0) + 1
     return out
+
+
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _sarif(findings, n_files) -> dict:
+    """Findings as a SARIF 2.1.0 log: one run, the rule catalog limited
+    to rules that actually fired (keeps the document small), one result
+    per finding with a 1-based column region."""
+    fired = sorted({f.rule for f in findings})
+    return {
+        "version": "2.1.0",
+        "$schema": _SARIF_SCHEMA,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "informationUri":
+                            "https://github.com/tpu-dbscan/tpu-dbscan",
+                        "rules": [
+                            {
+                                "id": r,
+                                "shortDescription": {
+                                    "text": lint_mod.RULES.get(r, r)
+                                },
+                            }
+                            for r in fired
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "warning",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {
+                                        "uri": _norm_path(f.path)
+                                    },
+                                    "region": {
+                                        "startLine": max(1, f.line),
+                                        "startColumn": f.col + 1,
+                                    },
+                                }
+                            }
+                        ],
+                    }
+                    for f in findings
+                ],
+                "properties": {"filesScanned": n_files},
+            }
+        ],
+    }
 
 
 def main(argv=None) -> int:
@@ -111,9 +194,10 @@ def main(argv=None) -> int:
     )
     p.add_argument(
         "--format",
-        choices=("text", "json"),
+        choices=("text", "json", "sarif"),
         default="text",
-        help="output format (default text: path:line:col: rule message)",
+        help="output format (default text: path:line:col: rule "
+        "message; sarif emits SARIF 2.1.0 for CI inline annotations)",
     )
     p.add_argument(
         "--rules",
@@ -147,23 +231,41 @@ def main(argv=None) -> int:
         "config.ENV_VARS and exit (paste it over the PARITY section "
         "when the registry changes)",
     )
+    p.add_argument(
+        "--shape-table",
+        action="store_true",
+        help="print the PARITY.md per-dispatch-family predicted-"
+        "footprint table generated from lint/shapes.py FAMILY_MODELS "
+        "and the live budget knobs, and exit",
+    )
     args = p.parse_args(argv)
 
     if args.list_rules:
         for rule in sorted(lint_mod.RULES):
             print(f"{rule:<28} {lint_mod.RULES[rule]}")
+        for alias in sorted(lint_mod.ALIASES):
+            print(
+                f"{alias:<28} (alias of {lint_mod.ALIASES[alias]})"
+            )
         return 0
     if args.env_table:
         from dbscan_tpu.config import parity_env_table
 
         print(parity_env_table())
         return 0
+    if args.shape_table:
+        from dbscan_tpu.lint.shapes import shape_table
 
+        print(shape_table())
+        return 0
+
+    # a glob matches a rule through its current id OR a retired alias
+    known_ids = set(lint_mod.RULES) | set(lint_mod.ALIASES)
     globs = None
     if args.rules:
         globs = [g.strip() for g in args.rules.split(",") if g.strip()]
         for g in globs:
-            if not fnmatch.filter(lint_mod.RULES, g):
+            if not fnmatch.filter(known_ids, g):
                 print(
                     f"graftlint: --rules glob {g!r} matches no known "
                     "rule (see --list-rules)",
@@ -184,10 +286,21 @@ def main(argv=None) -> int:
         return 2
 
     if globs is not None:
+        # aliases of a finding's rule count as its names for matching
+        def _names_of(rule: str):
+            yield rule
+            for alias, target in lint_mod.ALIASES.items():
+                if target == rule:
+                    yield alias
+
         findings = [
             f
             for f in findings
-            if any(fnmatch.fnmatch(f.rule, g) for g in globs)
+            if any(
+                fnmatch.fnmatch(n, g)
+                for g in globs
+                for n in _names_of(f.rule)
+            )
         ]
 
     if args.write_baseline:
@@ -222,8 +335,12 @@ def main(argv=None) -> int:
         kept = []
         for f in findings:
             key = _baseline_key(f)
+            wild = (key[0], key[1], None)  # retired-id rows, see above
             if known.get(key, 0) > 0:
                 known[key] -= 1
+                n_baselined += 1
+            elif known.get(wild, 0) > 0:
+                known[wild] -= 1
                 n_baselined += 1
             else:
                 kept.append(f)
@@ -239,6 +356,8 @@ def main(argv=None) -> int:
                 }
             )
         )
+    elif args.format == "sarif":
+        print(json.dumps(_sarif(findings, n_files)))
     else:
         for f in findings:
             print(f.render())

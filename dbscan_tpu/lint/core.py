@@ -154,19 +154,27 @@ def load_package(paths: Iterable[str]) -> Package:
 
 
 def apply_suppressions(
-    pkg: Package, findings: List[Finding], known_rules: Iterable[str]
+    pkg: Package,
+    findings: List[Finding],
+    known_rules: Iterable[str],
+    aliases: Optional[Dict[str, str]] = None,
 ) -> List[Finding]:
     """Drop findings covered by a same-line suppression WITH a reason;
     emit ``suppress-no-reason`` / ``suppress-unknown-rule`` findings for
-    malformed suppressions."""
-    known = set(known_rules)
+    malformed suppressions. ``aliases`` (retired rule id -> successor)
+    lets a suppression naming the OLD id keep silencing the successor's
+    findings, and keeps the old id "known"."""
+    aliases = aliases or {}
+    known = set(known_rules) | set(aliases)
     out: List[Finding] = []
     for f in findings:
         src = pkg.by_path(f.path)
         sup = src.suppressions.get(f.line) if src else None
-        if sup and (f.rule in sup.rules or "all" in sup.rules):
-            if sup.reason:
-                continue  # properly suppressed
+        if sup:
+            sup_rules = {aliases.get(r, r) for r in sup.rules}
+            if f.rule in sup_rules or "all" in sup_rules:
+                if sup.reason:
+                    continue  # properly suppressed
         out.append(f)
     for src in pkg.files:
         for sup in src.suppressions.values():
@@ -195,7 +203,9 @@ def apply_suppressions(
     return out
 
 
-def run_rules(pkg: Package, rule_fns, known_rules) -> List[Finding]:
+def run_rules(
+    pkg: Package, rule_fns, known_rules, aliases=None
+) -> List[Finding]:
     """Run every rule family over the package, then apply suppressions
     and sort (path, line, col, rule). Unparseable files surface as
     ``parse-error`` findings rather than crashing the run."""
@@ -217,6 +227,6 @@ def run_rules(pkg: Package, rule_fns, known_rules) -> List[Finding]:
     pkg.callgraph = cg.build(pkg)
     for fn in rule_fns:
         findings.extend(fn(pkg))
-    findings = apply_suppressions(pkg, findings, known_rules)
+    findings = apply_suppressions(pkg, findings, known_rules, aliases)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

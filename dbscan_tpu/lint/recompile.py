@@ -9,13 +9,12 @@
   Python scalar or tuple literal positionally while the jit declared
   no static_argnums/static_argnames — tuples fail at trace, scalars
   retrace per dtype and silently defeat weak-type reuse when mixed.
-- ``dtype-drift``: float64 dtype literals in kernel code (``ops/`` and
-  ``parallel/spill_device.py``): ``jnp.float64`` references, string
-  ``"float64"`` dtypes flowing into ``jnp.*``/``astype`` calls. The
-  kernels are f32/bf16 by design (config.Precision); a float64 constant
-  either upcasts a kernel (2x HBM, MXU off the fast path) or retraces
-  against the f32 signature. Host-side ``np.*`` float64 (grid
-  coordinates, merge precision) is exempt.
+
+The old literal-only ``dtype-drift`` rule lived here until graftshape:
+``lint/shapes.py``'s flow-based ``dtype-flow-drift`` supersedes it
+(``lint.ALIASES`` keeps the old id working in globs/baselines/
+suppressions). :func:`_kernel_file` stays here as the shared
+definition of "kernel code" both families scope to.
 """
 
 from __future__ import annotations
@@ -120,58 +119,10 @@ def _check_scalar_args(pkg: Package, findings: List[Finding]) -> None:
                     )
 
 
-def _check_dtype_drift(mod, findings: List[Finding]) -> None:
-    if not _kernel_file(mod.path):
-        return
-
-    def flag(node, what):
-        findings.append(
-            Finding(
-                "dtype-drift",
-                mod.path,
-                node.lineno,
-                node.col_offset,
-                f"{what} in kernel code: the device kernels are f32/bf16 "
-                "(config.Precision); a float64 constant upcasts or "
-                "retraces the kernel — use the configured dtype",
-            )
-        )
-
-    for node in ast.walk(mod.tree):
-        if (
-            isinstance(node, ast.Attribute)
-            and node.attr == "float64"
-            and isinstance(node.value, ast.Name)
-            and node.value.id in ("jnp",)
-        ):
-            flag(node, "jnp.float64")
-        elif isinstance(node, ast.Call):
-            f = node.func
-            is_jnp_call = (
-                isinstance(f, ast.Attribute)
-                and isinstance(f.value, ast.Name)
-                and f.value.id == "jnp"
-            )
-            is_astype = isinstance(f, ast.Attribute) and f.attr == "astype"
-            if not (is_jnp_call or is_astype):
-                continue
-            for arg in list(node.args) + [k.value for k in node.keywords]:
-                if isinstance(arg, ast.Constant) and arg.value == "float64":
-                    flag(arg, '"float64" dtype literal')
-                elif (
-                    isinstance(arg, ast.Attribute)
-                    and arg.attr == "float64"
-                    and isinstance(arg.value, ast.Name)
-                    and arg.value.id in ("np", "numpy", "jnp")
-                ):
-                    flag(arg, f"{arg.value.id}.float64 dtype")
-
-
 def check(pkg: Package) -> List[Finding]:
     findings: List[Finding] = []
     cg = pkg.callgraph
     for mod in cg.modules.values():
         _check_jit_in_loop(mod, findings)
-        _check_dtype_drift(mod, findings)
     _check_scalar_args(pkg, findings)
     return findings

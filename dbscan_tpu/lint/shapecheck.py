@@ -1,0 +1,381 @@
+"""graftshape runtime cross-check: validate the static shape/HBM model
+against a real run.
+
+The static rules (``lint/shapes.py``) reason about shapes symbolically;
+this module watches the same contract AT RUNTIME so the two check each
+other: when ``DBSCAN_SHAPECHECK=1`` (or a test calls :func:`enable`),
+every ``obs/compile.py::tracked_call`` dispatch records its concrete
+argument shapes/dtypes and asserts
+
+- **model instantiation**: the observed shapes unify with the family's
+  declared symbolic model (``shapes.FAMILY_MODELS``) — rank, dim
+  bindings consistent across arguments (the same ``P`` everywhere),
+  dtype classes, and the declared constraints (``B == 512*NB`` shard-
+  block division). A dispatch whose real shapes the model cannot
+  explain is a violation: either the kernel changed (update the model
+  — that IS the registration step) or a shape bug shipped;
+- **HBM containment**: on backends with allocator stats (TPU/GPU), the
+  per-call growth of ``bytes_in_use`` across the dispatch must stay
+  within the model's predicted footprint (exact input bytes + the
+  family's symbolic overhead evaluated at the observed dims). On
+  stat-less backends (CPU) the memory half degrades to a no-op, the
+  shape half still runs — which is what the tier-1 suite exercises.
+
+Overhead contract (same discipline as tsan/obs): the DISABLED path is
+one module-global truthiness check per dispatch; enabling costs a pure-
+Python unification per tracked call (microseconds against millisecond-
+scale dispatches) plus, where available, two allocator-stat probes.
+
+Reports: :func:`report` (dict), :func:`assert_clean` (raises on any
+violation), :func:`predicted_peak` (the static envelope bench.py turns
+into the ``hbm_pred_ratio`` gate), and — under
+``DBSCAN_SHAPECHECK_REPORT=path`` — an atexit JSON dump, which is how
+the tier-1 rerun of the distributed + streaming suites asserts an
+empty violation report from outside the process. :func:`emit_telemetry`
+publishes the declared ``shapecheck.*`` counters/events when obs is
+enabled.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+from typing import List, Optional, Tuple
+
+from dbscan_tpu import config
+from dbscan_tpu.lint import shapes
+
+_rt: Optional["ShapecheckRuntime"] = None
+
+
+def spec_of(x):
+    """Observed spec of one dispatch argument: ``(shape, dtype)`` for
+    arrays, a list of specs for tuples/lists (the postpass chunk-group
+    idiom), ``("scalar", type name)`` markers otherwise."""
+    if isinstance(x, (tuple, list)):
+        return [spec_of(el) for el in x]
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        from dbscan_tpu.lint.absint import dtype_name
+
+        return (tuple(int(d) for d in shape), dtype_name(str(dtype)))
+    return ("scalar", type(x).__name__)
+
+
+def _bytes_in_use() -> Optional[int]:
+    """Summed live allocator bytes, or None on stat-less backends.
+    Routed through obs/memory's probe (which latches availability, so
+    CPU pays one probe per process)."""
+    from dbscan_tpu.obs import memory as obs_memory
+
+    if not obs_memory.available():
+        return None
+    stats = obs_memory.device_memory_stats()
+    if not stats:
+        return None
+    return sum(int(s.get("bytes_in_use", 0)) for s in stats.values())
+
+
+class ShapecheckRuntime:
+    """Process-global cross-check state (see module docstring)."""
+
+    def __init__(self):
+        # a raw lock on purpose (like tsan's _mu): the runtime is
+        # itself diagnostic machinery, invisible to the sanitizer
+        self._mu = threading.Lock()
+        self.checks = 0
+        self.violations: List[dict] = []
+        self.sites: dict = {}  # family -> per-site record
+        self._pred_peak: Optional[int] = None
+        #: max bytes_in_use observed at THIS runtime's dispatch-boundary
+        #: probes — per-run by construction (a fresh runtime resets it),
+        #: unlike the allocator's process-monotone peak_bytes_in_use,
+        #: so bench's observed/predicted ratio compares like with like
+        self._obs_peak: Optional[int] = None
+        # telemetry watermark: emit_telemetry publishes deltas
+        self._emitted = {"checks": 0, "violations": 0}
+
+    # --- per-dispatch hooks --------------------------------------------
+
+    def observe_call(self, family: str, args: Tuple) -> dict:
+        """Pre-call hook: validate shapes against the static model and
+        snapshot memory. Returns the handle :meth:`settle_call` takes."""
+        specs = [spec_of(a) for a in args]
+        subst, problems = shapes.validate_args(family, specs)
+        model = shapes.FAMILY_MODELS.get(family)
+        predicted = None
+        if model is not None and not problems:
+            exact_in = self._exact_bytes(specs)
+            overhead = model.overhead_bytes(subst)
+            if exact_in is not None and overhead is not None:
+                predicted = exact_in + overhead
+        pre = _bytes_in_use()
+        with self._mu:
+            self.checks += 1
+            rec = self.sites.setdefault(
+                family,
+                {"calls": 0, "violations": 0, "shapes": [],
+                 "predicted_bytes_max": None, "observed_delta_max": None},
+            )
+            rec["calls"] += 1
+            sig = json.dumps(specs, default=str)
+            if sig not in rec["shapes"] and len(rec["shapes"]) < 8:
+                rec["shapes"].append(sig)
+            if pre is not None:
+                if self._obs_peak is None or pre > self._obs_peak:
+                    self._obs_peak = pre
+            if predicted is not None:
+                rec["predicted_bytes_max"] = max(
+                    rec["predicted_bytes_max"] or 0, predicted
+                )
+                if pre is not None:
+                    peak = pre + predicted
+                    if self._pred_peak is None or peak > self._pred_peak:
+                        self._pred_peak = peak
+            for p in problems:
+                rec["violations"] += 1
+                self.violations.append(
+                    {"kind": "shape-model", "family": family,
+                     "detail": p, "subst": dict(subst)}
+                )
+        if problems:
+            _emit_violations(family, problems)
+        return {"family": family, "pre": pre, "predicted": predicted}
+
+    @staticmethod
+    def _exact_bytes(specs) -> Optional[int]:
+        from dbscan_tpu.lint.absint import DTYPE_BYTES
+
+        total = 0
+        for s in specs:
+            if isinstance(s, list):
+                sub = ShapecheckRuntime._exact_bytes(s)
+                if sub is None:
+                    return None
+                total += sub
+            elif isinstance(s, tuple) and len(s) == 2 and isinstance(
+                s[0], tuple
+            ):
+                shape, dtype = s
+                size = DTYPE_BYTES.get(dtype or "", None)
+                if size is None:
+                    return None
+                n = size
+                for d in shape:
+                    n *= int(d)
+                total += n
+            # scalar markers cost nothing
+        return total
+
+    def settle_call(self, handle: dict) -> None:
+        """Post-call hook: the allocator growth across the dispatch
+        must stay within the predicted footprint (skipped where stats
+        or a prediction are unavailable)."""
+        pre = handle.get("pre")
+        predicted = handle.get("predicted")
+        if pre is None:
+            return
+        post = _bytes_in_use()
+        if post is None:
+            return
+        delta = post - pre
+        family = handle["family"]
+        with self._mu:
+            if self._obs_peak is None or post > self._obs_peak:
+                self._obs_peak = post
+            rec = self.sites.get(family)
+            if rec is not None:
+                rec["observed_delta_max"] = max(
+                    rec["observed_delta_max"] or 0, delta
+                )
+            over = (
+                rec is not None
+                and predicted is not None
+                and delta > predicted
+            )
+            if over:
+                rec["violations"] += 1
+                self.violations.append(
+                    {
+                        "kind": "hbm-over-prediction",
+                        "family": family,
+                        "detail": (
+                            f"allocator grew {delta} bytes across the "
+                            f"dispatch, static prediction {predicted}"
+                        ),
+                        "observed_delta": delta,
+                        "predicted": predicted,
+                    }
+                )
+        if over:
+            _emit_violations(
+                family,
+                [f"observed HBM delta {delta} > predicted {predicted}"],
+            )
+
+    # --- reporting ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "enabled": True,
+                "checks": self.checks,
+                "sites": {
+                    fam: dict(rec) for fam, rec in sorted(self.sites.items())
+                },
+                "violations": list(self.violations),
+                "predicted_peak_bytes": self._pred_peak,
+                "observed_peak_bytes": self._obs_peak,
+            }
+
+
+def _empty_report() -> dict:
+    return {
+        "enabled": False,
+        "checks": 0,
+        "sites": {},
+        "violations": [],
+        "predicted_peak_bytes": None,
+        "observed_peak_bytes": None,
+    }
+
+
+def _emit_violations(family: str, problems: List[str]) -> None:
+    """Publish violation events immediately when obs is live (counters
+    ride :func:`emit_telemetry` deltas so totals stay exact)."""
+    from dbscan_tpu import obs
+
+    if not obs.active():
+        return
+    for p in problems:
+        obs.event("shapecheck.violation", family=family, detail=p)
+
+
+# --- public API --------------------------------------------------------
+
+
+def runtime() -> Optional[ShapecheckRuntime]:
+    """The live runtime, or None when disabled — the ONE check
+    tracked_call pays on the disabled path."""
+    return _rt
+
+
+def enabled() -> bool:
+    return _rt is not None
+
+
+def enable() -> ShapecheckRuntime:
+    """Turn the cross-check on (idempotent); returns the runtime."""
+    global _rt
+    if _rt is None:
+        _rt = ShapecheckRuntime()
+    return _rt
+
+
+def disable() -> None:
+    global _rt
+    _rt = None
+
+
+def reset() -> None:
+    """Fresh runtime if enabled (drop recorded state, keep recording)."""
+    global _rt
+    if _rt is not None:
+        _rt = ShapecheckRuntime()
+
+
+def report() -> dict:
+    """The current cross-check report (a disabled checker reports
+    ``enabled: False`` with empty tables)."""
+    rt = _rt
+    if rt is None:
+        return _empty_report()
+    return rt.snapshot()
+
+
+def assert_clean() -> None:
+    """Raise AssertionError when the run recorded any model or HBM
+    violation (the test-suite gate)."""
+    rep = report()
+    if rep["violations"]:
+        raise AssertionError(
+            f"shapecheck found {len(rep['violations'])} violation(s): "
+            + json.dumps(rep["violations"], indent=2, default=str)
+        )
+
+
+def predicted_peak() -> Optional[int]:
+    """Max over observed dispatches of (pre-dispatch occupancy + the
+    static footprint prediction): the envelope observed HBM peaks are
+    gated against (bench.py's ``hbm_pred_ratio``). None without
+    allocator stats (CPU) or before the first tracked dispatch."""
+    rt = _rt
+    if rt is None:
+        return None
+    with rt._mu:
+        return rt._pred_peak
+
+
+def observed_peak() -> Optional[int]:
+    """Max ``bytes_in_use`` sampled at THIS runtime's dispatch-boundary
+    probes — the observed half of ``hbm_pred_ratio``. Deliberately NOT
+    the allocator's ``peak_bytes_in_use``: that figure is process-
+    monotone (PR 3), so a second bench run in the same process would
+    inherit the first run's peak and spuriously break the <= 1.0 cap;
+    this one resets with the runtime and samples exactly where the
+    predictions apply."""
+    rt = _rt
+    if rt is None:
+        return None
+    with rt._mu:
+        return rt._obs_peak
+
+
+def emit_telemetry() -> None:
+    """Publish the declared ``shapecheck.*`` counters (no-op unless
+    both the checker and obs are enabled). Emits DELTAS since the last
+    call, so periodic publication never double-counts."""
+    rt = _rt
+    if rt is None:
+        return
+    from dbscan_tpu import obs
+
+    if not obs.active():
+        return
+    with rt._mu:
+        checks, nviol = rt.checks, len(rt.violations)
+        done = dict(rt._emitted)
+        rt._emitted = {"checks": checks, "violations": nviol}
+    obs.count("shapecheck.checks", checks - done["checks"])
+    obs.count("shapecheck.violations", nviol - done["violations"])
+
+
+def write_report(path: str) -> str:
+    """Write the JSON report atomically; returns the path. Publishes
+    pending ``shapecheck.*`` telemetry deltas first (the one product
+    call site — the ``DBSCAN_SHAPECHECK_REPORT`` atexit hook)."""
+    emit_telemetry()
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(report(), f, indent=1, sort_keys=True, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def _env_init() -> None:
+    """Activate from the environment at import: ``DBSCAN_SHAPECHECK=1``
+    turns recording on; ``DBSCAN_SHAPECHECK_REPORT=path`` additionally
+    dumps the JSON report at process exit (how the tier-1 subprocess
+    rerun of the distributed/streaming suites is asserted clean from
+    outside)."""
+    if config.env("DBSCAN_SHAPECHECK"):
+        enable()
+        path = config.env("DBSCAN_SHAPECHECK_REPORT")
+        if path:
+            atexit.register(write_report, path)
+
+
+_env_init()

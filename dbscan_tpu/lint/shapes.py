@@ -1,0 +1,855 @@
+"""graftshape rules: symbolic shape/dtype/HBM checks over the jit call
+graph, plus the per-dispatch-family footprint models the runtime
+cross-check (``lint/shapecheck.py``) asserts against live runs.
+
+Four rule families on top of :mod:`lint.absint`:
+
+- ``shape-mismatch``: provable broadcast / concatenate / reshape / dot
+  incompatibilities under symbolic dims. The interpreter is
+  conservative by construction — a dim it cannot prove concrete
+  unifies with anything — so every finding is an arithmetic
+  impossibility, not a heuristic.
+- ``shape-unratcheted-dim``: a data-dependent leading dim (``len(x)``,
+  ``np.flatnonzero`` counts, ``.sum()`` values) entering a KNOWN jit
+  boundary without passing through one of the repo's sanctioned
+  padding functions (``_ratchet`` / ``_ladder_width`` / ``_pad_parts``
+  / ``_pad_idx`` / ``_ladder8``). This is the static twin of the
+  ``compiles.ratchet_raises`` counter: the dim that mints a fresh jit
+  signature per batch, caught before it ships.
+- ``dtype-flow-drift``: explicit float64 (np.float64 constructions,
+  ``dtype="float64"``, ``astype(float64)``) reaching device code in
+  kernel files (``ops/``, ``parallel/spill_device.py``) via VALUE FLOW
+  — supersedes the literal-only ``dtype-drift`` rule (kept as an
+  alias, see ``lint.ALIASES``): the old rule saw ``jnp.sum(x,
+  dtype=jnp.float64)``; this one also sees ``w = np.float64(h);
+  jnp.sum(x * w)``. numpy's silent float64 DEFAULTS (host geometry
+  math) are deliberately exempt — only explicit f64 is drift.
+- ``hbm-over-budget`` / ``shard-indivisible``: the memory-envelope and
+  mesh-divisibility gates. ``hbm-over-budget`` fires (a) on any array
+  CONSTRUCTED inside jit-reachable code whose concrete byte count
+  alone exceeds the device budget, and (b) on any ``tracked_call``
+  dispatch family whose knob-bounded worst case
+  (:data:`FAMILY_MODELS`, evaluated against the live
+  ``config.ENV_VARS`` values) exceeds it — so raising
+  ``DBSCAN_GROUP_SLOTS`` past what HBM can hold fails lint before it
+  OOMs a chip. ``shard-indivisible`` checks concrete arg dims against
+  statically-visible ``shard_map`` mesh axis sizes at jit call sites —
+  the gate ROADMAP item 1 (multi-chip scale-out) needs.
+
+:data:`FAMILY_MODELS` is the single declared symbolic model of every
+dispatch family's argument shapes, dtype classes, constraints, and
+footprint algebra; ``python -m dbscan_tpu.lint --shape-table`` renders
+it for PARITY.md and ``lint/shapecheck.py`` unifies observed shapes
+against it at runtime (``DBSCAN_SHAPECHECK=1``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from dbscan_tpu.lint import absint
+from dbscan_tpu.lint.absint import (
+    Arr,
+    DTYPE_BYTES,
+    E,
+    FLOATS,
+    INTS,
+    IntVal,
+    Interp,
+    Lit,
+    Sym,
+    Tup,
+    UNKNOWN,
+    fresh,
+    unify_dim,
+)
+from dbscan_tpu.lint.core import Finding, Package
+from dbscan_tpu.lint.recompile import _kernel_file
+
+#: static HBM budget for the envelope checks: one v5e chip's HBM. The
+#: runtime cross-check uses the live ``device.memory_stats()``
+#: ``bytes_limit`` instead; this constant only gates the lint-time
+#: worst case (tests may monkeypatch it to exercise the rule).
+DEFAULT_HBM_BYTES = 16 * 2**30
+
+#: the sanctioned padding functions — a dim produced by one of these
+#: carries the "ratchet" provenance the unratcheted-dim rule accepts
+#: (the repo's idioms: binning._ratchet/_ladder_width/_pad_parts,
+#: driver._pad_idx, spill_device._ladder8)
+RATCHET_FNS = ("_ratchet", "_ladder_width", "_pad_parts", "_ladder8")
+RATCHET_ARRAY_FNS = ("_pad_idx",)
+
+#: shape-transparent mesh helpers (return their array argument's shape)
+_TRANSPARENT_LAST_ARG = ("shard_host_array",)
+_TRANSPARENT_FIRST_ARG = ("replicate_host_array", "device_put")
+
+# --- dispatch-family models --------------------------------------------
+
+#: dtype classes for model args
+FLOAT = FLOATS
+INT = INTS
+BOOL = ("bool",)
+ANY = FLOATS + INTS + BOOL
+
+#: block size the banded packer pads bucket widths to — mirrors
+#: ``parallel.binning.BANDED_BLOCK`` (pinned equal by
+#: tests/test_shapecheck.py; lint stays stdlib-only, so no import)
+BANDED_BLOCK = 512
+#: window rows per point — mirrors ``parallel.binning.BANDED_ROWS``
+BANDED_ROWS = 5
+
+
+class ArgModel:
+    """Symbolic model of one dispatch argument: ``dims`` are symbol
+    names (shared across the call's args), ints, or :class:`E`
+    expressions; ``dtypes`` the allowed canonical dtype class."""
+
+    def __init__(self, name: str, dims: Tuple, dtypes: Tuple,
+                 tuple_of: bool = False):
+        self.name = name
+        self.dims = dims
+        self.dtypes = dtypes
+        #: a tuple/list of arrays, each matching ``dims`` with FRESH
+        #: per-element symbols (the postpass chunk-group idiom)
+        self.tuple_of = tuple_of
+
+    def render(self) -> str:
+        dims = ",".join(
+            d if isinstance(d, str)
+            else (d.render() if isinstance(d, E) else str(d))
+            for d in self.dims
+        )
+        cls = (
+            "float" if self.dtypes == FLOAT
+            else "int" if self.dtypes == INT
+            else "bool" if self.dtypes == BOOL
+            else "any"
+        )
+        body = f"[{dims}] {cls}"
+        return f"{self.name}: ({body}, ...)" if self.tuple_of else (
+            f"{self.name}: {body}"
+        )
+
+
+class FamilyModel:
+    """One dispatch family's declared contract.
+
+    ``args``: positional :class:`ArgModel`s (extra observed scalar args
+    are permitted — static-argnum specialization bakes scalars into the
+    builder, but a few families pass them through).
+    ``constraints``: ``(lhs, rhs)`` E-expression pairs over the model
+    symbols that must agree once bound (shard-block division:
+    ``B == BANDED_BLOCK * NB``).
+    ``overhead``: symbolic temp+output bytes ON TOP of the exact input
+    bytes (which the checker computes from the observed arrays).
+    ``static_slots``: symbol -> env-var name binding the worst case for
+    the lint-time gate, or None when the family scales with data the
+    knobs do not bound (resident payload rows) — listed in the table,
+    gated only at runtime.
+    """
+
+    def __init__(
+        self,
+        family: str,
+        args: List[ArgModel],
+        overhead: E,
+        constraints: List[Tuple[E, E]] = (),
+        static_slots: Optional[Dict[str, str]] = None,
+        note: str = "",
+    ):
+        self.family = family
+        self.args = args
+        self.overhead = overhead
+        self.constraints = list(constraints)
+        self.static_slots = static_slots
+        self.note = note
+
+    # symbolic exact-input bytes, for the table and the static bound
+    def input_expr(self) -> Optional[E]:
+        total = E(0)
+        for a in self.args:
+            if a.tuple_of:
+                # tuple args: per-element dims are fresh, so their
+                # TOTAL rides the family's slot-bound overhead term
+                # instead (one element's worst case is meaningless)
+                continue
+            size = max(DTYPE_BYTES[d] for d in a.dtypes)
+            prod = E(size)
+            for d in a.dims:
+                if isinstance(d, str):
+                    prod = prod * E.of(Sym(d))
+                else:
+                    prod = prod * E.of(d)
+            total = total + prod
+        return total
+
+    def static_worst(self, env_fn) -> Optional[int]:
+        """Worst-case total bytes under the live budget knobs, or None
+        when some symbol has no knob bound (runtime-only family)."""
+        if self.static_slots is None:
+            return None
+        binding: Dict[str, int] = {}
+        for sym, env_name in self.static_slots.items():
+            if isinstance(env_name, int):
+                binding[sym] = env_name
+            else:
+                binding[sym] = int(env_fn(env_name))
+        expr = self.input_expr() + self.overhead
+        return expr.substitute(binding).evaluate(binding)
+
+    def overhead_bytes(self, subst: Dict[str, int]) -> Optional[int]:
+        return self.overhead.evaluate(subst)
+
+
+def _sy(name: str) -> E:
+    return E.of(Sym(name))
+
+
+def _models() -> Dict[str, FamilyModel]:
+    P, B, D, NB, N, M, K, G = (
+        _sy(n) for n in ("P", "B", "D", "NB", "N", "M", "K", "G")
+    )
+    R = BANDED_ROWS
+    slots = P * B  # one group's padded slot count
+    # the driver's dense vmap temp cap: batch <= 1.2e9 elements of
+    # [B, B] f32 adjacency in flight (driver._dispatch_partitions)
+    dense_temp = E(int(1.2e9) * 4)
+    return {
+        m.family: m
+        for m in (
+            FamilyModel(
+                "dispatch.dense",
+                [
+                    ArgModel("points", ("P", "B", "D"), FLOAT),
+                    ArgModel("mask", ("P", "B"), BOOL),
+                ],
+                # temp: capped [batch, B, B] adjacencies; out: labels +
+                # core per slot (i32 + bool + i32 seeds)
+                overhead=dense_temp + slots * 9,
+                static_slots={
+                    # one width-class group's P*B is bounded by the
+                    # dispatch-group slot budget; D <= 4 by the payload
+                    # contract (binning's difference-form limit)
+                    "P": "DBSCAN_GROUP_SLOTS", "B": 1, "D": 4,
+                },
+                note="temp = capped [batch,B,B] f32 adjacency "
+                "(1.2e9 elements, driver._dispatch_partitions)",
+            ),
+            FamilyModel(
+                "dispatch.resident",
+                [
+                    ArgModel("x", ("N", "D"), FLOAT),
+                    ArgModel("idx", ("P", "B"), INT),
+                    ArgModel("mask", ("P", "B"), BOOL),
+                ],
+                overhead=dense_temp + slots * 9 + slots * D * 4,
+                static_slots=None,
+                note="unbounded statically: scales with resident "
+                "payload rows N (gated at runtime)",
+            ),
+            FamilyModel(
+                "dispatch.banded_p1",
+                [
+                    ArgModel("points", ("P", "B", "D"), FLOAT),
+                    ArgModel("mask", ("P", "B"), BOOL),
+                    ArgModel("rel_starts", ("P", "B", R), INT),
+                    ArgModel("spans", ("P", "B", R), INT),
+                    ArgModel("slab_starts", ("P", "NB", R), INT),
+                    ArgModel("cx", ("P", "B"), INT),
+                ],
+                # out: core bool + bits i32 per slot (+ per-slot counts
+                # consumed on device); temp: per-batch slab gathers,
+                # dwarfed by the run tables — covered by 2x slot bytes
+                overhead=slots * (1 + 4 + 4) + slots * 8,
+                constraints=[(B, E(BANDED_BLOCK) * NB)],
+                static_slots={
+                    "P": "DBSCAN_GROUP_SLOTS", "B": 1, "D": 4,
+                    "NB": 1,
+                },
+                note=f"B = {BANDED_BLOCK}*NB (BANDED_BLOCK slabs); "
+                "run tables ship u16 when slabs fit",
+            ),
+            FamilyModel(
+                "cellcc.postpass",
+                [
+                    ArgModel("cores", ("Pi", "Bi"), BOOL, tuple_of=True),
+                    ArgModel("bitses", ("Pi", "Bi"), INT, tuple_of=True),
+                    ArgModel("segflags", ("Si",), BOOL, tuple_of=True),
+                    ArgModel("or_idx", ("G",), INT),
+                ],
+                # the device-resident tuple inputs (core bool + bits
+                # i32 + segflag bool per slot) plus flat concats, scan
+                # buffers, and the packed output over the chunk's M
+                # slots, plus the gathered scan bytes
+                overhead=M * (1 + 4 + 1) + M * (1 + 4 + 1 + 8) + G * 8,
+                constraints=[],
+                static_slots={
+                    "M": "DBSCAN_COMPACT_CHUNK_SLOTS",
+                    "G": "DBSCAN_COMPACT_CHUNK_SLOTS",
+                },
+                note="M = sum of the chunk's P*B slots, bounded by "
+                "the compact-chunk budget; inputs are already "
+                "device-resident",
+            ),
+            FamilyModel(
+                "cellcc.gather",
+                [
+                    ArgModel("src", ("M",), INT),
+                    ArgModel("idx", ("K",), INT),
+                ],
+                overhead=K * 4,
+                static_slots={
+                    "M": "DBSCAN_COMPACT_CHUNK_SLOTS",
+                    "K": "DBSCAN_COMPACT_CHUNK_SLOTS",
+                },
+                note="border-candidate gather from the resident "
+                "bits_flat; K is ladder-padded (driver._pad_idx)",
+            ),
+            FamilyModel(
+                "spill.gather",
+                [
+                    ArgModel("x", ("N", "D"), FLOAT),
+                    ArgModel("idx", ("K",), INT),
+                ],
+                overhead=K * D * 2,
+                static_slots=None,
+                note="unbounded statically: scales with resident "
+                "payload rows N (gated at runtime)",
+            ),
+        )
+    }
+
+
+FAMILY_MODELS: Dict[str, FamilyModel] = _models()
+
+# tuple args are validated elementwise with per-element fresh symbols;
+# these cross-arg couplings say WHICH tuple args must agree per element
+TUPLE_COUPLED = {
+    # cores[i].shape == bitses[i].shape; segflags[i] = prod(cores[i])
+    "cellcc.postpass": (("cores", "bitses"),),
+}
+
+
+def shape_table(env_fn=None, budget: Optional[int] = None) -> str:
+    """The PARITY.md per-dispatch-family predicted-footprint table
+    (``python -m dbscan_tpu.lint --shape-table`` prints it)."""
+    from dbscan_tpu import config
+
+    env_fn = env_fn or config.env
+    budget = budget if budget is not None else DEFAULT_HBM_BYTES
+    lines = [
+        "| Family | Symbolic args | Overhead (temp+out bytes) | "
+        "Knob-bounded worst case | Verdict |",
+        "|---|---|---|---|---|",
+    ]
+    for family in sorted(FAMILY_MODELS):
+        m = FAMILY_MODELS[family]
+        worst = m.static_worst(env_fn)
+        if worst is None:
+            wtxt, verdict = "unbounded (data-scaled)", "runtime-gated"
+        else:
+            wtxt = f"{worst / 2**30:.2f} GiB"
+            verdict = (
+                "fits" if worst <= budget
+                else f"OVER {budget / 2**30:.0f} GiB budget"
+            )
+        args = "<br>".join(a.render() for a in m.args)
+        lines.append(
+            f"| `{family}` | {args} | `{m.overhead.render()}` | "
+            f"{wtxt} | {verdict} |"
+        )
+    return "\n".join(lines)
+
+
+# --- runtime-shared validation ----------------------------------------
+
+
+def validate_args(family: str, observed: List) -> Tuple[
+    Dict[str, int], List[str]
+]:
+    """Unify observed ``(shape, dtype)`` specs (see
+    ``shapecheck.spec_of``) against the family model. Returns
+    ``(subst, violations)``; an unknown family is itself a violation.
+    TRAILING observed scalars (static-argnum passthrough) are
+    tolerated, but an undeclared extra ARRAY argument is a violation —
+    a kernel signature growing a buffer the model does not know about
+    must fail the cross-check (updating FAMILY_MODELS is the
+    registration step)."""
+    model = FAMILY_MODELS.get(family)
+    if model is None:
+        return {}, [f"undeclared dispatch family {family!r}"]
+    subst: Dict[str, int] = {}
+    problems: List[str] = []
+    arrays = list(observed)
+    if len(arrays) < len(model.args):
+        problems.append(
+            f"{family}: {len(arrays)} args observed, model declares "
+            f"{len(model.args)}"
+        )
+        return subst, problems
+    for i, extra in enumerate(
+        arrays[len(model.args):], start=len(model.args)
+    ):
+        is_arrayish = isinstance(extra, list) or (
+            isinstance(extra, tuple)
+            and len(extra) == 2
+            and isinstance(extra[0], tuple)
+        )
+        if is_arrayish:
+            problems.append(
+                f"{family}: undeclared extra array argument at "
+                f"position {i} ({extra!r}) — the model declares "
+                f"{len(model.args)} args; register the new buffer in "
+                "lint/shapes.py FAMILY_MODELS"
+            )
+    for spec, obs in zip(model.args, arrays):
+        if spec.tuple_of:
+            if not isinstance(obs, (list, tuple)):
+                problems.append(
+                    f"{family}.{spec.name}: expected a tuple of arrays, "
+                    f"got {obs!r}"
+                )
+                continue
+            for i, el in enumerate(obs):
+                _match_one(
+                    family, f"{spec.name}[{i}]", spec, el, {}, problems
+                )
+            continue
+        _match_one(family, spec.name, spec, obs, subst, problems)
+    # per-element couplings across tuple args (postpass: cores[i] and
+    # bitses[i] share a shape; segflags[i] has prod(cores[i]) slots)
+    for pair in TUPLE_COUPLED.get(family, ()):
+        tuples = {}
+        for spec, obs in zip(model.args, arrays):
+            if spec.name in pair and isinstance(obs, (list, tuple)):
+                tuples[spec.name] = obs
+        if len(tuples) == len(pair):
+            a, b = (tuples[n] for n in pair)
+            if len(a) != len(b):
+                problems.append(
+                    f"{family}: {pair[0]} has {len(a)} elements, "
+                    f"{pair[1]} has {len(b)}"
+                )
+            else:
+                for i, (ea, eb) in enumerate(zip(a, b)):
+                    sa = ea[0] if isinstance(ea, tuple) else None
+                    sb = eb[0] if isinstance(eb, tuple) else None
+                    if sa is not None and sb is not None and sa != sb:
+                        problems.append(
+                            f"{family}: {pair[0]}[{i}] shape {sa} != "
+                            f"{pair[1]}[{i}] shape {sb}"
+                        )
+    for lhs, rhs in model.constraints:
+        lv = lhs.evaluate(subst)
+        rv = rhs.evaluate(subst)
+        if lv is not None and rv is not None and lv != rv:
+            problems.append(
+                f"{family}: constraint {lhs.render()} == {rhs.render()} "
+                f"violated ({lv} != {rv}) under {subst}"
+            )
+    return subst, problems
+
+
+def _match_one(family, label, spec: ArgModel, obs, subst, problems):
+    if not (isinstance(obs, tuple) and len(obs) == 2):
+        # non-array observed (None, scalar): scalars are permitted
+        # passthroughs only for 0-d model slots — report otherwise
+        problems.append(f"{family}.{label}: expected an array, got {obs!r}")
+        return
+    shape, dtype = obs
+    if len(shape) != len(spec.dims):
+        problems.append(
+            f"{family}.{label}: rank {len(shape)} observed, model "
+            f"declares [{','.join(map(str, spec.dims))}]"
+        )
+        return
+    for i, (md, od) in enumerate(zip(spec.dims, shape)):
+        model_dim = E.of(Sym(md)) if isinstance(md, str) else E.of(md)
+        if not unify_dim(model_dim, int(od), subst):
+            problems.append(
+                f"{family}.{label}: dim {i} = {od} does not instantiate "
+                f"model dim "
+                f"{md if isinstance(md, str) else model_dim.render()} "
+                f"under {subst}"
+            )
+            return
+    if dtype is not None and dtype not in spec.dtypes:
+        problems.append(
+            f"{family}.{label}: dtype {dtype} outside the declared "
+            f"class {spec.dtypes}"
+        )
+
+
+# --- static rule driver ------------------------------------------------
+
+
+class _MeshVal:
+    """Abstract mesh: axis name -> size (None when not literal)."""
+
+    def __init__(self, axes: Dict[str, Optional[int]]):
+        self.axes = axes
+
+
+class _SpecVal:
+    """Abstract PartitionSpec: per-dim axis name (or None)."""
+
+    def __init__(self, entries):
+        self.entries = entries
+
+
+class _JitFn:
+    """A name bound to ``jax.jit(shard_map(block, mesh=..,
+    in_specs=..))`` inside one scope: calling it checks concrete arg
+    dims for divisibility by the partitioning mesh axes."""
+
+    def __init__(self, rules: "_Rules", mesh: Optional[_MeshVal],
+                 in_specs: List):
+        self.rules = rules
+        self.mesh = mesh
+        self.in_specs = in_specs
+
+    def absint_call(self, interp, node, args, kwargs):
+        if self.mesh is None:
+            return UNKNOWN
+        for arg, spec in zip(args, self.in_specs):
+            if not (isinstance(arg, Arr) and arg.shape is not None):
+                continue
+            if not isinstance(spec, _SpecVal):
+                continue
+            for i, axis in enumerate(spec.entries):
+                if axis is None or i >= len(arg.shape):
+                    continue
+                size = self.mesh.axes.get(axis)
+                dim = arg.shape[i].const()
+                if size and dim is not None and dim % size != 0:
+                    self.rules.add(
+                        "shard-indivisible",
+                        node,
+                        f"dim {i} = {dim} of a shard_map input is not "
+                        f"divisible by mesh axis {axis!r} (size "
+                        f"{size}): the block would see ragged shards — "
+                        "pad the dim to a mesh multiple "
+                        "(binning._pad_parts) before dispatch",
+                    )
+        return UNKNOWN
+
+
+class _Rules:
+    """Per-module rule context: wires the interpreter hooks to
+    findings."""
+
+    def __init__(self, pkg: Package, mod, findings: List[Finding],
+                 budget: int):
+        self.pkg = pkg
+        self.mod = mod
+        self.findings = findings
+        self.budget = budget
+        self.jitted_local: set = set()
+        cg = pkg.callgraph
+        if cg is not None:
+            for (path, name), _stat in cg.jitted_names.items():
+                if path == mod.path:
+                    self.jitted_local.add(name)
+            # from-imported jit roots callable by bare name
+            for name, (src, orig) in mod.from_names.items():
+                m2 = cg.by_modname.get(src)
+                info = m2.functions.get(orig) if m2 is not None else None
+                if info is not None and info.is_jit_root:
+                    self.jitted_local.add(name)
+
+    def add(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(
+            Finding(
+                rule,
+                self.mod.path,
+                getattr(node, "lineno", 1),
+                getattr(node, "col_offset", 0),
+                msg,
+            )
+        )
+
+    # --- interpreter hooks ---------------------------------------------
+
+    def intrinsics(self) -> Dict:
+        out = {}
+        for name in RATCHET_FNS:
+            out[name] = self._ratchet_scalar
+        for name in RATCHET_ARRAY_FNS:
+            out[name] = self._ratchet_array
+        for name in _TRANSPARENT_LAST_ARG:
+            out[name] = self._passthrough_last
+        for name in _TRANSPARENT_FIRST_ARG:
+            out[name] = self._passthrough_first
+        out["jit"] = self._jit
+        out["Mesh"] = self._mesh
+        out["make_mesh"] = self._make_mesh
+        out["P"] = self._pspec
+        out["PartitionSpec"] = self._pspec
+        return out
+
+    @staticmethod
+    def _ratchet_scalar(interp, node, args, kwargs):
+        return IntVal(E.of(fresh("pad", "ratchet")))
+
+    @staticmethod
+    def _ratchet_array(interp, node, args, kwargs):
+        return Arr((E.of(fresh("pad", "ratchet")),), "i32")
+
+    @staticmethod
+    def _passthrough_last(interp, node, args, kwargs):
+        return args[-1] if args else UNKNOWN
+
+    @staticmethod
+    def _passthrough_first(interp, node, args, kwargs):
+        return args[0] if args else UNKNOWN
+
+    @staticmethod
+    def _mesh(interp, node, args, kwargs):
+        # Mesh(devices, ("x", "y")): sizes are runtime (device count)
+        axes: Dict[str, Optional[int]] = {}
+        names = args[1] if len(args) > 1 else kwargs.get("axis_names")
+        if isinstance(names, Tup):
+            for it in names.items:
+                if isinstance(it, Lit) and isinstance(it.v, str):
+                    axes[it.v] = None
+        return _MeshVal(axes)
+
+    @staticmethod
+    def _make_mesh(interp, node, args, kwargs):
+        # jax.make_mesh((4, 2), ("x", "y")): literal sizes resolve
+        axes: Dict[str, Optional[int]] = {}
+        shape = args[0] if args else kwargs.get("axis_shapes")
+        names = args[1] if len(args) > 1 else kwargs.get("axis_names")
+        if isinstance(shape, Tup) and isinstance(names, Tup):
+            for sv, nv in zip(shape.items, names.items):
+                if isinstance(nv, Lit) and isinstance(nv.v, str):
+                    size = (
+                        sv.e.const() if isinstance(sv, IntVal) else None
+                    )
+                    axes[nv.v] = size
+        return _MeshVal(axes)
+
+    @staticmethod
+    def _pspec(interp, node, args, kwargs):
+        entries = []
+        for a in args:
+            if isinstance(a, Lit) and isinstance(a.v, str):
+                entries.append(a.v)
+            elif isinstance(a, Lit) and a.v is None:
+                entries.append(None)
+            else:
+                entries.append(None)
+        return _SpecVal(entries)
+
+    def _jit(self, interp, node, args, kwargs):
+        """``jax.jit(shard_map(block, mesh=.., in_specs=..))``: return
+        a _JitFn so calls through the bound name get the divisibility
+        check. Plain jits return UNKNOWN (callable opaque)."""
+        if not node.args:
+            return UNKNOWN
+        target = node.args[0]
+        if not isinstance(target, ast.Call):
+            return UNKNOWN
+        tname = target.func.attr if isinstance(
+            target.func, ast.Attribute
+        ) else (target.func.id if isinstance(target.func, ast.Name) else "")
+        if tname != "shard_map":
+            return UNKNOWN
+        mesh_v = None
+        in_specs: List = []
+        for kw in target.keywords:
+            if kw.arg == "mesh":
+                v = interp.expr(kw.value)
+                if isinstance(v, _MeshVal):
+                    mesh_v = v
+            elif kw.arg == "in_specs":
+                v = interp.expr(kw.value)
+                if isinstance(v, Tup):
+                    in_specs = v.items
+                elif isinstance(v, _SpecVal):
+                    in_specs = [v]
+        return _JitFn(self, mesh_v, in_specs)
+
+    def on_call(self, interp, node, name, args, kwargs):
+        # (1) data-dependent leading dims entering a KNOWN jit boundary
+        jit_args: Optional[List] = None
+        if name in self.jitted_local:
+            jit_args = args
+        elif name in ("tracked_call",) and len(args) >= 2:
+            jit_args = args[2:]
+        if jit_args:
+            for a in jit_args:
+                if not (isinstance(a, Arr) and a.shape):
+                    continue
+                lead = a.shape[0]
+                if Interp._prov(lead) == "data":
+                    self.add(
+                        "shape-unratcheted-dim",
+                        node,
+                        "data-dependent leading dim "
+                        f"[{lead.render()}] enters a jit boundary "
+                        "without a shape ratchet: every distinct value "
+                        "mints a fresh jit signature (the compile-storm "
+                        "mechanism) — pad it through binning._ratchet /"
+                        " _ladder_width / _pad_idx first",
+                    )
+                    break
+        # (2) constructed-array HBM check inside jit-reachable code
+        if self._in_jit_scope and name in absint._CREATION:
+            shape = interp._shape_from(args[0]) if args else None
+            if shape is not None:
+                dt, _exp = interp._dtype_from(
+                    kwargs.get("dtype", UNKNOWN)
+                )
+                if dt is None:
+                    for a in args[1:]:
+                        dt, _exp = interp._dtype_from(a)
+                        if dt is not None:
+                            break
+                total = absint.nbytes(shape, dt or "f32")
+                c = total.const() if total is not None else None
+                if c is not None and c > self.budget:
+                    self.add(
+                        "hbm-over-budget",
+                        node,
+                        f"array of {c / 2**30:.1f} GiB constructed in "
+                        "jit-reachable code exceeds the "
+                        f"{self.budget / 2**30:.0f} GiB device budget "
+                        "— tile it (lax.map batching, the driver's "
+                        "mem_cap idiom) or lower the slot knobs",
+                    )
+    _in_jit_scope = False
+
+
+def _literal_jnp_f64(mod, findings: List[Finding]) -> None:
+    """Parity with the superseded literal rule: a bare ``jnp.float64``
+    reference in kernel code is drift even before it flows anywhere."""
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr == "float64"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jnp"
+        ):
+            findings.append(
+                Finding(
+                    "dtype-flow-drift",
+                    mod.path,
+                    node.lineno,
+                    node.col_offset,
+                    "jnp.float64 in kernel code: the device kernels "
+                    "are f32/bf16 (config.Precision) — use the "
+                    "configured dtype",
+                )
+            )
+
+
+def _static_family_budget(pkg: Package, findings: List[Finding],
+                          budget: int) -> None:
+    """The knob-bound worst-case gate: every ``tracked_call`` family
+    literal in the linted set whose :data:`FAMILY_MODELS` envelope,
+    evaluated against the LIVE ``config.ENV_VARS`` values, exceeds the
+    device budget."""
+    from dbscan_tpu import config
+    from dbscan_tpu.lint.callgraph import terminal_name
+
+    for mod in pkg.callgraph.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) not in (
+                "tracked_call", "note_compile"
+            ):
+                continue
+            if not (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            fam = node.args[0].value
+            model = FAMILY_MODELS.get(fam)
+            if model is None:
+                continue  # schema-family rule owns unknown literals
+            worst = model.static_worst(config.env)
+            if worst is not None and worst > budget:
+                findings.append(
+                    Finding(
+                        "hbm-over-budget",
+                        mod.path,
+                        node.lineno,
+                        node.col_offset,
+                        f"dispatch family {fam!r} worst-case footprint "
+                        f"{worst / 2**30:.1f} GiB exceeds the "
+                        f"{budget / 2**30:.0f} GiB device budget under "
+                        "the current budget knobs ("
+                        + ", ".join(
+                            sorted(
+                                v
+                                for v in model.static_slots.values()
+                                if isinstance(v, str)
+                            )
+                        )
+                        + ") — lower them or split the dispatch",
+                    )
+                )
+
+
+def check(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    cg = pkg.callgraph
+    if cg is None:
+        return findings
+    budget = DEFAULT_HBM_BYTES
+    for mod in cg.modules.values():
+        kernel = _kernel_file(mod.path)
+        rules = _Rules(pkg, mod, findings, budget)
+        if kernel:
+            _literal_jnp_f64(mod, findings)
+
+        def emit(rule, node, msg, _rules=rules):
+            _rules.add(rule, node, msg)
+
+        def run_one(fn_node, in_jit: bool, _rules=rules, _mod=mod,
+                    _kernel=kernel, _emit=emit):
+            interp = Interp(
+                _emit,
+                module_aliases=_mod.import_alias,
+                intrinsics=_rules.intrinsics(),
+                kernel=_kernel,
+                on_call=_rules.on_call,
+            )
+            _rules._in_jit_scope = in_jit
+            params: Dict[str, object] = {}
+            args = getattr(fn_node, "args", None)
+            info = cg.func_for(fn_node)
+            statics = info.static_params if info is not None else set()
+            if args is not None:
+                for a in list(args.args) + list(args.kwonlyargs):
+                    if a.arg in statics:
+                        # static-argnum specialization: the param is a
+                        # compile-time int the shapes may use as a dim
+                        params[a.arg] = IntVal(E.of(fresh(a.arg)))
+                    else:
+                        params[a.arg] = Arr(None, None, device=in_jit)
+            try:
+                interp.run(fn_node, params)
+            except Exception:
+                if absint.STRICT:
+                    raise
+                # a modeling gap must never break lint: skip the fn
+
+        seen = set()
+        for info in mod.all_functions:
+            node = info.node
+            if id(node) in seen or not hasattr(node, "body"):
+                continue
+            seen.add(id(node))
+            run_one(node, cg.in_reachable(node))
+        # module-level statements (kernel constants, builder wiring)
+        class _ModFn:
+            body = mod.tree.body
+        run_one(_ModFn, False)
+    _static_family_budget(pkg, findings, budget)
+    return findings

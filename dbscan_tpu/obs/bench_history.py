@@ -48,9 +48,14 @@ from dbscan_tpu.obs import schema
 
 # scalar keys promoted to history records: exact names + suffixes
 # (_overlap_ratio: the pull-pipeline's overlapped/total pull share —
-# a throughput-like health figure that regresses DOWN)
+# a throughput-like health figure that regresses DOWN; _pred_ratio:
+# graftshape's observed-HBM-peak / predicted-peak containment figure,
+# hard-capped at 1.0 by obs/regress.py)
 _EXACT_KEYS = ("value", "seconds", "vs_baseline")
-_SUFFIXES = ("_seconds", "_s", "_mpts", "_vs_baseline", "_overlap_ratio")
+_SUFFIXES = (
+    "_seconds", "_s", "_mpts", "_vs_baseline", "_overlap_ratio",
+    "_pred_ratio",
+)
 # numeric-but-not-perf keys the suffix rule would otherwise catch —
 # declared with the telemetry schema (the keys are fault-counter
 # deltas riding bench rows, so the exclusion must track the schema)
@@ -79,7 +84,7 @@ def git_rev(cwd: Optional[str] = None) -> str:
 def _unit_for(metric: str, obj: dict) -> Optional[str]:
     if metric == "value":
         return obj.get("unit")
-    if metric.endswith("_overlap_ratio"):
+    if metric.endswith(("_overlap_ratio", "_pred_ratio")):
         return "ratio"
     if metric.endswith(("_seconds", "_s")) or metric == "seconds":
         return "s"

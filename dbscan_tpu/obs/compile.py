@@ -39,6 +39,7 @@ import time
 
 import dbscan_tpu.obs as obs
 from dbscan_tpu import config
+from dbscan_tpu.lint import shapecheck as _shapecheck
 from dbscan_tpu.lint import tsan as _tsan
 
 logger = logging.getLogger(__name__)
@@ -67,11 +68,21 @@ def _cache_size(fn):
 
 
 def tracked_call(family: str, fn, *args):
-    """Call ``fn(*args)`` with compile accounting (see module doc).
-    Strict pass-through when obs is disabled."""
+    """Call ``fn(*args)`` with compile accounting (see module doc) and,
+    under ``DBSCAN_SHAPECHECK=1``, the graftshape runtime cross-check
+    (lint/shapecheck.py): observed arg shapes/dtypes must instantiate
+    the static family model, and the allocator growth across the call
+    must stay within the static footprint prediction. Strict
+    pass-through when obs is disabled (one extra truthiness check for
+    the — independently enabled — shape checker)."""
+    sc = _shapecheck.runtime()
+    handle = sc.observe_call(family, args) if sc is not None else None
     st = obs.state()
     if st is None:
-        return fn(*args)
+        out = fn(*args)
+        if handle is not None:
+            sc.settle_call(handle)
+        return out
     before = _cache_size(fn)
     t0 = time.perf_counter()
     out = fn(*args)
@@ -84,6 +95,8 @@ def tracked_call(family: str, fn, *args):
             frame = sys._getframe(1)
             site = f"{frame.f_code.co_filename}:{frame.f_lineno}"
             note_compile(family, t0, time.perf_counter(), site=site)
+    if handle is not None:
+        sc.settle_call(handle)
     return out
 
 

@@ -24,6 +24,12 @@ flag at 25%, while a stable metric flags at the requested bound.
 Direction comes from the metric name: ``*_seconds``/``*_s`` regress
 UP, ``*_mpts``/``*_vs_baseline``/throughput headline regress DOWN;
 metrics with no known direction are skipped (reported, not gated).
+
+One exception to the noise-aware scheme: ``*_pred_ratio`` (graftshape's
+observed-HBM-peak / statically-predicted-peak containment figure) is a
+HARD CAP at 1.0 with no history needed — it is a contract ("the static
+model bounds the observed peak"), not a perf direction, so widening its
+threshold to the noise spread would defeat it.
 """
 
 from __future__ import annotations
@@ -70,6 +76,25 @@ def compare(
     regressions, ok, skipped = [], [], []
     for rec in fresh:
         metric = rec["metric"]
+        if metric.endswith("_pred_ratio"):
+            # graftshape containment contract, not a perf direction:
+            # the static model must BOUND the observed HBM peak, so a
+            # ratio above 1.0 fails with no history needed (the only
+            # hard-capped metric — noise widening would defeat it)
+            value = rec["value"]
+            entry = {
+                "metric": metric,
+                "value": value,
+                "median": 1.0,
+                "n": 0,
+                "direction": "cap",
+                "delta": round(value - 1.0, 4),
+                "threshold": 0.0,
+                "resident_hot": rec.get("resident_hot"),
+                "backend": rec.get("backend"),
+            }
+            (regressions if value > 1.0 else ok).append(entry)
+            continue
         dirn = direction(metric, rec.get("unit"))
         if dirn is None:
             skipped.append({"metric": metric, "reason": "no_direction"})

@@ -102,6 +102,10 @@ COUNTERS = {
     "pull.overlap_s": "pull/finalize seconds hidden behind other work",
     "pull.busy_s": "total pipelined pull+finalize wall (worker seconds)",
     "pull.bytes": "bytes routed through the pull pipeline (size hints)",
+    "shapecheck.checks": "dispatch shape/footprint validations run "
+    "by the graftshape runtime cross-check",
+    "shapecheck.violations": "model-instantiation or HBM-containment "
+    "violations the cross-check recorded",
     "tsan.accesses": "shared-state accesses the thread sanitizer saw",
     "tsan.acquires": "registered-lock acquisitions the sanitizer saw",
     "tsan.races": "lockset races detected (empty-intersection, "
@@ -149,6 +153,8 @@ EVENTS = {
     "fault.fatal": "supervised dispatch exhausted retries, aborting",
     "fault.degrade_host": "caller-counted host degradation (spill tree)",
     "faults.run_delta": "per-run fault-counter delta (= stats['faults'])",
+    "shapecheck.violation": "graftshape cross-check violation record "
+    "(family + detail)",
     "tsan.race": "thread sanitizer race record (site + thread roles)",
     "tsan.lock_inversion": "thread sanitizer lock-order inversion record",
 }

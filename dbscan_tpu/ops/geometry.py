@@ -232,8 +232,13 @@ def cell_histogram(points, cell_size):
     """
     idx, counts, inverse = cell_histogram_int(points, cell_size)
     cells = (
+        # host grid corners are f64 by design (reference merge
+        # precision) and never ship to a kernel. The literal-only
+        # dtype-drift rule needed a suppression here; the flow-based
+        # dtype-flow-drift successor tracks np-vs-jnp provenance and
+        # proves this astype host-side on its own.
         np.concatenate([idx, idx + 1], axis=-1)
-        .astype(np.float64)  # graftlint: disable=dtype-drift  host grid corners are f64 by design (reference merge precision), never shipped to a kernel
+        .astype(np.float64)
         * cell_size
     )
     return cells, counts, inverse

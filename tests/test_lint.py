@@ -227,7 +227,10 @@ def test_jit_scalar_arg_with_statics_is_clean(tmp_path):
     assert findings == []
 
 
-def test_dtype_drift_in_kernel_path(tmp_path):
+def test_dtype_flow_drift_literal_in_kernel_path(tmp_path):
+    """Parity with the superseded literal-only rule: a float64 dtype
+    literal entering a jnp call in kernel code still flags — now under
+    the successor id."""
     findings, _ = _lint_source(
         tmp_path,
         """
@@ -239,7 +242,27 @@ def test_dtype_drift_in_kernel_path(tmp_path):
         name="kern.py",
         subdir="ops",
     )
-    assert _rules(findings) == ["dtype-drift"]
+    assert _rules(findings) == ["dtype-flow-drift"]
+
+
+def test_dtype_flow_drift_through_value_flow(tmp_path):
+    """The flow half the literal rule could not see: an explicit f64
+    VALUE built host-side and later fed to a device op."""
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def kern(x):
+            w = np.float64(2.5)
+            return jnp.sum(x * w)
+        """,
+        name="kern_flow.py",
+        subdir="ops",
+    )
+    assert _rules(findings) == ["dtype-flow-drift"]
+    assert findings[0].line == 7
 
 
 def test_dtype_f32_kernel_and_host_f64_are_clean(tmp_path):
@@ -259,6 +282,42 @@ def test_dtype_f32_kernel_and_host_f64_are_clean(tmp_path):
         subdir="ops",
     )
     assert findings == []  # host np.* f64 is exempt by design
+
+
+def test_dtype_flow_host_astype_f64_is_clean(tmp_path):
+    """The geometry.py migration pin: host-provenance f64 (an astype on
+    an np.concatenate result) no longer needs the suppression the
+    literal rule required — the flow rule proves it never reaches a
+    device op."""
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import numpy as np
+
+        def grid_corners(idx, cell):
+            return np.concatenate([idx, idx + 1]).astype(np.float64) * cell
+        """,
+        name="kern3.py",
+        subdir="ops",
+    )
+    assert findings == []
+
+
+def test_dtype_drift_alias_suppression_still_works(tmp_path):
+    """A suppression written against the RETIRED id keeps silencing the
+    successor's findings (lint.ALIASES)."""
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def kern(x):
+            return jnp.asarray(x, dtype="float64")  # graftlint: disable=dtype-drift  parity fixture
+        """,
+        name="kern4.py",
+        subdir="ops",
+    )
+    assert findings == []
 
 
 # --- telemetry-schema family ------------------------------------------
@@ -1018,6 +1077,318 @@ def test_suppression_unknown_rule_flags(tmp_path):
     assert _rules(findings) == ["suppress-unknown-rule"]
 
 
+# --- shapes family (graftshape) ---------------------------------------
+
+
+def test_shape_mismatch_broadcast(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def root(x):
+            a = jnp.zeros((4, 8))
+            b = jnp.ones((3, 8))
+            return a + b
+        """,
+    )
+    assert _rules(findings) == ["shape-mismatch"]
+    assert findings[0].line == 9
+
+
+def test_shape_mismatch_symbolic_dims_unify_clean(tmp_path):
+    """A symbolic dim (x.shape[0]) against a concrete one is NOT a
+    provable conflict — the interpreter must stay conservative."""
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def root(x):
+            n = x.shape[0]
+            a = jnp.zeros((n, 8))
+            b = jnp.ones((128, 8))
+            c = jnp.concatenate([a, b])
+            return c * jnp.ones((1, 8))
+        """,
+    )
+    assert findings == []
+
+
+def test_shape_mismatch_concat_off_axis(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def root(x):
+            a = jnp.zeros((4, 8))
+            b = jnp.ones((4, 9))
+            return jnp.concatenate([a, b], axis=0)
+        """,
+    )
+    assert _rules(findings) == ["shape-mismatch"]
+
+
+def test_shape_mismatch_dot_contraction(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def root(x):
+            a = jnp.zeros((4, 8))
+            b = jnp.ones((9, 5))
+            return jnp.dot(a, b)
+        """,
+    )
+    assert _rules(findings) == ["shape-mismatch"]
+
+
+def test_shape_unratcheted_dim_flags(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import numpy as np
+        import jax
+
+        fn = jax.jit(lambda x: x)
+
+        def drive(pts):
+            n = len(pts)
+            buf = np.zeros((n, 4), dtype=np.float32)
+            return fn(buf)
+        """,
+    )
+    assert _rules(findings) == ["shape-unratcheted-dim"]
+
+
+def test_shape_ratcheted_dim_is_clean(tmp_path):
+    """The repo idiom: a dim routed through a sanctioned padding
+    function carries ratchet provenance and passes."""
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import numpy as np
+        import jax
+
+        fn = jax.jit(lambda x: x)
+
+        def _ratchet(floors, key, val, cap=None):
+            return val
+
+        def drive(pts):
+            n = _ratchet(None, "k", len(pts))
+            buf = np.zeros((n, 4), dtype=np.float32)
+            return fn(buf)
+        """,
+    )
+    assert findings == []
+
+
+def test_hbm_over_budget_constructed_array(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def root(x):
+            big = jnp.zeros((1 << 20, 1 << 20), dtype=jnp.float32)
+            return big.sum()
+        """,
+    )
+    assert _rules(findings) == ["hbm-over-budget"]
+
+
+def test_hbm_within_budget_is_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def root(x):
+            tile = jnp.zeros((4096, 4096), dtype=jnp.float32)
+            return tile.sum()
+        """,
+    )
+    assert findings == []
+
+
+def test_hbm_over_budget_family_knobs(tmp_path, monkeypatch):
+    """The knob-bound worst-case gate: a tracked_call dispatch family
+    whose FAMILY_MODELS envelope exceeds the device budget under the
+    LIVE env knobs fails lint before it OOMs a chip."""
+    monkeypatch.setenv("DBSCAN_GROUP_SLOTS", str(1 << 34))
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        from dbscan_tpu.obs import compile as obs_compile
+
+        def dispatch(fn, pts, mask):
+            return obs_compile.tracked_call(
+                "dispatch.dense", fn, pts, mask
+            )
+        """,
+    )
+    assert _rules(findings) == ["hbm-over-budget"]
+    assert "DBSCAN_GROUP_SLOTS" in findings[0].message
+
+
+def test_shard_indivisible_flags(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        def block(x):
+            return x * 2
+
+        def drive():
+            mesh = jax.make_mesh((4, 2), ("i", "j"))
+            fn = jax.jit(shard_map(
+                block, mesh=mesh, in_specs=(P("i", None),),
+                out_specs=P("i", None),
+            ))
+            return fn(jnp.zeros((6, 8)))
+        """,
+    )
+    assert _rules(findings) == ["shard-indivisible"]
+
+
+def test_shard_divisible_is_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        def block(x):
+            return x * 2
+
+        def drive():
+            mesh = jax.make_mesh((4, 2), ("i", "j"))
+            fn = jax.jit(shard_map(
+                block, mesh=mesh, in_specs=(P("i", None),),
+                out_specs=P("i", None),
+            ))
+            return fn(jnp.zeros((8, 8)))
+        """,
+    )
+    assert findings == []
+
+
+def test_rules_glob_matches_retired_alias(tmp_path, capsys):
+    """--rules dtype-drift (the RETIRED id) still gates the successor's
+    findings, so existing CI pipelines survive the rename."""
+    bad = tmp_path / "ops"
+    bad.mkdir()
+    (bad / "k.py").write_text(
+        "import jax.numpy as jnp\n\n"
+        "def kern(x):\n"
+        "    return jnp.asarray(x, dtype='float64')\n"
+    )
+    assert lint_main(["--rules", "dtype-drift", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "dtype-flow-drift" in out
+    # and a disjoint real family still filters it out
+    assert lint_main(["--rules", "race-*", str(bad)]) == 0
+
+
+def test_baseline_written_under_old_rule_id_still_matches(
+    tmp_path, capsys
+):
+    """A baseline row recorded under the retired id suppresses the
+    successor's finding (canonicalized on read)."""
+    bad = tmp_path / "ops"
+    bad.mkdir()
+    src = bad / "k.py"
+    src.write_text(
+        "import jax.numpy as jnp\n\n"
+        "def kern(x):\n"
+        "    return jnp.asarray(x, dtype='float64')\n"
+    )
+    base = tmp_path / "baseline.json"
+    assert lint_main(["--write-baseline", str(base), str(bad)]) == 0
+    capsys.readouterr()
+    # rewrite the baseline rows as the OLD linter would have recorded
+    # them: retired id AND its old message text — the successor's
+    # messages differ by design, so retired-id rows must match
+    # message-agnostically (rule+path only)
+    payload = json.loads(base.read_text())
+    for row in payload["findings"]:
+        assert row["rule"] == "dtype-flow-drift"
+        row["rule"] = "dtype-drift"
+        row["message"] = (
+            '"float64" dtype literal in kernel code: the device '
+            "kernels are f32/bf16 (config.Precision); a float64 "
+            "constant upcasts or retraces the kernel — use the "
+            "configured dtype"
+        )
+    base.write_text(json.dumps(payload))
+    assert lint_main(["--baseline", str(base), str(bad)]) == 0
+    assert "(1 baselined)" in capsys.readouterr().err
+
+
+def test_cli_sarif_output_schema(tmp_path, capsys):
+    """SARIF 2.1.0 pin: the keys CI code-scanning ingestion reads are
+    stable — version/$schema, the driver's rule catalog, and one
+    result per finding with a 1-based region."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nv = os.environ.get('DBSCAN_X')\n")
+    assert lint_main(["--format", "sarif", str(bad)]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "graftlint"
+    assert [r["id"] for r in driver["rules"]] == ["env-direct-read"]
+    (result,) = run["results"]
+    assert result["ruleId"] == "env-direct-read"
+    assert result["level"] == "warning"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+    assert loc["region"] == {"startLine": 2, "startColumn": 5}
+    # exit contract identical across formats: clean file, sarif, exit 0
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    capsys.readouterr()
+    assert lint_main(["--format", "sarif", str(good)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_shape_table(capsys):
+    assert lint_main(["--shape-table"]) == 0
+    out = capsys.readouterr().out
+    for family in ("dispatch.dense", "dispatch.banded_p1",
+                   "cellcc.postpass", "spill.gather"):
+        assert f"`{family}`" in out
+    assert "runtime-gated" in out  # the data-scaled families
+    from dbscan_tpu.obs import schema
+
+    # every declared compile family has a row (model completeness pin)
+    for family in schema.COMPILE_FAMILIES:
+        assert f"`{family}`" in out
+
+
 # --- repo-wide pins ---------------------------------------------------
 
 
@@ -1147,7 +1518,26 @@ def test_cli_list_rules(capsys):
 
 def test_console_entrypoint_gates_repo():
     """The CI command verbatim: python -m dbscan_tpu.lint dbscan_tpu/
-    exits 0 on the repo."""
+    exits 0 on the repo — with EVERY rule family (old + shapes) active.
+    The explicit ``--rules`` sweep pins that no family silently drops
+    out of the default run: a glob per family, all gating the same
+    invocation."""
+    all_families = (
+        "host-sync-*,jit-*,schema-*,env-*,race-*,collective-*,"
+        "pull-in-collective,shape-*,dtype-flow-drift,hbm-over-budget,"
+        "shard-indivisible,suppress-*,parse-error"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "dbscan_tpu.lint", "--rules",
+         all_families, PKG],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # the filtered sweep and the default run gate identically
     proc = subprocess.run(
         [sys.executable, "-m", "dbscan_tpu.lint", PKG],
         capture_output=True,
@@ -1157,3 +1547,11 @@ def test_console_entrypoint_gates_repo():
         timeout=240,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+    # every rule family id is registered (catalog completeness)
+    from dbscan_tpu import lint as _lm
+
+    for rule in ("shape-mismatch", "shape-unratcheted-dim",
+                 "dtype-flow-drift", "hbm-over-budget",
+                 "shard-indivisible"):
+        assert rule in _lm.RULES
+    assert _lm.ALIASES == {"dtype-drift": "dtype-flow-drift"}

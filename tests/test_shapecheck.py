@@ -1,0 +1,501 @@
+"""graftshape: the symbolic shape/dtype/HBM abstract-interpretation
+core (dbscan_tpu/lint/absint.py), the dispatch-family models
+(lint/shapes.py), and the runtime cross-check (lint/shapecheck.py).
+
+Pins, per the PR acceptance bar:
+
+- the dim algebra and unification edge cases: monomial solving (shard
+  block division ``B == 512*NB``), ratchet floor raises (a GROWN
+  observed dim still instantiates the per-call model), static-argnum
+  specialization (a static param usable as a symbolic dim), and the
+  conservative no-refutation rule for under-determined dims;
+- the family models against REAL runs: dense, banded, resident, spill
+  and streaming trains validate with zero violations on this backend,
+  and the model constants mirror the packer's (BANDED_BLOCK);
+- the HBM containment half: a dispatch whose observed allocator growth
+  exceeds the static prediction is a violation (faked stats — the CPU
+  backend has none);
+- the bench gate: ``hbm_pred_ratio`` ingests with unit ``ratio`` and
+  ``obs/regress.py`` hard-caps it at 1.0 with no history needed;
+- the tier-1 rerun: a distributed + streaming train passes under
+  ``DBSCAN_SHAPECHECK=1`` with an EMPTY violation report
+  (``DBSCAN_SHAPECHECK_REPORT`` JSON, asserted from outside the
+  process).
+
+STRICT mode is on for every interpreter-driven test here so a modeling
+crash fails the suite instead of being swallowed by the per-function
+guard.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from dbscan_tpu.lint import absint, shapecheck, shapes
+from dbscan_tpu.lint.absint import E, Sym, unify_dim
+
+pytestmark = pytest.mark.shapecheck
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def rt():
+    """A fresh, enabled cross-check runtime; always disabled after."""
+    shapecheck.enable()
+    shapecheck.reset()
+    yield shapecheck
+    shapecheck.disable()
+
+
+@pytest.fixture(autouse=True)
+def strict_absint():
+    absint.STRICT = True
+    yield
+    absint.STRICT = False
+
+
+# --- dim algebra ------------------------------------------------------
+
+
+def test_expr_normalization_and_algebra():
+    P, B = Sym("P"), Sym("B")
+    e = (E.of(P) + P) * 3 + 4  # 6P + 4
+    assert e.evaluate({"P": 10}) == 64
+    assert (E.of(P) * B).evaluate({"P": 3, "B": 5}) == 15
+    assert (E.of(P) - P).const() == 0
+    assert E(7).const() == 7
+    assert (E.of(P) * 0).const() == 0
+    # unbound symbols evaluate to None, partial substitution folds
+    assert (E.of(P) * B).evaluate({"P": 3}) is None
+    assert (E.of(P) * B).substitute({"P": 3}).evaluate({"B": 5}) == 15
+
+
+def test_nbytes_symbolic():
+    P = Sym("P")
+    e = absint.nbytes((E.of(P), E(4)), "f32")
+    assert e.evaluate({"P": 100}) == 1600
+    assert absint.nbytes(None, "f32") is None
+    assert absint.nbytes((E(2),), "nonsense") is None
+
+
+def test_unify_concrete_and_symbolic():
+    subst = {}
+    assert unify_dim(E(8), 8, subst)
+    assert not unify_dim(E(8), 9, subst)
+    assert unify_dim(E.of(Sym("P")), 12, subst) and subst["P"] == 12
+    # a bound symbol must stay consistent
+    assert unify_dim(E.of(Sym("P")), 12, subst)
+    assert not unify_dim(E.of(Sym("P")), 13, subst)
+
+
+def test_unify_monomial_shard_block_division():
+    """The shard-block edge case: 512*NB against an observed width
+    solves NB when divisible and REFUTES when not."""
+    subst = {}
+    assert unify_dim(E(512) * Sym("NB"), 1024, subst)
+    assert subst["NB"] == 2
+    assert not unify_dim(E(512) * Sym("NB"), 1000, {})
+    # and the solved binding participates in later constraints
+    assert unify_dim(E.of(Sym("NB")) * 512, 1024, dict(subst))
+
+
+def test_unify_under_determined_never_refutes():
+    # two unbound symbols cannot be refuted by one observation
+    assert unify_dim(E.of(Sym("A")) * Sym("B"), 7, {})
+
+
+def test_ratchet_floor_raise_instantiates_per_call():
+    """A streaming ratchet raise grows B between dispatches; each call
+    unifies against a FRESH substitution, so the grown shape still
+    instantiates the same symbolic model."""
+    for b in (512, 1024, 1536):  # a raising rung sequence
+        specs = [((8, b, 2), "f32"), ((8, b), "bool")]
+        subst, problems = shapes.validate_args("dispatch.dense", specs)
+        assert problems == []
+        assert subst["B"] == b
+
+
+def test_static_argnum_specialization_dim():
+    """A static-argnums param is a compile-time int the kernel may use
+    as a dimension: the interpreter binds it symbolically, so shapes
+    built from it unify instead of going unknown (and provably
+    conflicting concrete dims still flag)."""
+    import dbscan_tpu.lint as lint_mod
+
+    src = textwrap.dedent(
+        """
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def root(x, k):
+            a = jnp.zeros((k, 8))
+            b = jnp.ones((k, 8))
+            return a + b
+        """
+    )
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "snippet.py")
+        with open(p, "w") as f:
+            f.write(src)
+        findings, _ = lint_mod.lint_paths([p])
+    assert findings == []
+
+
+# --- family-model validation -----------------------------------------
+
+
+def test_model_constants_mirror_the_packer():
+    from dbscan_tpu.parallel import binning
+
+    assert shapes.BANDED_BLOCK == binning.BANDED_BLOCK
+    assert shapes.BANDED_ROWS == binning.BANDED_ROWS
+
+
+def test_models_cover_every_declared_family():
+    from dbscan_tpu.obs import schema
+
+    assert set(shapes.FAMILY_MODELS) == set(schema.COMPILE_FAMILIES)
+
+
+def test_banded_model_constraint():
+    base = {
+        "points": ((4, 1024, 2), "f32"),
+        "mask": ((4, 1024), "bool"),
+        "rel_starts": ((4, 1024, 5), "u16"),
+        "spans": ((4, 1024, 5), "u16"),
+        "slab_starts": ((4, 2, 5), "i32"),
+        "cx": ((4, 1024), "i32"),
+    }
+    specs = list(base.values())
+    subst, problems = shapes.validate_args("dispatch.banded_p1", specs)
+    assert problems == []
+    assert subst["NB"] == 2 and subst["B"] == 1024
+    # an inconsistent block count violates B == 512*NB
+    bad = dict(base, slab_starts=((4, 3, 5), "i32"))
+    _, problems = shapes.validate_args(
+        "dispatch.banded_p1", list(bad.values())
+    )
+    assert any("constraint" in p for p in problems)
+
+
+def test_model_rejects_rank_dtype_and_binding_drift():
+    # rank drift
+    _, p1 = shapes.validate_args(
+        "dispatch.dense", [((8, 512), "f32"), ((8, 512), "bool")]
+    )
+    assert any("rank" in p for p in p1)
+    # dtype class drift (int points)
+    _, p2 = shapes.validate_args(
+        "dispatch.dense", [((8, 512, 2), "i32"), ((8, 512), "bool")]
+    )
+    assert any("dtype" in p for p in p2)
+    # inconsistent P across args
+    _, p3 = shapes.validate_args(
+        "dispatch.dense", [((8, 512, 2), "f32"), ((9, 512), "bool")]
+    )
+    assert any("does not instantiate" in p for p in p3)
+    # unknown family is itself a violation
+    _, p4 = shapes.validate_args("dispatch.nope", [])
+    assert p4 and "undeclared" in p4[0]
+
+
+def test_postpass_tuple_coupling():
+    cores = [((2, 512), "bool"), ((4, 512), "bool")]
+    bitses_ok = [((2, 512), "i32"), ((4, 512), "i32")]
+    segflags = [((1024,), "bool"), ((2048,), "bool")]
+    or_idx = ((64,), "i32")
+    _, problems = shapes.validate_args(
+        "cellcc.postpass", [cores, bitses_ok, segflags, or_idx]
+    )
+    assert problems == []
+    bitses_bad = [((2, 512), "i32"), ((3, 512), "i32")]
+    _, problems = shapes.validate_args(
+        "cellcc.postpass", [cores, bitses_bad, segflags, or_idx]
+    )
+    assert any("shape" in p for p in problems)
+
+
+def test_scalar_passthrough_args_tolerated(rt):
+    """Static-argnum passthrough: trailing non-array args beyond the
+    declared model do not fail validation."""
+    pts = np.zeros((8, 512, 2), np.float32)
+    mask = np.zeros((8, 512), bool)
+    h = rt.runtime().observe_call("dispatch.dense", (pts, mask, 7))
+    rt.runtime().settle_call(h)
+    assert rt.report()["violations"] == []
+
+
+def test_undeclared_extra_array_arg_is_a_violation(rt):
+    """A kernel signature growing an ARRAY the model does not declare
+    must fail the cross-check — zip truncation would otherwise let new
+    buffers ship unregistered."""
+    pts = np.zeros((8, 512, 2), np.float32)
+    mask = np.zeros((8, 512), bool)
+    extra = np.zeros((8, 512), np.int32)
+    rt.runtime().observe_call("dispatch.dense", (pts, mask, extra))
+    rep = rt.report()
+    assert len(rep["violations"]) == 1
+    assert "undeclared extra array" in rep["violations"][0]["detail"]
+
+
+# --- runtime cross-check against real runs -----------------------------
+
+
+def test_runtime_clean_on_dense_and_banded_train(rt):
+    from dbscan_tpu import train
+
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(4000, 2)) * 10
+    train(pts, eps=0.5, min_points=5, max_points_per_partition=400)
+    train(
+        pts, eps=0.5, min_points=5, max_points_per_partition=1500,
+        neighbor_backend="banded",
+    )
+    rep = rt.report()
+    assert rep["enabled"] and rep["checks"] > 0
+    assert rep["violations"] == [], rep["violations"]
+    assert "dispatch.dense" in rep["sites"]
+    assert "dispatch.banded_p1" in rep["sites"]
+    assert "cellcc.postpass" in rep["sites"]
+    rt.assert_clean()
+
+
+def test_runtime_clean_on_streaming(rt):
+    from dbscan_tpu.streaming import StreamingDBSCAN
+
+    rng = np.random.default_rng(1)
+    s = StreamingDBSCAN(eps=0.5, min_points=5, window=3000)
+    for _ in range(3):
+        s.update(rng.normal(size=(1200, 2)) * 10)
+    rep = rt.report()
+    assert rep["checks"] > 0
+    assert rep["violations"] == [], rep["violations"]
+    rt.assert_clean()
+
+
+def test_runtime_clean_on_spill_gather(rt):
+    from dbscan_tpu.parallel import spill_device
+
+    ops = spill_device.DeviceNodeOps.from_host(
+        np.random.default_rng(0).normal(size=(512, 16))
+    )
+    ops.take(np.arange(0, 256, 2))
+    rep = rt.report()
+    assert rep["sites"]["spill.gather"]["calls"] == 1
+    assert rep["violations"] == []
+
+
+def test_runtime_flags_model_drift(rt):
+    """A dispatch whose real shapes the model cannot explain is a
+    violation — the contract that forces model updates alongside
+    kernel-signature changes."""
+    pts = np.zeros((8, 512), np.float32)  # rank 2: model declares 3
+    mask = np.zeros((8, 512), bool)
+    rt.runtime().observe_call("dispatch.dense", (pts, mask))
+    rep = rt.report()
+    assert len(rep["violations"]) == 1
+    assert rep["violations"][0]["kind"] == "shape-model"
+    with pytest.raises(AssertionError):
+        rt.assert_clean()
+
+
+def test_runtime_hbm_over_prediction(rt, monkeypatch):
+    """Faked allocator stats: growth past the static prediction across
+    a dispatch is a violation; growth within it is not."""
+    probes = iter([1000, 10**13, 1000, 2000])
+    monkeypatch.setattr(
+        shapecheck, "_bytes_in_use", lambda: next(probes)
+    )
+    pts = np.zeros((8, 512, 2), np.float32)
+    mask = np.zeros((8, 512), bool)
+    r = rt.runtime()
+    h = r.observe_call("dispatch.dense", (pts, mask))
+    assert h["predicted"] is not None
+    r.settle_call(h)  # grew 10**13 - 1000 >> predicted
+    rep = rt.report()
+    assert any(
+        v["kind"] == "hbm-over-prediction" for v in rep["violations"]
+    )
+    # a contained dispatch records no violation
+    h = r.observe_call("dispatch.dense", (pts, mask))
+    r.settle_call(h)
+    assert (
+        len([v for v in rt.report()["violations"]
+             if v["kind"] == "hbm-over-prediction"]) == 1
+    )
+    # and both halves of the bench gate are tracked: the predicted
+    # envelope and the PER-RUN observed peak (dispatch-boundary
+    # samples, not the allocator's process-monotone figure)
+    assert rt.predicted_peak() is not None
+    assert rt.observed_peak() == 10**13
+    # a fresh runtime resets the observed peak — the property that
+    # keeps a second bench run's ratio independent of the first
+    shapecheck.reset()
+    assert rt.observed_peak() is None
+
+
+def test_disabled_path_is_a_noop():
+    shapecheck.disable()
+    assert shapecheck.runtime() is None
+    rep = shapecheck.report()
+    assert rep == {
+        "enabled": False,
+        "checks": 0,
+        "sites": {},
+        "violations": [],
+        "predicted_peak_bytes": None,
+        "observed_peak_bytes": None,
+    }
+    shapecheck.assert_clean()  # no violations when disabled
+    assert shapecheck.predicted_peak() is None
+    assert shapecheck.observed_peak() is None
+
+
+def test_enable_idempotent_reset_and_write_report(rt, tmp_path):
+    r1 = shapecheck.enable()
+    assert shapecheck.enable() is r1  # idempotent
+    pts = np.zeros((8, 512, 2), np.float32)
+    mask = np.zeros((8, 512), bool)
+    r1.observe_call("dispatch.dense", (pts, mask))
+    path = shapecheck.write_report(str(tmp_path / "sc.json"))
+    rep = json.loads(open(path).read())
+    assert rep["enabled"] is True and rep["checks"] == 1
+    shapecheck.reset()
+    assert shapecheck.report()["checks"] == 0
+    assert shapecheck.enabled()
+
+
+def test_telemetry_deltas_declared_and_exact(rt):
+    from dbscan_tpu import obs
+
+    st = obs.enable()
+    try:
+        pts = np.zeros((8, 512), np.float32)  # rank drift -> violation
+        mask = np.zeros((8, 512), bool)
+        rt.runtime().observe_call("dispatch.dense", (pts, mask))
+        shapecheck.emit_telemetry()
+        c = obs.counters()
+        assert c.get("shapecheck.checks") == 1
+        assert c.get("shapecheck.violations") == 1
+        shapecheck.emit_telemetry()  # deltas: no double count
+        c = obs.counters()
+        assert c.get("shapecheck.checks") == 1
+        ev = [
+            i for i in st.tracer.instants
+            if i[0] == "shapecheck.violation"
+        ]
+        assert len(ev) == 1 and ev[0][2]["family"] == "dispatch.dense"
+    finally:
+        obs.disable()
+
+
+# --- bench gate --------------------------------------------------------
+
+
+def test_hbm_pred_ratio_ingests_with_ratio_unit(tmp_path):
+    from dbscan_tpu.obs import bench_history
+
+    cap = tmp_path / "BENCH_X.json"
+    cap.write_text(json.dumps({
+        "metric": "tpu_1m_dense_mpts",
+        "value": 0.7,
+        "unit": "Mpoints/s",
+        "backend": "tpu",
+        "hbm_pred_ratio": 0.93,
+        "anchor_hbm_pred_ratio": 0.88,
+    }))
+    recs = bench_history.parse_capture_file(str(cap))
+    ratios = {
+        r["metric"]: r for r in recs if r["metric"].endswith("_pred_ratio")
+    }
+    assert set(ratios) == {"hbm_pred_ratio", "anchor_hbm_pred_ratio"}
+    for r in ratios.values():
+        assert r["unit"] == "ratio"
+
+
+def test_regress_hard_caps_pred_ratio():
+    """<= 1.0 passes with NO history; above 1.0 regresses regardless of
+    spread — a containment contract, not a noise-widened direction."""
+    from dbscan_tpu.obs import regress
+
+    fresh_ok = [{"metric": "anchor_hbm_pred_ratio", "value": 0.97,
+                 "backend": "tpu", "source": "a.json"}]
+    fresh_bad = [{"metric": "anchor_hbm_pred_ratio", "value": 1.08,
+                  "backend": "tpu", "source": "a.json"}]
+    res = regress.compare(fresh_ok, history=[])
+    assert res["regressions"] == [] and len(res["ok"]) == 1
+    res = regress.compare(fresh_bad, history=[])
+    assert len(res["regressions"]) == 1
+    e = res["regressions"][0]
+    assert e["direction"] == "cap" and e["value"] == 1.08
+    # the shared renderer handles the cap entry
+    assert "anchor_hbm_pred_ratio" in regress.format_regression(e)
+
+
+# --- the tier-1 rerun --------------------------------------------------
+
+
+def test_distributed_and_streaming_under_shapecheck_env():
+    """The acceptance gate from OUTSIDE the process: a distributed
+    (dense + banded) and streaming train under DBSCAN_SHAPECHECK=1
+    records observed shapes instantiating the static model at every
+    tracked dispatch site, and an EMPTY violation report."""
+    report = os.path.join(REPO, "bench", ".sc_report_test.json")
+    if os.path.exists(report):
+        os.remove(report)
+    script = textwrap.dedent(
+        """
+        import numpy as np
+        from dbscan_tpu import train
+        from dbscan_tpu.streaming import StreamingDBSCAN
+
+        rng = np.random.default_rng(0)
+        pts = rng.normal(size=(5000, 2)) * 10
+        train(pts, eps=0.5, min_points=5, max_points_per_partition=400)
+        train(pts, eps=0.5, min_points=5,
+              max_points_per_partition=1500, neighbor_backend="banded")
+        s = StreamingDBSCAN(eps=0.5, min_points=5, window=3000)
+        for _ in range(3):
+            s.update(rng.normal(size=(1200, 2)) * 10)
+        """
+    )
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "DBSCAN_SHAPECHECK": "1",
+        "DBSCAN_SHAPECHECK_REPORT": report,
+    }
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            env=env,
+            timeout=420,
+        )
+        assert proc.returncode == 0, (
+            proc.stdout[-4000:] + proc.stderr[-2000:]
+        )
+        rep = json.loads(open(report).read())
+        assert rep["enabled"] is True
+        assert rep["violations"] == [], rep["violations"]
+        assert rep["checks"] > 0
+        # the run exercised both engines' dispatch sites
+        for fam in ("dispatch.dense", "dispatch.banded_p1",
+                    "cellcc.postpass", "cellcc.gather"):
+            assert fam in rep["sites"], sorted(rep["sites"])
+            assert rep["sites"][fam]["violations"] == 0
+    finally:
+        if os.path.exists(report):
+            os.remove(report)
