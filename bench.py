@@ -245,6 +245,11 @@ def sparse_row(prefix: str, n: int, maxpp: int) -> dict:
         f"{prefix}_leaves": stats.get("n_partitions"),
         f"{prefix}_dup": stats.get("duplication_factor"),
         f"{prefix}_phases": _phases(stats),
+        # the ROADMAP-item-2 figures, flat so the history ingests and
+        # the regress gate trends them (walls regress up): the spill
+        # wall of the hot rep + the level-build round count (0 = host
+        # recursion)
+        **_spill_fields(prefix, stats),
     }
     cpu_n = int(os.environ.get("BENCH_SPARSE_CPU_N", "30000"))
     out.update(_row_cpu_baseline(prefix, "sparse", cpu_n, n / dt))
@@ -263,6 +268,27 @@ V5E_BF16_PEAK = 197e12
 # issue ~ 8x128 lanes x 4 ALUs x ~0.94 GHz x 1 FLOP = ~3.9 TFLOP/s.
 V5E_HBM_BYTES_S = 819e9
 V5E_VPU_F32_PEAK = 3.9e12
+
+
+def _spill_fields(prefix: str, stats: dict) -> dict:
+    """Flat spill-tree figures for a cosine/sparse row: the spill wall
+    (promotable `_s` key, regress-up) and the level-synchronous build's
+    round count. Empty when the run never spilled."""
+    t = dict(stats.get("timings") or {})
+    if t.get("spill_partition_s") is None:
+        return {}  # the run never spilled (grid metrics)
+    out = {
+        f"{prefix}_spill_partition_s": round(
+            float(t["spill_partition_s"]), 3
+        )
+    }
+    # stamped only when the level build actually ran: 0 means the host
+    # recursion (CPU bench, or a degraded device build) — mixing those
+    # into the gated history would make a silent degrade read as the
+    # best possible depth and flag the next healthy capture
+    if stats.get("spill_levels"):
+        out[f"{prefix}_spill_levels"] = int(stats["spill_levels"])
+    return out
 
 
 def _phases(stats, top=8) -> dict:
@@ -787,6 +813,9 @@ def anchor_row(prefix: str, n: int, kind: str, maxpp: int) -> dict:
         # the cosine wall is only comparable across captures once each
         # rep says whether it paid the resident-payload upload
         **{f"{prefix}_{k2}": v for k2, v in rep_obs.items()},
+        # spill wall + level-build rounds (cosine rows; empty for the
+        # grid metrics, which never spill)
+        **_spill_fields(prefix, model.stats),
     }
     if kind == "euclidean" and os.environ.get("BENCH_MFU", "1") == "1":
         import jax

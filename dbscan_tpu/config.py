@@ -324,6 +324,20 @@ ENV_VARS = _env_table(
         "is live.",
     ),
     EnvVar(
+        "DBSCAN_SPILL_DEVICE_TREE", "bool", True,
+        "Level-synchronous device spill-tree build (one fused dispatch "
+        "per tree level over all open nodes); engages wherever the "
+        "device passes are live. 0 keeps the node-recursive host build "
+        "as the parity oracle.",
+    ),
+    EnvVar(
+        "DBSCAN_SPILL_LEVEL_SLOTS", "int", 1 << 28,
+        "Instance*pivot element budget per level dispatch of the "
+        "device spill tree: the pivot-slot rung is halved until "
+        "instances * pivot_slots fits, bounding the level's [M, m] "
+        "working set.",
+    ),
+    EnvVar(
         "DBSCAN_COMPILE_STORM_THRESHOLD", "int", 12,
         "Compiles per dispatch family past which obs/compile.py logs a "
         "once-per-family recompile-storm warning; <=0 disables.",

@@ -69,9 +69,13 @@ _KINDS = (TRANSIENT, RESOURCE_EXHAUSTED, PERSISTENT)
 SITE_DISPATCH = "dispatch"  # dense/resident kernel group fan-out
 SITE_BANDED = "banded"  # banded phase-1 group fan-out
 SITE_SPILL = "spill"  # spill-tree device ops (spill_device.py)
+SITE_SPILL_LEVEL = "spill_level"  # level-synchronous spill-tree dispatch
 SITE_STREAM = "stream"  # streaming per-batch update step
 SITE_PULL = "pull"  # pipelined compact-chunk pull (parallel/pipeline.py)
-_SITES = (SITE_DISPATCH, SITE_BANDED, SITE_SPILL, SITE_STREAM, SITE_PULL, "*")
+_SITES = (
+    SITE_DISPATCH, SITE_BANDED, SITE_SPILL, SITE_SPILL_LEVEL,
+    SITE_STREAM, SITE_PULL, "*",
+)
 
 
 class FaultInjected(Exception):
@@ -109,7 +113,7 @@ class FaultClause:
 
 
 _CLAUSE_RE = re.compile(
-    r"^(?P<site>[a-z*]+)#(?P<ord>\d+):(?P<kind>[A-Z_]+)"
+    r"^(?P<site>[a-z_*]+)#(?P<ord>\d+):(?P<kind>[A-Z_]+)"
     r"(?:\*(?P<count>\d+))?$"
 )
 
@@ -119,8 +123,9 @@ def parse_fault_spec(spec: str) -> Tuple[FaultClause, ...]:
 
     Grammar: semicolon-separated clauses ``site#ordinal:KIND[*count]``:
 
-    - ``site``: ``dispatch`` | ``banded`` | ``spill`` | ``stream`` |
-      ``*`` (any supervised site, ordinal counted globally);
+    - ``site``: ``dispatch`` | ``banded`` | ``spill`` | ``spill_level``
+      | ``stream`` | ``pull`` | ``*`` (any supervised site, ordinal
+      counted globally);
     - ``ordinal``: 0-based index of the supervised dispatch at that
       site (each :func:`supervised` call consumes one ordinal);
     - ``KIND``: ``TRANSIENT`` (fails ``count`` attempts, then heals),

@@ -317,8 +317,98 @@ def _models() -> Dict[str, FamilyModel]:
                 note="unbounded statically: scales with resident "
                 "payload rows N (gated at runtime)",
             ),
+            _level_model(),
+            _level_final_model(),
         )
     }
+
+
+#: pivot-slot ceiling of the level build (mirrors spill._MAX_PIVOTS via
+#: spill_device._ladder8's cap; pinned equal by tests/test_spill_tree.py
+#: — lint stays stdlib-only, so no import)
+LEVEL_PIVOT_CAP = 192
+
+
+def _level_model() -> "FamilyModel":
+    """The level-synchronous spill-tree step (``spill.level``): compact
+    the previous level's membership bits into the new slot-contiguous
+    layout, then batched pivot selection + membership over the open
+    prefix. Trailing scalars (instance totals, halo, slack) ride as
+    plain Python numbers. Data-scaled (resident rows N, per-level
+    instance capacity M) — runtime-gated like dispatch.resident."""
+    N, D, MP, MB, MQ, SP, SP1, T, MS, S, S1 = (
+        _sy(n)
+        for n in ("N", "D", "MP", "MB", "MQ", "SP", "SP1", "T", "MS",
+                  "S", "S1")
+    )
+    mcap = E(LEVEL_PIVOT_CAP)
+    return FamilyModel(
+        "spill.level",
+        [
+            ArgModel("x", ("N", "D"), FLOAT),
+            ArgModel("idx_p", ("MP",), INT),
+            ArgModel("home_p", ("MP",), BOOL),
+            ArgModel("assign_p", ("MP",), INT),
+            ArgModel("member_p", ("MP", "MB"), INT),
+            ArgModel("base_p", ("SP1",), INT),
+            ArgModel("dest", ("SP", "MQ"), INT),
+            ArgModel("carry", ("SP",), BOOL),
+            ArgModel("out_base", ("T",), INT),
+            ArgModel("sel_pos", ("MS",), INT),
+            ArgModel("seed_pos", ("S",), INT),
+            ArgModel("m_req", ("S",), INT),
+            ArgModel("base", ("S1",), INT),
+        ],
+        # sampled selection rows + the gathered f32 rows and membership
+        # working set of the NEW layout (its capacity is duplication-
+        # bounded by ~2.4x the previous level's, folded into the MP
+        # factors; pivot slots capped at LEVEL_PIVOT_CAP) + the
+        # compaction cumsum over the previous layout + per-node pivot
+        # tables — deliberately generous upper bounds
+        overhead=(
+            MS * D * 8
+            + MP * D * 16
+            + MP * mcap * 32
+            + MP * MQ * 16
+            + S * mcap * D * 8
+            + S * mcap * mcap * 8
+        ),
+        constraints=[(SP1, SP + 1), (S1, S + 1), (MQ, E(8) * MB)],
+        static_slots=None,
+        note="one fused dispatch per tree level (compact + build); "
+        "unbounded statically: scales with the level's instance "
+        "count M (gated at runtime; m slots bounded by "
+        "DBSCAN_SPILL_LEVEL_SLOTS)",
+    )
+
+
+def _level_final_model() -> "FamilyModel":
+    """The closing compact-only dispatch (``spill.level_final``): the
+    last level's children are all leaves/fallbacks, so only the layout
+    scatter runs."""
+    MP, MB, MQ, SP, SP1, T = (
+        _sy(n) for n in ("MP", "MB", "MQ", "SP", "SP1", "T")
+    )
+    return FamilyModel(
+        "spill.level_final",
+        [
+            ArgModel("idx_p", ("MP",), INT),
+            ArgModel("home_p", ("MP",), BOOL),
+            ArgModel("assign_p", ("MP",), INT),
+            ArgModel("member_p", ("MP", "MB"), INT),
+            ArgModel("base_p", ("SP1",), INT),
+            ArgModel("dest", ("SP", "MQ"), INT),
+            ArgModel("carry", ("SP",), BOOL),
+            ArgModel("out_base", ("T",), INT),
+        ],
+        # the unpacked membership + cumsum over the previous layout plus
+        # the (ladder-padded, duplication-bounded) output buffers
+        overhead=MP * MQ * 16 + MP * 32,
+        constraints=[(SP1, SP + 1), (MQ, E(8) * MB)],
+        static_slots=None,
+        note="closing compact of the level build; data-scaled, "
+        "runtime-gated",
+    )
 
 
 FAMILY_MODELS: Dict[str, FamilyModel] = _models()

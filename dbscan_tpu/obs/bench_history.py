@@ -50,11 +50,13 @@ from dbscan_tpu.obs import schema
 # (_overlap_ratio: the pull-pipeline's overlapped/total pull share —
 # a throughput-like health figure that regresses DOWN; _pred_ratio:
 # graftshape's observed-HBM-peak / predicted-peak containment figure,
-# hard-capped at 1.0 by obs/regress.py)
+# hard-capped at 1.0 by obs/regress.py; _spill_levels: the level-
+# synchronous spill build's round count — a depth/dispatch figure that
+# regresses UP like a wall)
 _EXACT_KEYS = ("value", "seconds", "vs_baseline")
 _SUFFIXES = (
     "_seconds", "_s", "_mpts", "_vs_baseline", "_overlap_ratio",
-    "_pred_ratio",
+    "_pred_ratio", "_spill_levels",
 )
 # numeric-but-not-perf keys the suffix rule would otherwise catch —
 # declared with the telemetry schema (the keys are fault-counter
@@ -86,6 +88,8 @@ def _unit_for(metric: str, obj: dict) -> Optional[str]:
         return obj.get("unit")
     if metric.endswith(("_overlap_ratio", "_pred_ratio")):
         return "ratio"
+    if metric.endswith("_spill_levels"):
+        return "levels"
     if metric.endswith(("_seconds", "_s")) or metric == "seconds":
         return "s"
     if metric.endswith("_mpts"):

@@ -45,6 +45,8 @@ COMPILE_FAMILIES = (
     "cellcc.postpass",
     "cellcc.gather",
     "spill.gather",
+    "spill.level",
+    "spill.level_final",
 )
 
 #: HBM watermark sample sites (obs/memory.py `sample`): each emits
@@ -98,6 +100,10 @@ COUNTERS = {
     "compiles.wall_s": "summed wall of the cache-miss calls",
     "compiles.ratchet_raises": "streaming shape-floor raises post-warm-up",
     "memory.samples": "HBM watermark samples taken",
+    "spill.levels": "level-synchronous spill-tree build rounds run",
+    "spill.level_dispatches": "fused level-build dispatches issued "
+    "(one per level + the closing compact; bounded by tree depth, "
+    "vs one-per-node on the host recursion)",
     "pull.wait_s": "consumer seconds actually blocked on pipelined pulls",
     "pull.overlap_s": "pull/finalize seconds hidden behind other work",
     "pull.busy_s": "total pipelined pull+finalize wall (worker seconds)",
@@ -133,6 +139,10 @@ SPANS = {
     "spill.membership": "spill-tree full-node membership pass",
     "spill.leader_cover": "spill-tree leader cover pass",
     "spill.child_gather": "spill-tree child row gather",
+    "spill.level": "one level-synchronous tree build round (all open "
+    "nodes, one fused dispatch)",
+    "spill.leaf_pull": "retiring leaf/fallback region pull of one "
+    "level (PullEngine-overlapped)",
     "compact.flush_chunk": "compact p1 chunk flush to device",
     "compact.pull_chunk": "compact p1 chunk pull to host",
     "pull.chunk": "one pull-pipeline job (transfer + host finalize)",

@@ -362,11 +362,18 @@ def _spill_sparse(
     # row-pair dot — bounded by the max row nnz, NOT the vocabulary width
     max_row_nnz = int(max(1, x.getnnz(axis=1).max())) if x.shape[0] else 1
     halo = chord_halo(eps, 1e-4, dim=max_row_nnz)
+    spill_info: dict = {}
     part_ids, point_idx, n_parts, home_of = spill_partition(
-        x.astype(np.float32), max_points_per_partition, halo
+        x.astype(np.float32), max_points_per_partition, halo,
+        info_out=spill_info,
     )
     t_spill = _time.perf_counter()
-    counts = np.bincount(part_ids, minlength=n_parts)
+    # leaf layout straight from the partitioner (partition-major
+    # instances, counts per leaf) — no re-derivation; the ladder pad
+    # below is the DISPATCH shape, applied once per leaf here
+    counts = spill_info.get("counts")
+    if counts is None:
+        counts = np.bincount(part_ids, minlength=n_parts)
     offsets = np.r_[0, np.cumsum(counts)]
     widths = [_ladder_width(int(c), 128) for c in counts]
     if widths:
@@ -375,6 +382,7 @@ def _spill_sparse(
         stats_out.update(
             n_partitions=n_parts,
             duplication_factor=float(len(part_ids)) / max(1, n),
+            spill_levels=int(spill_info.get("levels", 0)),
         )
 
     # Per-leaf gram+cluster dispatch with NO per-leaf pull: each leaf's
@@ -437,9 +445,13 @@ def _spill_sparse(
         ]
     )
     cand, inst_inner = band_membership(part_ids, point_idx, home_of, n)
+    # canonical ids (min-member-row numbering): the spill layout depends
+    # on pivot choice, so rank-ordered gids would differ between equally
+    # valid trees — canonical numbering makes the labels a function of
+    # the DATA alone (finalize_merge docstring)
     clusters, flags, _ = finalize_merge(
         part_ids, point_idx, inst_seed, inst_flag, cand, inst_inner,
-        n, n_parts, max_b,
+        n, n_parts, max_b, canonical=True,
     )
     if stats_out is not None:
         # phase split in the driver's timings idiom: where the wall goes

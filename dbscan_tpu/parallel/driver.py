@@ -900,6 +900,7 @@ def finalize_merge(
     n: int,
     p_true: int,
     max_b: int,
+    canonical: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Steps 6-9 of the reference pipeline (DBSCAN.scala:179-283) on flat
     instance tables: deterministic per-partition cluster enumeration,
@@ -913,7 +914,18 @@ def finalize_merge(
     Shared by the grid/spill drivers (train_arrays) and the sparse cosine
     front-end (ops/sparse.py), whose decompositions produce the same
     instance-table shape.
-    """
+
+    ``canonical``: renumber the final global ids so clusters appear in
+    order of their minimum member point row. The default numbering
+    follows the unique (partition, local-id) RANK order, which depends
+    on the partition layout — fine for the 2-D grid (deterministic in
+    the data), but the spill tree's layout depends on pivot choice, and
+    the level-synchronous device build (spill_device.build_level_tree)
+    must produce labels IDENTICAL to the host recursion's even though
+    the two pick different pivots. Cluster MEMBERSHIP is decomposition-
+    independent (the coverage contract + this merge, PARITY.md "Spill
+    tree"), so numbering by min member row makes the full label vector
+    decomposition-independent too. Spill callers pass True."""
     # 6. local ids + deterministic cluster enumeration.
     inst_loc, upart, uloc, labeled_inst, inst_urank = _local_ids_flat(
         inst_part, inst_seed, p_true, max_b
@@ -1027,6 +1039,17 @@ def finalize_merge(
             j = first_j[pos_c[hit]]
             res_cluster[m_hit] = inst_gid[j]
             res_flag[m_hit] = inst_flag[j]
+    if canonical and n_clusters:
+        # renumber by minimum member row: one O(n) scatter-min + an
+        # O(K log K) argsort over the (small) cluster count. Noise (0)
+        # stays 0.
+        first = np.full(n_clusters + 1, n, dtype=np.int64)
+        np.minimum.at(first, res_cluster, np.arange(n, dtype=np.int64))
+        order = np.argsort(first[1:], kind="stable")
+        remap = np.empty(n_clusters + 1, dtype=np.int32)
+        remap[0] = 0
+        remap[1:][order] = np.arange(1, n_clusters + 1, dtype=np.int32)
+        res_cluster = remap[res_cluster]
     return res_cluster, res_flag, n_clusters
 
 
@@ -1043,6 +1066,10 @@ def _resume_from_premerge(state: dict, t_start: float) -> TrainOutput:
         a["inst_part"], a["inst_ptidx"], a["inst_seed"], a["inst_flag"],
         a["cand"], a["inst_inner"],
         int(s["n_points"]), int(s["n_partitions"]), int(s["bucket_size"]),
+        # spill runs use canonical ids (min-member-row numbering); the
+        # saved scalars say which decomposition produced these tables,
+        # so a resumed run numbers exactly like the fresh one would
+        canonical=bool(s.get("spill_tree", False)),
     )
     rects = a["rects"]
     partitions = [(i, rects[i]) for i in range(len(rects))]
@@ -1262,6 +1289,7 @@ def train_arrays(
                 "n_core_instances": 0,
                 "projected": False,
                 "spill_tree": False,
+                "spill_levels": 0,
                 "timings": {},
             },
         )
@@ -1387,6 +1415,7 @@ def train_arrays(
     # contract as the 2eps grid. Merge classification then comes from
     # instance multiplicity, not rectangles.
     rp = None
+    spill_info: dict = {}  # spill_partition diagnostics + leaf layout
     resident_ops = None
     resident_unit = None  # host unit rows backing the resident payload
     if cfg.metric == "cosine":
@@ -1540,12 +1569,16 @@ def train_arrays(
             resident_unit = unit
         rp = spill.spill_partition(
             unit, cfg.max_points_per_partition, halo,
-            device_ops=resident_ops,
+            device_ops=resident_ops, info_out=spill_info,
         )
         _mark("spill_partition_s", t0)
         if rp[2]:
-            # oversized unsplittable leaves fail fast, pre-packing
-            cmax = int(np.bincount(rp[0], minlength=rp[2]).max())
+            # oversized unsplittable leaves fail fast, pre-packing —
+            # leaf counts come straight from the partitioner's layout
+            counts_rp = spill_info.get("counts")
+            if counts_rp is None:
+                counts_rp = np.bincount(rp[0], minlength=rp[2])
+            cmax = int(counts_rp.max())
             _check_dense_width(
                 binning._ladder_width(cmax, cfg.bucket_multiple), cmax
             )
@@ -2571,6 +2604,9 @@ def train_arrays(
         "n_core_instances": int(n_core),
         "projected": sph is not None,  # spherical embedding in effect
         "spill_tree": rp is not None,  # metric spill partitioning in effect
+        # level-synchronous device-tree rounds (0: host recursion or no
+        # spill) — bench stamps this next to spill_partition_s
+        "spill_levels": int(spill_info.get("levels", 0)),
         "faults": fault_stats,
     }
 
@@ -2603,7 +2639,7 @@ def train_arrays(
     # instance tables.
     res_cluster, res_flag, n_clusters = finalize_merge(
         inst_part, inst_ptidx, inst_seed, inst_flag, cand, inst_inner,
-        n, p_true, max_b,
+        n, p_true, max_b, canonical=rp is not None,
     )
 
     # spill-tree partitions have no rectangle representation

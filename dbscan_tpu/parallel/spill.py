@@ -81,6 +81,29 @@ MAX_CHILD_FRAC = 0.95
 # and by the [node, m] f32 distance matrix staying under ~2 GB.
 _MAX_PIVOTS = 192
 _MEMBER_BUDGET = 5 * 10**8  # elements of the [node, m] distance matrix
+# Concentration signature (see the rejection-screen comment in
+# _spill_tree): duplication this far past the budget with most cells'
+# bands covering each point means escalation cannot help. ONE set of
+# constants shared with the level-synchronous build
+# (spill_device.build_level_tree) so host and device trees stop
+# escalating at the same points.
+SCREEN_DUP_MARGIN = 1.15
+CONCENTRATION_CELL_FRAC = 0.5
+
+
+def pivot_escalation(count: int, attempt: int, maxpp: int) -> int:
+    """Pivot count for one node at escalation ``attempt`` — THE split
+    policy's m formula, shared verbatim by the host recursion and the
+    level-synchronous device build: base 2x the leaf quotient, doubled
+    per retry, capped by _MAX_PIVOTS and the member-matrix budget."""
+    base_m = max(4, -(-count // maxpp) * 2)
+    return int(
+        min(
+            base_m << attempt,
+            _MAX_PIVOTS,
+            max(4, _MEMBER_BUDGET // max(1, count)),
+        )
+    )
 # Pivot selection (farthest-point + Lloyd) runs on at most this many
 # sampled rows per node; the exact membership pass still sees every row.
 _PIVOT_SAMPLE = 65536
@@ -266,11 +289,16 @@ def _pivot_vectors(sub, m: int, halo: float, rng):
 def halo_separation_filter(
     p: np.ndarray, mass: np.ndarray, halo: float
 ) -> np.ndarray:
-    """Greedy halo-separation filter shared by the host and device
-    pivot paths (farthest-point seed order is lost after Lloyd, so
-    re-derive): keep pivots in descending cell-mass order, dropping any
-    within halo chord of a kept one. Host/device pivot parity depends on
-    this being the ONE implementation."""
+    """Greedy halo-separation filter shared by the host recursion and
+    the node-recursive device path (farthest-point seed order is lost
+    after Lloyd, so re-derive): keep pivots in descending cell-mass
+    order, dropping any within halo chord of a kept one. Pivot parity
+    BETWEEN THOSE TWO paths depends on this being their one
+    implementation; the level-synchronous build runs its own batched
+    twin ON DEVICE (spill_device._make_level_build's hstep loop, same
+    policy, per-node in parallel) — a policy change here must be
+    mirrored there (different pivots stay label-safe either way:
+    canonical merge ids, PARITY.md "Spill tree")."""
     order = np.argsort(-mass)
     kept: list = []
     for j in order:
@@ -553,27 +581,58 @@ def prefix_components(x_csr, t: float, budget: int = None):
     # a 524 s spill at 200k docs before this screen).
     parent = np.arange(n, dtype=np.int64)
 
+    # INVARIANT: outside _union_edges, ``parent`` is fully flattened
+    # (parent[parent] == parent), so a root lookup is ONE gather. The
+    # doc count n is tiny next to the candidate-id streams (millions of
+    # pairs screened per _verify), so paying an O(n)-per-round flatten
+    # inside the union to make every screen a single gather is the
+    # cheap side of the trade — the old per-id path walk re-traversed
+    # chains across multi-million-element arrays.
     def _roots(ids):
-        r = parent[ids]
+        return parent[ids]
+
+    def _flatten_parent():
         while True:
-            rr = parent[r]
-            if np.array_equal(rr, r):
-                parent[ids] = r  # path-compress the queried ids: long
-                return r  # chains would otherwise re-walk every screen
-            r = rr
+            pp = parent[parent]
+            if np.array_equal(pp, parent):
+                return
+            parent[:] = pp
 
     def _union_edges(a, b):
-        for xi, yi in zip(a.tolist(), b.tolist()):
-            rx = xi
-            while parent[rx] != rx:
-                parent[rx] = parent[parent[rx]]
-                rx = parent[rx]
-            ry = yi
-            while parent[ry] != ry:
-                parent[ry] = parent[parent[ry]]
-                ry = parent[ry]
-            if rx != ry:
-                parent[max(rx, ry)] = min(rx, ry)
+        """Batch-union accepted edges — vectorized min-root hooking
+        instead of the old per-edge interpreted loop (measured as one of
+        the dominant costs of the 200k-doc sparse spill: ~3.4 s of
+        Python union-find plus the chains it left for _roots). Each
+        round resolves roots for every pending pair at once, attaches
+        each greater root to the SMALLEST peer root observed for it
+        (parent values only ever decrease, so chains stay acyclic), and
+        re-queues the merged pairs — chains collapse in O(log) rounds.
+        Decisions are order-independent: union is idempotent and the
+        final components equal the sequential walk's."""
+        a = np.asarray(a, dtype=np.int64)
+        b = np.asarray(b, dtype=np.int64)
+        while len(a):
+            ra = parent[a]  # flattened ⇒ roots
+            rb = parent[b]
+            live = ra != rb
+            if not live.any():
+                return
+            ra, rb = ra[live], rb[live]
+            lo = np.minimum(ra, rb)
+            hi = np.maximum(ra, rb)
+            order = np.argsort(hi, kind="stable")
+            hi_s, lo_s = hi[order], lo[order]
+            starts = np.flatnonzero(np.r_[True, hi_s[1:] != hi_s[:-1]])
+            min_lo = np.minimum.reduceat(lo_s, starts)
+            tgt = hi_s[starts]
+            parent[tgt] = np.minimum(parent[tgt], min_lo)
+            _flatten_parent()  # restore the single-gather invariant
+            # EVERY live edge stays queued until its endpoints share a
+            # root: the hooking above applied only each group's minimum
+            # edge, and dropping the rest would under-merge this round
+            # (correct only eventually, via re-verified duplicate dots
+            # — measured 3.3x the verification volume)
+            a, b = lo, hi
 
     def _verify():
         nonlocal pending
@@ -586,11 +645,30 @@ def prefix_components(x_csr, t: float, budget: int = None):
         pending = 0
         lo = np.minimum(lo_, hi_)
         hi = np.maximum(lo_, hi_)
+        # union-find screen BEFORE the packed-key dedup: once a
+        # component is connected every further intra pair is redundant,
+        # and candidate lists are dominated by exactly those — screening
+        # first makes the sort/unique cost proportional to the LIVE
+        # pairs instead of the raw candidate stream (measured ~12 s of
+        # unique+sort at 200k docs pre-screen)
+        live = _roots(lo) != _roots(hi)
+        lo, hi = lo[live], hi[live]
+        if not len(lo):
+            return
         uniq = np.unique(lo * np.int64(n) + hi)
         ua, ub = np.divmod(uniq, np.int64(n))
-        for s in range(0, len(ua), 1 << 18):
-            a = ua[s : s + (1 << 18)]
-            b = ub[s : s + (1 << 18)]
+        # SMALL dot batches, screened per batch: pairs are sorted by
+        # (lo, hi), so one component's candidates are adjacent — after
+        # the first batch connects it, the per-batch root screen kills
+        # the rest of its pairs BEFORE they pay the CSR gather+multiply.
+        # One big batch would dot a whole component's pair list (~k^2)
+        # before any union could prune (measured 3.3x the verification
+        # volume at 200k docs); the batch size trades that against
+        # per-call scipy overhead.
+        bs = 4096
+        for s in range(0, len(ua), bs):
+            a = ua[s : s + bs]
+            b = ub[s : s + bs]
             live = _roots(a) != _roots(b)
             if not live.any():
                 continue
@@ -625,9 +703,18 @@ def prefix_components(x_csr, t: float, budget: int = None):
         if sizes[gi] < 2:
             continue
         for a_blk, b_blk in _pair_blocks(pr[bounds[gi] : bounds[gi + 1]]):
-            pa_l.append(a_blk)
-            pb_l.append(b_blk)
-            pending += len(a_blk)
+            # source screen: a topic's pairs recur across every feature
+            # in its prefix (~row-nnz times) — once one group's pairs
+            # are verified and unioned, the repeats die HERE for one
+            # root gather instead of riding the pending buffers into
+            # _verify's concat/min/max/unique passes (measured as the
+            # dominant _verify cost at 200k docs)
+            live = _roots(a_blk) != _roots(b_blk)
+            if not live.any():
+                continue
+            pa_l.append(a_blk[live])
+            pb_l.append(b_blk[live])
+            pending += int(live.sum())
             if pending >= _PREFIX_CHUNK:
                 _verify()
     _verify()
@@ -725,7 +812,7 @@ def _spill_device_enabled() -> bool:
 
 def spill_partition(
     unit, maxpp: int, halo: float, seed: int = 0, _presplit: bool = True,
-    device_ops=None,
+    device_ops=None, info_out: dict = None,
 ) -> Tuple[np.ndarray, np.ndarray, int, np.ndarray]:
     """Build the spill partition over ``unit`` [N, D] (rows must be the
     UNIT-NORM coordinates ``halo`` refers to — normalized vectors for
@@ -736,7 +823,14 @@ def spill_partition(
     instance list sorted by (partition, point index) — the layout the
     packers require (binning.bucketize_grouped) — and ``home_of`` giving
     each point's home leaf (its nearest-pivot chain; exactly one).
-    """
+
+    ``info_out`` (optional dict) receives build diagnostics plus the
+    leaf LAYOUT the dispatchers consume without re-deriving it:
+    ``counts`` ([n_parts] instances per leaf — part_ids is
+    partition-major, so offsets are its cumsum), and, when the
+    level-synchronous device build ran, ``levels`` /
+    ``level_dispatches`` (one fused dispatch per level + the closing
+    compact)."""
     if hasattr(unit, "tocsr"):  # scipy sparse input
         unit = unit.tocsr()
         n = unit.shape[0]
@@ -750,7 +844,12 @@ def spill_partition(
             # component can never succeed.
             pc = prefix_components(unit, 1.0 - halo * halo / 2.0)
             if pc is not None and pc[1] > 1:
-                return _split_by_components(unit, pc, maxpp, halo, seed)
+                out = _split_by_components(unit, pc, maxpp, halo, seed)
+                if info_out is not None:
+                    info_out["counts"] = np.bincount(
+                        out[0], minlength=out[2]
+                    )
+                return out
         ops = _SparseOps(unit) if n else None
     else:
         unit = np.asarray(unit)
@@ -771,11 +870,20 @@ def spill_partition(
     # obs.analyze attribute the remainder for the next optimization PR.
     with obs.span("spill.partition", n=int(n), maxpp=int(maxpp)):
         return _spill_tree(
-            unit, ops, n, maxpp, halo, seed, rng, device_ops
+            unit, ops, n, maxpp, halo, seed, rng, device_ops, info_out
         )
 
 
-def _spill_tree(unit, ops, n, maxpp, halo, seed, rng, device_ops):
+def _level_tree_enabled() -> bool:
+    """DBSCAN_SPILL_DEVICE_TREE: the level-synchronous device build
+    (one fused dispatch per tree level, spill_device.build_level_tree).
+    On by default wherever the device passes are live; 0 keeps the
+    node-recursive path as the parity oracle."""
+    return bool(config.env("DBSCAN_SPILL_DEVICE_TREE"))
+
+
+def _spill_tree(unit, ops, n, maxpp, halo, seed, rng, device_ops,
+                info_out=None):
     """The recursive pivot-tree build behind :func:`spill_partition`
     (split out so the root span wraps exactly the tree work)."""
     # Device-resident rows for the accelerated passes (dense only): one
@@ -803,6 +911,36 @@ def _spill_tree(unit, ops, n, maxpp, halo, seed, rng, device_ops):
                 dev_root = None
     leaves = []  # (member point rows, home flags)
     stack = [(np.arange(n, dtype=np.int64), np.ones(n, dtype=bool))]
+    # Level-synchronous device build (ROADMAP item 2): one fused
+    # dispatch per tree LEVEL over all open nodes at once, host
+    # involvement only at the split policy ([S, m] size tables) and the
+    # final leaf pulls (PullEngine-overlapped). Nodes its pivot policy
+    # cannot split come back as fallback items and seed the classic
+    # recursion below, which owns the leader-cover / prefix-split /
+    # oversized-leaf ladder unchanged. Any failure degrades to the host
+    # recursion for the WHOLE build — correctness never depends on the
+    # level path.
+    if dev_root is not None and n > maxpp and _level_tree_enabled():
+        try:
+            lv_leaves, lv_fallback = sdev.build_level_tree(
+                dev_root, n, maxpp, halo, rng, info=info_out
+            )
+            leaves.extend(lv_leaves)
+            stack = [
+                (np.asarray(ix, dtype=np.int64), np.asarray(hm, bool))
+                for ix, hm in lv_fallback
+            ]
+        except Exception as e:  # noqa: BLE001 — degrade, don't die
+            logger.warning(
+                "spill: level-synchronous device tree failed (%s); "
+                "host recursion",
+                e,
+            )
+            faults.note_degrade()
+            leaves = []
+            stack = [
+                (np.arange(n, dtype=np.int64), np.ones(n, dtype=bool))
+            ]
     while stack:
         idx, home = stack.pop()
         if len(idx) <= maxpp:
@@ -821,15 +959,8 @@ def _spill_tree(unit, ops, n, maxpp, halo, seed, rng, device_ops):
         sub = ops.take(idx) if dev_sub is None else None
         split = None
         degenerate = False
-        base_m = max(4, -(-len(idx) // maxpp) * 2)
         for attempt in range(3):  # retries escalate the pivot count
-            m = int(
-                min(
-                    base_m << attempt,
-                    _MAX_PIVOTS,
-                    max(4, _MEMBER_BUDGET // max(1, len(idx))),
-                )
-            )
+            m = pivot_escalation(len(idx), attempt, maxpp)
             # pivot SELECTION runs on a sample: farthest-point + Lloyd
             # cost ~m+4 node-wide matmuls, needed only for pivot quality
             # — a 64k sample sees every cluster worth a pivot (smaller
@@ -953,7 +1084,7 @@ def _spill_tree(unit, ops, n, maxpp, halo, seed, rng, device_ops):
                         )
                     screen_dup = float(mem_s.sum()) / mem_s.shape[0]
                     screen_m = mem_s.shape[1]
-                if screen_dup > 1.15 * MAX_DUP_FACTOR:
+                if screen_dup > SCREEN_DUP_MARGIN * MAX_DUP_FACTOR:
                     # Concentration signature: each point lands in MOST
                     # cells' bands (dup per point ~ pivot count), i.e.
                     # every cell radius swallows the node spread. More
@@ -964,7 +1095,7 @@ def _spill_tree(unit, ops, n, maxpp, halo, seed, rng, device_ops):
                     # component fallback, saving their pivot-selection
                     # passes (measured ~2/5 of the 300k anchor's spill
                     # wall). Marginal overshoots keep escalating.
-                    if screen_dup >= 0.5 * screen_m:
+                    if screen_dup >= CONCENTRATION_CELL_FRAC * screen_m:
                         break
                     continue  # escalate without the full-node pass
             # chord distances to pivots in one pass (device when
@@ -1109,4 +1240,8 @@ def _spill_tree(unit, ops, n, maxpp, halo, seed, rng, device_ops):
     home_of[point_idx[home_flat]] = part_ids[home_flat]
     if (home_of < 0).any():  # every point has exactly one home leaf
         raise AssertionError("spill: point with no home leaf")
+    if info_out is not None:
+        # the leaf layout downstream dispatchers consume directly
+        # (instances are partition-major, so offsets = cumsum(counts))
+        info_out["counts"] = sizes
     return part_ids, point_idx, n_parts, home_of

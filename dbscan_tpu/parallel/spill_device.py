@@ -560,6 +560,684 @@ def leader_components_device(
     return comp, int(n_comp)
 
 
+# --- level-synchronous tree build (one dispatch per level) -------------
+#
+# The host recursion above — kept behind DBSCAN_SPILL_DEVICE_TREE=0 as
+# the parity oracle — dispatches per NODE: pivot selection, screen,
+# membership, and the child gather each cost a device round-trip, and a
+# deep tree pays hundreds of them (spill_partition_s = 51/65 s sparse,
+# 3.9/5.1 s cosine per BENCH_TPU_r05c). The level-synchronous build
+# (Prokopenko et al., arXiv:2103.05162; Wang et al., arXiv:1912.06255)
+# processes ALL open nodes of a level in ONE fused dispatch:
+#
+#   - the previous level's membership bits are compacted on device into
+#     the new level's slot-contiguous instance layout (open nodes first,
+#     then retiring leaf slots, then fallback slots — so the host's only
+#     data pull is one contiguous leaf-region slice per level, submitted
+#     through the PR-5 PullEngine to overlap the next level's compute);
+#   - batched farthest-point seeding + 2 Lloyd steps + the greedy
+#     halo-separation filter + the full-node membership pass run as
+#     fori_loop/segment-reduce kernels keyed on the node-id vector, so
+#     one [M] instance stream serves every open node at once;
+#   - the only synchronous pull per level is the [S, m] cell-size /
+#     pivot-validity table the host split policy reads.
+#
+# Shapes are ratcheted (instance capacity up binning._ladder_width,
+# node/pivot slots up _ladder8), so the level loop re-traces only when a
+# rung changes — a second same-shaped build compiles nothing (pinned by
+# tests/test_spill_tree.py). The rejection screen is subsumed: the fused
+# pass computes exact full-node sizes anyway, so escalation decisions
+# use them directly. Nodes the pivot policy cannot split (pkeep < 2,
+# attempts exhausted, concentration signature) are emitted as fallback
+# items and re-enter spill.py's host-recursion stack, which owns the
+# leader-cover / prefix-split / oversized-leaf ladder unchanged.
+
+#: node slots per level dispatch (piv/pair2 temps scale with S*m*D)
+_LEVEL_NODE_CAP = 512
+#: instance-capacity ladder multiple for the level buffers
+_LEVEL_LADDER = 1024
+
+
+def _level_ladder(c: int) -> int:
+    from dbscan_tpu.parallel.binning import _ladder_width
+
+    return _ladder_width(max(1, int(c)), _LEVEL_LADDER)
+
+
+def _make_level_compact(jax, jnp, mp_pad, sp_pad, mcap_p, t_pad, mcap):
+    """Compaction closure: scatter the previous level's (instance, cell)
+    memberships into the new slot-contiguous layout. ``dest`` maps each
+    (node, cell) to its destination slot (-1 dead); a ``carry`` node
+    re-emits every instance once (escalation retries, fallback
+    extraction, and the fabricated root all ride this path). Ranks come
+    from a per-column cumsum rebased at each node's start — node blocks
+    are contiguous, so the column cumsum is per-(node, cell) exact."""
+
+    def compact(
+        idx_p, home_p, assign_p, member_p, base_p, dest, carry,
+        out_base, total_p,
+    ):
+        pos = jnp.arange(mcap_p, dtype=jnp.int32)
+        node_of = jnp.clip(
+            jnp.searchsorted(base_p, pos, side="right") - 1, 0, sp_pad - 1
+        ).astype(jnp.int32)
+        inst_valid = pos < total_p
+        memb = jnp.unpackbits(member_p, axis=1, count=mp_pad).astype(bool)
+        carried = carry[node_of]
+        first_col = jnp.arange(mp_pad) == 0
+        memb_e = jnp.where(carried[:, None], first_col[None, :], memb)
+        memb_e = memb_e & inst_valid[:, None]
+        dst = dest[node_of]  # [mcap_p, mp_pad]
+        live = memb_e & (dst >= 0)
+        # split child j keeps home iff the instance's nearest kept cell
+        # IS j (exactly one per home instance — the home-chain
+        # invariant); carried nodes pass home through unchanged
+        home_e = jnp.where(
+            carried[:, None],
+            home_p[:, None],
+            home_p[:, None]
+            & (assign_p[:, None] == jnp.arange(mp_pad)[None, :]),
+        )
+        colcs = jnp.cumsum(live.astype(jnp.int32), axis=0)  # inclusive
+        node_start = jnp.maximum(base_p[:sp_pad] - 1, 0)
+        col_start = jnp.where(
+            (base_p[:sp_pad] > 0)[:, None], colcs[node_start], 0
+        )
+        rank = colcs - 1 - col_start[node_of]
+        outpos = jnp.where(
+            live,
+            out_base[jnp.clip(dst, 0, t_pad - 1)] + rank,
+            mcap,  # out of bounds: dropped by the scatter
+        )
+        flat = outpos.reshape(-1)
+        out_idx = (
+            jnp.zeros((mcap,), jnp.int32)
+            .at[flat]
+            .set(
+                jnp.broadcast_to(
+                    idx_p[:, None], (mcap_p, mp_pad)
+                ).reshape(-1),
+                mode="drop",
+            )
+        )
+        out_home = (
+            jnp.zeros((mcap,), bool)
+            .at[flat]
+            .set(home_e.reshape(-1), mode="drop")
+        )
+        return out_idx, out_home
+
+    return compact
+
+
+def _make_level_build(jax, jnp, dim, m_pad, s_pad, mcap, msel, matmul):
+    """Build closure: one level's pivot selection + membership over all
+    open nodes at once. Mirrors the host algorithms keyed by a node-id
+    vector: farthest-point and Lloyd run on the COMPACTED selection
+    sample (``sel_pos``, <= _PIVOT_SAMPLE rows per node — exactly the
+    host's sampling split: selection cost rides the sample, the exact
+    full-node membership pass rides everything); the halo-separation
+    filter is the host greedy (mass-descending, drop within halo of a
+    kept pivot) run rank-by-rank across every node in parallel;
+    membership is spill._membership's band formula with the bf16 slack
+    inflation of :func:`_membership_fn`. Pivot choice never affects
+    correctness, so fp/Lloyd need no slack; the bands carry 2*slack.
+
+    ``matmul``: compute the [rows, m] own-node pivot dots as ONE
+    [rows, S*m] MXU matmul + per-row block gather (the fast shape when
+    the cross product fits the level-slot budget — always true at the
+    root, where S is 1); otherwise one [rows, D] pivot gather per
+    pivot slot (bandwidth ~ m*rows*D, the fallback for wide levels
+    whose nodes are small)."""
+    sgsum = jax.ops.segment_sum
+    sgmax = jax.ops.segment_max
+    sgmin = jax.ops.segment_min
+
+    def node_dots(rows, piv, node_r):
+        # D[i, j] = rows[i] . piv[node_r[i], j]
+        if matmul:
+            g = rows @ piv.reshape(s_pad * m_pad, dim).T
+            cols = node_r[:, None] * m_pad + jnp.arange(m_pad)[None, :]
+            return jnp.take_along_axis(g, cols, axis=1)
+
+        def col(j, acc):
+            pj = piv[:, j, :][node_r]
+            return acc.at[:, j].set(jnp.sum(rows * pj, axis=1))
+
+        return jax.lax.fori_loop(
+            0, m_pad, col,
+            jnp.zeros((rows.shape[0], m_pad), jnp.float32),
+        )
+
+    def build(x, idx, home, base, sel_pos, seed_pos, m_req, total, halo,
+              slack):
+        del home  # home flags ride the NEXT compact, not the build
+        pos = jnp.arange(mcap, dtype=jnp.int32)
+        node_of = jnp.clip(
+            jnp.searchsorted(base, pos, side="right") - 1, 0, s_pad - 1
+        ).astype(jnp.int32)
+        inst_valid = pos < total
+        xr = x[idx].astype(jnp.float32)
+        node_live = m_req > 0
+
+        # compacted selection sample: fp/Lloyd touch ONLY these rows
+        sel_ok = sel_pos < total
+        sel_clip = jnp.clip(sel_pos, 0, mcap - 1)
+        xs = xr[sel_clip]  # [msel, D]
+        node_s = node_of[sel_clip]
+        spos = jnp.arange(msel, dtype=jnp.int32)
+
+        # farthest-point seeding on the sample
+        p0 = xs[jnp.clip(seed_pos, 0, msel - 1)]
+        p0 = jnp.where(node_live[:, None], p0, 0.0)
+        piv = jnp.zeros((s_pad, m_pad, dim), jnp.float32).at[:, 0, :].set(p0)
+        pvalid = jnp.zeros((s_pad, m_pad), bool).at[:, 0].set(node_live)
+        g0 = piv[:, 0, :][node_s]
+        d0 = jnp.maximum(2.0 - 2.0 * jnp.sum(xs * g0, axis=1), 0.0)
+
+        def fp_body(j, st):
+            piv, pvalid, dmin = st
+            v = jnp.where(sel_ok, dmin, -jnp.inf)
+            segtop = sgmax(v, node_s, num_segments=s_pad)
+            newvalid = (segtop > 0.0) & (j < m_req)
+            iswin = sel_ok & (v == segtop[node_s]) & newvalid[node_s]
+            cand = jnp.where(iswin, spos, msel)
+            win = sgmin(cand, node_s, num_segments=s_pad)
+            rowj = xs[jnp.clip(win, 0, msel - 1)]
+            rowj = jnp.where(newvalid[:, None], rowj, 0.0)
+            piv = piv.at[:, j, :].set(rowj)
+            pvalid = pvalid.at[:, j].set(newvalid)
+            dj = jnp.maximum(
+                2.0 - 2.0 * jnp.sum(xs * rowj[node_s], axis=1), 0.0
+            )
+            dmin = jnp.where(
+                newvalid[node_s], jnp.minimum(dmin, dj), dmin
+            )
+            return piv, pvalid, dmin
+
+        piv, pvalid, _ = jax.lax.fori_loop(
+            1, m_pad, fp_body, (piv, pvalid, d0)
+        )
+
+        def lloyd(_, st):
+            piv, pvalid = st
+            dots = node_dots(xs, piv, node_s)
+            dots = jnp.where(
+                pvalid[node_s] & sel_ok[:, None], dots, -jnp.inf
+            )
+            a = jnp.argmax(dots, axis=1)
+            key = node_s * m_pad + a.astype(jnp.int32)
+            sums = sgsum(
+                jnp.where(sel_ok[:, None], xs, 0.0),
+                key,
+                num_segments=s_pad * m_pad,
+            )
+            norms = jnp.linalg.norm(sums, axis=1, keepdims=True)
+            newp = (sums / jnp.maximum(norms, 1e-12)).reshape(
+                s_pad, m_pad, dim
+            )
+            ok = (norms[:, 0] > 1e-12).reshape(s_pad, m_pad)
+            piv = jnp.where((ok & pvalid)[..., None], newp, piv)
+            return piv, pvalid
+
+        piv, pvalid = jax.lax.fori_loop(0, 2, lloyd, (piv, pvalid))
+
+        # sample cell masses (empty cells drop, host convention)
+        dots = node_dots(xs, piv, node_s)
+        dots = jnp.where(pvalid[node_s] & sel_ok[:, None], dots, -jnp.inf)
+        a = jnp.argmax(dots, axis=1).astype(jnp.int32)
+        mass = sgsum(
+            sel_ok.astype(jnp.int32),
+            node_s * m_pad + a,
+            num_segments=s_pad * m_pad,
+        ).reshape(s_pad, m_pad)
+        pvalid = pvalid & (mass > 0)
+
+        # greedy halo-separation filter (host semantics, all nodes in
+        # parallel): walk pivots in descending sample mass, drop any
+        # within halo chord of a kept one
+        pair2 = jnp.maximum(
+            2.0 - 2.0 * jnp.einsum("sid,sjd->sij", piv, piv), 0.0
+        )
+        h2 = halo * halo
+        order = jnp.argsort(
+            jnp.where(pvalid, -mass.astype(jnp.float32), jnp.inf),
+            axis=1,
+            stable=True,
+        )
+        srange = jnp.arange(s_pad)
+        keep0 = jnp.take_along_axis(pvalid, order[:, :1], 1)[:, 0]
+        keepr0 = jnp.zeros((s_pad, m_pad), bool).at[:, 0].set(keep0)
+        rmask = jnp.arange(m_pad)
+
+        def hstep(r, keepr):
+            cur = order[:, r]
+            rowcur = pair2[srange[:, None], cur[:, None], order]
+            curvalid = jnp.take_along_axis(pvalid, cur[:, None], 1)[:, 0]
+            covered = jnp.any(
+                keepr & (rmask < r)[None, :] & (rowcur <= h2), axis=1
+            )
+            return keepr.at[:, r].set(curvalid & ~covered)
+
+        keepr = jax.lax.fori_loop(1, m_pad, hstep, keepr0)
+        pkeep = (
+            jnp.zeros((s_pad, m_pad), bool)
+            .at[srange[:, None], order]
+            .set(keepr)
+        )
+
+        # full-node membership over the kept pivots (band formula of
+        # spill._membership, +2*slack per band as in _membership_fn)
+        dots = node_dots(xr, piv, node_of)
+        dchord = jnp.sqrt(jnp.maximum(2.0 - 2.0 * dots, 0.0))
+        dchord = jnp.where(pkeep[node_of], dchord, jnp.inf)
+        assign = jnp.argmin(dchord, axis=1).astype(jnp.int32)
+        dminc = jnp.take_along_axis(dchord, assign[:, None], 1)[:, 0]
+        r_c = sgmax(
+            jnp.where(inst_valid, dminc, -jnp.inf),
+            node_of * m_pad + assign,
+            num_segments=s_pad * m_pad,
+        ).reshape(s_pad, m_pad)
+        member = (dchord <= r_c[node_of] + (halo + 2.0 * slack)) & (
+            dchord <= (dminc + 2.0 * halo + 2.0 * slack)[:, None]
+        )
+        member = member & inst_valid[:, None] & pkeep[node_of]
+        sizes = sgsum(
+            member.astype(jnp.int32), node_of, num_segments=s_pad
+        )
+        packed = jnp.packbits(member, axis=1)
+        return packed, assign, sizes, pkeep
+
+    return build
+
+
+@functools.lru_cache(maxsize=64)
+def _level_step_fn(dim, mp_pad, sp_pad, mcap_p, t_pad, m_pad, s_pad,
+                   mcap, msel, matmul):
+    """ONE fused level dispatch: compact the previous level's membership
+    into the new layout, then build pivots/membership for its open
+    prefix. The root level rides the same signature with a fabricated
+    single-carry previous level, so the whole tree uses one compiled
+    family (``spill.level``)."""
+    jax, jnp = _jax()
+    compact = _make_level_compact(jax, jnp, mp_pad, sp_pad, mcap_p, t_pad, mcap)
+    build = _make_level_build(jax, jnp, dim, m_pad, s_pad, mcap, msel, matmul)
+
+    def fn(
+        x, idx_p, home_p, assign_p, member_p, base_p, dest, carry,
+        out_base, sel_pos, seed_pos, m_req, base, total_p, total, halo,
+        slack,
+    ):
+        idx, home = compact(
+            idx_p, home_p, assign_p, member_p, base_p, dest, carry,
+            out_base, total_p,
+        )
+        packed, assign, sizes, pkeep = build(
+            x, idx, home, base, sel_pos, seed_pos, m_req, total, halo,
+            slack,
+        )
+        return idx, home, packed, assign, sizes, pkeep
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _level_final_fn(mp_pad, sp_pad, mcap_p, t_pad, mcap):
+    """Closing compact-only dispatch: the last level's children are all
+    leaves/fallbacks, so only the layout scatter remains."""
+    jax, jnp = _jax()
+    compact = _make_level_compact(jax, jnp, mp_pad, sp_pad, mcap_p, t_pad, mcap)
+
+    def fn(idx_p, home_p, assign_p, member_p, base_p, dest, carry,
+           out_base, total_p):
+        return compact(
+            idx_p, home_p, assign_p, member_p, base_p, dest, carry,
+            out_base, total_p,
+        )
+
+    return jax.jit(fn)
+
+
+def _level_m_req(count: int, attempt: int, maxpp: int) -> int:
+    """Per-node pivot request: delegates to the ONE escalation formula
+    (spill.pivot_escalation) the host recursion also uses, so the two
+    builds cannot drift apart."""
+    from dbscan_tpu.parallel import spill as _spill
+
+    return _spill.pivot_escalation(count, attempt, maxpp)
+
+
+class _LevelNode:
+    """Host bookkeeping for one open node slot."""
+
+    __slots__ = ("count", "attempt")
+
+    def __init__(self, count: int, attempt: int = 0):
+        self.count = count
+        self.attempt = attempt
+
+
+def build_level_tree(dev: DeviceNodeOps, n: int, maxpp: int, halo: float,
+                     rng, info: dict = None):
+    """Level-synchronous device build over the resident rows.
+
+    Returns ``(leaves, fallback)``: lists of ``(row_idx, home_flag)``
+    host arrays. ``leaves`` are finished spill leaves; ``fallback``
+    items re-enter the host recursion (spill.py's stack), which owns
+    the leader-cover / prefix-split / oversized-leaf ladder. ``info``
+    (optional dict) receives ``levels`` / ``level_dispatches``.
+
+    Split policy per node (the host recursion's, from exact full-node
+    sizes): accept when duplication <= MAX_DUP_FACTOR and no child
+    holds > MAX_CHILD_FRAC of the parent; otherwise escalate the pivot
+    count (<= 3 attempts) unless the concentration signature (dup both
+    >> the budget and ~half the kept-pivot count) says escalation
+    cannot help — then fall back. Host and device trees may pick
+    DIFFERENT pivots (different sampling, batched fp): the coverage
+    contract plus the canonical merge ids make the final labels
+    identical anyway (PARITY.md "Spill tree")."""
+    import jax
+
+    from dbscan_tpu import config
+    from dbscan_tpu.parallel import pipeline as pipe_mod
+    from dbscan_tpu.parallel import spill as _spill
+
+    jnp = _jax()[1]
+    slot_budget = max(1 << 20, int(config.env("DBSCAN_SPILL_LEVEL_SLOTS")))
+    leaves: list = []
+    fallback: list = []
+    engine = pipe_mod.get_engine()
+    pull_jobs: list = []
+
+    dispatches = 0
+    levels = 0
+
+    def _supervised_call(fn_label, fn, *args):
+        nonlocal dispatches
+        dispatches += 1
+        obs.count("spill.level_dispatches")
+        return faults.supervised(
+            faults.SITE_SPILL_LEVEL,
+            lambda _b: obs_compile.tracked_call(fn_label, fn, *args),
+            label=fn_label,
+        )
+
+    def _pull_region(idx_dev, home_dev, lo, entries, sink_of):
+        """Pull one contiguous retiring region (leaf + fallback slots)
+        and split it into per-slot (rows, home) pairs. ``entries`` =
+        [(count, sink_name), ...] in slot order. Submitted through the
+        pull engine when live, so the D2H + split overlap the next
+        level's device compute."""
+        if not entries:
+            return
+        hi = lo + sum(c for c, _ in entries)
+        i_slice = idx_dev[lo:hi]
+        h_slice = home_dev[lo:hi]
+
+        def work():
+            with obs.span("spill.leaf_pull", rows=int(hi - lo)):
+                li, lh = jax.device_get((i_slice, h_slice))
+            li = np.asarray(li, dtype=np.int64)
+            lh = np.asarray(lh, dtype=bool)
+            obs.count("transfer.d2h_bytes", int(li.nbytes + lh.nbytes))
+            off = 0
+            for cnt, sink in entries:
+                sink_of[sink].append((li[off : off + cnt], lh[off : off + cnt]))
+                off += cnt
+
+        if engine is not None:
+            pull_jobs.append((engine.submit(work, label="spill-leaves"), work))
+        else:
+            work()
+
+    sink_of = {"leaf": leaves, "fallback": fallback}
+
+    # fabricated previous level: one carried node holding [0, n) — the
+    # root build then rides the same fused step as every later level
+    mcap_p = _level_ladder(n)
+    sp_pad = _ladder8(1, cap=_LEVEL_NODE_CAP)
+    mp_pad = 8
+    idx_p = jnp.minimum(jnp.arange(mcap_p, dtype=jnp.int32), max(0, n - 1))
+    home_p = jnp.arange(mcap_p) < n
+    assign_p = jnp.zeros((mcap_p,), jnp.int32)
+    member_p = jnp.zeros((mcap_p, 1), jnp.uint8)
+    base_p = np.zeros(sp_pad + 1, np.int32)
+    base_p[1:] = n
+    dest = np.full((sp_pad, mp_pad), -1, np.int32)
+    dest[0, 0] = 0
+    carry = np.zeros(sp_pad, bool)
+    carry[0] = True
+    total_p = n
+
+    nodes = [_LevelNode(n)]
+    out_base_np = np.zeros(1, np.int64)  # open slot 0 starts at 0
+    retire_entries: list = []  # [(count, sink)] after the open region
+    total_out = n
+
+    try:
+        while nodes:
+            levels += 1
+            obs.count("spill.levels")
+            # node slots ride a power-of-2 ladder (not _ladder8's floor of
+            # 8): the root level has ONE node, and the matmul dots path
+            # scales with s_pad * m_pad columns
+            s_pad = max(1, 1 << (len(nodes) - 1).bit_length())
+            mcap = _level_ladder(total_out)
+            # pivot-slot rung: per-node requests capped so the [M, m]
+            # working set stays under the level-slot budget
+            m_reqs = [
+                _level_m_req(nd.count, nd.attempt, maxpp) for nd in nodes
+            ]
+            m_pad = _ladder8(max(m_reqs), cap=_spill._MAX_PIVOTS)
+            while m_pad > 8 and mcap * m_pad > slot_budget:
+                m_pad = max(8, (m_pad // 2) // 8 * 8)
+            m_req = np.zeros(s_pad, np.int32)
+            m_req[: len(nodes)] = np.minimum(m_reqs, m_pad)
+            # the own-node dots: one [M, S*m] matmul when the cross product
+            # fits the budget (always at the root), else per-slot gathers
+            matmul = mcap * s_pad * m_pad <= slot_budget
+            # layout of THIS level: open nodes occupy [out_base[s],
+            # out_base[s] + count); the selection sample and per-node seeds
+            # are node-major positions into that layout
+            base = np.zeros(s_pad + 1, np.int32)
+            counts = np.array([nd.count for nd in nodes], dtype=np.int64)
+            starts = out_base_np[: len(nodes)]
+            base[: len(nodes)] = starts
+            base[len(nodes) :] = int(starts[-1] + counts[-1]) if len(nodes) else 0
+            total = int(base[len(nodes)])
+            sel_l = []
+            seed_pos = np.zeros(s_pad, np.int32)
+            for s, nd in enumerate(nodes):
+                lo = int(starts[s])
+                if nd.count > _spill._PIVOT_SAMPLE:
+                    picks = lo + rng.choice(
+                        nd.count, _spill._PIVOT_SAMPLE, replace=False
+                    )
+                    picks.sort()
+                else:
+                    picks = np.arange(lo, lo + nd.count)
+                seed_pos[s] = sum(len(p) for p in sel_l) + int(
+                    rng.integers(len(picks))
+                )
+                sel_l.append(picks)
+            n_sel = sum(len(p) for p in sel_l)
+            msel = _level_ladder(n_sel)
+            sel_pos = np.full(msel, mcap, np.int32)  # pad: fails sel_ok
+            sel_pos[:n_sel] = np.concatenate(sel_l)
+
+            t_pad = max(8, _ladder8(len(out_base_np) + len(retire_entries), cap=1 << 20))
+            out_base = np.zeros(t_pad, np.int32)
+            out_base[: len(out_base_np)] = out_base_np
+            off = total
+            for k, (cnt, _sink) in enumerate(retire_entries):
+                out_base[len(out_base_np) + k] = off
+                off += cnt
+
+            with obs.span(
+                "spill.level",
+                level=int(levels),
+                nodes=int(len(nodes)),
+                instances=int(total),
+                m=int(m_pad),
+            ):
+                fn = _level_step_fn(
+                    int(dev.dim), int(mp_pad), int(sp_pad), int(mcap_p),
+                    int(t_pad), int(m_pad), int(s_pad), int(mcap),
+                    int(msel), bool(matmul),
+                )
+                out = _supervised_call(
+                    "spill.level", fn,
+                    dev.x, idx_p, home_p, assign_p, member_p,
+                    jnp.asarray(base_p), jnp.asarray(dest), jnp.asarray(carry),
+                    jnp.asarray(out_base), jnp.asarray(sel_pos),
+                    jnp.asarray(seed_pos), jnp.asarray(m_req),
+                    jnp.asarray(base), int(total_p), int(total),
+                    float(halo), float(BF16_CHORD_SLACK),
+                )
+                idx_dev, home_dev, packed_dev, assign_dev, sizes_dev, pkeep_dev = out
+                # retiring region of THIS layout: pull it while the sizes
+                # sync (and the next level's dispatch) proceed
+                _pull_region(idx_dev, home_dev, total, retire_entries, sink_of)
+                sizes, pkeep = jax.device_get((sizes_dev, pkeep_dev))
+            sizes = np.asarray(sizes)
+            pkeep = np.asarray(pkeep)
+
+            # host split policy over the pulled [S, m] tables
+            next_nodes: list = []
+            next_starts: list = []
+            next_retire: list = []  # (count, sink)
+            dest2 = np.full((s_pad, m_pad), -1, np.int32)
+            carry2 = np.zeros(s_pad, bool)
+            open_off = 0
+            retire_list: list = []  # (s-or-(s,j), count, sink) in slot order
+            for s, nd in enumerate(nodes):
+                cnt = nd.count
+                kp = int(pkeep[s].sum())
+                sz = sizes[s]
+                tot = int(sz.sum())
+                dup = tot / cnt
+                frac = float(sz.max()) / cnt if cnt else 0.0
+                split_ok = (
+                    kp >= 2
+                    and dup <= _spill.MAX_DUP_FACTOR
+                    and frac <= _spill.MAX_CHILD_FRAC
+                )
+                if split_ok:
+                    for j in np.flatnonzero(sz > 0):
+                        cj = int(sz[j])
+                        if cj <= maxpp:
+                            retire_list.append((("cell", s, int(j)), cj, "leaf"))
+                        elif len(next_nodes) >= _LEVEL_NODE_CAP:
+                            # node-slot budget for the next dispatch: the
+                            # overflow children finish on the host-recursion
+                            # ladder instead (correctness unchanged; only
+                            # reachable at extreme tree arity)
+                            retire_list.append(
+                                (("cell", s, int(j)), cj, "fallback")
+                            )
+                        else:
+                            dest2[s, j] = len(next_nodes)
+                            next_nodes.append(_LevelNode(cj))
+                            next_starts.append(open_off)
+                            open_off += cj
+                    continue
+                # escalation / fallback: the whole node carries forward
+                concentration = (
+                    kp >= 2
+                    and dup > _spill.SCREEN_DUP_MARGIN * _spill.MAX_DUP_FACTOR
+                    and dup >= _spill.CONCENTRATION_CELL_FRAC * kp
+                )
+                nd.attempt += 1
+                if (
+                    kp < 2
+                    or concentration
+                    or nd.attempt >= 3
+                    or len(next_nodes) >= _LEVEL_NODE_CAP
+                ):
+                    carry2[s] = True
+                    retire_list.append((("node", s), cnt, "fallback"))
+                else:
+                    carry2[s] = True
+                    dest2[s, 0] = len(next_nodes)
+                    next_nodes.append(_LevelNode(cnt, attempt=nd.attempt))
+                    next_starts.append(open_off)
+                    open_off += cnt
+            # assign retiring slots after the open region, in list order
+            for k, (tag, cnt, sink) in enumerate(retire_list):
+                slot = len(next_nodes) + k
+                if tag[0] == "cell":
+                    _c, s, j = tag
+                    dest2[s, j] = slot
+                else:
+                    dest2[tag[1], 0] = slot
+                next_retire.append((cnt, sink))
+
+            total_out2 = open_off + sum(c for c, _ in next_retire)
+
+            if not next_nodes:
+                # closing compact: only the layout scatter remains
+                mcap2 = _level_ladder(max(1, total_out2))
+                t_pad2 = max(
+                    8, _ladder8(max(1, len(next_retire)), cap=1 << 20)
+                )
+                ob2 = np.zeros(t_pad2, np.int32)
+                off = 0
+                for k, (cnt, _sink) in enumerate(next_retire):
+                    ob2[k] = off
+                    off += cnt
+                # remap dest slot ids: no open slots, so retiring slots
+                # start at 0
+                d2 = np.where(dest2 >= len(next_nodes), dest2 - len(next_nodes), -1)
+                ffn = _level_final_fn(
+                    int(m_pad), int(s_pad), int(mcap), int(t_pad2), int(mcap2)
+                )
+                fidx, fhome = _supervised_call(
+                    "spill.level_final", ffn,
+                    idx_dev, home_dev, assign_dev, packed_dev,
+                    jnp.asarray(base), jnp.asarray(d2.astype(np.int32)),
+                    jnp.asarray(carry2), jnp.asarray(ob2), int(total),
+                )
+                _pull_region(fidx, fhome, 0, next_retire, sink_of)
+                break
+
+            # roll the level state forward: this level's arrays become the
+            # next step's "previous level"
+            idx_p, home_p, assign_p, member_p = (
+                idx_dev, home_dev, assign_dev, packed_dev,
+            )
+            mcap_p, sp_pad, mp_pad = mcap, s_pad, m_pad
+            base_p, dest, carry, total_p = base, dest2, carry2, total
+            nodes = next_nodes
+            out_base_np = np.asarray(next_starts, dtype=np.int64)
+            retire_entries = next_retire
+            total_out = total_out2
+
+    except BaseException:
+        # a failing level dispatch degrades the WHOLE build to the
+        # host recursion (spill.py's handler) — but leaf pulls
+        # already submitted would keep running as orphans on the
+        # shared process-wide pull worker: their spans/byte counters
+        # would charge a run whose results are discarded, a pull
+        # fault would be banked on a job nobody ever waits on, and
+        # the ordered single worker would delay the degraded run's
+        # later pipelined pulls behind them. Drain them here; their
+        # results land in lists this frame is about to drop, and a
+        # pull error is deliberately consumed (the build is already
+        # failing with the primary exception).
+        for job, _work in pull_jobs:
+            try:
+                engine.wait(job)
+            except Exception:  # noqa: BLE001 — already degrading
+                pass
+        raise
+    for job, work in pull_jobs:
+        engine.settle(job, work)
+    if info is not None:
+        info["levels"] = levels
+        info["level_dispatches"] = dispatches
+    return leaves, fallback
+
+
 def device_available() -> bool:
     """True when a non-CPU jax backend is initialized/initializable —
     the gate the spill tree uses before routing passes here. Import

@@ -1,0 +1,285 @@
+"""Level-synchronous device spill tree (spill_device.build_level_tree).
+
+``DBSCAN_SPILL_DEVICE=1`` forces the jax spill passes on the CPU
+backend (the device-path convention of tests/test_spill.py); the level
+build engages by default (``DBSCAN_SPILL_DEVICE_TREE``) and must
+produce IDENTICAL final labels to the host recursion — not just ARI
+1.0. That is a real contract, not luck: cluster MEMBERSHIP is
+decomposition-independent (every kernel-accepted pair shares a leaf, a
+point's home leaf sees its whole neighborhood, and the merge unions
+clusters across any doubly-labeled point), and spill runs number global
+ids canonically by minimum member row (driver.finalize_merge
+``canonical=True``), so two different trees — host and device pick
+DIFFERENT pivots by design — yield the same label vector
+(PARITY.md "Spill tree").
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.spill_tree
+
+
+def _unit_blobs(rng, k, per, d, jitter=0.004):
+    centers = rng.normal(size=(k, d)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    pts = np.repeat(centers, per, axis=0).astype(np.float32)
+    pts += jitter * rng.normal(size=pts.shape).astype(np.float32)
+    return pts
+
+
+def _train_kw(maxpp=256):
+    return dict(
+        eps=0.02, min_points=5, max_points_per_partition=maxpp,
+        metric="cosine",
+    )
+
+
+@pytest.fixture
+def fresh_resident_cache():
+    from dbscan_tpu.parallel import driver
+
+    driver._RESIDENT_CACHE.clear()
+    yield
+    driver._RESIDENT_CACHE.clear()
+
+
+def test_level_vs_host_labels_identical(rng, monkeypatch,
+                                        fresh_resident_cache):
+    """The tentpole parity contract: the level-synchronous device build
+    and the pure-host recursion produce byte-identical labels AND flags
+    through the full train pipeline, and the device run really took the
+    level path (spill_levels >= 1)."""
+    from dbscan_tpu import train
+
+    pts = _unit_blobs(rng, 15, 140, 24)
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "0")
+    m_host = train(pts, **_train_kw())
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "1")
+    m_dev = train(pts, **_train_kw())
+    assert m_dev.stats["spill_levels"] >= 1
+    assert m_host.stats["spill_levels"] == 0
+    assert m_dev.n_clusters == m_host.n_clusters == 15
+    assert np.array_equal(m_dev.clusters, m_host.clusters)
+    assert np.array_equal(m_dev.flags, m_host.flags)
+
+
+def test_level_vs_node_recursive_device(rng, monkeypatch,
+                                        fresh_resident_cache):
+    """DBSCAN_SPILL_DEVICE_TREE=0 is the parity oracle: the
+    node-recursive device path (same bf16 storage, different tree)
+    matches the level build label-for-label."""
+    from dbscan_tpu import train
+
+    pts = _unit_blobs(rng, 12, 130, 20)
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "1")
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE_TREE", "0")
+    m_node = train(pts, **_train_kw())
+    assert m_node.stats["spill_levels"] == 0
+
+    from dbscan_tpu.parallel import driver
+
+    driver._RESIDENT_CACHE.clear()
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE_TREE", "1")
+    m_level = train(pts, **_train_kw())
+    assert m_level.stats["spill_levels"] >= 1
+    assert np.array_equal(m_level.clusters, m_node.clusters)
+    assert np.array_equal(m_level.flags, m_node.flags)
+
+
+def test_level_partition_contract_and_layout(rng, monkeypatch):
+    """Direct spill_partition contract under the level build: exact
+    home-leaf invariant, every kernel-accepted pair shares a leaf, and
+    info_out carries the partition-major leaf layout (counts) without
+    the caller re-deriving it."""
+    from dbscan_tpu.parallel import spill
+
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "1")
+    d = 24
+    unit = _unit_blobs(rng, 12, 120, d)
+    unit /= np.linalg.norm(unit, axis=1, keepdims=True)
+    halo = spill.chord_halo(0.02, 1e-5, dim=d)
+    info = {}
+    pid, pidx, n_parts, home = spill.spill_partition(
+        unit, 256, halo, info_out=info
+    )
+    assert info["levels"] >= 1
+    assert info["level_dispatches"] <= info["levels"] + 1
+    counts = info["counts"]
+    assert len(counts) == n_parts and counts.sum() == len(pid)
+    # partition-major: offsets = cumsum(counts) slice exact leaves
+    offsets = np.r_[0, np.cumsum(counts)]
+    for p in range(n_parts):
+        assert (pid[offsets[p] : offsets[p + 1]] == p).all()
+    # home invariant: exactly one home leaf, containing the point
+    assert (home >= 0).all()
+    inst = set(zip(pid.tolist(), pidx.tolist()))
+    for p in range(0, len(unit), 89):
+        assert (home[p], p) in inst
+    # coverage: sampled accepted pairs share a leaf
+    sims = unit @ unit.T
+    acc = np.argwhere(np.triu(2.0 - 2.0 * sims <= halo * halo, k=1))
+    from collections import defaultdict
+
+    parts_of = defaultdict(set)
+    for pp, pt in zip(pid.tolist(), pidx.tolist()):
+        parts_of[pt].add(pp)
+    step = max(1, len(acc) // 4000)
+    for a, b in acc[::step]:
+        assert parts_of[int(a)] & parts_of[int(b)]
+
+
+def test_fault_on_level_dispatch(rng, monkeypatch, fresh_resident_cache):
+    """The retry/degrade ladder covers the new spill_level site: a
+    transient fault heals through supervised retries with identical
+    labels; a persistent fault degrades the WHOLE build to the host
+    recursion — also with identical labels (the point of the parity
+    contract)."""
+    from dbscan_tpu import train
+
+    pts = _unit_blobs(rng, 12, 140, 24)
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "1")
+    m_ref = train(pts, **_train_kw())
+    assert m_ref.stats["spill_levels"] >= 1
+
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "spill_level#0:TRANSIENT")
+    m_t = train(pts, **_train_kw())
+    assert m_t.stats["faults"]["injected"] >= 1
+    assert m_t.stats["faults"]["retries"] >= 1
+    assert np.array_equal(m_t.clusters, m_ref.clusters)
+
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "spill_level#0:PERSISTENT")
+    m_p = train(pts, **_train_kw())
+    assert m_p.stats["spill_levels"] == 0  # degraded to host recursion
+    assert np.array_equal(m_p.clusters, m_ref.clusters)
+
+    # a LATER level's dispatch failing leaves level-1 leaf pulls already
+    # submitted to the shared pull worker: the degrade path must drain
+    # them (no orphaned jobs/banked errors) and still match labels
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "spill_level#1:PERSISTENT")
+    m_p2 = train(pts, **_train_kw())
+    assert m_p2.stats["spill_levels"] == 0
+    assert np.array_equal(m_p2.clusters, m_ref.clusters)
+
+
+def test_degenerate_inputs(rng, monkeypatch):
+    """All-duplicate points (single halo ball), n below the leaf size,
+    and a single-open-node tree all terminate with the host-identical
+    layout invariants."""
+    from dbscan_tpu.parallel import spill
+
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "1")
+    d = 16
+    halo = spill.chord_halo(0.02, 1e-5, dim=d)
+
+    one = rng.normal(size=d).astype(np.float32)
+    one /= np.linalg.norm(one)
+    dup = np.tile(one, (700, 1))
+    info = {}
+    pid, pidx, n_parts, home = spill.spill_partition(
+        dup, 256, halo, info_out=info
+    )
+    # unsplittable: one oversized leaf, zero duplication
+    assert n_parts == 1 and len(pid) == 700
+    assert (home == 0).all()
+
+    # n <= maxpp: no tree at all
+    small = _unit_blobs(rng, 4, 20, d)
+    small /= np.linalg.norm(small, axis=1, keepdims=True)
+    pid2, _pidx2, np2, _h2 = spill.spill_partition(small, 256, halo)
+    assert np2 == 1
+
+    # single open node, one level deep: n just over the leaf size
+    unit = _unit_blobs(rng, 6, 60, d)
+    unit /= np.linalg.norm(unit, axis=1, keepdims=True)
+    info3 = {}
+    pid3, pidx3, np3, home3 = spill.spill_partition(
+        unit, 300, halo, info_out=info3
+    )
+    assert np3 >= 2 and (home3 >= 0).all()
+    assert info3["levels"] >= 1
+
+
+def test_recompile_stability_and_dispatch_count(rng, monkeypatch,
+                                                fresh_resident_cache):
+    """The level loop must not retrace per level: a second identical
+    run mints ZERO new spill.level compiles, and the dispatch counter
+    stays bounded by levels + 1 (one fused step per level + the closing
+    compact) — the one-dispatch-per-level acceptance pin."""
+    from dbscan_tpu import obs, train
+
+    pts = _unit_blobs(rng, 12, 140, 24)
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "1")
+    obs.enable()
+    try:
+        train(pts, **_train_kw())  # warm: compiles the level rungs
+        from dbscan_tpu.parallel import driver
+
+        driver._RESIDENT_CACHE.clear()
+        snap = obs.counters()
+        m = train(pts, **_train_kw())
+        delta = obs.counters_delta(snap)
+        assert delta.get("compiles.spill.level", 0) == 0, delta
+        assert delta.get("compiles.spill.level_final", 0) == 0, delta
+        levels = int(delta.get("spill.levels", 0))
+        dispatches = int(delta.get("spill.level_dispatches", 0))
+        assert levels == m.stats["spill_levels"] >= 1
+        assert dispatches <= levels + 1
+    finally:
+        obs.disable()
+
+
+def test_sparse_labels_decomposition_independent(monkeypatch):
+    """The sparse engine's leg of the parity contract: two DIFFERENT
+    spill decompositions (maxpp values → different trees and layouts)
+    yield byte-identical labels, because ids are canonical and
+    membership is decomposition-independent. This is the property the
+    device-vs-host toggle relies on for every engine that spills."""
+    import scipy.sparse as sp
+
+    from dbscan_tpu.ops.sparse import sparse_cosine_dbscan
+
+    rng = np.random.default_rng(7)
+    k, per, vocab, nnz = 40, 60, 5000, 24
+    feat = rng.integers(0, vocab, size=(k, nnz))
+    val = rng.random((k, nnz)) + 0.1
+    blob_of = np.repeat(np.arange(k), per)
+    rows = np.repeat(np.arange(k * per), nnz)
+    cols = feat[blob_of].ravel()
+    vals = (val[blob_of] * rng.uniform(0.9, 1.1, (k * per, nnz))).ravel()
+    x = sp.coo_matrix((vals, (rows, cols)), shape=(k * per, vocab)).tocsr()
+
+    kw = dict(eps=0.05, min_points=5)
+    c1, f1 = sparse_cosine_dbscan(x, max_points_per_partition=256, **kw)
+    c2, f2 = sparse_cosine_dbscan(x, max_points_per_partition=700, **kw)
+    assert np.array_equal(c1, c2)
+    assert np.array_equal(f1, f2)
+
+
+def test_level_model_pins():
+    """Cross-module constants the lint model mirrors without imports,
+    plus the fault-site registration for the new dispatch."""
+    from dbscan_tpu import faults
+    from dbscan_tpu.lint.shapes import FAMILY_MODELS, LEVEL_PIVOT_CAP
+    from dbscan_tpu.parallel import spill
+
+    assert LEVEL_PIVOT_CAP == spill._MAX_PIVOTS
+    assert "spill.level" in FAMILY_MODELS
+    assert "spill.level_final" in FAMILY_MODELS
+    # the split policy is ONE implementation: the device build's pivot
+    # request delegates to the host recursion's escalation formula, and
+    # both read the same concentration-signature constants
+    from dbscan_tpu.parallel import spill_device
+
+    for count, attempt, maxpp in (
+        (10_000, 0, 256), (10_000, 2, 256), (5_000_000, 1, 8192),
+    ):
+        assert spill_device._level_m_req(
+            count, attempt, maxpp
+        ) == spill.pivot_escalation(count, attempt, maxpp)
+    assert spill.SCREEN_DUP_MARGIN == 1.15
+    assert spill.CONCENTRATION_CELL_FRAC == 0.5
+    assert faults.SITE_SPILL_LEVEL in faults._SITES
+    (clause,) = faults.parse_fault_spec("spill_level#2:TRANSIENT*2")
+    assert clause.site == "spill_level"
+    assert clause.ordinal == 2 and clause.count == 2
