@@ -681,12 +681,20 @@ def _rep_obs_fields(delta: dict, dt: float) -> dict:
     misses = int(delta.get("resident_cache.misses", 0))
     if hits or misses:
         out["resident_hot"] = hits > 0 and misses == 0
+    # device-busy share of the rep wall, from the devtime ready-sync
+    # brackets (obs/devtime.py): the MEASURED device-time figure the
+    # host-inferred ratios get checked against. Absent when the rep ran
+    # no bracketed dispatch (devtime off / no tracked dispatch).
+    if delta.get("devtime.samples"):
+        dev_s = float(delta.get("devtime.device_s", 0.0))
+        out["device_busy_frac"] = round(min(1.0, dev_s / dt), 4)
     return out
 
 
 def run_train(pts, maxpp, use_pallas=False, reps=1, **extra):
     from dbscan_tpu import Engine, obs, train
     from dbscan_tpu.lint import shapecheck
+    from dbscan_tpu.obs import devtime as devtime_mod
 
     kw = dict(
         eps=EPS,
@@ -705,6 +713,16 @@ def run_train(pts, maxpp, use_pallas=False, reps=1, **extra):
     # callers that had it off.
     sc_was_on = shapecheck.enabled()
     shapecheck.enable()
+    # devtime ready-sync brackets ride the bench run the same way: the
+    # per-dispatch block_until_ready serializes the dispatch tail (the
+    # DBSCAN_TIME_DEVICE trade, made per-family), buying the MEASURED
+    # device_busy_frac figure on every headline/anchor row — the
+    # device-side ground truth the host-inferred ratios (pull_overlap,
+    # compute_s) get gated against. BENCH_DEVTIME=0 opts a capture out
+    # when the sync bias must be zero (e.g. record-attempt TPU walls).
+    dev_was_on = devtime_mod.enabled()
+    if os.environ.get("BENCH_DEVTIME", "1") == "1":
+        devtime_mod.enable()
     try:
         # compile warm-up on identical shapes, then best-of-reps timed
         # runs: the TPU is reached over a shared tunnel whose transfer
@@ -769,6 +787,8 @@ def run_train(pts, maxpp, use_pallas=False, reps=1, **extra):
     finally:
         if not sc_was_on:
             shapecheck.disable()
+        if not dev_was_on:
+            devtime_mod.disable()
 
 
 def child_cpu(data_path: str, out_path: str, maxpp: int) -> None:
@@ -1251,6 +1271,9 @@ _COMPACT_SUFFIXES = (
     # graftshape containment figure (lint/shapecheck.py): observed HBM
     # peak / statically predicted peak, hard-capped <= 1.0 by regress
     "_hbm_pred_ratio",
+    # devtime measured device-busy share of the rep wall
+    # (obs/devtime.py): gates higher-better like the overlap ratio
+    "_device_busy_frac",
 )
 
 

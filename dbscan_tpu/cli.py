@@ -224,11 +224,18 @@ def _run(args, log) -> int:
             if isinstance(value, float):
                 value = round(value, 6)
             print(f"  {name:<28} {value}")
+        # gauges ride the summary next to the counters (HBM watermarks,
+        # pull.inflight/queue_depth) — set-last-wins values, so this is
+        # the run's END state; pinned by tests/test_flight.py
         gauges = summ.get("gauges") or {}
         if gauges:
             print("gauges:")
             for name, value in sorted(gauges.items()):
                 print(f"  {name:<28} {value}")
+        from dbscan_tpu.obs import flight
+
+        if flight.active():
+            print(f"flight recorder: on (dump -> {flight._default_path()})")
 
     if args.output:
         io_mod.save_labeled(

@@ -345,7 +345,52 @@ ENV_VARS = _env_table(
     EnvVar(
         "DBSCAN_TRACE", "str", None,
         "Path that activates observability at the pipeline entry points "
-        "and receives the trace (Chrome JSON, or JSONL for .jsonl).",
+        "and receives the trace (Chrome JSON, or JSONL for .jsonl). "
+        "Multi-process runs write per-process shards <path>.<i>, merged "
+        "by python -m dbscan_tpu.obs.analyze --merge.",
+    ),
+    EnvVar(
+        "DBSCAN_FLIGHTREC", "bool", True,
+        "Always-on flight recorder (obs/flight.py): a bounded ring of "
+        "the most recent spans/events/counters, dumped as JSON on a "
+        "fatal fault, SIGTERM, SIGUSR1, or obs.flight.dump(); 0 "
+        "restores the strict no-op hook path.",
+    ),
+    EnvVar(
+        "DBSCAN_FLIGHTREC_PATH", "str", "flightrec.json",
+        "Flight-recorder dump path (multi-process runs shard it as "
+        "<path>.<process_index>, like DBSCAN_TRACE).",
+    ),
+    EnvVar(
+        "DBSCAN_FLIGHTREC_EVENTS", "int", 2048,
+        "Flight-recorder ring capacity: the dump carries at least this "
+        "many trailing spans/instants (floor 64).",
+    ),
+    EnvVar(
+        "DBSCAN_DEVTIME", "bool", False,
+        "Ready-sync device-timeline brackets (obs/devtime.py): every "
+        "tracked dispatch blocks on its outputs and records devtime.* "
+        "counters plus a devtime.<family> span — the always-available "
+        "device-busy measurement (serializes the dispatch tail; bench "
+        "enables it around its timed reps).",
+    ),
+    EnvVar(
+        "DBSCAN_PROFILE_WINDOW", "int", 0,
+        "When >0, open one jax.profiler capture window spanning this "
+        "many tracked dispatches (closed automatically; atexit guard "
+        "stops an abandoned session). One window per process.",
+    ),
+    EnvVar(
+        "DBSCAN_PROFILE_DIR", "str", "dbscan_profile",
+        "Log directory the DBSCAN_PROFILE_WINDOW capture writes to "
+        "(TensorBoard profile layout; obs.devtime.convert_profile "
+        "turns any emitted trace.json[.gz] into our Chrome format).",
+    ),
+    EnvVar(
+        "DBSCAN_PULL_STALL_S", "float", 30.0,
+        "Seconds a pull-pipeline consumer may block on one job before "
+        "a pull.stall event (with queue depth) is emitted — the "
+        "wedged-engine mark the flight recorder captures; <=0 disables.",
     ),
     EnvVar(
         "DBSCAN_TRACE_MAX_SPANS", "int", 200000,

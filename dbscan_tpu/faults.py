@@ -55,6 +55,7 @@ import numpy as np
 
 from dbscan_tpu import config, obs
 from dbscan_tpu.lint import tsan as _tsan
+from dbscan_tpu.obs import flight as _obs_flight
 from dbscan_tpu.obs import memory as _obs_memory
 
 logger = logging.getLogger(__name__)
@@ -573,6 +574,13 @@ def supervised(
         attempts=attempts,
         error=f"{type(last).__name__}"[:80],
     )
+    # the run is about to die with no degradation path: leave the
+    # flight-recorder postmortem (the ring's tail + this abort site)
+    # BEFORE raising, so even a caller with no abort handler of its own
+    # (spill/stream sites) gets a dump; the driver's abort guard dumps
+    # again after checkpoint.note_abort with the banked-chunk context —
+    # same file, atomically rewritten, strictly more information.
+    _obs_flight.dump_on_fault(site, ordinal, f"{type(last).__name__}: {last}")
     raise FatalDeviceFault(site, ordinal, attempts, last)
 
 

@@ -28,11 +28,18 @@ Activation:
   or a harness (bench.py enables an in-memory registry around its
   timed reps; no file unless a path is given).
 
-THE DISABLED PATH IS A STRICT NO-OP (pinned by tests/test_obs.py and
-the overhead-guard test): every module-level hook performs exactly one
-truthiness check of the process-global state — no allocation, no
-registry, no file is ever touched — so the hooks are safe to leave
-wired through every hot call site.
+THE DISABLED PATH IS (NEARLY) A STRICT NO-OP (pinned by
+tests/test_obs.py and the overhead-guard tests): with full
+observability off, every module-level hook performs one truthiness
+check of the process-global state plus one of the flight recorder's
+(:mod:`dbscan_tpu.obs.flight` — the always-on bounded postmortem ring,
+``DBSCAN_FLIGHTREC``, default on). With the recorder live the hook
+appends to its bounded ring (<1% on the dense bench shape, pinned by
+tests/test_flight.py); with ``DBSCAN_FLIGHTREC=0`` the original strict
+no-op path is restored — no allocation, no registry, no file is ever
+touched. When observability is ENABLED the hooks record once, into the
+live registries only (the flight dump then reads their tail), so the
+enabled path pays nothing new.
 """
 
 from __future__ import annotations
@@ -43,11 +50,14 @@ from typing import Optional
 from dbscan_tpu import config
 from dbscan_tpu.lint import tsan as _tsan
 from dbscan_tpu.obs import export as export_mod
+from dbscan_tpu.obs import flight
+_flight = flight  # internal alias: hot hooks read _flight._state directly
 from dbscan_tpu.obs.metrics import MetricsRegistry
 from dbscan_tpu.obs.trace import NOOP_SPAN, Span, Tracer  # noqa: F401
 
 __all__ = [
     "NOOP_SPAN",
+    "flight",
     "Span",
     "Tracer",
     "MetricsRegistry",
@@ -132,7 +142,11 @@ def enable(
             )
         elif trace_path and not _state.trace_path:
             _state.trace_path = trace_path
-        return _state
+        st = _state
+    # the flight recorder's env latch (and its signal handlers) must be
+    # live for obs-enabled runs too: the dump then reads THESE registries
+    _flight.ensure_env()
+    return st
 
 
 def disable() -> None:
@@ -148,55 +162,87 @@ def disable() -> None:
 
 def ensure_env() -> None:
     """Activate from ``DBSCAN_TRACE=path`` when set — called at the
-    pipeline entry points; one env lookup when disabled, one truthiness
-    check when already live."""
+    pipeline entry points — and (re)apply the always-on subsystems'
+    env knobs: the flight recorder (``DBSCAN_FLIGHTREC``) and the
+    device-timeline hooks (``DBSCAN_DEVTIME`` /
+    ``DBSCAN_PROFILE_WINDOW``). A few env lookups per train entry;
+    each subsystem latches its value, so steady-state updates pay no
+    state churn."""
     if _state is None:
         path = config.env("DBSCAN_TRACE")
         if path:
             enable(trace_path=path)
+    _flight.ensure_env()
+    from dbscan_tpu.obs import devtime as _devtime
+
+    _devtime.ensure_env()
 
 
-# --- hot-path hooks (single truthiness check each) --------------------
+# --- hot-path hooks ---------------------------------------------------
+#
+# Each hook truth-checks the obs state, then — only when obs is off —
+# the flight recorder's (a plain module-global read, no call). The
+# recorder reuses the same Tracer/MetricsRegistry machinery, so the
+# two destinations behave identically; a run records into exactly ONE.
 
 
 def span(name: str, **args):
-    """Open a nested span (context manager); NOOP_SPAN when disabled."""
+    """Open a nested span (context manager); NOOP_SPAN when both
+    observability and the flight recorder are off."""
     st = _state
-    if st is None:
+    if st is not None:
+        return st.tracer.span(name, args)
+    fs = _flight._state
+    if fs is None:
         return NOOP_SPAN
-    return st.tracer.span(name, args)
+    return fs.tracer.span(name, args)
 
 
 def add_span(name: str, t0: float, t1: float, **args):
     """Register a retroactive span from perf_counter bounds — the
     bridge for phases that already time themselves (driver timings)."""
     st = _state
-    if st is None:
+    if st is not None:
+        return st.tracer.add_span(name, t0, t1, args)
+    fs = _flight._state
+    if fs is None:
         return None
-    return st.tracer.add_span(name, t0, t1, args)
+    return fs.tracer.add_span(name, t0, t1, args)
 
 
 def event(name: str, **args) -> None:
     """Instant event: attaches to the innermost open span on this
     thread, else to the process-level list."""
     st = _state
-    if st is None:
+    if st is not None:
+        st.tracer.instant(name, args)
         return
-    st.tracer.instant(name, args)
+    fs = _flight._state
+    if fs is None:
+        return
+    fs.tracer.instant(name, args)
 
 
 def count(name: str, value=1) -> None:
     st = _state
-    if st is None:
+    if st is not None:
+        st.metrics.count(name, value)
         return
-    st.metrics.count(name, value)
+    fs = _flight._state
+    if fs is None:
+        return
+    fs.metrics.count(name, value)
 
 
 def gauge(name: str, value) -> None:
     st = _state
-    if st is None:
+    if st is not None:
+        st.metrics.gauge(name, value)
         return
-    st.metrics.gauge(name, value)
+    fs = _flight._state
+    if fs is None:
+        return
+    fs.metrics.gauge(name, value)
 
 
 # --- snapshots / export -----------------------------------------------
@@ -221,11 +267,20 @@ def counters_delta(snap: dict) -> dict:
 def flush() -> Optional[str]:
     """Write the accumulated trace to the configured path (full
     rewrite — atomic, cumulative across runs in this process); returns
-    the path, or None when disabled or path-less."""
+    the path, or None when disabled or path-less. Multi-process runs
+    write per-process shards — ``<path>.<process_index>`` — so the
+    workers of one job never clobber a shared trace path; merge them
+    with ``python -m dbscan_tpu.obs.analyze --merge <shards>``."""
     st = _state
     if st is None or not st.trace_path:
         return None
-    return export_mod.write(st.trace_path, st.tracer, st.metrics)
+    suffix = export_mod.shard_suffix()
+    path = st.trace_path + suffix
+    if suffix and st.trace_path.endswith(".jsonl"):
+        # the shard suffix hides the extension from write()'s
+        # format-by-extension rule; keep the configured format
+        return export_mod.write_jsonl(path, st.tracer, st.metrics)
+    return export_mod.write(path, st.tracer, st.metrics)
 
 
 def write(path: str) -> Optional[str]:
@@ -252,8 +307,12 @@ def summary(top: int = 10) -> dict:
 
 def timed_count(name: str, t0: float) -> None:
     """Accumulate elapsed-since-``t0`` seconds into counter ``name``
-    (one perf_counter call, only when enabled)."""
+    (one perf_counter call, only when a destination is live)."""
     st = _state
-    if st is None:
+    if st is not None:
+        st.metrics.count(name, time.perf_counter() - t0)
         return
-    st.metrics.count(name, time.perf_counter() - t0)
+    fs = _flight._state
+    if fs is None:
+        return
+    fs.metrics.count(name, time.perf_counter() - t0)

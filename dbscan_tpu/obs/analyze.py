@@ -1,6 +1,7 @@
 """Offline trace analyzer: per-phase rollups, critical-path (self-time)
 attribution, transfer-bandwidth tables, hot/cold resident-cache splits,
-and memory watermarks — from a PR-2 trace file alone.
+memory watermarks, device-timeline rollups, and the multi-shard merge —
+from trace files alone.
 
 `obs/export.py` writes two formats (Chrome-trace JSON and JSONL) and
 until now nothing in the repo CONSUMED them: answering "where did the
@@ -11,6 +12,8 @@ This module reads either format back and prints the rollups the VERDICT
 rounds kept asking for::
 
     python -m dbscan_tpu.obs.analyze trace.json [--top N] [--json]
+    python -m dbscan_tpu.obs.analyze --merge shard.0 shard.1 \
+        [-o merged.json] [--json]
 
 Self-time model: spans are nested intervals per thread (the tracer's
 thread-local stack guarantees proper nesting for live spans;
@@ -22,16 +25,35 @@ wait from the host algebra. A span that OVERLAPS but is not contained
 (possible only for hand-built traces; the tracer never emits one)
 charges its full wall to the span it starts inside.
 
+Device timeline (PR 9): when a capture carries ``devtime.*`` telemetry
+(obs/devtime.py ready-sync brackets, or the converted profiler window),
+the report adds a per-family device-time rollup — device-busy vs
+host-busy vs the train wall — and a MEASURED cross-check of the pull
+pipeline's host-inferred ``pull.overlap_s``: the device-side overlap is
+the exact interval intersection of the ``pull.chunk`` windows with the
+union of ``devtime.<family>`` windows.
+
+Multi-shard merge (``--merge``): per-process shards
+(``DBSCAN_TRACE=<path>`` writes ``<path>.<i>`` under multi-process
+runs) are clock-aligned on their ``epoch0`` wall anchors, given
+disjoint track ids (pid = shard index + 1; every (shard, tid) pair maps
+to a distinct merged tid), written as ONE Perfetto-loadable trace, and
+rolled into a cross-process critical path: per-shard busy/exclusive
+seconds plus the longest single-shard-busy stretches — the stretches
+where that one process WAS the job's critical path.
+
 Programmatic API: :func:`load_trace` -> :func:`analyze` -> report dict
-(exact numbers, test surface) -> :func:`render` -> text.
+(exact numbers, test surface) -> :func:`render` -> text;
+:func:`merge_shards` for the merge leg.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
-from typing import Optional
+from typing import List, Optional
 
 from dbscan_tpu.obs import schema
 
@@ -45,14 +67,40 @@ _TRANSFER_KEYS = (
     "transfer.d2h_bytes",
     "transfer.d2h_s",
 )
-for _k in _TRANSFER_KEYS:
-    assert schema.is_declared("counter", _k), _k
-for _k in _RESIDENT_MARKS:
-    assert schema.is_declared("event", _k), _k
+_DEVTIME_KEYS = (
+    "devtime.samples",
+    "devtime.dispatch_s",
+    "devtime.sync_s",
+    "devtime.device_s",
+)
+_PULL_CHECK_KEYS = ("pull.busy_s", "pull.overlap_s")
+
+#: every section this module renders, mapped to the declared name
+#: family it reads — the schema-coverage contract `tests/test_obs.py`
+#: asserts (a section whose names vanish from obs/schema.py breaks at
+#: import/test time, never silently renders empty)
+SECTIONS = {
+    "phases": ("span", None),  # all spans; no name filter
+    "bandwidth": ("counter", _TRANSFER_KEYS),
+    "resident": ("event", _RESIDENT_MARKS),
+    "memory": ("gauge", schema.PREFIX_MEMORY),
+    "compiles": ("counter", schema.PREFIX_COMPILES),
+    "faults": ("counter", schema.PREFIX_FAULTS),
+    "devtime": ("counter", _DEVTIME_KEYS),
+    "pull_check": ("counter", _PULL_CHECK_KEYS),
+}
+for _kind, _names in SECTIONS.values():
+    if isinstance(_names, tuple):
+        for _k in _names:
+            assert schema.is_declared(_kind, _k), (_kind, _k)
+    elif isinstance(_names, str):
+        assert schema.prefix_declared(_kind, _names), (_kind, _names)
 assert schema.is_declared("counter", "resident_cache.hits")
 assert schema.is_declared("counter", "resident_cache.misses")
 assert schema.is_declared("span", "transfer.pull")
-del _k
+assert schema.is_declared("span", "pull.chunk")
+assert schema.prefix_declared("span", schema.PREFIX_DEVTIME)
+del _k, _kind, _names
 
 
 def load_trace(path: str) -> dict:
@@ -107,19 +155,29 @@ def _from_chrome(obj: dict) -> dict:
         "counters": counters,
         "gauges": dict(other.get("gauges") or {}),
         "dropped_spans": int(other.get("dropped_spans", 0)),
+        # clock anchor + track identity for --merge (absent on pre-PR-9
+        # traces: they merge with offset 0 and a synthetic pid)
+        "meta": {
+            k: other[k] for k in ("epoch0", "pid", "shard") if k in other
+        },
     }
 
 
 def _from_jsonl(text: str) -> dict:
     spans, instants, counters, gauges = [], [], {}, {}
     dropped = 0
+    meta: dict = {}
     for line in text.splitlines():
         line = line.strip()
         if not line:
             continue
         r = json.loads(line)
         t = r.get("type")
-        if t == "span":
+        if t == "meta":
+            meta = {
+                k: r[k] for k in ("epoch0", "pid", "shard") if k in r
+            }
+        elif t == "span":
             spans.append(
                 {
                     "name": r["name"],
@@ -151,6 +209,7 @@ def _from_jsonl(text: str) -> dict:
         "counters": counters,
         "gauges": gauges,
         "dropped_spans": dropped,
+        "meta": meta,
     }
 
 
@@ -280,6 +339,119 @@ def _resident_split(data: dict) -> dict:
     return out
 
 
+def _union_intervals(intervals: list) -> list:
+    """Sorted disjoint union of (t0, t1) intervals."""
+    out: list = []
+    for t0, t1 in sorted(intervals):
+        if t1 <= t0:
+            continue
+        if out and t0 <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], t1))
+        else:
+            out.append((t0, t1))
+    return out
+
+
+def _intersection_s(a: list, b: list) -> float:
+    """Total overlap seconds between two interval lists (each is
+    union-ed first) — exact arithmetic, the measured-overlap primitive."""
+    a, b = _union_intervals(a), _union_intervals(b)
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def _devtime_rollup(counters: dict, spans: list) -> dict:
+    """Device-timeline section: per-family issue->ready windows from
+    the ``devtime.<family>`` spans plus the counter totals, and the
+    device-busy share of the train wall (the figure bench stamps as
+    ``device_busy_frac``). Empty dict when the capture carries no
+    devtime telemetry (the DBSCAN_DEVTIME brackets were off)."""
+    dev_spans = [
+        sp for sp in spans
+        if sp["name"].startswith(schema.PREFIX_DEVTIME)
+    ]
+    if not dev_spans and not counters.get("devtime.samples"):
+        return {}
+    fams: dict = {}
+    for sp in dev_spans:
+        fam = sp["name"][len(schema.PREFIX_DEVTIME):]
+        row = fams.setdefault(
+            fam,
+            {"family": fam, "count": 0, "device_s": 0.0,
+             "host_s": 0.0, "sync_s": 0.0},
+        )
+        row["count"] += 1
+        row["device_s"] += sp["dur"]
+        row["host_s"] += float(sp["args"].get("host_s", 0.0))
+        row["sync_s"] += float(sp["args"].get("sync_s", 0.0))
+    rows = sorted(fams.values(), key=lambda r: -r["device_s"])
+    for r in rows:
+        for k in ("device_s", "host_s", "sync_s"):
+            r[k] = round(r[k], 6)
+    train_wall = sum(
+        sp["dur"] for sp in spans if sp["name"] == "train"
+    )
+    device_s = float(counters.get("devtime.device_s", 0.0)) or sum(
+        r["device_s"] for r in rows
+    )
+    out = {
+        "families": rows,
+        "samples": int(counters.get("devtime.samples", 0)),
+        "device_s": round(device_s, 6),
+        "dispatch_s": round(
+            float(counters.get("devtime.dispatch_s", 0.0)), 6
+        ),
+        "sync_s": round(float(counters.get("devtime.sync_s", 0.0)), 6),
+    }
+    if train_wall > 0:
+        out["train_wall_s"] = round(train_wall, 6)
+        out["device_busy_frac"] = round(
+            min(1.0, device_s / train_wall), 4
+        )
+    return out
+
+
+def _pull_device_check(counters: dict, spans: list) -> dict:
+    """The measured check of ``pull_overlap_ratio``: the host-side
+    figure claims pull/finalize seconds were hidden behind other work;
+    the device side corroborates by intersecting the ``pull.chunk``
+    windows with the union of the ``devtime.<family>`` device windows.
+    Empty when the capture has no pull jobs or no devtime spans (there
+    is nothing to check against)."""
+    pulls = [
+        (sp["t0"], sp["t0"] + sp["dur"])
+        for sp in spans
+        if sp["name"] == "pull.chunk"
+    ]
+    devs = [
+        (sp["t0"], sp["t0"] + sp["dur"])
+        for sp in spans
+        if sp["name"].startswith(schema.PREFIX_DEVTIME)
+    ]
+    busy = float(counters.get("pull.busy_s", 0.0))
+    if not pulls or not devs or busy <= 0:
+        return {}
+    measured = _intersection_s(pulls, devs)
+    host_overlap = float(counters.get("pull.overlap_s", 0.0))
+    return {
+        "pull_busy_s": round(busy, 6),
+        "host_overlap_s": round(host_overlap, 6),
+        "host_overlap_ratio": round(min(1.0, host_overlap / busy), 4),
+        "device_overlap_s": round(measured, 6),
+        "device_overlap_ratio": round(min(1.0, measured / busy), 4),
+    }
+
+
 def analyze(data: dict, top: Optional[int] = None) -> dict:
     """Full report from normalized trace data (see module doc). Exact
     and deterministic — the test surface asserts on these numbers."""
@@ -305,6 +477,257 @@ def analyze(data: dict, top: Optional[int] = None) -> dict:
             k: v for k, v in sorted(counters.items())
             if k.startswith(schema.PREFIX_FAULTS)
         },
+        "devtime": _devtime_rollup(counters, spans),
+        "pull_check": _pull_device_check(counters, spans),
+    }
+
+
+# --- multi-shard merge ------------------------------------------------
+
+
+def merge_shards(paths: List[str]) -> dict:
+    """Load per-process trace shards, align their clocks, and build the
+    merged view: ``{"data": <normalized, analyze()-ready>,
+    "trace": <one Perfetto-loadable Chrome object>,
+    "merge": <cross-process critical-path section>}``.
+
+    Clock alignment: every shard's span times are relative to its own
+    tracer base; the export's ``epoch0`` anchors that base to wall
+    clock, so shard i's offset is ``epoch0_i - min(epoch0)``. A shard
+    without an anchor (pre-PR-9 capture, converted profiler trace)
+    merges at offset 0.
+
+    Track ids are made disjoint BY CONSTRUCTION: merged pid = shard
+    index + 1 (the original pid moves into the process_name metadata
+    and ``otherData.shards``), and every distinct (shard, tid) pair
+    maps to a fresh small merged tid — two processes that happened to
+    share an OS pid/thread id can never interleave on one track."""
+    shards = []
+    for i, p in enumerate(paths):
+        d = load_trace(p)
+        meta = d.get("meta") or {}
+        shards.append(
+            {
+                "index": i,
+                "source": os.path.basename(p),
+                "data": d,
+                "epoch0": meta.get("epoch0"),
+                "orig_pid": meta.get("pid"),
+                "shard_id": meta.get("shard"),
+            }
+        )
+    anchors = [s["epoch0"] for s in shards if s["epoch0"] is not None]
+    base = min(anchors) if anchors else 0.0
+    for s in shards:
+        s["offset"] = (
+            float(s["epoch0"]) - base if s["epoch0"] is not None else 0.0
+        )
+
+    # merged normalized data: offset times, disjoint (shard, tid) tracks
+    tid_map: dict = {}
+
+    def _tid(i, tid):
+        key = (i, tid)
+        if key not in tid_map:
+            tid_map[key] = len(tid_map) + 1
+        return tid_map[key]
+
+    m_spans, m_instants, m_counters = [], [], {}
+    trace_events = []
+    for s in shards:
+        i, off, d = s["index"], s["offset"], s["data"]
+        pid = i + 1
+        label = f"shard{i}"
+        trace_events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "ts": 0.0,
+                "pid": pid,
+                "args": {
+                    "name": f"{label} ({s['source']}"
+                    + (
+                        f", pid {s['orig_pid']}"
+                        if s["orig_pid"] is not None
+                        else ""
+                    )
+                    + ")"
+                },
+            }
+        )
+        shard_spans = []
+        for sp in d["spans"]:
+            msp = dict(
+                sp, t0=sp["t0"] + off, tid=_tid(i, sp["tid"]),
+                shard=i,
+            )
+            m_spans.append(msp)
+            shard_spans.append(msp)
+            trace_events.append(
+                {
+                    "name": sp["name"],
+                    "cat": "dbscan",
+                    "ph": "X",
+                    "ts": msp["t0"] * 1e6,
+                    "dur": sp["dur"] * 1e6,
+                    "pid": pid,
+                    "tid": msp["tid"],
+                    "args": dict(sp["args"], depth=sp["depth"], shard=i),
+                }
+            )
+        for inst in d["instants"]:
+            m_instants.append(
+                dict(inst, t=inst["t"] + off, shard=i)
+            )
+            trace_events.append(
+                {
+                    "name": inst["name"],
+                    "cat": "dbscan",
+                    "ph": "i",
+                    "s": "p",
+                    "ts": (inst["t"] + off) * 1e6,
+                    "pid": pid,
+                    "tid": 0,
+                    "args": dict(inst["args"], shard=i),
+                }
+            )
+        for name, value in sorted(d["counters"].items()):
+            if isinstance(value, (int, float)) and not isinstance(
+                value, bool
+            ):
+                m_counters[name] = m_counters.get(name, 0) + value
+            trace_events.append(
+                {
+                    "name": name,
+                    "cat": "dbscan",
+                    "ph": "C",
+                    "ts": 0.0,
+                    "pid": pid,
+                    "args": {"value": value},
+                }
+            )
+        s["busy_intervals"] = _union_intervals(
+            [(sp["t0"], sp["t0"] + sp["dur"]) for sp in shard_spans]
+        )
+    trace_events.sort(key=lambda e: (e.get("ts", 0.0), e["ph"] != "M"))
+    merged_trace = {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged": True,
+            "epoch_base": base,
+            "shards": [
+                {
+                    "index": s["index"],
+                    "pid": s["index"] + 1,
+                    "source": s["source"],
+                    "orig_pid": s["orig_pid"],
+                    "shard": s["shard_id"],
+                    "offset_s": round(s["offset"], 9),
+                }
+                for s in shards
+            ],
+        },
+    }
+    data = {
+        "spans": m_spans,
+        "instants": m_instants,
+        "counters": m_counters,
+        "gauges": {},  # set-last-wins values do not merge meaningfully
+        "dropped_spans": sum(s["data"]["dropped_spans"] for s in shards),
+        "meta": {"merged": True},
+    }
+    return {
+        "data": data,
+        "trace": merged_trace,
+        "merge": _merge_critical_path(shards),
+    }
+
+
+def _merge_critical_path(shards: list, top_segments: int = 10) -> dict:
+    """Cross-process critical path over the merged wall: sweep the
+    union of every shard's busy intervals; an instant where exactly ONE
+    shard is busy means that shard IS the job's critical path there
+    (everyone else idles on it — the merge-barrier shape the reference
+    paper's driver-side merge forces, DBSCAN.scala:171-178). Reports
+    per-shard busy/exclusive seconds, the all-busy (truly parallel) and
+    idle shares, and the longest exclusive stretches with the span that
+    was running."""
+    if not shards:
+        return {}
+    bounds = [
+        iv for s in shards for iv in s["busy_intervals"]
+    ]
+    if not bounds:
+        return {}
+    t_min = min(iv[0] for iv in bounds)
+    t_max = max(iv[1] for iv in bounds)
+    edges = sorted(
+        {t for s in shards for iv in s["busy_intervals"] for t in iv}
+    )
+    per_shard = {
+        s["index"]: {"busy_s": 0.0, "exclusive_s": 0.0} for s in shards
+    }
+    all_busy = idle = 0.0
+    segments: list = []
+    # one advancing cursor per shard: its busy intervals are sorted and
+    # disjoint, and every interval endpoint is an edge, so an interval
+    # that covers a segment's start covers the whole segment — the sweep
+    # is O(edges * shards), not O(edges * intervals) (a fragmented
+    # 200k-span shard would otherwise make the merge quadratic)
+    cursors = {s["index"]: 0 for s in shards}
+    for a, b in zip(edges, edges[1:]):
+        if b <= a:
+            continue
+        busy_here = []
+        for s in shards:
+            ivs = s["busy_intervals"]
+            i = cursors[s["index"]]
+            while i < len(ivs) and ivs[i][1] <= a:
+                i += 1
+            cursors[s["index"]] = i
+            if i < len(ivs) and ivs[i][0] <= a:
+                busy_here.append(s["index"])
+        dur = b - a
+        for i in busy_here:
+            per_shard[i]["busy_s"] += dur
+        if len(busy_here) == 0:
+            idle += dur
+        elif len(busy_here) == len(shards):
+            all_busy += dur
+        if len(busy_here) == 1:
+            i = busy_here[0]
+            per_shard[i]["exclusive_s"] += dur
+            # coalesce adjacent exclusive segments of the same shard
+            if segments and segments[-1]["shard"] == i and abs(
+                segments[-1]["t1_s"] - a
+            ) < 1e-9:
+                segments[-1]["t1_s"] = b
+            else:
+                segments.append({"shard": i, "t0_s": a, "t1_s": b})
+    for seg in segments:
+        seg["dur_s"] = round(seg["t1_s"] - seg["t0_s"], 6)
+        seg["t0_s"] = round(seg["t0_s"], 6)
+        seg["t1_s"] = round(seg["t1_s"], 6)
+    segments.sort(key=lambda g: -g["dur_s"])
+    return {
+        "n_shards": len(shards),
+        "wall_s": round(t_max - t_min, 6),
+        "all_busy_s": round(all_busy, 6),
+        "idle_s": round(idle, 6),
+        "shards": [
+            {
+                "index": s["index"],
+                "source": s["source"],
+                "offset_s": round(s["offset"], 6),
+                "busy_s": round(per_shard[s["index"]]["busy_s"], 6),
+                "exclusive_s": round(
+                    per_shard[s["index"]]["exclusive_s"], 6
+                ),
+            }
+            for s in shards
+        ],
+        "serial_segments": segments[:top_segments],
     }
 
 
@@ -387,6 +810,72 @@ def render(report: dict) -> str:
         for k, v in report["faults"].items():
             v = round(v, 6) if isinstance(v, float) else v
             out.append(f"{k:<36} {v:>12}")
+    dev = report.get("devtime") or {}
+    if dev:
+        out.append("")
+        out.append("-- device timeline (ready-sync brackets) --")
+        out.append(
+            f"{'family':<24} {'count':>6} {'device_s':>10} "
+            f"{'host_s':>10} {'sync_s':>10}"
+        )
+        for r in dev["families"]:
+            out.append(
+                f"{r['family']:<24} {r['count']:>6} "
+                f"{r['device_s']:>10.3f} {r['host_s']:>10.3f} "
+                f"{r['sync_s']:>10.3f}"
+            )
+        line = (
+            f"device busy {dev['device_s']:.3f}s"
+            f" (dispatch {dev['dispatch_s']:.3f}s"
+            f" + sync {dev['sync_s']:.3f}s)"
+        )
+        if "device_busy_frac" in dev:
+            line += (
+                f" / train wall {dev['train_wall_s']:.3f}s"
+                f" = device_busy_frac {dev['device_busy_frac']:.3f}"
+            )
+        out.append(line)
+    pc = report.get("pull_check") or {}
+    if pc:
+        out.append("")
+        out.append("-- pull overlap, device-measured --")
+        out.append(
+            f"host-inferred: {pc['host_overlap_s']:.3f}s of "
+            f"{pc['pull_busy_s']:.3f}s pull busy "
+            f"(ratio {pc['host_overlap_ratio']:.3f})"
+        )
+        out.append(
+            f"device-measured: {pc['device_overlap_s']:.3f}s of pull "
+            f"windows overlapped device work "
+            f"(ratio {pc['device_overlap_ratio']:.3f})"
+        )
+    mg = report.get("merge") or {}
+    if mg:
+        out.append("")
+        out.append("-- cross-process critical path --")
+        out.append(
+            f"{mg['n_shards']} shard(s), merged wall "
+            f"{mg['wall_s']:.3f}s: all-busy {mg['all_busy_s']:.3f}s, "
+            f"idle {mg['idle_s']:.3f}s"
+        )
+        out.append(
+            f"{'shard':<28} {'offset_s':>10} {'busy_s':>10} "
+            f"{'exclusive_s':>12}"
+        )
+        for s in mg["shards"]:
+            label = f"{s['index']}: {s['source']}"[:28]
+            out.append(
+                f"{label:<28} {s['offset_s']:>10.3f} "
+                f"{s['busy_s']:>10.3f} {s['exclusive_s']:>12.3f}"
+            )
+        if mg["serial_segments"]:
+            out.append("longest single-shard (critical-path) stretches:")
+            for seg in mg["serial_segments"][:5]:
+                out.append(
+                    f"  shard{seg['shard']} "
+                    f"[{seg['t0_s']:.3f}, {seg['t1_s']:.3f}] "
+                    f"{seg['dur_s']:.3f}s"
+                )
     return "\n".join(out)
 
 
@@ -395,9 +884,26 @@ def main(argv=None) -> int:
         prog="python -m dbscan_tpu.obs.analyze",
         description="Analyze a DBSCAN_TRACE capture (Chrome JSON or "
         "JSONL): phase rollups, self-time attribution, bandwidth, "
-        "hot/cold splits, memory watermarks.",
+        "hot/cold splits, memory watermarks, device-timeline rollups; "
+        "--merge aligns per-process shards into one trace + a "
+        "cross-process critical path.",
     )
-    p.add_argument("trace", help="trace file written by obs (--trace / DBSCAN_TRACE)")
+    p.add_argument(
+        "traces", nargs="+",
+        help="trace file(s) written by obs (--trace / DBSCAN_TRACE; "
+        "multi-process runs write <path>.<i> shards)",
+    )
+    p.add_argument(
+        "--merge", action="store_true",
+        help="treat the inputs as per-process shards of ONE run: align "
+        "their epoch0 clocks, write a single merged Perfetto trace "
+        "(--out), and report the cross-process critical path",
+    )
+    p.add_argument(
+        "-o", "--out",
+        help="with --merge: path for the merged Chrome trace "
+        "(default <first shard>.merged.json)",
+    )
     p.add_argument(
         "--top", type=int, default=20,
         help="rows in the self-time table (default 20; 0 = all)",
@@ -407,15 +913,31 @@ def main(argv=None) -> int:
         help="print the full report as JSON instead of tables",
     )
     args = p.parse_args(argv)
+    if not args.merge and len(args.traces) > 1:
+        p.error("multiple traces require --merge")
     try:
-        data = load_trace(args.trace)
+        if args.merge:
+            merged = merge_shards(args.traces)
+            out_path = args.out or args.traces[0] + ".merged.json"
+            from dbscan_tpu.obs import export as export_mod
+
+            export_mod._atomic_write(
+                out_path, json.dumps(merged["trace"])
+            )
+            report = analyze(merged["data"], top=args.top or None)
+            report["merge"] = merged["merge"]
+            report["merged_trace"] = out_path
+        else:
+            data = load_trace(args.traces[0])
+            report = analyze(data, top=args.top or None)
     except (OSError, ValueError) as e:
-        print(f"analyze: cannot read {args.trace}: {e}", file=sys.stderr)
+        print(f"analyze: cannot read input: {e}", file=sys.stderr)
         return 2
-    report = analyze(data, top=args.top or None)
     if args.json:
         print(json.dumps(report))
     else:
+        if args.merge:
+            print(f"merged trace written to {report['merged_trace']}")
         print(render(report))
     return 0
 

@@ -52,11 +52,13 @@ from dbscan_tpu.obs import schema
 # graftshape's observed-HBM-peak / predicted-peak containment figure,
 # hard-capped at 1.0 by obs/regress.py; _spill_levels: the level-
 # synchronous spill build's round count — a depth/dispatch figure that
-# regresses UP like a wall)
+# regresses UP like a wall; _busy_frac: devtime's measured device-busy
+# share of the rep wall — device utilization lost = work moved back to
+# the host/link, so it regresses DOWN like the overlap ratio)
 _EXACT_KEYS = ("value", "seconds", "vs_baseline")
 _SUFFIXES = (
     "_seconds", "_s", "_mpts", "_vs_baseline", "_overlap_ratio",
-    "_pred_ratio", "_spill_levels",
+    "_pred_ratio", "_spill_levels", "_busy_frac",
 )
 # numeric-but-not-perf keys the suffix rule would otherwise catch —
 # declared with the telemetry schema (the keys are fault-counter
@@ -86,7 +88,7 @@ def git_rev(cwd: Optional[str] = None) -> str:
 def _unit_for(metric: str, obj: dict) -> Optional[str]:
     if metric == "value":
         return obj.get("unit")
-    if metric.endswith(("_overlap_ratio", "_pred_ratio")):
+    if metric.endswith(("_overlap_ratio", "_pred_ratio", "_busy_frac")):
         return "ratio"
     if metric.endswith("_spill_levels"):
         return "levels"

@@ -41,6 +41,7 @@ import dbscan_tpu.obs as obs
 from dbscan_tpu import config
 from dbscan_tpu.lint import shapecheck as _shapecheck
 from dbscan_tpu.lint import tsan as _tsan
+from dbscan_tpu.obs import devtime as _devtime
 
 logger = logging.getLogger(__name__)
 
@@ -68,24 +69,31 @@ def _cache_size(fn):
 
 
 def tracked_call(family: str, fn, *args):
-    """Call ``fn(*args)`` with compile accounting (see module doc) and,
-    under ``DBSCAN_SHAPECHECK=1``, the graftshape runtime cross-check
-    (lint/shapecheck.py): observed arg shapes/dtypes must instantiate
-    the static family model, and the allocator growth across the call
-    must stay within the static footprint prediction. Strict
-    pass-through when obs is disabled (one extra truthiness check for
-    the — independently enabled — shape checker)."""
+    """Call ``fn(*args)`` with compile accounting (see module doc) and
+    the per-dispatch hooks of the independently-enabled runtime
+    checkers: the graftshape cross-check (``DBSCAN_SHAPECHECK=1``,
+    lint/shapecheck.py — observed shapes must instantiate the static
+    family model, allocator growth within the static prediction) and
+    the device-timeline hooks (obs/devtime.py — the
+    ``DBSCAN_PROFILE_WINDOW`` profiler capture opens/closes here, and
+    ``DBSCAN_DEVTIME=1`` brackets the dispatch with a ready-sync delta
+    per family). Strict pass-through when everything is disabled (one
+    extra truthiness check per optional hook)."""
     sc = _shapecheck.runtime()
     handle = sc.observe_call(family, args) if sc is not None else None
+    _devtime.dispatch_begin(family)
     st = obs.state()
     if st is None:
+        t0 = time.perf_counter()
         out = fn(*args)
+        _devtime.dispatch_end(family, out, t0, time.perf_counter())
         if handle is not None:
             sc.settle_call(handle)
         return out
     before = _cache_size(fn)
     t0 = time.perf_counter()
     out = fn(*args)
+    t1 = time.perf_counter()
     if before is not None:
         after = _cache_size(fn)
         if after is not None and after > before:
@@ -94,7 +102,8 @@ def tracked_call(family: str, fn, *args):
             # the signatures are being minted, not just which family
             frame = sys._getframe(1)
             site = f"{frame.f_code.co_filename}:{frame.f_lineno}"
-            note_compile(family, t0, time.perf_counter(), site=site)
+            note_compile(family, t0, t1, site=site)
+    _devtime.dispatch_end(family, out, t0, t1)
     if handle is not None:
         sc.settle_call(handle)
     return out

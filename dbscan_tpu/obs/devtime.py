@@ -1,0 +1,294 @@
+"""Device-timeline profiling: measure what the device DID, not what the
+host inferred.
+
+Every wall the obs stack reports (``pull_overlap_ratio``,
+``cellcc_pull_core_s``, the ``spill.level`` spans) is host-side: a span
+covers the dispatch call, and device execution hides behind jax's async
+dispatch. GPU DBSCAN papers justify their decompositions with per-kernel
+DEVICE time (arXiv:2103.05162 reports per-phase device timings;
+arXiv:1506.02226 attributes wall to individual CUDA kernels); this
+module adds the two legs that get us the same ground truth, sharing the
+PR-2 trace schema:
+
+**Sampled capture window** (``DBSCAN_PROFILE_WINDOW=<n>``): a
+``jax.profiler`` trace spanning the next ``n`` tracked dispatches
+(``obs/compile.tracked_call`` is the funnel), written to
+``DBSCAN_PROFILE_DIR``. One window per process (a latch — profiling is
+a sampling tool, not an always-on cost), opened at the first tracked
+dispatch and closed after the n-th; an atexit guard stops a window the
+process abandoned so no profiler session ever leaks. The profiler's own
+per-device tracks (``*.trace.json[.gz]`` under the log dir, where the
+jaxlib version emits them) convert into our Chrome-trace format via
+:func:`convert_profile`, and the converted file merges with host-side
+shards through ``obs.analyze --merge``.
+
+**Ready-sync fallback** (``DBSCAN_DEVTIME=1`` or :func:`enable` — the
+always-available leg, no profiler needed): every tracked dispatch is
+bracketed with a ``block_until_ready`` delta —
+
+- ``devtime.dispatch_s`` — host wall of the dispatch call itself
+  (trace/lower + enqueue);
+- ``devtime.sync_s`` — the residual wait until the dispatch's outputs
+  were actually ready (a LOWER bound on device work still running when
+  the host moved on);
+- ``devtime.device_s`` — the full issue->ready window (an UPPER bound
+  on the dispatch's device occupancy), also emitted per family as a
+  ``devtime.<family>`` span so the trace carries a device-time track
+  per compile family (including the PR-8 ``spill.level`` families).
+
+The sync point serializes the dispatch tail, so this leg is for
+instrumented runs (bench enables it around its timed reps the way it
+enables the graftshape checker) and the profiler window is the
+low-bias path. ``obs.analyze`` turns the counters+spans into the
+device-busy/host-busy rollup and a measured cross-check of
+``pull_overlap_ratio`` (do the pull windows really overlap device
+work?); bench stamps ``devtime.device_s / wall`` as
+``device_busy_frac``.
+
+Disabled path: one module-global truthiness check per hook, matching
+the obs/tsan/shapecheck discipline.
+"""
+
+from __future__ import annotations
+
+import atexit
+import glob
+import gzip
+import json
+import logging
+import os
+import time
+from typing import List, Optional
+
+import dbscan_tpu.obs as obs
+from dbscan_tpu import config
+from dbscan_tpu.lint import tsan as _tsan
+
+logger = logging.getLogger(__name__)
+
+# ready-sync bracket switch: explicit enable/disable wins; ensure_env
+# applies DBSCAN_DEVTIME at the pipeline entry points
+_on = False
+_env_applied: Optional[bool] = None
+
+# profiler-window state (one window per process; reset() for tests)
+_lock = _tsan.lock("obs.devtime")
+_win = {
+    "target": 0,  # dispatches the window spans (0 = off)
+    "seen": 0,  # dispatches completed since the window opened
+    "active": False,
+    "done": False,
+    "dir": None,
+}
+
+
+def enabled() -> bool:
+    return _on
+
+
+def enable() -> None:
+    """Turn the ready-sync brackets on (idempotent)."""
+    global _on
+    _on = True
+
+
+def disable() -> None:
+    global _on
+    _on = False
+
+
+def ensure_env() -> None:
+    """Apply ``DBSCAN_DEVTIME`` / ``DBSCAN_PROFILE_WINDOW`` — called at
+    the pipeline entry points alongside ``obs.ensure_env``. The env
+    value is latched per distinct value, so steady-state updates pay
+    two env reads, not state churn; an explicit :func:`enable` is never
+    un-done by the env (same precedence as ``obs.enable`` vs
+    ``DBSCAN_TRACE``)."""
+    global _on, _env_applied
+    env_on = bool(config.env("DBSCAN_DEVTIME"))
+    if env_on != _env_applied:
+        _env_applied = env_on
+        if env_on:
+            _on = True
+    with _lock:
+        _tsan.access("obs.devtime")
+        if not _win["done"] and not _win["active"]:
+            _win["target"] = int(config.env("DBSCAN_PROFILE_WINDOW"))
+
+
+def reset() -> None:
+    """Tests: drop the window latch and the bracket switch (a leaked
+    live profiler session is stopped first)."""
+    global _on, _env_applied
+    _stop_window(at_exit=False)
+    with _lock:
+        _tsan.access("obs.devtime")
+        _win.update(target=0, seen=0, active=False, done=False, dir=None)
+    _on = False
+    _env_applied = None
+
+
+def window_state() -> dict:
+    with _lock:
+        _tsan.access("obs.devtime", write=False)
+        return dict(_win)
+
+
+# --- profiler capture window ------------------------------------------
+
+
+def _profile_dir() -> str:
+    return str(config.env("DBSCAN_PROFILE_DIR"))
+
+
+def _start_window() -> None:
+    d = _profile_dir()
+    try:
+        import jax
+
+        os.makedirs(d, exist_ok=True)
+        jax.profiler.start_trace(d)
+    except Exception as e:  # noqa: BLE001 — profiling is best-effort
+        logger.warning("profiler window failed to open (%s): %s", d, e)
+        with _lock:
+            _tsan.access("obs.devtime")
+            _win["done"] = True
+            _win["active"] = False
+        return
+    with _lock:
+        _tsan.access("obs.devtime")
+        _win["active"] = True
+        _win["dir"] = d
+        _win["seen"] = 0
+    obs.event("profile.window_open", dir=d, dispatches=_win["target"])
+    logger.info(
+        "profiler window open: %d dispatch(es) -> %s", _win["target"], d
+    )
+
+
+def _stop_window(at_exit: bool = False) -> None:
+    with _lock:
+        _tsan.access("obs.devtime")
+        if not _win["active"]:
+            return
+        _win["active"] = False
+        _win["done"] = True
+        d, seen = _win["dir"], _win["seen"]
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception as e:  # noqa: BLE001 — closing must never raise
+        logger.warning("profiler window failed to close: %s", e)
+        return
+    obs.event(
+        "profile.window_close",
+        dir=d,
+        dispatches=int(seen),
+        at_exit=bool(at_exit),
+    )
+    obs.count("profile.windows")
+    logger.info(
+        "profiler window closed after %d dispatch(es): %s", seen, d
+    )
+
+
+# a window the process abandons mid-capture (crash between dispatches,
+# a run shorter than the window) must still close: a leaked session
+# breaks every later start_trace in the process
+atexit.register(_stop_window, at_exit=True)
+
+
+def dispatch_begin(family: str) -> None:
+    """Pre-dispatch hook from ``tracked_call``: opens the profiler
+    window at the first tracked dispatch after ``DBSCAN_PROFILE_WINDOW``
+    was set. One dict read on the (default) no-window path."""
+    if _win["done"] or _win["active"]:
+        return
+    if _win["target"] <= 0:
+        return
+    _start_window()
+
+
+def dispatch_end(family: str, out, t0: float, t1: float) -> None:
+    """Post-dispatch hook from ``tracked_call``: counts the dispatch
+    against an open profiler window and, when the ready-sync brackets
+    are enabled, blocks on ``out`` and emits the devtime telemetry."""
+    if _win["active"]:
+        with _lock:
+            _tsan.access("obs.devtime")
+            _win["seen"] += 1
+            close = _win["seen"] >= _win["target"]
+        if close:
+            _stop_window()
+    if not _on:
+        return
+    try:
+        import jax
+
+        jax.block_until_ready(out)
+    except Exception:  # noqa: BLE001 — a bad handle must not kill the run
+        pass
+    t2 = time.perf_counter()
+    obs.count("devtime.samples")
+    obs.count("devtime.dispatch_s", t1 - t0)
+    obs.count("devtime.sync_s", t2 - t1)
+    obs.count("devtime.device_s", t2 - t0)
+    obs.add_span(
+        f"devtime.{family}",
+        t0,
+        t2,
+        family=family,
+        host_s=round(t1 - t0, 9),
+        sync_s=round(t2 - t1, 9),
+    )
+
+
+# --- profiler-output conversion ---------------------------------------
+
+
+def profile_trace_files(logdir: str) -> List[str]:
+    """The profiler-emitted Chrome traces under ``logdir`` (the
+    TensorBoard layout: ``plugins/profile/<run>/<host>.trace.json.gz``;
+    some jaxlib versions emit only ``*.xplane.pb``, which has no stdlib
+    decoder — those runs still carry the ready-sync fallback)."""
+    out: List[str] = []
+    for pat in ("**/*.trace.json.gz", "**/*.trace.json"):
+        out.extend(glob.glob(os.path.join(logdir, pat), recursive=True))
+    return sorted(set(out))
+
+
+def convert_profile(logdir: str, out_path: Optional[str] = None):
+    """Convert the profiler's own trace files into ONE trace in our
+    Chrome format (per-device tracks preserved), suitable for
+    ``obs.analyze`` / ``--merge`` next to the host-side shards. Returns
+    the written path (or the trace dict when ``out_path`` is None);
+    None when the log dir holds no decodable trace."""
+    events: list = []
+    files = profile_trace_files(logdir)
+    for path in files:
+        try:
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rt") as f:
+                obj = json.load(f)
+        except Exception as e:  # noqa: BLE001 — skip undecodable files
+            logger.warning("cannot decode profiler trace %s: %s", path, e)
+            continue
+        events.extend(obj.get("traceEvents") or [])
+    if not events:
+        return None
+    trace = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "jax.profiler",
+            "profile_dir": logdir,
+            "files": [os.path.basename(p) for p in files],
+        },
+    }
+    if out_path is None:
+        return trace
+    from dbscan_tpu.obs import export as export_mod
+
+    export_mod._atomic_write(out_path, json.dumps(trace))
+    return out_path

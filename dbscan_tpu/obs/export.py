@@ -26,6 +26,30 @@ import os
 from typing import Optional
 
 
+def shard_index() -> Optional[int]:
+    """This process's shard id in a multi-process (DCN) job, or None
+    for single-process runs / before jax initializes. The id keys the
+    per-process trace/flight-dump shards and the merged trace's track
+    ids (``obs.analyze --merge``)."""
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — no jax / uninitialized runtime
+        pass
+    return None
+
+
+def shard_suffix() -> str:
+    """``.<process_index>`` under multi-process runs, else ``""`` —
+    the sharding rule every per-process artifact path follows
+    (``DBSCAN_TRACE`` -> ``<path>.<i>``, ``DBSCAN_FLIGHTREC_PATH``
+    likewise), so concurrent workers never clobber one file."""
+    idx = shard_index()
+    return "" if idx is None else f".{idx}"
+
+
 def _jsonable(v):
     """Coerce numpy scalars/arrays and other exotica into JSON types —
     span args come straight from hot loops that pass whatever they have."""
@@ -59,6 +83,7 @@ def chrome_trace(tracer, metrics=None) -> dict:
     appends at END time (obs/trace.py), so the export layer re-sorts."""
     pid = os.getpid()
     base = tracer.t0
+    shard = shard_index()
     events = []
     t_last = 0.0
     for sp in tracer.snapshot_spans():
@@ -121,25 +146,53 @@ def chrome_trace(tracer, metrics=None) -> dict:
                     "args": {"value": _jsonable(value)},
                 }
             )
+    # track identity: Perfetto groups by pid, so name the process track
+    # after this shard — merged multi-shard traces stay tellable apart.
+    # Appended last (metadata has no timeline position of its own).
+    events.append(
+        {
+            "name": "process_name",
+            "ph": "M",
+            "ts": t_last,
+            "pid": pid,
+            "args": {
+                "name": "dbscan"
+                + (f" shard {shard}" if shard is not None else f" pid {pid}")
+            },
+        }
+    )
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
         "otherData": {
             # epoch anchor: ts are perf_counter-relative; this pins the
             # trace to wall-clock time for cross-process correlation
+            # (obs.analyze --merge aligns shard clocks on it)
             "epoch0": tracer.epoch0,
             # >0 means the retention bound (DBSCAN_TRACE_MAX_SPANS)
             # dropped the oldest spans — the trace is a tail, not a whole
             "dropped_spans": getattr(tracer, "dropped_spans", 0),
             "gauges": _jsonable(metrics.gauges()) if metrics else {},
+            # per-process track identity for the multi-shard merge
+            "pid": pid,
+            "shard": shard,
         },
     }
 
 
 def jsonl_records(tracer, metrics=None):
     """Yield one flat JSON-able dict per span / instant / counter —
-    the grep-able format for harnesses that don't want a trace UI."""
+    the grep-able format for harnesses that don't want a trace UI.
+    The leading ``meta`` record carries the clock anchor + track
+    identity the Chrome format keeps in ``otherData`` (without it a
+    JSONL shard could not participate in ``obs.analyze --merge``)."""
     base = tracer.t0
+    yield {
+        "type": "meta",
+        "epoch0": tracer.epoch0,
+        "pid": os.getpid(),
+        "shard": shard_index(),
+    }
     for sp in tracer.snapshot_spans():
         t1 = sp.t1 if sp.t1 is not None else sp.t0
         yield {

@@ -1,0 +1,334 @@
+"""Always-on flight recorder: a bounded in-memory ring of the last
+spans/events/counter state, dumped as JSON exactly when a run dies.
+
+Every number the obs stack reports today exists only while somebody
+remembered to enable tracing — but the runs that NEED a postmortem
+(a preempted TPU worker mid-campaign, a wedged pull engine, a retries-
+exhausted abort) are precisely the ones nobody instrumented in advance.
+The reference's answer was driver-side println taps (DBSCAN.scala:139,
+202); ours is the black-box pattern every serving system carries: an
+always-on (``DBSCAN_FLIGHTREC``, default ON), bounded, per-thread-
+tracked ring of the most recent telemetry, flushed atomically to
+``DBSCAN_FLIGHTREC_PATH`` when it matters:
+
+- **fatal fault** — ``faults.supervised`` dumps right where it raises
+  :class:`~dbscan_tpu.faults.FatalDeviceFault`, and the driver's abort
+  guard dumps next to ``checkpoint.note_abort`` (so async pull faults
+  that never pass through the supervised site are covered too); the
+  dump carries the abort site/ordinal and the last spans leading up
+  to it;
+- **SIGTERM** — the preemption/teardown signal a streaming service
+  receives: dump, then chain to the previous disposition so the
+  process still dies;
+- **SIGUSR1** — dump and keep running (poke a live, wedged process);
+- **on demand** — :func:`dump` from any harness or debugger.
+
+Mechanics: the ring reuses the PR-2 span machinery — a private
+:class:`~dbscan_tpu.obs.trace.Tracer` (span cap = 2x the configured
+ring, so after the tracer's drop-oldest-half trim the TAIL always
+holds >= ``DBSCAN_FLIGHTREC_EVENTS`` spans) plus a private
+:class:`~dbscan_tpu.obs.metrics.MetricsRegistry`. The ``dbscan_tpu.obs``
+module-level hooks route here ONLY while full observability is
+disabled — an obs-enabled run records once, into the live registries,
+and :func:`dump` then reads ITS tail instead. Spans carry their
+thread id, so the dump is a per-thread timeline (the pull-engine
+worker's wedged ``pull.chunk`` is distinguishable from the main
+thread's dispatch stall).
+
+Overhead contract (pinned by ``tests/test_flight.py``): with the
+recorder ON and observability OFF — the default production state —
+a hook costs one extra module-global truthiness check plus a bounded
+ring append; the dense bench shape stays within 1% of a build with
+the recorder disabled. ``DBSCAN_FLIGHTREC=0`` restores the PR-2
+strict no-op path bit-for-bit.
+
+Multi-process runs shard the dump path exactly like ``DBSCAN_TRACE``
+(``<path>.<process_index>``, via :func:`obs.export.shard_suffix`), so
+every worker of a ROADMAP-item-1 job leaves its own postmortem.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from dbscan_tpu import config
+from dbscan_tpu.lint import tsan as _tsan
+from dbscan_tpu.obs import export as export_mod
+from dbscan_tpu.obs.metrics import MetricsRegistry
+from dbscan_tpu.obs.trace import Tracer
+
+
+class _RingTracer(Tracer):
+    """A Tracer whose process-level instants are bounded like its spans
+    (the base class bounds only ``spans``; a recorder that runs for the
+    process lifetime must not grow EITHER list without bound)."""
+
+    def instant(self, name: str, args: dict) -> None:
+        super().instant(name, args)
+        with self._lock:
+            if len(self.instants) > self.max_spans:
+                del self.instants[: len(self.instants) // 2]
+
+
+class FlightState:
+    """The live recorder: one ring tracer + one metrics registry."""
+
+    __slots__ = ("tracer", "metrics", "capacity")
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self.tracer = _RingTracer(device_sync=False)
+        # drop-oldest-half trim => the surviving tail is always >= cap
+        self.tracer.max_spans = 2 * self.capacity
+        self.metrics = MetricsRegistry()
+
+
+#: the one value the obs hooks truth-check on their disabled path
+_state: Optional[FlightState] = None
+_configured: Optional[bool] = None  # last DBSCAN_FLIGHTREC value applied
+_lock = _tsan.lock("obs.flight")
+_signals_installed = False
+_prev_handlers: dict = {}
+
+
+def state() -> Optional[FlightState]:
+    return _state
+
+
+def active() -> bool:
+    return _state is not None
+
+
+def capacity() -> int:
+    """Ring size: the dump's span/instant tail bound (floor 64 — the
+    acceptance contract promises at least the last 64 spans)."""
+    return max(64, int(config.env("DBSCAN_FLIGHTREC_EVENTS")))
+
+
+def ensure_env() -> None:
+    """(Re)apply ``DBSCAN_FLIGHTREC`` — called at the pipeline entry
+    points alongside ``obs.ensure_env``. One env read per call; the
+    recorder is built/dropped only when the knob value CHANGED, so a
+    long-lived stream pays a latch check per update, not a rebuild.
+    Rings survive across runs by design: the recorder's whole point is
+    holding the tail of whatever happened most recently."""
+    global _state, _configured
+    on = bool(config.env("DBSCAN_FLIGHTREC"))
+    if on == _configured:
+        return
+    with _lock:
+        _tsan.access("obs.flight")
+        if on == _configured:
+            return
+        _state = FlightState(capacity()) if on else None
+        _configured = on
+    if on:
+        _install_signal_handlers()
+
+
+def reset() -> None:
+    """Drop the recorder and its env latch (tests): the next
+    :func:`ensure_env` re-reads the knob into a FRESH ring."""
+    global _state, _configured
+    with _lock:
+        _tsan.access("obs.flight")
+        _state = None
+        _configured = None
+
+
+# --- dumping ----------------------------------------------------------
+
+
+def _default_path() -> str:
+    """``DBSCAN_FLIGHTREC_PATH`` with the multi-process shard suffix
+    (``<path>.<process_index>``) — same sharding rule as DBSCAN_TRACE."""
+    return str(config.env("DBSCAN_FLIGHTREC_PATH")) + export_mod.shard_suffix()
+
+
+def _span_records(spans: list, base: float, cap: int) -> list:
+    out = []
+    for sp in spans[-cap:]:
+        t1 = sp.t1 if sp.t1 is not None else sp.t0
+        out.append(
+            {
+                "name": sp.name,
+                "t0_s": round(sp.t0 - base, 9),
+                "dur_s": round(max(0.0, t1 - sp.t0), 9),
+                "depth": sp.depth,
+                "tid": sp.tid,
+                "args": export_mod._jsonable(sp.args),
+                "events": [
+                    {
+                        "name": n,
+                        "t_s": round(t - base, 9),
+                        "args": export_mod._jsonable(a),
+                    }
+                    for n, t, a in sp.events
+                ],
+            }
+        )
+    return out
+
+
+def dump(
+    path: Optional[str] = None,
+    reason: str = "manual",
+    _signal_safe: bool = False,
+    **note,
+) -> Optional[str]:
+    """Write the flight ring as one JSON postmortem; returns the path,
+    or None when neither the recorder nor observability is live.
+
+    Source registries: a run with full observability enabled records
+    once (into the obs registries), so the dump reads THEIR tail; the
+    always-on ring covers every other run. ``note`` fields (abort
+    site/ordinal/error) land under ``"note"`` — the first thing a
+    postmortem reader wants next to the last spans. Best-effort by
+    contract: a dump must never mask the fault that triggered it, so
+    callers wrap it in try/except (the module's own signal handlers
+    do).
+
+    ``_signal_safe``: the signal handlers set it — a CPython signal
+    handler runs ON the main thread between bytecodes, so the
+    interrupted frame may already HOLD the (non-reentrant) tracer/
+    metrics locks, and a dump that tried to acquire them would
+    deadlock the dying process. In that mode the dump skips its own
+    telemetry emission and snapshots the registries WITHOUT locking —
+    CPython list/dict copies are safe against concurrent mutation,
+    and the worst case is one in-flight record missing from the tail,
+    which beats no postmortem at all."""
+    import dbscan_tpu.obs as obs
+
+    st = obs.state()
+    if st is not None:
+        tracer, metrics, source = st.tracer, st.metrics, "obs"
+    else:
+        fs = _state
+        if fs is None:
+            return None
+        tracer, metrics, source = fs.tracer, fs.metrics, "flightrec"
+    if not _signal_safe:
+        # the dump records itself first, so the ring's final instant
+        # says why this file exists (and a trace flushed later carries
+        # it). Skipped on the signal path: these take the locks.
+        obs.event("flightrec.dump", reason=reason, **note)
+        obs.count("flightrec.dumps")
+        spans = tracer.snapshot_spans()
+        counters = metrics.counters()
+        gauges = metrics.gauges()
+    else:
+        spans = list(tracer.spans)
+        counters = dict(metrics._counters)
+        gauges = dict(metrics._gauges)
+    cap = capacity()
+    base = tracer.t0
+    payload = {
+        "flightrec": 1,
+        "reason": reason,
+        "note": export_mod._jsonable(note),
+        "source": source,
+        "time": time.time(),
+        "epoch0": tracer.epoch0,
+        "pid": os.getpid(),
+        "shard": export_mod.shard_index(),
+        "capacity": cap,
+        "dropped_spans": getattr(tracer, "dropped_spans", 0),
+        "spans": _span_records(spans, base, cap),
+        "instants": [
+            {
+                "name": n,
+                "t_s": round(t - base, 9),
+                "args": export_mod._jsonable(a),
+            }
+            for n, t, a in list(tracer.instants)[-cap:]
+        ],
+        "counters": export_mod._jsonable(counters),
+        "gauges": export_mod._jsonable(gauges),
+    }
+    out = path or _default_path()
+    export_mod._atomic_write(out, json.dumps(payload))
+    return out
+
+
+def dump_on_fault(site: str, ordinal: int, error: str) -> Optional[str]:
+    """The fatal-fault dump (``faults.supervised`` exhausting retries,
+    the driver's abort guard): best-effort, never raises — the original
+    device fault must always win."""
+    try:
+        return dump(
+            reason="fatal_fault",
+            site=site,
+            ordinal=int(ordinal),
+            error=str(error)[:200],
+        )
+    except Exception:  # noqa: BLE001 — postmortem must not mask the fault
+        return None
+
+
+def load(path: str) -> dict:
+    """Read a dump back (tests, tooling)."""
+    with open(path) as f:
+        return json.load(f)
+
+
+# --- signal wiring (streaming-service prep) ---------------------------
+
+
+def _on_sigusr1(signum, frame):
+    try:
+        dump(reason="SIGUSR1", _signal_safe=True)
+    except Exception:  # noqa: BLE001 — a poke must never kill the process
+        pass
+    prev = _prev_handlers.get(signal.SIGUSR1)
+    if callable(prev):
+        prev(signum, frame)
+
+
+def _on_sigterm(signum, frame):
+    try:
+        dump(reason="SIGTERM", _signal_safe=True)
+    except Exception:  # noqa: BLE001 — teardown must still tear down
+        pass
+    prev = _prev_handlers.get(signal.SIGTERM)
+    if callable(prev):
+        prev(signum, frame)
+        return
+    if prev is signal.SIG_IGN:
+        # the prior disposition IGNORED SIGTERM: honor it (the process
+        # survives) and KEEP this handler installed, so every later
+        # SIGTERM still dumps — uninstalling here would silently end
+        # the always-on contract after the first signal
+        return
+    # default disposition: restore it and re-raise so the process still
+    # terminates with the standard SIGTERM exit status
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def _install_signal_handlers() -> None:
+    """SIGTERM (preemption: dump then die) + SIGUSR1 (dump and keep
+    running). Installed once per process, main thread only (the signal
+    API's own constraint); previous handlers are chained, so a harness
+    with its own SIGTERM hook keeps it."""
+    global _signals_installed
+    if _signals_installed:
+        return
+    if threading.current_thread() is not threading.main_thread():
+        return
+    try:
+        _prev_handlers[signal.SIGUSR1] = signal.signal(
+            signal.SIGUSR1, _on_sigusr1
+        )
+        _prev_handlers[signal.SIGTERM] = signal.signal(
+            signal.SIGTERM, _on_sigterm
+        )
+        _signals_installed = True
+    except (ValueError, OSError, AttributeError):
+        # non-main thread or a platform without these signals: the
+        # fault/dump() triggers still work, only the signal leg is off
+        pass

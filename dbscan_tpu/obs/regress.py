@@ -53,6 +53,10 @@ def direction(metric: str, unit: Optional[str] = None) -> Optional[str]:
     if metric.endswith("_overlap_ratio"):
         # overlap lost = pulls back on the critical path: regresses DOWN
         return HIGHER_BETTER
+    if metric.endswith("_busy_frac"):
+        # device utilization lost = work moved back to the host/link
+        # (devtime's measured device-busy share): regresses DOWN
+        return HIGHER_BETTER
     if metric.endswith("_spill_levels"):
         # level-build rounds = fused dispatches = tree depth: a deeper
         # tree pays more round-trips, so the count regresses UP

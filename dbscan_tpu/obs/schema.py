@@ -108,6 +108,19 @@ COUNTERS = {
     "pull.overlap_s": "pull/finalize seconds hidden behind other work",
     "pull.busy_s": "total pipelined pull+finalize wall (worker seconds)",
     "pull.bytes": "bytes routed through the pull pipeline (size hints)",
+    "pull.stalls": "pull-pipeline stall warnings emitted (a consumer "
+    "blocked past DBSCAN_PULL_STALL_S on one job)",
+    "flightrec.dumps": "flight-recorder postmortem dumps written",
+    "devtime.samples": "dispatches bracketed by the ready-sync "
+    "device-timeline hooks (DBSCAN_DEVTIME)",
+    "devtime.dispatch_s": "summed host wall of the bracketed dispatch "
+    "calls (trace/lower + enqueue)",
+    "devtime.sync_s": "summed residual ready-wait after the host call "
+    "returned (lower bound on device work still running)",
+    "devtime.device_s": "summed issue->ready windows (upper bound on "
+    "device occupancy; device_busy_frac = this / train wall)",
+    "profile.windows": "jax.profiler capture windows completed "
+    "(DBSCAN_PROFILE_WINDOW)",
     "shapecheck.checks": "dispatch shape/footprint validations run "
     "by the graftshape runtime cross-check",
     "shapecheck.violations": "model-instantiation or HBM-containment "
@@ -124,6 +137,9 @@ GAUGES = {
     "memory.peak_bytes_in_use": "process high-water mark (monotone)",
     "memory.bytes_limit": "summed allocator capacity when reported",
     "pull.inflight": "pull-pipeline jobs started and not yet finished",
+    "pull.queue_depth": "pull-pipeline jobs submitted and not yet "
+    "executed (pending + started-ahead; a wedged engine shows a "
+    "frozen nonzero depth in the flight dump)",
 }
 
 SPANS = {
@@ -167,11 +183,24 @@ EVENTS = {
     "(family + detail)",
     "tsan.race": "thread sanitizer race record (site + thread roles)",
     "tsan.lock_inversion": "thread sanitizer lock-order inversion record",
+    "pull.stall": "a pull-pipeline consumer blocked past "
+    "DBSCAN_PULL_STALL_S on one job (label + queue depth attached) — "
+    "the wedged-engine mark the flight recorder exists to capture",
+    "flightrec.dump": "flight-recorder dump written (reason + abort "
+    "site attached); the ring's final instant says why the file exists",
+    "profile.window_open": "jax.profiler capture window opened at a "
+    "tracked dispatch (DBSCAN_PROFILE_WINDOW)",
+    "profile.window_close": "jax.profiler capture window closed "
+    "(dispatch count + log dir attached)",
 }
 
 for _f in COMPILE_FAMILIES:
     COUNTERS[f"compiles.{_f}"] = f"cache misses of the {_f} dispatch"
     SPANS[f"compile.{_f}"] = f"trace+lower+compile wall of a {_f} miss"
+    SPANS[f"devtime.{_f}"] = (
+        f"issue->ready device-time window of one {_f} dispatch "
+        "(DBSCAN_DEVTIME ready-sync bracket)"
+    )
 for _s in MEMORY_SITES:
     GAUGES[f"memory.at.{_s}"] = f"HBM occupancy at the last {_s} sample"
 for _p in DRIVER_PHASES:
@@ -191,6 +220,7 @@ KINDS = {
 PREFIX_MEMORY = "memory."
 PREFIX_COMPILES = "compiles."
 PREFIX_FAULTS = "faults."
+PREFIX_DEVTIME = "devtime."
 
 #: the hot/cold classification marks obs/analyze.py reads back
 RESIDENT_MARKS = ("resident_cache.hit", "resident_cache.miss")

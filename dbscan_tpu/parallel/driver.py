@@ -51,6 +51,7 @@ from dbscan_tpu import _native, faults, obs
 from dbscan_tpu import config as config_mod
 from dbscan_tpu.config import DBSCANConfig
 from dbscan_tpu.obs import compile as obs_compile
+from dbscan_tpu.obs import flight as obs_flight
 from dbscan_tpu.obs import memory as obs_memory
 from dbscan_tpu.ops import geometry as geo
 from dbscan_tpu.ops.labels import CORE, NOISE, SEED_NONE
@@ -2071,12 +2072,19 @@ def train_arrays(
         except faults.FatalDeviceFault as e:
             _halt_pipeline()
             _abort_flush(e.site, e.ordinal, str(e))
+            # postmortem AFTER the flush: the ring now also holds the
+            # abort-path spans (quiesce, banked-chunk pulls), so the
+            # dump shows what was saved, not just what died
+            obs_flight.dump_on_fault(e.site, e.ordinal, str(e))
             raise
         except Exception as e:  # noqa: BLE001 — classify() filters
             if faults.classify(e) is None:
                 raise
             _halt_pipeline()
             _abort_flush("pull", -1, f"{type(e).__name__}: {e}")
+            # async device faults surface here (a consuming pull), never
+            # through faults.supervised — this is their ONLY dump site
+            obs_flight.dump_on_fault("pull", -1, f"{type(e).__name__}: {e}")
             raise
 
     def _halt_pipeline():

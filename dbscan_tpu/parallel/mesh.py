@@ -75,24 +75,26 @@ def pull_to_host(x) -> np.ndarray:
     """
     if isinstance(x, np.ndarray):
         return x  # already host-side: no transfer to account
-    st = obs.state()
-    t0 = time.perf_counter() if st is not None else 0.0
+    # routed through the obs HOOKS (not the registry directly) so the
+    # accounting lands in whichever destination is live: the full obs
+    # registries, or the always-on flight ring — a postmortem that
+    # cannot say how many bytes moved before the death is half blind
+    live = obs.state() is not None or obs.flight._state is not None
+    t0 = time.perf_counter() if live else 0.0
     if not multiprocess() or getattr(x, "is_fully_addressable", True):
         arr = np.asarray(x)
     else:
         from jax.experimental import multihost_utils
 
         arr = np.asarray(multihost_utils.process_allgather(x, tiled=True))
-    if st is not None:
+    if live:
         # the measured wall includes any device wait np.asarray blocked
         # on (async dispatch retires here), not pure link time — that
         # is exactly the "pull" cost the driver's timings charge too
         t1 = time.perf_counter()
-        st.metrics.count("transfer.d2h_bytes", int(arr.nbytes))
-        st.metrics.count("transfer.d2h_s", t1 - t0)
-        st.tracer.add_span(
-            "transfer.pull", t0, t1, {"bytes": int(arr.nbytes)}
-        )
+        obs.count("transfer.d2h_bytes", int(arr.nbytes))
+        obs.count("transfer.d2h_s", t1 - t0)
+        obs.add_span("transfer.pull", t0, t1, bytes=int(arr.nbytes))
     return arr
 
 

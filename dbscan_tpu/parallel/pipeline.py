@@ -40,10 +40,15 @@ safe.
 
 Observability (declared in :mod:`dbscan_tpu.obs.schema`): a
 ``pull.inflight`` gauge (started-but-unfinished jobs — bounded by the
-configured depth), ``pull.wait_s`` (consumer seconds actually blocked)
-and ``pull.overlap_s`` (worker seconds hidden behind other work)
-counters, ``pull.busy_s``/``pull.bytes`` totals, and one ``pull.chunk``
-span per job. The same figures accumulate in engine-internal
+configured depth), a ``pull.queue_depth`` gauge (submitted-but-
+unexecuted backlog — a wedged engine freezes it nonzero), ``pull.wait_s``
+(consumer seconds actually blocked) and ``pull.overlap_s`` (worker
+seconds hidden behind other work) counters, ``pull.busy_s``/
+``pull.bytes`` totals, one ``pull.chunk`` span per job, and a
+``pull.stall`` event (+ ``pull.stalls`` counter) when a consumer blocks
+past ``DBSCAN_PULL_STALL_S`` on one job — all of which also land in the
+always-on flight ring (obs/flight.py) when tracing is off, so a wedged
+engine leaves a postmortem. The same figures accumulate in engine-internal
 :meth:`PullEngine.totals` (independent of obs being enabled) so the
 driver can stamp ``stats["pull"]`` and bench can derive
 ``pull_overlap_ratio`` without a live trace.
@@ -152,6 +157,10 @@ class PullEngine:
             to_start = self._start_ready_locked()
             self._cv.notify_all()
         self._run_start_hooks(to_start)
+        if not to_start:
+            # depth grew without a start (budget full): the queue-depth
+            # gauge must still see the backlog a wedged worker builds
+            self._set_inflight_gauge()
         return job
 
     # --- consumer side -------------------------------------------------
@@ -161,8 +170,35 @@ class PullEngine:
         its exception at THIS (consuming) call site. A cancelled job
         returns None with its record untouched — the caller's serial
         fallback still applies. Idempotent accounting: only the first
-        wait on a job contributes to wait/overlap totals."""
+        wait on a job contributes to wait/overlap totals.
+
+        Stall watchdog: a consumer blocked past ``DBSCAN_PULL_STALL_S``
+        (default 30 s) on ONE job emits a ``pull.stall`` event with the
+        job label and the engine's queue depth — into the live obs
+        registries or the always-on flight ring — so a wedged engine
+        (dead worker, hung D2H) leaves a mark in the postmortem even
+        though this thread never unblocks to report it."""
         t0 = time.perf_counter()
+        stall_s = float(config.env("DBSCAN_PULL_STALL_S"))
+        if stall_s > 0 and not job._done.wait(stall_s):
+            with self._cv:
+                _tsan.access("pipeline.engine", write=False)
+                depth = self._queue_depth_locked()
+            obs.count("pull.stalls")
+            obs.event(
+                "pull.stall",
+                label=job.label,
+                waited_s=round(time.perf_counter() - t0, 3),
+                queue_depth=depth,
+                stall_after_s=stall_s,
+            )
+            logger.warning(
+                "pull pipeline stall: consumer blocked > %.1fs on job "
+                "%r (queue depth %d) — worker wedged or transfer hung",
+                stall_s,
+                job.label,
+                depth,
+            )
         job._done.wait()
         waited = time.perf_counter() - t0
         first = False
@@ -251,13 +287,25 @@ class PullEngine:
             _tsan.access("pipeline.engine", write=False)
             return dict(self._totals)
 
+    def _queue_depth_locked(self) -> int:
+        """Jobs submitted and not yet executed (pending + started-ahead
+        + the one executing) — the backlog figure a wedged engine
+        freezes at a nonzero value."""
+        return (
+            len(self._pending)
+            + len(self._ready)
+            + (1 if self._executing is not None else 0)
+        )
+
     def _set_inflight_gauge(self) -> None:
         with self._cv:
             _tsan.access("pipeline.engine")
             n = self._started
+            depth = self._queue_depth_locked()
             if n > self._totals["inflight_peak"]:
                 self._totals["inflight_peak"] = n
         obs.gauge("pull.inflight", n)
+        obs.gauge("pull.queue_depth", depth)
 
     # --- worker --------------------------------------------------------
 

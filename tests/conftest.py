@@ -6,6 +6,17 @@ no TPU pod needed. Must run before any test module imports jax."""
 
 import os
 import sys
+import tempfile
+
+# The always-on flight recorder (dbscan_tpu/obs/flight.py) dumps a
+# postmortem on every retries-exhausted abort — which the fault-injection
+# suites trigger on purpose, dozens of times. Point the default dump path
+# at a per-process temp file so test runs never litter the working tree;
+# tests that assert on dumps set their own DBSCAN_FLIGHTREC_PATH.
+os.environ.setdefault(
+    "DBSCAN_FLIGHTREC_PATH",
+    os.path.join(tempfile.gettempdir(), f"dbscan_flightrec_{os.getpid()}.json"),
+)
 
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:

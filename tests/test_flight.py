@@ -1,0 +1,860 @@
+"""flightrec + devtime + multi-shard merge (PR 9, dbscan_tpu/obs/).
+
+Pins, per the acceptance bar:
+
+- a fault-injected train with tracing DISABLED leaves a flight-recorder
+  dump containing the abort site and >= the last 64 spans (the ring is
+  cross-run by design — a campaign's healthy legs stay in the tail);
+- the always-on recorder's overhead is < 1% on the dense bench shape
+  (min-of-reps, absolute slack for timer noise — the PR-2 guard's
+  discipline at the tighter bound);
+- ``obs.analyze --merge`` over two process shards emits ONE
+  Perfetto-valid trace with disjoint track ids and a cross-process
+  critical path whose arithmetic is pinned exactly on hand-built
+  shards;
+- ``DBSCAN_PROFILE_WINDOW`` opens and closes without leaking a
+  profiler session under tier-1 CPU;
+- ``pull.stall`` / ``pull.queue_depth`` make a wedged pull engine
+  visible from the blocked consumer;
+- ``cli.py --metrics-summary`` reports gauges (HBM watermarks,
+  ``pull.inflight``) next to the counters.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import Engine, faults, obs, train
+from dbscan_tpu.obs import analyze as analyze_mod
+from dbscan_tpu.obs import devtime
+from dbscan_tpu.obs import export as export_mod
+from dbscan_tpu.obs import flight
+from dbscan_tpu.obs.trace import NOOP_SPAN
+from dbscan_tpu.parallel import driver
+from dbscan_tpu.parallel import pipeline as pipe_mod
+
+pytestmark = pytest.mark.flight
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch, tmp_path):
+    """Every test starts with a fresh flight ring (default-on), a
+    test-local dump path, devtime off, obs off, and a virgin fault
+    registry/pull engine."""
+    monkeypatch.delenv("DBSCAN_TRACE", raising=False)
+    monkeypatch.delenv("DBSCAN_FLIGHTREC", raising=False)
+    monkeypatch.setenv(
+        "DBSCAN_FLIGHTREC_PATH", str(tmp_path / "flightrec.json")
+    )
+    monkeypatch.setenv("DBSCAN_FAULT_BACKOFF_S", "0")
+    obs.disable()
+    flight.reset()
+    devtime.reset()
+    faults.reset_registry()
+    pipe_mod.reset_engine()
+    yield
+    obs.disable()
+    flight.reset()
+    devtime.reset()
+    faults.reset_registry()
+    pipe_mod.reset_engine()
+
+
+def _blobs(seed=0):
+    rng = np.random.default_rng(seed)
+    sizes = [80, 200, 500, 1200, 300, 900]
+    centers = [(0, 0), (8, 8), (-7, 9), (9, -8), (-9, -9), (16, 2)]
+    pts = np.concatenate(
+        [rng.normal(c, 0.4, (s, 2)) for c, s in zip(centers, sizes)]
+    )
+    rng.shuffle(pts)
+    return pts
+
+
+KW_BANDED = dict(
+    eps=0.5, min_points=5, max_points_per_partition=256,
+    engine=Engine.ARCHERY, neighbor_backend="banded",
+)
+KW_DENSE = dict(
+    eps=0.5, min_points=5, max_points_per_partition=256,
+    engine=Engine.ARCHERY, neighbor_backend="dense",
+)
+
+
+# --- the always-on ring -----------------------------------------------
+
+
+def test_ring_records_with_tracing_disabled(tmp_path):
+    """A plain train() with observability OFF fills the flight ring —
+    spans, counters, per-thread track ids — and creates neither an obs
+    registry nor any file."""
+    train(_blobs(), **KW_BANDED)
+    assert obs.state() is None  # full observability stayed off
+    fs = flight.state()
+    assert fs is not None
+    spans = fs.tracer.snapshot_spans()
+    assert len(spans) >= 20
+    names = {sp.name for sp in spans}
+    assert "driver.histogram" in names and "pull.chunk" in names
+    # the pull-engine worker's spans carry their own thread track
+    assert len({sp.tid for sp in spans}) >= 2
+    assert fs.metrics.counters().get("transfer.d2h_bytes", 0) > 0
+    assert not (tmp_path / "flightrec.json").exists()  # no dump yet
+
+
+def test_flightrec_off_restores_strict_noop(monkeypatch):
+    monkeypatch.setenv("DBSCAN_FLIGHTREC", "0")
+    flight.reset()
+    train(_blobs(), **KW_BANDED)
+    assert flight.state() is None
+    assert obs.span("x") is NOOP_SPAN
+    assert obs.add_span("x", 0.0, 1.0) is None
+
+
+def test_dump_on_demand_shape(tmp_path):
+    train(_blobs(), **KW_BANDED)
+    path = flight.dump(reason="operator_poke", extra="context")
+    d = flight.load(path)
+    assert d["flightrec"] == 1
+    assert d["reason"] == "operator_poke"
+    assert d["note"] == {"extra": "context"}
+    assert d["source"] == "flightrec"
+    assert d["pid"] == os.getpid() and d["shard"] is None
+    assert d["capacity"] >= 64
+    assert len(d["spans"]) >= 20
+    for sp in d["spans"]:
+        assert {"name", "t0_s", "dur_s", "depth", "tid", "args"} <= set(sp)
+    assert d["counters"].get("flightrec.dumps") == 1
+    # the dump records itself as the ring's final instant
+    ev_names = [e["name"] for s in d["spans"] for e in s["events"]]
+    ev_names += [i["name"] for i in d["instants"]]
+    assert "flightrec.dump" in ev_names
+
+
+def test_dump_reads_live_obs_registries_when_enabled(tmp_path):
+    """An obs-enabled run records once: the dump reads the live obs
+    tail instead of the (idle) flight ring."""
+    obs.enable()
+    train(_blobs(), **KW_DENSE)
+    path = flight.dump(reason="obs_backed")
+    d = flight.load(path)
+    assert d["source"] == "obs"
+    assert any(sp["name"] == "train" for sp in d["spans"])
+    # and the dump marked itself in the obs registries
+    assert obs.counters().get("flightrec.dumps") == 1
+
+
+def test_fault_dump_contains_abort_site_and_last_64_spans(
+    tmp_path, monkeypatch
+):
+    """THE acceptance pin: a campaign runs two healthy legs, then a
+    persistent mid-pull fault kills the third — all with tracing
+    disabled. The abort leaves (a) the banked chunks + abort note the
+    PR-5 path already guaranteed, and (b) a flight-recorder dump whose
+    note names the ``pull`` site and whose ring tail holds >= 64 spans
+    of the runs leading up to the death."""
+    pts = _blobs()
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 512)
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
+    train(pts, **KW_BANDED)  # healthy legs: the ring keeps their tail
+    train(pts, **KW_BANDED)
+    ck = tmp_path / "ck"
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "pull#1:PERSISTENT")
+    faults.reset_registry()
+    with pytest.raises(faults.FatalDeviceFault) as ei:
+        train(pts, checkpoint_dir=str(ck), **KW_BANDED)
+    assert ei.value.site == "pull"
+    assert obs.state() is None  # tracing really was off throughout
+
+    d = flight.load(str(tmp_path / "flightrec.json"))
+    assert d["reason"] == "fatal_fault"
+    assert d["note"]["site"] == "pull"
+    assert len(d["spans"]) >= 64
+    names = [sp["name"] for sp in d["spans"]]
+    assert "dispatch.banded" in names and "pull.chunk" in names
+    ev_names = {e["name"] for s in d["spans"] for e in s["events"]}
+    ev_names |= {i["name"] for i in d["instants"]}
+    assert "fault.fatal" in ev_names and "flightrec.dump" in ev_names
+    # the PR-5 abort guarantees still hold next to the new dump
+    assert len(list(ck.glob("p1chunk*.npz"))) >= 1
+    from dbscan_tpu.parallel import checkpoint as ckpt_mod
+
+    assert ckpt_mod.read_progress(str(ck))["aborted_site"] == "pull"
+
+
+def test_fatal_dispatch_fault_dumps_site(monkeypatch, tmp_path):
+    """Fatal faults that never reach the driver's abort guard path
+    with a checkpoint (plain dispatch, no fallback) still dump — the
+    wiring sits in faults.supervised itself."""
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "dispatch#0:PERSISTENT")
+    faults.reset_registry()
+    with pytest.raises(faults.FatalDeviceFault):
+        train(_blobs(), fault_cpu_fallback=False, **KW_DENSE)
+    d = flight.load(str(tmp_path / "flightrec.json"))
+    assert d["reason"] == "fatal_fault"
+    assert d["note"]["site"] == "dispatch"
+    assert d["note"]["ordinal"] == 0
+
+
+def test_sigusr1_dumps_and_process_continues(tmp_path):
+    """SIGUSR1 = poke a live process for a postmortem: the handler
+    dumps and execution continues (the streaming-service debug lever)."""
+    train(_blobs(), **KW_BANDED)  # installs the handlers via ensure_env
+    os.kill(os.getpid(), signal.SIGUSR1)
+    deadline = time.time() + 5
+    path = tmp_path / "flightrec.json"
+    while not path.exists() and time.time() < deadline:
+        time.sleep(0.01)
+    d = flight.load(str(path))
+    assert d["reason"] == "SIGUSR1"
+    assert len(d["spans"]) >= 20
+
+
+def test_sigterm_dumps_then_terminates(tmp_path):
+    """SIGTERM (the preemption signal): dump, then die with the
+    standard SIGTERM status. Exercised in a subprocess — the recorder
+    needs no jax, so the child is import-light."""
+    dump = tmp_path / "term.json"
+    code = (
+        "import os, signal\n"
+        f"os.environ['DBSCAN_FLIGHTREC_PATH'] = {str(dump)!r}\n"
+        "from dbscan_tpu.obs import flight\n"
+        "import dbscan_tpu.obs as obs\n"
+        "flight.ensure_env()\n"
+        "with obs.span('child.work', step=1):\n"
+        "    obs.count('child.counter', 3)\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n"
+        "print('UNREACHABLE')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == -signal.SIGTERM, proc.stderr.decode()
+    assert b"UNREACHABLE" not in proc.stdout
+    d = flight.load(str(dump))
+    assert d["reason"] == "SIGTERM"
+    assert [sp["name"] for sp in d["spans"]] == ["child.work"]
+    assert d["counters"]["child.counter"] == 3
+
+
+def test_signal_safe_dump_cannot_deadlock_on_held_locks():
+    """CPython signal handlers run on the main thread between
+    bytecodes: the interrupted frame may already HOLD the tracer or
+    metrics lock. The signal-path dump must therefore never acquire
+    them — pinned by dumping WHILE this thread holds both locks (the
+    locked path would deadlock right here)."""
+    flight.ensure_env()
+    fs = flight.state()
+    obs.count("pre.lock", 1)
+    with obs.span("held"):
+        pass
+    with fs.metrics._lock:
+        with fs.tracer._lock:
+            path = flight.dump(reason="SIGTERM", _signal_safe=True)
+    d = flight.load(path)
+    assert d["reason"] == "SIGTERM"
+    assert d["counters"]["pre.lock"] == 1
+    assert any(sp["name"] == "held" for sp in d["spans"])
+    # the signal-safe path emits no telemetry of its own (no locks)
+    assert "flightrec.dumps" not in d["counters"]
+
+
+def test_sigterm_with_ignored_disposition_keeps_handler(tmp_path):
+    """A harness that set SIGTERM to SIG_IGN before the recorder
+    installed: the prior disposition is honored (the process survives)
+    AND the handler stays installed — the SECOND SIGTERM still dumps."""
+    dump = tmp_path / "ign.json"
+    code = (
+        "import os, signal, json\n"
+        f"os.environ['DBSCAN_FLIGHTREC_PATH'] = {str(dump)!r}\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        "from dbscan_tpu.obs import flight\n"
+        "import dbscan_tpu.obs as obs\n"
+        "flight.ensure_env()\n"
+        "obs.count('c', 1)\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n"
+        "obs.count('c', 1)  # survived: prior disposition was ignore\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n"
+        "d = json.load(open(os.environ['DBSCAN_FLIGHTREC_PATH']))\n"
+        "print('second dump counters', d['counters']['c'])\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr.decode()
+    # the dump on disk reflects the SECOND signal (c == 2): the handler
+    # survived the first one
+    assert b"second dump counters 2" in proc.stdout
+
+
+def test_ring_is_bounded(monkeypatch):
+    monkeypatch.setenv("DBSCAN_FLIGHTREC_EVENTS", "100")
+    flight.reset()
+    flight.ensure_env()
+    fs = flight.state()
+    assert fs.capacity == 100 and fs.tracer.max_spans == 200
+    for i in range(500):
+        obs.add_span(f"s{i}", float(i), float(i) + 0.5)
+        obs.event(f"e{i}", i=i)
+    assert len(fs.tracer.spans) <= 200
+    assert len(fs.tracer.instants) <= 200  # instants bounded too (ring)
+    assert fs.tracer.dropped_spans > 0
+    path = flight.dump(reason="bounded")
+    d = flight.load(path)
+    # the TAIL survives: the newest span is present, >= capacity kept
+    assert d["spans"][-1]["name"] == "s499"
+    assert len(d["spans"]) >= 100
+
+
+def test_flight_overhead_under_1pct_on_dense_shape(monkeypatch):
+    """The acceptance overhead pin: the always-on ring (flight ON, obs
+    OFF — the default production state) adds < 1% to the dense bench
+    shape versus DBSCAN_FLIGHTREC=0, min-of-reps on a warmed pipeline,
+    with absolute slack for timer noise (the PR-2 guard's discipline
+    at the tighter bound)."""
+    pts = _blobs(1)[:600]
+
+    def run():
+        train(pts, **KW_DENSE)
+
+    def min_wall(reps=5):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    run()  # warm the jit caches
+    monkeypatch.setenv("DBSCAN_FLIGHTREC", "0")
+    flight.reset()
+    run()
+    without = min_wall()
+    assert flight.state() is None
+    monkeypatch.setenv("DBSCAN_FLIGHTREC", "1")
+    flight.reset()
+    run()
+    assert flight.state() is not None
+    with_ring = min_wall()
+    assert with_ring <= without * 1.01 + 0.015, (
+        f"flight-recorder overhead: {with_ring:.4f}s vs "
+        f"{without:.4f}s with the ring off"
+    )
+
+
+# --- pull-engine health (pull.stall / pull.queue_depth) ---------------
+
+
+def test_pull_stall_event_from_blocked_consumer(monkeypatch):
+    """A consumer blocked past DBSCAN_PULL_STALL_S on one job emits
+    pull.stall (once) with the queue depth — into the live obs
+    registries here, into the flight ring when tracing is off."""
+    import threading
+
+    monkeypatch.setenv("DBSCAN_PULL_STALL_S", "0.1")
+    obs.enable()
+    eng = pipe_mod.PullEngine(inflight=1)
+    gate = threading.Event()
+    wedged = eng.submit(lambda: gate.wait(10), label="wedged")
+    eng.submit(lambda: "queued", label="queued")
+    releaser = threading.Timer(0.4, gate.set)
+    releaser.start()
+    try:
+        t0 = time.perf_counter()
+        eng.wait(wedged)
+        assert time.perf_counter() - t0 >= 0.3
+        stalls = [
+            i for i in obs.state().tracer.instants
+            if i[0] == "pull.stall"
+        ]
+        assert len(stalls) == 1
+        args = stalls[0][2]
+        assert args["label"] == "wedged"
+        assert args["queue_depth"] == 2  # wedged (executing) + queued
+        assert args["waited_s"] >= 0.1
+        assert obs.counters()["pull.stalls"] == 1
+    finally:
+        releaser.cancel()
+        gate.set()
+        eng.close()
+
+
+def test_pull_stall_lands_in_flight_ring(monkeypatch):
+    import threading
+
+    monkeypatch.setenv("DBSCAN_PULL_STALL_S", "0.05")
+    flight.ensure_env()
+    assert obs.state() is None and flight.active()
+    eng = pipe_mod.PullEngine(inflight=1)
+    gate = threading.Event()
+    job = eng.submit(lambda: gate.wait(10), label="wedged")
+    releaser = threading.Timer(0.2, gate.set)
+    releaser.start()
+    try:
+        eng.wait(job)
+        fs = flight.state()
+        stalled = [i for i in fs.tracer.instants if i[0] == "pull.stall"]
+        assert len(stalled) == 1
+        assert fs.metrics.counters()["pull.stalls"] == 1
+    finally:
+        releaser.cancel()
+        gate.set()
+        eng.close()
+
+
+def test_queue_depth_gauge_tracks_backlog(monkeypatch):
+    import threading
+
+    monkeypatch.setenv("DBSCAN_PULL_STALL_S", "0")  # disabled: no event
+    obs.enable()
+    eng = pipe_mod.PullEngine(inflight=1)
+    gate = threading.Event()
+    entered = threading.Event()
+    first = eng.submit(lambda: (entered.set(), gate.wait(10)))
+    rest = [eng.submit(lambda i=i: i) for i in range(4)]
+    try:
+        assert entered.wait(5)
+        # 1 executing + 4 backlogged, observed while wedged
+        assert obs.summary()["gauges"]["pull.queue_depth"] == 5
+        gate.set()
+        for j in rest:
+            eng.wait(j)
+        eng.wait(first)
+        eng.drain()
+        assert obs.summary()["gauges"]["pull.queue_depth"] == 0
+        # stall disabled: the blocked waits above emitted no event
+        assert "pull.stalls" not in obs.counters()
+    finally:
+        gate.set()
+        eng.close()
+
+
+def test_stall_knob_declared_and_typed():
+    from dbscan_tpu import config
+
+    assert config.ENV_VARS["DBSCAN_PULL_STALL_S"].kind == "float"
+    assert config.env("DBSCAN_PULL_STALL_S") == 30.0
+
+
+# --- device timeline (obs/devtime.py) ---------------------------------
+
+
+def test_devtime_brackets_emit_counters_and_family_spans():
+    devtime.enable()
+    obs.enable()
+    snap = obs.counters()
+    train(_blobs(), **KW_DENSE)
+    delta = obs.counters_delta(snap)
+    assert delta.get("devtime.samples", 0) >= 1
+    assert delta["devtime.device_s"] > 0
+    # device window >= host dispatch wall, and = dispatch + sync exactly
+    assert delta["devtime.device_s"] >= delta["devtime.dispatch_s"]
+    assert delta["devtime.device_s"] == pytest.approx(
+        delta["devtime.dispatch_s"] + delta["devtime.sync_s"]
+    )
+    spans = obs.state().tracer.snapshot_spans()
+    dev = [s for s in spans if s.name.startswith("devtime.")]
+    assert dev and all(
+        s.name == f"devtime.{s.args['family']}" for s in dev
+    )
+    # every devtime span names a declared compile family
+    from dbscan_tpu.obs import schema
+
+    for s in dev:
+        assert s.args["family"] in schema.COMPILE_FAMILIES
+
+
+def test_devtime_disabled_is_default_noop():
+    obs.enable()
+    train(_blobs(), **KW_DENSE)
+    assert "devtime.samples" not in obs.counters()
+
+
+def test_analyze_devtime_rollup_and_busy_frac(tmp_path):
+    """The devtime section's arithmetic, pinned exactly on a hand-built
+    trace: per-family device seconds, the counter totals, and
+    device_busy_frac = device_s / train wall."""
+    obs.enable()
+    obs.add_span("train", 0.0, 10.0)
+    obs.add_span(
+        "devtime.dispatch.dense", 1.0, 4.0,
+        family="dispatch.dense", host_s=1.0, sync_s=2.0,
+    )
+    obs.add_span(
+        "devtime.spill.level", 5.0, 7.0,
+        family="spill.level", host_s=0.5, sync_s=1.5,
+    )
+    obs.count("devtime.samples", 2)
+    obs.count("devtime.dispatch_s", 1.5)
+    obs.count("devtime.sync_s", 3.5)
+    obs.count("devtime.device_s", 5.0)
+    path = str(tmp_path / "t.json")
+    obs.write(path)
+    rep = analyze_mod.analyze(analyze_mod.load_trace(path))
+    dev = rep["devtime"]
+    assert dev["samples"] == 2
+    assert dev["device_s"] == 5.0
+    assert dev["train_wall_s"] == 10.0
+    assert dev["device_busy_frac"] == 0.5
+    rows = {r["family"]: r for r in dev["families"]}
+    assert rows["dispatch.dense"]["device_s"] == 3.0
+    assert rows["dispatch.dense"]["host_s"] == 1.0
+    assert rows["spill.level"]["sync_s"] == 1.5
+    # families sort by device seconds descending
+    assert [r["family"] for r in dev["families"]] == [
+        "dispatch.dense", "spill.level",
+    ]
+
+
+def test_analyze_pull_check_measures_device_overlap(tmp_path):
+    """The measured pull_overlap_ratio check: device-side overlap is
+    the exact intersection of pull.chunk windows with the devtime
+    union — 1.5s of the 2s pull busy here, vs the host's claimed 1.8s."""
+    obs.enable()
+    obs.add_span("pull.chunk", 1.0, 2.0, label="c0", bytes=10)
+    obs.add_span("pull.chunk", 3.0, 4.0, label="c1", bytes=10)
+    obs.add_span(
+        "devtime.dispatch.banded_p1", 0.0, 2.5,
+        family="dispatch.banded_p1", host_s=0.1, sync_s=2.4,
+    )
+    obs.add_span(
+        "devtime.dispatch.banded_p1", 3.5, 6.0,
+        family="dispatch.banded_p1", host_s=0.1, sync_s=2.4,
+    )
+    obs.count("pull.busy_s", 2.0)
+    obs.count("pull.overlap_s", 1.8)
+    path = str(tmp_path / "t.json")
+    obs.write(path)
+    rep = analyze_mod.analyze(analyze_mod.load_trace(path))
+    pc = rep["pull_check"]
+    assert pc["pull_busy_s"] == 2.0
+    assert pc["host_overlap_s"] == 1.8
+    assert pc["host_overlap_ratio"] == 0.9
+    assert pc["device_overlap_s"] == 1.5  # [1,2] full + [3.5,4] half
+    assert pc["device_overlap_ratio"] == 0.75
+
+
+def test_bench_stamps_device_busy_frac():
+    import bench
+
+    delta = {
+        "devtime.samples": 3,
+        "devtime.device_s": 0.6,
+        "transfer.payload_upload_s": 0.0,
+    }
+    fields = bench._rep_obs_fields(delta, 1.2)
+    assert fields["device_busy_frac"] == 0.5
+    # absent when no bracketed dispatch ran
+    assert "device_busy_frac" not in bench._rep_obs_fields({}, 1.2)
+
+
+def test_history_promotes_and_gates_device_busy_frac(tmp_path):
+    """bench_history promotes *_device_busy_frac at unit `ratio`;
+    obs.regress gates it HIGHER-better — mirroring pull_overlap_ratio."""
+    from dbscan_tpu.obs import bench_history, regress
+
+    cap = {
+        "backend": "cpu",
+        "anchor_seconds": 10.0,
+        "anchor_device_busy_frac": 0.8,
+    }
+    recs = bench_history.normalize_capture(cap, "CAP_new.json", "r9")
+    by_metric = {r["metric"]: r for r in recs}
+    assert by_metric["anchor_device_busy_frac"]["unit"] == "ratio"
+    assert regress.direction("anchor_device_busy_frac") == "higher"
+    history = [
+        dict(by_metric["anchor_device_busy_frac"],
+             value=v, source=f"CAP_{i}.json")
+        for i, v in enumerate((0.8, 0.82, 0.78))
+    ]
+    fresh_bad = [dict(by_metric["anchor_device_busy_frac"], value=0.3)]
+    res = regress.compare(fresh_bad, history, threshold=0.25)
+    assert [e["metric"] for e in res["regressions"]] == [
+        "anchor_device_busy_frac"
+    ]
+    fresh_ok = [dict(by_metric["anchor_device_busy_frac"], value=0.79)]
+    res = regress.compare(fresh_ok, history, threshold=0.25)
+    assert not res["regressions"]
+
+
+# --- profiler capture window ------------------------------------------
+
+
+def test_profile_window_opens_and_closes_without_leak(
+    monkeypatch, tmp_path
+):
+    """DBSCAN_PROFILE_WINDOW=1 under tier-1 CPU: the window opens at
+    the first tracked dispatch, closes after the n-th, and leaves NO
+    live profiler session (a fresh start_trace/stop_trace cycle must
+    succeed afterwards). One window per process: the latch holds."""
+    import jax
+
+    monkeypatch.setenv("DBSCAN_PROFILE_WINDOW", "1")
+    monkeypatch.setenv("DBSCAN_PROFILE_DIR", str(tmp_path / "prof"))
+    devtime.reset()
+    train(_blobs(), **KW_DENSE)
+    ws = devtime.window_state()
+    assert ws["done"] and not ws["active"]
+    assert ws["seen"] >= 1
+    # no leaked session: a fresh profiler cycle succeeds
+    jax.profiler.start_trace(str(tmp_path / "prof2"))
+    jax.profiler.stop_trace()
+    # latch: a second train opens no second window
+    train(_blobs(), **KW_DENSE)
+    assert devtime.window_state()["seen"] == ws["seen"]
+
+
+def test_profile_window_events_and_conversion(monkeypatch, tmp_path):
+    monkeypatch.setenv("DBSCAN_PROFILE_WINDOW", "1")
+    prof = str(tmp_path / "prof")
+    monkeypatch.setenv("DBSCAN_PROFILE_DIR", prof)
+    devtime.reset()
+    obs.enable()
+    train(_blobs(), **KW_DENSE)
+    evs = [i[0] for i in obs.state().tracer.instants] + [
+        e[0]
+        for sp in obs.state().tracer.snapshot_spans()
+        for e in sp.events
+    ]
+    assert "profile.window_open" in evs
+    assert "profile.window_close" in evs
+    assert obs.counters().get("profile.windows") == 1
+    # conversion: where this jaxlib emits trace.json.gz, the converted
+    # file is a loadable Chrome trace; where it emits only xplane.pb,
+    # convert returns None (documented degradation) — both accepted,
+    # but the call itself must never raise
+    out = devtime.convert_profile(prof, str(tmp_path / "conv.json"))
+    if out is not None:
+        data = analyze_mod.load_trace(out)
+        assert isinstance(data["spans"], list)
+    assert devtime.convert_profile(str(tmp_path / "empty")) is None
+
+
+# --- multi-shard trace merge ------------------------------------------
+
+
+def _write_shard(path, epoch0, pid, shard, spans):
+    """Hand-built JSONL shard: exact numbers for the merge arithmetic."""
+    lines = [
+        json.dumps(
+            {"type": "meta", "epoch0": epoch0, "pid": pid, "shard": shard}
+        )
+    ]
+    for name, t0, dur, tid in spans:
+        lines.append(
+            json.dumps(
+                {
+                    "type": "span", "name": name, "t0_s": t0,
+                    "dur_s": dur, "depth": 0, "tid": tid, "args": {},
+                    "events": [],
+                }
+            )
+        )
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def test_merge_aligns_clocks_and_pins_critical_path(tmp_path):
+    """Exact-arithmetic pin of the cross-process critical path: shard B
+    starts 2s after A (epoch offset); A busy [0,4]+[6,8] in merged
+    time, B busy [3,7] — exclusive stretches A:[0,3]+[7,8]=4s,
+    B:[4,6]=2s, all-busy [3,4]+[6,7]=2s, idle [pause]=0."""
+    a = str(tmp_path / "s.0")
+    b = str(tmp_path / "s.1")
+    _write_shard(
+        a, epoch0=1000.0, pid=7, shard=0,
+        spans=[("work", 0.0, 4.0, 11), ("work", 6.0, 2.0, 11)],
+    )
+    _write_shard(
+        b, epoch0=1002.0, pid=7, shard=1,  # SAME os pid on purpose
+        spans=[("work", 1.0, 4.0, 11)],  # merged: [3, 7]
+    )
+    merged = analyze_mod.merge_shards([a, b])
+    mg = merged["merge"]
+    assert mg["n_shards"] == 2
+    assert mg["wall_s"] == 8.0
+    assert mg["all_busy_s"] == 2.0
+    assert mg["idle_s"] == 0.0
+    sh = {s["index"]: s for s in mg["shards"]}
+    assert sh[0]["offset_s"] == 0.0 and sh[1]["offset_s"] == 2.0
+    assert sh[0]["busy_s"] == 6.0 and sh[0]["exclusive_s"] == 4.0
+    assert sh[1]["busy_s"] == 4.0 and sh[1]["exclusive_s"] == 2.0
+    segs = sorted(
+        ((g["shard"], g["t0_s"], g["t1_s"]) for g in mg["serial_segments"])
+    )
+    assert segs == [(0, 0.0, 3.0), (0, 7.0, 8.0), (1, 4.0, 6.0)]
+    # disjoint track ids BY CONSTRUCTION, even with colliding os pids
+    trace = merged["trace"]
+    pids = {
+        e["pid"] for e in trace["traceEvents"] if e["ph"] != "M"
+    }
+    assert pids == {1, 2}
+    # the same-tid spans of different shards landed on different tracks
+    tids = {
+        (e["pid"], e["tid"])
+        for e in trace["traceEvents"]
+        if e["ph"] == "X"
+    }
+    assert len({t for _, t in tids}) == 2
+
+
+def test_merge_real_two_shard_trace_is_perfetto_valid(tmp_path):
+    """Two real runs exported as shards -> --merge emits one
+    Perfetto-valid trace (ph/ts/dur/pid on every event) with disjoint
+    per-shard pids, and the console entry point round-trips."""
+    pts = _blobs()
+    s0 = str(tmp_path / "run.json.0")
+    s1 = str(tmp_path / "run.json.1")
+    obs.enable(trace_path=s0)
+    train(pts, **KW_BANDED)
+    obs.flush()
+    obs.disable()
+    obs.enable(trace_path=s1)
+    train(pts, **KW_DENSE)
+    obs.flush()
+    obs.disable()
+    out = str(tmp_path / "merged.json")
+    rc = analyze_mod.main(["--merge", s0, s1, "-o", out])
+    assert rc == 0
+    with open(out) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    assert evs
+    by_shard_pid = {}
+    for e in evs:
+        assert e["ph"] in ("X", "i", "C", "M")
+        assert isinstance(e.get("ts"), (int, float))
+        assert "pid" in e and "name" in e
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+            by_shard_pid.setdefault(e["pid"], set()).add(e["tid"])
+    assert set(by_shard_pid) == {1, 2}  # one disjoint pid per shard
+    # no merged tid is shared across the two shard pids
+    assert not (by_shard_pid[1] & by_shard_pid[2])
+    assert trace["otherData"]["merged"] is True
+    assert len(trace["otherData"]["shards"]) == 2
+    # both shards' spans survived into one timeline
+    names = {e["name"] for e in evs if e["ph"] == "X"}
+    assert "train" in names and "driver.histogram" in names
+    # the merged report carries a cross-process critical path
+    merged = analyze_mod.merge_shards([s0, s1])
+    assert merged["merge"]["n_shards"] == 2
+    assert merged["merge"]["wall_s"] > 0
+    assert sum(s["busy_s"] for s in merged["merge"]["shards"]) > 0
+
+
+def test_single_trace_cli_rejects_multiple_without_merge(tmp_path):
+    with pytest.raises(SystemExit):
+        analyze_mod.main(["a.json", "b.json"])
+
+
+def test_trace_flush_shards_path_under_multiprocess(
+    monkeypatch, tmp_path
+):
+    """DBSCAN_TRACE under a multi-process job: flush writes
+    <path>.<process_index> (and JSONL shards keep the JSONL format
+    despite the suffix hiding the extension)."""
+    monkeypatch.setattr(export_mod, "shard_index", lambda: 1)
+    for name, want_jsonl in (("t.json", False), ("t.jsonl", True)):
+        obs.disable()
+        obs.enable(trace_path=str(tmp_path / name))
+        with obs.span("x"):
+            pass
+        written = obs.flush()
+        assert written == str(tmp_path / name) + ".1"
+        with open(written) as f:
+            text = f.read()
+        if want_jsonl:
+            assert text.splitlines()[0].startswith('{"type": "meta"')
+        else:
+            assert json.loads(text)["traceEvents"]
+        # and the shard id rides the export metadata
+        data = analyze_mod.load_trace(written)
+        assert data["meta"]["shard"] == 1
+
+
+# --- cli gauges regression (satellite) --------------------------------
+
+
+def _write_csv(tmp_path):
+    path = tmp_path / "pts.csv"
+    np.savetxt(path, _blobs()[:800], delimiter=",")
+    return str(path)
+
+
+def test_cli_metrics_summary_includes_gauges(monkeypatch, tmp_path, capsys):
+    """--metrics-summary reports GAUGES (HBM watermarks, pull.inflight)
+    next to the counters — pinned with fake allocator stats so the
+    memory.* watermarks appear under tier-1 CPU too."""
+    from dbscan_tpu import cli
+    from dbscan_tpu.obs import memory
+
+    stats = {
+        "tpu:0": {
+            "bytes_in_use": 123_000,
+            "peak_bytes_in_use": 456_000,
+            "bytes_limit": 16_000_000,
+        }
+    }
+    monkeypatch.setattr(memory, "device_memory_stats", lambda: stats)
+    memory.reset_peak()
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
+    rc = cli.main(
+        [
+            "--input", _write_csv(tmp_path),
+            "--eps", "0.5", "--min-points", "5",
+            "--max-points-per-partition", "256",
+            "--metrics-summary",
+        ]
+    )
+    memory.reset_peak()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "== metrics summary ==" in out
+    assert "gauges:" in out
+    gauge_block = out.split("gauges:", 1)[1]
+    assert "pull.inflight" in gauge_block
+    assert "memory.bytes_in_use" in gauge_block
+    assert "memory.peak_bytes_in_use" in gauge_block
+    assert "flight recorder: on" in out
+
+
+def test_cli_trace_plus_summary_gauges_in_both(monkeypatch, tmp_path, capsys):
+    """--trace + --metrics-summary together: the summary carries the
+    gauges AND the flushed trace file carries them on the counter
+    track (the satellite's regression shape)."""
+    from dbscan_tpu import cli
+
+    trace = str(tmp_path / "t.json")
+    monkeypatch.setenv("DBSCAN_PULL_PIPELINE", "1")
+    rc = cli.main(
+        [
+            "--input", _write_csv(tmp_path),
+            "--eps", "0.5", "--min-points", "5",
+            "--max-points-per-partition", "256",
+            "--trace", trace,
+            "--metrics-summary",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "gauges:" in out and "pull.inflight" in out
+    with open(trace) as f:
+        t = json.load(f)
+    counter_names = {
+        e["name"] for e in t["traceEvents"] if e["ph"] == "C"
+    }
+    assert "pull.inflight" in counter_names
+    assert "pull.inflight" in t["otherData"]["gauges"]

@@ -31,11 +31,18 @@ pytestmark = pytest.mark.obs
 @pytest.fixture(autouse=True)
 def _clean_obs(monkeypatch):
     """Every test starts (and leaves) the process with observability
-    disabled and no trace env, so state never leaks across tests."""
+    disabled, no trace env, and the always-on flight recorder OFF (its
+    ring would otherwise catch the hooks these tests pin as strict
+    no-ops; the recorder has its own suite, tests/test_flight.py)."""
+    from dbscan_tpu.obs import flight
+
     monkeypatch.delenv("DBSCAN_TRACE", raising=False)
+    monkeypatch.setenv("DBSCAN_FLIGHTREC", "0")
+    flight.reset()
     obs.disable()
     yield
     obs.disable()
+    flight.reset()
 
 
 def _blobs(n_per=300):
@@ -218,9 +225,17 @@ def test_chrome_trace_is_valid_perfetto_json(tmp_path):
     evs = trace["traceEvents"]
     assert isinstance(evs, list) and evs
     for e in evs:
-        assert e["ph"] in ("X", "i", "C")
+        # "M" = the PR-9 process_name track metadata (shard identity)
+        assert e["ph"] in ("X", "i", "C", "M")
         assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
         assert "name" in e and "pid" in e
+    # exactly one process_name metadata record names this track
+    assert [e["args"]["name"] for e in evs if e["ph"] == "M"] == [
+        f"dbscan pid {os.getpid()}"
+    ]
+    # the merge anchors ride otherData
+    assert trace["otherData"]["pid"] == os.getpid()
+    assert trace["otherData"]["epoch0"] > 0
     xs = [e for e in evs if e["ph"] == "X"]
     assert {e["name"] for e in xs} == {"parent", "child"}
     for e in xs:
@@ -244,7 +259,12 @@ def test_jsonl_export(tmp_path):
     with open(path) as f:
         records = [json.loads(line) for line in f if line.strip()]
     kinds = {r["type"] for r in records}
-    assert kinds == {"span", "counter"}
+    assert kinds == {"meta", "span", "counter"}
+    # the leading meta record carries the clock anchor + track identity
+    # the --merge mode aligns shards on
+    assert records[0]["type"] == "meta"
+    assert records[0]["pid"] == os.getpid()
+    assert records[0]["epoch0"] > 0 and records[0]["shard"] is None
     span_rec = next(r for r in records if r["type"] == "span")
     assert span_rec["name"] == "a" and span_rec["dur_s"] >= 0
 
@@ -660,6 +680,37 @@ def test_all_runtime_telemetry_names_are_declared(monkeypatch):
     finally:
         faults.reset_registry()
         pipe_mod.reset_engine()
+
+
+def test_analyze_sections_map_to_declared_names():
+    """Every section obs.analyze renders is wired to a DECLARED name
+    family (analyze.SECTIONS): a consumer section whose producer names
+    vanish from obs/schema.py must fail here (and at analyze import),
+    never silently render empty — the drift the schema exists to stop."""
+    from dbscan_tpu.obs import analyze, schema
+
+    # one entry per rendered report section (keep SECTIONS honest: a
+    # new analyze() section must register its name family here)
+    report_keys = set(
+        analyze.analyze(
+            {"spans": [], "instants": [], "counters": {}, "gauges": {},
+             "dropped_spans": 0}
+        )
+    )
+    for key in analyze.SECTIONS:
+        assert key in report_keys, key
+    # and every registered family resolves against the schema
+    for key, (kind, names) in analyze.SECTIONS.items():
+        if names is None:
+            continue  # spans section: unfiltered
+        if isinstance(names, str):
+            assert schema.prefix_declared(kind, names), (key, names)
+        else:
+            for name in names:
+                assert schema.is_declared(kind, name), (key, name)
+    # the merge/devtime consumers read declared span families
+    assert schema.prefix_declared("span", "devtime.")
+    assert schema.is_declared("span", "pull.chunk")
 
 
 def test_small_train_records_compile_accounting():
