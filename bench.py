@@ -291,6 +291,29 @@ def _spill_fields(prefix: str, stats: dict) -> dict:
     return out
 
 
+def _cellcc_fields(prefix: str, stats: dict) -> dict:
+    """Flat cellcc-finalize figures for a banded row: the whole-finalize
+    wall (promotable `_s` key, regress-up) and — when the device
+    finalize ran — its CC sweep count, so the history gate catches
+    propagation-count blowups, not just wall regressions. Empty when
+    the run had no banded finalize (dense/cosine paths)."""
+    t = dict(stats.get("timings") or {})
+    if t.get("cellcc_finalize_s") is None:
+        return {}
+    out = {
+        f"{prefix}_cellcc_finalize_s": round(
+            float(t["cellcc_finalize_s"]), 3
+        )
+    }
+    # 0 means the host oracle ran (DBSCAN_CELLCC_DEVICE=0, a structural
+    # exclusion, or a fault degrade) — mixing those into the gated
+    # history would make a silent degrade read as the best possible
+    # sweep count and flag the next healthy capture
+    if stats.get("cellcc_cc_iters"):
+        out[f"{prefix}_cellcc_cc_iters"] = int(stats["cellcc_cc_iters"])
+    return out
+
+
 def _phases(stats, top=8) -> dict:
     """Condense stats['timings'] to the `top` largest phases + total."""
     t = dict(stats.get("timings") or {})
@@ -836,6 +859,9 @@ def anchor_row(prefix: str, n: int, kind: str, maxpp: int) -> dict:
         # spill wall + level-build rounds (cosine rows; empty for the
         # grid metrics, which never spill)
         **_spill_fields(prefix, model.stats),
+        # cellcc finalize wall + device CC sweep count (banded rows;
+        # empty for paths with no banded finalize)
+        **_cellcc_fields(prefix, model.stats),
     }
     if kind == "euclidean" and os.environ.get("BENCH_MFU", "1") == "1":
         import jax
@@ -1036,6 +1062,7 @@ def main() -> None:
         "seconds": round(dt, 3),
         "phases": _phases(model.stats),
         **rep_obs,  # upload/compute split (+ resident_hot when cosine)
+        **_cellcc_fields("headline", model.stats),
     }
     if backend != "cpu" and os.environ.get("BENCH_MFU", "1") == "1":
         try:
@@ -1274,6 +1301,11 @@ _COMPACT_SUFFIXES = (
     # devtime measured device-busy share of the rep wall
     # (obs/devtime.py): gates higher-better like the overlap ratio
     "_device_busy_frac",
+    # device cellcc finalize: the whole-finalize wall and the CC sweep
+    # count (parallel/cellgraph.py finalize_device) — both gated, so
+    # tail-only captures still catch a finalize regression
+    "_cellcc_finalize_s",
+    "_cellcc_cc_iters",
 )
 
 

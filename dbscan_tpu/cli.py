@@ -67,6 +67,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="route the local kernel through the streaming Pallas sweeps",
     )
     p.add_argument(
+        "--neighbor-backend", choices=["auto", "dense", "banded"],
+        default="auto",
+        help="per-partition neighbor engine: auto routes by width, "
+        "banded forces the grid-banded sweeps (+ the cellcc finalize) "
+        "at any size, dense forces the [B, B] adjacency engine",
+    )
+    p.add_argument(
         "--mesh-devices", type=int, default=0,
         help="fan partitions out over this many devices (0 = single device)",
     )
@@ -183,6 +190,7 @@ def _run(args, log) -> int:
         metric=args.metric,
         precision=Precision(args.precision),
         use_pallas=args.use_pallas,
+        neighbor_backend=args.neighbor_backend,
         fault_max_retries=args.fault_retries,
         fault_cpu_fallback=not args.no_fault_cpu_fallback,
         mesh=mesh,

@@ -318,6 +318,25 @@ ENV_VARS = _env_table(
         "oversized chunk still runs, alone).",
     ),
     EnvVar(
+        "DBSCAN_CELLCC_DEVICE", "bool", True,
+        "Device-resident cellcc finalize for banded runs: per-chunk "
+        "unpack + one fused on-device cell connected-components / "
+        "border-algebra dispatch, so only final labels cross the link. "
+        "0 keeps the host unpack/scipy finalize as the parity oracle; "
+        "checkpointed, multi-process, DBSCAN_EAGER_PULL, and "
+        "pull-fault-clause (DBSCAN_FAULT_SPEC pull#N) runs use the "
+        "host path regardless (their per-chunk artifacts/ordinals must "
+        "materialize host-side).",
+    ),
+    EnvVar(
+        "DBSCAN_CELLCC_DEVICE_SLOTS", "int", 1 << 28,
+        "Staged-slot budget of the device cellcc finalize: it keeps "
+        "~13 B/slot of chunk metadata/partials resident until the tail "
+        "CC dispatch (the host path frees per chunk), so a run whose "
+        "chunks exceed this degrades the finalize to the host oracle "
+        "mid-run, freeing the staged HBM; labels are unchanged.",
+    ),
+    EnvVar(
         "DBSCAN_SPILL_DEVICE", "str", "auto",
         "Spill-tree device passes: 1 forces the accelerator path, 0 "
         "forces host BLAS, auto uses the device when a non-CPU backend "

@@ -73,9 +73,10 @@ SITE_SPILL = "spill"  # spill-tree device ops (spill_device.py)
 SITE_SPILL_LEVEL = "spill_level"  # level-synchronous spill-tree dispatch
 SITE_STREAM = "stream"  # streaming per-batch update step
 SITE_PULL = "pull"  # pipelined compact-chunk pull (parallel/pipeline.py)
+SITE_CELLCC = "cellcc_cc"  # device cellcc finalize (cellgraph.finalize_device)
 _SITES = (
     SITE_DISPATCH, SITE_BANDED, SITE_SPILL, SITE_SPILL_LEVEL,
-    SITE_STREAM, SITE_PULL, "*",
+    SITE_STREAM, SITE_PULL, SITE_CELLCC, "*",
 )
 
 
@@ -125,8 +126,8 @@ def parse_fault_spec(spec: str) -> Tuple[FaultClause, ...]:
     Grammar: semicolon-separated clauses ``site#ordinal:KIND[*count]``:
 
     - ``site``: ``dispatch`` | ``banded`` | ``spill`` | ``spill_level``
-      | ``stream`` | ``pull`` | ``*`` (any supervised site, ordinal
-      counted globally);
+      | ``stream`` | ``pull`` | ``cellcc_cc`` | ``*`` (any supervised
+      site, ordinal counted globally);
     - ``ordinal``: 0-based index of the supervised dispatch at that
       site (each :func:`supervised` call consumes one ordinal);
     - ``KIND``: ``TRANSIENT`` (fails ``count`` attempts, then heals),

@@ -207,8 +207,8 @@ def _sy(name: str) -> E:
 
 
 def _models() -> Dict[str, FamilyModel]:
-    P, B, D, NB, N, M, K, G = (
-        _sy(n) for n in ("P", "B", "D", "NB", "N", "M", "K", "G")
+    P, B, D, NB, N, M, K, G, C, V = (
+        _sy(n) for n in ("P", "B", "D", "NB", "N", "M", "K", "G", "C", "V")
     )
     R = BANDED_ROWS
     slots = P * B  # one group's padded slot count
@@ -305,6 +305,55 @@ def _models() -> Dict[str, FamilyModel]:
                 },
                 note="border-candidate gather from the resident "
                 "bits_flat; K is ladder-padded (driver._pad_idx)",
+            ),
+            FamilyModel(
+                "cellcc.unpack",
+                [
+                    ArgModel("combo", ("CB",), INT),
+                    ArgModel("cell_flat", ("M",), INT),
+                    ArgModel("fold_flat", ("M",), INT),
+                    ArgModel("or_gid", ("K",), INT),
+                ],
+                # outputs: core bool [M] + the per-cell partials
+                # ([C, 25] bool + [C] i32, C = padded cell count — not
+                # an arg dim, so the HBM half gates at runtime only);
+                # temps: the [K, 25] unpacked scan values
+                overhead=M + K * (4 + BANDED_ROWS * BANDED_ROWS * 4)
+                + C * (BANDED_ROWS * BANDED_ROWS + 4),
+                static_slots=None,
+                note="per-chunk device fold of the packed postpass "
+                "slabs into per-cell partials (CB = M/8 + 4*K combo "
+                "bytes); C scales with occupied cells — data-scaled, "
+                "runtime-gated",
+            ),
+            FamilyModel(
+                "cellcc.cc",
+                [
+                    ArgModel(
+                        "wintab", ("C", BANDED_ROWS * BANDED_ROWS), INT
+                    ),
+                    ArgModel(
+                        "cellors",
+                        ("Ci", BANDED_ROWS * BANDED_ROWS),
+                        BOOL,
+                        tuple_of=True,
+                    ),
+                    ArgModel("cellfolds", ("Ci",), INT, tuple_of=True),
+                    ArgModel("cores", ("Mi",), BOOL, tuple_of=True),
+                    ArgModel("bitses", ("Mi",), INT, tuple_of=True),
+                    ArgModel("cells", ("Mi",), INT, tuple_of=True),
+                    ArgModel("folds", ("Mi",), INT, tuple_of=True),
+                ],
+                # temps: labels/comp/seed tables + the [C, 25] seed_win
+                # + bounded lax.map label-pass tiles; outputs: the
+                # compacted [V] i32 seeds + i8 flags (V = ladder-padded
+                # valid count — not an arg dim, runtime-gated)
+                overhead=C * (BANDED_ROWS * BANDED_ROWS * 4 + 16) + V * 5,
+                static_slots=None,
+                note="one fused dispatch: cell CC (min-label "
+                "propagation + pointer jump) + border algebra + "
+                "valid-prefix compaction across every chunk; V scales "
+                "with instances — data-scaled, runtime-gated",
             ),
             FamilyModel(
                 "spill.gather",
@@ -418,6 +467,12 @@ FAMILY_MODELS: Dict[str, FamilyModel] = _models()
 TUPLE_COUPLED = {
     # cores[i].shape == bitses[i].shape; segflags[i] = prod(cores[i])
     "cellcc.postpass": (("cores", "bitses"),),
+    # the per-chunk flat arrays all share one slot count per element
+    "cellcc.cc": (
+        ("cores", "bitses"),
+        ("cores", "cells"),
+        ("cores", "folds"),
+    ),
 }
 
 

@@ -54,11 +54,13 @@ from dbscan_tpu.obs import schema
 # synchronous spill build's round count — a depth/dispatch figure that
 # regresses UP like a wall; _busy_frac: devtime's measured device-busy
 # share of the rep wall — device utilization lost = work moved back to
-# the host/link, so it regresses DOWN like the overlap ratio)
+# the host/link, so it regresses DOWN like the overlap ratio;
+# _cc_iters: the device cellcc finalize's CC sweep count — a
+# propagation-depth figure that regresses UP like the spill levels)
 _EXACT_KEYS = ("value", "seconds", "vs_baseline")
 _SUFFIXES = (
     "_seconds", "_s", "_mpts", "_vs_baseline", "_overlap_ratio",
-    "_pred_ratio", "_spill_levels", "_busy_frac",
+    "_pred_ratio", "_spill_levels", "_busy_frac", "_cc_iters",
 )
 # numeric-but-not-perf keys the suffix rule would otherwise catch —
 # declared with the telemetry schema (the keys are fault-counter
@@ -92,6 +94,8 @@ def _unit_for(metric: str, obj: dict) -> Optional[str]:
         return "ratio"
     if metric.endswith("_spill_levels"):
         return "levels"
+    if metric.endswith("_cc_iters"):
+        return "iters"
     if metric.endswith(("_seconds", "_s")) or metric == "seconds":
         return "s"
     if metric.endswith("_mpts"):

@@ -34,7 +34,11 @@ bracketed with a ``block_until_ready`` delta —
 - ``devtime.device_s`` — the full issue->ready window (an UPPER bound
   on the dispatch's device occupancy), also emitted per family as a
   ``devtime.<family>`` span so the trace carries a device-time track
-  per compile family (including the PR-8 ``spill.level`` families).
+  per compile family — coverage follows ``obs.schema.COMPILE_FAMILIES``
+  exactly, so the PR-8 ``spill.level*`` families and the device cellcc
+  finalize (``cellcc.unpack`` / ``cellcc.cc``) appear the moment their
+  dispatches run; ``device_busy_frac`` therefore credits the on-device
+  finalize the way it credits the sweeps.
 
 The sync point serializes the dispatch tail, so this leg is for
 instrumented runs (bench enables it around its timed reps the way it

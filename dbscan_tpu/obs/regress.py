@@ -61,6 +61,10 @@ def direction(metric: str, unit: Optional[str] = None) -> Optional[str]:
         # level-build rounds = fused dispatches = tree depth: a deeper
         # tree pays more round-trips, so the count regresses UP
         return LOWER_BETTER
+    if metric.endswith("_cc_iters"):
+        # device cellcc CC sweeps: each is a full [C, 25] gather pass,
+        # so a propagation-count blowup regresses UP like a wall
+        return LOWER_BETTER
     if metric.endswith(("_seconds", "_s")) or metric == "seconds":
         return LOWER_BETTER
     if metric.endswith(("_mpts", "_vs_baseline", "_throughput")) or metric in (

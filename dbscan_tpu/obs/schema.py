@@ -44,6 +44,8 @@ COMPILE_FAMILIES = (
     "dispatch.banded_p1",
     "cellcc.postpass",
     "cellcc.gather",
+    "cellcc.unpack",
+    "cellcc.cc",
     "spill.gather",
     "spill.level",
     "spill.level_final",
@@ -100,6 +102,9 @@ COUNTERS = {
     "compiles.wall_s": "summed wall of the cache-miss calls",
     "compiles.ratchet_raises": "streaming shape-floor raises post-warm-up",
     "memory.samples": "HBM watermark samples taken",
+    "cellcc.cc_iters": "neighbor-min sweeps the device cell "
+    "connected-components ran to its fixed point (data-dependent "
+    "convergence depth; labels are iteration-count-independent)",
     "spill.levels": "level-synchronous spill-tree build rounds run",
     "spill.level_dispatches": "fused level-build dispatches issued "
     "(one per level + the closing compact; bounded by tree depth, "
@@ -161,6 +166,10 @@ SPANS = {
     "level (PullEngine-overlapped)",
     "compact.flush_chunk": "compact p1 chunk flush to device",
     "compact.pull_chunk": "compact p1 chunk pull to host",
+    "cellcc.finalize": "whole cellcc finalize window (device CC + "
+    "label pull, or the host-oracle merge; mode/cc_iters attached — "
+    "prior overlapped chunk-pull seconds ride the pull_prior_s attr, "
+    "timings['cellcc_finalize_s'] adds them to this span's wall)",
     "pull.chunk": "one pull-pipeline job (transfer + host finalize)",
     "checkpoint.save_premerge": "pre-merge checkpoint write",
     "checkpoint.save_p1_chunk": "p1 chunk checkpoint write",

@@ -60,6 +60,7 @@ from jax import lax
 
 # Block/window geometry lives host-side next to the packer that must agree
 # on it.
+from dbscan_tpu.ops.labels import BORDER, CORE, NOISE
 from dbscan_tpu.parallel.binning import BANDED_BLOCK, BANDED_ROWS, BANDED_WIN
 
 # Element budget for how many blocks one lax.map step may process at once
@@ -338,3 +339,191 @@ def gather_flat(src, idx):
     """One-array device gather: compact readout of ``idx`` positions from a
     resident flat array (indices host-padded; out-of-range clamps)."""
     return src[idx]
+
+
+# --- device-resident cellcc finalize ----------------------------------
+#
+# The host finalize (parallel/cellgraph.py) pulled each chunk's packed
+# combo buffer, ran np.unpackbits/np.flatnonzero over every slot, built
+# the cell-graph edge list, and solved connected components with scipy —
+# 20+ s of host work on the critical path at 3M+ points
+# (`cellcc_pull_core_s`). These two kernels keep all of that on device
+# (the GPU-DBSCAN decomposition move, cf. the CUDA cluster merge of
+# arXiv:1506.02226): `cellcc.unpack` folds each chunk's packed slabs
+# into per-cell partials as the chunk flushes, and `cellcc.cc` runs the
+# cell connected-components union as iterated min-label propagation +
+# pointer jumping (ops/propagation.py window_cc) plus the whole border
+# algebra, emitting ONLY the final valid-prefix-compacted [V] labels.
+# Orchestration (uploads, pull, split, fault degrade to the host
+# oracle) lives in cellgraph.finalize_device / driver.
+
+#: chunk slots per lax.map step of the cc label pass: bounds the
+#: [batch, SCAN_BLOCK, BANDED_WIN] gather/unpack transients to ~100 MB
+#: while keeping enough blocks in flight to fill the VPU.
+_CC_BLOCK_BATCH = 2048
+
+_INT32_INF = 2**31 - 1  # == ops.labels.SEED_NONE: min-identity sentinel
+
+
+@functools.lru_cache(maxsize=64)
+def compiled_cellcc_unpack(n_cells_pad: int):
+    """Build (once per padded cell count) the jitted per-chunk unpack:
+    (combo, cell_flat, fold_flat, or_gid) -> (core [M] bool, cellor
+    [C, 25] bool, cellfold [C] int32), all device-resident.
+
+    combo is the banded_postpass output (packed core bits, then the
+    little-endian bytes of the gathered segmented-OR scan values);
+    cell_flat/fold_flat are the chunk's flat per-slot global cell id /
+    fold index (invalid slots carry the sentinel ``n_cells_pad - 1``);
+    or_gid maps each gathered scan value to its cell (host-padded to the
+    same ladder as the postpass or_idx, padding -> sentinel). The
+    per-cell OR rides a scatter-max of the unpacked scan values — a cell
+    spanning SCAN_BLOCK boundaries has several gather positions, and OR
+    is order-free — and the per-cell min core fold a scatter-min, so the
+    partials merge across chunks elementwise (each cell lives in exactly
+    one chunk; the others contribute identities).
+    """
+    sentinel = jnp.int32(n_cells_pad - 1)
+
+    def unpack(combo, cell_flat, fold_flat, or_gid):
+        m = cell_flat.shape[0]
+        m8 = m // 8
+        # np.unpackbits-compatible big-endian unpack (bit 7 first)
+        shifts = jnp.arange(7, -1, -1, dtype=jnp.int32)
+        core = (
+            ((combo[:m8].astype(jnp.int32)[:, None] >> shifts[None, :]) & 1)
+            .reshape(-1)
+            .astype(bool)
+        )
+        k = or_gid.shape[0]
+        orvals = lax.bitcast_convert_type(
+            combo[m8 : m8 + 4 * k].reshape(k, 4), jnp.int32
+        )
+        win_iota = jnp.arange(BANDED_WIN, dtype=jnp.int32)
+        unp = ((orvals[:, None] >> win_iota[None, :]) & 1).astype(jnp.int32)
+        cellor = (
+            jnp.zeros((n_cells_pad, BANDED_WIN), jnp.int32)
+            .at[or_gid]
+            .max(unp, mode="drop")
+            .astype(bool)
+        )
+        # the padded or_gid positions gather REAL scan values (the pad
+        # index is slot 0) into the sentinel row: clear it, or the
+        # phantom adjacency costs one extra CC sweep whenever the pad
+        # rung crosses a ladder boundary — cellcc.cc_iters must track
+        # the cell graph's diameter, not the padding (it is regress-
+        # gated); labels were already immune (cellfold[sentinel] = INF)
+        cellor = cellor.at[n_cells_pad - 1].set(False)
+        valid = cell_flat != sentinel
+        folds = jnp.where(core & valid, fold_flat, jnp.int32(_INT32_INF))
+        cellfold = (
+            jnp.full((n_cells_pad,), _INT32_INF, jnp.int32)
+            .at[cell_flat]
+            .min(folds, mode="drop")
+        )
+        return core, cellor, cellfold
+
+    return jax.jit(unpack)
+
+
+@functools.lru_cache(maxsize=64)
+def compiled_cellcc_cc(engine: str, out_slots: int):
+    """Build the fused device finalize: cell CC + seeds + border algebra
+    + valid-prefix compaction over ALL chunks, one dispatch.
+
+    Args (per call): wintab [C, 25] int32 (-1 = unoccupied window slot),
+    then per-chunk tuples — cellors/cellfolds (the unpack partials) and
+    cores/bitses/cells/folds (per-slot flat arrays, chunk order). The
+    label algebra is cellgraph.finalize_compact's, verbatim in int32:
+    identical components (window_cc's min-index representative vs
+    scipy's arbitrary numbering never matters — seeds are component-MIN
+    folds, numbering-free), identical border adoption, so labels are
+    byte-identical to the host oracle. Outputs are the valid slots'
+    seeds/flags in row-major prefix order (exactly the host finalize's
+    flat per-group layout, concatenated), padded to the static
+    ``out_slots`` ladder, plus the CC sweep count.
+    """
+    naive = engine == "naive"
+    inf = jnp.int32(_INT32_INF)
+
+    def cc(wintab, cellors, cellfolds, cores, bitses, cells, folds):
+        from dbscan_tpu.ops.propagation import window_cc
+
+        c1 = wintab.shape[0]
+        cellor = cellors[0]
+        cellfold = cellfolds[0]
+        for o in cellors[1:]:
+            cellor = cellor | o
+        for f in cellfolds[1:]:
+            cellfold = jnp.minimum(cellfold, f)
+
+        comp, iters = window_cc(cellor, wintab)
+        # seed per component = min cell fold over member cells; comp is
+        # the component-min cell index, so one scatter-min + one gather
+        rootmin = (
+            jnp.full((c1,), _INT32_INF, jnp.int32).at[comp].min(cellfold)
+        )
+        seed_of_cell = rootmin[comp]
+        # per-(cell, window-slot) seed table for the border algebra:
+        # junk at -1 (unoccupied) slots is masked to the min identity
+        seed_win = jnp.where(
+            wintab >= 0,
+            seed_of_cell[jnp.clip(wintab, 0, c1 - 1)],
+            inf,
+        )
+
+        cell_flat = jnp.concatenate(list(cells))
+        fold_flat = jnp.concatenate(list(folds))
+        bits_flat = jnp.concatenate(list(bitses))
+        core_flat = jnp.concatenate(list(cores))
+        win_iota = jnp.arange(BANDED_WIN, dtype=jnp.int32)
+
+        def label_block(args):
+            cb, fb, bb, kb = args
+            sw = seed_win[cb]  # [T, 25] row gather
+            unp = ((bb[:, None] >> win_iota[None, :]) & 1) != 0
+            nbr = jnp.min(jnp.where(unp, sw, inf), axis=1)
+            # NAIVE adopts a border only when the adopting expansion
+            # precedes the point's own fold visit; ARCHERY adopts
+            # whenever any window bit is set (nbr < inf then: a set bit
+            # means an adjacent core exists, whose cell has a real seed)
+            adopt = nbr < (fb if naive else inf)
+            seeds = jnp.where(kb, seed_of_cell[cb], jnp.where(adopt, nbr, inf))
+            flags = jnp.where(
+                kb,
+                jnp.int8(CORE),
+                jnp.where(adopt, jnp.int8(BORDER), jnp.int8(NOISE)),
+            )
+            return seeds, flags
+
+        nb = cell_flat.shape[0] // SCAN_BLOCK
+        seeds, flags = lax.map(
+            label_block,
+            (
+                cell_flat.reshape(nb, SCAN_BLOCK),
+                fold_flat.reshape(nb, SCAN_BLOCK),
+                bits_flat.reshape(nb, SCAN_BLOCK),
+                core_flat.reshape(nb, SCAN_BLOCK),
+            ),
+            batch_size=min(nb, _CC_BLOCK_BATCH),
+        )
+        # valid-prefix compaction (the "only final labels cross the
+        # link" contract): valid slots are per-row prefixes, so their
+        # running count IS the compact position; invalid slots scatter
+        # out of range and drop
+        valid = cell_flat != jnp.int32(c1 - 1)
+        pos = jnp.cumsum(valid.astype(jnp.int32)) - 1
+        tgt = jnp.where(valid, pos, jnp.int32(out_slots))
+        out_seeds = (
+            jnp.full((out_slots,), _INT32_INF, jnp.int32)
+            .at[tgt]
+            .set(seeds.reshape(-1), mode="drop")
+        )
+        out_flags = (
+            jnp.zeros((out_slots,), jnp.int8)
+            .at[tgt]
+            .set(flags.reshape(-1), mode="drop")
+        )
+        return out_seeds, out_flags, iters
+
+    return jax.jit(cc)

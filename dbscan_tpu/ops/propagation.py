@@ -33,6 +33,7 @@ def min_label_fixed_point(
     init: jnp.ndarray,
     neighbor_min: Callable[[jnp.ndarray], jnp.ndarray],
     pos_of_label: jnp.ndarray | None = None,
+    with_iters: bool = False,
 ) -> jnp.ndarray:
     """Iterate ``labels -> min(labels, neighbor_min(labels), hop)`` to a fixed
     point.
@@ -45,6 +46,9 @@ def min_label_fixed_point(
       position that carries it — for engines whose label values are not array
       positions (the banded engine labels by original fold index while its
       arrays live in cell-sorted order). None means values ARE positions.
+    with_iters: also return the number of neighbor-min sweeps the loop ran
+      (an int32 scalar, data-dependent) — the convergence-depth figure the
+      device cellcc finalize reports as ``cellcc.cc_iters``.
 
     Each step runs one neighbor-min sweep (the expensive part — the
     backends recompute their masked distance tests inside it) followed by
@@ -85,5 +89,39 @@ def min_label_fixed_point(
     # data-derived ("varying") for shard_map, and a constant True init is
     # not; semantically free since body is idempotent at the fixed point.
     state = body((init, jnp.bool_(True), jnp.int32(0)))
-    labels, _, _ = lax.while_loop(cond, body, state)
+    labels, _, iters = lax.while_loop(cond, body, state)
+    if with_iters:
+        return labels, iters
     return labels
+
+
+def window_cc(
+    adj_mask: jnp.ndarray,
+    neighbor_tab: jnp.ndarray,
+) -> tuple:
+    """Connected components of a windowed adjacency table, on device.
+
+    adj_mask: [N, W] bool — row i is adjacent to ``neighbor_tab[i, j]``
+      where ``adj_mask[i, j]`` (the banded engine's per-cell OR of its
+      core rows' 5x5-window bitmasks; callers must supply a SYMMETRIC
+      relation — core-core eps-adjacency is, see ops/banded.py).
+    neighbor_tab: [N, W] int32 neighbor index per window slot (junk at
+      masked-off slots is fine; gathers are clipped, values masked).
+
+    Returns ``(comp [N] int32, iters int32)``: per-row component-minimum
+    row index (the same component sets scipy's connected_components
+    finds on the host — component NUMBERING differs, the min-index
+    representative does not) and the sweep count. This is the shared CC
+    kernel of the device cellcc finalize (cellgraph.finalize_device);
+    streaming micro-batches reuse it through the same driver path.
+    """
+    n = adj_mask.shape[0]
+    none = jnp.int32(SEED_NONE)
+    tab = jnp.clip(neighbor_tab, 0, n - 1)
+
+    def neighbor_min(labels):
+        return jnp.min(jnp.where(adj_mask, labels[tab], none), axis=1)
+
+    return min_label_fixed_point(
+        jnp.arange(n, dtype=jnp.int32), neighbor_min, with_iters=True
+    )

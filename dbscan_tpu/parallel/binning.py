@@ -471,6 +471,7 @@ def bucketize_banded(
     pad_parts_ladder: bool = False,
     resume_prefix: int = 0,
     on_plan=None,
+    on_meta=None,
     shape_floors=None,
 ) -> Tuple[list, int, "CellGraphMeta"]:
     """Pack partitions for the banded engine (dbscan_tpu/ops/banded.py).
@@ -501,7 +502,11 @@ def bucketize_banded(
 
     ``on_group``, when given, is invoked with each finished BucketGroup in
     emission order — the driver uses it to dispatch device work while later
-    groups are still packing.
+    groups are still packing. ``on_meta``, when given, receives the
+    CellGraphMeta BEFORE any group emits (cell numbering completes ahead
+    of packing) — the driver's device cellcc finalize sizes its padded
+    cell tables from it so per-chunk unpack dispatches can ride the
+    packing window; never called on the all-dense early return.
 
     Returns (groups sorted with dense first, max width, CellGraphMeta);
     ``banded`` is set on the banded groups.
@@ -683,6 +688,8 @@ def bucketize_banded(
         rr, cc = np.nonzero(ok)
         wintab[rr, k * 5 + offs[rr, cc]] = idx_c[rr, cc].astype(np.int32)
     meta = CellGraphMeta(wintab, upart.astype(np.int32), u_n)
+    if on_meta is not None:
+        on_meta(meta)
 
     # Banded bucket widths: the dense ladder width padded up to a multiple
     # of the block size.

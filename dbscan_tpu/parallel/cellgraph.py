@@ -137,6 +137,110 @@ def cell_layout(groups: Sequence[BucketGroup]) -> dict:
     }
 
 
+def unpack_combo(
+    combo_host: np.ndarray, layout: dict
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-oracle unpack of one pulled combo buffer: the unpacked core
+    mask and the border-candidate positions (valid non-core slots).
+
+    The ONE implementation shared by the driver's ``_pull_record`` and
+    the tail-flush merge (their inlined copies had drifted in
+    accounting flags) and by the device path's degrade-to-host
+    fallback; ``combo_host[total // 8:]`` still carries the gathered
+    scan bytes the caller views as int32.
+    """
+    total = layout["total"]
+    core = np.unpackbits(combo_host[: total // 8], count=total).astype(bool)
+    bpos = np.flatnonzero(layout["validflat"] & ~core)
+    return core, bpos
+
+
+def or_gid_positions(layout: dict) -> np.ndarray:
+    """Per-GATHER-POSITION cell id for one chunk's OR readout plan:
+    ``layout["or_gid"]`` names the cell per RUN of gather positions
+    (``or_starts`` offsets); the device scatter-OR wants the cell per
+    position. A cell spanning scan blocks repeats — OR is order-free."""
+    n_pos = len(layout["or_pos"])
+    runs = np.diff(np.r_[layout["or_starts"], n_pos])
+    return np.repeat(layout["or_gid"], runs).astype(np.int32)
+
+
+def device_chunk_arrays(
+    groups: Sequence[BucketGroup], sentinel: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Flat per-slot (cell id, fold index) int32 arrays over one chunk's
+    group concat — the device finalize's upload payload. Invalid slots
+    (cell_gid < 0) carry ``sentinel`` (the padded cell table's last
+    row), which doubles as the device-side validity test."""
+    cells = np.concatenate(
+        [g.banded.cell_gid.reshape(-1) for g in groups]
+    )
+    folds = np.concatenate(
+        [g.banded.fold_idx.reshape(-1) for g in groups]
+    ).astype(np.int32)
+    return (
+        np.where(cells < 0, np.int64(sentinel), cells).astype(np.int32),
+        folds,
+    )
+
+
+def finalize_device(
+    dev_chunks: Sequence[dict],
+    wintab_dev,
+    engine: str,
+    out_slots: int,
+):
+    """Dispatch the fused device finalize (ops/banded.py
+    ``compiled_cellcc_cc``) over the staged per-chunk device artifacts:
+    cell CC (iterated min-label propagation + pointer jumping,
+    ops/propagation.py ``window_cc``), component seeds, border algebra,
+    and valid-prefix compaction — one ``cellcc.cc`` dispatch for the
+    whole run, after one ``cellcc.unpack`` per chunk folded the packed
+    slabs into per-cell partials at flush time.
+
+    ``dev_chunks``: per chunk, the dict staged by the driver —
+    ``cellor``/``cellfold`` (unpack partials), ``core`` (unpacked core
+    mask), ``cells``/``folds`` (uploaded flat metadata) and ``bits``
+    (the resident phase-1 bitmasks). Returns the DEVICE handles
+    ``(seeds [out_slots] int32, flags [out_slots] int8, iters)`` — the
+    caller owns the pull (pipelined, supervised) and the per-group
+    split (:func:`split_device_labels`); labels are byte-identical to
+    :func:`finalize_compact` (see PARITY.md "Cellcc finalize").
+    """
+    if engine not in ("naive", "archery"):
+        raise ValueError(f"unknown engine {engine!r}")
+    from dbscan_tpu.obs import compile as obs_compile
+    from dbscan_tpu.ops.banded import compiled_cellcc_cc
+
+    return obs_compile.tracked_call(
+        "cellcc.cc",
+        compiled_cellcc_cc(engine, out_slots),
+        wintab_dev,
+        tuple(c["cellor"] for c in dev_chunks),
+        tuple(c["cellfold"] for c in dev_chunks),
+        tuple(c["core"] for c in dev_chunks),
+        tuple(c["bits"] for c in dev_chunks),
+        tuple(c["cells"] for c in dev_chunks),
+        tuple(c["folds"] for c in dev_chunks),
+    )
+
+
+def split_device_labels(
+    seeds: np.ndarray, flags: np.ndarray, counts: Sequence[int]
+) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Split the pulled compact label arrays back into the host
+    finalize's per-group contract: one flat (seeds [cnt], flags [cnt])
+    pair per group, valid slots in row-major prefix order — the device
+    compaction preserves exactly that order, so this is pure slicing."""
+    bounds = np.cumsum(np.asarray(counts, dtype=np.int64))
+    out: List[Tuple[np.ndarray, np.ndarray]] = []
+    lo = 0
+    for hi in bounds:
+        out.append((seeds[lo:hi], flags[lo:hi]))
+        lo = int(hi)
+    return out
+
+
 def finalize_compact(
     groups: Sequence[BucketGroup],
     layout: dict,

@@ -1713,7 +1713,83 @@ def train_arrays(
     # per-group tables, so none of this waits for packing to finish.
     compact_on = use_banded and not config_mod.env("DBSCAN_NO_COMPACT")
     if compact_on:
-        from dbscan_tpu.ops.banded import banded_postpass, gather_flat
+        from dbscan_tpu.ops.banded import (
+            banded_postpass,
+            compiled_cellcc_unpack,
+            gather_flat,
+        )
+    # Device-resident cellcc finalize (ROADMAP item 3): per-chunk
+    # `cellcc.unpack` folds the packed core/scan slabs into per-cell
+    # partials AS CHUNKS FLUSH (riding the packing window), then ONE
+    # fused `cellcc.cc` dispatch at the tail runs the cell
+    # connected-components union + border algebra on device, so only
+    # the final valid-prefix [V] labels cross the link — the host
+    # unpackbits/flatnonzero/scipy pass (20+ s of cellcc_pull_core_s at
+    # 3M+ points) disappears. Host path stays the parity oracle under
+    # DBSCAN_CELLCC_DEVICE=0, and structurally under checkpoints (saved
+    # chunks ARE the pulled host artifacts), multi-process (pull order
+    # is a collective contract), and DBSCAN_EAGER_PULL (serial-pull
+    # resilience mode). `cpad` (ladder-padded cell count + sentinel
+    # row) lands via bucketize_banded's on_meta callback BEFORE any
+    # chunk flushes.
+    cellcc_dev = {
+        "on": (
+            compact_on
+            and bool(config_mod.env("DBSCAN_CELLCC_DEVICE"))
+            and ckpt_fp is None
+            and not mesh_mod.multiprocess()
+            and not config_mod.env("DBSCAN_EAGER_PULL")
+            # a pull-site fault clause targets the per-chunk pull jobs
+            # (their supervised wrap + ordinal stream): honor it on the
+            # host path rather than silently consuming no pull ordinals
+            and not faults.pull_site_active()
+        ),
+        "cpad": 0,
+        "iters": 0,
+        "slots": 0,  # staged device-finalize slots (HBM residency guard)
+    }
+    # Staged-residency cap: unlike the host path (whose _pull_record
+    # pops each chunk's combo/bits after its pull), the device finalize
+    # keeps every chunk's packed buffers PLUS ~13 B/slot of staged
+    # cells/folds/core/bits resident until the tail CC dispatch. The
+    # cap bounds that at ~13 B * DBSCAN_CELLCC_DEVICE_SLOTS; a run
+    # whose chunks exceed it degrades the finalize to the host oracle
+    # MID-RUN (staged partials are dropped so their HBM frees, and the
+    # already-flushed chunks re-enter the normal pipelined pulls) —
+    # labels identical either way, only the finalize locus moves.
+    _CELLCC_DEVICE_SLOTS = int(config_mod.env("DBSCAN_CELLCC_DEVICE_SLOTS"))
+
+    def _cellcc_degrade_residency():
+        cellcc_dev["on"] = False
+        logger.warning(
+            "device cellcc finalize: staged slots would exceed "
+            "DBSCAN_CELLCC_DEVICE_SLOTS=%d — degrading the finalize to "
+            "the host path (labels unchanged)",
+            _CELLCC_DEVICE_SLOTS,
+        )
+        for r in eager["records"]:
+            r.pop("dev", None)  # free the staged partials' HBM
+            # restore the PR-5 overlap for the chunks already flushed:
+            # they never got a pull job (nor an async copy) in device
+            # mode; serial runs at least start the D2H moving so the
+            # tail's back-to-back _pull_record calls find the combos
+            # already in flight
+            if "combo_dev" not in r or "pull_job" in r:
+                continue
+            if pull_pipe is not None and not eager.get("aborting"):
+                _submit_pull(r)
+            elif not mesh_mod.multiprocess():
+                r["combo_dev"].copy_to_host_async()
+
+    def _on_cellmeta(meta):
+        if meta.n_cells == 0:
+            cellcc_dev["on"] = False
+            return
+        cellcc_dev["cpad"] = binning._ratchet(
+            getattr(cfg, "shape_floors", None),
+            "cellcc_cells",
+            binning._ladder_width(meta.n_cells + 1, 4096),
+        )
     eager = {
         "cur": [],  # pending indices of the open chunk's banded groups
         "cur_slots": 0,
@@ -1798,10 +1874,7 @@ def train_arrays(
         layout = rec["layout"]
         total = layout["total"]
         combo_host = mesh_mod.pull_to_host(rec["combo_dev"])
-        core_ch = np.unpackbits(
-            combo_host[: total // 8], count=total
-        ).astype(bool)
-        bpos = np.flatnonzero(layout["validflat"] & ~core_ch)
+        core_ch, bpos = cellgraph.unpack_combo(combo_host, layout)
         bb_dev = obs_compile.tracked_call(
             "cellcc.gather",
             gather_flat,
@@ -1859,6 +1932,7 @@ def train_arrays(
             if pending[i][1] is None:
                 _redispatch(i)
         layout = cellgraph.cell_layout(rec["groups"])
+        or_idx = _pad_idx(layout["or_pos"])
         combo_dev, bits_flat = obs_compile.tracked_call(
             "cellcc.postpass",
             banded_postpass,
@@ -1868,17 +1942,61 @@ def train_arrays(
                 mesh_mod.replicate_host_array(f)
                 for f in layout["segflags"]
             ),
-            mesh_mod.replicate_host_array(_pad_idx(layout["or_pos"])),
+            mesh_mod.replicate_host_array(or_idx),
         )
-        if not mesh_mod.multiprocess() and pull_pipe is None:
+        if (
+            not mesh_mod.multiprocess()
+            and pull_pipe is None
+            and not cellcc_dev["on"]
+        ):
             # local-shard async copy; cross-host pulls gather instead.
             # Pipelined runs defer this to the job's start hook so the
             # DBSCAN_PULL_INFLIGHT_BYTES budget bounds how many chunks
-            # are host-materialized at once
+            # are host-materialized at once; device-finalize runs never
+            # pull the combo at all unless they degrade
             combo_dev.copy_to_host_async()
         rec["layout"] = layout
         rec["combo_dev"] = combo_dev
         rec["bits_flat"] = bits_flat
+        if cellcc_dev["on"] and (
+            cellcc_dev["slots"] + layout["total"] > _CELLCC_DEVICE_SLOTS
+        ):
+            _cellcc_degrade_residency()
+        if cellcc_dev["on"]:
+            # stage the chunk's device finalize inputs while later
+            # groups still pack: upload the flat cell/fold metadata and
+            # fold the packed slabs into per-cell partials ON DEVICE.
+            # The or-gid vector pads to the SAME ladder as or_idx above
+            # (padding scatters to the sentinel row, discarded); the
+            # combo/bits handles stay in the record untouched, so a
+            # later degrade to the host oracle pulls them as if this
+            # staging never happened.
+            cellcc_dev["slots"] += layout["total"]
+            cpad = cellcc_dev["cpad"]
+            cell_h, fold_h = cellgraph.device_chunk_arrays(
+                rec["groups"], cpad - 1
+            )
+            gid_pos = cellgraph.or_gid_positions(layout)
+            gid_pad = np.full(len(or_idx), cpad - 1, np.int32)
+            gid_pad[: len(gid_pos)] = gid_pos
+            cell_d = mesh_mod.replicate_host_array(cell_h)
+            fold_d = mesh_mod.replicate_host_array(fold_h)
+            core_d, cellor_d, cellfold_d = obs_compile.tracked_call(
+                "cellcc.unpack",
+                compiled_cellcc_unpack(cpad),
+                combo_dev,
+                cell_d,
+                fold_d,
+                mesh_mod.replicate_host_array(gid_pad),
+            )
+            rec["dev"] = {
+                "core": core_d,
+                "cellor": cellor_d,
+                "cellfold": cellfold_d,
+                "cells": cell_d,
+                "folds": fold_d,
+                "bits": bits_flat,
+            }
 
     def _submit_pull(rec):
         """Hand a freshly-flushed chunk's pull + host finalize to the
@@ -1994,7 +2112,13 @@ def train_arrays(
         # jobs and settles serially (_abort_flush), so submits stop once
         # an abort began. With no engine, the serial one-behind pipeline
         # (pull chunk i-1 while chunk i's phase-1 window executes).
-        if (
+        if cellcc_dev["on"]:
+            # device finalize: nothing to pull per chunk — the unpack
+            # partials staged in _run_postpass wait for the tail's one
+            # fused cellcc.cc dispatch, whose [V]-label pull is the
+            # only D2H of the whole finalize
+            pass
+        elif (
             config_mod.env("DBSCAN_EAGER_PULL")
             and not mesh_mod.multiprocess()
         ):
@@ -2233,6 +2357,7 @@ def train_arrays(
                     if (compact_on and checkpoint_dir is not None)
                     else None
                 ),
+                on_meta=_on_cellmeta if cellcc_dev["on"] else None,
                 shape_floors=getattr(cfg, "shape_floors", None),
             )
         else:
@@ -2405,100 +2530,216 @@ def train_arrays(
     # reference's driver-side graph pass (DBSCANGraph.scala:70-87)
     # transplanted to per-partition scale (parallel/cellgraph.py)
     if compact:
-        # Pull any chunks still on the device (the eager pipeline leaves
-        # the last one live), then merge every chunk into ONE flat space
-        # (chunk bases stack in order) so the per-group label algebra
-        # runs once: group-local ``starts`` need no rebase,
-        # ``bases``/``or_starts``/border positions shift by the running
-        # chunk offsets. Checkpoint-loaded chunks re-derive their layout
-        # and border positions from the re-packed groups + saved combo
-        # (both deterministic).
-        tc = time.perf_counter()
-        pull0 = eager["pull_spent"]
-        m_bidx: list = []
-        m_groups: list = []
-        m_starts: list = []
-        m_bases: list = []
-        m_orgid: list = []
-        m_orstarts: list = []
-        core_l, orv_l = [], []
-        bpos_l, bbits_l = [], []
-        base_off = 0
-        or_off = 0
-        for rec in compact:
-            # the last chunk is usually still live here; its pull is
-            # the final place an async device fault can surface with
-            # earlier chunks' artifacts worth banking (a pipelined
-            # worker fault re-raises at this wait — same guard)
-            with _abort_guard():
-                _consume_pull(rec)
-            layout = rec.get("layout")
-            if layout is None:  # checkpoint-loaded chunk
-                layout = cellgraph.cell_layout(rec["groups"])
-            total = layout["total"]
-            combo_host = rec["combo_host"]
-            core_ch = rec.get("core_ch")
-            if core_ch is None:
-                core_ch = np.unpackbits(
-                    combo_host[: total // 8], count=total
-                ).astype(bool)
-            bpos_ch = rec.get("bpos")
-            if bpos_ch is None:
-                bpos_ch = np.flatnonzero(
-                    layout["validflat"] & ~core_ch
+        tfin = time.perf_counter()
+        pull_prior = eager["pull_spent"]
+
+        def _host_finalize():
+            """The host-oracle finalize (and the device path's degrade
+            target): pull any chunks still on the device (the eager
+            pipeline leaves the last one live), then merge every chunk
+            into ONE flat space (chunk bases stack in order) so the
+            per-group label algebra runs once: group-local ``starts``
+            need no rebase, ``bases``/``or_starts``/border positions
+            shift by the running chunk offsets. Checkpoint-loaded
+            chunks re-derive their layout and border positions from the
+            re-packed groups + saved combo (both deterministic)."""
+            tc = time.perf_counter()
+            pull0 = eager["pull_spent"]
+            m_bidx: list = []
+            m_groups: list = []
+            m_starts: list = []
+            m_bases: list = []
+            m_orgid: list = []
+            m_orstarts: list = []
+            core_l, orv_l = [], []
+            bpos_l, bbits_l = [], []
+            base_off = 0
+            or_off = 0
+            for rec in compact:
+                # the last chunk is usually still live here; its pull is
+                # the final place an async device fault can surface with
+                # earlier chunks' artifacts worth banking (a pipelined
+                # worker fault re-raises at this wait — same guard)
+                with _abort_guard():
+                    _consume_pull(rec)
+                layout = rec.get("layout")
+                if layout is None:  # checkpoint-loaded chunk
+                    layout = cellgraph.cell_layout(rec["groups"])
+                total = layout["total"]
+                combo_host = rec["combo_host"]
+                core_ch = rec.get("core_ch")
+                bpos_ch = rec.get("bpos")
+                if core_ch is None or bpos_ch is None:
+                    # checkpoint-loaded chunks re-derive both through
+                    # the SAME helper _pull_record used live
+                    core_ch, bpos_ch = cellgraph.unpack_combo(
+                        combo_host, layout
+                    )
+                orv_l.append(
+                    combo_host[total // 8 :].view("<i4")[
+                        : len(layout["or_pos"])
+                    ]
                 )
-            orv_l.append(
-                combo_host[total // 8 :].view("<i4")[
-                    : len(layout["or_pos"])
-                ]
+                core_l.append(core_ch)
+                bpos_l.append(bpos_ch + base_off)
+                bbits_l.append(rec["bbits"])
+                m_bidx.extend(rec["ch"])
+                m_groups.extend(rec["groups"])
+                m_starts.extend(layout["starts"])
+                m_bases.extend(b + base_off for b in layout["bases"])
+                m_orgid.append(layout["or_gid"])
+                m_orstarts.append(layout["or_starts"] + or_off)
+                base_off += total
+                or_off += len(layout["or_pos"])
+            core_flat = (
+                np.concatenate(core_l) if len(core_l) > 1 else core_l[0]
             )
-            core_l.append(core_ch)
-            bpos_l.append(bpos_ch + base_off)
-            bbits_l.append(rec["bbits"])
-            m_bidx.extend(rec["ch"])
-            m_groups.extend(rec["groups"])
-            m_starts.extend(layout["starts"])
-            m_bases.extend(b + base_off for b in layout["bases"])
-            m_orgid.append(layout["or_gid"])
-            m_orstarts.append(layout["or_starts"] + or_off)
-            base_off += total
-            or_off += len(layout["or_pos"])
-        core_flat = (
-            np.concatenate(core_l) if len(core_l) > 1 else core_l[0]
-        )
-        or_vals = np.concatenate(orv_l) if len(orv_l) > 1 else orv_l[0]
-        border_pos = (
-            np.concatenate(bpos_l) if len(bpos_l) > 1 else bpos_l[0]
-        )
-        m_layout = {
-            "starts": m_starts,
-            "bases": m_bases,
-            "total": base_off,
-            "or_gid": np.concatenate(m_orgid),
-            "or_starts": np.concatenate(m_orstarts),
-        }
-        # pulls that happened before this loop (packing-window + tail
-        # flush, snapshotted as pull0 at loop start) are reported here —
-        # dispatch_s/postdispatch_s excluded them — and the loop's own
-        # wall already contains ITS pulls exactly once
-        timings["cellcc_pull_core_s"] = round(
-            time.perf_counter() - tc + pull0, 6
-        )
-        tc = time.perf_counter()
-        border_bits = (
-            np.concatenate(bbits_l) if len(bbits_l) > 1 else bbits_l[0]
-        )
-        tc = _mark("cellcc_pull_rest_s", tc)
-        finalized = cellgraph.finalize_compact(
-            m_groups, m_layout, cellmeta, cfg.engine.value, core_flat,
-            or_vals, border_pos, border_bits,
-        )
-        _mark("cellcc_host_s", tc)
+            or_vals = np.concatenate(orv_l) if len(orv_l) > 1 else orv_l[0]
+            border_pos = (
+                np.concatenate(bpos_l) if len(bpos_l) > 1 else bpos_l[0]
+            )
+            m_layout = {
+                "starts": m_starts,
+                "bases": m_bases,
+                "total": base_off,
+                "or_gid": np.concatenate(m_orgid),
+                "or_starts": np.concatenate(m_orstarts),
+            }
+            # pulls that happened before this loop (packing-window + tail
+            # flush, snapshotted as pull0 at loop start) are reported here —
+            # dispatch_s/postdispatch_s excluded them — and the loop's own
+            # wall already contains ITS pulls exactly once
+            timings["cellcc_pull_core_s"] = round(
+                time.perf_counter() - tc + pull0, 6
+            )
+            tc = time.perf_counter()
+            border_bits = (
+                np.concatenate(bbits_l) if len(bbits_l) > 1 else bbits_l[0]
+            )
+            tc = _mark("cellcc_pull_rest_s", tc)
+            fin = cellgraph.finalize_compact(
+                m_groups, m_layout, cellmeta, cfg.engine.value, core_flat,
+                or_vals, border_pos, border_bits,
+            )
+            _mark("cellcc_host_s", tc)
+            return m_bidx, fin
+
+        def _device_finalize():
+            """One fused cellcc.cc dispatch over the staged chunks +
+            the [V]-label pull: the whole cell-CC/border finalize stays
+            on device (cellgraph.finalize_device). Idempotent — nothing
+            is mutated before the pull lands — so a supervised retry
+            re-dispatches from intact inputs, and the records' combo/
+            bits handles are untouched for the host degrade path."""
+            tc = time.perf_counter()
+            cpad = cellcc_dev["cpad"]
+            wt = np.full((cpad, binning.BANDED_WIN), -1, np.int32)
+            wt[: cellmeta.n_cells] = cellmeta.wintab
+            wintab_dev = mesh_mod.replicate_host_array(wt)
+            m_bidx: list = []
+            counts: list = []
+            for rec in compact:
+                m_bidx.extend(rec["ch"])
+                for g in rec["groups"]:
+                    counts.append(
+                        int(g.row_counts.sum())
+                        if g.row_counts is not None
+                        else int((g.point_idx >= 0).sum())
+                    )
+            out_slots = binning._ratchet(
+                getattr(cfg, "shape_floors", None),
+                "cellcc_out",
+                binning._ladder_width(max(1, sum(counts)), 4096),
+            )
+            seeds_dev, flags_dev, iters_dev = cellgraph.finalize_device(
+                [rec["dev"] for rec in compact],
+                wintab_dev,
+                cfg.engine.value,
+                out_slots,
+            )
+
+            def _pull_labels():
+                return (
+                    mesh_mod.pull_to_host(seeds_dev),
+                    mesh_mod.pull_to_host(flags_dev),
+                    mesh_mod.pull_to_host(iters_dev),
+                )
+
+            if pull_pipe is not None and not eager.get("aborting"):
+                # the thin label pull rides the PR-5 engine: D2H streams
+                # on the worker (stall telemetry included) while the
+                # host stages the split below
+                job = pull_pipe.submit(
+                    _pull_labels,
+                    on_start=getattr(
+                        seeds_dev, "copy_to_host_async", None
+                    ),
+                    bytes_hint=5 * out_slots,
+                    label="cellcc_labels",
+                )
+                seeds_h, flags_h, iters_h = pull_pipe.settle(
+                    job, _pull_labels
+                )
+            else:
+                seeds_h, flags_h, iters_h = _pull_labels()
+            timings["cellcc_pull_core_s"] = round(
+                time.perf_counter() - tc + pull_prior, 6
+            )
+            tc = time.perf_counter()
+            iters = int(np.asarray(iters_h))
+            cellcc_dev["iters"] = iters
+            obs.count("cellcc.cc_iters", iters)
+            fin = cellgraph.split_device_labels(seeds_h, flags_h, counts)
+            timings["cellcc_host_s"] = round(time.perf_counter() - tc, 6)
+            return m_bidx, fin
+
+        def _drop_staged():
+            # free the staged per-cell partials/metadata (~13 B/slot):
+            # on the degrade path BEFORE the host oracle dispatches —
+            # they are the very allocations a RESOURCE_EXHAUSTED fault
+            # implicates (the mid-run residency degrade already does
+            # this) — and on success before the merge phases run
+            for r in compact:
+                r.pop("dev", None)
+
+        def _host_fallback():
+            _drop_staged()
+            return _host_finalize()
+
+        if cellcc_dev["on"] and all("dev" in r for r in compact):
+            # supervised like any dispatch: transient faults retry the
+            # fused CC, exhaustion degrades the WHOLE finalize to the
+            # host oracle with labels intact (the records still hold
+            # their combo/bits device handles)
+            with _abort_guard():
+                m_bidx, finalized = faults.supervised(
+                    faults.SITE_CELLCC,
+                    lambda _b: _device_finalize(),
+                    fallback=_host_fallback,
+                    label="device cellcc finalize",
+                )
+            _drop_staged()
+        else:
+            m_bidx, finalized = _host_finalize()
         for i, (seeds_np, flags_np) in zip(m_bidx, finalized):
             g = pending[i][0]
             pending[i] = (
                 g, (seeds_np, flags_np, int((flags_np == CORE).sum()))
             )
+        # whole-finalize wall, both modes: this block's window plus the
+        # chunk pulls charged to pull_spent before it (they were part
+        # of the finalize work, just overlapped with dispatch)
+        timings["cellcc_finalize_s"] = round(
+            time.perf_counter() - tfin + pull_prior, 6
+        )
+        obs.add_span(
+            "cellcc.finalize",
+            tfin,
+            time.perf_counter(),
+            mode="device" if cellcc_dev["iters"] else "host",
+            cc_iters=int(cellcc_dev["iters"]),
+            pull_prior_s=round(pull_prior, 6),
+        )
     elif cellmeta is not None:
         b_idx = [i for i, (g, _) in enumerate(pending) if g.banded is not None]
         if b_idx:  # DBSCAN_NO_COMPACT=1 debug runs only: full [P, B]
@@ -2615,6 +2856,11 @@ def train_arrays(
         # level-synchronous device-tree rounds (0: host recursion or no
         # spill) — bench stamps this next to spill_partition_s
         "spill_levels": int(spill_info.get("levels", 0)),
+        # device cellcc-finalize CC sweeps (0: host-oracle finalize ran,
+        # whether by knob, structural exclusion, or fault degrade) —
+        # bench stamps this next to cellcc_finalize_s so the history
+        # gate catches propagation-count blowups, not just walls
+        "cellcc_cc_iters": int(cellcc_dev["iters"]),
         "faults": fault_stats,
     }
 

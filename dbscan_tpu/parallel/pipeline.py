@@ -11,6 +11,17 @@ et al., arXiv:1912.06255; Prokopenko et al., arXiv:2103.05162); this is
 the transfer-stage counterpart of the driver's existing pack/compute
 overlap.
 
+With the PR-10 device-resident cellcc finalize
+(``DBSCAN_CELLCC_DEVICE``, parallel/cellgraph.py ``finalize_device``)
+the banded jobs shrink again: the per-chunk pull+unpack work this
+engine used to hide moves onto the device entirely, and the one job
+the finalize still submits is a THIN LABEL PULL — the fused CC
+dispatch's compact ``[V]`` seeds/flags, ~5 bytes per instance instead
+of per-slot slabs plus host algebra. The engine's role there is the
+stall telemetry + fault-composition path, and full-depth pipelining
+remains live for the host-oracle modes (checkpointed, multi-process,
+``DBSCAN_CELLCC_DEVICE=0``) and the group/sparse/streaming families.
+
 Shape: a bounded-depth producer/consumer pipeline with ONE background
 worker.
 

@@ -1,0 +1,398 @@
+"""Device-resident cellcc finalize (parallel/cellgraph.py
+``finalize_device`` + ops/banded.py ``compiled_cellcc_unpack`` /
+``compiled_cellcc_cc`` + ops/propagation.py ``window_cc``).
+
+The parity contract is EXACT: the device finalize must produce
+byte-identical labels AND flags to the host oracle
+(``DBSCAN_CELLCC_DEVICE=0``) — not just ARI 1.0. That is a real
+contract, not luck: seeds are component-MINIMUM fold indices, so the
+CC algorithm's component NUMBERING (scipy's arbitrary ids vs the
+device's min-index representatives) never reaches a label, and every
+other step is the same int32 algebra (PARITY.md "Cellcc finalize").
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import Engine, train
+
+pytestmark = pytest.mark.cellcc
+
+
+def _blobs(rng, scale=1):
+    return np.concatenate(
+        [rng.normal(c, 0.6, (1500 * scale, 2)) for c in [(0, 0), (6, 6), (-5, 7)]]
+        + [rng.uniform(-10, 12, (500 * scale, 2))]
+    )
+
+
+def _kw(engine=Engine.ARCHERY, maxpp=700):
+    return dict(
+        eps=0.3, min_points=8, max_points_per_partition=maxpp,
+        engine=engine, neighbor_backend="banded",
+    )
+
+
+def _toggle(monkeypatch, pts, kw):
+    """(host model, device model) for one dataset/config pair."""
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "0")
+    m_host = train(pts, **kw)
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    m_dev = train(pts, **kw)
+    return m_host, m_dev
+
+
+@pytest.mark.parametrize("engine", [Engine.NAIVE, Engine.ARCHERY])
+def test_device_vs_host_banded_exact(engine, rng, monkeypatch):
+    """Tentpole parity pin, both border semantics: multi-partition
+    banded run, byte-identical labels/flags, and the device run really
+    took the device path (cc sweeps >= 1) while the host run did not."""
+    pts = _blobs(rng)
+    m_host, m_dev = _toggle(monkeypatch, pts, _kw(engine))
+    assert m_host.stats["n_partitions"] > 4
+    assert m_dev.stats["cellcc_cc_iters"] >= 1
+    assert m_host.stats["cellcc_cc_iters"] == 0
+    np.testing.assert_array_equal(m_host.clusters, m_dev.clusters)
+    np.testing.assert_array_equal(m_host.flags, m_dev.flags)
+    # the whole-finalize wall is stamped on both modes (the bench key)
+    for m in (m_host, m_dev):
+        assert m.stats["timings"]["cellcc_finalize_s"] >= 0
+
+
+def test_device_vs_host_haversine(rng, monkeypatch):
+    """The spherical-chord banded payload (3-D points, projected grid)
+    goes through the same finalize: exact parity, device path taken."""
+    lat = np.concatenate([rng.normal(45.0, 0.01, 1200) for _ in range(3)])
+    lon = np.concatenate(
+        [rng.normal(c, 0.015, 1200) for c in (-74.0, -73.8, -73.6)]
+    )
+    pts = np.stack([lat, lon], axis=1)
+    kw = dict(
+        eps=0.5, min_points=6, max_points_per_partition=1500,
+        metric="haversine", neighbor_backend="banded",
+    )
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "0")
+    m_host = train(pts, **kw)
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    m_dev = train(pts, **kw)
+    assert m_dev.stats["cellcc_cc_iters"] >= 1, (
+        "banded route not taken — widen the geometry margins"
+    )
+    np.testing.assert_array_equal(m_host.clusters, m_dev.clusters)
+    np.testing.assert_array_equal(m_host.flags, m_dev.flags)
+
+
+def test_dense_and_sparse_paths_unaffected(rng, monkeypatch):
+    """Engines with no banded finalize must be bit-for-bit unaffected
+    by the knob: the dense backend and the sparse-cosine front-end."""
+    pts = _blobs(rng)[:2000]
+    kw = dict(eps=0.3, min_points=8, max_points_per_partition=700,
+              neighbor_backend="dense")
+    m_host, m_dev = _toggle(monkeypatch, pts, kw)
+    assert m_dev.stats["cellcc_cc_iters"] == 0
+    np.testing.assert_array_equal(m_host.clusters, m_dev.clusters)
+    np.testing.assert_array_equal(m_host.flags, m_dev.flags)
+
+    sp = pytest.importorskip("scipy.sparse")
+    from dbscan_tpu.ops.sparse import sparse_cosine_dbscan
+
+    srng = np.random.default_rng(7)
+    k, per, vocab, nnz = 20, 50, 3000, 16
+    feat = srng.integers(0, vocab, size=(k, nnz))
+    val = srng.random((k, nnz)) + 0.1
+    blob_of = np.repeat(np.arange(k), per)
+    rows = np.repeat(np.arange(k * per), nnz)
+    cols = feat[blob_of].ravel()
+    vals = (val[blob_of] * srng.uniform(0.9, 1.1, (k * per, nnz))).ravel()
+    x = sp.coo_matrix((vals, (rows, cols)), shape=(k * per, vocab)).tocsr()
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "0")
+    c0, f0 = sparse_cosine_dbscan(x, max_points_per_partition=256,
+                                  eps=0.05, min_points=5)
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    c1, f1 = sparse_cosine_dbscan(x, max_points_per_partition=256,
+                                  eps=0.05, min_points=5)
+    np.testing.assert_array_equal(c0, c1)
+    np.testing.assert_array_equal(f0, f1)
+
+
+def test_streaming_parity_and_steady_state(monkeypatch):
+    """Streaming micro-batches: per-update ids identical across the
+    toggle, and the cellcc shapes ratchet — steady-state updates mint
+    ZERO new cellcc compiles (the shape_floors contract extended to
+    cpad / out_slots / the or-gid pad)."""
+    from dbscan_tpu import obs
+    from dbscan_tpu.config import DBSCANConfig
+    from dbscan_tpu.streaming import StreamingDBSCAN
+
+    def run(dev):
+        monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", dev)
+        rng = np.random.default_rng(5)
+        cfg = DBSCANConfig(
+            eps=0.3, min_points=6, max_points_per_partition=10**9,
+            neighbor_backend="banded", static_partition_pad=True,
+        )
+        s = StreamingDBSCAN(eps=0.3, min_points=6, config=cfg)
+        outs = []
+        for i in range(4):
+            b = np.concatenate(
+                [rng.normal(c, 0.4, (900 + 40 * i, 2)) for c in [(0, 0), (5, 5)]]
+            )
+            outs.append(np.asarray(s.update(b).clusters).copy())
+        return outs
+
+    o_host = run("0")
+    o_dev = run("1")
+    for a, b in zip(o_host, o_dev):
+        np.testing.assert_array_equal(a, b)
+
+    obs.enable()
+    try:
+        monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+        rng = np.random.default_rng(9)
+        cfg = DBSCANConfig(
+            eps=0.3, min_points=6, max_points_per_partition=10**9,
+            neighbor_backend="banded", static_partition_pad=True,
+        )
+        s = StreamingDBSCAN(eps=0.3, min_points=6, config=cfg)
+        snap = None
+        for i in range(5):
+            b = np.concatenate(
+                [rng.normal(c, 0.4, (900 + 40 * i, 2)) for c in [(0, 0), (5, 5)]]
+            )
+            if i == 3:
+                snap = obs.counters()
+            s.update(b)
+        delta = obs.counters_delta(snap)
+        assert delta.get("compiles.cellcc.unpack", 0) == 0, delta
+        assert delta.get("compiles.cellcc.cc", 0) == 0, delta
+        assert delta.get("cellcc.cc_iters", 0) >= 1  # path stayed live
+    finally:
+        obs.disable()
+
+
+def test_fault_transient_heals(rng, monkeypatch):
+    """cellcc_cc#0:TRANSIENT: the supervised retry re-dispatches the
+    fused CC from intact inputs and the run heals with device labels."""
+    pts = _blobs(rng)
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    m_ref = train(pts, **_kw())
+    assert m_ref.stats["cellcc_cc_iters"] >= 1
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "cellcc_cc#0:TRANSIENT")
+    m_t = train(pts, **_kw())
+    assert m_t.stats["faults"]["injected"] >= 1
+    assert m_t.stats["faults"]["retries"] >= 1
+    assert m_t.stats["cellcc_cc_iters"] >= 1  # healed ON the device
+    np.testing.assert_array_equal(m_t.clusters, m_ref.clusters)
+    np.testing.assert_array_equal(m_t.flags, m_ref.flags)
+
+
+def test_fault_persistent_degrades_to_host(rng, monkeypatch):
+    """cellcc_cc#0:PERSISTENT: the WHOLE finalize degrades to the host
+    oracle (the records' combo/bits handles were never consumed) with
+    labels intact — the acceptance shape of the fault surface."""
+    pts = _blobs(rng)
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    m_ref = train(pts, **_kw())
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "cellcc_cc#0:PERSISTENT")
+    m_p = train(pts, **_kw())
+    assert m_p.stats["faults"]["fallbacks"] >= 1
+    assert m_p.stats["cellcc_cc_iters"] == 0  # host oracle produced them
+    np.testing.assert_array_equal(m_p.clusters, m_ref.clusters)
+    np.testing.assert_array_equal(m_p.flags, m_ref.flags)
+
+
+def test_zero_retrace_and_thin_pull(rng, monkeypatch):
+    """Compile pin: a second same-shaped train mints ZERO new cellcc
+    kernels (shapes are ratcheted/laddered), runs ZERO per-chunk combo
+    pulls (the finalize's only D2H is the thin label pull), and the
+    cc_iters counter delta equals the stats figure."""
+    from dbscan_tpu import obs
+
+    pts = _blobs(rng)
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    obs.enable()
+    try:
+        train(pts, **_kw())  # warm: compiles the cellcc rungs
+        snap = obs.counters()
+        m = train(pts, **_kw())
+        delta = obs.counters_delta(snap)
+        assert delta.get("compiles.cellcc.unpack", 0) == 0, delta
+        assert delta.get("compiles.cellcc.cc", 0) == 0, delta
+        assert delta.get("checkpoint.chunk_pulls", 0) == 0, (
+            "device finalize must not pull per-chunk combo buffers"
+        )
+        assert delta.get("cellcc.cc_iters", 0) == m.stats["cellcc_cc_iters"]
+    finally:
+        obs.disable()
+
+
+def test_multi_chunk_fused_cc(rng, monkeypatch):
+    """Several compact chunks feed ONE fused cc dispatch: shrink the
+    chunk budget so the run flushes >= 2 chunks, then pin exact parity
+    (cells never cross chunks, partials merge elementwise)."""
+    from dbscan_tpu import obs
+    from dbscan_tpu.parallel import driver
+
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 1 << 12)
+    pts = _blobs(rng)
+    obs.enable()
+    try:
+        monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "0")
+        m_host = train(pts, **_kw())
+        snap = obs.counters()
+        monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+        m_dev = train(pts, **_kw())
+        delta = obs.counters_delta(snap)
+        assert delta.get("checkpoint.chunk_flushes", 0) >= 2, delta
+        assert delta.get("compiles.cellcc.cc", 0) >= 1
+    finally:
+        obs.disable()
+    assert m_dev.stats["cellcc_cc_iters"] >= 1
+    np.testing.assert_array_equal(m_host.clusters, m_dev.clusters)
+    np.testing.assert_array_equal(m_host.flags, m_dev.flags)
+
+
+def test_cc_iters_independent_of_chunking(rng, monkeypatch):
+    """cellcc.cc_iters is a property of the merged cell graph, not of
+    the chunk/padding layout: the same data at different chunk budgets
+    (different or-gather pads, different partial counts) must converge
+    in the SAME sweep count — the regress gate trends graph diameter,
+    and a padding-dependent count (the sentinel-row phantom-adjacency
+    bug) would false-flag across ladder boundaries."""
+    from dbscan_tpu.parallel import driver
+
+    pts = _blobs(rng)
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    m_one = train(pts, **_kw())
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 1 << 12)
+    m_many = train(pts, **_kw())
+    assert m_one.stats["cellcc_cc_iters"] >= 1
+    assert (
+        m_many.stats["cellcc_cc_iters"] == m_one.stats["cellcc_cc_iters"]
+    )
+    np.testing.assert_array_equal(m_one.clusters, m_many.clusters)
+
+
+def test_residency_budget_degrades_to_host_midrun(rng, monkeypatch):
+    """DBSCAN_CELLCC_DEVICE_SLOTS: a run whose chunks exceed the staged
+    budget degrades the finalize to the host oracle MID-RUN — the
+    staged partials are dropped, already-flushed chunks re-enter the
+    pipelined pulls, and labels stay identical (the review finding:
+    device mode must not pin unbounded chunk metadata on HBM)."""
+    from dbscan_tpu import obs
+    from dbscan_tpu.parallel import driver
+
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 1 << 12)
+    pts = _blobs(rng)
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    m_ref = train(pts, **_kw())
+    assert m_ref.stats["cellcc_cc_iters"] >= 1
+    # budget below one chunk: the first flush already overflows
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE_SLOTS", "1024")
+    obs.enable()
+    try:
+        snap = obs.counters()
+        m_cap = train(pts, **_kw())
+        delta = obs.counters_delta(snap)
+    finally:
+        obs.disable()
+    assert m_cap.stats["cellcc_cc_iters"] == 0  # host oracle finished it
+    assert delta.get("checkpoint.chunk_pulls", 0) >= 2  # pulls resumed
+    np.testing.assert_array_equal(m_cap.clusters, m_ref.clusters)
+    np.testing.assert_array_equal(m_cap.flags, m_ref.flags)
+
+
+def test_unpack_combo_shared_helper():
+    """The one host unpack implementation (driver._pull_record, the
+    tail merge, and the degrade path all route here): packed bits +
+    validity mask -> (core bools, border-candidate positions), exactly
+    np.unpackbits/np.flatnonzero semantics."""
+    from dbscan_tpu.parallel import cellgraph
+
+    rng = np.random.default_rng(3)
+    total = 1024
+    core = rng.random(total) < 0.4
+    valid = rng.random(total) < 0.8
+    combo = np.concatenate(
+        [np.packbits(core), np.arange(12, dtype=np.uint8)]  # scan tail
+    )
+    layout = {"total": total, "validflat": valid}
+    got_core, got_bpos = cellgraph.unpack_combo(combo, layout)
+    np.testing.assert_array_equal(got_core, core)
+    np.testing.assert_array_equal(got_bpos, np.flatnonzero(valid & ~core))
+
+
+def test_or_gid_positions_repeats_runs():
+    """Per-position cell ids expand the run-compressed readout plan: a
+    cell spanning scan blocks repeats once per gather position."""
+    from dbscan_tpu.parallel import cellgraph
+
+    layout = {
+        "or_pos": np.arange(6),
+        "or_starts": np.array([0, 1, 4]),
+        "or_gid": np.array([7, 3, 9]),
+    }
+    np.testing.assert_array_equal(
+        cellgraph.or_gid_positions(layout),
+        np.array([7, 3, 3, 3, 9, 9], dtype=np.int32),
+    )
+
+
+def test_registration_pins():
+    """Cross-module contracts: the fault site, the compile families,
+    the declared telemetry, and the lint models all name the new path."""
+    from dbscan_tpu import faults
+    from dbscan_tpu.lint.shapes import FAMILY_MODELS, TUPLE_COUPLED
+    from dbscan_tpu.obs import schema
+
+    assert faults.SITE_CELLCC in faults._SITES
+    (clause,) = faults.parse_fault_spec("cellcc_cc#1:TRANSIENT*2")
+    assert clause.site == "cellcc_cc"
+    assert clause.ordinal == 1 and clause.count == 2
+    assert "cellcc.unpack" in schema.COMPILE_FAMILIES
+    assert "cellcc.cc" in schema.COMPILE_FAMILIES
+    assert schema.is_declared("counter", "cellcc.cc_iters")
+    assert schema.is_declared("span", "cellcc.finalize")
+    # devtime coverage rides the family registry
+    assert schema.is_declared("span", "devtime.cellcc.cc")
+    assert "cellcc.unpack" in FAMILY_MODELS
+    assert "cellcc.cc" in FAMILY_MODELS
+    assert ("cores", "bitses") in TUPLE_COUPLED["cellcc.cc"]
+
+
+def test_shapecheck_subprocess_clean(tmp_path):
+    """DBSCAN_SHAPECHECK=1 rerun of a banded device-finalize train in a
+    fresh process: the atexit JSON report must be violation-free with
+    both cellcc families covered (the runtime model cross-check)."""
+    report = tmp_path / "shapecheck.json"
+    code = (
+        "import numpy as np\n"
+        "from dbscan_tpu import train\n"
+        "rng = np.random.default_rng(1)\n"
+        "pts = np.concatenate([rng.normal(c, 0.6, (1200, 2))"
+        " for c in [(0, 0), (6, 6)]])\n"
+        "m = train(pts, eps=0.3, min_points=8,"
+        " max_points_per_partition=700, neighbor_backend='banded')\n"
+        "assert m.stats['cellcc_cc_iters'] >= 1, m.stats\n"
+    )
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        DBSCAN_CELLCC_DEVICE="1",
+        DBSCAN_SHAPECHECK="1",
+        DBSCAN_SHAPECHECK_REPORT=str(report),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env, capture_output=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr.decode(errors="replace")[-2000:]
+    rep = json.loads(report.read_text())
+    assert rep["violations"] == []
+    assert "cellcc.unpack" in rep["sites"]
+    assert "cellcc.cc" in rep["sites"]

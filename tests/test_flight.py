@@ -831,6 +831,33 @@ def test_cli_metrics_summary_includes_gauges(monkeypatch, tmp_path, capsys):
     assert "flight recorder: on" in out
 
 
+def test_cli_metrics_summary_pins_cellcc_counters(monkeypatch, tmp_path,
+                                                  capsys):
+    """The PR-10 extension of the summary regression: a banded run
+    (--neighbor-backend banded forces the route at any size) must
+    surface the device cellcc finalize's convergence counter in the
+    counters block next to the gauges the base test pins (the compile
+    counters only appear on cache-cold processes, so the always-emitted
+    cc_iters is the pinned name)."""
+    from dbscan_tpu import cli
+
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    rc = cli.main(
+        [
+            "--input", _write_csv(tmp_path),
+            "--eps", "0.5", "--min-points", "5",
+            "--max-points-per-partition", "256",
+            "--neighbor-backend", "banded",
+            "--metrics-summary",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "== metrics summary ==" in out
+    assert "cellcc.cc_iters" in out
+    assert "gauges:" in out
+
+
 def test_cli_trace_plus_summary_gauges_in_both(monkeypatch, tmp_path, capsys):
     """--trace + --metrics-summary together: the summary carries the
     gauges AND the flushed trace file carries them on the counter

@@ -459,11 +459,20 @@ def test_distributed_and_streaming_under_shapecheck_env():
         from dbscan_tpu import train
         from dbscan_tpu.streaming import StreamingDBSCAN
 
+        import os
+
         rng = np.random.default_rng(0)
         pts = rng.normal(size=(5000, 2)) * 10
         train(pts, eps=0.5, min_points=5, max_points_per_partition=400)
         train(pts, eps=0.5, min_points=5,
               max_points_per_partition=1500, neighbor_backend="banded")
+        # host-oracle finalize: covers the cellcc.gather border readout
+        # the device path (the default, covered above as cellcc.unpack/
+        # cellcc.cc) replaces
+        os.environ["DBSCAN_CELLCC_DEVICE"] = "0"
+        train(pts, eps=0.5, min_points=5,
+              max_points_per_partition=1500, neighbor_backend="banded")
+        del os.environ["DBSCAN_CELLCC_DEVICE"]
         s = StreamingDBSCAN(eps=0.5, min_points=5, window=3000)
         for _ in range(3):
             s.update(rng.normal(size=(1200, 2)) * 10)
@@ -491,9 +500,11 @@ def test_distributed_and_streaming_under_shapecheck_env():
         assert rep["enabled"] is True
         assert rep["violations"] == [], rep["violations"]
         assert rep["checks"] > 0
-        # the run exercised both engines' dispatch sites
+        # the run exercised both engines' dispatch sites, the device
+        # cellcc finalize (the default), and the host oracle's gather
         for fam in ("dispatch.dense", "dispatch.banded_p1",
-                    "cellcc.postpass", "cellcc.gather"):
+                    "cellcc.postpass", "cellcc.gather",
+                    "cellcc.unpack", "cellcc.cc"):
             assert fam in rep["sites"], sorted(rep["sites"])
             assert rep["sites"][fam]["violations"] == 0
     finally:
