@@ -513,34 +513,26 @@ def child_m100(ckpt_dir: str, out_path: str) -> None:
     os.replace(tmp, out_path)
 
 
-def _chunks_written_since(ckpt_dir: str, since: float) -> int:
-    """How many p1chunk files were (re)written at-or-after ``since``
-    (an epoch timestamp) — the leg-progress signal for the retry loop."""
-    fresh = 0
-    try:
-        names = os.listdir(ckpt_dir)
-    except OSError:
-        return 0
-    for name in names:
-        if name.startswith("p1chunk") and name.endswith(".npz"):
-            try:
-                if os.path.getmtime(os.path.join(ckpt_dir, name)) >= since:
-                    fresh += 1
-            except OSError:
-                pass
-    return fresh
-
-
 def m100_row(prefix: str = "m100") -> dict:
-    """The 100M campaign as a HARNESS row (VERDICT r4 item 1): a bounded
-    retry-resume loop around child_m100 legs — one fresh subprocess per
-    leg so a dead TPU backend can never wedge the capture — banking
-    phase-1 chunk checkpoints across legs and reporting partial progress
-    (chunks_done/chunks_total from the driver's plan-derived
+    """The 100M campaign as a HARNESS row (VERDICT r4 item 1), riding
+    the elastic campaign driver (dbscan_tpu/campaign.py::run_frontier):
+    a bounded lease loop around child_m100 subprocess legs — one fresh
+    process per leg so a dead TPU backend can never wedge the capture —
+    banking phase-1 chunk checkpoints across legs and reporting partial
+    progress (chunks_done/chunks_total from the driver's plan-derived
     progress.json) even when every leg dies at the tunneled worker's
-    ~4-25-min endurance limit. Runs LAST so a worker death cannot take
-    the other rows with it. Knobs: BENCH_100M_{N,MAXPP,CKPT,LEGS,
-    BUDGET_S,LEG_TIMEOUT_S,REST_S}."""
+    ~4-25-min endurance limit. The campaign driver supplies the
+    measured-honesty rules this row always had (stall breakout — now on
+    the sidecar's monotone chunk-write counter with mtime as fallback —
+    budget-capped leg timeouts, campaign-key invalidation hoisted into
+    campaign.ensure_campaign_key) plus the priced replay budget:
+    ``{prefix}_campaign_replay_frac`` (= replayed wall / total work
+    wall, pro-rata over unbanked chunks) is stamped on the row,
+    promoted by obs/bench_history, and gated regress-up by obs/regress.
+    Runs LAST so a worker death cannot take the other rows with it.
+    Knobs: BENCH_100M_{N,MAXPP,CKPT,LEGS,BUDGET_S,LEG_TIMEOUT_S,
+    REST_S}."""
+    from dbscan_tpu import campaign as campaign_mod
     from dbscan_tpu.parallel import checkpoint as ckpt_mod
 
     ckpt_dir = os.environ.get("BENCH_100M_CKPT", "/tmp/ckpt100m")
@@ -566,98 +558,52 @@ def m100_row(prefix: str = "m100") -> dict:
     env.setdefault("DBSCAN_EAGER_PULL", "1")
     env.setdefault("DBSCAN_COMPACT_CHUNK_SLOTS", "4194304")
     env.setdefault("DBSCAN_GROUP_SLOTS", "4194304")
-    # a config change (N, maxpp, chunk/group slots) makes every banked
-    # chunk unloadable (fingerprint/budget mismatch at load) but NOT
-    # invisible: stale files would inflate chunks_done and mask real
-    # progress from the stall detector. The campaign key captures every
-    # knob the fingerprint depends on (the anchor data is seed-
-    # deterministic), so a mismatch wipes the dir clean.
-    campaign_key = {
-        "n": int(os.environ.get("BENCH_100M_N", "100000000")),
-        "maxpp": int(os.environ.get("BENCH_100M_MAXPP", "262144")),
-        "chunk_slots": env["DBSCAN_COMPACT_CHUNK_SLOTS"],
-        "group_slots": env["DBSCAN_GROUP_SLOTS"],
-    }
-    key_path = os.path.join(ckpt_dir, "campaign.json")
-    try:
-        with open(key_path) as f:
-            prior_key = json.load(f)
-    except (OSError, ValueError):
-        prior_key = None
-    if prior_key != campaign_key:
-        if prior_key is not None:
-            ckpt_mod.invalidate_p1_chunk(ckpt_dir, 0)
-            for stale in ("progress.json", "premerge.npz", "manifest.json"):
-                try:
-                    os.unlink(os.path.join(ckpt_dir, stale))
-                except OSError:
-                    pass
-        with open(key_path, "w") as f:
-            json.dump(campaign_key, f)
-    t0 = time.monotonic()
+    campaign_mod.ensure_campaign_key(
+        ckpt_dir,
+        {
+            "n": int(os.environ.get("BENCH_100M_N", "100000000")),
+            "maxpp": int(os.environ.get("BENCH_100M_MAXPP", "262144")),
+            "chunk_slots": env["DBSCAN_COMPACT_CHUNK_SLOTS"],
+            "group_slots": env["DBSCAN_GROUP_SLOTS"],
+        },
+    )
     # chunks already banked by PRIOR campaigns: when > 0, this
     # campaign's wall covers only the tail of the work, so no
     # throughput figure can honestly be derived from it
     prior_chunks = ckpt_mod.count_p1_chunks(ckpt_dir)
-    legs = 0
+    fr = campaign_mod.run_frontier(
+        ckpt_dir,
+        [
+            sys.executable,
+            os.path.abspath(__file__),
+            "--m100-child",
+            ckpt_dir,
+            out_path,
+        ],
+        env=env,
+        max_leases=max_legs,
+        budget_s=budget,
+        leg_timeout_s=leg_timeout,
+        rest_s=rest,
+        success_path=out_path,
+    )
     result = None
-    last_err = ""
-    stall = 0
-    while legs < max_legs:
-        remaining = budget - (time.monotonic() - t0)
-        if legs and remaining <= 0:
-            break
-        leg_start = time.time()
-        legs += 1
-        try:
-            proc = subprocess.run(
-                [
-                    sys.executable,
-                    os.path.abspath(__file__),
-                    "--m100-child",
-                    ckpt_dir,
-                    out_path,
-                ],
-                env=env,
-                stdout=subprocess.DEVNULL,
-                stderr=subprocess.PIPE,
-                # honor the campaign budget even against a WEDGED (not
-                # crashed) worker: a leg never outlives the remaining
-                # budget by more than the floor that lets it reach its
-                # first restart points (~10 min incl. datagen + re-pack)
-                timeout=min(leg_timeout, max(remaining, 600.0)),
-            )
-            if proc.returncode == 0 and os.path.exists(out_path):
-                with np.load(out_path) as z:
-                    result = {k: z[k].item() for k in z.files}
-                break
-            tail = proc.stderr.decode(errors="replace")[-300:]
-            last_err = f"rc {proc.returncode}: {tail}".strip()
-        except subprocess.TimeoutExpired:
-            last_err = "leg timeout"
-        # two consecutive legs with zero new restart points means the
-        # worker is killing us before any progress — stop burning budget.
-        # Progress = a chunk file WRITTEN during this leg (mtime-based:
-        # resumed legs overwrite indices in place, so a bare count
-        # cannot see progress past stale higher-index files)
-        if not _chunks_written_since(ckpt_dir, leg_start):
-            stall += 1
-            if stall >= 2:
-                break
-        else:
-            stall = 0
-        if legs < max_legs:
-            time.sleep(rest)
-    chunks_done = ckpt_mod.count_p1_chunks(ckpt_dir)
-    progress = ckpt_mod.read_progress(ckpt_dir)
+    if fr.complete and os.path.exists(out_path):
+        with np.load(out_path) as z:
+            result = {k: z[k].item() for k in z.files}
     out = {
         f"{prefix}_n": int(os.environ.get("BENCH_100M_N", "100000000")),
-        f"{prefix}_legs": legs,
-        f"{prefix}_chunks_done": chunks_done,
-        f"{prefix}_chunks_total": progress.get("chunks_total"),
-        f"{prefix}_wall_s": round(time.monotonic() - t0, 1),
+        f"{prefix}_legs": fr.legs,
+        f"{prefix}_chunks_done": fr.chunks_done,
+        f"{prefix}_chunks_total": fr.chunks_total,
+        f"{prefix}_wall_s": round(fr.wall_s, 1),
         f"{prefix}_complete": bool(result),
+        # priced restart overhead: the share of the campaign's work
+        # wall that bought chunks a later leg had to recompute (gated
+        # regress-up against bench/history.jsonl)
+        f"{prefix}_campaign_replay_frac": fr.replay_frac,
     }
+    last_err = fr.last_error
     if result:
         out.update(
             {

@@ -1,0 +1,1429 @@
+"""Elastic fault-priced campaign driver (ROADMAP item 5).
+
+The reference paper's whole design is "survive the cluster": partitions
+are eps-halo'd precisely so any executor can die and be rescheduled
+without poisoning the global merge (DBSCAN.scala:53-60 leans on Spark
+lineage for the replay). Our only campaign harness so far was the m100
+retry-resume loop hard-coded in bench.py — one worker, whole-process
+restarts, no way to steal work, resize its grain, or price what a
+restart costs. This module generalizes it into a campaign driver that
+runs ONE logical clustering job as a queue of resumable work chunks
+over a worker fleet:
+
+- **work-stealing chunk queue** (:class:`ChunkQueue`): the p1chunk
+  restart points (parallel/checkpoint.py) are the lease currency.
+  Workers lease batches of chunk indices with heartbeat-expiring
+  leases; a preempted or wedged worker's unfinished chunks return to
+  the queue and are restolen instead of stalling the campaign. Chunk
+  artifacts are deterministic (the plan-derived composition signature
+  is the adoption gate), so even the stale-leaseholder-races-the-thief
+  case is benign: both write byte-identical files through an atomic
+  rename.
+- **fault-rate-aware re-partitioning**: each worker watches its own
+  leases' ``stats["faults"]`` deltas (PR 1) and outcomes, halving its
+  lease size (never below ``DBSCAN_CAMPAIGN_MIN_CHUNK``) while faults
+  run hot and doubling it back (capped at ``DBSCAN_CAMPAIGN_MAX_CHUNK``)
+  after sustained health. Lease size only changes WHICH chunks a leg
+  computes — chunk compositions are plan-fixed and every dispatch rides
+  the existing ladder/ratchet shapes — so re-partitioning can never
+  mint a recompile.
+- **degradation tiers**: a lease that dies with a real retries-
+  exhausted device fault (``faults.FatalDeviceFault`` from a non-
+  campaign site) degrades its WORKER to the CPU tier — subsequent
+  leases run the per-group CPU kernel for the whole leg
+  (``CampaignLeg(tier="cpu")``, the whole-chunk generalization of the
+  faults.py per-group fallback) — rather than aborting the campaign.
+  Labels are unchanged (same algebra; PARITY.md "Campaign contract").
+- **priced replay budget**: every lease's wall is accounted.
+  A failed/killed/expired lease's wall is charged pro-rata to the
+  chunks that did NOT land (``wasted = wall * unfinished/leased``), and
+  ``replay_frac = replayed_wall / work_wall`` is stamped on the bench
+  row (``campaign_replay_frac``), promoted by obs/bench_history, and
+  gated regress-UP by obs/regress — restart overhead is a first-class
+  regression-tested metric, the spot-instance economics of production
+  clusters made measurable.
+- **preemption drills**: the ``campaign`` site in ``DBSCAN_FAULT_SPEC``
+  (faults.py) injects deterministic worker failures at lease grant:
+  ``TRANSIENT`` kills the leg after it banks one chunk (through the
+  driver's real abort path — note_abort + flightrec dump),
+  ``PERSISTENT`` wedges the worker (its lease must heartbeat-expire and
+  be restolen), ``RESOURCE_EXHAUSTED`` degrades the worker to the CPU
+  tier. The steal/resume/degrade paths are exercised in tier-1
+  (tests/test_campaign.py) with flightrec (PR 9) as the per-worker
+  postmortem and the graftcheck/tsan rules (PR 6) certifying the shared
+  queue state.
+
+Two campaign shapes share the machinery:
+
+- **chunk-leased** (:class:`Campaign` + :class:`TrainChunkJob`): N
+  in-process worker threads lease chunk subsets and run partial legs
+  (``driver.train_arrays(campaign=CampaignLeg(...))``); a finalize run
+  over the fully-banked dir loads every chunk and merges. In-process
+  legs serialize on the module device lease (one accelerator per
+  process) — the queue semantics are fleet-general, and ROADMAP item 1's
+  multi-chip mesh is the consumer that will lease chips concurrently.
+- **frontier** (:func:`run_frontier`): subprocess legs in the m100 mold
+  — each lease is one full ``train(checkpoint_dir=...)`` attempt that
+  banks whatever it reaches; bench.py::m100_row now rides this,
+  keeping its measured-honesty rules (prior-chunk mpts suppression,
+  stall breakout on the progress counter, campaign-key invalidation)
+  while gaining lease accounting and the priced replay budget.
+
+CLI: ``python -m dbscan_tpu.campaign`` runs a deterministic drilled
+campaign (see README "Campaigns") and emits a bench-history-ingestible
+capture; ``--leg`` is the subprocess leg entry the drills SIGTERM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from dbscan_tpu import config, faults, obs
+from dbscan_tpu.lint import tsan as _tsan
+
+logger = logging.getLogger(__name__)
+
+#: one accelerator per process: in-process chunk legs (and the plan /
+#: finalize runs) serialize on this reentrant lease, so concurrent
+#: worker threads contend for the device instead of interleaving
+#: dispatches inside one run. Subprocess legs and (ROADMAP item 1)
+#: per-chip meshes are the true-parallel tiers.
+_DEVICE_LEASE = _tsan.rlock("campaign.device")
+
+_MAX_WORKER_ERRORS = 3  # unclassified failures before a worker retires
+
+
+class LeaseCancelled(Exception):
+    """The campaign is shutting down (budget exhausted / stop set)
+    while this lease was still queued behind the device — the leg
+    never ran; its chunks go straight back to the queue."""
+
+
+def _consume_campaign_fault():
+    """Consume one ``campaign`` fault-site ordinal for a lease grant
+    (only when the spec names the site — the ``pull#N`` opt-in
+    discipline) and return ``(kind, ordinal)``; ``(None, -1)`` with no
+    active campaign clause. The ONE consume rule both campaign shapes
+    (worker fleet and frontier legs) share."""
+    if not faults.campaign_site_active():
+        return None, -1
+    reg = faults.get_registry()
+    n, g = reg.next_ordinal(faults.SITE_CAMPAIGN)
+    try:
+        reg.check(faults.SITE_CAMPAIGN, n, g, 0)
+    except faults.FaultInjected as e:
+        return e.kind, n
+    return None, n
+
+
+# --- lease / queue -----------------------------------------------------
+
+
+@dataclasses.dataclass
+class Lease:
+    """One granted lease: a batch of chunk ids owned by a worker until
+    it completes, fails, or stops heartbeating past the expiry window."""
+
+    lease_id: int
+    worker: str
+    tier: str
+    chunks: tuple  # chunk ids granted (sorted)
+    granted_at: float  # time.monotonic at grant
+    heartbeat_at: float
+    done: set = dataclasses.field(default_factory=set)
+    active: bool = True
+    outcome: str = ""  # ok | kill | fault | error | expired | cancelled
+
+
+class ChunkQueue:
+    """Work-stealing chunk queue with heartbeat-expiring leases.
+
+    Thread-safety: one condition variable guards ALL queue state
+    (pending/done sets, lease table, replay accounting) — the same
+    single-monitor discipline as the pull engine
+    (parallel/pipeline.py), checked statically by graftcheck's
+    race rules and at runtime under ``DBSCAN_TSAN=1``. Telemetry is
+    emitted OUTSIDE the lock.
+
+    Replay pricing: ``work_wall_s`` accumulates every lease's wall;
+    ``replayed_wall_s`` accumulates the pro-rata share of a
+    failed/expired lease's wall attributable to the chunks it did not
+    finish (they must be recomputed by the thief). A wedged worker that
+    reports after its lease expired is ignored entirely — its wall was
+    priced at expiry."""
+
+    def __init__(self, chunk_ids: Sequence[int], lease_s: float):
+        self._cv = _tsan.condition("campaign.queue")
+        self._pending: List[int] = sorted(int(c) for c in chunk_ids)
+        self._done: set = set()
+        self._total = len(self._pending)
+        self._leases: dict = {}
+        self._next_id = 0
+        self.lease_s = float(lease_s)
+        self._acct = {
+            "leases": 0,
+            "steals": 0,
+            "expired": 0,
+            "work_wall_s": 0.0,
+            "replayed_wall_s": 0.0,
+        }
+
+    # --- worker side ---------------------------------------------------
+
+    def lease(self, worker: str, want: int, tier: str) -> Optional[Lease]:
+        """Grant up to ``want`` pending chunks (lowest ids first) to
+        ``worker``; None when nothing is pending (completed chunks never
+        re-lease — only failed/expired ones return)."""
+        now = time.monotonic()
+        with self._cv:
+            _tsan.access("campaign.queue")
+            if not self._pending:
+                return None
+            take = self._pending[: max(1, int(want))]
+            del self._pending[: len(take)]
+            lease = Lease(
+                lease_id=self._next_id,
+                worker=worker,
+                tier=tier,
+                chunks=tuple(take),
+                granted_at=now,
+                heartbeat_at=now,
+            )
+            self._next_id += 1
+            self._leases[lease.lease_id] = lease
+            self._acct["leases"] += 1
+            depth = self._depth_locked()
+        obs.count("campaign.leases")
+        self._emit_depth(depth)
+        return lease
+
+    def heartbeat(self, lease: Lease) -> None:
+        """Refresh a lease's expiry window: the holder demonstrated
+        forward progress (a leased group dispatched, or the leg just
+        acquired the device). A lease only reads as wedged after a
+        whole ``lease_s`` window with NO progress — a long first chunk
+        is not a wedge."""
+        with self._cv:
+            _tsan.access("campaign.queue")
+            lease.heartbeat_at = time.monotonic()
+
+    def note_chunk(self, lease: Lease, ci: int) -> None:
+        """Heartbeat + incremental completion: chunk ``ci`` of ``lease``
+        is banked on disk. An expired lease's notes are ignored (the
+        chunk was requeued at expiry; the thief's recompute overwrites
+        the same bytes)."""
+        with self._cv:
+            _tsan.access("campaign.queue")
+            lease.heartbeat_at = time.monotonic()
+            if not lease.active:
+                return
+            ci = int(ci)
+            lease.done.add(ci)
+            if ci not in self._done:
+                self._done.add(ci)
+                self._cv.notify_all()
+            depth = self._depth_locked()
+        obs.count("campaign.chunks_done")
+        self._emit_depth(depth)
+
+    def release(self, lease: Lease, wall_s: float, outcome: str) -> int:
+        """A worker finished (or died on) its lease: price the wall,
+        requeue unfinished chunks, and return how many were requeued.
+        No-op (returns 0) when the lease already expired — its pricing
+        happened at steal time."""
+        requeued = 0
+        with self._cv:
+            _tsan.access("campaign.queue")
+            if not lease.active:
+                return 0
+            lease.active = False
+            lease.outcome = outcome
+            requeued = self._requeue_locked(lease)
+            wall = max(0.0, float(wall_s))
+            if outcome != "cancelled":
+                # a cancelled lease never ran its leg (shutdown while
+                # queued): its wait wall is neither work nor replay
+                self._acct["work_wall_s"] += wall
+            if outcome not in ("ok", "cancelled"):
+                self._acct["replayed_wall_s"] += self._wasted(
+                    lease, wall, requeued
+                )
+                self._acct["steals"] += requeued
+            self._cv.notify_all()
+            depth = self._depth_locked()
+        # telemetry mirrors the priced accounting exactly: cancelled
+        # leases requeue their chunks but are neither steals nor replay
+        # (the bench row and the trace must agree)
+        if requeued and outcome != "cancelled":
+            obs.count("campaign.steals", requeued)
+            obs.event(
+                "campaign.steal",
+                lease=lease.lease_id,
+                worker=lease.worker,
+                outcome=outcome,
+                chunks=requeued,
+            )
+        self._emit_depth(depth)
+        return requeued
+
+    def expire_stale(self) -> List[Lease]:
+        """Requeue the chunks of every active lease whose heartbeat is
+        older than ``lease_s`` — the steal path for wedged/preempted
+        workers. The expired lease's elapsed wall is priced pro-rata
+        here; any later report from the stale holder is ignored."""
+        now = time.monotonic()
+        stolen = []
+        with self._cv:
+            _tsan.access("campaign.queue")
+            for lease in self._leases.values():
+                if not lease.active:
+                    continue
+                if now - lease.heartbeat_at <= self.lease_s:
+                    continue
+                lease.active = False
+                lease.outcome = "expired"
+                requeued = self._requeue_locked(lease)
+                elapsed = max(0.0, now - lease.granted_at)
+                self._acct["work_wall_s"] += elapsed
+                self._acct["replayed_wall_s"] += self._wasted(
+                    lease, elapsed, requeued
+                )
+                self._acct["expired"] += 1
+                self._acct["steals"] += requeued
+                stolen.append(lease)
+            if stolen:
+                self._cv.notify_all()
+            depth = self._depth_locked()
+        for lease in stolen:
+            obs.count("campaign.expired")
+            obs.count("campaign.steals", len(lease.chunks) - len(lease.done))
+            obs.event(
+                "campaign.expire",
+                lease=lease.lease_id,
+                worker=lease.worker,
+                chunks=len(lease.chunks) - len(lease.done),
+                lease_s=self.lease_s,
+            )
+        if stolen:
+            self._emit_depth(depth)
+        return stolen
+
+    def _requeue_locked(self, lease: Lease) -> int:
+        """Return the lease's unfinished chunks to the pending queue
+        (caller holds the lock)."""
+        back = [c for c in lease.chunks if c not in lease.done
+                and c not in self._done and c not in self._pending]
+        self._pending = sorted(self._pending + back)
+        return len(back)
+
+    @staticmethod
+    def _wasted(lease: Lease, wall: float, requeued: int) -> float:
+        """Pro-rata replayed wall: the share of this lease's wall
+        attributable to chunks that must be recomputed. Exact under the
+        uniform-chunk approximation; a lease that banked nothing wastes
+        its whole wall."""
+        if not lease.chunks:
+            return wall
+        return wall * (requeued / len(lease.chunks))
+
+    # --- campaign side -------------------------------------------------
+
+    def mark_done(self, chunk_ids: Sequence[int]) -> None:
+        """Chunks already banked on disk (a resumed campaign): never
+        leased, counted done."""
+        with self._cv:
+            _tsan.access("campaign.queue")
+            for ci in chunk_ids:
+                ci = int(ci)
+                self._done.add(ci)
+                if ci in self._pending:
+                    self._pending.remove(ci)
+            self._cv.notify_all()
+            depth = self._depth_locked()
+        self._emit_depth(depth)
+
+    def done(self) -> bool:
+        with self._cv:
+            _tsan.access("campaign.queue", write=False)
+            return len(self._done) >= self._total
+
+    def wait(self, timeout: float) -> bool:
+        """Block up to ``timeout`` for any queue-state change; returns
+        :meth:`done`."""
+        with self._cv:
+            _tsan.access("campaign.queue", write=False)
+            if len(self._done) < self._total:
+                self._cv.wait(timeout)
+            return len(self._done) >= self._total
+
+    def snapshot(self) -> dict:
+        """Queue accounting for the campaign result (counts + replay
+        pricing)."""
+        with self._cv:
+            _tsan.access("campaign.queue", write=False)
+            out = dict(self._acct)
+            out["chunks_total"] = self._total
+            out["chunks_done"] = len(self._done)
+            out["pending"] = len(self._pending)
+            out["work_wall_s"] = round(out["work_wall_s"], 6)
+            out["replayed_wall_s"] = round(out["replayed_wall_s"], 6)
+            return out
+
+    def _depth_locked(self) -> int:
+        """Chunks not yet banked (caller holds the monitor) — computed
+        inside the caller's existing critical section so telemetry
+        emission costs no second lock round-trip per queue op."""
+        return self._total - len(self._done)
+
+    @staticmethod
+    def _emit_depth(depth: int) -> None:
+        obs.gauge("campaign.queue_depth", depth)
+
+
+def replay_frac(work_wall_s: float, replayed_wall_s: float) -> float:
+    """``campaign_replay_frac`` = replayed wall / total work wall (0.0
+    for an idle or fault-free campaign) — THE priced restart-overhead
+    figure, gated regress-up (obs/regress.py)."""
+    if work_wall_s <= 0:
+        return 0.0
+    return round(min(1.0, replayed_wall_s / work_wall_s), 4)
+
+
+# --- workers -----------------------------------------------------------
+
+
+class CampaignWorker:
+    """One worker of the fleet: a thread that leases chunk batches,
+    runs them through the job, adapts its lease size to its own fault
+    rate, and degrades to the CPU tier when the device path exhausts
+    its retries. All cross-thread state lives in the
+    :class:`ChunkQueue` monitor; a worker's own fields are owned by its
+    thread (the campaign reads them only after ``join``)."""
+
+    def __init__(
+        self,
+        name: str,
+        job,
+        queue: ChunkQueue,
+        *,
+        min_chunk: int,
+        max_chunk: int,
+        stop: threading.Event,
+        release: threading.Event,
+    ):
+        self.name = name
+        self.job = job
+        self.queue = queue
+        self.min_chunk = max(1, int(min_chunk))
+        self.max_chunk = max(self.min_chunk, int(max_chunk))
+        self.stop = stop
+        self.release = release
+        # start mid-ladder: hot fault rates halve toward min_chunk,
+        # sustained health doubles toward max_chunk
+        self.want = min(self.max_chunk, max(self.min_chunk, 2))
+        self.tier = "device"
+        self.clean_streak = 0
+        self.errors = 0
+        self.kills = 0
+        self.wedged = False
+        self.degraded = False
+        self.last_error = ""
+        self._thread = threading.Thread(
+            target=self._run, name=f"dbscan-campaign-{name}", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    # --- internals -----------------------------------------------------
+
+    def _degrade(self, why: str) -> None:
+        if self.tier == "cpu":
+            return
+        self.tier = "cpu"
+        self.degraded = True
+        obs.count("campaign.degrades")
+        obs.event("campaign.degrade", worker=self.name, error=why[:120])
+        logger.warning(
+            "campaign worker %s: degrading to the CPU tier (%s)",
+            self.name,
+            why,
+        )
+
+    def _adapt(self, hot: bool) -> None:
+        """Fault-rate-aware re-partitioning of this worker's lease
+        size. Pure queue-grain arithmetic: chunk compositions are
+        plan-fixed and shapes ride the existing ladders, so no setting
+        of ``want`` can mint a recompile."""
+        old = self.want
+        if hot:
+            self.clean_streak = 0
+            self.want = max(self.min_chunk, self.want // 2)
+        else:
+            self.clean_streak += 1
+            if self.clean_streak >= 2:
+                self.clean_streak = 0
+                self.want = min(self.max_chunk, self.want * 2)
+        if self.want != old:
+            obs.count("campaign.repartitions")
+            obs.event(
+                "campaign.repartition",
+                worker=self.name,
+                want=self.want,
+                was=old,
+                hot=hot,
+            )
+
+    def _wedge(self, lease: Lease) -> None:
+        """Injected PERSISTENT campaign fault: this worker wedges —
+        holds its lease, stops heartbeating, and parks until the
+        campaign releases it. The lease must expire and be restolen by
+        the rest of the fleet (the drill the acceptance test pins)."""
+        self.wedged = True
+        obs.count("campaign.wedges")
+        obs.event(
+            "campaign.wedge",
+            worker=self.name,
+            lease=lease.lease_id,
+            chunks=len(lease.chunks),
+        )
+        logger.warning(
+            "campaign worker %s: injected wedge holding lease %d "
+            "(%d chunk(s)); lease expires in %.1fs",
+            self.name,
+            lease.lease_id,
+            len(lease.chunks),
+            self.queue.lease_s,
+        )
+        self.release.wait()
+
+    def _run(self) -> None:
+        poll = max(0.05, min(self.queue.lease_s / 4.0, 0.5))
+        while not self.stop.is_set():
+            self.queue.expire_stale()
+            kind, ordinal = None, -1
+            lease = self.queue.lease(self.name, self.want, self.tier)
+            if lease is None:
+                if self.queue.wait(poll):
+                    break
+                continue
+            kind, ordinal = _consume_campaign_fault()
+            if kind == faults.PERSISTENT:
+                self._wedge(lease)
+                return
+            if kind == faults.RESOURCE_EXHAUSTED:
+                # the drill stand-in for "this worker's device lost its
+                # memory headroom": degrade the tier, then run the lease
+                self._degrade("injected RESOURCE_EXHAUSTED")
+            kill_after = 1 if kind == faults.TRANSIENT else 0
+            outcome = "ok"
+            stats = None
+            t0 = time.monotonic()
+            tp0 = time.perf_counter()
+            try:
+                stats = self.job.run_lease(
+                    sorted(lease.chunks),
+                    tier=self.tier,
+                    kill_after=kill_after,
+                    kill_ordinal=ordinal,
+                    on_chunk=lambda ci, lease=lease: self.queue.note_chunk(
+                        lease, ci
+                    ),
+                    heartbeat=lambda lease=lease: self.queue.heartbeat(
+                        lease
+                    ),
+                    should_stop=self.stop.is_set,
+                )
+            except LeaseCancelled:
+                # shutdown while queued behind the device: the leg
+                # never ran — hand the chunks back and exit the loop
+                outcome = "cancelled"
+            except faults.FatalDeviceFault as e:
+                self.last_error = str(e)
+                if e.site == faults.SITE_CAMPAIGN:
+                    # the injected worker-kill drill: the leg died
+                    # through the driver's real abort path (banked
+                    # chunks + note_abort + flightrec dump)
+                    outcome = "kill"
+                    self.kills += 1
+                    obs.count("campaign.kills")
+                    obs.event(
+                        "campaign.kill",
+                        worker=self.name,
+                        lease=lease.lease_id,
+                        ordinal=ordinal,
+                    )
+                else:
+                    # a real retries-exhausted device fault: this
+                    # worker's device path is unhealthy — degrade the
+                    # whole worker to the CPU tier and requeue
+                    outcome = "fault"
+                    self._degrade(str(e))
+            except Exception as e:  # noqa: BLE001 — worker must survive
+                outcome = "error"
+                self.errors += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+                logger.exception(
+                    "campaign worker %s: lease %d failed",
+                    self.name,
+                    lease.lease_id,
+                )
+            wall = time.monotonic() - t0
+            self.queue.release(lease, wall, outcome)
+            obs.add_span(
+                "campaign.lease",
+                tp0,
+                time.perf_counter(),
+                worker=self.name,
+                lease=lease.lease_id,
+                chunks=len(lease.chunks),
+                tier=self.tier,
+                outcome=outcome,
+            )
+            if outcome == "cancelled":
+                continue  # shutdown, not a fault: no lease-size signal
+            hot = outcome != "ok" or bool(
+                stats
+                and (
+                    stats.get("faults", {}).get("retries", 0)
+                    or stats.get("faults", {}).get("fallbacks", 0)
+                )
+            )
+            self._adapt(hot)
+            if self.errors >= _MAX_WORKER_ERRORS:
+                logger.error(
+                    "campaign worker %s: retiring after %d errors "
+                    "(last: %s)",
+                    self.name,
+                    self.errors,
+                    self.last_error,
+                )
+                return
+
+
+# --- campaign ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CampaignResult:
+    """One campaign's outcome + priced accounting. ``replay_frac`` is
+    the bench-row ``campaign_replay_frac`` figure."""
+
+    complete: bool
+    output: object  # TrainOutput of the finalize run, or None
+    chunks_total: int
+    chunks_done: int
+    leases: int
+    steals: int
+    expired: int
+    kills: int
+    wedges: int
+    degrades: int
+    work_wall_s: float
+    replayed_wall_s: float
+    replay_frac: float
+    wall_s: float
+    workers: int
+    last_error: str = ""
+
+    def row(self, prefix: str = "campaign") -> dict:
+        """Bench-row keys for this campaign (the shape bench.py stamps
+        and obs/bench_history promotes)."""
+        out = {
+            f"{prefix}_complete": bool(self.complete),
+            f"{prefix}_chunks_total": int(self.chunks_total),
+            f"{prefix}_chunks_done": int(self.chunks_done),
+            f"{prefix}_leases": int(self.leases),
+            f"{prefix}_steals": int(self.steals),
+            f"{prefix}_expired": int(self.expired),
+            f"{prefix}_kills": int(self.kills),
+            f"{prefix}_wedges": int(self.wedges),
+            f"{prefix}_degrades": int(self.degrades),
+            f"{prefix}_replay_frac": float(self.replay_frac),
+            f"{prefix}_wall_s": round(float(self.wall_s), 3),
+        }
+        if self.last_error:
+            out[f"{prefix}_last_error"] = self.last_error[:200]
+        return out
+
+
+class Campaign:
+    """Run one chunk-leased campaign over a worker fleet (module
+    docstring). ``job`` duck-types three methods:
+
+    - ``plan() -> dict`` with ``chunks_total`` (and optionally
+      ``banked`` — chunk ids already on disk — and ``output`` when the
+      job discovered it is ALREADY complete, e.g. a premerge resume);
+    - ``run_lease(chunks, *, tier, kill_after, kill_ordinal, on_chunk,
+      heartbeat, should_stop) -> stats dict`` — compute + bank the
+      leased chunks, calling ``on_chunk(ci)`` after each (lease
+      completion), ``heartbeat()`` on any forward progress, and
+      raising :class:`LeaseCancelled` if ``should_stop()`` turns true
+      before the leg starts;
+    - ``finalize() -> output`` — the assembly run over the fully-banked
+      state.
+    """
+
+    def __init__(
+        self,
+        job,
+        *,
+        workers: Optional[int] = None,
+        lease_s: Optional[float] = None,
+        min_chunk: Optional[int] = None,
+        max_chunk: Optional[int] = None,
+        budget_s: Optional[float] = None,
+        poll_s: float = 0.25,
+    ):
+        self.job = job
+        self.n_workers = int(
+            workers
+            if workers is not None
+            else config.env("DBSCAN_CAMPAIGN_WORKERS")
+        )
+        self.lease_s = float(
+            lease_s
+            if lease_s is not None
+            else config.env("DBSCAN_CAMPAIGN_LEASE_S")
+        )
+        self.min_chunk = int(
+            min_chunk
+            if min_chunk is not None
+            else config.env("DBSCAN_CAMPAIGN_MIN_CHUNK")
+        )
+        self.max_chunk = int(
+            max_chunk
+            if max_chunk is not None
+            else config.env("DBSCAN_CAMPAIGN_MAX_CHUNK")
+        )
+        self.budget_s = budget_s
+        self.poll_s = float(poll_s)
+
+    def run(self) -> CampaignResult:
+        t0 = time.monotonic()
+        tp0 = time.perf_counter()
+        plan = self.job.plan()
+        if plan.get("output") is not None:
+            # the job was already complete (premerge resume): a
+            # zero-lease campaign with nothing replayed
+            return CampaignResult(
+                complete=True,
+                output=plan["output"],
+                chunks_total=int(plan.get("chunks_total") or 0),
+                chunks_done=int(plan.get("chunks_total") or 0),
+                leases=0, steals=0, expired=0, kills=0, wedges=0,
+                degrades=0, work_wall_s=0.0, replayed_wall_s=0.0,
+                replay_frac=0.0,
+                wall_s=round(time.monotonic() - t0, 6),
+                workers=0,
+            )
+        total = int(plan.get("chunks_total") or 0)
+        queue = ChunkQueue(range(total), self.lease_s)
+        banked = [c for c in plan.get("banked", ()) if 0 <= c < total]
+        if banked:
+            queue.mark_done(banked)
+        stop = threading.Event()
+        release = threading.Event()
+        fleet = [
+            CampaignWorker(
+                f"w{i}",
+                self.job,
+                queue,
+                min_chunk=self.min_chunk,
+                max_chunk=self.max_chunk,
+                stop=stop,
+                release=release,
+            )
+            for i in range(max(1, self.n_workers))
+        ]
+        obs.gauge("campaign.workers_active", len(fleet))
+        for w in fleet:
+            w.start()
+        try:
+            while not queue.done():
+                queue.wait(self.poll_s)
+                # the main thread steals too: with every worker wedged
+                # or busy, SOMEONE must still expire stale leases
+                queue.expire_stale()
+                if (
+                    self.budget_s is not None
+                    and time.monotonic() - t0 > self.budget_s
+                ):
+                    logger.warning(
+                        "campaign: budget %.1fs exhausted with %s",
+                        self.budget_s,
+                        queue.snapshot(),
+                    )
+                    break
+                # no worker left that could ever lease again — retired,
+                # dead, or parked in an injected wedge (alive but
+                # permanently out of the loop): stop instead of
+                # spinning forever on an unfillable queue
+                if all(not w.alive or w.wedged for w in fleet):
+                    break
+        finally:
+            stop.set()
+            release.set()
+        for w in fleet:
+            # a worker blocked inside a leg finishes that leg first —
+            # bounded by the leg itself, the same contract as one m100
+            # subprocess leg
+            w.join()
+        obs.gauge("campaign.workers_active", 0)
+        snap = queue.snapshot()
+        output = None
+        complete = queue.done()
+        last_error = next(
+            (w.last_error for w in fleet if w.last_error), ""
+        )
+        if complete:
+            fin0 = time.perf_counter()
+            output = self.job.finalize()
+            obs.add_span("campaign.finalize", fin0, time.perf_counter())
+        wall = time.monotonic() - t0
+        obs.count("campaign.work_wall_s", snap["work_wall_s"])
+        obs.count("campaign.replayed_wall_s", snap["replayed_wall_s"])
+        obs.add_span(
+            "campaign.run",
+            tp0,
+            time.perf_counter(),
+            chunks=total,
+            workers=len(fleet),
+            complete=complete,
+        )
+        obs.flush()  # the campaign tail must reach DBSCAN_TRACE's file
+        return CampaignResult(
+            complete=complete,
+            output=output,
+            chunks_total=snap["chunks_total"],
+            chunks_done=snap["chunks_done"],
+            leases=snap["leases"],
+            steals=snap["steals"],
+            expired=snap["expired"],
+            kills=sum(w.kills for w in fleet),
+            wedges=sum(1 for w in fleet if w.wedged),
+            degrades=sum(1 for w in fleet if w.degraded),
+            work_wall_s=snap["work_wall_s"],
+            replayed_wall_s=snap["replayed_wall_s"],
+            replay_frac=replay_frac(
+                snap["work_wall_s"], snap["replayed_wall_s"]
+            ),
+            wall_s=round(wall, 6),
+            workers=len(fleet),
+            last_error=last_error,
+        )
+
+
+# --- the in-process clustering job -------------------------------------
+
+
+class TrainChunkJob:
+    """Chunk-leased campaign job over one dataset: partial legs via
+    ``driver.train_arrays(campaign=CampaignLeg(...))``, assembly via an
+    unrestricted run over the fully-banked checkpoint dir. Labels are
+    byte-identical to a single fault-free ``train`` (pinned by
+    tests/test_campaign.py): chunk artifacts are deterministic and the
+    finalize run adopts them under the ordinal-salted composition
+    signatures, exactly as the existing resume path does."""
+
+    def __init__(self, points, cfg, ckpt_dir: str, mesh=None):
+        self.points = points
+        self.cfg = cfg.validate()
+        self.ckpt_dir = ckpt_dir
+        self.mesh = mesh
+
+    def _fingerprint(self) -> str:
+        from dbscan_tpu.parallel import checkpoint as ckpt_mod
+
+        # mirror train_arrays' input normalization (euclidean banded
+        # path: f64 cast) so the fingerprint matches the legs'
+        pts = np.asarray(self.points, dtype=np.float64)
+        return ckpt_mod.run_fingerprint(pts, self.cfg)
+
+    def plan(self) -> dict:
+        from dbscan_tpu.parallel import checkpoint as ckpt_mod
+        from dbscan_tpu.parallel import driver
+
+        leg = driver.CampaignLeg(chunks=frozenset())
+        with _DEVICE_LEASE:
+            out = driver.train_arrays(
+                self.points,
+                self.cfg,
+                mesh=self.mesh,
+                checkpoint_dir=self.ckpt_dir,
+                campaign=leg,
+            )
+        if out.stats.get("resumed_from_checkpoint"):
+            return {"output": out, "chunks_total": 0, "banked": []}
+        return {
+            "output": None,
+            "chunks_total": out.stats.get("campaign_chunks_total") or 0,
+            # chunks banked by a prior (interrupted) campaign: the
+            # queue marks them done so only the holes get leased
+            "banked": ckpt_mod.p1_chunk_indices(
+                self.ckpt_dir,
+                self._fingerprint(),
+                budget=driver._COMPACT_CHUNK_SLOTS,
+            ),
+        }
+
+    def run_lease(
+        self,
+        chunks,
+        *,
+        tier: str,
+        kill_after: int = 0,
+        kill_ordinal: int = -1,
+        on_chunk: Optional[Callable[[int], None]] = None,
+        heartbeat: Optional[Callable[[], None]] = None,
+        should_stop: Optional[Callable[[], bool]] = None,
+    ) -> dict:
+        from dbscan_tpu.parallel import driver
+
+        leg = driver.CampaignLeg(
+            chunks=frozenset(int(c) for c in chunks),
+            tier=tier,
+            kill_after=int(kill_after),
+            kill_ordinal=int(kill_ordinal),
+            on_chunk=on_chunk,
+            # per-group heartbeat: a first chunk longer than the expiry
+            # window must not read as a wedge
+            on_progress=heartbeat,
+        )
+        # heartbeat WHILE queued behind the device lease too: a worker
+        # blocked here is healthy (serialized behind a peer's leg, not
+        # wedged), and letting its lease expire would both steal its
+        # chunks into duplicate recompute and inflate the regress-gated
+        # replay_frac on a fault-free campaign. The beat stops the
+        # moment the leg runs — a hung dispatch still expires via the
+        # absence of per-group progress. The wait also observes the
+        # campaign's shutdown: once the budget breaks the main loop, a
+        # still-queued lease must NOT run its whole leg serially after
+        # the campaign already gave up.
+        while not _DEVICE_LEASE.acquire(timeout=0.5):
+            if should_stop is not None and should_stop():
+                raise LeaseCancelled("campaign stopped while queued")
+            if heartbeat is not None:
+                heartbeat()
+        try:
+            if heartbeat is not None:
+                heartbeat()
+            out = driver.train_arrays(
+                self.points,
+                self.cfg,
+                mesh=self.mesh,
+                checkpoint_dir=self.ckpt_dir,
+                campaign=leg,
+            )
+        finally:
+            _DEVICE_LEASE.release()
+        return out.stats
+
+    def finalize(self):
+        from dbscan_tpu.parallel import driver
+
+        with _DEVICE_LEASE:
+            return driver.train_arrays(
+                self.points,
+                self.cfg,
+                mesh=self.mesh,
+                checkpoint_dir=self.ckpt_dir,
+            )
+
+
+# --- campaign-key invalidation (shared with bench.py) ------------------
+
+
+def ensure_campaign_key(ckpt_dir: str, key: dict) -> bool:
+    """A config change (n, maxpp, chunk/group slots) makes every banked
+    chunk unloadable but NOT invisible: stale files would inflate
+    chunks_done and mask real progress from the stall detector. The
+    campaign key captures every knob the fingerprint depends on; a
+    mismatch wipes the dir clean. Returns True when prior state was
+    invalidated. (Hoisted from bench.py::m100_row so every campaign
+    harness shares one invalidation rule.)"""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    key_path = os.path.join(ckpt_dir, "campaign.json")
+    prior = None
+    if os.path.exists(key_path):
+        try:
+            with open(key_path) as f:
+                prior = json.load(f)
+        except (OSError, ValueError):
+            # a PRESENT but unreadable key (torn write, foreign file)
+            # must invalidate, not read as a fresh dir: skipping the
+            # wipe here is exactly the stale-chunk-masking hazard this
+            # function exists to prevent
+            prior = "unreadable"
+    invalidated = False
+    if prior != key:
+        if prior is not None:
+            from dbscan_tpu.parallel import checkpoint as ckpt_mod
+
+            ckpt_mod.invalidate_p1_chunk(ckpt_dir, 0)
+            for stale in ("progress.json", "premerge.npz", "manifest.json"):
+                try:
+                    os.unlink(os.path.join(ckpt_dir, stale))
+                except OSError:
+                    pass
+            invalidated = True
+        tmp = key_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(key, f)
+        os.replace(tmp, key_path)  # never leave a torn key behind
+    return invalidated
+
+
+# --- leg-progress signal (the stall detector's input) ------------------
+
+
+def progress_counter(ckpt_dir: str) -> int:
+    """The monotone chunk-write counter from the progress sidecar, or
+    -1 when absent (pre-campaign dirs / no chunk ever banked)."""
+    from dbscan_tpu.parallel import checkpoint as ckpt_mod
+
+    try:
+        return int(
+            ckpt_mod.read_progress(ckpt_dir).get(
+                ckpt_mod.PROGRESS_WRITE_COUNTER, -1
+            )
+        )
+    except (TypeError, ValueError):
+        return -1
+
+
+def mtime_fresh_chunks(ckpt_dir: str, since: float) -> int:
+    """Fallback leg-progress signal: p1chunk files (re)written at or
+    after ``since`` (an epoch timestamp). mtime granularity and clock
+    skew can misclassify a productive leg as stalled, which is why the
+    sidecar counter is authoritative when present."""
+    fresh = 0
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return 0
+    for name in names:
+        if name.startswith("p1chunk") and name.endswith(".npz"):
+            try:
+                if os.path.getmtime(os.path.join(ckpt_dir, name)) >= since:
+                    fresh += 1
+            except OSError:
+                pass
+    return fresh
+
+
+def leg_progressed(
+    ckpt_dir: str, counter_before: int, since: float
+) -> bool:
+    """Did a leg bank anything? The sidecar's monotone write counter is
+    authoritative (written by the child under the progress file lock);
+    mtimes are the fallback for dirs that predate the counter."""
+    after = progress_counter(ckpt_dir)
+    if after >= 0:
+        return after > max(0, counter_before)
+    return mtime_fresh_chunks(ckpt_dir, since) > 0
+
+
+# --- frontier campaigns (subprocess legs, the m100 mold) ---------------
+
+
+@dataclasses.dataclass
+class FrontierResult:
+    """Outcome of a frontier campaign: sequential full-train subprocess
+    legs over one checkpoint dir, each banking whatever it reaches."""
+
+    complete: bool
+    legs: int
+    wall_s: float
+    work_wall_s: float
+    replayed_wall_s: float
+    replay_frac: float
+    chunks_done: int
+    chunks_total: Optional[int]
+    stall_break: bool
+    expired: int
+    kills: int
+    degrades: int = 0
+    last_error: str = ""
+
+
+def run_frontier(
+    ckpt_dir: str,
+    argv: Sequence[str],
+    *,
+    env: Optional[dict] = None,
+    max_leases: int = 3,
+    budget_s: float = 1500.0,
+    leg_timeout_s: float = 3600.0,
+    rest_s: float = 45.0,
+    success_path: Optional[str] = None,
+    lease_s: Optional[float] = None,
+    poll_s: float = 0.5,
+) -> FrontierResult:
+    """Run a frontier campaign: each lease launches ``argv`` as one
+    subprocess leg (child_m100 / ``--leg`` mold) that resumes from the
+    banked chunks and runs until completion or death. Keeps the m100
+    harness's measured-honesty rules — a leg never outlives the
+    remaining budget by more than the ~10-min floor that lets it reach
+    its first restart points, and two consecutive legs with no progress
+    signal (the sidecar counter, mtime fallback) break out instead of
+    burning budget — and adds lease accounting, the priced replay
+    budget, and the ``campaign``-site drills (TRANSIENT kills the child
+    after its next banked chunk; PERSISTENT wedges the lease for
+    ``lease_s`` so the next leg steals it)."""
+    from dbscan_tpu.parallel import checkpoint as ckpt_mod
+
+    lease_s = float(
+        lease_s if lease_s is not None
+        else config.env("DBSCAN_CAMPAIGN_LEASE_S")
+    )
+    t0 = time.monotonic()
+    tp0 = time.perf_counter()
+    legs = 0
+    stall = 0
+    stall_break = False
+    complete = False
+    expired = 0
+    kills = 0
+    degraded = False
+    degrades = 0
+    work_wall = 0.0
+    replayed_wall = 0.0
+    last_err = ""
+    campaign_active = faults.campaign_site_active()
+    while legs < max_leases:
+        remaining = budget_s - (time.monotonic() - t0)
+        if legs and remaining <= 0:
+            break
+        legs += 1
+        obs.count("campaign.leases")
+        kind = _consume_campaign_fault()[0] if campaign_active else None
+        if kind == faults.PERSISTENT:
+            # wedged lease: nothing runs, nothing heartbeats; the wall
+            # is pure waste and the next leg is the steal
+            obs.count("campaign.wedges")
+            obs.count("campaign.expired")
+            obs.event("campaign.wedge", leg=legs, lease_s=lease_s)
+            wedge_wall = min(lease_s, max(0.0, remaining))
+            time.sleep(wedge_wall)
+            expired += 1
+            work_wall += wedge_wall
+            replayed_wall += wedge_wall
+            continue
+        if kind == faults.RESOURCE_EXHAUSTED and not degraded:
+            # tier drill, frontier shape: this and every later leg runs
+            # on the CPU backend (the subprocess analog of the worker
+            # fleet's whole-lease CPU degradation) — same algebra,
+            # labels unchanged
+            degraded = True
+            degrades += 1
+            env = {**(env or os.environ), "JAX_PLATFORMS": "cpu"}
+            obs.count("campaign.degrades")
+            obs.event("campaign.degrade", leg=legs, error="injected")
+        counter0 = progress_counter(ckpt_dir)
+        done0 = ckpt_mod.count_p1_chunks(ckpt_dir)
+        leg_start = time.time()
+        t_leg = time.monotonic()
+        # honor the campaign budget even against a WEDGED (not crashed)
+        # worker: the floor lets a resumed leg reach its first restart
+        # points (~10 min incl. datagen + re-pack at m100 scale)
+        deadline = t_leg + min(leg_timeout_s, max(remaining, 600.0))
+        rc = None
+        with tempfile.TemporaryFile() as errf:
+            proc = subprocess.Popen(
+                list(argv),
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=errf,
+            )
+            killed = False
+            try:
+                while True:
+                    rc = proc.poll()
+                    if rc is not None:
+                        break
+                    now = time.monotonic()
+                    if now >= deadline:
+                        proc.kill()
+                        proc.wait()
+                        rc = None
+                        last_err = "leg timeout"
+                        break
+                    if (
+                        kind == faults.TRANSIENT
+                        and not killed
+                        and leg_progressed(ckpt_dir, counter0, leg_start)
+                    ):
+                        # deterministic preemption drill: the worker
+                        # dies right after banking its next chunk
+                        proc.kill()
+                        killed = True
+                        kills += 1
+                        obs.count("campaign.kills")
+                        obs.event("campaign.kill", leg=legs)
+                    time.sleep(poll_s)
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+            if rc is not None and rc != 0:
+                errf.seek(0)
+                tail = errf.read()[-300:].decode(errors="replace")
+                last_err = f"rc {rc}: {tail}".strip()
+        wall = time.monotonic() - t_leg
+        work_wall += wall
+        ok = (
+            rc == 0
+            and not killed
+            and (success_path is None or os.path.exists(success_path))
+        )
+        done1 = ckpt_mod.count_p1_chunks(ckpt_dir)
+        if ok:
+            complete = True
+            break
+        if rc == 0 and not killed:
+            # a clean-exit leg that produced no result file is its own
+            # failure shape (wrong output path, result unlinked by a
+            # concurrent campaign) — leave the breadcrumb the old m100
+            # loop always recorded for any non-success leg
+            last_err = f"leg exited 0 without {success_path}"
+        # pro-rata replay pricing, consistent with ChunkQueue._wasted:
+        # charge the share of the wall the leg ACTUALLY spent on work
+        # that did not persist. A failed leg's wall bought `banked`
+        # durable restart points plus one lost in-flight chunk's worth
+        # of compute, so under the uniform-chunk approximation the
+        # wasted share is 1/(banked+1) — a leg that banked nothing
+        # wasted everything, and pricing never depends on how much of
+        # the campaign happened to remain when the leg started (the
+        # old remaining-chunks denominator overstated replay for legs
+        # that died late, failing the regress gate on kill TIMING
+        # rather than real restart overhead).
+        banked = max(0, done1 - done0)
+        frac_wasted = 1.0 / (banked + 1.0)
+        replayed_wall += wall * frac_wasted
+        obs.event(
+            "campaign.leg",
+            leg=legs,
+            rc=-1 if rc is None else int(rc),
+            banked=banked,
+            wall_s=round(wall, 3),
+        )
+        # two consecutive legs with zero new restart points means the
+        # worker is dying before any progress — stop burning budget
+        if not leg_progressed(ckpt_dir, counter0, leg_start):
+            stall += 1
+            if stall >= 2:
+                stall_break = True
+                break
+        else:
+            stall = 0
+        if legs < max_leases:
+            time.sleep(rest_s)
+    chunks_done = ckpt_mod.count_p1_chunks(ckpt_dir)
+    total = ckpt_mod.read_progress(ckpt_dir).get("chunks_total")
+    obs.count("campaign.work_wall_s", work_wall)
+    obs.count("campaign.replayed_wall_s", replayed_wall)
+    obs.add_span(
+        "campaign.run",
+        tp0,
+        time.perf_counter(),
+        legs=legs,
+        complete=complete,
+        frontier=True,
+    )
+    obs.flush()  # the campaign tail must reach DBSCAN_TRACE's file
+    return FrontierResult(
+        complete=complete,
+        legs=legs,
+        wall_s=round(time.monotonic() - t0, 6),
+        work_wall_s=round(work_wall, 6),
+        replayed_wall_s=round(replayed_wall, 6),
+        replay_frac=replay_frac(work_wall, replayed_wall),
+        chunks_done=chunks_done,
+        chunks_total=total,
+        stall_break=stall_break,
+        expired=expired,
+        kills=kills,
+        degrades=degrades,
+        last_error=last_err[:200],
+    )
+
+
+# --- CLI ---------------------------------------------------------------
+
+
+def demo_points(n: int, seed: int = 0) -> np.ndarray:
+    """Deterministic mixed-density blobs: partitions land on several
+    bucket-ladder rungs so the packer emits multiple groups (chunking
+    is group-granular)."""
+    rng = np.random.default_rng(seed)
+    centers = [(0, 0), (8, 8), (-7, 9), (9, -8), (-9, -9), (16, 2)]
+    weights = np.array([1, 3, 6, 15, 4, 11], dtype=np.float64)
+    sizes = np.maximum(
+        1, (weights / weights.sum() * n).astype(int)
+    )
+    pts = np.concatenate(
+        [rng.normal(c, 0.4, (s, 2)) for c, s in zip(centers, sizes)]
+    )
+    rng.shuffle(pts)
+    return pts
+
+
+def _cli_config(args):
+    from dbscan_tpu.config import DBSCANConfig, Engine
+
+    return DBSCANConfig(
+        eps=args.eps,
+        min_points=args.min_points,
+        max_points_per_partition=args.maxpp,
+        engine=Engine.ARCHERY,
+        neighbor_backend="banded",
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dbscan_tpu.campaign",
+        description="Elastic fault-priced campaign driver: run one "
+        "clustering job as a work-stealing chunk-lease campaign over a "
+        "worker fleet, with deterministic preemption drills "
+        "(DBSCAN_FAULT_SPEC campaign#N clauses) and a priced replay "
+        "budget (campaign_replay_frac).",
+    )
+    p.add_argument("--n", type=int, default=8000,
+                   help="points in the deterministic demo dataset")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--eps", type=float, default=0.5)
+    p.add_argument("--min-points", type=int, default=5, dest="min_points")
+    p.add_argument("--maxpp", type=int, default=256,
+                   help="max points per partition")
+    p.add_argument("--ckpt", default=None,
+                   help="checkpoint dir (default: a fresh temp dir)")
+    p.add_argument("--workers", type=int, default=None,
+                   help="fleet size (default DBSCAN_CAMPAIGN_WORKERS)")
+    p.add_argument("--lease-s", type=float, default=None,
+                   help="lease heartbeat expiry "
+                   "(default DBSCAN_CAMPAIGN_LEASE_S)")
+    p.add_argument("--budget-s", type=float, default=None,
+                   help="campaign wall budget")
+    p.add_argument("--chunk-slots", type=int, default=None,
+                   help="compact chunk slot budget override (drill "
+                   "knob: the env knob clamps at 2^16, too coarse for "
+                   "laptop-scale multi-chunk drills)")
+    p.add_argument("--fault-spec", default=None,
+                   help="DBSCAN_FAULT_SPEC for this campaign, e.g. "
+                   "'campaign#0:TRANSIENT;campaign#2:PERSISTENT'")
+    p.add_argument("--verify", action="store_true",
+                   help="also run a clean single-process train and "
+                   "assert byte-identical labels")
+    p.add_argument("--json", default=None,
+                   help="write the capture record to this path "
+                   "(bench-history-ingestible)")
+    p.add_argument("--leg", action="store_true",
+                   help="run ONE subprocess leg over --ckpt instead of "
+                   "a whole campaign (the frontier/drill child entry)")
+    p.add_argument("--chunks", default=None,
+                   help="with --leg: comma-separated chunk ids to "
+                   "lease (omitted = full frontier leg)")
+    p.add_argument("--tier", default="device", choices=("device", "cpu"),
+                   help="with --leg: dispatch tier")
+    args = p.parse_args(argv)
+
+    if args.fault_spec is not None:
+        os.environ["DBSCAN_FAULT_SPEC"] = args.fault_spec
+        faults.reset_registry()
+    from dbscan_tpu.parallel import driver
+
+    if args.chunk_slots is not None:
+        driver._COMPACT_CHUNK_SLOTS = max(256, int(args.chunk_slots))
+    pts = demo_points(args.n, args.seed)
+    cfg = _cli_config(args)
+    ckpt_dir = args.ckpt or tempfile.mkdtemp(prefix="dbscan_campaign_")
+
+    if args.leg:
+        if args.chunks is not None:
+            chunks = frozenset(
+                int(c) for c in args.chunks.split(",") if c.strip()
+            )
+            leg = driver.CampaignLeg(chunks=chunks, tier=args.tier)
+            out = driver.train_arrays(
+                pts, cfg, checkpoint_dir=ckpt_dir, campaign=leg
+            )
+            print(json.dumps(out.stats.get("campaign_chunks_done", [])))
+        else:
+            out = driver.train_arrays(pts, cfg, checkpoint_dir=ckpt_dir)
+            print(
+                json.dumps(
+                    {
+                        "n_clusters": int(out.n_clusters),
+                        "resumed": bool(
+                            out.stats.get("resumed_from_checkpoint", False)
+                        ),
+                    }
+                )
+            )
+        return 0
+
+    ensure_campaign_key(
+        ckpt_dir,
+        {
+            "n": args.n,
+            "seed": args.seed,
+            "eps": args.eps,
+            "min_points": args.min_points,
+            "maxpp": args.maxpp,
+            "chunk_slots": int(driver._COMPACT_CHUNK_SLOTS),
+            "group_slots": int(config.env("DBSCAN_GROUP_SLOTS")),
+        },
+    )
+    job = TrainChunkJob(pts, cfg, ckpt_dir)
+    result = Campaign(
+        job,
+        workers=args.workers,
+        lease_s=args.lease_s,
+        budget_s=args.budget_s,
+    ).run()
+    import jax
+
+    row = result.row("campaign")
+    row["backend"] = jax.default_backend()
+    row["campaign_n"] = args.n
+    row["campaign_workers"] = result.workers
+    if args.verify and result.output is not None:
+        clean = driver.train_arrays(pts, cfg)
+        row["labels_equal"] = bool(
+            np.array_equal(clean.clusters, result.output.clusters)
+            and np.array_equal(clean.flags, result.output.flags)
+        )
+    print(json.dumps(row, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(row, f, indent=2)
+    if not result.complete:
+        return 1
+    if args.verify and row.get("labels_equal") is False:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -417,6 +417,29 @@ ENV_VARS = _env_table(
         "and reports dropped_spans in the export.",
     ),
     EnvVar(
+        "DBSCAN_CAMPAIGN_WORKERS", "int", 2,
+        "Worker fleet size for chunk-leased campaigns "
+        "(dbscan_tpu/campaign.py Campaign; python -m dbscan_tpu.campaign).",
+    ),
+    EnvVar(
+        "DBSCAN_CAMPAIGN_LEASE_S", "float", 30.0,
+        "Campaign lease heartbeat expiry: a leased worker that banks no "
+        "chunk (and sends no heartbeat) for this long has its chunks "
+        "requeued and restolen by the rest of the fleet.",
+    ),
+    EnvVar(
+        "DBSCAN_CAMPAIGN_MIN_CHUNK", "int", 1,
+        "Floor of the fault-rate-aware lease size ladder: a worker "
+        "whose leases keep faulting halves its chunk batch down to "
+        "this many chunks per lease.",
+    ),
+    EnvVar(
+        "DBSCAN_CAMPAIGN_MAX_CHUNK", "int", 8,
+        "Cap of the fault-rate-aware lease size ladder: sustained "
+        "healthy leases double the batch back up to this many chunks "
+        "per lease.",
+    ),
+    EnvVar(
         "DBSCAN_FAULT_SPEC", "str", "",
         "Deterministic fault-injection spec, semicolon-separated "
         "site#ordinal:KIND[*count] clauses (faults.parse_fault_spec).",

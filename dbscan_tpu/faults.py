@@ -74,9 +74,10 @@ SITE_SPILL_LEVEL = "spill_level"  # level-synchronous spill-tree dispatch
 SITE_STREAM = "stream"  # streaming per-batch update step
 SITE_PULL = "pull"  # pipelined compact-chunk pull (parallel/pipeline.py)
 SITE_CELLCC = "cellcc_cc"  # device cellcc finalize (cellgraph.finalize_device)
+SITE_CAMPAIGN = "campaign"  # campaign worker lease (dbscan_tpu/campaign.py)
 _SITES = (
     SITE_DISPATCH, SITE_BANDED, SITE_SPILL, SITE_SPILL_LEVEL,
-    SITE_STREAM, SITE_PULL, SITE_CELLCC, "*",
+    SITE_STREAM, SITE_PULL, SITE_CELLCC, SITE_CAMPAIGN, "*",
 )
 
 
@@ -126,8 +127,14 @@ def parse_fault_spec(spec: str) -> Tuple[FaultClause, ...]:
     Grammar: semicolon-separated clauses ``site#ordinal:KIND[*count]``:
 
     - ``site``: ``dispatch`` | ``banded`` | ``spill`` | ``spill_level``
-      | ``stream`` | ``pull`` | ``cellcc_cc`` | ``*`` (any supervised
-      site, ordinal counted globally);
+      | ``stream`` | ``pull`` | ``cellcc_cc`` | ``campaign`` | ``*``
+      (any supervised site, ordinal counted globally). The ``campaign``
+      site is consumed per LEASE by the campaign driver
+      (dbscan_tpu/campaign.py), not per device dispatch: ``TRANSIENT``
+      kills the leased worker after it banks one chunk (steal/resume
+      drill), ``PERSISTENT`` wedges it (its lease must heartbeat-expire
+      and be restolen), ``RESOURCE_EXHAUSTED`` degrades the worker to
+      the CPU tier before the lease runs;
     - ``ordinal``: 0-based index of the supervised dispatch at that
       site (each :func:`supervised` call consumes one ordinal);
     - ``KIND``: ``TRANSIENT`` (fails ``count`` attempts, then heals),
@@ -267,6 +274,17 @@ def pull_site_active() -> bool:
     either way: they surface at the consuming wait and hit the
     driver's abort guard."""
     return any(c.site == SITE_PULL for c in get_registry().clauses)
+
+
+def campaign_site_active() -> bool:
+    """True when the active fault spec names the ``campaign`` site
+    explicitly. The campaign driver consumes one ``campaign`` ordinal
+    per granted lease ONLY then — the same opt-in discipline as
+    :func:`pull_site_active`: an unconditional consume would shift the
+    global (``*``-clause) ordinal stream every existing spec was
+    written against, and would interleave nondeterministically, since
+    leases are granted on campaign worker threads."""
+    return any(c.site == SITE_CAMPAIGN for c in get_registry().clauses)
 
 
 class FaultCounters:

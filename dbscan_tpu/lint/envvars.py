@@ -54,7 +54,12 @@ def _environ_read_name(node: ast.AST) -> Optional[ast.AST]:
                 return node.args[0] if node.args else None
         elif isinstance(f, ast.Name) and f.id == "getenv":
             return node.args[0] if node.args else None
-    elif isinstance(node, ast.Subscript):
+    elif isinstance(node, ast.Subscript) and isinstance(
+        node.ctx, ast.Load
+    ):
+        # Load context only: os.environ["DBSCAN_X"] = ... is a WRITE —
+        # setting a knob (drill CLIs, test harnesses) is not a registry
+        # bypass, since the value is read back through config.env
         v = node.value
         is_environ = (
             isinstance(v, ast.Attribute) and v.attr == "environ"

@@ -86,6 +86,7 @@ SECTIONS = {
     "memory": ("gauge", schema.PREFIX_MEMORY),
     "compiles": ("counter", schema.PREFIX_COMPILES),
     "faults": ("counter", schema.PREFIX_FAULTS),
+    "campaign": ("counter", schema.PREFIX_CAMPAIGN),
     "devtime": ("counter", _DEVTIME_KEYS),
     "pull_check": ("counter", _PULL_CHECK_KEYS),
 }
@@ -477,9 +478,27 @@ def analyze(data: dict, top: Optional[int] = None) -> dict:
             k: v for k, v in sorted(counters.items())
             if k.startswith(schema.PREFIX_FAULTS)
         },
+        "campaign": _campaign_rollup(counters),
         "devtime": _devtime_rollup(counters, spans),
         "pull_check": _pull_device_check(counters, spans),
     }
+
+
+def _campaign_rollup(counters: dict) -> dict:
+    """The campaign section: every campaign.* counter plus the derived
+    ``campaign.replay_frac`` (replayed/work wall — the figure the bench
+    row stamps and obs/regress gates; dbscan_tpu/campaign.py)."""
+    out = {
+        k: v
+        for k, v in sorted(counters.items())
+        if k.startswith(schema.PREFIX_CAMPAIGN)
+    }
+    work = out.get("campaign.work_wall_s", 0.0)
+    if work > 0:
+        out["campaign.replay_frac"] = round(
+            min(1.0, out.get("campaign.replayed_wall_s", 0.0) / work), 4
+        )
+    return out
 
 
 # --- multi-shard merge ------------------------------------------------
@@ -808,6 +827,12 @@ def render(report: dict) -> str:
         out.append("")
         out.append("-- faults --")
         for k, v in report["faults"].items():
+            v = round(v, 6) if isinstance(v, float) else v
+            out.append(f"{k:<36} {v:>12}")
+    if report.get("campaign"):
+        out.append("")
+        out.append("-- campaign (priced replay budget) --")
+        for k, v in report["campaign"].items():
             v = round(v, 6) if isinstance(v, float) else v
             out.append(f"{k:<36} {v:>12}")
     dev = report.get("devtime") or {}

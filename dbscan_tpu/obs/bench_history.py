@@ -56,11 +56,14 @@ from dbscan_tpu.obs import schema
 # share of the rep wall — device utilization lost = work moved back to
 # the host/link, so it regresses DOWN like the overlap ratio;
 # _cc_iters: the device cellcc finalize's CC sweep count — a
-# propagation-depth figure that regresses UP like the spill levels)
+# propagation-depth figure that regresses UP like the spill levels;
+# _replay_frac: the campaign driver's priced restart overhead —
+# replayed wall / total work wall — which regresses UP like a wall)
 _EXACT_KEYS = ("value", "seconds", "vs_baseline")
 _SUFFIXES = (
     "_seconds", "_s", "_mpts", "_vs_baseline", "_overlap_ratio",
     "_pred_ratio", "_spill_levels", "_busy_frac", "_cc_iters",
+    "_replay_frac",
 )
 # numeric-but-not-perf keys the suffix rule would otherwise catch —
 # declared with the telemetry schema (the keys are fault-counter
@@ -90,7 +93,9 @@ def git_rev(cwd: Optional[str] = None) -> str:
 def _unit_for(metric: str, obj: dict) -> Optional[str]:
     if metric == "value":
         return obj.get("unit")
-    if metric.endswith(("_overlap_ratio", "_pred_ratio", "_busy_frac")):
+    if metric.endswith(
+        ("_overlap_ratio", "_pred_ratio", "_busy_frac", "_replay_frac")
+    ):
         return "ratio"
     if metric.endswith("_spill_levels"):
         return "levels"

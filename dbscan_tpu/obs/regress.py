@@ -65,6 +65,11 @@ def direction(metric: str, unit: Optional[str] = None) -> Optional[str]:
         # device cellcc CC sweeps: each is a full [C, 25] gather pass,
         # so a propagation-count blowup regresses UP like a wall
         return LOWER_BETTER
+    if metric.endswith("_replay_frac"):
+        # campaign restart overhead (replayed wall / total work wall,
+        # dbscan_tpu/campaign.py): more of the campaign's wall spent
+        # recomputing stolen/killed leases regresses UP like a wall
+        return LOWER_BETTER
     if metric.endswith(("_seconds", "_s")) or metric == "seconds":
         return LOWER_BETTER
     if metric.endswith(("_mpts", "_vs_baseline", "_throughput")) or metric in (

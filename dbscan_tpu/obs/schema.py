@@ -115,6 +115,26 @@ COUNTERS = {
     "pull.bytes": "bytes routed through the pull pipeline (size hints)",
     "pull.stalls": "pull-pipeline stall warnings emitted (a consumer "
     "blocked past DBSCAN_PULL_STALL_S on one job)",
+    "campaign.leases": "campaign chunk/frontier leases granted",
+    "campaign.chunks_done": "campaign chunks banked across leases",
+    "campaign.steals": "chunks requeued from failed/expired leases "
+    "(available to be restolen by the fleet)",
+    "campaign.expired": "leases expired by the heartbeat window "
+    "(DBSCAN_CAMPAIGN_LEASE_S) — the wedged-worker steal path",
+    "campaign.kills": "injected campaign worker kills (TRANSIENT "
+    "clauses at the campaign fault site)",
+    "campaign.wedges": "injected campaign worker wedges (PERSISTENT "
+    "clauses: the lease must expire and be restolen)",
+    "campaign.degrades": "campaign workers degraded to the CPU tier "
+    "(real retries-exhausted device faults, or injected "
+    "RESOURCE_EXHAUSTED)",
+    "campaign.repartitions": "fault-rate-aware lease-size changes "
+    "(halved while faults run hot, doubled back under health)",
+    "campaign.work_wall_s": "summed campaign lease wall (the replay "
+    "pricing denominator)",
+    "campaign.replayed_wall_s": "summed pro-rata wall of chunks that "
+    "had to be recomputed after a lease failed/expired "
+    "(campaign_replay_frac numerator)",
     "flightrec.dumps": "flight-recorder postmortem dumps written",
     "devtime.samples": "dispatches bracketed by the ready-sync "
     "device-timeline hooks (DBSCAN_DEVTIME)",
@@ -145,6 +165,10 @@ GAUGES = {
     "pull.queue_depth": "pull-pipeline jobs submitted and not yet "
     "executed (pending + started-ahead; a wedged engine shows a "
     "frozen nonzero depth in the flight dump)",
+    "campaign.queue_depth": "campaign chunks not yet banked (pending "
+    "+ leased; a stalled campaign freezes it nonzero)",
+    "campaign.workers_active": "campaign worker threads currently "
+    "started (0 once the fleet joined)",
 }
 
 SPANS = {
@@ -171,6 +195,12 @@ SPANS = {
     "prior overlapped chunk-pull seconds ride the pull_prior_s attr, "
     "timings['cellcc_finalize_s'] adds them to this span's wall)",
     "pull.chunk": "one pull-pipeline job (transfer + host finalize)",
+    "campaign.run": "root span over one campaign (chunk-leased or "
+    "frontier)",
+    "campaign.lease": "one lease execution window (worker, chunk "
+    "count, tier, outcome attached)",
+    "campaign.finalize": "the campaign's assembly run over the "
+    "fully-banked checkpoint dir",
     "checkpoint.save_premerge": "pre-merge checkpoint write",
     "checkpoint.save_p1_chunk": "p1 chunk checkpoint write",
     "transfer.pull": "device->host pull (bytes in args)",
@@ -195,6 +225,19 @@ EVENTS = {
     "pull.stall": "a pull-pipeline consumer blocked past "
     "DBSCAN_PULL_STALL_S on one job (label + queue depth attached) — "
     "the wedged-engine mark the flight recorder exists to capture",
+    "campaign.steal": "unfinished chunks of a failed lease returned "
+    "to the queue (lease, worker, outcome, count attached)",
+    "campaign.expire": "a lease's heartbeat window lapsed — its "
+    "chunks were requeued for the fleet to steal",
+    "campaign.kill": "injected campaign worker kill fired (the leg "
+    "died through the driver's real abort path)",
+    "campaign.wedge": "injected campaign worker wedge fired (the "
+    "worker parks holding its lease until it expires)",
+    "campaign.degrade": "a campaign worker degraded to the CPU tier",
+    "campaign.repartition": "a worker's lease size adapted to its "
+    "fault rate (old/new size attached)",
+    "campaign.leg": "one frontier subprocess leg ended (rc, banked "
+    "chunk count, wall attached)",
     "flightrec.dump": "flight-recorder dump written (reason + abort "
     "site attached); the ring's final instant says why the file exists",
     "profile.window_open": "jax.profiler capture window opened at a "
@@ -230,6 +273,7 @@ PREFIX_MEMORY = "memory."
 PREFIX_COMPILES = "compiles."
 PREFIX_FAULTS = "faults."
 PREFIX_DEVTIME = "devtime."
+PREFIX_CAMPAIGN = "campaign."
 
 #: the hot/cold classification marks obs/analyze.py reads back
 RESIDENT_MARKS = ("resident_cache.hit", "resident_cache.miss")

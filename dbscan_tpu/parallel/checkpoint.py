@@ -23,6 +23,7 @@ recomputes from scratch.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
@@ -31,6 +32,11 @@ import zipfile
 from typing import Optional
 
 import numpy as np
+
+try:  # POSIX file locks guard the progress sidecar's read-modify-write
+    import fcntl
+except ImportError:  # pragma: no cover - non-posix fallback (no locking)
+    fcntl = None
 
 from dbscan_tpu import config, obs
 
@@ -240,6 +246,18 @@ def save_p1_chunk(
             **arrays,
         )
     os.replace(tmp, path)
+    # monotone write counter in the progress sidecar: the leg-progress
+    # signal retry harnesses read (bench.py / campaign.py) instead of
+    # trusting file mtimes. Best-effort — a failed bump must never turn
+    # a successfully banked chunk into a failed save (the mtime
+    # fallback still sees the file).
+    try:
+        bump_progress(ckpt_dir, PROGRESS_WRITE_COUNTER)
+    except Exception:  # noqa: BLE001 — pragma: no cover
+        # ANY sidecar failure (fs error, foreign/corrupt progress.json)
+        # must not turn the successfully banked chunk into a failed
+        # save; the mtime fallback still sees the file
+        pass
     obs.count("checkpoint.chunks_saved")
     obs.count(
         "checkpoint.chunk_bytes",
@@ -289,6 +307,40 @@ def load_p1_chunks(
     return out
 
 
+def p1_chunk_indices(
+    ckpt_dir: str, fingerprint: str, budget: int = 0
+) -> list:
+    """ALL saved chunk indices matching ``fingerprint`` and ``budget``,
+    gaps allowed — campaign legs (dbscan_tpu/campaign.py) bank disjoint
+    chunk subsets out of order, and the lease queue needs to know which
+    indices are already on disk so a resumed campaign only leases the
+    holes. The consecutive-prefix :func:`load_p1_chunks` stays the
+    merge-time gate: a finalize run adopts chunks only once the prefix
+    is complete."""
+    out = []
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(_P1_PREFIX) and name.endswith(".npz")):
+            continue
+        try:
+            ci = int(name[len(_P1_PREFIX) : -len(".npz")])
+        except ValueError:
+            continue
+        try:
+            with np.load(os.path.join(ckpt_dir, name)) as z:
+                if str(z["_fingerprint"]) != fingerprint:
+                    continue
+                if int(z["_budget"]) != int(budget):
+                    continue
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+            continue  # torn file: the hole gets re-leased
+        out.append(ci)
+    return sorted(out)
+
+
 # --- campaign progress sidecar ----------------------------------------
 #
 # A retry-resume harness (bench.py::m100_row) needs two numbers a dead
@@ -299,16 +351,79 @@ def load_p1_chunks(
 # prefix — files behind a gap never resume (see load_p1_chunks).
 
 _PROGRESS = "progress.json"
+_PROGRESS_LOCK = _PROGRESS + ".lock"
+
+#: monotonic count of p1-chunk WRITES in this checkpoint dir, bumped by
+#: :func:`save_p1_chunk` under the progress lock. Distinct from the
+#: consecutive-prefix ``chunks_done`` figure: a resumed leg overwriting
+#: chunk indices in place still bumps it, so a retry harness reads a
+#: counter DELTA as "this leg banked something" without trusting
+#: filesystem mtimes (coarse granularity / clock skew can misclassify a
+#: productive leg as stalled — two misses kills a campaign).
+PROGRESS_WRITE_COUNTER = "chunks_written"
 
 
-def write_progress(ckpt_dir: str, **fields) -> None:
-    """Atomically persist campaign-progress metadata (plan totals)."""
+@contextlib.contextmanager
+def _progress_locked(ckpt_dir: str):
+    """Exclusive advisory lock over the progress sidecar. flock locks
+    are per open file description, so this serializes BOTH concurrent
+    processes (campaign legs vs. the harness) and concurrent threads
+    (each entry opens its own fd). Non-posix platforms degrade to no
+    locking — same behavior as before this lock existed."""
     os.makedirs(ckpt_dir, exist_ok=True)
+    if fcntl is None:  # pragma: no cover - non-posix
+        yield
+        return
+    with open(os.path.join(ckpt_dir, _PROGRESS_LOCK), "a+") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
+
+
+def _write_progress_locked(ckpt_dir: str, prog: dict) -> None:
     path = os.path.join(ckpt_dir, _PROGRESS)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        json.dump(fields, f)
+        json.dump(prog, f)
     os.replace(tmp, path)
+
+
+def write_progress(ckpt_dir: str, **fields) -> None:
+    """MERGE campaign-progress metadata into progress.json under the
+    progress file lock (atomic replace; readers never see a torn file).
+
+    Merge — not replace — because the sidecar has concurrent writers
+    with disjoint keys: the driver's plan write (``chunks_total``),
+    the abort path (``aborted_*``), the chunk-save counter bump, and N
+    campaign workers' legs. An unlocked read-modify-write (or a
+    replacing write) could silently drop another writer's fields —
+    the lost-update race the concurrent-writer regression test pins.
+    Keys persist until overwritten: readers treat ``aborted_*`` as
+    "most recent abort", not "currently aborted"."""
+    with _progress_locked(ckpt_dir):
+        prog = read_progress(ckpt_dir)
+        prog.update(fields)
+        _write_progress_locked(ckpt_dir, prog)
+
+
+def bump_progress(ckpt_dir: str, key: str, by: int = 1) -> int:
+    """Atomically increment an integer progress field (missing = 0)
+    under the progress lock; returns the new value. A corrupt
+    (non-numeric) stored value restarts the counter from 0 rather than
+    raising — the counter is a progress heuristic, and its failure
+    must never poison the chunk save that triggered the bump."""
+    with _progress_locked(ckpt_dir):
+        prog = read_progress(ckpt_dir)
+        try:
+            val = int(prog.get(key, 0))
+        except (TypeError, ValueError):
+            val = 0
+        val += int(by)
+        prog[key] = val
+        _write_progress_locked(ckpt_dir, prog)
+    return val
 
 
 def note_abort(ckpt_dir: str, **fields) -> None:
@@ -316,10 +431,9 @@ def note_abort(ckpt_dir: str, **fields) -> None:
     exhausted its retries, dbscan_tpu/faults.py) into progress.json so a
     retry-resume harness can report WHERE a dead leg stopped — the
     driver's abort path flushes its compact chunk and records this just
-    before the fatal fault propagates."""
-    prog = read_progress(ckpt_dir)
-    prog.update(fields)
-    write_progress(ckpt_dir, **prog)
+    before the fatal fault propagates. Merge-under-lock: a concurrent
+    plan write or counter bump can no longer drop these fields."""
+    write_progress(ckpt_dir, **fields)
 
 
 def read_progress(ckpt_dir: str) -> dict:

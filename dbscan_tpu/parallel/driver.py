@@ -33,13 +33,14 @@ fixes):
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import functools
 import hashlib
 import logging
 import os as _os
 import time
 import weakref
-from typing import List, NamedTuple, Optional, Tuple
+from typing import Callable, FrozenSet, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -146,6 +147,51 @@ class TrainOutput(NamedTuple):
     partitions: List[Tuple[int, np.ndarray]]  # (id, float main rect [4])
     n_clusters: int
     stats: dict
+
+
+@dataclasses.dataclass(frozen=True)
+class CampaignLeg:
+    """One chunk-leased PARTIAL run of the banded device phase
+    (dbscan_tpu/campaign.py). The leg computes ONLY the p1 chunks in
+    ``chunks`` — every other banded group's dispatch is skipped — saves
+    each completed chunk's pulled artifacts at its PLAN-derived chunk
+    index, and returns a partial :class:`TrainOutput` (empty labels,
+    ``stats["campaign_partial"] = True``) BEFORE the merge phases. The
+    chunk indices come from the same accumulation rule ``_on_plan``
+    mirrors, so independently-leased legs produce exactly the chunk
+    files a single sequential run would, and a final unrestricted run
+    over the fully-banked dir loads them all and merges — labels
+    byte-identical by the checkpoint adoption contract. Requires the
+    banded compact path and a ``checkpoint_dir``.
+
+    ``chunks`` empty = plan-only leg: no dispatch at all; the leg packs,
+    writes ``progress.json`` (chunks_total), and reports the plan in its
+    partial stats.
+
+    ``tier`` = "cpu" routes every leased dispatch through the
+    per-group CPU degradation kernel (the whole-lease generalization of
+    the faults.py per-group fallback) — same algebra, labels unchanged.
+
+    ``kill_after`` > 0 is the deterministic worker-kill drill: after
+    that many chunks of this leg have been pulled AND saved, the leg
+    raises ``faults.FatalDeviceFault`` at the ``campaign`` site — the
+    abort guard banks progress + dumps the flight recorder exactly as
+    for a real mid-leg death, and the campaign worker accounts the
+    steal.
+
+    ``on_chunk(ci)`` fires after each chunk save (lease completion +
+    heartbeat); ``on_progress()`` fires after each leased GROUP
+    dispatch — the fine-grained heartbeat, so a lease whose first
+    chunk takes longer than the expiry window is still provably alive
+    (only a leg making NO forward progress for a whole window reads
+    as wedged)."""
+
+    chunks: FrozenSet[int]
+    tier: str = "device"
+    kill_after: int = 0
+    kill_ordinal: int = -1
+    on_chunk: Optional[Callable[[int], None]] = None
+    on_progress: Optional[Callable[[], None]] = None
 
 
 def clear_compile_cache() -> None:
@@ -1216,6 +1262,7 @@ def train_arrays(
     cfg: DBSCANConfig,
     mesh=None,
     checkpoint_dir: Optional[str] = None,
+    campaign: Optional[CampaignLeg] = None,
 ) -> TrainOutput:
     """Run the full distributed pipeline on host arrays.
 
@@ -1227,8 +1274,18 @@ def train_arrays(
     per-partition seed tables) is written there once the device phase
     completes, and a later call with the same data/config resumes straight
     at the merge (parallel/checkpoint.py).
+
+    campaign: a :class:`CampaignLeg` makes this call a chunk-leased
+    partial leg of a campaign (dbscan_tpu/campaign.py): only the leased
+    p1 chunks are computed and saved, and the call returns a partial
+    output before the merge. Requires ``checkpoint_dir``.
     """
     cfg = cfg.validate()
+    if campaign is not None and checkpoint_dir is None:
+        raise ValueError(
+            "a CampaignLeg requires checkpoint_dir: leased chunks are "
+            "banked as p1chunk restart points, which is the whole point"
+        )
     # observability (dbscan_tpu/obs): activate from DBSCAN_TRACE=path if
     # set — one env lookup; every hook below is a no-op when disabled
     obs.ensure_env()
@@ -1712,6 +1769,26 @@ def train_arrays(
     # chunks, and picks up where the chunks stop. cell_layout needs only
     # per-group tables, so none of this waits for packing to finish.
     compact_on = use_banded and not config_mod.env("DBSCAN_NO_COMPACT")
+    if campaign is not None:
+        if not (use_banded and compact_on):
+            raise ValueError(
+                "campaign chunk leases require the banded compact path "
+                "(the p1 chunk checkpoints ARE the lease currency): got "
+                f"metric={cfg.metric!r} "
+                f"neighbor_backend={cfg.neighbor_backend!r} "
+                f"compact={'on' if compact_on else 'off'}"
+            )
+        if campaign.tier not in ("device", "cpu"):
+            raise ValueError(
+                f"campaign tier must be 'device' or 'cpu', got "
+                f"{campaign.tier!r}"
+            )
+        # leased chunks pull serially at their own completion (below) —
+        # the campaign's parallelism is across legs, not inside one, and
+        # a serial pull keeps the save-then-heartbeat ordering the lease
+        # kill/steal accounting depends on
+        pull_pipe = None
+        pull_snap = None
     if compact_on:
         from dbscan_tpu.ops.banded import (
             banded_postpass,
@@ -1799,7 +1876,12 @@ def train_arrays(
     }
     p1_loaded: list = []
     p1_exp: list = []  # (chunk idx, (P, B, slab)) per CANONICAL ordinal
-    if compact_on and ckpt_fp is not None:
+    # campaign legs never ADOPT saved chunks (they only produce them):
+    # the lease queue already excludes completed chunks, and the
+    # consecutive-prefix loader cannot represent the arbitrary subsets
+    # concurrent legs bank — the finalize run (no CampaignLeg) is where
+    # the full prefix loads and merges
+    if compact_on and ckpt_fp is not None and campaign is None:
         from dbscan_tpu.parallel import checkpoint as _ckpt_p1
 
         p1_loaded = _ckpt_p1.load_p1_chunks(
@@ -1827,6 +1909,15 @@ def train_arrays(
                 ),
             }
         )
+
+    # Campaign chunk-lease state (campaign is not None): the plan map
+    # (ordinal -> chunk index, per-chunk group count / first ordinal,
+    # filled by _on_plan BEFORE any group emits), the per-chunk
+    # accumulation of leased groups' pending indices, and the completed
+    # chunk list the partial exit + kill drill read.
+    camp_plan: dict = {"chunk_of": [], "count": {}, "ord0": {}}
+    camp_acc: dict = {}
+    camp_done: list = []
 
     def _chunk_sig(ch, ord0):
         # salted with the chunk's starting banded ordinal: shapes are
@@ -2128,6 +2219,46 @@ def train_arrays(
         elif len(eager["records"]) >= 2:
             _pull_record(eager["records"][-2])
 
+    def _camp_complete_chunk(ci, ch):
+        """All of leased chunk ``ci``'s groups have arrived: run its
+        postpass, pull the artifacts serially, and persist them at the
+        PLAN chunk index (the composition signature is computed exactly
+        as a sequential run would, so the finalize run adopts the file
+        without redispatch). Fires the lease heartbeat, then the
+        deterministic kill drill when armed."""
+        rec = {
+            "ch": ch,
+            "ci": ci,
+            "sig": _chunk_sig(ch, camp_plan["ord0"][ci]),
+            "groups": [pending[i][0] for i in ch],
+        }
+        obs.count("checkpoint.chunk_flushes")
+        with obs.span(
+            "compact.flush_chunk", chunk=int(ci), groups=len(ch)
+        ):
+            _run_postpass(rec)
+        _pull_record(rec)
+        # the abort path's serial re-walk must see this record (a
+        # no-op: artifacts already pulled + saved)
+        eager["records"].append(rec)
+        camp_done.append(int(ci))
+        if campaign.on_chunk is not None:
+            campaign.on_chunk(int(ci))
+        if campaign.kill_after and len(camp_done) >= campaign.kill_after:
+            # deterministic worker-kill drill: die AFTER banking this
+            # chunk, through the same FatalDeviceFault/abort-guard path
+            # a real mid-leg death takes (note_abort + flightrec dump)
+            raise faults.FatalDeviceFault(
+                faults.SITE_CAMPAIGN,
+                campaign.kill_ordinal,
+                1,
+                faults.FaultInjected(
+                    faults.SITE_CAMPAIGN,
+                    campaign.kill_ordinal,
+                    faults.TRANSIENT,
+                ),
+            )
+
     def _abort_flush(site, ordinal, msg):
         """A device fault with no degradation path is about to abort the
         run. Before it propagates, bank a restart point at the LAST
@@ -2226,7 +2357,12 @@ def train_arrays(
 
     def _on_group(g):
         td = time.perf_counter()
-        if g.banded is None:
+        if g.banded is None and campaign is not None:
+            # dense small-bucket groups are not chunk currency: the
+            # finalize run computes them — a partial leg's result would
+            # be discarded at the early exit anyway
+            out = None
+        elif g.banded is None:
             out = _dispatch_partitions(
                 g, cfg, mesh, kernel_eps, kernel_metric,
                 resident_x=(
@@ -2236,6 +2372,22 @@ def train_arrays(
                 ),
                 resident_unit=resident_unit,
             )
+        elif compact_on and campaign is not None:
+            k = g.ordinal  # CANONICAL ordinal (no rotation: no adoption)
+            ci = (
+                camp_plan["chunk_of"][k]
+                if k is not None and k < len(camp_plan["chunk_of"])
+                else None
+            )
+            if ci is None or ci not in campaign.chunks:
+                out = None  # chunk not leased by this leg: skip entirely
+            elif campaign.tier == "cpu":
+                # degraded-tier lease: the whole leg runs the per-group
+                # CPU degradation kernel (same algebra as the device
+                # sweep — labels unchanged, faults.py contract)
+                out = _cpu_dispatch_banded_p1(g, cfg, mesh, kernel_eps)
+            else:
+                out = _dispatch_banded_p1(g, cfg, mesh, kernel_eps)
         elif compact_on:
             k = g.ordinal  # CANONICAL ordinal (arrival may be rotated)
             exp = (
@@ -2271,7 +2423,18 @@ def train_arrays(
                 osz, oout = inflight.pop(0)
                 jax.block_until_ready(oout)
                 inflight_slots[0] -= osz
-        if g.banded is not None and compact_on:
+        if g.banded is not None and compact_on and campaign is not None:
+            if out is not None:
+                if campaign.on_progress is not None:
+                    # per-group heartbeat: the lease is alive even when
+                    # its first CHUNK is still minutes away
+                    campaign.on_progress()
+                ci = camp_plan["chunk_of"][g.ordinal]
+                acc = camp_acc.setdefault(ci, [])
+                acc.append(len(pending) - 1)
+                if len(acc) == camp_plan["count"][ci]:
+                    _camp_complete_chunk(ci, acc)
+        elif g.banded is not None and compact_on:
             k = g.ordinal
             if k is not None and k < len(p1_exp):
                 # belongs to a saved chunk's composition (even on a shape
@@ -2308,15 +2471,22 @@ def train_arrays(
         total = 0
         chunks = 0
         cur = 0
-        for p_pad, b in entries:
+        for k, (p_pad, b) in enumerate(entries):
             sz = p_pad * b
             total += sz
             if cur and cur + sz > _COMPACT_CHUNK_SLOTS:
                 chunks += 1
                 cur = 0
             cur += sz
+            # ordinal -> plan chunk index (campaign chunk leases): group
+            # k lands in the chunk open when it arrives — exactly the
+            # record _flush_chunk would have put it in
+            camp_plan["chunk_of"].append(chunks)
+            camp_plan["count"][chunks] = camp_plan["count"].get(chunks, 0) + 1
+            camp_plan["ord0"].setdefault(chunks, k)
         if cur:
             chunks += 1
+        camp_plan["chunks_total"] = chunks
         from dbscan_tpu.parallel import checkpoint as _ckpt_p1
 
         _ckpt_p1.write_progress(
@@ -2383,6 +2553,52 @@ def train_arrays(
     if time_device:
         timings["banded_p1_sync_s"] = round(sync_spent[0], 6)
     t0 = time.perf_counter()
+
+    if campaign is not None:
+        # chunk-leased partial leg: every leased chunk was pulled and
+        # banked at its plan index as its last group arrived — there is
+        # nothing to merge here. Return the partial accounting the
+        # campaign worker reads; the finalize run (no CampaignLeg) loads
+        # the fully-banked prefix and merges.
+        missing = sorted(set(campaign.chunks) - set(camp_done))
+        if missing:
+            # plan/emission share one accumulation rule, so a leased
+            # chunk that never completed means the lease was written
+            # against a DIFFERENT plan (changed knobs/data slipping
+            # past the campaign key) — recomputing under the wrong plan
+            # would bank misindexed chunks, so fail loudly instead
+            raise RuntimeError(
+                f"campaign leg: leased chunk(s) {missing} never formed "
+                f"under this run's emission plan "
+                f"(chunks_total={camp_plan.get('chunks_total')}); the "
+                "campaign key no longer matches the checkpoint dir"
+            )
+        t_end = time.perf_counter()
+        timings["total_s"] = round(t_end - t_start, 6)
+        fault_stats = faults.counters.delta(fault_snap)
+        stats = {
+            "n_points": int(n),
+            "n_partitions": int(p_true),
+            "campaign_partial": True,
+            "campaign_tier": campaign.tier,
+            "campaign_chunks_done": sorted(camp_done),
+            "campaign_chunks_total": camp_plan.get("chunks_total"),
+            "faults": fault_stats,
+            "timings": timings,
+        }
+        obs.add_span(
+            "train",
+            t_start,
+            t_end,
+            n=int(n),
+            metric=cfg.metric,
+            n_partitions=int(p_true),
+            campaign_chunks=len(camp_done),
+        )
+        obs.flush()
+        return TrainOutput(
+            np.empty(0, np.int32), np.empty(0, np.int8), [], 0, stats
+        )
 
     # 5. per-partition clustering on device, one launch per bucket width
     # (ascending; same widths recur across runs -> jit cache hits).

@@ -1,0 +1,756 @@
+"""Elastic fault-priced campaign driver (dbscan_tpu/campaign.py).
+
+The acceptance contract this suite pins:
+
+- under a deterministic worker-kill fault spec (>= 2 kills across a
+  multi-chunk campaign) the campaign COMPLETES with labels
+  byte-identical to a fault-free run, and ``campaign_replay_frac``
+  prices the wasted wall;
+- a wedged worker's lease provably EXPIRES and is restolen by the rest
+  of the fleet (the heartbeat-expiry steal path);
+- a worker whose device path exhausts its retries DEGRADES to the CPU
+  tier instead of aborting the campaign — labels unchanged;
+- a campaign worker killed by SIGTERM between chunk flushes leaves a
+  flightrec dump, its banked chunks intact, and a clean steal+resume
+  by another worker (subprocess drill);
+- the ``DBSCAN_TSAN=1`` rerun of this suite reports zero races on the
+  shared queue state.
+
+Plus the queue/lease/replay-pricing unit semantics, the fault-rate
+lease-size ladder, frontier-mode subprocess campaigns (the m100 mold),
+and the ``campaign_replay_frac`` history promotion + regress-up gate.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import campaign as camp
+from dbscan_tpu import faults
+from dbscan_tpu.parallel import checkpoint as ckpt_mod
+from dbscan_tpu.parallel import driver
+
+pytestmark = pytest.mark.campaign
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry(monkeypatch):
+    monkeypatch.setenv("DBSCAN_FAULT_BACKOFF_S", "0")
+    faults.reset_registry()
+    yield
+    faults.reset_registry()
+
+
+def _pts():
+    return camp.demo_points(3000, seed=0)
+
+
+def _cfg():
+    from dbscan_tpu.config import DBSCANConfig, Engine
+
+    return DBSCANConfig(
+        eps=0.5, min_points=5, max_points_per_partition=256,
+        engine=Engine.ARCHERY, neighbor_backend="banded",
+    )
+
+
+@pytest.fixture
+def small_chunks(monkeypatch):
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 512)
+
+
+# --- ChunkQueue unit semantics -----------------------------------------
+
+
+def test_queue_lease_complete_and_replay_pricing():
+    q = camp.ChunkQueue(range(6), lease_s=60.0)
+    a = q.lease("w0", 4, "device")
+    assert a.chunks == (0, 1, 2, 3)
+    b = q.lease("w1", 4, "device")
+    assert b.chunks == (4, 5)
+    assert q.lease("w2", 1, "device") is None  # nothing pending
+    for ci in a.chunks:
+        q.note_chunk(a, ci)
+    q.release(a, wall_s=4.0, outcome="ok")
+    # b fails having banked one of two chunks: half the wall is wasted
+    q.note_chunk(b, 4)
+    q.release(b, wall_s=2.0, outcome="error")
+    snap = q.snapshot()
+    assert snap["work_wall_s"] == pytest.approx(6.0)
+    assert snap["replayed_wall_s"] == pytest.approx(1.0)
+    assert snap["steals"] == 1
+    assert not q.done()
+    c = q.lease("w0", 4, "device")
+    assert c.chunks == (5,)  # only the unfinished chunk re-leases
+    q.note_chunk(c, 5)
+    q.release(c, wall_s=1.0, outcome="ok")
+    assert q.done()
+    assert camp.replay_frac(7.0, 1.0) == pytest.approx(1.0 / 7.0, rel=1e-3)
+
+
+def test_queue_expiry_steals_wedged_lease():
+    q = camp.ChunkQueue(range(3), lease_s=0.05)
+    lease = q.lease("wedged", 3, "device")
+    assert q.lease("thief", 1, "device") is None
+    time.sleep(0.08)
+    stolen = q.expire_stale()
+    assert [s.lease_id for s in stolen] == [lease.lease_id]
+    assert lease.active is False and lease.outcome == "expired"
+    # the stale holder's late report is ignored — no double pricing
+    before = q.snapshot()
+    q.note_chunk(lease, 0)
+    q.release(lease, wall_s=99.0, outcome="ok")
+    after = q.snapshot()
+    assert after["work_wall_s"] == before["work_wall_s"]
+    assert after["chunks_done"] == 0
+    # the thief gets all three chunks back
+    steal = q.lease("thief", 3, "device")
+    assert steal.chunks == (0, 1, 2)
+    assert after["expired"] == 1 and after["steals"] == 3
+
+
+def test_queue_mark_done_excludes_banked_chunks():
+    q = camp.ChunkQueue(range(4), lease_s=60.0)
+    q.mark_done([1, 3])
+    lease = q.lease("w0", 10, "device")
+    assert lease.chunks == (0, 2)
+    q.note_chunk(lease, 0)
+    q.note_chunk(lease, 2)
+    q.release(lease, 1.0, "ok")
+    assert q.done()
+
+
+# --- fault-rate-aware lease-size ladder --------------------------------
+
+
+class _SyntheticJob:
+    """Scripted lease outcomes: 'ok' completes every chunk, 'fail'
+    raises after completing none, 'faulty' completes with a nonzero
+    retry delta (device faults that healed)."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.leases = []
+
+    def plan(self):
+        return {"output": None, "chunks_total": 12, "banked": []}
+
+    def run_lease(self, chunks, *, tier, kill_after=0, kill_ordinal=-1,
+                  on_chunk=None, heartbeat=None, should_stop=None):
+        if heartbeat is not None:
+            heartbeat()
+        mode = self.script.pop(0) if self.script else "ok"
+        self.leases.append((tuple(chunks), tier, mode))
+        if mode == "fail":
+            raise RuntimeError("synthetic leg failure")
+        for ci in chunks:
+            if on_chunk is not None:
+                on_chunk(ci)
+        retries = 2 if mode == "faulty" else 0
+        return {"faults": {"retries": retries, "fallbacks": 0}}
+
+    def finalize(self):
+        return "assembled"
+
+
+def test_worker_repartitions_lease_size_by_fault_rate():
+    job = _SyntheticJob(["faulty", "fail", "ok", "ok", "ok", "ok", "ok"])
+    result = camp.Campaign(
+        job, workers=1, lease_s=60.0, min_chunk=1, max_chunk=4,
+        budget_s=30.0, poll_s=0.01,
+    ).run()
+    assert result.complete and result.output == "assembled"
+    sizes = [len(c) for c, _t, _m in job.leases]
+    # starts at 2; the faulty lease halves to 1; the failed lease keeps
+    # it floored; two clean leases double back to 2, then toward 4
+    assert sizes[0] == 2
+    assert sizes[1] == 1  # halved after the faulty lease
+    assert max(sizes) == 4  # sustained health grew it to the cap
+    assert result.replay_frac > 0.0  # the failed lease was priced
+    assert result.chunks_done == result.chunks_total == 12
+
+
+class _SlowHeartbeatJob(_SyntheticJob):
+    """A healthy leg whose first chunk takes several expiry windows —
+    it must stay leased as long as it heartbeats (per-group progress),
+    never be stolen mid-compute."""
+
+    def __init__(self, beat_s, beats):
+        super().__init__([])
+        self.beat_s = beat_s
+        self.beats = beats
+
+    def run_lease(self, chunks, *, heartbeat=None, on_chunk=None, **kw):
+        self.leases.append((tuple(chunks), kw.get("tier"), "slow"))
+        for _ in range(self.beats):
+            time.sleep(self.beat_s)
+            if heartbeat is not None:
+                heartbeat()
+        for ci in chunks:
+            if on_chunk is not None:
+                on_chunk(ci)
+        return {"faults": {"retries": 0, "fallbacks": 0}}
+
+
+def test_healthy_slow_lease_heartbeats_instead_of_expiring():
+    """Regression (review finding): a lease whose first chunk outlives
+    DBSCAN_CAMPAIGN_LEASE_S must NOT be expired while it demonstrates
+    per-group progress — only a leg with no progress for a whole
+    window reads as wedged. Here every lease runs ~3 expiry windows
+    while heartbeating twice per window: zero expiries, zero replay."""
+    job = _SlowHeartbeatJob(beat_s=0.1, beats=6)  # 0.6s per lease
+    result = camp.Campaign(
+        job, workers=2, lease_s=0.2, min_chunk=4, max_chunk=4,
+        budget_s=30.0, poll_s=0.02,
+    ).run()
+    assert result.complete, result.last_error
+    assert result.expired == 0
+    assert result.steals == 0
+    assert result.replay_frac == 0.0
+
+
+def test_all_wedged_campaign_terminates_without_budget():
+    """Regression (review finding): every worker wedged (injected
+    PERSISTENT) with budget_s=None must terminate incomplete — not
+    spin forever on a queue nobody can drain."""
+    os.environ["DBSCAN_FAULT_SPEC"] = "campaign#0:PERSISTENT"
+    faults.reset_registry()
+    try:
+        job = _SyntheticJob([])
+        t0 = time.monotonic()
+        result = camp.Campaign(
+            job, workers=1, lease_s=0.2, poll_s=0.02,
+        ).run()
+        assert not result.complete
+        assert result.wedges == 1
+        assert job.leases == []  # the wedged worker never ran a leg
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        os.environ.pop("DBSCAN_FAULT_SPEC", None)
+        faults.reset_registry()
+
+
+def test_worker_retires_after_repeated_errors():
+    job = _SyntheticJob(["fail"] * 20)
+    result = camp.Campaign(
+        job, workers=1, lease_s=60.0, min_chunk=1, max_chunk=2,
+        budget_s=10.0, poll_s=0.01,
+    ).run()
+    assert not result.complete
+    assert result.output is None
+    assert "synthetic leg failure" in result.last_error
+    assert result.replay_frac == pytest.approx(1.0)  # nothing landed
+
+
+# --- the acceptance drills (real clustering job) -----------------------
+
+
+def _spec(monkeypatch, spec):
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", spec)
+    faults.reset_registry()
+
+
+def test_two_kills_campaign_labels_byte_identical(
+    tmp_path, monkeypatch, small_chunks
+):
+    """THE acceptance drill: >= 2 deterministic worker kills across a
+    multi-chunk campaign; the campaign completes, labels are
+    byte-identical to a fault-free run, and the replay budget priced
+    the kills. Each kill goes through the driver's REAL abort path, so
+    the abort site lands in the progress sidecar too."""
+    pts = _pts()
+    clean = driver.train_arrays(pts, _cfg())
+    _spec(monkeypatch, "campaign#0:TRANSIENT;campaign#2:TRANSIENT")
+    job = camp.TrainChunkJob(pts, _cfg(), str(tmp_path))
+    result = camp.Campaign(
+        job, workers=2, lease_s=30.0, budget_s=300.0, poll_s=0.05
+    ).run()
+    assert result.complete, result.last_error
+    assert result.kills == 2
+    assert result.chunks_total >= 3  # a real multi-chunk campaign
+    assert result.replay_frac > 0.0  # the kills cost priced wall
+    assert result.replay_frac <= 1.0
+    np.testing.assert_array_equal(result.output.clusters, clean.clusters)
+    np.testing.assert_array_equal(result.output.flags, clean.flags)
+    # the kill drove the driver's real abort path: site recorded
+    assert ckpt_mod.read_progress(str(tmp_path)).get(
+        "aborted_site"
+    ) == "campaign"
+
+
+def test_wedged_worker_lease_expires_and_is_restolen(
+    tmp_path, monkeypatch, small_chunks
+):
+    """A PERSISTENT campaign clause wedges a worker mid-campaign: its
+    lease must heartbeat-expire and its chunks must be restolen by the
+    other worker, completing the campaign with identical labels."""
+    pts = _pts()
+    clean = driver.train_arrays(pts, _cfg())
+    _spec(monkeypatch, "campaign#1:PERSISTENT")
+    job = camp.TrainChunkJob(pts, _cfg(), str(tmp_path))
+    result = camp.Campaign(
+        job, workers=2, lease_s=2.0, budget_s=300.0, poll_s=0.05
+    ).run()
+    assert result.complete, result.last_error
+    assert result.wedges == 1
+    assert result.expired >= 1  # the wedged lease provably expired
+    assert result.steals >= 1  # and its chunks were restolen
+    assert result.replay_frac > 0.0  # the wedge wall was priced
+    np.testing.assert_array_equal(result.output.clusters, clean.clusters)
+    np.testing.assert_array_equal(result.output.flags, clean.flags)
+
+
+def test_exhausted_worker_degrades_to_cpu_tier(
+    tmp_path, monkeypatch, small_chunks
+):
+    """An injected RESOURCE_EXHAUSTED at the campaign site degrades the
+    worker's whole lease stream to the CPU tier (the per-group
+    degradation machinery generalized to chunk leases) — the campaign
+    completes instead of aborting, labels byte-identical."""
+    pts = _pts()
+    clean = driver.train_arrays(pts, _cfg())
+    _spec(monkeypatch, "campaign#0:RESOURCE_EXHAUSTED")
+    job = camp.TrainChunkJob(pts, _cfg(), str(tmp_path))
+    result = camp.Campaign(
+        job, workers=1, lease_s=30.0, budget_s=300.0, poll_s=0.05
+    ).run()
+    assert result.complete, result.last_error
+    assert result.degrades == 1
+    np.testing.assert_array_equal(result.output.clusters, clean.clusters)
+    np.testing.assert_array_equal(result.output.flags, clean.flags)
+
+
+def test_campaign_resumes_over_banked_chunks(
+    tmp_path, monkeypatch, small_chunks
+):
+    """A campaign over a dir where an earlier (interrupted) campaign
+    banked some chunks leases ONLY the holes, and the premerge-complete
+    case short-circuits to a zero-lease result."""
+    pts = _pts()
+    clean = driver.train_arrays(pts, _cfg())
+    job = camp.TrainChunkJob(pts, _cfg(), str(tmp_path))
+    plan = job.plan()
+    total = plan["chunks_total"]
+    assert total >= 3
+    # bank chunk 0 and the last chunk "by hand" (a dead campaign's legs)
+    job.run_lease([0, total - 1], tier="device")
+    leased = []
+
+    class _Spy(camp.TrainChunkJob):
+        def run_lease(self, chunks, **kw):
+            leased.append(tuple(chunks))
+            return super().run_lease(chunks, **kw)
+
+    spy = _Spy(pts, _cfg(), str(tmp_path))
+    result = camp.Campaign(
+        spy, workers=1, lease_s=30.0, budget_s=300.0, poll_s=0.05
+    ).run()
+    assert result.complete
+    got = sorted(c for ch in leased for c in ch)
+    assert got == list(range(1, total - 1))  # only the holes leased
+    np.testing.assert_array_equal(result.output.clusters, clean.clusters)
+    # second campaign over the now-complete dir: premerge resume,
+    # zero leases, zero replay
+    again = camp.Campaign(
+        camp.TrainChunkJob(pts, _cfg(), str(tmp_path)),
+        workers=1, lease_s=30.0, poll_s=0.05,
+    ).run()
+    assert again.complete and again.leases == 0
+    assert again.replay_frac == 0.0
+    assert again.output.stats["resumed_from_checkpoint"] is True
+
+
+def test_waiting_for_device_lease_heartbeats_instead_of_expiring(
+    tmp_path, small_chunks
+):
+    """Regression (review finding): a worker queued BEHIND the
+    in-process device lease is healthy — its lease must heartbeat
+    through the wait (several expiry windows long here) instead of
+    being expired and restolen into duplicate recompute."""
+    pts = _pts()
+    job = camp.TrainChunkJob(pts, _cfg(), str(tmp_path))
+    total = job.plan()["chunks_total"]
+    q = camp.ChunkQueue(range(total), lease_s=0.4)
+    lease = q.lease("w0", total, "device")
+    assert camp._DEVICE_LEASE.acquire()  # a peer's leg holds the device
+    try:
+        t = threading.Thread(
+            target=lambda: job.run_lease(
+                sorted(lease.chunks),
+                tier="device",
+                on_chunk=lambda ci: q.note_chunk(lease, ci),
+                heartbeat=lambda: q.heartbeat(lease),
+            ),
+        )
+        t.start()
+        time.sleep(1.3)  # ~3 expiry windows spent blocked on the lock
+        assert q.expire_stale() == []  # the wait heartbeats kept it alive
+        assert lease.active
+    finally:
+        camp._DEVICE_LEASE.release()
+    t.join(180)
+    assert not t.is_alive()
+    q.release(lease, 1.0, "ok")
+    assert q.done()
+    snap = q.snapshot()
+    assert snap["expired"] == 0 and snap["replayed_wall_s"] == 0.0
+
+
+# --- SIGTERM mid-leg subprocess drill (satellite) ----------------------
+
+
+def _wait_for(pred, timeout_s, what):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if pred():
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_sigterm_mid_leg_leaves_dump_banked_chunks_and_resumes(
+    tmp_path, monkeypatch, small_chunks
+):
+    """A campaign worker killed by SIGTERM between chunk flushes must
+    leave a flightrec dump, its banked chunks intact, and a clean
+    steal+resume by another worker with byte-identical labels."""
+    pts = _pts()
+    clean = driver.train_arrays(pts, _cfg())
+    ck = tmp_path / "ck"
+    dump = tmp_path / "flight.json"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "DBSCAN_FLIGHTREC_PATH": str(dump),
+        # serial per-flush pulls: chunks bank one by one, so the
+        # SIGTERM window "between chunk flushes" is wide and real
+        "DBSCAN_EAGER_PULL": "1",
+    }
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dbscan_tpu.campaign",
+            "--leg", "--ckpt", str(ck),
+            "--n", "3000", "--chunk-slots", "512",
+        ],
+        env=env,
+        cwd=REPO,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        _wait_for(
+            lambda: ckpt_mod.count_p1_chunks(str(ck)) >= 1,
+            timeout_s=120,
+            what="first banked chunk",
+        )
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert proc.returncode != 0  # the leg really died
+    # flightrec postmortem written by the SIGTERM handler
+    _wait_for(lambda: dump.exists(), 10, "flightrec dump")
+    rep = json.loads(dump.read_text())
+    assert rep["reason"] == "SIGTERM"
+    # banked chunks are intact restart points
+    job = camp.TrainChunkJob(pts, _cfg(), str(ck))
+    banked = ckpt_mod.p1_chunk_indices(
+        str(ck), job._fingerprint(), budget=512
+    )
+    assert len(banked) >= 1
+    # another worker steals the rest and the campaign completes
+    result = camp.Campaign(
+        job, workers=1, lease_s=30.0, budget_s=300.0, poll_s=0.05
+    ).run()
+    assert result.complete, result.last_error
+    np.testing.assert_array_equal(result.output.clusters, clean.clusters)
+    np.testing.assert_array_equal(result.output.flags, clean.flags)
+
+
+# --- frontier mode (the m100 mold) -------------------------------------
+
+
+def test_frontier_campaign_kill_drill_resumes_and_prices_replay(
+    tmp_path, monkeypatch, small_chunks
+):
+    """Frontier campaign over subprocess legs: a TRANSIENT campaign
+    clause kills leg 1 right after it banks a chunk; leg 2 steals the
+    frontier, resumes from the banked chunks, and completes. The killed
+    leg's unbanked wall is priced into replay_frac."""
+    pts = _pts()
+    clean = driver.train_arrays(pts, _cfg())
+    ck = tmp_path / "ck"
+    _spec(monkeypatch, "campaign#0:TRANSIENT")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "DBSCAN_EAGER_PULL": "1",
+    }
+    env.pop("DBSCAN_FAULT_SPEC", None)  # the drill is the PARENT's
+    fr = camp.run_frontier(
+        str(ck),
+        [
+            sys.executable, "-m", "dbscan_tpu.campaign",
+            "--leg", "--ckpt", str(ck),
+            "--n", "3000", "--chunk-slots", "512",
+        ],
+        env=env,
+        max_leases=3,
+        budget_s=600.0,
+        leg_timeout_s=300.0,
+        rest_s=0.1,
+        poll_s=0.05,
+    )
+    assert fr.complete, fr.last_error
+    assert fr.kills == 1
+    assert fr.legs == 2
+    assert fr.replay_frac > 0.0
+    assert fr.chunks_done == fr.chunks_total
+    # the banked chunks merge into byte-identical labels
+    out = driver.train_arrays(pts, _cfg(), checkpoint_dir=str(ck))
+    np.testing.assert_array_equal(out.clusters, clean.clusters)
+    assert out.stats["resumed_from_checkpoint"] is True
+
+
+def test_frontier_resource_exhausted_degrades_leg_env(
+    tmp_path, monkeypatch, small_chunks
+):
+    """A RESOURCE_EXHAUSTED campaign clause on a frontier campaign
+    degrades the leg stream to the CPU backend (JAX_PLATFORMS=cpu in
+    the child env) instead of being silently ignored — the documented
+    grammar holds for both campaign shapes."""
+    ck = tmp_path / "ck"
+    _spec(monkeypatch, "campaign#0:RESOURCE_EXHAUSTED")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "DBSCAN_EAGER_PULL": "1"}
+    env.pop("DBSCAN_FAULT_SPEC", None)
+    fr = camp.run_frontier(
+        str(ck),
+        [
+            sys.executable, "-m", "dbscan_tpu.campaign",
+            "--leg", "--ckpt", str(ck),
+            "--n", "3000", "--chunk-slots", "512",
+        ],
+        env=env,
+        max_leases=2,
+        budget_s=600.0,
+        leg_timeout_s=300.0,
+        rest_s=0.1,
+        poll_s=0.1,
+    )
+    assert fr.complete, fr.last_error
+    assert fr.degrades == 1
+    assert fr.legs == 1  # degraded tier, not killed: one clean leg
+
+
+def test_frontier_stall_breakout_on_progress_counter(tmp_path):
+    """Two consecutive legs that bank nothing break the campaign out —
+    signalled by the sidecar's monotone chunk-write counter, not file
+    mtimes."""
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    fr = camp.run_frontier(
+        str(ck),
+        [sys.executable, "-c", "raise SystemExit(3)"],
+        env={**os.environ},
+        max_leases=5,
+        budget_s=60.0,
+        leg_timeout_s=30.0,
+        rest_s=0.05,
+        poll_s=0.05,
+    )
+    assert not fr.complete
+    assert fr.stall_break is True
+    assert fr.legs == 2  # broke out, did not burn all 5 leases
+    assert fr.replay_frac == pytest.approx(1.0)  # pure waste
+    assert "rc 3" in fr.last_error
+
+
+# --- leg-progress signal + campaign key --------------------------------
+
+
+def test_leg_progressed_counter_authoritative_with_mtime_fallback(
+    tmp_path,
+):
+    ck = str(tmp_path)
+    # no sidecar counter at all: mtime fallback
+    assert camp.progress_counter(ck) == -1
+    assert not camp.leg_progressed(ck, -1, time.time() + 60)
+    (tmp_path / "p1chunk0000.npz").write_bytes(b"x")
+    assert camp.leg_progressed(ck, -1, time.time() - 60)
+    # once the counter exists it is authoritative: stale mtimes in the
+    # window no longer count as progress
+    ckpt_mod.write_progress(ck, **{ckpt_mod.PROGRESS_WRITE_COUNTER: 5})
+    assert camp.progress_counter(ck) == 5
+    assert camp.leg_progressed(ck, 4, time.time() + 60)
+    assert not camp.leg_progressed(ck, 5, time.time() - 60)
+
+
+def test_ensure_campaign_key_invalidates_on_change(tmp_path):
+    ck = str(tmp_path)
+    key = {"n": 1, "maxpp": 2}
+    assert camp.ensure_campaign_key(ck, key) is False  # first write
+    ckpt_mod.save_p1_chunk(
+        ck, "fp", 0, "sig0",
+        np.array([[4, 512, 8]], dtype=np.int64),
+        {"combo": np.zeros(8, np.uint8)},
+        budget=512,
+    )
+    ckpt_mod.write_progress(ck, chunks_total=9)
+    assert camp.ensure_campaign_key(ck, key) is False  # unchanged: keep
+    assert ckpt_mod.count_p1_chunks(ck) == 1
+    assert camp.ensure_campaign_key(ck, {"n": 2, "maxpp": 2}) is True
+    assert ckpt_mod.count_p1_chunks(ck) == 0  # wiped
+    assert ckpt_mod.read_progress(ck) == {}
+
+
+# --- replay-frac promotion + regress gate ------------------------------
+
+
+def test_replay_frac_promoted_and_gated_regress_up():
+    from dbscan_tpu.obs import bench_history, regress
+
+    assert regress.direction("m100_campaign_replay_frac") == "lower"
+    assert regress.direction("campaign_replay_frac") == "lower"
+    recs = bench_history.normalize_capture(
+        {"campaign_replay_frac": 0.12, "backend": "cpu"}, "t.json", "rev"
+    )
+    assert [
+        (r["metric"], r["unit"]) for r in recs
+    ] == [("campaign_replay_frac", "ratio")]
+    history = [
+        {"metric": "campaign_replay_frac", "value": v, "unit": "ratio",
+         "backend": "cpu", "resident_hot": None, "rev": "r",
+         "source": f"h{i}.json"}
+        for i, v in enumerate((0.10, 0.12))
+    ]
+    bad = dict(history[0], value=0.55, source="fresh.json")
+    res = regress.compare([bad], history)
+    assert [e["metric"] for e in res["regressions"]] == [
+        "campaign_replay_frac"
+    ]
+    good = dict(history[0], value=0.11, source="fresh.json")
+    assert regress.compare([good], history)["regressions"] == []
+
+
+def test_committed_history_gates_campaign_replay_frac():
+    """The committed bench/history.jsonl carries enough
+    campaign_replay_frac samples for the regress gate to actually gate
+    (min_samples=2) — a future capture with doubled restart overhead
+    fails CI."""
+    from dbscan_tpu.obs import bench_history, regress
+
+    history = bench_history.load_history(
+        os.path.join(REPO, "bench", "history.jsonl")
+    )
+    samples = [
+        h for h in history if h["metric"] == "campaign_replay_frac"
+    ]
+    assert len(samples) >= 2, "committed replay-frac baseline missing"
+    worst = max(s["value"] for s in samples)
+    bad = {
+        "metric": "campaign_replay_frac",
+        "value": max(worst * 4.0, 0.9),
+        "unit": "ratio",
+        "backend": samples[0]["backend"],
+        "resident_hot": None,
+        "source": "fresh.json",
+    }
+    res = regress.compare([bad], history)
+    assert [e["metric"] for e in res["regressions"]] == [
+        "campaign_replay_frac"
+    ]
+
+
+# --- concurrency: the tsan acceptance rerun ----------------------------
+
+
+def test_campaign_queue_hammer_is_race_free():
+    """Raw concurrent hammer on one ChunkQueue under the runtime
+    sanitizer: every access must carry the queue monitor."""
+    from dbscan_tpu.lint import tsan
+
+    # under the DBSCAN_TSAN=1 rerun the sanitizer is already live for
+    # the whole process — don't reset/disable the accumulated state the
+    # atexit report asserts on
+    was_enabled = tsan.enabled()
+    if not was_enabled:
+        tsan.reset()
+        tsan.enable()
+    try:
+        q = camp.ChunkQueue(range(64), lease_s=60.0)
+
+        def worker(name):
+            while True:
+                lease = q.lease(name, 3, "device")
+                if lease is None:
+                    if q.done():
+                        return
+                    q.wait(0.01)
+                    continue
+                for ci in lease.chunks:
+                    q.note_chunk(lease, ci)
+                q.release(lease, 0.01, "ok")
+
+        threads = [
+            threading.Thread(target=worker, args=(f"w{i}",))
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert q.done()
+        tsan.assert_clean()
+    finally:
+        if not was_enabled:
+            tsan.disable()
+            tsan.reset()
+
+
+def test_campaign_suite_race_free_under_tsan(tmp_path):
+    """DBSCAN_TSAN=1 rerun of the campaign drills: the suite passes AND
+    the atexit report shows zero races / zero lock inversions across
+    the shared queue state and the worker fleet."""
+    report = tmp_path / "tsan_report.json"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "DBSCAN_TSAN": "1",
+        "DBSCAN_TSAN_REPORT": str(report),
+    }
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest",
+            os.path.join(REPO, "tests", "test_campaign.py"),
+            "-q", "-m", "not slow", "-p", "no:cacheprovider",
+            "-k", "kills or wedged or repartitions or hammer",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=420,
+    )
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    rep = json.loads(report.read_text())
+    assert rep["enabled"] is True
+    assert rep["races"] == [], rep["races"]
+    assert rep["lock_inversions"] == [], rep["lock_inversions"]
+    worker_threads = {
+        t
+        for site in rep["accesses"].values()
+        for t in site["threads"]
+        if t.startswith("dbscan-campaign")
+    }
+    assert worker_threads, "no campaign-worker activity recorded"

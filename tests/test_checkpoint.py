@@ -350,6 +350,91 @@ def test_invalidate_p1_chunk_gap_semantics(rng, tmp_path):
     ckpt.invalidate_p1_chunk(str(tmp_path / "nope"), 0)
 
 
+def test_progress_merge_survives_concurrent_writers(tmp_path):
+    """The lost-update race note_abort had: progress.json is a
+    read-modify-write shared by the driver's plan write, the abort
+    merge, the chunk-save counter bump, and (now) N campaign workers.
+    All writes merge under the progress file lock, so concurrent
+    writers with disjoint fields can never silently drop each other's
+    updates."""
+    import threading
+
+    ck = str(tmp_path)
+    n_threads, n_rounds = 8, 25
+    errors = []
+
+    def writer(i):
+        try:
+            for r in range(n_rounds):
+                ckpt.write_progress(ck, **{f"field_{i}": r})
+                ckpt.bump_progress(ck, "counter")
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(i,))
+        for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    prog = ckpt.read_progress(ck)
+    # no field lost to a concurrent read-modify-write
+    for i in range(n_threads):
+        assert prog[f"field_{i}"] == n_rounds - 1
+    # and the shared counter saw every single bump
+    assert prog["counter"] == n_threads * n_rounds
+
+
+def test_note_abort_merges_with_plan_fields(tmp_path):
+    """note_abort must never drop the plan totals a prior write_progress
+    landed (and vice versa) — the driver writes chunks_total minutes
+    before an abort merges its site in."""
+    ck = str(tmp_path)
+    ckpt.write_progress(ck, chunks_total=7, planned_groups=12)
+    ckpt.note_abort(ck, aborted_site="banded", aborted_ordinal=3)
+    prog = ckpt.read_progress(ck)
+    assert prog["chunks_total"] == 7
+    assert prog["planned_groups"] == 12
+    assert prog["aborted_site"] == "banded"
+    # a later plan write keeps the abort breadcrumb too (merge, not
+    # replace — readers treat aborted_* as "most recent abort")
+    ckpt.write_progress(ck, chunks_total=7)
+    assert ckpt.read_progress(ck)["aborted_site"] == "banded"
+
+
+def test_save_p1_chunk_bumps_monotone_write_counter(tmp_path):
+    """Every chunk save bumps the sidecar's chunks_written counter —
+    including in-place OVERWRITES of an existing index, which is
+    exactly the resumed-leg progress a bare file count cannot see (the
+    stall detector's signal, bench.py/campaign.py)."""
+    ck = str(tmp_path)
+    assert ckpt.read_progress(ck).get(ckpt.PROGRESS_WRITE_COUNTER) is None
+    for _ in range(2):  # second save overwrites chunk 0 in place
+        _dummy_chunk(ck, "fp", 0)
+    _dummy_chunk(ck, "fp", 1)
+    prog = ckpt.read_progress(ck)
+    assert prog[ckpt.PROGRESS_WRITE_COUNTER] == 3
+    assert ckpt.count_p1_chunks(ck) == 2
+
+
+def test_p1_chunk_indices_gaps_and_validation(tmp_path):
+    """p1_chunk_indices (the campaign lease queue's banked-chunk scan)
+    returns ALL matching indices — gaps allowed — and skips files from
+    a different fingerprint/budget or torn files."""
+    ck = str(tmp_path)
+    _dummy_chunk(ck, "fp", 0)
+    _dummy_chunk(ck, "fp", 3)  # gap at 1, 2
+    _dummy_chunk(ck, "other", 1)  # wrong fingerprint
+    _dummy_chunk(ck, "fp", 2, budget=2048)  # wrong budget
+    raw = (tmp_path / "p1chunk0003.npz").read_bytes()
+    (tmp_path / "p1chunk0004.npz").write_bytes(raw[: len(raw) // 2])
+    assert ckpt.p1_chunk_indices(ck, "fp", budget=512) == [0, 3]
+    assert ckpt.p1_chunk_indices(str(tmp_path / "nope"), "fp") == []
+
+
 def test_device_phase_sig_divergence_rechunks(rng, tmp_path, monkeypatch):
     """A saved chunk whose composition signature no longer matches (a
     stale/corrupt checkpoint) must NOT be adopted: its groups re-enter

@@ -432,6 +432,24 @@ def test_env_direct_read_flags(tmp_path):
     assert [f.line for f in findings] == [5, 6, 7]
 
 
+def test_env_direct_write_is_clean(tmp_path):
+    """Setting a knob (os.environ["DBSCAN_X"] = ...) is not a registry
+    bypass — drill CLIs (dbscan_tpu/campaign.py --fault-spec) and
+    harnesses set knobs that are read back through config.env; only
+    Load-context reads route around the registry."""
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import os
+
+        def arm(spec):
+            os.environ["DBSCAN_FAULT_SPEC"] = spec
+            del os.environ["DBSCAN_FAULT_SPEC"]
+        """,
+    )
+    assert _rules(findings) == []
+
+
 def test_env_accessor_of_declared_name_is_clean(tmp_path):
     findings, _ = _lint_source(
         tmp_path,
