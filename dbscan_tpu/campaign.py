@@ -1288,6 +1288,68 @@ def demo_points(n: int, seed: int = 0) -> np.ndarray:
     return pts
 
 
+def train_resharded(pts, mesh, **train_kw):
+    """One sharded train that survives chip drop (ROADMAP items 1+5
+    composed): a retries-exhausted device fault re-shards the run onto
+    a smaller mesh — half the devices, eventually single-device —
+    instead of dying. Labels are mesh-decomposition-independent (the
+    halo-merge fixed point and the dispatch sharding are pure layout;
+    pinned by tests/test_meshshard.py), so every degraded rerun returns
+    byte-identical output.
+
+    Drills ride the ``campaign`` fault site with the one-ordinal-per-
+    attempt discipline every campaign shape shares
+    (:func:`_consume_campaign_fault`): a ``campaign#N`` clause kills
+    attempt N before dispatch, exercising the re-shard path
+    deterministically. ``DBSCAN_MESH_RESHARD=0`` lets faults propagate
+    (the historical dead-run behavior).
+    """
+    from dbscan_tpu import train as _train
+    from dbscan_tpu.parallel import mesh as mesh_mod
+
+    cur = mesh
+    attempt = 0
+    while True:
+        kind, _n = _consume_campaign_fault()
+        try:
+            if kind is not None:
+                raise faults.FatalDeviceFault(
+                    faults.SITE_CAMPAIGN, _n, 1,
+                    RuntimeError(f"injected sharded-attempt fault: {kind}"),
+                )
+            return _train(pts, mesh=cur, **train_kw)
+        except faults.FatalDeviceFault as e:
+            k = mesh_mod.mesh_size(cur)
+            if not config.env("DBSCAN_MESH_RESHARD") or k <= 1:
+                raise
+            # the fault carries no device attribution, so we cannot
+            # route around the failed chip directly; ALTERNATE which
+            # half survives each rung so a single bad chip is excluded
+            # within two re-shards instead of riding a fixed low-index
+            # prefix all the way down the ladder
+            flat = list(cur.devices.flat)
+            half = max(1, k // 2)
+            devs = flat[half:] if attempt % 2 else flat[:half]
+            attempt += 1
+            new = mesh_mod.make_mesh(devs) if len(devs) > 1 else None
+            obs.count("mesh.reshards")
+            obs.event(
+                "mesh.reshard",
+                old_devices=k,
+                new_devices=len(devs),
+                error=str(e)[:200],
+            )
+            logger.warning(
+                "sharded run lost its mesh (%s); re-sharding %d -> %d "
+                "devices and rerunning (labels are decomposition-"
+                "independent)",
+                e,
+                k,
+                len(devs),
+            )
+            cur = new
+
+
 def _cli_config(args):
     from dbscan_tpu.config import DBSCANConfig, Engine
 

@@ -337,6 +337,29 @@ ENV_VARS = _env_table(
         "mid-run, freeing the staged HBM; labels are unchanged.",
     ),
     EnvVar(
+        "DBSCAN_MESH_MERGE", "bool", True,
+        "Collective halo-merge on multi-device meshes "
+        "(parallel/halo.py): the cross-partition border union runs as "
+        "a shard_map fixed point with ppermute/psum-style neighbor "
+        "collectives instead of the driver-side union-find; 0 keeps "
+        "the host union-find as the parity oracle (labels are "
+        "byte-identical either way).",
+    ),
+    EnvVar(
+        "DBSCAN_MESH_SHAPE", "str", None,
+        "2-D mesh factorization for make_mesh2d as 'PARTSxHALO' (e.g. "
+        "4x2); unset picks the most-square factorization of the device "
+        "count.",
+    ),
+    EnvVar(
+        "DBSCAN_MESH_RESHARD", "bool", True,
+        "Chip-drop degradation for sharded runs "
+        "(campaign.train_resharded): a retries-exhausted device fault "
+        "re-shards the run onto a smaller mesh (halving the device "
+        "count, eventually single-device) instead of dying; 0 lets the "
+        "fault propagate.",
+    ),
+    EnvVar(
         "DBSCAN_SPILL_DEVICE", "str", "auto",
         "Spill-tree device passes: 1 forces the accelerator path, 0 "
         "forces host BLAS, auto uses the device when a non-CPU backend "

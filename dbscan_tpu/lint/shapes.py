@@ -366,6 +366,24 @@ def _models() -> Dict[str, FamilyModel]:
                 note="unbounded statically: scales with resident "
                 "payload rows N (gated at runtime)",
             ),
+            FamilyModel(
+                "halo.merge",
+                [
+                    ArgModel("ua", ("EH",), INT),
+                    ArgModel("ub", ("EH",), INT),
+                ],
+                # temps: the replicated [NH] int32 label vector and its
+                # per-round scatter/ring/jump copies (~4 live at once)
+                # per shard; NH (padded node count) is not an arg dim —
+                # data-scaled with the per-partition cluster count,
+                # runtime-gated like the other data-scaled families
+                overhead=_sy("NH") * 4 * 4,
+                static_slots=None,
+                note="collective halo-merge fixed point "
+                "(parallel/halo.py): border-union edges shard over "
+                "every mesh axis, the label vector replicates; EH is "
+                "the ladder-padded edge count",
+            ),
             _level_model(),
             _level_final_model(),
         )

@@ -185,26 +185,37 @@ def normalize_capture(obj: dict, source: str, rev: str = "unknown") -> list:
 
     - driver wrapper (``tail``/``parsed``): recurse into the parsed
       record and every JSON line embedded in the tail;
-    - multichip dryrun (``n_devices``+``ok``): one ``multichip_ok``
-      record (the dryrun has no timing worth trending);
+    - multichip capture (``n_devices``+``ok``): the ``multichip_ok``
+      record, PLUS — since the harness became a real capture (PR 12)
+      rather than a correctness dryrun — every flat perf key the
+      capture carries (``multichip_mpts``, ``multichip_seconds``,
+      per-shard ``_busy_frac`` / ``_overlap_ratio`` figures) under the
+      ``multichip<n>`` backend, so sharded throughput trends and gates
+      like every other row; legacy dryruns have no such keys and
+      ingest exactly as before;
     - probe record (``rows`` list of dicts): each row's perf keys;
     - anything else: the perf keys of the object itself.
     """
     records: list = []
     if "n_devices" in obj and "ok" in obj:
-        # multichip dryruns also carry a `tail` log: this branch must
+        # multichip captures also carry a `tail` log: this branch must
         # win over the wrapper branch
-        return [
+        backend = f"multichip{obj.get('n_devices', 0)}"
+        records = [
             {
                 "metric": "multichip_ok",
                 "value": 1.0 if obj.get("ok") else 0.0,
                 "unit": None,
-                "backend": f"multichip{obj.get('n_devices', 0)}",
+                "backend": backend,
                 "resident_hot": None,
                 "rev": rev,
                 "source": source,
             }
         ]
+        sub = dict(obj)
+        sub["backend"] = backend
+        records += _records_from_metric_obj(sub, source, rev)
+        return records
     if "tail" in obj and isinstance(obj.get("tail"), str):
         parsed = obj.get("parsed")
         seen_texts = set()

@@ -49,6 +49,7 @@ COMPILE_FAMILIES = (
     "spill.gather",
     "spill.level",
     "spill.level_final",
+    "halo.merge",
 )
 
 #: HBM watermark sample sites (obs/memory.py `sample`): each emits
@@ -109,6 +110,15 @@ COUNTERS = {
     "spill.level_dispatches": "fused level-build dispatches issued "
     "(one per level + the closing compact; bounded by tree depth, "
     "vs one-per-node on the host recursion)",
+    "halo.rounds": "collective halo-merge neighbor-min sweeps to the "
+    "union fixed point (data-dependent convergence depth; labels are "
+    "round-count-independent, like cellcc.cc_iters)",
+    "halo.edges": "border-union edges merged collectively (doubly-"
+    "labeled halo seeds, the paper's executor-merge currency)",
+    "halo.nodes": "per-partition cluster nodes entering the collective "
+    "halo-merge",
+    "mesh.reshards": "sharded runs re-sharded onto a smaller mesh "
+    "after a chip-drop fault (campaign.train_resharded)",
     "pull.wait_s": "consumer seconds actually blocked on pipelined pulls",
     "pull.overlap_s": "pull/finalize seconds hidden behind other work",
     "pull.busy_s": "total pipelined pull+finalize wall (worker seconds)",
@@ -238,6 +248,9 @@ EVENTS = {
     "fault rate (old/new size attached)",
     "campaign.leg": "one frontier subprocess leg ended (rc, banked "
     "chunk count, wall attached)",
+    "mesh.reshard": "a chip-drop fault degraded a sharded run to a "
+    "smaller mesh (old/new device counts attached) — re-sharding, "
+    "not a dead campaign (ROADMAP items 1+5 composition)",
     "flightrec.dump": "flight-recorder dump written (reason + abort "
     "site attached); the ring's final instant says why the file exists",
     "profile.window_open": "jax.profiler capture window opened at a "

@@ -85,12 +85,13 @@ def _pack_csr(x_csr, feature_block: int) -> _PackedCSR:
 
 
 def _gram_scan(rows, cols, vals, n_rows: int, feature_block: int,
-               varying_axis: str = None):
+               varying_axes: tuple = ()):
     """Accumulate X @ X.T over feature blocks: scatter-densify each
-    [N, F_block] slab, one MXU matmul per block. ``varying_axis``: set
-    to the mesh axis name when tracing inside shard_map — the scan
+    [N, F_block] slab, one MXU matmul per block. ``varying_axes``: set
+    to the mesh axis names when tracing inside shard_map — the scan
     carry's zero init must be marked device-varying to match the
-    varying inputs (jax >= 0.9 shard_map type discipline)."""
+    varying inputs (jax >= 0.9 shard_map type discipline; a no-op on
+    older jax, mesh.pvary)."""
 
     def step(gram, triple):
         r, c, v = triple
@@ -102,8 +103,10 @@ def _gram_scan(rows, cols, vals, n_rows: int, feature_block: int,
         return gram, None
 
     init = jnp.zeros((n_rows, n_rows), dtype=jnp.float32)
-    if varying_axis is not None:
-        init = jax.lax.pcast(init, varying_axis, to="varying")
+    if varying_axes:
+        from dbscan_tpu.parallel import mesh as mesh_mod
+
+        init = mesh_mod.pvary(init, tuple(varying_axes))
     gram, _ = jax.lax.scan(step, init, (rows, cols, vals))
     return gram
 
@@ -197,13 +200,15 @@ def _compiled_leaf_batch(
     from jax.sharding import PartitionSpec
 
     from dbscan_tpu.ops.labels import CORE
-    from dbscan_tpu.parallel.mesh import PARTS_AXIS
+    from dbscan_tpu.parallel import mesh as mesh_mod
+
+    axes = mesh_mod.parts_axes(mesh)
 
     def block(rows, cols, vals, mask, eps):
         def one(args):
             r, c, v, m = args
             gram = _gram_scan(
-                r, c, v, w, feature_block, varying_axis=PARTS_AXIS
+                r, c, v, w, feature_block, varying_axes=axes
             )
             res = _cluster_gram_body(gram, eps, m, min_points, engine)
             return res.seed_labels, res.flags
@@ -213,13 +218,13 @@ def _compiled_leaf_batch(
         # the sparse production program, mirroring _compiled_block — so
         # multichip dryruns validate the communication path for sparse
         ncore = jnp.sum(flags == CORE, dtype=jnp.int32)
-        ncore = lax.psum(ncore, PARTS_AXIS)
+        ncore = lax.psum(ncore, axes)
         return seeds, flags, ncore
 
     assert mesh is not None  # only the multi-device dispatch builds this
-    spec = PartitionSpec(PARTS_AXIS)
+    spec = mesh_mod.parts_spec(mesh)
     return jax.jit(
-        jax.shard_map(
+        mesh_mod.shard_map(
             block,
             mesh=mesh,
             in_specs=(spec, spec, spec, spec, PartitionSpec()),
@@ -451,7 +456,7 @@ def _spill_sparse(
     # the DATA alone (finalize_merge docstring)
     clusters, flags, _ = finalize_merge(
         part_ids, point_idx, inst_seed, inst_flag, cand, inst_inner,
-        n, n_parts, max_b, canonical=True,
+        n, n_parts, max_b, canonical=True, mesh=mesh,
     )
     if stats_out is not None:
         # phase split in the driver's timings idiom: where the wall goes

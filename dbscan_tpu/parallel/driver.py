@@ -61,7 +61,7 @@ from dbscan_tpu.parallel import binning, cellgraph, partitioner
 from dbscan_tpu.parallel import mesh as mesh_mod
 from dbscan_tpu.parallel import pipeline as pipe_mod
 from dbscan_tpu.parallel.graph import uf_components
-from dbscan_tpu.parallel.mesh import PARTS_AXIS, mesh_size
+from dbscan_tpu.parallel.mesh import mesh_size
 
 logger = logging.getLogger(__name__)
 
@@ -255,14 +255,14 @@ def _compiled_block(
         # validate the communication path, at the cost of one fused scalar.
         ncore = jnp.sum(flags == CORE, dtype=jnp.int32)
         if mesh is not None:
-            ncore = lax.psum(ncore, PARTS_AXIS)
+            ncore = lax.psum(ncore, mesh_mod.parts_axes(mesh))
         return seeds, flags, ncore
 
     if mesh is None:
         return jax.jit(block)
-    spec = PartitionSpec(PARTS_AXIS)
+    spec = mesh_mod.parts_spec(mesh)
     return jax.jit(
-        jax.shard_map(
+        mesh_mod.shard_map(
             block,
             mesh=mesh,
             in_specs=(spec, spec),
@@ -313,7 +313,7 @@ def _compiled_banded_p1(
         # communication path even for all-banded workloads.
         ncore = jnp.sum(core, dtype=jnp.int32)
         if mesh is not None:
-            ncore = lax.psum(ncore, PARTS_AXIS)
+            ncore = lax.psum(ncore, mesh_mod.parts_axes(mesh))
         # counts are consumed on-device (core = counts >= minPts) and
         # nothing downstream reads them — returning them would pin
         # 4 B/slot of HBM across every banded group until the postpass
@@ -321,9 +321,9 @@ def _compiled_banded_p1(
 
     if mesh is None:
         return jax.jit(block)
-    spec = PartitionSpec(PARTS_AXIS)
+    spec = mesh_mod.parts_spec(mesh)
     return jax.jit(
-        jax.shard_map(
+        mesh_mod.shard_map(
             block,
             mesh=mesh,
             in_specs=(spec,) * 6,
@@ -389,14 +389,14 @@ def _compiled_block_resident(
         )
         ncore = jnp.sum(flags == CORE, dtype=jnp.int32)
         if mesh is not None:
-            ncore = lax.psum(ncore, PARTS_AXIS)
+            ncore = lax.psum(ncore, mesh_mod.parts_axes(mesh))
         return seeds, flags, ncore
 
     if mesh is None:
         return jax.jit(block)
-    spec = PartitionSpec(PARTS_AXIS)
+    spec = mesh_mod.parts_spec(mesh)
     return jax.jit(
-        jax.shard_map(
+        mesh_mod.shard_map(
             block,
             mesh=mesh,
             in_specs=(PartitionSpec(), spec, spec),
@@ -948,6 +948,8 @@ def finalize_merge(
     p_true: int,
     max_b: int,
     canonical: bool = False,
+    mesh=None,
+    shape_floors: Optional[dict] = None,
 ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Steps 6-9 of the reference pipeline (DBSCAN.scala:179-283) on flat
     instance tables: deterministic per-partition cluster enumeration,
@@ -961,6 +963,15 @@ def finalize_merge(
     Shared by the grid/spill drivers (train_arrays) and the sparse cosine
     front-end (ops/sparse.py), whose decompositions produce the same
     instance-table shape.
+
+    ``mesh``: with a multi-device mesh (and ``DBSCAN_MESH_MERGE`` on),
+    the union step — the one phase here that grows with the mesh — runs
+    as the collective halo-merge (parallel/halo.py): the border-union
+    edges shard over the mesh axes and iterate to the union-find fixed
+    point with ppermute/psum-style neighbor collectives, byte-identical
+    numbering included. None (or a 1-device mesh) keeps the host
+    union-find. ``shape_floors`` is the streaming ratchet dict for the
+    halo kernel's padded widths.
 
     ``canonical``: renumber the final global ids so clusters appear in
     order of their minimum member point row. The default numbering
@@ -1007,11 +1018,20 @@ def finalize_merge(
         uniq_e = np.unique(ranks[first[rest]] * span + ranks[rest])
         ua, ub = np.divmod(uniq_e, span)
 
-    # union-find + global-id assignment over the rank edges (native with
-    # dict-UnionFind fallback): one pass replacing the interpreted
-    # per-edge loop and the per-key numbering loop (reference
-    # DBSCAN.scala:206-222); gid_of_u aligns with upart/uloc by rank
-    n_clusters, gid_of_u = uf_components(ua, ub, n_uniq)
+    # union-find + global-id assignment over the rank edges; gid_of_u
+    # aligns with upart/uloc by rank (reference DBSCAN.scala:206-222).
+    # On a multi-device mesh the union runs IN the mesh — the collective
+    # halo-merge fixed point (parallel/halo.py) — instead of on the
+    # driver; numbering is byte-identical by the first-appearance ==
+    # min-rank argument in that module's docstring.
+    from dbscan_tpu.parallel import halo
+
+    if halo.merge_active(mesh):
+        n_clusters, gid_of_u = halo.collective_merge(
+            ua, ub, n_uniq, mesh, shape_floors=shape_floors
+        )
+    else:
+        n_clusters, gid_of_u = uf_components(ua, ub, n_uniq)
     logger.info("Total Clusters: %d, Unique: %d", n_uniq, n_clusters)
 
     # per-instance global id (0 for noise): labeled instances carry their
@@ -1360,19 +1380,29 @@ def train_arrays(
     fault_snap = faults.counters.snapshot()
 
     ckpt_fp = None
+    if checkpoint_dir is not None and mesh_mod.multiprocess():
+        # per-chunk skip/hit decisions are process-local state, but the
+        # miss branch issues cross-process collectives — hosts with
+        # divergent checkpoint contents would deadlock in them; and
+        # every process writing the same files races. The historical
+        # hard raise here turned a sharded job into a dead run over a
+        # knob that only affects restartability; degrade gracefully
+        # instead (BEFORE any partition work starts): the run proceeds
+        # un-checkpointed with identical labels, and checkpointed
+        # multi-host jobs belong to the campaign driver, whose chunk
+        # leases are coordinator-mediated by construction.
+        logger.warning(
+            "checkpoint_dir=%r ignored in multi-process runs (divergent "
+            "per-host checkpoint state would desynchronize the "
+            "collective sequence); proceeding WITHOUT checkpointing — "
+            "for checkpointed multi-host jobs use the campaign driver "
+            "(python -m dbscan_tpu.campaign / campaign.run_frontier), "
+            "whose leased p1 chunks are the coordinator-mediated "
+            "restart currency",
+            checkpoint_dir,
+        )
+        checkpoint_dir = None
     if checkpoint_dir is not None:
-        if mesh_mod.multiprocess():
-            # per-chunk skip/hit decisions are process-local state, but
-            # the miss branch issues cross-process collectives — hosts
-            # with divergent checkpoint contents would deadlock in them;
-            # and every process writing the same files races. Fail fast
-            # until a coordinator-mediated scheme exists.
-            raise ValueError(
-                "checkpoint_dir is not supported in multi-process runs: "
-                "checkpoint state must be identical on every host or the "
-                "resume-skip control flow desynchronizes the collective "
-                "sequence; run checkpointed jobs single-process"
-            )
         from dbscan_tpu.parallel import checkpoint as _ckpt
 
         ckpt_fp = _ckpt.run_fingerprint(pts, cfg)
@@ -1730,9 +1760,12 @@ def train_arrays(
     # finalize that consumes them run on a background worker, bounded by
     # DBSCAN_PULL_INFLIGHT/_BYTES, so transfers overlap host algebra and
     # remaining device dispatch. None under DBSCAN_PULL_PIPELINE=0 (every
-    # serial code path below is then byte-for-byte the pre-pipeline one)
-    # and in multi-process runs (pulls are collectives whose issue order
-    # must stay deterministic on the main thread).
+    # serial code path below is then byte-for-byte the pre-pipeline one).
+    # Multi-process runs get the COLLECTIVE-AWARE engine: jobs execute
+    # inline at their (plan-deterministic) submission points — the
+    # per-shard submission barrier that keeps every process's cross-host
+    # pull sequence identical — so stats["pull"] / pull_overlap_ratio
+    # exist per shard there too.
     pull_pipe = pipe_mod.get_engine()
     pull_snap = pull_pipe.totals() if pull_pipe is not None else None
     # DBSCAN_TIME_DEVICE=1: block synchronously on each banded phase-1
@@ -3110,6 +3143,7 @@ def train_arrays(
     res_cluster, res_flag, n_clusters = finalize_merge(
         inst_part, inst_ptidx, inst_seed, inst_flag, cand, inst_inner,
         n, p_true, max_b, canonical=rp is not None,
+        mesh=mesh, shape_floors=getattr(cfg, "shape_floors", None),
     )
 
     # spill-tree partitions have no rectangle representation

@@ -1,0 +1,211 @@
+"""Collective halo-merge: the cross-partition cluster union as an
+in-mesh fixed point.
+
+The reference paper's global step is driver work: every executor ships
+its doubly-labeled border points back, and the driver folds them through
+a union-find (DBSCAN.scala:187-222). Our ``finalize_merge`` kept that
+shape — ``graph.uf_components`` on the host — which means the one phase
+that grows with the MESH (more chips = more borders) ran on one CPU.
+arXiv:1912.06255's observation is that this merge is itself a connected-
+components problem over a tiny graph and parallelizes cleanly once the
+border unions become collectives; this module is that step as ONE
+``shard_map`` kernel over the device mesh.
+
+Shape of the computation:
+
+- **Nodes** are the per-partition clusters of the merge step — dense
+  RANKS into the unique ``(partition, local-id)`` table the driver
+  builds (``_local_ids_flat``). Rank order is partition-major, so a
+  contiguous block of ranks is a contiguous block of eps-halo'd spatial
+  partitions: chip blocks on the mesh ARE the paper's executor blocks.
+- **Edges** are the border unions: two clusters observed on the same
+  eps-halo point (the doubly-labeled border seeds). The edge table
+  shards over every mesh axis in contiguous blocks
+  (``mesh.parts_spec``); the node label vector is replicated.
+- **Iteration**: each round scatter-mins every shard's local edge
+  contributions into its label copy, then reconciles the shards with a
+  psum-style allreduce-min built from ``lax.ppermute`` neighbor
+  exchanges — one ring per mesh axis, dimension-ordered, so on a real
+  2-D slice each exchange only crosses torus neighbors — followed by
+  one pointer jump (the classic compression step,
+  ops/propagation.py). The ``lax.while_loop`` runs to the exact fixed
+  point the host union-find computes: every node's label is its
+  component-minimum rank.
+
+Byte-identical numbering: ``graph.uf_components`` assigns dense 1-based
+gids in first-appearance node order. A component's first appearance
+scanning ranks 0..n-1 is exactly its minimum-rank member — the fixed
+point's label value — so ``gid = cumsum(label == arange)[label]``
+reproduces the host numbering bit-for-bit (pinned by
+tests/test_meshshard.py against ``uf_components`` on random graphs and
+end-to-end on every engine).
+
+Shapes ride the usual ladders: nodes and edges pad to
+``binning._ladder_width`` rungs rounded up to a mesh-size multiple
+(``shard-indivisible``), so a second same-shaped sharded run compiles
+ZERO new kernels. ``DBSCAN_MESH_MERGE=0`` keeps the host union-find as
+the parity oracle; runs without a mesh (or a 1-device mesh) never enter
+this path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dbscan_tpu import config as config_mod
+from dbscan_tpu import obs
+from dbscan_tpu.parallel import mesh as mesh_mod
+from dbscan_tpu.parallel.binning import _ladder_width
+
+#: pad node the sentinel edges point at (self-loops: a no-op under min)
+_PAD_MULT = 128
+
+
+def _pad_up(n: int, k: int) -> int:
+    """Ladder rung >= n, rounded up to a multiple of k (the mesh-axis
+    block divisibility the shard-indivisible rule pins)."""
+    w = _ladder_width(max(1, n), _PAD_MULT)
+    return ((w + k - 1) // k) * k
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_halo_merge(n_pad: int, mesh):
+    """Jitted collective fixed-point kernel for one (node width, mesh)
+    pair; cached like the driver's dispatch builders so ladder-recurring
+    shapes never re-trace."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec
+
+    axes = mesh_mod.parts_axes(mesh)
+    sizes = {a: mesh.shape[a] for a in axes}
+
+    def ring_min(x):
+        # psum-style allreduce-min from ppermute neighbor exchanges:
+        # one ring per mesh axis in turn (dimension-ordered), each step
+        # passing the running partial to the next chip on that axis's
+        # ring — torus-neighbor traffic only, unlike a flat all_gather
+        acc = x
+        for ax in axes:
+            k = sizes[ax]
+            perm = [(i, (i + 1) % k) for i in range(k)]
+            part = acc
+            for _ in range(k - 1):
+                part = lax.ppermute(part, ax, perm)
+                acc = jnp.minimum(acc, part)
+        return acc
+
+    def block(ua, ub):
+        # ua/ub: this shard's block of the border-union edge table
+        # (int32 ranks; sentinel self-loops at the pad node). Labels
+        # start as identity over the full padded node space — tiny
+        # (cluster count, not instance count), so every shard carries a
+        # full copy and only EDGES shard.
+        none = jnp.int32(n_pad - 1)
+
+        def body(state):
+            lab, _, it = state
+            upd = lab.at[jnp.minimum(ua, none)].min(lab[jnp.minimum(ub, none)])
+            upd = upd.at[jnp.minimum(ub, none)].min(lab[jnp.minimum(ua, none)])
+            new = ring_min(upd)
+            # one pointer jump per sweep (ops/propagation.py rationale:
+            # more jumps cost more than the sweeps they save)
+            new = jnp.minimum(new, new[new])
+            return new, jnp.any(new != lab), it + 1
+
+        def cond(state):
+            _, changed, it = state
+            return changed & (it < n_pad)
+
+        init = jnp.arange(n_pad, dtype=jnp.int32)
+        # one unrolled step first: the while_loop carry must be
+        # data-derived for shard_map's type discipline, and body is
+        # idempotent at the fixed point (same device as propagation.py)
+        state = body((init, jnp.bool_(True), jnp.int32(0)))
+        lab, _, iters = lax.while_loop(cond, body, state)
+        return lab, iters
+
+    espec = mesh_mod.parts_spec(mesh)
+    return jax.jit(
+        mesh_mod.shard_map(
+            block,
+            mesh=mesh,
+            in_specs=(espec, espec),
+            out_specs=(PartitionSpec(), PartitionSpec()),
+            # the carry mixes varying scatter results with the psum-style
+            # ring reconciliation inside lax.while_loop; the vma checker
+            # has no rule for that composition (values are replicated by
+            # construction after every ring — pinned against the host
+            # union-find by tests/test_meshshard.py)
+            check_vma=False,
+        )
+    )
+
+
+def collective_merge(
+    ua: np.ndarray,
+    ub: np.ndarray,
+    n_uniq: int,
+    mesh,
+    shape_floors: Optional[dict] = None,
+) -> Tuple[int, np.ndarray]:
+    """In-mesh replacement for ``graph.uf_components`` over the border
+    union edges: returns ``(n_clusters, gid_of_u [n_uniq] int64)``,
+    byte-identical to the host union-find (module docstring).
+
+    ``shape_floors``: the streaming ratchet dict (binning._ratchet) —
+    padded widths only grow across micro-batches so steady-state
+    updates reuse exact jit signatures.
+    """
+    from dbscan_tpu.obs import compile as obs_compile
+    from dbscan_tpu.parallel.binning import _ratchet
+
+    if n_uniq == 0:
+        # nothing to merge: skip the dispatch AND the cross-host pulls
+        # (collectives in multi-process runs) a sentinel-only fixed
+        # point would burn
+        return 0, np.empty(0, dtype=np.int64)
+    k = mesh_mod.mesh_size(mesh)
+    n_pad = _ratchet(
+        shape_floors, "halo_nodes", _pad_up(n_uniq + 1, k)
+    )
+    e_pad = _ratchet(
+        shape_floors, "halo_edges", _pad_up(max(1, len(ua)), k)
+    )
+    # sentinel self-loops at the pad node: scatter-min no-ops
+    ua_p = np.full(e_pad, n_pad - 1, dtype=np.int32)
+    ub_p = np.full(e_pad, n_pad - 1, dtype=np.int32)
+    ua_p[: len(ua)] = ua
+    ub_p[: len(ub)] = ub
+    fn = _compiled_halo_merge(n_pad, mesh)
+    lab_dev, iters_dev = obs_compile.tracked_call(
+        "halo.merge",
+        fn,
+        mesh_mod.shard_host_array(mesh, ua_p),
+        mesh_mod.shard_host_array(mesh, ub_p),
+    )
+    lab = mesh_mod.pull_to_host(lab_dev)[:n_uniq].astype(np.int64)
+    rounds = int(mesh_mod.pull_to_host(iters_dev))
+    obs.count("halo.rounds", rounds)
+    obs.count("halo.edges", int(len(ua)))
+    obs.count("halo.nodes", int(n_uniq))
+    # dense 1-based gids in first-appearance order == component-min-rank
+    # order (a component first appears at its min-rank member, which is
+    # exactly the fixed-point label value)
+    is_root = lab == np.arange(n_uniq, dtype=np.int64)
+    gid_of_root = np.cumsum(is_root)
+    return int(gid_of_root[-1]), gid_of_root[lab].astype(np.int64)
+
+
+def merge_active(mesh) -> bool:
+    """True when the collective halo-merge replaces the host union-find:
+    a real (multi-device) mesh with ``DBSCAN_MESH_MERGE`` on."""
+    return (
+        mesh is not None
+        and mesh_mod.mesh_size(mesh) > 1
+        and bool(config_mod.env("DBSCAN_MESH_MERGE"))
+    )

@@ -10,7 +10,7 @@ Multi-host (DCN) extends the same mesh via jax.distributed initialization.
 from __future__ import annotations
 
 import time
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -19,11 +19,53 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from dbscan_tpu import obs
 
 PARTS_AXIS = "parts"
+#: second mesh axis of the 2-D scale-out layout (make_mesh2d): the
+#: partition axis shards over BOTH axes in contiguous blocks — chip
+#: (i, j) owns block i*cols+j — and the collective halo-merge
+#: (parallel/halo.py) runs its psum-style neighbor exchanges along each
+#: axis in turn (dimension-ordered, the torus-friendly schedule).
+HALO_AXIS = "halo"
 
 
 def multiprocess() -> bool:
     """True when this JAX runtime spans multiple processes (DCN job)."""
     return jax.process_count() > 1
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable ``shard_map``: every mesh kernel in the package
+    builds through here. Newer jax exposes ``jax.shard_map`` with the
+    vma (varying-mesh-axes) type discipline; 0.4.x keeps it under
+    ``jax.experimental.shard_map`` with the older ``check_rep`` checker,
+    which has no replication rule for ``lax.while_loop`` at all — so on
+    that line the check is disabled outright (the vma discipline is a
+    new-jax static check; disabling it never changes computed values).
+    Without this shim every on-mesh path AttributeErrors on 0.4.x,
+    which is exactly the class of environment this CPU container is."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
+def pvary(x, axes):
+    """Version-portable ``lax.pcast(..., to="varying")``: mark a
+    replicated value device-varying over ``axes`` inside a shard_map
+    body (the scan-carry discipline of jax >= 0.9). Older jax has no
+    varying-type system, so the no-op is exact there."""
+    if not axes:
+        return x
+    lax = jax.lax
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes if len(axes) > 1 else axes[0], to="varying")
+    return x
 
 
 def shard_host_array(mesh: Optional[Mesh], x):
@@ -42,7 +84,7 @@ def shard_host_array(mesh: Optional[Mesh], x):
     """
     if mesh is None or not multiprocess():
         return x
-    sharding = NamedSharding(mesh, PartitionSpec(PARTS_AXIS))
+    sharding = NamedSharding(mesh, parts_spec(mesh))
     return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
 
 
@@ -102,6 +144,65 @@ def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
     """1-D mesh over all (or the given) devices, axis name 'parts'."""
     devices = list(devices) if devices is not None else jax.devices()
     return Mesh(np.array(devices), (PARTS_AXIS,))
+
+
+def make_mesh2d(
+    devices: Optional[Sequence] = None,
+    shape: Optional[Tuple[int, int]] = None,
+) -> Mesh:
+    """2-D ('parts', 'halo') mesh: the executor grid of the reference's
+    cluster mapped onto a chip torus. The partition axis shards over
+    BOTH axes (parts_spec), so dispatch semantics are identical to the
+    1-D mesh at the same device count; what the second axis buys is the
+    dimension-ordered halo-merge exchange (parallel/halo.py) — each
+    psum-style reduction runs along one torus axis at a time, the
+    ICI-friendly schedule on real 2-D slices.
+
+    ``shape``: (parts, halo) factorization of the device count; default
+    honors ``DBSCAN_MESH_SHAPE`` ('PARTSxHALO', e.g. ``4x2``) and falls
+    back to the most-square one (8 -> 4x2, 4 -> 2x2, 2 -> 2x1). A shape
+    whose product mismatches the device count raises.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    k = len(devices)
+    if shape is None:
+        from dbscan_tpu import config
+
+        raw = config.env("DBSCAN_MESH_SHAPE")
+        if raw:
+            r, _, c = str(raw).lower().partition("x")
+            shape = (int(r), int(c))
+    if shape is None:
+        c = int(np.sqrt(k))
+        while c > 1 and k % c:
+            c -= 1
+        shape = (k // max(1, c), max(1, c))
+    if int(shape[0]) * int(shape[1]) != k:
+        raise ValueError(
+            f"mesh shape {tuple(shape)} does not cover {k} devices"
+        )
+    arr = np.array(devices).reshape(int(shape[0]), int(shape[1]))
+    return Mesh(arr, (PARTS_AXIS, HALO_AXIS))
+
+
+def parts_axes(mesh: Optional[Mesh]) -> tuple:
+    """The mesh axis names the partition axis shards over — ('parts',)
+    on the 1-D mesh, ('parts', 'halo') on the 2-D one. The tuple is
+    what collectives over "all chips" (ncore psum, halo pmin rings)
+    reduce over."""
+    if mesh is None:
+        return ()
+    return tuple(mesh.axis_names)
+
+
+def parts_spec(mesh: Optional[Mesh]) -> PartitionSpec:
+    """PartitionSpec sharding a leading partition axis over EVERY mesh
+    axis in contiguous blocks (the eps-halo'd block ownership of the
+    scale-out contract, PARITY.md "Mesh scale-out")."""
+    if mesh is None:
+        return PartitionSpec()
+    names = tuple(mesh.axis_names)
+    return PartitionSpec(names if len(names) > 1 else names[0])
 
 
 def mesh_size(mesh: Optional[Mesh]) -> int:

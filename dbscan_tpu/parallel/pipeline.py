@@ -66,9 +66,36 @@ driver can stamp ``stats["pull"]`` and bench can derive
 
 Off-switch: ``DBSCAN_PULL_PIPELINE=0`` makes :func:`get_engine` return
 None and every call site keeps its original serial code path
-byte-for-byte. Multi-process runs also get None: pulls there are
-cross-host collectives whose issue order must stay deterministic on the
-main thread (see mesh.pull_to_host).
+byte-for-byte.
+
+Collective-aware mode (multi-process runs): pulls there are cross-host
+collectives (``mesh.pull_to_host`` allgathers non-addressable shards),
+so their ISSUE ORDER must be identical on every process or the job
+deadlocks — the reason earlier revisions forced the engine off under
+``mesh.multiprocess()`` entirely. The engine now runs there with
+``collective=True``, which turns the submission order into a
+per-shard submission barrier:
+
+- jobs execute INLINE at the submission point, on the submitting
+  thread. A background worker issuing a cross-host allgather while the
+  main thread dispatches a psum-bearing device program would let the
+  two processes enqueue the same pair of collectives in OPPOSITE
+  orders — the classic all-chips deadlock graftcheck's rules exist to
+  prevent. One issuing thread per process, with the issue point pinned
+  to the (plan-deterministic) submission point, makes every process's
+  collective sequence identical by construction; the cost is the
+  transfer/compute overlap, which a future split of the addressable
+  local copy (async-able) from the DCN allgather can win back;
+- ``on_start`` prefetch hooks are suppressed (an async copy of a
+  non-addressable global array is not meaningful, and a second thread
+  touching transfers would break the single-issuer ordering);
+- ``quiesce`` cancels nothing (there is never a started-but-unexecuted
+  job to cancel), so an abort on one process cannot desynchronize the
+  others; ``barrier()`` (= drain) is trivially satisfied.
+
+``stats["pull"]`` (and bench's ``pull_overlap_ratio``) therefore now
+exist per shard in multi-process runs — the per-process engine totals
+are the per-shard figures the MULTICHIP capture stamps.
 """
 
 from __future__ import annotations
@@ -123,9 +150,17 @@ class PullJob:
 class PullEngine:
     """Single-worker bounded-depth pull pipeline (module docstring)."""
 
-    def __init__(self, inflight: int = 2, inflight_bytes: int = 1 << 30):
+    def __init__(
+        self,
+        inflight: int = 2,
+        inflight_bytes: int = 1 << 30,
+        collective: bool = False,
+    ):
         self.inflight = max(1, int(inflight))
         self.inflight_bytes = max(1, int(inflight_bytes))
+        #: collective-aware mode (module docstring): submission order is
+        #: a cross-process barrier — on_start suppressed, quiesce drains
+        self.collective = bool(collective)
         self._cv = _tsan.condition("pipeline.engine")
         self._pending: deque = deque()  # submitted, on_start not yet run
         self._ready: deque = deque()  # started, not yet executed
@@ -151,6 +186,15 @@ class PullEngine:
         """Enqueue one job; never blocks. Jobs execute strictly in
         submission order on the worker."""
         job = PullJob(work, on_start, bytes_hint, label)
+        if self.collective:
+            # collective mode: the submission point IS the issue point
+            # (module docstring) — execute on THIS thread, no worker
+            with self._cv:
+                _tsan.access("pipeline.engine")
+                if self._shutdown:
+                    raise RuntimeError("pull engine is shut down")
+            self._execute(job)
+            return job
         with self._cv:
             _tsan.access("pipeline.engine")
             if self._shutdown:
@@ -257,11 +301,26 @@ class PullEngine:
         for j in jobs:
             j._done.wait()
 
+    def barrier(self) -> None:
+        """Submission barrier (collective mode's public name for
+        :meth:`drain`): block until every job submitted so far has
+        executed, so a main-thread collective pull issued AFTER the
+        barrier can never interleave with worker-issued ones. Valid —
+        and a plain drain — in any mode."""
+        self.drain()
+
     def quiesce(self) -> int:
-        """Abort-path brake: cancel every job that has not begun
-        executing (their records stay untouched — serial re-pull safe)
-        and block until the in-flight one finishes. Returns the number
-        of cancelled jobs."""
+        """Abort-path brake. Serial mode: cancel every job that has not
+        begun executing (their records stay untouched — serial re-pull
+        safe) and block until the in-flight one finishes; returns the
+        number of cancelled jobs. Collective mode: cancelling would
+        desynchronize the cross-process pull sequence (another process
+        may be executing the very job this one cancels), so every
+        submitted job RUNS instead — quiesce degrades to the barrier
+        and returns 0."""
+        if self.collective:
+            self.drain()
+            return 0
         with self._cv:
             _tsan.access("pipeline.engine")
             dropped = list(self._pending) + list(self._ready)
@@ -349,7 +408,10 @@ class PullEngine:
         exactly once (under the lock), so its hook runs exactly once —
         from whichever thread moved it."""
         for j in to_start:
-            if j.on_start is not None:
+            # collective mode: no prefetch — the pull itself is the
+            # ordered cross-process collective, and only the worker may
+            # touch transfers (single-issuer ordering)
+            if j.on_start is not None and not self.collective:
                 try:
                     j.on_start()
                 except Exception as e:  # noqa: BLE001 — surfaces at wait
@@ -375,41 +437,59 @@ class PullEngine:
                     continue
                 job = self._ready.popleft()
                 self._executing = job
-            t0 = time.perf_counter()
-            try:
-                job.result = job.work()
-            except BaseException as e:  # noqa: BLE001 — re-raised at wait
-                job.error = e
-            job.busy_s = time.perf_counter() - t0
-            with self._cv:
-                _tsan.access("pipeline.engine")
+            self._execute(job, from_worker=True)
+
+    def _execute(self, job: PullJob, from_worker: bool = False) -> None:
+        """Run one job to completion and finish its accounting — the
+        shared tail of the worker loop and of collective-mode inline
+        submission (where the job never entered the started window, so
+        no depth/byte release applies)."""
+        t0 = time.perf_counter()
+        try:
+            job.result = job.work()
+        except BaseException as e:  # noqa: BLE001 — re-raised at wait
+            job.error = e
+        job.busy_s = time.perf_counter() - t0
+        with self._cv:
+            _tsan.access("pipeline.engine")
+            if from_worker:
                 self._executing = None
                 self._started -= 1
                 self._started_bytes -= job.bytes_hint
-                self._totals["jobs"] += 1
-                self._totals["busy_s"] += job.busy_s
-                self._totals["bytes"] += job.bytes_hint
-                self._cv.notify_all()
-            # telemetry BEFORE the done event (a consumer that returned
-            # from wait() must find the job's counters/span already
-            # emitted), shielded so a failing hook can never strand the
-            # waiter
-            try:
-                obs.count("pull.busy_s", job.busy_s)
-                if job.bytes_hint:
-                    obs.count("pull.bytes", job.bytes_hint)
-                obs.add_span(
-                    "pull.chunk",
-                    t0,
-                    t0 + job.busy_s,
-                    label=job.label,
-                    bytes=int(job.bytes_hint),
-                    failed=job.error is not None,
-                )
-                self._set_inflight_gauge()
-            except Exception:  # noqa: BLE001 — never strand a waiter
-                logger.exception("pull telemetry emission failed")
-            job._done.set()
+            else:
+                # inline (collective-mode) execution: the SUBMITTER
+                # blocked for the whole job, so the honest accounting is
+                # wait = busy and overlap = 0 — consumed here so a later
+                # wait() (which returns instantly) cannot re-score it as
+                # fully overlapped
+                job.consumed = True
+                self._totals["wait_s"] += job.busy_s
+            self._totals["jobs"] += 1
+            self._totals["busy_s"] += job.busy_s
+            self._totals["bytes"] += job.bytes_hint
+            self._cv.notify_all()
+        # telemetry BEFORE the done event (a consumer that returned
+        # from wait() must find the job's counters/span already
+        # emitted), shielded so a failing hook can never strand the
+        # waiter
+        try:
+            obs.count("pull.busy_s", job.busy_s)
+            if not from_worker:
+                obs.count("pull.wait_s", job.busy_s)
+            if job.bytes_hint:
+                obs.count("pull.bytes", job.bytes_hint)
+            obs.add_span(
+                "pull.chunk",
+                t0,
+                t0 + job.busy_s,
+                label=job.label,
+                bytes=int(job.bytes_hint),
+                failed=job.error is not None,
+            )
+            self._set_inflight_gauge()
+        except Exception:  # noqa: BLE001 — never strand a waiter
+            logger.exception("pull telemetry emission failed")
+        job._done.set()
 
 
 # --- process-global engine --------------------------------------------
@@ -421,20 +501,26 @@ _engine_lock = _tsan.lock("pipeline.engine_state")
 
 def get_engine() -> Optional[PullEngine]:
     """The process pull engine for the CURRENT env configuration, or
-    None when pipelining must not run:
+    None under ``DBSCAN_PULL_PIPELINE=0`` — the hard off-switch; every
+    call site then keeps its original serial code path byte-for-byte.
 
-    - ``DBSCAN_PULL_PIPELINE=0`` — the hard off-switch; every call site
-      then keeps its original serial code path byte-for-byte;
-    - multi-process runs — pulls are cross-host collectives whose issue
-      order must stay deterministic on the main thread.
+    Multi-process runs get a COLLECTIVE-AWARE engine (module docstring)
+    instead of the historical None: the single worker executing jobs in
+    submission order is the per-shard submission barrier that keeps
+    every process's cross-host pull sequence identical, and quiesce
+    drains rather than cancels so an abort on one process can never
+    desynchronize the others.
 
     The engine is rebuilt (old worker drained and stopped) whenever the
     knob values change, so tests can monkeypatch the env per test."""
     global _engine, _engine_key
+    from dbscan_tpu.parallel import mesh as mesh_mod
+
     key = (
         bool(config.env("DBSCAN_PULL_PIPELINE")),
         int(config.env("DBSCAN_PULL_INFLIGHT")),
         int(config.env("DBSCAN_PULL_INFLIGHT_BYTES")),
+        mesh_mod.multiprocess(),
     )
     with _engine_lock:
         _tsan.access("pipeline.engine_state")
@@ -444,14 +530,12 @@ def get_engine() -> Optional[PullEngine]:
                 _engine = None
                 _engine_key = None
             return None
-        from dbscan_tpu.parallel import mesh as mesh_mod
-
-        if mesh_mod.multiprocess():
-            return None
         if _engine is None or _engine_key != key:
             if _engine is not None:
                 _engine.close()
-            _engine = PullEngine(inflight=key[1], inflight_bytes=key[2])
+            _engine = PullEngine(
+                inflight=key[1], inflight_bytes=key[2], collective=key[3]
+            )
             _engine_key = key
         return _engine
 

@@ -1312,6 +1312,155 @@ def test_shard_divisible_is_clean(tmp_path):
     assert findings == []
 
 
+# --- halo-merge kernel coverage (PR 12 mesh scale-out) -----------------
+#
+# Fixture pairs shaped like the collective halo-merge kernel
+# (parallel/halo.py _compiled_halo_merge): a ppermute ring + scatter-min
+# fixed point under shard_map. Each of the three collective rules gets a
+# BAD variant (the hazard injected into the halo shape) and the GOOD
+# variant is the real kernel shape, which must stay clean.
+
+_HALO_KERNEL_GOOD = """
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+import numpy as np
+
+PARTS_AXIS = "parts"
+HALO_AXIS = "halo"
+mesh = Mesh(np.empty((4, 2), object), (PARTS_AXIS, HALO_AXIS))
+
+def build(n_pad, mesh):
+    def ring_min(x):
+        acc = x
+        part = lax.ppermute(x, PARTS_AXIS, [(0, 1)])
+        acc = jnp.minimum(acc, part)
+        part = lax.ppermute(part, HALO_AXIS, [(0, 1)])
+        acc = jnp.minimum(acc, part)
+        return acc
+
+    def block(ua, ub):
+        def body(state):
+            lab, _, it = state
+            upd = lab.at[ua].min(lab[ub])
+            new = ring_min(upd)
+            return new, jnp.any(new != lab), it + 1
+
+        def cond(state):
+            _, changed, it = state
+            return changed & (it < n_pad)
+
+        init = jnp.arange(n_pad, dtype=jnp.int32)
+        state = body((init, jnp.bool_(True), jnp.int32(0)))
+        lab, _, iters = lax.while_loop(cond, body, state)
+        return lab, iters
+
+    return jax.jit(jax.shard_map(
+        block, mesh=mesh,
+        in_specs=(PartitionSpec(PARTS_AXIS), PartitionSpec(PARTS_AXIS)),
+        out_specs=(PartitionSpec(), PartitionSpec()),
+    ))
+"""
+
+
+def test_halo_kernel_shape_is_clean(tmp_path):
+    findings, _ = _lint_source(tmp_path, _HALO_KERNEL_GOOD)
+    assert findings == []
+
+
+def test_halo_kernel_collective_in_branch(tmp_path):
+    """A ring exchange gated on a traced value — some shards would skip
+    their ppermute: the all-chips deadlock the rule exists for."""
+    bad = _HALO_KERNEL_GOOD.replace(
+        """        init = jnp.arange(n_pad, dtype=jnp.int32)
+""",
+        """        if ua[0] > 0:
+            ub = lax.ppermute(ub, PARTS_AXIS, [(0, 1)])
+        init = jnp.arange(n_pad, dtype=jnp.int32)
+""",
+    )
+    assert bad != _HALO_KERNEL_GOOD
+    findings, _ = _lint_source(tmp_path, bad)
+    assert "collective-in-branch" in _rules(findings)
+
+
+def test_halo_kernel_axis_undeclared(tmp_path):
+    """A typo'd ring axis fails at trace time only on the multichip
+    path nobody runs in CI — the static rule catches it here."""
+    bad = _HALO_KERNEL_GOOD.replace(
+        'part = lax.ppermute(part, HALO_AXIS, [(0, 1)])',
+        'part = lax.ppermute(part, "chips", [(0, 1)])',
+    )
+    assert bad != _HALO_KERNEL_GOOD
+    findings, _ = _lint_source(tmp_path, bad)
+    assert "collective-axis-undeclared" in _rules(findings)
+
+
+def test_halo_kernel_pull_in_collective(tmp_path):
+    """A host pull reachable from the fixed-point body would interleave
+    cross-host transfers inside the collective region."""
+    bad = _HALO_KERNEL_GOOD.replace(
+        """        init = jnp.arange(n_pad, dtype=jnp.int32)
+""",
+        """        init = jnp.arange(n_pad, dtype=jnp.int32)
+        jax.device_get(init)
+""",
+    )
+    assert bad != _HALO_KERNEL_GOOD
+    findings, _ = _lint_source(tmp_path, bad)
+    assert "pull-in-collective" in _rules(findings)
+
+
+def test_halo_mesh_block_shapes_divide(tmp_path):
+    """shard-indivisible pin for the halo kernel's mesh-axis block
+    shapes: an edge table NOT divisible by the flattened mesh flags,
+    and halo._pad_up (the width every live call goes through) always
+    produces divisible widths."""
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        def block(ua):
+            return ua
+
+        def drive():
+            mesh = jax.make_mesh((8,), ("parts",))
+            fn = jax.jit(shard_map(
+                block, mesh=mesh, in_specs=(P("parts"),),
+                out_specs=P("parts"),
+            ))
+            return fn(jnp.zeros((1001,), jnp.int32))
+        """,
+    )
+    assert _rules(findings) == ["shard-indivisible"]
+    from dbscan_tpu.parallel.halo import _pad_up
+
+    for k in (1, 2, 3, 4, 7, 8):
+        for n in (0, 1, 127, 128, 129, 5000):
+            assert _pad_up(n, k) % k == 0
+            assert _pad_up(n, k) >= max(1, n)
+
+
+def test_halo_merge_family_registered():
+    """The new compile family is declared end to end: obs/schema.py
+    COMPILE_FAMILIES (counters/spans/devtime ride it automatically) and
+    lint/shapes.py FAMILY_MODELS (the shapecheck runtime refuses
+    undeclared families)."""
+    from dbscan_tpu.lint.shapes import FAMILY_MODELS
+    from dbscan_tpu.obs import schema
+
+    assert "halo.merge" in schema.COMPILE_FAMILIES
+    assert schema.is_declared("counter", "compiles.halo.merge")
+    assert schema.is_declared("span", "devtime.halo.merge")
+    model = FAMILY_MODELS["halo.merge"]
+    assert [a.name for a in model.args] == ["ua", "ub"]
+
+
 def test_rules_glob_matches_retired_alias(tmp_path, capsys):
     """--rules dtype-drift (the RETIRED id) still gates the successor's
     findings, so existing CI pipelines survive the rename."""

@@ -1,0 +1,473 @@
+"""Mesh scale-out suite (ROADMAP item 1): the collective halo-merge and
+the sharded campaign path.
+
+What is pinned here:
+
+- the collective fixed point (parallel/halo.py) is BYTE-IDENTICAL to
+  the host union-find (``graph.uf_components``) on random graphs —
+  numbering included, not just component sets;
+- 1/2/4/8-device forced-host-device runs of the banded, haversine, and
+  sparse engines produce byte-identical labels to the single-device
+  engine, with the collective merge demonstrably ACTIVE (halo.rounds
+  counters) and the driver-side union-find demonstrably replaced;
+- the 2-D ('parts', 'halo') mesh gives the same labels as the 1-D mesh
+  (the dimension-ordered ring schedule is pure layout);
+- a second same-shaped sharded run compiles ZERO new kernels (the
+  ladder padding of the halo kernel's node/edge widths);
+- a chip dropping out degrades to RE-SHARDING (campaign.train_resharded
+  + the ``campaign`` fault site), not a dead run, with labels intact —
+  the ROADMAP item 1+5 composition;
+- multi-process checkpoint requests degrade gracefully (warning naming
+  the campaign driver, un-checkpointed run, identical labels) instead
+  of the historical hard raise;
+- DBSCAN_SHAPECHECK=1 validates the halo.merge dispatch family clean
+  on a live sharded run (subprocess rerun).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import Engine, obs, train
+from dbscan_tpu.parallel import halo
+from dbscan_tpu.parallel.graph import uf_components
+from dbscan_tpu.parallel.mesh import make_mesh, make_mesh2d
+
+pytestmark = pytest.mark.multichip
+
+
+def _blobs(rng, n_per=800):
+    return np.concatenate(
+        [rng.normal(c, 0.5, (n_per, 2)) for c in [(0, 0), (6, 6), (-5, 7)]]
+        + [rng.uniform(-9, 11, (n_per // 2, 2))]
+    )
+
+
+def _geo_blobs(rng, centers, per, spread_km):
+    out = []
+    for lon, lat in centers:
+        dlat = spread_km / 111.0
+        dlon = spread_km / (111.0 * np.cos(np.deg2rad(lat)))
+        out.append(
+            np.stack(
+                [rng.normal(lon, dlon, per), rng.normal(lat, dlat, per)],
+                axis=1,
+            )
+        )
+    return np.concatenate(out)
+
+
+def _sparse_corpus(rng, k=8, per=50):
+    import scipy.sparse as sp
+
+    rows, cols, vals = [], [], []
+    for c in range(k):
+        feats = np.arange(c * 5, c * 5 + 5)
+        for i in range(per):
+            pick = rng.choice(feats, size=4, replace=False)
+            ri = c * per + i
+            rows += [ri] * 4
+            cols += list(pick)
+            vals += [1.0] * 4
+    return sp.csr_matrix(
+        (vals, (rows, cols)), shape=(k * per, k * 5), dtype=np.float32
+    )
+
+
+def _devices(k):
+    import jax
+
+    return jax.devices()[:k]
+
+
+# --- unit: collective fixed point == host union-find -------------------
+
+
+def test_collective_merge_matches_uf_components_random_graphs():
+    """Exact (n_clusters, gid) equality on 25 random edge sets across
+    1-D and 2-D meshes — numbering included (first-appearance order ==
+    component-min-rank order, the halo.py docstring argument)."""
+    rng = np.random.default_rng(42)
+    meshes = [make_mesh(_devices(4)), make_mesh2d(_devices(8))]
+    for trial in range(25):
+        n = int(rng.integers(1, 400))
+        e = int(rng.integers(0, 600))
+        ua = rng.integers(0, n, e).astype(np.int64)
+        ub = rng.integers(0, n, e).astype(np.int64)
+        ref_n, ref_gid = uf_components(ua, ub, n)
+        mesh = meshes[trial % len(meshes)]
+        got_n, got_gid = halo.collective_merge(ua, ub, n, mesh)
+        assert got_n == ref_n, trial
+        np.testing.assert_array_equal(got_gid, ref_gid, err_msg=str(trial))
+
+
+def test_collective_merge_empty_and_edgeless():
+    mesh = make_mesh(_devices(2))
+    n_c, gid = halo.collective_merge(
+        np.empty(0, np.int64), np.empty(0, np.int64), 0, mesh
+    )
+    assert n_c == 0 and len(gid) == 0
+    # edgeless nodes: every node is its own 1-based component in order
+    n_c, gid = halo.collective_merge(
+        np.empty(0, np.int64), np.empty(0, np.int64), 5, mesh
+    )
+    assert n_c == 5
+    np.testing.assert_array_equal(gid, np.arange(1, 6))
+
+
+# --- end-to-end label parity: 1/2/4/8 devices, three engines -----------
+
+
+@pytest.mark.parametrize("ndev", [1, 2, 4, 8])
+def test_banded_sharded_labels_byte_identical(ndev, rng):
+    pts = _blobs(rng)
+    kw = dict(
+        eps=0.3, min_points=6, max_points_per_partition=600,
+        engine=Engine.NAIVE, neighbor_backend="banded",
+    )
+    ref = train(pts, **kw)
+    mesh = make_mesh(_devices(ndev))
+    got = train(pts, mesh=mesh, **kw)
+    np.testing.assert_array_equal(ref.clusters, got.clusters)
+    np.testing.assert_array_equal(ref.flags, got.flags)
+
+
+def test_haversine_sharded_labels_byte_identical(rng):
+    geo = _geo_blobs(
+        rng,
+        [(-74.0, 40.7), (-73.95, 40.75), (-73.9, 40.8), (-74.05, 40.65)],
+        per=120,
+        spread_km=0.25,
+    )
+    kw = dict(
+        eps=0.3, min_points=5, max_points_per_partition=300,
+        metric="haversine", neighbor_backend="banded",
+    )
+    ref = train(geo, **kw)
+    for ndev in (2, 8):
+        got = train(geo, mesh=make_mesh(_devices(ndev)), **kw)
+        np.testing.assert_array_equal(ref.clusters, got.clusters, err_msg=str(ndev))
+        np.testing.assert_array_equal(ref.flags, got.flags)
+
+
+def test_sparse_sharded_labels_byte_identical(rng):
+    from dbscan_tpu.ops.sparse import sparse_cosine_dbscan
+
+    x = _sparse_corpus(rng)
+    kw = dict(eps=0.35, min_points=5, max_points_per_partition=96)
+    ref_c, ref_f = sparse_cosine_dbscan(x, **kw)
+    for ndev in (2, 4, 8):
+        got_c, got_f = sparse_cosine_dbscan(
+            x, mesh=make_mesh(_devices(ndev)), **kw
+        )
+        np.testing.assert_array_equal(ref_c, got_c, err_msg=str(ndev))
+        np.testing.assert_array_equal(ref_f, got_f)
+
+
+def test_mesh2d_matches_mesh1d_and_single(rng):
+    """The 2-D ('parts','halo') mesh is pure layout: same labels as the
+    1-D mesh and the single-device run, on the banded engine."""
+    pts = _blobs(rng, n_per=500)
+    kw = dict(
+        eps=0.3, min_points=6, max_points_per_partition=400,
+        engine=Engine.ARCHERY, neighbor_backend="banded",
+    )
+    ref = train(pts, **kw)
+    m1 = train(pts, mesh=make_mesh(_devices(8)), **kw)
+    m2 = train(pts, mesh=make_mesh2d(_devices(8)), **kw)
+    m2b = train(pts, mesh=make_mesh2d(_devices(8), shape=(2, 4)), **kw)
+    for m in (m1, m2, m2b):
+        np.testing.assert_array_equal(ref.clusters, m.clusters)
+        np.testing.assert_array_equal(ref.flags, m.flags)
+
+
+def test_mesh2d_shape_validation():
+    with pytest.raises(ValueError):
+        make_mesh2d(_devices(8), shape=(3, 2))
+
+
+# --- the merge really is collective ------------------------------------
+
+
+def test_halo_merge_active_and_counted(rng, tmp_path):
+    """The sharded run routes the union through the mesh (halo.rounds/
+    edges/nodes counters move), and DBSCAN_MESH_MERGE=0 restores the
+    host union-find (counters still) with identical labels."""
+    pts = _blobs(rng, n_per=400)
+    kw = dict(
+        eps=0.3, min_points=6, max_points_per_partition=300,
+        engine=Engine.NAIVE, neighbor_backend="banded",
+    )
+    mesh = make_mesh(_devices(8))
+    obs.enable(str(tmp_path / "t.jsonl"))
+    try:
+        st = obs.state()
+        snap = st.metrics.snapshot()
+        on = train(pts, mesh=mesh, **kw)
+        d1 = st.metrics.delta(snap)
+        assert d1.get("halo.rounds", 0) > 0
+        assert d1.get("halo.nodes", 0) > 0
+        snap = st.metrics.snapshot()
+        os.environ["DBSCAN_MESH_MERGE"] = "0"
+        try:
+            off = train(pts, mesh=mesh, **kw)
+        finally:
+            os.environ.pop("DBSCAN_MESH_MERGE", None)
+        d2 = st.metrics.delta(snap)
+        assert d2.get("halo.rounds", 0) == 0
+    finally:
+        obs.disable()
+    np.testing.assert_array_equal(on.clusters, off.clusters)
+    np.testing.assert_array_equal(on.flags, off.flags)
+
+
+def test_sharded_second_run_zero_new_compiles(rng, tmp_path):
+    """Compile-count pin: a second same-shaped sharded run (fresh data,
+    same shapes) compiles ZERO new kernels — the halo widths ride the
+    ladder like every other dispatch family."""
+    kw = dict(
+        eps=0.3, min_points=6, max_points_per_partition=400,
+        engine=Engine.NAIVE, neighbor_backend="banded",
+    )
+    mesh = make_mesh(_devices(8))
+    pts = _blobs(rng, n_per=500)
+    obs.enable(str(tmp_path / "c.jsonl"))
+    try:
+        st = obs.state()
+        train(pts, mesh=mesh, **kw)
+        snap = st.metrics.snapshot()
+        # same-shaped second run: jitter the values, keep the layout
+        train(pts + 1e-9, mesh=mesh, **kw)
+        delta = st.metrics.delta(snap)
+        assert delta.get("compiles.total", 0) == 0, delta
+    finally:
+        obs.disable()
+
+
+# --- chip drop degrades to re-sharding ---------------------------------
+
+
+def test_chip_drop_resharding_labels_identical(rng, monkeypatch, tmp_path):
+    """A campaign-site fault on the sharded attempt re-shards (8 -> 4
+    devices) instead of killing the run; labels stay byte-identical and
+    mesh.reshards counts the event."""
+    from dbscan_tpu.campaign import train_resharded
+    from dbscan_tpu import faults
+
+    pts = _blobs(rng, n_per=400)
+    kw = dict(
+        eps=0.3, min_points=6, max_points_per_partition=300,
+        engine=Engine.NAIVE, neighbor_backend="banded",
+    )
+    ref = train(pts, **kw)
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "campaign#0:TRANSIENT")
+    faults.reset_registry()
+    obs.enable(str(tmp_path / "r.jsonl"))
+    try:
+        st = obs.state()
+        snap = st.metrics.snapshot()
+        got = train_resharded(pts, make_mesh(_devices(8)), **kw)
+        delta = st.metrics.delta(snap)
+        assert delta.get("mesh.reshards", 0) == 1, delta
+    finally:
+        obs.disable()
+        monkeypatch.delenv("DBSCAN_FAULT_SPEC", raising=False)
+        faults.reset_registry()
+    np.testing.assert_array_equal(ref.clusters, got.clusters)
+    np.testing.assert_array_equal(ref.flags, got.flags)
+
+
+def test_chip_drop_resharding_to_single_device(rng, monkeypatch):
+    """Two consecutive faults walk the ladder 4 -> 2 -> 1 device; the
+    single-device (mesh=None) rerun still lands identical labels."""
+    from dbscan_tpu.campaign import train_resharded
+    from dbscan_tpu import faults
+
+    pts = _blobs(rng, n_per=300)
+    kw = dict(
+        eps=0.3, min_points=6, max_points_per_partition=300,
+        engine=Engine.NAIVE, neighbor_backend="banded",
+    )
+    ref = train(pts, **kw)
+    monkeypatch.setenv(
+        "DBSCAN_FAULT_SPEC", "campaign#0:TRANSIENT;campaign#1:TRANSIENT"
+    )
+    faults.reset_registry()
+    try:
+        got = train_resharded(pts, make_mesh(_devices(4)), **kw)
+    finally:
+        monkeypatch.delenv("DBSCAN_FAULT_SPEC", raising=False)
+        faults.reset_registry()
+    np.testing.assert_array_equal(ref.clusters, got.clusters)
+
+
+def test_reshard_disabled_propagates(rng, monkeypatch):
+    from dbscan_tpu.campaign import train_resharded
+    from dbscan_tpu import faults
+
+    pts = _blobs(rng, n_per=200)
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "campaign#0:TRANSIENT")
+    monkeypatch.setenv("DBSCAN_MESH_RESHARD", "0")
+    faults.reset_registry()
+    try:
+        with pytest.raises(faults.FatalDeviceFault):
+            train_resharded(
+                pts, make_mesh(_devices(2)),
+                eps=0.3, min_points=6, max_points_per_partition=300,
+            )
+    finally:
+        monkeypatch.delenv("DBSCAN_FAULT_SPEC", raising=False)
+        monkeypatch.delenv("DBSCAN_MESH_RESHARD", raising=False)
+        faults.reset_registry()
+
+
+# --- multi-process checkpoint degrade ----------------------------------
+
+
+class _MeshModProxy:
+    """Proxy of parallel.mesh that reports multiprocess=True to the
+    DRIVER's gates only: the real mesh helpers (shard_host_array,
+    pull_to_host) keep consulting the genuine single-process state, so
+    the run itself stays healthy — this isolates exactly the driver's
+    multi-process control flow, the way the historical raise fired."""
+
+    def __init__(self, real):
+        self._real = real
+
+    def multiprocess(self):
+        return True
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+def test_multiprocess_checkpoint_degrades_gracefully(
+    rng, tmp_path, monkeypatch, caplog
+):
+    """checkpoint_dir in a (simulated) multi-process run no longer
+    raises: the run completes un-checkpointed BEFORE any partition work,
+    the warning names the campaign-driver alternative, and labels equal
+    the plain run."""
+    import logging
+
+    from dbscan_tpu.parallel import driver as drv
+    from dbscan_tpu.parallel import mesh as mesh_mod
+
+    pts = _blobs(rng, n_per=300)
+    kw = dict(
+        eps=0.3, min_points=6, max_points_per_partition=300,
+        engine=Engine.NAIVE, neighbor_backend="banded",
+    )
+    ref = train(pts, **kw)
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+    monkeypatch.setattr(drv, "mesh_mod", _MeshModProxy(mesh_mod))
+    with caplog.at_level(logging.WARNING, logger="dbscan_tpu.parallel.driver"):
+        got = train(pts, checkpoint_dir=str(ck), **kw)
+    np.testing.assert_array_equal(ref.clusters, got.clusters)
+    np.testing.assert_array_equal(ref.flags, got.flags)
+    assert not got.stats.get("resumed_from_checkpoint")
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("campaign" in m and "checkpoint" in m.lower() for m in msgs), msgs
+    # nothing was written: the degrade happened before any partition work
+    assert list(ck.iterdir()) == []
+
+
+# --- collective-aware pulls under (simulated) multi-process ------------
+
+
+def test_collective_engine_active_under_multiprocess(rng, monkeypatch):
+    """get_engine() no longer returns None under multiprocess: the
+    collective engine runs every pull at its submission point (one
+    issuing thread, deterministic sequence) and stats['pull'] exists —
+    the per-shard pull_overlap_ratio source the MULTICHIP capture
+    stamps."""
+    from dbscan_tpu.parallel import mesh as mesh_mod
+    from dbscan_tpu.parallel import pipeline as pipe_mod
+
+    monkeypatch.setattr(mesh_mod, "multiprocess", lambda: True)
+    pipe_mod.reset_engine()
+    try:
+        eng = pipe_mod.get_engine()
+        assert eng is not None and eng.collective
+        order = []
+        jobs = [
+            eng.submit(lambda i=i: order.append(i) or i, label=f"j{i}")
+            for i in range(5)
+        ]
+        # inline-at-submit: already done, strict submission order
+        assert order == list(range(5))
+        assert [eng.wait(j) for j in jobs] == list(range(5))
+        # quiesce cancels nothing in collective mode
+        assert eng.quiesce() == 0
+        t = eng.totals()
+        assert t["jobs"] == 5 and t["overlap_s"] == 0.0
+    finally:
+        pipe_mod.reset_engine()
+        monkeypatch.undo()
+        pipe_mod.reset_engine()
+
+
+def test_collective_engine_fault_surfaces_at_settle(monkeypatch):
+    from dbscan_tpu.parallel import mesh as mesh_mod
+    from dbscan_tpu.parallel import pipeline as pipe_mod
+
+    monkeypatch.setattr(mesh_mod, "multiprocess", lambda: True)
+    pipe_mod.reset_engine()
+    try:
+        eng = pipe_mod.get_engine()
+
+        def boom():
+            raise RuntimeError("pull died")
+
+        job = eng.submit(boom, label="bad")
+        with pytest.raises(RuntimeError, match="pull died"):
+            eng.settle(job)
+    finally:
+        pipe_mod.reset_engine()
+        monkeypatch.undo()
+        pipe_mod.reset_engine()
+
+
+# --- shapecheck coverage for the new family ----------------------------
+
+
+_SHAPECHECK_CHILD = r"""
+import os, sys
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+from dbscan_tpu import Engine, train
+from dbscan_tpu.lint import shapecheck
+from dbscan_tpu.parallel.mesh import make_mesh
+rng = np.random.default_rng(5)
+pts = np.concatenate(
+    [rng.normal(c, 0.5, (400, 2)) for c in [(0, 0), (6, 6), (-5, 7)]]
+)
+m = train(
+    pts, eps=0.3, min_points=6, max_points_per_partition=300,
+    engine=Engine.NAIVE, neighbor_backend="banded", mesh=make_mesh(),
+)
+rep = shapecheck.report()
+assert rep["enabled"], rep
+assert "halo.merge" in rep["sites"], sorted(rep["sites"])
+assert rep["violations"] == [], rep
+print("SHAPECHECK_OK", sorted(rep["sites"]))
+"""
+
+
+def test_shapecheck_clean_on_sharded_run(tmp_path):
+    env = dict(os.environ)
+    env["DBSCAN_SHAPECHECK"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-c", _SHAPECHECK_CHILD],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "SHAPECHECK_OK" in out.stdout

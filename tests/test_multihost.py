@@ -57,6 +57,12 @@ def _child_main(pid: int, port: int, out_path: str) -> None:
         results[f"{name}_clusters"] = m.clusters
         results[f"{name}_flags"] = m.flags
         results[f"{name}_nparts"] = np.int64(m.stats["n_partitions"])
+        # collective-aware pulls (PR 12): the engine no longer disables
+        # itself under multi-process — every pull rides it at its
+        # submission point, so stats["pull"] exists PER SHARD here
+        pull = m.stats.get("pull")
+        assert pull is not None and pull["jobs"] > 0, (name, m.stats)
+        results[f"{name}_pull_jobs"] = np.int64(pull["jobs"])
     if pid == 0:
         np.savez(out_path, **results)
 

@@ -320,6 +320,42 @@ def test_normalize_wrapper_and_multichip(tmp_path):
     ]
 
 
+def test_normalize_multichip_real_capture(tmp_path):
+    """The PR-12 multichip shape (dryrun keys + real flat metrics)
+    promotes its perf keys under the multichip backend — _mpts,
+    walls, and the per-shard busy/overlap ratios — while the legacy
+    dryrun shape (previous test) stays one multichip_ok record."""
+    mc = _capture(
+        tmp_path, "MULTICHIP_Y.json",
+        {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+         "multichip_n": 120000, "multichip_seconds": 14.7,
+         "multichip_mpts": 0.00815,
+         "multichip_all_busy_frac": 0.9998,
+         "multichip_pull_overlap_ratio": 0.0,
+         "multichip_shard_dispatches": [7, 7],
+         "multichip_recompiles": 0},
+    )
+    recs = bench_history.parse_capture_file(mc)
+    by_metric = {r["metric"]: r for r in recs}
+    assert by_metric["multichip_ok"]["value"] == 1.0
+    assert by_metric["multichip_mpts"]["unit"] == "Mpoints/s"
+    assert by_metric["multichip_seconds"]["unit"] == "s"
+    assert by_metric["multichip_all_busy_frac"]["value"] == 0.9998
+    assert "multichip_pull_overlap_ratio" in by_metric
+    # every promoted record rides the multichip backend so sharded
+    # trends never mix with single-chip rows
+    assert {r["backend"] for r in recs} == {"multichip8"}
+    # list/bool/count keys are not perf metrics
+    assert "multichip_shard_dispatches" not in by_metric
+    assert "multichip_recompiles" not in by_metric
+    # and the regress gate reads the ratios HIGHER-better
+    from dbscan_tpu.obs import regress
+
+    assert regress.direction("multichip_all_busy_frac") == regress.HIGHER_BETTER
+    assert regress.direction("multichip_mpts") == regress.HIGHER_BETTER
+    assert regress.direction("multichip_seconds") != regress.HIGHER_BETTER
+
+
 def test_ingest_append_only_dedup(tmp_path):
     cap = _capture(tmp_path, "BENCH_A.json", BASE_CAPTURE)
     hist = str(tmp_path / "history.jsonl")
