@@ -34,20 +34,42 @@ def build_parser() -> argparse.ArgumentParser:
         prog="dbscan_tpu",
         description="Distributed TPU-native DBSCAN (train + label a point set).",
     )
-    p.add_argument("--input", required=True, help="points file (csv/parquet/npy/npz)")
+    p.add_argument(
+        "--input",
+        help="points file (csv/parquet/npy/npz); required unless --serve",
+    )
     p.add_argument("--output", help="labeled output file (csv/parquet/npz)")
     p.add_argument("--input-format", choices=["csv", "parquet", "numpy"])
     p.add_argument("--output-format", choices=["csv", "parquet", "numpy"])
     p.add_argument("--delimiter", default=",", help="csv delimiter (default ',')")
-    p.add_argument("--eps", type=float, required=True, help="neighborhood radius")
     p.add_argument(
-        "--min-points", type=int, required=True,
-        help="min self-inclusive neighborhood size for a core point",
+        "--eps", type=float, help="neighborhood radius (required unless --serve)"
     )
     p.add_argument(
-        "--max-points-per-partition", type=int, default=250,
-        help="best-effort per-partition point bound (default 250, as the "
-        "reference's DBSCAN.train default position)",
+        "--min-points", type=int,
+        help="min self-inclusive neighborhood size for a core point "
+        "(required unless --serve)",
+    )
+    p.add_argument(
+        "--serve", action="store_true",
+        help="run the resident ClusterService against a synthetic "
+        "stream (concurrent ingest + queries + the tenancy batch leg) "
+        "and print health/QPS — the python -m dbscan_tpu.serve demo",
+    )
+    p.add_argument(
+        "--serve-updates", type=int, default=4,
+        help="with --serve: synthetic micro-batches to ingest",
+    )
+    p.add_argument(
+        "--serve-batch", type=int, default=1000,
+        help="with --serve: points per synthetic micro-batch",
+    )
+    p.add_argument(
+        "--max-points-per-partition", type=int, default=None,
+        help="best-effort per-partition point bound (default 250 for "
+        "train, as the reference's DBSCAN.train default position; the "
+        "--serve demo keeps its own default unless this is set "
+        "explicitly)",
     )
     p.add_argument(
         "--engine", choices=[e.value for e in Engine], default=Engine.NAIVE.value,
@@ -118,7 +140,32 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.serve:
+        from dbscan_tpu.serve.__main__ import main as serve_main
+
+        serve_argv = [
+            "--updates", str(args.serve_updates),
+            "--batch", str(args.serve_batch),
+        ]
+        if args.eps is not None:
+            serve_argv += ["--eps", str(args.eps)]
+        if args.min_points is not None:
+            serve_argv += ["--min-points", str(args.min_points)]
+        if args.max_points_per_partition is not None:
+            serve_argv += [
+                "--max-points-per-partition",
+                str(args.max_points_per_partition),
+            ]
+        if args.checkpoint_dir:
+            serve_argv += ["--checkpoint-dir", args.checkpoint_dir]
+        if args.stats:
+            serve_argv += ["--json"]
+        return serve_main(serve_argv)
+    if args.input is None or args.eps is None or args.min_points is None:
+        parser.error("--input, --eps, and --min-points are required "
+                     "(unless --serve)")
     if args.platform:
         import jax
 
@@ -185,7 +232,11 @@ def _run(args, log) -> int:
         points,
         eps=args.eps,
         min_points=args.min_points,
-        max_points_per_partition=args.max_points_per_partition,
+        max_points_per_partition=(
+            250
+            if args.max_points_per_partition is None
+            else args.max_points_per_partition
+        ),
         engine=Engine(args.engine),
         metric=args.metric,
         precision=Precision(args.precision),

@@ -463,6 +463,41 @@ ENV_VARS = _env_table(
         "per lease.",
     ),
     EnvVar(
+        "DBSCAN_SERVE_QUEUE", "int", 8,
+        "Ingest-queue bound of the resident ClusterService "
+        "(dbscan_tpu/serve): micro-batches submitted past this depth "
+        "block (or are rejected with block=False) — the service's "
+        "backpressure signal, surfaced as the serve.queue_depth gauge.",
+    ),
+    EnvVar(
+        "DBSCAN_SERVE_QUERY_SLOTS", "int", 4096,
+        "Padded query-point slots per serve.query dispatch: a query "
+        "batch larger than this is split into consecutive dispatches "
+        "(each padded up the ladder), bounding the [Q, K] measure "
+        "working set.",
+    ),
+    EnvVar(
+        "DBSCAN_SERVE_JOB_SLOTS", "int", 2048,
+        "Per-job padded point cap of the multi-tenant JobBatcher: a "
+        "job with more points than this is rejected at admission "
+        "(small-job batching is the wrong tool past it — run "
+        "train/streaming instead).",
+    ),
+    EnvVar(
+        "DBSCAN_SERVE_BATCH_JOBS", "int", 64,
+        "Max jobs stacked into one serve.jobs batched dispatch; also "
+        "the J bound the lint-time HBM gate evaluates the serve.jobs "
+        "family model at.",
+    ),
+    EnvVar(
+        "DBSCAN_SERVE_HEADROOM_BYTES", "int", 1 << 34,
+        "HBM headroom budget the serve admission controller prices "
+        "serve.jobs dispatches against (graftshape FAMILY_MODELS "
+        "prediction): a batch whose predicted footprint exceeds this "
+        "is split/queued, and a single job that alone breaches it is "
+        "rejected.",
+    ),
+    EnvVar(
         "DBSCAN_FAULT_SPEC", "str", "",
         "Deterministic fault-injection spec, semicolon-separated "
         "site#ordinal:KIND[*count] clauses (faults.parse_fault_spec).",

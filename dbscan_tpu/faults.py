@@ -75,9 +75,10 @@ SITE_STREAM = "stream"  # streaming per-batch update step
 SITE_PULL = "pull"  # pipelined compact-chunk pull (parallel/pipeline.py)
 SITE_CELLCC = "cellcc_cc"  # device cellcc finalize (cellgraph.finalize_device)
 SITE_CAMPAIGN = "campaign"  # campaign worker lease (dbscan_tpu/campaign.py)
+SITE_SERVE = "serve"  # ClusterService ingest/query steps (dbscan_tpu/serve)
 _SITES = (
     SITE_DISPATCH, SITE_BANDED, SITE_SPILL, SITE_SPILL_LEVEL,
-    SITE_STREAM, SITE_PULL, SITE_CELLCC, SITE_CAMPAIGN, "*",
+    SITE_STREAM, SITE_PULL, SITE_CELLCC, SITE_CAMPAIGN, SITE_SERVE, "*",
 )
 
 
@@ -127,8 +128,11 @@ def parse_fault_spec(spec: str) -> Tuple[FaultClause, ...]:
     Grammar: semicolon-separated clauses ``site#ordinal:KIND[*count]``:
 
     - ``site``: ``dispatch`` | ``banded`` | ``spill`` | ``spill_level``
-      | ``stream`` | ``pull`` | ``cellcc_cc`` | ``campaign`` | ``*``
-      (any supervised site, ordinal counted globally). The ``campaign``
+      | ``stream`` | ``pull`` | ``cellcc_cc`` | ``campaign`` | ``serve``
+      | ``*`` (any supervised site, ordinal counted globally). The
+      ``serve`` site is consumed per ClusterService ingest step and
+      query dispatch (dbscan_tpu/serve), opt-in like ``pull``; the
+      ``campaign``
       site is consumed per LEASE by the campaign driver
       (dbscan_tpu/campaign.py), not per device dispatch: ``TRANSIENT``
       kills the leased worker after it banks one chunk (steal/resume
@@ -285,6 +289,18 @@ def campaign_site_active() -> bool:
     written against, and would interleave nondeterministically, since
     leases are granted on campaign worker threads."""
     return any(c.site == SITE_CAMPAIGN for c in get_registry().clauses)
+
+
+def serve_site_active() -> bool:
+    """True when the active fault spec names the ``serve`` site
+    explicitly. The ClusterService consumes one ``serve`` ordinal per
+    ingest step and per query dispatch ONLY then — the same opt-in
+    discipline as :func:`pull_site_active`: an unconditional consume
+    would shift the global (``*``-clause) ordinal stream, and would
+    interleave nondeterministically, since ingest ordinals are consumed
+    on the service's ingest thread while query ordinals are consumed on
+    whatever reader thread asked."""
+    return any(c.site == SITE_SERVE for c in get_registry().clauses)
 
 
 class FaultCounters:

@@ -384,6 +384,52 @@ def _models() -> Dict[str, FamilyModel]:
                 "every mesh axis, the label vector replicates; EH is "
                 "the ladder-padded edge count",
             ),
+            FamilyModel(
+                "serve.query",
+                [
+                    ArgModel("qpts", ("Q", "D"), FLOAT),
+                    ArgModel("spts", ("K", "D"), FLOAT),
+                    ArgModel("sids", ("K",), INT),
+                ],
+                # temps: the [Q, K] measure (f64 on the x64 serving
+                # path) + adjacency + a couple of where/min copies;
+                # outputs: gid i32 + core i8 + counts i32 per query
+                # slot. Trailing eps rides as a plain Python scalar.
+                # K is the published skeleton — data-scaled with the
+                # stream's window density, runtime-gated.
+                overhead=_sy("Q") * _sy("K") * 24 + _sy("Q") * 16,
+                static_slots=None,
+                note="resident-grid point->cluster query "
+                "(dbscan_tpu/serve/query.py): Q is the ladder-padded "
+                "query batch (split past DBSCAN_SERVE_QUERY_SLOTS), K "
+                "the ladder-padded skeleton — data-scaled, "
+                "runtime-gated",
+            ),
+            FamilyModel(
+                "serve.jobs",
+                [
+                    ArgModel("pts", ("J", "S", "D"), FLOAT),
+                    ArgModel("mask", ("J", "S"), BOOL),
+                    ArgModel("eps", ("J",), FLOAT),
+                    ArgModel("min_points", ("J",), INT),
+                ],
+                # temps per job: the [S, S] measure (f64) + adjacency
+                # + core-CC label passes; outputs: seeds i32 + flags i8
+                # per slot. This is ALSO the admission controller's
+                # pricing expression (serve/tenancy.py prices candidate
+                # batches with exactly this model before dispatch).
+                overhead=_sy("J") * _sy("S") * _sy("S") * 24
+                + _sy("J") * _sy("S") * 16,
+                static_slots={
+                    "J": "DBSCAN_SERVE_BATCH_JOBS",
+                    "S": "DBSCAN_SERVE_JOB_SLOTS",
+                    "D": 4,
+                },
+                note="pad-and-stack multi-tenant small-job dispatch "
+                "(dbscan_tpu/serve/tenancy.py): J jobs of S padded "
+                "point slots, per-job eps/min_points traced — the "
+                "admission headroom gate prices THIS envelope",
+            ),
             _level_model(),
             _level_final_model(),
         )

@@ -87,6 +87,7 @@ SECTIONS = {
     "compiles": ("counter", schema.PREFIX_COMPILES),
     "faults": ("counter", schema.PREFIX_FAULTS),
     "campaign": ("counter", schema.PREFIX_CAMPAIGN),
+    "serve": ("counter", schema.PREFIX_SERVE),
     "devtime": ("counter", _DEVTIME_KEYS),
     "pull_check": ("counter", _PULL_CHECK_KEYS),
 }
@@ -479,6 +480,7 @@ def analyze(data: dict, top: Optional[int] = None) -> dict:
             if k.startswith(schema.PREFIX_FAULTS)
         },
         "campaign": _campaign_rollup(counters),
+        "serve": _serve_rollup(counters, spans),
         "devtime": _devtime_rollup(counters, spans),
         "pull_check": _pull_device_check(counters, spans),
     }
@@ -498,6 +500,39 @@ def _campaign_rollup(counters: dict) -> dict:
         out["campaign.replay_frac"] = round(
             min(1.0, out.get("campaign.replayed_wall_s", 0.0) / work), 4
         )
+    return out
+
+
+def _serve_rollup(counters: dict, spans: list) -> dict:
+    """The serve section: every serve.* counter plus rates derived
+    from the recorded ``serve.query`` spans — ``serve.qps`` (answered
+    query batches over the span WINDOW, min t0 to max t1, the honest
+    sustained figure under concurrent readers) and
+    ``serve.query_p50_ms`` / ``serve.query_p99_ms`` (nearest-rank
+    percentiles of the span walls, the same definition the bench row
+    stamps)."""
+    out = {
+        k: v
+        for k, v in sorted(counters.items())
+        if k.startswith(schema.PREFIX_SERVE)
+    }
+    walls = sorted(
+        s["dur"] for s in spans if s.get("name") == "serve.query"
+    )
+    if walls:
+        qspans = [s for s in spans if s.get("name") == "serve.query"]
+        t0 = min(s["t0"] for s in qspans)
+        t1 = max(s["t0"] + s["dur"] for s in qspans)
+        window = t1 - t0
+        if window > 0:
+            out["serve.qps"] = round(len(walls) / window, 3)
+
+        def _pct(p: float) -> float:
+            i = min(len(walls) - 1, int(p * (len(walls) - 1) + 0.5))
+            return walls[i]
+
+        out["serve.query_p50_ms"] = round(_pct(0.50) * 1e3, 3)
+        out["serve.query_p99_ms"] = round(_pct(0.99) * 1e3, 3)
     return out
 
 
@@ -833,6 +868,12 @@ def render(report: dict) -> str:
         out.append("")
         out.append("-- campaign (priced replay budget) --")
         for k, v in report["campaign"].items():
+            v = round(v, 6) if isinstance(v, float) else v
+            out.append(f"{k:<36} {v:>12}")
+    if report.get("serve"):
+        out.append("")
+        out.append("-- serve (resident service / tenancy) --")
+        for k, v in report["serve"].items():
             v = round(v, 6) if isinstance(v, float) else v
             out.append(f"{k:<36} {v:>12}")
     dev = report.get("devtime") or {}

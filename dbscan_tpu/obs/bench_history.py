@@ -58,12 +58,18 @@ from dbscan_tpu.obs import schema
 # _cc_iters: the device cellcc finalize's CC sweep count — a
 # propagation-depth figure that regresses UP like the spill levels;
 # _replay_frac: the campaign driver's priced restart overhead —
-# replayed wall / total work wall — which regresses UP like a wall)
+# replayed wall / total work wall — which regresses UP like a wall;
+# _qps: the serving layer's sustained query rate — a throughput that
+# regresses DOWN; _ms: serve query latency percentiles — walls in
+# milliseconds, regress UP. NOTE the ordering trap the serve keys
+# introduce: tenancy_jobs_s ENDS in "_s" but is a jobs-per-second
+# THROUGHPUT — obs/regress.direction and _unit_for both special-case
+# the "_jobs_s" suffix BEFORE the seconds rule)
 _EXACT_KEYS = ("value", "seconds", "vs_baseline")
 _SUFFIXES = (
     "_seconds", "_s", "_mpts", "_vs_baseline", "_overlap_ratio",
     "_pred_ratio", "_spill_levels", "_busy_frac", "_cc_iters",
-    "_replay_frac",
+    "_replay_frac", "_qps", "_ms",
 )
 # numeric-but-not-perf keys the suffix rule would otherwise catch —
 # declared with the telemetry schema (the keys are fault-counter
@@ -101,6 +107,14 @@ def _unit_for(metric: str, obj: dict) -> Optional[str]:
         return "levels"
     if metric.endswith("_cc_iters"):
         return "iters"
+    if metric.endswith("_jobs_s"):
+        # jobs PER second (serve tenancy throughput), not a wall —
+        # must beat the "_s" rule below
+        return "jobs/s"
+    if metric.endswith("_qps"):
+        return "queries/s"
+    if metric.endswith("_ms"):
+        return "ms"
     if metric.endswith(("_seconds", "_s")) or metric == "seconds":
         return "s"
     if metric.endswith("_mpts"):

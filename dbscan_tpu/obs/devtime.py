@@ -110,12 +110,16 @@ def ensure_env() -> None:
     ``DBSCAN_TRACE``)."""
     global _on, _env_applied
     env_on = bool(config.env("DBSCAN_DEVTIME"))
-    if env_on != _env_applied:
-        _env_applied = env_on
-        if env_on:
-            _on = True
     with _lock:
         _tsan.access("obs.devtime")
+        # latch update under the module lock: ensure_env runs at EVERY
+        # pipeline entry, which now includes the serve ingest thread
+        # (dbscan_tpu/serve) concurrently with main-thread trains — an
+        # unlocked check-then-write here could lose a toggle
+        if env_on != _env_applied:
+            _env_applied = env_on
+            if env_on:
+                _on = True
         if not _win["done"] and not _win["active"]:
             _win["target"] = int(config.env("DBSCAN_PROFILE_WINDOW"))
 

@@ -276,7 +276,51 @@ def load(path: str) -> dict:
         return json.load(f)
 
 
-# --- signal wiring (streaming-service prep) ---------------------------
+# --- signal wiring (the serving system's teardown path) ---------------
+
+#: cooperative SIGTERM hooks (dbscan_tpu/serve's checkpoint-on-preempt):
+#: run AFTER the dump, BEFORE the chain to the previous disposition —
+#: the documented "dump, then <your teardown>, then die" order. A
+#: service that instead installed its own raw ``signal.signal`` handler
+#: either replaced this module's (losing the dump) or chained back into
+#: it (double-dumping); :func:`on_sigterm` is the composition API that
+#: has neither problem.
+_sigterm_hooks: list = []
+#: re-entrancy guard WITHIN one signal delivery: a foreign handler that
+#: chains back into :func:`_on_sigterm` (the pre-hook composition style)
+#: must not dump or run the hooks a second time
+_sigterm_active = False
+
+
+def sigterm_armed() -> bool:
+    """True when this module's SIGTERM handler is actually installed —
+    the precondition for :func:`on_sigterm` hooks ever running. False
+    when the recorder was never enabled (``DBSCAN_FLIGHTREC=0`` from
+    process start) or the first :func:`ensure_env` ran off the main
+    thread (the signal API's own constraint). Callers that REQUIRE
+    their teardown hook (the serving layer's checkpoint-on-preempt)
+    check this and warn, instead of discovering an inert preemption
+    path at the first real SIGTERM."""
+    return _signals_installed
+
+
+def on_sigterm(hook):
+    """Register a zero-arg teardown hook on the recorder's SIGTERM path
+    (dump -> hooks in registration order -> chain). Returns an
+    unregister callable. Hooks are best-effort: an exception in one is
+    swallowed (teardown must still tear down) and later hooks still
+    run. Signal-context caveats apply: the hook runs on the main thread
+    between bytecodes, so it must not acquire locks an interrupted
+    frame may hold (write files, read published snapshots)."""
+    _sigterm_hooks.append(hook)
+
+    def _remove() -> None:
+        try:
+            _sigterm_hooks.remove(hook)
+        except ValueError:
+            pass
+
+    return _remove
 
 
 def _on_sigusr1(signum, frame):
@@ -289,11 +333,9 @@ def _on_sigusr1(signum, frame):
         prev(signum, frame)
 
 
-def _on_sigterm(signum, frame):
-    try:
-        dump(reason="SIGTERM", _signal_safe=True)
-    except Exception:  # noqa: BLE001 — teardown must still tear down
-        pass
+def _chain_sigterm(signum, frame):
+    """The termination tail: hand off to the disposition that was live
+    before this module installed itself."""
     prev = _prev_handlers.get(signal.SIGTERM)
     if callable(prev):
         prev(signum, frame)
@@ -308,6 +350,33 @@ def _on_sigterm(signum, frame):
     # terminates with the standard SIGTERM exit status
     signal.signal(signal.SIGTERM, signal.SIG_DFL)
     os.kill(os.getpid(), signal.SIGTERM)
+
+
+def _on_sigterm(signum, frame):
+    global _sigterm_active
+    if _sigterm_active:
+        # re-entered through a foreign handler chaining back into this
+        # one mid-delivery: the dump and the hooks already ran — go
+        # straight to the termination tail instead of double-dumping
+        _chain_sigterm(signum, frame)
+        return
+    _sigterm_active = True
+    try:
+        try:
+            dump(reason="SIGTERM", _signal_safe=True)
+        except Exception:  # noqa: BLE001 — teardown must still tear down
+            pass
+        for hook in list(_sigterm_hooks):
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — best-effort by contract
+                pass
+        _chain_sigterm(signum, frame)
+    finally:
+        # reached when the chain did not terminate the process (SIG_IGN
+        # disposition, or a harness handler that returns): the next
+        # delivery dumps again
+        _sigterm_active = False
 
 
 def _install_signal_handlers() -> None:

@@ -70,6 +70,17 @@ def direction(metric: str, unit: Optional[str] = None) -> Optional[str]:
         # dbscan_tpu/campaign.py): more of the campaign's wall spent
         # recomputing stolen/killed leases regresses UP like a wall
         return LOWER_BETTER
+    if metric.endswith("_jobs_s"):
+        # serve tenancy throughput (jobs PER second): a rate, so it
+        # regresses DOWN — and it must be matched BEFORE the "_s"
+        # seconds rule below catches the suffix
+        return HIGHER_BETTER
+    if metric.endswith("_qps"):
+        # serving query rate under concurrent ingest: regresses DOWN
+        return HIGHER_BETTER
+    if metric.endswith("_ms"):
+        # serve query latency percentiles: walls, regress UP
+        return LOWER_BETTER
     if metric.endswith(("_seconds", "_s")) or metric == "seconds":
         return LOWER_BETTER
     if metric.endswith(("_mpts", "_vs_baseline", "_throughput")) or metric in (

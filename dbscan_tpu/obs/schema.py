@@ -50,6 +50,8 @@ COMPILE_FAMILIES = (
     "spill.level",
     "spill.level_final",
     "halo.merge",
+    "serve.query",
+    "serve.jobs",
 )
 
 #: HBM watermark sample sites (obs/memory.py `sample`): each emits
@@ -60,6 +62,7 @@ MEMORY_SITES = (
     "dispatch.banded",
     "spill.payload_upload",
     "fault.resource_exhausted",
+    "serve.health",
 )
 
 #: driver `_mark` phases (timings keys sans ``_s``): each emits span
@@ -146,6 +149,34 @@ COUNTERS = {
     "had to be recomputed after a lease failed/expired "
     "(campaign_replay_frac numerator)",
     "flightrec.dumps": "flight-recorder postmortem dumps written",
+    "serve.updates": "completed ClusterService ingest steps (each "
+    "publishes a new query snapshot epoch)",
+    "serve.ingest_points": "points ingested across completed serve "
+    "updates",
+    "serve.ingest_rejects": "micro-batches refused at the full ingest "
+    "queue (block=False backpressure refusals)",
+    "serve.queries": "query batches answered against the resident "
+    "snapshot",
+    "serve.query_points": "points across answered query batches",
+    "serve.degraded": "serve ingest steps that died un-degradable "
+    "(FatalDeviceFault surfaced to the service health state)",
+    "serve.checkpoints": "serve state checkpoints written (explicit, "
+    "shutdown, or SIGTERM)",
+    "serve.restores": "serve state checkpoints restored at service "
+    "construction",
+    "serve.jobs_done": "small tenant jobs completed by batched "
+    "serve.jobs dispatches",
+    "serve.job_batches": "batched serve.jobs dispatches issued "
+    "(pad-and-stack fan-ins, not per-job dispatches)",
+    "serve.jobs_rejected": "tenant jobs rejected at admission (HBM "
+    "price over DBSCAN_SERVE_HEADROOM_BYTES, or oversized)",
+    "serve.admit_splits": "job batches split because the stacked "
+    "HBM price would breach the admission headroom",
+    "checkpoint.serve_saves": "serve state checkpoints written by "
+    "checkpoint.save_serve",
+    "checkpoint.serve_loads": "serve state checkpoints read back by "
+    "checkpoint.load_serve",
+    "checkpoint.serve_bytes": "bytes across saved serve state arrays",
     "devtime.samples": "dispatches bracketed by the ready-sync "
     "device-timeline hooks (DBSCAN_DEVTIME)",
     "devtime.dispatch_s": "summed host wall of the bracketed dispatch "
@@ -179,6 +210,14 @@ GAUGES = {
     "+ leased; a stalled campaign freezes it nonzero)",
     "campaign.workers_active": "campaign worker threads currently "
     "started (0 once the fleet joined)",
+    "serve.queue_depth": "micro-batches submitted to the ClusterService "
+    "and not yet ingested (the backpressure figure; bounded by "
+    "DBSCAN_SERVE_QUEUE)",
+    "serve.epoch": "the service's last PUBLISHED snapshot epoch — "
+    "queries are answered against exactly this state, never a "
+    "half-merged update",
+    "serve.resident_points": "skeleton core points in the published "
+    "query snapshot",
 }
 
 SPANS = {
@@ -213,6 +252,13 @@ SPANS = {
     "fully-banked checkpoint dir",
     "checkpoint.save_premerge": "pre-merge checkpoint write",
     "checkpoint.save_p1_chunk": "p1 chunk checkpoint write",
+    "checkpoint.save_serve": "serve state checkpoint write",
+    "serve.update": "one ClusterService ingest step (stream update + "
+    "snapshot publish; epoch attached)",
+    "serve.query": "one query batch answered against the resident "
+    "snapshot (epoch + point count attached)",
+    "serve.job_batch": "one pad-and-stack serve.jobs dispatch window "
+    "(job count + padded shape attached)",
     "transfer.pull": "device->host pull (bytes in args)",
     "stream.update": "streaming micro-batch update step",
 }
@@ -253,6 +299,10 @@ EVENTS = {
     "not a dead campaign (ROADMAP items 1+5 composition)",
     "flightrec.dump": "flight-recorder dump written (reason + abort "
     "site attached); the ring's final instant says why the file exists",
+    "serve.epoch_publish": "a completed ingest step published a new "
+    "query snapshot (epoch + skeleton size attached)",
+    "serve.admit_reject": "the admission controller rejected a tenant "
+    "job (predicted bytes + headroom attached)",
     "profile.window_open": "jax.profiler capture window opened at a "
     "tracked dispatch (DBSCAN_PROFILE_WINDOW)",
     "profile.window_close": "jax.profiler capture window closed "
@@ -287,6 +337,7 @@ PREFIX_COMPILES = "compiles."
 PREFIX_FAULTS = "faults."
 PREFIX_DEVTIME = "devtime."
 PREFIX_CAMPAIGN = "campaign."
+PREFIX_SERVE = "serve."
 
 #: the hot/cold classification marks obs/analyze.py reads back
 RESIDENT_MARKS = ("resident_cache.hit", "resident_cache.miss")

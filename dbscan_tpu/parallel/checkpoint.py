@@ -341,6 +341,83 @@ def p1_chunk_indices(
     return sorted(out)
 
 
+# --- serve state checkpoints (resident ClusterService) -----------------
+#
+# The serving layer (dbscan_tpu/serve) is long-lived by design, and the
+# signal it dies to — SIGTERM preemption — arrives mid-ingest. Its
+# checkpoint is tiny compared to the premerge state above: the stream's
+# window skeleton + identity union-find (streaming.export_state), a few
+# MB even at production window sizes. Same torn-write discipline as the
+# premerge pair: atomic npz with the fingerprint embedded, loader
+# rejects mismatches outright (a resumed server must never adopt
+# another stream's identity state — relabeling drift is the one failure
+# the serving contract forbids).
+
+_SERVE_NPZ = "serve_state.npz"
+
+
+def save_serve(
+    ckpt_dir: str,
+    fingerprint: str,
+    arrays: dict,
+    scalars: dict,
+    quiet: bool = False,
+) -> str:
+    """Atomically persist one serve/stream state snapshot; returns the
+    written path. Signal-handler safe by construction with ``quiet``
+    set: one tmp write + rename, no locks taken — the telemetry hooks
+    (which DO take the registry locks) are skipped, because the
+    SIGTERM-interrupted frame may already hold them. The arrays are an
+    immutable published snapshot, never live mutable state."""
+    t0 = time.perf_counter()
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, _SERVE_NPZ)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(
+            f,
+            _fingerprint=np.array(fingerprint),
+            _scalars=np.array(json.dumps(scalars)),
+            **arrays,
+        )
+    os.replace(tmp, path)
+    if not quiet:
+        obs.count("checkpoint.serve_saves")
+        obs.count(
+            "checkpoint.serve_bytes",
+            int(sum(a.nbytes for a in arrays.values())),
+        )
+        obs.add_span("checkpoint.save_serve", t0, time.perf_counter())
+    return path
+
+
+def load_serve(ckpt_dir: str, fingerprint: str) -> Optional[dict]:
+    """Load a serve state matching ``fingerprint``; None when absent,
+    torn, or written for a different stream config (resume must never
+    be less safe than starting a fresh stream)."""
+    path = os.path.join(ckpt_dir, _SERVE_NPZ)
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            if str(z["_fingerprint"]) != fingerprint:
+                return None
+            scalars = json.loads(str(z["_scalars"]))
+            arrays = {
+                k: z[k] for k in z.files if not k.startswith("_")
+            }
+    except (
+        OSError,
+        ValueError,
+        KeyError,
+        json.JSONDecodeError,
+        zipfile.BadZipFile,
+    ):
+        return None
+    obs.count("checkpoint.serve_loads")
+    return {"arrays": arrays, "scalars": scalars}
+
+
 # --- campaign progress sidecar ----------------------------------------
 #
 # A retry-resume harness (bench.py::m100_row) needs two numbers a dead

@@ -51,6 +51,7 @@ from jax.sharding import PartitionSpec
 from dbscan_tpu import _native, faults, obs
 from dbscan_tpu import config as config_mod
 from dbscan_tpu.config import DBSCANConfig
+from dbscan_tpu.lint import tsan as _tsan
 from dbscan_tpu.obs import compile as obs_compile
 from dbscan_tpu.obs import flight as obs_flight
 from dbscan_tpu.obs import memory as obs_memory
@@ -1174,6 +1175,20 @@ def _resume_from_premerge(state: dict, t_start: float) -> TrainOutput:
 # input evicts via weakref so the cache can never outlive the data it
 # mirrors. Opt out with DBSCAN_RESIDENT_CACHE=0.
 _RESIDENT_CACHE: dict = {}
+# The cache is shared mutable state on the worker slice since the serve
+# ingest thread (dbscan_tpu/serve) started driving train_arrays
+# concurrently with main-thread trains; the weakref eviction callback
+# additionally fires on WHATEVER thread runs the gc. Reentrant on
+# purpose: that callback can fire inside the locked store below when
+# the clear() drops the last strong reference chain to a prior key.
+_RESIDENT_CACHE_LOCK = _tsan.rlock("driver.resident_cache")
+
+
+def _resident_cache_drop(key: int) -> None:
+    """Weakref eviction: the input array was gc'd, drop its entry."""
+    with _RESIDENT_CACHE_LOCK:
+        _tsan.access("driver.resident_cache")
+        _RESIDENT_CACHE.pop(key, None)
 
 
 # Odd per-position multipliers for the fingerprint's 64 KiB windows:
@@ -1238,7 +1253,9 @@ def _resident_payload_lookup(pts: np.ndarray):
     assume the prior call's config decided it."""
     if not config_mod.env("DBSCAN_RESIDENT_CACHE"):
         return None, None
-    ent = _RESIDENT_CACHE.get(id(pts))
+    with _RESIDENT_CACHE_LOCK:
+        _tsan.access("driver.resident_cache", write=False)
+        ent = _RESIDENT_CACHE.get(id(pts))
     if ent is None:
         return None, None
     ref, ent_fp, unit, ops, has_zeros = ent
@@ -1269,11 +1286,13 @@ def _resident_payload_cached(
         fp = _pts_fingerprint(pts)
     ops = sdev.DeviceNodeOps.from_host(unit)
     try:
-        ref = weakref.ref(pts, lambda _r, k=key: _RESIDENT_CACHE.pop(k, None))
+        ref = weakref.ref(pts, lambda _r, k=key: _resident_cache_drop(k))
     except TypeError:  # un-weakref-able input: keep the prior entry
         return ops
-    _RESIDENT_CACHE.clear()  # one entry: the latest dataset
-    _RESIDENT_CACHE[key] = (ref, fp, unit, ops, bool(has_zeros))
+    with _RESIDENT_CACHE_LOCK:
+        _tsan.access("driver.resident_cache")
+        _RESIDENT_CACHE.clear()  # one entry: the latest dataset
+        _RESIDENT_CACHE[key] = (ref, fp, unit, ops, bool(has_zeros))
     return ops
 
 

@@ -68,6 +68,17 @@ Off-switch: ``DBSCAN_PULL_PIPELINE=0`` makes :func:`get_engine` return
 None and every call site keeps its original serial code path
 byte-for-byte.
 
+Dedicated instances: :func:`get_engine` hands out ONE process engine,
+and its strict submission order is load-bearing for the driver's
+sequential finalize — but that same strict order means an unrelated
+consumer sharing it inherits the driver's queue as latency. Consumers
+with their own ordering domain construct their own
+:class:`PullEngine`: the serving layer's query path
+(dbscan_tpu/serve/service.py) does exactly this, so point-lookup pulls
+never queue behind an ingest train's chunk pulls (measured ~10x
+sustained QPS on this container). Same off-switch discipline applies —
+under ``DBSCAN_PULL_PIPELINE=0`` such consumers run their serial path.
+
 Collective-aware mode (multi-process runs): pulls there are cross-host
 collectives (``mesh.pull_to_host`` allgathers non-addressable shards),
 so their ISSUE ORDER must be identical on every process or the job

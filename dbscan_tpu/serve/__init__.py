@@ -1,0 +1,48 @@
+"""dbscan_tpu.serve — the resident serving layer.
+
+Two legs over the batch/streaming engines (ROADMAP: "a real serving
+system"):
+
+- **online** — :class:`ClusterService` (serve/service.py): a long-lived
+  server whose ingest thread drives streaming micro-batch updates while
+  concurrent readers answer ``query(points) -> (gid, core_flag)``
+  against the last published snapshot epoch (serve/query.py), with
+  backpressure/health from the obs counters and SIGTERM-safe
+  checkpoint/restore through parallel/checkpoint.py;
+- **batch tenancy** — :class:`JobBatcher` + :class:`AdmissionController`
+  (serve/tenancy.py): thousands of small independent clustering jobs
+  pad-and-stacked into single ``serve.jobs`` dispatches (zero
+  recompiles across a mixed job stream), admission-priced against the
+  graftshape HBM model before anything is dispatched.
+
+``python -m dbscan_tpu.serve`` serves a synthetic stream and prints
+health/QPS (serve/__main__.py); ``cli.py --serve`` runs the same demo.
+"""
+
+from dbscan_tpu.serve.query import QueryAnswer, batched_query, query_host
+from dbscan_tpu.serve.service import (
+    ClusterService,
+    QueryResult,
+    Snapshot,
+    stream_fingerprint,
+)
+from dbscan_tpu.serve.tenancy import (
+    AdmissionController,
+    AdmissionRejected,
+    JobBatcher,
+    JobResult,
+)
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionRejected",
+    "ClusterService",
+    "JobBatcher",
+    "JobResult",
+    "QueryAnswer",
+    "QueryResult",
+    "Snapshot",
+    "batched_query",
+    "query_host",
+    "stream_fingerprint",
+]

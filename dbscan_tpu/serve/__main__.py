@@ -1,0 +1,169 @@
+"""``python -m dbscan_tpu.serve`` — serve a synthetic stream.
+
+The zero-to-serving demo AND the shape the bench harness measures:
+start a :class:`ClusterService`, ingest drifting synthetic micro-
+batches on the service's ingest thread, hammer it with concurrent
+query batches from reader threads, print a health line per completed
+update, then run a small multi-tenant :class:`JobBatcher` stream — and
+finish with one JSON summary line (``serve_qps``, ``serve_p50_ms``,
+``serve_p99_ms``, ``tenancy_jobs_s``), the same keys
+``BENCH_SERVE_*.json`` captures carry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dbscan_tpu.serve",
+        description="Serve a synthetic stream: concurrent ingest + "
+        "point->cluster queries, then a multi-tenant small-job batch.",
+    )
+    p.add_argument("--updates", type=int, default=6, help="ingest batches")
+    p.add_argument(
+        "--batch", type=int, default=2000, help="points per ingest batch"
+    )
+    p.add_argument("--eps", type=float, default=0.6)
+    p.add_argument("--min-points", type=int, default=5)
+    p.add_argument("--window", type=int, default=3)
+    p.add_argument(
+        "--max-points-per-partition", type=int, default=4096
+    )
+    p.add_argument(
+        "--query-batch", type=int, default=256,
+        help="points per query batch",
+    )
+    p.add_argument(
+        "--readers", type=int, default=2,
+        help="concurrent query reader threads",
+    )
+    p.add_argument(
+        "--jobs", type=int, default=40,
+        help="small tenant jobs for the JobBatcher leg (0 disables)",
+    )
+    p.add_argument(
+        "--checkpoint-dir",
+        help="serve state checkpoint dir (SIGTERM-safe resume)",
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--json", action="store_true",
+        help="print ONLY the final JSON summary line",
+    )
+    return p
+
+
+def _synthetic_batches(rng, updates: int, batch: int):
+    from dbscan_tpu.serve import synthetic
+
+    centers = synthetic.blob_centers(side=4)
+    for u in range(updates):
+        yield synthetic.drifting_batch(rng, u, batch, centers)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    from dbscan_tpu.serve import ClusterService, JobBatcher
+
+    rng = np.random.default_rng(args.seed)
+    svc = ClusterService(
+        args.eps,
+        args.min_points,
+        window=args.window,
+        max_points_per_partition=args.max_points_per_partition,
+        checkpoint_dir=args.checkpoint_dir,
+    )
+    lat_ms: list = []
+    lat_lock = threading.Lock()
+    stop = threading.Event()
+    qpts = rng.uniform(0, 4 * 8.0, (args.query_batch, 2))
+
+    def reader():
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            svc.query(qpts)
+            dt = (time.perf_counter() - t0) * 1e3
+            with lat_lock:
+                lat_ms.append(dt)
+
+    threads = [
+        threading.Thread(target=reader, daemon=True)
+        for _ in range(max(1, args.readers))
+    ]
+    t_start = time.perf_counter()
+    with svc:
+        for t in threads:
+            t.start()
+        last_epoch = 0
+        for batch in _synthetic_batches(rng, args.updates, args.batch):
+            svc.submit(batch)
+            svc.drain()
+            h = svc.health()
+            last_epoch = h["epoch"]
+            if not args.json:
+                print(
+                    f"epoch {h['epoch']}: queue={h['queue_depth']}/"
+                    f"{h['queue_max']} resident={h['resident_points']} "
+                    f"update={h['last_update_s']:.3f}s "
+                    f"queries={len(lat_ms)}"
+                    + (" DEGRADED" if h["degraded"] else "")
+                )
+        ingest_wall = time.perf_counter() - t_start
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        health = svc.health()
+
+    with lat_lock:
+        lats = np.asarray(lat_ms, np.float64)
+    qps = len(lats) / ingest_wall if ingest_wall > 0 else 0.0
+
+    tenancy_jobs_s = 0.0
+    if args.jobs > 0:
+        from dbscan_tpu.serve import synthetic
+
+        batcher = JobBatcher()
+        t0 = time.perf_counter()
+        for j in range(args.jobs):
+            batcher.submit(
+                synthetic.tenant_job(rng), eps=0.5, min_points=4
+            )
+        done = batcher.flush()
+        tenancy_wall = time.perf_counter() - t0
+        tenancy_jobs_s = len(done) / tenancy_wall if tenancy_wall > 0 else 0.0
+
+    from dbscan_tpu import obs
+
+    obs.flush()  # land the tenancy-leg counters in any DBSCAN_TRACE file
+    summary = {
+        "metric": "serve",
+        "serve_updates": int(args.updates),
+        "serve_epoch": int(last_epoch),
+        "serve_queries": int(len(lats)),
+        "serve_qps": round(float(qps), 3),
+        "serve_p50_ms": round(float(np.percentile(lats, 50)), 3)
+        if len(lats)
+        else None,
+        "serve_p99_ms": round(float(np.percentile(lats, 99)), 3)
+        if len(lats)
+        else None,
+        "serve_batch_period_s": round(ingest_wall / max(1, args.updates), 4),
+        "serve_resident_points": int(health["resident_points"]),
+        "tenancy_jobs_s": round(float(tenancy_jobs_s), 3),
+        "degraded": health["degraded"],
+    }
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,282 @@
+"""Online point->cluster queries against the resident stream skeleton.
+
+The streaming engine (dbscan_tpu/streaming.py) answers "cluster this
+batch" but not the serving question — "which cluster is THIS point in,
+right now?" — without re-running a whole micro-batch update. This
+module is the thin read path: one batched device dispatch per query
+batch against the service's published snapshot (window core points +
+their resolved stream ids), shaped so a steady query stream compiles
+ZERO new kernels.
+
+Query semantics (the serving contract, PARITY.md):
+
+- a query point's neighbors are the snapshot's skeleton core points
+  within ``eps`` (the same subsampled-probe shape SNG-DBSCAN's
+  similarity queries take against a retained structure,
+  arXiv:2006.06743 — the skeleton IS the density summary the stream
+  retains);
+- ``gid`` = the MINIMUM resolved stream id among those neighbors
+  ("elder id wins", the stream's own tie rule), 0 when it has none
+  (noise/unseen space);
+- ``core_flag`` = whether the point's self-inclusive neighbor count
+  within the skeleton reaches ``min_points`` — would this point be a
+  core point of the resident density structure. Border points of the
+  live stream report ``gid > 0`` with ``core_flag`` False.
+
+Queries are read-only: they never densify the stream (a query is not
+an ingest), and they are answered against exactly one published epoch
+(serve/service.py's seqlock), never a half-merged update.
+
+Shape discipline: the skeleton is padded ONCE per published snapshot
+(:func:`pad_skeleton`, ladder widths + the streaming shape ratchet),
+and each query batch pads its own [Q] axis the same way — after
+warm-up every dispatch reuses an exact jit signature. Batches larger
+than ``DBSCAN_SERVE_QUERY_SLOTS`` split into consecutive dispatches,
+bounding the [Q, K] measure working set. Results come back through
+the process PullEngine (parallel/pipeline.py) as one thin label pull
+per batch.
+
+Fault surface: when ``DBSCAN_FAULT_SPEC`` names the ``serve`` site,
+each query dispatch consumes one ``serve`` ordinal under
+:func:`faults.supervised` with the numpy host oracle
+(:func:`query_host`) as the degradation path — same opt-in discipline
+as the ``pull`` site (ordinals are consumed on reader threads, so an
+unconditional consume would interleave nondeterministically with the
+dispatch sites' streams).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from dbscan_tpu import config, faults, obs
+from dbscan_tpu.obs import compile as obs_compile
+from dbscan_tpu.ops import distance as dist_mod
+from dbscan_tpu.parallel import pipeline as pipe_mod
+from dbscan_tpu.parallel.binning import _ladder_width, _ratchet
+
+QUERY_FAMILY = "serve.query"
+
+#: min-fold identity for "no adjacent skeleton id" (ids are positive)
+_NO_SID = np.int32(np.iinfo(np.int32).max)
+
+#: ladder quantum for the query/skeleton axes (sublane-friendly, same
+#: spirit as the bucket_multiple default)
+_PAD = 128
+
+
+@functools.lru_cache(maxsize=None)
+def _query_builder(min_points: int, metric: str):
+    """One compiled query kernel per (min_points, metric) — the
+    driver's compiled-builder idiom, so ``tracked_call`` sees a real
+    jit object (compile accounting + shapecheck + devtime hooks)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(qpts, spts, sids, eps):
+        m = dist_mod.get_metric(metric)
+        measure = m.pairwise(qpts, spts)
+        thr = m.threshold(jnp.asarray(eps, measure.dtype))
+        valid = sids > 0  # padding rows carry sid 0
+        adj = (measure <= thr) & valid[None, :]
+        counts = jnp.sum(adj, axis=1, dtype=jnp.int32)
+        core = (counts + 1) >= jnp.int32(min_points)  # self-inclusive
+        gid = jnp.min(
+            jnp.where(adj, sids[None, :], jnp.int32(_NO_SID)), axis=1
+        )
+        gid = jnp.where(gid == jnp.int32(_NO_SID), jnp.int32(0), gid)
+        return gid, core.astype(jnp.int8), counts
+
+    return fn
+
+
+class QueryAnswer(NamedTuple):
+    """One answered query batch, aligned with the input row order."""
+
+    gids: np.ndarray  # [N] int64 resolved stream ids; 0 = noise
+    core: np.ndarray  # [N] int8 would-be-core flag vs the skeleton
+    counts: np.ndarray  # [N] int32 skeleton neighbors (self exclusive)
+
+
+def pad_skeleton(
+    spts: np.ndarray,
+    sids: np.ndarray,
+    floors: Optional[dict] = None,
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Ladder-pad one snapshot's skeleton (points + resolved ids) for
+    the query dispatches: returns ``(spts_padded, sids_padded_i32,
+    k_valid)``. Done once per PUBLISHED snapshot (the write side), so
+    queries only ever pad their own [Q] axis. Padding rows carry sid 0
+    (excluded in-kernel) and zero coordinates. Ids are narrowed to
+    int32 for the device (the stream allocates ids densely from 1;
+    the service asserts the stream stays below 2**31)."""
+    spts = np.asarray(spts, np.float64)
+    sids = np.asarray(sids)
+    k = len(spts)
+    if sids.size and int(sids.max()) >= int(_NO_SID):
+        raise ValueError(
+            "stream ids exceeded int32 range; the query kernel's "
+            "device ids are i32"
+        )
+    kp = _ratchet(floors, "serve_k", _ladder_width(max(k, 1), _PAD))
+    d = spts.shape[1] if spts.ndim == 2 else 2
+    out_p = np.zeros((kp, d), np.float64)
+    out_i = np.zeros(kp, np.int32)
+    if k:
+        out_p[:k] = spts
+        out_i[:k] = sids.astype(np.int32)
+    return out_p, out_i, k
+
+
+def query_host(
+    qpts: np.ndarray,
+    spts: np.ndarray,
+    sids: np.ndarray,
+    eps: float,
+    min_points: int,
+    metric: str,
+) -> QueryAnswer:
+    """Host-path oracle (numpy, same algebra): the degradation target
+    of a persistently-faulting query dispatch, and the reference the
+    device path is pinned against."""
+    qpts = np.asarray(qpts, np.float64)
+    spts = np.asarray(spts, np.float64)
+    sids = np.asarray(sids, np.int64)
+    n = len(qpts)
+    gids = np.zeros(n, np.int64)
+    core = np.zeros(n, np.int8)
+    counts = np.zeros(n, np.int32)
+    valid = sids > 0
+    if n == 0:
+        return QueryAnswer(gids, core, counts)
+    # the metric registry's pairwise runs eagerly on host arrays —
+    # one algebra, evaluated outside any jit
+    m = dist_mod.get_metric(metric)
+    measure = np.asarray(m.pairwise(qpts, spts))
+    thr = float(np.asarray(m.threshold(np.float64(eps))))
+    adj = (measure <= thr) & valid[None, :]
+    counts[:] = adj.sum(axis=1)
+    core[:] = ((counts + 1) >= int(min_points)).astype(np.int8)
+    big = np.int64(np.iinfo(np.int64).max)
+    nbr = np.where(adj, sids[None, :], big).min(axis=1)
+    gids[:] = np.where(nbr == big, 0, nbr)
+    return QueryAnswer(gids, core, counts)
+
+
+def _dispatch_one(
+    qp: np.ndarray,
+    spts: np.ndarray,
+    sids: np.ndarray,
+    eps: float,
+    min_points: int,
+    metric: str,
+    q: int,
+    label: str,
+    engine: Optional[pipe_mod.PullEngine] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """One padded query dispatch + its thin label pull (PullEngine when
+    live); returns host arrays sliced to the valid prefix ``q``.
+
+    ``engine``: the PullEngine the label pull rides. The service passes
+    its OWN dedicated instance: the process-global engine executes jobs
+    in strict submission order, so a query pull submitted there would
+    queue behind the ingest train's chunk pulls and host finalize —
+    coupling read latency to write batch size, exactly what the
+    epoch-snapshot design exists to avoid. None falls back to the
+    process engine (standalone/offline use)."""
+    fn = _query_builder(int(min_points), metric)
+    gid_d, core_d, cnt_d = obs_compile.tracked_call(
+        QUERY_FAMILY, fn, qp, spts, sids, float(eps)
+    )
+
+    def work():
+        return (
+            np.asarray(gid_d)[:q].astype(np.int64),
+            np.asarray(core_d)[:q],
+            np.asarray(cnt_d)[:q],
+        )
+
+    eng = engine if engine is not None else pipe_mod.get_engine()
+    if eng is None:
+        return work()
+
+    def on_start():
+        for a in (gid_d, core_d, cnt_d):
+            start = getattr(a, "copy_to_host_async", None)
+            if start is not None:
+                start()
+
+    job = eng.submit(
+        work,
+        on_start=on_start,
+        bytes_hint=int(len(qp) * 9),
+        label=label,
+    )
+    return eng.settle(job, serial_fallback=work)
+
+
+def batched_query(
+    qpts: np.ndarray,
+    spts: np.ndarray,
+    sids: np.ndarray,
+    eps: float,
+    min_points: int,
+    metric: str,
+    floors: Optional[dict] = None,
+    engine: Optional[pipe_mod.PullEngine] = None,
+) -> QueryAnswer:
+    """Answer one query batch against a (pre-padded) skeleton snapshot.
+
+    ``spts``/``sids`` come from :func:`pad_skeleton` (the service pads
+    at publish time); ``qpts`` is any [N, D] host array with the
+    snapshot's clustering columns. Batches past
+    ``DBSCAN_SERVE_QUERY_SLOTS`` split into consecutive dispatches.
+    ``engine``: see :func:`_dispatch_one`.
+    """
+    qpts = np.asarray(qpts, np.float64)
+    n = len(qpts)
+    gids = np.zeros(n, np.int64)
+    core = np.zeros(n, np.int8)
+    counts = np.zeros(n, np.int32)
+    if n == 0:
+        return QueryAnswer(gids, core, counts)
+    if qpts.shape[1] != spts.shape[1]:
+        raise ValueError(
+            f"query points have {qpts.shape[1]} columns; the resident "
+            f"skeleton carries {spts.shape[1]}"
+        )
+    slots = max(_PAD, int(config.env("DBSCAN_SERVE_QUERY_SLOTS")))
+    drill = faults.serve_site_active()
+    for start in range(0, n, slots):
+        stop = min(start + slots, n)
+        q = stop - start
+        qp_pad = _ratchet(floors, "serve_q", _ladder_width(q, _PAD))
+        qp = np.zeros((qp_pad, qpts.shape[1]), np.float64)
+        qp[:q] = qpts[start:stop]
+        label = f"serve.query[{start}:{stop}]"
+
+        def attempt(_budget, qp=qp, q=q, label=label):
+            return _dispatch_one(
+                qp, spts, sids, eps, min_points, metric, q, label,
+                engine=engine,
+            )
+
+        if drill:
+            g, c, cn = faults.supervised(
+                faults.SITE_SERVE,
+                attempt,
+                fallback=lambda qp=qp, q=q: query_host(
+                    qp[:q], spts, sids, eps, min_points, metric
+                ),
+                label=label,
+            )
+        else:
+            g, c, cn = attempt(None)
+        gids[start:stop] = g
+        core[start:stop] = c
+        counts[start:stop] = cn
+    return QueryAnswer(gids, core, counts)

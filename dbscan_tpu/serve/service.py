@@ -1,0 +1,527 @@
+"""Resident ClusterService: concurrent ingest + point->cluster queries.
+
+The long-lived front of the streaming engine (ROADMAP item "a real
+serving system"): ONE process holds the stream's device/jit state
+resident across micro-batches, an ingest thread drives
+``StreamingDBSCAN.update``, and concurrent reader threads answer
+``query(points) -> (gid, core_flag)`` against the last PUBLISHED
+snapshot of the resident grid — never a half-merged update.
+
+Consistency: a seqlock-style epoch guards the published snapshot. The
+ingest thread is the only writer; it bumps ``_seq`` to odd, swaps in
+the new immutable :class:`Snapshot`, and bumps back to even — all
+under the writer lock (one writer today, but the lock is what the
+static race rules and the runtime sanitizer certify). Readers spin the
+classic seqlock read (even seq, read, recheck) and therefore always
+observe one complete epoch; the epoch number rides every answer so a
+caller can correlate results with ingest progress.
+
+Backpressure & health: ``submit`` blocks (or refuses, with
+``block=False``) once ``DBSCAN_SERVE_QUEUE`` micro-batches are
+pending — the ``serve.queue_depth`` gauge is the live signal — and
+:meth:`health` reports queue depth, epoch/update counters, resident
+skeleton size, HBM occupancy (obs/memory), the process fault counters,
+and the pull-engine totals: everything a load balancer or autoscaler
+polls.
+
+Preemption: the service composes with the flight recorder's SIGTERM
+path through :func:`obs.flight.on_sigterm` — on SIGTERM the recorder
+dumps its postmortem ring FIRST, then this service's hook checkpoints
+the last published snapshot (``checkpoint.save_serve``; quiet — the
+signal path takes no telemetry locks), then the previous disposition
+chains and the process dies. A restarted service restores the stream
+state and resumes with BYTE-IDENTICAL labels for every later batch
+(no relabeling drift; pinned by tests/test_serve.py).
+
+Fault drills: ``DBSCAN_FAULT_SPEC`` clauses at the ``serve`` site
+cover both legs — ingest steps and query dispatches each consume one
+``serve`` ordinal when the site is named (opt-in, like ``pull``).
+A retries-exhausted ingest fault marks the service degraded in
+:meth:`health` but keeps the query side serving the last good epoch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+import time
+from collections import deque
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+from dbscan_tpu import config, faults, obs
+from dbscan_tpu.config import DBSCANConfig, Engine, Precision
+from dbscan_tpu.lint import tsan as _tsan
+from dbscan_tpu.obs import flight as obs_flight
+from dbscan_tpu.obs import memory as obs_memory
+from dbscan_tpu.parallel import checkpoint as ckpt_mod
+from dbscan_tpu.parallel import pipeline as pipe_mod
+from dbscan_tpu.serve import query as query_mod
+from dbscan_tpu.streaming import StreamingDBSCAN, StreamUpdate
+
+logger = logging.getLogger(__name__)
+
+
+class Snapshot(NamedTuple):
+    """One published query state: immutable by construction, so a
+    reader that got a reference under an even seqlock value holds a
+    complete epoch regardless of later publishes."""
+
+    epoch: int
+    n_updates: int
+    spts: np.ndarray  # [Kp, D] ladder-padded skeleton core points
+    sids: np.ndarray  # [Kp] int32 resolved stream ids (0 on padding)
+    k: int  # valid skeleton rows
+    state: Optional[dict]  # streaming.export_state() at this epoch
+    update: Optional[StreamUpdate] = None  # the ingest step's labels
+
+
+class QueryResult(NamedTuple):
+    gids: np.ndarray  # [N] int64 resolved stream ids; 0 = noise
+    core: np.ndarray  # [N] int8 would-be-core flag vs the skeleton
+    counts: np.ndarray  # [N] int32 skeleton neighbors (self exclusive)
+    epoch: int  # the snapshot epoch this answer is consistent with
+
+
+def stream_fingerprint(cfg: DBSCANConfig, window: int) -> str:
+    """Digest of the config fields that determine stream identity
+    state — the gate :func:`checkpoint.load_serve` applies so a resumed
+    server can never adopt another stream's ids."""
+    h = hashlib.sha256()
+    h.update(
+        json.dumps(
+            {
+                "eps": cfg.eps,
+                "min_points": cfg.min_points,
+                "max_points_per_partition": cfg.max_points_per_partition,
+                "metric": cfg.metric,
+                "engine": cfg.engine.value,
+                "precision": cfg.precision.value,
+                "neighbor_backend": cfg.neighbor_backend,
+                "window": int(window),
+            },
+            sort_keys=True,
+        ).encode()
+    )
+    return h.hexdigest()
+
+
+class ClusterService:
+    """Long-lived concurrent ingest/query server over one stream.
+
+    Lifecycle: construct (optionally restoring from ``checkpoint_dir``),
+    :meth:`start`, then :meth:`submit` micro-batches from any thread
+    while any number of threads call :meth:`query`; :meth:`stop` drains,
+    checkpoints, and joins. Also usable as a context manager.
+    """
+
+    def __init__(
+        self,
+        eps: float,
+        min_points: int,
+        *,
+        window: int = 3,
+        metric: str = "euclidean",
+        engine: Engine = Engine.ARCHERY,
+        precision: Precision = Precision.F32,
+        max_points_per_partition: int = 4096,
+        config_obj: Optional[DBSCANConfig] = None,
+        mesh=None,
+        checkpoint_dir: Optional[str] = None,
+        queue_depth: Optional[int] = None,
+        snapshot_log: Optional[List[Snapshot]] = None,
+    ):
+        if config_obj is None:
+            config_obj = DBSCANConfig(
+                eps=eps,
+                min_points=min_points,
+                max_points_per_partition=max_points_per_partition,
+                engine=engine,
+                precision=precision,
+                metric=metric,
+                # the streaming front-end's steady-state contract:
+                # ladder-pad the partition axis so micro-batches hit
+                # the jit cache (streaming.py sets the same)
+                static_partition_pad=True,
+            )
+        self._stream = StreamingDBSCAN(
+            eps,
+            min_points,
+            max_points_per_partition,
+            window=window,
+            mesh=mesh,
+            config=config_obj,
+        )
+        cfg = self._stream.config
+        self._fingerprint = stream_fingerprint(cfg, self._stream.window)
+        self._checkpoint_dir = checkpoint_dir
+        self._queue_max = max(
+            1,
+            int(
+                queue_depth
+                if queue_depth is not None
+                else config.env("DBSCAN_SERVE_QUEUE")
+            ),
+        )
+        self._floors = {}  # query-shape ratchet (ladder rungs recur)
+        self._cv = _tsan.condition("serve.queue")
+        self._queue: deque = deque()
+        self._lock = _tsan.lock("serve.state")
+        self._seq = 0  # seqlock: even = stable, odd = publish in flight
+        self._snap = Snapshot(0, 0, np.zeros((0, 2)), np.zeros(0, np.int32), 0, None)
+        self._snapshot_log = snapshot_log
+        self._degraded_error: Optional[str] = None
+        self._last_update_s = 0.0
+        self._busy = False  # an update is being ingested right now
+        self._fault_snap = faults.counters.snapshot()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._unhook = None
+        self._t_started = time.perf_counter()
+        # dedicated query-pull engine: the process-global engine
+        # executes in strict submission order, so query pulls there
+        # would queue behind the ingest train's chunk pulls — coupling
+        # read latency to write batch size (query.py module docstring)
+        self._pull = (
+            pipe_mod.PullEngine(
+                inflight=int(config.env("DBSCAN_PULL_INFLIGHT"))
+            )
+            if config.env("DBSCAN_PULL_PIPELINE")
+            else None
+        )
+        if checkpoint_dir is not None:
+            restored = ckpt_mod.load_serve(checkpoint_dir, self._fingerprint)
+            if restored is not None:
+                self._stream.restore_state(restored)
+                obs.count("serve.restores")
+                self._publish(self._stream.export_state(), epoch=int(
+                    restored["scalars"].get("epoch", 0)
+                ))
+
+    # --- lifecycle ------------------------------------------------------
+
+    def start(self) -> "ClusterService":
+        obs.ensure_env()  # DBSCAN_TRACE + flight recorder/signal wiring
+        if self._unhook is None:
+            self._unhook = obs_flight.on_sigterm(self._sigterm_hook)
+            if self._checkpoint_dir is not None and not (
+                obs_flight.sigterm_armed()
+            ):
+                # the hook rides the flight recorder's SIGTERM handler;
+                # with the recorder never enabled (DBSCAN_FLIGHTREC=0)
+                # or start() off the main thread, that handler was
+                # never installed and a preemption would kill the
+                # process with NO checkpoint — say so now, not at the
+                # first real SIGTERM
+                logger.warning(
+                    "serve: SIGTERM checkpoint hook is INERT — the "
+                    "flight recorder's signal handler is not installed "
+                    "(DBSCAN_FLIGHTREC=0, or the first enable ran off "
+                    "the main thread). A preempted server will NOT "
+                    "checkpoint; call checkpoint() explicitly or "
+                    "enable the recorder."
+                )
+        if self._thread is None or not self._thread.is_alive():
+            self._stop_evt.clear()
+            self._thread = threading.Thread(
+                target=self._ingest_loop,
+                name="dbscan-serve-ingest",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, checkpoint: bool = True, timeout: float = 60.0) -> None:
+        """Drain-and-join: the ingest thread finishes queued batches,
+        then exits; the final state is checkpointed (when a dir is
+        configured) and the SIGTERM hook unregistered."""
+        with self._cv:
+            _tsan.access("serve.queue")
+            self._stop_evt.set()
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self._unhook is not None:
+            self._unhook()
+            self._unhook = None
+        if self._pull is not None:
+            self._pull.close()
+        if checkpoint:
+            self.checkpoint()
+        # the stream's per-update flushes predate the LAST publish (the
+        # update's trace flush runs before the snapshot goes live): one
+        # closing flush so the exported trace carries the final epoch
+        obs.flush()
+
+    def __enter__(self) -> "ClusterService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # --- ingest side ----------------------------------------------------
+
+    def submit(
+        self, batch: np.ndarray, *, block: bool = True, timeout=None
+    ) -> bool:
+        """Enqueue one micro-batch for the ingest thread. Returns False
+        (and counts a refusal) when the queue is at its
+        ``DBSCAN_SERVE_QUEUE`` bound and ``block`` is False or the wait
+        timed out — the caller-visible backpressure signal."""
+        b = np.asarray(batch, dtype=np.float64)
+        if b.ndim != 2 or b.shape[1] < 2:
+            raise ValueError(f"batch must be [B, >=2], got {b.shape}")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            _tsan.access("serve.queue")
+            while len(self._queue) >= self._queue_max:
+                if self._stop_evt.is_set():
+                    raise RuntimeError("service is stopping")
+                if not block:
+                    obs.count("serve.ingest_rejects")
+                    return False
+                wait = (
+                    None
+                    if deadline is None
+                    else max(0.0, deadline - time.monotonic())
+                )
+                if not self._cv.wait(wait if wait is not None else 1.0):
+                    if deadline is not None:
+                        obs.count("serve.ingest_rejects")
+                        return False
+            if self._stop_evt.is_set():
+                raise RuntimeError("service is stopping")
+            self._queue.append(b)
+            depth = len(self._queue)
+            self._cv.notify_all()
+        obs.gauge("serve.queue_depth", depth)
+        return True
+
+    def drain(self, timeout: float = 300.0) -> bool:
+        """Block until every submitted batch has been ingested and
+        published; True on success, False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            _tsan.access("serve.queue", write=False)
+            while self._queue or self._busy:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.5))
+        return True
+
+    def _ingest_loop(self) -> None:
+        while True:
+            with self._cv:
+                _tsan.access("serve.queue")
+                while not self._queue and not self._stop_evt.is_set():
+                    self._cv.wait(0.5)
+                if not self._queue:
+                    return  # stopping and drained
+                batch = self._queue.popleft()
+                self._busy = True
+                depth = len(self._queue)
+                self._cv.notify_all()
+            obs.gauge("serve.queue_depth", depth)
+            try:
+                self._ingest_one(batch)
+            except faults.FatalDeviceFault as e:
+                # the query side keeps serving the last good epoch; the
+                # health endpoint carries the degradation (the flight
+                # recorder already dumped at the supervised raise site)
+                with self._lock:
+                    _tsan.access("serve.state")
+                    self._degraded_error = str(e)
+                obs.count("serve.degraded")
+            finally:
+                with self._cv:
+                    _tsan.access("serve.queue")
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def _ingest_one(self, batch: np.ndarray) -> StreamUpdate:
+        t0 = time.perf_counter()
+        with obs.span(
+            "serve.update",
+            epoch=int(self._snap.epoch + 1),
+            batch=int(len(batch)),
+        ):
+            if faults.serve_site_active():
+                upd = faults.supervised(
+                    faults.SITE_SERVE,
+                    lambda _b: self._stream.update(batch),
+                    label=f"ingest epoch {self._snap.epoch + 1}",
+                )
+            else:
+                upd = self._stream.update(batch)
+            state = self._stream.export_state()
+            self._publish(
+                state, wall_s=time.perf_counter() - t0, update=upd
+            )
+        obs.count("serve.updates")
+        obs.count("serve.ingest_points", int(len(batch)))
+        return upd
+
+    def _publish(
+        self,
+        state: dict,
+        epoch: Optional[int] = None,
+        wall_s: float = 0.0,
+        update: Optional[StreamUpdate] = None,
+    ) -> None:
+        """Build and publish one snapshot from an exported stream state
+        (ingest thread, or __init__ on restore). The skeleton ids are
+        re-resolved through the union-find so queries at this epoch see
+        canonical ("elder wins") ids."""
+        wpts = state["arrays"]["window_pts"]
+        wids = self._stream.resolve(state["arrays"]["window_ids"])
+        spts, sids, k = query_mod.pad_skeleton(wpts, wids, self._floors)
+        snap = Snapshot(
+            epoch=(self._snap.epoch + 1) if epoch is None else int(epoch),
+            n_updates=int(state["scalars"]["n_updates"]),
+            spts=spts,
+            sids=sids,
+            k=k,
+            state=state,
+            update=update,
+        )
+        with self._lock:
+            _tsan.access("serve.state")
+            self._seq += 1  # odd: publish in flight
+            self._snap = snap
+            self._last_update_s = wall_s
+            self._seq += 1  # even: stable
+            if self._snapshot_log is not None:
+                self._snapshot_log.append(snap)
+        obs.gauge("serve.epoch", snap.epoch)
+        obs.gauge("serve.resident_points", snap.k)
+        obs.event("serve.epoch_publish", epoch=snap.epoch, skeleton=snap.k)
+
+    # --- query side -------------------------------------------------------
+
+    def _read_snapshot(self) -> Snapshot:
+        """Seqlock read: retry while a publish is in flight. The
+        snapshot itself is immutable, so an even-seq reference IS a
+        consistent epoch."""
+        while True:
+            s0 = self._seq
+            if not (s0 & 1):
+                snap = self._snap
+                if self._seq == s0:
+                    return snap
+            time.sleep(0)  # yield to the publishing ingest thread
+
+    def query(self, points: np.ndarray) -> QueryResult:
+        """Answer ``point -> (gid, core_flag)`` for a batch, against
+        the last published epoch. Safe from any number of threads,
+        concurrent with ingest."""
+        pts = np.asarray(points, dtype=np.float64)
+        if pts.ndim != 2 or pts.shape[1] < 2:
+            raise ValueError(f"query points must be [N, >=2], got {pts.shape}")
+        snap = self._read_snapshot()
+        cfg = self._stream.config
+        ncols = 2 if cfg.metric == "euclidean" else pts.shape[1]
+        qpts = pts[:, :ncols]
+        with obs.span(
+            "serve.query", epoch=int(snap.epoch), points=int(len(pts))
+        ):
+            if snap.k == 0:
+                # empty skeleton: everything is noise (and core only in
+                # the degenerate min_points <= 1 regime) — no dispatch
+                ans = query_mod.QueryAnswer(
+                    np.zeros(len(pts), np.int64),
+                    np.full(
+                        len(pts),
+                        np.int8(1 if cfg.min_points <= 1 else 0),
+                    ),
+                    np.zeros(len(pts), np.int32),
+                )
+            else:
+                ans = query_mod.batched_query(
+                    qpts,
+                    snap.spts,
+                    snap.sids,
+                    cfg.eps,
+                    cfg.min_points,
+                    cfg.metric,
+                    floors=self._floors,
+                    engine=self._pull,
+                )
+        obs.count("serve.queries")
+        obs.count("serve.query_points", int(len(pts)))
+        return QueryResult(ans.gids, ans.core, ans.counts, snap.epoch)
+
+    def resolve(self, ids: np.ndarray) -> np.ndarray:
+        """Map previously-answered gids to their current canonical ids
+        (merges only ever lower an id toward the elder)."""
+        return self._stream.resolve(ids)
+
+    def last_update(self) -> Optional[StreamUpdate]:
+        """The most recent completed ingest step's stream-stable labels
+        (None before the first epoch, or right after a restore — the
+        checkpoint persists identity state, not the dead process's last
+        batch labels)."""
+        return self._read_snapshot().update
+
+    # --- health / checkpoint ---------------------------------------------
+
+    def health(self) -> dict:
+        """The poll endpoint: backpressure, progress, residency, HBM,
+        faults, pull-engine totals."""
+        with self._cv:
+            _tsan.access("serve.queue", write=False)
+            depth = len(self._queue)
+            busy = self._busy
+        snap = self._read_snapshot()
+        with self._lock:
+            _tsan.access("serve.state", write=False)
+            degraded = self._degraded_error
+            last_update_s = self._last_update_s
+        hbm = obs_memory.sample("serve.health")
+        eng = self._pull if self._pull is not None else pipe_mod.get_engine()
+        return {
+            "epoch": snap.epoch,
+            "n_updates": snap.n_updates,
+            "queue_depth": depth,
+            "queue_max": self._queue_max,
+            "ingesting": busy,
+            "backpressure": depth >= self._queue_max,
+            "resident_points": snap.k,
+            "last_update_s": round(last_update_s, 4),
+            "uptime_s": round(time.perf_counter() - self._t_started, 3),
+            "degraded": degraded,
+            "faults": faults.counters.delta(self._fault_snap),
+            "hbm_bytes_in_use": hbm,
+            "pull": eng.totals() if eng is not None else None,
+        }
+
+    def checkpoint(self, quiet: bool = False) -> Optional[str]:
+        """Persist the last published snapshot's stream state; returns
+        the path (None without a checkpoint dir or before the first
+        epoch). ``quiet`` skips telemetry — the SIGTERM hook sets it,
+        because the interrupted frame may hold the obs locks."""
+        if self._checkpoint_dir is None:
+            return None
+        snap = self._read_snapshot()
+        if snap.state is None:
+            return None
+        path = ckpt_mod.save_serve(
+            self._checkpoint_dir,
+            self._fingerprint,
+            snap.state["arrays"],
+            {**snap.state["scalars"], "epoch": int(snap.epoch)},
+            quiet=quiet,
+        )
+        if not quiet:
+            obs.count("serve.checkpoints")
+        return path
+
+    def _sigterm_hook(self) -> None:
+        """Runs on the flight recorder's SIGTERM path AFTER its dump:
+        checkpoint the last published epoch, then let the recorder
+        chain to the previous disposition. Quiet — a signal handler
+        must not touch locks the interrupted frame may hold."""
+        self.checkpoint(quiet=True)

@@ -1,0 +1,57 @@
+"""Shared synthetic traffic generators for the serving demo and bench.
+
+One definition of the drifting-blob ingest stream and the mixed tenant
+job shape, consumed by both ``python -m dbscan_tpu.serve``
+(serve/__main__.py) and the bench capture (``bench.py serve_row``) —
+two independently-drifting copies of the harness data would let a fix
+to one silently miss the other. The TIMING policy (warm-up rules,
+reader gating) stays with each harness; only the data shapes live
+here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def blob_centers(side: int = 4, spacing: float = 8.0) -> np.ndarray:
+    """A ``side x side`` grid of cluster centers."""
+    return np.stack(
+        np.meshgrid(np.arange(side) * spacing, np.arange(side) * spacing),
+        axis=-1,
+    ).reshape(-1, 2)
+
+
+def drifting_batch(
+    rng: np.random.Generator,
+    u: int,
+    batch: int,
+    centers: np.ndarray,
+    drift: float = 0.15,
+    noise: float = 0.25,
+) -> np.ndarray:
+    """Micro-batch ``u`` of a drifting blob field: the same cluster
+    grid plus a slow per-update drift, so stream identities persist
+    across updates while the window skeleton keeps moving."""
+    per = max(4, batch // len(centers))
+    return (
+        np.repeat(centers + drift * u, per, axis=0)
+        + rng.normal(0, noise, (len(centers) * per, 2))
+    )
+
+
+def tenant_job(
+    rng: np.random.Generator,
+    lo: int = 40,
+    hi: int = 260,
+) -> np.ndarray:
+    """One small tenant job: half a tight cluster, half uniform noise —
+    the mixed density a per-user clustering request actually carries."""
+    n = int(rng.integers(lo, hi))
+    c = rng.uniform(0, 10, 2)
+    return np.concatenate(
+        [
+            rng.normal(c, 0.2, (n // 2, 2)),
+            rng.uniform(-20, 20, (n - n // 2, 2)),
+        ]
+    )

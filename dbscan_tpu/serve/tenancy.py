@@ -1,0 +1,369 @@
+"""Multi-tenant batched small-job dispatch: pad-and-stack tenancy.
+
+"Millions of users" is not one 100M-point campaign — it is thousands
+of SMALL independent clustering jobs (one user's session, one
+document's mentions, one store's day of orders). Dispatching each as
+its own ``train`` pays a full driver walk and, worse, a fresh jit
+signature per job size. This module batches them the way the rest of
+the package batches partitions: pad every job's point axis up the
+recurring ladder, stack up to ``DBSCAN_SERVE_BATCH_JOBS`` jobs into
+one ``[J, S, D]`` tensor, and run ONE vmapped kernel dispatch
+(``serve.jobs`` family) whose per-job eps/min_points ride as traced
+``[J]`` arrays — so a fully mixed tenant stream (different sizes,
+different eps, different density thresholds) compiles ZERO new kernels
+at steady state (the ladder/ratchet discipline of
+parallel/binning.py, pinned by tests/test_serve.py).
+
+Admission control: before anything is stacked, each job — and each
+candidate batch — is PRICED with graftshape's declared symbolic model
+(``lint/shapes.FAMILY_MODELS["serve.jobs"]``: exact input bytes plus
+the [S, S] per-job adjacency temps) against
+``DBSCAN_SERVE_HEADROOM_BYTES``. A batch whose stacked price would
+breach the headroom is split (the remainder queues for the next
+dispatch, ``serve.admit_splits``); a single job that alone breaches
+it — or exceeds ``DBSCAN_SERVE_JOB_SLOTS`` points — is REJECTED at
+submit (:class:`AdmissionRejected`, ``serve.jobs_rejected``), because
+no schedule can make it fit. This is the graftshape HBM contract run
+FORWARD: predict, then dispatch, instead of dispatch-and-hope.
+
+Results are exact: each job's labels equal a standalone
+``ops.local_dbscan`` run of that job (same adjacency algebra, same
+seed-index components, 1-based per-job numbering via
+``labels.seed_to_local_ids``) — pinned against the per-job oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import deque
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+from dbscan_tpu import config, obs
+from dbscan_tpu.obs import compile as obs_compile
+from dbscan_tpu.ops import distance as dist_mod
+from dbscan_tpu.ops.labels import seed_to_local_ids
+from dbscan_tpu.parallel import pipeline as pipe_mod
+from dbscan_tpu.parallel.binning import _ladder_width, _ratchet
+
+JOBS_FAMILY = "serve.jobs"
+
+#: job-count ladder quantum (8 keeps J rungs sparse without padding a
+#: 3-job flush to 64) and point-axis quantum (sublane-friendly)
+_J_PAD = 8
+_S_PAD = 128
+
+
+class AdmissionRejected(ValueError):
+    """A job the admission controller provably cannot schedule: its
+    HBM price alone breaches the headroom, or it exceeds the per-job
+    point cap. Carries the pricing so the tenant can be told why."""
+
+    def __init__(self, reason: str, predicted_bytes: int, headroom: int):
+        super().__init__(
+            f"{reason} (predicted {predicted_bytes} B vs headroom "
+            f"{headroom} B)"
+        )
+        self.reason = reason
+        self.predicted_bytes = int(predicted_bytes)
+        self.headroom = int(headroom)
+
+
+class JobResult(NamedTuple):
+    job_id: int
+    clusters: np.ndarray  # [n] int32 1-based per-job cluster ids; 0 noise
+    flags: np.ndarray  # [n] int8 Core/Border/Noise
+    n_clusters: int
+
+
+class AdmissionController:
+    """Prices candidate ``serve.jobs`` dispatch shapes with the
+    declared graftshape family model and gates them on the configured
+    HBM headroom."""
+
+    def __init__(self, headroom_bytes: Optional[int] = None):
+        self.headroom = int(
+            headroom_bytes
+            if headroom_bytes is not None
+            else config.env("DBSCAN_SERVE_HEADROOM_BYTES")
+        )
+
+    def price(self, jobs: int, slots: int, d: int) -> int:
+        """Predicted dispatch bytes for a padded [jobs, slots, d]
+        batch: the family model's exact input bytes + symbolic temp/
+        output overhead, evaluated at the candidate shape — the same
+        arithmetic the lint-time gate and the DBSCAN_SHAPECHECK=1
+        runtime cross-check apply to the dispatch after the fact."""
+        from dbscan_tpu.lint.shapes import FAMILY_MODELS
+
+        model = FAMILY_MODELS[JOBS_FAMILY]
+        binding = {"J": int(jobs), "S": int(slots), "D": int(d)}
+        expr = model.input_expr() + model.overhead
+        return int(expr.substitute(binding).evaluate(binding))
+
+    def admit(self, jobs: int, slots: int, d: int) -> bool:
+        return self.price(jobs, slots, d) <= self.headroom
+
+
+@functools.lru_cache(maxsize=None)
+def _jobs_builder(engine: str, metric: str):
+    """One compiled pad-and-stack kernel per (engine, metric): a vmap
+    of the shared adjacency->labels tail over the job axis, with
+    per-job eps / min_points as traced scalars."""
+    import jax
+    import jax.numpy as jnp
+
+    from dbscan_tpu.ops.local_dbscan import cluster_from_adjacency
+
+    def one(pts, mask, eps, min_points):
+        m = dist_mod.get_metric(metric)
+        measure = m.pairwise(pts, pts)
+        thr = m.threshold(jnp.asarray(eps, measure.dtype))
+        adj = (measure <= thr) & mask[None, :] & mask[:, None]
+        adj = adj | (jnp.eye(pts.shape[0], dtype=bool) & mask[:, None])
+        res = cluster_from_adjacency(adj, mask, min_points, engine)
+        return res.seed_labels, res.flags
+
+    return jax.jit(jax.vmap(one))
+
+
+class _Pending(NamedTuple):
+    job_id: int
+    pts: np.ndarray  # [n, D] float64
+    eps: float
+    min_points: int
+    slots: int  # this job's own ladder rung
+
+
+class JobBatcher:
+    """Pad-and-stack batcher for small independent clustering jobs.
+
+    One batcher per (engine, metric, D) tenant class; eps/min_points
+    vary freely per job. ``submit`` applies per-job admission and
+    queues; ``flush`` forms admitted batches in submission order and
+    dispatches each as one ``serve.jobs`` kernel call, returning
+    results in submission order.
+    """
+
+    def __init__(
+        self,
+        *,
+        engine: str = "archery",
+        metric: str = "euclidean",
+        admission: Optional[AdmissionController] = None,
+        max_job_points: Optional[int] = None,
+        max_jobs: Optional[int] = None,
+        shape_floors: Optional[dict] = None,
+    ):
+        if engine not in ("naive", "archery"):
+            raise ValueError(f"unknown engine {engine!r}")
+        self.engine = engine
+        self.metric = metric
+        self.admission = admission or AdmissionController()
+        self.max_job_points = int(
+            max_job_points
+            if max_job_points is not None
+            else config.env("DBSCAN_SERVE_JOB_SLOTS")
+        )
+        self.max_jobs = max(
+            1,
+            int(
+                max_jobs
+                if max_jobs is not None
+                else config.env("DBSCAN_SERVE_BATCH_JOBS")
+            ),
+        )
+        self._floors = shape_floors if shape_floors is not None else {}
+        self._pending: deque = deque()
+        self._next_id = 0
+        self._d: Optional[int] = None
+
+    def submit(self, points: np.ndarray, eps: float, min_points: int) -> int:
+        """Admit and queue one job; returns its job id. Raises
+        :class:`AdmissionRejected` when the job provably cannot be
+        scheduled (too many points, or its single-job HBM price alone
+        breaches the headroom)."""
+        pts = np.asarray(points, dtype=np.float64)
+        if pts.ndim != 2 or pts.shape[1] < 2:
+            raise ValueError(f"job points must be [n, >=2], got {pts.shape}")
+        if self._d is None:
+            self._d = int(pts.shape[1])
+        elif int(pts.shape[1]) != self._d:
+            raise ValueError(
+                f"job has D={pts.shape[1]}; this batcher's tenant class "
+                f"is D={self._d}"
+            )
+        if not eps > 0 or min_points < 1:
+            raise ValueError(
+                f"bad job parameters eps={eps} min_points={min_points}"
+            )
+        n = len(pts)
+        headroom = self.admission.headroom
+        if n > self.max_job_points:
+            obs.count("serve.jobs_rejected")
+            obs.event(
+                "serve.admit_reject",
+                reason="oversized",
+                points=int(n),
+                headroom=int(headroom),
+            )
+            raise AdmissionRejected(
+                f"job of {n} points exceeds DBSCAN_SERVE_JOB_SLOTS="
+                f"{self.max_job_points}",
+                0,
+                headroom,
+            )
+        slots = _ladder_width(max(n, 1), _S_PAD)
+        single = self.admission.price(_ladder_width(1, _J_PAD), slots, self._d)
+        if single > headroom:
+            obs.count("serve.jobs_rejected")
+            obs.event(
+                "serve.admit_reject",
+                reason="hbm_price",
+                predicted_bytes=int(single),
+                headroom=int(headroom),
+            )
+            raise AdmissionRejected(
+                f"single job of {n} points cannot fit the admission "
+                "headroom", single, headroom,
+            )
+        job_id = self._next_id
+        self._next_id += 1
+        self._pending.append(
+            _Pending(job_id, pts, float(eps), int(min_points), slots)
+        )
+        return job_id
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def _preview_shape(self, n_jobs: int, slots: int) -> tuple:
+        """The (jp, sp) shape a batch of ``n_jobs`` jobs with max job
+        rung ``slots`` would ACTUALLY dispatch at — ladder rungs lifted
+        to the current ratchet floors, without mutating them. Admission
+        must price THIS shape, not the raw candidate: the floors are
+        monotone across flushes, so a tiny batch after a wide one pads
+        up to the combined floor (a pre-ratchet price could admit a
+        shape the dispatch then inflates past the headroom)."""
+        sp_cap = _ladder_width(self.max_job_points, _S_PAD)
+        jp_cap = _ladder_width(self.max_jobs, _J_PAD)
+        sp = min(
+            sp_cap,
+            max(slots, int(self._floors.get("serve_jobs_s", 0))),
+        )
+        jp = min(
+            jp_cap,
+            max(
+                _ladder_width(n_jobs, _J_PAD),
+                int(self._floors.get("serve_jobs_j", 0)),
+            ),
+        )
+        return jp, sp
+
+    def flush(self) -> List[JobResult]:
+        """Dispatch every queued job; returns results in submission
+        order. Batches are cut at ``max_jobs`` or where the stacked
+        admission price — of the POST-ratchet padded shape — would
+        breach the headroom (``serve.admit_splits`` counts the splits,
+        the 'queues jobs' half of reject-or-queue).
+        """
+        results: List[JobResult] = []
+        while self._pending:
+            batch: List[_Pending] = [self._pending.popleft()]
+            slots = batch[0].slots
+            while self._pending and len(batch) < self.max_jobs:
+                nxt = self._pending[0]
+                cand_slots = max(slots, nxt.slots)
+                jp, sp = self._preview_shape(len(batch) + 1, cand_slots)
+                if not self.admission.admit(jp, sp, self._d):
+                    obs.count("serve.admit_splits")
+                    break
+                batch.append(self._pending.popleft())
+                slots = cand_slots
+            results.extend(self._dispatch(batch, slots))
+        return results
+
+    def _dispatch(self, batch: List[_Pending], slots: int) -> List[JobResult]:
+        d = self._d
+        # ratchet both padded axes so a mixed job stream re-uses exact
+        # signatures after warm-up (the zero-recompile pin) — UNLESS
+        # the ratcheted shape would breach the admission headroom
+        # (floors inflated by an earlier wide batch): then this batch
+        # dispatches at its own un-ratcheted rungs, paying a possible
+        # recompile instead of un-admitted HBM. The headroom is the
+        # hard contract; the ratchet is best-effort.
+        jp, sp = self._preview_shape(len(batch), slots)
+        if self.admission.admit(jp, sp, d):
+            sp = _ratchet(
+                self._floors, "serve_jobs_s", sp,
+                cap=_ladder_width(self.max_job_points, _S_PAD),
+            )
+            jp = _ratchet(
+                self._floors, "serve_jobs_j", jp,
+                cap=_ladder_width(self.max_jobs, _J_PAD),
+            )
+        else:
+            sp = min(slots, _ladder_width(self.max_job_points, _S_PAD))
+            jp = min(
+                _ladder_width(len(batch), _J_PAD),
+                _ladder_width(self.max_jobs, _J_PAD),
+            )
+        pts = np.zeros((jp, sp, d), np.float64)
+        mask = np.zeros((jp, sp), bool)
+        eps = np.zeros(jp, np.float64)
+        mp = np.ones(jp, np.int32)
+        for i, job in enumerate(batch):
+            n = len(job.pts)
+            pts[i, :n] = job.pts
+            mask[i, :n] = True
+            eps[i] = job.eps
+            mp[i] = job.min_points
+        with obs.span(
+            "serve.job_batch",
+            jobs=int(len(batch)),
+            padded_jobs=int(jp),
+            slots=int(sp),
+        ):
+            fn = _jobs_builder(self.engine, self.metric)
+            seeds_d, flags_d = obs_compile.tracked_call(
+                JOBS_FAMILY, fn, pts, mask, eps, mp
+            )
+
+            def work():
+                return np.asarray(seeds_d), np.asarray(flags_d)
+
+            eng = pipe_mod.get_engine()
+            if eng is None:
+                seeds, flags = work()
+            else:
+
+                def on_start():
+                    for a in (seeds_d, flags_d):
+                        start = getattr(a, "copy_to_host_async", None)
+                        if start is not None:
+                            start()
+
+                job_h = eng.submit(
+                    work,
+                    on_start=on_start,
+                    bytes_hint=int(jp * sp * 5),
+                    label=f"serve.jobs x{len(batch)}",
+                )
+                seeds, flags = eng.settle(job_h, serial_fallback=work)
+        out = []
+        for i, job in enumerate(batch):
+            n = len(job.pts)
+            clusters = seed_to_local_ids(seeds[i, :n])
+            out.append(
+                JobResult(
+                    job_id=job.job_id,
+                    clusters=clusters,
+                    flags=np.asarray(flags[i, :n]),
+                    n_clusters=int(clusters.max()) if n else 0,
+                )
+            )
+        obs.count("serve.job_batches")
+        obs.count("serve.jobs_done", len(batch))
+        return out

@@ -187,6 +187,74 @@ class StreamingDBSCAN:
 
         return run
 
+    def export_state(self) -> dict:
+        """Serialize everything future labels depend on — the window
+        skeleton (per-batch core points + stream ids, in age order),
+        the identity union-find, and the id/update counters — as flat
+        arrays + scalars (``{"arrays": ..., "scalars": ...}``, the
+        shape :func:`checkpoint.save_serve` persists).
+
+        The contract (pinned by tests/test_serve.py): a stream restored
+        from this state produces BYTE-IDENTICAL labels for every later
+        batch to the uninterrupted stream — no relabeling drift. The
+        export is a deep copy (the caller may hold it across later
+        updates: the serving layer snapshots one per completed update),
+        built on the updating thread, so it is torn-free by
+        construction."""
+        lens = np.array([len(p) for p, _ in self._window], np.int64)
+        if len(self._window):
+            wpts = np.concatenate([p for p, _ in self._window]).copy()
+            wids = np.concatenate([i for _, i in self._window]).copy()
+        else:
+            wpts = np.empty((0, self._ncols or 2), np.float64)
+            wids = np.empty(0, np.int64)
+        return {
+            "arrays": {
+                "window_pts": wpts,
+                "window_ids": wids,
+                "window_lens": lens,
+                "uf_parent": self._uf._parent.copy(),
+            },
+            "scalars": {
+                "next_id": int(self._next_id),
+                "n_updates": int(self._n_updates),
+                "n_roots": int(self._uf.n_roots),
+                "ncols": -1 if self._ncols is None else int(self._ncols),
+                "window": int(self.window),
+            },
+        }
+
+    def restore_state(self, state: dict) -> None:
+        """Adopt an :meth:`export_state` snapshot: the next
+        :meth:`update` continues the stream exactly where the exported
+        one would have (same ids, same merges, same window expiry).
+        The window length must match this instance's (the deque maxlen
+        is construction state, not stream state)."""
+        scalars = state["scalars"]
+        if int(scalars["window"]) != self.window:
+            raise ValueError(
+                f"checkpoint was taken at window={scalars['window']}, "
+                f"this stream has window={self.window}"
+            )
+        arrays = state["arrays"]
+        self._window.clear()
+        start = 0
+        for ln in np.asarray(arrays["window_lens"], np.int64):
+            ln = int(ln)
+            self._window.append(
+                (
+                    np.asarray(arrays["window_pts"][start : start + ln]),
+                    np.asarray(arrays["window_ids"][start : start + ln]),
+                )
+            )
+            start += ln
+        self._uf._parent = np.asarray(arrays["uf_parent"], np.int64).copy()
+        self._uf.n_roots = int(scalars["n_roots"])
+        self._next_id = int(scalars["next_id"])
+        self._n_updates = int(scalars["n_updates"])
+        ncols = int(scalars["ncols"])
+        self._ncols = None if ncols < 0 else ncols
+
     def resolve(self, ids: np.ndarray) -> np.ndarray:
         """Map previously-emitted stream ids to their current canonical ids
         (after later batches merged clusters). Vectorized — safe to call on

@@ -112,3 +112,31 @@ def test_cli_too_many_devices(blob_csv):
         ]
     )
     assert rc == 2
+
+
+def test_cli_serve_smoke(capsys):
+    """--serve runs the resident ClusterService demo (synthetic stream,
+    concurrent queries, tenancy leg) and prints the serve summary JSON
+    (--stats routes to JSON-only output)."""
+    rc = cli_main(
+        [
+            "--serve", "--serve-updates", "2", "--serve-batch", "300",
+            "--eps", "0.6", "--min-points", "5", "--stats",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert summary["metric"] == "serve"
+    assert summary["serve_epoch"] == 2
+    assert summary["serve_queries"] > 0
+    assert summary["serve_qps"] > 0
+    assert summary["tenancy_jobs_s"] > 0
+    assert summary["degraded"] is None
+
+
+def test_cli_requires_input_unless_serve(capsys):
+    with pytest.raises(SystemExit) as ei:
+        cli_main(["--eps", "0.5", "--min-points", "5"])
+    assert ei.value.code == 2
+    assert "--input" in capsys.readouterr().err
