@@ -54,6 +54,15 @@ def sparse_cosine_dbscan(*args, **kwargs):
     return impl(*args, **kwargs)
 
 
+def embed_dbscan(*args, **kwargs):
+    """Lazy re-export of :func:`dbscan_tpu.embed.embed_dbscan` — the
+    high-dimensional cosine engine (LSH binning + spill-tree fallback +
+    blocked MXU neighbor kernel; dbscan_tpu/embed)."""
+    from dbscan_tpu.embed import embed_dbscan as impl
+
+    return impl(*args, **kwargs)
+
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -64,6 +73,7 @@ __all__ = [
     "train",
     "StreamingDBSCAN",
     "sparse_cosine_dbscan",
+    "embed_dbscan",
     "CORE",
     "BORDER",
     "NOISE",

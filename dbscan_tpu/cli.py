@@ -12,6 +12,7 @@ Usage:
       --eps 0.3 --min-points 10 [--max-points-per-partition 250] \
       [--engine naive|archery] [--metric euclidean|haversine|cosine] \
       [--precision f32|f64|bf16] [--use-pallas] [--mesh-devices N] \
+      [--embed [--embed-sample-frac F]] \
       [--stats] [--trace trace.json] [--metrics-summary] \
       [--log-level INFO]
 """
@@ -51,6 +52,20 @@ def build_parser() -> argparse.ArgumentParser:
         "(required unless --serve)",
     )
     p.add_argument(
+        "--embed", action="store_true",
+        help="treat the input as [N, D] embeddings and run the "
+        "high-dimensional cosine engine (dbscan_tpu/embed: LSH "
+        "binning + spill-tree fallback + blocked MXU neighbor "
+        "kernel) instead of the spatial train() pipeline; --eps is "
+        "the cosine distance threshold",
+    )
+    p.add_argument(
+        "--embed-sample-frac", type=float, default=None,
+        help="with --embed: opt into the subsampled-edge mode at this "
+        "edge-keep probability (accuracy contract in PARITY.md; "
+        "equivalent env: DBSCAN_EMBED_SAMPLE_FRAC)",
+    )
+    p.add_argument(
         "--serve", action="store_true",
         help="run the resident ClusterService against a synthetic "
         "stream (concurrent ingest + queries + the tenancy batch leg) "
@@ -77,8 +92,10 @@ def build_parser() -> argparse.ArgumentParser:
         "archery = textbook DBSCAN (default naive)",
     )
     p.add_argument(
-        "--metric", default="euclidean",
-        help="distance metric: euclidean/haversine/cosine (default euclidean)",
+        "--metric", default=None,
+        help="distance metric: euclidean/haversine/cosine (default "
+        "euclidean; --embed is cosine-only and rejects a conflicting "
+        "explicit metric)",
     )
     p.add_argument(
         "--precision", choices=[e.value for e in Precision],
@@ -166,6 +183,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.input is None or args.eps is None or args.min_points is None:
         parser.error("--input, --eps, and --min-points are required "
                      "(unless --serve)")
+    if args.embed:
+        # an accepted flag that silently does nothing is a bug, not a
+        # mode: the embed engine is cosine-only and has no pallas/mesh
+        # fan-out — reject explicit conflicting flags instead of
+        # discarding them
+        if args.metric not in (None, "cosine"):
+            parser.error(
+                f"--embed clusters by cosine distance; --metric "
+                f"{args.metric} conflicts"
+            )
+        if args.use_pallas:
+            parser.error("--embed does not support --use-pallas")
+        if args.mesh_devices:
+            parser.error("--embed does not support --mesh-devices")
     if args.platform:
         import jax
 
@@ -211,6 +242,9 @@ def _run(args, log) -> int:
     points = io_mod.load_points(args.input, args.input_format, args.delimiter)
     log.info("loaded %d points (%d columns) from %s", len(points), points.shape[1], args.input)
 
+    if args.embed:
+        return _run_embed(args, log, points)
+
     mesh = None
     if args.mesh_devices > 0:
         import jax
@@ -238,7 +272,7 @@ def _run(args, log) -> int:
             else args.max_points_per_partition
         ),
         engine=Engine(args.engine),
-        metric=args.metric,
+        metric=args.metric or "euclidean",
         precision=Precision(args.precision),
         use_pallas=args.use_pallas,
         neighbor_backend=args.neighbor_backend,
@@ -271,30 +305,7 @@ def _run(args, log) -> int:
     # text next to it (the machine-readable record stays the trace
     # file, which main()'s finally block flushes even on error)
     if args.metrics_summary:
-        from dbscan_tpu import obs
-
-        summ = obs.summary(top=10)
-        print("== metrics summary ==")
-        print("top spans (total_s x count):")
-        for name, cnt, total in summ["spans"]:
-            print(f"  {name:<28} {total:>10.3f}s x {cnt}")
-        print("counters:")
-        for name, value in sorted(summ["counters"].items()):
-            if isinstance(value, float):
-                value = round(value, 6)
-            print(f"  {name:<28} {value}")
-        # gauges ride the summary next to the counters (HBM watermarks,
-        # pull.inflight/queue_depth) — set-last-wins values, so this is
-        # the run's END state; pinned by tests/test_flight.py
-        gauges = summ.get("gauges") or {}
-        if gauges:
-            print("gauges:")
-            for name, value in sorted(gauges.items()):
-                print(f"  {name:<28} {value}")
-        from dbscan_tpu.obs import flight
-
-        if flight.active():
-            print(f"flight recorder: on (dump -> {flight._default_path()})")
+        _print_metrics_summary()
 
     if args.output:
         io_mod.save_labeled(
@@ -308,21 +319,97 @@ def _run(args, log) -> int:
         log.info("wrote %s", args.output)
 
     if args.stats:
-        def as_json(v):
-            if isinstance(v, dict):
-                return {k: as_json(x) for k, x in v.items()}
-            return float(v) if isinstance(v, float) else int(v)
+        _print_stats(len(points), int(model.n_clusters), seconds, model.stats)
+    return 0
 
-        print(
-            json.dumps(
-                {
-                    "n_points": int(len(points)),
-                    "n_clusters": int(model.n_clusters),
-                    "seconds": round(seconds, 4),
-                    **{k: as_json(v) for k, v in model.stats.items()},
-                }
-            )
+
+def _as_stats_json(v):
+    """Plain-JSON coercion for stats values, shared by the train and
+    --embed legs (the two copies had already drifted on string stats
+    like the embed engine's ``embed_degraded`` marker)."""
+    if isinstance(v, dict):
+        return {k: _as_stats_json(x) for k, x in v.items()}
+    if isinstance(v, str):
+        return v
+    return float(v) if isinstance(v, float) else int(v)
+
+
+def _print_stats(n_points, n_clusters, seconds, stats) -> None:
+    print(
+        json.dumps(
+            {
+                "n_points": int(n_points),
+                "n_clusters": int(n_clusters),
+                "seconds": round(seconds, 4),
+                **{k: _as_stats_json(v) for k, v in stats.items()},
+            }
         )
+    )
+
+
+def _print_metrics_summary() -> None:
+    """The --metrics-summary text block, shared by the train and
+    --embed legs (an accepted flag that silently prints nothing is a
+    bug, not a mode)."""
+    from dbscan_tpu import obs
+
+    summ = obs.summary(top=10)
+    print("== metrics summary ==")
+    print("top spans (total_s x count):")
+    for name, cnt, total in summ["spans"]:
+        print(f"  {name:<28} {total:>10.3f}s x {cnt}")
+    print("counters:")
+    for name, value in sorted(summ["counters"].items()):
+        if isinstance(value, float):
+            value = round(value, 6)
+        print(f"  {name:<28} {value}")
+    # gauges ride the summary next to the counters (HBM watermarks,
+    # pull.inflight/queue_depth) — set-last-wins values, so this is
+    # the run's END state; pinned by tests/test_flight.py
+    gauges = summ.get("gauges") or {}
+    if gauges:
+        print("gauges:")
+        for name, value in sorted(gauges.items()):
+            print(f"  {name:<28} {value}")
+    from dbscan_tpu.obs import flight
+
+    if flight.active():
+        print(f"flight recorder: on (dump -> {flight._default_path()})")
+
+
+def _run_embed(args, log, points) -> int:
+    """The --embed leg: the high-dimensional cosine engine over the
+    loaded [N, D] rows, with the same output/stats surface as train."""
+    from dbscan_tpu import embed_dbscan
+
+    stats: dict = {}
+    t0 = time.perf_counter()
+    clusters, flags = embed_dbscan(
+        points,
+        eps=args.eps,
+        min_points=args.min_points,
+        engine=args.engine,
+        max_points_per_partition=(
+            4096
+            if args.max_points_per_partition is None
+            else args.max_points_per_partition
+        ),
+        sample_frac=args.embed_sample_frac,
+        stats_out=stats,
+    )
+    seconds = time.perf_counter() - t0
+    n_clusters = int(stats.get("n_clusters", len(set(clusters[clusters > 0].tolist()))))
+    log.info("embed-clustered in %.3fs: %d clusters", seconds, n_clusters)
+    if args.metrics_summary:
+        _print_metrics_summary()
+    if args.output:
+        io_mod.save_labeled(
+            args.output, points, clusters, flags,
+            args.output_format, args.delimiter,
+        )
+        log.info("wrote %s", args.output)
+    if args.stats:
+        _print_stats(len(points), n_clusters, seconds, stats)
     return 0
 
 

@@ -498,6 +498,30 @@ ENV_VARS = _env_table(
         "rejected.",
     ),
     EnvVar(
+        "DBSCAN_EMBED_SAMPLE_FRAC", "float", 0.0,
+        "Opt-in subsampled-edge mode of the embed engine "
+        "(dbscan_tpu/embed): each candidate edge survives a "
+        "deterministic symmetric coin with this probability and the "
+        "core threshold scales to match (SNG-DBSCAN style); 0 (the "
+        "default) runs the exact path. The accuracy contract — "
+        "reported ARI vs the exact path, declared floor, regression "
+        "gate — is in PARITY.md.",
+    ),
+    EnvVar(
+        "DBSCAN_EMBED_BITS", "int", 16,
+        "Hyperplanes per SRP hash table of the embed engine's LSH "
+        "front-end; the primary table's planes drive the exact "
+        "boundary-spill binning, so more bits = deeper available "
+        "splits before the spill-tree fallback.",
+    ),
+    EnvVar(
+        "DBSCAN_EMBED_TABLES", "int", 4,
+        "SRP hash tables computed by the embed.hash dispatch; tables "
+        "past the first feed the multi-table candidate diagnostics "
+        "(recall vs the Goemans-Williamson bound), not the exact "
+        "partitioner.",
+    ),
+    EnvVar(
         "DBSCAN_FAULT_SPEC", "str", "",
         "Deterministic fault-injection spec, semicolon-separated "
         "site#ordinal:KIND[*count] clauses (faults.parse_fault_spec).",

@@ -1,0 +1,16 @@
+"""``dbscan_tpu/embed``: high-dimensional cosine DBSCAN engine.
+
+The workload modern traffic actually brings (ROADMAP item 3): [N, D]
+unit-normalized embeddings, D up to 768 and beyond. Signed-random-
+projection LSH binning replaces the 2-D grid front-end, the pivot
+spill tree is the exact fallback partitioner, a blocked MXU cosine
+neighbor kernel feeds the shared ``ops/propagation.window_cc``, and an
+opt-in subsampled-edge mode trades accuracy for speed under a declared,
+regression-gated ARI floor. See ``embed/engine.py`` for the pipeline
+and PARITY.md "Embed accuracy contract" for the knobs.
+"""
+
+from dbscan_tpu.embed.engine import embed_dbscan
+from dbscan_tpu.embed.oracle import cosine_dbscan_oracle
+
+__all__ = ["embed_dbscan", "cosine_dbscan_oracle"]

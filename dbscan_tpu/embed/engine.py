@@ -1,0 +1,468 @@
+"""The embed engine: high-dimensional cosine DBSCAN for [N, D]
+normalized embeddings (D up to 768 and beyond).
+
+Pipeline (every stage wired the way the other engines are wired):
+
+1. ``embed.hash`` device dispatch (ONE matmul) projects the payload
+   onto the SRP tables (``embed/lsh.py``);
+2. host boundary-spill binning over the primary table's projections —
+   exact coverage by construction, with the pivot spill tree
+   (``parallel/spill.py`` + PR 8's device-resident build) as the exact
+   fallback partitioner for nodes no hyperplane can split;
+3. one ``embed.neighbors`` dispatch per bucket (``embed/neighbors.py``:
+   blocked MXU similarity slabs -> windowed neighbor tables ->
+   ``ops/propagation.window_cc`` -> the shared border algebra), each
+   under :func:`dbscan_tpu.faults.supervised` at the ``embed`` site —
+   transients heal with backoff, a PERSISTENT fault degrades THAT
+   bucket to the numpy host oracle (``embed/oracle.py``), and a
+   persistently-failing hash dispatch degrades the WHOLE run to the
+   oracle (small-N capped);
+4. per-bucket label pulls ride the PullEngine
+   (``parallel/pipeline.py``) so D2H transfers overlap the remaining
+   bucket dispatches — the driver's label-pull discipline;
+5. the shared instance-table merge (``parallel/driver.finalize_merge``,
+   canonical min-member-row numbering): flags are exact on any input
+   (the binning's neighborhood-completeness invariant), memberships
+   exact up to the reference's border-bridged merges
+   (DBSCAN.scala:161-173 — the grid driver's documented semantic), and
+   on bridge-free workloads the label vector is a function of the DATA
+   alone — LSH seed, bucket layout, and spill fallbacks cannot move a
+   label (the renumbering contract the tests pin).
+
+Subsampled-edge mode (``DBSCAN_EMBED_SAMPLE_FRAC`` or the
+``sample_frac`` argument): each candidate edge survives a
+deterministic symmetric coin with the declared probability and the
+core threshold scales to match (``neighbors.eff_min_points``) — the
+explicit accuracy knob; ``bench.py --embed`` reports the resulting ARI
+against the exact path and the regression gate holds it to the
+declared floor (PARITY.md "Embed accuracy contract").
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from typing import Tuple
+
+import numpy as np
+
+from dbscan_tpu import config, faults, obs
+from dbscan_tpu.embed import lsh, neighbors, oracle
+from dbscan_tpu.obs import compile as obs_compile
+from dbscan_tpu.ops.labels import NOISE, NOT_FLAGGED, SEED_NONE
+from dbscan_tpu.parallel.binning import _ladder_width
+
+logger = logging.getLogger(__name__)
+
+
+def _resolve_frac(sample_frac) -> float:
+    """The sampled-edge fraction: explicit argument wins, else the
+    ``DBSCAN_EMBED_SAMPLE_FRAC`` knob; 0 (the default) means the exact
+    path."""
+    explicit = sample_frac is not None
+    if sample_frac is None:
+        sample_frac = float(config.env("DBSCAN_EMBED_SAMPLE_FRAC"))
+    frac = float(sample_frac)
+    if frac == 0.0:
+        return 1.0
+    if not 0.0 < frac <= 1.0:
+        # a negative typo must not silently run (and report) the exact
+        # path as if it were a benchmarked approximation
+        raise ValueError(
+            f"sample_frac must be in (0, 1], got {frac}"
+            + ("" if explicit else " (DBSCAN_EMBED_SAMPLE_FRAC)")
+        )
+    return frac
+
+
+def embed_dbscan(
+    x: np.ndarray,
+    eps: float,
+    min_points: int,
+    engine: str = "archery",
+    max_points_per_partition: int = 4096,
+    seed: int = 0,
+    sample_frac: float = None,
+    oracle_fallback: bool = True,
+    stats_out: dict = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Cosine DBSCAN over dense ``[N, D]`` embeddings.
+
+    Rows are L2-normalized internally (zero rows keep similarity 0 to
+    everything and are noise for ``eps < 1``). Returns ``(clusters [N]
+    int32 with 0 = noise, flags [N] int8)`` in the package's standard
+    conventions with canonical (min-member-row) cluster numbering.
+
+    ``engine``: border semantics, ``"naive"`` | ``"archery"`` (a
+    :class:`dbscan_tpu.config.Engine` value is accepted).
+    ``max_points_per_partition`` bounds the per-bucket similarity
+    working set; ``seed`` fixes the SRP planes and the spill tree's
+    pivot draws; ``sample_frac`` opts into the subsampled-edge mode
+    (None reads ``DBSCAN_EMBED_SAMPLE_FRAC``); ``oracle_fallback``
+    controls the persistent-fault degradation to the host oracle;
+    ``stats_out`` (optional dict) receives run diagnostics in the
+    driver's stats idiom (``n_partitions``, ``duplication_factor``,
+    ``timings``, embed counters).
+    """
+    engine = getattr(engine, "value", engine)
+    if engine not in ("naive", "archery"):
+        raise ValueError(f"unknown engine {engine!r}")
+    if not float(eps) > 0:
+        raise ValueError(f"eps must be > 0, got {eps}")
+    if int(min_points) < 1:
+        raise ValueError(f"min_points must be >= 1, got {min_points}")
+    x = np.asarray(x)
+    if x.ndim != 2:
+        raise ValueError(f"expected [N, D] embeddings, got shape {x.shape}")
+    maxpp = int(max_points_per_partition)
+    if maxpp < 1:
+        raise ValueError(
+            f"max_points_per_partition must be >= 1, got {maxpp}"
+        )
+    frac = _resolve_frac(sample_frac)
+    obs.ensure_env()
+
+    n = len(x)
+    if n == 0:
+        if stats_out is not None:
+            stats_out.update(n_partitions=0, duplication_factor=0.0)
+        return np.empty(0, np.int32), np.empty(0, np.int8)
+
+    # normalize straight into f32 (the driver's cosine-route
+    # discipline): an f64 intermediate of the whole payload would be
+    # 2x the input bytes of pure transient at 10M x 768 scale. Norms
+    # accumulate in f64 (cheap [N] vector) for stable zero detection.
+    x32 = np.asarray(x, dtype=np.float32)
+    norms = np.sqrt(np.einsum("ij,ij->i", x32, x32, dtype=np.float64))
+    inv = np.where(norms > 0, 1.0 / np.maximum(norms, 1e-30), 0.0)
+    unit = x32 * inv.astype(np.float32)[:, None]
+    nz_rows = np.flatnonzero(norms > 0)
+    if float(eps) < 1.0 and len(nz_rows) < n:
+        # zero rows are sim-0 to everything: deterministic noise under
+        # eps < 1, and inside the partitioner they would be equidistant
+        # to every pivot/hyperplane (the sparse front-end's strip)
+        clusters = np.zeros(n, dtype=np.int32)
+        flags = np.full(n, NOISE, dtype=np.int8)
+        if len(nz_rows):
+            sub_c, sub_f = _embed_unit(
+                unit[nz_rows], eps, min_points,
+                engine, maxpp, seed, frac, oracle_fallback, stats_out,
+            )
+            clusters[nz_rows] = sub_c
+            flags[nz_rows] = sub_f
+            if stats_out is not None and "duplication_factor" in stats_out:
+                stats_out["duplication_factor"] = float(
+                    stats_out["duplication_factor"] * len(nz_rows) / n
+                )
+        elif stats_out is not None:
+            stats_out.update(n_partitions=0, duplication_factor=0.0)
+        if stats_out is not None:
+            stats_out["n_zero_norm_noise"] = int(n - len(nz_rows))
+        return clusters, flags
+    return _embed_unit(
+        unit, eps, min_points, engine, maxpp, seed,
+        frac, oracle_fallback, stats_out,
+    )
+
+
+def _whole_run_oracle(unit32, eps, min_points, engine, stats_out, t0):
+    """The persistent-hash-fault degradation: the exact numpy oracle
+    over the whole (small-N-capped) run."""
+    obs.count("embed.oracle_fallbacks")
+    logger.warning(
+        "embed: hash dispatch persistently failing; degrading the "
+        "whole run to the host oracle (%d points)", len(unit32)
+    )
+    seed_l, flags, _counts = oracle.oracle_local(
+        np.asarray(unit32, dtype=np.float64), eps, min_points, engine
+    )
+    clusters = oracle.canonical_ids(seed_l)
+    if stats_out is not None:
+        stats_out.update(
+            n_partitions=1,
+            duplication_factor=1.0,
+            embed_degraded="oracle",
+            sample_frac=1.0,
+            timings={"total_s": round(time.perf_counter() - t0, 6)},
+        )
+    return clusters, flags
+
+
+def _embed_unit(
+    unit32, eps, min_points, engine, maxpp, seed, frac,
+    oracle_fallback, stats_out,
+):
+    """The engine body over PRE-NORMALIZED f32 rows (no zero rows)."""
+    import jax
+
+    from dbscan_tpu.parallel import pipeline as pipe_mod
+    from dbscan_tpu.parallel import spill as spill_mod
+    from dbscan_tpu.parallel.driver import _check_dense_width, finalize_merge
+
+    t_start = time.perf_counter()
+    n, dim = unit32.shape
+    obs.count("embed.points", int(n))
+    obs.gauge("embed.sample_frac", float(frac))
+    # spill halo in chord units; the quantization term covers the
+    # neighbor kernel's f32 similarity rounding (error ~ D * 2^-24 per
+    # dot), so every kernel-accepted pair is inside the spill band
+    halo = spill_mod.chord_halo(eps, dim * 2.0**-23, dim=dim)
+    bin_info: dict = {}
+
+    with obs.span("embed.run", n=int(n), d=int(dim)):
+        if n <= maxpp:
+            part_ids = np.zeros(n, dtype=np.int64)
+            point_idx = np.arange(n, dtype=np.int64)
+            n_parts = 1
+            home_of = np.zeros(n, dtype=np.int32)
+            bin_info = {
+                "buckets": 1, "fallbacks": 0, "fallback_points": 0,
+                "occupancy": [n],
+            }
+            t_hash = t_bin = time.perf_counter()
+        else:
+            bits = lsh.default_bits()
+            tables = lsh.default_tables()
+            d_pad = _ladder_width(dim, 8)
+            n_pad = _ladder_width(n, 128)
+            planes = lsh.make_planes(d_pad, bits, tables, seed)
+            x_pad = np.zeros((n_pad, d_pad), dtype=np.float32)
+            x_pad[:n, :dim] = unit32
+            try:
+                _codes, proj0 = lsh.hash_points(
+                    x_pad, planes, bits, tables
+                )
+            except faults.FatalDeviceFault:
+                if not oracle_fallback or n > oracle.ORACLE_MAX_POINTS:
+                    raise
+                return _whole_run_oracle(
+                    unit32, eps, min_points, engine, stats_out, t_start
+                )
+            t_hash = time.perf_counter()
+
+            def spill_fallback(idx):
+                return spill_mod.spill_partition(
+                    unit32[idx], maxpp, halo, seed=seed
+                )
+
+            with obs.span("embed.bin", n=int(n)):
+                part_ids, point_idx, n_parts, home_of = lsh.bin_points(
+                    proj0[:n], halo, maxpp, spill_fallback, info=bin_info
+                )
+            t_bin = time.perf_counter()
+
+        obs.count("embed.buckets", int(bin_info["buckets"]))
+        if bin_info["fallbacks"]:
+            obs.count("embed.spill_fallbacks", int(bin_info["fallbacks"]))
+            obs.count(
+                "embed.spill_fallback_points",
+                int(bin_info["fallback_points"]),
+            )
+        lsh.occupancy_counters(bin_info["occupancy"])
+        m_tot = len(part_ids)
+        obs.count("embed.instances", int(m_tot))
+
+        counts_p = np.bincount(part_ids, minlength=n_parts).astype(np.int64)
+        offsets = np.r_[0, np.cumsum(counts_p)]
+        widths = np.array(
+            [_ladder_width(int(c), 128) for c in counts_p], dtype=np.int64
+        )
+        if len(widths):
+            _check_dense_width(int(widths.max()), int(counts_p.max()))
+        max_b = int(widths.max()) if len(widths) else 0
+
+        inst_seed = np.full(m_tot, SEED_NONE, dtype=np.int32)
+        inst_flag = np.full(m_tot, NOT_FLAGGED, dtype=np.int8)
+        eff_min = neighbors.eff_min_points(min_points, frac)
+        keep_num = neighbors.keep_threshold(frac)
+        pull_pipe = pipe_mod.get_engine()
+        results: dict = {}
+        edges = 0
+        cc_iters_max = 0
+        escalations = 0
+        oracle_buckets = [0]  # mutable: bumped inside the fallback
+
+        def _oracle_bucket(rows_idx, b):
+            """Per-bucket persistent-fault degradation: the numpy
+            oracle over this bucket's rows, padded to the dispatch
+            width (exact — a degraded bucket ignores the sampling
+            coin, documented in PARITY.md)."""
+            sub = np.asarray(
+                unit32[rows_idx], dtype=np.float64
+            )
+            seed_l, flags_l, counts_l = oracle.oracle_local(
+                sub, eps, min_points, engine
+            )
+            c = len(rows_idx)
+            seed_p = np.full(b, SEED_NONE, np.int32)
+            flag_p = np.full(b, NOT_FLAGGED, np.int8)
+            cnt_p = np.zeros(b, np.int32)
+            seed_p[:c] = seed_l
+            flag_p[:c] = flags_l
+            cnt_p[:c] = counts_l
+            obs.count("embed.oracle_fallbacks")
+            oracle_buckets[0] += 1
+            return seed_p, flag_p, cnt_p, np.bool_(False), np.int32(0)
+
+        def _dispatch(p: int, w: int):
+            """One supervised ``embed.neighbors`` dispatch for bucket
+            ``p`` at W rung ``w``; returns the device (or fallback
+            numpy) output tuple plus the layout it was built from."""
+            import jax.numpy as jnp
+
+            lo, hi = int(offsets[p]), int(offsets[p + 1])
+            rows_idx = point_idx[lo:hi]
+            c = hi - lo
+            b = int(widths[p])
+            xb = np.zeros((b, dim), dtype=np.float32)
+            xb[:c] = unit32[rows_idx]
+            maskb = np.zeros(b, dtype=bool)
+            maskb[:c] = True
+            ids = np.full(b, -1, dtype=np.int32)
+            ids[:c] = rows_idx
+            fn = neighbors._neighbors_fn(b, int(w), engine)
+            obs.count("embed.neighbor_dispatches")
+            fallback = (
+                functools.partial(_oracle_bucket, rows_idx, b)
+                if oracle_fallback
+                else None
+            )
+            with obs.span("embed.bucket", p=int(p), b=b, w=int(w)):
+                out = faults.supervised(
+                    faults.SITE_EMBED,
+                    lambda _budget: obs_compile.tracked_call(
+                        "embed.neighbors",
+                        fn,
+                        jnp.asarray(xb),
+                        jnp.asarray(maskb),
+                        jnp.asarray(ids),
+                        float(eps),
+                        int(eff_min),
+                        int(keep_num),
+                        int(seed),
+                    ),
+                    fallback=fallback,
+                    label=f"bucket{p}",
+                )
+            obs.count("transfer.h2d_bytes", int(xb.nbytes + maskb.nbytes))
+            return out
+
+        def _land(p: int, out):
+            """Pull one bucket's labels to host (PullEngine worker when
+            live) and bank them for assembly/escalation."""
+            if isinstance(out[0], np.ndarray):
+                seed_h, flag_h, cnt_h, ovf, iters = out  # oracle path
+            else:
+                seed_h, flag_h, cnt_h, ovf, iters = jax.device_get(out)
+                obs.count(
+                    "transfer.d2h_bytes",
+                    int(
+                        np.asarray(seed_h).nbytes
+                        + np.asarray(flag_h).nbytes
+                        + np.asarray(cnt_h).nbytes
+                    ),
+                )
+            results[p] = (
+                np.asarray(seed_h),
+                np.asarray(flag_h),
+                np.asarray(cnt_h),
+                bool(ovf),
+                int(iters),
+            )
+
+        jobs = []
+        disp_w: dict = {}
+        try:
+            for p in range(n_parts):
+                w = neighbors.w_floor(int(widths[p]), eff_min)
+                disp_w[p] = w
+                out = _dispatch(p, w)
+                if pull_pipe is not None:
+                    jobs.append(
+                        (
+                            pull_pipe.submit(
+                                functools.partial(_land, p, out),
+                                bytes_hint=int(widths[p]) * 9,
+                                label=f"embed{p}",
+                            ),
+                            functools.partial(_land, p, out),
+                        )
+                    )
+                else:
+                    _land(p, out)
+        except BaseException:
+            # mirror spill_device's orphan-drain: pulls already
+            # submitted must not outlive a failing dispatch loop on the
+            # shared worker (their results land in state this frame is
+            # about to drop)
+            for job, _work in jobs:
+                try:
+                    pull_pipe.wait(job)
+                except Exception:  # noqa: BLE001 — already failing
+                    pass
+            raise
+        for job, work in jobs:
+            pull_pipe.settle(job, work)
+        t_dispatch = time.perf_counter()
+
+        # W-rung escalation: any bucket whose table truncated re-runs
+        # synchronously at the rung its observed max degree needs; the
+        # ratchet pins the settled rung so the NEXT same-width bucket
+        # starts there (zero recompiles at steady state)
+        for p in range(n_parts):
+            seed_h, flag_h, cnt_h, ovf, iters = results[p]
+            b = int(widths[p])
+            w = int(disp_w[p])
+            while ovf:
+                c = int(counts_p[p])
+                need = int(cnt_h[:c].max()) - 1 if c else 1
+                w = neighbors.next_w(b, need)  # > old w: overflow
+                # means some observed degree exceeded the old rung
+                escalations += 1
+                obs.count("embed.neighbor_escalations")
+                _land(p, _dispatch(p, w))
+                seed_h, flag_h, cnt_h, ovf, iters = results[p]
+            neighbors.note_w(b, w)
+            lo, hi = int(offsets[p]), int(offsets[p + 1])
+            c = hi - lo
+            inst_seed[lo:hi] = seed_h[:c]
+            inst_flag[lo:hi] = flag_h[:c]
+            edges += int(np.asarray(cnt_h[:c], dtype=np.int64).sum())
+            cc_iters_max = max(cc_iters_max, int(iters))
+        obs.count("embed.edges", int(edges))
+        t_pull = time.perf_counter()
+
+        cand, inst_inner = spill_mod.band_membership(
+            part_ids, point_idx, home_of, n
+        )
+        with obs.span("embed.merge", instances=int(m_tot)):
+            clusters, flags, n_clusters = finalize_merge(
+                part_ids, point_idx, inst_seed, inst_flag, cand,
+                inst_inner, n, n_parts, max_b, canonical=True,
+            )
+        t_end = time.perf_counter()
+
+    if stats_out is not None:
+        stats_out.update(
+            n_partitions=int(n_parts),
+            duplication_factor=float(m_tot) / max(1, n),
+            n_clusters=int(n_clusters),
+            sample_frac=float(frac),
+            embed_buckets=int(bin_info["buckets"]),
+            embed_spill_fallbacks=int(bin_info["fallbacks"]),
+            embed_spill_fallback_points=int(bin_info["fallback_points"]),
+            embed_edges=int(edges),
+            embed_cc_iters=int(cc_iters_max),
+            embed_escalations=int(escalations),
+            embed_oracle_buckets=int(oracle_buckets[0]),
+            timings={
+                "hash_s": round(t_hash - t_start, 6),
+                "bin_s": round(t_bin - t_hash, 6),
+                "dispatch_s": round(t_dispatch - t_bin, 6),
+                "pull_s": round(t_pull - t_dispatch, 6),
+                "merge_s": round(t_end - t_pull, 6),
+                "total_s": round(t_end - t_start, 6),
+            },
+        )
+    return clusters, flags

@@ -1,0 +1,314 @@
+"""Signed-random-projection LSH binning: the high-dim replacement for
+the 2-D grid front-end (``parallel/binning.py``).
+
+Device side, ONE matmul: :func:`hash_dispatch` projects the whole
+``[N, D]`` payload onto ``T * H`` random unit normals (family
+``embed.hash``) and returns per-table sign codes plus the PRIMARY
+table's signed projections. Codes serve the multi-table candidate
+diagnostics (:func:`pair_covered`, the recall bound the tests check);
+the primary projections drive the EXACT partitioner below.
+
+Host side, :func:`bin_points` turns the primary projections into a
+partition with the spill tree's coverage contract — every point pair
+the kernel can accept shares at least one partition:
+
+- recurse one hyperplane at a time; points within ``band`` of the cut
+  (``|proj| <= halo + slack``) are COPIED into both children. The
+  invariant this buys is NEIGHBORHOOD COMPLETENESS at the home chain —
+  strictly stronger than pair-sharing, and the one the merge actually
+  needs: core flags come from bucket-LOCAL counts, so the home
+  instance of every point must see its ENTIRE eps-ball (the same
+  invariant the spill tree's ``r_c + halo`` bands provide — a point
+  assigned to cell c pulls every neighbor into c's band). Proof, one
+  Cauchy-Schwarz line: for unit normal ``w`` and a pair with
+  ``chord(p, q) <= halo``, ``|p.w - q.w| <= halo``; if q sits on the
+  other side of the cut from p's HOME side, then ``|q.w| <= halo``, so
+  q is in band and is copied into p's home child. Inductively every
+  neighbor of p follows p's home chain to its home leaf. (A half-width
+  ``halo/2`` band guarantees only that the PAIR shares some leaf —
+  p's home instance can still lose out-of-band neighbors on the far
+  side, undercounting its core test; caught by review + the
+  uniform-sphere fuzz in tests/test_embed.py.);
+- a cut whose band swallows too much of the node (dense mass ON the
+  hyperplane — the regime ``parallel/spill.py``'s docstring warns
+  projections hit in high-D: data spread along a random direction
+  contracts by ~sqrt(D) while the band stays at chord scale, so
+  hyperplane cuts pay only when ``halo/2 < ~1/sqrt(D)``, i.e. TIGHT
+  thresholds — the near-duplicate regime embeddings are actually
+  deduped at) is skipped for the next plane, and a node with NO
+  payable plane left falls back to the pivot spill tree
+  (``spill.spill_partition`` — dimension-agnostic, device-resident via
+  PR 8), which owns exactly that regime. The recursion contract
+  composes: pairs crossing the fallback node's boundary were already
+  covered by ancestor bands, pairs inside it are the spill tree's
+  standard guarantee;
+- ``home`` follows the SIGN chain (band membership never moves a
+  point's home), so every point has exactly one home leaf — the
+  invariant ``spill.band_membership`` and the driver's merge
+  classification require.
+
+The reference analog is the margin/outer-rectangle duplication
+(DBSCAN.scala:132-137) with hyperplane cells standing in for grid
+rectangles.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Tuple
+
+import numpy as np
+
+from dbscan_tpu import config, faults, obs
+from dbscan_tpu.obs import compile as obs_compile
+from dbscan_tpu.parallel.spill import MAX_CHILD_FRAC
+
+#: a cut duplicating more than this fraction of a node into both
+#: children makes no progress worth its copies — skip to the next plane
+#: (0.5 bounds per-level duplication at 1.5x; the spill tree's
+#: MAX_DUP_FACTOR regime owns anything denser via the fallback)
+BAND_FRAC_MAX = 0.5
+#: absolute slack added to the band over the chord halo: f32 projection
+#: rounding (dot error ~ D * 2^-24 on unit rows, < 5e-5 at D = 768) can
+#: only SHRINK a measured |proj|, and an under-read band could miss a
+#: boundary pair — inflating is one-sided, copies only grow
+PROJ_SLACK = 1e-4
+
+
+def default_bits() -> int:
+    return max(1, int(config.env("DBSCAN_EMBED_BITS")))
+
+
+def default_tables() -> int:
+    return max(1, int(config.env("DBSCAN_EMBED_TABLES")))
+
+
+def make_planes(
+    dim: int, bits: int, tables: int, seed: int = 0
+) -> np.ndarray:
+    """[T * H, D] f32 unit normals, seed-deterministic."""
+    rng = np.random.default_rng([seed, dim, bits, tables])
+    p = rng.standard_normal((tables * bits, dim)).astype(np.float32)
+    p /= np.maximum(np.linalg.norm(p, axis=1, keepdims=True), 1e-20)
+    return p
+
+
+@functools.lru_cache(maxsize=32)
+def _hash_fn(bits: int, tables: int):
+    """Jitted SRP hash: one [N, D] x [D, T*H] MXU matmul, sign-packed
+    per-table codes + the primary table's raw projections. Compiled per
+    (bits, tables); N and D ride the callers' ladder pads."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x, planes):
+        proj = x @ planes.T  # [n, T*H] f32
+        bits_ = (proj >= 0.0).reshape(x.shape[0], tables, bits)
+        weights = jnp.left_shift(
+            jnp.int32(1), jnp.arange(bits, dtype=jnp.int32)
+        )
+        codes = jnp.sum(
+            bits_ * weights[None, None, :], axis=2, dtype=jnp.int32
+        )
+        return codes, proj[:, :bits]
+
+    return jax.jit(fn)
+
+
+def hash_points(
+    x_pad: np.ndarray, planes: np.ndarray, bits: int, tables: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """``embed.hash`` device dispatch under fault supervision: returns
+    host ``(codes [n_pad, T] int32, proj0 [n_pad, H] f32)``.
+
+    ``x_pad`` is the ladder-padded [n_pad, d_pad] f32 payload (zero
+    rows/columns hash harmlessly — padded rows' codes are never read,
+    padded columns meet zero plane weights). A persistent device fault
+    raises :class:`dbscan_tpu.faults.FatalDeviceFault`; the engine owns
+    the whole-run oracle degradation decision."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = _hash_fn(int(bits), int(tables))
+    obs.count("embed.hash_dispatches")
+    with obs.span(
+        "embed.hash",
+        n=int(x_pad.shape[0]),
+        d=int(x_pad.shape[1]),
+        tables=int(tables),
+        bits=int(bits),
+    ) as sp:
+        out = faults.supervised(
+            faults.SITE_EMBED,
+            lambda _b: obs_compile.tracked_call(
+                "embed.hash",
+                fn,
+                jnp.asarray(x_pad),
+                jnp.asarray(planes),
+            ),
+            label="hash",
+        )
+        sp.sync(out)
+    codes, proj0 = jax.device_get(out)
+    obs.count("transfer.h2d_bytes", int(x_pad.nbytes + planes.nbytes))
+    obs.count("transfer.d2h_bytes", int(codes.nbytes + proj0.nbytes))
+    return np.asarray(codes), np.asarray(proj0)
+
+
+def collision_lower_bound(eps: float, bits: int, tables: int) -> float:
+    """Goemans-Williamson lower bound on the probability that an
+    eps-close pair (cosine distance <= eps on unit rows) co-buckets in
+    at least one of ``tables`` SRP tables of ``bits`` bits each:
+    per-bit collision >= 1 - theta_max / pi with
+    ``theta_max = arccos(1 - eps)``. The recall test checks the
+    multi-table candidate sets against this floor."""
+    theta = float(np.arccos(np.clip(1.0 - float(eps), -1.0, 1.0)))
+    p_bit = 1.0 - theta / np.pi
+    return float(1.0 - (1.0 - p_bit ** int(bits)) ** int(tables))
+
+
+def pair_covered(
+    codes: np.ndarray, ii: np.ndarray, jj: np.ndarray
+) -> np.ndarray:
+    """[len(ii)] bool: pair (ii[k], jj[k]) shares a bucket in at least
+    one table — the multi-table candidate relation the recall
+    diagnostics measure (the EXACT partitioner does not rely on it)."""
+    codes = np.asarray(codes)
+    return (codes[ii] == codes[jj]).any(axis=1)
+
+
+def bin_points(
+    proj0: np.ndarray,
+    halo: float,
+    maxpp: int,
+    spill_fallback: Callable,
+    info: dict = None,
+) -> Tuple[np.ndarray, np.ndarray, int, np.ndarray]:
+    """Exact boundary-spill binning over the primary-table projections.
+
+    Args:
+      proj0: [N, H] f32 signed projections of the (unit) payload onto
+        the primary table's hyperplanes, host-side.
+      halo: chord halo (``spill.chord_halo``); the duplication band is
+        ``halo + PROJ_SLACK`` (module docstring: every neighbor of a
+        point must follow its home chain — neighborhood completeness,
+        not merely pair-sharing).
+      maxpp: bucket size target; a node at or under it becomes a leaf.
+      spill_fallback: ``idx -> (part_ids, point_idx, n_parts,
+        home_of)`` over the node's rows (node-local indices) — the
+        pivot spill tree, invoked for nodes no remaining hyperplane can
+        split within the band/progress budget.
+      info: optional dict receiving ``buckets`` / ``fallbacks`` /
+        ``fallback_points`` / ``occupancy`` (leaf sizes, spill
+        sub-leaves included).
+
+    Returns ``(part_ids [M], point_idx [M], n_parts, home_of [N])`` —
+    instances sorted by (partition, point row), the layout
+    ``band_membership`` and ``finalize_merge`` consume.
+    """
+    proj0 = np.asarray(proj0)
+    n, depth_max = proj0.shape
+    band = float(halo) + PROJ_SLACK
+    part_blocks = []  # (pid array, point row array) per emitted leaf
+    home_of = np.full(n, -1, dtype=np.int32)
+    occupancy: list = []
+    next_pid = 0
+    buckets = 0
+    fallbacks = 0
+    fallback_points = 0
+
+    stack = [(np.arange(n, dtype=np.int64), np.ones(n, dtype=bool), 0)]
+    while stack:
+        idx, home, depth = stack.pop()
+        if len(idx) == 0:
+            continue
+        if len(idx) <= maxpp:
+            pid = next_pid
+            next_pid += 1
+            buckets += 1
+            occupancy.append(len(idx))
+            part_blocks.append(
+                (np.full(len(idx), pid, dtype=np.int64), idx)
+            )
+            home_of[idx[home]] = pid
+            continue
+        chosen = -1
+        k = depth
+        while k < depth_max:
+            p = proj0[idx, k]
+            in_band = np.abs(p) <= band
+            left_n = int((p <= band).sum())
+            right_n = int((p >= -band).sum())
+            cap = MAX_CHILD_FRAC * len(idx)
+            if (
+                in_band.mean() <= BAND_FRAC_MAX
+                and left_n <= cap
+                and right_n <= cap
+            ):
+                chosen = k
+                break
+            k += 1
+        if chosen < 0:
+            # no payable hyperplane left: the node is dense on every
+            # remaining cut — exactly the pivot tree's regime
+            fallbacks += 1
+            fallback_points += len(idx)
+            pa, pi, n_sub, home_sub = spill_fallback(idx)
+            part_blocks.append(
+                (np.asarray(pa, np.int64) + next_pid, idx[pi])
+            )
+            sizes = np.bincount(pa, minlength=n_sub)
+            occupancy.extend(int(c) for c in sizes)
+            home_of[idx[home]] = (
+                np.asarray(home_sub, np.int64) + next_pid
+            )[home].astype(np.int32)
+            next_pid += int(n_sub)
+            continue
+        p = proj0[idx, chosen]
+        sign_pos = p >= 0
+        neg = p <= band
+        pos = p >= -band
+        stack.append((idx[pos], home[pos] & sign_pos[pos], chosen + 1))
+        stack.append(
+            (idx[neg], home[neg] & ~sign_pos[neg], chosen + 1)
+        )
+
+    if part_blocks:
+        part_ids = np.concatenate([b[0] for b in part_blocks])
+        point_idx = np.concatenate([b[1] for b in part_blocks])
+        # leaves emit in pid order but the fallback sub-blocks arrive
+        # partition-major only locally; one stable lexsort pins the
+        # global (partition, point) layout the packers/merge require
+        order = np.lexsort((point_idx, part_ids))
+        part_ids = part_ids[order]
+        point_idx = point_idx[order]
+    else:
+        part_ids = np.empty(0, np.int64)
+        point_idx = np.empty(0, np.int64)
+    if info is not None:
+        info["buckets"] = buckets
+        info["fallbacks"] = fallbacks
+        info["fallback_points"] = fallback_points
+        info["occupancy"] = occupancy
+    assert (home_of >= 0).all(), "every point needs exactly one home leaf"
+    return part_ids, point_idx, next_pid, home_of
+
+
+def occupancy_counters(occupancy) -> None:
+    """Fold leaf sizes into the fixed-edge occupancy histogram counters
+    the ``obs.analyze`` embed section renders."""
+    sizes = np.asarray(occupancy, dtype=np.int64)
+    if sizes.size == 0:
+        return
+    le64 = int((sizes <= 64).sum())
+    le1k = int(((sizes > 64) & (sizes <= 1024)).sum())
+    le16k = int(((sizes > 1024) & (sizes <= 16384)).sum())
+    gt16k = int((sizes > 16384).sum())
+    if le64:
+        obs.count("embed.occ_le_64", le64)
+    if le1k:
+        obs.count("embed.occ_le_1024", le1k)
+    if le16k:
+        obs.count("embed.occ_le_16384", le16k)
+    if gt16k:
+        obs.count("embed.occ_gt_16384", gt16k)

@@ -1,0 +1,203 @@
+"""Blocked cosine-similarity neighbor kernel for one embed bucket.
+
+One fused device dispatch (family ``embed.neighbors``) per bucket:
+
+1. ``lax.map`` over row blocks of ``_BLK`` rows; each block computes
+   its ``[_BLK, B]`` similarity slab as ONE MXU matmul against the
+   resident bucket rows — the same blocked-matmul shape discipline as
+   the spill tree's ``[M, S*m]`` passes — thresholds the cosine
+   distance, and compacts each row's eps-neighbors into a ``[B, W]``
+   neighbor table (sorted column indices; ``B`` = "no neighbor");
+2. core flags from the self-inclusive counts, then connected
+   components of the core-core windowed relation through the SHARED
+   ``ops/propagation.window_cc`` — the same min-label fixed point the
+   banded cellcc finalize rides;
+3. border/noise algebra via the shared ``ops.local_dbscan._finalize``
+   tail, so both border semantics (naive/archery) match the other
+   engines by construction.
+
+``W`` (neighbor slots per row) rides the ladder/ratchet compiled-shape
+discipline of ``ops/banded.py``: widths come from
+``binning._ladder_width``, the kernel reports an ``overflow`` flag when
+any valid row's non-self neighbor count exceeds ``W`` (truncation would
+break CC/border exactness), the caller re-runs at the rung that fits,
+and a process-wide per-width ratchet (:func:`w_floor` /
+:func:`note_w`) pins the settled rung so steady-state job streams
+re-dispatch with zero recompiles.
+
+Subsampled-edge mode (SNG-DBSCAN, arXiv:2006.06743): a deterministic
+symmetric per-pair hash keeps each candidate edge with probability
+``frac`` (self-edges always kept), and the core threshold scales to
+``ceil(frac * (min_points - 1)) + 1`` sampled neighbors — the explicit
+accuracy knob; the engine reports ARI vs the exact path and the bench
+gate keeps the declared floor honest (PARITY.md "Embed accuracy
+contract").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from dbscan_tpu.lint import tsan as _tsan
+from dbscan_tpu.parallel.binning import _ladder_width
+
+#: rows per similarity block (the lax.map slab height); bucket widths
+#: are _ladder_width(c, 128) multiples, so blocks always divide B
+_BLK = 128
+
+#: resolution of the sampled-edge keep threshold (frac quantizes to
+#: 1/2^24; the exact path passes the full range so every edge keeps)
+SAMPLE_RES = 1 << 24
+
+# per-width settled W rungs: the ratchet that makes a steady-state job
+# stream re-dispatch with ZERO recompiles (the escalation rerun only
+# ever fires the first time a width class meets a denser bucket).
+# Written from the engine's pull-land path, which may run on the
+# PullEngine worker while the main thread dispatches — lock it.
+_w_floors: dict = {}
+_w_lock = _tsan.lock("embed.w_floors")
+
+
+def w_floor(b: int, min_points: int) -> int:
+    """Starting W rung for bucket width ``b``: the settled floor when
+    one exists, else a ladder rung sized to the density the core
+    threshold implies."""
+    with _w_lock:
+        _tsan.access("embed.w_floors")
+        prev = _w_floors.get(int(b), 0)
+    guess = max(32, 4 * int(min_points))
+    return min(int(b), max(prev, _ladder_width(guess, 8)))
+
+
+def note_w(b: int, w: int) -> None:
+    """Ratchet the settled W rung for width ``b`` up to ``w``."""
+    with _w_lock:
+        _tsan.access("embed.w_floors")
+        _w_floors[int(b)] = max(_w_floors.get(int(b), 0), int(w))
+
+
+def reset_w_floors() -> None:
+    """Drop the ratchet state (tests)."""
+    with _w_lock:
+        _tsan.access("embed.w_floors")
+        _w_floors.clear()
+
+
+def next_w(b: int, max_count: int) -> int:
+    """The rung that fits an observed max non-self neighbor count."""
+    return min(int(b), _ladder_width(max(1, int(max_count)), 8))
+
+
+def _pair_keep(jnp, rids, cids, seed):
+    """[R, C] uint32 in [0, 2^24): a deterministic symmetric hash of
+    the UNORDERED original-row pair — the sampled-edge coin. Keyed on
+    original rows (not bucket slots), so the sampled graph is identical
+    across decompositions of the same data."""
+    a = jnp.minimum(rids[:, None], cids[None, :]).astype(jnp.uint32)
+    b = jnp.maximum(rids[:, None], cids[None, :]).astype(jnp.uint32)
+    h = (
+        a * jnp.uint32(2654435761)
+        + b * jnp.uint32(0x9E3779B9)
+        + jnp.uint32(seed)
+    )
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(0x2C1B3C6D)
+    h = h ^ (h >> 12)
+    h = h * jnp.uint32(0x297A2D39)
+    h = h ^ (h >> 15)
+    return h & jnp.uint32(SAMPLE_RES - 1)
+
+
+@functools.lru_cache(maxsize=128)
+def _neighbors_fn(b: int, w: int, engine: str):
+    """Jitted per-bucket kernel (see module doc). Compiled per
+    (bucket width, W rung, engine); D rides the traced array shape.
+    Returns (seed_labels [b], flags [b], counts [b], overflow bool,
+    cc iters int32)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from dbscan_tpu.ops.labels import SEED_NONE
+    from dbscan_tpu.ops.local_dbscan import _finalize
+    from dbscan_tpu.ops.propagation import window_cc
+
+    nb = b // _BLK
+    assert nb * _BLK == b, "bucket widths are _BLK multiples"
+    none = jnp.int32(SEED_NONE)
+
+    def fn(x, mask, ids, eps, eff_min, keep_num, seed):
+        col = jnp.arange(b, dtype=jnp.int32)
+
+        def block(i0):
+            r0 = i0 * _BLK
+            rows = lax.dynamic_slice(
+                x, (r0, jnp.int32(0)), (_BLK, x.shape[1])
+            )
+            rmask = lax.dynamic_slice(mask, (r0,), (_BLK,))
+            rids = lax.dynamic_slice(ids, (r0,), (_BLK,))
+            sims = rows @ x.T  # the MXU slab
+            valid = rmask[:, None] & mask[None, :]
+            selfm = (rids[:, None] == ids[None, :]) & valid
+            adj = ((1.0 - sims) <= eps) & valid
+            # self-adjacency explicit (f32 self-similarity can round
+            # below 1.0) — counts stay self-inclusive under sampling
+            adj = adj | selfm
+            keep = _pair_keep(jnp, rids, ids, seed) < jnp.uint32(
+                keep_num
+            )
+            adj = adj & (keep | selfm)
+            counts = jnp.sum(adj, axis=1, dtype=jnp.int32)
+            key = jnp.where(adj & ~selfm, col[None, :], jnp.int32(b))
+            tab = jnp.sort(key, axis=1)[:, :w]
+            return tab, counts
+
+        tabs, counts = lax.map(block, jnp.arange(nb, dtype=jnp.int32))
+        tab = tabs.reshape(b, w)
+        counts = counts.reshape(b)
+        # truncation guard: a row listing more non-self neighbors than
+        # W slots would drop edges — CC and border assignment both
+        # need the full relation, so the caller escalates the rung
+        overflow = jnp.any(mask & (counts - 1 > jnp.int32(w)))
+
+        core = mask & (counts >= eff_min)
+        in_tab = tab < jnp.int32(b)
+        tabc = jnp.clip(tab, 0, b - 1)
+        col_core = core[tabc] & in_tab
+        # symmetric by construction: the underlying eps-relation is
+        # symmetric (one compiled matmul per block -> bitwise-equal
+        # sims both ways), the pair hash is unordered, and no-overflow
+        # means every neighbor is listed — window_cc's contract
+        comp_all, iters = window_cc(col_core & core[:, None], tabc)
+        comp = jnp.where(core, comp_all, none)
+        nbr_seed = jnp.min(
+            jnp.where(col_core, comp[tabc], none), axis=1
+        )
+        # cores see their own component (self sits outside the table)
+        core_nbr_seed = jnp.minimum(
+            nbr_seed, jnp.where(core, comp, none)
+        )
+        res = _finalize(mask, core, comp, core_nbr_seed, counts, engine)
+        return res.seed_labels, res.flags, res.counts, overflow, iters
+
+    return jax.jit(fn)
+
+
+def eff_min_points(min_points: int, frac: float) -> int:
+    """Core threshold on SAMPLED self-inclusive counts: self always
+    kept, each of the other ``min_points - 1`` required neighbors
+    survives with probability ``frac`` — the declared SNG-style scaling
+    (PARITY.md "Embed accuracy contract")."""
+    if frac >= 1.0:
+        return int(min_points)
+    return int(np.ceil(frac * (int(min_points) - 1))) + 1
+
+
+def keep_threshold(frac: float) -> int:
+    """``frac`` quantized to the kernel's 2^-24 keep-coin resolution;
+    the exact path (frac >= 1) keeps every edge."""
+    if frac >= 1.0:
+        return SAMPLE_RES
+    return max(0, int(round(float(frac) * SAMPLE_RES)))

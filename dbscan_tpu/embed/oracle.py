@@ -1,0 +1,181 @@
+"""Host numpy cosine-DBSCAN oracle: exact, small-N, no JAX.
+
+Two consumers, one implementation:
+
+- the ``embed`` fault site's PERSISTENT degradation path
+  (``dbscan_tpu/embed/engine.py``): a bucket whose neighbor dispatch
+  exhausts its retries runs here instead of aborting the run, and a
+  persistently-failing hash dispatch degrades the WHOLE run here — the
+  numpy analog of the dense driver's per-group CPU ``local_dbscan``
+  fallback;
+- test parity assertions (``tests/test_embed.py``): the engine's exact
+  path must reproduce these labels on fuzzed ``[N, D]`` inputs.
+
+Semantics are the package's standard label algebra
+(``ops/local_dbscan.py``), computed in float64:
+
+- cosine distance ``1 - dot`` on L2-normalized rows; adjacency
+  ``dist <= eps``, self-inclusive; core at ``counts >= min_points``;
+- a cluster's seed label is the minimum core row index of its
+  core-core component;
+- border algebra per engine: ARCHERY adopts any non-core point with a
+  core neighbor, NAIVE additionally requires the min adjacent seed to
+  precede the point's own row index;
+- :func:`cosine_dbscan_oracle` numbers clusters canonically by minimum
+  MEMBER row (the ``finalize_merge(canonical=True)`` rule), so its
+  label vector is directly comparable to the engine's merged output.
+
+Everything here is dense O(N^2) host math — the exactness reference,
+never a production path. :data:`ORACLE_MAX_POINTS` caps the
+degradation path so a faulting 10M-point run fails loudly instead of
+allocating an 800 TB similarity matrix.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from dbscan_tpu.ops.labels import (
+    BORDER,
+    CORE,
+    NOISE,
+    NOT_FLAGGED,
+    SEED_NONE,
+)
+
+#: largest N the fault-degradation path accepts (the [N, N] f64
+#: similarity is 80 GB here; past it the original device fault
+#: re-raises — an oracle that OOMs the host is not a degradation)
+ORACLE_MAX_POINTS = 100_000
+
+
+def normalize_rows(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(L2-normalized float64 copy, row norms); zero-norm rows stay
+    zero (similarity 0 to everything, the sparse front-end's
+    convention)."""
+    x = np.asarray(x, dtype=np.float64)
+    norms = np.linalg.norm(x, axis=1)
+    inv = np.where(norms > 0, 1.0 / np.maximum(norms, 1e-300), 0.0)
+    return x * inv[:, None], norms
+
+
+def oracle_local(
+    unit: np.ndarray, eps: float, min_points: int, engine: str = "archery"
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """One partition's exact labels over PRE-NORMALIZED rows.
+
+    Returns ``(seed_labels [n] int32, flags [n] int8, counts [n]
+    int32)`` in the positional conventions of
+    ``ops.local_dbscan.cluster_from_adjacency`` — the drop-in shape the
+    engine's per-bucket fault fallback needs (labels are positions
+    WITHIN this row block).
+    """
+    if engine not in ("naive", "archery"):
+        raise ValueError(f"unknown engine {engine!r}")
+    unit = np.asarray(unit, dtype=np.float64)
+    n = len(unit)
+    none = np.int32(SEED_NONE)
+    if n == 0:
+        return (
+            np.empty(0, np.int32),
+            np.empty(0, np.int8),
+            np.empty(0, np.int32),
+        )
+    dist = 1.0 - unit @ unit.T
+    adj = dist <= float(eps)
+    np.fill_diagonal(adj, True)  # self-inclusive regardless of eps
+    counts = adj.sum(axis=1).astype(np.int32)
+    core = counts >= int(min_points)
+
+    # core-core components by BFS; comp = min core row per component
+    comp = np.full(n, none, dtype=np.int32)
+    adj_cc = adj & core[None, :] & core[:, None]
+    seen = np.zeros(n, dtype=bool)
+    for i in np.flatnonzero(core):
+        if seen[i]:
+            continue
+        members = [i]
+        seen[i] = True
+        frontier = [i]
+        while frontier:
+            nxt = np.flatnonzero(adj_cc[frontier].any(axis=0) & ~seen)
+            seen[nxt] = True
+            members.extend(nxt.tolist())
+            frontier = nxt.tolist()
+        comp[members] = min(members)
+
+    # min seed among eps-adjacent cores (cores see their own component)
+    nbr = np.where(adj & core[None, :], comp[None, :], none)
+    core_nbr_seed = nbr.min(axis=1).astype(np.int32)
+    has_core_nbr = core_nbr_seed != none
+    idx = np.arange(n, dtype=np.int32)
+    if engine == "naive":
+        border = ~core & has_core_nbr & (core_nbr_seed < idx)
+    else:
+        border = ~core & has_core_nbr
+
+    seed_labels = np.where(
+        core, comp, np.where(border, core_nbr_seed, none)
+    ).astype(np.int32)
+    flags = np.where(
+        core,
+        np.int8(CORE),
+        np.where(border, np.int8(BORDER), np.int8(NOISE)),
+    ).astype(np.int8)
+    return seed_labels, flags, counts
+
+
+def canonical_ids(seed_labels: np.ndarray) -> np.ndarray:
+    """Seed labels -> canonical 1-based cluster ids, numbered by each
+    cluster's minimum MEMBER row (border members included) — exactly
+    ``finalize_merge(canonical=True)``'s rule, so oracle and engine
+    label vectors compare with plain array equality. Noise maps to 0."""
+    seed_labels = np.asarray(seed_labels)
+    out = np.zeros(len(seed_labels), dtype=np.int32)
+    mask = seed_labels != SEED_NONE
+    if not mask.any():
+        return out
+    uniq, inv = np.unique(seed_labels[mask], return_inverse=True)
+    first = np.full(len(uniq), len(seed_labels), dtype=np.int64)
+    np.minimum.at(first, inv, np.flatnonzero(mask))
+    order = np.argsort(first, kind="stable")
+    rank = np.empty(len(uniq), dtype=np.int32)
+    rank[order] = np.arange(1, len(uniq) + 1, dtype=np.int32)
+    out[mask] = rank[inv]
+    return out
+
+
+def cosine_dbscan_oracle(
+    x: np.ndarray, eps: float, min_points: int, engine: str = "archery"
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Full-run exact cosine DBSCAN on the host.
+
+    Returns ``(clusters [N] int32 with 0 = noise, flags [N] int8)`` in
+    the engine's output conventions with canonical (min-member-row)
+    cluster numbering. Rows are normalized here; zero rows keep
+    similarity 0 to everything and cluster only when ``eps >= 1``.
+    """
+    unit, _norms = normalize_rows(x)
+    if len(unit) > ORACLE_MAX_POINTS:
+        raise ValueError(
+            f"cosine oracle is exact small-N host math: {len(unit)} "
+            f"points exceeds ORACLE_MAX_POINTS={ORACLE_MAX_POINTS} "
+            "(the [N, N] f64 similarity would not fit host memory)"
+        )
+    seed, flags, _counts = oracle_local(unit, eps, min_points, engine)
+    return canonical_ids(seed), flags
+
+
+__all__ = [
+    "ORACLE_MAX_POINTS",
+    "normalize_rows",
+    "oracle_local",
+    "canonical_ids",
+    "cosine_dbscan_oracle",
+    "BORDER",
+    "CORE",
+    "NOISE",
+    "NOT_FLAGGED",
+]

@@ -76,9 +76,11 @@ SITE_PULL = "pull"  # pipelined compact-chunk pull (parallel/pipeline.py)
 SITE_CELLCC = "cellcc_cc"  # device cellcc finalize (cellgraph.finalize_device)
 SITE_CAMPAIGN = "campaign"  # campaign worker lease (dbscan_tpu/campaign.py)
 SITE_SERVE = "serve"  # ClusterService ingest/query steps (dbscan_tpu/serve)
+SITE_EMBED = "embed"  # embed engine hash/neighbor dispatches (dbscan_tpu/embed)
 _SITES = (
     SITE_DISPATCH, SITE_BANDED, SITE_SPILL, SITE_SPILL_LEVEL,
-    SITE_STREAM, SITE_PULL, SITE_CELLCC, SITE_CAMPAIGN, SITE_SERVE, "*",
+    SITE_STREAM, SITE_PULL, SITE_CELLCC, SITE_CAMPAIGN, SITE_SERVE,
+    SITE_EMBED, "*",
 )
 
 
@@ -129,7 +131,13 @@ def parse_fault_spec(spec: str) -> Tuple[FaultClause, ...]:
 
     - ``site``: ``dispatch`` | ``banded`` | ``spill`` | ``spill_level``
       | ``stream`` | ``pull`` | ``cellcc_cc`` | ``campaign`` | ``serve``
-      | ``*`` (any supervised site, ordinal counted globally). The
+      | ``embed`` | ``*`` (any supervised site, ordinal counted
+      globally). The ``embed`` site is consumed per embed-engine device
+      dispatch (the hash pass, then one ordinal per bucket neighbor
+      dispatch, dbscan_tpu/embed): transients heal with backoff, a
+      PERSISTENT neighbor fault degrades that bucket to the numpy host
+      oracle, and a persistent hash fault degrades the whole run to the
+      oracle (small-N capped). The
       ``serve`` site is consumed per ClusterService ingest step and
       query dispatch (dbscan_tpu/serve), opt-in like ``pull``; the
       ``campaign``

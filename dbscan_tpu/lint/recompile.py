@@ -30,8 +30,14 @@ _SCALARS = (int, float, bool, str)
 
 
 def _kernel_file(path: str) -> bool:
+    # embed/ holds device kernels too (lsh/neighbors jit builders), so
+    # the kernel-only rules (dtype-flow-drift et al.) cover it like ops/
     parts = os.path.normpath(path).split(os.sep)
-    return "ops" in parts or os.path.basename(path) == "spill_device.py"
+    return (
+        "ops" in parts
+        or "embed" in parts
+        or os.path.basename(path) == "spill_device.py"
+    )
 
 
 def _check_jit_in_loop(mod, findings: List[Finding]) -> None:

@@ -430,6 +430,43 @@ def _models() -> Dict[str, FamilyModel]:
                 "point slots, per-job eps/min_points traced — the "
                 "admission headroom gate prices THIS envelope",
             ),
+            FamilyModel(
+                "embed.hash",
+                [
+                    ArgModel("x", ("N", "D"), FLOAT),
+                    ArgModel("planes", ("TH", "D"), FLOAT),
+                ],
+                # temps/outs: the [N, T*H] projection matrix + packed
+                # per-table codes + the primary table's projections —
+                # bounded by 3x the projection bytes
+                overhead=_sy("N") * _sy("TH") * 12,
+                static_slots=None,
+                note="SRP hash of the embed payload (dbscan_tpu/embed/"
+                "lsh.py): one [N, D] x [D, T*H] matmul; N/D are "
+                "ladder-padded — data-scaled, runtime-gated",
+            ),
+            FamilyModel(
+                "embed.neighbors",
+                [
+                    ArgModel("x", ("B", "D"), FLOAT),
+                    ArgModel("mask", ("B",), BOOL),
+                    ArgModel("ids", ("B",), INT),
+                ],
+                # temps: one [128, B] similarity slab (+ adjacency/key
+                # copies) per lax.map step; outs: the [B, W] neighbor
+                # table + seed/flag/count vectors. W (the neighbor-slot
+                # rung) is not an arg dim — data-scaled like cellcc's
+                # C/V, runtime-gated; trailing eps/eff_min/keep/seed
+                # ride as plain Python scalars.
+                overhead=E(128) * _sy("B") * 16
+                + _sy("B") * (_sy("W") * 8 + 16),
+                static_slots=None,
+                note="blocked cosine neighbor kernel per embed bucket "
+                "(dbscan_tpu/embed/neighbors.py): B is the ladder-"
+                "padded bucket width (<= DENSE_MAX_BUCKET via the "
+                "dense-width guard), W the ratcheted neighbor-slot "
+                "rung — data-scaled, runtime-gated",
+            ),
             _level_model(),
             _level_final_model(),
         )

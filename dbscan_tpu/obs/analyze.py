@@ -88,6 +88,7 @@ SECTIONS = {
     "faults": ("counter", schema.PREFIX_FAULTS),
     "campaign": ("counter", schema.PREFIX_CAMPAIGN),
     "serve": ("counter", schema.PREFIX_SERVE),
+    "embed": ("counter", schema.PREFIX_EMBED),
     "devtime": ("counter", _DEVTIME_KEYS),
     "pull_check": ("counter", _PULL_CHECK_KEYS),
 }
@@ -481,6 +482,7 @@ def analyze(data: dict, top: Optional[int] = None) -> dict:
         },
         "campaign": _campaign_rollup(counters),
         "serve": _serve_rollup(counters, spans),
+        "embed": _embed_rollup(counters, data["gauges"]),
         "devtime": _devtime_rollup(counters, spans),
         "pull_check": _pull_device_check(counters, spans),
     }
@@ -500,6 +502,32 @@ def _campaign_rollup(counters: dict) -> dict:
         out["campaign.replay_frac"] = round(
             min(1.0, out.get("campaign.replayed_wall_s", 0.0) / work), 4
         )
+    return out
+
+
+def _embed_rollup(counters: dict, gauges: dict) -> dict:
+    """The embed section: every embed.* counter plus the derived
+    figures the ROADMAP-item-3 capture reads — the bucket-occupancy
+    histogram (the fixed-edge ``embed.occ_*`` counters), the
+    spill-fallback rate (fallback points / points), the duplication
+    factor (instances / points), and the sampled-edge fraction (the
+    ``embed.sample_frac`` gauge; 1.0 = exact path)."""
+    out = {
+        k: v
+        for k, v in sorted(counters.items())
+        if k.startswith(schema.PREFIX_EMBED)
+    }
+    pts = out.get("embed.points", 0)
+    if pts > 0:
+        out["embed.spill_fallback_rate"] = round(
+            out.get("embed.spill_fallback_points", 0) / pts, 4
+        )
+        out["embed.dup_factor"] = round(
+            out.get("embed.instances", 0) / pts, 4
+        )
+    frac = gauges.get("embed.sample_frac")
+    if frac is not None:
+        out["embed.sampled_edge_frac"] = round(float(frac), 6)
     return out
 
 
@@ -874,6 +902,12 @@ def render(report: dict) -> str:
         out.append("")
         out.append("-- serve (resident service / tenancy) --")
         for k, v in report["serve"].items():
+            v = round(v, 6) if isinstance(v, float) else v
+            out.append(f"{k:<36} {v:>12}")
+    if report.get("embed"):
+        out.append("")
+        out.append("-- embed (LSH binning / cosine neighbors) --")
+        for k, v in report["embed"].items():
             v = round(v, 6) if isinstance(v, float) else v
             out.append(f"{k:<36} {v:>12}")
     dev = report.get("devtime") or {}

@@ -25,10 +25,13 @@ file skips records already present)::
 
 Which numeric keys become records: ``value`` (named by the capture's
 own ``metric`` string), plus scalar keys ending in ``_seconds`` /
-``_s`` / ``_mpts`` / ``_vs_baseline`` (and bare ``seconds`` /
-``vs_baseline``) — the walls and throughputs the regress gate knows a
-better-direction for. Cluster counts, ARIs, and shape diagnostics stay
-in the raw captures; the history is the PERF trajectory.
+``_s`` / ``_mpts`` / ``_vs_baseline`` / ``_ari`` (and bare
+``seconds`` / ``vs_baseline``) — the walls, throughputs, and accuracy
+scores the regress gate knows a better-direction for (``_ari``
+promoted since the embed engine's subsampled-edge mode made accuracy
+a tunable: its declared floor gates regress-down like a throughput).
+Cluster counts and shape diagnostics stay in the raw captures; the
+history is the PERF + accuracy trajectory.
 
 The regress gate (:mod:`dbscan_tpu.obs.regress`) compares a fresh
 capture against this history with a noise-aware threshold.
@@ -61,15 +64,20 @@ from dbscan_tpu.obs import schema
 # replayed wall / total work wall — which regresses UP like a wall;
 # _qps: the serving layer's sustained query rate — a throughput that
 # regresses DOWN; _ms: serve query latency percentiles — walls in
-# milliseconds, regress UP. NOTE the ordering trap the serve keys
-# introduce: tenancy_jobs_s ENDS in "_s" but is a jobs-per-second
-# THROUGHPUT — obs/regress.direction and _unit_for both special-case
-# the "_jobs_s" suffix BEFORE the seconds rule)
+# milliseconds, regress UP; _ari: clustering-accuracy scores — the
+# embed engine's subsampled-edge mode made accuracy a TUNABLE, so its
+# declared floor must trend and gate like a throughput (regress DOWN);
+# every row's ARI rides the same suffix, so an accuracy collapse on
+# any engine now flags instead of hiding in the raw captures. NOTE the
+# ordering trap the serve keys introduce: tenancy_jobs_s ENDS in "_s"
+# but is a jobs-per-second THROUGHPUT — obs/regress.direction and
+# _unit_for both special-case the "_jobs_s" suffix BEFORE the seconds
+# rule)
 _EXACT_KEYS = ("value", "seconds", "vs_baseline")
 _SUFFIXES = (
     "_seconds", "_s", "_mpts", "_vs_baseline", "_overlap_ratio",
     "_pred_ratio", "_spill_levels", "_busy_frac", "_cc_iters",
-    "_replay_frac", "_qps", "_ms",
+    "_replay_frac", "_qps", "_ms", "_ari",
 )
 # numeric-but-not-perf keys the suffix rule would otherwise catch —
 # declared with the telemetry schema (the keys are fault-counter
@@ -115,6 +123,8 @@ def _unit_for(metric: str, obj: dict) -> Optional[str]:
         return "queries/s"
     if metric.endswith("_ms"):
         return "ms"
+    if metric.endswith("_ari"):
+        return "ari"
     if metric.endswith(("_seconds", "_s")) or metric == "seconds":
         return "s"
     if metric.endswith("_mpts"):

@@ -81,6 +81,12 @@ def direction(metric: str, unit: Optional[str] = None) -> Optional[str]:
     if metric.endswith("_ms"):
         # serve query latency percentiles: walls, regress UP
         return LOWER_BETTER
+    if metric.endswith("_ari"):
+        # clustering accuracy (embed subsampled mode's declared floor,
+        # and every row's construction ARI): regresses DOWN like a
+        # throughput — an accuracy collapse must flag, not hide in the
+        # raw capture
+        return HIGHER_BETTER
     if metric.endswith(("_seconds", "_s")) or metric == "seconds":
         return LOWER_BETTER
     if metric.endswith(("_mpts", "_vs_baseline", "_throughput")) or metric in (

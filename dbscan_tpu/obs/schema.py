@@ -52,6 +52,8 @@ COMPILE_FAMILIES = (
     "halo.merge",
     "serve.query",
     "serve.jobs",
+    "embed.hash",
+    "embed.neighbors",
 )
 
 #: HBM watermark sample sites (obs/memory.py `sample`): each emits
@@ -177,6 +179,31 @@ COUNTERS = {
     "checkpoint.serve_loads": "serve state checkpoints read back by "
     "checkpoint.load_serve",
     "checkpoint.serve_bytes": "bytes across saved serve state arrays",
+    "embed.points": "points entering embed-engine runs",
+    "embed.instances": "embed instances after LSH/spill duplication "
+    "(duplication factor = this / embed.points)",
+    "embed.buckets": "LSH leaf buckets emitted by the boundary-spill "
+    "binning (spill-fallback sub-leaves not included)",
+    "embed.spill_fallbacks": "binning nodes no hyperplane could split "
+    "within the band/progress budget, routed to the pivot spill tree",
+    "embed.spill_fallback_points": "points across those fallback nodes "
+    "(spill-fallback rate = this / embed.points)",
+    "embed.hash_dispatches": "embed.hash device dispatches issued",
+    "embed.neighbor_dispatches": "embed.neighbors bucket dispatches "
+    "issued (escalation re-runs included)",
+    "embed.neighbor_escalations": "bucket re-runs at a wider W rung "
+    "after the neighbor table overflowed (steady state: zero — the "
+    "per-width ratchet pins the settled rung)",
+    "embed.edges": "self-inclusive adjacency entries observed across "
+    "bucket dispatches (sampled-edge mode counts the SAMPLED graph)",
+    "embed.oracle_fallbacks": "embed dispatches degraded to the numpy "
+    "host oracle after persistent faults (per bucket, or one for a "
+    "whole-run hash degradation)",
+    "embed.occ_le_64": "embed buckets holding <= 64 points "
+    "(occupancy-histogram edge)",
+    "embed.occ_le_1024": "embed buckets holding 65..1024 points",
+    "embed.occ_le_16384": "embed buckets holding 1025..16384 points",
+    "embed.occ_gt_16384": "embed buckets holding > 16384 points",
     "devtime.samples": "dispatches bracketed by the ready-sync "
     "device-timeline hooks (DBSCAN_DEVTIME)",
     "devtime.dispatch_s": "summed host wall of the bracketed dispatch "
@@ -218,6 +245,9 @@ GAUGES = {
     "half-merged update",
     "serve.resident_points": "skeleton core points in the published "
     "query snapshot",
+    "embed.sample_frac": "sampled-edge keep probability of the last "
+    "embed run (1.0 = exact path) — the declared accuracy knob the "
+    "analyzer's sampled-edge fraction reads back",
 }
 
 SPANS = {
@@ -261,6 +291,14 @@ SPANS = {
     "(job count + padded shape attached)",
     "transfer.pull": "device->host pull (bytes in args)",
     "stream.update": "streaming micro-batch update step",
+    "embed.run": "root span over one embed-engine run",
+    "embed.hash": "embed SRP hash dispatch window (one matmul over "
+    "the padded payload)",
+    "embed.bin": "host boundary-spill binning over the primary-table "
+    "projections (spill-tree fallbacks nest inside)",
+    "embed.bucket": "one embed bucket neighbor dispatch window "
+    "(partition id, width, W rung attached)",
+    "embed.merge": "embed instance-table merge (shared finalize_merge)",
 }
 
 EVENTS = {
@@ -338,6 +376,7 @@ PREFIX_FAULTS = "faults."
 PREFIX_DEVTIME = "devtime."
 PREFIX_CAMPAIGN = "campaign."
 PREFIX_SERVE = "serve."
+PREFIX_EMBED = "embed."
 
 #: the hot/cold classification marks obs/analyze.py reads back
 RESIDENT_MARKS = ("resident_cache.hit", "resident_cache.miss")

@@ -24,6 +24,17 @@ whether to escalate, and the exact full-node pass re-decides).
 Reference analog: none — the reference's decomposition is 2-D
 rectangles on a driver-local grid (EvenSplitPartitioner.scala:66-103);
 this is the high-dimensional counterpart's hot path moved to the chip.
+
+Dimension contract: every pass here is written against generic unit
+rows ``[N, D]`` — chord arithmetic is dot products, pivots are
+synthetic unit vectors, the level build's node tables carry ``dim`` as
+a plain static — so the SAME tree serves the 512-d cosine route, the
+sparse TF-IDF route, and the embed engine's spill fallback at any
+D in 2..4096 (the bf16 slack bound above). The only shape requirement
+is the explicit rank-2 guard in :meth:`DeviceNodeOps.from_host`;
+nothing assumes D == 2 (the reference's grid world), and
+``tests/test_embed.py`` pins D=64 parity so the embed fallback can
+reuse the tree unmodified.
 """
 
 from __future__ import annotations
@@ -63,6 +74,16 @@ class DeviceNodeOps:
         import jax.numpy as jnp
         import ml_dtypes
 
+        x_host = np.asarray(x_host)
+        if x_host.ndim != 2:
+            # generic [N, D] unit rows at ANY D — the tree is
+            # dimension-agnostic (module docstring), so the only
+            # structural requirement is rank 2, not the 2-D world of
+            # the reference's grid decomposition
+            raise ValueError(
+                "spill device payload must be [N, D] unit rows, got "
+                f"shape {x_host.shape}"
+            )
         xb = np.asarray(x_host, dtype=ml_dtypes.bfloat16)
         # supervised upload: the bf16 payload is the biggest single
         # transfer of the cosine route (~1 GB at 1M x 512 over the

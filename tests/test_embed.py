@@ -1,0 +1,527 @@
+"""Embed engine suite (``dbscan_tpu/embed/``): exact-path label parity
+vs the numpy host oracle on fuzzed [N, D] inputs (D in {8, 64, 256,
+768}), the canonical-gid renumbering contract (labels are a function
+of the data alone — LSH seed, bucket layout, spill fallbacks, and the
+metric-spill train() route all produce the identical vector),
+multi-table LSH recall vs the Goemans-Williamson bound, the
+subsampled-edge accuracy contract, zero-recompile ladder pins across
+mixed N/D job streams, ``embed`` fault-site drills (transient heal,
+persistent bucket degrade to the oracle, persistent hash degrade of
+the whole run), the D=64 spill-tree fallback parity + rank-2 guard,
+and a ``DBSCAN_TSAN=1`` concurrent rerun asserting a race-free report.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import embed_dbscan, faults, obs
+from dbscan_tpu.embed import lsh, neighbors, oracle
+from dbscan_tpu.utils.ari import adjusted_rand_index
+
+pytestmark = pytest.mark.embed
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _blobs(rng, d, k, per, noise, n_noise=0):
+    """k tight unit-sphere blobs + optional random-direction noise."""
+    c = rng.normal(size=(k, d))
+    c /= np.linalg.norm(c, axis=1, keepdims=True)
+    x = np.repeat(c, per, axis=0) + noise * rng.normal(size=(k * per, d))
+    if n_noise:
+        x = np.concatenate([x, rng.normal(size=(n_noise, d))])
+    return x
+
+
+def _boundary_clear(x, eps, rel=2e-5):
+    unit, _ = oracle.normalize_rows(x)
+    d = 1.0 - unit @ unit.T
+    return not (np.abs(d - float(eps)) < rel).any()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_embed_state(monkeypatch):
+    monkeypatch.setenv("DBSCAN_FAULT_BACKOFF_S", "0")
+    faults.reset_registry()
+    neighbors.reset_w_floors()
+    yield
+    faults.reset_registry()
+
+
+# --- exact-path oracle parity ------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "d,eps,maxpp",
+    [(8, 0.002, 128), (64, 0.002, 128), (256, 0.003, 96), (768, 0.02, 64)],
+    ids=["d8", "d64", "d256", "d768"],
+)
+def test_exact_parity_fuzz(rng, d, eps, maxpp):
+    """Exact-path labels match the host oracle on fuzzed [N, D]
+    inputs: ARI 1.0, byte-equal flags, and — via the shared canonical
+    numbering — byte-equal label VALUES."""
+    done = attempts = 0
+    while done < 2 and attempts < 10:
+        attempts += 1
+        k = int(rng.integers(4, 9))
+        per = int(rng.integers(30, 60))
+        x = _blobs(rng, d, k, per, noise=0.1 * eps, n_noise=per // 3)
+        if not _boundary_clear(x, eps):
+            continue
+        mp = int(rng.integers(3, 8))
+        engine = ["naive", "archery"][int(rng.integers(2))]
+        stats = {}
+        cl, fl = embed_dbscan(
+            x, eps, mp, engine=engine,
+            max_points_per_partition=maxpp, stats_out=stats,
+        )
+        ocl, ofl = oracle.cosine_dbscan_oracle(x, eps, mp, engine)
+        assert adjusted_rand_index(cl, ocl) == 1.0, (d, eps, mp, engine)
+        np.testing.assert_array_equal(fl, ofl)
+        np.testing.assert_array_equal(cl, ocl)
+        assert stats["n_partitions"] >= 1
+        done += 1
+    assert done == 2, f"only {done} boundary-clear trials in {attempts}"
+
+
+def test_exact_parity_straddling_neighborhoods(rng):
+    """Adversarial regression for the duplication band: UNIFORM sphere
+    points with eps at a low pair-distance quantile, so eps-balls
+    routinely straddle hyperplane cuts with one endpoint OUT of band.
+    A pair-sharing-only band (the reviewed-out halo/2 variant) loses
+    out-of-band neighbors from home buckets, undercounts core tests,
+    and fails the flag check on nearly every trial. The full-halo
+    band's neighborhood-completeness invariant guarantees, on ANY
+    input: byte-equal flags, no oracle cluster ever SPLIT, and merges
+    only where a shared border point witnesses them (the reference's
+    border-bridged merge semantic, PARITY.md — separated workloads
+    have no witnesses, which is why the blob fuzz above gets full
+    byte equality)."""
+    done = attempts = 0
+    while done < 2 and attempts < 10:
+        attempts += 1
+        d = int(rng.integers(4, 6))  # low D: dense straddling regime
+        n = 2000
+        x = rng.normal(size=(n, d))
+        unit, _ = oracle.normalize_rows(x)
+        dist = 1.0 - unit @ unit.T
+        iu = np.triu_indices(n, k=1)
+        flat = np.sort(dist[iu])
+        # eps at the ~0.05% pair quantile, NUDGED into the widest gap
+        # between consecutive pair distances nearby — dense pair
+        # spectra always have SOME pair inside a fixed boundary
+        # window, so reroll-until-clear would never terminate
+        k0 = int(0.0005 * len(flat))
+        lo, hi = max(1, k0 - 200), min(len(flat) - 1, k0 + 200)
+        gaps = flat[lo + 1 : hi] - flat[lo : hi - 1]
+        g = int(np.argmax(gaps))
+        if gaps[g] < 2e-5:  # midpoint margin 1e-5 >> the f32 rounding
+            continue
+        eps = float((flat[lo + g] + flat[lo + g + 1]) / 2.0)
+        mp = int(rng.integers(3, 6))
+        stats = {}
+        cl, fl = embed_dbscan(
+            x, eps, mp, max_points_per_partition=256, stats_out=stats
+        )
+        assert stats["n_partitions"] > 1  # the decomposition engaged
+        assert stats["embed_buckets"] >= 2  # ...including LSH cuts
+        ocl, ofl = oracle.cosine_dbscan_oracle(x, eps, mp)
+        # (1) core/border/noise decisions are EXACT — the invariant the
+        # duplication band exists to protect
+        np.testing.assert_array_equal(fl, ofl)
+        # (2) completeness: the engine never splits an oracle cluster
+        m = (cl > 0) & (ocl > 0)
+        pairs = set(zip(ocl[m].tolist(), cl[m].tolist()))
+        o2e: dict = {}
+        for o, e in pairs:
+            o2e.setdefault(o, set()).add(e)
+        assert all(len(s) == 1 for s in o2e.values()), "oracle cluster split"
+        # (3) soundness: engine-merged oracle clusters must share a
+        # border-bridge witness class (reference merge semantics)
+        adj = dist <= eps
+        np.fill_diagonal(adj, True)
+        core = ofl == oracle.CORE
+        comp_of = np.where(core, ocl, 0)
+        parent: dict = {}
+
+        def find(a):
+            while parent.get(a, a) != a:
+                a = parent[a]
+            return a
+
+        for i in np.flatnonzero(ofl == oracle.BORDER):
+            cs = sorted(set(comp_of[np.flatnonzero(adj[i] & core)]))
+            for c in cs[1:]:
+                ra, rb = find(cs[0]), find(c)
+                if ra != rb:
+                    parent[max(ra, rb)] = min(ra, rb)
+        e2o: dict = {}
+        for o, e in pairs:
+            e2o.setdefault(e, set()).add(o)
+        for merged in e2o.values():
+            assert len({find(o) for o in merged}) == 1, merged
+        done += 1
+    assert done == 2, f"only {done} boundary-clear trials in {attempts}"
+
+
+def test_canonical_gid_renumbering(rng):
+    """The canonical-gid contract: different LSH seeds (different
+    planes AND different spill pivot draws), different bucket caps, and
+    the metric-spill train() route all produce the byte-identical label
+    vector — cluster numbering is a function of the data alone."""
+    from dbscan_tpu import Engine, train
+
+    x = _blobs(rng, 64, 6, 50, noise=0.0005, n_noise=20)
+    eps, mp = 0.002, 4
+    base, base_f = embed_dbscan(x, eps, mp, max_points_per_partition=96)
+    for seed, maxpp in ((1, 96), (7, 64), (0, 200)):
+        cl, fl = embed_dbscan(
+            x, eps, mp, seed=seed, max_points_per_partition=maxpp
+        )
+        np.testing.assert_array_equal(base, cl)
+        np.testing.assert_array_equal(base_f, fl)
+    # cross-engine: the spill-route train() numbers canonically too
+    model = train(
+        x, eps=eps, min_points=mp, metric="cosine",
+        max_points_per_partition=96, engine=Engine.ARCHERY,
+    )
+    np.testing.assert_array_equal(base, model.clusters)
+
+
+def test_zero_norm_rows_are_noise(rng):
+    x = _blobs(rng, 16, 3, 40, noise=0.0005)
+    x = np.concatenate([x, np.zeros((5, 16))])
+    cl, fl = embed_dbscan(x, 0.01, 4, max_points_per_partition=64)
+    assert (cl[-5:] == 0).all()
+    assert (fl[-5:] == oracle.NOISE).all()
+    assert (cl[:-5] > 0).any()
+
+
+def test_empty_and_tiny_inputs():
+    cl, fl = embed_dbscan(np.empty((0, 32)), 0.1, 3)
+    assert len(cl) == 0 and len(fl) == 0
+    cl, fl = embed_dbscan(np.ones((1, 32)), 0.1, 1)
+    assert cl.tolist() == [1] and fl.tolist() == [int(oracle.CORE)]
+
+
+# --- LSH front-end -----------------------------------------------------
+
+
+def test_lsh_binning_engages_at_tight_eps(rng):
+    """Tight-threshold (dedup-regime) workloads must actually split on
+    hyperplanes — the front-end is not allowed to silently degrade to
+    the spill tree everywhere."""
+    x = _blobs(rng, 64, 12, 50, noise=0.0003)
+    stats = {}
+    cl, _ = embed_dbscan(
+        x, 0.002, 4, max_points_per_partition=128, stats_out=stats
+    )
+    assert stats["embed_buckets"] >= 2
+    # hyperplanes must do the bulk of the splitting (stray dense nodes
+    # may still fall back — that composes, it must not dominate)
+    assert stats["embed_spill_fallback_points"] < len(x) // 2
+    assert len(np.unique(cl[cl > 0])) == 12
+
+
+def test_lsh_recall_vs_brute_force_bound(rng):
+    """Multi-table co-bucketing recall of eps-close pairs is at or
+    above the Goemans-Williamson lower bound (minus sampling noise) —
+    the diagnostic contract of the non-primary tables."""
+    d, bits, tables = 64, 12, 6
+    base = _blobs(rng, d, 40, 1, noise=0.0)
+    pert = base + 0.01 * rng.normal(size=base.shape)  # eps-close pairs
+    x = np.concatenate([base, pert])
+    unit, _ = oracle.normalize_rows(x)
+    eps = float(
+        (1.0 - np.sum(unit[:40] * unit[40:], axis=1)).max()
+    ) + 1e-9
+    planes = lsh.make_planes(d, bits, tables, seed=3)
+    codes, _proj = lsh.hash_points(
+        unit.astype(np.float32), planes, bits, tables
+    )
+    ii = np.arange(40)
+    jj = ii + 40
+    recall = float(lsh.pair_covered(codes, ii, jj).mean())
+    bound = lsh.collision_lower_bound(eps, bits, tables)
+    assert recall >= bound - 0.17, (recall, bound)  # 3 sigma at n=40
+
+
+def test_bin_points_coverage_is_exact(rng):
+    """Every eps-pair shares at least one partition (the coverage
+    contract) and every point has exactly one home leaf."""
+    from dbscan_tpu.parallel.spill import chord_halo, spill_partition
+
+    x = _blobs(rng, 32, 8, 40, noise=0.002)
+    unit, _ = oracle.normalize_rows(x)
+    u32 = unit.astype(np.float32)
+    eps = 0.01
+    halo = chord_halo(eps, 32 * 2.0**-23, dim=32)
+    planes = lsh.make_planes(32, 16, 1, seed=0)
+    proj0 = (u32 @ planes.T).astype(np.float32)[:, :16]
+    part_ids, point_idx, n_parts, home_of = lsh.bin_points(
+        proj0, halo, 64,
+        lambda idx: spill_partition(u32[idx], 64, halo),
+    )
+    assert home_of.min() >= 0 and home_of.max() < n_parts
+    # brute-force eps-pairs must co-reside somewhere
+    dmat = 1.0 - unit @ unit.T
+    ai, aj = np.nonzero(np.triu(dmat <= eps, k=1))
+    parts_of = [set() for _ in range(len(x))]
+    for p, i in zip(part_ids, point_idx):
+        parts_of[i].add(int(p))
+    for i, j in zip(ai, aj):
+        assert parts_of[i] & parts_of[j], (i, j)
+
+
+# --- subsampled-edge mode ---------------------------------------------
+
+
+def test_subsampled_mode_ari_floor_and_determinism(rng):
+    """The declared accuracy contract (PARITY.md): at frac 0.5 on a
+    clusterable workload the sampled labels stay at or above the
+    declared ARI floor vs the exact path, and the deterministic pair
+    coin makes reruns byte-identical."""
+    x = _blobs(rng, 64, 8, 60, noise=0.0005, n_noise=30)
+    eps, mp = 0.002, 5
+    exact, _ = embed_dbscan(x, eps, mp, max_points_per_partition=128)
+    s1 = {}
+    samp, _f = embed_dbscan(
+        x, eps, mp, max_points_per_partition=128, sample_frac=0.5,
+        stats_out=s1,
+    )
+    assert s1["sample_frac"] == 0.5
+    ari = adjusted_rand_index(exact, samp)
+    assert ari >= 0.95, ari  # the declared floor, PARITY.md
+    samp2, _ = embed_dbscan(
+        x, eps, mp, max_points_per_partition=128, sample_frac=0.5
+    )
+    np.testing.assert_array_equal(samp, samp2)
+
+
+def test_sample_frac_env_knob(rng, monkeypatch):
+    monkeypatch.setenv("DBSCAN_EMBED_SAMPLE_FRAC", "0.5")
+    x = _blobs(rng, 16, 3, 40, noise=0.0005)
+    s = {}
+    embed_dbscan(x, 0.002, 4, max_points_per_partition=64, stats_out=s)
+    assert s["sample_frac"] == 0.5
+    with pytest.raises(ValueError):
+        embed_dbscan(x, 0.002, 4, sample_frac=1.5)
+
+
+def test_eff_min_points_scaling():
+    assert neighbors.eff_min_points(10, 1.0) == 10
+    assert neighbors.eff_min_points(10, 0.5) == 6  # ceil(0.5*9)+1
+    assert neighbors.eff_min_points(1, 0.1) == 1
+    assert neighbors.keep_threshold(1.0) == neighbors.SAMPLE_RES
+
+
+# --- compiled-shape discipline ----------------------------------------
+
+
+def test_zero_recompile_across_mixed_jobs(rng):
+    """The ladder/ratchet pin: after one warm pass over a mixed N/D
+    job stream, re-running the SAME stream compiles nothing and never
+    escalates a W rung — the embed analog of the serve/spill
+    steady-state pins."""
+    jobs = []
+    for d, k, per in ((16, 4, 40), (64, 6, 30), (16, 3, 55)):
+        jobs.append(_blobs(rng, d, k, per, noise=0.0005))
+    was = obs.active()
+    obs.enable()
+    try:
+        for x in jobs:  # warm pass settles every rung
+            embed_dbscan(x, 0.002, 4, max_points_per_partition=64)
+        snap = obs.counters()
+        for x in jobs:
+            embed_dbscan(x, 0.002, 4, max_points_per_partition=64)
+        delta = obs.counters_delta(snap)
+        assert delta.get("compiles.total", 0) == 0, delta
+        assert delta.get("embed.neighbor_escalations", 0) == 0
+    finally:
+        if not was:
+            obs.disable()
+
+
+def test_w_escalation_is_exact(rng):
+    """A bucket denser than the starting W rung re-runs at the rung
+    its max degree needs; labels stay exact."""
+    neighbors.reset_w_floors()
+    x = _blobs(rng, 16, 2, 150, noise=0.0002)  # degree ~149 >> first rung
+    stats = {}
+    cl, fl = embed_dbscan(
+        x, 0.002, 4, max_points_per_partition=512, stats_out=stats
+    )
+    assert stats["embed_escalations"] >= 1
+    ocl, ofl = oracle.cosine_dbscan_oracle(x, 0.002, 4)
+    np.testing.assert_array_equal(cl, ocl)
+    np.testing.assert_array_equal(fl, ofl)
+
+
+# --- fault-site drills -------------------------------------------------
+
+
+def _spec(monkeypatch, spec):
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", spec)
+    faults.reset_registry()
+
+
+def test_embed_transient_heals(rng, monkeypatch):
+    x = _blobs(rng, 32, 4, 40, noise=0.0005)
+    clean, clean_f = embed_dbscan(x, 0.002, 4, max_points_per_partition=64)
+    _spec(monkeypatch, "embed#1:TRANSIENT*2")
+    snap = faults.counters.snapshot()
+    cl, fl = embed_dbscan(x, 0.002, 4, max_points_per_partition=64)
+    delta = faults.counters.delta(snap)
+    assert delta["retries"] >= 2 and delta["injected"] >= 2
+    assert delta["fallbacks"] == 0
+    np.testing.assert_array_equal(clean, cl)
+    np.testing.assert_array_equal(clean_f, fl)
+
+
+def test_embed_persistent_bucket_degrades_to_oracle(rng, monkeypatch):
+    x = _blobs(rng, 32, 4, 40, noise=0.0005)
+    clean, clean_f = embed_dbscan(x, 0.002, 4, max_points_per_partition=64)
+    _spec(monkeypatch, "embed#2:PERSISTENT")
+    stats = {}
+    cl, fl = embed_dbscan(
+        x, 0.002, 4, max_points_per_partition=64, stats_out=stats
+    )
+    assert stats["embed_oracle_buckets"] >= 1
+    np.testing.assert_array_equal(clean, cl)
+    np.testing.assert_array_equal(clean_f, fl)
+
+
+def test_embed_persistent_hash_degrades_whole_run(rng, monkeypatch):
+    x = _blobs(rng, 32, 4, 40, noise=0.0005)
+    _spec(monkeypatch, "embed#0:PERSISTENT")  # ordinal 0 = the hash
+    stats = {}
+    cl, fl = embed_dbscan(
+        x, 0.002, 4, max_points_per_partition=64, stats_out=stats
+    )
+    assert stats.get("embed_degraded") == "oracle"
+    ocl, ofl = oracle.cosine_dbscan_oracle(x, 0.002, 4)
+    np.testing.assert_array_equal(cl, ocl)
+    np.testing.assert_array_equal(fl, ofl)
+
+
+def test_embed_persistent_without_fallback_raises(rng, monkeypatch):
+    x = _blobs(rng, 32, 4, 40, noise=0.0005)
+    _spec(monkeypatch, "embed#0:PERSISTENT")
+    with pytest.raises(faults.FatalDeviceFault):
+        embed_dbscan(
+            x, 0.002, 4, max_points_per_partition=64,
+            oracle_fallback=False,
+        )
+
+
+# --- spill-tree fallback at D=64 ---------------------------------------
+
+
+def test_spill_fallback_d64_device_host_parity(rng, monkeypatch):
+    """The embed fallback reuses the dimension-agnostic spill tree
+    unmodified: at D=64, forced device passes (level build on) and the
+    host recursion produce byte-identical labels."""
+    x = _blobs(rng, 64, 5, 60, noise=0.02)  # loose eps => fallback
+    eps, mp = 0.05, 5
+    stats_h = {}
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "0")
+    host, host_f = embed_dbscan(
+        x, eps, mp, max_points_per_partition=64, stats_out=stats_h
+    )
+    assert stats_h["embed_spill_fallbacks"] >= 1
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE", "1")
+    monkeypatch.setenv("DBSCAN_SPILL_DEVICE_TREE", "1")
+    dev, dev_f = embed_dbscan(x, eps, mp, max_points_per_partition=64)
+    np.testing.assert_array_equal(host, dev)
+    np.testing.assert_array_equal(host_f, dev_f)
+
+
+def test_spill_device_rank_guard():
+    from dbscan_tpu.parallel.spill_device import DeviceNodeOps
+
+    with pytest.raises(ValueError, match=r"\[N, D\]"):
+        DeviceNodeOps.from_host(np.ones(8, np.float32))
+    with pytest.raises(ValueError, match=r"\[N, D\]"):
+        DeviceNodeOps.from_host(np.ones((4, 2, 2), np.float32))
+    ops = DeviceNodeOps.from_host(np.ones((4, 64), np.float32))
+    assert ops.dim == 64 and ops.n == 4
+
+
+# --- telemetry ---------------------------------------------------------
+
+
+def test_embed_counters_declared_and_analyzed(rng, tmp_path):
+    """Every embed.* emission is schema-declared and the analyzer's
+    -- embed -- section derives the occupancy/fallback/sampling
+    figures from them."""
+    from dbscan_tpu.obs import analyze as obs_analyze
+    from dbscan_tpu.obs import schema
+
+    trace = tmp_path / "embed_trace.jsonl"
+    was = obs.active()
+    obs.enable(trace_path=str(trace))
+    try:
+        x = _blobs(rng, 64, 8, 40, noise=0.0005)
+        embed_dbscan(
+            x, 0.002, 4, max_points_per_partition=64, sample_frac=0.5
+        )
+        snap = obs.counters()
+        for name in snap:
+            assert schema.is_declared("counter", name), name
+    finally:
+        obs.flush()
+        if not was:
+            obs.disable()
+    report = obs_analyze.analyze(obs_analyze.load_trace(str(trace)))
+    emb = report["embed"]
+    assert emb["embed.points"] == len(x)
+    assert emb["embed.dup_factor"] >= 1.0
+    assert emb["embed.sampled_edge_frac"] == 0.5
+    assert "embed.spill_fallback_rate" in emb
+    occ = sum(
+        emb.get(k, 0)
+        for k in (
+            "embed.occ_le_64", "embed.occ_le_1024",
+            "embed.occ_le_16384", "embed.occ_gt_16384",
+        )
+    )
+    assert occ >= 1
+    text = obs_analyze.render(report)
+    assert "-- embed (LSH binning / cosine neighbors) --" in text
+
+
+# --- concurrency -------------------------------------------------------
+
+
+def test_embed_suite_race_free_under_tsan(tmp_path):
+    """DBSCAN_TSAN=1 concurrent rerun: the PullEngine-overlapped land
+    path and the W-floor ratchet must produce an empty race report."""
+    report = tmp_path / "tsan_report.json"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "DBSCAN_TSAN": "1",
+        "DBSCAN_TSAN_REPORT": str(report),
+    }
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest",
+            os.path.join(REPO, "tests", "test_embed.py"),
+            "-q", "-m", "not slow", "-p", "no:cacheprovider",
+            "-k", "exact_parity_fuzz and (d8 or d64)",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    with open(report) as f:
+        rep = json.load(f)
+    assert rep["races"] == [], rep["races"]
+    assert rep["lock_inversions"] == [], rep["lock_inversions"]
