@@ -318,6 +318,14 @@ def _cellcc_fields(prefix: str, stats: dict) -> dict:
     # sweep count and flag the next healthy capture
     if stats.get("cellcc_cc_iters"):
         out[f"{prefix}_cellcc_cc_iters"] = int(stats["cellcc_cc_iters"])
+    # shared-propagation figures (ops/propagation.py): the window_cc
+    # sweep count rides next to _cc_iters (same 0-means-host rule) and
+    # regresses UP in obs/regress; the resolved mode labels the row so
+    # a sweep-count shift is attributable to the knob, not noise
+    if stats.get("prop_sweeps"):
+        out[f"{prefix}_prop_sweeps"] = int(stats["prop_sweeps"])
+    if stats.get("prop_mode"):
+        out[f"{prefix}_prop_mode"] = str(stats["prop_mode"])
     return out
 
 
@@ -1395,6 +1403,35 @@ def main() -> None:
     import jax
 
     backend = jax.default_backend()
+    # BENCH_PROFILE=path: apply a tuned knob profile (written by
+    # python -m dbscan_tpu.bench --tune) as tuned DEFAULTS — explicit
+    # DBSCAN_* exports still win (config.Profile precedence). The
+    # profile's tournament speedup is stamped on the capture so the
+    # committed figure trends and gates next to the walls it bought.
+    profile_fields = {}
+    profile_path = os.environ.get("BENCH_PROFILE")
+    if profile_path:
+        from dbscan_tpu.config import Profile
+
+        prof = Profile.load(profile_path)
+        prof.apply()
+        profile_fields = {
+            "profile": os.path.basename(profile_path),
+            "profile_workload": prof.workload,
+        }
+        spd = prof.meta.get("tuned_vs_default_speedup")
+        if prof.backend not in ("unknown", backend):
+            # profiles are per-backend by design: apply the knobs (the
+            # operator asked), but NEVER stamp a foreign tournament's
+            # speedup onto this backend's gated history population
+            print(
+                f"bench: profile {profile_path} was tuned on backend "
+                f"{prof.backend!r} but this run is {backend!r} — "
+                "applying its knobs, NOT stamping its speedup",
+                file=sys.stderr,
+            )
+        elif spd is not None:
+            profile_fields["tuned_vs_default_speedup"] = float(spd)
     pts = make_data(n)
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -1506,6 +1543,7 @@ def main() -> None:
         "n_partitions": model.stats["n_partitions"],
         "seconds": round(dt, 3),
         "phases": _phases(model.stats),
+        **profile_fields,  # tuned-profile provenance + gated speedup
         **rep_obs,  # upload/compute split (+ resident_hot when cosine)
         **_cellcc_fields("headline", model.stats),
     }
@@ -1751,6 +1789,10 @@ _COMPACT_SUFFIXES = (
     # tail-only captures still catch a finalize regression
     "_cellcc_finalize_s",
     "_cellcc_cc_iters",
+    # shared window_cc propagation depth (ops/propagation.py) and the
+    # autotuner's committed tuned-vs-default ratio — both gated
+    "_prop_sweeps",
+    "_vs_default_speedup",
 )
 
 

@@ -142,6 +142,13 @@ def build_parser() -> argparse.ArgumentParser:
         "site-level plugin registration can override)",
     )
     p.add_argument(
+        "--profile", metavar="PATH",
+        help="load a tuned knob profile (bench/profiles/*.json, "
+        "written by python -m dbscan_tpu.bench --tune) and apply it "
+        "as tuned DEFAULTS — explicitly exported DBSCAN_* variables "
+        "still win (config.Profile)",
+    )
+    p.add_argument(
         "--trace", metavar="PATH",
         help="write a span trace of the run to PATH: Chrome-trace JSON "
         "(chrome://tracing / Perfetto) by default, JSONL records when "
@@ -159,6 +166,15 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.profile:
+        # applied FIRST so every leg (train, --embed, --serve) reads
+        # the tuned defaults through config.env
+        from dbscan_tpu.config import Profile
+
+        try:
+            Profile.load(args.profile).apply()
+        except (OSError, ValueError, KeyError) as e:
+            parser.error(f"--profile {args.profile}: {e}")
     if args.serve:
         from dbscan_tpu.serve.__main__ import main as serve_main
 

@@ -329,6 +329,30 @@ ENV_VARS = _env_table(
         "materialize host-side).",
     ),
     EnvVar(
+        "DBSCAN_PROP_UNIONFIND", "str", "auto",
+        "Propagation mode of the shared min-label fixed point "
+        "(ops/propagation.py): 'auto'/'1' route every window_cc "
+        "consumer (banded cellcc, dense, embed neighbors, halo merge) "
+        "through the single-pass union-find variant — scatter-min edge "
+        "relaxation plus aggressive pointer doubling per sweep, the "
+        "arXiv:1912.06255 structure — which collapses the O(diameter) "
+        "sweep count; '0' keeps the classic iterated path as the "
+        "parity oracle (labels are byte-identical either way; only the "
+        "gated sweep counts move).",
+    ),
+    EnvVar(
+        "DBSCAN_CELLCC_FUSED", "str", "auto",
+        "Fused Pallas unpack+fold+propagate for the device cellcc "
+        "finalize (ops/pallas_banded.py): each chunk's packed-slab "
+        "unpack, per-cell scatter-fold, AND the first propagation "
+        "sweep run as ONE cellcc.fused dispatch at flush time, so the "
+        "tail cellcc.cc starts one sweep warm. 'auto' engages it on "
+        "Pallas-capable (TPU) backends only; '1' forces it anywhere "
+        "(interpreter mode keeps the CPU suite honest); '0' keeps the "
+        "split unpack/cc pair. DBSCAN_CELLCC_DEVICE semantics (fault "
+        "site, degrade ladder, residency cap) are unchanged.",
+    ),
+    EnvVar(
         "DBSCAN_CELLCC_DEVICE_SLOTS", "int", 1 << 28,
         "Staged-slot budget of the device cellcc finalize: it keeps "
         "~13 B/slot of chunk metadata/partials resident until the tail "
@@ -584,6 +608,11 @@ def env(name: str, default: object = None):
     whose fallback is contextual (e.g. a DBSCANConfig field). Raises
     KeyError on an undeclared name — adding the table row (and its
     PARITY.md line) IS the registration step the linter enforces.
+
+    Precedence: a set (non-empty) environment variable wins; otherwise
+    an applied :class:`Profile` overlay (``apply_profile``) supplies
+    the value; otherwise the default. Profiles are tuned DEFAULTS, so
+    an operator's explicit export always overrides a committed profile.
     """
     spec = ENV_VARS[name]
     raw = os.environ.get(name)
@@ -593,6 +622,8 @@ def env(name: str, default: object = None):
         # exported-but-empty means "use the default", matching the
         # pre-registry call sites (an empty DBSCAN_TPU_NATIVE must not
         # silently disable the native runtime)
+        if name in _profile_overlay:
+            return _profile_overlay[name]
         return default
     if spec.kind == "bool":
         return raw.strip().lower() in _TRUE
@@ -606,6 +637,160 @@ def env(name: str, default: object = None):
             f"{name}={raw!r} is not a valid {spec.kind}: {e}"
         ) from None
     return raw
+
+
+# --- tunable-knob registry + profiles ---------------------------------
+#
+# The autotuner (``python -m dbscan_tpu.bench --tune``) searches ONLY
+# the knobs declared here — typed ranges/steps next to the ENV_VARS
+# rows they tune, so the search space is as pinned as the registry
+# itself. The linter's ``env-tunable-undeclared`` rule rejects any
+# Tunable whose name is missing from ENV_VARS, whose kind disagrees
+# with the declared row, or whose range is empty: declaring BOTH rows
+# is the registration step.
+
+
+@dataclasses.dataclass(frozen=True)
+class Tunable:
+    """One searchable knob: ``choices`` is the full ordered candidate
+    set (ints for slot/ladder budgets — powers of two so jit shapes
+    recur; strings for mode knobs). ``kind`` must match the ENV_VARS
+    row."""
+
+    name: str
+    kind: str
+    choices: tuple
+    doc: str
+
+
+def _pow2(lo: int, hi: int) -> tuple:
+    return tuple(1 << k for k in range(lo, hi + 1))
+
+
+TUNABLES = (
+    Tunable(
+        "DBSCAN_GROUP_SLOTS", "int", _pow2(20, 26),
+        "dispatch-group padded-slot budget (pack/compute overlap grain)",
+    ),
+    Tunable(
+        "DBSCAN_COMPACT_CHUNK_SLOTS", "int", _pow2(20, 26),
+        "compact p1 chunk grain (flush/pull frequency vs residency)",
+    ),
+    Tunable(
+        "DBSCAN_INFLIGHT_SLOTS", "int", _pow2(24, 27),
+        "dispatched-but-unretired slot window (backpressure depth)",
+    ),
+    Tunable(
+        "DBSCAN_PULL_INFLIGHT", "int", (1, 2, 3, 4),
+        "pull-pipeline depth (chunks with D2H issued ahead)",
+    ),
+    Tunable(
+        "DBSCAN_PULL_INFLIGHT_BYTES", "int", _pow2(28, 30),
+        "byte budget across in-flight pipelined pulls",
+    ),
+    Tunable(
+        "DBSCAN_CELLCC_DEVICE_SLOTS", "int", _pow2(26, 28),
+        "device cellcc finalize staged-residency ladder cap",
+    ),
+    Tunable(
+        "DBSCAN_SPILL_LEVEL_SLOTS", "int", _pow2(26, 28),
+        "spill-tree level-dispatch element ladder cap",
+    ),
+    Tunable(
+        "DBSCAN_PROP_UNIONFIND", "str", ("auto", "1", "0"),
+        "propagation mode: single-pass union-find vs iterated",
+    ),
+    Tunable(
+        "DBSCAN_CELLCC_FUSED", "str", ("auto", "1", "0"),
+        "fused Pallas unpack+fold+propagate vs split unpack/cc",
+    ),
+)
+
+
+#: applied-profile overlay read by :func:`env` when the variable is
+#: unset: name -> typed value. One profile at a time; module-global on
+#: purpose (a profile is process-wide tuning state, like the env).
+_profile_overlay: dict = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class Profile:
+    """One tuned knob profile: the per-(backend, workload) winner the
+    autotuner commits to ``bench/profiles/`` and ``cli.py --profile`` /
+    ``bench.py`` (BENCH_PROFILE) load. ``values`` maps declared knob
+    names to typed values; ``meta`` carries the tuning provenance
+    (tuned_vs_default_speedup, walls, rev) verbatim."""
+
+    backend: str
+    workload: str
+    values: dict
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    def validate(self) -> "Profile":
+        declared = {t.name: t for t in TUNABLES}
+        for name, value in self.values.items():
+            t = declared.get(name)
+            if t is None:
+                raise ValueError(
+                    f"profile knob {name!r} is not a declared Tunable "
+                    "(config.TUNABLES) — the search space and the "
+                    "loadable profile surface are the same registry"
+                )
+            if value not in t.choices:
+                raise ValueError(
+                    f"profile value {name}={value!r} outside the "
+                    f"declared choices {t.choices}"
+                )
+        return self
+
+    def apply(self) -> None:
+        """Install as the process overlay (tuned defaults: a set env
+        var still wins, see :func:`env`)."""
+        self.validate()
+        _profile_overlay.clear()
+        _profile_overlay.update(self.values)
+
+    def save(self, path: str) -> None:
+        import json
+
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "backend": self.backend,
+                    "workload": self.workload,
+                    "values": self.values,
+                    "meta": self.meta,
+                },
+                f,
+                indent=1,
+                sort_keys=True,
+            )
+            f.write("\n")
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load(path: str) -> "Profile":
+        import json
+
+        with open(path, encoding="utf-8") as f:
+            obj = json.load(f)
+        return Profile(
+            backend=str(obj.get("backend", "unknown")),
+            workload=str(obj.get("workload", "unknown")),
+            values=dict(obj.get("values") or {}),
+            meta=dict(obj.get("meta") or {}),
+        ).validate()
+
+
+def clear_profile() -> None:
+    """Drop the applied overlay (tests / between tuner candidates)."""
+    _profile_overlay.clear()
+
+
+def active_profile_values() -> dict:
+    """Snapshot of the applied overlay (empty when no profile)."""
+    return dict(_profile_overlay)
 
 
 def parity_env_table() -> str:

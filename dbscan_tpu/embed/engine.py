@@ -50,6 +50,7 @@ import numpy as np
 from dbscan_tpu import config, faults, obs
 from dbscan_tpu.embed import lsh, neighbors, oracle
 from dbscan_tpu.obs import compile as obs_compile
+from dbscan_tpu.ops import propagation as prop_propagation
 from dbscan_tpu.ops.labels import NOISE, NOT_FLAGGED, SEED_NONE
 from dbscan_tpu.parallel.binning import _ladder_width
 
@@ -280,6 +281,7 @@ def _embed_unit(
         results: dict = {}
         edges = 0
         cc_iters_max = 0
+        prop_sweeps = 0
         escalations = 0
         oracle_buckets = [0]  # mutable: bumped inside the fallback
 
@@ -430,7 +432,14 @@ def _embed_unit(
             inst_flag[lo:hi] = flag_h[:c]
             edges += int(np.asarray(cnt_h[:c], dtype=np.int64).sum())
             cc_iters_max = max(cc_iters_max, int(iters))
+            prop_sweeps += int(iters)
         obs.count("embed.edges", int(edges))
+        if prop_sweeps:
+            # the shared propagation telemetry (ops/propagation.py):
+            # every bucket's window_cc sweep count funnels into
+            # prop.sweeps so leg-1's collapse is measured on the embed
+            # path too, not just the banded cellcc finalize
+            prop_propagation.note_sweeps(prop_sweeps)
         t_pull = time.perf_counter()
 
         cand, inst_inner = spill_mod.band_membership(

@@ -110,12 +110,20 @@ def _pair_keep(jnp, rids, cids, seed):
     return h & jnp.uint32(SAMPLE_RES - 1)
 
 
-@functools.lru_cache(maxsize=128)
-def _neighbors_fn(b: int, w: int, engine: str):
+def _neighbors_fn(b: int, w: int, engine: str, mode: str = None):
     """Jitted per-bucket kernel (see module doc). Compiled per
-    (bucket width, W rung, engine); D rides the traced array shape.
-    Returns (seed_labels [b], flags [b], counts [b], overflow bool,
-    cc iters int32)."""
+    (bucket width, W rung, engine, propagation mode) — the mode
+    (DBSCAN_PROP_UNIONFIND, ops/propagation.py) resolves BEFORE the
+    cache so an in-process knob flip mints a fresh trace; D rides the
+    traced array shape. Returns (seed_labels [b], flags [b], counts
+    [b], overflow bool, cc iters int32)."""
+    from dbscan_tpu.ops.propagation import prop_mode
+
+    return _neighbors_fn_cached(b, w, engine, prop_mode(mode))
+
+
+@functools.lru_cache(maxsize=128)
+def _neighbors_fn_cached(b: int, w: int, engine: str, mode: str):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -170,7 +178,9 @@ def _neighbors_fn(b: int, w: int, engine: str):
         # symmetric (one compiled matmul per block -> bitwise-equal
         # sims both ways), the pair hash is unordered, and no-overflow
         # means every neighbor is listed — window_cc's contract
-        comp_all, iters = window_cc(col_core & core[:, None], tabc)
+        comp_all, iters = window_cc(
+            col_core & core[:, None], tabc, mode=mode
+        )
         comp = jnp.where(core, comp_all, none)
         nbr_seed = jnp.min(
             jnp.where(col_core, comp[tabc], none), axis=1

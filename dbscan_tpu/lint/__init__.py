@@ -84,6 +84,9 @@ RULES = {
     "env-undeclared": "config.env() of a name missing from "
     "config.ENV_VARS",
     "env-parity": "declared env var missing from PARITY.md",
+    "env-tunable-undeclared": "config.TUNABLES knob missing from "
+    "ENV_VARS, type-mismatched, or range-less (the autotuner search "
+    "space must be a declared registry surface)",
     "race-unlocked-shared": "unlocked write to shared state from the "
     "pull-engine worker slice",
     "race-lock-order": "lock-acquisition-order cycle (or non-reentrant "

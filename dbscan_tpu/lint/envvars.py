@@ -15,7 +15,15 @@ the declared table in :mod:`dbscan_tpu.config`.
   so the row marker is what's required (regenerate with
   ``python -m dbscan_tpu.lint --env-table``). Only checked when the
   linted set includes the real package (fixture runs in temp dirs
-  skip it).
+  skip it);
+- ``env-tunable-undeclared``: a ``config.TUNABLES`` entry (the
+  autotuner's declared search space, ``python -m dbscan_tpu.bench
+  --tune``) naming a knob missing from ``ENV_VARS``, disagreeing with
+  the declared row's type, or declaring an empty range — every knob
+  the tuner may set must be a first-class registry row, so a tuned
+  profile can never smuggle an undeclared/untyped variable into the
+  process. Only checked when the linted set includes the real
+  package, like ``env-parity``.
 """
 
 from __future__ import annotations
@@ -151,6 +159,55 @@ def check(pkg: Package) -> List[Finding]:
                         )
                     )
     if lints_real_config:
+        from dbscan_tpu.config import TUNABLES
+
+        config_path = next(
+            f.path
+            for f in pkg.files
+            if os.path.basename(f.path) == "config.py"
+        )
+        for t in TUNABLES:
+            spec = declared.get(t.name)
+            if spec is None:
+                findings.append(
+                    Finding(
+                        "env-tunable-undeclared",
+                        config_path,
+                        1,
+                        0,
+                        f"Tunable {t.name!r} is not declared in "
+                        "config.ENV_VARS — the tuner's search space "
+                        "and the env registry must be the same "
+                        "surface (add the table row first)",
+                    )
+                )
+                continue
+            if spec.kind != t.kind:
+                findings.append(
+                    Finding(
+                        "env-tunable-undeclared",
+                        config_path,
+                        1,
+                        0,
+                        f"Tunable {t.name!r} declares kind "
+                        f"{t.kind!r} but the ENV_VARS row says "
+                        f"{spec.kind!r} — a tuned profile would "
+                        "write values the typed reader rejects",
+                    )
+                )
+            if not t.choices:
+                findings.append(
+                    Finding(
+                        "env-tunable-undeclared",
+                        config_path,
+                        1,
+                        0,
+                        f"Tunable {t.name!r} declares an empty "
+                        "choice range — the successive-halving "
+                        "search has nothing to explore; declare the "
+                        "typed range/steps next to the ENV_VARS row",
+                    )
+                )
         parity = _find_parity(
             [os.path.dirname(f.path) for f in pkg.files]
         )

@@ -327,6 +327,32 @@ def _models() -> Dict[str, FamilyModel]:
                 "runtime-gated",
             ),
             FamilyModel(
+                "cellcc.fused",
+                [
+                    ArgModel("combo", ("CB",), INT),
+                    ArgModel("cell_flat", ("M",), INT),
+                    ArgModel("fold_flat", ("M",), INT),
+                    ArgModel("or_gid", ("K",), INT),
+                    ArgModel(
+                        "wintab", ("C", BANDED_ROWS * BANDED_ROWS), INT
+                    ),
+                ],
+                # compiled_cellcc_unpack's envelope plus the folded
+                # first-sweep partial: core [M] + the [K, 25] Pallas
+                # bit expansions + per-cell partials and the [C, 25]
+                # window gather behind lab0
+                overhead=M * 5
+                + K * (4 + 2 * BANDED_ROWS * BANDED_ROWS * 4)
+                + C * (2 * BANDED_ROWS * BANDED_ROWS * 4 + 12),
+                static_slots=None,
+                note="fused Pallas unpack+fold+propagate per chunk "
+                "(ops/pallas_banded.py compiled_cellcc_fused): the "
+                "cellcc.unpack scatter-fold plus the first window_cc "
+                "sweep in ONE dispatch riding the packing window; C "
+                "scales with occupied cells — data-scaled, "
+                "runtime-gated",
+            ),
+            FamilyModel(
                 "cellcc.cc",
                 [
                     ArgModel(
@@ -343,6 +369,11 @@ def _models() -> Dict[str, FamilyModel]:
                     ArgModel("bitses", ("Mi",), INT, tuple_of=True),
                     ArgModel("cells", ("Mi",), INT, tuple_of=True),
                     ArgModel("folds", ("Mi",), INT, tuple_of=True),
+                    # the fused path's per-chunk first-sweep label
+                    # partials (EMPTY tuple on the split unpack path —
+                    # tuple args validate elementwise, so empty is
+                    # exactly "no warm start")
+                    ArgModel("labs", ("Ci",), INT, tuple_of=True),
                 ],
                 # temps: labels/comp/seed tables + the [C, 25] seed_win
                 # + bounded lax.map label-pass tiles; outputs: the

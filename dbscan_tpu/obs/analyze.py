@@ -103,7 +103,17 @@ assert schema.is_declared("counter", "resident_cache.misses")
 assert schema.is_declared("span", "transfer.pull")
 assert schema.is_declared("span", "pull.chunk")
 assert schema.prefix_declared("span", schema.PREFIX_DEVTIME)
-del _k, _kind, _names
+# the device-timeline section is keyed per family off the
+# ``devtime.<family>`` span names: EVERY declared compile family must
+# have its devtime span generated, or a family added to
+# COMPILE_FAMILIES without the schema generator loop would silently
+# never reach the rollup (and the --merge report) — the PR-13/14
+# families (serve.query/serve.jobs/embed.hash/embed.neighbors) are
+# exactly what this pin was added for (tests/test_obs_analyze.py pins
+# the rollup end-to-end per family)
+for _f in schema.COMPILE_FAMILIES:
+    assert schema.is_declared("span", f"devtime.{_f}"), _f
+del _f, _k, _kind, _names
 
 
 def load_trace(path: str) -> dict:

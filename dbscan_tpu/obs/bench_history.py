@@ -73,11 +73,19 @@ from dbscan_tpu.obs import schema
 # but is a jobs-per-second THROUGHPUT — obs/regress.direction and
 # _unit_for both special-case the "_jobs_s" suffix BEFORE the seconds
 # rule)
+# _prop_sweeps: the shared window_cc-family sweep count (ops/
+# propagation.py) — a propagation-depth figure that regresses UP like
+# _cc_iters (and trends the DBSCAN_PROP_UNIONFIND collapse);
+# _vs_default_speedup: the autotuner's tuned-vs-default ratio
+# (python -m dbscan_tpu.bench --tune) — HARD-FLOORED at 1.0 by
+# obs/regress.py (a committed profile that loses to defaults is a red
+# gate, the same contract shape as _pred_ratio's hard cap)
 _EXACT_KEYS = ("value", "seconds", "vs_baseline")
 _SUFFIXES = (
     "_seconds", "_s", "_mpts", "_vs_baseline", "_overlap_ratio",
     "_pred_ratio", "_spill_levels", "_busy_frac", "_cc_iters",
-    "_replay_frac", "_qps", "_ms", "_ari",
+    "_replay_frac", "_qps", "_ms", "_ari", "_prop_sweeps",
+    "_vs_default_speedup",
 )
 # numeric-but-not-perf keys the suffix rule would otherwise catch —
 # declared with the telemetry schema (the keys are fault-counter
@@ -115,6 +123,10 @@ def _unit_for(metric: str, obj: dict) -> Optional[str]:
         return "levels"
     if metric.endswith("_cc_iters"):
         return "iters"
+    if metric.endswith("_prop_sweeps"):
+        return "iters"
+    if metric.endswith("_vs_default_speedup"):
+        return "ratio"
     if metric.endswith("_jobs_s"):
         # jobs PER second (serve tenancy throughput), not a wall —
         # must beat the "_s" rule below

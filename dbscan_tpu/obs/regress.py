@@ -65,6 +65,11 @@ def direction(metric: str, unit: Optional[str] = None) -> Optional[str]:
         # device cellcc CC sweeps: each is a full [C, 25] gather pass,
         # so a propagation-count blowup regresses UP like a wall
         return LOWER_BETTER
+    if metric.endswith("_prop_sweeps"):
+        # shared window_cc-family sweep count (ops/propagation.py):
+        # the figure DBSCAN_PROP_UNIONFIND exists to collapse —
+        # regresses UP like _cc_iters
+        return LOWER_BETTER
     if metric.endswith("_replay_frac"):
         # campaign restart overhead (replayed wall / total work wall,
         # dbscan_tpu/campaign.py): more of the campaign's wall spent
@@ -110,6 +115,26 @@ def compare(
     regressions, ok, skipped = [], [], []
     for rec in fresh:
         metric = rec["metric"]
+        if metric.endswith("_vs_default_speedup"):
+            # autotuner contract, not a perf direction: a committed
+            # profile must BEAT (or tie) the defaults it replaces, so
+            # the ratio is hard-FLOORED at 1.0 with no history needed —
+            # the mirror image of the _pred_ratio hard cap below (and
+            # immune to noise widening for the same reason)
+            value = rec["value"]
+            entry = {
+                "metric": metric,
+                "value": value,
+                "median": 1.0,
+                "n": 0,
+                "direction": "floor",
+                "delta": round(1.0 - value, 4),
+                "threshold": 0.0,
+                "resident_hot": rec.get("resident_hot"),
+                "backend": rec.get("backend"),
+            }
+            (regressions if value < 1.0 else ok).append(entry)
+            continue
         if metric.endswith("_pred_ratio"):
             # graftshape containment contract, not a perf direction:
             # the static model must BOUND the observed HBM peak, so a

@@ -45,6 +45,7 @@ COMPILE_FAMILIES = (
     "cellcc.postpass",
     "cellcc.gather",
     "cellcc.unpack",
+    "cellcc.fused",
     "cellcc.cc",
     "spill.gather",
     "spill.level",
@@ -111,6 +112,11 @@ COUNTERS = {
     "cellcc.cc_iters": "neighbor-min sweeps the device cell "
     "connected-components ran to its fixed point (data-dependent "
     "convergence depth; labels are iteration-count-independent)",
+    "prop.sweeps": "window_cc-family fixed-point sweeps across every "
+    "consumer (cellcc finalize, halo merge, embed buckets) — the "
+    "shared convergence-depth figure the DBSCAN_PROP_UNIONFIND "
+    "single-pass union-find mode exists to collapse "
+    "(ops/propagation.py note_sweeps; labels are count-independent)",
     "spill.levels": "level-synchronous spill-tree build rounds run",
     "spill.level_dispatches": "fused level-build dispatches issued "
     "(one per level + the closing compact; bounded by tree depth, "
@@ -248,6 +254,9 @@ GAUGES = {
     "embed.sample_frac": "sampled-edge keep probability of the last "
     "embed run (1.0 = exact path) — the declared accuracy knob the "
     "analyzer's sampled-edge fraction reads back",
+    "prop.mode": "resolved propagation mode of the last settled "
+    "window_cc-family fixed point (1.0 = unionfind, 0.0 = iterated — "
+    "DBSCAN_PROP_UNIONFIND, ops/propagation.py note_sweeps)",
 }
 
 SPANS = {

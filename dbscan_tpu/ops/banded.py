@@ -427,14 +427,20 @@ def compiled_cellcc_unpack(n_cells_pad: int):
 
 
 @functools.lru_cache(maxsize=64)
-def compiled_cellcc_cc(engine: str, out_slots: int):
+def compiled_cellcc_cc(
+    engine: str, out_slots: int, prop_mode: str = "iterated",
+    warm: bool = False,
+):
     """Build the fused device finalize: cell CC + seeds + border algebra
     + valid-prefix compaction over ALL chunks, one dispatch.
 
     Args (per call): wintab [C, 25] int32 (-1 = unoccupied window slot),
     then per-chunk tuples — cellors/cellfolds (the unpack partials) and
-    cores/bitses/cells/folds (per-slot flat arrays, chunk order). The
-    label algebra is cellgraph.finalize_compact's, verbatim in int32:
+    cores/bitses/cells/folds (per-slot flat arrays, chunk order), and
+    ``labs`` — the per-chunk first-sweep label partials the fused
+    Pallas unpack emits (ops/pallas_banded.py; EMPTY tuple on the
+    split unpack path, ``warm`` says which was traced). The label
+    algebra is cellgraph.finalize_compact's, verbatim in int32:
     identical components (window_cc's min-index representative vs
     scipy's arbitrary numbering never matters — seeds are component-MIN
     folds, numbering-free), identical border adoption, so labels are
@@ -442,11 +448,16 @@ def compiled_cellcc_cc(engine: str, out_slots: int):
     seeds/flags in row-major prefix order (exactly the host finalize's
     flat per-group layout, concatenated), padded to the static
     ``out_slots`` ladder, plus the CC sweep count.
+
+    ``prop_mode`` ("unionfind"/"iterated") is part of the build key —
+    the propagation knob must mint a fresh trace, or an in-process
+    toggle (tests, the tuner) would silently reuse the other mode's
+    compiled loop.
     """
     naive = engine == "naive"
     inf = jnp.int32(_INT32_INF)
 
-    def cc(wintab, cellors, cellfolds, cores, bitses, cells, folds):
+    def cc(wintab, cellors, cellfolds, cores, bitses, cells, folds, labs):
         from dbscan_tpu.ops.propagation import window_cc
 
         c1 = wintab.shape[0]
@@ -457,7 +468,19 @@ def compiled_cellcc_cc(engine: str, out_slots: int):
         for f in cellfolds[1:]:
             cellfold = jnp.minimum(cellfold, f)
 
-        comp, iters = window_cc(cellor, wintab)
+        init = None
+        if warm and labs:
+            # per-chunk first-sweep partials merge elementwise: the full
+            # cell graph's first neighbor-min sweep is the min over each
+            # chunk's edge subset, so starting here is exactly "sweep 1
+            # already ran" — the fixed point (and labels) are unchanged,
+            # only the counted sweeps drop
+            init = labs[0]
+            for l in labs[1:]:
+                init = jnp.minimum(init, l)
+        comp, iters = window_cc(
+            cellor, wintab, mode=prop_mode, init=init
+        )
         # seed per component = min cell fold over member cells; comp is
         # the component-min cell index, so one scatter-min + one gather
         rootmin = (

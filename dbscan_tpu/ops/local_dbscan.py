@@ -60,7 +60,9 @@ class LocalResult(NamedTuple):
     counts: jnp.ndarray
 
 
-def _components_min_label(adj_cc: jnp.ndarray, core: jnp.ndarray) -> jnp.ndarray:
+def _components_min_label(
+    adj_cc: jnp.ndarray, core: jnp.ndarray, mode: str = None
+) -> jnp.ndarray:
     """Min-row-index label per connected component of the core-core adjacency
     (the "seed index"); non-core rows hold SEED_NONE throughout."""
     n = core.shape[0]
@@ -71,12 +73,9 @@ def _components_min_label(adj_cc: jnp.ndarray, core: jnp.ndarray) -> jnp.ndarray
     def neighbor_min(labels):
         return jnp.min(jnp.where(adj_cc, labels[None, :], none), axis=1)
 
-    return min_label_fixed_point(init, neighbor_min)
+    return min_label_fixed_point(init, neighbor_min, mode=mode)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("min_points", "engine", "metric", "use_pallas")
-)
 def local_dbscan(
     points: jnp.ndarray,
     mask: jnp.ndarray,
@@ -85,6 +84,7 @@ def local_dbscan(
     engine: str = "naive",
     metric: str = "euclidean",
     use_pallas: bool = False,
+    mode: str = None,
 ) -> LocalResult:
     """Cluster one (padded) partition.
 
@@ -100,9 +100,45 @@ def local_dbscan(
       use_pallas: route the adjacency sweeps through the streaming Pallas
         kernels (O(N) memory, euclidean 2-D only) instead of the
         materialized [N, N] XLA form (static).
+      mode: propagation mode (ops/propagation.py; None resolves
+        DBSCAN_PROP_UNIONFIND) — resolved HERE, before the jit below, so
+        an in-process knob flip mints a fresh trace instead of reusing
+        the other mode's compiled loop.
 
     Returns a :class:`LocalResult` of [N] arrays.
     """
+    from dbscan_tpu.ops.propagation import prop_mode
+
+    return _local_dbscan_jit(
+        points, mask, eps, min_points, engine, metric, use_pallas,
+        prop_mode(mode),
+    )
+
+
+# the jit cache surface stays reachable through the public name: the
+# compile accounting (obs/compile.py tracked_call) and the streaming
+# zero-recompile pins read fn._cache_size() off whatever they dispatch
+def _local_cache_size():
+    return _local_dbscan_jit._cache_size()
+
+
+local_dbscan._cache_size = _local_cache_size
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("min_points", "engine", "metric", "use_pallas", "mode"),
+)
+def _local_dbscan_jit(
+    points: jnp.ndarray,
+    mask: jnp.ndarray,
+    eps: float,
+    min_points: int,
+    engine: str,
+    metric: str,
+    use_pallas: bool,
+    mode: str,
+) -> LocalResult:
     if engine not in ("naive", "archery"):
         raise ValueError(f"unknown engine {engine!r}")
     n = points.shape[0]
@@ -120,7 +156,7 @@ def local_dbscan(
         from dbscan_tpu.ops.pallas_kernel import pallas_engine
 
         counts, core, comp, core_nbr_seed = pallas_engine(
-            points, mask, eps, min_points
+            points, mask, eps, min_points, mode=mode
         )
     else:
         m = dist_mod.get_metric(metric)
@@ -131,13 +167,17 @@ def local_dbscan(
         # euclidean/cosine (measure 0 at the diagonal) but made explicit so
         # counts are self-inclusive under any registered metric.
         adj = adj | (jnp.eye(n, dtype=bool) & mask[:, None])
-        return cluster_from_adjacency(adj, mask, min_points, engine)
+        return cluster_from_adjacency(adj, mask, min_points, engine, mode)
 
     return _finalize(mask, core, comp, core_nbr_seed, counts, engine)
 
 
 def cluster_from_adjacency(
-    adj: jnp.ndarray, mask: jnp.ndarray, min_points: int, engine: str
+    adj: jnp.ndarray,
+    mask: jnp.ndarray,
+    min_points: int,
+    engine: str,
+    mode: str = None,
 ) -> LocalResult:
     """Full DBSCAN labeling from a materialized [N, N] eps-adjacency.
 
@@ -145,7 +185,8 @@ def cluster_from_adjacency(
     path above, and external adjacency builders (e.g. the sparse TF-IDF
     gram pipeline in :mod:`dbscan_tpu.ops.sparse`). ``adj`` must already be
     masked (no true entries on invalid rows/cols) and self-inclusive on
-    valid rows.
+    valid rows. Cached/jitted callers pass their resolved propagation
+    ``mode`` so it rides their trace key; eager callers may leave None.
     """
     if engine not in ("naive", "archery"):
         raise ValueError(f"unknown engine {engine!r}")
@@ -154,7 +195,7 @@ def cluster_from_adjacency(
     core = (counts >= jnp.int32(min_points)) & mask
 
     adj_cc = adj & core[None, :] & core[:, None]
-    comp = _components_min_label(adj_cc, core)
+    comp = _components_min_label(adj_cc, core, mode)
 
     # Min seed index among eps-adjacent cores (for cores: own component).
     core_nbr_seed = jnp.min(

@@ -50,11 +50,13 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dbscan_tpu import config as config_mod
 from dbscan_tpu.ops.banded import _slab_chunks
-from dbscan_tpu.parallel.binning import BANDED_BLOCK, BANDED_ROWS
+from dbscan_tpu.parallel.binning import BANDED_BLOCK, BANDED_ROWS, BANDED_WIN
 
 
 def _interpret() -> bool:
@@ -326,3 +328,182 @@ def banded_phase1_pallas(
     )
 
     return counts, core, bits.reshape(-1)
+
+
+# --- fused cellcc unpack + fold + first propagation sweep ---------------
+#
+# The device cellcc finalize used to be TWO families: a per-chunk
+# `cellcc.unpack` (big-endian bit unpack of the packed postpass slabs +
+# scatter-fold into per-cell partials, ops/banded.py
+# compiled_cellcc_unpack) and the tail `cellcc.cc` (the iterated
+# window_cc propagation from identity labels). `cellcc.fused` merges the
+# unpack, the fold, AND the first propagation sweep into the per-chunk
+# dispatch riding the packing window: the bit expansions (the
+# np.unpackbits analog — pure elementwise shift/mask work) run as Pallas
+# kernels, while the scatter-folds and the folded first sweep stay XLA
+# in the SAME jitted dispatch — exactly the split this module's phase-1
+# kernels already use (Mosaic's tiling rules make data-dependent
+# scatters hostile, the slab-gather rationale in the module docstring),
+# so nothing round-trips HBM between unpack, fold, and sweep.
+#
+# The folded sweep: ``lab0[c] = min(c, min over this chunk's cellor
+# edges of wintab[c, j])`` is the chunk-restricted first neighbor-min
+# relaxation from identity labels. The full graph's first sweep is the
+# elementwise min over chunks of these partials (cellor_full = OR of
+# chunk cellors), so the tail `cellcc.cc` starts from "sweep 1 already
+# ran" — same fixed point, byte-identical labels, one fewer counted
+# sweep (compiled_cellcc_cc's ``warm`` path). DBSCAN_CELLCC_FUSED
+# gates it: auto = Pallas-capable (TPU) backends, 1 forces interpreter
+# mode (how the CPU suite pins bit-exactness), 0 keeps the split pair.
+# DBSCAN_CELLCC_DEVICE semantics — fault site, degrade ladder,
+# residency cap — are untouched: the fused dispatch stages the same
+# record fields and degrades through the same paths.
+
+#: packed bytes per fused-unpack grid step (512 core bits each — one
+#: SCAN_BLOCK; M is a SCAN_BLOCK multiple, so the grid always divides)
+_UNPACK_BYTES = 64
+
+#: or-scan values per fused-expand grid step (the or_gid pad ladder is
+#: 4096-based — binning._ladder_width multiples of 128 — so 128 always
+#: divides the padded K)
+_UNPACK_ORV = 128
+
+
+def fused_mode(raw=None) -> bool:
+    """Resolve ``DBSCAN_CELLCC_FUSED``: True routes the per-chunk cellcc
+    unpack through :func:`compiled_cellcc_fused`. ``auto`` engages only
+    on Pallas-capable (TPU) backends — the fused family's win is the
+    merged dispatch in the packing window; CPU runs keep the split
+    unpack/cc pair unless forced ('1'), which runs the kernels in
+    interpreter mode (the bit-exactness test path)."""
+    if raw is None:
+        raw = str(config_mod.env("DBSCAN_CELLCC_FUSED") or "auto")
+    raw = raw.strip().lower()
+    if raw in ("1", "true", "yes", "on"):
+        return True
+    if raw in ("0", "false", "off", "no"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _unpack_core_kernel(bytes_ref, out_ref):
+    """[B8] packed bytes -> [B8, 8] bits (np.unpackbits-compatible
+    big-endian order: bit 7 of byte i lands at out[i, 0]). Everything
+    int32-strict: interpret mode under x64 rejects a mixed-width store."""
+    b = bytes_ref[0, 0, :]
+    shifts = jnp.int32(7) - jax.lax.broadcasted_iota(
+        jnp.int32, (_UNPACK_BYTES, 8), 1
+    )
+    out_ref[0] = (b[:, None] >> shifts) & jnp.int32(1)
+
+
+def _unpack_orv_kernel(orv_ref, out_ref):
+    """[KB] gathered segmented-OR scan values -> [KB, 25] window-slot
+    bits (the per-cell OR mask expansion the scatter-fold consumes)."""
+    v = orv_ref[0, 0, :]
+    win = jax.lax.broadcasted_iota(
+        jnp.int32, (_UNPACK_ORV, BANDED_WIN), 1
+    )
+    out_ref[0] = (v[:, None] >> win) & jnp.int32(1)
+
+
+@functools.lru_cache(maxsize=64)
+def compiled_cellcc_fused(n_cells_pad: int):
+    """Build (once per padded cell count) the fused per-chunk dispatch:
+    (combo, cell_flat, fold_flat, or_gid, wintab) -> (core [M] bool,
+    cellor [C, 25] bool, cellfold [C] i32, lab0 [C] i32), all
+    device-resident — the drop-in replacement for
+    ops/banded.py::compiled_cellcc_unpack that additionally emits the
+    chunk's first-sweep label partial (module comment above).
+
+    Input contract is compiled_cellcc_unpack's, plus the padded wintab
+    ([C, 25] int32, -1 at unoccupied slots — the same table the tail cc
+    receives; the driver uploads it once and shares the handle)."""
+    sentinel = jnp.int32(n_cells_pad - 1)
+    inf = jnp.int32(2**31 - 1)
+
+    def fused(combo, cell_flat, fold_flat, or_gid, wintab):
+        m = cell_flat.shape[0]
+        m8 = m // 8
+        interp = _interpret()
+
+        # Pallas leg 1: packed core bytes -> bits ([rows, B8] bytes ->
+        # [rows, B8, 8] bits; the (B8, 8) block passes Mosaic's
+        # last-two-dims rule by dimension equality)
+        rows = m8 // _UNPACK_BYTES
+        byte32 = combo[:m8].astype(jnp.int32)
+        core_bits = pl.pallas_call(
+            _unpack_core_kernel,
+            grid=(rows,),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, _UNPACK_BYTES), lambda i: (i, 0, 0)
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, _UNPACK_BYTES, 8), lambda i: (i, 0, 0)
+            ),
+            out_shape=jax.ShapeDtypeStruct(
+                (rows, _UNPACK_BYTES, 8), jnp.int32
+            ),
+            interpret=interp,
+        )(byte32.reshape(rows, 1, _UNPACK_BYTES))
+        core = core_bits.reshape(-1).astype(bool)
+
+        # Pallas leg 2: gathered scan values -> [K, 25] window bits
+        k = or_gid.shape[0]
+        orvals = lax.bitcast_convert_type(
+            combo[m8 : m8 + 4 * k].reshape(k, 4), jnp.int32
+        )
+        rows_k = k // _UNPACK_ORV
+        unp = pl.pallas_call(
+            _unpack_orv_kernel,
+            grid=(rows_k,),
+            in_specs=[
+                pl.BlockSpec((1, 1, _UNPACK_ORV), lambda i: (i, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, _UNPACK_ORV, BANDED_WIN), lambda i: (i, 0, 0)
+            ),
+            out_shape=jax.ShapeDtypeStruct(
+                (rows_k, _UNPACK_ORV, BANDED_WIN), jnp.int32
+            ),
+            interpret=interp,
+        )(orvals.reshape(rows_k, 1, _UNPACK_ORV)).reshape(
+            k, BANDED_WIN
+        )
+
+        # XLA folds (data-dependent scatters — the Mosaic-hostile part,
+        # same split as the phase-1 slab gathers), fused into THIS
+        # dispatch: per-cell OR partial + min-core-fold partial,
+        # byte-identical to compiled_cellcc_unpack's
+        cellor = (
+            jnp.zeros((n_cells_pad, BANDED_WIN), jnp.int32)
+            .at[or_gid]
+            .max(unp, mode="drop")
+            .astype(bool)
+        )
+        # padded or_gid positions gather REAL scan values into the
+        # sentinel row: clear it (same phantom-adjacency note as the
+        # split unpack — the gated sweep counts must track the graph)
+        cellor = cellor.at[n_cells_pad - 1].set(False)
+        valid = cell_flat != sentinel
+        folds = jnp.where(core & valid, fold_flat, inf)
+        cellfold = (
+            jnp.full((n_cells_pad,), 2**31 - 1, jnp.int32)
+            .at[cell_flat]
+            .min(folds, mode="drop")
+        )
+
+        # the folded first propagation sweep (chunk-restricted
+        # neighbor-min relaxation from identity labels): bits are only
+        # set where an adjacent core exists, so wintab >= 0 wherever
+        # cellor is True — the clip only disciplines masked junk
+        tab = jnp.clip(wintab, 0, n_cells_pad - 1)
+        nbr = jnp.min(jnp.where(cellor, tab, inf), axis=1)
+        lab0 = jnp.minimum(
+            jnp.arange(n_cells_pad, dtype=jnp.int32), nbr
+        )
+        return core, cellor, cellfold, lab0
+
+    return jax.jit(fused)

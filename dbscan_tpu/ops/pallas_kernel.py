@@ -212,8 +212,17 @@ def neighbor_min_label(
     return out[:n, 0]
 
 
-@functools.partial(jax.jit, static_argnames=("min_points",))
-def pallas_engine(points, mask, eps, min_points):
+def pallas_engine(points, mask, eps, min_points, mode=None):
+    """Resolve the propagation mode (ops/propagation.py) BEFORE the jit
+    so an in-process DBSCAN_PROP_UNIONFIND flip mints a fresh trace —
+    see :func:`_pallas_engine_jit` for the engine itself."""
+    from dbscan_tpu.ops.propagation import prop_mode
+
+    return _pallas_engine_jit(points, mask, eps, min_points, prop_mode(mode))
+
+
+@functools.partial(jax.jit, static_argnames=("min_points", "mode"))
+def _pallas_engine_jit(points, mask, eps, min_points, mode):
     """counts / core / component seeds via the streaming sweeps.
 
     Returns (counts [N] i32, core [N] bool, comp [N] i32 — component seed on
@@ -239,7 +248,7 @@ def pallas_engine(points, mask, eps, min_points):
     def neighbor_min(labels):
         return neighbor_min_label(points, mask, core, labels, eps2)
 
-    final = min_label_fixed_point(init, neighbor_min)
+    final = min_label_fixed_point(init, neighbor_min, mode=mode)
 
     comp = jnp.where(core, final, none)
     core_nbr_seed = final

@@ -2,31 +2,96 @@
 
 Both local-engine backends (materialized XLA adjacency and streaming Pallas
 sweeps) find connected components by the same iteration: masked neighbor-min
-propagation plus one pointer jump per step inside ``lax.while_loop``. Only
-the neighbor-min computation differs, so the convergence harness lives here
+propagation plus pointer jumping inside ``lax.while_loop``. Only the
+neighbor-min computation differs, so the convergence harness lives here
 once. Invariants: labels only decrease; a core row's label is always a core
 row index inside its own component and <= its own index; the fixed point is
 the component minimum — the "seed index" (the fold index of the point that
 would have seeded the cluster in the reference's sequential scan,
 LocalDBSCANNaive.scala:45-64).
+
+Two propagation modes share the harness (``DBSCAN_PROP_UNIONFIND``):
+
+- **iterated** (the original path, the parity oracle): one neighbor-min
+  sweep + ONE pointer jump per step — O(log diameter) steps, each paying
+  a full sweep (the expensive part: backends recompute their masked
+  distance tests inside it).
+- **unionfind** (default via ``auto``): the single-pass lock-free
+  union-find structure of "Theoretically-Efficient and Practical
+  Parallel DBSCAN" (arXiv:1912.06255) mapped onto the same monotone
+  min-label lattice — each step runs the neighbor-min EDGE RELAXATION,
+  a scatter-min push of the freshly relaxed labels back along the edges
+  (pull-then-push = two hops per sweep on the symmetric relation), and
+  ``_UF_JUMPS`` aggressive pointer-doubling jumps. Chains that cost the
+  iterated path ~log2(diameter) sweeps collapse to a small constant.
+
+The two modes reach the SAME fixed point — labels are a monotone
+decreasing sequence bounded below by the component minimum, and any
+label above it still has a decreasing edge/jump — so final labels are
+byte-identical; only the gated sweep counts (``prop.sweeps``,
+``cellcc.cc_iters``, ``halo.rounds``) move. PARITY.md "Propagation
+contract" is the written form of this invariant.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax.numpy as jnp
 from jax import lax
 
+from dbscan_tpu import config
 from dbscan_tpu.ops.labels import SEED_NONE
 
-# Pointer jumps per neighbor-min sweep. A 1-D arbitrary-index gather on
-# TPU runs at ~40M elements/s (scalar-loop lowering) — ~a third of a full
-# neighbor-min sweep at bench densities — so extra jumps per sweep COST
-# more than the sweeps they save (4 unrolled jumps: +64% device time;
-# jump-to-convergence inner loop: +21%; both measured on v5e at 10M
-# points). One jump (the classic pointer-doubling step) is the optimum.
+# Pointer jumps per neighbor-min sweep on the ITERATED path. A 1-D
+# arbitrary-index gather on TPU runs at ~40M elements/s (scalar-loop
+# lowering) — ~a third of a full neighbor-min sweep at bench densities —
+# so extra jumps per sweep COST more than the sweeps they save (4
+# unrolled jumps: +64% device time; jump-to-convergence inner loop:
+# +21%; both measured on v5e at 10M points). One jump (the classic
+# pointer-doubling step) is the optimum for the POINT-graph engines that
+# measurement covered.
 _COMPRESS_JUMPS = 1
+
+# Pointer jumps per sweep on the UNION-FIND path. The consumers that
+# ride it (cell graph, halo node graph, embed window tables) are one to
+# two orders of magnitude smaller than the point graphs the
+# _COMPRESS_JUMPS measurement covered, so the jump gathers are cheap
+# relative to the sweep they amortize — and each ELIMINATED sweep saves
+# a full [N, W] relaxation pass. 4 jumps compress 16-hop chains per
+# sweep; combined with the pull+push double hop, sweep counts collapse
+# to a small constant (the arXiv:1912.06255 observation).
+_UF_JUMPS = 4
+
+
+def prop_mode(raw: Optional[str] = None) -> str:
+    """Resolve ``DBSCAN_PROP_UNIONFIND`` (or an explicit ``raw``
+    override) to ``"unionfind"`` | ``"iterated"``. ``auto`` routes to
+    union-find: the sweep collapse is structural (it is what the gated
+    ``*_prop_sweeps`` counts prove on any backend), and the iterated
+    path stays one knob away as the parity oracle."""
+    if raw is None:
+        raw = str(config.env("DBSCAN_PROP_UNIONFIND") or "auto")
+    raw = raw.strip().lower()
+    if raw in ("0", "false", "off", "no", "iterated"):
+        return "iterated"
+    return "unionfind"
+
+
+def note_sweeps(sweeps: int, mode: Optional[str] = None) -> None:
+    """Host-side telemetry for one settled ``window_cc``-family fixed
+    point: accumulate the data-dependent sweep count and publish the
+    resolved mode (gauge 1.0 = unionfind, 0.0 = iterated) — the shared
+    emission every consumer (cellcc finalize, halo merge, embed
+    buckets) funnels its pulled iteration counts through, so leg-1's
+    win is measured everywhere ``window_cc`` runs."""
+    from dbscan_tpu import obs
+
+    obs.count("prop.sweeps", int(sweeps))
+    obs.gauge(
+        "prop.mode",
+        1.0 if (mode or prop_mode()) == "unionfind" else 0.0,
+    )
 
 
 def min_label_fixed_point(
@@ -34,6 +99,8 @@ def min_label_fixed_point(
     neighbor_min: Callable[[jnp.ndarray], jnp.ndarray],
     pos_of_label: jnp.ndarray | None = None,
     with_iters: bool = False,
+    mode: Optional[str] = None,
+    scatter_relax: Optional[Callable] = None,
 ) -> jnp.ndarray:
     """Iterate ``labels -> min(labels, neighbor_min(labels), hop)`` to a fixed
     point.
@@ -48,14 +115,22 @@ def min_label_fixed_point(
       arrays live in cell-sorted order). None means values ARE positions.
     with_iters: also return the number of neighbor-min sweeps the loop ran
       (an int32 scalar, data-dependent) — the convergence-depth figure the
-      device cellcc finalize reports as ``cellcc.cc_iters``.
+      device cellcc finalize reports as ``cellcc.cc_iters`` and every
+      consumer funnels into ``prop.sweeps``.
+    mode: "unionfind" | "iterated" | None (resolve the knob at trace
+      time). Builders that lru-cache their jits must resolve the mode
+      BEFORE their cache key (cellcc/embed/halo do), since a traced
+      function latches whatever mode it was traced under.
+    scatter_relax: optional labels -> labels scatter-min push (the
+      union-find edge relaxation's other direction); only invoked in
+      unionfind mode. Consumers with an explicit edge/window table
+      supply it (``window_cc``); pull-only consumers (dense adjacency)
+      leave it None and still get the aggressive jumps.
 
-    Each step runs one neighbor-min sweep (the expensive part — the
-    backends recompute their masked distance tests inside it) followed by
-    ``_COMPRESS_JUMPS`` pointer jumps (chain-collapsing ``new[new]``
-    gathers), keeping iteration count O(log diameter) instead of
-    O(diameter) for chain-shaped clusters — see the constant's comment for
-    why more jumps per sweep do not pay on TPU.
+    Each step runs one neighbor-min sweep (the expensive part) followed
+    by the mode's pointer jumps — ``_COMPRESS_JUMPS`` chain-collapsing
+    ``new[new]`` gathers on the iterated path, pull+push relaxation plus
+    ``_UF_JUMPS`` jumps on the union-find path (see the constants).
 
     The loop is hard-capped at n iterations: labels strictly decrease while
     unconverged, so n steps always suffice — and the cap guarantees the
@@ -65,13 +140,15 @@ def min_label_fixed_point(
     """
     n = init.shape[0]
     none = jnp.int32(SEED_NONE)
+    mode = prop_mode(mode)
+    jumps = _UF_JUMPS if mode == "unionfind" else _COMPRESS_JUMPS
 
     def pos(labels):
         safe = jnp.clip(labels, 0, n - 1)
         return pos_of_label[safe] if pos_of_label is not None else safe
 
     def compress(labels):
-        for _ in range(_COMPRESS_JUMPS):
+        for _ in range(jumps):
             hop = jnp.where(labels == none, none, labels[pos(labels)])
             labels = jnp.minimum(labels, hop)
         return labels
@@ -82,7 +159,14 @@ def min_label_fixed_point(
 
     def body(state):
         labels, _, it = state
-        new = compress(jnp.minimum(labels, neighbor_min(labels)))
+        new = jnp.minimum(labels, neighbor_min(labels))
+        if mode == "unionfind" and scatter_relax is not None:
+            # push the freshly pulled labels back along the edges
+            # (scatter-min): with the pull above this makes each sweep a
+            # two-hop relaxation — new already carries the pulled
+            # minima, so the push forwards them another hop
+            new = jnp.minimum(new, scatter_relax(new))
+        new = compress(new)
         return new, jnp.any(new != labels), it + 1
 
     # One unrolled body step first: the while_loop carry must be
@@ -98,6 +182,8 @@ def min_label_fixed_point(
 def window_cc(
     adj_mask: jnp.ndarray,
     neighbor_tab: jnp.ndarray,
+    mode: Optional[str] = None,
+    init: jnp.ndarray | None = None,
 ) -> tuple:
     """Connected components of a windowed adjacency table, on device.
 
@@ -107,21 +193,50 @@ def window_cc(
       relation — core-core eps-adjacency is, see ops/banded.py).
     neighbor_tab: [N, W] int32 neighbor index per window slot (junk at
       masked-off slots is fine; gathers are clipped, values masked).
+    mode: propagation mode ("unionfind"/"iterated"/None = resolve the
+      knob at trace time; cached builders pass it explicitly so their
+      jit keys carry it).
+    init: optional [N] int32 warm-start labels — already-relaxed
+      partials (the fused Pallas unpack folds the FIRST sweep per
+      chunk, ops/pallas_banded.py); the identity labels are min-merged
+      in, so any monotone partial is a valid warm start and the fixed
+      point is unchanged.
 
     Returns ``(comp [N] int32, iters int32)``: per-row component-minimum
     row index (the same component sets scipy's connected_components
     finds on the host — component NUMBERING differs, the min-index
     representative does not) and the sweep count. This is the shared CC
     kernel of the device cellcc finalize (cellgraph.finalize_device);
-    streaming micro-batches reuse it through the same driver path.
+    streaming micro-batches and the embed buckets reuse it through the
+    same driver paths.
     """
     n = adj_mask.shape[0]
     none = jnp.int32(SEED_NONE)
     tab = jnp.clip(neighbor_tab, 0, n - 1)
+    mode = prop_mode(mode)
 
     def neighbor_min(labels):
         return jnp.min(jnp.where(adj_mask, labels[tab], none), axis=1)
 
+    scatter_relax = None
+    if mode == "unionfind":
+        # masked-off slots scatter out of range and drop: the push is
+        # exactly the edge set the pull reads, no phantom adjacency
+        push_tab = jnp.where(adj_mask, tab, jnp.int32(n))
+
+        def scatter_relax(labels):
+            return labels.at[push_tab].min(
+                jnp.broadcast_to(labels[:, None], push_tab.shape),
+                mode="drop",
+            )
+
+    start = jnp.arange(n, dtype=jnp.int32)
+    if init is not None:
+        start = jnp.minimum(start, init)
     return min_label_fixed_point(
-        jnp.arange(n, dtype=jnp.int32), neighbor_min, with_iters=True
+        start,
+        neighbor_min,
+        with_iters=True,
+        mode=mode,
+        scatter_relax=scatter_relax,
     )

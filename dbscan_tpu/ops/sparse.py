@@ -172,23 +172,46 @@ def sparse_cosine_gram(x_csr, feature_block: int = FEATURE_BLOCK) -> jnp.ndarray
     return _gram_unit(_normalize_rows(x_csr)[0], feature_block)
 
 
-def _cluster_gram_body(gram, eps, mask, min_points: int, engine: str) -> LocalResult:
+def _cluster_gram_body(
+    gram, eps, mask, min_points: int, engine: str, mode: str = None
+) -> LocalResult:
     n = gram.shape[0]
     dist = 1.0 - gram
     adj = dist <= eps
     adj = adj | jnp.eye(n, dtype=bool)  # self-inclusive regardless of eps
     adj = adj & (mask[None, :] & mask[:, None])  # padding rows inert
-    return cluster_from_adjacency(adj, mask, min_points, engine)
+    return cluster_from_adjacency(adj, mask, min_points, engine, mode)
 
 
-@functools.partial(jax.jit, static_argnames=("min_points", "engine"))
 def _cluster_gram(gram, eps, mask, min_points: int, engine: str) -> LocalResult:
-    return _cluster_gram_body(gram, eps, mask, min_points, engine)
+    # propagation mode resolved BEFORE the jit key (ops/propagation.py
+    # contract for cached builders): an in-process knob flip re-traces
+    from dbscan_tpu.ops.propagation import prop_mode
+
+    return _cluster_gram_jit(gram, eps, mask, min_points, engine, prop_mode())
+
+
+@functools.partial(jax.jit, static_argnames=("min_points", "engine", "mode"))
+def _cluster_gram_jit(
+    gram, eps, mask, min_points: int, engine: str, mode: str
+) -> LocalResult:
+    return _cluster_gram_body(gram, eps, mask, min_points, engine, mode)
+
+
+def _compiled_leaf_batch(
+    w: int, feature_block: int, min_points: int, engine: str, mesh
+):
+    from dbscan_tpu.ops.propagation import prop_mode
+
+    return _compiled_leaf_batch_cached(
+        w, feature_block, min_points, engine, mesh, prop_mode()
+    )
 
 
 @functools.lru_cache(maxsize=64)
-def _compiled_leaf_batch(
-    w: int, feature_block: int, min_points: int, engine: str, mesh
+def _compiled_leaf_batch_cached(
+    w: int, feature_block: int, min_points: int, engine: str, mesh,
+    mode: str,
 ):
     """Jitted mesh-sharded executor for a batch of SAME-WIDTH sparse
     leaves: [K, nb, mn] packed-CSR scan inputs -> per-leaf gram ->
@@ -210,7 +233,7 @@ def _compiled_leaf_batch(
             gram = _gram_scan(
                 r, c, v, w, feature_block, varying_axes=axes
             )
-            res = _cluster_gram_body(gram, eps, m, min_points, engine)
+            res = _cluster_gram_body(gram, eps, m, min_points, engine, mode)
             return res.seed_labels, res.flags
 
         seeds, flags = lax.map(one, (rows, cols, vals, mask))

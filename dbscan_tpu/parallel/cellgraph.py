@@ -189,32 +189,46 @@ def finalize_device(
     wintab_dev,
     engine: str,
     out_slots: int,
+    prop_mode: str = None,
 ):
     """Dispatch the fused device finalize (ops/banded.py
     ``compiled_cellcc_cc``) over the staged per-chunk device artifacts:
-    cell CC (iterated min-label propagation + pointer jumping,
+    cell CC (the shared min-label fixed point — iterated, or the
+    single-pass union-find variant per ``DBSCAN_PROP_UNIONFIND``,
     ops/propagation.py ``window_cc``), component seeds, border algebra,
     and valid-prefix compaction — one ``cellcc.cc`` dispatch for the
-    whole run, after one ``cellcc.unpack`` per chunk folded the packed
-    slabs into per-cell partials at flush time.
+    whole run, after one ``cellcc.unpack`` (or fused ``cellcc.fused``,
+    ops/pallas_banded.py) per chunk folded the packed slabs into
+    per-cell partials at flush time.
 
     ``dev_chunks``: per chunk, the dict staged by the driver —
     ``cellor``/``cellfold`` (unpack partials), ``core`` (unpacked core
-    mask), ``cells``/``folds`` (uploaded flat metadata) and ``bits``
-    (the resident phase-1 bitmasks). Returns the DEVICE handles
-    ``(seeds [out_slots] int32, flags [out_slots] int8, iters)`` — the
-    caller owns the pull (pipelined, supervised) and the per-group
-    split (:func:`split_device_labels`); labels are byte-identical to
-    :func:`finalize_compact` (see PARITY.md "Cellcc finalize").
+    mask), ``cells``/``folds`` (uploaded flat metadata), ``bits`` (the
+    resident phase-1 bitmasks), and optionally ``lab0`` (the fused
+    path's first-sweep label partial — present on ALL chunks or used on
+    none: a warm start from a partial first sweep would still converge
+    to the same labels, but the counted sweeps would depend on the
+    chunk mix). Returns the DEVICE handles ``(seeds [out_slots] int32,
+    flags [out_slots] int8, iters)`` — the caller owns the pull
+    (pipelined, supervised) and the per-group split
+    (:func:`split_device_labels`); labels are byte-identical to
+    :func:`finalize_compact` (see PARITY.md "Cellcc finalize" and
+    "Propagation contract").
     """
     if engine not in ("naive", "archery"):
         raise ValueError(f"unknown engine {engine!r}")
     from dbscan_tpu.obs import compile as obs_compile
     from dbscan_tpu.ops.banded import compiled_cellcc_cc
+    from dbscan_tpu.ops import propagation as prop_mod
 
+    mode = prop_mod.prop_mode(prop_mode)
+    warm = all("lab0" in c for c in dev_chunks) and bool(dev_chunks)
+    labs = (
+        tuple(c["lab0"] for c in dev_chunks) if warm else ()
+    )
     return obs_compile.tracked_call(
         "cellcc.cc",
-        compiled_cellcc_cc(engine, out_slots),
+        compiled_cellcc_cc(engine, out_slots, mode, warm),
         wintab_dev,
         tuple(c["cellor"] for c in dev_chunks),
         tuple(c["cellfold"] for c in dev_chunks),
@@ -222,6 +236,7 @@ def finalize_device(
         tuple(c["bits"] for c in dev_chunks),
         tuple(c["cells"] for c in dev_chunks),
         tuple(c["folds"] for c in dev_chunks),
+        labs,
     )
 
 

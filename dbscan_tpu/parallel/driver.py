@@ -105,6 +105,28 @@ if _COMPACT_CHUNK_SLOTS != _requested_chunk_slots:
 # fault flushes the current compact chunk before raising, so even the
 # abort path resumes from the last completed group.
 _INFLIGHT_SLOTS = int(config_mod.env("DBSCAN_INFLIGHT_SLOTS"))
+_IMPORT_INFLIGHT_SLOTS = _INFLIGHT_SLOTS
+
+
+def _live_chunk_slots() -> int:
+    """Per-run resolution of DBSCAN_COMPACT_CHUNK_SLOTS. The module
+    attribute stays the latch (and the tests' monkeypatch surface),
+    but the autotuner and an applied config.Profile set knobs
+    IN-PROCESS after this module imported — when the live env/profile
+    value moved from the import-time read, it wins (same clamp)."""
+    req = int(config_mod.env("DBSCAN_COMPACT_CHUNK_SLOTS"))
+    if req == _requested_chunk_slots:
+        return _COMPACT_CHUNK_SLOTS
+    return min(1 << 28, max(1 << 16, req))
+
+
+def _live_inflight_slots() -> int:
+    """Per-run resolution of DBSCAN_INFLIGHT_SLOTS (same contract as
+    :func:`_live_chunk_slots`)."""
+    req = int(config_mod.env("DBSCAN_INFLIGHT_SLOTS"))
+    if req == _IMPORT_INFLIGHT_SLOTS:
+        return _INFLIGHT_SLOTS
+    return req
 
 # Widest bucket the dense engine may materialize
 # (binning.DENSE_MAX_BUCKET — NOT the spatial routing threshold, which is
@@ -199,15 +221,14 @@ def clear_compile_cache() -> None:
     """Drop all cached jitted executors (and the Mesh objects and XLA
     executables they retain). For long-lived processes sweeping many
     configurations or meshes."""
-    _compiled_block.cache_clear()
-    _compiled_block_resident.cache_clear()
+    _compiled_block_cached.cache_clear()
+    _compiled_block_resident_cached.cache_clear()
     _compiled_banded_p1.cache_clear()
-    from dbscan_tpu.ops.sparse import _compiled_leaf_batch
+    from dbscan_tpu.ops.sparse import _compiled_leaf_batch_cached
 
-    _compiled_leaf_batch.cache_clear()
+    _compiled_leaf_batch_cached.cache_clear()
 
 
-@functools.lru_cache(maxsize=256)
 def _compiled_block(
     eps: float,
     min_points: int,
@@ -216,6 +237,27 @@ def _compiled_block(
     use_pallas: bool,
     batch: Optional[int],
     mesh,
+):
+    # propagation mode resolved BEFORE the cache key (ops/propagation.py
+    # contract for cached builders): an in-process knob flip re-traces
+    from dbscan_tpu.ops.propagation import prop_mode
+
+    return _compiled_block_cached(
+        eps, min_points, engine, metric, use_pallas, batch, mesh,
+        prop_mode(),
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_block_cached(
+    eps: float,
+    min_points: int,
+    engine: str,
+    metric: str,
+    use_pallas: bool,
+    batch: Optional[int],
+    mesh,
+    mode: str,
 ):
     """Build (once per distinct config+mesh) the jitted per-group executor.
 
@@ -236,6 +278,7 @@ def _compiled_block(
             engine=engine,
             metric=metric,
             use_pallas=use_pallas,
+            mode=mode,
         )
         return r.seed_labels, r.flags
 
@@ -350,7 +393,6 @@ def _banded_batch(group, mesh) -> int:
     return max(1, min(8, mem_cap, p_total // max(1, mesh_size(mesh))))
 
 
-@functools.lru_cache(maxsize=256)
 def _compiled_block_resident(
     eps: float,
     min_points: int,
@@ -358,6 +400,24 @@ def _compiled_block_resident(
     metric: str,
     batch: Optional[int],
     mesh,
+):
+    # propagation mode resolved BEFORE the cache key, as _compiled_block
+    from dbscan_tpu.ops.propagation import prop_mode
+
+    return _compiled_block_resident_cached(
+        eps, min_points, engine, metric, batch, mesh, prop_mode()
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_block_resident_cached(
+    eps: float,
+    min_points: int,
+    engine: str,
+    metric: str,
+    batch: Optional[int],
+    mesh,
+    mode: str,
 ):
     """Resident-payload variant of :func:`_compiled_block`: the full
     [N, D] row array (bf16, uploaded ONCE by the spill phase) stays on
@@ -379,6 +439,7 @@ def _compiled_block_resident(
                 engine=engine,
                 metric=metric,
                 use_pallas=False,
+                mode=mode,
             )
             return r.seed_labels, r.flags
 
@@ -810,6 +871,18 @@ def _group_bytes(g) -> int:
     )
     writes = p_g * b_g * (4 + 1 + 4)
     return reads + writes
+
+
+def _resolved_prop_mode(cellcc_dev: dict) -> str:
+    """The propagation mode the run's stats report: the per-run latch
+    when the device finalize resolved one, else the live knob (host-
+    oracle and dense runs still say which mode their window_cc-family
+    fixed points would ride)."""
+    if cellcc_dev.get("prop_mode"):
+        return str(cellcc_dev["prop_mode"])
+    from dbscan_tpu.ops import propagation as prop_mod
+
+    return prop_mod.prop_mode()
 
 
 def _pad_idx(pos: np.ndarray, shape_floors=None) -> np.ndarray:
@@ -1325,6 +1398,13 @@ def train_arrays(
             "a CampaignLeg requires checkpoint_dir: leased chunks are "
             "banked as p1chunk restart points, which is the whole point"
         )
+    # Per-run slot budgets: rebinding the module-constant names here
+    # makes every use below (and in the nested closures) see the LIVE
+    # env/profile value — the autotuner and cli --profile set knobs
+    # in-process, after the import-time latch (module attrs stay the
+    # tests' monkeypatch surface, honored when the env hasn't moved).
+    _COMPACT_CHUNK_SLOTS = _live_chunk_slots()
+    _INFLIGHT_SLOTS = _live_inflight_slots()
     # observability (dbscan_tpu/obs): activate from DBSCAN_TRACE=path if
     # set — one env lookup; every hook below is a no-op when disabled
     obs.ensure_env()
@@ -1876,7 +1956,23 @@ def train_arrays(
         "cpad": 0,
         "iters": 0,
         "slots": 0,  # staged device-finalize slots (HBM residency guard)
+        # fused Pallas unpack+fold+propagate (ops/pallas_banded.py):
+        # resolved ONCE per run so every chunk stages the same shape —
+        # a mid-run flip would mix lab0-bearing and bare records and
+        # make the counted sweeps chunk-mix-dependent
+        "fused": False,
+        "wintab_dev": None,  # shared padded window table (fused + cc)
+        "meta": None,  # CellGraphMeta (wintab source)
+        # propagation mode of the tail cc, resolved per run for the
+        # same reason (it keys the compiled cc trace)
+        "prop_mode": None,
     }
+    if cellcc_dev["on"]:
+        from dbscan_tpu.ops import pallas_banded as pallas_cellcc
+        from dbscan_tpu.ops import propagation as prop_mod
+
+        cellcc_dev["fused"] = pallas_cellcc.fused_mode()
+        cellcc_dev["prop_mode"] = prop_mod.prop_mode()
     # Staged-residency cap: unlike the host path (whose _pull_record
     # pops each chunk's combo/bits after its pull), the device finalize
     # keeps every chunk's packed buffers PLUS ~13 B/slot of staged
@@ -1914,11 +2010,26 @@ def train_arrays(
         if meta.n_cells == 0:
             cellcc_dev["on"] = False
             return
+        cellcc_dev["meta"] = meta
         cellcc_dev["cpad"] = binning._ratchet(
             getattr(cfg, "shape_floors", None),
             "cellcc_cells",
             binning._ladder_width(meta.n_cells + 1, 4096),
         )
+
+    def _wintab_dev():
+        """The padded [cpad, 25] window table, uploaded ONCE per run
+        and shared by the per-chunk fused dispatches and the tail cc
+        (the fused path needs it at flush time for the folded first
+        sweep; the split path only at the tail)."""
+        if cellcc_dev["wintab_dev"] is None:
+            meta = cellcc_dev["meta"]
+            wt = np.full(
+                (cellcc_dev["cpad"], binning.BANDED_WIN), -1, np.int32
+            )
+            wt[: meta.n_cells] = meta.wintab
+            cellcc_dev["wintab_dev"] = mesh_mod.replicate_host_array(wt)
+        return cellcc_dev["wintab_dev"]
     eager = {
         "cur": [],  # pending indices of the open chunk's banded groups
         "cur_slots": 0,
@@ -2124,14 +2235,37 @@ def train_arrays(
             gid_pad[: len(gid_pos)] = gid_pos
             cell_d = mesh_mod.replicate_host_array(cell_h)
             fold_d = mesh_mod.replicate_host_array(fold_h)
-            core_d, cellor_d, cellfold_d = obs_compile.tracked_call(
-                "cellcc.unpack",
-                compiled_cellcc_unpack(cpad),
-                combo_dev,
-                cell_d,
-                fold_d,
-                mesh_mod.replicate_host_array(gid_pad),
-            )
+            if cellcc_dev["fused"]:
+                # fused Pallas unpack+fold+propagate: the unpack/cc
+                # pair's per-chunk half becomes ONE cellcc.fused
+                # dispatch that also folds the first propagation sweep
+                # (lab0); the tail cc then starts one sweep warm
+                # (compiled_cellcc_cc warm=True)
+                from dbscan_tpu.ops.pallas_banded import (
+                    compiled_cellcc_fused,
+                )
+
+                core_d, cellor_d, cellfold_d, lab0_d = (
+                    obs_compile.tracked_call(
+                        "cellcc.fused",
+                        compiled_cellcc_fused(cpad),
+                        combo_dev,
+                        cell_d,
+                        fold_d,
+                        mesh_mod.replicate_host_array(gid_pad),
+                        _wintab_dev(),
+                    )
+                )
+            else:
+                core_d, cellor_d, cellfold_d = obs_compile.tracked_call(
+                    "cellcc.unpack",
+                    compiled_cellcc_unpack(cpad),
+                    combo_dev,
+                    cell_d,
+                    fold_d,
+                    mesh_mod.replicate_host_array(gid_pad),
+                )
+                lab0_d = None
             rec["dev"] = {
                 "core": core_d,
                 "cellor": cellor_d,
@@ -2140,6 +2274,8 @@ def train_arrays(
                 "folds": fold_d,
                 "bits": bits_flat,
             }
+            if lab0_d is not None:
+                rec["dev"]["lab0"] = lab0_d
 
     def _submit_pull(rec):
         """Hand a freshly-flushed chunk's pull + host finalize to the
@@ -2900,10 +3036,7 @@ def train_arrays(
             re-dispatches from intact inputs, and the records' combo/
             bits handles are untouched for the host degrade path."""
             tc = time.perf_counter()
-            cpad = cellcc_dev["cpad"]
-            wt = np.full((cpad, binning.BANDED_WIN), -1, np.int32)
-            wt[: cellmeta.n_cells] = cellmeta.wintab
-            wintab_dev = mesh_mod.replicate_host_array(wt)
+            wintab_dev = _wintab_dev()
             m_bidx: list = []
             counts: list = []
             for rec in compact:
@@ -2924,6 +3057,7 @@ def train_arrays(
                 wintab_dev,
                 cfg.engine.value,
                 out_slots,
+                prop_mode=cellcc_dev["prop_mode"],
             )
 
             def _pull_labels():
@@ -2957,6 +3091,12 @@ def train_arrays(
             iters = int(np.asarray(iters_h))
             cellcc_dev["iters"] = iters
             obs.count("cellcc.cc_iters", iters)
+            # the shared propagation telemetry: every settled window_cc
+            # consumer funnels its sweep count here (leg-1's win is
+            # measured everywhere the fixed point runs, not just cellcc)
+            from dbscan_tpu.ops import propagation as prop_mod
+
+            prop_mod.note_sweeps(iters, cellcc_dev["prop_mode"])
             fin = cellgraph.split_device_labels(seeds_h, flags_h, counts)
             timings["cellcc_host_s"] = round(time.perf_counter() - tc, 6)
             return m_bidx, fin
@@ -3129,6 +3269,13 @@ def train_arrays(
         # bench stamps this next to cellcc_finalize_s so the history
         # gate catches propagation-count blowups, not just walls
         "cellcc_cc_iters": int(cellcc_dev["iters"]),
+        # shared-propagation figures (ops/propagation.py): the run's
+        # window_cc sweep count (the banded path's device CC sweeps —
+        # 0 when the host oracle ran) and the resolved mode, so bench
+        # rows stamp {prefix}_prop_sweeps next to _cellcc_cc_iters and
+        # the history gate trends leg-1's sweep collapse directly
+        "prop_sweeps": int(cellcc_dev["iters"]),
+        "prop_mode": _resolved_prop_mode(cellcc_dev),
         "faults": fault_stats,
     }
 

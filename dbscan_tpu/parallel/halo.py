@@ -72,14 +72,27 @@ def _pad_up(n: int, k: int) -> int:
 
 
 @functools.lru_cache(maxsize=64)
-def _compiled_halo_merge(n_pad: int, mesh):
-    """Jitted collective fixed-point kernel for one (node width, mesh)
-    pair; cached like the driver's dispatch builders so ladder-recurring
-    shapes never re-trace."""
+def _compiled_halo_merge(n_pad: int, mesh, prop_mode: str = "iterated"):
+    """Jitted collective fixed-point kernel for one (node width, mesh,
+    propagation mode) triple; cached like the driver's dispatch
+    builders so ladder-recurring shapes never re-trace. ``prop_mode``
+    keys the trace: the union-find variant (DBSCAN_PROP_UNIONFIND)
+    runs the SAME scatter-min edge relaxation but compresses with
+    ``propagation._UF_JUMPS`` aggressive pointer-doubling jumps per
+    round instead of one — same fixed point (byte-identical gids), the
+    gated ``halo.rounds`` count collapses."""
     import jax
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec
+
+    from dbscan_tpu.ops import propagation as prop_lib
+
+    jumps = (
+        prop_lib._UF_JUMPS
+        if prop_mode == "unionfind"
+        else prop_lib._COMPRESS_JUMPS
+    )
 
     axes = mesh_mod.parts_axes(mesh)
     sizes = {a: mesh.shape[a] for a in axes}
@@ -112,9 +125,13 @@ def _compiled_halo_merge(n_pad: int, mesh):
             upd = lab.at[jnp.minimum(ua, none)].min(lab[jnp.minimum(ub, none)])
             upd = upd.at[jnp.minimum(ub, none)].min(lab[jnp.minimum(ua, none)])
             new = ring_min(upd)
-            # one pointer jump per sweep (ops/propagation.py rationale:
-            # more jumps cost more than the sweeps they save)
-            new = jnp.minimum(new, new[new])
+            # pointer jumps per round: one on the iterated path (the
+            # ops/propagation.py point-graph rationale), aggressive
+            # doubling on the union-find path — the halo node graph is
+            # tiny (cluster count), so jump gathers are cheap relative
+            # to the ring exchange each ELIMINATED round saves
+            for _ in range(jumps):
+                new = jnp.minimum(new, new[new])
             return new, jnp.any(new != lab), it + 1
 
         def cond(state):
@@ -162,6 +179,7 @@ def collective_merge(
     updates reuse exact jit signatures.
     """
     from dbscan_tpu.obs import compile as obs_compile
+    from dbscan_tpu.ops import propagation as prop_lib
     from dbscan_tpu.parallel.binning import _ratchet
 
     if n_uniq == 0:
@@ -181,7 +199,8 @@ def collective_merge(
     ub_p = np.full(e_pad, n_pad - 1, dtype=np.int32)
     ua_p[: len(ua)] = ua
     ub_p[: len(ub)] = ub
-    fn = _compiled_halo_merge(n_pad, mesh)
+    mode = prop_lib.prop_mode()
+    fn = _compiled_halo_merge(n_pad, mesh, mode)
     lab_dev, iters_dev = obs_compile.tracked_call(
         "halo.merge",
         fn,
@@ -191,6 +210,7 @@ def collective_merge(
     lab = mesh_mod.pull_to_host(lab_dev)[:n_uniq].astype(np.int64)
     rounds = int(mesh_mod.pull_to_host(iters_dev))
     obs.count("halo.rounds", rounds)
+    prop_lib.note_sweeps(rounds, mode)
     obs.count("halo.edges", int(len(ua)))
     obs.count("halo.nodes", int(n_uniq))
     # dense 1-based gids in first-appearance order == component-min-rank

@@ -106,11 +106,19 @@ class AdmissionController:
         return self.price(jobs, slots, d) <= self.headroom
 
 
-@functools.lru_cache(maxsize=None)
 def _jobs_builder(engine: str, metric: str):
-    """One compiled pad-and-stack kernel per (engine, metric): a vmap
-    of the shared adjacency->labels tail over the job axis, with
-    per-job eps / min_points as traced scalars."""
+    # propagation mode resolved BEFORE the cache key (ops/propagation.py
+    # contract for cached builders): an in-process knob flip re-traces
+    from dbscan_tpu.ops.propagation import prop_mode
+
+    return _jobs_builder_cached(engine, metric, prop_mode())
+
+
+@functools.lru_cache(maxsize=None)
+def _jobs_builder_cached(engine: str, metric: str, mode: str):
+    """One compiled pad-and-stack kernel per (engine, metric,
+    propagation mode): a vmap of the shared adjacency->labels tail over
+    the job axis, with per-job eps / min_points as traced scalars."""
     import jax
     import jax.numpy as jnp
 
@@ -122,7 +130,7 @@ def _jobs_builder(engine: str, metric: str):
         thr = m.threshold(jnp.asarray(eps, measure.dtype))
         adj = (measure <= thr) & mask[None, :] & mask[:, None]
         adj = adj | (jnp.eye(pts.shape[0], dtype=bool) & mask[:, None])
-        res = cluster_from_adjacency(adj, mask, min_points, engine)
+        res = cluster_from_adjacency(adj, mask, min_points, engine, mode)
         return res.seed_labels, res.flags
 
     return jax.jit(jax.vmap(one))

@@ -1,0 +1,242 @@
+"""Fused Pallas unpack+fold+propagate (ops/pallas_banded.py
+``compiled_cellcc_fused``, family ``cellcc.fused``): the per-chunk half
+of the device cellcc finalize as ONE dispatch — unpack + scatter-fold +
+the first propagation sweep — with the tail ``cellcc.cc`` starting one
+sweep warm.
+
+The parity contract is the device finalize's, EXACT: byte-identical
+labels and flags against both the split unpack path and the host
+oracle; interpreter mode is how this CPU suite pins the kernels
+bit-for-bit (the module's established discipline). DBSCAN_CELLCC_DEVICE
+semantics — fault site, degrade ladder, residency cap — are unchanged.
+"""
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import Engine, obs, train
+
+pytestmark = pytest.mark.cellcc
+
+
+def _blobs(rng):
+    return np.concatenate(
+        [rng.normal(c, 0.6, (1500, 2)) for c in [(0, 0), (6, 6), (-5, 7)]]
+        + [rng.uniform(-10, 12, (500, 2))]
+    )
+
+
+def _kw(engine=Engine.ARCHERY, maxpp=700):
+    return dict(
+        eps=0.3, min_points=8, max_points_per_partition=maxpp,
+        engine=engine, neighbor_backend="banded",
+    )
+
+
+def test_fused_mode_resolution(monkeypatch):
+    from dbscan_tpu.ops import pallas_banded as pb
+
+    monkeypatch.delenv("DBSCAN_CELLCC_FUSED", raising=False)
+    # auto on this CPU suite = off (Pallas-capable backends only)
+    assert pb.fused_mode() is False
+    assert pb.fused_mode("1") is True
+    assert pb.fused_mode("0") is False
+    monkeypatch.setenv("DBSCAN_CELLCC_FUSED", "1")
+    assert pb.fused_mode() is True
+
+
+def test_fused_unpack_bit_exact_vs_split(rng):
+    """The fused dispatch's unpack/fold outputs are byte-identical to
+    compiled_cellcc_unpack's, and its lab0 is exactly the chunk's
+    first pull sweep from identity labels."""
+    import jax.numpy as jnp
+
+    from dbscan_tpu.ops.banded import compiled_cellcc_unpack
+    from dbscan_tpu.ops.pallas_banded import compiled_cellcc_fused
+    from dbscan_tpu.parallel.binning import BANDED_WIN
+
+    cpad, m, k = 4096, 2048, 4096
+    core = rng.random(m) < 0.4
+    orv = rng.integers(0, 1 << 25, k).astype(np.int32)
+    combo = np.concatenate([np.packbits(core), orv.view(np.uint8)])
+    cell_flat = rng.integers(0, cpad - 1, m).astype(np.int32)
+    cell_flat[rng.random(m) < 0.1] = cpad - 1
+    fold_flat = rng.integers(0, 10**6, m).astype(np.int32)
+    or_gid = rng.integers(0, cpad - 1, k).astype(np.int32)
+    or_gid[k // 2:] = cpad - 1
+    wintab = rng.integers(-1, cpad - 1, (cpad, BANDED_WIN)).astype(
+        np.int32
+    )
+    args = tuple(
+        jnp.asarray(a) for a in (combo, cell_flat, fold_flat, or_gid)
+    )
+    c0, o0, f0 = compiled_cellcc_unpack(cpad)(*args)
+    c1, o1, f1, lab0 = compiled_cellcc_fused(cpad)(
+        *args, jnp.asarray(wintab)
+    )
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+    np.testing.assert_array_equal(np.asarray(o0), np.asarray(o1))
+    np.testing.assert_array_equal(np.asarray(f0), np.asarray(f1))
+    inf = 2**31 - 1
+    cand = np.where(np.asarray(o0), np.clip(wintab, 0, cpad - 1), inf)
+    ref = np.minimum(np.arange(cpad), cand.min(axis=1)).astype(np.int32)
+    np.testing.assert_array_equal(np.asarray(lab0), ref)
+
+
+@pytest.mark.parametrize("engine", [Engine.NAIVE, Engine.ARCHERY])
+def test_fused_train_parity_both_engines(engine, rng, monkeypatch):
+    """End-to-end: fused vs split vs host oracle, byte-identical, and
+    the warm start saves a counted sweep on the iterated path (leg-1
+    off isolates the fused contribution)."""
+    pts = _blobs(rng)
+    kw = _kw(engine)
+    monkeypatch.setenv("DBSCAN_PROP_UNIONFIND", "0")
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "0")
+    m_host = train(pts, **kw)
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    monkeypatch.setenv("DBSCAN_CELLCC_FUSED", "0")
+    m_split = train(pts, **kw)
+    monkeypatch.setenv("DBSCAN_CELLCC_FUSED", "1")
+    m_fused = train(pts, **kw)
+    for m in (m_split, m_fused):
+        np.testing.assert_array_equal(m_host.clusters, m.clusters)
+        np.testing.assert_array_equal(m_host.flags, m.flags)
+    assert m_split.stats["cellcc_cc_iters"] >= 2
+    assert (
+        m_fused.stats["cellcc_cc_iters"]
+        < m_split.stats["cellcc_cc_iters"]
+    ), "the folded first sweep must drop the counted tail sweeps"
+
+
+def test_fused_family_dispatched_and_zero_recompile(rng, monkeypatch):
+    """Compile pin: fused mode dispatches cellcc.fused (and never
+    cellcc.unpack), and a second same-shaped train mints ZERO new
+    kernels — the ladder/ratchet discipline extends to the fused
+    family."""
+    import jax
+
+    from dbscan_tpu.ops.pallas_banded import compiled_cellcc_fused
+
+    pts = _blobs(rng)
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    monkeypatch.setenv("DBSCAN_CELLCC_FUSED", "1")
+    # an earlier test in this process may already have compiled the
+    # fused rungs: start from a cold trace cache so the compile
+    # accounting below is this test's own
+    compiled_cellcc_fused.cache_clear()
+    jax.clear_caches()
+    obs.enable()
+    try:
+        snap0 = obs.counters()
+        train(pts, **_kw())  # warm: compiles the fused rungs
+        delta0 = obs.counters_delta(snap0)
+        assert delta0.get("compiles.cellcc.fused", 0) >= 1
+        assert delta0.get("compiles.cellcc.unpack", 0) == 0
+        snap = obs.counters()
+        m = train(pts, **_kw())
+        delta = obs.counters_delta(snap)
+        assert delta.get("compiles.total", 0) == 0, delta
+        assert delta.get("cellcc.cc_iters", 0) == m.stats[
+            "cellcc_cc_iters"
+        ]
+    finally:
+        obs.disable()
+
+
+def test_fused_multi_chunk_parity(rng, monkeypatch):
+    """Several compact chunks: per-chunk lab0 partials min-merge into
+    the full first sweep, so labels AND the counted sweeps are
+    chunk-layout-independent (the cc_iters contract extended to the
+    warm start)."""
+    from dbscan_tpu.parallel import driver
+
+    pts = _blobs(rng)
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    monkeypatch.setenv("DBSCAN_CELLCC_FUSED", "1")
+    m_one = train(pts, **_kw())
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 1 << 12)
+    m_many = train(pts, **_kw())
+    assert m_one.stats["cellcc_cc_iters"] >= 1
+    assert (
+        m_many.stats["cellcc_cc_iters"] == m_one.stats["cellcc_cc_iters"]
+    )
+    np.testing.assert_array_equal(m_one.clusters, m_many.clusters)
+    np.testing.assert_array_equal(m_one.flags, m_many.flags)
+
+
+def test_fused_fault_degrade_semantics_unchanged(rng, monkeypatch):
+    """DBSCAN_CELLCC_DEVICE semantics are untouched by the fused path:
+    a persistent cellcc_cc fault still degrades the WHOLE finalize to
+    the host oracle with labels intact (the staged fused partials are
+    dropped through the same _drop_staged path)."""
+    pts = _blobs(rng)
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    monkeypatch.setenv("DBSCAN_CELLCC_FUSED", "1")
+    m_ref = train(pts, **_kw())
+    assert m_ref.stats["cellcc_cc_iters"] >= 1
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "cellcc_cc#0:PERSISTENT")
+    m_p = train(pts, **_kw())
+    assert m_p.stats["faults"]["fallbacks"] >= 1
+    assert m_p.stats["cellcc_cc_iters"] == 0
+    np.testing.assert_array_equal(m_p.clusters, m_ref.clusters)
+    np.testing.assert_array_equal(m_p.flags, m_ref.flags)
+
+
+def test_fused_residency_cap_unchanged(rng, monkeypatch):
+    """The staged-residency degrade ladder applies to fused records the
+    same way: a budget below one chunk degrades mid-run to the host
+    oracle, labels identical."""
+    from dbscan_tpu.parallel import driver
+
+    monkeypatch.setattr(driver, "_COMPACT_CHUNK_SLOTS", 1 << 12)
+    pts = _blobs(rng)
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    monkeypatch.setenv("DBSCAN_CELLCC_FUSED", "1")
+    m_ref = train(pts, **_kw())
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE_SLOTS", "1024")
+    m_cap = train(pts, **_kw())
+    assert m_cap.stats["cellcc_cc_iters"] == 0  # host oracle finished
+    np.testing.assert_array_equal(m_cap.clusters, m_ref.clusters)
+    np.testing.assert_array_equal(m_cap.flags, m_ref.flags)
+
+
+def test_fused_registration_pins():
+    """Cross-module contracts: the fused family is declared end to end
+    — schema (counters/spans/devtime ride the generator), FAMILY_MODELS
+    (the shapecheck runtime refuses undeclared families), and the
+    cellcc.cc model's labs slot for the warm-start tuple."""
+    from dbscan_tpu.lint.shapes import FAMILY_MODELS
+    from dbscan_tpu.obs import schema
+
+    assert "cellcc.fused" in schema.COMPILE_FAMILIES
+    assert schema.is_declared("counter", "compiles.cellcc.fused")
+    assert schema.is_declared("span", "devtime.cellcc.fused")
+    model = FAMILY_MODELS["cellcc.fused"]
+    assert [a.name for a in model.args] == [
+        "combo", "cell_flat", "fold_flat", "or_gid", "wintab",
+    ]
+    cc = FAMILY_MODELS["cellcc.cc"]
+    assert cc.args[-1].name == "labs" and cc.args[-1].tuple_of
+
+
+def test_fused_under_shapecheck(rng, monkeypatch):
+    """The runtime graftshape cross-check validates the fused family's
+    observed shapes against the declared model (violation-free run,
+    both cellcc.fused and cellcc.cc sites covered)."""
+    from dbscan_tpu.lint import shapecheck
+
+    pts = _blobs(rng)
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    monkeypatch.setenv("DBSCAN_CELLCC_FUSED", "1")
+    was_on = shapecheck.enabled()
+    shapecheck.enable()
+    try:
+        m = train(pts, **_kw())
+        assert m.stats["cellcc_cc_iters"] >= 1
+        rep = shapecheck.report()
+        assert rep["violations"] == []
+        assert "cellcc.fused" in rep["sites"]
+        assert "cellcc.cc" in rep["sites"]
+    finally:
+        if not was_on:
+            shapecheck.disable()

@@ -588,3 +588,55 @@ def test_regress_gate_against_committed_history(tmp_path):
             obj[k] = obj[k] * 2
     slow = _capture(tmp_path, "BENCH_2X.json", obj)
     assert regress.main(["--history", hist, "--capture", slow]) == 1
+
+
+# --- devtime schema coverage (device-timeline rollup) ------------------
+
+
+def test_devtime_rollup_covers_every_compile_family(tmp_path):
+    """Schema-coverage pin for the device-timeline section: EVERY
+    declared compile family — explicitly including the PR-13/14
+    additions (serve.query/serve.jobs/embed.hash/embed.neighbors) —
+    has its ``devtime.<family>`` span generated in the schema, reaches
+    the per-family rollup, and survives the --merge path into the
+    merged report. A family added to COMPILE_FAMILIES can never
+    silently drop out of the device timeline again."""
+    from dbscan_tpu.obs import schema
+
+    for fam in (
+        "serve.query", "serve.jobs", "embed.hash", "embed.neighbors",
+        "cellcc.fused",
+    ):
+        assert fam in schema.COMPILE_FAMILIES, fam
+    for fam in schema.COMPILE_FAMILIES:
+        assert schema.is_declared("span", f"devtime.{fam}"), fam
+
+    fams = list(schema.COMPILE_FAMILIES)
+    records = [
+        {"type": "meta", "epoch0": 100.0, "pid": 1, "shard": 0},
+        _span("train", 0.0, float(len(fams) + 1)),
+    ] + [
+        _span(f"devtime.{fam}", float(i), 0.5, depth=1,
+              args={"host_s": 0.1, "sync_s": 0.05})
+        for i, fam in enumerate(fams)
+    ]
+    path = _write_jsonl(tmp_path / "dev.jsonl", records)
+    report = analyze.analyze(analyze.load_trace(path))
+    rolled = {r["family"] for r in report["devtime"]["families"]}
+    assert rolled == set(fams)
+    assert report["devtime"]["device_busy_frac"] > 0
+
+    # the merged (--merge) view rolls the same families up
+    records2 = [dict(r) for r in records]
+    records2[0] = {"type": "meta", "epoch0": 101.0, "pid": 2, "shard": 1}
+    path2 = _write_jsonl(tmp_path / "dev2.jsonl", records2)
+    merged = analyze.merge_shards([path, path2])
+    mreport = analyze.analyze(merged["data"])
+    mreport["merge"] = merged["merge"]
+    mrolled = {r["family"] for r in mreport["devtime"]["families"]}
+    assert mrolled == set(fams)
+    text = analyze.render(mreport)
+    assert "-- device timeline (ready-sync brackets) --" in text
+    for fam in ("serve.query", "serve.jobs", "embed.hash",
+                "embed.neighbors", "cellcc.fused"):
+        assert fam in text, fam

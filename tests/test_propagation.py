@@ -1,0 +1,316 @@
+"""Propagation contract (ops/propagation.py): the single-pass
+union-find variant (DBSCAN_PROP_UNIONFIND) vs the iterated min-label
+fixed point.
+
+The contract is EXACT (PARITY.md "Propagation contract"): both modes
+are monotone decreasing sequences on the same lattice, bounded below by
+the per-component minimum, with a decreasing move available at any
+label above it — so the fixed point (and every label) is byte-identical
+under the documented SYMMETRIC-relation contract of ``window_cc``. Only
+the counted sweeps differ, and the union-find mode must never need
+MORE sweeps: pull+push is a two-hop relaxation per sweep and the
+aggressive jumps strictly extend the iterated path's single jump.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dbscan_tpu.ops import propagation
+
+
+def _run(adj, tab, mode, init=None):
+    comp, it = propagation.window_cc(
+        jnp.asarray(adj), jnp.asarray(tab), mode=mode, init=init
+    )
+    return np.asarray(comp), int(it)
+
+
+def _sym_window(n, edges, w):
+    """Edge list -> symmetric [n, w] window table + mask; an edge is
+    kept only when BOTH endpoints have a free slot (window_cc's
+    symmetric-relation contract)."""
+    tab = np.zeros((n, w), np.int32)
+    adj = np.zeros((n, w), bool)
+    deg = np.zeros(n, np.int64)
+    for u, v in edges:
+        if u == v or deg[u] >= w or deg[v] >= w:
+            continue
+        tab[u, deg[u]] = v
+        adj[u, deg[u]] = True
+        deg[u] += 1
+        tab[v, deg[v]] = u
+        adj[v, deg[v]] = True
+        deg[v] += 1
+    return adj, tab
+
+
+def _scipy_minlabels(n, adj, tab):
+    sp = pytest.importorskip("scipy.sparse")
+    from scipy.sparse.csgraph import connected_components
+
+    uu, vv = np.nonzero(adj)
+    g = sp.coo_matrix(
+        (np.ones(len(uu)), (uu, tab[uu, vv])), shape=(n, n)
+    )
+    _, lab = connected_components(g, directed=False)
+    ref = np.empty(n, np.int64)
+    for c in range(lab.max() + 1):
+        mem = np.flatnonzero(lab == c)
+        ref[mem] = mem.min()
+    return ref
+
+
+def test_mode_resolution(monkeypatch):
+    monkeypatch.delenv("DBSCAN_PROP_UNIONFIND", raising=False)
+    assert propagation.prop_mode() == "unionfind"  # auto default
+    for raw in ("0", "off", "iterated", "false"):
+        assert propagation.prop_mode(raw) == "iterated"
+    for raw in ("1", "auto", "unionfind", "on"):
+        assert propagation.prop_mode(raw) == "unionfind"
+    monkeypatch.setenv("DBSCAN_PROP_UNIONFIND", "0")
+    assert propagation.prop_mode() == "iterated"
+
+
+@pytest.mark.parametrize(
+    "shape",
+    ["long-chain", "star-forest", "torus", "two-rings"],
+)
+def test_pathological_shapes_parity_and_collapse(shape):
+    """The sweep-count-maximizing shapes: byte-identical labels, and
+    the union-find mode strictly collapses the sweep count wherever the
+    iterated path needs more than the trivial 2 sweeps."""
+    if shape == "long-chain":
+        n, w = 4096, 2
+        edges = [(i, i + 1) for i in range(n - 1)]
+    elif shape == "star-forest":
+        # many small stars chained at the hubs: mixes degree-w hubs
+        # with chains (the hub fan-in is where scatter-min pays)
+        n, w = 2048, 8
+        edges = []
+        for hub in range(0, n - 8, 8):
+            edges += [(hub, hub + k) for k in range(1, 8)]
+            if hub + 8 < n:
+                edges.append((hub + 7, hub + 8))
+    elif shape == "torus":
+        s = 48
+        n, w = s * s, 4
+        idx = np.arange(n).reshape(s, s)
+        edges = []
+        for a, b in (
+            (idx, np.roll(idx, 1, 0)),
+            (idx, np.roll(idx, 1, 1)),
+        ):
+            edges += list(zip(a.reshape(-1), b.reshape(-1)))
+        edges = [(int(u), int(v)) for u, v in edges]
+    else:  # two-rings
+        n, w = 2048, 2
+        half = n // 2
+        edges = [(i, (i + 1) % half) for i in range(half)]
+        edges += [
+            (half + i, half + (i + 1) % half) for i in range(half)
+        ]
+    adj, tab = _sym_window(n, edges, w)
+    c_it, s_it = _run(adj, tab, "iterated")
+    c_uf, s_uf = _run(adj, tab, "unionfind")
+    np.testing.assert_array_equal(c_it, c_uf)
+    np.testing.assert_array_equal(c_it, _scipy_minlabels(n, adj, tab))
+    assert s_uf <= s_it
+    if s_it > 2:
+        assert s_uf < s_it, (shape, s_it, s_uf)
+
+
+def test_property_fuzz_random_graphs(rng):
+    """Property-based parity fuzz: random symmetric graphs across a
+    density range — labels byte-identical between the modes AND equal
+    to scipy's min-index components; union-find never needs more
+    sweeps."""
+    for trial in range(8):
+        n = int(rng.integers(200, 1200))
+        w = int(rng.integers(2, 12))
+        m = int(rng.integers(n // 4, 2 * n))
+        u = rng.integers(0, n, m)
+        v = rng.integers(0, n, m)
+        adj, tab = _sym_window(n, list(zip(u, v)), w)
+        c_it, s_it = _run(adj, tab, "iterated")
+        c_uf, s_uf = _run(adj, tab, "unionfind")
+        np.testing.assert_array_equal(c_it, c_uf)
+        np.testing.assert_array_equal(
+            c_it, _scipy_minlabels(n, adj, tab)
+        )
+        assert s_uf <= s_it, (trial, s_it, s_uf)
+
+
+def test_warm_init_preserves_fixed_point():
+    """A monotone warm start (the fused path's first-sweep partial)
+    changes the counted sweeps, never the labels."""
+    n, w = 1024, 2
+    edges = [(i, i + 1) for i in range(n - 1)]
+    adj, tab = _sym_window(n, edges, w)
+    cold, s_cold = _run(adj, tab, "unionfind")
+    # the exact first pull sweep, as ops/pallas_banded.py folds it
+    nbr = np.where(adj, tab, 2**31 - 1).min(axis=1)
+    lab0 = np.minimum(np.arange(n), nbr).astype(np.int32)
+    warm, s_warm = _run(adj, tab, "unionfind", init=jnp.asarray(lab0))
+    np.testing.assert_array_equal(cold, warm)
+    assert s_warm <= s_cold
+
+
+def test_dense_engine_parity_across_modes(rng):
+    """The dense (materialized-adjacency) consumer: eager
+    cluster_from_adjacency under both modes, byte-identical
+    labels/flags (the [N, N] path has no scatter table — it rides the
+    pull + aggressive jumps half of the variant)."""
+    from dbscan_tpu.ops.local_dbscan import cluster_from_adjacency
+
+    pts = np.concatenate(
+        [rng.normal(c, 0.5, (120, 2)) for c in [(0, 0), (4, 4)]]
+        + [rng.uniform(-2, 6, (40, 2))]
+    )
+    d2 = ((pts[:, None] - pts[None, :]) ** 2).sum(-1)
+    adj = jnp.asarray(d2 <= 0.36)
+    mask = jnp.ones(len(pts), bool)
+    outs = {}
+    for mode in ("0", "1"):
+        import os
+
+        prev = os.environ.get("DBSCAN_PROP_UNIONFIND")
+        os.environ["DBSCAN_PROP_UNIONFIND"] = mode
+        try:
+            res = cluster_from_adjacency(adj, mask, 6, "archery")
+            outs[mode] = (
+                np.asarray(res.seed_labels),
+                np.asarray(res.flags),
+            )
+        finally:
+            if prev is None:
+                os.environ.pop("DBSCAN_PROP_UNIONFIND", None)
+            else:
+                os.environ["DBSCAN_PROP_UNIONFIND"] = prev
+    np.testing.assert_array_equal(outs["0"][0], outs["1"][0])
+    np.testing.assert_array_equal(outs["0"][1], outs["1"][1])
+
+
+def test_banded_train_parity_and_strictly_fewer_sweeps(rng, monkeypatch):
+    """End-to-end banded anchor-style shape: byte-identical labels and
+    flags across the knob, the gated cellcc_cc_iters / prop_sweeps
+    STRICTLY lower in union-find mode, and the telemetry funnel live
+    (prop.sweeps counter == the stats figure, prop.mode gauge set)."""
+    from dbscan_tpu import Engine, obs, train
+
+    pts = np.concatenate(
+        [rng.normal(c, 0.6, (1500, 2)) for c in [(0, 0), (6, 6), (-5, 7)]]
+        + [rng.uniform(-10, 12, (500, 2))]
+    )
+    kw = dict(
+        eps=0.3, min_points=8, max_points_per_partition=700,
+        engine=Engine.ARCHERY, neighbor_backend="banded",
+    )
+    monkeypatch.setenv("DBSCAN_CELLCC_DEVICE", "1")
+    monkeypatch.setenv("DBSCAN_PROP_UNIONFIND", "0")
+    m_it = train(pts, **kw)
+    monkeypatch.setenv("DBSCAN_PROP_UNIONFIND", "1")
+    obs.enable()
+    try:
+        snap = obs.counters()
+        m_uf = train(pts, **kw)
+        delta = obs.counters_delta(snap)
+        gauges = obs.state().metrics.gauges()
+    finally:
+        obs.disable()
+    np.testing.assert_array_equal(m_it.clusters, m_uf.clusters)
+    np.testing.assert_array_equal(m_it.flags, m_uf.flags)
+    assert m_it.stats["cellcc_cc_iters"] >= 1
+    assert (
+        m_uf.stats["cellcc_cc_iters"] < m_it.stats["cellcc_cc_iters"]
+    )
+    assert m_it.stats["prop_mode"] == "iterated"
+    assert m_uf.stats["prop_mode"] == "unionfind"
+    assert m_uf.stats["prop_sweeps"] == m_uf.stats["cellcc_cc_iters"]
+    assert delta.get("prop.sweeps") == m_uf.stats["prop_sweeps"]
+    assert gauges.get("prop.mode") == 1.0
+
+
+def test_embed_parity_across_modes(rng, monkeypatch):
+    """The embed consumer: bucket window_cc under both modes, labels
+    identical (the mode is part of the kernel cache key, so an
+    in-process flip really flips the compiled path)."""
+    from dbscan_tpu import embed_dbscan
+    from dbscan_tpu.embed import neighbors
+
+    d, k = 16, 4
+    centers = rng.standard_normal((k, d)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    blob_of = rng.integers(0, k, 400)
+    pts = centers[blob_of] + 0.001 * rng.standard_normal(
+        (400, d)
+    ).astype(np.float32)
+    neighbors.reset_w_floors()
+    monkeypatch.setenv("DBSCAN_PROP_UNIONFIND", "0")
+    c0, f0 = embed_dbscan(pts, 0.01, 4, max_points_per_partition=256)
+    monkeypatch.setenv("DBSCAN_PROP_UNIONFIND", "1")
+    c1, f1 = embed_dbscan(pts, 0.01, 4, max_points_per_partition=256)
+    np.testing.assert_array_equal(c0, c1)
+    np.testing.assert_array_equal(f0, f1)
+
+
+def test_sparse_parity_across_modes(monkeypatch):
+    """The sparse front-end (cluster_from_adjacency consumer) under
+    both modes: identical ids/flags."""
+    sp = pytest.importorskip("scipy.sparse")
+    from dbscan_tpu.ops.sparse import sparse_cosine_dbscan
+
+    srng = np.random.default_rng(7)
+    k, per, vocab, nnz = 12, 40, 2000, 12
+    feat = srng.integers(0, vocab, size=(k, nnz))
+    val = srng.random((k, nnz)) + 0.1
+    blob_of = np.repeat(np.arange(k), per)
+    rows = np.repeat(np.arange(k * per), nnz)
+    cols = feat[blob_of].ravel()
+    vals = (val[blob_of] * srng.uniform(0.9, 1.1, (k * per, nnz))).ravel()
+    x = sp.coo_matrix((vals, (rows, cols)), shape=(k * per, vocab)).tocsr()
+    kw = dict(max_points_per_partition=256, eps=0.05, min_points=5)
+    monkeypatch.setenv("DBSCAN_PROP_UNIONFIND", "0")
+    c0, f0 = sparse_cosine_dbscan(x, **kw)
+    monkeypatch.setenv("DBSCAN_PROP_UNIONFIND", "1")
+    c1, f1 = sparse_cosine_dbscan(x, **kw)
+    np.testing.assert_array_equal(c0, c1)
+    np.testing.assert_array_equal(f0, f1)
+
+
+def test_halo_merge_parity_across_modes(rng, monkeypatch):
+    """The collective halo-merge consumer: the union-find rounds reach
+    the same gids as the iterated rounds AND the host union-find, with
+    no more rounds."""
+    jax = pytest.importorskip("jax")
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    from dbscan_tpu import obs
+    from dbscan_tpu.parallel import graph as graph_mod
+    from dbscan_tpu.parallel import halo, mesh as mesh_mod
+
+    mesh = mesh_mod.make_mesh(jax.devices()[:4])
+    n = 600
+    m = 900
+    ua = rng.integers(0, n, m).astype(np.int64)
+    ub = rng.integers(0, n, m).astype(np.int64)
+    n_ref, gid_ref = graph_mod.uf_components(ua, ub, n)
+    results = {}
+    obs.enable()
+    try:
+        for mode in ("0", "1"):
+            monkeypatch.setenv("DBSCAN_PROP_UNIONFIND", mode)
+            snap = obs.counters()
+            n_got, gid = halo.collective_merge(
+                ua.astype(np.int32), ub.astype(np.int32), n, mesh
+            )
+            rounds = obs.counters_delta(snap).get("halo.rounds", 0)
+            results[mode] = (n_got, gid, rounds)
+    finally:
+        obs.disable()
+    for mode, (n_got, gid, rounds) in results.items():
+        assert n_got == n_ref, mode
+        np.testing.assert_array_equal(gid, gid_ref)
+        assert rounds >= 1
+    assert results["1"][2] <= results["0"][2]

@@ -1,0 +1,249 @@
+"""Knob autotuner (dbscan_tpu/bench.py ``--tune``), the config.Profile
+surface, and their gates: the HBM pre-dispatch constraint (never run a
+config predicted to breach), the tuned-vs-default hard floor in
+obs/regress, the history promotion of the new metrics, and the
+``env-tunable-undeclared`` lint rule.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import config
+from dbscan_tpu import bench as tune_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_profile():
+    config.clear_profile()
+    yield
+    config.clear_profile()
+
+
+# --- search space / constraint ----------------------------------------
+
+
+def test_tunables_are_declared_registry_rows():
+    declared = config.ENV_VARS
+    for t in config.TUNABLES:
+        assert t.name in declared, t.name
+        assert declared[t.name].kind == t.kind, t.name
+        assert len(t.choices) >= 2, t.name
+        # every choice round-trips through the typed env reader
+        for c in t.choices:
+            os.environ[t.name] = str(c)
+            try:
+                got = config.env(t.name)
+            finally:
+                os.environ.pop(t.name, None)
+            assert got == c, (t.name, c, got)
+
+
+def test_hbm_ok_rejects_predicted_breach():
+    fits, breaches = tune_mod.hbm_ok({})
+    assert fits and breaches == []
+    # shrink the budget until the knob-bounded families breach: the
+    # constraint is graftshape's FAMILY_MODELS envelope itself
+    fits, breaches = tune_mod.hbm_ok({}, budget=1 << 20)
+    assert not fits and breaches
+
+
+def test_sample_candidates_never_proposes_breaching_config():
+    cands = tune_mod.sample_candidates(16, seed=3)
+    assert cands[0] == {}  # the default is always entrant 0
+    declared = {t.name: t for t in config.TUNABLES}
+    for cand in cands:
+        fits, breaches = tune_mod.hbm_ok(cand)
+        assert fits, breaches
+        for name, value in cand.items():
+            assert value in declared[name].choices
+    # deterministic: the same seed reproduces the tournament field
+    assert cands == tune_mod.sample_candidates(16, seed=3)
+    # a tiny budget filters the slot-heavy combos BEFORE evaluation —
+    # sampled candidates that would breach are resampled, never run
+    # (entrant 0, the operator's current defaults, is the baseline and
+    # is not re-filtered: it is what already runs today)
+    small = tune_mod.sample_candidates(16, seed=3, budget=1 << 33)
+    for cand in small[1:]:
+        fits, _ = tune_mod.hbm_ok(cand, budget=1 << 33)
+        assert fits
+
+
+# --- profile object ----------------------------------------------------
+
+
+def test_profile_roundtrip_validation_and_precedence(tmp_path, monkeypatch):
+    values = {
+        "DBSCAN_PULL_INFLIGHT": 3,
+        "DBSCAN_PROP_UNIONFIND": "1",
+    }
+    prof = config.Profile("cpu", "headline", values, {"rev": "x"})
+    path = str(tmp_path / "p.json")
+    prof.save(path)
+    loaded = config.Profile.load(path)
+    assert loaded.values == values
+    assert loaded.meta == {"rev": "x"}
+    monkeypatch.delenv("DBSCAN_PULL_INFLIGHT", raising=False)
+    loaded.apply()
+    assert config.env("DBSCAN_PULL_INFLIGHT") == 3
+    # an explicit export still wins: profiles are tuned DEFAULTS
+    monkeypatch.setenv("DBSCAN_PULL_INFLIGHT", "2")
+    assert config.env("DBSCAN_PULL_INFLIGHT") == 2
+    config.clear_profile()
+    monkeypatch.delenv("DBSCAN_PULL_INFLIGHT", raising=False)
+    assert config.env("DBSCAN_PULL_INFLIGHT") == 2  # table default
+
+
+def test_profile_rejects_undeclared_knob_and_value(tmp_path):
+    with pytest.raises(ValueError, match="not a declared Tunable"):
+        config.Profile("cpu", "w", {"DBSCAN_NOT_A_KNOB": 1}).validate()
+    with pytest.raises(ValueError, match="outside the declared"):
+        config.Profile(
+            "cpu", "w", {"DBSCAN_PULL_INFLIGHT": 999}
+        ).validate()
+
+
+# --- the --tune smoke ---------------------------------------------------
+
+
+def test_tune_smoke_and_cli_profile_roundtrip(tmp_path, capsys):
+    """Tiny-budget tournament: a committed profile whose speedup is
+    >= 1.0 by construction (the default is a tournament entrant), the
+    history gate/append runs green, and the written profile round-trips
+    through ``cli.py --profile`` into a real run."""
+    out_dir = str(tmp_path / "profiles")
+    hist = str(tmp_path / "history.jsonl")
+    rc = tune_mod.main(
+        [
+            "--tune", "--n", "3000", "--candidates", "3",
+            "--rounds", "1", "--budget-s", "180",
+            "--out-dir", out_dir, "--history", hist, "--seed", "1",
+        ]
+    )
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert result["tuned_vs_default_speedup"] >= 1.0
+    prof_path = result["profile"]
+    prof = config.Profile.load(prof_path)
+    assert prof.meta["tuned_vs_default_speedup"] >= 1.0
+    # the tune capture landed in the history with the gated metric
+    recs = [json.loads(l) for l in open(hist) if l.strip()]
+    metrics = {r["metric"] for r in recs}
+    assert "tuned_vs_default_speedup" in metrics
+    config.clear_profile()
+
+    # round-trip: cli.py --profile applies the committed profile
+    from dbscan_tpu import cli as cli_mod
+
+    rng = np.random.default_rng(0)
+    pts = np.concatenate(
+        [rng.normal(c, 0.4, (150, 2)) for c in [(0, 0), (5, 5)]]
+    )
+    in_csv = str(tmp_path / "in.csv")
+    out_csv = str(tmp_path / "out.csv")
+    np.savetxt(in_csv, pts, delimiter=",")
+    rc = cli_mod.main(
+        [
+            "--input", in_csv, "--output", out_csv,
+            "--eps", "0.5", "--min-points", "5",
+            "--profile", prof_path, "--stats",
+        ]
+    )
+    assert rc == 0
+    assert config.active_profile_values() == prof.values
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["n_clusters"] == 2
+    assert os.path.exists(out_csv)
+
+
+def test_tune_under_tiny_hbm_budget_only_fielded_safe_configs():
+    """The pre-dispatch constraint reaches the tournament field: with a
+    tight HBM budget every sampled candidate prices under it."""
+    budget = 1 << 34
+    cands = tune_mod.sample_candidates(8, seed=0, budget=budget)
+    assert len(cands) >= 2  # the space is not empty under the budget
+    for cand in cands:
+        fits, breaches = tune_mod.hbm_ok(cand, budget=budget)
+        assert fits, (cand, breaches)
+
+
+# --- gates --------------------------------------------------------------
+
+
+def test_regress_floor_tuned_vs_default_speedup():
+    from dbscan_tpu.obs import regress
+
+    def rec(v):
+        return {
+            "metric": "tuned_vs_default_speedup",
+            "value": v,
+            "backend": "cpu",
+            "resident_hot": None,
+            "source": "x",
+        }
+
+    out = regress.compare([rec(1.2)], [])
+    assert not out["regressions"] and out["ok"][0]["direction"] == "floor"
+    out = regress.compare([rec(0.93)], [])
+    (bad,) = out["regressions"]
+    assert bad["direction"] == "floor"
+    # exactly 1.0 (the default winning its own tournament) is green
+    assert not regress.compare([rec(1.0)], [])["regressions"]
+
+
+def test_regress_direction_prop_sweeps():
+    from dbscan_tpu.obs import regress
+
+    assert regress.direction("anchor_prop_sweeps") == regress.LOWER_BETTER
+    assert regress.direction("headline_prop_sweeps") == regress.LOWER_BETTER
+
+
+def test_bench_history_promotes_new_metrics():
+    from dbscan_tpu.obs import bench_history
+
+    cap = {
+        "metric": "tune",
+        "backend": "cpu",
+        "tuned_vs_default_speedup": 1.07,
+        "anchor_prop_sweeps": 3,
+        "anchor_prop_mode": "unionfind",  # a label, NOT promoted
+    }
+    recs = bench_history.normalize_capture(cap, "t.json", "rev")
+    by = {r["metric"]: r for r in recs}
+    assert by["tuned_vs_default_speedup"]["unit"] == "ratio"
+    assert by["anchor_prop_sweeps"]["unit"] == "iters"
+    assert "anchor_prop_mode" not in by
+
+
+# --- lint rule ----------------------------------------------------------
+
+
+def test_lint_env_tunable_undeclared(monkeypatch):
+    import dbscan_tpu
+    from dbscan_tpu import lint as lint_mod
+
+    pkg_dir = os.path.dirname(os.path.abspath(dbscan_tpu.__file__))
+    cfg_py = os.path.join(pkg_dir, "config.py")
+
+    findings, _ = lint_mod.lint_paths([cfg_py])
+    assert [f for f in findings if f.rule == "env-tunable-undeclared"] == []
+
+    bad = config.TUNABLES + (
+        config.Tunable("DBSCAN_NOT_DECLARED", "int", (1, 2), "bad"),
+        config.Tunable("DBSCAN_PULL_INFLIGHT", "str", ("1",), "kind"),
+        config.Tunable("DBSCAN_GROUP_SLOTS", "int", (), "empty"),
+    )
+    monkeypatch.setattr(config, "TUNABLES", bad)
+    findings, _ = lint_mod.lint_paths([cfg_py])
+    msgs = [
+        f.message for f in findings if f.rule == "env-tunable-undeclared"
+    ]
+    assert len(msgs) == 3
+    assert any("DBSCAN_NOT_DECLARED" in m for m in msgs)
+    assert any("kind" in m and "DBSCAN_PULL_INFLIGHT" in m for m in msgs)
+    assert any("empty" in m and "DBSCAN_GROUP_SLOTS" in m for m in msgs)
+    # the rule is in the catalog (a finding under an unlisted id would
+    # crash the --rules/--list-rules contract)
+    assert "env-tunable-undeclared" in lint_mod.RULES
