@@ -1118,6 +1118,163 @@ def serve_row(prefix: str = "serve") -> dict:
     return row
 
 
+def serve_replicated_row(max_replicas: int, prefix: str = "serve") -> dict:
+    """The replicated-serving capture (serve/sharded.py + router.py):
+    for each replica count on the ladder 1..max_replicas, sustained
+    ROUTED query QPS and latency percentiles under simultaneous sharded
+    ingest with a FIXED reader pool — the rung axis isolates read-side
+    scaling (more replicas absorbing the same offered load), which is
+    the acceptance figure: QPS grows with the ladder while p99 stays
+    well under the ingest batch period. Honesty rules match serve_row:
+    every rung re-ingests the SAME deterministic schedule into a fresh
+    service (re-seeded rng per rung), warms a full window plus the
+    routed query signatures before timing, and records latencies only
+    while ingest is in flight. The shed governor is ARMED during each
+    timed window at a generous bound (BENCH_SERVE_SHED_BOUND_MS,
+    default 5000): a healthy run sheds nothing, so the committed
+    ``serve_shed_frac`` of 0.0 regressing UP means p99 actually drifted
+    past the declared bound — the gate catches capacity loss, not a
+    tuning choice."""
+    import threading
+
+    from dbscan_tpu.serve import (
+        QueryRouter,
+        QueryShed,
+        ShardedClusterService,
+        synthetic,
+    )
+
+    n_updates = int(os.environ.get("BENCH_SERVE_UPDATES", "5"))
+    batch_n = int(os.environ.get("BENCH_SERVE_BATCH", "20000"))
+    qbatch = int(os.environ.get("BENCH_SERVE_QBATCH", "256"))
+    readers = max(1, int(os.environ.get("BENCH_SERVE_READERS", "4")))
+    n_shards = int(os.environ.get("BENCH_SERVE_SHARDS", "2"))
+    shed_bound = os.environ.get("BENCH_SERVE_SHED_BOUND_MS", "5000")
+
+    side = 6
+    row: dict = {
+        f"{prefix}_replicas": int(max_replicas),
+        f"{prefix}_shards": n_shards,
+        f"{prefix}_updates": n_updates,
+        f"{prefix}_batch_points": batch_n,
+        f"{prefix}_readers": readers,
+    }
+    shed_total = routed_total = 0
+    prev_bound = os.environ.get("DBSCAN_SERVE_SHED_P99_MS")
+    for n_rep in range(1, int(max_replicas) + 1):
+        # identical deterministic schedule per rung: the rng is
+        # re-seeded so every rung ingests the same batches and offers
+        # the same query mix — the rung axis varies ONLY the replica
+        # count
+        rng = np.random.default_rng(11)
+        centers = synthetic.blob_centers(side=side)
+
+        def mk_batch(u: int) -> np.ndarray:
+            return synthetic.drifting_batch(
+                rng, u, batch_n, centers, drift=0.1
+            )
+
+        # several distinct query payloads per reader slot: content
+        # routing hashes each payload to a replica, so a rotating mix
+        # spreads the offered load without scripting the router
+        q_list = [
+            rng.uniform(0.0, side * 8.0, (qbatch, 2))
+            for _ in range(4 * readers)
+        ]
+        lat_ms: list = []
+        lat_lock = threading.Lock()
+        stop = threading.Event()
+        record = threading.Event()
+
+        svc = ShardedClusterService(
+            0.6, 5, n_shards=n_shards,
+            max_points_per_partition=8192, window=3,
+        )
+
+        with svc:
+            warm = 3
+            for u in range(warm):
+                svc.submit(mk_batch(u))
+            svc.drain()
+            router = QueryRouter(svc, replicas=n_rep)
+
+            def reader(slot: int, router=router, q_list=q_list) -> None:
+                i = slot
+                while not stop.is_set():
+                    q = q_list[i % len(q_list)]
+                    i += readers
+                    t0 = time.perf_counter()
+                    try:
+                        router.query(q)
+                    except QueryShed:
+                        continue  # counted by the router; not a wall
+                    dt = (time.perf_counter() - t0) * 1e3
+                    if record.is_set():
+                        with lat_lock:
+                            lat_ms.append(dt)
+
+            try:
+                for q in q_list:
+                    router.query(q)  # warm every payload's route
+                threads = [
+                    threading.Thread(target=reader, args=(s,), daemon=True)
+                    for s in range(readers)
+                ]
+                for t in threads:
+                    t.start()
+                # arm the shed governor for the timed window only: the
+                # warm pass above may carry one-time compile walls that
+                # would otherwise poison the rolling p99
+                os.environ["DBSCAN_SERVE_SHED_P99_MS"] = shed_bound
+                record.set()
+                t0 = time.perf_counter()
+                for u in range(warm, warm + n_updates):
+                    svc.submit(mk_batch(u))
+                svc.drain()
+                wall = time.perf_counter() - t0
+                record.clear()
+                stop.set()
+                for t in threads:
+                    t.join(timeout=30)
+                h = router.health()
+                shed_total += h["shed"]
+                routed_total += h["routed"]
+            finally:
+                if prev_bound is None:
+                    os.environ.pop("DBSCAN_SERVE_SHED_P99_MS", None)
+                else:
+                    os.environ["DBSCAN_SERVE_SHED_P99_MS"] = prev_bound
+                router.close()
+
+        with lat_lock:
+            lats = np.asarray(lat_ms, np.float64)
+        row[f"{prefix}_r{n_rep}_queries"] = int(len(lats))
+        row[f"{prefix}_r{n_rep}_qps"] = (
+            round(float(len(lats) / wall), 3) if wall > 0 else 0.0
+        )
+        if len(lats):
+            row[f"{prefix}_r{n_rep}_p50_ms"] = round(
+                float(np.percentile(lats, 50)), 3
+            )
+            row[f"{prefix}_r{n_rep}_p99_ms"] = round(
+                float(np.percentile(lats, 99)), 3
+            )
+        # the top rung's figure survives: the acceptance inequality
+        # (p99 well under the batch period) is read at the top rung.
+        # Distinct key from serve_row's serve_batch_period_s — the
+        # replicated row's ingest period (sharded service + router
+        # reader pool) is a DIFFERENT population, and the gate must
+        # not mix populations under one metric
+        row[f"{prefix}_rep_batch_period_s"] = round(
+            wall / max(1, n_updates), 4
+        )
+    total = shed_total + routed_total
+    row[f"{prefix}_shed_frac"] = (
+        round(shed_total / total, 6) if total else 0.0
+    )
+    return row
+
+
 def make_embed_anchor(n: int, d: int):
     """Engineered embed workload in the regime the LSH front-end is
     built for (tight-threshold near-duplicate clustering): K unit-
@@ -1342,12 +1499,18 @@ def main() -> None:
     if len(sys.argv) >= 2 and sys.argv[1] == "--serve":
         # standalone serving capture: the BENCH_SERVE_* shape (QPS +
         # latency-under-ingest + tenancy throughput flat), printed as
-        # ONE JSON object and gate-then-appended to BENCH_HISTORY
+        # ONE JSON object and gate-then-appended to BENCH_HISTORY.
+        # --replicas N switches to the replicated-serving ladder
+        # (sharded service + query router, serve_r{k}_* keys)
         _ensure_live_backend()
         import jax as _jax
 
         cap = {"metric": "serve", "backend": _jax.default_backend()}
-        cap.update(serve_row())
+        if "--replicas" in sys.argv:
+            n_rep = int(sys.argv[sys.argv.index("--replicas") + 1])
+            cap.update(serve_replicated_row(n_rep))
+        else:
+            cap.update(serve_row())
         print(json.dumps(cap))
         hist_path = os.environ.get("BENCH_HISTORY")
         if hist_path:

@@ -522,6 +522,33 @@ ENV_VARS = _env_table(
         "rejected.",
     ),
     EnvVar(
+        "DBSCAN_SERVE_REPLICAS", "int", 2,
+        "Query replica count of the serving failover router "
+        "(dbscan_tpu/serve/router.py): each published consistent cut "
+        "broadcasts its ladder-padded skeletons to this many read "
+        "replicas, and queries hash across the live set; a replica "
+        "evicted by a persistent fault shrinks the set (re-route, "
+        "never an error) until it is empty and the host oracle "
+        "answers.",
+    ),
+    EnvVar(
+        "DBSCAN_SERVE_READ_TIMEOUT_S", "float", 30.0,
+        "Seqlock read starvation bound of the serving layer: a reader "
+        "spinning on a publish that never completes (wedged writer — "
+        "odd epoch that never returns to even) raises after this many "
+        "seconds with the stale shard named, instead of spinning "
+        "forever.",
+    ),
+    EnvVar(
+        "DBSCAN_SERVE_SHED_P99_MS", "float", 0.0,
+        "Declared p99 latency bound of the serving router's load "
+        "shedder: while the rolling query p99 exceeds this many "
+        "milliseconds, the router admits only batches whose "
+        "serve.query family-model price fits the proportionally "
+        "shrunk admission headroom and sheds the rest "
+        "(serve.router.shed). 0 (the default) disables shedding.",
+    ),
+    EnvVar(
         "DBSCAN_EMBED_SAMPLE_FRAC", "float", 0.0,
         "Opt-in subsampled-edge mode of the embed engine "
         "(dbscan_tpu/embed): each candidate edge survives a "

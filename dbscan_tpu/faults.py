@@ -76,12 +76,25 @@ SITE_PULL = "pull"  # pipelined compact-chunk pull (parallel/pipeline.py)
 SITE_CELLCC = "cellcc_cc"  # device cellcc finalize (cellgraph.finalize_device)
 SITE_CAMPAIGN = "campaign"  # campaign worker lease (dbscan_tpu/campaign.py)
 SITE_SERVE = "serve"  # ClusterService ingest/query steps (dbscan_tpu/serve)
+SITE_SERVE_REPLICA = "serve_replica"  # router query replicas (serve/router.py)
 SITE_EMBED = "embed"  # embed engine hash/neighbor dispatches (dbscan_tpu/embed)
 _SITES = (
     SITE_DISPATCH, SITE_BANDED, SITE_SPILL, SITE_SPILL_LEVEL,
     SITE_STREAM, SITE_PULL, SITE_CELLCC, SITE_CAMPAIGN, SITE_SERVE,
-    SITE_EMBED, "*",
+    SITE_SERVE_REPLICA, SITE_EMBED, "*",
 )
+
+
+def shard_site(base: str, shard=None) -> str:
+    """The namespaced site token for ``base`` on shard/replica
+    ``shard``: ``base@<shard>`` for shard >= 1, ``base`` itself for
+    shard 0 or None. Shard 0 NORMALIZES to the bare token, so an
+    existing single-process spec (``serve#3:...``) addresses — and an
+    unsharded service consumes — exactly the ordinal stream it always
+    did (regression-pinned)."""
+    if not shard:
+        return base
+    return f"{base}@{int(shard)}"
 
 
 class FaultInjected(Exception):
@@ -112,14 +125,14 @@ class FatalDeviceFault(RuntimeError):
 
 @dataclasses.dataclass(frozen=True)
 class FaultClause:
-    site: str  # site token or "*"
+    site: str  # (possibly @shard-namespaced) site token, or "*"
     ordinal: int  # 0-based per-site dispatch ordinal ("*": global)
     kind: str
     count: int  # consecutive failing attempts (ignored for PERSISTENT)
 
 
 _CLAUSE_RE = re.compile(
-    r"^(?P<site>[a-z_*]+)#(?P<ord>\d+):(?P<kind>[A-Z_]+)"
+    r"^(?P<site>[a-z_*]+)(?:@(?P<shard>\d+))?#(?P<ord>\d+):(?P<kind>[A-Z_]+)"
     r"(?:\*(?P<count>\d+))?$"
 )
 
@@ -127,12 +140,22 @@ _CLAUSE_RE = re.compile(
 def parse_fault_spec(spec: str) -> Tuple[FaultClause, ...]:
     """Parse ``DBSCAN_FAULT_SPEC``.
 
-    Grammar: semicolon-separated clauses ``site#ordinal:KIND[*count]``:
+    Grammar: semicolon-separated clauses
+    ``site[@shard]#ordinal:KIND[*count]``:
 
     - ``site``: ``dispatch`` | ``banded`` | ``spill`` | ``spill_level``
       | ``stream`` | ``pull`` | ``cellcc_cc`` | ``campaign`` | ``serve``
-      | ``embed`` | ``*`` (any supervised site, ordinal counted
-      globally). The ``embed`` site is consumed per embed-engine device
+      | ``serve_replica`` | ``embed`` | ``*`` (any supervised site,
+      ordinal counted globally). The sharded serving sites accept an
+      ``@<shard>`` namespace — ``serve@2#0:TRANSIENT`` is the first
+      supervised step on ingest shard 2, ``serve_replica@1#0:PERSISTENT``
+      kills query replica 1's first routed dispatch — each namespaced
+      token owning its OWN deterministic ordinal stream, so a drill
+      stays reproducible across a fleet of shard threads whose global
+      interleaving is not. ``@0`` normalizes to the bare token: bare
+      ``serve#N`` means shard 0, and an existing single-process spec
+      consumes ordinals exactly as before (regression-pinned). The
+      ``embed`` site is consumed per embed-engine device
       dispatch (the hash pass, then one ordinal per bucket neighbor
       dispatch, dbscan_tpu/embed): transients heal with backoff, a
       PERSISTENT neighbor fault degrades that bucket to the numpy host
@@ -176,13 +199,19 @@ def parse_fault_spec(spec: str) -> Tuple[FaultClause, ...]:
             raise ValueError(
                 f"bad DBSCAN_FAULT_SPEC site {site!r}: one of {_SITES}"
             )
+        shard = m.group("shard")
+        if shard is not None and site == "*":
+            raise ValueError(
+                "bad DBSCAN_FAULT_SPEC clause: '*' matches every site "
+                "and cannot take an @shard namespace"
+            )
         if kind not in _KINDS:
             raise ValueError(
                 f"bad DBSCAN_FAULT_SPEC kind {kind!r}: one of {_KINDS}"
             )
         clauses.append(
             FaultClause(
-                site=site,
+                site=shard_site(site, int(shard or 0)),
                 ordinal=int(m.group("ord")),
                 kind=kind,
                 count=int(m.group("count") or 1),
@@ -301,14 +330,26 @@ def campaign_site_active() -> bool:
 
 def serve_site_active() -> bool:
     """True when the active fault spec names the ``serve`` site
-    explicitly. The ClusterService consumes one ``serve`` ordinal per
-    ingest step and per query dispatch ONLY then — the same opt-in
-    discipline as :func:`pull_site_active`: an unconditional consume
+    explicitly (shard 0's bare token — sharded services check their own
+    namespaced token via :func:`site_active`). The ClusterService
+    consumes one ``serve`` ordinal per ingest step and per query
+    dispatch ONLY then — the same opt-in discipline as
+    :func:`pull_site_active`: an unconditional consume
     would shift the global (``*``-clause) ordinal stream, and would
     interleave nondeterministically, since ingest ordinals are consumed
     on the service's ingest thread while query ordinals are consumed on
     whatever reader thread asked."""
-    return any(c.site == SITE_SERVE for c in get_registry().clauses)
+    return site_active(SITE_SERVE)
+
+
+def site_active(site: str) -> bool:
+    """True when the active fault spec names exactly this (possibly
+    ``@shard``-namespaced) site token. The sharded serving sites
+    (``serve@<shard>``, ``serve_replica@<replica>``) opt in per token:
+    a drill naming shard 1 makes ONLY shard 1 consume ordinals, so
+    every shard's stream stays deterministic regardless of how the
+    shard threads interleave."""
+    return any(c.site == site for c in get_registry().clauses)
 
 
 class FaultCounters:

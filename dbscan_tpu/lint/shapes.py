@@ -437,6 +437,25 @@ def _models() -> Dict[str, FamilyModel]:
                 "runtime-gated",
             ),
             FamilyModel(
+                "serve.broadcast",
+                [
+                    ArgModel("spts", ("K", "D"), FLOAT),
+                    ArgModel("sids", ("K",), INT),
+                ],
+                # temps/outs: one owned copy of each input on the
+                # replica's device (the identity-plus-zero transfer —
+                # the replica must not alias the publisher's buffers).
+                # K is the ladder-padded skeleton — data-scaled,
+                # runtime-gated like serve.query.
+                overhead=_sy("K") * _sy("D") * 8 + _sy("K") * 4,
+                static_slots=None,
+                note="per-replica consistent-cut skeleton broadcast "
+                "(dbscan_tpu/serve/router.py): one dispatch per "
+                "non-empty shard per live replica per published cut; "
+                "padded at publish time, so steady-state broadcasts "
+                "compile ZERO new kernels",
+            ),
+            FamilyModel(
                 "serve.jobs",
                 [
                     ArgModel("pts", ("J", "S", "D"), FLOAT),

@@ -571,6 +571,12 @@ def _serve_rollup(counters: dict, spans: list) -> dict:
 
         out["serve.query_p50_ms"] = round(_pct(0.50) * 1e3, 3)
         out["serve.query_p99_ms"] = round(_pct(0.99) * 1e3, 3)
+    shed = out.get("serve.router.shed", 0)
+    routed = out.get("serve.router.routed", 0)
+    if shed or routed:
+        # the router's admission figure: refused / offered — the same
+        # arithmetic the bench row stamps as serve_shed_frac
+        out["serve.shed_frac"] = round(shed / (shed + routed), 6)
     return out
 
 

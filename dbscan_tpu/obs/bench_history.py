@@ -85,7 +85,7 @@ _SUFFIXES = (
     "_seconds", "_s", "_mpts", "_vs_baseline", "_overlap_ratio",
     "_pred_ratio", "_spill_levels", "_busy_frac", "_cc_iters",
     "_replay_frac", "_qps", "_ms", "_ari", "_prop_sweeps",
-    "_vs_default_speedup",
+    "_vs_default_speedup", "_shed_frac",
 )
 # numeric-but-not-perf keys the suffix rule would otherwise catch —
 # declared with the telemetry schema (the keys are fault-counter
@@ -116,7 +116,13 @@ def _unit_for(metric: str, obj: dict) -> Optional[str]:
     if metric == "value":
         return obj.get("unit")
     if metric.endswith(
-        ("_overlap_ratio", "_pred_ratio", "_busy_frac", "_replay_frac")
+        (
+            "_overlap_ratio",
+            "_pred_ratio",
+            "_busy_frac",
+            "_replay_frac",
+            "_shed_frac",
+        )
     ):
         return "ratio"
     if metric.endswith("_spill_levels"):

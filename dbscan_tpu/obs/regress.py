@@ -80,6 +80,11 @@ def direction(metric: str, unit: Optional[str] = None) -> Optional[str]:
         # regresses DOWN — and it must be matched BEFORE the "_s"
         # seconds rule below catches the suffix
         return HIGHER_BETTER
+    if metric.endswith("_shed_frac"):
+        # router load-shed fraction (shed / (shed + routed),
+        # serve/router.py): capacity the fleet turned away — more
+        # shedding at the same offered load regresses UP like a wall
+        return LOWER_BETTER
     if metric.endswith("_qps"):
         # serving query rate under concurrent ingest: regresses DOWN
         return HIGHER_BETTER

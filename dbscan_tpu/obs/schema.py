@@ -53,6 +53,7 @@ COMPILE_FAMILIES = (
     "halo.merge",
     "serve.query",
     "serve.jobs",
+    "serve.broadcast",
     "embed.hash",
     "embed.neighbors",
 )
@@ -178,6 +179,23 @@ COUNTERS = {
     "(pad-and-stack fan-ins, not per-job dispatches)",
     "serve.jobs_rejected": "tenant jobs rejected at admission (HBM "
     "price over DBSCAN_SERVE_HEADROOM_BYTES, or oversized)",
+    "serve.router.routed": "query batches the router accepted and "
+    "answered (replica dispatch or host fallback) — the shed-fraction "
+    "denominator's accepted leg",
+    "serve.router.shed": "query batches refused under p99 shed "
+    "pressure (rolling p99 past DBSCAN_SERVE_SHED_P99_MS and the "
+    "batch's priced cost over the shrunk admission window)",
+    "serve.router.failovers": "in-flight queries re-routed to a "
+    "surviving replica after a persistent replica fault (the pinned "
+    "cut re-dispatched, never re-pinned)",
+    "serve.router.host_fallbacks": "router queries answered by the "
+    "numpy union oracle because no live replica remained",
+    "serve.replica.evictions": "query replicas evicted from the live "
+    "set after a persistent serve_replica fault",
+    "serve.broadcast.casts": "per-replica cut broadcasts completed "
+    "(one per live replica per published cut)",
+    "serve.broadcast.bytes": "host bytes of skeleton state shipped by "
+    "cut broadcasts (pre-padding payload, summed over shards)",
     "serve.admit_splits": "job batches split because the stacked "
     "HBM price would breach the admission headroom",
     "checkpoint.serve_saves": "serve state checkpoints written by "
@@ -251,6 +269,15 @@ GAUGES = {
     "half-merged update",
     "serve.resident_points": "skeleton core points in the published "
     "query snapshot",
+    "serve.cut_id": "the sharded service's last published consistent-"
+    "cut id (each shard publish folds a new epoch VECTOR; readers pin "
+    "one cut, never a blend of two)",
+    "serve.router.replicas_live": "query replicas currently in the "
+    "router's live set (drops on eviction — the read mesh re-sharding "
+    "over the survivors)",
+    "serve.router.p99_ms": "rolling p99 of answered router queries at "
+    "the last shed-pressure evaluation (only sampled while past the "
+    "declared bound)",
     "embed.sample_frac": "sampled-edge keep probability of the last "
     "embed run (1.0 = exact path) — the declared accuracy knob the "
     "analyzer's sampled-edge fraction reads back",
@@ -298,6 +325,8 @@ SPANS = {
     "snapshot (epoch + point count attached)",
     "serve.job_batch": "one pad-and-stack serve.jobs dispatch window "
     "(job count + padded shape attached)",
+    "serve.route": "one routed query batch end-to-end (pin cut, pick "
+    "replica, dispatch, failovers included; point count attached)",
     "transfer.pull": "device->host pull (bytes in args)",
     "stream.update": "streaming micro-batch update step",
     "embed.run": "root span over one embed-engine run",
@@ -350,6 +379,12 @@ EVENTS = {
     "query snapshot (epoch + skeleton size attached)",
     "serve.admit_reject": "the admission controller rejected a tenant "
     "job (predicted bytes + headroom attached)",
+    "serve.cut_publish": "a shard publish folded a new consistent cut "
+    "(publishing shard, cut id, epoch vector attached)",
+    "serve.replica.evict": "a query replica left the live set after a "
+    "persistent fault (replica, survivor count, error attached)",
+    "serve.router.failover": "an in-flight query re-routed its pinned "
+    "cut to a surviving replica (replica + cut id attached)",
     "profile.window_open": "jax.profiler capture window opened at a "
     "tracked dispatch (DBSCAN_PROFILE_WINDOW)",
     "profile.window_close": "jax.profiler capture window closed "

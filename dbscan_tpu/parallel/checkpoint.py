@@ -26,6 +26,7 @@ from __future__ import annotations
 import contextlib
 import hashlib
 import json
+import logging
 import os
 import time
 import zipfile
@@ -39,6 +40,8 @@ except ImportError:  # pragma: no cover - non-posix fallback (no locking)
     fcntl = None
 
 from dbscan_tpu import config, obs
+
+logger = logging.getLogger(__name__)
 
 _FORMAT_VERSION = 1
 _NPZ = "premerge.npz"
@@ -356,28 +359,48 @@ def p1_chunk_indices(
 _SERVE_NPZ = "serve_state.npz"
 
 
+def _serve_path(ckpt_dir: str, shard: Optional[int]) -> str:
+    """The per-shard serve checkpoint path: the obs.flush() shard-suffix
+    convention (``<path>.<shard>``) so N ingest shards of one sharded
+    service can never clobber each other's snapshot; an unsharded
+    service (shard None) keeps the historical unsuffixed name."""
+    base = os.path.join(ckpt_dir, _SERVE_NPZ)
+    return base if shard is None else f"{base}.{int(shard)}"
+
+
 def save_serve(
     ckpt_dir: str,
     fingerprint: str,
     arrays: dict,
     scalars: dict,
     quiet: bool = False,
+    shard: Optional[int] = None,
+    n_shards: int = 1,
 ) -> str:
     """Atomically persist one serve/stream state snapshot; returns the
     written path. Signal-handler safe by construction with ``quiet``
     set: one tmp write + rename, no locks taken — the telemetry hooks
     (which DO take the registry locks) are skipped, because the
     SIGTERM-interrupted frame may already hold them. The arrays are an
-    immutable published snapshot, never live mutable state."""
+    immutable published snapshot, never live mutable state.
+
+    ``shard``/``n_shards``: sharded services write one suffixed file
+    per ingest shard (:func:`_serve_path`) with the shard layout
+    embedded next to the stream fingerprint, so a resume under a
+    DIFFERENT shard count refuses instead of silently adopting a
+    partition's identity state as the whole stream's."""
     t0 = time.perf_counter()
     os.makedirs(ckpt_dir, exist_ok=True)
-    path = os.path.join(ckpt_dir, _SERVE_NPZ)
+    path = _serve_path(ckpt_dir, shard)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(
             f,
             _fingerprint=np.array(fingerprint),
             _scalars=np.array(json.dumps(scalars)),
+            _shards=np.array(
+                [int(shard) if shard is not None else 0, int(n_shards)]
+            ),
             **arrays,
         )
     os.replace(tmp, path)
@@ -391,16 +414,40 @@ def save_serve(
     return path
 
 
-def load_serve(ckpt_dir: str, fingerprint: str) -> Optional[dict]:
+def load_serve(
+    ckpt_dir: str,
+    fingerprint: str,
+    shard: Optional[int] = None,
+    n_shards: int = 1,
+) -> Optional[dict]:
     """Load a serve state matching ``fingerprint``; None when absent,
     torn, or written for a different stream config (resume must never
-    be less safe than starting a fresh stream)."""
-    path = os.path.join(ckpt_dir, _SERVE_NPZ)
+    be less safe than starting a fresh stream). A shard-count mismatch
+    — the file was written by a service sharded differently than the
+    caller — REFUSES with a warning rather than part-loading: adopting
+    one layout's partition state under another layout would relabel,
+    the one failure the serving contract forbids. (Files written before
+    the shard fingerprint existed carry no ``_shards`` entry and only
+    load unsharded, the layout they were written under.)"""
+    path = _serve_path(ckpt_dir, shard)
     if not os.path.exists(path):
         return None
+    want_shard = int(shard) if shard is not None else 0
     try:
         with np.load(path) as z:
             if str(z["_fingerprint"]) != fingerprint:
+                return None
+            if "_shards" in z.files:
+                got_shard, got_n = (int(v) for v in z["_shards"])
+            else:
+                got_shard, got_n = 0, 1
+            if got_shard != want_shard or got_n != int(n_shards):
+                logger.warning(
+                    "serve checkpoint %s was written as shard %d of %d "
+                    "but this service is shard %d of %d — refusing the "
+                    "restore (starting fresh identity state)",
+                    path, got_shard, got_n, want_shard, int(n_shards),
+                )
                 return None
             scalars = json.loads(str(z["_scalars"]))
             arrays = {

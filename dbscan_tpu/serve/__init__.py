@@ -13,18 +13,32 @@ system"):
   (serve/tenancy.py): thousands of small independent clustering jobs
   pad-and-stacked into single ``serve.jobs`` dispatches (zero
   recompiles across a mixed job stream), admission-priced against the
-  graftshape HBM model before anything is dispatched.
+  graftshape HBM model before anything is dispatched;
+- **distributed** — :class:`ShardedClusterService` (serve/sharded.py)
+  partitions the resident ingest across N shards publishing epoch-VECTOR
+  consistent cuts, and :class:`QueryRouter` (serve/router.py) replicates
+  reads across N failover replicas with cut broadcast and priced load
+  shedding — zero failed queries under any schedule of replica kills.
 
 ``python -m dbscan_tpu.serve`` serves a synthetic stream and prints
 health/QPS (serve/__main__.py); ``cli.py --serve`` runs the same demo.
 """
 
 from dbscan_tpu.serve.query import QueryAnswer, batched_query, query_host
+from dbscan_tpu.serve.router import QueryRouter, QueryShed
 from dbscan_tpu.serve.service import (
     ClusterService,
     QueryResult,
     Snapshot,
     stream_fingerprint,
+)
+from dbscan_tpu.serve.sharded import (
+    Cut,
+    ShardCut,
+    ShardedClusterService,
+    ShardedQueryResult,
+    cut_query_host,
+    shard_of,
 )
 from dbscan_tpu.serve.tenancy import (
     AdmissionController,
@@ -37,12 +51,20 @@ __all__ = [
     "AdmissionController",
     "AdmissionRejected",
     "ClusterService",
+    "Cut",
     "JobBatcher",
     "JobResult",
     "QueryAnswer",
     "QueryResult",
+    "QueryRouter",
+    "QueryShed",
+    "ShardCut",
+    "ShardedClusterService",
+    "ShardedQueryResult",
     "Snapshot",
     "batched_query",
+    "cut_query_host",
     "query_host",
+    "shard_of",
     "stream_fingerprint",
 ]

@@ -228,6 +228,8 @@ def batched_query(
     metric: str,
     floors: Optional[dict] = None,
     engine: Optional[pipe_mod.PullEngine] = None,
+    site: str = faults.SITE_SERVE,
+    host_fallback: bool = True,
 ) -> QueryAnswer:
     """Answer one query batch against a (pre-padded) skeleton snapshot.
 
@@ -235,7 +237,15 @@ def batched_query(
     at publish time); ``qpts`` is any [N, D] host array with the
     snapshot's clustering columns. Batches past
     ``DBSCAN_SERVE_QUERY_SLOTS`` split into consecutive dispatches.
-    ``engine``: see :func:`_dispatch_one`.
+    ``engine``: see :func:`_dispatch_one`. ``site``: the fault-spec
+    token this read leg consumes ordinals at when named — a sharded
+    service passes its ``serve@<shard>`` namespace, the router its
+    ``serve_replica@<replica>`` one, so each shard/replica drill owns a
+    deterministic stream (faults.shard_site). ``host_fallback``: when
+    True (default) a PERSISTENT fault degrades in place to
+    :func:`query_host`; the router passes False so the fault RAISES
+    ``FatalDeviceFault`` instead — a dead replica is evicted and the
+    query fails over, it does not silently degrade one shard's slice.
     """
     qpts = np.asarray(qpts, np.float64)
     n = len(qpts)
@@ -250,7 +260,7 @@ def batched_query(
             f"skeleton carries {spts.shape[1]}"
         )
     slots = max(_PAD, int(config.env("DBSCAN_SERVE_QUERY_SLOTS")))
-    drill = faults.serve_site_active()
+    drill = faults.site_active(site)
     for start in range(0, n, slots):
         stop = min(start + slots, n)
         q = stop - start
@@ -266,13 +276,13 @@ def batched_query(
             )
 
         if drill:
-            g, c, cn = faults.supervised(
-                faults.SITE_SERVE,
-                attempt,
-                fallback=lambda qp=qp, q=q: query_host(
+            fb = None
+            if host_fallback:
+                fb = lambda qp=qp, q=q: query_host(  # noqa: E731
                     qp[:q], spts, sids, eps, min_points, metric
-                ),
-                label=label,
+                )
+            g, c, cn = faults.supervised(
+                site, attempt, fallback=fb, label=label
             )
         else:
             g, c, cn = attempt(None)

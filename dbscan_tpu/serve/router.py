@@ -1,0 +1,439 @@
+"""Replicated query reads: cut broadcast, failover routing, load shed.
+
+The sharded service (serve/sharded.py) scales INGEST; this module
+scales and hardens READS. A :class:`QueryRouter` subscribes to the
+service's consistent-cut feed and maintains N query replicas — each a
+device-resident copy of every shard's ladder-padded skeleton plus its
+own dedicated PullEngine — then routes each query batch to one replica
+by a deterministic content hash. Three robustness behaviors live here:
+
+**Broadcast.** Every published cut transfers each shard's padded
+skeleton to each live replica as one ``serve.broadcast`` family
+dispatch (a jit identity-copy: the replica OWNS its skeleton, no
+aliasing of the publisher's buffers). The arrays are already
+ladder-padded at publish time, so after each replica warms its rungs
+the broadcast compiles ZERO new kernels — a bounded, compile-stable
+transfer, priced like everything else by a declared graftshape family
+model (lint/shapes.py).
+
+**Failover.** Each replica's dispatches run supervised at its own
+``serve_replica@<r>`` fault site (faults.shard_site): TRANSIENT faults
+heal in place (retry, replica keeps serving); a PERSISTENT fault
+raises ``FatalDeviceFault``, the router EVICTS the replica (it leaves
+the live set, its skeletons are dropped — the read mesh re-shards over
+the survivors the way campaign.train_resharded shrinks the batch
+ladder), and the in-flight query re-dispatches on the next live
+replica AGAINST THE SAME PINNED CUT — the cut's host arrays are
+immutable, so the answer the caller gets is the one its pinned epoch
+vector promised, regardless of which replica died under it. With no
+replica left the router degrades to the numpy union oracle
+(:func:`~dbscan_tpu.serve.sharded.cut_query_host`). Net contract,
+pinned by tests/test_serve_sharded.py: ZERO failed queries under any
+schedule of replica kills.
+
+**Load shed.** When the rolling p99 of answered queries drifts past
+``DBSCAN_SERVE_SHED_P99_MS`` (opt-in; 0 disables), the router sheds
+the EXPENSIVE tail instead of queueing it: each candidate batch is
+priced with the declared ``serve.query`` model (the admission
+controller's forward-pricing discipline, serve/tenancy.py) and
+admitted only if its price fits the headroom scaled down by
+``bound / p99`` — the further p99 drifts, the cheaper a batch must be
+to board. Shed queries raise :class:`QueryShed` (an admission refusal,
+not a failure) and count ``serve.router.shed``;
+``serve_shed_frac = shed / (shed + routed)`` is the bench/regression
+surface (obs/bench_history.py, LOWER is better).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+import zlib
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dbscan_tpu import config, faults, obs
+from dbscan_tpu.lint import tsan as _tsan
+from dbscan_tpu.obs import compile as obs_compile
+from dbscan_tpu.parallel import pipeline as pipe_mod
+from dbscan_tpu.serve import query as query_mod
+from dbscan_tpu.serve.sharded import (
+    Cut,
+    ShardedClusterService,
+    ShardedQueryResult,
+    combine_answers,
+    cut_query_host,
+)
+
+logger = logging.getLogger(__name__)
+
+BROADCAST_FAMILY = "serve.broadcast"
+
+
+class QueryShed(RuntimeError):
+    """The router refused a query batch under shed pressure: the
+    rolling p99 is past the declared bound and this batch's priced
+    cost does not fit the shrunk admission headroom. An ADMISSION
+    refusal (retry later / smaller), not a failed query."""
+
+    def __init__(self, price: int, allowed: int, p99: float, bound: float):
+        super().__init__(
+            f"serve.router: shed — rolling p99 {p99:.1f} ms is past the "
+            f"{bound:.1f} ms bound and this batch prices at {price} B "
+            f"vs the shrunk {allowed} B admission window"
+        )
+        self.price = int(price)
+        self.allowed = int(allowed)
+        self.p99 = float(p99)
+        self.bound = float(bound)
+
+
+@functools.lru_cache(maxsize=None)
+def _broadcast_builder():
+    """One compiled broadcast kernel (shared across replicas — the
+    cpp jit cache keys executables per destination device): an
+    identity-plus-zero copy so the replica owns fresh buffers rather
+    than aliasing the publisher's donated ones."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(spts, sids):
+        return spts + 0.0, sids + jnp.int32(0)
+
+    return fn
+
+
+class _Replica:
+    """One query replica: a device pin, a dedicated PullEngine, the
+    last broadcast cut and its device-resident skeletons, and its own
+    ``serve_replica@<idx>`` fault-ordinal namespace. Mutable fields
+    (``alive``/``cut``/``skel``) are guarded by the router lock."""
+
+    def __init__(self, idx: int, device):
+        self.idx = idx
+        self.device = device
+        self.site = faults.shard_site(faults.SITE_SERVE_REPLICA, idx)
+        self.alive = True
+        self.cut: Optional[Cut] = None
+        #: shard -> (device spts, device gsids) for self.cut
+        self.skel: Dict[int, Tuple] = {}
+        # dedicated engine, same rationale as the service's (query.py):
+        # replicas must not serialize behind each other's pulls
+        self.pull = (
+            pipe_mod.PullEngine(
+                inflight=int(config.env("DBSCAN_PULL_INFLIGHT"))
+            )
+            if config.env("DBSCAN_PULL_PIPELINE")
+            else None
+        )
+        self.floors: dict = {}  # per-replica [Q]-axis ladder ratchet
+
+
+class QueryRouter:
+    """Hash-routes query batches across N replicated readers of one
+    :class:`ShardedClusterService`, with broadcast, failover, and
+    priced load shedding (module docstring). Construct AFTER the
+    service (the router subscribes to its cut feed and starts warm),
+    close BEFORE discarding it. Usable as a context manager."""
+
+    def __init__(
+        self,
+        service: ShardedClusterService,
+        *,
+        replicas: Optional[int] = None,
+        devices: Optional[list] = None,
+        p99_window: int = 256,
+    ):
+        n = int(
+            replicas
+            if replicas is not None
+            else config.env("DBSCAN_SERVE_REPLICAS")
+        )
+        if n < 1:
+            raise ValueError(f"replicas must be >= 1, got {n}")
+        self._svc = service
+        self._lock = _tsan.lock("serve.router")
+        if devices is None:
+            try:
+                import jax
+
+                devices = list(jax.devices())
+            except Exception:  # pragma: no cover - jaxless host path
+                devices = [None]
+        self._replicas = [
+            _Replica(i, devices[i % len(devices)]) for i in range(n)
+        ]
+        self._last_cut_id = 0
+        self._lats = deque(maxlen=int(p99_window))
+        self._routed = 0
+        self._shed = 0
+        self._closed = False
+        self._headroom = int(config.env("DBSCAN_SERVE_HEADROOM_BYTES"))
+        obs.gauge("serve.router.replicas_live", n)
+        service.add_listener(self.publish_cut)
+
+    # --- lifecycle ------------------------------------------------------
+
+    def __enter__(self) -> "QueryRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        """Stop accepting broadcasts and join every replica's pull
+        engine (evicted replicas' engines are joined here too — an
+        evict must not block on a possibly-wedged worker)."""
+        with self._lock:
+            _tsan.access("serve.router")
+            if self._closed:
+                return
+            self._closed = True
+            replicas = list(self._replicas)
+        for r in replicas:
+            if r.pull is not None:
+                r.pull.close()
+
+    # --- broadcast side -------------------------------------------------
+
+    def publish_cut(self, cut: Cut) -> None:
+        """Transfer one published cut's shard skeletons to every live
+        replica (the service's cut listener — runs on the publishing
+        shard's ingest thread). Stale cut_ids are dropped: two shards
+        racing their listeners can never regress a replica, because a
+        later cut contains every earlier shard entry."""
+        import jax
+
+        with self._lock:
+            _tsan.access("serve.router")
+            if self._closed or cut.cut_id <= self._last_cut_id:
+                return
+            self._last_cut_id = cut.cut_id
+            live = [r for r in self._replicas if r.alive]
+        fn = _broadcast_builder()
+        for r in live:
+            skel: Dict[int, Tuple] = {}
+            nbytes = 0
+            for s, sc in enumerate(cut.shards):
+                if sc.k == 0:
+                    continue
+                sp, si = sc.spts, sc.gsids
+                if r.device is not None:
+                    sp = jax.device_put(sp, r.device)
+                    si = jax.device_put(si, r.device)
+                skel[s] = obs_compile.tracked_call(
+                    BROADCAST_FAMILY, fn, sp, si
+                )
+                nbytes += sc.spts.nbytes + sc.gsids.nbytes
+            with self._lock:
+                _tsan.access("serve.router")
+                # a replica evicted (or a newer cut landed) while we
+                # were transferring: drop, never regress
+                if r.alive and (r.cut is None or cut.cut_id > r.cut.cut_id):
+                    r.cut = cut
+                    r.skel = skel
+            obs.count("serve.broadcast.casts")
+            obs.count("serve.broadcast.bytes", nbytes)
+
+    # --- shed policy ----------------------------------------------------
+
+    def _price(self, n_q: int, cut: Cut, d: int) -> int:
+        """This batch's predicted dispatch bytes at its padded shapes:
+        the declared ``serve.query`` model evaluated at (padded Q,
+        summed padded K across non-empty shards, D) — the admission
+        controller's arithmetic pointed at the read path."""
+        from dbscan_tpu.lint.shapes import FAMILY_MODELS
+        from dbscan_tpu.parallel.binning import _ladder_width
+
+        qp = _ladder_width(max(n_q, 1), query_mod._PAD)
+        kp = sum(len(sc.gsids) for sc in cut.shards if sc.k > 0)
+        model = FAMILY_MODELS[query_mod.QUERY_FAMILY]
+        binding = {"Q": int(qp), "K": int(max(kp, 1)), "D": int(d)}
+        expr = model.input_expr() + model.overhead
+        return int(expr.substitute(binding).evaluate(binding))
+
+    def _rolling_p99(self) -> Optional[float]:
+        with self._lock:
+            _tsan.access("serve.router")
+            lats = list(self._lats)
+        if len(lats) < 8:  # not enough signal to declare drift
+            return None
+        return float(np.percentile(np.asarray(lats), 99))
+
+    def _shed_check(self, n_q: int, cut: Cut, d: int) -> None:
+        bound = float(config.env("DBSCAN_SERVE_SHED_P99_MS"))
+        if bound <= 0:
+            return
+        p99 = self._rolling_p99()
+        if p99 is None or p99 <= bound:
+            return
+        obs.gauge("serve.router.p99_ms", p99)
+        price = self._price(n_q, cut, d)
+        allowed = int(self._headroom * (bound / p99))
+        if price > allowed:
+            with self._lock:
+                _tsan.access("serve.router")
+                self._shed += 1
+            obs.count("serve.router.shed")
+            raise QueryShed(price, allowed, p99, bound)
+
+    @property
+    def shed_frac(self) -> float:
+        """Shed fraction over this router's lifetime:
+        ``shed / (shed + routed)`` (0.0 before any traffic)."""
+        with self._lock:
+            _tsan.access("serve.router")
+            total = self._shed + self._routed
+            return self._shed / total if total else 0.0
+
+    # --- query side -----------------------------------------------------
+
+    def _pick(self, key: int) -> Optional[_Replica]:
+        with self._lock:
+            _tsan.access("serve.router")
+            live = [r for r in self._replicas if r.alive]
+        if not live:
+            return None
+        return live[key % len(live)]
+
+    def _evict(self, r: _Replica, err: BaseException) -> None:
+        with self._lock:
+            _tsan.access("serve.router")
+            if not r.alive:
+                return
+            r.alive = False
+            r.cut = None
+            r.skel = {}
+            live = sum(1 for x in self._replicas if x.alive)
+        obs.count("serve.replica.evictions")
+        obs.gauge("serve.router.replicas_live", live)
+        obs.event(
+            "serve.replica.evict",
+            replica=r.idx,
+            live=live,
+            error=str(err)[:160],
+        )
+        logger.warning(
+            "serve.router: replica %d evicted after a persistent fault "
+            "(%s) — read mesh re-shards over %d survivor(s)",
+            r.idx, err, live,
+        )
+
+    def _replica_query(
+        self, r: _Replica, qpts: np.ndarray, cut: Cut
+    ) -> query_mod.QueryAnswer:
+        """One replica's answer at the PINNED cut: per-shard dispatches
+        through the replica's own engine at its own fault site, folded
+        by the union algebra. Uses the replica's device-resident
+        skeletons only when its broadcast cut IS the pinned cut;
+        otherwise (failover onto a replica mid-broadcast) the pinned
+        cut's immutable host arrays ride the same ladder shapes."""
+        cfg = self._svc.config
+        answers = []
+        for s, sc in enumerate(cut.shards):
+            if sc.k == 0:
+                continue
+            dev = r.skel.get(s) if r.cut is cut else None
+            sp, si = dev if dev is not None else (sc.spts, sc.gsids)
+            answers.append(
+                query_mod.batched_query(
+                    qpts,
+                    sp,
+                    si,
+                    cfg.eps,
+                    cfg.min_points,
+                    cfg.metric,
+                    floors=r.floors,
+                    engine=r.pull,
+                    site=r.site,
+                    host_fallback=False,
+                )
+            )
+        return combine_answers(answers, len(qpts), cfg.min_points)
+
+    def query(self, points: np.ndarray) -> ShardedQueryResult:
+        """Route one query batch: pin a cut, hash to a live replica,
+        answer there; on a persistent replica fault, evict and re-route
+        the SAME pinned cut to the next live replica; with none left,
+        answer from the numpy union oracle. Every accepted query gets
+        an answer exact for its pinned epoch vector — the zero-failed-
+        queries contract. Raises :class:`QueryShed` only as an
+        admission refusal under p99 pressure."""
+        pts = np.asarray(points, dtype=np.float64)
+        if pts.ndim != 2 or pts.shape[1] < 2:
+            raise ValueError(
+                f"query points must be [N, >=2], got {pts.shape}"
+            )
+        cfg = self._svc.config
+        ncols = 2 if cfg.metric == "euclidean" else pts.shape[1]
+        qpts = np.ascontiguousarray(pts[:, :ncols])
+        # deterministic content hash: the same batch always lands on
+        # the same replica (for a fixed live set), so drills replay
+        key = zlib.crc32(qpts.tobytes())
+        pinned: Optional[Cut] = None
+        t0 = time.perf_counter()
+        with obs.span("serve.route", points=int(len(pts))):
+            while True:
+                r = self._pick(key)
+                if r is None:
+                    break  # no replica left: host oracle below
+                if pinned is None:
+                    pinned = r.cut if r.cut is not None else self._svc.cut()
+                    self._shed_check(len(qpts), pinned, qpts.shape[1])
+                try:
+                    ans = self._replica_query(r, qpts, pinned)
+                except faults.FatalDeviceFault as err:
+                    self._evict(r, err)
+                    obs.count("serve.router.failovers")
+                    obs.event(
+                        "serve.router.failover",
+                        replica=r.idx,
+                        cut=int(pinned.cut_id),
+                    )
+                    continue  # re-route the pinned cut to a survivor
+                self._record(t0, replica=r.idx)
+                return ShardedQueryResult(
+                    ans.gids, ans.core, ans.counts, pinned.epochs
+                )
+            if pinned is None:
+                pinned = self._svc.cut()
+                self._shed_check(len(qpts), pinned, qpts.shape[1])
+            ans = cut_query_host(
+                qpts, pinned, cfg.eps, cfg.min_points, cfg.metric
+            )
+            obs.count("serve.router.host_fallbacks")
+            self._record(t0, replica=-1)
+            return ShardedQueryResult(
+                ans.gids, ans.core, ans.counts, pinned.epochs
+            )
+
+    def _record(self, t0: float, replica: int) -> None:
+        ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            _tsan.access("serve.router")
+            self._lats.append(ms)
+            self._routed += 1
+        obs.count("serve.router.routed")
+
+    def health(self) -> dict:
+        with self._lock:
+            _tsan.access("serve.router")
+            live = [r.idx for r in self._replicas if r.alive]
+            cut_ids = [
+                (r.cut.cut_id if r.cut is not None else 0)
+                for r in self._replicas
+            ]
+            shed, routed = self._shed, self._routed
+        total = shed + routed
+        return {
+            "replicas": len(self._replicas),
+            "live": live,
+            "replica_cut_ids": cut_ids,
+            "routed": routed,
+            "shed": shed,
+            "shed_frac": shed / total if total else 0.0,
+        }

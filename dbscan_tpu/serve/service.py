@@ -48,7 +48,7 @@ import logging
 import threading
 import time
 from collections import deque
-from typing import List, NamedTuple, Optional
+from typing import Callable, List, NamedTuple, Optional
 
 import numpy as np
 
@@ -133,7 +133,22 @@ class ClusterService:
         checkpoint_dir: Optional[str] = None,
         queue_depth: Optional[int] = None,
         snapshot_log: Optional[List[Snapshot]] = None,
+        shard: Optional[int] = None,
+        n_shards: int = 1,
+        on_publish: Optional[Callable[[int, Snapshot], None]] = None,
+        auto_restore: bool = True,
     ):
+        """``shard``/``n_shards``: this service is one ingest shard of a
+        :class:`~dbscan_tpu.serve.sharded.ShardedClusterService` — its
+        fault-spec ordinals consume the ``serve@<shard>`` namespaced
+        stream (shard 0 = the bare ``serve`` token, faults.shard_site)
+        and its checkpoints carry the shard-suffixed layout. Unsharded
+        (the default, shard None) behaves exactly as before.
+        ``on_publish(shard, snap)`` is called after every seqlock
+        publish — the sharded layer's consistent-cut assembly hook.
+        ``auto_restore=False`` defers checkpoint adoption to the caller
+        (the sharded layer restores all shards or none; see
+        :meth:`adopt_state`)."""
         if config_obj is None:
             config_obj = DBSCANConfig(
                 eps=eps,
@@ -158,6 +173,10 @@ class ClusterService:
         cfg = self._stream.config
         self._fingerprint = stream_fingerprint(cfg, self._stream.window)
         self._checkpoint_dir = checkpoint_dir
+        self._shard = shard
+        self._n_shards = max(1, int(n_shards))
+        self._site = faults.shard_site(faults.SITE_SERVE, shard)
+        self._on_publish = on_publish
         self._queue_max = max(
             1,
             int(
@@ -192,14 +211,28 @@ class ClusterService:
             if config.env("DBSCAN_PULL_PIPELINE")
             else None
         )
-        if checkpoint_dir is not None:
-            restored = ckpt_mod.load_serve(checkpoint_dir, self._fingerprint)
+        if checkpoint_dir is not None and auto_restore:
+            restored = ckpt_mod.load_serve(
+                checkpoint_dir,
+                self._fingerprint,
+                shard=self._shard,
+                n_shards=self._n_shards,
+            )
             if restored is not None:
-                self._stream.restore_state(restored)
-                obs.count("serve.restores")
-                self._publish(self._stream.export_state(), epoch=int(
-                    restored["scalars"].get("epoch", 0)
-                ))
+                self.adopt_state(restored)
+
+    def adopt_state(self, restored: dict) -> None:
+        """Adopt one loaded checkpoint state (checkpoint.load_serve)
+        and publish it as the resume epoch — the restore tail of
+        ``__init__``, split out so a sharded service can gate adoption
+        on EVERY shard's checkpoint being present first (all-or-nothing;
+        a partial restore would relabel across the shard boundary)."""
+        self._stream.restore_state(restored)
+        obs.count("serve.restores")
+        self._publish(
+            self._stream.export_state(),
+            epoch=int(restored["scalars"].get("epoch", 0)),
+        )
 
     # --- lifecycle ------------------------------------------------------
 
@@ -228,7 +261,8 @@ class ClusterService:
             self._stop_evt.clear()
             self._thread = threading.Thread(
                 target=self._ingest_loop,
-                name="dbscan-serve-ingest",
+                name="dbscan-serve-ingest"
+                + (f"-{self._shard}" if self._shard is not None else ""),
                 daemon=True,
             )
             self._thread.start()
@@ -349,9 +383,9 @@ class ClusterService:
             epoch=int(self._snap.epoch + 1),
             batch=int(len(batch)),
         ):
-            if faults.serve_site_active():
+            if faults.site_active(self._site):
                 upd = faults.supervised(
-                    faults.SITE_SERVE,
+                    self._site,
                     lambda _b: self._stream.update(batch),
                     label=f"ingest epoch {self._snap.epoch + 1}",
                 )
@@ -399,19 +433,42 @@ class ClusterService:
         obs.gauge("serve.epoch", snap.epoch)
         obs.gauge("serve.resident_points", snap.k)
         obs.event("serve.epoch_publish", epoch=snap.epoch, skeleton=snap.k)
+        if self._on_publish is not None:
+            # AFTER the seqlock settles: the sharded layer folds this
+            # shard's new epoch into the next published consistent cut
+            self._on_publish(
+                self._shard if self._shard is not None else 0, snap
+            )
 
     # --- query side -------------------------------------------------------
 
     def _read_snapshot(self) -> Snapshot:
         """Seqlock read: retry while a publish is in flight. The
         snapshot itself is immutable, so an even-seq reference IS a
-        consistent epoch."""
+        consistent epoch. The spin is BOUNDED by
+        ``DBSCAN_SERVE_READ_TIMEOUT_S``: a publish that never completes
+        (wedged writer — the seq stays odd) starves every reader, and a
+        reader that starves must say which writer wedged rather than
+        burn a core forever."""
+        deadline = None
         while True:
             s0 = self._seq
             if not (s0 & 1):
                 snap = self._snap
                 if self._seq == s0:
                     return snap
+            if deadline is None:
+                timeout = float(config.env("DBSCAN_SERVE_READ_TIMEOUT_S"))
+                deadline = time.monotonic() + timeout
+            elif time.monotonic() >= deadline:
+                shard = self._shard if self._shard is not None else 0
+                raise RuntimeError(
+                    f"serve: seqlock read starved for {timeout:.3g}s — "
+                    f"shard {shard}'s snapshot publish never completed "
+                    "(wedged writer holds an odd epoch); raise "
+                    "DBSCAN_SERVE_READ_TIMEOUT_S if the publish is "
+                    "legitimately that slow"
+                )
             time.sleep(0)  # yield to the publishing ingest thread
 
     def query(self, points: np.ndarray) -> QueryResult:
@@ -449,6 +506,7 @@ class ClusterService:
                     cfg.metric,
                     floors=self._floors,
                     engine=self._pull,
+                    site=self._site,
                 )
         obs.count("serve.queries")
         obs.count("serve.query_points", int(len(pts)))
@@ -483,6 +541,7 @@ class ClusterService:
         hbm = obs_memory.sample("serve.health")
         eng = self._pull if self._pull is not None else pipe_mod.get_engine()
         return {
+            "shard": self._shard,
             "epoch": snap.epoch,
             "n_updates": snap.n_updates,
             "queue_depth": depth,
@@ -514,6 +573,8 @@ class ClusterService:
             snap.state["arrays"],
             {**snap.state["scalars"], "epoch": int(snap.epoch)},
             quiet=quiet,
+            shard=self._shard,
+            n_shards=self._n_shards,
         )
         if not quiet:
             obs.count("serve.checkpoints")

@@ -1,0 +1,536 @@
+"""Sharded ClusterService: N resident ingest shards, one consistent cut.
+
+The PR-13 service is one process on one device — a single point of
+failure in the subsystem that faces the query load. This module is the
+ingest-scale axis of the distributed serving design (ROADMAP item 2):
+the resident streaming grid partitions over the mesh like the batch
+engines, with one :class:`~dbscan_tpu.serve.service.ClusterService`
+per partition (its own ingest thread, dedicated query-pull engine,
+seqlock, fault-ordinal namespace, and shard-suffixed checkpoint), and
+this layer owning two things the shards cannot own alone:
+
+**Routing.** Micro-batches split by a deterministic spatial hash of
+the ``8*eps`` grid cell (:func:`shard_of`) — the same grow-by-eps cell
+geometry the batch partitioner bins by — so a point's shard is a pure
+function of its coordinates and a resumed service routes every later
+batch identically (byte-identical labels, the serving contract's one
+hard rule). Per-shard stream ids are disjoint BY CONSTRUCTION:
+:func:`namespace_sids` strides shard ``s``'s local id ``l`` to the
+global ``(l - 1) * n_shards + s + 1``, so the cross-shard min-fold at
+query time stays the stream's own "elder id wins" rule.
+
+**The consistent cut.** Each shard publishes its own epoch under its
+own seqlock; a reader must never mix shard 0's epoch 7 with shard 1's
+half-published epoch 4. So the published unit here is an **epoch
+vector**: after every shard publish, the completing shard folds its new
+snapshot into a :class:`Cut` — the vector of every shard's CURRENT
+snapshot — under a second, cut-level seqlock (classic odd/even
+protocol, generalized to N writers by serializing publishers on the cut
+lock). Readers pin one cut (:meth:`ShardedClusterService.cut`) and are
+answered against exactly that vector: one completed update per shard,
+never a blend of two cuts. The spin is bounded by
+``DBSCAN_SERVE_READ_TIMEOUT_S`` and a starved reader names the shard
+whose publish wedged.
+
+Query semantics (the distributed serving contract, PARITY.md): the
+sharded service's density skeleton is the UNION of the per-shard
+skeletons at the pinned cut. Counts add across shards, the gid is the
+min-fold of the per-shard gids (associative and partition-independent,
+the same algebra the collective halo merge fixed-points over,
+arXiv:1912.06255), and the core flag is recomputed from the summed
+neighbor count. :func:`cut_query_host` is the numpy oracle for exactly
+this contract — what the router degrades to with no replica left, and
+what the drill tests pin device answers against.
+
+Replicated reads ride on top: serve/router.py subscribes via
+:meth:`add_listener`, broadcasts every published cut's ladder-padded
+skeletons to its query replicas, and fails over between them.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from dbscan_tpu import config, faults, obs
+from dbscan_tpu.config import DBSCANConfig, Engine, Precision
+from dbscan_tpu.lint import tsan as _tsan
+from dbscan_tpu.parallel import checkpoint as ckpt_mod
+from dbscan_tpu.parallel import mesh as mesh_mod
+from dbscan_tpu.serve import query as query_mod
+from dbscan_tpu.serve.service import ClusterService, Snapshot
+
+logger = logging.getLogger(__name__)
+
+#: min-fold identity for the cross-shard gid combine (gids are int64
+#: host-side; per-shard answers report 0 for "no adjacent skeleton")
+_NO_GID = np.int64(np.iinfo(np.int64).max)
+
+
+class ShardCut(NamedTuple):
+    """One shard's contribution to a published cut: its epoch and the
+    ladder-padded skeleton with GLOBALLY-namespaced ids — immutable, so
+    a pinned cut stays answerable forever (failover re-runs against it
+    on another replica without re-reading the shard)."""
+
+    epoch: int
+    spts: np.ndarray  # [Kp, D] ladder-padded skeleton core points
+    gsids: np.ndarray  # [Kp] int32 shard-strided global ids (0 = pad)
+    k: int  # valid skeleton rows
+    snap: Optional[Snapshot]
+
+
+class Cut(NamedTuple):
+    """One published epoch VECTOR: every shard's current snapshot at
+    one cut-seqlock publish. ``epochs`` rides every answer so a caller
+    can correlate results with per-shard ingest progress."""
+
+    cut_id: int
+    epochs: Tuple[int, ...]
+    shards: Tuple[ShardCut, ...]
+
+
+class ShardedQueryResult(NamedTuple):
+    gids: np.ndarray  # [N] int64 global stream ids; 0 = noise
+    core: np.ndarray  # [N] int8 would-be-core flag vs the union skeleton
+    counts: np.ndarray  # [N] int32 union-skeleton neighbors (self excl.)
+    epochs: Tuple[int, ...]  # the pinned cut's per-shard epoch vector
+
+
+def shard_of(points: np.ndarray, eps: float, n_shards: int) -> np.ndarray:
+    """Deterministic spatial routing: hash of the ``8*eps`` grid cell
+    of each point's first two (clustering) columns, mod the shard
+    count. Cells are 8 eps wide so a cluster's points mostly land on
+    one shard (locality), while the classic two-prime XOR hash spreads
+    cells evenly. Pure function of coordinates — the property the
+    byte-identical-resume contract needs."""
+    cell = np.floor(
+        np.asarray(points, np.float64)[:, :2] / (8.0 * float(eps))
+    ).astype(np.int64)
+    h = (cell[:, 0] * np.int64(73856093)) ^ (cell[:, 1] * np.int64(19349663))
+    return ((h % n_shards) + n_shards) % n_shards
+
+
+def namespace_sids(
+    sids: np.ndarray, shard: int, n_shards: int
+) -> np.ndarray:
+    """Stride shard-local stream ids into the disjoint global id space:
+    local ``l`` on shard ``s`` becomes ``(l - 1) * n_shards + s + 1``
+    (injective across shards, monotone per shard — the cross-shard
+    min-fold therefore still prefers elder local ids, tie-broken by
+    shard index). 0 (padding/noise) maps to 0."""
+    sids = np.asarray(sids)
+    if sids.size:
+        mx = int(sids.max())
+        if mx > 0 and (mx - 1) * n_shards + shard + 1 >= np.iinfo(np.int32).max:
+            raise ValueError(
+                "shard-strided stream ids exceeded int32 range; the "
+                "query kernel's device ids are i32"
+            )
+    out = np.where(
+        sids > 0,
+        (sids.astype(np.int64) - 1) * n_shards + shard + 1,
+        0,
+    )
+    return out.astype(np.int32)
+
+
+def combine_answers(
+    answers: List[query_mod.QueryAnswer], n: int, min_points: int
+) -> query_mod.QueryAnswer:
+    """Fold per-shard answers into the union-skeleton answer: counts
+    add, gid is the positive min across shards, and the core flag is
+    recomputed from the SUMMED self-inclusive neighbor count (a point
+    can be core against the union without being core against any one
+    shard's skeleton)."""
+    counts = np.zeros(n, np.int32)
+    gids = np.full(n, _NO_GID)
+    for a in answers:
+        counts += a.counts
+        gids = np.minimum(gids, np.where(a.gids > 0, a.gids, _NO_GID))
+    gids = np.where(gids == _NO_GID, np.int64(0), gids)
+    core = ((counts + 1) >= int(min_points)).astype(np.int8)
+    return query_mod.QueryAnswer(gids, core, counts)
+
+
+def cut_query_host(
+    qpts: np.ndarray, cut: Cut, eps: float, min_points: int, metric: str
+) -> query_mod.QueryAnswer:
+    """The numpy oracle of the distributed serving contract: answer
+    against the UNION of the pinned cut's shard skeletons — the router's
+    no-replica-left degradation path, and the reference every device
+    answer at this cut is pinned against."""
+    answers = [
+        query_mod.query_host(
+            qpts, sc.spts, sc.gsids, eps, min_points, metric
+        )
+        for sc in cut.shards
+        if sc.k > 0
+    ]
+    return combine_answers(answers, len(qpts), min_points)
+
+
+def _shard_meshes(mesh, n_shards: int) -> List:
+    """Partition one mesh's devices into contiguous per-shard slabs
+    (mesh.parts_spec geometry, one sub-mesh per ingest shard) — shards
+    must not share a mesh: each drives its own collective dispatches
+    from its own ingest thread, and interleaved collectives on one
+    device set would desync (streaming.py's single-writer rule, per
+    shard). Fewer devices than shards leaves the tail shards meshless
+    (single-device ingest)."""
+    if mesh is None:
+        return [None] * n_shards
+    devs = list(np.asarray(mesh.devices).flat)
+    slabs = np.array_split(np.arange(len(devs)), n_shards)
+    out = []
+    for slab in slabs:
+        if len(slab) == 0:
+            out.append(None)
+        else:
+            out.append(mesh_mod.make_mesh([devs[i] for i in slab]))
+    return out
+
+
+_EMPTY_SKEL = np.zeros((0, 2), np.float64)
+_EMPTY_IDS = np.zeros(0, np.int32)
+
+
+class ShardedClusterService:
+    """N-shard resident serving front: concurrent per-shard ingest,
+    epoch-vector consistent cuts, union-skeleton queries.
+
+    Lifecycle mirrors :class:`ClusterService`: construct (optionally
+    restoring per-shard checkpoints — all shards or none),
+    :meth:`start`, :meth:`submit` micro-batches from any thread while
+    readers call :meth:`query`; :meth:`stop` drains every shard,
+    checkpoints each under its shard suffix, and joins. Usable as a
+    context manager. ``cut_log`` (tests) records every published cut.
+    """
+
+    def __init__(
+        self,
+        eps: float,
+        min_points: int,
+        *,
+        n_shards: int = 2,
+        window: int = 3,
+        metric: str = "euclidean",
+        engine: Engine = Engine.ARCHERY,
+        precision: Precision = Precision.F32,
+        max_points_per_partition: int = 4096,
+        config_obj: Optional[DBSCANConfig] = None,
+        mesh=None,
+        checkpoint_dir: Optional[str] = None,
+        queue_depth: Optional[int] = None,
+        cut_log: Optional[List[Cut]] = None,
+    ):
+        if n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        self.n_shards = int(n_shards)
+        self._eps = float(eps)
+        self._min_points = int(min_points)
+        # cut seqlock state: N writer threads (one per shard) serialize
+        # on the lock; readers spin the odd/even protocol unlocked
+        self._cut_lock = _tsan.lock("serve.cut")
+        self._cut_seq = 0
+        self._publishing_shard: Optional[int] = None
+        empty = tuple(
+            ShardCut(0, _EMPTY_SKEL, _EMPTY_IDS, 0, None)
+            for _ in range(self.n_shards)
+        )
+        self._cut = Cut(0, (0,) * self.n_shards, empty)
+        self._cut_log = cut_log
+        self._listeners: List[Callable[[Cut], None]] = []
+        self._floors = {}  # [Q]-axis ladder ratchet for the read path
+        meshes = _shard_meshes(mesh, self.n_shards)
+        self._shards = [
+            ClusterService(
+                eps,
+                min_points,
+                window=window,
+                metric=metric,
+                engine=engine,
+                precision=precision,
+                max_points_per_partition=max_points_per_partition,
+                config_obj=config_obj,
+                mesh=meshes[s],
+                checkpoint_dir=checkpoint_dir,
+                queue_depth=queue_depth,
+                shard=s,
+                n_shards=self.n_shards,
+                on_publish=self._on_shard_publish,
+                auto_restore=False,
+            )
+            for s in range(self.n_shards)
+        ]
+        if checkpoint_dir is not None:
+            self._restore(checkpoint_dir)
+
+    def _restore(self, checkpoint_dir: str) -> None:
+        """All-or-nothing per-shard restore: a cut with some shards
+        resumed and others fresh would answer queries against a vector
+        no service ever published — refuse-and-warn, start every shard
+        fresh instead (the same contract load_serve applies to a
+        shard-count mismatch)."""
+        restored = [
+            ckpt_mod.load_serve(
+                checkpoint_dir,
+                svc._fingerprint,
+                shard=s,
+                n_shards=self.n_shards,
+            )
+            for s, svc in enumerate(self._shards)
+        ]
+        have = sum(r is not None for r in restored)
+        if have == 0:
+            return
+        if have < self.n_shards:
+            logger.warning(
+                "sharded serve checkpoint in %s is PARTIAL (%d of %d "
+                "shard files restorable) — refusing the restore and "
+                "starting every shard fresh; a half-restored cut would "
+                "relabel across the shard boundary",
+                checkpoint_dir, have, self.n_shards,
+            )
+            return
+        for svc, r in zip(self._shards, restored):
+            svc.adopt_state(r)
+
+    # --- lifecycle ------------------------------------------------------
+
+    @property
+    def config(self) -> DBSCANConfig:
+        return self._shards[0]._stream.config
+
+    def start(self) -> "ShardedClusterService":
+        for svc in self._shards:
+            svc.start()
+        return self
+
+    def stop(self, checkpoint: bool = True, timeout: float = 60.0) -> None:
+        for svc in self._shards:
+            svc.stop(checkpoint=checkpoint, timeout=timeout)
+
+    def __enter__(self) -> "ShardedClusterService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # --- ingest side ----------------------------------------------------
+
+    def submit(
+        self, batch: np.ndarray, *, block: bool = True, timeout=None
+    ) -> bool:
+        """Route one micro-batch across the shards (:func:`shard_of`)
+        and enqueue each non-empty slice on its shard's ingest queue.
+        False when ANY shard refused its slice (backpressure, same
+        semantics as the unsharded service)."""
+        b = np.asarray(batch, dtype=np.float64)
+        if b.ndim != 2 or b.shape[1] < 2:
+            raise ValueError(f"batch must be [B, >=2], got {b.shape}")
+        if len(b) == 0:
+            return True
+        owner = shard_of(b, self._eps, self.n_shards)
+        ok = True
+        for s in range(self.n_shards):
+            rows = b[owner == s]
+            if len(rows) == 0:
+                continue
+            ok = (
+                self._shards[s].submit(rows, block=block, timeout=timeout)
+                and ok
+            )
+        return ok
+
+    def replay(self, batches) -> int:
+        """Resume helper: re-ingest the tail of a known batch sequence
+        after a restore, giving each shard EXACTLY the slices its
+        restored epoch says it has not ingested yet. Correct because
+        routing is a pure function of coordinates: shard ``s``'s epoch
+        counts the non-empty slices it completed, in sequence order, so
+        replay walks the sequence, re-derives each batch's slices, and
+        skips the first ``n_updates[s]`` non-empty ones. Returns the
+        number of slices actually re-submitted."""
+        done = [svc.health()["n_updates"] for svc in self._shards]
+        seen = [0] * self.n_shards
+        sent = 0
+        for b in batches:
+            b = np.asarray(b, dtype=np.float64)
+            owner = shard_of(b, self._eps, self.n_shards)
+            for s in range(self.n_shards):
+                rows = b[owner == s]
+                if len(rows) == 0:
+                    continue
+                seen[s] += 1
+                if seen[s] > done[s]:
+                    self._shards[s].submit(rows)
+                    sent += 1
+        return sent
+
+    def drain(self, timeout: float = 300.0) -> bool:
+        deadline = time.monotonic() + timeout
+        for svc in self._shards:
+            if not svc.drain(timeout=max(0.0, deadline - time.monotonic())):
+                return False
+        return True
+
+    def _on_shard_publish(self, shard: int, snap: Snapshot) -> None:
+        """Fold one shard's freshly-published snapshot into the next
+        consistent cut (runs on that shard's ingest thread — the N
+        writers of the cut seqlock, serialized by the cut lock)."""
+        sc = ShardCut(
+            epoch=snap.epoch,
+            spts=snap.spts,
+            gsids=namespace_sids(snap.sids, shard, self.n_shards),
+            k=snap.k,
+            snap=snap,
+        )
+        with self._cut_lock:
+            _tsan.access("serve.cut")
+            shards = list(self._cut.shards)
+            shards[shard] = sc
+            epochs = tuple(s.epoch for s in shards)
+            new = Cut(self._cut.cut_id + 1, epochs, tuple(shards))
+            self._publishing_shard = shard
+            self._cut_seq += 1  # odd: cut publish in flight
+            self._cut = new
+            self._cut_seq += 1  # even: stable
+            self._publishing_shard = None
+            if self._cut_log is not None:
+                self._cut_log.append(new)
+            listeners = tuple(self._listeners)
+        obs.gauge("serve.cut_id", new.cut_id)
+        obs.event(
+            "serve.cut_publish",
+            shard=shard,
+            cut=new.cut_id,
+            epochs=list(epochs),
+        )
+        # broadcast OUTSIDE the seqlock (device transfers under it
+        # would starve readers); listeners drop stale cut_ids, so two
+        # shards racing here can never regress a replica's cut
+        for fn in listeners:
+            fn(new)
+
+    # --- read side ------------------------------------------------------
+
+    def cut(self) -> Cut:
+        """Pin one published consistent cut (bounded seqlock read):
+        every shard's epoch in the returned vector comes from the same
+        publish — never a blend of two cuts."""
+        deadline = None
+        while True:
+            s0 = self._cut_seq
+            if not (s0 & 1):
+                cut = self._cut
+                if self._cut_seq == s0:
+                    return cut
+            if deadline is None:
+                timeout = float(config.env("DBSCAN_SERVE_READ_TIMEOUT_S"))
+                deadline = time.monotonic() + timeout
+            elif time.monotonic() >= deadline:
+                stale = self._publishing_shard
+                raise RuntimeError(
+                    f"serve: consistent-cut read starved for "
+                    f"{timeout:.3g}s — shard "
+                    f"{stale if stale is not None else '?'}'s cut "
+                    "publish never completed (wedged writer holds an "
+                    "odd cut epoch); raise DBSCAN_SERVE_READ_TIMEOUT_S "
+                    "if the publish is legitimately that slow"
+                )
+            time.sleep(0)  # yield to the publishing shard thread
+
+    def add_listener(self, fn: Callable[[Cut], None]) -> None:
+        """Subscribe to cut publishes (the router's broadcast feed);
+        the current cut is delivered immediately so a late subscriber
+        starts warm."""
+        with self._cut_lock:
+            _tsan.access("serve.cut")
+            self._listeners.append(fn)
+            cut = self._cut
+        if cut.cut_id:
+            fn(cut)
+
+    def query(self, points: np.ndarray) -> ShardedQueryResult:
+        """Answer one batch against the union skeleton of a pinned
+        consistent cut — the DIRECT read path (no router): one
+        ``serve.query`` dispatch per non-empty shard, each through that
+        shard's dedicated pull engine, folded by the cross-shard
+        min/sum algebra."""
+        pts = np.asarray(points, dtype=np.float64)
+        if pts.ndim != 2 or pts.shape[1] < 2:
+            raise ValueError(f"query points must be [N, >=2], got {pts.shape}")
+        cut = self.cut()
+        cfg = self.config
+        ncols = 2 if cfg.metric == "euclidean" else pts.shape[1]
+        qpts = pts[:, :ncols]
+        with obs.span(
+            "serve.query", cut=int(cut.cut_id), points=int(len(pts))
+        ):
+            answers = [
+                query_mod.batched_query(
+                    qpts,
+                    sc.spts,
+                    sc.gsids,
+                    cfg.eps,
+                    cfg.min_points,
+                    cfg.metric,
+                    floors=self._floors,
+                    engine=self._shards[s]._pull,
+                    site=self._shards[s]._site,
+                )
+                for s, sc in enumerate(cut.shards)
+                if sc.k > 0
+            ]
+            ans = combine_answers(answers, len(pts), cfg.min_points)
+        obs.count("serve.queries")
+        obs.count("serve.query_points", int(len(pts)))
+        return ShardedQueryResult(ans.gids, ans.core, ans.counts, cut.epochs)
+
+    def resolve(self, ids: np.ndarray) -> np.ndarray:
+        """Map previously-answered GLOBAL gids to their current
+        canonical ids: un-stride to the owning shard's local id space,
+        resolve through that shard's union-find, re-stride."""
+        ids = np.asarray(ids, np.int64)
+        out = ids.copy()
+        pos = ids > 0
+        owner = np.where(pos, (ids - 1) % self.n_shards, -1)
+        for s in range(self.n_shards):
+            mask = owner == s
+            if not mask.any():
+                continue
+            local = (ids[mask] - 1) // self.n_shards + 1
+            res = np.asarray(self._shards[s].resolve(local), np.int64)
+            out[mask] = np.where(
+                res > 0, (res - 1) * self.n_shards + s + 1, 0
+            )
+        return out
+
+    # --- health / checkpoint --------------------------------------------
+
+    def health(self) -> dict:
+        """Fleet poll endpoint: the cut id + epoch vector, plus every
+        shard's own health dict (queue depth, degradation, faults)."""
+        cut = self.cut()
+        shards = [svc.health() for svc in self._shards]
+        return {
+            "n_shards": self.n_shards,
+            "cut_id": cut.cut_id,
+            "epochs": list(cut.epochs),
+            "resident_points": int(sum(sc.k for sc in cut.shards)),
+            "degraded": [
+                s for s, h in enumerate(shards) if h["degraded"]
+            ],
+            "shards": shards,
+        }
+
+    def checkpoint(self, quiet: bool = False) -> List[Optional[str]]:
+        """Persist every shard's last published snapshot under its
+        shard-suffixed path; per-shard SIGTERM hooks do the same on the
+        flight recorder's signal path (each shard registered its own
+        hook at start())."""
+        return [svc.checkpoint(quiet=quiet) for svc in self._shards]

@@ -1,0 +1,803 @@
+"""dbscan_tpu/serve sharded+replicated: the distributed serving layer.
+
+Pins the distributed serving contract (PARITY.md "Distributed serving
+contract"):
+
+- deterministic spatial shard routing (pure function of coordinates)
+  and disjoint global id namespacing;
+- direct sharded queries exactly matching the union-skeleton numpy
+  oracle at the pinned consistent cut;
+- the epoch-VECTOR consistent-cut property under genuinely concurrent
+  multi-shard ingest (every pinned vector is a vector some publish
+  actually produced — never a blend of two cuts) — fuzzed;
+- bounded seqlock reads: a wedged publish starves readers into a
+  DBSCAN_SERVE_READ_TIMEOUT_S error NAMING the stale shard, at both
+  the per-shard and the cut level;
+- shard-suffixed serve checkpoints: roundtrip, shard-count fingerprint
+  refuse-and-warn, all-or-nothing partial-restore refusal;
+- ``site@<shard>#N`` fault ordinal namespacing (bare = shard 0
+  regression pin, ``*@N`` rejected, independent per-shard streams) and
+  a shard-TARGETED ingest drill degrading only its shard;
+- THE replica-kill acceptance drill: under a kill schedule taking every
+  replica down, every accepted query completes oracle-exact for its
+  pinned epoch vector — zero failed queries (failover chain ending in
+  the host union oracle); transient faults heal without eviction;
+- p99 load shedding via the declared serve.query family-model price
+  (QueryShed as an admission refusal, shed_frac accounting);
+- zero-recompile pin for the steady-state cut broadcast, and a
+  DBSCAN_SHAPECHECK=1 live run validating serve.broadcast clean;
+- the sharded SIGTERM subprocess drill: per-shard flight/checkpoint
+  artifacts, then a replay()-resumed service answering byte-identical
+  to an uninterrupted oracle run;
+- registration/promotion/direction pins for the new telemetry,
+  serve.broadcast family model, serve_shed_frac history promotion
+  (unit ratio, regresses UP), and the committed BENCH_SERVE_r02.json
+  gating green against bench/history.jsonl.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import faults
+from dbscan_tpu.serve import (
+    ClusterService,
+    QueryRouter,
+    QueryShed,
+    ShardedClusterService,
+    cut_query_host,
+    shard_of,
+)
+from dbscan_tpu.serve import query as query_mod
+from dbscan_tpu.serve import router as router_mod
+from dbscan_tpu.serve import sharded as sharded_mod
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EPS, MINPTS = 0.6, 5
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults(monkeypatch):
+    monkeypatch.setenv("DBSCAN_FAULT_BACKOFF_S", "0")
+    faults.reset_registry()
+    yield
+    faults.reset_registry()
+
+
+def _spec(monkeypatch, spec):
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", spec)
+    faults.reset_registry()
+
+
+def _blob(rng, center, n=60, s=0.25):
+    return rng.normal(center, s, size=(n, 2))
+
+
+def _batches(seed, k=4, n=70):
+    """k micro-batches spanning well-separated centers so every batch
+    slices onto multiple shards."""
+    rng = np.random.default_rng(seed)
+    centers = [(0, 0), (5, 0), (0, 5), (5, 5), (-4, 2), (2, -4)]
+    return [
+        np.concatenate([_blob(rng, c, n=n) for c in centers])
+        for _ in range(k)
+    ]
+
+
+def _svc(n_shards=2, **kw):
+    kw.setdefault("window", 2)
+    kw.setdefault("max_points_per_partition", 500)
+    return ShardedClusterService(EPS, MINPTS, n_shards=n_shards, **kw)
+
+
+# --- routing + namespacing --------------------------------------------
+
+
+def test_shard_routing_deterministic_partition(rng):
+    pts = rng.uniform(-20, 20, size=(5000, 2))
+    for n in (1, 2, 3, 7):
+        a = shard_of(pts, EPS, n)
+        b = shard_of(pts, EPS, n)
+        np.testing.assert_array_equal(a, b)  # pure function
+        assert a.min() >= 0 and a.max() < n
+        if n > 1:
+            assert len(np.unique(a)) == n  # all shards actually used
+    # cell-level: points in the same 8*eps cell always co-locate
+    cell = np.floor(pts / (8.0 * EPS))
+    sh = shard_of(pts, EPS, 3)
+    for c in np.unique(cell, axis=0)[:20]:
+        mask = (cell == c[None, :]).all(axis=1)
+        assert len(np.unique(sh[mask])) == 1
+
+
+def test_namespace_sids_disjoint_and_invertible():
+    ns = sharded_mod.namespace_sids
+    a = ns(np.array([1, 2, 3, 0]), 0, 3)
+    b = ns(np.array([1, 2, 3, 0]), 1, 3)
+    c = ns(np.array([1, 2, 3, 0]), 2, 3)
+    pos = np.concatenate([a[:3], b[:3], c[:3]])
+    assert len(set(pos.tolist())) == 9  # injective across shards
+    assert a[3] == b[3] == c[3] == 0  # 0 maps to 0
+    # invertible: shard = (g-1) % n, local = (g-1) // n + 1
+    for g, (s, l) in zip(pos, [(0, 1), (0, 2), (0, 3),
+                               (1, 1), (1, 2), (1, 3),
+                               (2, 1), (2, 2), (2, 3)]):
+        assert (int(g) - 1) % 3 == s and (int(g) - 1) // 3 + 1 == l
+    # elder-id min-fold preserved per shard: striding is monotone
+    assert a[0] < a[1] < a[2]
+    with pytest.raises(ValueError, match="int32"):
+        ns(np.array([2**30]), 1, 4)
+
+
+def test_fault_spec_shard_namespacing_pins():
+    # bare token: the pre-sharding grammar, pinned — and @0 NORMALIZES
+    # to it, so existing specs keep their exact ordinal streams
+    (c,) = faults.parse_fault_spec("serve#0:TRANSIENT")
+    assert c.site == "serve" and c.ordinal == 0
+    (c0,) = faults.parse_fault_spec("serve@0#0:TRANSIENT")
+    assert c0.site == "serve"
+    (c2,) = faults.parse_fault_spec("serve@2#1:PERSISTENT")
+    assert c2.site == "serve@2"
+    (cr,) = faults.parse_fault_spec("serve_replica@1#0:PERSISTENT")
+    assert cr.site == "serve_replica@1"
+    with pytest.raises(ValueError, match="cannot take an @shard"):
+        faults.parse_fault_spec("*@1#0:TRANSIENT")
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec("nosuchsite@1#0:TRANSIENT")
+    # per-namespace ordinal streams are independent
+    reg = faults.FaultRegistry("")
+    assert reg.next_ordinal("serve@1")[0] == 0
+    assert reg.next_ordinal("serve@1")[0] == 1
+    assert reg.next_ordinal("serve")[0] == 0  # untouched by shard 1's
+    assert reg.next_ordinal("serve@2")[0] == 0
+    assert faults.shard_site("serve", None) == "serve"
+    assert faults.shard_site("serve", 0) == "serve"
+    assert faults.shard_site("serve", 3) == "serve@3"
+
+
+# --- sharded query vs the union oracle --------------------------------
+
+
+def test_sharded_query_matches_union_oracle(rng):
+    log = []
+    svc = _svc(n_shards=3, cut_log=log)
+    with svc:
+        for b in _batches(7):
+            assert svc.submit(b)
+        assert svc.drain(timeout=300)
+        qpts = np.concatenate(
+            [_blob(rng, (0, 0), 40), rng.uniform(-25, 25, (60, 2))]
+        )
+        res = svc.query(qpts)
+        cut = svc.cut()
+    assert res.epochs == cut.epochs
+    want = cut_query_host(qpts, cut, EPS, MINPTS, "euclidean")
+    np.testing.assert_array_equal(res.gids, want.gids)
+    np.testing.assert_array_equal(res.core, want.core)
+    np.testing.assert_array_equal(res.counts, want.counts)
+    assert (res.gids > 0).any()  # the probe actually hit clusters
+    # and the union answer is NOT degenerate sharding: >1 shard holds
+    # skeleton mass at the final cut
+    assert sum(1 for sc in cut.shards if sc.k > 0) > 1
+    # resolve: global ids round-trip through the owning shard
+    rr = svc.resolve(res.gids)
+    assert ((rr > 0) == (res.gids > 0)).all()
+
+
+def test_epoch_vector_consistent_cut_fuzz(rng):
+    """THE consistent-cut property, fuzzed under concurrent multi-shard
+    ingest: every vector a reader ever pins is exactly the vector of
+    one published cut (cut_log is append-ordered under the cut lock),
+    and a single reader's pinned cut ids never go backwards."""
+    log = []
+    svc = _svc(n_shards=3, cut_log=log)
+    seen = [[] for _ in range(3)]
+    stop = threading.Event()
+
+    def reader(i):
+        while not stop.is_set():
+            c = svc.cut()
+            seen[i].append((c.cut_id, c.epochs))
+
+    threads = [
+        threading.Thread(target=reader, args=(i,), daemon=True)
+        for i in range(3)
+    ]
+    with svc:
+        [t.start() for t in threads]
+        for b in _batches(11, k=6, n=50):
+            svc.submit(b)
+        assert svc.drain(timeout=300)
+        stop.set()
+        [t.join(timeout=60) for t in threads]
+    assert len(log) >= 6  # at least one publish per batch per shard
+    # cut ids are dense, append-ordered, epoch vectors monotone
+    for i, cut in enumerate(log):
+        assert cut.cut_id == i + 1
+        if i:
+            prev = log[i - 1].epochs
+            assert all(a >= b for a, b in zip(cut.epochs, prev))
+            assert sum(cut.epochs) == sum(prev) + 1  # one shard stepped
+    published = {c.cut_id: c.epochs for c in log}
+    published[0] = (0,) * 3  # the pre-ingest empty cut
+    for reads in seen:
+        assert reads, "reader thread never pinned a cut"
+        last = -1
+        for cut_id, epochs in reads:
+            assert epochs == published[cut_id], (cut_id, epochs)
+            assert cut_id >= last  # a reader never observes regression
+            last = cut_id
+
+
+# --- seqlock starvation (bounded reads) -------------------------------
+
+
+def test_shard_seqlock_starvation_names_stale_shard(monkeypatch):
+    monkeypatch.setenv("DBSCAN_SERVE_READ_TIMEOUT_S", "0.2")
+    svc = ClusterService(
+        EPS, MINPTS, window=2, max_points_per_partition=500, shard=1,
+        n_shards=2,
+    )
+    svc._seq = 1  # wedged writer: publish never completes
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match=r"shard 1's snapshot publish"):
+        svc.query(np.zeros((4, 2)))
+    assert 0.1 < time.monotonic() - t0 < 5.0  # bounded, not a hang
+    svc._seq = 0
+    assert svc.query(np.zeros((4, 2))).epoch == 0  # recovered
+
+
+def test_cut_seqlock_starvation_names_stale_shard(monkeypatch):
+    monkeypatch.setenv("DBSCAN_SERVE_READ_TIMEOUT_S", "0.2")
+    svc = _svc(n_shards=3)
+    svc._cut_seq = 1
+    svc._publishing_shard = 2  # the wedged cut publisher
+    with pytest.raises(RuntimeError, match=r"shard 2's cut publish"):
+        svc.cut()
+    svc._cut_seq = 0
+    svc._publishing_shard = None
+    assert svc.cut().cut_id == 0
+
+
+# --- shard-suffixed checkpoints ---------------------------------------
+
+
+def test_shard_checkpoint_suffix_roundtrip(rng, tmp_path):
+    ck = str(tmp_path / "ck")
+    svc = _svc(n_shards=2, checkpoint_dir=ck)
+    with svc:
+        for b in _batches(23, k=3):
+            svc.submit(b)
+        assert svc.drain(timeout=300)
+        cut = svc.cut()
+        qpts = rng.uniform(-6, 6, (80, 2))
+        want = svc.query(qpts)
+    # stop() checkpointed each shard under its suffix
+    assert os.path.exists(os.path.join(ck, "serve_state.npz.0"))
+    assert os.path.exists(os.path.join(ck, "serve_state.npz.1"))
+    assert not os.path.exists(os.path.join(ck, "serve_state.npz"))
+    svc2 = _svc(n_shards=2, checkpoint_dir=ck)
+    assert svc2.cut().epochs == cut.epochs
+    got = svc2.query(qpts)
+    np.testing.assert_array_equal(got.gids, want.gids)
+    np.testing.assert_array_equal(got.counts, want.counts)
+
+
+def test_shard_checkpoint_mismatch_refused(rng, tmp_path, caplog):
+    import logging
+
+    ck = str(tmp_path / "ck")
+    svc = _svc(n_shards=2, checkpoint_dir=ck)
+    with svc:
+        for b in _batches(29, k=2):
+            svc.submit(b)
+        assert svc.drain(timeout=300)
+    # shard-count fingerprint: a 3-shard service must REFUSE the
+    # 2-shard files (different routing = different per-shard streams)
+    with caplog.at_level(logging.WARNING):
+        svc3 = _svc(n_shards=3, checkpoint_dir=ck)
+    assert svc3.cut().epochs == (0, 0, 0)
+    assert any("refusing the restore" in r.message for r in caplog.records)
+    caplog.clear()
+    # all-or-nothing: remove one shard file -> the whole restore is
+    # refused (a half-restored cut would relabel across the boundary)
+    os.remove(os.path.join(ck, "serve_state.npz.1"))
+    with caplog.at_level(logging.WARNING):
+        svc4 = _svc(n_shards=2, checkpoint_dir=ck)
+    assert svc4.cut().epochs == (0, 0)
+    assert any("PARTIAL" in r.message for r in caplog.records)
+
+
+# --- fault drills ------------------------------------------------------
+
+
+def test_shard_targeted_ingest_fault_degrades_one_shard(
+    rng, monkeypatch
+):
+    _spec(monkeypatch, "serve@1#0:PERSISTENT")
+    svc = _svc(n_shards=2)
+    with svc:
+        for b in _batches(31, k=3):
+            svc.submit(b)
+        assert svc.drain(timeout=300)
+        h = svc.health()
+        assert h["degraded"] == [1]  # ONLY the targeted shard marked
+        cut = svc.cut()
+        # the faulted update was dropped on shard 1 (its epoch lags by
+        # exactly the one killed ingest step); shard 0 is untouched —
+        # and BOTH keep ingesting after the mark (degraded, not dead)
+        assert cut.epochs[0] == 3 and cut.epochs[1] == 2
+        assert cut.shards[0].k > 0 and cut.shards[1].k > 0
+        qpts = rng.uniform(-6, 6, (50, 2))
+        res = svc.query(qpts)  # queries keep serving the union
+        want = cut_query_host(qpts, cut, EPS, MINPTS, "euclidean")
+        np.testing.assert_array_equal(res.gids, want.gids)
+
+
+def _oracle_for(res, cut_log, n_shards):
+    """The union oracle at an answer's PINNED epoch vector (unique in
+    the log: each publish steps exactly one coordinate)."""
+    if res.epochs == (0,) * n_shards:
+        return None  # pre-ingest empty cut: everything is noise
+    return next(c for c in cut_log if c.epochs == res.epochs)
+
+
+def test_replica_kill_drill_zero_failed_queries(rng, monkeypatch):
+    """THE acceptance drill: a kill schedule that takes down EVERY
+    replica (kill-on-first-touch), queries interleaved with live
+    ingest. Every accepted query must complete and be oracle-exact for
+    its pinned epoch vector — the failover chain drains each dying
+    replica onto the next, ending at the host union oracle."""
+    _spec(
+        monkeypatch,
+        "serve_replica@0#0:PERSISTENT;serve_replica@1#0:PERSISTENT;"
+        "serve_replica@2#0:PERSISTENT",
+    )
+    log = []
+    svc = _svc(n_shards=2, cut_log=log)
+    batches = _batches(37, k=4)
+    with svc:
+        svc.submit(batches[0])
+        assert svc.drain(timeout=300)
+        with QueryRouter(svc, replicas=3) as router:
+            answers = []
+            for i, b in enumerate(batches[1:]):
+                svc.submit(b)  # ingest stays live: cuts keep landing
+                for j in range(3):
+                    q = rng.uniform(-6, 6, (40 + 8 * j, 2))
+                    answers.append((q, router.query(q)))  # must not raise
+            assert svc.drain(timeout=300)
+            h = router.health()
+    # the schedule executed: every replica died, nothing failed
+    assert h["live"] == []
+    assert h["routed"] == len(answers) and h["shed"] == 0
+    for q, res in answers:
+        cut = _oracle_for(res, log, 2)
+        if cut is None:
+            assert not (res.gids > 0).any()
+            continue
+        want = cut_query_host(q, cut, EPS, MINPTS, "euclidean")
+        np.testing.assert_array_equal(res.gids, want.gids)
+        np.testing.assert_array_equal(res.core, want.core)
+        np.testing.assert_array_equal(res.counts, want.counts)
+
+
+def test_replica_transient_heals_no_eviction(rng, monkeypatch):
+    _spec(monkeypatch, "serve_replica@0#0:TRANSIENT")
+    svc = _svc(n_shards=2)
+    with svc:
+        for b in _batches(41, k=2):
+            svc.submit(b)
+        assert svc.drain(timeout=300)
+        with QueryRouter(svc, replicas=2) as router:
+            cut = svc.cut()
+            for _ in range(4):
+                q = rng.uniform(-6, 6, (30, 2))
+                res = router.query(q)
+                want = cut_query_host(q, cut, EPS, MINPTS, "euclidean")
+                np.testing.assert_array_equal(res.gids, want.gids)
+            h = router.health()
+    assert h["live"] == [0, 1]  # healed in place: nobody evicted
+    assert h["routed"] == 4
+
+
+def test_router_shed_under_p99_pressure(rng, monkeypatch):
+    svc = _svc(n_shards=2)
+    with svc:
+        for b in _batches(43, k=2):
+            svc.submit(b)
+        assert svc.drain(timeout=300)
+        with QueryRouter(svc, replicas=2) as router:
+            # warm the rolling-latency window while shedding is off
+            for _ in range(10):
+                router.query(rng.uniform(-6, 6, (16, 2)))
+            assert router.shed_frac == 0.0
+            # declare an unmeetable bound: every real latency is past
+            # it, so the admission window shrinks toward zero
+            monkeypatch.setenv("DBSCAN_SERVE_SHED_P99_MS", "1e-6")
+            with pytest.raises(QueryShed) as exc:
+                router.query(rng.uniform(-6, 6, (512, 2)))
+            assert exc.value.price > exc.value.allowed
+            assert exc.value.p99 > exc.value.bound
+            h = router.health()
+    assert h["shed"] == 1 and h["routed"] == 10
+    assert 0.0 < h["shed_frac"] < 1.0
+    assert router.shed_frac == pytest.approx(1.0 / 11.0)
+
+
+# --- compile stability + shapecheck -----------------------------------
+
+
+def test_broadcast_steady_state_zero_recompile(rng):
+    svc = _svc(n_shards=2)
+    with svc:
+        for b in _batches(47, k=3, n=50):
+            svc.submit(b)
+        assert svc.drain(timeout=300)
+        with QueryRouter(svc, replicas=2):
+            fn = router_mod._broadcast_builder()
+            misses0 = fn._cache_size()
+            # more steady-state publishes inside the warmed rungs:
+            # window retention keeps the skeleton in the same ladder
+            # rung, so every further broadcast reuses the signature
+            for b in _batches(53, k=3, n=50):
+                svc.submit(b)
+            assert svc.drain(timeout=300)
+            assert fn._cache_size() == misses0
+
+
+_SHAPECHECK_CHILD = r"""
+import os, sys
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+from dbscan_tpu.lint import shapecheck
+from dbscan_tpu.serve import QueryRouter, ShardedClusterService
+
+rng = np.random.default_rng(3)
+svc = ShardedClusterService(
+    0.6, 5, n_shards=2, window=2, max_points_per_partition=500
+)
+with svc:
+    for i in range(3):
+        svc.submit(np.concatenate([
+            rng.normal(c, 0.25, (70, 2))
+            for c in [(0, 0), (5, 0), (0, 5)]
+        ]))
+    assert svc.drain(timeout=300)
+    with QueryRouter(svc, replicas=2) as router:
+        for n in (32, 200):
+            router.query(rng.uniform(-6, 6, (n, 2)))
+rep = shapecheck.report()
+assert rep["enabled"], rep
+assert "serve.broadcast" in rep["sites"], sorted(rep["sites"])
+assert "serve.query" in rep["sites"], sorted(rep["sites"])
+assert rep["violations"] == [], rep
+print("SHAPECHECK_OK", sorted(rep["sites"]))
+"""
+
+
+def test_shapecheck_clean_on_sharded_serving(tmp_path):
+    env = dict(os.environ)
+    env.update(
+        DBSCAN_SHAPECHECK="1",
+        JAX_PLATFORMS="cpu",
+        DBSCAN_FAULT_SPEC="",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    out = subprocess.run(
+        [sys.executable, "-c", _SHAPECHECK_CHILD],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "SHAPECHECK_OK" in out.stdout
+
+
+def test_sharded_tsan_rerun_race_free(tmp_path):
+    """DBSCAN_TSAN=1 certification of the cut seqlock + router locks
+    under genuinely concurrent shard publishes, broadcasts, and routed
+    reads."""
+    report = tmp_path / "tsan.json"
+    code = (
+        "import threading\n"
+        "import numpy as np\n"
+        "from dbscan_tpu.serve import QueryRouter, ShardedClusterService\n"
+        "rng = np.random.default_rng(0)\n"
+        "svc = ShardedClusterService(0.6, 5, n_shards=2, window=2,"
+        " max_points_per_partition=500)\n"
+        "stop = threading.Event()\n"
+        "with svc:\n"
+        "    router = QueryRouter(svc, replicas=2)\n"
+        "    def reader():\n"
+        "        q = rng.uniform(-6, 6, (24, 2))\n"
+        "        while not stop.is_set():\n"
+        "            router.query(q)\n"
+        "    threads = [threading.Thread(target=reader, daemon=True)"
+        " for _ in range(2)]\n"
+        "    [t.start() for t in threads]\n"
+        "    for i in range(4):\n"
+        "        svc.submit(np.concatenate(["
+        "rng.normal(c, 0.25, (60, 2))"
+        " for c in [(0, 0), (5, 0), (0, 5)]]))\n"
+        "    assert svc.drain(timeout=300)\n"
+        "    stop.set()\n"
+        "    [t.join(timeout=60) for t in threads]\n"
+        "    router.close()\n"
+        "print('OK')\n"
+    )
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        DBSCAN_TSAN="1",
+        DBSCAN_TSAN_REPORT=str(report),
+        DBSCAN_FAULT_SPEC="",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout
+    rep = json.load(open(report))
+    assert rep["races"] == []
+    assert rep["lock_inversions"] == []
+
+
+# --- SIGTERM drill -----------------------------------------------------
+
+
+_DRILL_CHILD = r"""
+import os, sys, time
+import numpy as np
+
+ck, data, out_dir, mode = sys.argv[1:5]
+
+z = np.load(data)
+batches = [z[f"b{i}"] for i in range(6)]
+probe = z["probe"]
+
+from dbscan_tpu.serve import ShardedClusterService
+
+def save_answer(svc):
+    res = svc.query(probe)
+    np.savez(
+        os.path.join(out_dir, "answer.npz"),
+        gids=res.gids, core=res.core, counts=res.counts,
+        epochs=np.asarray(res.epochs, np.int64),
+    )
+
+if mode == "oracle":
+    svc = ShardedClusterService(
+        0.6, 5, n_shards=2, window=2, max_points_per_partition=500
+    )
+    with svc:
+        for b in batches:
+            svc.submit(b)
+        assert svc.drain(timeout=300)
+        save_answer(svc)
+    print("DONE", flush=True)
+    sys.exit(0)
+
+svc = ShardedClusterService(
+    0.6, 5, n_shards=2, window=2, max_points_per_partition=500,
+    checkpoint_dir=ck,
+)
+svc.start()
+if mode == "victim":
+    for i in range(3):
+        svc.submit(batches[i])
+        svc.drain()
+        print(f"CUT {svc.cut().cut_id}", flush=True)
+    # submit the 4th batch and DON'T drain: the parent SIGTERMs us
+    # while both shard ingest threads are inside update #4
+    svc.submit(batches[3])
+    print("READY", flush=True)
+    time.sleep(120)
+    print("UNREACHABLE", flush=True)
+else:
+    print("RESUME", list(svc.cut().epochs), flush=True)
+    sent = svc.replay(batches)
+    assert sent > 0  # the kill left SOMETHING to replay
+    assert svc.drain(timeout=300)
+    save_answer(svc)
+    svc.stop()
+print("DONE", flush=True)
+"""
+
+
+def test_sharded_sigterm_drill_resumes_byte_identical(tmp_path):
+    """The sharded robustness acceptance: SIGTERM mid-ingest dumps the
+    flight recording, checkpoints EVERY shard under its suffix, and a
+    replay()-resumed service converges to answers byte-identical to an
+    uninterrupted run's — per-shard epochs included."""
+    from dbscan_tpu.obs import flight
+
+    batches = _batches(59, k=6, n=60)
+    probe = np.random.default_rng(61).uniform(-6, 6, (120, 2))
+    ck = tmp_path / "ck"
+    out_dir = tmp_path / "out"
+    oracle_dir = tmp_path / "oracle"
+    out_dir.mkdir()
+    oracle_dir.mkdir()
+    data = tmp_path / "batches.npz"
+    np.savez(
+        data, probe=probe, **{f"b{i}": b for i, b in enumerate(batches)}
+    )
+    child = tmp_path / "child.py"
+    child.write_text(_DRILL_CHILD)
+    dump = tmp_path / "flight.json"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        DBSCAN_FLIGHTREC_PATH=str(dump),
+        DBSCAN_FAULT_SPEC="",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+
+    proc0 = subprocess.run(
+        [sys.executable, str(child), str(ck), str(data),
+         str(oracle_dir), "oracle"],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env,
+    )
+    assert proc0.returncode == 0, proc0.stderr
+
+    proc = subprocess.Popen(
+        [sys.executable, str(child), str(ck), str(data), str(out_dir),
+         "victim"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env=env,
+    )
+    deadline = time.monotonic() + 300
+    for line in proc.stdout:
+        if line.startswith("READY"):
+            break
+        assert time.monotonic() < deadline
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=120)
+    err = proc.stderr.read()
+    assert rc == -signal.SIGTERM, err
+    assert "UNREACHABLE" not in err
+
+    rep = flight.load(str(dump))
+    assert rep["reason"] == "SIGTERM"
+    # EVERY shard checkpointed under its suffix on the signal path
+    assert (ck / "serve_state.npz.0").exists()
+    assert (ck / "serve_state.npz.1").exists()
+
+    proc2 = subprocess.run(
+        [sys.executable, str(child), str(ck), str(data), str(out_dir),
+         "resume"],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env,
+    )
+    assert proc2.returncode == 0, proc2.stderr
+    resumed = json.loads(proc2.stdout.split("RESUME ", 1)[1].split("\n")[0])
+    assert min(resumed) >= 3  # the drained epochs survived the kill
+
+    got = np.load(out_dir / "answer.npz")
+    want = np.load(oracle_dir / "answer.npz")
+    for key in ("gids", "core", "counts", "epochs"):
+        np.testing.assert_array_equal(got[key], want[key])
+
+
+# --- registration / history / gate pins --------------------------------
+
+
+def test_registration_pins_sharded():
+    from dbscan_tpu import config
+    from dbscan_tpu.lint.shapes import FAMILY_MODELS
+    from dbscan_tpu.obs import schema
+
+    assert "serve.broadcast" in schema.COMPILE_FAMILIES
+    assert "serve.broadcast" in FAMILY_MODELS
+    model = FAMILY_MODELS["serve.broadcast"]
+    assert [a.dims for a in model.args] == [("K", "D"), ("K",)]
+    for name in (
+        "serve.router.routed", "serve.router.shed",
+        "serve.router.failovers", "serve.router.host_fallbacks",
+        "serve.replica.evictions", "serve.broadcast.casts",
+        "serve.broadcast.bytes", "compiles.serve.broadcast",
+    ):
+        assert schema.is_declared("counter", name), name
+    for name in (
+        "serve.cut_id", "serve.router.replicas_live",
+        "serve.router.p99_ms",
+    ):
+        assert schema.is_declared("gauge", name), name
+    assert schema.is_declared("span", "serve.route")
+    for name in (
+        "serve.cut_publish", "serve.replica.evict",
+        "serve.router.failover",
+    ):
+        assert schema.is_declared("event", name), name
+    for knob in (
+        "DBSCAN_SERVE_REPLICAS", "DBSCAN_SERVE_READ_TIMEOUT_S",
+        "DBSCAN_SERVE_SHED_P99_MS",
+    ):
+        assert knob in config.ENV_VARS, knob
+    assert faults.SITE_SERVE_REPLICA in faults._SITES
+
+
+def test_shed_frac_promotion_and_direction():
+    from dbscan_tpu.obs import analyze, bench_history, regress
+
+    cap = {
+        "metric": "serve",
+        "backend": "cpu",
+        "serve_r1_qps": 9.0,
+        "serve_r4_qps": 26.0,
+        "serve_r4_p99_ms": 310.0,
+        "serve_shed_frac": 0.03,
+        "serve_replicas": 4,  # not a perf key: must NOT promote
+    }
+    recs = bench_history.normalize_capture(cap, "t.json", "rev")
+    by = {r["metric"]: r for r in recs}
+    assert by["serve_shed_frac"]["unit"] == "ratio"
+    assert by["serve_r1_qps"]["unit"] == "queries/s"
+    assert "serve_replicas" not in by
+    # shed fraction is capacity turned away: it regresses UP
+    assert regress.direction("serve_shed_frac") == regress.LOWER_BETTER
+    assert regress.direction("serve_r4_qps") == regress.HIGHER_BETTER
+    hist = [
+        {"metric": "serve_shed_frac", "value": v, "backend": "cpu",
+         "resident_hot": None, "source": f"h{i}"}
+        for i, v in enumerate((0.02, 0.03, 0.04))
+    ]
+    bad = [{"metric": "serve_shed_frac", "value": 0.5, "backend": "cpu",
+            "resident_hot": None, "source": "f"}]
+    result = regress.compare(bad, hist, threshold=0.25)
+    assert {e["metric"] for e in result["regressions"]} == {
+        "serve_shed_frac"
+    }
+    # analyze derives the same figure from the router counters
+    out = analyze._serve_rollup(
+        {"serve.router.shed": 3, "serve.router.routed": 97}, []
+    )
+    assert out["serve.shed_frac"] == pytest.approx(0.03)
+
+
+def test_committed_serve_r02_capture_gates_green():
+    """BENCH_SERVE_r02.json (the replicated-serving capture) is in
+    bench/history.jsonl and gates green — and carries the acceptance
+    inequalities: QPS grows with the replica ladder, p99 well under the
+    ingest batch period."""
+    from dbscan_tpu.obs import bench_history, regress
+
+    cap_path = os.path.join(REPO, "BENCH_SERVE_r02.json")
+    hist_path = os.path.join(REPO, "bench", "history.jsonl")
+    assert os.path.exists(cap_path)
+    cap = json.load(open(cap_path))
+    row = (cap["runs"] if "runs" in cap else [cap])[0]
+    ladder = sorted(
+        int(k[len("serve_r"):-len("_qps")])
+        for k in row if k.startswith("serve_r") and k.endswith("_qps")
+    )
+    assert len(ladder) >= 2 and ladder[0] == 1
+    top = ladder[-1]
+    assert row[f"serve_r{top}_qps"] > row["serve_r1_qps"]
+    assert (
+        row[f"serve_r{top}_p99_ms"] / 1e3
+        < 0.5 * row["serve_rep_batch_period_s"]
+    )
+    assert 0.0 <= row["serve_shed_frac"] < 1.0
+    recs = bench_history.parse_capture_file(cap_path)
+    metrics = {r["metric"] for r in recs}
+    assert {
+        "serve_r1_qps", f"serve_r{top}_qps", "serve_shed_frac",
+    } <= metrics
+    history = bench_history.load_history(hist_path)
+    assert [
+        r for r in history if r["metric"] == f"serve_r{top}_qps"
+    ], "r02 not ingested into the committed history"
+    recs = [{**r, "source": "fresh-check"} for r in recs]
+    result = regress.compare(recs, history, threshold=0.25)
+    assert result["regressions"] == []
