@@ -39,6 +39,16 @@ regress-down) plus the subsampled-edge accuracy contract (`embed_ari`
 = sampled vs exact labels at BENCH_EMBED_SAMPLE_FRAC, gated
 regress-down against the declared floor — PARITY.md "Embed accuracy
 contract"). Knobs: BENCH_EMBED_{N,D,MAXPP,SAMPLE_FRAC,REPS}.
+
+`bench.py --hdbscan` is the standalone density-engine capture
+(dbscan_tpu/density): multi-density anchor (geomspaced blob scales —
+the workload plain DBSCAN's single eps cannot separate) recovered by
+hdbscan(), with throughput (`hdbscan_mpts`, gated regress-down),
+device-vs-construction ARI (`hdbscan_construction_ari`, gated
+regress-down), and the Borůvka contraction depth
+(`hdbscan_boruvka_rounds`, unit "rounds", gated regress-UP like
+`_spill_levels` — PARITY.md "Variable-density contract"). Knobs:
+BENCH_HDBSCAN_{N,MIN_PTS,REPS}.
 """
 
 import hashlib
@@ -1369,6 +1379,68 @@ def embed_row(prefix: str = "embed") -> dict:
     }
 
 
+def make_hdbscan_anchor(n: int):
+    """Engineered variable-density workload: K blobs whose scales span
+    a decade (no single eps labels them all — the density engine's
+    reason to exist) plus uniform noise. Returns (points f64,
+    blob_of [n_blob], K)."""
+    rng = np.random.default_rng(42)
+    k = max(6, n // 2000)
+    n_noise = n // 20
+    n_blob = n - n_noise
+    blob_of = rng.integers(0, k, n_blob)
+    centers = rng.uniform(0.0, 100.0, (k, 2))
+    scales = np.geomspace(0.05, 0.5, k)[rng.permutation(k)]
+    pts = centers[blob_of] + rng.normal(size=(n_blob, 2)) * (
+        scales[blob_of][:, None]
+    )
+    noise = rng.uniform(-5.0, 105.0, (n_noise, 2))
+    return np.concatenate([pts, noise]), blob_of, k
+
+
+def hdbscan_row(prefix: str = "hdbscan") -> dict:
+    """The density-engine capture (`bench.py --hdbscan`): HDBSCAN*
+    throughput (`hdbscan_mpts`, gated regress-down) + the Borůvka MST
+    round count (`hdbscan_boruvka_rounds`, gated regress-up as a
+    dispatch-depth figure, bounded by ceil(log2 n) + 2) over an
+    engineered multi-density workload, with construction ARI as the
+    correctness anchor. Same discipline as the other rows: full warm
+    run first (ladders/kernels settle), best-of-reps timed runs."""
+    from dbscan_tpu import hdbscan
+    from dbscan_tpu.utils.ari import adjusted_rand_index
+
+    n = int(os.environ.get("BENCH_HDBSCAN_N", "4000"))
+    min_pts = int(os.environ.get("BENCH_HDBSCAN_MIN_PTS", "10"))
+    reps = int(os.environ.get("BENCH_HDBSCAN_REPS", "2"))
+    pts, blob_of, k = make_hdbscan_anchor(n)
+    n_blob = len(blob_of)
+
+    hdbscan(pts, min_pts=min_pts)  # warm: settles ladders + kernels
+    dt = float("inf")
+    stats: dict = {}
+    for _ in range(max(1, reps)):
+        rep_stats: dict = {}
+        t0 = time.perf_counter()
+        labels = hdbscan(pts, min_pts=min_pts, stats_out=rep_stats)
+        dt_rep = time.perf_counter() - t0
+        if dt_rep < dt:
+            dt, stats = dt_rep, rep_stats
+    construction_ari = adjusted_rand_index(labels[:n_blob], blob_of)
+
+    return {
+        f"{prefix}_n": n,
+        f"{prefix}_min_pts": min_pts,
+        f"{prefix}_seconds": round(dt, 3),
+        f"{prefix}_mpts": round(n / dt / 1e6, 5),
+        f"{prefix}_clusters": int(len(np.unique(labels[labels > 0]))),
+        f"{prefix}_expect": k,
+        f"{prefix}_construction_ari": round(float(construction_ari), 6),
+        f"{prefix}_boruvka_rounds": int(stats.get("boruvka_rounds", 0)),
+        f"{prefix}_core_chunks": int(stats.get("core_chunks", 0)),
+        f"{prefix}_phases": _phases(stats),
+    }
+
+
 def anchor_row(prefix: str, n: int, kind: str, maxpp: int) -> dict:
     """One engineered-structure run: exact cluster count + construction
     ARI are the correctness anchor at scale (no oracle fits >=10M). Same
@@ -1530,6 +1602,25 @@ def main() -> None:
 
         cap = {"metric": "embed", "backend": _jax.default_backend()}
         cap.update(embed_row())
+        print(json.dumps(cap))
+        hist_path = os.environ.get("BENCH_HISTORY")
+        if hist_path:
+            try:
+                _history_gate_append(cap, hist_path)
+            except Exception as e:  # noqa: BLE001 — never cost the capture
+                sys.stderr.write(f"bench: history append failed: {e}\n")
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--hdbscan":
+        # standalone density-engine capture (BENCH_HDBSCAN_* knobs),
+        # printed as ONE JSON object and gate-then-appended to
+        # BENCH_HISTORY — hdbscan_mpts gates regress-down as a
+        # throughput, hdbscan_boruvka_rounds regress-up as a
+        # dispatch-depth figure
+        _ensure_live_backend()
+        import jax as _jax
+
+        cap = {"metric": "hdbscan", "backend": _jax.default_backend()}
+        cap.update(hdbscan_row())
         print(json.dumps(cap))
         hist_path = os.environ.get("BENCH_HISTORY")
         if hist_path:

@@ -63,6 +63,25 @@ def embed_dbscan(*args, **kwargs):
     return impl(*args, **kwargs)
 
 
+def hdbscan(*args, **kwargs):
+    """Lazy re-export of :func:`dbscan_tpu.density.hdbscan` — the
+    variable-density engine (device core distances + Borůvka
+    mutual-reachability MST + condensed-tree EOM labels;
+    dbscan_tpu/density)."""
+    from dbscan_tpu.density import hdbscan as impl
+
+    return impl(*args, **kwargs)
+
+
+def optics(*args, **kwargs):
+    """Lazy re-export of :func:`dbscan_tpu.density.optics` — the OPTICS
+    reachability ordering off the same sorted mutual-reachability MST
+    (dbscan_tpu/density)."""
+    from dbscan_tpu.density import optics as impl
+
+    return impl(*args, **kwargs)
+
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -74,6 +93,8 @@ __all__ = [
     "StreamingDBSCAN",
     "sparse_cosine_dbscan",
     "embed_dbscan",
+    "hdbscan",
+    "optics",
     "CORE",
     "BORDER",
     "NOISE",

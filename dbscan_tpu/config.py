@@ -573,6 +573,33 @@ ENV_VARS = _env_table(
         "partitioner.",
     ),
     EnvVar(
+        "DBSCAN_DENSITY_CHUNK", "int", 512,
+        "Packing-window chunk rows per density.core dispatch of the "
+        "density engine (dbscan_tpu/density): each chunk is one "
+        "[chunk, n_pad] core-distance slab, so this prices the "
+        "per-dispatch HBM slab against dispatch count (clamped to the "
+        "padded payload).",
+    ),
+    EnvVar(
+        "DBSCAN_DENSITY_ORACLE_MAX", "int", 100000,
+        "Largest point count the density engine will degrade whole to "
+        "the numpy host HDBSCAN*/OPTICS oracle after a persistent "
+        "density_boruvka fault; larger payloads re-raise instead of "
+        "running an O(n^2) host MST.",
+    ),
+    EnvVar(
+        "DBSCAN_DENSITY_AUTO_SAMPLE", "int", 4096,
+        "Subsample cap of the eps='auto' k-distance probe (plain "
+        "DBSCAN): an evenly-strided deterministic sample of at most "
+        "this many points feeds the per-strip knee selection.",
+    ),
+    EnvVar(
+        "DBSCAN_DENSITY_AUTO_PARTS", "int", 8,
+        "Coordinate strips the eps='auto' probe splits its subsample "
+        "into (the per-partition proxy); eps is the median of the "
+        "per-strip k-distance knees.",
+    ),
+    EnvVar(
         "DBSCAN_FAULT_SPEC", "str", "",
         "Deterministic fault-injection spec, semicolon-separated "
         "site#ordinal:KIND[*count] clauses (faults.parse_fault_spec).",

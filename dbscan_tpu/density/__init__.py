@@ -1,0 +1,296 @@
+"""dbscan_tpu/density — variable-density clustering (HDBSCAN*/OPTICS)
+on the shared kernel stack.
+
+Single-eps DBSCAN cannot label mixed-density payloads: any eps that
+resolves the dense tenants dissolves the sparse ones into noise.
+HDBSCAN* replaces the one global threshold with the full density
+hierarchy — and every primitive it needs already exists in this repo,
+which is what this engine family rides (ROADMAP item 4):
+
+1. ``density.core`` (``density/core.py``): k-th-neighbor core
+   distances, one dispatch per packing-window chunk over the
+   device-resident payload — the 2-D euclidean leg mirrors the banded
+   neighbor math, the cosine leg the embed similarity slabs;
+2. ``density.boruvka`` (``density/boruvka.py``): device Borůvka MST
+   over mutual-reachability edges — scatter-min cheapest-edge
+   selection + union-find contraction via ``ops/propagation.py``, one
+   dispatch per round, rounds <= ceil(log2 n);
+3. ``density.condense`` (``density/condense.py``): device sort of the
+   MST under the total edge order + lambda prefix, one thin PullEngine
+   pull, then the single-sweep condensed-tree build with
+   ``min_cluster_size`` pruning and excess-of-mass selection; OPTICS
+   reachability ordering falls out of the same sorted-MST pass;
+4. ``density/oracle.py``: the exact pure-NumPy host reference — the
+   parity bar AND the persistent-fault degradation target.
+
+Citizenship: the three dispatch families live in
+``obs/schema.COMPILE_FAMILIES`` and ``lint/shapes.FAMILY_MODELS``
+(shapecheck-validated live); the ``density_core``/``density_boruvka``
+fault sites heal transients and degrade persistents (per-chunk host
+fallback, whole-run oracle); ``DBSCAN_DENSITY_*`` knobs are declared
+in ``config.ENV_VARS``; ``bench.py --hdbscan`` commits the gated
+capture. Labels follow the canonical min-member-row contract from
+PR 8: clusters 1..K by smallest member row, noise 0 — and match the
+host oracle exactly (tests/test_density.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dbscan_tpu import config, faults, obs
+from dbscan_tpu.density import boruvka as boruvka_mod
+from dbscan_tpu.density import condense as condense_mod
+from dbscan_tpu.density import core as core_mod
+from dbscan_tpu.density import oracle as oracle_mod
+from dbscan_tpu.parallel.binning import _ladder_width, _ratchet
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["hdbscan", "optics", "auto_eps"]
+
+#: monotone shape floors for the node/edge ladders — repeated runs of
+#: nearby sizes reuse the SAME padded shapes (zero steady-state
+#: compiles, the streaming ratchet discipline)
+_SHAPE_FLOORS: dict = {}
+
+#: re-export: the per-partition eps probe plain DBSCAN's ``eps="auto"``
+#: rides (models/dbscan.py)
+auto_eps = core_mod.auto_eps
+
+
+def _oracle_cap() -> int:
+    return int(config.env("DBSCAN_DENSITY_ORACLE_MAX"))
+
+
+def _validate(pts, min_pts: int, metric: str) -> np.ndarray:
+    if metric not in core_mod.METRICS:
+        raise ValueError(
+            f"unknown metric {metric!r}: one of {core_mod.METRICS}"
+        )
+    if int(min_pts) < 1:
+        raise ValueError(f"min_pts must be >= 1, got {min_pts}")
+    pts = np.asarray(pts)
+    if pts.ndim != 2:
+        raise ValueError(f"expected [N, D] points, got shape {pts.shape}")
+    return pts
+
+
+def _unit_payload(pts: np.ndarray, metric: str) -> np.ndarray:
+    """The f32 device payload: raw coordinates (euclidean) or
+    L2-normalized rows (cosine — zero rows stay zero, similarity 0 to
+    everything, the embed convention the oracle mirrors)."""
+    x32 = np.asarray(pts, dtype=np.float32)
+    if metric == "euclidean":
+        return x32
+    norms = np.sqrt(np.einsum("ij,ij->i", x32, x32, dtype=np.float64))
+    inv = np.where(norms > 0, 1.0 / np.maximum(norms, 1e-30), 0.0)
+    return x32 * inv.astype(np.float32)[:, None]
+
+
+def _padded(unit32: np.ndarray, metric: str):
+    """Ratcheted node ladder + (cosine) lane-padded width."""
+    n, d = unit32.shape
+    d_pad = d if metric == "euclidean" else _ladder_width(d, 8)
+    n_pad = _ratchet(
+        _SHAPE_FLOORS,
+        ("n", metric, d_pad),
+        _ladder_width(n, 128),
+    )
+    xh = np.zeros((n_pad, d_pad), dtype=np.float32)
+    xh[:n, :d] = unit32
+    maskh = np.zeros(n_pad, dtype=bool)
+    maskh[:n] = True
+    return xh, maskh
+
+
+def _device_mst(
+    unit32: np.ndarray, min_pts: int, metric: str, stats: dict
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Stages 1+2 on device: returns ``(mst edges [n-1, 3] f64 in
+    selection order, core [n] f32)``. Raises FatalDeviceFault on a
+    persistent un-degradable fault for the caller's whole-run oracle
+    degrade."""
+    import jax.numpy as jnp
+
+    from dbscan_tpu.ops.propagation import prop_mode
+    from dbscan_tpu.parallel import pipeline as pipe_mod
+
+    n = len(unit32)
+    xh, maskh = _padded(unit32, metric)
+    n_pad, d_pad = xh.shape
+    x_dev = jnp.asarray(xh)
+    mask_dev = jnp.asarray(maskh)
+    obs.count("transfer.h2d_bytes", int(xh.nbytes + maskh.nbytes))
+    pull_pipe = pipe_mod.get_engine()
+    t0 = time.perf_counter()
+    core = core_mod.device_core(
+        x_dev, mask_dev, xh, maskh, min_pts, metric, pull_pipe,
+        oracle_fallback=stats.get("_oracle_fallback", True),
+    )
+    stats["core_s"] = round(time.perf_counter() - t0, 6)
+    stats["core_chunks"] = -(-n_pad // core_mod.chunk_rows(n_pad))
+    t1 = time.perf_counter()
+    core_dev = jnp.asarray(core)
+    obs.count("transfer.h2d_bytes", int(core.nbytes))
+    mode = prop_mode()
+    edges, rounds = boruvka_mod.boruvka_mst(
+        x_dev, mask_dev, core_dev, n_pad, d_pad, n, metric, mode, stats
+    )
+    stats["mst_s"] = round(time.perf_counter() - t1, 6)
+    return edges, core[:n]
+
+
+def hdbscan(
+    pts: np.ndarray,
+    min_pts: int = 5,
+    min_cluster_size: Optional[int] = None,
+    metric: str = "euclidean",
+    stats_out: Optional[dict] = None,
+    oracle_fallback: bool = True,
+) -> np.ndarray:
+    """HDBSCAN* labels over ``[N, D]`` points: [N] int32, clusters
+    1..K by smallest member row (the canonical PR 8 contract), 0
+    noise.
+
+    ``min_pts`` sets the core-distance rank (self-inclusive);
+    ``min_cluster_size`` (default ``min_pts``) prunes the condensed
+    tree; ``metric`` is ``"euclidean"`` (the 2-D banded leg) or
+    ``"cosine"`` (the embed leg, rows L2-normalized internally);
+    ``oracle_fallback`` controls the persistent-fault degradations
+    (per-chunk for ``density_core``, whole-run for
+    ``density_boruvka``); ``stats_out`` receives run diagnostics
+    (``boruvka_rounds``, ``core_chunks``, timings)."""
+    pts = _validate(pts, min_pts, metric)
+    mcs = int(min_cluster_size) if min_cluster_size is not None else int(
+        min_pts
+    )
+    if mcs < 2:
+        raise ValueError(f"min_cluster_size must be >= 2, got {mcs}")
+    obs.ensure_env()
+    n = len(pts)
+    if n == 0:
+        return np.empty(0, dtype=np.int32)
+    if n == 1:
+        return np.zeros(1, dtype=np.int32)
+    obs.count("density.points", int(n))
+    stats: dict = {"_oracle_fallback": oracle_fallback}
+    t0 = time.perf_counter()
+    unit32 = _unit_payload(pts, metric)
+    with obs.span("density.run", n=int(n), metric=metric, kind="hdbscan"):
+        try:
+            edges, _core = _device_mst(unit32, min_pts, metric, stats)
+        except faults.FatalDeviceFault:
+            if not oracle_fallback or n > _oracle_cap():
+                raise
+            return _whole_run_oracle(
+                unit32, min_pts, mcs, metric, stats_out, t0
+            )
+        t2 = time.perf_counter()
+        from dbscan_tpu.parallel import pipeline as pipe_mod
+
+        sorted_rows, lam = condense_mod.sorted_edges_device(
+            edges, pipe_mod.get_engine()
+        )
+        raw = condense_mod.condense_labels(sorted_rows, lam, n, mcs)
+        labels = oracle_mod.canonical_raw(raw)
+        stats["condense_s"] = round(time.perf_counter() - t2, 6)
+    _finish_stats(stats_out, stats, n, metric, t0)
+    return labels
+
+
+def optics(
+    pts: np.ndarray,
+    min_pts: int = 5,
+    metric: str = "euclidean",
+    stats_out: Optional[dict] = None,
+    oracle_fallback: bool = True,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """OPTICS over the mutual-reachability MST: ``(order [N] int64,
+    reach [N] f64, core [N] f64)``.
+
+    The ordering is the Prim traversal of the (unique, total-ordered)
+    MST from row 0 — reachability is the attaching edge weight, inf at
+    the start row (PARITY.md "Variable-density contract"). Exactly the
+    oracle's definition, so order parity is structural in the edge
+    set."""
+    pts = _validate(pts, min_pts, metric)
+    obs.ensure_env()
+    n = len(pts)
+    if n == 0:
+        return (
+            np.empty(0, np.int64),
+            np.empty(0, np.float64),
+            np.empty(0, np.float64),
+        )
+    obs.count("density.points", int(n))
+    stats: dict = {"_oracle_fallback": oracle_fallback}
+    t0 = time.perf_counter()
+    unit32 = _unit_payload(pts, metric)
+    if n == 1:
+        core = np.zeros(1, np.float64)
+        return np.zeros(1, np.int64), np.full(1, np.inf), core
+    with obs.span("density.run", n=int(n), metric=metric, kind="optics"):
+        try:
+            edges, core32 = _device_mst(unit32, min_pts, metric, stats)
+        except faults.FatalDeviceFault:
+            if not oracle_fallback or n > _oracle_cap():
+                raise
+            obs.count("density.oracle_fallbacks")
+            logger.warning(
+                "density: device MST persistently failing; degrading "
+                "the whole OPTICS run to the host oracle (%d points)", n
+            )
+            order, reach, core = oracle_mod.optics_oracle(
+                np.asarray(unit32, dtype=np.float64), min_pts, metric
+            )
+            if stats_out is not None:
+                stats_out.update(density_degraded="oracle")
+            return order, reach, core
+        t2 = time.perf_counter()
+        from dbscan_tpu.parallel import pipeline as pipe_mod
+
+        sorted_rows, _lam = condense_mod.sorted_edges_device(
+            edges, pipe_mod.get_engine()
+        )
+        order, reach = oracle_mod.optics_order(sorted_rows, n)
+        stats["condense_s"] = round(time.perf_counter() - t2, 6)
+    _finish_stats(stats_out, stats, n, metric, t0)
+    return order, reach, core32.astype(np.float64)
+
+
+def _whole_run_oracle(unit32, min_pts, mcs, metric, stats_out, t0):
+    """The ``density_boruvka`` persistent-fault degradation: the exact
+    host oracle over the whole (capped) run — labels intact."""
+    obs.count("density.oracle_fallbacks")
+    logger.warning(
+        "density: boruvka round persistently failing; degrading the "
+        "whole run to the host oracle (%d points)", len(unit32)
+    )
+    labels = oracle_mod.hdbscan_labels(
+        np.asarray(unit32, dtype=np.float64), min_pts, mcs, metric
+    )
+    if stats_out is not None:
+        stats_out.update(
+            density_degraded="oracle",
+            timings={"total_s": round(time.perf_counter() - t0, 6)},
+        )
+    return labels
+
+
+def _finish_stats(stats_out, stats, n, metric, t0):
+    if stats_out is None:
+        return
+    stats.pop("_oracle_fallback", None)
+    timings = {
+        k: stats.pop(k)
+        for k in ("core_s", "mst_s", "condense_s")
+        if k in stats
+    }
+    timings["total_s"] = round(time.perf_counter() - t0, 6)
+    stats_out.update(stats)
+    stats_out.update(n=int(n), metric=metric, timings=timings)

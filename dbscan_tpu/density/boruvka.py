@@ -1,0 +1,255 @@
+"""Device Borůvka MST over mutual-reachability edges (stage 2).
+
+One ``density.boruvka`` dispatch per round, each a single compiled
+kernel reused every round (and every same-shaped later run — the
+zero-retrace pin): blocked [128, n_pad] mutual-reachability slabs pick
+each point's cheapest OUTGOING candidate, a three-stage scatter-min
+reduces candidates to one edge per live component, and the contraction
+is the shared union-find propagation
+(:func:`dbscan_tpu.ops.propagation.min_label_fixed_point` — the PR 15
+single-pass structure) over the selected-edge graph of component
+roots. Rounds are bounded by ceil(log2 n): every live component
+selects an outgoing edge (the mutual-reachability graph is complete),
+so components at least halve per round.
+
+Edge uniqueness is the load-bearing invariant: candidates are ordered
+by the TOTAL key ``(w, min(u, v), max(u, v))`` — within a row the
+lowest-j argmin realizes it, across a component the three scatter-min
+stages (min w, then min(u, v) among w-ties, then max(u, v)) finish
+it — so the union of per-round selections IS the unique MST the host
+oracle's Kruskal finds under the same order, and Borůvka's
+data-dependent ROUND count can never move a label (PARITY.md
+"Variable-density contract").
+
+Per-round pulls are thin (the selected-edge vectors + two scalars)
+and synchronous — the live-component count decides termination.
+Components may pairwise-select the same undirected edge; the host
+dedupes per round by (min, max) pair. The ``density_boruvka`` fault
+site supervises every round; with no per-round fallback (the MST is
+global state), a persistent fault raises
+:class:`dbscan_tpu.faults.FatalDeviceFault` and the engine degrades
+the WHOLE run to the host oracle — labels intact, the drill
+tests/test_density.py pins.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dbscan_tpu import faults, obs
+from dbscan_tpu.obs import compile as obs_compile
+from dbscan_tpu.ops.labels import SEED_NONE
+from dbscan_tpu.ops.propagation import min_label_fixed_point
+
+#: row-block edge of the candidate scan (divides every ladder width)
+BLK = 128
+
+
+@functools.lru_cache(maxsize=32)
+def _round_fn(n_pad: int, d: int, metric: str, mode: str):
+    """One compiled Borůvka round per (n_pad, d, metric, prop-mode)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    nb = n_pad // BLK
+    big = jnp.int32(n_pad)
+    none = jnp.int32(SEED_NONE)
+    inf = jnp.float32(jnp.inf)
+
+    @jax.jit
+    def fn(x, mask, core, comp):
+        idx = jnp.arange(n_pad, dtype=jnp.int32)
+
+        def block(bi):
+            s = bi * jnp.int32(BLK)
+            rows = lax.dynamic_slice(x, (s, jnp.int32(0)), (BLK, d))
+            rcore = lax.dynamic_slice(core, (s,), (BLK,))
+            rcomp = lax.dynamic_slice(comp, (s,), (BLK,))
+            rmask = lax.dynamic_slice(mask, (s,), (BLK,))
+            if metric == "euclidean":
+                d2 = jnp.zeros((BLK, n_pad), dtype=jnp.float32)
+                for j in range(d):
+                    diff = rows[:, j][:, None] - x[:, j][None, :]
+                    d2 = d2 + diff * diff
+                dist = jnp.sqrt(d2)
+            else:
+                dist = jnp.float32(1.0) - rows @ x.T
+                dist = jnp.maximum(dist, jnp.float32(0.0))
+            mr = jnp.maximum(dist, jnp.maximum(rcore[:, None], core[None, :]))
+            out_ok = (
+                mask[None, :]
+                & rmask[:, None]
+                & (comp[None, :] != rcomp[:, None])
+            )
+            val = jnp.where(out_ok, mr, inf)
+            # first-match argmin = lowest j among w-ties, which realizes
+            # the (w, min(u,v), max(u,v)) total key within the row
+            return jnp.min(val, axis=1), jnp.argmin(val, axis=1).astype(
+                jnp.int32
+            )
+
+        w, j = lax.map(block, jnp.arange(nb, dtype=jnp.int32))
+        w = w.reshape(n_pad)
+        j = j.reshape(n_pad)
+        validp = mask & jnp.isfinite(w)
+
+        # three-stage scatter-min per component root: min w, then
+        # min(u, v) among w-ties, then max(u, v) — the total order
+        # without 64-bit key packing
+        r = jnp.clip(comp, 0, n_pad - 1)
+        a = jnp.minimum(idx, j)
+        b = jnp.maximum(idx, j)
+        best_w = jnp.full(n_pad, inf).at[r].min(jnp.where(validp, w, inf))
+        tie1 = validp & (w == best_w[r])
+        best_a = jnp.full(n_pad, big).at[r].min(jnp.where(tie1, a, big))
+        tie2 = tie1 & (a == best_a[r])
+        best_b = jnp.full(n_pad, big).at[r].min(jnp.where(tie2, b, big))
+        tie3 = tie2 & (b == best_b[r])
+        best_i = jnp.full(n_pad, big).at[r].min(jnp.where(tie3, idx, big))
+        has = jnp.isfinite(best_w)
+        safe_i = jnp.clip(best_i, 0, n_pad - 1)
+        sel_j = j[safe_i]
+        eu = jnp.where(has, safe_i, jnp.int32(-1))
+        ev = jnp.where(has, sel_j, jnp.int32(-1))
+        ew = jnp.where(has, best_w, jnp.float32(0.0))
+
+        # contraction: selected edges link root slots; the shared
+        # union-find propagation collapses each linked group to its
+        # min root in a handful of pull+push+jump sweeps
+        partner = comp[jnp.clip(sel_j, 0, n_pad - 1)]
+
+        def neighbor_min(lab):
+            # SYMMETRIC relaxation: pull the partner's label AND
+            # scatter-min own labels onto partners. The selected-edge
+            # graph is a pseudoforest (out-degree 1), so a pull-only
+            # sweep would strand a group minimum sitting at a leaf —
+            # nobody pulls FROM a leaf — splitting the group and
+            # re-selecting its edges next round.
+            pull = jnp.where(has, lab[jnp.clip(partner, 0, n_pad - 1)], none)
+            push = (
+                jnp.full(n_pad, none)
+                .at[jnp.where(has, partner, big)]
+                .min(jnp.where(has, lab, none), mode="drop")
+            )
+            return jnp.minimum(pull, push)
+
+        def scatter_relax(lab):
+            return lab.at[jnp.where(has, partner, big)].min(lab, mode="drop")
+
+        root_map, iters = min_label_fixed_point(
+            idx,
+            neighbor_min,
+            with_iters=True,
+            mode=mode,
+            scatter_relax=scatter_relax if mode == "unionfind" else None,
+        )
+        comp_new = root_map[r]
+        n_live = jnp.sum(
+            (mask & (comp_new == idx)).astype(jnp.int32), dtype=jnp.int32
+        )
+        return comp_new, eu, ev, ew, has, n_live, iters
+
+    return fn
+
+
+def boruvka_mst(
+    x_dev,
+    mask_dev,
+    core_dev,
+    n_pad: int,
+    d: int,
+    n: int,
+    metric: str,
+    mode: str,
+    stats: Optional[dict] = None,
+) -> Tuple[np.ndarray, int]:
+    """The full device MST: [n-1, 3] f64 ``(u, v, w)`` edge rows in
+    selection order (unsorted — stage 3 sorts), plus the round count.
+
+    Raises :class:`faults.FatalDeviceFault` when a round persistently
+    fails (the engine's whole-run oracle degrade) and RuntimeError if
+    the rounds bound trips without convergence (a kernel bug, not a
+    data condition — the mutual-reachability graph is complete)."""
+    import jax
+    import jax.numpy as jnp
+
+    if n <= 1:
+        if stats is not None:
+            stats["boruvka_rounds"] = 0
+        return np.empty((0, 3), dtype=np.float64), 0
+    fn = _round_fn(n_pad, d, metric, mode)
+    comp = jnp.arange(n_pad, dtype=jnp.int32)
+    max_rounds = int(math.ceil(math.log2(max(n, 2)))) + 2
+    ea: list = []
+    eb: list = []
+    ew_all: list = []
+    rounds = 0
+    sweeps = 0
+    while rounds < max_rounds:
+        obs.count("density.boruvka_dispatches")
+        with obs.span("density.round", r=rounds):
+            out = faults.supervised(
+                faults.SITE_DENSITY_BORUVKA,
+                lambda _budget: obs_compile.tracked_call(
+                    "density.boruvka", fn, x_dev, mask_dev, core_dev, comp
+                ),
+                label=f"round{rounds}",
+            )
+        comp, eu, ev, ewv, has, n_live, iters = out
+        rounds += 1
+        obs.count("density.rounds")
+        eu_h, ev_h, ew_h, has_h, live, it = jax.device_get(
+            (eu, ev, ewv, has, n_live, iters)
+        )
+        obs.count(
+            "transfer.d2h_bytes",
+            int(
+                np.asarray(eu_h).nbytes
+                + np.asarray(ev_h).nbytes
+                + np.asarray(ew_h).nbytes
+                + np.asarray(has_h).nbytes
+            ),
+        )
+        sweeps += int(it)
+        sel = np.flatnonzero(np.asarray(has_h))
+        if len(sel):
+            a = np.minimum(eu_h[sel], ev_h[sel]).astype(np.int64)
+            b = np.maximum(eu_h[sel], ev_h[sel]).astype(np.int64)
+            # two components may select the same undirected edge
+            # (the classic Borůvka 2-cycle): dedupe by (min, max) pair
+            pair = a * np.int64(n_pad) + b
+            _, first = np.unique(pair, return_index=True)
+            ea.append(a[first])
+            eb.append(b[first])
+            ew_all.append(np.asarray(ew_h)[sel][first].astype(np.float64))
+        if int(live) <= 1:
+            break
+    else:
+        raise RuntimeError(
+            f"boruvka failed to converge in {max_rounds} rounds "
+            f"(n={n}) — component selection must halve per round"
+        )
+    if sweeps:
+        from dbscan_tpu.ops import propagation as prop
+
+        prop.note_sweeps(sweeps, mode)
+    edges = np.empty((0, 3), dtype=np.float64)
+    if ea:
+        edges = np.column_stack(
+            [np.concatenate(ea), np.concatenate(eb), np.concatenate(ew_all)]
+        ).astype(np.float64)
+    if len(edges) != n - 1:
+        raise RuntimeError(
+            f"boruvka produced {len(edges)} edges for n={n} "
+            "(expected n-1: the mutual-reachability graph is complete)"
+        )
+    obs.count("density.edges", int(len(edges)))
+    if stats is not None:
+        stats["boruvka_rounds"] = rounds
+        stats["boruvka_sweeps"] = sweeps
+    return edges, rounds

@@ -1,0 +1,331 @@
+"""Condensed-tree extraction (stage 3): device edge sort + lambda
+prefix, one thin PullEngine pull, and a single-sweep host build.
+
+The device ``density.condense`` dispatch lexsorts the MST edges by the
+total key ``(w, min(u, v), max(u, v))`` — the SAME order the oracle's
+Kruskal consumed, so merge order is pinned even among equal-weight
+edges — computes the lambda transform ``1/w`` and the valid-edge
+prefix count (the compaction), all on the padded edge ladder. The
+sorted arrays come back through ONE PullEngine pull (the final-labels
+ride) and the host finishes with a single ascending sweep.
+
+The sweep is the bottom-up dual of the reference top-down condense
+(scikit-learn-contrib ``hdbscan`` ``_condense_tree`` +
+``compute_stability`` + EOM ``get_clusters``), one union-find pass
+over the sorted merges:
+
+- a component below ``min_cluster_size`` keeps its points PENDING;
+- when a pending component reaches the threshold (or merges into a
+  component that already has), every pending point sheds at the
+  current merge's lambda into that component's cluster entity — the
+  condensed tree's point rows;
+- when two at-threshold entities merge, both CLOSE as children of a
+  fresh parent entity (the condensed tree's cluster rows) and their
+  excess-of-mass stability settles as
+  ``sum(lambda_row * size) - lambda_close * sum(size)``;
+- EOM selection then runs leaves-up over the entity tree (root
+  excluded, ``allow_single_cluster=False``), and each point labels to
+  the nearest selected ancestor of its shed entity, else noise.
+
+``dbscan_tpu/density/oracle.py`` implements the same semantics
+top-down (dendrogram, then condense, then select) — two independent
+constructions whose label-for-label agreement tests/test_density.py
+pins, with the ``hdbscan``-library cross-check on top when that
+package is importable.
+
+OPTICS falls out of the same pass: the sorted MST edges feed the
+shared Prim traversal (:func:`dbscan_tpu.density.oracle.optics_order`)
+— ordering parity with the oracle is then structural in the edge set.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dbscan_tpu import obs
+from dbscan_tpu.obs import compile as obs_compile
+from dbscan_tpu.parallel.binning import _ladder_width
+
+
+@functools.lru_cache(maxsize=32)
+def _sort_fn(e_pad: int):
+    """One compiled sort/compact kernel per edge-ladder width."""
+    import jax
+    import jax.numpy as jnp
+
+    big = jnp.int32(2**30)
+    inf = jnp.float32(jnp.inf)
+
+    @jax.jit
+    def fn(eu, ev, ew, valid):
+        a = jnp.minimum(eu, ev)
+        b = jnp.maximum(eu, ev)
+        wkey = jnp.where(valid, ew, inf)
+        akey = jnp.where(valid, a, big)
+        bkey = jnp.where(valid, b, big)
+        # lexsort: LAST key is primary -> (w, min(u,v), max(u,v)),
+        # invalid (padding) rows sort to the tail
+        perm = jnp.lexsort((bkey, akey, wkey))
+        sw = ew[perm]
+        lam = jnp.where(
+            sw > jnp.float32(0.0), jnp.float32(1.0) / sw, inf
+        )
+        n_valid = jnp.sum(valid.astype(jnp.int32), dtype=jnp.int32)
+        return a[perm], b[perm], sw, lam, valid[perm], n_valid
+
+    return fn
+
+
+def sorted_edges_device(
+    edges: np.ndarray, pull_pipe=None
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Sort [E, 3] MST edge rows on device under the total order.
+
+    Returns ``(sorted [E, 3] f64 (u, v, w) rows, lam [E] f64)``; the
+    pull rides the PullEngine when live. Padding to the 128-step edge
+    ladder keeps the jit cache keyed by recurring widths."""
+    import jax
+    import jax.numpy as jnp
+
+    e = len(edges)
+    if e == 0:
+        return np.empty((0, 3), dtype=np.float64), np.empty(0, np.float64)
+    e_pad = _ladder_width(e, 128)
+    eu = np.zeros(e_pad, dtype=np.int32)
+    ev = np.zeros(e_pad, dtype=np.int32)
+    ew = np.zeros(e_pad, dtype=np.float32)
+    valid = np.zeros(e_pad, dtype=bool)
+    eu[:e] = edges[:, 0].astype(np.int32)
+    ev[:e] = edges[:, 1].astype(np.int32)
+    ew[:e] = edges[:, 2].astype(np.float32)
+    valid[:e] = True
+    obs.count(
+        "transfer.h2d_bytes",
+        int(eu.nbytes + ev.nbytes + ew.nbytes + valid.nbytes),
+    )
+    fn = _sort_fn(e_pad)
+    obs.count("density.condense_dispatches")
+    with obs.span("density.condense", e=e):
+        out = obs_compile.tracked_call(
+            "density.condense",
+            fn,
+            jnp.asarray(eu),
+            jnp.asarray(ev),
+            jnp.asarray(ew),
+            jnp.asarray(valid),
+        )
+    landed: dict = {}
+
+    def _land() -> None:
+        su, sv, sw, lam, sval, n_valid = jax.device_get(out)
+        obs.count(
+            "transfer.d2h_bytes",
+            int(sum(np.asarray(v).nbytes for v in (su, sv, sw, lam, sval))),
+        )
+        landed["rows"] = (su, sv, sw, lam, sval, int(n_valid))
+
+    if pull_pipe is not None:
+        with obs.span("density.condense_pull", e=e):
+            job = pull_pipe.submit(
+                _land, bytes_hint=e_pad * 17, label="density.condense"
+            )
+            pull_pipe.settle(job, _land)
+    else:
+        _land()
+    su, sv, sw, lam, sval, n_valid = landed["rows"]
+    if n_valid != e:
+        raise RuntimeError(
+            f"condense compaction lost edges: {n_valid} valid of {e}"
+        )
+    out_rows = np.column_stack(
+        [
+            su[:e].astype(np.float64),
+            sv[:e].astype(np.float64),
+            sw[:e].astype(np.float64),
+        ]
+    )
+    return out_rows, lam[:e].astype(np.float64)
+
+
+class _Entity:
+    """One condensed-tree cluster entity of the single-sweep build."""
+
+    __slots__ = (
+        "eid", "point_rows", "child_rows", "sum_ls", "sum_s",
+        "stability", "parent", "children", "closed",
+    )
+
+    def __init__(self, eid: int):
+        self.eid = eid
+        self.point_rows: List[Tuple[int, float]] = []  # (point, lam)
+        self.child_rows: List[Tuple[int, float, int]] = []
+        self.sum_ls = 0.0  # sum(lam * size) over finite-lam rows
+        self.sum_s = 0  # sum(size) over finite-lam rows
+        self.stability = 0.0
+        self.parent: Optional[int] = None
+        self.children: List[int] = []
+        self.closed = False
+
+    def add_point(self, p: int, lam: float) -> None:
+        self.point_rows.append((p, lam))
+        if np.isfinite(lam):
+            self.sum_ls += lam
+            self.sum_s += 1
+
+    def add_child(self, child: int, lam: float, size: int) -> None:
+        self.child_rows.append((child, lam, size))
+        if np.isfinite(lam):
+            self.sum_ls += lam * size
+            self.sum_s += size
+
+    def close(self, birth_lam: float) -> None:
+        self.stability = self.sum_ls - birth_lam * self.sum_s
+        self.closed = True
+
+
+def condense_labels(
+    sorted_edges: np.ndarray,
+    lam: np.ndarray,
+    n: int,
+    min_cluster_size: int,
+) -> np.ndarray:
+    """Single-sweep condensed-tree build + EOM labels over MST edges
+    ALREADY in the total order. Returns RAW labels (entity ids, -1
+    noise) — callers canonicalize (the PR 8 min-member-row contract)."""
+    out = np.full(n, -1, dtype=np.int64)
+    if n <= 1 or len(sorted_edges) == 0:
+        return out
+    mcs = max(int(min_cluster_size), 2)
+    parent_uf = np.arange(n, dtype=np.int64)
+
+    def find(x: int) -> int:
+        root = x
+        while parent_uf[root] != root:
+            root = parent_uf[root]
+        while parent_uf[x] != root:
+            parent_uf[x], x = root, parent_uf[x]
+        return root
+
+    size = np.ones(n, dtype=np.int64)
+    # per-root state: pending points (not yet shed) or a live entity
+    pending: Dict[int, List[int]] = {i: [i] for i in range(n)}
+    entity_of: Dict[int, int] = {}
+    entities: Dict[int, _Entity] = {}
+    close_order: List[int] = []
+    next_eid = n
+
+    def new_entity() -> _Entity:
+        nonlocal next_eid
+        ent = _Entity(next_eid)
+        entities[next_eid] = ent
+        next_eid += 1
+        return ent
+
+    for t in range(len(sorted_edges)):
+        u, v = int(sorted_edges[t, 0]), int(sorted_edges[t, 1])
+        lv = float(lam[t])
+        ru, rv = find(u), find(v)
+        su, sv = int(size[ru]), int(size[rv])
+        eu, ev = entity_of.get(ru), entity_of.get(rv)
+        # union (rv into ru), then settle the merged root's state
+        parent_uf[rv] = ru
+        size[ru] = su + sv
+        if eu is not None and ev is not None:
+            # cluster-cluster merge: both entities CLOSE as children
+            # of a fresh parent born (bottom-up) at this lambda
+            par = new_entity()
+            for ent in (entities[eu], entities[ev]):
+                ent.close(lv)
+                ent.parent = par.eid
+                par.children.append(ent.eid)
+                close_order.append(ent.eid)
+            par.add_child(eu, lv, su)
+            par.add_child(ev, lv, sv)
+            entity_of[ru] = par.eid
+            entity_of.pop(rv, None)
+        elif eu is not None or ev is not None:
+            # one side already a cluster: the small pending side sheds
+            # every point at this lambda into the continuing entity
+            keep = eu if eu is not None else ev
+            ent = entities[keep]
+            small_root = rv if eu is not None else ru
+            for p in pending.pop(small_root, []):
+                ent.add_point(p, lv)
+            entity_of[ru] = keep
+            entity_of.pop(rv, None)
+        else:
+            merged = pending.pop(ru, []) + pending.pop(rv, [])
+            if su + sv >= mcs:
+                # the component reaches min_cluster_size: its entity
+                # begins, and every pending point sheds HERE — the
+                # top-down "children both too small" case
+                ent = new_entity()
+                for p in merged:
+                    ent.add_point(p, lv)
+                entity_of[ru] = ent.eid
+            else:
+                pending[ru] = merged
+    # the final entity is the condensed root: close it with birth 0
+    # (EOM excludes it regardless — allow_single_cluster=False)
+    root_root = find(0)
+    root_eid = entity_of.get(root_root)
+    if root_eid is None:
+        return out  # n < mcs: everything stayed pending -> all noise
+    entities[root_eid].close(0.0)
+    close_order.append(root_eid)
+
+    # EOM selection leaves-up (closing order IS child-before-parent)
+    wins: Dict[int, bool] = {}
+    subtree: Dict[int, float] = {}
+    for eid in close_order:
+        ent = entities[eid]
+        child_sum = sum(subtree[c] for c in ent.children)
+        if eid == root_eid:
+            wins[eid] = False
+            subtree[eid] = child_sum
+        elif ent.children and ent.stability < child_sum:
+            wins[eid] = False
+            subtree[eid] = child_sum
+        else:
+            wins[eid] = True
+            subtree[eid] = ent.stability
+    # final set: winners with no winning ancestor (top-down emit;
+    # iterative — entity chains can be as deep as the merge count)
+    selected: Dict[int, int] = {}
+    stack = [(root_eid, -1)]
+    while stack:
+        eid, above = stack.pop()
+        mine = above
+        if wins[eid] and above < 0 and eid != root_eid:
+            selected[eid] = eid
+            mine = eid
+        for c in entities[eid].children:
+            stack.append((c, mine))
+
+    # label each shed point to the nearest selected ancestor
+    label_of: Dict[int, int] = {}
+
+    def entity_label(eid: int) -> int:
+        chain = []
+        cur: Optional[int] = eid
+        while cur is not None and cur not in label_of:
+            if cur in selected:
+                label_of[cur] = cur
+                break
+            chain.append(cur)
+            cur = entities[cur].parent
+        got = label_of.get(cur, -1) if cur is not None else -1
+        for link in chain:
+            label_of[link] = got
+        return got
+
+    for eid, ent in entities.items():
+        lab = entity_label(eid)
+        if lab < 0:
+            continue
+        for p, _plam in ent.point_rows:
+            out[p] = lab
+    return out

@@ -1,0 +1,296 @@
+"""Device core distances (stage 1 of the density engine) and the
+k-distance statistics they yield.
+
+``core(p)`` = distance to the ``min_pts``-th nearest neighbor,
+self-inclusive — the mutual-reachability ingredient. The payload lives
+on device once ([n_pad, d] f32, ladder-padded) and the packing window
+walks it in fixed-size chunks: one ``density.core`` dispatch per chunk
+(``DBSCAN_DENSITY_CHUNK`` rows), each a [chunk, n_pad] blocked
+distance slab + ``lax.top_k`` k-th-smallest reduction, supervised at
+the ``density_core`` fault site. The chunk start rides as a TRACED
+0-d int32 so every chunk — and every same-shaped later run — reuses
+one compiled kernel (the zero-retrace pin).
+
+Metric legs mirror the package's two exact engines:
+
+- ``euclidean`` (the 2-D banded leg): unrolled per-coordinate
+  difference form ``sum_j (x_ij - x_kj)^2`` then sqrt — elementwise
+  f32, which makes the numpy host fallback BITWISE identical, so a
+  ``density_core`` persistent fault on the 2-D leg cannot move a
+  label;
+- ``cosine`` (the embed leg): ``1 - rows @ x.T`` over pre-normalized
+  rows, the embed neighbor slab's similarity form (f32 matmul — the
+  host fallback agrees to f32 matmul rounding, documented in
+  PARITY.md).
+
+Self-distance is forced to exactly 0 on both legs (diagonal mask), so
+the self-inclusive rank never depends on rounding.
+
+:func:`auto_eps` is the satellite consumer: the per-partition
+``eps="auto"`` probe for plain DBSCAN — a capped deterministic
+subsample split into coordinate strips (the partition proxy), each
+strip's sorted k-distance curve kneed by max chord distance, eps =
+the median strip knee. The per-strip statistics are stamped into the
+caller's ``stats`` for the ROADMAP item-3 planner probe.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+from dbscan_tpu import config, faults, obs
+from dbscan_tpu.obs import compile as obs_compile
+
+#: metrics the density engine accepts (the two exact device legs)
+METRICS = ("euclidean", "cosine")
+
+
+def chunk_rows(n_pad: int) -> int:
+    """The packing-window chunk width: ``DBSCAN_DENSITY_CHUNK``
+    clamped to the padded payload (a short payload is one chunk)."""
+    c = int(config.env("DBSCAN_DENSITY_CHUNK"))
+    return max(1, min(c, n_pad))
+
+
+@functools.lru_cache(maxsize=32)
+def _core_fn(n_pad: int, d: int, c: int, k: int, metric: str):
+    """One compiled chunk kernel per (n_pad, d, chunk, k, metric):
+    f32 [c, n_pad] distance slab -> k-th-smallest per row."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def fn(x, mask, start):
+        rows = lax.dynamic_slice(x, (start, jnp.int32(0)), (c, d))
+        if metric == "euclidean":
+            d2 = jnp.zeros((c, n_pad), dtype=jnp.float32)
+            for j in range(d):
+                diff = rows[:, j][:, None] - x[:, j][None, :]
+                d2 = d2 + diff * diff
+            dist = jnp.sqrt(d2)
+        else:
+            dist = jnp.float32(1.0) - rows @ x.T
+            dist = jnp.maximum(dist, jnp.float32(0.0))
+        col = jnp.arange(n_pad, dtype=jnp.int32)
+        ridx = start + jnp.arange(c, dtype=jnp.int32)
+        dist = jnp.where(
+            col[None, :] == ridx[:, None], jnp.float32(0.0), dist
+        )
+        dist = jnp.where(mask[None, :], dist, jnp.float32(jnp.inf))
+        kth = -lax.top_k(-dist, k)[0][:, k - 1]
+        rmask = lax.dynamic_slice(mask, (start,), (c,))
+        return jnp.where(rmask, kth, jnp.float32(0.0))
+
+    return fn
+
+
+def _host_chunk(
+    x: np.ndarray, mask: np.ndarray, start: int, c: int, k: int, metric: str
+) -> np.ndarray:
+    """Numpy mirror of one chunk — the ``density_core`` persistent-
+    fault degradation. Same f32 expression order as the kernel: on the
+    euclidean leg the result is bitwise identical; on the cosine leg
+    it agrees to f32-matmul rounding."""
+    n_pad = len(x)
+    rows = x[start : start + c]
+    if metric == "euclidean":
+        d2 = np.zeros((c, n_pad), dtype=np.float32)
+        for j in range(x.shape[1]):
+            diff = rows[:, j][:, None] - x[:, j][None, :]
+            d2 += diff * diff
+        dist = np.sqrt(d2)
+    else:
+        dist = np.float32(1.0) - rows @ x.T
+        np.maximum(dist, np.float32(0.0), out=dist)
+    col = np.arange(n_pad, dtype=np.int32)
+    ridx = start + np.arange(c, dtype=np.int32)
+    dist[col[None, :] == ridx[:, None]] = np.float32(0.0)
+    dist = np.where(mask[None, :], dist, np.float32(np.inf))
+    kth = np.partition(dist, k - 1, axis=1)[:, k - 1]
+    return np.where(mask[start : start + c], kth, np.float32(0.0)).astype(
+        np.float32
+    )
+
+
+def device_core(
+    x_dev,
+    mask_dev,
+    x_host: np.ndarray,
+    mask_host: np.ndarray,
+    min_pts: int,
+    metric: str,
+    pull_pipe=None,
+    oracle_fallback: bool = True,
+) -> np.ndarray:
+    """Core distances over a device-resident padded payload.
+
+    ``x_dev``/``mask_dev``: the [n_pad, d] f32 / [n_pad] bool device
+    arrays (put once by the engine); ``x_host``/``mask_host``: their
+    host twins, consumed only by the per-chunk fault fallback. Returns
+    the [n_pad] f32 host core-distance vector (0 at padding rows).
+    One supervised ``density.core`` dispatch per chunk; chunk pulls
+    ride the PullEngine when live so D2H overlaps later chunks."""
+    import jax
+    import jax.numpy as jnp
+
+    n_pad, d = x_host.shape
+    n_live = int(mask_host.sum())
+    k = max(1, min(int(min_pts), max(n_live, 1)))
+    c = chunk_rows(n_pad)
+    fn = _core_fn(n_pad, d, c, k, metric)
+    out = np.zeros(n_pad, dtype=np.float32)
+    starts = list(range(0, n_pad, c))
+    if starts and starts[-1] + c > n_pad:
+        starts[-1] = n_pad - c
+
+    def _land(start: int, res) -> None:
+        if isinstance(res, np.ndarray):
+            chunk = res  # host-fallback path
+        else:
+            chunk = np.asarray(jax.device_get(res))
+            obs.count("transfer.d2h_bytes", int(chunk.nbytes))
+        out[start : start + c] = chunk
+
+    jobs = []
+    try:
+        for start in starts:
+            obs.count("density.core_dispatches")
+            fallback = (
+                functools.partial(
+                    _host_chunk, x_host, mask_host, start, c, k, metric
+                )
+                if oracle_fallback
+                else None
+            )
+            with obs.span("density.core_chunk", start=start, c=c):
+                res = faults.supervised(
+                    faults.SITE_DENSITY_CORE,
+                    lambda _budget: obs_compile.tracked_call(
+                        "density.core",
+                        fn,
+                        x_dev,
+                        mask_dev,
+                        jnp.int32(start),
+                    ),
+                    fallback=fallback,
+                    label=f"chunk@{start}",
+                )
+            if pull_pipe is not None:
+                work = functools.partial(_land, start, res)
+                jobs.append((pull_pipe.submit(
+                    work, bytes_hint=c * 4, label=f"core@{start}"
+                ), work))
+            else:
+                _land(start, res)
+    except BaseException:
+        # orphan-drain (the embed/spill discipline): submitted pulls
+        # must not outlive a failing dispatch loop — they write into
+        # `out`, which this frame is about to drop
+        for job, _work in jobs:
+            try:
+                pull_pipe.wait(job)
+            except Exception:  # noqa: BLE001 — already failing
+                pass
+        raise
+    for job, work in jobs:
+        pull_pipe.settle(job, work)
+    return out
+
+
+# --- eps="auto" probe (plain-DBSCAN satellite) -------------------------
+
+
+def knee_index(curve: np.ndarray) -> int:
+    """Knee of an ascending curve by max distance to the chord from
+    its first to its last sample (the classic k-distance elbow pick,
+    deterministic; flat curves knee at their midpoint)."""
+    m = len(curve)
+    if m <= 2:
+        return m - 1 if m else 0
+    y = np.asarray(curve, dtype=np.float64)
+    x = np.arange(m, dtype=np.float64)
+    dx, dy = x[-1] - x[0], y[-1] - y[0]
+    norm = float(np.hypot(dx, dy))
+    if norm == 0.0:
+        return (m - 1) // 2
+    # perpendicular distance from each sample to the chord
+    dist = np.abs(dy * (x - x[0]) - dx * (y - y[0])) / norm
+    return int(np.argmax(dist))
+
+
+def auto_eps(
+    pts: np.ndarray,
+    min_pts: int,
+    stats_out: Optional[dict] = None,
+) -> float:
+    """Per-partition eps auto-select for plain 2-D DBSCAN.
+
+    A deterministic evenly-strided subsample (cap
+    ``DBSCAN_DENSITY_AUTO_SAMPLE``) is split into
+    ``DBSCAN_DENSITY_AUTO_PARTS`` x-sorted strips — the probe's
+    stand-in for the driver's spatial partitions — and each strip's
+    sorted core-distance curve (the k-distance curve, k = min_pts,
+    via the SAME ``density.core`` dispatches) is kneed; eps is the
+    median strip knee. Stamps per-strip statistics into ``stats_out``
+    under ``eps_auto`` for the planner probe."""
+    from dbscan_tpu.parallel.binning import _ladder_width
+    from dbscan_tpu.parallel import pipeline as pipe_mod
+
+    pts = np.asarray(pts, dtype=np.float64)[:, :2]
+    n = len(pts)
+    if n < 2:
+        raise ValueError(f"eps='auto' needs >= 2 points, got {n}")
+    cap = max(int(config.env("DBSCAN_DENSITY_AUTO_SAMPLE")), 2)
+    stride = max(1, int(np.ceil(n / cap)))
+    sample = pts[::stride]
+    parts = max(1, int(config.env("DBSCAN_DENSITY_AUTO_PARTS")))
+    parts = min(parts, max(1, len(sample) // max(2, int(min_pts))))
+    order = np.argsort(sample[:, 0], kind="stable")
+    strips = np.array_split(order, parts)
+    pull_pipe = pipe_mod.get_engine()
+    knees = []
+    sizes = []
+    with obs.span("density.auto_eps", n=int(n), parts=int(parts)):
+        import jax.numpy as jnp
+
+        for strip in strips:
+            sub = sample[strip]
+            m = len(sub)
+            if m < 2:
+                continue
+            n_pad = _ladder_width(m, 128)
+            xh = np.zeros((n_pad, 2), dtype=np.float32)
+            xh[:m] = sub
+            maskh = np.zeros(n_pad, dtype=bool)
+            maskh[:m] = True
+            obs.count("transfer.h2d_bytes", int(xh.nbytes + maskh.nbytes))
+            core = device_core(
+                jnp.asarray(xh), jnp.asarray(maskh), xh, maskh,
+                min_pts, "euclidean", pull_pipe,
+            )[:m]
+            curve = np.sort(core.astype(np.float64))
+            knees.append(float(curve[knee_index(curve)]))
+            sizes.append(m)
+    if not knees:
+        raise ValueError("eps='auto' probe produced no strips")
+    eps = float(np.median(knees))
+    if eps <= 0.0:
+        # degenerate strips (all-duplicate rows): fall back to the
+        # largest strip knee, and ultimately a tiny positive floor so
+        # the driver's eps > 0 validation holds
+        eps = max(max(knees), 1e-12)
+    obs.gauge("density.eps_auto", eps)
+    if stats_out is not None:
+        stats_out["eps_auto"] = {
+            "eps": eps,
+            "k": int(min_pts),
+            "sample": int(len(sample)),
+            "strips": int(len(knees)),
+            "strip_sizes": [int(s) for s in sizes],
+            "strip_knees": [round(float(v), 9) for v in knees],
+        }
+    return eps

@@ -1,0 +1,457 @@
+"""Exact pure-NumPy host HDBSCAN*/OPTICS oracle.
+
+The parity reference for the device density engine
+(``dbscan_tpu/density``) and the degradation target for persistent
+device faults — the same role ``embed/oracle.py`` plays for the cosine
+engine. Everything here is f64 host math over the full pairwise
+matrix, so it is O(n^2) memory and capped at
+``DBSCAN_DENSITY_ORACLE_MAX`` rows by the callers.
+
+Semantics (Campello/Moulavi/Sander HDBSCAN*, the scikit-learn-contrib
+``hdbscan`` reference implementation):
+
+- core distance ``core(p)`` = distance to the ``min_pts``-th nearest
+  neighbor, SELF-INCLUSIVE (``min_pts = 1`` makes every core distance
+  0);
+- mutual reachability ``mr(a, b) = max(core(a), core(b), d(a, b))``;
+- the MST of the mutual-reachability graph under the TOTAL edge order
+  ``(w, min(u, v), max(u, v))`` — the lexicographic tie-break makes
+  the MST unique, which is what lets the device Borůvka pass and this
+  Kruskal pass agree edge-for-edge (PARITY.md "Variable-density
+  contract");
+- single-linkage dendrogram from the MST edges sorted under the same
+  total order, condensed with ``min_cluster_size`` pruning, and
+  excess-of-mass (EOM) stability selection with
+  ``allow_single_cluster=False`` (the root is never a cluster);
+- labels renumbered by the canonical min-member-row contract from
+  PR 8 (``embed.oracle.canonical_ids``): clusters are 1..K ordered by
+  smallest member row, noise is 0.
+
+OPTICS is defined here (and in PARITY.md) as the Prim traversal of the
+mutual-reachability MST from row 0 with the same ``(w, min, max)``
+tie-break: because the MST is unique under the total order, Prim on
+the MST visits vertices in the same order as Prim on the full graph,
+and the attaching edge weight IS the point's reachability distance
+(inf for the start row). That gives the reachability plot the device
+pass reproduces exactly from its own sorted-MST output.
+
+Cross-check: when the scikit-learn-contrib ``hdbscan`` package is
+importable, tests/test_density.py compares this oracle's labels
+against it (skip-marked otherwise — no new hard dependency).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from dbscan_tpu.embed.oracle import canonical_ids  # noqa: F401 (re-export)
+
+#: host-oracle cap fallback (the callers consult the
+#: ``DBSCAN_DENSITY_ORACLE_MAX`` knob; this mirrors its default so the
+#: oracle is usable standalone)
+ORACLE_MAX_POINTS = 100_000
+
+
+def pairwise_dists(x: np.ndarray, metric: str) -> np.ndarray:
+    """Full [n, n] f64 distance matrix with an exact-zero diagonal.
+
+    ``euclidean``: plain L2 over all columns. ``cosine``: chord-style
+    ``1 - <u, v>`` over L2-normalized rows (zero rows stay at
+    similarity 0 — distance 1 — to everything, the embed engine's
+    convention). The diagonal is forced to exactly 0 either way so the
+    self-inclusive core-distance rank never depends on rounding."""
+    x = np.asarray(x, dtype=np.float64)
+    if metric == "euclidean":
+        sq = np.einsum("ij,ij->i", x, x)
+        d2 = sq[:, None] + sq[None, :] - 2.0 * (x @ x.T)
+        d = np.sqrt(np.maximum(d2, 0.0))
+    elif metric == "cosine":
+        norms = np.sqrt(np.einsum("ij,ij->i", x, x))
+        inv = np.where(norms > 0, 1.0 / np.maximum(norms, 1e-300), 0.0)
+        unit = x * inv[:, None]
+        d = 1.0 - unit @ unit.T
+        np.clip(d, 0.0, None, out=d)
+    else:
+        raise ValueError(f"unknown metric {metric!r}")
+    np.fill_diagonal(d, 0.0)
+    return d
+
+
+def core_distances(dists: np.ndarray, min_pts: int) -> np.ndarray:
+    """Self-inclusive k-th-NN core distance per row (k = min_pts)."""
+    n = len(dists)
+    k = min(int(min_pts), n)
+    if k <= 1:
+        return np.zeros(n, dtype=np.float64)
+    part = np.partition(dists, k - 1, axis=1)
+    return part[:, k - 1].copy()
+
+
+def mutual_reachability(dists: np.ndarray, core: np.ndarray) -> np.ndarray:
+    """``mr(a, b) = max(core(a), core(b), d(a, b))`` with a 0 diagonal
+    (self-reachability never participates in the MST)."""
+    mr = np.maximum(dists, np.maximum(core[:, None], core[None, :]))
+    np.fill_diagonal(mr, 0.0)
+    return mr
+
+
+def mst_edges(mr: np.ndarray) -> np.ndarray:
+    """The unique MST of the full mutual-reachability graph under the
+    ``(w, min(u, v), max(u, v))`` total order.
+
+    Kruskal over all n*(n-1)/2 undirected edges lexsorted by that key;
+    returns an [n-1, 3] f64 array of ``(u, v, w)`` rows, themselves in
+    the total order (u < v per row). O(n^2 log n) host work — oracle
+    territory, cap enforced by callers."""
+    n = len(mr)
+    if n <= 1:
+        return np.empty((0, 3), dtype=np.float64)
+    iu, iv = np.triu_indices(n, k=1)
+    w = mr[iu, iv]
+    order = np.lexsort((iv, iu, w))
+    iu, iv, w = iu[order], iv[order], w[order]
+    parent = np.arange(n, dtype=np.int64)
+
+    def find(a: int) -> int:
+        root = a
+        while parent[root] != root:
+            root = parent[root]
+        while parent[a] != root:
+            parent[a], a = root, parent[a]
+        return root
+
+    out = np.empty((n - 1, 3), dtype=np.float64)
+    got = 0
+    for u, v, wt in zip(iu, iv, w):
+        ru, rv = find(int(u)), find(int(v))
+        if ru == rv:
+            continue
+        parent[rv] = ru
+        out[got] = (u, v, wt)
+        got += 1
+        if got == n - 1:
+            break
+    assert got == n - 1, "mutual-reachability graph must be connected"
+    return out
+
+
+def single_linkage(
+    edges: np.ndarray, n: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Dendrogram from MST edges ALREADY in the total order.
+
+    Returns ``(left, right, weight, size)``: internal node ``n + t``
+    merges dendrogram nodes ``left[t]`` and ``right[t]`` at distance
+    ``weight[t]``; ``size[node]`` counts leaves under any node id. The
+    merge ORDER is the sorted-edge order, so equal-weight merges are
+    deterministic — the device condense pass sorts with the same key
+    and builds the identical tree."""
+    left = np.empty(max(n - 1, 0), dtype=np.int64)
+    right = np.empty(max(n - 1, 0), dtype=np.int64)
+    weight = np.empty(max(n - 1, 0), dtype=np.float64)
+    size = np.ones(2 * n - 1 if n else 0, dtype=np.int64)
+    parent = np.arange(n, dtype=np.int64)
+    node_of = np.arange(n, dtype=np.int64)
+
+    def find(a: int) -> int:
+        root = a
+        while parent[root] != root:
+            root = parent[root]
+        while parent[a] != root:
+            parent[a], a = root, parent[a]
+        return root
+
+    for t in range(len(edges)):
+        u, v, wt = int(edges[t, 0]), int(edges[t, 1]), float(edges[t, 2])
+        ru, rv = find(u), find(v)
+        node = n + t
+        left[t] = node_of[ru]
+        right[t] = node_of[rv]
+        weight[t] = wt
+        size[node] = size[node_of[ru]] + size[node_of[rv]]
+        parent[rv] = ru
+        node_of[ru] = node
+    return left, right, weight, size
+
+
+def condense_tree(
+    left: np.ndarray,
+    right: np.ndarray,
+    weight: np.ndarray,
+    size: np.ndarray,
+    n: int,
+    min_cluster_size: int,
+) -> List[Tuple[int, int, float, int]]:
+    """Condensed tree: rows ``(parent, child, lambda, child_size)``.
+
+    Points keep ids 0..n-1; condensed clusters number from ``n`` (the
+    root) upward in discovery order — the scikit-learn-contrib
+    reference algorithm verbatim: a split where both sides reach
+    ``min_cluster_size`` creates two new clusters; a side below it
+    sheds its points at the split's lambda while the big side keeps
+    the parent's identity."""
+    if n == 0:
+        return []
+    if n == 1:
+        return []
+    root = 2 * n - 2
+    mcs = max(int(min_cluster_size), 2)
+
+    def children(node: int) -> Tuple[int, int]:
+        t = node - n
+        return int(left[t]), int(right[t])
+
+    def bfs(node: int) -> List[int]:
+        out, frontier = [], [node]
+        while frontier:
+            out.extend(frontier)
+            frontier = [
+                c
+                for f in frontier
+                if f >= n
+                for c in children(f)
+            ]
+        return out
+
+    relabel: Dict[int, int] = {root: n}
+    next_label = n + 1
+    ignore = np.zeros(2 * n - 1, dtype=bool)
+    rows: List[Tuple[int, int, float, int]] = []
+    for node in bfs(root):
+        if node < n or ignore[node]:
+            continue
+        lnode, rnode = children(node)
+        dist = float(weight[node - n])
+        lam = 1.0 / dist if dist > 0.0 else np.inf
+        lc, rc = int(size[lnode]), int(size[rnode])
+        lab = relabel[node]
+        if lc >= mcs and rc >= mcs:
+            relabel[lnode] = next_label
+            rows.append((lab, next_label, lam, lc))
+            next_label += 1
+            relabel[rnode] = next_label
+            rows.append((lab, next_label, lam, rc))
+            next_label += 1
+        elif lc < mcs and rc < mcs:
+            for sub in bfs(lnode):
+                if sub < n:
+                    rows.append((lab, sub, lam, 1))
+                ignore[sub] = True
+            for sub in bfs(rnode):
+                if sub < n:
+                    rows.append((lab, sub, lam, 1))
+                ignore[sub] = True
+        elif lc < mcs:
+            relabel[rnode] = lab
+            for sub in bfs(lnode):
+                if sub < n:
+                    rows.append((lab, sub, lam, 1))
+                ignore[sub] = True
+        else:
+            relabel[lnode] = lab
+            for sub in bfs(rnode):
+                if sub < n:
+                    rows.append((lab, sub, lam, 1))
+                ignore[sub] = True
+    return rows
+
+
+def eom_select(
+    rows: List[Tuple[int, int, float, int]], n: int
+) -> Tuple[set, Dict[int, int]]:
+    """Excess-of-mass cluster selection (``allow_single_cluster=False``).
+
+    Returns ``(selected cluster ids, child -> parent over condensed
+    CLUSTERS)``. Stability(c) = sum over c's condensed rows of
+    ``(lambda_row - lambda_birth(c)) * child_size``; processing
+    clusters bottom-up, a cluster beats its children when its own
+    stability is >= the sum of theirs, in which case its whole
+    descendant subtree is deselected. The root (id ``n``) is excluded
+    outright."""
+    if not rows:
+        return set(), {}
+    birth: Dict[int, float] = {}
+    stability: Dict[int, float] = {}
+    cluster_parent: Dict[int, int] = {}
+    cluster_children: Dict[int, List[int]] = {}
+    for parent, child, lam, _sz in rows:
+        if child >= n:
+            birth[child] = lam
+            cluster_parent[child] = parent
+            cluster_children.setdefault(parent, []).append(child)
+    birth[n] = 0.0
+    for parent, _child, lam, sz in rows:
+        b = birth[parent]
+        contrib = (lam - b) * sz if np.isfinite(lam) else 0.0
+        stability[parent] = stability.get(parent, 0.0) + contrib
+    for c in birth:
+        stability.setdefault(c, 0.0)
+    is_cluster = {c: True for c in birth if c != n}
+    for node in sorted(is_cluster, reverse=True):
+        kids = cluster_children.get(node, [])
+        child_sum = sum(stability[k] for k in kids)
+        if stability[node] < child_sum and kids:
+            is_cluster[node] = False
+            stability[node] = child_sum
+        else:
+            # node wins: deselect every descendant cluster
+            frontier = list(kids)
+            while frontier:
+                k = frontier.pop()
+                is_cluster[k] = False
+                frontier.extend(cluster_children.get(k, []))
+    selected = {c for c, keep in is_cluster.items() if keep}
+    return selected, cluster_parent
+
+
+def labels_from_tree(
+    rows: List[Tuple[int, int, float, int]], n: int
+) -> np.ndarray:
+    """Point labels via EOM selection: each point maps to the nearest
+    selected ancestor of the condensed cluster it fell out of, else
+    noise. Returns RAW selected-cluster ids (>= n) with -1 noise; the
+    callers canonicalize."""
+    out = np.full(n, -1, dtype=np.int64)
+    if not rows:
+        return out
+    selected, cluster_parent = eom_select(rows, n)
+    resolve: Dict[int, int] = {}
+
+    def nearest_selected(c: int) -> int:
+        chain = []
+        cur = c
+        while cur not in resolve:
+            if cur in selected:
+                resolve[cur] = cur
+                break
+            if cur == n or cur not in cluster_parent:
+                resolve[cur] = -1
+                break
+            chain.append(cur)
+            cur = cluster_parent[cur]
+        got = resolve[cur] if cur in resolve else -1
+        for link in chain:
+            resolve[link] = got
+        return got
+
+    for parent, child, _lam, _sz in rows:
+        if child < n:
+            out[child] = nearest_selected(parent)
+    return out
+
+
+def hdbscan_labels(
+    pts: np.ndarray,
+    min_pts: int,
+    min_cluster_size: int,
+    metric: str = "euclidean",
+) -> np.ndarray:
+    """Canonical HDBSCAN* labels: [n] int32, clusters 1..K by smallest
+    member row, 0 noise — the full oracle pipeline in one call."""
+    pts = np.asarray(pts, dtype=np.float64)
+    n = len(pts)
+    if n == 0:
+        return np.empty(0, dtype=np.int32)
+    if n == 1:
+        return np.zeros(1, dtype=np.int32)
+    d = pairwise_dists(pts, metric)
+    core = core_distances(d, min_pts)
+    mr = mutual_reachability(d, core)
+    edges = mst_edges(mr)
+    raw = labels_from_mst(edges, n, min_cluster_size)
+    return canonical_raw(raw)
+
+
+def labels_from_mst(
+    edges: np.ndarray, n: int, min_cluster_size: int
+) -> np.ndarray:
+    """RAW labels (selected-cluster ids, -1 noise) from total-ordered
+    MST edges — the shared back half of :func:`hdbscan_labels`, also
+    used by tests to process device-produced MSTs through the oracle's
+    condense machinery."""
+    left, right, weight, size = single_linkage(edges, n)
+    rows = condense_tree(left, right, weight, size, n, min_cluster_size)
+    return labels_from_tree(rows, n)
+
+
+def canonical_raw(raw: np.ndarray) -> np.ndarray:
+    """Canonical renumbering of raw labels (-1 noise): clusters become
+    1..K ordered by smallest member row, noise 0 — the PR 8 contract
+    (same renumbering ``embed.oracle.canonical_ids`` applies to seed
+    labels)."""
+    n = len(raw)
+    out = np.zeros(n, dtype=np.int32)
+    seen: Dict[int, int] = {}
+    nxt = 1
+    for i in range(n):
+        r = int(raw[i])
+        if r < 0:
+            continue
+        if r not in seen:
+            seen[r] = nxt
+            nxt += 1
+        out[i] = seen[r]
+    return out
+
+
+def optics_order(
+    edges: np.ndarray, n: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """OPTICS ordering + reachability from total-ordered MST edges.
+
+    Prim traversal of the (unique) mutual-reachability MST starting at
+    row 0, frontier keyed by ``(w, min(u, v), max(u, v))`` — the same
+    total order everywhere. Returns ``(order [n] int64, reach [n]
+    f64)`` with ``reach[order[0]] = inf``. Both the oracle and the
+    device engine derive OPTICS through this function, so parity is
+    structural; its INPUT edges are what the two sides must agree on."""
+    order = np.empty(n, dtype=np.int64)
+    reach = np.full(n, np.inf, dtype=np.float64)
+    if n == 0:
+        return order, reach
+    adj: Dict[int, List[Tuple[int, float]]] = {i: [] for i in range(n)}
+    for u, v, w in edges:
+        adj[int(u)].append((int(v), float(w)))
+        adj[int(v)].append((int(u), float(w)))
+    visited = np.zeros(n, dtype=bool)
+    heap: List[Tuple[float, int, int, int]] = [(-np.inf, -1, -1, 0)]
+    got = 0
+    while heap:
+        w, _a, _b, node = heapq.heappop(heap)
+        if visited[node]:
+            continue
+        visited[node] = True
+        order[got] = node
+        reach[node] = np.inf if got == 0 else w
+        got += 1
+        for nbr, wt in adj[node]:
+            if not visited[nbr]:
+                heapq.heappush(
+                    heap, (wt, min(node, nbr), max(node, nbr), nbr)
+                )
+    assert got == n, "MST must span all rows"
+    return order, reach
+
+
+def optics_oracle(
+    pts: np.ndarray, min_pts: int, metric: str = "euclidean"
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Full OPTICS oracle: ``(order, reach, core)`` f64 host arrays."""
+    pts = np.asarray(pts, dtype=np.float64)
+    n = len(pts)
+    if n == 0:
+        return (
+            np.empty(0, np.int64),
+            np.empty(0, np.float64),
+            np.empty(0, np.float64),
+        )
+    d = pairwise_dists(pts, metric)
+    core = core_distances(d, min_pts)
+    if n == 1:
+        return np.zeros(1, np.int64), np.full(1, np.inf), core
+    edges = mst_edges(mutual_reachability(d, core))
+    order, reach = optics_order(edges, n)
+    return order, reach, core

@@ -78,10 +78,13 @@ SITE_CAMPAIGN = "campaign"  # campaign worker lease (dbscan_tpu/campaign.py)
 SITE_SERVE = "serve"  # ClusterService ingest/query steps (dbscan_tpu/serve)
 SITE_SERVE_REPLICA = "serve_replica"  # router query replicas (serve/router.py)
 SITE_EMBED = "embed"  # embed engine hash/neighbor dispatches (dbscan_tpu/embed)
+SITE_DENSITY_CORE = "density_core"  # density core-distance chunks (density/)
+SITE_DENSITY_BORUVKA = "density_boruvka"  # density Borůvka MST rounds
 _SITES = (
     SITE_DISPATCH, SITE_BANDED, SITE_SPILL, SITE_SPILL_LEVEL,
     SITE_STREAM, SITE_PULL, SITE_CELLCC, SITE_CAMPAIGN, SITE_SERVE,
-    SITE_SERVE_REPLICA, SITE_EMBED, "*",
+    SITE_SERVE_REPLICA, SITE_EMBED, SITE_DENSITY_CORE,
+    SITE_DENSITY_BORUVKA, "*",
 )
 
 

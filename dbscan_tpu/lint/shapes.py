@@ -517,6 +517,61 @@ def _models() -> Dict[str, FamilyModel]:
                 "dense-width guard), W the ratcheted neighbor-slot "
                 "rung — data-scaled, runtime-gated",
             ),
+            FamilyModel(
+                "density.core",
+                [
+                    ArgModel("x", ("N", "D"), FLOAT),
+                    ArgModel("mask", ("N",), BOOL),
+                    ArgModel("start", (), INT),
+                ],
+                # temps: one [C, N] f32 distance slab + the top_k
+                # working copy per chunk; outs: the [C] chunk vector.
+                # C (the DBSCAN_DENSITY_CHUNK packing-window rung,
+                # clamped to N) is not an arg dim — data-scaled like
+                # embed's W, runtime-gated; the chunk start rides as a
+                # TRACED 0-d int32 so every chunk shares one kernel.
+                overhead=_sy("C") * _sy("N") * 12 + _sy("C") * 4,
+                static_slots=None,
+                note="chunked k-th-neighbor core distances "
+                "(dbscan_tpu/density/core.py): N is the ladder-padded "
+                "payload, one dispatch per DBSCAN_DENSITY_CHUNK rows",
+            ),
+            FamilyModel(
+                "density.boruvka",
+                [
+                    ArgModel("x", ("N", "D"), FLOAT),
+                    ArgModel("mask", ("N",), BOOL),
+                    ArgModel("core", ("N",), FLOAT),
+                    ArgModel("comp", ("N",), INT),
+                ],
+                # temps: one [128, N] mutual-reachability slab per
+                # lax.map step + the per-point candidate vectors and
+                # the scatter-min stages (a handful of [N] arrays);
+                # outs: comp' + the selected-edge vectors
+                overhead=E(128) * _sy("N") * 16 + _sy("N") * 64,
+                static_slots=None,
+                note="one Borůvka MST round over mutual-reachability "
+                "edges (dbscan_tpu/density/boruvka.py): scatter-min "
+                "cheapest-edge selection + union-find contraction; "
+                "data-scaled, runtime-gated",
+            ),
+            FamilyModel(
+                "density.condense",
+                [
+                    ArgModel("eu", ("EP",), INT),
+                    ArgModel("ev", ("EP",), INT),
+                    ArgModel("ew", ("EP",), FLOAT),
+                    ArgModel("valid", ("EP",), BOOL),
+                ],
+                # temps: the three lexsort key vectors + the perm;
+                # outs: five sorted vectors + a scalar — all [EP]
+                overhead=_sy("EP") * 64,
+                static_slots=None,
+                note="MST edge sort under the total order + lambda "
+                "prefix (dbscan_tpu/density/condense.py): EP is the "
+                "128-step padded edge ladder; data-scaled, "
+                "runtime-gated",
+            ),
             _level_model(),
             _level_final_model(),
         )

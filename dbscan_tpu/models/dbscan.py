@@ -106,6 +106,12 @@ def train(
     data: [N, >=2] host array; only the first two columns participate in
     Euclidean clustering (reference DBSCAN.scala:33-34); extra columns ride
     along into labeled_points.
+    eps: the neighborhood radius, or the string ``"auto"`` to select it
+    from the data — the knee of the per-partition sorted k-distance
+    curve (k = min_points) over a deterministic subsample, median
+    across ``DBSCAN_DENSITY_AUTO_PARTS`` coordinate strips
+    (dbscan_tpu/density/core.py:auto_eps, euclidean only); the chosen
+    value and per-strip statistics land in ``model.stats["eps_auto"]``.
     mesh: optional jax.sharding.Mesh to fan partitions out over devices;
     None = single device.
     checkpoint_dir: when set, the expensive pre-merge state is persisted
@@ -116,6 +122,21 @@ def train(
     whether a retries-exhausted group degrades to the CPU engine
     instead of aborting the run.
     """
+    auto_stats: dict = {}
+    if isinstance(eps, str):
+        if eps != "auto":
+            raise ValueError(f"eps must be a number or 'auto', got {eps!r}")
+        if config is not None:
+            raise ValueError("eps='auto' cannot override an explicit config")
+        if metric != "euclidean":
+            raise ValueError("eps='auto' supports only metric='euclidean'")
+        from dbscan_tpu.density.core import auto_eps
+
+        eps = auto_eps(
+            np.asarray(data, dtype=np.float64)[:, :2],
+            min_points,
+            stats_out=auto_stats,
+        )
     cfg = config or DBSCANConfig(
         eps=eps,
         min_points=min_points,
@@ -133,6 +154,8 @@ def train(
     out: TrainOutput = train_arrays(
         data, cfg, mesh=mesh, checkpoint_dir=checkpoint_dir
     )
+    if auto_stats:
+        out.stats.update(auto_stats)
     return DBSCANModel(
         config=cfg,
         points=np.asarray(data),

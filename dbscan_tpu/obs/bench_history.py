@@ -79,13 +79,16 @@ from dbscan_tpu.obs import schema
 # _vs_default_speedup: the autotuner's tuned-vs-default ratio
 # (python -m dbscan_tpu.bench --tune) — HARD-FLOORED at 1.0 by
 # obs/regress.py (a committed profile that loses to defaults is a red
-# gate, the same contract shape as _pred_ratio's hard cap)
+# gate, the same contract shape as _pred_ratio's hard cap);
+# _boruvka_rounds: the density engine's MST contraction round count
+# (dbscan_tpu/density/boruvka.py) — a dispatch-depth figure bounded by
+# ceil(log2 n) + 2 that regresses UP like _spill_levels
 _EXACT_KEYS = ("value", "seconds", "vs_baseline")
 _SUFFIXES = (
     "_seconds", "_s", "_mpts", "_vs_baseline", "_overlap_ratio",
     "_pred_ratio", "_spill_levels", "_busy_frac", "_cc_iters",
     "_replay_frac", "_qps", "_ms", "_ari", "_prop_sweeps",
-    "_vs_default_speedup", "_shed_frac",
+    "_vs_default_speedup", "_shed_frac", "_boruvka_rounds",
 )
 # numeric-but-not-perf keys the suffix rule would otherwise catch —
 # declared with the telemetry schema (the keys are fault-counter
@@ -127,6 +130,8 @@ def _unit_for(metric: str, obj: dict) -> Optional[str]:
         return "ratio"
     if metric.endswith("_spill_levels"):
         return "levels"
+    if metric.endswith("_boruvka_rounds"):
+        return "rounds"
     if metric.endswith("_cc_iters"):
         return "iters"
     if metric.endswith("_prop_sweeps"):

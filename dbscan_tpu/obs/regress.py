@@ -88,6 +88,12 @@ def direction(metric: str, unit: Optional[str] = None) -> Optional[str]:
     if metric.endswith("_qps"):
         # serving query rate under concurrent ingest: regresses DOWN
         return HIGHER_BETTER
+    if metric.endswith("_boruvka_rounds"):
+        # density-engine Borůvka MST contraction rounds: each is a full
+        # [n_pad, n_pad] mutual-reachability scan + a synchronous pull,
+        # bounded by ceil(log2 n) + 2 — a round-count blowup regresses
+        # UP like _spill_levels (labels are count-independent)
+        return LOWER_BETTER
     if metric.endswith("_ms"):
         # serve query latency percentiles: walls, regress UP
         return LOWER_BETTER

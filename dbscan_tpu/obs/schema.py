@@ -56,6 +56,9 @@ COMPILE_FAMILIES = (
     "serve.broadcast",
     "embed.hash",
     "embed.neighbors",
+    "density.core",
+    "density.boruvka",
+    "density.condense",
 )
 
 #: HBM watermark sample sites (obs/memory.py `sample`): each emits
@@ -228,6 +231,22 @@ COUNTERS = {
     "embed.occ_le_1024": "embed buckets holding 65..1024 points",
     "embed.occ_le_16384": "embed buckets holding 1025..16384 points",
     "embed.occ_gt_16384": "embed buckets holding > 16384 points",
+    "density.points": "points entering density-engine (HDBSCAN*/"
+    "OPTICS) runs",
+    "density.core_dispatches": "density.core chunk dispatches issued "
+    "(packing-window core-distance slabs)",
+    "density.boruvka_dispatches": "density.boruvka round dispatches "
+    "issued (retries included; = density.rounds when fault-free)",
+    "density.rounds": "completed Borůvka MST contraction rounds "
+    "(data-dependent, bounded by ceil(log2 n) + 2; labels are "
+    "round-count-independent — the unique-MST total-order invariant)",
+    "density.edges": "mutual-reachability MST edges banked across "
+    "runs (= n - 1 per run)",
+    "density.condense_dispatches": "density.condense sort/compact "
+    "dispatches issued (one per run)",
+    "density.oracle_fallbacks": "density runs degraded whole to the "
+    "numpy host oracle after a persistent density_boruvka fault "
+    "(labels intact — the PARITY.md variable-density contract)",
     "devtime.samples": "dispatches bracketed by the ready-sync "
     "device-timeline hooks (DBSCAN_DEVTIME)",
     "devtime.dispatch_s": "summed host wall of the bracketed dispatch "
@@ -284,6 +303,8 @@ GAUGES = {
     "prop.mode": "resolved propagation mode of the last settled "
     "window_cc-family fixed point (1.0 = unionfind, 0.0 = iterated — "
     "DBSCAN_PROP_UNIONFIND, ops/propagation.py note_sweeps)",
+    "density.eps_auto": "eps selected by the last eps='auto' "
+    "k-distance knee probe (median of the per-strip knees)",
 }
 
 SPANS = {
@@ -337,6 +358,18 @@ SPANS = {
     "embed.bucket": "one embed bucket neighbor dispatch window "
     "(partition id, width, W rung attached)",
     "embed.merge": "embed instance-table merge (shared finalize_merge)",
+    "density.run": "root span over one density-engine run (n, metric, "
+    "kind=hdbscan/optics attached)",
+    "density.core_chunk": "one density.core chunk dispatch window "
+    "(chunk start + width attached)",
+    "density.round": "one Borůvka round window (dispatch + the thin "
+    "synchronous selection pull; round index attached)",
+    "density.condense": "the density.condense sort/compact dispatch "
+    "window (edge count attached)",
+    "density.condense_pull": "the ONE PullEngine pull riding the "
+    "sorted-MST arrays back (the final-labels pull)",
+    "density.auto_eps": "the eps='auto' probe window (sample size + "
+    "strip count attached)",
 }
 
 EVENTS = {

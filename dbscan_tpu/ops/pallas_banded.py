@@ -154,7 +154,11 @@ def _make_counts_kernel(d: int, sc: int, nsub: int, ns: int):
             adj = _tile_adj(
                 bl_planes, bm, brel, bspan, slabs, smask, offs, eps2, k
             )
-            acc = acc + jnp.sum(adj.astype(jnp.int32), axis=1)
+            # dtype pinned: under interpret+x64 a default integer sum
+            # widens to int64 and the scratch store rejects the mix
+            acc = acc + jnp.sum(
+                adj.astype(jnp.int32), axis=1, dtype=jnp.int32
+            )
         _accumulate(out, acc_ref, acc, nsub, ns, lambda a, b: a + b)
 
     return kernel

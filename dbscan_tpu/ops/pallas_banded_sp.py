@@ -120,7 +120,11 @@ def _make_counts_kernel_sp(d: int, sc: int, nsub: int, ns: int):
                 [prows[p * r + k] for p in range(d)], mrows[k],
                 offs_rel, eps2, i, s, sc, k,
             )
-            acc = acc + jnp.sum(adj.astype(jnp.int32), axis=1)
+            # dtype pinned: under interpret+x64 a default integer sum
+            # widens to int64 and the scratch store rejects the mix
+            acc = acc + jnp.sum(
+                adj.astype(jnp.int32), axis=1, dtype=jnp.int32
+            )
         _accumulate(out, acc_ref, acc, nsub, ns, lambda a, b: a + b)
 
     return kernel

@@ -111,9 +111,16 @@ def _smem_spec():
     return pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
 
 
+# pallas renamed TPUCompilerParams -> CompilerParams across jax releases;
+# resolve whichever this jax ships so the kernels import on both sides.
+_COMPILER_PARAMS = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
+
 def _grid_params(n: int):
     grid = (n // TILE, n // TILE)
-    compiler_params = pltpu.CompilerParams(
+    compiler_params = _COMPILER_PARAMS(
         dimension_semantics=("parallel", "arbitrary")
     )
     return grid, compiler_params

@@ -1,0 +1,560 @@
+"""Variable-density engine suite (``dbscan_tpu/density/``).
+
+Pins the PARITY.md "Variable-density contract": device HDBSCAN*
+labels match the pure-NumPy host oracle EXACTLY (two independent
+condense constructions — the oracle's top-down dendrogram walk vs the
+engine's single-sweep bottom-up build — agreeing label-for-label on
+the same total-ordered MST), on 2-D euclidean and cosine embed inputs,
+under both propagation modes, and under injected ``density_core`` /
+``density_boruvka`` faults (transient heal; persistent chunk-fallback
+and whole-run oracle degrade with labels intact). Also: the
+zero-retrace second-run compile pin, the ceil(log2 n) + 2 Borůvka
+round bound, MST total-weight property-fuzz vs SciPy, OPTICS
+order/reachability parity, the eps='auto' knee probe, and the
+``DBSCAN_SHAPECHECK=1`` subprocess rerun asserting an empty violation
+report with all three density families covered.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import faults, obs
+from dbscan_tpu import density
+from dbscan_tpu.density import boruvka, condense, core, oracle
+
+pytestmark = pytest.mark.density
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _multi_density_blobs(rng, n_noise=20):
+    """Two tight blobs + one loose blob + uniform noise: the payload a
+    single global eps cannot label (the engine's reason to exist)."""
+    a = rng.normal((0.0, 0.0), 0.05, (60, 2))
+    b = rng.normal((1.5, 0.0), 0.05, (50, 2))
+    c = rng.normal((0.0, 4.0), 0.6, (80, 2))
+    noise = rng.uniform(-3.0, 7.0, (n_noise, 2))
+    return np.concatenate([a, b, c, noise])
+
+
+def _cosine_blobs(rng, d=16):
+    e1 = rng.normal(0, 1, (1, d))
+    e2 = rng.normal(0, 1, (1, d))
+    p1 = e1 + rng.normal(0, 0.02, (70, d))
+    p2 = e2 + rng.normal(0, 0.02, (60, d))
+    p3 = rng.normal(0, 1, (30, d))
+    return np.concatenate([p1, p2, p3])
+
+
+def _payload(rng, metric):
+    return _cosine_blobs(rng) if metric == "cosine" else (
+        _multi_density_blobs(rng)
+    )
+
+
+def _oracle_input(pts, metric):
+    """What the oracle must see to be the engine's exact reference: the
+    engine's own f32 payload (cosine rows f32-normalized) upcast."""
+    x32 = density._unit_payload(np.asarray(pts), metric)
+    return np.asarray(x32, dtype=np.float64)
+
+
+def _oracle_labels(pts, min_pts, metric, mcs=None):
+    return oracle.hdbscan_labels(
+        _oracle_input(pts, metric), min_pts, mcs or min_pts, metric
+    )
+
+
+@pytest.fixture(autouse=True)
+def _fresh_density_state(monkeypatch):
+    monkeypatch.setenv("DBSCAN_FAULT_BACKOFF_S", "0")
+    faults.reset_registry()
+    yield
+    faults.reset_registry()
+
+
+# --- oracle-vs-device exact parity -------------------------------------
+
+
+@pytest.mark.parametrize("metric", ["euclidean", "cosine"])
+@pytest.mark.parametrize("prop", ["unionfind", "iterated"])
+def test_hdbscan_device_oracle_parity(rng, metric, prop, monkeypatch):
+    """Device labels == host-oracle labels, byte for byte, on
+    multi-density payloads — under BOTH propagation modes of the shared
+    union-find contraction."""
+    monkeypatch.setenv("DBSCAN_PROP_UNIONFIND", prop)
+    pts = _payload(rng, metric)
+    stats = {}
+    lab = density.hdbscan(pts, min_pts=5, metric=metric, stats_out=stats)
+    ref = _oracle_labels(pts, 5, metric)
+    np.testing.assert_array_equal(lab, ref)
+    assert lab.max() >= 2  # the payload really holds multiple clusters
+    assert (lab == 0).any()  # and real noise
+    assert stats["boruvka_rounds"] >= 1
+    assert stats["n"] == len(pts)
+
+
+@pytest.mark.parametrize("min_pts,mcs", [(3, 3), (5, 10), (8, 4)])
+def test_hdbscan_parameter_sweep_parity(rng, min_pts, mcs):
+    pts = _multi_density_blobs(rng)
+    lab = density.hdbscan(pts, min_pts=min_pts, min_cluster_size=mcs)
+    ref = _oracle_labels(pts, min_pts, "euclidean", mcs=mcs)
+    np.testing.assert_array_equal(lab, ref)
+
+
+def test_hdbscan_chunked_core_parity(rng, monkeypatch):
+    """A chunk width smaller than the payload forces multiple
+    density.core dispatches (incl. the clamped overlapping tail) —
+    labels must not depend on the chunking."""
+    pts = _multi_density_blobs(rng)
+    whole = density.hdbscan(pts, min_pts=5)
+    monkeypatch.setenv("DBSCAN_DENSITY_CHUNK", "96")
+    stats = {}
+    lab = density.hdbscan(pts, min_pts=5, stats_out=stats)
+    assert stats["core_chunks"] >= 3
+    np.testing.assert_array_equal(lab, whole)
+
+
+def test_hdbscan_two_condense_constructions_agree(rng):
+    """The single-sweep bottom-up condense (condense.py) and the
+    oracle's top-down dendrogram condense produce identical labels from
+    the SAME total-ordered MST — the two independent constructions the
+    missing hdbscan library is compensated by."""
+    pts = _multi_density_blobs(rng)
+    x = _oracle_input(pts, "euclidean")
+    n = len(x)
+    d = oracle.pairwise_dists(x, "euclidean")
+    edges = oracle.mst_edges(
+        oracle.mutual_reachability(d, oracle.core_distances(d, 5))
+    )
+    lam = np.where(edges[:, 2] > 0, 1.0 / edges[:, 2], np.inf)
+    for mcs in (3, 5, 12):
+        sweep = oracle.canonical_raw(
+            condense.condense_labels(edges, lam, n, mcs)
+        )
+        ref = oracle.canonical_raw(oracle.labels_from_mst(edges, n, mcs))
+        np.testing.assert_array_equal(sweep, ref)
+
+
+def test_degenerate_inputs():
+    assert density.hdbscan(np.empty((0, 2)), min_pts=3).shape == (0,)
+    np.testing.assert_array_equal(
+        density.hdbscan(np.zeros((1, 2)), min_pts=3), [0]
+    )
+    # n < min_cluster_size: everything stays pending -> all noise
+    pts = np.array([[0.0, 0.0], [0.1, 0.0], [0.0, 0.1]])
+    np.testing.assert_array_equal(
+        density.hdbscan(pts, min_pts=2, min_cluster_size=5), [0, 0, 0]
+    )
+    # all-duplicate rows: zero-weight chain MST, infinite lambdas
+    dup = np.zeros((24, 2))
+    lab = density.hdbscan(dup, min_pts=3)
+    ref = oracle.hdbscan_labels(dup, 3, 3, "euclidean")
+    np.testing.assert_array_equal(lab, ref)
+
+
+def test_validation_errors():
+    pts = np.zeros((10, 2))
+    with pytest.raises(ValueError, match="metric"):
+        density.hdbscan(pts, metric="manhattan")
+    with pytest.raises(ValueError, match="min_pts"):
+        density.hdbscan(pts, min_pts=0)
+    with pytest.raises(ValueError, match="min_cluster_size"):
+        density.hdbscan(pts, min_pts=3, min_cluster_size=1)
+    with pytest.raises(ValueError, match="N, D"):
+        density.hdbscan(np.zeros(10), min_pts=3)
+
+
+def test_hdbscan_lib_cross_check(rng):
+    """Cross-check the host oracle against scikit-learn-contrib
+    ``hdbscan`` when importable (skip-marked otherwise — no new hard
+    dependency): identical partitions up to canonical renumbering."""
+    hdb = pytest.importorskip("hdbscan")
+    pts = _multi_density_blobs(rng)
+    ref = hdb.HDBSCAN(
+        min_samples=5,
+        min_cluster_size=5,
+        allow_single_cluster=False,
+        approx_min_span_tree=False,
+    ).fit(pts)
+    theirs = oracle.canonical_raw(np.asarray(ref.labels_, dtype=np.int64))
+    ours = oracle.hdbscan_labels(pts, 5, 5, "euclidean")
+    np.testing.assert_array_equal(ours, theirs)
+
+
+# --- Borůvka MST: property-fuzz + round bound --------------------------
+
+
+@pytest.mark.parametrize("seed,n,min_pts", [
+    (1, 70, 3), (2, 150, 5), (3, 150, 3), (4, 260, 5), (5, 90, 8),
+])
+def test_boruvka_mst_weight_matches_scipy(seed, n, min_pts):
+    """Property-fuzz: the device Borůvka MST total weight equals
+    SciPy's ``minimum_spanning_tree`` over the f64 mutual-reachability
+    graph (to f32 edge-weight rounding), and the oracle's Kruskal
+    matches it to f64 precision."""
+    sp = pytest.importorskip("scipy.sparse")
+    from scipy.sparse.csgraph import minimum_spanning_tree
+
+    g = np.random.default_rng(seed)
+    pts = np.concatenate([
+        g.normal((0, 0), 0.1, (n // 2, 2)),
+        g.normal((3, 3), 0.5, (n - n // 2, 2)),
+    ])
+    x = _oracle_input(pts, "euclidean")
+    d = oracle.pairwise_dists(x, "euclidean")
+    mr = oracle.mutual_reachability(d, oracle.core_distances(d, min_pts))
+    scipy_total = float(minimum_spanning_tree(sp.csr_matrix(mr)).sum())
+    kruskal = oracle.mst_edges(mr)
+    assert np.isclose(kruskal[:, 2].sum(), scipy_total, rtol=1e-9)
+    stats = {"_oracle_fallback": True}
+    dev_edges, rounds = density._device_mst(
+        np.asarray(pts, dtype=np.float32), min_pts, "euclidean", stats
+    )[0], None
+    assert len(dev_edges) == len(pts) - 1
+    assert np.isclose(dev_edges[:, 2].sum(), scipy_total, rtol=1e-5)
+    # and edge-for-edge identity with the oracle under the total order
+    dev_sorted = dev_edges[
+        np.lexsort((dev_edges[:, 1], dev_edges[:, 0], dev_edges[:, 2]))
+    ]
+    np.testing.assert_array_equal(
+        dev_sorted[:, :2].astype(np.int64),
+        kruskal[
+            np.lexsort((kruskal[:, 1], kruskal[:, 0], kruskal[:, 2]))
+        ][:, :2].astype(np.int64),
+    )
+
+
+def test_boruvka_round_bound(rng):
+    """Rounds are bounded by ceil(log2 n) + 2 — components at least
+    halve per round because every live component selects an edge of the
+    complete mutual-reachability graph."""
+    pts = _multi_density_blobs(rng)
+    stats = {}
+    density.hdbscan(pts, min_pts=5, stats_out=stats)
+    bound = int(math.ceil(math.log2(len(pts)))) + 2
+    assert 1 <= stats["boruvka_rounds"] <= bound, stats
+
+
+# --- OPTICS ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("metric", ["euclidean", "cosine"])
+def test_optics_order_and_reach_parity(rng, metric):
+    """Device OPTICS ordering is EXACTLY the oracle's (structural in
+    the shared MST edge set); reachability/core values agree to f32
+    edge-weight rounding."""
+    pts = _payload(rng, metric)
+    o_ord, o_reach, o_core = density.optics(pts, min_pts=5, metric=metric)
+    r_ord, r_reach, r_core = oracle.optics_oracle(
+        _oracle_input(pts, metric), 5, metric
+    )
+    np.testing.assert_array_equal(o_ord, r_ord)
+    assert np.isinf(o_reach[o_ord[0]])
+    fin = np.isfinite(r_reach)
+    np.testing.assert_allclose(
+        o_reach[fin], r_reach[fin], rtol=1e-4, atol=1e-6
+    )
+    np.testing.assert_allclose(o_core, r_core, rtol=1e-4, atol=1e-6)
+
+
+def test_optics_reachability_separates_densities(rng):
+    """The reachability plot's valleys are the clusters: within-blob
+    reachability sits far below the ridge entering the noise."""
+    pts = _multi_density_blobs(rng, n_noise=12)
+    order, reach, _ = density.optics(pts, min_pts=5)
+    lab = density.hdbscan(pts, min_pts=5)
+    in_cluster = lab[order] > 0
+    r = reach[order]
+    fin = np.isfinite(r)
+    assert np.median(r[in_cluster & fin]) < 0.5 * np.median(
+        r[~in_cluster & fin]
+    )
+
+
+# --- fault-site drills -------------------------------------------------
+
+
+def _spec(monkeypatch, spec):
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", spec)
+    faults.reset_registry()
+
+
+@pytest.mark.faults
+def test_density_core_transient_heals(rng, monkeypatch):
+    pts = _multi_density_blobs(rng)
+    clean = density.hdbscan(pts, min_pts=5)
+    _spec(monkeypatch, "density_core#0:TRANSIENT*2")
+    snap = faults.counters.snapshot()
+    lab = density.hdbscan(pts, min_pts=5)
+    delta = faults.counters.delta(snap)
+    assert delta["retries"] >= 2 and delta["injected"] >= 2
+    assert delta["fallbacks"] == 0
+    np.testing.assert_array_equal(clean, lab)
+
+
+@pytest.mark.faults
+def test_density_core_persistent_degrades_chunk_to_host(
+    rng, monkeypatch
+):
+    """A persistently failing core chunk degrades to the bitwise-
+    identical numpy chunk (euclidean leg) — labels intact."""
+    pts = _multi_density_blobs(rng)
+    clean = density.hdbscan(pts, min_pts=5)
+    _spec(monkeypatch, "density_core#0:PERSISTENT")
+    snap = faults.counters.snapshot()
+    lab = density.hdbscan(pts, min_pts=5)
+    delta = faults.counters.delta(snap)
+    assert delta["fallbacks"] >= 1
+    np.testing.assert_array_equal(clean, lab)
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("metric", ["euclidean", "cosine"])
+def test_density_boruvka_transient_heals(rng, metric, monkeypatch):
+    pts = _payload(rng, metric)
+    clean = density.hdbscan(pts, min_pts=5, metric=metric)
+    _spec(monkeypatch, "density_boruvka#0:TRANSIENT*2")
+    snap = faults.counters.snapshot()
+    lab = density.hdbscan(pts, min_pts=5, metric=metric)
+    delta = faults.counters.delta(snap)
+    assert delta["retries"] >= 2 and delta["injected"] >= 2
+    np.testing.assert_array_equal(clean, lab)
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("metric", ["euclidean", "cosine"])
+def test_density_boruvka_persistent_degrades_whole_run(
+    rng, metric, monkeypatch
+):
+    """A persistent Borůvka fault cannot degrade per round (the MST is
+    global state): the WHOLE run degrades to the host oracle, labels
+    intact."""
+    pts = _payload(rng, metric)
+    clean = density.hdbscan(pts, min_pts=5, metric=metric)
+    _spec(monkeypatch, "density_boruvka#0:PERSISTENT")
+    was = obs.active()
+    obs.enable()
+    try:
+        snap = obs.counters()
+        stats = {}
+        lab = density.hdbscan(
+            pts, min_pts=5, metric=metric, stats_out=stats
+        )
+        delta = obs.counters_delta(snap)
+    finally:
+        if not was:
+            obs.disable()
+    assert stats["density_degraded"] == "oracle"
+    assert delta.get("density.oracle_fallbacks", 0) == 1
+    np.testing.assert_array_equal(clean, lab)
+
+
+@pytest.mark.faults
+def test_density_persistent_without_fallback_raises(rng, monkeypatch):
+    pts = _multi_density_blobs(rng)
+    _spec(monkeypatch, "density_boruvka#0:PERSISTENT")
+    with pytest.raises(faults.FatalDeviceFault):
+        density.hdbscan(pts, min_pts=5, oracle_fallback=False)
+    _spec(monkeypatch, "density_core#0:PERSISTENT")
+    with pytest.raises(faults.FatalDeviceFault):
+        density.hdbscan(pts, min_pts=5, oracle_fallback=False)
+
+
+@pytest.mark.faults
+def test_density_optics_persistent_degrades_whole_run(rng, monkeypatch):
+    pts = _multi_density_blobs(rng)
+    c_ord, c_reach, _ = density.optics(pts, min_pts=5)
+    _spec(monkeypatch, "density_boruvka#0:PERSISTENT")
+    stats = {}
+    o_ord, o_reach, _ = density.optics(pts, min_pts=5, stats_out=stats)
+    assert stats["density_degraded"] == "oracle"
+    np.testing.assert_array_equal(c_ord, o_ord)
+    fin = np.isfinite(c_reach)
+    np.testing.assert_allclose(
+        o_reach[fin], c_reach[fin], rtol=1e-4, atol=1e-6
+    )
+
+
+# --- zero-retrace + citizenship ----------------------------------------
+
+
+def test_zero_retrace_second_run(rng):
+    """The acceptance pin: a second same-shaped run (hdbscan AND
+    optics, both metrics) compiles ZERO new kernels — chunk starts are
+    traced, ladders are ratcheted, round kernels are shape-keyed."""
+    jobs = [
+        (_multi_density_blobs(rng), "euclidean"),
+        (_cosine_blobs(rng), "cosine"),
+    ]
+    was = obs.active()
+    obs.enable()
+    try:
+        for pts, metric in jobs:  # warm pass settles every ladder
+            density.hdbscan(pts, min_pts=5, metric=metric)
+            density.optics(pts, min_pts=5, metric=metric)
+        snap = obs.counters()
+        for pts, metric in jobs:
+            density.hdbscan(pts, min_pts=5, metric=metric)
+            density.optics(pts, min_pts=5, metric=metric)
+        delta = obs.counters_delta(snap)
+        assert delta.get("compiles.total", 0) == 0, delta
+        assert delta.get("compiles.ratchet_raises", 0) == 0, delta
+    finally:
+        if not was:
+            obs.disable()
+
+
+def test_density_counters_declared(rng):
+    """Every density.* emission is schema-declared (the obs acceptance
+    contract) and the run stamps the expected counters."""
+    from dbscan_tpu.obs import schema
+
+    was = obs.active()
+    obs.enable()
+    try:
+        snap = obs.counters()
+        pts = _multi_density_blobs(rng)
+        density.hdbscan(pts, min_pts=5)
+        delta = obs.counters_delta(snap)
+        for name in obs.counters():
+            assert schema.is_declared("counter", name), name
+    finally:
+        if not was:
+            obs.disable()
+    assert delta.get("density.points", 0) == len(pts)
+    assert delta.get("density.edges", 0) == len(pts) - 1
+    assert delta.get("density.rounds", 0) >= 1
+    assert delta.get("density.core_dispatches", 0) >= 1
+    assert delta.get("density.condense_dispatches", 0) == 1
+
+
+def test_density_registry_citizenship():
+    """The three dispatch families + both fault sites + all knobs are
+    registered in their registries (the PR's citizenship checklist)."""
+    from dbscan_tpu import config
+    from dbscan_tpu.lint.shapes import FAMILY_MODELS
+    from dbscan_tpu.obs import schema
+
+    for fam in ("density.core", "density.boruvka", "density.condense"):
+        assert fam in schema.COMPILE_FAMILIES
+        assert fam in FAMILY_MODELS
+        assert schema.is_declared("counter", f"compiles.{fam}")
+    assert faults.SITE_DENSITY_CORE in faults._SITES
+    assert faults.SITE_DENSITY_BORUVKA in faults._SITES
+    for knob in (
+        "DBSCAN_DENSITY_CHUNK",
+        "DBSCAN_DENSITY_ORACLE_MAX",
+        "DBSCAN_DENSITY_AUTO_SAMPLE",
+        "DBSCAN_DENSITY_AUTO_PARTS",
+    ):
+        assert knob in config.ENV_VARS
+    assert schema.is_declared("span", "density.run")
+    assert schema.is_declared("gauge", "density.eps_auto")
+
+
+def test_shapecheck_subprocess_clean(tmp_path):
+    """DBSCAN_SHAPECHECK=1 rerun of hdbscan + optics in a fresh
+    process: the atexit JSON report must be violation-free with ALL
+    THREE density families covered."""
+    report = tmp_path / "shapecheck.json"
+    code = (
+        "import numpy as np\n"
+        "from dbscan_tpu import hdbscan, optics\n"
+        "from dbscan_tpu.density import oracle\n"
+        "rng = np.random.default_rng(0)\n"
+        "pts = np.concatenate([rng.normal((0, 0), 0.05, (60, 2)),"
+        " rng.normal((1.5, 0), 0.05, (50, 2)),"
+        " rng.normal((0, 4), 0.6, (80, 2)),"
+        " rng.uniform(-3, 7, (20, 2))])\n"
+        "lab = hdbscan(pts, min_pts=5)\n"
+        "ref = oracle.hdbscan_labels(pts.astype(np.float64), 5, 5)\n"
+        "assert np.array_equal(lab, ref)\n"
+        "order, reach, core = optics(pts, min_pts=5)\n"
+        "assert len(order) == len(pts)\n"
+    )
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        DBSCAN_SHAPECHECK="1",
+        DBSCAN_SHAPECHECK_REPORT=str(report),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env, capture_output=True, timeout=600, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr.decode(errors="replace")[-2000:]
+    rep = json.loads(report.read_text())
+    assert rep["violations"] == []
+    assert "density.core" in rep["sites"]
+    assert "density.boruvka" in rep["sites"]
+    assert "density.condense" in rep["sites"]
+
+
+# --- eps='auto' (plain-DBSCAN satellite) -------------------------------
+
+
+def test_auto_eps_probe_deterministic(rng):
+    pts = _multi_density_blobs(rng)
+    stats = {}
+    eps1 = core.auto_eps(pts, 5, stats_out=stats)
+    eps2 = core.auto_eps(pts, 5)
+    assert eps1 == eps2 > 0.0
+    info = stats["eps_auto"]
+    assert info["eps"] == eps1 and info["k"] == 5
+    assert info["strips"] == len(info["strip_knees"]) >= 1
+    # stamped knees are rounded to 9 decimals for the stats record
+    assert np.isclose(eps1, np.median(info["strip_knees"]), atol=1e-8)
+
+
+def test_knee_index_picks_the_elbow():
+    flat = np.linspace(0.1, 0.1001, 50)
+    assert 0 <= core.knee_index(flat) < 50
+    hockey = np.concatenate([np.full(40, 0.05), np.linspace(0.05, 2.0, 10)])
+    assert core.knee_index(hockey) >= 38  # at the bend, not the blade
+    assert core.knee_index(np.array([1.0])) == 0
+    assert core.knee_index(np.empty(0)) == 0
+
+
+def test_train_eps_auto_recovers_blobs(rng):
+    """train(eps='auto') resolves the knob from the k-distance knee and
+    stamps the probe statistics; the two same-density blobs come back
+    as the two dominant clusters."""
+    import dbscan_tpu
+
+    a = rng.normal((0.0, 0.0), 0.08, (120, 2))
+    b = rng.normal((4.0, 4.0), 0.08, (120, 2))
+    pts = np.concatenate([a, b, rng.uniform(-2.0, 6.0, (15, 2))])
+    m = dbscan_tpu.train(pts, "auto", 5)
+    info = m.stats["eps_auto"]
+    assert m.config.eps == info["eps"] > 0.0
+    la, lb = m.clusters[:120], m.clusters[120:240]
+    da = np.bincount(la[la > 0]).max()
+    db = np.bincount(lb[lb > 0]).max()
+    assert da >= 96 and db >= 96  # >= 80% of each blob in one cluster
+    assert (
+        np.bincount(la[la > 0]).argmax() != np.bincount(lb[lb > 0]).argmax()
+    )
+
+
+def test_train_eps_auto_validation(rng):
+    import dbscan_tpu
+    from dbscan_tpu.config import DBSCANConfig
+
+    pts = rng.normal(0, 1, (50, 2))
+    with pytest.raises(ValueError, match="'auto'"):
+        dbscan_tpu.train(pts, "bogus", 5)
+    with pytest.raises(ValueError, match="euclidean"):
+        dbscan_tpu.train(pts, "auto", 5, metric="haversine")
+    with pytest.raises(ValueError, match="config"):
+        dbscan_tpu.train(
+            pts, "auto", 5, config=DBSCANConfig(eps=0.1, min_points=5)
+        )
+    with pytest.raises(ValueError, match=">= 2"):
+        core.auto_eps(pts[:1], 5)
