@@ -1080,6 +1080,17 @@ def serve_row(prefix: str = "serve") -> dict:
         ]
         for t in threads:
             t.start()
+        # fresh live windows sized to cover the whole timed leg: the
+        # stamped serve_windowed_* figures then describe EXACTLY the
+        # timed population (warm-pass compile walls excluded), so they
+        # are comparable with the lats-derived percentiles committed
+        # beside them (the live-vs-offline agreement pin)
+        prev_win = os.environ.get("DBSCAN_OBS_WINDOW_S")
+        os.environ["DBSCAN_OBS_WINDOW_S"] = "600"
+        from dbscan_tpu.obs import live as obs_live
+
+        obs_live.reset()
+        obs_live.ensure_env()
         record.set()
         t0 = time.perf_counter()
         for u in range(warm, warm + n_updates):
@@ -1091,6 +1102,12 @@ def serve_row(prefix: str = "serve") -> dict:
         for t in threads:
             t.join(timeout=30)
         health = svc.health()
+        windowed_p99 = obs_live.quantile("serve.query_ms", 0.99)
+        windowed_qps = obs_live.rate("serve.queries")
+        if prev_win is None:
+            os.environ.pop("DBSCAN_OBS_WINDOW_S", None)
+        else:
+            os.environ["DBSCAN_OBS_WINDOW_S"] = prev_win
 
     with lat_lock:
         lats = np.asarray(lat_ms, np.float64)
@@ -1125,6 +1142,9 @@ def serve_row(prefix: str = "serve") -> dict:
     if len(lats):
         row[f"{prefix}_p50_ms"] = round(float(np.percentile(lats, 50)), 3)
         row[f"{prefix}_p99_ms"] = round(float(np.percentile(lats, 99)), 3)
+    if windowed_p99 is not None:
+        row[f"{prefix}_windowed_p99_ms"] = round(float(windowed_p99), 3)
+        row[f"{prefix}_windowed_qps"] = round(float(windowed_qps), 3)
     return row
 
 
@@ -1234,7 +1254,17 @@ def serve_replicated_row(max_replicas: int, prefix: str = "serve") -> dict:
                     t.start()
                 # arm the shed governor for the timed window only: the
                 # warm pass above may carry one-time compile walls that
-                # would otherwise poison the rolling p99
+                # would otherwise poison the rolling p99. The live
+                # windows reset with it — sized to cover the whole
+                # timed leg, so the stamped serve_windowed_* figures
+                # describe exactly the timed population (the
+                # live-vs-offline agreement pin)
+                prev_win = os.environ.get("DBSCAN_OBS_WINDOW_S")
+                os.environ["DBSCAN_OBS_WINDOW_S"] = "600"
+                from dbscan_tpu.obs import live as obs_live
+
+                obs_live.reset()
+                obs_live.ensure_env()
                 os.environ["DBSCAN_SERVE_SHED_P99_MS"] = shed_bound
                 record.set()
                 t0 = time.perf_counter()
@@ -1249,6 +1279,14 @@ def serve_replicated_row(max_replicas: int, prefix: str = "serve") -> dict:
                 h = router.health()
                 shed_total += h["shed"]
                 routed_total += h["routed"]
+                windowed_p99 = obs_live.quantile("serve.query_ms", 0.99)
+                windowed_qps = obs_live.rate(
+                    "serve.router.routed"
+                ) + obs_live.rate("serve.queries")
+                if prev_win is None:
+                    os.environ.pop("DBSCAN_OBS_WINDOW_S", None)
+                else:
+                    os.environ["DBSCAN_OBS_WINDOW_S"] = prev_win
             finally:
                 if prev_bound is None:
                     os.environ.pop("DBSCAN_SERVE_SHED_P99_MS", None)
@@ -1269,6 +1307,12 @@ def serve_replicated_row(max_replicas: int, prefix: str = "serve") -> dict:
             row[f"{prefix}_r{n_rep}_p99_ms"] = round(
                 float(np.percentile(lats, 99)), 3
             )
+        if windowed_p99 is not None:
+            # top rung's figure survives, like rep_batch_period_s
+            row[f"{prefix}_windowed_p99_ms"] = round(
+                float(windowed_p99), 3
+            )
+            row[f"{prefix}_windowed_qps"] = round(float(windowed_qps), 3)
         # the top rung's figure survives: the acceptance inequality
         # (p99 well under the batch period) is read at the top rung.
         # Distinct key from serve_row's serve_batch_period_s — the

@@ -423,9 +423,12 @@ ENV_VARS = _env_table(
         "restores the strict no-op hook path.",
     ),
     EnvVar(
-        "DBSCAN_FLIGHTREC_PATH", "str", "flightrec.json",
+        "DBSCAN_FLIGHTREC_PATH", "str", None,
         "Flight-recorder dump path (multi-process runs shard it as "
-        "<path>.<process_index>, like DBSCAN_TRACE).",
+        "<path>.<process_index>, like DBSCAN_TRACE). Unset (the "
+        "default), dumps go to a run-scoped file under the system tmp "
+        "dir — dbscan-flightrec.<pid>.json — so unconfigured runs "
+        "never litter the working directory.",
     ),
     EnvVar(
         "DBSCAN_FLIGHTREC_EVENTS", "int", 2048,
@@ -542,11 +545,97 @@ ENV_VARS = _env_table(
     EnvVar(
         "DBSCAN_SERVE_SHED_P99_MS", "float", 0.0,
         "Declared p99 latency bound of the serving router's load "
-        "shedder: while the rolling query p99 exceeds this many "
-        "milliseconds, the router admits only batches whose "
-        "serve.query family-model price fits the proportionally "
-        "shrunk admission headroom and sheds the rest "
-        "(serve.router.shed). 0 (the default) disables shedding.",
+        "shedder: while the query p99 — the LIVE sliding-window "
+        "figure (obs/live.py) when the live plane is on, the rolling "
+        "in-router sample otherwise — exceeds this many milliseconds, "
+        "the router admits only batches whose serve.query family-model "
+        "price fits the proportionally shrunk admission headroom and "
+        "sheds the rest (serve.router.shed). The same bound shrinks "
+        "the tenancy AdmissionController's effective headroom. 0 (the "
+        "default) disables shedding.",
+    ),
+    EnvVar(
+        "DBSCAN_OBS_LIVE", "bool", True,
+        "Live telemetry plane (obs/live.py): mergeable log-bucketed "
+        "sliding-window latency histograms + windowed counter rates "
+        "feeding health(), the expo file, the live console, and the "
+        "SLO engine. 0 restores the strict no-op hook path (shedding "
+        "then falls back to the router's rolling sample).",
+    ),
+    EnvVar(
+        "DBSCAN_OBS_WINDOW_S", "float", 60.0,
+        "Width in seconds of the live sliding windows (the SLO "
+        "engine's FAST burn window; the slow window is 6x this). "
+        "Memory is bounded per series: DBSCAN_OBS_SLICES slices of "
+        "128 int64 buckets.",
+    ),
+    EnvVar(
+        "DBSCAN_OBS_SLICES", "int", 12,
+        "Time slices per live sliding window (floor 2): observations "
+        "land in epoch-stamped slices of WINDOW_S/SLICES seconds, so "
+        "expiry is O(1) zeroing on touch — no timestamps retained.",
+    ),
+    EnvVar(
+        "DBSCAN_OBS_EXPO", "str", None,
+        "Prometheus-style text exposition path: when set, the live "
+        "plane atomically (tmp+rename) rewrites this file with the "
+        "current window snapshot on health() polls, at most once per "
+        "DBSCAN_OBS_EXPO_PERIOD_S; python -m dbscan_tpu.obs.live "
+        "tails it as a top-style console.",
+    ),
+    EnvVar(
+        "DBSCAN_OBS_EXPO_PERIOD_S", "float", 2.0,
+        "Minimum seconds between exposition-file rewrites (write "
+        "throttle for hot health()/record paths).",
+    ),
+    EnvVar(
+        "DBSCAN_SLO_QUERY_P99_MS", "float", 0.0,
+        "Query-latency SLO bound: a serve query slower than this many "
+        "milliseconds is a bad event for the query_p99 SLO "
+        "(objective: DBSCAN_SLO_OBJECTIVE good fraction). 0 (the "
+        "default) leaves the SLO undeclared.",
+    ),
+    EnvVar(
+        "DBSCAN_SLO_OBJECTIVE", "float", 0.99,
+        "Good-event objective shared by the declared SLOs (error "
+        "budget = 1 - objective; burn rate = bad fraction / budget).",
+    ),
+    EnvVar(
+        "DBSCAN_SLO_SHED_FRAC", "float", 0.0,
+        "Shed-fraction SLO bound: the windowed shed fraction "
+        "(shed / (shed + routed)) this fleet may sustain before the "
+        "shed_frac SLO burns (burn = windowed frac / bound). 0 (the "
+        "default) leaves the SLO undeclared.",
+    ),
+    EnvVar(
+        "DBSCAN_SLO_STALENESS_S", "float", 0.0,
+        "Epoch-staleness SLO bound: seconds since the last snapshot/"
+        "cut publish before the staleness SLO burns (burn = staleness "
+        "/ bound). 0 (the default) leaves the SLO undeclared.",
+    ),
+    EnvVar(
+        "DBSCAN_SLO_FAULT_RATE", "float", 0.0,
+        "Fault-rate SLO bound: windowed supervised-failure events per "
+        "second this fleet may sustain before the fault_rate SLO "
+        "burns (burn = windowed rate / bound). 0 (the default) leaves "
+        "the SLO undeclared.",
+    ),
+    EnvVar(
+        "DBSCAN_SLO_BURN_PAGE", "float", 8.0,
+        "Page-severity burn-rate threshold: when an SLO's fast AND "
+        "slow window burn both exceed this, a slo.burn event fires at "
+        "page severity and the flight recorder dumps on demand.",
+    ),
+    EnvVar(
+        "DBSCAN_SLO_BURN_TICKET", "float", 2.0,
+        "Ticket-severity burn-rate threshold (fires slo.burn at "
+        "ticket severity; also the recovery line an alerting SLO must "
+        "drop back under for slo.recover).",
+    ),
+    EnvVar(
+        "DBSCAN_SLO_EVAL_PERIOD_S", "float", 1.0,
+        "Minimum seconds between SLO engine evaluations (piggybacked "
+        "on the serving record/publish paths — no dedicated thread).",
     ),
     EnvVar(
         "DBSCAN_EMBED_SAMPLE_FRAC", "float", 0.0,

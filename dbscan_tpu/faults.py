@@ -56,6 +56,7 @@ import numpy as np
 from dbscan_tpu import config, obs
 from dbscan_tpu.lint import tsan as _tsan
 from dbscan_tpu.obs import flight as _obs_flight
+from dbscan_tpu.obs import live as _obs_live
 from dbscan_tpu.obs import memory as _obs_memory
 
 logger = logging.getLogger(__name__)
@@ -562,6 +563,9 @@ def supervised(
             if isinstance(e, FaultInjected):
                 counters.add("injected")
                 obs.count("faults.injected")
+            # one live tick per CLASSIFIED fault (injected or real) —
+            # the fault_rate SLO's windowed numerator (obs/slo.py)
+            _obs_live.bump("faults.events")
             last = e
             if kind == PERSISTENT:
                 # every attempt would fail identically: stop burning

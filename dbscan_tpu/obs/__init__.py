@@ -51,13 +51,24 @@ from dbscan_tpu import config
 from dbscan_tpu.lint import tsan as _tsan
 from dbscan_tpu.obs import export as export_mod
 from dbscan_tpu.obs import flight
+from dbscan_tpu.obs import live
 _flight = flight  # internal alias: hot hooks read _flight._state directly
 from dbscan_tpu.obs.metrics import MetricsRegistry
-from dbscan_tpu.obs.trace import NOOP_SPAN, Span, Tracer  # noqa: F401
+from dbscan_tpu.obs.trace import (  # noqa: F401
+    NOOP_SPAN,
+    Span,
+    Tracer,
+    current_request,
+    mint_request_id,
+    request_scope,
+    reset_request,
+    set_request,
+)
 
 __all__ = [
     "NOOP_SPAN",
     "flight",
+    "live",
     "Span",
     "Tracer",
     "MetricsRegistry",
@@ -66,12 +77,17 @@ __all__ = [
     "count",
     "counters",
     "counters_delta",
+    "current_request",
     "disable",
     "enable",
     "ensure_env",
     "event",
     "flush",
     "gauge",
+    "mint_request_id",
+    "request_scope",
+    "reset_request",
+    "set_request",
     "span",
     "state",
     "summary",
@@ -173,6 +189,7 @@ def ensure_env() -> None:
         if path:
             enable(trace_path=path)
     _flight.ensure_env()
+    live.ensure_env()
     from dbscan_tpu.obs import devtime as _devtime
 
     _devtime.ensure_env()

@@ -91,6 +91,7 @@ SECTIONS = {
     "embed": ("counter", schema.PREFIX_EMBED),
     "devtime": ("counter", _DEVTIME_KEYS),
     "pull_check": ("counter", _PULL_CHECK_KEYS),
+    "requests": ("span", None),  # rid-stamped spans; no name filter
 }
 for _kind, _names in SECTIONS.values():
     if isinstance(_names, tuple):
@@ -140,6 +141,9 @@ def _from_chrome(obj: dict) -> dict:
         if ph == "X":
             args = dict(e.get("args") or {})
             depth = args.pop("depth", 0)
+            # the request id rides Chrome args (export.py); lift it
+            # back to a first-class field for the --requests rollup
+            rid = args.pop("rid", None)
             spans.append(
                 {
                     "name": e["name"],
@@ -147,6 +151,7 @@ def _from_chrome(obj: dict) -> dict:
                     "dur": float(e.get("dur", 0.0)) / 1e6,
                     "depth": depth,
                     "tid": e.get("tid", 0),
+                    "rid": rid,
                     "args": args,
                     "events": [],
                 }
@@ -198,6 +203,7 @@ def _from_jsonl(text: str) -> dict:
                     "dur": float(r["dur_s"]),
                     "depth": r.get("depth", 0),
                     "tid": r.get("tid", 0),
+                    "rid": r.get("rid"),
                     "args": r.get("args") or {},
                     "events": r.get("events") or [],
                 }
@@ -495,7 +501,88 @@ def analyze(data: dict, top: Optional[int] = None) -> dict:
         "embed": _embed_rollup(counters, data["gauges"]),
         "devtime": _devtime_rollup(counters, spans),
         "pull_check": _pull_device_check(counters, spans),
+        "requests": _requests_rollup(data, top=top or 10),
     }
+
+
+def _requests_rollup(data: dict, top: int = 10) -> dict:
+    """Per-request critical paths from the rid-stamped spans: group by
+    request id (minted at the router's ingress, obs/trace.py; carried
+    across the ingest queue, the pull worker, and every shard's read
+    dispatch), and report the slowest-N requests by wall — request
+    extent (first span start to last span end, across EVERY thread and
+    shard the request touched), busy seconds (union of its span
+    intervals — the request's own critical path: wall minus busy is
+    time the request sat in queues), the shard set, the longest single
+    span, and any fault events that named the rid. Empty ({}) on
+    captures with no rid-stamped spans — pre-tracing traces render
+    identically to before."""
+    by_rid: dict = {}
+    for sp in data["spans"]:
+        rid = sp.get("rid")
+        if rid:
+            by_rid.setdefault(rid, []).append(sp)
+    if not by_rid:
+        return {}
+    faults_by_rid: dict = {}
+    for inst in data["instants"]:
+        rid = (inst.get("args") or {}).get("rid")
+        if rid and inst["name"].startswith("fault."):
+            faults_by_rid[rid] = faults_by_rid.get(rid, 0) + 1
+    rows = []
+    for rid, sps in by_rid.items():
+        t0 = min(s["t0"] for s in sps)
+        t1 = max(s["t0"] + s["dur"] for s in sps)
+        busy = _union_intervals(
+            [(s["t0"], s["t0"] + s["dur"]) for s in sps]
+        )
+        shards = sorted({s["shard"] for s in sps if "shard" in s})
+        top_sp = max(sps, key=lambda s: s["dur"])
+        rows.append(
+            {
+                "rid": rid,
+                "n_spans": len(sps),
+                "shards": shards,
+                "t0_s": round(t0, 6),
+                "wall_ms": round((t1 - t0) * 1e3, 3),
+                "busy_ms": round(
+                    sum(b - a for a, b in busy) * 1e3, 3
+                ),
+                "top_span": top_sp["name"],
+                "top_span_ms": round(top_sp["dur"] * 1e3, 3),
+                "faults": faults_by_rid.get(rid, 0),
+            }
+        )
+    rows.sort(key=lambda r: -r["wall_ms"])
+    return {
+        "n_requests": len(by_rid),
+        "rows": rows[:top] if top else rows,
+    }
+
+
+def render_requests(report: dict) -> str:
+    """The ``--requests`` table alone (also embedded in render())."""
+    req = report.get("requests") or {}
+    if not req:
+        return "no rid-stamped spans in this capture"
+    out = [
+        f"-- slowest requests ({len(req['rows'])} of "
+        f"{req['n_requests']}; wall = cross-shard extent, busy = "
+        "union of the request's spans) --",
+        f"{'rid':<18} {'spans':>5} {'shards':<8} {'wall_ms':>9} "
+        f"{'busy_ms':>9} {'top span':<22} {'faults':>6}",
+    ]
+    for r in req["rows"]:
+        shards = (
+            ",".join(str(s) for s in r["shards"]) if r["shards"] else "-"
+        )
+        top_span = f"{r['top_span']} ({r['top_span_ms']:.1f})"
+        out.append(
+            f"{r['rid']:<18} {r['n_spans']:>5} {shards:<8} "
+            f"{r['wall_ms']:>9.3f} {r['busy_ms']:>9.3f} "
+            f"{top_span:<22} {r['faults']:>6}"
+        )
+    return "\n".join(out)
 
 
 def _campaign_rollup(counters: dict) -> dict:
@@ -661,6 +748,9 @@ def merge_shards(paths: List[str]) -> dict:
             )
             m_spans.append(msp)
             shard_spans.append(msp)
+            margs = dict(sp["args"], depth=sp["depth"], shard=i)
+            if sp.get("rid"):
+                margs["rid"] = sp["rid"]
             trace_events.append(
                 {
                     "name": sp["name"],
@@ -670,7 +760,7 @@ def merge_shards(paths: List[str]) -> dict:
                     "dur": sp["dur"] * 1e6,
                     "pid": pid,
                     "tid": msp["tid"],
-                    "args": dict(sp["args"], depth=sp["depth"], shard=i),
+                    "args": margs,
                 }
             )
         for inst in d["instants"]:
@@ -951,6 +1041,9 @@ def render(report: dict) -> str:
                 f" = device_busy_frac {dev['device_busy_frac']:.3f}"
             )
         out.append(line)
+    if report.get("requests"):
+        out.append("")
+        out.append(render_requests(report))
     pc = report.get("pull_check") or {}
     if pc:
         out.append("")
@@ -1028,6 +1121,13 @@ def main(argv=None) -> int:
         "--json", action="store_true",
         help="print the full report as JSON instead of tables",
     )
+    p.add_argument(
+        "--requests", action="store_true",
+        help="print ONLY the slowest-requests table: per-request "
+        "cross-shard critical paths from the rid-stamped spans the "
+        "serving path records (router ingress mints the id; ingest "
+        "queue, pull worker, and shard reads carry it)",
+    )
     args = p.parse_args(argv)
     if not args.merge and len(args.traces) > 1:
         p.error("multiple traces require --merge")
@@ -1051,6 +1151,8 @@ def main(argv=None) -> int:
         return 2
     if args.json:
         print(json.dumps(report))
+    elif args.requests:
+        print(render_requests(report))
     else:
         if args.merge:
             print(f"merged trace written to {report['merged_trace']}")

@@ -90,6 +90,12 @@ def chrome_trace(tracer, metrics=None) -> dict:
         t1 = sp.t1 if sp.t1 is not None else sp.t0
         ts = (sp.t0 - base) * 1e6
         t_last = max(t_last, (t1 - base) * 1e6)
+        args = dict(sp.args, depth=sp.depth)
+        rid = getattr(sp, "rid", None)
+        if rid is not None:
+            # request id rides the args (Perfetto has no first-class
+            # request field); analyze --requests reads it back
+            args["rid"] = rid
         events.append(
             {
                 "name": sp.name,
@@ -99,7 +105,7 @@ def chrome_trace(tracer, metrics=None) -> dict:
                 "dur": max(0.0, (t1 - sp.t0) * 1e6),
                 "pid": pid,
                 "tid": sp.tid,
-                "args": _jsonable(dict(sp.args, depth=sp.depth)),
+                "args": _jsonable(args),
             }
         )
         for name, t, args in sp.events:
@@ -195,7 +201,7 @@ def jsonl_records(tracer, metrics=None):
     }
     for sp in tracer.snapshot_spans():
         t1 = sp.t1 if sp.t1 is not None else sp.t0
-        yield {
+        rec = {
             "type": "span",
             "name": sp.name,
             "t0_s": round(sp.t0 - base, 9),
@@ -212,6 +218,10 @@ def jsonl_records(tracer, metrics=None):
                 for n, t, a in sp.events
             ],
         }
+        rid = getattr(sp, "rid", None)
+        if rid is not None:
+            rec["rid"] = rid
+        yield rec
     for name, t, args in getattr(tracer, "instants", ()):
         yield {
             "type": "instant",

@@ -146,32 +146,45 @@ def reset() -> None:
 
 def _default_path() -> str:
     """``DBSCAN_FLIGHTREC_PATH`` with the multi-process shard suffix
-    (``<path>.<process_index>``) — same sharding rule as DBSCAN_TRACE."""
-    return str(config.env("DBSCAN_FLIGHTREC_PATH")) + export_mod.shard_suffix()
+    (``<path>.<process_index>``) — same sharding rule as DBSCAN_TRACE.
+    Unconfigured runs dump to a run-scoped file under the system tmp
+    dir: an always-on recorder must never litter whatever directory
+    the dying process happened to be cwd'd into (a tier-1 test run
+    leaves no ``flightrec.json`` in the repo root — pinned)."""
+    path = config.env("DBSCAN_FLIGHTREC_PATH")
+    if not path:
+        import tempfile
+
+        path = os.path.join(
+            tempfile.gettempdir(), f"dbscan-flightrec.{os.getpid()}.json"
+        )
+    return str(path) + export_mod.shard_suffix()
 
 
 def _span_records(spans: list, base: float, cap: int) -> list:
     out = []
     for sp in spans[-cap:]:
         t1 = sp.t1 if sp.t1 is not None else sp.t0
-        out.append(
-            {
-                "name": sp.name,
-                "t0_s": round(sp.t0 - base, 9),
-                "dur_s": round(max(0.0, t1 - sp.t0), 9),
-                "depth": sp.depth,
-                "tid": sp.tid,
-                "args": export_mod._jsonable(sp.args),
-                "events": [
-                    {
-                        "name": n,
-                        "t_s": round(t - base, 9),
-                        "args": export_mod._jsonable(a),
-                    }
-                    for n, t, a in sp.events
-                ],
-            }
-        )
+        rec = {
+            "name": sp.name,
+            "t0_s": round(sp.t0 - base, 9),
+            "dur_s": round(max(0.0, t1 - sp.t0), 9),
+            "depth": sp.depth,
+            "tid": sp.tid,
+            "args": export_mod._jsonable(sp.args),
+            "events": [
+                {
+                    "name": n,
+                    "t_s": round(t - base, 9),
+                    "args": export_mod._jsonable(a),
+                }
+                for n, t, a in sp.events
+            ],
+        }
+        rid = getattr(sp, "rid", None)
+        if rid is not None:
+            rec["rid"] = rid
+        out.append(rec)
     return out
 
 

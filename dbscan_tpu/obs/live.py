@@ -1,0 +1,675 @@
+"""Live sliding-window telemetry: the while-it's-running aggregation
+plane for the serving fleet.
+
+The post-mortem stack (obs/trace -> obs/export -> obs/analyze) answers
+"where did the time go" AFTER a run; a serving fleet needs the same
+answers WHILE it runs — a load-shed decision cannot wait for a trace
+flush. This module keeps mergeable log-bucketed sliding-window
+histograms and windowed counter rates, surfaced three ways:
+
+- extended ``service.health()`` / ``router.health()`` dicts;
+- a Prometheus-style text exposition file (``DBSCAN_OBS_EXPO=path``,
+  atomic tmp+rename rewrite, throttled by ``DBSCAN_OBS_EXPO_PERIOD_S``);
+- ``python -m dbscan_tpu.obs.live`` — a top-style console polling the
+  exposition file (``--once`` for scripts/tests).
+
+Design constraints (pinned by tests/test_obs_live.py):
+
+- STRICT NO-OP WHEN DISABLED: ``DBSCAN_OBS_LIVE=0`` drops the state;
+  every hook is then one module-global truthiness check (<1% overhead
+  on the serving hot path, pinned) — the flight.py latch pattern.
+- BOUNDED MEMORY, declared: each histogram series is exactly
+  ``n_slices`` slices x :data:`NBUCKETS` int64 buckets (plus one
+  count/sum per slice); each rate series is ``n_slices`` float slices.
+  Series names are DECLARED in obs/schema.py (:data:`LIVE_HISTOGRAMS`
+  / :data:`LIVE_RATES`) and undeclared names are rejected, so the
+  total footprint is a compile-time constant of the schema —
+  ``bytes_bound()`` reports it.
+- MERGEABLE: every histogram shares one fixed bucket geometry
+  (growth :data:`GROWTH` per bucket), so windows merge by plain
+  bucket-count addition — across slices here, across shards by any
+  downstream scraper of the exposition files.
+- LOCK-CHEAP + TSAN-CERTIFIED: one registered lock guards the whole
+  state; the critical section of an observe is a few int adds (no
+  allocation after the first touch of a series). The DBSCAN_TSAN=1
+  serving drill runs with these aggregators hot.
+- QUANTILE ERROR DECLARED: a reported quantile is the geometric
+  midpoint of its bucket, so its relative error is bounded by
+  ``sqrt(GROWTH) - 1`` (~9.1% at the fixed 2**(1/4) growth) — the
+  figure PARITY.md's SLO contract declares and the live-vs-offline
+  agreement test budgets.
+
+Timekeeping: slices are stamped with their absolute slice epoch
+``int(now / slice_s)`` and zeroed lazily when an observe or a read
+touches a slice whose epoch moved on — expiry costs no timer thread
+and no per-observation timestamps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+import time
+from typing import Optional
+
+from dbscan_tpu import config
+from dbscan_tpu.lint import tsan as _tsan
+from dbscan_tpu.obs import schema
+
+# --- fixed histogram geometry (shared by every series: mergeable) -----
+
+#: per-bucket growth factor; quantile relative error <= sqrt(GROWTH)-1
+GROWTH = 2.0 ** 0.25
+_LOG_G = math.log(GROWTH)
+#: upper edge of bucket 0 in milliseconds (1 microsecond)
+LO_MS = 1e-3
+#: buckets per slice; covers LO_MS * GROWTH**(NBUCKETS-1) ~ 3.7e6 ms
+#: (~1 hour) before clamping to the top bucket
+NBUCKETS = 128
+
+#: declared relative quantile error bound of the geometry (PARITY.md
+#: "SLO contract"): a bucket spans [edge, edge*GROWTH) and we report
+#: its geometric midpoint edge*sqrt(GROWTH).
+QUANTILE_REL_ERROR = math.sqrt(GROWTH) - 1.0
+
+
+def bucket_of(value_ms: float) -> int:
+    """Bucket index of a millisecond observation (clamped to range)."""
+    if value_ms <= LO_MS:
+        return 0
+    i = int(math.log(value_ms / LO_MS) / _LOG_G) + 1
+    return i if i < NBUCKETS else NBUCKETS - 1
+
+
+def bucket_mid_ms(i: int) -> float:
+    """Geometric midpoint of bucket ``i`` — the reported quantile
+    value (relative error <= :data:`QUANTILE_REL_ERROR`)."""
+    if i <= 0:
+        return LO_MS / 2.0
+    return LO_MS * GROWTH ** (i - 1) * math.sqrt(GROWTH)
+
+
+class _HistWindow:
+    """One histogram series: a ring of epoch-stamped slices of bucket
+    counts. All access under the LiveState lock."""
+
+    __slots__ = ("epochs", "buckets", "counts", "sums", "t_created")
+
+    def __init__(self, n_slices: int, now: float):
+        self.epochs = [-1] * n_slices
+        self.buckets = [None] * n_slices  # lazily-allocated count lists
+        self.counts = [0] * n_slices
+        self.sums = [0.0] * n_slices
+        self.t_created = now
+
+    def _slot(self, epoch: int) -> int:
+        n = len(self.epochs)
+        i = epoch % n
+        if self.epochs[i] != epoch:
+            self.epochs[i] = epoch
+            b = self.buckets[i]
+            if b is None:
+                self.buckets[i] = [0] * NBUCKETS
+            else:
+                for j in range(NBUCKETS):
+                    b[j] = 0
+            self.counts[i] = 0
+            self.sums[i] = 0.0
+        return i
+
+    def observe(self, value_ms: float, epoch: int) -> None:
+        i = self._slot(epoch)
+        self.buckets[i][bucket_of(value_ms)] += 1
+        self.counts[i] += 1
+        self.sums[i] += value_ms
+
+    def _live_slots(self, epoch: int) -> list:
+        """Slot indices whose epoch is within the window ending at
+        ``epoch`` (stale slices excluded without zeroing them)."""
+        lo = epoch - len(self.epochs) + 1
+        return [
+            i
+            for i, e in enumerate(self.epochs)
+            if lo <= e <= epoch and self.buckets[i] is not None
+        ]
+
+    def merged(self, epoch: int):
+        """(total_count, total_sum, merged bucket counts) over the
+        live window — plain bucket addition, the mergeability the
+        fixed geometry buys."""
+        total = 0
+        s = 0.0
+        merged = [0] * NBUCKETS
+        for i in self._live_slots(epoch):
+            total += self.counts[i]
+            s += self.sums[i]
+            b = self.buckets[i]
+            for j in range(NBUCKETS):
+                merged[j] += b[j]
+        return total, s, merged
+
+    def quantile(self, q: float, epoch: int) -> Optional[float]:
+        total, _, merged = self.merged(epoch)
+        if total == 0:
+            return None
+        rank = min(total - 1, int(q * total))
+        seen = 0
+        for j in range(NBUCKETS):
+            seen += merged[j]
+            if seen > rank:
+                return bucket_mid_ms(j)
+        return bucket_mid_ms(NBUCKETS - 1)
+
+    def frac_above(self, bound_ms: float, epoch: int) -> Optional[float]:
+        """Fraction of windowed observations in buckets strictly above
+        ``bound_ms``'s bucket — the SLO engine's bad-event fraction
+        (quantized to the declared bucket error, like every readback)."""
+        total, _, merged = self.merged(epoch)
+        if total == 0:
+            return None
+        jb = bucket_of(bound_ms)
+        above = sum(merged[jb + 1:])
+        return above / total
+
+
+class _RateWindow:
+    """One windowed counter series: a ring of epoch-stamped slice sums."""
+
+    __slots__ = ("epochs", "sums", "t_created")
+
+    def __init__(self, n_slices: int, now: float):
+        self.epochs = [-1] * n_slices
+        self.sums = [0.0] * n_slices
+        self.t_created = now
+
+    def bump(self, value: float, epoch: int) -> None:
+        n = len(self.epochs)
+        i = epoch % n
+        if self.epochs[i] != epoch:
+            self.epochs[i] = epoch
+            self.sums[i] = 0.0
+        self.sums[i] += value
+
+    def total(self, epoch: int) -> float:
+        lo = epoch - len(self.epochs) + 1
+        return sum(
+            s for e, s in zip(self.epochs, self.sums) if lo <= e <= epoch
+        )
+
+
+class LiveState:
+    """The process-global live-aggregation state: every declared
+    series' window, one lock, the expo write throttle."""
+
+    __slots__ = (
+        "window_s",
+        "n_slices",
+        "slice_s",
+        "t0",
+        "_hists",
+        "_rates",
+        "_lock",
+        "_expo_t_last",
+        "_last_seen",
+    )
+
+    def __init__(self, window_s: float, n_slices: int):
+        self.window_s = max(1e-3, float(window_s))
+        self.n_slices = max(2, int(n_slices))
+        self.slice_s = self.window_s / self.n_slices
+        self.t0 = time.monotonic()
+        self._hists = {}
+        self._rates = {}
+        self._lock = _tsan.lock("obs.live")
+        self._expo_t_last = 0.0
+        # last wall-clock an event landed on each rate series — the
+        # staleness SLO's freshness source (0.0 = never)
+        self._last_seen = {}
+
+    # -- recording ------------------------------------------------------
+
+    def _epoch(self, now: float) -> int:
+        return int(now / self.slice_s)
+
+    def observe(self, name: str, value_ms: float) -> None:
+        if name not in schema.LIVE_HISTOGRAMS:
+            raise ValueError(
+                f"live histogram {name!r} not declared in "
+                "obs.schema.LIVE_HISTOGRAMS"
+            )
+        now = time.monotonic()
+        with self._lock:
+            _tsan.access("obs.live")
+            w = self._hists.get(name)
+            if w is None:
+                w = self._hists[name] = _HistWindow(self.n_slices, now)
+            w.observe(float(value_ms), self._epoch(now))
+
+    def bump(self, name: str, value: float = 1.0) -> None:
+        if name not in schema.LIVE_RATES:
+            raise ValueError(
+                f"live rate {name!r} not declared in "
+                "obs.schema.LIVE_RATES"
+            )
+        now = time.monotonic()
+        with self._lock:
+            _tsan.access("obs.live")
+            w = self._rates.get(name)
+            if w is None:
+                w = self._rates[name] = _RateWindow(self.n_slices, now)
+            w.bump(float(value), self._epoch(now))
+            self._last_seen[name] = now
+
+    # -- readback -------------------------------------------------------
+
+    def _elapsed(self, t_created: float, now: float) -> float:
+        """Effective window denominator: the full window once the
+        series has lived that long, the series' age before (so early
+        rates are not diluted by empty future slices)."""
+        return max(self.slice_s, min(self.window_s, now - t_created))
+
+    def quantile(self, name: str, q: float) -> Optional[float]:
+        now = time.monotonic()
+        with self._lock:
+            _tsan.access("obs.live", write=False)
+            w = self._hists.get(name)
+            if w is None:
+                return None
+            return w.quantile(q, self._epoch(now))
+
+    def frac_above(self, name: str, bound_ms: float) -> Optional[float]:
+        now = time.monotonic()
+        with self._lock:
+            _tsan.access("obs.live", write=False)
+            w = self._hists.get(name)
+            if w is None:
+                return None
+            return w.frac_above(bound_ms, self._epoch(now))
+
+    def window_count(self, name: str) -> int:
+        now = time.monotonic()
+        with self._lock:
+            _tsan.access("obs.live", write=False)
+            w = self._hists.get(name)
+            if w is None:
+                return 0
+            total, _, _ = w.merged(self._epoch(now))
+            return total
+
+    def rate(self, name: str) -> float:
+        """Windowed events/second of a rate series (0.0 when unseen)."""
+        now = time.monotonic()
+        with self._lock:
+            _tsan.access("obs.live", write=False)
+            w = self._rates.get(name)
+            if w is None:
+                return 0.0
+            return w.total(self._epoch(now)) / self._elapsed(
+                w.t_created, now
+            )
+
+    def window_total(self, name: str) -> float:
+        now = time.monotonic()
+        with self._lock:
+            _tsan.access("obs.live", write=False)
+            w = self._rates.get(name)
+            if w is None:
+                return 0.0
+            return w.total(self._epoch(now))
+
+    def seconds_since(self, name: str) -> Optional[float]:
+        """Seconds since the last bump of ``name`` (None = never) —
+        the staleness SLO's freshness read."""
+        with self._lock:
+            _tsan.access("obs.live", write=False)
+            t = self._last_seen.get(name)
+        if t is None:
+            return None
+        return max(0.0, time.monotonic() - t)
+
+    def snapshot(self) -> dict:
+        """One coherent read of every live series — the body of the
+        exposition file and the console."""
+        now = time.monotonic()
+        epoch = self._epoch(now)
+        out = {
+            "window_s": self.window_s,
+            "slices": self.n_slices,
+            "hists": {},
+            "rates": {},
+        }
+        with self._lock:
+            _tsan.access("obs.live", write=False)
+            for name, w in sorted(self._hists.items()):
+                total, s, merged = w.merged(epoch)
+                ent = {"count": total}
+                ent["rate"] = total / self._elapsed(w.t_created, now)
+                if total:
+                    ent["mean_ms"] = s / total
+                    for q, key in (
+                        (0.5, "p50_ms"),
+                        (0.9, "p90_ms"),
+                        (0.99, "p99_ms"),
+                    ):
+                        rank = min(total - 1, int(q * total))
+                        seen = 0
+                        for j in range(NBUCKETS):
+                            seen += merged[j]
+                            if seen > rank:
+                                ent[key] = bucket_mid_ms(j)
+                                break
+                out["hists"][name] = ent
+            for name, w in sorted(self._rates.items()):
+                total = w.total(epoch)
+                out["rates"][name] = {
+                    "total": total,
+                    "rate": total / self._elapsed(w.t_created, now),
+                }
+        return out
+
+    def bytes_bound(self) -> int:
+        """Declared upper bound on this state's series storage: every
+        schema-declared series at full allocation (8 bytes per bucket
+        count / slice sum — CPython ints and floats are boxed, so this
+        is the payload figure the docstring contract declares, not an
+        allocator measurement)."""
+        per_hist = self.n_slices * (NBUCKETS + 2) * 8
+        per_rate = self.n_slices * 2 * 8
+        return (
+            len(schema.LIVE_HISTOGRAMS) * per_hist
+            + len(schema.LIVE_RATES) * per_rate
+        )
+
+
+# --- process-global latch (the flight.py pattern) ---------------------
+
+_state: Optional[LiveState] = None
+_configured = None  # (on, window_s, n_slices) last applied
+_lock = _tsan.lock("obs.live_state")
+
+
+def ensure_env() -> None:
+    """(Re)apply the env knobs; latches, so steady-state calls are one
+    tuple compare. Called from obs.ensure_env() at the pipeline entry
+    points and from the serving constructors."""
+    global _state, _configured
+    on = bool(config.env("DBSCAN_OBS_LIVE"))
+    window_s = float(config.env("DBSCAN_OBS_WINDOW_S"))
+    n_slices = int(config.env("DBSCAN_OBS_SLICES"))
+    conf = (on, window_s, n_slices)
+    if conf == _configured:
+        return
+    with _lock:
+        _tsan.access("obs.live_state")
+        if conf == _configured:
+            return
+        _state = LiveState(window_s, n_slices) if on else None
+        _configured = conf
+
+
+def reset() -> None:
+    """Drop the state and the latch (tests + bench rung isolation); the
+    next ensure_env() rebuilds fresh windows."""
+    global _state, _configured
+    with _lock:
+        _tsan.access("obs.live_state")
+        _state = None
+        _configured = None
+
+
+def state() -> Optional[LiveState]:
+    return _state
+
+
+def active() -> bool:
+    return _state is not None
+
+
+# --- hot hooks (strict no-op when disabled) ---------------------------
+
+
+def observe(name: str, value_ms: float) -> None:
+    """Record one ms observation into a declared histogram window;
+    a single module-global check when the live plane is off."""
+    st = _state
+    if st is None:
+        return
+    st.observe(name, value_ms)
+
+
+def bump(name: str, value: float = 1.0) -> None:
+    """Add to a declared windowed rate series; no-op when off."""
+    st = _state
+    if st is None:
+        return
+    st.bump(name, value)
+
+
+def quantile(name: str, q: float) -> Optional[float]:
+    """Windowed quantile of a histogram series (None when the plane is
+    off or the window is empty) — the read shed decisions take."""
+    st = _state
+    if st is None:
+        return None
+    return st.quantile(name, q)
+
+
+def frac_above(name: str, bound_ms: float) -> Optional[float]:
+    st = _state
+    if st is None:
+        return None
+    return st.frac_above(name, bound_ms)
+
+
+def rate(name: str) -> float:
+    st = _state
+    if st is None:
+        return 0.0
+    return st.rate(name)
+
+
+def window_total(name: str) -> float:
+    st = _state
+    if st is None:
+        return 0.0
+    return st.window_total(name)
+
+
+def seconds_since(name: str) -> Optional[float]:
+    st = _state
+    if st is None:
+        return None
+    return st.seconds_since(name)
+
+
+def snapshot() -> Optional[dict]:
+    st = _state
+    if st is None:
+        return None
+    return st.snapshot()
+
+
+# --- exposition file --------------------------------------------------
+
+
+def expo_path() -> Optional[str]:
+    """The configured exposition path (shard-suffixed for multi-
+    process runs, like every artifact path), or None."""
+    path = config.env("DBSCAN_OBS_EXPO")
+    if not path:
+        return None
+    from dbscan_tpu.obs import export as export_mod
+
+    return str(path) + export_mod.shard_suffix()
+
+
+def render_expo(snap: dict) -> str:
+    """Prometheus-style text exposition of a snapshot: one metric
+    family per live statistic, series names as the ``name`` label."""
+    lines = [
+        "# HELP dbscan_live_window_seconds sliding-window width",
+        "# TYPE dbscan_live_window_seconds gauge",
+        f"dbscan_live_window_seconds {snap['window_s']:g}",
+    ]
+    stats = (
+        ("count", "windowed observation count", "%d"),
+        ("rate", "windowed events per second", "%g"),
+        ("mean_ms", "windowed mean milliseconds", "%g"),
+        ("p50_ms", "windowed p50 milliseconds", "%g"),
+        ("p90_ms", "windowed p90 milliseconds", "%g"),
+        ("p99_ms", "windowed p99 milliseconds", "%g"),
+    )
+    for key, help_, fmt in stats:
+        fam = f"dbscan_live_{key}"
+        rows = []
+        for name, ent in snap["hists"].items():
+            if key in ent:
+                rows.append((name, ent[key]))
+        if key in ("count", "rate"):
+            for name, ent in snap["rates"].items():
+                rows.append((name, ent["total" if key == "count" else key]))
+        if not rows:
+            continue
+        lines.append(f"# HELP {fam} {help_}")
+        lines.append(f"# TYPE {fam} gauge")
+        for name, v in sorted(rows):
+            lines.append(f'{fam}{{name="{name}"}} ' + fmt % v)
+    return "\n".join(lines) + "\n"
+
+
+def parse_expo(text: str) -> dict:
+    """Inverse of :func:`render_expo` (the console's reader): returns
+    ``{"window_s": ..., "series": {name: {stat: value}}}``."""
+    out = {"window_s": None, "series": {}}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        head, _, val = line.rpartition(" ")
+        if head == "dbscan_live_window_seconds":
+            out["window_s"] = float(val)
+            continue
+        if not head.startswith("dbscan_live_") or '{name="' not in head:
+            continue
+        fam, _, label = head.partition("{")
+        stat = fam[len("dbscan_live_"):]
+        name = label[len('name="'):].rstrip('"}')
+        out["series"].setdefault(name, {})[stat] = float(val)
+    return out
+
+
+def write_expo(path: Optional[str] = None) -> Optional[str]:
+    """Atomically rewrite the exposition file from the current
+    windows; returns the path written (None when the plane is off or
+    no path is configured)."""
+    st = _state
+    if st is None:
+        return None
+    path = path or expo_path()
+    if not path:
+        return None
+    from dbscan_tpu.obs import export as export_mod
+
+    export_mod._atomic_write(path, render_expo(st.snapshot()))
+    return path
+
+
+def maybe_write_expo() -> Optional[str]:
+    """Throttled :func:`write_expo` for hot health/record paths: at
+    most one rewrite per DBSCAN_OBS_EXPO_PERIOD_S."""
+    st = _state
+    if st is None:
+        return None
+    path = expo_path()
+    if not path:
+        return None
+    period = float(config.env("DBSCAN_OBS_EXPO_PERIOD_S"))
+    now = time.monotonic()
+    with st._lock:
+        _tsan.access("obs.live")
+        if now - st._expo_t_last < period:
+            return None
+        st._expo_t_last = now
+    return write_expo(path)
+
+
+# --- the top-style console --------------------------------------------
+
+
+def render_console(parsed: dict, source: str) -> str:
+    """One console frame from a parsed exposition snapshot."""
+    lines = [
+        f"dbscan live — {source}  "
+        f"(window {parsed['window_s'] or 0:g}s)",
+        "",
+        f"{'series':<28}{'count':>9}{'rate/s':>10}"
+        f"{'p50 ms':>10}{'p90 ms':>10}{'p99 ms':>10}",
+    ]
+    for name in sorted(parsed["series"]):
+        ent = parsed["series"][name]
+        def col(key, fmt="%.3g"):
+            return (fmt % ent[key]) if key in ent else "-"
+        lines.append(
+            f"{name:<28}{col('count', '%.0f'):>9}{col('rate'):>10}"
+            f"{col('p50_ms'):>10}{col('p90_ms'):>10}{col('p99_ms'):>10}"
+        )
+    if not parsed["series"]:
+        lines.append("(no live series yet)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dbscan_tpu.obs.live",
+        description="Top-style console over the live-telemetry "
+        "exposition file (DBSCAN_OBS_EXPO).",
+    )
+    p.add_argument(
+        "path",
+        nargs="?",
+        help="exposition file to poll (default: $DBSCAN_OBS_EXPO, or "
+        "this process's own live windows when it has any)",
+    )
+    p.add_argument(
+        "--interval", type=float, default=2.0,
+        help="poll period in seconds (default 2)",
+    )
+    p.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (scripts/tests)",
+    )
+    args = p.parse_args(argv)
+
+    path = args.path or expo_path()
+    if not path:
+        print(
+            "obs.live: no exposition file (set DBSCAN_OBS_EXPO=path "
+            "on the serving process, or pass the path)",
+            file=sys.stderr,
+        )
+        return 2
+
+    while True:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                parsed = parse_expo(f.read())
+        except OSError as e:
+            print(f"obs.live: cannot read {path}: {e}", file=sys.stderr)
+            if args.once:
+                return 2
+            time.sleep(args.interval)
+            continue
+        frame = render_console(parsed, os.path.basename(path))
+        if args.once:
+            print(frame)
+            return 0
+        # clear + home, then the frame: a plain-terminal top
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,36 @@ MEMORY_SITES = (
     "serve.health",
 )
 
+#: declared SLO keys (obs/slo.py): each emits gauge ``slo.burn.<key>``
+#: (the last evaluated fast-window burn rate) and rides the ``slo``
+#: argument of the ``slo.burn``/``slo.recover`` events.
+SLO_KEYS = (
+    "query_p99",
+    "shed_frac",
+    "staleness",
+    "fault_rate",
+)
+
+#: live sliding-window HISTOGRAM series (obs/live.py observe): ms-valued
+#: observations into the shared log-bucketed window geometry. These are
+#: windowed series, not cumulative counters — they surface through
+#: health()/the expo file/the live console, never through obs.count.
+LIVE_HISTOGRAMS = (
+    "serve.query_ms",
+    "serve.update_ms",
+)
+
+#: live sliding-window RATE series (obs/live.py bump): windowed event
+#: counts read back as events/second over the declared window.
+LIVE_RATES = (
+    "serve.router.routed",
+    "serve.router.shed",
+    "serve.queries",
+    "serve.updates",
+    "serve.epoch_publish",
+    "faults.events",
+)
+
 #: driver `_mark` phases (timings keys sans ``_s``): each emits span
 #: ``driver.<phase>`` over the exact window ``stats["timings"]`` reports.
 DRIVER_PHASES = (
@@ -266,6 +296,11 @@ COUNTERS = {
     "tsan.races": "lockset races detected (empty-intersection, "
     "multi-thread, written sites)",
     "tsan.lock_inversions": "lock-acquisition-order inversions observed",
+    "slo.pages": "page-severity SLO burn alerts fired (fast AND slow "
+    "window burn past DBSCAN_SLO_BURN_PAGE; each triggers an on-demand "
+    "flight-recorder dump)",
+    "slo.tickets": "ticket-severity SLO burn alerts fired (burn past "
+    "DBSCAN_SLO_BURN_TICKET but below the page threshold)",
 }
 
 GAUGES = {
@@ -305,6 +340,13 @@ GAUGES = {
     "DBSCAN_PROP_UNIONFIND, ops/propagation.py note_sweeps)",
     "density.eps_auto": "eps selected by the last eps='auto' "
     "k-distance knee probe (median of the per-strip knees)",
+    "serve.windowed_p99_ms": "live sliding-window query p99 (the "
+    "serve.query_ms log-bucketed window, obs/live.py) at the last "
+    "health/shed evaluation — the figure shed decisions actually read",
+    "serve.windowed_qps": "live sliding-window query rate at the last "
+    "health evaluation (windowed count / elapsed window)",
+    "serve.windowed_shed_frac": "live sliding-window shed fraction "
+    "(windowed shed / (shed + routed)) at the last health evaluation",
 }
 
 SPANS = {
@@ -422,6 +464,15 @@ EVENTS = {
     "tracked dispatch (DBSCAN_PROFILE_WINDOW)",
     "profile.window_close": "jax.profiler capture window closed "
     "(dispatch count + log dir attached)",
+    "serve.router.shed": "a query batch was refused at the router "
+    "(the SLO driving the refusal, the live windowed p99, the bound, "
+    "and the priced/allowed costs attached) — the event NAMES the SLO "
+    "so a shed is attributable to windowed burn, not ad-hoc stats",
+    "slo.burn": "an SLO's multi-window burn rate crossed an alerting "
+    "threshold (slo key, severity=page/ticket, fast/slow burns, bound "
+    "attached); page severity also writes a flight-recorder dump",
+    "slo.recover": "a previously-alerting SLO's burn dropped back "
+    "below the ticket threshold (slo key + final burns attached)",
 }
 
 for _f in COMPILE_FAMILIES:
@@ -435,7 +486,12 @@ for _s in MEMORY_SITES:
     GAUGES[f"memory.at.{_s}"] = f"HBM occupancy at the last {_s} sample"
 for _p in DRIVER_PHASES:
     SPANS[f"driver.{_p}"] = f"driver phase window (timings['{_p}_s'])"
-del _f, _s, _p
+for _k in SLO_KEYS:
+    GAUGES[f"slo.burn.{_k}"] = (
+        f"last evaluated fast-window burn rate of the {_k} SLO "
+        "(bad fraction / error budget; obs/slo.py)"
+    )
+del _f, _s, _p, _k
 
 KINDS = {
     "counter": COUNTERS,
@@ -500,4 +556,15 @@ def self_check() -> list:
     for fam in COMPILE_FAMILIES:
         if "." not in fam:
             errors.append(f"compile family {fam!r}: must be dotted")
+    for series in LIVE_HISTOGRAMS:
+        if not series.endswith("_ms"):
+            errors.append(
+                f"live histogram {series!r}: windows observe "
+                "milliseconds; the name must say so (_ms suffix)"
+            )
+    live_overlap = set(LIVE_HISTOGRAMS) & set(LIVE_RATES)
+    if live_overlap:
+        errors.append(
+            f"live histogram/rate name collision: {sorted(live_overlap)}"
+        )
     return errors

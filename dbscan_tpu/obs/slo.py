@@ -1,0 +1,306 @@
+"""Declared SLOs with multi-window burn-rate evaluation.
+
+The serving fleet's health questions ("are we meeting the latency
+objective", "how much capacity are we turning away", "is the snapshot
+stale") were answerable only post-mortem. This module declares them as
+SLO objects over the live windows (obs/live.py) and evaluates the
+classic multi-window burn-rate rules on the serving hot paths — no
+dedicated thread, no timer:
+
+- burn rate = bad-event fraction / error budget (budget =
+  1 - DBSCAN_SLO_OBJECTIVE). Burn 1.0 = exactly consuming budget at
+  the sustainable rate; DBSCAN_SLO_BURN_PAGE (default 8) and
+  DBSCAN_SLO_BURN_TICKET (default 2) are the alert thresholds.
+- two windows: the FAST window is the live plane's sliding window
+  (DBSCAN_OBS_WINDOW_S); the SLOW window is a :data:`SLOW_MULT` x
+  wider exponential moving average of the fast figure. An alert needs
+  BOTH past the threshold — the fast window makes alerts prompt, the
+  slow window keeps a single spike from paging.
+- alerts are DECLARED obs events: ``slo.burn`` (severity page/ticket,
+  slo key, both burns, bound attached) on the upward transition,
+  ``slo.recover`` when an alerting SLO drops back under the ticket
+  line. Page severity also writes an on-demand flight-recorder dump —
+  the postmortem arrives WHILE the incident runs, not after the
+  process dies.
+
+The declared SLOs (each enabled by its bound knob, 0 = undeclared):
+
+==============  ======================  ================================
+key             knob                    bad-event definition
+==============  ======================  ================================
+``query_p99``   DBSCAN_SLO_QUERY_P99_MS windowed serve.query_ms
+                                        observations over the bound
+``shed_frac``   DBSCAN_SLO_SHED_FRAC    windowed shed/(shed+routed)
+                                        over the bound (ratio SLO:
+                                        burn = frac / bound)
+``staleness``   DBSCAN_SLO_STALENESS_S  seconds since the last
+                                        serve.epoch_publish over the
+                                        bound (burn = staleness/bound)
+``fault_rate``  DBSCAN_SLO_FAULT_RATE   windowed faults.events per
+                                        second over the bound
+                                        (burn = rate / bound)
+==============  ======================  ================================
+
+STRICT NO-OP WHEN DISABLED: with the live plane off, or no SLO bound
+declared, :func:`maybe_evaluate` is one module-global check.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import NamedTuple, Optional
+
+from dbscan_tpu import config
+from dbscan_tpu.lint import tsan as _tsan
+from dbscan_tpu.obs import live
+
+#: slow-window width as a multiple of the fast (live) window — the
+#: classic 1h/6h shape scaled to our default 60 s fast window.
+SLOW_MULT = 6.0
+
+#: canonical SLO keys (mirrors obs.schema.SLO_KEYS; the event/gauge
+#: names are generated there)
+QUERY_P99 = "query_p99"
+SHED_FRAC = "shed_frac"
+STALENESS = "staleness"
+FAULT_RATE = "fault_rate"
+
+
+class SLO(NamedTuple):
+    """One declared objective: ``key`` names it everywhere (events,
+    gauges, PARITY table); ``bound`` defines a bad event; ``budget``
+    is the error budget the burn rate divides by (None for ratio-style
+    SLOs whose burn is measured/bound directly)."""
+
+    key: str
+    bound: float
+    budget: Optional[float]
+
+
+def declared_slos() -> list:
+    """The SLOs the env declares right now (bound knobs > 0)."""
+    budget = max(1e-6, 1.0 - float(config.env("DBSCAN_SLO_OBJECTIVE")))
+    out = []
+    p99 = float(config.env("DBSCAN_SLO_QUERY_P99_MS"))
+    if p99 > 0:
+        out.append(SLO(QUERY_P99, p99, budget))
+    shed = float(config.env("DBSCAN_SLO_SHED_FRAC"))
+    if shed > 0:
+        out.append(SLO(SHED_FRAC, shed, None))
+    stale = float(config.env("DBSCAN_SLO_STALENESS_S"))
+    if stale > 0:
+        out.append(SLO(STALENESS, stale, None))
+    faults = float(config.env("DBSCAN_SLO_FAULT_RATE"))
+    if faults > 0:
+        out.append(SLO(FAULT_RATE, faults, None))
+    return out
+
+
+def fast_burn(slo: SLO) -> Optional[float]:
+    """The SLO's fast-window burn rate from the live windows (None =
+    no data yet: an empty window neither burns nor recovers)."""
+    if slo.key == QUERY_P99:
+        bad = live.frac_above("serve.query_ms", slo.bound)
+        if bad is None:
+            return None
+        return bad / slo.budget
+    if slo.key == SHED_FRAC:
+        shed = live.window_total("serve.router.shed")
+        routed = live.window_total("serve.router.routed")
+        if shed + routed <= 0:
+            return None
+        return (shed / (shed + routed)) / slo.bound
+    if slo.key == STALENESS:
+        age = live.seconds_since("serve.epoch_publish")
+        if age is None:
+            return None
+        return age / slo.bound
+    if slo.key == FAULT_RATE:
+        return live.rate("faults.events") / slo.bound
+    raise ValueError(f"unknown SLO key {slo.key!r}")
+
+
+class SLOEngine:
+    """Evaluates the declared SLOs against the live windows; keeps the
+    slow-window EMAs and the per-SLO alerting latch. One per process
+    (see :func:`get_engine`); all state under one registered lock."""
+
+    __slots__ = ("_lock", "_t_last", "_slow", "_alerting", "window_s")
+
+    def __init__(self, window_s: Optional[float] = None):
+        self._lock = _tsan.lock("obs.slo")
+        self._t_last = None
+        self._slow = {}  # key -> slow-window EMA of the fast burn
+        self._alerting = {}  # key -> "page" | "ticket" (absent = quiet)
+        self.window_s = (
+            float(config.env("DBSCAN_OBS_WINDOW_S"))
+            if window_s is None
+            else float(window_s)
+        )
+
+    def evaluate(self) -> list:
+        """One evaluation pass: returns the per-SLO verdict dicts and
+        emits the transition events/gauges. Cheap when quiet — a few
+        window reads per declared SLO."""
+        import dbscan_tpu.obs as obs
+
+        slos = declared_slos()
+        if not slos:
+            return []
+        now = time.monotonic()
+        page = float(config.env("DBSCAN_SLO_BURN_PAGE"))
+        ticket = float(config.env("DBSCAN_SLO_BURN_TICKET"))
+        slow_w = SLOW_MULT * self.window_s
+        out = []
+        with self._lock:
+            _tsan.access("obs.slo")
+            dt = (
+                self.window_s / 4.0
+                if self._t_last is None
+                else max(1e-6, now - self._t_last)
+            )
+            self._t_last = now
+            alpha = min(1.0, dt / slow_w)
+            for slo in slos:
+                fast = fast_burn(slo)
+                if fast is None:
+                    out.append(
+                        {"slo": slo.key, "fast": None, "slow": None,
+                         "severity": self._alerting.get(slo.key)}
+                    )
+                    continue
+                slow = self._slow.get(slo.key, 0.0)
+                slow += alpha * (fast - slow)
+                self._slow[slo.key] = slow
+                obs.gauge(f"slo.burn.{slo.key}", fast)
+                severity = None
+                if fast >= page and slow >= page:
+                    severity = "page"
+                elif fast >= ticket and slow >= ticket:
+                    severity = "ticket"
+                prev = self._alerting.get(slo.key)
+                if severity and severity != prev:
+                    # upward transition (or page escalation): one
+                    # event per state change, never per evaluation
+                    if prev != "page":  # page never demotes to ticket
+                        self._alerting[slo.key] = severity
+                        obs.event(
+                            "slo.burn",
+                            slo=slo.key,
+                            severity=severity,
+                            fast_burn=round(fast, 3),
+                            slow_burn=round(slow, 3),
+                            bound=slo.bound,
+                        )
+                        if severity == "page":
+                            obs.count("slo.pages")
+                            from dbscan_tpu.obs import flight
+
+                            flight.dump(
+                                reason="slo_burn",
+                                slo=slo.key,
+                                fast_burn=round(fast, 3),
+                            )
+                        else:
+                            obs.count("slo.tickets")
+                elif prev and fast < ticket and slow < ticket:
+                    del self._alerting[slo.key]
+                    obs.event(
+                        "slo.recover",
+                        slo=slo.key,
+                        fast_burn=round(fast, 3),
+                        slow_burn=round(slow, 3),
+                    )
+                out.append(
+                    {"slo": slo.key, "fast": fast, "slow": slow,
+                     "severity": self._alerting.get(slo.key)}
+                )
+        return out
+
+    def alerting(self) -> dict:
+        """Current alert latch: {slo key: severity} (health() view)."""
+        with self._lock:
+            _tsan.access("obs.slo", write=False)
+            return dict(self._alerting)
+
+
+_engine: Optional[SLOEngine] = None
+_engine_lock = _tsan.lock("obs.slo_engine")
+_eval_t_last = 0.0
+
+
+def get_engine() -> SLOEngine:
+    global _engine
+    st = _engine
+    if st is not None:
+        return st
+    with _engine_lock:
+        _tsan.access("obs.slo_engine")
+        if _engine is None:
+            _engine = SLOEngine()
+        return _engine
+
+
+def reset_engine() -> None:
+    """Drop the engine (tests): the next evaluation builds fresh
+    slow windows and a quiet alert latch."""
+    global _engine, _eval_t_last
+    with _engine_lock:
+        _tsan.access("obs.slo_engine")
+        _engine = None
+        _eval_t_last = 0.0
+
+
+def windowed_health() -> dict:
+    """The live plane's health() extension, shared by the router and
+    the services: windowed p99/qps/shed-frac plus the SLO alert latch
+    ({} with DBSCAN_OBS_LIVE=0 — health dicts stay backward-shaped).
+    Emits the matching serve.windowed_* gauges and gives the throttled
+    expo writer its poll."""
+    import dbscan_tpu.obs as obs
+
+    if not live.active():
+        return {}
+    p99 = live.quantile("serve.query_ms", 0.99)
+    shed = live.window_total("serve.router.shed")
+    routed = live.window_total("serve.router.routed")
+    win = {
+        "window_s": live.state().window_s,
+        "windowed_p99_ms": p99,
+        "windowed_qps": live.rate("serve.router.routed")
+        + live.rate("serve.queries"),
+        "windowed_shed_frac": (
+            shed / (shed + routed) if (shed + routed) > 0 else 0.0
+        ),
+        "slo_alerting": get_engine().alerting(),
+    }
+    if p99 is not None:
+        obs.gauge("serve.windowed_p99_ms", p99)
+    obs.gauge("serve.windowed_qps", win["windowed_qps"])
+    obs.gauge("serve.windowed_shed_frac", win["windowed_shed_frac"])
+    expo = live.expo_path()
+    if expo:
+        win["expo"] = expo
+        live.maybe_write_expo()
+    maybe_evaluate()
+    return {"windowed": win}
+
+
+def maybe_evaluate() -> Optional[list]:
+    """Throttled evaluation for the serving hot paths (router record,
+    snapshot publish, health polls): at most one pass per
+    DBSCAN_SLO_EVAL_PERIOD_S, and a single module-global check when
+    the live plane is off."""
+    global _eval_t_last
+    if live._state is None:
+        return None
+    now = time.monotonic()
+    period = float(config.env("DBSCAN_SLO_EVAL_PERIOD_S"))
+    if now - _eval_t_last < period:
+        return None
+    with _engine_lock:
+        _tsan.access("obs.slo_engine")
+        if now - _eval_t_last < period:
+            return None
+        _eval_t_last = now
+    return get_engine().evaluate()

@@ -29,12 +29,78 @@ Design constraints (enforced by tests/test_obs.py):
 
 from __future__ import annotations
 
+import contextvars
+import itertools
+import os
 import threading
 import time
 from typing import Optional
 
 from dbscan_tpu import config
 from dbscan_tpu.lint import tsan as _tsan
+
+# --- request-scoped trace context -------------------------------------
+#
+# A request id minted at the serving ingress (QueryRouter.query) rides
+# a ContextVar so every span/event/fault the request touches — across
+# the router thread, the replica dispatch, the sharded cut read, the
+# service ingest thread, and the PullEngine workers — is stamped with
+# it at construction time. ContextVars do NOT flow into threads that
+# already exist (the ingest loop and pull workers are long-lived), so
+# queue hops capture the id explicitly at submit time and restore it
+# around the work (serve/service.py, parallel/pipeline.py).
+
+_request_ctx: "contextvars.ContextVar[Optional[str]]" = (
+    contextvars.ContextVar("dbscan_obs_request_id", default=None)
+)
+# next() on itertools.count is a single bytecode under the GIL — ids
+# stay unique without a lock even when many router threads mint at once
+_rid_counter = itertools.count(1)
+
+
+def mint_request_id() -> str:
+    """A fresh process-unique request id (``r<pid:hex>-<seq>``): the
+    pid component keeps ids from multi-process shard traces distinct
+    when merged by ``obs.analyze --merge``."""
+    return f"r{os.getpid():x}-{next(_rid_counter)}"
+
+
+def current_request() -> Optional[str]:
+    """The request id bound in this context, or None outside any
+    request scope — a plain ContextVar read, safe on every hot path."""
+    return _request_ctx.get()
+
+
+def set_request(rid: Optional[str]):
+    """Bind ``rid`` in the current context; returns the reset token.
+    Prefer :class:`request_scope` — this low-level pair exists for
+    callers that cannot use a with-block (generator-shaped code)."""
+    return _request_ctx.set(rid)
+
+
+def reset_request(token) -> None:
+    _request_ctx.reset(token)
+
+
+class request_scope:
+    """Context manager binding a request id for the dynamic extent of a
+    block: ``with request_scope(rid): ...`` — every span/event created
+    inside (on this thread's context) carries ``rid``. Re-entrant and
+    exception-safe; ``request_scope(None)`` is a valid no-request
+    scope (used by queue consumers restoring a possibly-absent id)."""
+
+    __slots__ = ("rid", "_token")
+
+    def __init__(self, rid: Optional[str]):
+        self.rid = rid
+        self._token = None
+
+    def __enter__(self) -> Optional[str]:
+        self._token = _request_ctx.set(self.rid)
+        return self.rid
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        _request_ctx.reset(self._token)
 
 
 class Span:
@@ -46,7 +112,7 @@ class Span:
     """
 
     __slots__ = (
-        "name", "t0", "t1", "depth", "tid", "args", "events",
+        "name", "t0", "t1", "depth", "tid", "rid", "args", "events",
         "_tracer", "_sync",
     )
 
@@ -57,6 +123,7 @@ class Span:
         self.t1 = None
         self.depth = 0
         self.tid = threading.get_ident()
+        self.rid = _request_ctx.get()
         self.events: list = []
         self._tracer = tracer
         self._sync = None
@@ -185,12 +252,17 @@ class Tracer:
         t1: float,
         args: Optional[dict] = None,
         events: Optional[list] = None,
+        rid: Optional[str] = None,
     ) -> Span:
         """Register a RETROACTIVE span from explicit perf_counter
         bounds — the bridge for phases that already time themselves
         (driver ``timings``): the trace records the exact same window
-        the stats dict reports."""
+        the stats dict reports. ``rid`` overrides the ambient request
+        id for emitters reporting on behalf of another context (the
+        PullEngine worker stamping a job's captured id)."""
         sp = Span(self, name, args or {})
+        if rid is not None:
+            sp.rid = rid
         sp.t0 = float(t0)
         sp.t1 = float(t1)
         sp.depth = len(self._stack())
@@ -207,8 +279,14 @@ class Tracer:
         span when one exists, else to the process-level list."""
         st = self._stack()
         if st:
+            # the enclosing span already carries the request id
             st[-1].event(name, **args)
         else:
+            rid = _request_ctx.get()
+            if rid is not None and "rid" not in args:
+                # orphan instants keep the (name, t, args) tuple shape
+                # every consumer pins; the request id rides the args
+                args = dict(args, rid=rid)
             with self._lock:
                 _tsan.access("obs.trace")
                 self.instants.append((name, time.perf_counter(), args))

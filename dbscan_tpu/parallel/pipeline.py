@@ -131,7 +131,7 @@ class PullJob:
     engine worker. ``wait`` on the owning engine blocks for it."""
 
     __slots__ = (
-        "work", "on_start", "bytes_hint", "label",
+        "work", "on_start", "bytes_hint", "label", "rid",
         "result", "error", "busy_s", "cancelled", "consumed", "_done",
     )
 
@@ -146,6 +146,10 @@ class PullJob:
         self.on_start = on_start
         self.bytes_hint = max(0, int(bytes_hint))
         self.label = label
+        # request context does not follow the job to the worker thread
+        # on its own (the worker predates the request): capture the id
+        # at submit, restore it around _execute
+        self.rid = obs.current_request()
         self.result = None
         self.error: Optional[BaseException] = None
         self.busy_s = 0.0
@@ -456,50 +460,54 @@ class PullEngine:
         submission (where the job never entered the started window, so
         no depth/byte release applies)."""
         t0 = time.perf_counter()
-        try:
-            job.result = job.work()
-        except BaseException as e:  # noqa: BLE001 — re-raised at wait
-            job.error = e
-        job.busy_s = time.perf_counter() - t0
-        with self._cv:
-            _tsan.access("pipeline.engine")
-            if from_worker:
-                self._executing = None
-                self._started -= 1
-                self._started_bytes -= job.bytes_hint
-            else:
-                # inline (collective-mode) execution: the SUBMITTER
-                # blocked for the whole job, so the honest accounting is
-                # wait = busy and overlap = 0 — consumed here so a later
-                # wait() (which returns instantly) cannot re-score it as
-                # fully overlapped
-                job.consumed = True
-                self._totals["wait_s"] += job.busy_s
-            self._totals["jobs"] += 1
-            self._totals["busy_s"] += job.busy_s
-            self._totals["bytes"] += job.bytes_hint
-            self._cv.notify_all()
-        # telemetry BEFORE the done event (a consumer that returned
-        # from wait() must find the job's counters/span already
-        # emitted), shielded so a failing hook can never strand the
-        # waiter
-        try:
-            obs.count("pull.busy_s", job.busy_s)
-            if not from_worker:
-                obs.count("pull.wait_s", job.busy_s)
-            if job.bytes_hint:
-                obs.count("pull.bytes", job.bytes_hint)
-            obs.add_span(
-                "pull.chunk",
-                t0,
-                t0 + job.busy_s,
-                label=job.label,
-                bytes=int(job.bytes_hint),
-                failed=job.error is not None,
-            )
-            self._set_inflight_gauge()
-        except Exception:  # noqa: BLE001 — never strand a waiter
-            logger.exception("pull telemetry emission failed")
+        # the submitter's request id is restored for the WHOLE job —
+        # the work itself and the retroactive pull.chunk span both
+        # stamp it, so a request's trace follows it onto the worker
+        with obs.request_scope(job.rid):
+            try:
+                job.result = job.work()
+            except BaseException as e:  # noqa: BLE001 — re-raised at wait
+                job.error = e
+            job.busy_s = time.perf_counter() - t0
+            with self._cv:
+                _tsan.access("pipeline.engine")
+                if from_worker:
+                    self._executing = None
+                    self._started -= 1
+                    self._started_bytes -= job.bytes_hint
+                else:
+                    # inline (collective-mode) execution: the SUBMITTER
+                    # blocked for the whole job, so the honest accounting
+                    # is wait = busy and overlap = 0 — consumed here so a
+                    # later wait() (which returns instantly) cannot
+                    # re-score it as fully overlapped
+                    job.consumed = True
+                    self._totals["wait_s"] += job.busy_s
+                self._totals["jobs"] += 1
+                self._totals["busy_s"] += job.busy_s
+                self._totals["bytes"] += job.bytes_hint
+                self._cv.notify_all()
+            # telemetry BEFORE the done event (a consumer that returned
+            # from wait() must find the job's counters/span already
+            # emitted), shielded so a failing hook can never strand the
+            # waiter
+            try:
+                obs.count("pull.busy_s", job.busy_s)
+                if not from_worker:
+                    obs.count("pull.wait_s", job.busy_s)
+                if job.bytes_hint:
+                    obs.count("pull.bytes", job.bytes_hint)
+                obs.add_span(
+                    "pull.chunk",
+                    t0,
+                    t0 + job.busy_s,
+                    label=job.label,
+                    bytes=int(job.bytes_hint),
+                    failed=job.error is not None,
+                )
+                self._set_inflight_gauge()
+            except Exception:  # noqa: BLE001 — never strand a waiter
+                logger.exception("pull telemetry emission failed")
         job._done.set()
 
 

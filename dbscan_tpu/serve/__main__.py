@@ -59,6 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", action="store_true",
         help="print ONLY the final JSON summary line",
     )
+    p.add_argument(
+        "--watch", action="store_true",
+        help="after each health line, render the live-telemetry "
+        "console frame (obs/live.py windows) for this process",
+    )
     return p
 
 
@@ -110,13 +115,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             h = svc.health()
             last_epoch = h["epoch"]
             if not args.json:
+                win = h.get("windowed") or {}
+                wp99 = win.get("windowed_p99_ms")
                 print(
                     f"epoch {h['epoch']}: queue={h['queue_depth']}/"
                     f"{h['queue_max']} resident={h['resident_points']} "
                     f"update={h['last_update_s']:.3f}s "
                     f"queries={len(lat_ms)}"
+                    + (f" wp99={wp99:.1f}ms" if wp99 is not None else "")
+                    + (f" expo={win['expo']}" if win.get("expo") else "")
                     + (" DEGRADED" if h["degraded"] else "")
                 )
+                if args.watch:
+                    from dbscan_tpu.obs import live as obs_live
+
+                    snap = obs_live.snapshot()
+                    if snap is not None:
+                        print(
+                            obs_live.render_console(
+                                obs_live.parse_expo(
+                                    obs_live.render_expo(snap)
+                                ),
+                                "in-process",
+                            )
+                        )
         ingest_wall = time.perf_counter() - t_start
         stop.set()
         for t in threads:

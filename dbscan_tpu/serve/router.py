@@ -31,17 +31,31 @@ replica left the router degrades to the numpy union oracle
 pinned by tests/test_serve_sharded.py: ZERO failed queries under any
 schedule of replica kills.
 
-**Load shed.** When the rolling p99 of answered queries drifts past
+**Load shed.** When the query p99 drifts past
 ``DBSCAN_SERVE_SHED_P99_MS`` (opt-in; 0 disables), the router sheds
 the EXPENSIVE tail instead of queueing it: each candidate batch is
 priced with the declared ``serve.query`` model (the admission
 controller's forward-pricing discipline, serve/tenancy.py) and
 admitted only if its price fits the headroom scaled down by
 ``bound / p99`` — the further p99 drifts, the cheaper a batch must be
-to board. Shed queries raise :class:`QueryShed` (an admission refusal,
-not a failure) and count ``serve.router.shed``;
+to board. The p99 read is the LIVE sliding-window figure
+(obs/live.py ``serve.query_ms``) whenever the live plane is on — a
+shed decision sees the fleet's last window, not this router's
+lifetime sample — and falls back to the in-router rolling deque with
+``DBSCAN_OBS_LIVE=0``. Shed queries raise :class:`QueryShed` (an
+admission refusal, not a failure), count ``serve.router.shed``, and
+emit the declared ``serve.router.shed`` EVENT naming the SLO that
+drove the refusal (query_p99);
 ``serve_shed_frac = shed / (shed + routed)`` is the bench/regression
 surface (obs/bench_history.py, LOWER is better).
+
+**Request tracing.** Every accepted query mints a request id at
+ingress (``obs.mint_request_id``) and binds it for the whole routed
+extent (``obs.request_scope``): the ``serve.route`` span, the
+replica's per-shard ``serve.query`` dispatches, the PullEngine's
+``pull.chunk`` spans, and any fault events the query touches all
+carry the same ``rid`` — ``obs.analyze --requests`` reconstructs the
+cross-shard critical path per request from a merged trace.
 """
 
 from __future__ import annotations
@@ -58,6 +72,8 @@ import numpy as np
 from dbscan_tpu import config, faults, obs
 from dbscan_tpu.lint import tsan as _tsan
 from dbscan_tpu.obs import compile as obs_compile
+from dbscan_tpu.obs import live as obs_live
+from dbscan_tpu.obs import slo as slo_mod
 from dbscan_tpu.parallel import pipeline as pipe_mod
 from dbscan_tpu.serve import query as query_mod
 from dbscan_tpu.serve.sharded import (
@@ -75,13 +91,14 @@ BROADCAST_FAMILY = "serve.broadcast"
 
 class QueryShed(RuntimeError):
     """The router refused a query batch under shed pressure: the
-    rolling p99 is past the declared bound and this batch's priced
-    cost does not fit the shrunk admission headroom. An ADMISSION
-    refusal (retry later / smaller), not a failed query."""
+    windowed (or fallback rolling) p99 is past the declared bound and
+    this batch's priced cost does not fit the shrunk admission
+    headroom. An ADMISSION refusal (retry later / smaller), not a
+    failed query."""
 
     def __init__(self, price: int, allowed: int, p99: float, bound: float):
         super().__init__(
-            f"serve.router: shed — rolling p99 {p99:.1f} ms is past the "
+            f"serve.router: shed — query p99 {p99:.1f} ms is past the "
             f"{bound:.1f} ms bound and this batch prices at {price} B "
             f"vs the shrunk {allowed} B admission window"
         )
@@ -173,6 +190,9 @@ class QueryRouter:
         self._shed = 0
         self._closed = False
         self._headroom = int(config.env("DBSCAN_SERVE_HEADROOM_BYTES"))
+        # the serving constructors are live-plane entry points: the
+        # latch makes this a tuple compare after the first router
+        obs_live.ensure_env()
         obs.gauge("serve.router.replicas_live", n)
         service.add_listener(self.publish_cut)
 
@@ -264,14 +284,26 @@ class QueryRouter:
             return None
         return float(np.percentile(np.asarray(lats), 99))
 
+    def _windowed_p99(self) -> Tuple[Optional[float], str]:
+        """The p99 shed decisions read: the LIVE sliding-window figure
+        when the live plane has data (source "window"), else this
+        router's rolling sample (source "rolling" — the
+        DBSCAN_OBS_LIVE=0 fallback)."""
+        p99 = obs_live.quantile("serve.query_ms", 0.99)
+        if p99 is not None:
+            return p99, "window"
+        return self._rolling_p99(), "rolling"
+
     def _shed_check(self, n_q: int, cut: Cut, d: int) -> None:
         bound = float(config.env("DBSCAN_SERVE_SHED_P99_MS"))
         if bound <= 0:
             return
-        p99 = self._rolling_p99()
+        p99, source = self._windowed_p99()
         if p99 is None or p99 <= bound:
             return
         obs.gauge("serve.router.p99_ms", p99)
+        if source == "window":
+            obs.gauge("serve.windowed_p99_ms", p99)
         price = self._price(n_q, cut, d)
         allowed = int(self._headroom * (bound / p99))
         if price > allowed:
@@ -279,6 +311,20 @@ class QueryRouter:
                 _tsan.access("serve.router")
                 self._shed += 1
             obs.count("serve.router.shed")
+            obs_live.bump("serve.router.shed")
+            # the refusal is attributable: the event NAMES the SLO
+            # whose windowed burn drove it (the query-latency
+            # objective), with the exact figures the decision read
+            obs.event(
+                "serve.router.shed",
+                slo=slo_mod.QUERY_P99,
+                p99_ms=round(p99, 3),
+                bound_ms=bound,
+                source=source,
+                price=price,
+                allowed=allowed,
+            )
+            slo_mod.maybe_evaluate()
             raise QueryShed(price, allowed, p99, bound)
 
     @property
@@ -376,7 +422,14 @@ class QueryRouter:
         key = zlib.crc32(qpts.tobytes())
         pinned: Optional[Cut] = None
         t0 = time.perf_counter()
-        with obs.span("serve.route", points=int(len(pts))):
+        # request ingress: mint the id here and bind it for the whole
+        # routed extent — every span/event/fault this query touches
+        # (route, per-shard dispatches, pull.chunk hops, failovers)
+        # carries it into the exports and the flightrec ring
+        rid = obs.mint_request_id()
+        with obs.request_scope(rid), obs.span(
+            "serve.route", points=int(len(pts))
+        ):
             while True:
                 r = self._pick(key)
                 if r is None:
@@ -418,6 +471,12 @@ class QueryRouter:
             self._lats.append(ms)
             self._routed += 1
         obs.count("serve.router.routed")
+        # feed the live plane: the windowed histogram the NEXT shed
+        # decision (and the SLO engine) reads, then a throttled SLO
+        # evaluation pass — no dedicated thread anywhere
+        obs_live.observe("serve.query_ms", ms)
+        obs_live.bump("serve.router.routed")
+        slo_mod.maybe_evaluate()
 
     def health(self) -> dict:
         with self._lock:
@@ -429,7 +488,7 @@ class QueryRouter:
             ]
             shed, routed = self._shed, self._routed
         total = shed + routed
-        return {
+        out = {
             "replicas": len(self._replicas),
             "live": live,
             "replica_cut_ids": cut_ids,
@@ -437,3 +496,5 @@ class QueryRouter:
             "shed": shed,
             "shed_frac": shed / total if total else 0.0,
         }
+        out.update(slo_mod.windowed_health())
+        return out

@@ -56,7 +56,9 @@ from dbscan_tpu import config, faults, obs
 from dbscan_tpu.config import DBSCANConfig, Engine, Precision
 from dbscan_tpu.lint import tsan as _tsan
 from dbscan_tpu.obs import flight as obs_flight
+from dbscan_tpu.obs import live as obs_live
 from dbscan_tpu.obs import memory as obs_memory
+from dbscan_tpu.obs import slo as slo_mod
 from dbscan_tpu.parallel import checkpoint as ckpt_mod
 from dbscan_tpu.parallel import pipeline as pipe_mod
 from dbscan_tpu.serve import query as query_mod
@@ -328,7 +330,10 @@ class ClusterService:
                         return False
             if self._stop_evt.is_set():
                 raise RuntimeError("service is stopping")
-            self._queue.append(b)
+            # the request context does not cross the queue on its own
+            # (the ingest thread predates this request): capture the id
+            # here, restore it around the ingest work
+            self._queue.append((obs.current_request(), b))
             depth = len(self._queue)
             self._cv.notify_all()
         obs.gauge("serve.queue_depth", depth)
@@ -355,13 +360,14 @@ class ClusterService:
                     self._cv.wait(0.5)
                 if not self._queue:
                     return  # stopping and drained
-                batch = self._queue.popleft()
+                rid, batch = self._queue.popleft()
                 self._busy = True
                 depth = len(self._queue)
                 self._cv.notify_all()
             obs.gauge("serve.queue_depth", depth)
             try:
-                self._ingest_one(batch)
+                with obs.request_scope(rid):
+                    self._ingest_one(batch)
             except faults.FatalDeviceFault as e:
                 # the query side keeps serving the last good epoch; the
                 # health endpoint carries the degradation (the flight
@@ -397,6 +403,8 @@ class ClusterService:
             )
         obs.count("serve.updates")
         obs.count("serve.ingest_points", int(len(batch)))
+        obs_live.observe("serve.update_ms", (time.perf_counter() - t0) * 1e3)
+        obs_live.bump("serve.updates")
         return upd
 
     def _publish(
@@ -433,6 +441,8 @@ class ClusterService:
         obs.gauge("serve.epoch", snap.epoch)
         obs.gauge("serve.resident_points", snap.k)
         obs.event("serve.epoch_publish", epoch=snap.epoch, skeleton=snap.k)
+        obs_live.bump("serve.epoch_publish")
+        slo_mod.maybe_evaluate()
         if self._on_publish is not None:
             # AFTER the seqlock settles: the sharded layer folds this
             # shard's new epoch into the next published consistent cut
@@ -482,6 +492,7 @@ class ClusterService:
         cfg = self._stream.config
         ncols = 2 if cfg.metric == "euclidean" else pts.shape[1]
         qpts = pts[:, :ncols]
+        t_q = time.perf_counter()
         with obs.span(
             "serve.query", epoch=int(snap.epoch), points=int(len(pts))
         ):
@@ -510,6 +521,8 @@ class ClusterService:
                 )
         obs.count("serve.queries")
         obs.count("serve.query_points", int(len(pts)))
+        obs_live.observe("serve.query_ms", (time.perf_counter() - t_q) * 1e3)
+        obs_live.bump("serve.queries")
         return QueryResult(ans.gids, ans.core, ans.counts, snap.epoch)
 
     def resolve(self, ids: np.ndarray) -> np.ndarray:
@@ -540,7 +553,7 @@ class ClusterService:
             last_update_s = self._last_update_s
         hbm = obs_memory.sample("serve.health")
         eng = self._pull if self._pull is not None else pipe_mod.get_engine()
-        return {
+        out = {
             "shard": self._shard,
             "epoch": snap.epoch,
             "n_updates": snap.n_updates,
@@ -556,6 +569,8 @@ class ClusterService:
             "hbm_bytes_in_use": hbm,
             "pull": eng.totals() if eng is not None else None,
         }
+        out.update(slo_mod.windowed_health())
+        return out
 
     def checkpoint(self, quiet: bool = False) -> Optional[str]:
         """Persist the last published snapshot's stream state; returns

@@ -58,6 +58,8 @@ import numpy as np
 from dbscan_tpu import config, faults, obs
 from dbscan_tpu.config import DBSCANConfig, Engine, Precision
 from dbscan_tpu.lint import tsan as _tsan
+from dbscan_tpu.obs import live as obs_live
+from dbscan_tpu.obs import slo as slo_mod
 from dbscan_tpu.parallel import checkpoint as ckpt_mod
 from dbscan_tpu.parallel import mesh as mesh_mod
 from dbscan_tpu.serve import query as query_mod
@@ -468,6 +470,7 @@ class ShardedClusterService:
         cfg = self.config
         ncols = 2 if cfg.metric == "euclidean" else pts.shape[1]
         qpts = pts[:, :ncols]
+        t_q = time.perf_counter()
         with obs.span(
             "serve.query", cut=int(cut.cut_id), points=int(len(pts))
         ):
@@ -489,6 +492,8 @@ class ShardedClusterService:
             ans = combine_answers(answers, len(pts), cfg.min_points)
         obs.count("serve.queries")
         obs.count("serve.query_points", int(len(pts)))
+        obs_live.observe("serve.query_ms", (time.perf_counter() - t_q) * 1e3)
+        obs_live.bump("serve.queries")
         return ShardedQueryResult(ans.gids, ans.core, ans.counts, cut.epochs)
 
     def resolve(self, ids: np.ndarray) -> np.ndarray:
@@ -517,7 +522,7 @@ class ShardedClusterService:
         shard's own health dict (queue depth, degradation, faults)."""
         cut = self.cut()
         shards = [svc.health() for svc in self._shards]
-        return {
+        out = {
             "n_shards": self.n_shards,
             "cut_id": cut.cut_id,
             "epochs": list(cut.epochs),
@@ -527,6 +532,8 @@ class ShardedClusterService:
             ],
             "shards": shards,
         }
+        out.update(slo_mod.windowed_health())
+        return out
 
     def checkpoint(self, quiet: bool = False) -> List[Optional[str]]:
         """Persist every shard's last published snapshot under its

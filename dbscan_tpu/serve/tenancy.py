@@ -25,6 +25,11 @@ it — or exceeds ``DBSCAN_SERVE_JOB_SLOTS`` points — is REJECTED at
 submit (:class:`AdmissionRejected`, ``serve.jobs_rejected``), because
 no schedule can make it fit. This is the graftshape HBM contract run
 FORWARD: predict, then dispatch, instead of dispatch-and-hope.
+Under latency pressure the gate also TIGHTENS: with
+``DBSCAN_SERVE_SHED_P99_MS`` declared and the live windowed query p99
+(obs/live.py — the router's shed signal) over that bound, the
+effective headroom shrinks by ``bound / p99``, so flushes split
+smaller and queue instead of stacking wider into an overloaded fleet.
 
 Results are exact: each job's labels equal a standalone
 ``ops.local_dbscan`` run of that job (same adjacency algebra, same
@@ -42,6 +47,7 @@ import numpy as np
 
 from dbscan_tpu import config, obs
 from dbscan_tpu.obs import compile as obs_compile
+from dbscan_tpu.obs import live as obs_live
 from dbscan_tpu.ops import distance as dist_mod
 from dbscan_tpu.ops.labels import seed_to_local_ids
 from dbscan_tpu.parallel import pipeline as pipe_mod
@@ -102,8 +108,26 @@ class AdmissionController:
         expr = model.input_expr() + model.overhead
         return int(expr.substitute(binding).evaluate(binding))
 
+    def effective_headroom(self) -> int:
+        """The byte budget :meth:`admit` actually gates on. Normally
+        the configured headroom; under latency pressure — the LIVE
+        windowed query p99 (obs/live.py, the same windowed figure the
+        router sheds on) over the declared
+        ``DBSCAN_SERVE_SHED_P99_MS`` bound — it shrinks
+        proportionally (``headroom * bound / p99``), so batch flushes
+        split smaller and queue work instead of stacking wider while
+        the fleet is already missing its latency objective. Reads one
+        windowed quantile; the full headroom is restored as soon as
+        the window drains back under the bound."""
+        bound = float(config.env("DBSCAN_SERVE_SHED_P99_MS"))
+        if bound > 0:
+            p99 = obs_live.quantile("serve.query_ms", 0.99)
+            if p99 is not None and p99 > bound:
+                return max(1, int(self.headroom * (bound / p99)))
+        return self.headroom
+
     def admit(self, jobs: int, slots: int, d: int) -> bool:
-        return self.price(jobs, slots, d) <= self.headroom
+        return self.price(jobs, slots, d) <= self.effective_headroom()
 
 
 def _jobs_builder(engine: str, metric: str):

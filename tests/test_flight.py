@@ -117,6 +117,38 @@ def test_flightrec_off_restores_strict_noop(monkeypatch):
     assert obs.add_span("x", 0.0, 1.0) is None
 
 
+def test_default_dump_path_is_tmp_scoped_never_cwd(monkeypatch):
+    """The CWD-littering regression pin: with DBSCAN_FLIGHTREC_PATH
+    unset, dumps land under the system tmp dir as a run-scoped
+    ``dbscan-flightrec.<pid>.json`` — never a bare ``flightrec.json``
+    in whatever directory the process was cwd'd into (the repo root,
+    for a tier-1 run). The stray file is also .gitignore'd in case an
+    older artifact survives somewhere."""
+    import tempfile
+
+    monkeypatch.delenv("DBSCAN_FLIGHTREC_PATH", raising=False)
+    path = flight._default_path()
+    assert os.path.isabs(path)
+    assert os.path.dirname(path) == tempfile.gettempdir()
+    assert os.path.basename(path) == (
+        f"dbscan-flightrec.{os.getpid()}.json"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert not os.path.exists(os.path.join(repo, "flightrec.json"))
+    gitignore = open(os.path.join(repo, ".gitignore")).read()
+    assert "flightrec.json" in gitignore
+    # a real dump honors the default: writes tmp, not the cwd
+    flight.ensure_env()
+    with obs.span("x"):
+        pass
+    out = flight.dump(reason="default_path_pin")
+    try:
+        assert out == path and os.path.exists(out)
+    finally:
+        if out and os.path.exists(out):
+            os.remove(out)
+
+
 def test_dump_on_demand_shape(tmp_path):
     train(_blobs(), **KW_BANDED)
     path = flight.dump(reason="operator_poke", extra="context")

@@ -135,6 +135,32 @@ def test_cli_serve_smoke(capsys):
     assert summary["degraded"] is None
 
 
+def test_serve_main_watch_renders_live_console(capsys, monkeypatch):
+    """``python -m dbscan_tpu.serve --watch`` interleaves the live-
+    telemetry console frame (obs/live.py windows, rendered through the
+    same expo round-trip the file poller uses) with the health lines,
+    and the health line itself carries the windowed p99."""
+    from dbscan_tpu.obs import live
+    from dbscan_tpu.serve.__main__ import main as serve_main
+
+    monkeypatch.delenv("DBSCAN_OBS_LIVE", raising=False)
+    live.reset()
+    rc = serve_main(
+        [
+            "--updates", "1", "--batch", "200", "--jobs", "0",
+            "--query-batch", "64", "--readers", "1", "--watch",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "dbscan live" in out  # the console frame rendered
+    assert "serve.query_ms" in out or "serve.update_ms" in out
+    assert "wp99=" in out  # the health line shows the windowed p99
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["metric"] == "serve"
+    live.reset()
+
+
 def test_cli_requires_input_unless_serve(capsys):
     with pytest.raises(SystemExit) as ei:
         cli_main(["--eps", "0.5", "--min-points", "5"])

@@ -1,0 +1,850 @@
+"""obs/live + obs/slo + request tracing: the live telemetry plane.
+
+Pins the PR's acceptance contract (PARITY.md "SLO contract"):
+
+- log-bucketed sliding-window histograms: bucket geometry, the
+  declared QUANTILE_REL_ERROR bound on every reported quantile, epoch-
+  ring expiry (observations older than the window stop counting),
+  windowed counter rates, and the declared bytes_bound memory ceiling;
+- DBSCAN_OBS_LIVE=0 is a STRICT no-op (no state, hooks return their
+  empty values, health dicts keep the pre-PR shape) and the enabled
+  plane adds < 1% to the serve query path (min-of-reps, the flight-
+  recorder guard's discipline);
+- undeclared series names are rejected (the schema stays the single
+  registry: you cannot observe into a window the linter cannot see);
+- the Prometheus-style exposition file: render/parse round-trip,
+  atomic rewrite, the DBSCAN_OBS_EXPO_PERIOD_S throttle, and the
+  ``python -m dbscan_tpu.obs.live`` console smoke;
+- the SLO engine: multi-window burn-rate evaluation with ticket ->
+  page escalation (page dumps the flight recorder WHILE the incident
+  runs), recovery events, all four declared SLO keys' burn arithmetic,
+  and maybe_evaluate's throttle + live-off single-check no-op;
+- service/router health() carrying the windowed figures, router
+  shedding driven by the LIVE windowed p99 with the refusal event
+  NAMING the SLO, and recovery once the window drains;
+- request-scoped tracing: ids minted at the router ingress ride every
+  span the request touches (route -> shard reads -> pull hops), across
+  the ingest queue hop and the PullEngine workers, stay coherent
+  through a mid-query replica failover (no orphan spans), and feed
+  ``obs.analyze --requests`` per-request critical paths;
+- live-vs-offline agreement: the windowed p99 matches the offline
+  client-side percentile within the declared tolerance;
+- the DBSCAN_TSAN=1 sharded rerun stays race-free with the live
+  aggregators, the SLO engine, and the expo writer all hot.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import faults
+from dbscan_tpu import obs
+from dbscan_tpu.obs import analyze as analyze_mod
+from dbscan_tpu.obs import flight
+from dbscan_tpu.obs import live
+from dbscan_tpu.obs import slo as slo_mod
+from dbscan_tpu.serve import (
+    ClusterService,
+    QueryRouter,
+    QueryShed,
+    ShardedClusterService,
+)
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EPS, MINPTS = 0.6, 5
+
+#: live-vs-offline agreement tolerance (relative) on the windowed p99
+#: vs the client-side offline percentile over the same query
+#: population — declared in PARITY.md "SLO contract" next to the
+#: histogram's QUANTILE_REL_ERROR (~9.1%) it subsumes.
+AGREEMENT_RTOL = 0.25
+
+_ENV_KNOBS = (
+    "DBSCAN_TRACE",
+    "DBSCAN_OBS_LIVE",
+    "DBSCAN_OBS_WINDOW_S",
+    "DBSCAN_OBS_SLICES",
+    "DBSCAN_OBS_EXPO",
+    "DBSCAN_OBS_EXPO_PERIOD_S",
+    "DBSCAN_SLO_QUERY_P99_MS",
+    "DBSCAN_SLO_OBJECTIVE",
+    "DBSCAN_SLO_SHED_FRAC",
+    "DBSCAN_SLO_STALENESS_S",
+    "DBSCAN_SLO_FAULT_RATE",
+    "DBSCAN_SLO_BURN_PAGE",
+    "DBSCAN_SLO_BURN_TICKET",
+    "DBSCAN_SLO_EVAL_PERIOD_S",
+    "DBSCAN_SERVE_SHED_P99_MS",
+    "DBSCAN_FAULT_SPEC",
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane(monkeypatch, tmp_path):
+    for var in _ENV_KNOBS:
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv(
+        "DBSCAN_FLIGHTREC_PATH", str(tmp_path / "flightrec.json")
+    )
+    monkeypatch.setenv("DBSCAN_FAULT_BACKOFF_S", "0")
+    obs.disable()
+    live.reset()
+    slo_mod.reset_engine()
+    flight.reset()
+    faults.reset_registry()
+    yield
+    obs.disable()
+    live.reset()
+    slo_mod.reset_engine()
+    flight.reset()
+    faults.reset_registry()
+
+
+def _batch(seed=7, n=60):
+    rng = np.random.default_rng(seed)
+    centers = [(0, 0), (5, 0), (0, 5), (5, 5)]
+    return np.concatenate(
+        [rng.normal(c, 0.25, size=(n, 2)) for c in centers]
+    )
+
+
+def _svc(**kw):
+    kw.setdefault("window", 2)
+    kw.setdefault("max_points_per_partition", 500)
+    return ClusterService(EPS, MINPTS, **kw)
+
+
+# --- bucket geometry + window arithmetic ------------------------------
+
+
+def test_bucket_geometry_within_declared_error():
+    # every representable value maps to a bucket whose reported
+    # midpoint is within the declared relative error
+    assert live.QUANTILE_REL_ERROR == pytest.approx(
+        math.sqrt(live.GROWTH) - 1.0
+    )
+    v = live.LO_MS * 1.5
+    while v < live.LO_MS * live.GROWTH ** (live.NBUCKETS - 3):
+        mid = live.bucket_mid_ms(live.bucket_of(v))
+        assert abs(mid - v) / v <= live.QUANTILE_REL_ERROR + 1e-9, v
+        v *= 1.07
+    # clamp edges: underflow to bucket 0, overflow to the top bucket
+    assert live.bucket_of(0.0) == 0
+    assert live.bucket_of(-5.0) == 0
+    assert live.bucket_of(1e12) == live.NBUCKETS - 1
+    assert live.bucket_of(live.LO_MS * 0.5) == 0
+
+
+def test_quantile_within_declared_error_vs_numpy():
+    live.ensure_env()
+    rng = np.random.default_rng(11)
+    vals = np.exp(rng.normal(2.5, 1.0, size=800))  # lognormal ms
+    for v in vals:
+        live.observe("serve.query_ms", float(v))
+    ordered = np.sort(vals)
+    for q in (0.5, 0.9, 0.99):
+        got = live.quantile("serve.query_ms", q)
+        # the exact empirical quantile at the histogram's own rank
+        # convention: the bucket-midpoint guarantee is the ONLY error
+        want = float(ordered[min(len(vals) - 1, int(q * len(vals)))])
+        assert got is not None
+        assert abs(got - want) / want <= live.QUANTILE_REL_ERROR + 1e-9, (
+            q, got, want,
+        )
+
+
+def test_window_expiry_epoch_ring():
+    # direct epoch control on one histogram window: no clock
+    # monkeypatching, the ring arithmetic is the contract
+    w = live._HistWindow(4, 0.0)
+    w.observe(10.0, epoch=100)
+    w.observe(20.0, epoch=101)
+    total, _s, _b = w.merged(epoch=101)
+    assert total == 2
+    # 3 epochs later the first slice has rolled out of the window
+    total, _s, _b = w.merged(epoch=104)
+    assert total == 1
+    # far future: everything expired, quantile says "no data"
+    total, _s, _b = w.merged(epoch=300)
+    assert total == 0
+    assert w.quantile(0.99, epoch=300) is None
+    # rate windows expire the same way
+    r = live._RateWindow(4, 0.0)
+    r.bump(3.0, epoch=100)
+    assert r.total(epoch=100) == 3.0
+    assert r.total(epoch=300) == 0.0
+
+
+def test_rates_and_window_totals():
+    monkeypatch_window = 60.0  # default window; test runs in < 1 s
+    live.ensure_env()
+    st = live.state()
+    assert st is not None and st.window_s == monkeypatch_window
+    for _ in range(12):
+        live.bump("serve.queries")
+    assert live.window_total("serve.queries") == 12.0
+    # the rate denominator is the plane's age (>= one slice), never
+    # the full window before it has lived that long
+    assert live.rate("serve.queries") > 0.0
+    assert live.window_total("serve.router.shed") == 0.0
+    assert live.seconds_since("serve.epoch_publish") is None
+    live.bump("serve.epoch_publish")
+    age = live.seconds_since("serve.epoch_publish")
+    assert age is not None and 0.0 <= age < 5.0
+
+
+def test_undeclared_series_rejected():
+    live.ensure_env()
+    with pytest.raises(ValueError, match="not declared"):
+        live.observe("serve.mystery_ms", 1.0)
+    with pytest.raises(ValueError, match="not declared"):
+        live.bump("serve.mystery_events")
+
+
+def test_bytes_bound_matches_declared_formula():
+    from dbscan_tpu.obs import schema
+
+    st = live.LiveState(window_s=60.0, n_slices=12)
+    per_hist = 12 * (live.NBUCKETS + 2) * 8
+    per_rate = 12 * 2 * 8
+    want = (
+        len(schema.LIVE_HISTOGRAMS) * per_hist
+        + len(schema.LIVE_RATES) * per_rate
+    )
+    assert st.bytes_bound() == want
+    assert want < 512 * 1024  # the "bounded memory" claim is real
+
+
+# --- disabled path: strict no-op --------------------------------------
+
+
+def test_disabled_plane_is_strict_noop(monkeypatch):
+    monkeypatch.setenv("DBSCAN_OBS_LIVE", "0")
+    live.reset()
+    live.ensure_env()
+    assert live.state() is None and not live.active()
+    # every hook returns its empty value without allocating state
+    live.observe("serve.query_ms", 5.0)
+    live.bump("serve.queries")
+    assert live.quantile("serve.query_ms", 0.99) is None
+    assert live.frac_above("serve.query_ms", 1.0) is None
+    assert live.rate("serve.queries") == 0.0
+    assert live.window_total("serve.queries") == 0.0
+    assert live.seconds_since("serve.epoch_publish") is None
+    assert live.snapshot() is None
+    assert live.state() is None
+    # SLO layer: one module-global check, no engine built
+    monkeypatch.setenv("DBSCAN_SLO_QUERY_P99_MS", "10")
+    assert slo_mod.maybe_evaluate() is None
+    assert slo_mod._engine is None
+    # health dicts keep the pre-PR shape
+    assert slo_mod.windowed_health() == {}
+    svc = _svc()
+    with svc:
+        svc.submit(_batch())
+        assert svc.drain(timeout=300)
+        h = svc.health()
+    assert "windowed" not in h
+
+
+def test_live_plane_overhead_under_1pct_on_query_path(monkeypatch):
+    """The overhead pin at the flight-recorder guard's discipline:
+    the live aggregators (histogram observe + rate bumps + the
+    windowed health rollup) add < 1% to the steady-state serve query
+    path versus DBSCAN_OBS_LIVE=0, min-of-reps on a warmed service,
+    with absolute slack for timer noise."""
+    svc = _svc()
+    rng = np.random.default_rng(0)
+    qpts = rng.uniform(-1, 6, size=(48, 2))
+
+    with svc:
+        svc.submit(_batch())
+        assert svc.drain(timeout=300)
+
+        def run():
+            for _ in range(6):
+                svc.query(qpts)
+
+        def min_wall(reps=5):
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                run()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        run()  # warm the jit caches
+        monkeypatch.setenv("DBSCAN_OBS_LIVE", "0")
+        live.reset()
+        live.ensure_env()
+        run()
+        without = min_wall()
+        assert live.state() is None
+        monkeypatch.delenv("DBSCAN_OBS_LIVE")
+        live.reset()
+        live.ensure_env()
+        assert live.state() is not None
+        run()
+        with_live = min_wall()
+    assert with_live <= without * 1.01 + 0.015, (
+        f"live-plane overhead: {with_live:.4f}s vs {without:.4f}s off"
+    )
+
+
+# --- exposition file + console ----------------------------------------
+
+
+def test_expo_render_parse_roundtrip_atomic(tmp_path):
+    live.ensure_env()
+    for v in (1.0, 2.0, 4.0, 80.0):
+        live.observe("serve.query_ms", v)
+    for _ in range(4):
+        live.bump("serve.queries")
+    path = tmp_path / "live.prom"
+    assert live.write_expo(str(path)) == str(path)
+    text = path.read_text()
+    assert "dbscan_live_window_seconds" in text
+    parsed = live.parse_expo(text)
+    assert parsed["window_s"] == live.state().window_s
+    q = parsed["series"]["serve.query_ms"]
+    assert q["count"] == 4.0
+    assert q["p99_ms"] == pytest.approx(
+        live.quantile("serve.query_ms", 0.99)
+    )
+    assert parsed["series"]["serve.queries"]["count"] == 4.0
+    # atomic: no temp litter beside the file
+    assert [p.name for p in tmp_path.iterdir()] == ["live.prom"]
+
+
+def test_expo_throttle_and_console_once(
+    tmp_path, monkeypatch, capsys
+):
+    path = tmp_path / "live.prom"
+    monkeypatch.setenv("DBSCAN_OBS_EXPO", str(path))
+    monkeypatch.setenv("DBSCAN_OBS_EXPO_PERIOD_S", "3600")
+    live.reset()
+    live.ensure_env()
+    live.observe("serve.query_ms", 7.0)
+    assert live.expo_path() == str(path)
+    assert live.maybe_write_expo() == str(path)  # first write lands
+    assert live.maybe_write_expo() is None  # throttled
+    assert path.exists()
+    # the top-style console, one frame
+    assert live.main([str(path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "dbscan live" in out and "serve.query_ms" in out
+    # no exposition file configured and none passed: exit 2
+    monkeypatch.delenv("DBSCAN_OBS_EXPO")
+    assert live.main(["--once"]) == 2
+
+
+# --- SLO engine --------------------------------------------------------
+
+
+def test_slo_burn_ticket_page_recover_and_flight_dump(
+    monkeypatch, tmp_path
+):
+    """The full alert lifecycle on the query-latency SLO: a saturated
+    bad-event window trips ticket then page (both windows burning),
+    the page dumps the flight recorder mid-incident, and a drained
+    window recovers with the declared event."""
+    monkeypatch.setenv("DBSCAN_SLO_QUERY_P99_MS", "100")
+    obs.enable()  # in-memory: events land in tracer.instants
+    live.ensure_env()
+    for _ in range(20):
+        live.observe("serve.query_ms", 500.0)  # every obs is bad
+    # budget 0.01 -> fast burn 100; a small engine window makes the
+    # slow EMA track it within a few evaluation passes
+    eng = slo_mod.SLOEngine(window_s=0.05)
+    for _ in range(50):
+        eng.evaluate()
+        if eng.alerting().get("query_p99") == "page":
+            break
+        time.sleep(0.05)
+    assert eng.alerting() == {"query_p99": "page"}
+    burns = [
+        (a["severity"], a["slo"])
+        for n, _t, a in obs.state().tracer.instants
+        if n == "slo.burn"
+    ]
+    assert burns == [("ticket", "query_p99"), ("page", "query_p99")]
+    counters = obs.counters()
+    assert counters["slo.tickets"] == 1
+    assert counters["slo.pages"] == 1
+    # the page wrote the postmortem WHILE the incident runs
+    dump = json.load(open(tmp_path / "flightrec.json"))
+    assert dump["reason"] == "slo_burn"
+    assert dump["note"]["slo"] == "query_p99"
+    # drain the window: flood with good observations, burn collapses
+    for _ in range(5000):
+        live.observe("serve.query_ms", 1.0)
+    for _ in range(100):
+        eng.evaluate()
+        if not eng.alerting():
+            break
+        time.sleep(0.05)
+    assert eng.alerting() == {}
+    recovers = [
+        a["slo"]
+        for n, _t, a in obs.state().tracer.instants
+        if n == "slo.recover"
+    ]
+    assert recovers == ["query_p99"]
+
+
+def test_all_four_slo_keys_burn_arithmetic(monkeypatch):
+    monkeypatch.setenv("DBSCAN_SLO_QUERY_P99_MS", "100")
+    monkeypatch.setenv("DBSCAN_SLO_SHED_FRAC", "0.1")
+    monkeypatch.setenv("DBSCAN_SLO_STALENESS_S", "10")
+    monkeypatch.setenv("DBSCAN_SLO_FAULT_RATE", "1000")
+    live.ensure_env()
+    slos = {s.key: s for s in slo_mod.declared_slos()}
+    assert set(slos) == {
+        "query_p99", "shed_frac", "staleness", "fault_rate",
+    }
+    # empty windows neither burn nor recover
+    assert slo_mod.fast_burn(slos["query_p99"]) is None
+    assert slo_mod.fast_burn(slos["shed_frac"]) is None
+    assert slo_mod.fast_burn(slos["staleness"]) is None
+    # query_p99: 1 bad of 4 over a 0.01 budget -> burn 25
+    for v in (1.0, 1.0, 1.0, 500.0):
+        live.observe("serve.query_ms", v)
+    assert slo_mod.fast_burn(slos["query_p99"]) == pytest.approx(
+        0.25 / 0.01
+    )
+    # shed_frac: 1 shed / 4 total over the 0.1 bound -> burn 2.5
+    for _ in range(3):
+        live.bump("serve.router.routed")
+    live.bump("serve.router.shed")
+    assert slo_mod.fast_burn(slos["shed_frac"]) == pytest.approx(2.5)
+    # staleness: a fresh publish burns ~0
+    live.bump("serve.epoch_publish")
+    burn = slo_mod.fast_burn(slos["staleness"])
+    assert burn is not None and burn < 0.1
+    # fault_rate: rate / bound
+    live.bump("faults.events")
+    assert slo_mod.fast_burn(slos["fault_rate"]) == pytest.approx(
+        live.rate("faults.events") / 1000.0
+    )
+
+
+def test_maybe_evaluate_throttle(monkeypatch):
+    monkeypatch.setenv("DBSCAN_SLO_QUERY_P99_MS", "100")
+    monkeypatch.setenv("DBSCAN_SLO_EVAL_PERIOD_S", "5")
+    live.ensure_env()
+    live.observe("serve.query_ms", 1.0)
+    first = slo_mod.maybe_evaluate()
+    assert first is not None and first[0]["slo"] == "query_p99"
+    assert slo_mod.maybe_evaluate() is None  # within the period
+
+
+def test_classified_fault_feeds_fault_rate_window(monkeypatch):
+    live.ensure_env()
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "serve#0:TRANSIENT")
+    faults.reset_registry()
+    svc = _svc()
+    with svc:
+        svc.submit(_batch())
+        assert svc.drain(timeout=300)  # transient heals via retry
+        h = svc.health()
+    assert live.window_total("faults.events") >= 1.0
+    assert not h["degraded"]
+
+
+# --- windowed health + shed recovery ----------------------------------
+
+
+def test_service_health_carries_windowed_figures():
+    obs.enable()
+    svc = _svc()
+    rng = np.random.default_rng(1)
+    with svc:
+        svc.submit(_batch())
+        assert svc.drain(timeout=300)
+        for _ in range(5):
+            svc.query(rng.uniform(-1, 6, size=(32, 2)))
+        h = svc.health()
+    win = h["windowed"]
+    assert win["window_s"] == 60.0
+    assert win["windowed_p99_ms"] > 0.0
+    assert win["windowed_qps"] > 0.0
+    assert win["windowed_shed_frac"] == 0.0
+    assert win["slo_alerting"] == {}
+    gauges = obs.state().metrics.gauges()
+    assert gauges["serve.windowed_p99_ms"] == win["windowed_p99_ms"]
+    assert gauges["serve.windowed_qps"] == win["windowed_qps"]
+
+
+def test_router_shed_names_slo_and_recovers(monkeypatch):
+    """The burn-driven refusal is attributable AND transient: the
+    shed event names the SLO whose windowed figure drove it with
+    source "window" (the LIVE plane, not the rolling fallback), and a
+    drained window readmits the same query."""
+    obs.enable()
+    rng = np.random.default_rng(5)
+    svc = ShardedClusterService(
+        EPS, MINPTS, n_shards=2, window=2, max_points_per_partition=500
+    )
+    with svc:
+        svc.submit(_batch(seed=3, n=70))
+        assert svc.drain(timeout=300)
+        # a small headroom so the burn-shrunk admission window is
+        # smaller than the drill batch's price
+        monkeypatch.setenv("DBSCAN_SERVE_HEADROOM_BYTES", str(1 << 22))
+        with QueryRouter(svc, replicas=2) as router:
+            for _ in range(10):
+                router.query(rng.uniform(-1, 6, size=(16, 2)))
+            assert live.state().window_count("serve.query_ms") >= 10
+            # a latency incident the WINDOW sees: the sliding window
+            # fills with observations far past a meetable bound
+            monkeypatch.setenv("DBSCAN_SERVE_SHED_P99_MS", "5000")
+            for _ in range(20):
+                live.observe("serve.query_ms", 500_000.0)
+            with pytest.raises(QueryShed):
+                router.query(rng.uniform(-1, 6, size=(512, 2)))
+            # the refusal mark rides the open serve.route span (the
+            # shed request's own trace line)
+            sheds = [
+                a
+                for sp in obs.state().tracer.snapshot_spans()
+                for n, _t, a in sp.events
+                if n == "serve.router.shed"
+            ]
+            assert len(sheds) == 1
+            assert sheds[0]["slo"] == "query_p99"
+            assert sheds[0]["source"] == "window"
+            assert sheds[0]["p99_ms"] > sheds[0]["bound_ms"]
+            assert live.window_total("serve.router.shed") == 1.0
+            h = router.health()
+            assert h["windowed"]["windowed_shed_frac"] == pytest.approx(
+                1.0 / 11.0
+            )
+            # recovery: the incident's observations age out (reset
+            # stands in for the sliding window draining) — the p99
+            # the check reads is back under the bound, so the SAME
+            # query readmits without any knob change
+            live.reset()
+            live.ensure_env()
+            res = router.query(rng.uniform(-1, 6, size=(512, 2)))
+            assert len(res.gids) == 512
+
+
+# --- request-scoped tracing -------------------------------------------
+
+
+def test_router_mints_rid_and_spans_are_coherent():
+    obs.enable()
+    rng = np.random.default_rng(9)
+    svc = ShardedClusterService(
+        EPS, MINPTS, n_shards=2, window=2, max_points_per_partition=500
+    )
+    with svc:
+        svc.submit(_batch(seed=3, n=70))
+        assert svc.drain(timeout=300)
+        with QueryRouter(svc, replicas=2) as router:
+            for _ in range(3):
+                router.query(rng.uniform(-1, 6, size=(24, 2)))
+    spans = obs.state().tracer.snapshot_spans()
+    routes = [s for s in spans if s.name == "serve.route"]
+    assert len(routes) == 3
+    rids = [s.rid for s in routes]
+    assert all(r and r.startswith(f"r{os.getpid():x}-") for r in rids)
+    assert len(set(rids)) == 3  # one id per request, process-unique
+    # every span the request produced carries ITS id: the pull-engine
+    # chunk hops (worker thread!) ride inside the routed extent
+    for rid in rids:
+        names = {s.name for s in spans if s.rid == rid}
+        assert "serve.route" in names
+        assert "pull.chunk" in names, names
+
+
+def test_rid_crosses_ingest_queue_hop():
+    obs.enable()
+    svc = _svc()
+    with svc:
+        with obs.request_scope("r-ingest-1"):
+            svc.submit(_batch())  # capture-at-submit
+        assert svc.drain(timeout=300)  # restore-around-work
+    updates = [
+        s
+        for s in obs.state().tracer.snapshot_spans()
+        if s.name == "serve.update"
+    ]
+    assert updates and all(s.rid == "r-ingest-1" for s in updates)
+
+
+def test_rid_rides_pull_engine_workers():
+    """The PullEngine queue hop: jobs capture the ambient id at
+    construction and the worker restores it around the whole
+    execution, so the retroactive pull.chunk spans are stamped."""
+    from dbscan_tpu.parallel import pipeline as pipe_mod
+
+    obs.enable()
+    pipe_mod.reset_engine()
+    eng = pipe_mod.get_engine()
+    assert eng is not None
+    with obs.request_scope("r-pull-7"):
+        jobs = [
+            eng.submit(lambda i=i: i * i, bytes_hint=8)
+            for i in range(4)
+        ]
+    for j in jobs:
+        eng.wait(j)
+    assert [j.result for j in jobs] == [0, 1, 4, 9]
+    assert all(j.rid == "r-pull-7" for j in jobs)
+    pipe_mod.reset_engine()
+    chunk_spans = [
+        s
+        for s in obs.state().tracer.snapshot_spans()
+        if s.name == "pull.chunk"
+    ]
+    assert chunk_spans
+    assert all(s.rid == "r-pull-7" for s in chunk_spans)
+
+
+def test_rid_coherent_through_replica_failover(monkeypatch):
+    """A replica dies mid-query: the failover event and the re-routed
+    dispatch stay inside the SAME request scope — one id, no orphan
+    spans, the trace reads as one request."""
+    monkeypatch.setenv(
+        "DBSCAN_FAULT_SPEC", "serve_replica@0#0:PERSISTENT"
+    )
+    faults.reset_registry()
+    obs.enable()
+    rng = np.random.default_rng(13)
+    svc = ShardedClusterService(
+        EPS, MINPTS, n_shards=2, window=2, max_points_per_partition=500
+    )
+    with svc:
+        svc.submit(_batch(seed=3, n=70))
+        assert svc.drain(timeout=300)
+        with QueryRouter(svc, replicas=2) as router:
+            res = router.query(rng.uniform(-1, 6, size=(30, 2)))
+            assert len(res.gids) == 30
+            h = router.health()
+    assert h["live"] == [1]  # replica 0 evicted mid-query
+    assert obs.counters()["serve.router.failovers"] == 1
+    spans = obs.state().tracer.snapshot_spans()
+    route = next(s for s in spans if s.name == "serve.route")
+    rid = route.rid
+    assert rid
+    # the failover mark rides a span of THIS request
+    fo = [
+        (s, e)
+        for s in spans
+        for e in s.events
+        if e[0] == "serve.router.failover"
+    ]
+    assert len(fo) == 1 and fo[0][0].rid == rid
+    # no orphans: every serve-layer span this trace recorded belongs
+    # to the request (single query -> single id)
+    serve_spans = [
+        s for s in spans if s.name in ("serve.route", "serve.query")
+    ]
+    assert serve_spans and all(s.rid == rid for s in serve_spans)
+
+
+# --- analyze --requests ------------------------------------------------
+
+
+def test_analyze_requests_rollup_and_render(tmp_path, capsys):
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable(trace_path=path)
+    with obs.request_scope("r-slow-1"):
+        with obs.span("serve.route", points=4):
+            time.sleep(0.03)
+        obs.event("fault.retry", site="serve_query")  # orphan instant
+    with obs.request_scope("r-fast-2"):
+        with obs.span("serve.route", points=4):
+            time.sleep(0.005)
+    with obs.span("serve.update", epoch=1):  # rid-less background work
+        pass
+    obs.flush()
+    data = analyze_mod.load_trace(path)
+    report = analyze_mod.analyze(data)
+    req = report["requests"]
+    assert req["n_requests"] == 2
+    assert [r["rid"] for r in req["rows"]] == ["r-slow-1", "r-fast-2"]
+    slow = req["rows"][0]
+    assert slow["wall_ms"] >= 25.0
+    assert slow["busy_ms"] <= slow["wall_ms"] + 1e-6
+    assert slow["top_span"] == "serve.route"
+    assert slow["faults"] == 1
+    assert req["rows"][1]["faults"] == 0
+    text = analyze_mod.render_requests(report)
+    assert "r-slow-1" in text and "slowest requests" in text
+    # console smoke: the --requests section alone
+    assert analyze_mod.main([path, "--requests"]) == 0
+    assert "r-slow-1" in capsys.readouterr().out
+
+
+def test_analyze_requests_empty_on_old_traces(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable(trace_path=path)
+    with obs.span("serve.update", epoch=1):
+        pass
+    obs.flush()
+    report = analyze_mod.analyze(analyze_mod.load_trace(path))
+    assert report["requests"] == {}  # pre-tracing captures unchanged
+    assert "no rid-stamped spans" in analyze_mod.render_requests(report)
+
+
+# --- live-vs-offline agreement ----------------------------------------
+
+
+def test_live_windowed_p99_agrees_with_offline():
+    """THE agreement pin (the bench stamps both figures): the live
+    windowed p99 over a query population matches the offline client-
+    side percentile of the same population within AGREEMENT_RTOL."""
+    svc = _svc()
+    rng = np.random.default_rng(2)
+    qpts = rng.uniform(-1, 6, size=(48, 2))
+    lats = []
+    with svc:
+        svc.submit(_batch())
+        assert svc.drain(timeout=300)
+        svc.query(qpts)  # warm the jit caches outside the population
+        live.reset()
+        live.ensure_env()
+        for _ in range(40):
+            t0 = time.perf_counter()
+            svc.query(qpts)
+            lats.append((time.perf_counter() - t0) * 1e3)
+        got = live.quantile("serve.query_ms", 0.99)
+        qps_live = live.rate("serve.queries")
+        assert live.state().window_count("serve.query_ms") == 40
+    # the offline figure at the histogram's rank convention (at bench
+    # scale — hundreds of samples — interpolation flavors converge;
+    # AGREEMENT_RTOL covers the bucket error plus scheduling jitter)
+    ordered = np.sort(np.asarray(lats))
+    want = float(ordered[min(len(lats) - 1, int(0.99 * len(lats)))])
+    assert got is not None
+    assert abs(got - want) / want <= AGREEMENT_RTOL, (got, want)
+    assert qps_live > 0.0
+
+
+# --- TSAN: the live plane is certified race-free ----------------------
+
+
+def test_sharded_tsan_rerun_race_free_with_live_plane_hot(tmp_path):
+    """DBSCAN_TSAN=1 rerun of the concurrent sharded serving shape
+    with every new lock hot: live aggregators (reader threads
+    observing + the health rollup), the SLO engine evaluating, and
+    the throttled expo writer — the report must stay empty."""
+    report = tmp_path / "tsan.json"
+    code = (
+        "import threading\n"
+        "import numpy as np\n"
+        "from dbscan_tpu.serve import QueryRouter, ShardedClusterService\n"
+        "rng = np.random.default_rng(0)\n"
+        "svc = ShardedClusterService(0.6, 5, n_shards=2, window=2,"
+        " max_points_per_partition=500)\n"
+        "stop = threading.Event()\n"
+        "with svc:\n"
+        "    router = QueryRouter(svc, replicas=2)\n"
+        "    def reader():\n"
+        "        q = rng.uniform(-6, 6, (24, 2))\n"
+        "        while not stop.is_set():\n"
+        "            router.query(q)\n"
+        "            router.health()\n"
+        "    threads = [threading.Thread(target=reader, daemon=True)"
+        " for _ in range(2)]\n"
+        "    [t.start() for t in threads]\n"
+        "    for i in range(4):\n"
+        "        svc.submit(np.concatenate(["
+        "rng.normal(c, 0.25, (60, 2))"
+        " for c in [(0, 0), (5, 0), (0, 5)]]))\n"
+        "    assert svc.drain(timeout=300)\n"
+        "    stop.set()\n"
+        "    [t.join(timeout=60) for t in threads]\n"
+        "    router.close()\n"
+        "from dbscan_tpu.obs import live\n"
+        "assert live.active()\n"
+        "assert live.state().window_count('serve.query_ms') > 0\n"
+        "print('OK')\n"
+    )
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        DBSCAN_TSAN="1",
+        DBSCAN_TSAN_REPORT=str(report),
+        DBSCAN_FAULT_SPEC="",
+        DBSCAN_OBS_EXPO=str(tmp_path / "live.prom"),
+        DBSCAN_OBS_EXPO_PERIOD_S="0.05",
+        DBSCAN_SLO_QUERY_P99_MS="50",
+        DBSCAN_SLO_EVAL_PERIOD_S="0.05",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout
+    rep = json.load(open(report))
+    assert rep["races"] == []
+    assert rep["lock_inversions"] == []
+    assert (tmp_path / "live.prom").exists()  # the writer ran hot
+
+
+def test_committed_serve_r03_capture_gates_green():
+    """BENCH_SERVE_r03.json (the first capture stamped by the live
+    plane) is in bench/history.jsonl and gates green — and pins the
+    live-vs-offline agreement on a COMMITTED artifact: the windowed
+    p99 the live aggregators reported during the run matches the
+    offline client-side top-rung p99 within AGREEMENT_RTOL."""
+    from dbscan_tpu.obs import bench_history, regress
+
+    cap_path = os.path.join(REPO, "BENCH_SERVE_r03.json")
+    hist_path = os.path.join(REPO, "bench", "history.jsonl")
+    assert os.path.exists(cap_path)
+    cap = json.load(open(cap_path))
+    row = (cap["runs"] if "runs" in cap else [cap])[0]
+    ladder = sorted(
+        int(k[len("serve_r"):-len("_qps")])
+        for k in row if k.startswith("serve_r") and k.endswith("_qps")
+    )
+    top = ladder[-1]
+    # the live plane's stamps ride beside the offline percentiles
+    assert row["serve_windowed_qps"] > 0
+    live_p99 = row["serve_windowed_p99_ms"]
+    offline_p99 = row[f"serve_r{top}_p99_ms"]
+    assert (
+        abs(live_p99 - offline_p99) / offline_p99 <= AGREEMENT_RTOL
+    ), (live_p99, offline_p99)
+    assert 0.0 <= row["serve_shed_frac"] < 1.0
+    recs = bench_history.parse_capture_file(cap_path)
+    metrics = {r["metric"] for r in recs}
+    assert {
+        f"serve_r{top}_qps", "serve_windowed_p99_ms", "serve_shed_frac",
+    } <= metrics
+    history = bench_history.load_history(hist_path)
+    assert [
+        r for r in history if r["metric"] == "serve_windowed_p99_ms"
+    ], "r03 not ingested into the committed history"
+    # gate the LIVE-plane metrics this PR introduced. The offline
+    # serve_r*_qps/_p99_ms family now spans two capture boxes (r02:
+    # multi-core, r03: single-core, where readers starve behind the
+    # ingest thread) — that population is gated by the r02 test
+    # through compare's spread widening; re-gating it here would just
+    # pin the box bimodality twice.
+    live_keys = {
+        "serve_windowed_p99_ms", "serve_windowed_qps", "serve_shed_frac",
+    }
+    recs = [
+        {**r, "source": "fresh-check"}
+        for r in recs if r["metric"] in live_keys
+    ]
+    assert len(recs) == len(live_keys)
+    result = regress.compare(recs, history, threshold=0.25)
+    assert result["regressions"] == []
