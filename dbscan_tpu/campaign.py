@@ -1478,8 +1478,10 @@ def main(argv=None) -> int:
         )
     print(json.dumps(row, indent=2))
     if args.json:
-        with open(args.json, "w") as f:
+        tmp = args.json + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(row, f, indent=2)
+        os.replace(tmp, args.json)  # never leave a torn row behind
     if not result.complete:
         return 1
     if args.verify and row.get("labels_equal") is False:

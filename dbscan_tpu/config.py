@@ -713,6 +713,22 @@ ENV_VARS = _env_table(
         "device faults attribute to the dispatch site.",
     ),
     EnvVar(
+        "DBSCAN_FAULTCHECK", "bool", False,
+        "graftfault runtime cross-check (lint/faultcheck.py): every "
+        "faults.supervised window fingerprints the shared-state "
+        "mutations actually observed (via the tsan site hooks) and "
+        "asserts containment in the static effect model "
+        "(lint/effects.py); violations surface in "
+        "faultcheck.report()/assert_clean().",
+    ),
+    EnvVar(
+        "DBSCAN_FAULTCHECK_REPORT", "str", None,
+        "With DBSCAN_FAULTCHECK=1: path receiving the cross-check's "
+        "JSON report at process exit (how the tier-1 rerun of the "
+        "fault/pipeline suites is asserted violation-free from outside "
+        "the process).",
+    ),
+    EnvVar(
         "DBSCAN_SHAPECHECK", "bool", False,
         "graftshape runtime cross-check (lint/shapecheck.py): every "
         "tracked dispatch validates its concrete arg shapes/dtypes "

@@ -54,6 +54,7 @@ from typing import Callable, Optional, Tuple
 import numpy as np
 
 from dbscan_tpu import config, obs
+from dbscan_tpu.lint import faultcheck as _faultcheck
 from dbscan_tpu.lint import tsan as _tsan
 from dbscan_tpu.obs import flight as _obs_flight
 from dbscan_tpu.obs import live as _obs_live
@@ -81,12 +82,167 @@ SITE_SERVE_REPLICA = "serve_replica"  # router query replicas (serve/router.py)
 SITE_EMBED = "embed"  # embed engine hash/neighbor dispatches (dbscan_tpu/embed)
 SITE_DENSITY_CORE = "density_core"  # density core-distance chunks (density/)
 SITE_DENSITY_BORUVKA = "density_boruvka"  # density Borůvka MST rounds
-_SITES = (
-    SITE_DISPATCH, SITE_BANDED, SITE_SPILL, SITE_SPILL_LEVEL,
-    SITE_STREAM, SITE_PULL, SITE_CELLCC, SITE_CAMPAIGN, SITE_SERVE,
-    SITE_SERVE_REPLICA, SITE_EMBED, SITE_DENSITY_CORE,
-    SITE_DENSITY_BORUVKA, "*",
+@dataclasses.dataclass(frozen=True)
+class SiteSpec:
+    """One declared fault site — the obs/schema.py registration idiom
+    applied to the fault plane. The row IS the contract graftfault
+    (lint/faultsurface.py) enforces: ``owner`` is the consuming module,
+    ``unit`` says what one injection ordinal spans, ``degrade`` is the
+    documented degradation ladder in order, and ``handler`` names the
+    mode(s) through which the ladder is reached —
+
+    - ``fallback-arg``: every supervised call passes ``fallback=``
+      (possibly conditionally None — presence marks the ladder);
+    - ``caller-except``: the call sits inside a degrading try/except
+      (the spill tree's per-node device->host teardown);
+    - ``propagate:<module>``: the FatalDeviceFault escapes to the named
+      module, which catches it (or counts via ``note_degrade``).
+    """
+
+    site: str
+    owner: str
+    unit: str
+    degrade: Tuple[str, ...]
+    handler: Tuple[str, ...]
+    doc: str
+
+
+def _site_table(*rows: SiteSpec) -> dict:
+    return {r.site: r for r in rows}
+
+
+SITES = _site_table(
+    SiteSpec(
+        SITE_DISPATCH, "parallel.driver",
+        "one dense/resident kernel group dispatch",
+        ("retry", "budget-halving", "cpu-tier"), ("fallback-arg",),
+        "Dense partition-group fan-out; persistent faults degrade THAT "
+        "group to the CPU local_dbscan engine.",
+    ),
+    SiteSpec(
+        SITE_BANDED, "parallel.driver",
+        "one banded phase-1 group dispatch",
+        ("retry", "budget-halving", "cpu-tier"), ("fallback-arg",),
+        "Banded phase-1 fan-out; same per-group CPU degradation as "
+        "the dense site.",
+    ),
+    SiteSpec(
+        SITE_SPILL, "parallel.spill_device",
+        "one spill-tree device op (upload/gather/pivots/screen/"
+        "membership/leader-cover)",
+        ("retry", "host-spill"),
+        ("caller-except", "propagate:dbscan_tpu.parallel.spill"),
+        "Per-node spill device ops; the tree tears the node down to "
+        "the host recursion itself (note_degrade).",
+    ),
+    SiteSpec(
+        SITE_SPILL_LEVEL, "parallel.spill_device",
+        "one level-synchronous spill-tree dispatch",
+        ("retry", "host-spill"),
+        ("propagate:dbscan_tpu.parallel.spill",),
+        "Level-synchronous build; a persistent fault degrades the "
+        "WHOLE build to the host recursion.",
+    ),
+    SiteSpec(
+        SITE_STREAM, "streaming",
+        "one streaming micro-batch update",
+        ("retry", "cpu-tier"), ("fallback-arg",),
+        "Whole-batch supervision over train_arrays (pure function of "
+        "host state — idempotent by construction).",
+    ),
+    SiteSpec(
+        SITE_PULL, "parallel.driver",
+        "one pipelined compact-chunk pull",
+        ("retry", "abort-flush-resume"),
+        ("propagate:dbscan_tpu.parallel.driver",),
+        "Chunk pulls on the pipeline worker; exhaustion aborts through "
+        "the driver's chunk-flush path and resumes from checkpoint.",
+    ),
+    SiteSpec(
+        SITE_CELLCC, "parallel.driver",
+        "one device cellcc finalize dispatch",
+        ("retry", "host-oracle"), ("fallback-arg",),
+        "Device cell-CC finalize; persistent faults degrade the whole "
+        "finalize to the host oracle.",
+    ),
+    SiteSpec(
+        SITE_CAMPAIGN, "campaign",
+        "one campaign worker lease",
+        ("lease-requeue", "worker-retire"),
+        ("propagate:dbscan_tpu.campaign",),
+        "Campaign lease consumption (direct ordinal draw, no "
+        "supervised wrap); the harness requeues the lease and retires "
+        "the worker on a fatal.",
+    ),
+    SiteSpec(
+        SITE_SERVE, "serve.service",
+        "one service ingest update",
+        ("retry", "serve-last-epoch"),
+        ("propagate:dbscan_tpu.serve.service",),
+        "Service ingest; a fatal marks the service degraded and the "
+        "query side keeps serving the last good epoch.",
+    ),
+    SiteSpec(
+        SITE_SERVE_REPLICA, "serve.router",
+        "one replica query dispatch",
+        ("retry", "replica-evict-failover"),
+        ("propagate:dbscan_tpu.serve.router",),
+        "Router replica queries; a fatal evicts the replica and fails "
+        "the query over to a healthy one.",
+    ),
+    SiteSpec(
+        SITE_EMBED, "embed",
+        "one embed hash/neighbor dispatch",
+        ("retry", "host-oracle"),
+        ("fallback-arg", "propagate:dbscan_tpu.embed.engine"),
+        "Embed-engine dispatches; bucket faults degrade per-bucket to "
+        "the oracle, hash faults degrade the whole run.",
+    ),
+    SiteSpec(
+        SITE_DENSITY_CORE, "density.core",
+        "one core-distance chunk dispatch",
+        ("retry", "host-oracle"),
+        ("fallback-arg", "propagate:dbscan_tpu.density",),
+        "Density core-distance chunks; per-chunk host fallback, or the "
+        "engine's whole-run oracle degrade.",
+    ),
+    SiteSpec(
+        SITE_DENSITY_BORUVKA, "density.boruvka",
+        "one Borůvka MST round dispatch",
+        ("retry", "host-oracle"),
+        ("propagate:dbscan_tpu.density",),
+        "Borůvka rounds; a persistent fault degrades the whole MST "
+        "build to the host oracle.",
+    ),
 )
+
+_SITES = tuple(SITES) + ("*",)
+
+
+def sites_self_check() -> list:
+    """Registry invariants, schema.self_check()-style: a list of error
+    strings (empty = healthy). Pinned by tests/test_faults.py."""
+    errors = []
+    known_modes = ("fallback-arg", "caller-except")
+    for site, spec in SITES.items():
+        if site != spec.site:
+            errors.append(f"SITES key {site!r} != spec.site {spec.site!r}")
+        if not re.fullmatch(r"[a-z][a-z0-9_]*", site):
+            errors.append(f"site token {site!r} is not a lowercase id")
+        if not spec.degrade:
+            errors.append(f"site {site!r} declares no degrade ladder")
+        if not spec.handler:
+            errors.append(f"site {site!r} declares no handler mode")
+        for mode in spec.handler:
+            if mode not in known_modes and not mode.startswith(
+                "propagate:"
+            ):
+                errors.append(
+                    f"site {site!r}: unknown handler mode {mode!r}"
+                )
+        if not spec.doc.strip():
+            errors.append(f"site {site!r} has no doc")
+    return errors
 
 
 def shard_site(base: str, shard=None) -> str:
@@ -550,7 +706,17 @@ def supervised(
         obs.count("faults.attempts")
         try:
             reg.check(site, ordinal, global_ordinal, attempt)
-            out = attempt_fn(budget)
+            # graftfault cross-check window: fingerprint the shared-
+            # state writes the attempt makes (one truthiness check
+            # when the checker is off — the tsan/obs discipline)
+            if _faultcheck._rt is not None:
+                _faultcheck.begin(site)
+                try:
+                    out = attempt_fn(budget)
+                finally:
+                    _faultcheck.end(site)
+            else:
+                out = attempt_fn(budget)
             if block and out is not None:
                 import jax
 
@@ -657,6 +823,12 @@ def supervised(
             type(last).__name__,
             last,
         )
+        if _faultcheck._rt is not None:
+            _faultcheck.begin(site)
+            try:
+                return fallback()
+            finally:
+                _faultcheck.end(site)
         return fallback()
     obs.event(
         "fault.fatal",

@@ -1,7 +1,8 @@
 """graftlint + graftcheck: AST-based static analysis for TPU hazards,
-telemetry contracts, and concurrency/collective safety.
+telemetry contracts, concurrency/collective safety, and the fault
+surface.
 
-Six rule families over the package source (no execution of the linted
+Eight rule families over the package source (no execution of the linted
 code; the schema/env cross-checks import the DECLARED registries —
 :mod:`dbscan_tpu.obs.schema` and ``config.ENV_VARS`` — not the linted
 files)::
@@ -40,7 +41,19 @@ files)::
   (supersedes ``dtype-drift`` — kept as an alias, :data:`ALIASES`),
   and the per-dispatch-family HBM envelope / shard-divisibility gates
   — validated at runtime by the opt-in shape cross-check
-  (``DBSCAN_SHAPECHECK=1``, lint/shapecheck.py).
+  (``DBSCAN_SHAPECHECK=1``, lint/shapecheck.py);
+- **fault surface** (``fault-retry-unsafe`` /
+  ``fault-site-undeclared`` / ``fault-site-undrilled`` /
+  ``fault-degrade-unreachable`` / ``atomic-write-violation`` —
+  graftfault, lint/faultsurface.py over the lint/effects.py
+  effect-purity interpreter): supervised callables that mutate
+  caller-visible state before their success point, ``supervised(...)``
+  site tokens missing from the declared ``faults.SITES`` registry or
+  lacking a ``DBSCAN_FAULT_SPEC`` drill in tests/, degrade ladders
+  unreachable from their call sites, and persistence writes without
+  the write-tmp-then-``os.replace`` idiom — validated at runtime by
+  the opt-in mutation-fingerprint cross-check (``DBSCAN_FAULTCHECK=1``,
+  lint/faultcheck.py).
 
 Suppress a finding on its line with a REQUIRED reason::
 
@@ -108,6 +121,16 @@ RULES = {
     "device HBM budget under the declared knobs",
     "shard-indivisible": "shard_map input dim not divisible by its "
     "mesh axis size",
+    "fault-retry-unsafe": "supervised callable mutates caller-visible "
+    "state before its success point (a retry double-applies it)",
+    "fault-site-undeclared": "supervised()/next_ordinal() site token "
+    "not declared in faults.SITES",
+    "fault-site-undrilled": "declared fault site consumed in product "
+    "code with no DBSCAN_FAULT_SPEC drill in tests/",
+    "fault-degrade-unreachable": "supervised call reaching none of its "
+    "site's declared degrade handler modes",
+    "atomic-write-violation": "file opened for writing without the "
+    "write-tmp-then-os.replace idiom (append mode exempt)",
     "suppress-no-reason": "graftlint suppression without a reason text",
     "suppress-unknown-rule": "graftlint suppression naming an unknown "
     "rule id",
@@ -134,6 +157,7 @@ def _rule_fns():
     from dbscan_tpu.lint import (
         collectives,
         envvars,
+        faultsurface,
         hostsync,
         races,
         recompile,
@@ -149,6 +173,7 @@ def _rule_fns():
         races.check,
         collectives.check,
         shapes.check,
+        faultsurface.check,
     )
 
 

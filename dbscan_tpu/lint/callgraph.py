@@ -174,6 +174,9 @@ class ModuleInfo:
         self.module_globals: Set[str] = set()
         #: module-global locks: name -> reentrant? (threading/tsan ctors)
         self.lock_globals: Dict[str, bool] = {}
+        #: module globals assigned ``threading.local()`` — per-thread
+        #: state, exempt from the shared-write rules
+        self.tls_globals: Set[str] = set()
 
     def resolve_scoped(
         self, name: str, scope_chain: List[ast.AST]
@@ -385,6 +388,8 @@ def _index_globals(mod: ModuleInfo) -> None:
             reentrant = _lock_ctor(value) if value is not None else None
             if reentrant is not None:
                 mod.lock_globals[t.id] = reentrant
+            elif value is not None and _is_tls_ctor(value):
+                mod.tls_globals.add(t.id)
             elif isinstance(value, ast.Constant) and isinstance(
                 value.value, str
             ):
@@ -546,6 +551,37 @@ def local_types(cg: CallGraph, info: FuncInfo) -> Dict[str, ClassInfo]:
     if info.owner_class is not None:
         types.setdefault("self", info.owner_class)
         types.setdefault("cls", info.owner_class)
+    # Closure variables: a nested def reads the enclosing frame's
+    # locals, so inherit the outer frame's inferred types for names
+    # this frame neither takes as a parameter nor binds itself.
+    scope = info.scope_node
+    if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        outer = next(
+            (f for f in info.module.all_functions if f.node is scope), None
+        )
+        if outer is not None:
+            bound: Set[str] = set(types)
+            if args is not None:
+                for a in (
+                    list(getattr(args, "posonlyargs", []))
+                    + list(args.args)
+                    + list(args.kwonlyargs)
+                ):
+                    bound.add(a.arg)
+                for va in (args.vararg, args.kwarg):
+                    if va is not None:
+                        bound.add(va.arg)
+            for node in walk_scope(info.node):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            bound.add(tgt.id)
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    if isinstance(node.target, ast.Name):
+                        bound.add(node.target.id)
+            for name, t in local_types(cg, outer).items():
+                if name not in bound:
+                    types.setdefault(name, t)
     for _ in range(2):
         for node in walk_scope(info.node):
             if (
@@ -681,6 +717,9 @@ def callable_argument(
             f"{info.qualname}.<lambda>",
             info.node,
         )
+        # a lambda in a method closes over the method's self: carry the
+        # owner class so `self.<attr>` chains type-resolve in its body
+        fi.owner_class = info.owner_class
         cg.func_of_node[id(expr)] = fi
         info.module.all_functions.append(fi)
         return fi

@@ -70,6 +70,8 @@ def _baseline_key(f) -> tuple:
 
 
 def _write_baseline(path: str, findings) -> None:
+    import os
+
     payload = {
         "version": _BASELINE_VERSION,
         "findings": [
@@ -81,9 +83,14 @@ def _write_baseline(path: str, findings) -> None:
             for f in findings
         ],
     }
-    with open(path, "w", encoding="utf-8") as fh:
+    # tmp-then-replace: a run killed mid-write must not leave a
+    # truncated baseline silently un-gating CI (atomic-write-violation
+    # discipline — this CLI is linted by its own rule)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(payload, fh, indent=1, sort_keys=True)
         fh.write("\n")
+    os.replace(tmp, path)
 
 
 def _read_baseline(path: str) -> dict:
@@ -238,6 +245,13 @@ def main(argv=None) -> int:
         "footprint table generated from lint/shapes.py FAMILY_MODELS "
         "and the live budget knobs, and exit",
     )
+    p.add_argument(
+        "--fault-table",
+        action="store_true",
+        help="print the PARITY.md fault-surface table generated from "
+        "faults.SITES and the statically-resolved supervised "
+        "consumptions/drills, and exit",
+    )
     args = p.parse_args(argv)
 
     if args.list_rules:
@@ -257,6 +271,11 @@ def main(argv=None) -> int:
         from dbscan_tpu.lint.shapes import shape_table
 
         print(shape_table())
+        return 0
+    if args.fault_table:
+        from dbscan_tpu.lint.faultsurface import fault_table
+
+        print(fault_table())
         return 0
 
     # a glob matches a rule through its current id OR a retired alias

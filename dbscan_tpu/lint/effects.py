@@ -1,0 +1,805 @@
+"""graftfault effect model: caller-visible-effect abstract interpreter.
+
+The fault plane's retry contract (dbscan_tpu/faults.py) is behavioral:
+the callable handed to ``supervised(site, fn)`` must be IDEMPOTENT —
+re-running it from the top after a partial execution must land the same
+final state, because a transient fault retries it and a budget halving
+re-enters it. PR 5 established the discipline by hand for
+``driver._pull_record`` ("the record is NOT mutated until every pull
+succeeded"); this module makes it checkable for every site.
+
+Per function we compute an ordered list of EVENTS over the body's own
+frame (nested defs/lambdas are separate frames, joined at their call
+sites):
+
+- **mutations** of some root expression, flavored:
+  ``store`` (plain ``x.a = v`` / ``x[k] = v`` — idempotent when the
+  value does not read the mutated root), ``augment`` (``+=`` or a
+  store whose RHS reads the root — NOT idempotent), ``mutator``
+  (``.append``/``.pop``/... — NOT idempotent), ``del``, ``file-write``
+  (``open(p, "w")``) and ``file-append`` (mode ``"a"``).
+- **fallible** operations — the ops a device fault can surface from:
+  jax-module calls (``jnp.*``/``jax.*``/``lax.*`` through the import
+  maps), ``tracked_call`` dispatches, jitted-name calls, device syncs
+  (``block_until_ready``/``device_get``/``device_put``/``.item``/
+  ``copy_to_host_async``), nested ``faults.supervised``, and any
+  resolved callee that transitively contains one.
+- **tsan sites** — ``tsan.access("<site>")`` literals, the observable
+  mutation vocabulary the runtime half (lint/faultcheck.py) fingerprints
+  supervised execution against.
+
+The **success point** of a frame is its last fallible event: mutations
+strictly after it (and not sharing a loop with a fallible event) are
+post-success and retry-safe; everything else is pre-success.
+
+Roots classify as in the race rules (lint/races.py):
+
+- ``local`` — created in the frame: ownership, exempt;
+- ``param`` — ownership transfer (objects handed TO the callable are
+  the caller's gift — ``_pull_record(rec)``'s record), exempt at the
+  top frame but tracked for interprocedural mapping;
+- ``self`` / ``global`` / ``closure`` — caller-visible.
+
+Documented exemptions (the PARITY.md "Fault surface contract"):
+
+- **telemetry**: calls into ``dbscan_tpu.obs.*``, ``lint/tsan.py``,
+  ``lint/faultcheck.py``, ``logging``, and ``dbscan_tpu.faults``'s own
+  accounting (FaultCounters / registry bookkeeping) carry no modeled
+  effects — counters are monotone diagnostics, not results;
+- **wall-clock accounting**: an augment whose RHS reads
+  ``perf_counter``/``monotonic``/``time.time`` is timing telemetry;
+- **failure paths**: effects inside ``except`` handlers run only after
+  the attempt already failed — they are the abort protocol;
+- **locks / thread-locals**: acquiring ``self._mu`` or writing a
+  ``threading.local()`` attr is not a caller-visible result;
+- **``__init__``**: the object under construction is not yet shared;
+- **memoization caches**: module-global registries following the
+  ``*_CACHE`` naming convention (driver's resident cache) — retries
+  re-land the same keyed entries;
+- **append-mode files**: ledgers/logs by the atomic-write contract;
+  their readers reconcile duplicate rows (bench history, progress);
+- **convergent guards**: a mutation under an ``if`` whose test reads
+  the mutated state (``if _engine is None: _engine = ...``) — re-entry
+  re-evaluates the guard and skips the already-applied arm (the
+  singleton-lifecycle idiom; self-rooted effects demand the guard read
+  the same attribute);
+- **restore-prologue**: a callable whose FIRST statement calls
+  ``<root>.restore_state(...)`` is re-entrant by construction for
+  mutations of ``<root>`` — each attempt re-enters from the snapshot
+  (the serve ingest idempotence fix rides this idiom).
+
+Interprocedural composition: a resolved call imports the callee's
+summary at the call position. Callee self-mutations map through the
+receiver expression's root in the caller (``trial.update()`` on a local
+is ownership; ``self._stream.update()`` through a closure-captured
+``self`` is caller-visible); callee param-mutations map through the
+argument expressions the same way. Callee mutations that were
+PRE-success in the callee's own frame stay pre-success at any call site
+reached by a retry (the callee's own fallible op can fault after them);
+post-success callee mutations inherit the call site's position.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from dbscan_tpu.lint import callgraph as cg_mod
+from dbscan_tpu.lint.callgraph import (
+    CallGraph,
+    FuncInfo,
+    callable_argument,
+    local_types,
+    resolve_callable,
+    terminal_name,
+)
+
+# mutator method names (the races.py set): receiver mutated in place
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "pop", "popitem",
+    "clear", "remove", "discard", "setdefault", "appendleft", "popleft",
+    "extendleft",
+}
+
+# device-sync / host-pull attribute calls: a real device fault surfaces
+# here even when the dispatch itself was async
+_DEVICE_SYNC_ATTRS = {
+    "block_until_ready", "device_get", "device_put", "item",
+    "copy_to_host_async", "pull_to_host",
+}
+
+# telemetry-plane modules: calls into them carry no modeled effects
+_TELEMETRY_MODULES = (
+    "dbscan_tpu.obs",
+    "dbscan_tpu.lint.tsan",
+    "dbscan_tpu.lint.faultcheck",
+    "dbscan_tpu.faults",
+    "logging",
+)
+
+# unresolved receiver aliases treated as telemetry (the instrumented
+# modules import them under these names)
+_TELEMETRY_ALIASES = {
+    "obs", "obs_live", "obs_memory", "obs_compile", "obs_flight",
+    "_obs_live", "_obs_memory", "_obs_flight", "logger", "logging",
+    "tsan", "_tsan", "faults", "counters",
+}
+
+_TIME_FNS = {"perf_counter", "monotonic", "time", "process_time"}
+
+
+def _root_name(expr: ast.AST) -> Optional[str]:
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _attr_chain(expr: ast.AST) -> str:
+    """Dotted/bracketed rendering of a mutation target for messages."""
+    parts: List[str] = []
+    while True:
+        if isinstance(expr, ast.Attribute):
+            parts.append("." + expr.attr)
+            expr = expr.value
+        elif isinstance(expr, ast.Subscript):
+            parts.append("[...]")
+            expr = expr.value
+        elif isinstance(expr, ast.Name):
+            parts.append(expr.id)
+            break
+        else:
+            parts.append("<expr>")
+            break
+    return "".join(reversed(parts))
+
+
+class Effect:
+    """One caller-visible mutation candidate inside a frame."""
+
+    __slots__ = (
+        "flavor", "root_kind", "root", "target", "line", "pos",
+        "loops", "pre", "via", "guarded",
+    )
+
+    def __init__(self, flavor, root_kind, root, target, line, pos, loops):
+        self.flavor = flavor  # store|augment|mutator|del|file-write|file-append
+        self.root_kind = root_kind  # local|param|self|global|closure
+        self.root = root  # root simple name ("self", "counters", ...)
+        self.target = target  # rendered chain for the finding message
+        self.line = line
+        self.pos = pos  # walk-order position in the frame
+        self.loops = loops  # frozenset of enclosing loop ids
+        self.pre = False  # before the frame's success point?
+        self.via = ""  # callee qualname when imported from a summary
+        self.guarded = False  # under a convergent check-then-act guard?
+
+    def idempotent(self) -> bool:
+        return self.flavor in ("store", "file-write")
+
+
+class FrameModel:
+    """One function frame's ordered events + interprocedural summary."""
+
+    __slots__ = (
+        "info", "effects", "fallible", "tsan_sites", "self_pre",
+        "self_post", "global_pre", "global_post", "param_pre",
+        "param_post", "is_fallible", "file_writes",
+    )
+
+    def __init__(self, info: FuncInfo):
+        self.info = info
+        self.effects: List[Effect] = []  # every recorded mutation
+        self.fallible: List[Tuple[int, frozenset]] = []  # (pos, loops)
+        self.tsan_sites: Set[str] = set()
+        self.is_fallible = False
+        # summary: non-idempotent mutation descriptors by root class,
+        # split at the frame's own success point
+        self.self_pre: List[Effect] = []
+        self.self_post: List[Effect] = []
+        self.global_pre: List[Effect] = []
+        self.global_post: List[Effect] = []
+        self.param_pre: List[Effect] = []
+        self.param_post: List[Effect] = []
+        self.file_writes: List[Effect] = []
+
+
+def _frame_locals(node: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(locally bound names, explicitly nonlocal/global names) for one
+    frame — scope-bounded, nested defs excluded."""
+    binds: Set[str] = set()
+    outer: Set[str] = set()
+    args = getattr(node, "args", None)
+    if args is not None:
+        for a in (
+            list(args.posonlyargs) + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            binds.add(a.arg)
+    for n in cg_mod.walk_scope(node):
+        if isinstance(n, (ast.Global, ast.Nonlocal)):
+            outer.update(n.names)
+        elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            binds.add(n.id)
+        elif isinstance(n, (ast.Import, ast.ImportFrom)):
+            for al in n.names:
+                binds.add((al.asname or al.name).split(".")[0])
+        elif isinstance(n, ast.ExceptHandler) and n.name:
+            binds.add(n.name)
+    return binds - outer, outer
+
+
+def _param_names(node: ast.AST) -> Set[str]:
+    args = getattr(node, "args", None)
+    if args is None:
+        return set()
+    out = set()
+    for a in (
+        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    ):
+        out.add(a.arg)
+    return out
+
+
+class EffectModel:
+    """Memoized per-function frame models over one callgraph."""
+
+    def __init__(self, cg: CallGraph):
+        self.cg = cg
+        self._frames: Dict[int, FrameModel] = {}
+        self._in_progress: Set[int] = set()
+
+    # --- classification helpers ---------------------------------------
+
+    def _root_kind(
+        self, info: FuncInfo, name: str, binds: Set[str]
+    ) -> str:
+        if name == "self" and info.owner_class is not None:
+            return "self"
+        if name in _param_names(info.node):
+            return "param"
+        if name in binds:
+            return "local"
+        # free variable: module-global if the module binds it, else a
+        # closure capture from an enclosing frame — both caller-visible
+        if name in info.module.module_globals:
+            return "global"
+        if name in info.module.functions or name in info.module.classes:
+            return "local"  # rebinding a function name is not state
+        return "closure"
+
+    def _is_jax_alias(self, info: FuncInfo, name: str) -> bool:
+        mod = info.module
+        target = mod.import_alias.get(name)
+        if target is None and name in mod.from_names:
+            target = mod.from_names[name][0]
+        return bool(target) and (
+            target == "jax" or target.startswith("jax.")
+        )
+
+    def _telemetry_callee(self, callee: Optional[FuncInfo]) -> bool:
+        if callee is None:
+            return False
+        modname = callee.module.modname
+        return any(
+            modname == t or modname.startswith(t + ".")
+            for t in _TELEMETRY_MODULES
+        )
+
+    def _telemetry_call(self, info: FuncInfo, call: ast.Call) -> bool:
+        f = call.func
+        root = _root_name(f) if isinstance(f, ast.Attribute) else None
+        if root is not None and root in _TELEMETRY_ALIASES:
+            return True
+        if isinstance(f, ast.Name) and f.id in ("note_degrade",):
+            return True
+        # self._counters.add(...) style: terminal telemetry verbs on a
+        # chain ending in a counters-ish attr stay un-modeled
+        if isinstance(f, ast.Attribute) and isinstance(
+            f.value, ast.Attribute
+        ):
+            if f.value.attr in ("counters", "metrics", "_metrics"):
+                return True
+        return False
+
+    def _fallible_call(
+        self, info: FuncInfo, call: ast.Call, types
+    ) -> bool:
+        f = call.func
+        tname = terminal_name(f)
+        if tname in ("tracked_call", "supervised"):
+            return True
+        if tname in _DEVICE_SYNC_ATTRS:
+            return True
+        if isinstance(f, ast.Attribute):
+            root = _root_name(f)
+            if root is not None and self._is_jax_alias(info, root):
+                return True
+        if isinstance(f, ast.Name):
+            if self._is_jax_alias(info, f.id):
+                return True
+            key = (info.path, f.id)
+            if key in self.cg.jitted_names:
+                return True
+        return False
+
+    # --- the per-frame walk -------------------------------------------
+
+    def frame(self, info: FuncInfo) -> FrameModel:
+        key = id(info.node)
+        got = self._frames.get(key)
+        if got is not None:
+            return got
+        if key in self._in_progress:
+            return FrameModel(info)  # cycle: optimistic empty summary
+        self._in_progress.add(key)
+        try:
+            fm = self._build(info)
+            self._frames[key] = fm
+            return fm
+        finally:
+            self._in_progress.discard(key)
+
+    def _restore_roots(self, info: FuncInfo) -> Set[str]:
+        """Roots covered by a restore-prologue: the frame's first
+        statement is ``<root chain>.restore_state(...)`` (or
+        ``restore``) — each attempt re-enters from the snapshot, so
+        mutations of that root are re-entrant by construction."""
+        body = getattr(info.node, "body", None)
+        if not isinstance(body, list) or not body:
+            return set()
+        first = body[0]
+        if not (
+            isinstance(first, ast.Expr)
+            and isinstance(first.value, ast.Call)
+        ):
+            return set()
+        f = first.value.func
+        if isinstance(f, ast.Attribute) and f.attr in (
+            "restore_state", "restore"
+        ):
+            root = _root_name(f)
+            if root is not None:
+                return {root}
+        return set()
+
+    def _build(self, info: FuncInfo) -> FrameModel:
+        fm = FrameModel(info)
+        node = info.node
+        binds, _outer = _frame_locals(node)
+        types = local_types(self.cg, info)
+        restore_roots = self._restore_roots(info)
+        is_init = getattr(node, "name", "") == "__init__"
+        tls = (
+            info.owner_class.tls_attrs if info.owner_class else set()
+        )
+        pos = 0
+        loop_stack: List[int] = []
+        if_stack: List[ast.AST] = []
+        except_depth = 0
+
+        def guard_matches(root: str, target: str) -> bool:
+            """Is some enclosing ``if`` test reading the mutated state?
+            Check-then-act on the same root converges under re-entry
+            (``if _engine is None: _engine = ...`` — the retry
+            re-evaluates the guard and skips the already-applied arm).
+            Self-rooted effects demand the test read the same first
+            attribute, or ``if self:`` would exempt every method."""
+            first_attr = None
+            if target.startswith(root + "."):
+                rest = target[len(root) + 1:]
+                first_attr = rest.split(".", 1)[0].split("[", 1)[0]
+            for test in if_stack:
+                for sub in ast.walk(test):
+                    if root == "self" or first_attr is not None:
+                        if (
+                            isinstance(sub, ast.Attribute)
+                            and sub.attr == first_attr
+                            and isinstance(sub.value, ast.Name)
+                            and sub.value.id == root
+                        ):
+                            return True
+                        if root != "self" and isinstance(
+                            sub, ast.Name
+                        ) and sub.id == root:
+                            return True
+                    elif isinstance(sub, ast.Name) and sub.id == root:
+                        return True
+            return False
+
+        def classify_target(tgt: ast.AST, flavor: str, line: int):
+            root = _root_name(tgt)
+            if root is None:
+                return
+            if root in info.module.tls_globals:
+                return  # threading.local(): per-thread scratch
+            kind = self._root_kind(info, root, binds)
+            if kind == "self":
+                if is_init:
+                    return
+                # self.<tls_attr> is per-thread scratch
+                t = tgt
+                while isinstance(t, ast.Subscript):
+                    t = t.value
+                if isinstance(t, ast.Attribute) and t.attr in tls:
+                    return
+            if root in restore_roots or (
+                kind == "self"
+                and "self" in restore_roots
+            ):
+                return
+            eff = Effect(
+                flavor, kind, root, _attr_chain(tgt), line, pos,
+                frozenset(loop_stack),
+            )
+            eff.guarded = guard_matches(root, eff.target)
+            fm.effects.append(eff)
+
+        def add_fallible():
+            fm.fallible.append((pos, frozenset(loop_stack)))
+            fm.is_fallible = True
+
+        def import_summary(
+            callee_fm: FrameModel, call: ast.Call, self_recv="func"
+        ):
+            """Map a resolved callee's summary into this frame at the
+            call position. ``self_recv`` is where the callee's
+            self-mutations land: the call func's receiver (default), an
+            explicit expression (callable arguments land through the
+            ARGUMENT's receiver — ``Thread(target=self._worker)``
+            mutates ``self``, not ``Thread``), or ``"drop"`` when no
+            receiver is resolvable (conservative)."""
+            if callee_fm.is_fallible:
+                add_fallible()
+            f = call.func
+            if self_recv == "func":
+                recv = f.value if isinstance(f, ast.Attribute) else None
+            elif self_recv == "drop":
+                recv = "drop"
+            else:
+                recv = self_recv
+
+            def land(eff: Effect, tgt_expr, callee_pre: bool):
+                if tgt_expr == "drop":
+                    return
+                if tgt_expr is None:
+                    # global/closure roots keep their name, but the
+                    # KIND reclassifies in this frame: a callee-closure
+                    # root bound HERE is this frame's own local
+                    root, target = eff.root, eff.target
+                    if root in info.module.tls_globals:
+                        return
+                    kind = self._root_kind(info, root, binds)
+                    if eff.root_kind == "global" and kind == "closure":
+                        kind = "global"  # defined in the callee's module
+                else:
+                    root = _root_name(tgt_expr)
+                    if root is None:
+                        return
+                    kind = self._root_kind(info, root, binds)
+                    target = (
+                        _attr_chain(tgt_expr)
+                        + "." + eff.target.split(".", 1)[-1]
+                        if "." in eff.target
+                        else _attr_chain(tgt_expr)
+                    )
+                if kind in ("local",):
+                    return  # ownership: the caller made this object
+                if root in restore_roots:
+                    return
+                e2 = Effect(
+                    eff.flavor, kind, root, target,
+                    call.lineno, pos, frozenset(loop_stack),
+                )
+                e2.via = callee_fm.info.qualname
+                # convergent either in the callee's own frame or by a
+                # check-then-act guard around this call site
+                e2.guarded = eff.guarded or guard_matches(root, target)
+                if callee_pre:
+                    e2.pre = True  # sticky: pre in the callee's frame
+                fm.effects.append(e2)
+
+            # callee self-mutations attach to the receiver expression
+            for eff in callee_fm.self_pre:
+                land(eff, recv, True)
+            for eff in callee_fm.self_post:
+                land(eff, recv, False)
+            # callee global/closure mutations are caller-visible as-is
+            for eff in callee_fm.global_pre:
+                land(eff, None, True)
+            for eff in callee_fm.global_post:
+                land(eff, None, False)
+            # callee param-mutations map through the argument exprs
+            callee_params = sorted(_param_names(callee_fm.info.node))
+            pmap = {}
+            args_list = getattr(callee_fm.info.node, "args", None)
+            ordered = (
+                [a.arg for a in args_list.posonlyargs + args_list.args]
+                if args_list is not None
+                else callee_params
+            )
+            skip_self = bool(
+                callee_fm.info.owner_class is not None
+                and ordered
+                and ordered[0] == "self"
+            )
+            if skip_self:
+                ordered = ordered[1:]
+            for i, a in enumerate(call.args):
+                if i < len(ordered):
+                    pmap[ordered[i]] = a
+            for kw in call.keywords:
+                if kw.arg:
+                    pmap[kw.arg] = kw.value
+            for eff, callee_pre in [
+                (e, True) for e in callee_fm.param_pre
+            ] + [(e, False) for e in callee_fm.param_post]:
+                tgt = pmap.get(eff.root)
+                if tgt is not None:
+                    land(eff, tgt, callee_pre)
+            fm.tsan_sites.update(callee_fm.tsan_sites)
+            for eff in callee_fm.file_writes:
+                e2 = Effect(
+                    eff.flavor, "global", eff.root, eff.target,
+                    call.lineno, pos, frozenset(loop_stack),
+                )
+                e2.via = callee_fm.info.qualname
+                e2.pre = eff.pre
+                fm.effects.append(e2)
+
+        def visit(n: ast.AST):
+            nonlocal pos, except_depth
+            pos += 1
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and n is not node:
+                return  # separate frame
+            if isinstance(n, ast.ExceptHandler):
+                # failure-path effects are the abort protocol: the
+                # attempt already failed, the retry has not re-run yet
+                except_depth += 1
+                for c in ast.iter_child_nodes(n):
+                    visit(c)
+                except_depth -= 1
+                return
+            if isinstance(n, (ast.For, ast.AsyncFor, ast.While)):
+                loop_stack.append(id(n))
+                for c in ast.iter_child_nodes(n):
+                    visit(c)
+                loop_stack.pop()
+                return
+            if isinstance(n, ast.If):
+                if_stack.append(n.test)
+                for c in ast.iter_child_nodes(n):
+                    visit(c)
+                if_stack.pop()
+                return
+            if except_depth == 0:
+                self._visit_effect(
+                    n, info, fm, binds, types, classify_target,
+                    add_fallible, import_summary,
+                )
+            for c in ast.iter_child_nodes(n):
+                visit(c)
+
+        body = getattr(node, "body", None)
+        stmts = body if isinstance(body, list) else [node.body]
+        for stmt in stmts:
+            visit(stmt)
+        # direct tsan-access literals, UNCONDITIONALLY (failure-path
+        # handlers still execute inside a supervised window, so their
+        # writes belong in the runtime containment model)
+        for n in cg_mod.walk_scope(node):
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "access"
+                and isinstance(n.func.value, ast.Name)
+                and "tsan" in n.func.value.id
+                and n.args
+                and isinstance(n.args[0], ast.Constant)
+                and isinstance(n.args[0].value, str)
+            ):
+                fm.tsan_sites.add(n.args[0].value)
+        self._summarize(fm)
+        return fm
+
+    def _visit_effect(
+        self, n, info, fm, binds, types, classify_target,
+        add_fallible, import_summary,
+    ):
+        if isinstance(n, ast.Assign):
+            for tgt in n.targets:
+                self._classify_store(tgt, n.value, classify_target, n)
+        elif isinstance(n, ast.AnnAssign) and n.value is not None:
+            self._classify_store(n.target, n.value, classify_target, n)
+        elif isinstance(n, ast.AugAssign):
+            if not self._timing_rhs(n.value):
+                classify_target(n.target, "augment", n.lineno)
+        elif isinstance(n, ast.Delete):
+            for tgt in n.targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    classify_target(tgt, "del", n.lineno)
+        elif isinstance(n, ast.Call):
+            self._classify_call(
+                n, info, fm, types, classify_target, add_fallible,
+                import_summary,
+            )
+
+    def _classify_store(self, tgt, value, classify_target, stmt):
+        if isinstance(tgt, ast.Name):
+            return  # local (re)bind — scope bookkeeping, not an effect
+        if isinstance(tgt, ast.Tuple):
+            for el in tgt.elts:
+                self._classify_store(el, value, classify_target, stmt)
+            return
+        if not isinstance(tgt, (ast.Attribute, ast.Subscript)):
+            return
+        root = _root_name(tgt)
+        flavor = "store"
+        if root is not None:
+            for sub in ast.walk(value):
+                if isinstance(sub, ast.Name) and sub.id == root:
+                    flavor = "augment"  # x.a = f(x.a): reads the root
+                    break
+        classify_target(tgt, flavor, stmt.lineno)
+
+    def _timing_rhs(self, value: ast.AST) -> bool:
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                t = terminal_name(sub.func)
+                if t in _TIME_FNS:
+                    return True
+        return False
+
+    def _classify_call(
+        self, call, info, fm, types, classify_target, add_fallible,
+        import_summary,
+    ):
+        f = call.func
+        tname = terminal_name(f)
+        # tsan site literals: the observable mutation vocabulary
+        if (
+            tname == "access"
+            and isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and "tsan" in f.value.id
+            and call.args
+            and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, str)
+        ):
+            fm.tsan_sites.add(call.args[0].value)
+            return
+        if self._telemetry_call(info, call):
+            return
+        if tname in ("acquire", "release", "wait", "notify",
+                     "notify_all", "set", "is_set"):
+            return  # lock/event protocol, not a result
+        # file writes: open(path, "w"/"a")
+        if isinstance(f, ast.Name) and f.id == "open":
+            mode = None
+            if len(call.args) >= 2 and isinstance(
+                call.args[1], ast.Constant
+            ):
+                mode = call.args[1].value
+            for kw in call.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if isinstance(mode, str) and (
+                "w" in mode or "a" in mode or "x" in mode or "+" in mode
+            ):
+                flavor = "file-append" if "a" in mode else "file-write"
+                eff = Effect(
+                    flavor, "global", "open",
+                    ast.unparse(call.args[0]) if call.args else "<path>",
+                    call.lineno, 0, frozenset(),
+                )
+                fm.effects.append(eff)
+                fm.file_writes.append(eff)
+            return
+        if self._fallible_call(info, call, types):
+            add_fallible()
+            # a nested supervised's attempt callable is that frame's
+            # own contract; don't double-import it here
+            if tname == "supervised":
+                return
+        # mutator method on a receiver chain
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in _MUTATORS
+            and isinstance(f.value, (ast.Name, ast.Attribute,
+                                     ast.Subscript))
+        ):
+            callee = resolve_callable(self.cg, info, f, types)
+            if callee is None:
+                classify_target(f.value, "mutator", call.lineno)
+                return
+        # resolved repo callee: import its summary
+        callee = resolve_callable(self.cg, info, f, types)
+        if callee is not None and not self._telemetry_callee(callee):
+            import_summary(self.frame(callee), call)
+        # callable arguments (thunks handed onward) run here too: their
+        # self-effects land through the ARGUMENT's receiver (a bound
+        # method mutates its own object, not the accepting callee)
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            fi = callable_argument(self.cg, info, arg, types)
+            if fi is not None and fi.node is not info.node:
+                if not self._telemetry_callee(fi):
+                    recv = (
+                        arg.value
+                        if isinstance(arg, ast.Attribute)
+                        else "drop"
+                    )
+                    import_summary(self.frame(fi), call, self_recv=recv)
+
+    # --- success-point split ------------------------------------------
+
+    def _summarize(self, fm: FrameModel) -> None:
+        fallible = fm.fallible
+        for eff in fm.effects:
+            if not eff.pre:
+                eff.pre = any(
+                    fpos > eff.pos or (floops & eff.loops)
+                    for fpos, floops in fallible
+                )
+            bucket = {
+                "self": (fm.self_pre, fm.self_post),
+                "param": (fm.param_pre, fm.param_post),
+                "global": (fm.global_pre, fm.global_post),
+                "closure": (fm.global_pre, fm.global_post),
+            }.get(eff.root_kind)
+            if bucket is None:
+                continue
+            if eff.idempotent() and not eff.pre:
+                continue  # post-success stores never matter upstream
+            (bucket[0] if eff.pre else bucket[1]).append(eff)
+
+
+def unsafe_mutations(model: EffectModel, info: FuncInfo) -> List[Effect]:
+    """The fault-retry-unsafe verdict for one supervised callable:
+    caller-visible, non-idempotent (or callee-pre-success) mutations
+    before the frame's success point."""
+    fm = model.frame(info)
+    out = []
+    for eff in fm.effects:
+        if eff.root_kind in ("local", "param"):
+            continue  # ownership / ownership transfer
+        if not eff.pre:
+            continue
+        if eff.idempotent():
+            # a pre-success keyed/plain store re-runs to the same value
+            # on retry (the repo's determinism bar: attempts are
+            # reproducible), and a whole-file rewrite re-lands the same
+            # content — direct or via a callee
+            continue
+        if eff.flavor == "file-append":
+            # append-mode artifacts are ledgers/logs by the atomic-write
+            # contract; their readers reconcile duplicates (bench
+            # history, progress ledger)
+            continue
+        if eff.root.endswith("_CACHE"):
+            # memoization registries (the *_CACHE module-global naming
+            # convention, e.g. driver._RESIDENT_CACHE): re-populating a
+            # keyed cache on retry lands the same entries
+            continue
+        if eff.guarded:
+            # check-then-act convergence: an enclosing `if` reads the
+            # mutated state, so re-entry re-evaluates the guard and the
+            # already-applied arm is skipped (the get_engine singleton
+            # lifecycle idiom)
+            continue
+        out.append(eff)
+    return out
+
+
+def callable_tsan_sites(model: EffectModel, info: FuncInfo) -> Set[str]:
+    """Transitive tsan-access literals reachable from one callable —
+    the static half of the faultcheck containment test."""
+    roots = [info]
+    closure = cg_mod.reach_closure(model.cg, roots)
+    sites: Set[str] = set()
+    for fi in closure.values():
+        sites.update(model.frame(fi).tsan_sites)
+    return sites

@@ -1,0 +1,370 @@
+"""graftfault runtime cross-check: validate the static effect model
+against a real supervised run.
+
+The static rules (``lint/faultsurface.py`` over ``lint/effects.py``)
+reason about what a ``faults.supervised(site, fn)`` callable may mutate;
+this module watches the same contract AT RUNTIME so the two check each
+other: when ``DBSCAN_FAULTCHECK=1`` (or a test calls :func:`enable`),
+every supervised window records the shared-state WRITE accesses the
+tsan site hooks observe on the executing thread and asserts
+
+- **mutation containment**: the per-site observed mutation set must be
+  a subset of the static effect model's reachable tsan sites for that
+  site's supervised callables (plus :data:`FAULTS_BASELINE` — the
+  registry/counter state the supervision machinery itself touches when
+  windows nest). An observed write the model cannot explain is a
+  violation: either the callable grew an effect the analyzer missed
+  (fix the model — that IS the registration step) or a retry-safety
+  bug shipped;
+- **retry idempotence** (test-driven): on injected-transient drills the
+  suite compares :func:`fingerprint` of a faulted run against the
+  no-fault run's — equal mutation SETS mean the retry re-applied only
+  what the clean path applies (tests/test_faultcheck.py).
+
+Attribution is per-thread: a window records the accesses made by the
+thread executing the attempt (and any telemetry those calls make on
+that thread). Nested windows each record — an outer site's model
+reaches the inner callable transitively, so containment composes.
+
+Overhead contract (same discipline as tsan/shapecheck): the DISABLED
+path is one module-global truthiness check per supervised attempt and
+per tsan write access; enabling costs a thread-local set-add per write
+plus a lock merge per window. The static model is parsed lazily at the
+first report/assert, never on the hot path.
+
+Reports: :func:`report` (dict), :func:`assert_clean` (raises on any
+containment violation), and — under ``DBSCAN_FAULTCHECK_REPORT=path``
+— an atexit JSON dump, which is how the tier-1 rerun of the fault +
+pipeline suites asserts an empty violation report from outside the
+process. :func:`emit_telemetry` publishes the declared ``faultcheck.*``
+counters/events when obs is enabled.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from dbscan_tpu import config
+
+_rt: Optional["FaultcheckRuntime"] = None
+
+#: tsan sites the supervision machinery itself touches inside a window
+#: (nested supervised calls tick the registry and counters): always
+#: allowed, never evidence of a callable-side effect.
+FAULTS_BASELINE = frozenset(
+    {"faults.registry", "faults.registry_state", "faults.counters"}
+)
+
+# thread-local stack of open supervised windows (each frame collects
+# the write accesses observed while it is open)
+_tls = threading.local()
+
+#: site -> frozenset of statically-reachable tsan sites, or None when
+#: the site has no statically-resolvable supervised callable (e.g. the
+#: router's replica site, whose callable arrives as an argument).
+#: Computed lazily from the installed package; process-cached.
+_static_cache: Optional[Dict[str, Optional[frozenset]]] = None
+
+
+def _base_site(site: str) -> str:
+    """Strip the ``@shard`` suffix so fingerprints aggregate per base
+    site (faults.shard_site composes ``base@N``)."""
+    return site.split("@", 1)[0]
+
+
+class FaultcheckRuntime:
+    """Process-global cross-check state (see module docstring)."""
+
+    def __init__(self):
+        # a raw lock on purpose (like tsan's _mu): the runtime is
+        # itself diagnostic machinery, invisible to the sanitizer
+        self._mu = threading.Lock()
+        self.checks = 0
+        self.violations: List[dict] = []
+        self.sites: Dict[str, dict] = {}  # base site -> record
+        # telemetry watermark: emit_telemetry publishes deltas
+        self._emitted = {"checks": 0, "violations": 0}
+
+    def settle_window(self, site: str, observed: Set[str]) -> None:
+        """Merge one closed window's observations into the per-site
+        fingerprint (containment is judged lazily at report time, so
+        the window close never pays the static-model parse)."""
+        base = _base_site(site)
+        with self._mu:
+            self.checks += 1
+            rec = self.sites.setdefault(
+                base, {"calls": 0, "mutations": set()}
+            )
+            rec["calls"] += 1
+            rec["mutations"] |= observed
+
+    def snapshot(self) -> dict:
+        """Report with containment judged against the static model.
+        The model parse happens OUTSIDE the lock (it loads and walks
+        the package source)."""
+        model = static_model()
+        with self._mu:
+            sites = {}
+            for base, rec in sorted(self.sites.items()):
+                allowed = model.get(base)
+                observed = rec["mutations"]
+                extra = (
+                    sorted(observed - allowed - FAULTS_BASELINE)
+                    if allowed is not None
+                    else []
+                )
+                sites[base] = {
+                    "calls": rec["calls"],
+                    "mutations": sorted(observed),
+                    "modeled": allowed is not None,
+                    "extra": extra,
+                }
+                if extra:
+                    key = (base, tuple(extra))
+                    if key not in self._flagged():
+                        self.violations.append(
+                            {
+                                "kind": "mutation-containment",
+                                "site": base,
+                                "extra": extra,
+                                "detail": (
+                                    f"supervised site '{base}' mutated "
+                                    f"{extra} at runtime; the static "
+                                    "effect model allows only "
+                                    f"{sorted(allowed)}"
+                                ),
+                            }
+                        )
+            return {
+                "enabled": True,
+                "checks": self.checks,
+                "sites": sites,
+                "violations": list(self.violations),
+            }
+
+    def _flagged(self) -> Set[Tuple[str, tuple]]:
+        """Dedup key set for already-recorded containment violations
+        (snapshot is re-entrant: report -> emit -> atexit dump)."""
+        return {
+            (v["site"], tuple(v["extra"]))
+            for v in self.violations
+            if v.get("kind") == "mutation-containment"
+        }
+
+
+def _empty_report() -> dict:
+    return {"enabled": False, "checks": 0, "sites": {}, "violations": []}
+
+
+# --- supervised-window hooks (called from faults.supervised) -----------
+
+
+def begin(site: str) -> None:
+    """Open a window on the calling thread. faults.supervised guards
+    this behind the one ``_rt is not None`` truthiness check."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append((site, set()))
+
+
+def end(site: str) -> None:
+    """Close the innermost window and merge its observations (called
+    from a finally, so fault paths settle too)."""
+    rt = _rt
+    stack = getattr(_tls, "stack", None)
+    if not stack:
+        return
+    opened, observed = stack.pop()
+    if rt is not None:
+        rt.settle_window(opened, observed)
+
+
+def note_access(site_name: str) -> None:
+    """Record one shared-state WRITE into every open window on this
+    thread (tsan.access forwards writes here; nested windows each see
+    the mutation so outer fingerprints stay complete)."""
+    stack = getattr(_tls, "stack", None)
+    if not stack:
+        return
+    for _, observed in stack:
+        observed.add(site_name)
+
+
+# --- static model ------------------------------------------------------
+
+
+def _compute_static_model() -> Dict[str, Optional[frozenset]]:
+    """site -> allowed tsan sites, from the installed package source:
+    resolve every ``supervised(site, fn)`` call's callable and union
+    the effect model's reachable tsan sites over its call closure.
+    Declared sites whose callable is not statically resolvable map to
+    None (containment is skipped — the static rules already require a
+    drill for every consumed site, so the gap is visible there)."""
+    import dbscan_tpu
+    from dbscan_tpu import faults
+    from dbscan_tpu.lint import callgraph, effects, faultsurface
+    from dbscan_tpu.lint.core import load_package
+
+    pkg = load_package(
+        [os.path.dirname(os.path.abspath(dbscan_tpu.__file__))]
+    )
+    pkg.callgraph = cg = callgraph.build(pkg)
+    model = effects.EffectModel(cg)
+    allowed: Dict[str, Optional[frozenset]] = {
+        site: None for site in faults.SITES
+    }
+    for sc in faultsurface.site_consumptions(pkg):
+        if (
+            sc.site is None
+            or sc.kind != "supervised"
+            or len(sc.call.args) < 2
+            or sc.info is None
+        ):
+            continue
+        types = callgraph.local_types(cg, sc.info)
+        fn = callgraph.callable_argument(
+            cg, sc.info, sc.call.args[1], types
+        )
+        if fn is None:
+            continue
+        reach = effects.callable_tsan_sites(model, fn)
+        base = _base_site(sc.site)
+        prev = allowed.get(base)
+        allowed[base] = frozenset(reach) | (prev or frozenset())
+    return allowed
+
+
+def static_model() -> Dict[str, Optional[frozenset]]:
+    """The cached site -> allowed-mutations model (parsed once per
+    process, on the first report/assert — never on the hot path)."""
+    global _static_cache
+    if _static_cache is None:
+        _static_cache = _compute_static_model()
+    return _static_cache
+
+
+# --- public API --------------------------------------------------------
+
+
+def runtime() -> Optional[FaultcheckRuntime]:
+    """The live runtime, or None when disabled — the ONE check
+    faults.supervised and tsan.access pay on the disabled path."""
+    return _rt
+
+
+def enabled() -> bool:
+    return _rt is not None
+
+
+def enable() -> FaultcheckRuntime:
+    """Turn the cross-check on (idempotent); returns the runtime."""
+    global _rt
+    if _rt is None:
+        _rt = FaultcheckRuntime()
+    return _rt
+
+
+def disable() -> None:
+    global _rt
+    _rt = None
+
+
+def reset() -> None:
+    """Fresh runtime if enabled (drop recorded state, keep recording)."""
+    global _rt
+    if _rt is not None:
+        _rt = FaultcheckRuntime()
+
+
+def fingerprint(site: str) -> Tuple[str, ...]:
+    """The sorted observed-mutation set for one base site — the value
+    the retry-idempotence drills compare between a faulted and a
+    no-fault run. Empty when disabled or the site never ran."""
+    rt = _rt
+    if rt is None:
+        return ()
+    with rt._mu:
+        rec = rt.sites.get(_base_site(site))
+        return tuple(sorted(rec["mutations"])) if rec else ()
+
+
+def report() -> dict:
+    """The current cross-check report (a disabled checker reports
+    ``enabled: False`` with empty tables)."""
+    rt = _rt
+    if rt is None:
+        return _empty_report()
+    return rt.snapshot()
+
+
+def assert_clean() -> None:
+    """Raise AssertionError when the run recorded any containment
+    violation (the test-suite gate)."""
+    rep = report()
+    if rep["violations"]:
+        raise AssertionError(
+            f"faultcheck found {len(rep['violations'])} violation(s): "
+            + json.dumps(rep["violations"], indent=2, default=str)
+        )
+
+
+def emit_telemetry() -> None:
+    """Publish the declared ``faultcheck.*`` counters and any pending
+    violation events (no-op unless both the checker and obs are
+    enabled). Emits DELTAS since the last call, so periodic publication
+    never double-counts."""
+    rt = _rt
+    if rt is None:
+        return
+    from dbscan_tpu import obs
+
+    if not obs.active():
+        return
+    rep = rt.snapshot()  # judges containment against the static model
+    with rt._mu:
+        checks, nviol = rt.checks, len(rt.violations)
+        done = dict(rt._emitted)
+        rt._emitted = {"checks": checks, "violations": nviol}
+        fresh = rt.violations[done["violations"]:nviol]
+    obs.count("faultcheck.checks", checks - done["checks"])
+    obs.count("faultcheck.violations", nviol - done["violations"])
+    for v in fresh:
+        obs.event(
+            "faultcheck.violation",
+            site=v.get("site", ""),
+            detail=v.get("detail", ""),
+        )
+    del rep
+
+
+def write_report(path: str) -> str:
+    """Write the JSON report atomically; returns the path. Publishes
+    pending ``faultcheck.*`` telemetry deltas first (the one product
+    call site — the ``DBSCAN_FAULTCHECK_REPORT`` atexit hook)."""
+    emit_telemetry()
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(report(), f, indent=1, sort_keys=True, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def _env_init() -> None:
+    """Activate from the environment at import: ``DBSCAN_FAULTCHECK=1``
+    turns recording on; ``DBSCAN_FAULTCHECK_REPORT=path`` additionally
+    dumps the JSON report at process exit (how the tier-1 subprocess
+    rerun of the fault/pipeline suites is asserted clean from
+    outside)."""
+    if config.env("DBSCAN_FAULTCHECK"):
+        enable()
+        path = config.env("DBSCAN_FAULTCHECK_REPORT")
+        if path:
+            atexit.register(write_report, path)
+
+
+_env_init()

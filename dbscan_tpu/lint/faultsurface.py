@@ -1,0 +1,554 @@
+"""graftfault static rules: the fault surface as a checked contract.
+
+Four ``fault-*`` families plus the persistence-atomicity rule, all over
+the effect model (lint/effects.py) and the declared site registry
+(``faults.SITES``, the obs/schema.py idiom applied to the fault plane):
+
+- ``fault-retry-unsafe`` — the callable handed to
+  ``faults.supervised(site, fn)`` mutates caller-visible state before
+  its success point, so a transient-fault retry double-applies it (the
+  ``_pull_record`` idempotence discipline from PR 5, generalized).
+- ``fault-site-undeclared`` — a ``supervised(...)`` /
+  ``next_ordinal(...)`` consumption whose site token is not in
+  ``faults.SITES``: adding the registry row (owner, ordinal unit,
+  degrade ladder, handler mode) IS the registration step.
+- ``fault-site-undrilled`` — a consumed declared site with no
+  ``DBSCAN_FAULT_SPEC`` drill clause anywhere in ``tests/`` (resolved
+  statically from the test ASTs): an undrilled site is a retry path CI
+  never exercises.
+- ``fault-degrade-unreachable`` — a supervised call that satisfies none
+  of its site's declared handler modes: no ``fallback=`` degradation
+  argument, no enclosing ``except`` degrade handler, and no
+  ``FatalDeviceFault`` catcher in the declared propagation module — the
+  documented degrade ladder cannot be reached from this site.
+- ``atomic-write-violation`` — a function opens a file for writing
+  without the write-tmp-then-``os.replace`` idiom the persistence
+  modules (checkpoint/flight/export/profiles) already follow: a run
+  killed mid-write must leave the previous artifact intact.
+  Append-mode opens are the other crash-tolerant idiom (bench-history
+  JSONL) and are exempt.
+
+Site tokens are resolved statically: string literals,
+``faults.SITE_*`` constants through the import maps,
+``shard_site(base, …)`` unwrapping (shard 0 normalizes to the bare
+token), ``self._site`` through the owner class's ``__init__``
+assignment, and parameter defaults (``site: str = faults.SITE_SERVE``).
+An unresolvable site expression is skipped — the rules are
+conservative, never guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from dbscan_tpu.lint import callgraph as cg_mod
+from dbscan_tpu.lint import effects as effects_mod
+from dbscan_tpu.lint.callgraph import (
+    FuncInfo,
+    callable_argument,
+    terminal_name,
+)
+from dbscan_tpu.lint.core import Finding, Package
+
+# one drill clause of the DBSCAN_FAULT_SPEC grammar, as it appears in
+# test-source string literals: site[@shard]#ordinal:KIND[*count]
+_CLAUSE_RE = re.compile(
+    r"(?P<site>[a-z_][a-z0-9_]*)(?:@\d+)?#\d+:[A-Z_]+(?:\*\d+)?"
+)
+
+_EXC_NAMES = ("Exception", "BaseException", "FatalDeviceFault")
+
+
+class SiteCall:
+    """One static consumption of a fault site."""
+
+    __slots__ = ("site", "call", "info", "path", "kind")
+
+    def __init__(self, site, call, info, path, kind):
+        self.site = site  # resolved token (shard suffix stripped) or None
+        self.call = call  # the ast.Call node
+        self.info = info  # enclosing FuncInfo (None at module level)
+        self.path = path
+        self.kind = kind  # "supervised" | "ordinal"
+
+
+def _strip_shard(token: str) -> str:
+    return token.split("@", 1)[0]
+
+
+def _resolve_site(cg, info: Optional[FuncInfo], mod, expr, depth=0):
+    """Best-effort static value of a site expression (see module doc)."""
+    if depth > 6 or expr is None:
+        return None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return _strip_shard(expr.value)
+    if isinstance(expr, ast.Call):
+        if terminal_name(expr.func) == "shard_site" and expr.args:
+            return _resolve_site(cg, info, mod, expr.args[0], depth + 1)
+        return None
+    if isinstance(expr, ast.Attribute):
+        recv = expr.value
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and info is not None and (
+                info.owner_class is not None
+            ):
+                # self._site: resolve through the owner class's
+                # assignments (canonically __init__)
+                cls = info.owner_class
+                for m in cls.methods.values():
+                    for n in cg_mod.walk_scope(m.node):
+                        if not isinstance(n, ast.Assign):
+                            continue
+                        for tgt in n.targets:
+                            if (
+                                isinstance(tgt, ast.Attribute)
+                                and tgt.attr == expr.attr
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"
+                            ):
+                                got = _resolve_site(
+                                    cg, m, m.module, n.value, depth + 1
+                                )
+                                if got is not None:
+                                    return got
+                return None
+            modname = mod.import_alias.get(recv.id)
+            if modname is None and recv.id in mod.from_names:
+                src, orig = mod.from_names[recv.id]
+                modname = f"{src}.{orig}"
+            if modname is not None:
+                m2 = cg.by_modname.get(modname)
+                if m2 is not None:
+                    val = m2.constants.get(expr.attr)
+                    if isinstance(val, str):
+                        return _strip_shard(val)
+        return None
+    if isinstance(expr, ast.Name):
+        val = mod.constants.get(expr.id)
+        if isinstance(val, str):
+            return _strip_shard(val)
+        if expr.id in mod.from_names:
+            src, _orig = mod.from_names[expr.id]
+            m2 = cg.by_modname.get(src)
+            if m2 is not None:
+                val = m2.constants.get(_orig)
+                if isinstance(val, str):
+                    return _strip_shard(val)
+        if info is not None:
+            # parameter default
+            args = getattr(info.node, "args", None)
+            if args is not None:
+                pos = args.posonlyargs + args.args
+                for a, d in zip(pos[len(pos) - len(args.defaults):],
+                                args.defaults):
+                    if a.arg == expr.id:
+                        return _resolve_site(
+                            cg, info, mod, d, depth + 1
+                        )
+                for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                    if a.arg == expr.id and d is not None:
+                        return _resolve_site(
+                            cg, info, mod, d, depth + 1
+                        )
+            # frame-local assignment
+            for n in cg_mod.walk_scope(info.node):
+                if isinstance(n, ast.Assign):
+                    for tgt in n.targets:
+                        if (
+                            isinstance(tgt, ast.Name)
+                            and tgt.id == expr.id
+                        ):
+                            got = _resolve_site(
+                                cg, info, mod, n.value, depth + 1
+                            )
+                            if got is not None:
+                                return got
+    return None
+
+
+def _enclosing_func(cg, mod, call: ast.Call) -> Optional[FuncInfo]:
+    best = None
+    best_span = None
+    for fi in mod.all_functions:
+        node = fi.node
+        lo = getattr(node, "lineno", None)
+        hi = getattr(node, "end_lineno", None)
+        if lo is None or hi is None:
+            continue
+        if lo <= call.lineno <= hi:
+            span = hi - lo
+            if best_span is None or span < best_span:
+                # innermost frame whose SCOPE walk actually contains
+                # the call (not a sibling nested def)
+                if any(n is call for n in cg_mod.walk_scope(node)):
+                    best, best_span = fi, span
+    return best
+
+
+def site_consumptions(pkg: Package) -> List[SiteCall]:
+    """Every static fault-site consumption in the linted set:
+    ``faults.supervised(site, …)`` wraps and direct
+    ``reg.next_ordinal(site)`` ordinal draws (the campaign lease path
+    consumes its stream without a supervised wrap)."""
+    cg = pkg.callgraph
+    out: List[SiteCall] = []
+    for sf in pkg.files:
+        if sf.tree is None:
+            continue
+        mod = cg.modules.get(sf.path)
+        if mod is None:
+            continue
+        if mod.modname == "dbscan_tpu.faults":
+            continue  # the supervisor itself, not a consumer
+        for n in ast.walk(sf.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            tname = terminal_name(n.func)
+            if tname == "supervised" and n.args:
+                info = _enclosing_func(cg, mod, n)
+                site = _resolve_site(cg, info, mod, n.args[0])
+                out.append(SiteCall(site, n, info, sf.path, "supervised"))
+            elif tname == "next_ordinal" and n.args:
+                info = _enclosing_func(cg, mod, n)
+                site = _resolve_site(cg, info, mod, n.args[0])
+                if site is not None:
+                    out.append(SiteCall(site, n, info, sf.path, "ordinal"))
+    return out
+
+
+# --- drills (tests/ AST scan) ----------------------------------------
+
+
+def _tests_dir(pkg: Package) -> Optional[str]:
+    dirs = {
+        os.path.dirname(os.path.abspath(f.path)) for f in pkg.files
+    }
+    if not dirs:
+        return None
+    common = os.path.commonpath(sorted(dirs))
+    for cand in (common, os.path.dirname(common)):
+        t = os.path.join(cand, "tests")
+        if os.path.isdir(t):
+            return t
+    return None
+
+
+def drill_sites(tests_dir: str) -> Dict[str, Set[str]]:
+    """site token -> test basenames containing a drill clause for it,
+    from every string literal in ``tests/test_*.py`` (static: the
+    linter never imports test code)."""
+    out: Dict[str, Set[str]] = {}
+    for name in sorted(os.listdir(tests_dir)):
+        if not (name.startswith("test_") and name.endswith(".py")):
+            continue
+        path = os.path.join(tests_dir, name)
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                for m in _CLAUSE_RE.finditer(n.value):
+                    out.setdefault(m.group("site"), set()).add(name)
+    return out
+
+
+# --- handler-mode checks ---------------------------------------------
+
+
+def _in_degrading_try(mod, call: ast.Call) -> bool:
+    """Is the call lexically inside a ``try`` whose handlers catch
+    Exception/BaseException/FatalDeviceFault (a caller-owned degrade
+    handler, the spill-tree pattern)?"""
+    hit = [False]
+
+    def walk(node, stack):
+        if node is call:
+            hit[0] = any(stack)
+            return
+        if isinstance(node, ast.Try):
+            catches = False
+            for h in node.handlers:
+                names = []
+                t = h.type
+                for sub in ast.walk(t) if t is not None else ():
+                    tn = terminal_name(sub)
+                    if tn:
+                        names.append(tn)
+                if t is None or any(x in _EXC_NAMES for x in names):
+                    catches = True
+            for child in node.body:
+                walk(child, stack + [catches])
+            for h in node.handlers:
+                for child in h.body:
+                    walk(child, stack)
+            for child in node.orelse + node.finalbody:
+                walk(child, stack)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, stack)
+
+    walk(mod.tree, [])
+    return hit[0]
+
+
+def _module_catches_fatal(cg, modname: str) -> Optional[bool]:
+    """Does the declared propagation module own a degrade handler: an
+    ``except`` naming FatalDeviceFault, or a ``faults.note_degrade()``
+    call (the caller-counted degradation protocol the spill tree uses)?
+    None when the module is outside the linted set (single-file fixture
+    runs) — leniently satisfied."""
+    m = cg.by_modname.get(modname)
+    if m is None:
+        return None
+    for n in ast.walk(m.tree):
+        if isinstance(n, ast.ExceptHandler) and n.type is not None:
+            for sub in ast.walk(n.type):
+                if terminal_name(sub) == "FatalDeviceFault":
+                    return True
+        if (
+            isinstance(n, ast.Call)
+            and terminal_name(n.func) == "note_degrade"
+        ):
+            return True
+    return False
+
+
+def _handler_satisfied(cg, spec, sc: SiteCall) -> bool:
+    mod = cg.modules.get(sc.path)
+    for mode in spec.handler:
+        if mode == "fallback-arg":
+            if any(kw.arg == "fallback" for kw in sc.call.keywords):
+                return True
+        elif mode == "caller-except":
+            if mod is not None and _in_degrading_try(mod, sc.call):
+                return True
+        elif mode.startswith("propagate:"):
+            got = _module_catches_fatal(cg, mode.split(":", 1)[1])
+            if got is None or got:
+                return True
+    return False
+
+
+# --- the rule entry point --------------------------------------------
+
+
+def check(pkg: Package) -> List[Finding]:
+    from dbscan_tpu import faults as _faults
+
+    cg = pkg.callgraph
+    findings: List[Finding] = []
+    consumptions = site_consumptions(pkg)
+    model = effects_mod.EffectModel(cg)
+
+    tests_dir = _tests_dir(pkg)
+    drills = drill_sites(tests_dir) if tests_dir is not None else None
+    undrilled_reported: Set[str] = set()
+
+    for sc in consumptions:
+        if sc.site is None:
+            continue
+        spec = _faults.SITES.get(sc.site)
+        if spec is None:
+            findings.append(Finding(
+                rule="fault-site-undeclared",
+                path=sc.path,
+                line=sc.call.lineno,
+                col=sc.call.col_offset + 1,
+                message=(
+                    f"fault site '{sc.site}' is not declared in "
+                    "faults.SITES — declare its owner, ordinal unit, "
+                    "degrade ladder, and handler mode there "
+                    "(registration is the obs/schema.py discipline: "
+                    "the registry row IS the contract)"
+                ),
+            ))
+            continue
+        if (
+            drills is not None
+            and sc.site not in drills
+            and sc.site not in undrilled_reported
+        ):
+            undrilled_reported.add(sc.site)
+            findings.append(Finding(
+                rule="fault-site-undrilled",
+                path=sc.path,
+                line=sc.call.lineno,
+                col=sc.call.col_offset + 1,
+                message=(
+                    f"fault site '{sc.site}' has no DBSCAN_FAULT_SPEC "
+                    "drill in tests/ — add at least one "
+                    f"'{sc.site}#0:TRANSIENT'-style clause so CI "
+                    "exercises this retry path"
+                ),
+            ))
+        if sc.kind != "supervised":
+            continue
+        if not _handler_satisfied(cg, spec, sc):
+            findings.append(Finding(
+                rule="fault-degrade-unreachable",
+                path=sc.path,
+                line=sc.call.lineno,
+                col=sc.call.col_offset + 1,
+                message=(
+                    f"site '{sc.site}' declares degrade ladder "
+                    f"{' -> '.join(spec.degrade)} (handler "
+                    f"{'/'.join(spec.handler)}) but this supervised "
+                    "call reaches none of it: pass fallback=, wrap in "
+                    "a degrading try/except, or route the "
+                    "FatalDeviceFault to the declared catcher"
+                ),
+            ))
+        # retry idempotence of the attempt callable (and the fallback:
+        # a degraded group re-lands the same state)
+        if len(sc.call.args) >= 2 and sc.info is not None:
+            types = cg_mod.local_types(cg, sc.info)
+            attempt = callable_argument(
+                cg, sc.info, sc.call.args[1], types
+            )
+            if attempt is not None:
+                seen: Set[Tuple[str, str]] = set()
+                for eff in effects_mod.unsafe_mutations(model, attempt):
+                    key = (eff.target, eff.flavor)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    via = f" (via {eff.via})" if eff.via else ""
+                    findings.append(Finding(
+                        rule="fault-retry-unsafe",
+                        path=sc.path,
+                        line=sc.call.lineno,
+                        col=sc.call.col_offset + 1,
+                        message=(
+                            f"supervised callable for site "
+                            f"'{sc.site}' mutates caller-visible "
+                            f"state before its success point: "
+                            f"{eff.target} ({eff.flavor}{via}, line "
+                            f"{eff.line}) — a transient-fault retry "
+                            "re-applies it; mutate only after the "
+                            "last device op, or restore a snapshot "
+                            "as the callable's first statement"
+                        ),
+                    ))
+    findings.extend(_check_atomic_writes(pkg))
+    return findings
+
+
+# --- atomic-write-violation ------------------------------------------
+
+
+def _open_write_mode(call: ast.Call) -> Optional[str]:
+    if not (isinstance(call.func, ast.Name) and call.func.id == "open"):
+        return None
+    mode = "r"
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    if not isinstance(mode, str):
+        return None
+    if "w" in mode or "x" in mode:
+        return mode
+    return None
+
+
+def _check_atomic_writes(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    cg = pkg.callgraph
+    for sf in pkg.files:
+        if sf.tree is None:
+            continue
+        mod = cg.modules.get(sf.path)
+        if mod is None:
+            continue
+        scopes = [mod.tree] + [fi.node for fi in mod.all_functions]
+        for scope in scopes:
+            opens: List[ast.Call] = []
+            has_replace = False
+            for n in cg_mod.walk_scope(scope):
+                if not isinstance(n, ast.Call):
+                    continue
+                if _open_write_mode(n) is not None:
+                    opens.append(n)
+                f = n.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "replace"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "os"
+                ):
+                    has_replace = True
+            if has_replace or not opens:
+                continue
+            for call in opens:
+                findings.append(Finding(
+                    rule="atomic-write-violation",
+                    path=sf.path,
+                    line=call.lineno,
+                    col=call.col_offset + 1,
+                    message=(
+                        "file opened for writing without the "
+                        "write-tmp-then-os.replace idiom — a run "
+                        "killed mid-write corrupts the artifact; "
+                        "write to '<path>.tmp' and os.replace() it "
+                        "(obs/export._atomic_write is the reference "
+                        "shape), or append (mode 'a') for logs"
+                    ),
+                ))
+    return findings
+
+
+# --- PARITY fault-surface table --------------------------------------
+
+
+def fault_table(pkg: Optional[Package] = None) -> str:
+    """The PARITY.md fault-surface table (``python -m dbscan_tpu.lint
+    --fault-table``): one row per declared site — its consumers as
+    found statically, ordinal unit, degrade ladder, handler mode(s),
+    and the test files drilling it."""
+    from dbscan_tpu import faults as _faults
+
+    if pkg is None:
+        import dbscan_tpu
+        from dbscan_tpu.lint.core import load_package, run_rules
+
+        pkg = load_package([os.path.dirname(dbscan_tpu.__file__)])
+        run_rules(pkg, (), {})
+    consumers: Dict[str, Set[str]] = {}
+    cg = pkg.callgraph
+    for sc in site_consumptions(pkg):
+        if sc.site is None:
+            continue
+        mod = cg.modules.get(sc.path)
+        name = (
+            mod.modname if mod is not None else os.path.basename(sc.path)
+        )
+        consumers.setdefault(sc.site, set()).add(
+            name.replace("dbscan_tpu.", "")
+        )
+    tests_dir = _tests_dir(pkg)
+    drills = drill_sites(tests_dir) if tests_dir is not None else {}
+    lines = [
+        "| Site | Consumers | Ordinal unit | Degrade ladder "
+        "| Handler | Drills |",
+        "|---|---|---|---|---|---|",
+    ]
+    for site in sorted(_faults.SITES):
+        spec = _faults.SITES[site]
+        cons = sorted(consumers.get(site, set()))
+        if not cons:
+            cons = [spec.owner + " (declared)"]
+        drill_names = sorted(drills.get(site, set()))
+        lines.append(
+            f"| `{site}` | {', '.join(f'`{c}`' for c in cons)} "
+            f"| {spec.unit} "
+            f"| {' -> '.join(spec.degrade)} "
+            f"| {'/'.join(spec.handler)} "
+            f"| {', '.join(f'`{d}`' for d in drill_names) or '—'} |"
+        )
+    return "\n".join(lines)

@@ -251,7 +251,7 @@ class _SharedWriteScanner(ast.NodeVisitor):
         if root in self.local_binds:
             return False
         mod = self.mod
-        if root in mod.lock_globals:
+        if root in mod.lock_globals or root in mod.tls_globals:
             return False
         if root in mod.module_globals:
             return True
@@ -259,7 +259,10 @@ class _SharedWriteScanner(ast.NodeVisitor):
         if tgt is not None:
             m2 = self.cg.by_modname.get(tgt[0])
             if m2 is not None and tgt[1] in m2.module_globals:
-                return tgt[1] not in m2.lock_globals
+                return (
+                    tgt[1] not in m2.lock_globals
+                    and tgt[1] not in m2.tls_globals
+                )
         return False
 
     def _self_shared(self, expr: ast.AST) -> bool:

@@ -51,6 +51,7 @@ import threading
 from typing import Optional
 
 from dbscan_tpu import config
+from dbscan_tpu.lint import faultcheck as _faultcheck
 
 _rt: Optional["TsanRuntime"] = None
 
@@ -320,10 +321,14 @@ def condition(site: str) -> TsanCondition:
 def access(site: str, write: bool = True) -> None:
     """Mark one access to the shared state behind ``site`` — call it
     INSIDE the locked region so the recorded lockset carries the guard.
-    One truthiness check when the sanitizer is off."""
+    One truthiness check (per checker) when the sanitizers are off.
+    Writes also feed the graftfault cross-check's per-supervised-window
+    mutation fingerprint (lint/faultcheck.py)."""
     rt = _rt
     if rt is not None:
         rt.note_access(site, write)
+    if write and _faultcheck._rt is not None:
+        _faultcheck.note_access(site)
 
 
 def enabled() -> bool:

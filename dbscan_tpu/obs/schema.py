@@ -291,6 +291,10 @@ COUNTERS = {
     "by the graftshape runtime cross-check",
     "shapecheck.violations": "model-instantiation or HBM-containment "
     "violations the cross-check recorded",
+    "faultcheck.checks": "supervised windows fingerprinted by the "
+    "graftfault runtime cross-check",
+    "faultcheck.violations": "mutation-containment violations the "
+    "cross-check recorded (observed write outside the static model)",
     "tsan.accesses": "shared-state accesses the thread sanitizer saw",
     "tsan.acquires": "registered-lock acquisitions the sanitizer saw",
     "tsan.races": "lockset races detected (empty-intersection, "
@@ -427,6 +431,8 @@ EVENTS = {
     "faults.run_delta": "per-run fault-counter delta (= stats['faults'])",
     "shapecheck.violation": "graftshape cross-check violation record "
     "(family + detail)",
+    "faultcheck.violation": "graftfault cross-check violation record "
+    "(site + detail)",
     "tsan.race": "thread sanitizer race record (site + thread roles)",
     "tsan.lock_inversion": "thread sanitizer lock-order inversion record",
     "pull.stall": "a pull-pipeline consumer blocked past "

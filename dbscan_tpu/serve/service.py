@@ -390,11 +390,29 @@ class ClusterService:
             batch=int(len(batch)),
         ):
             if faults.site_active(self._site):
-                upd = faults.supervised(
-                    self._site,
-                    lambda _b: self._stream.update(batch),
-                    label=f"ingest epoch {self._snap.epoch + 1}",
-                )
+                # retry idempotence (fault-retry-unsafe): stream.update
+                # mutates the stream (epoch counter, union-find, window)
+                # BEFORE its device op can fault, so a bare retry would
+                # double-apply the batch. Each attempt re-enters from
+                # the pre-batch snapshot (the restore-prologue idiom the
+                # effect model accepts), and the exhaustion path
+                # restores it too, so the degraded service still serves
+                # the last good epoch un-corrupted.
+                state0 = self._stream.export_state()
+
+                def _attempt(_b):
+                    self._stream.restore_state(state0)
+                    return self._stream.update(batch)
+
+                try:
+                    upd = faults.supervised(
+                        self._site,
+                        _attempt,
+                        label=f"ingest epoch {self._snap.epoch + 1}",
+                    )
+                except faults.FatalDeviceFault:
+                    self._stream.restore_state(state0)
+                    raise
             else:
                 upd = self._stream.update(batch)
             state = self._stream.export_state()

@@ -1,0 +1,381 @@
+"""graftfault runtime cross-check (dbscan_tpu/lint/faultcheck.py).
+
+The static fault-surface rules (tests/test_lint.py) reason about what a
+``faults.supervised`` callable MAY mutate; this suite pins the runtime
+half that watches what one actually DOES:
+
+- window mechanics: per-thread window stacks, nested windows each
+  recording, shard-suffixed sites aggregating per base site, and the
+  strictly-empty disabled path;
+- mutation containment: the observed per-site write fingerprint must be
+  a subset of the static effect model's reachable tsan sites (plus the
+  FAULTS_BASELINE the supervision machinery itself touches) — judged
+  against a controlled model in units, against the REAL parsed model on
+  a live faulted train;
+- retry idempotence: an injected-transient drill's fingerprint equals
+  the no-fault run's (the runtime twin of ``fault-retry-unsafe``), and
+  the serve-ingest restore-prologue regression: a transient ingest
+  fault applies the batch exactly once;
+- the tier-1 rerun: the fault + pipeline suites pass under
+  ``DBSCAN_FAULTCHECK=1`` with an EMPTY violation report
+  (``DBSCAN_FAULTCHECK_REPORT`` JSON, asserted from outside).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import Engine, faults, train
+from dbscan_tpu.lint import faultcheck
+from dbscan_tpu.lint import tsan
+
+pytestmark = pytest.mark.faultcheck
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NO_BACKOFF = faults.RetryPolicy(max_retries=3, backoff_base_s=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    """Each test gets a virgin checker, fault registry, and no sleeps;
+    the process-cached static model is preserved (it is content-pure)."""
+    monkeypatch.setenv("DBSCAN_FAULT_BACKOFF_S", "0")
+    faults.reset_registry()
+    faultcheck.disable()
+    yield
+    faultcheck.disable()
+    faults.reset_registry()
+
+
+def _model(monkeypatch, table):
+    """Pin the static model the checker judges against (units stay
+    independent of the real repo's effect analysis)."""
+    monkeypatch.setattr(faultcheck, "_static_cache", dict(table))
+
+
+def _spec(monkeypatch, spec):
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", spec)
+    faults.reset_registry()
+
+
+# --- window mechanics --------------------------------------------------
+
+
+def test_disabled_is_a_noop():
+    assert not faultcheck.enabled()
+    # hooks are safe without a runtime (the one-truthiness-check path)
+    faultcheck.begin("dispatch")
+    faultcheck.note_access("anything")
+    faultcheck.end("dispatch")
+    rep = faultcheck.report()
+    assert rep == {
+        "enabled": False, "checks": 0, "sites": {}, "violations": [],
+    }
+    assert faultcheck.fingerprint("dispatch") == ()
+    faultcheck.assert_clean()  # never raises when disabled
+
+
+def test_window_records_contained_mutations(monkeypatch):
+    _model(monkeypatch, {"dispatch": frozenset({"a.site", "b.site"})})
+    rt = faultcheck.enable()
+    faultcheck.begin("dispatch")
+    faultcheck.note_access("a.site")
+    faultcheck.end("dispatch")
+    assert rt.checks == 1
+    assert faultcheck.fingerprint("dispatch") == ("a.site",)
+    rep = faultcheck.report()
+    assert rep["sites"]["dispatch"] == {
+        "calls": 1, "mutations": ["a.site"], "modeled": True, "extra": [],
+    }
+    faultcheck.assert_clean()
+
+
+def test_uncontained_mutation_is_a_violation(monkeypatch):
+    _model(monkeypatch, {"dispatch": frozenset({"a.site"})})
+    faultcheck.enable()
+    faultcheck.begin("dispatch")
+    faultcheck.note_access("rogue.state")
+    faultcheck.end("dispatch")
+    rep = faultcheck.report()
+    (viol,) = rep["violations"]
+    assert viol["kind"] == "mutation-containment"
+    assert viol["site"] == "dispatch"
+    assert viol["extra"] == ["rogue.state"]
+    with pytest.raises(AssertionError, match="rogue.state"):
+        faultcheck.assert_clean()
+    # re-reporting must not duplicate the violation (atexit re-snapshots)
+    assert len(faultcheck.report()["violations"]) == 1
+
+
+def test_faults_baseline_sites_always_allowed(monkeypatch):
+    """The supervision machinery's own registry/counter writes inside a
+    window are never evidence of a callable-side effect."""
+    _model(monkeypatch, {"dispatch": frozenset()})
+    faultcheck.enable()
+    faultcheck.begin("dispatch")
+    for site in faultcheck.FAULTS_BASELINE:
+        faultcheck.note_access(site)
+    faultcheck.end("dispatch")
+    assert faultcheck.report()["violations"] == []
+
+
+def test_unmodeled_site_skips_containment(monkeypatch):
+    """A site whose supervised callable is not statically resolvable
+    maps to None: recorded but not judged (the static rules already
+    force a drill, so the gap stays visible there)."""
+    _model(monkeypatch, {"serve_replica": None})
+    faultcheck.enable()
+    faultcheck.begin("serve_replica")
+    faultcheck.note_access("router.replicas")
+    faultcheck.end("serve_replica")
+    rep = faultcheck.report()
+    assert rep["sites"]["serve_replica"]["modeled"] is False
+    assert rep["violations"] == []
+
+
+def test_nested_windows_each_record(monkeypatch):
+    """An inner supervised call's mutations land in the outer window
+    too — the outer model reaches the inner callable transitively, so
+    outer fingerprints must stay complete."""
+    _model(monkeypatch, {
+        "serve": frozenset({"x"}), "dispatch": frozenset({"x"}),
+    })
+    faultcheck.enable()
+    faultcheck.begin("serve")
+    faultcheck.begin("dispatch")
+    faultcheck.note_access("x")
+    faultcheck.end("dispatch")
+    faultcheck.end("serve")
+    assert faultcheck.fingerprint("serve") == ("x",)
+    assert faultcheck.fingerprint("dispatch") == ("x",)
+    assert faultcheck.report()["checks"] == 2
+
+
+def test_shard_suffixed_sites_aggregate_per_base(monkeypatch):
+    _model(monkeypatch, {"serve_replica": frozenset({"a", "b"})})
+    faultcheck.enable()
+    for shard, site in enumerate(("serve_replica", "serve_replica@1")):
+        faultcheck.begin(site)
+        faultcheck.note_access("ab"[shard])
+        faultcheck.end(site)
+    assert faultcheck.fingerprint("serve_replica") == ("a", "b")
+    rep = faultcheck.report()
+    assert rep["sites"]["serve_replica"]["calls"] == 2
+
+
+def test_supervised_drives_the_window_hooks(monkeypatch):
+    """faults.supervised opens/closes windows itself (attempt AND
+    fallback), and tsan write accesses inside land in them."""
+    _model(monkeypatch, {"dispatch": frozenset({"probe.state"})})
+    faultcheck.enable()
+    faults.supervised(
+        "dispatch", lambda b: tsan.access("probe.state", write=True),
+        policy=NO_BACKOFF,
+    )
+    assert faultcheck.fingerprint("dispatch") == ("probe.state",)
+    # fallback path: a persistent fault runs the fallback in a window
+    _spec(monkeypatch, "dispatch#1:PERSISTENT")
+    faults.supervised(
+        "dispatch", lambda b: None, policy=NO_BACKOFF,
+        fallback=lambda: tsan.access("probe.state", write=True),
+    )
+    rep = faultcheck.report()
+    assert rep["sites"]["dispatch"]["calls"] >= 2
+    faultcheck.assert_clean()
+
+
+def test_reads_are_not_mutations(monkeypatch):
+    _model(monkeypatch, {"dispatch": frozenset()})
+    faultcheck.enable()
+    faults.supervised(
+        "dispatch", lambda b: tsan.access("probe.state", write=False),
+        policy=NO_BACKOFF,
+    )
+    assert faultcheck.fingerprint("dispatch") == ()
+    faultcheck.assert_clean()
+
+
+def test_write_report_and_env_activation(tmp_path):
+    """DBSCAN_FAULTCHECK=1 turns recording on at import and the REPORT
+    path receives the atexit JSON (checked in a subprocess so the env
+    init path itself is exercised)."""
+    report = tmp_path / "fc.json"
+    code = (
+        "from dbscan_tpu.lint import faultcheck\n"
+        "assert faultcheck.enabled()\n"
+        "faultcheck.begin('dispatch'); faultcheck.end('dispatch')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+        env={
+            **os.environ, "JAX_PLATFORMS": "cpu",
+            "DBSCAN_FAULTCHECK": "1",
+            "DBSCAN_FAULTCHECK_REPORT": str(report),
+        },
+    )
+    assert proc.returncode == 0, proc.stderr
+    rep = json.loads(report.read_text())
+    assert rep["enabled"] is True and rep["checks"] == 1
+
+
+def test_telemetry_deltas(monkeypatch):
+    """faultcheck.* counters/events are declared and emitted as deltas
+    (periodic publication never double-counts)."""
+    from dbscan_tpu import obs
+
+    _model(monkeypatch, {"dispatch": frozenset()})
+    faultcheck.enable()
+    faultcheck.begin("dispatch")
+    faultcheck.note_access("rogue.state")
+    faultcheck.end("dispatch")
+    was = obs.active()
+    obs.enable()
+    try:
+        snap = obs.counters()
+        faultcheck.emit_telemetry()
+        d1 = obs.counters_delta(snap)
+        faultcheck.emit_telemetry()  # no new activity: zero delta
+        d2 = obs.counters_delta(snap)
+    finally:
+        if not was:
+            obs.disable()
+    assert d1.get("faultcheck.checks", 0) == 1
+    assert d1.get("faultcheck.violations", 0) == 1
+    assert d2 == d1
+
+
+# --- the real static model on live runs --------------------------------
+
+
+def _blobs():
+    rng = np.random.default_rng(3)
+    return np.concatenate([
+        rng.normal((0, 0), 0.4, (300, 2)),
+        rng.normal((8, 8), 0.4, (300, 2)),
+    ])
+
+
+KW = dict(
+    eps=0.5, min_points=5, max_points_per_partition=128,
+    engine=Engine.ARCHERY, neighbor_backend="dense",
+)
+
+
+def _clean_fingerprints():
+    """Observed per-site mutation sets minus the supervision baseline
+    (injection bookkeeping differs between faulted and clean runs)."""
+    rep = faultcheck.report()
+    return {
+        site: frozenset(rec["mutations"]) - faultcheck.FAULTS_BASELINE
+        for site, rec in rep["sites"].items()
+    }
+
+
+def test_real_train_is_contained_in_the_static_model(monkeypatch):
+    """A live faulted train's observed mutations are explained by the
+    REAL parsed effect model — the two halves cross-check each other."""
+    faultcheck.enable()
+    _spec(monkeypatch, "dispatch#0:TRANSIENT")
+    out = train(_blobs(), **KW)
+    assert out.stats["faults"]["retries"] == 1
+    rep = faultcheck.report()  # parses the package effect model
+    assert rep["checks"] > 0 and "dispatch" in rep["sites"]
+    assert rep["sites"]["dispatch"]["modeled"] is True
+    assert rep["violations"] == [], rep["violations"]
+
+
+def test_transient_drill_fingerprint_matches_no_fault_run(monkeypatch):
+    """Retry idempotence, measured: the faulted run's per-site mutation
+    fingerprint equals the no-fault run's."""
+    pts = _blobs()
+    faultcheck.enable()
+    clean_out = train(pts, **KW)
+    clean = _clean_fingerprints()
+    faultcheck.reset()
+    _spec(monkeypatch, "dispatch#0:TRANSIENT*2")
+    faulted_out = train(pts, **KW)
+    assert faulted_out.stats["faults"]["retries"] == 2
+    np.testing.assert_array_equal(
+        clean_out.clusters, faulted_out.clusters
+    )
+    assert _clean_fingerprints() == clean
+    faultcheck.assert_clean()
+
+
+def test_serve_ingest_transient_applies_batch_once(monkeypatch):
+    """Regression for the real fault-retry-unsafe finding: the serve
+    ingest attempt re-enters from a snapshot, so a transient fault plus
+    retry applies the batch EXACTLY once (epoch/update counters equal
+    the no-fault run's, labels identical)."""
+    from dbscan_tpu.serve import ClusterService
+
+    rng = np.random.default_rng(5)
+    batch = rng.normal((0, 0), 0.4, (200, 2))
+
+    def run_service():
+        svc = ClusterService(
+            0.6, 5, window=2, max_points_per_partition=500
+        )
+        with svc:
+            svc.submit(batch.copy())
+            assert svc.drain(timeout=120)
+            state = svc._stream.export_state()
+            res = svc.query(batch[:20].copy())
+        return state, res
+
+    _spec(monkeypatch, "serve#0:TRANSIENT;serve#1:TRANSIENT")
+    f_state, f_res = run_service()
+    monkeypatch.delenv("DBSCAN_FAULT_SPEC")
+    faults.reset_registry()
+    c_state, c_res = run_service()
+    assert f_state["scalars"] == c_state["scalars"]  # n_updates == 1
+    for k, arr in c_state["arrays"].items():
+        np.testing.assert_array_equal(f_state["arrays"][k], arr)
+    np.testing.assert_array_equal(f_res.gids, c_res.gids)
+
+
+# --- tier-1 rerun: the fault + pipeline suites under the checker -------
+
+
+def test_fault_and_pipeline_suites_clean_under_faultcheck(tmp_path):
+    """Tier-1 rerun of the fault + pipeline suites with
+    DBSCAN_FAULTCHECK=1: the suites must pass AND the atexit JSON
+    report must show zero containment violations. The nested
+    distributed-suite smoke is deselected (it spawns its own
+    subprocess sweeps; the drills here are the in-process ones)."""
+    report = tmp_path / "faultcheck_report.json"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "DBSCAN_FAULTCHECK": "1",
+        "DBSCAN_FAULTCHECK_REPORT": str(report),
+    }
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest",
+            os.path.join(REPO, "tests", "test_faults.py"),
+            os.path.join(REPO, "tests", "test_pipeline.py"),
+            "-q", "-m", "not slow", "-p", "no:cacheprovider",
+            "-k", "not distributed_suite",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=420,
+    )
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    rep = json.loads(report.read_text())
+    assert rep["enabled"] is True
+    assert rep["violations"] == [], rep["violations"]
+    # the suites exercised real supervised windows, not a no-op run
+    assert rep["checks"] > 50
+    assert "dispatch" in rep["sites"] and "stream" in rep["sites"]
+    # drilled transient/persistent paths settled their windows too
+    assert rep["sites"]["dispatch"]["calls"] > 10

@@ -243,6 +243,37 @@ def test_sync_mode_env(monkeypatch):
     assert faults.sync_mode()
 
 
+# --- the declared site registry (faults.SITES) ------------------------
+
+
+def test_sites_self_check_clean():
+    """The registry's own invariants hold (the schema.self_check()
+    idiom applied to the fault plane)."""
+    assert faults.sites_self_check() == []
+
+
+def test_sites_registry_covers_every_site_constant():
+    """Every SITE_* constant has a registry row and vice versa, and the
+    spec grammar's site vocabulary is exactly the registry plus '*'."""
+    consts = {
+        v for k, v in vars(faults).items()
+        if k.startswith("SITE_") and isinstance(v, str)
+    }
+    assert consts == set(faults.SITES)
+    assert set(faults._SITES) == consts | {"*"}
+    for site, spec in faults.SITES.items():
+        assert spec.site == site
+        assert spec.degrade and spec.handler and spec.owner
+
+
+def test_parse_fault_spec_rejects_undeclared_site():
+    """A drill clause naming a site outside the registry is a spec
+    error, not a silently-never-firing clause — declaring the SITES row
+    IS the registration step."""
+    with pytest.raises(ValueError, match="nosuchsite"):
+        faults.parse_fault_spec("nosuchsite#0:TRANSIENT")
+
+
 # --- end-to-end label parity under injection --------------------------
 
 

@@ -1556,6 +1556,274 @@ def test_cli_shape_table(capsys):
         assert f"`{family}`" in out
 
 
+# --- fault-surface family (graftfault) --------------------------------
+
+
+def test_fault_retry_unsafe_premature_mutation(tmp_path):
+    """The attempt callable bumps a module global BEFORE its fallible
+    device op: a transient-fault retry double-counts it."""
+    findings, p = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        from dbscan_tpu import faults
+
+        _progress = {"batches": 0}
+
+        def _attempt(budget):
+            _progress["batches"] += 1
+            return jnp.sum(budget)
+
+        def run():
+            return faults.supervised("serve", _attempt)
+        """,
+    )
+    assert _rules(findings) == ["fault-retry-unsafe"]
+    assert "_progress" in findings[0].message
+    assert findings[0].line == 13  # reported at the supervised call
+
+
+def test_fault_retry_post_success_mutation_is_clean(tmp_path):
+    """The same mutation AFTER the last fallible op is once-per-success
+    bookkeeping — the safe shape the rule message prescribes."""
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        from dbscan_tpu import faults
+
+        _progress = {"batches": 0}
+
+        def _attempt(budget):
+            out = jnp.sum(budget)
+            _progress["batches"] += 1
+            return out
+
+        def run():
+            return faults.supervised("serve", _attempt)
+        """,
+    )
+    assert _rules(findings) == []
+
+
+def test_fault_retry_restore_prologue_is_clean(tmp_path):
+    """A callable whose FIRST statement restores a snapshot of the root
+    it mutates re-enters idempotently (the serve-ingest fix idiom)."""
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        from dbscan_tpu import faults
+
+        _stream = make_stream()
+        _snap = None
+
+        def _attempt(budget):
+            _stream.restore_state(_snap)
+            _stream.epoch += 1
+            return jnp.sum(budget)
+
+        def run():
+            return faults.supervised("serve", _attempt)
+        """,
+    )
+    assert _rules(findings) == []
+
+
+def test_fault_site_undeclared(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        from dbscan_tpu import faults
+
+        def run():
+            return faults.supervised("nosuchsite", lambda b: b)
+        """,
+    )
+    assert _rules(findings) == ["fault-site-undeclared"]
+    assert "nosuchsite" in findings[0].message
+
+
+def test_fault_site_resolved_through_constant_and_shard(tmp_path):
+    """Site tokens resolve through module constants and shard_site()
+    wraps; a declared site this way is clean (no undeclared finding)."""
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        from dbscan_tpu import faults
+
+        SITE = "serve"
+
+        def run(shard):
+            faults.supervised(SITE, lambda b: b)
+            return faults.supervised(
+                faults.shard_site("serve", shard), lambda b: b
+            )
+        """,
+    )
+    assert _rules(findings) == []
+
+
+def test_fault_site_undrilled(tmp_path):
+    """A consumed declared site with no DBSCAN_FAULT_SPEC clause in
+    tests/ is a retry path CI never exercises."""
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_other.py").write_text(
+        'SPEC = "dispatch#0:TRANSIENT"\n'
+    )
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        from dbscan_tpu import faults
+
+        def run():
+            return faults.supervised("serve", lambda b: b)
+        """,
+    )
+    assert _rules(findings) == ["fault-site-undrilled"]
+    assert "serve#0:TRANSIENT" in findings[0].message
+
+
+def test_fault_site_drilled_is_clean(tmp_path):
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_other.py").write_text(
+        '_spec(monkeypatch, "serve#1:TRANSIENT*2")\n'
+    )
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        from dbscan_tpu import faults
+
+        def run():
+            return faults.supervised("serve", lambda b: b)
+        """,
+    )
+    assert _rules(findings) == []
+
+
+def test_fault_degrade_unreachable_without_fallback(tmp_path):
+    """Site 'dispatch' declares handler mode fallback-arg; a supervised
+    call without fallback= cannot reach the documented ladder."""
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        from dbscan_tpu import faults
+
+        def run():
+            return faults.supervised("dispatch", lambda b: b)
+        """,
+    )
+    assert _rules(findings) == ["fault-degrade-unreachable"]
+    assert "cpu-tier" in findings[0].message
+
+
+def test_fault_degrade_fallback_arg_is_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        from dbscan_tpu import faults
+
+        def run():
+            return faults.supervised(
+                "dispatch", lambda b: b, fallback=lambda: None
+            )
+        """,
+    )
+    assert _rules(findings) == []
+
+
+def test_atomic_write_violation(tmp_path):
+    findings, p = _lint_source(
+        tmp_path,
+        """
+        import json
+
+        def save(path, row):
+            with open(path, "w") as f:
+                json.dump(row, f)
+        """,
+    )
+    assert _rules(findings) == ["atomic-write-violation"]
+    assert findings[0].line == 5
+    assert "os.replace" in findings[0].message
+
+
+def test_atomic_write_tmp_then_replace_is_clean(tmp_path):
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        import json
+        import os
+
+        def save(path, row):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(row, f)
+            os.replace(tmp, path)
+        """,
+    )
+    assert _rules(findings) == []
+
+
+def test_atomic_write_append_mode_is_exempt(tmp_path):
+    """Append is the other crash-tolerant idiom (JSONL ledgers)."""
+    findings, _ = _lint_source(
+        tmp_path,
+        """
+        def log(path, line):
+            with open(path, "a") as f:
+                f.write(line)
+        """,
+    )
+    assert _rules(findings) == []
+
+
+def test_fixed_persistence_writes_stay_atomic():
+    """Regression pin for the real atomic-write-violation findings this
+    family surfaced (the campaign --json row and the linter's own
+    --write-baseline): the rule is per-file, so a single-file lint
+    re-derives any regression."""
+    for rel in ("campaign.py", os.path.join("lint", "cli.py")):
+        findings, _ = lint_mod.lint_paths([os.path.join(PKG, rel)])
+        assert [
+            f.render() for f in findings
+            if f.rule == "atomic-write-violation"
+        ] == []
+
+
+def test_serve_ingest_retry_safety_is_from_the_restore_prologue():
+    """Regression pin for the real fault-retry-unsafe finding: the serve
+    ingest attempt re-enters from an export_state() snapshot. Pin BOTH
+    halves so the clean repo result is not vacuous: the effect model
+    still sees StreamingDBSCAN.update mutating the stream before its
+    success point (the hazard), while the service's _attempt wrapper is
+    effect-free (the restore prologue exempts it)."""
+    from dbscan_tpu.lint import effects as effects_mod
+    from dbscan_tpu.lint.core import load_package
+
+    pkg = load_package([PKG])
+    pkg.callgraph = cg = cg_mod.build(pkg)
+    model = effects_mod.EffectModel(cg)
+    mods = {m.modname: m for m in cg.modules.values()}
+    upd = mods["dbscan_tpu.streaming"].classes["StreamingDBSCAN"].methods[
+        "update"
+    ]
+    hazards = effects_mod.unsafe_mutations(model, upd)
+    assert any(e.root == "self" for e in hazards)  # the raw hazard
+    attempts = [
+        fi for fi in mods["dbscan_tpu.serve.service"].all_functions
+        if fi.node.name == "_attempt"
+    ]
+    assert attempts  # the wrapper exists ...
+    for fi in attempts:  # ... and is retry-safe
+        assert effects_mod.unsafe_mutations(model, fi) == []
+
+
 # --- repo-wide pins ---------------------------------------------------
 
 
@@ -1692,7 +1960,8 @@ def test_console_entrypoint_gates_repo():
     all_families = (
         "host-sync-*,jit-*,schema-*,env-*,race-*,collective-*,"
         "pull-in-collective,shape-*,dtype-flow-drift,hbm-over-budget,"
-        "shard-indivisible,suppress-*,parse-error"
+        "shard-indivisible,fault-*,atomic-write-violation,"
+        "suppress-*,parse-error"
     )
     proc = subprocess.run(
         [sys.executable, "-m", "dbscan_tpu.lint", "--rules",
@@ -1719,6 +1988,8 @@ def test_console_entrypoint_gates_repo():
 
     for rule in ("shape-mismatch", "shape-unratcheted-dim",
                  "dtype-flow-drift", "hbm-over-budget",
-                 "shard-indivisible"):
+                 "shard-indivisible", "fault-retry-unsafe",
+                 "fault-site-undeclared", "fault-site-undrilled",
+                 "fault-degrade-unreachable", "atomic-write-violation"):
         assert rule in _lm.RULES
     assert _lm.ALIASES == {"dtype-drift": "dtype-flow-drift"}
