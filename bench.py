@@ -1423,6 +1423,179 @@ def embed_row(prefix: str = "embed") -> dict:
     }
 
 
+def child_embed1b(ckpt_dir: str, out_path: str) -> None:
+    """One leg of the billion-point embed campaign: regenerate the
+    deterministic embed anchor, run embed_dbscan(checkpoint_dir=...) so
+    every bucket band persists as a restart point, and write the result
+    npz (labels crc32 included — the parent's byte-identity check). A
+    worker death kills this process; the parent (embed1b_row) counts
+    banked bands and relaunches."""
+    import zlib
+
+    from dbscan_tpu import embed_dbscan
+    from dbscan_tpu.utils.ari import adjusted_rand_index
+
+    n = int(os.environ.get("BENCH_EMBED1B_N", "20000"))
+    d = int(os.environ.get("BENCH_EMBED1B_D", "128"))
+    maxpp = int(os.environ.get("BENCH_EMBED1B_MAXPP", "2048"))
+    pts, blob_of, n_blob, k, eps = make_embed_anchor(n, d)
+    stats: dict = {}
+    t0 = time.perf_counter()
+    clusters, _flags = embed_dbscan(
+        pts, eps, 5,
+        max_points_per_partition=maxpp,
+        checkpoint_dir=ckpt_dir,
+        stats_out=stats,
+    )
+    dt = time.perf_counter() - t0
+    ari = adjusted_rand_index(clusters[:n_blob], blob_of)
+    tmp = out_path + ".tmp.npz"
+    with open(tmp, "wb") as f:
+        np.savez(
+            f,
+            seconds=dt,
+            clusters=int(len(np.unique(clusters[clusters > 0]))),
+            expect=k,
+            ari=float(ari),
+            dup=float(stats.get("duplication_factor", 0.0)),
+            bands=int(stats.get("campaign_chunks_total", 0)),
+            bands_loaded=int(stats.get("campaign_bands_loaded", 0)),
+            resumed=bool(stats.get("resumed_from_checkpoint", False)),
+            labels_crc=np.uint32(
+                zlib.crc32(np.ascontiguousarray(clusters).tobytes())
+            ),
+        )
+    os.replace(tmp, out_path)
+
+
+def embed1b_row(prefix: str = "embed1b") -> dict:
+    """The billion-point embed campaign as a harness row (`bench.py
+    --embed1b`, ROADMAP item 1): `campaign.run_frontier` subprocess
+    legs around child_embed1b, leasing bucket-band chunks
+    (`count_done=engine.count_banked_bands` — the embed restart-point
+    grain) so a killed leg's banked bands survive and its replay is
+    priced. Stamps the two gated figures: ``embed1b_mpts``
+    (regress-down; only when the campaign did ALL the work —
+    the m100 prior-chunks honesty rule) and ``embed1b_replay_frac``
+    (regress-up; restart overhead as a first-class metric), plus the
+    byte-identity verdict ``embed1b_labels_match`` — the campaign's
+    final labels crc32 vs a clean uncheckpointed in-process run on the
+    regenerated anchor. Knobs: BENCH_EMBED1B_{N,D,MAXPP,CKPT,LEGS,
+    BUDGET_S,LEG_TIMEOUT_S,REST_S}."""
+    import zlib
+
+    import jax
+
+    from dbscan_tpu import campaign as campaign_mod
+    from dbscan_tpu import embed_dbscan
+    from dbscan_tpu.embed import engine as embed_engine
+
+    on_cpu = jax.default_backend() == "cpu"
+    n = int(
+        os.environ.get("BENCH_EMBED1B_N", "20000" if on_cpu else "1000000000")
+    )
+    os.environ["BENCH_EMBED1B_N"] = str(n)  # children must match
+    d = int(os.environ.get("BENCH_EMBED1B_D", "128"))
+    maxpp = int(os.environ.get("BENCH_EMBED1B_MAXPP", "2048"))
+    ckpt_dir = os.environ.get("BENCH_EMBED1B_CKPT", "/tmp/ckptembed1b")
+    max_legs = int(os.environ.get("BENCH_EMBED1B_LEGS", "4"))
+    budget = float(os.environ.get("BENCH_EMBED1B_BUDGET_S", "1500"))
+    leg_timeout = float(
+        os.environ.get("BENCH_EMBED1B_LEG_TIMEOUT_S", "3600")
+    )
+    rest = float(os.environ.get("BENCH_EMBED1B_REST_S", "5"))
+    os.makedirs(ckpt_dir, exist_ok=True)
+    out_path = os.path.join(ckpt_dir, "leg_result.npz")
+    try:  # a stale result from an older campaign must not count
+        os.unlink(out_path)
+    except OSError:
+        pass
+    env = dict(os.environ)
+    # band fingerprints are knob-keyed (engine._band_fingerprint), so
+    # the campaign key carries everything that invalidates banked bands
+    campaign_mod.ensure_campaign_key(
+        ckpt_dir,
+        {
+            "n": n,
+            "d": d,
+            "maxpp": maxpp,
+            "quantizer": env.get("DBSCAN_EMBED_QUANTIZER", "srp"),
+            "band": env.get("DBSCAN_EMBED_BAND", "0"),
+        },
+    )
+    # bands already banked by PRIOR campaigns: when > 0, this
+    # campaign's wall covers only the tail of the work, so no
+    # throughput figure can honestly be derived from it
+    prior_bands = embed_engine.count_banked_bands(ckpt_dir)
+    fr = campaign_mod.run_frontier(
+        ckpt_dir,
+        [
+            sys.executable,
+            os.path.abspath(__file__),
+            "--embed1b-child",
+            ckpt_dir,
+            out_path,
+        ],
+        env=env,
+        max_leases=max_legs,
+        budget_s=budget,
+        leg_timeout_s=leg_timeout,
+        rest_s=rest,
+        success_path=out_path,
+        count_done=embed_engine.count_banked_bands,
+    )
+    result = None
+    if fr.complete and os.path.exists(out_path):
+        with np.load(out_path) as z:
+            result = {k: z[k].item() for k in z.files}
+    out = {
+        f"{prefix}_n": n,
+        f"{prefix}_d": d,
+        f"{prefix}_legs": fr.legs,
+        f"{prefix}_kills": fr.kills,
+        f"{prefix}_chunks_done": fr.chunks_done,
+        f"{prefix}_chunks_total": fr.chunks_total,
+        f"{prefix}_wall_s": round(fr.wall_s, 1),
+        f"{prefix}_complete": bool(result),
+        # priced restart overhead: the share of the campaign's work
+        # wall that bought bands a later leg had to recompute (gated
+        # regress-up against bench/history.jsonl)
+        f"{prefix}_replay_frac": fr.replay_frac,
+    }
+    if result:
+        out.update(
+            {
+                f"{prefix}_leg_seconds": round(result["seconds"], 3),
+                f"{prefix}_clusters": int(result["clusters"]),
+                f"{prefix}_expect": int(result["expect"]),
+                f"{prefix}_ari": round(result["ari"], 6),
+                f"{prefix}_dup": round(result["dup"], 4),
+                f"{prefix}_bands": int(result["bands"]),
+                f"{prefix}_resumed": bool(result["resumed"]),
+                f"{prefix}_prior_bands": prior_bands,
+            }
+        )
+        if prior_bands == 0:
+            out[f"{prefix}_mpts"] = round(
+                n / out[f"{prefix}_wall_s"] / 1e6, 4
+            )
+        # byte-identity across the kill schedule: the campaign's final
+        # labels vs a clean uncheckpointed run of the same anchor —
+        # the "byte-identical finalize" contract, verified on the
+        # capture itself rather than asserted
+        pts, _blob_of, _n_blob, _k, eps = make_embed_anchor(n, d)
+        clean, _cf = embed_dbscan(
+            pts, eps, 5, max_points_per_partition=maxpp
+        )
+        clean_crc = zlib.crc32(np.ascontiguousarray(clean).tobytes())
+        out[f"{prefix}_labels_match"] = bool(
+            int(result["labels_crc"]) == clean_crc
+        )
+    elif fr.last_error:
+        out[f"{prefix}_last_error"] = fr.last_error[:200]
+    return out
+
+
 def make_hdbscan_anchor(n: int):
     """Engineered variable-density workload: K blobs whose scales span
     a decade (no single eps labels them all — the density engine's
@@ -1606,6 +1779,9 @@ def main() -> None:
     if len(sys.argv) >= 4 and sys.argv[1] == "--m100-child":
         child_m100(sys.argv[2], sys.argv[3])
         return
+    if len(sys.argv) >= 4 and sys.argv[1] == "--embed1b-child":
+        child_embed1b(sys.argv[2], sys.argv[3])
+        return
     if len(sys.argv) >= 7 and sys.argv[1] == "--multichip-child":
         child_multichip(
             int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
@@ -1646,6 +1822,25 @@ def main() -> None:
 
         cap = {"metric": "embed", "backend": _jax.default_backend()}
         cap.update(embed_row())
+        print(json.dumps(cap))
+        hist_path = os.environ.get("BENCH_HISTORY")
+        if hist_path:
+            try:
+                _history_gate_append(cap, hist_path)
+            except Exception as e:  # noqa: BLE001 — never cost the capture
+                sys.stderr.write(f"bench: history append failed: {e}\n")
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--embed1b":
+        # billion-point embed frontier campaign (BENCH_EMBED1B_*
+        # knobs), printed as ONE JSON object and gate-then-appended to
+        # BENCH_HISTORY — embed1b_mpts gates regress-down as a
+        # throughput, embed1b_replay_frac regress-up as the priced
+        # restart overhead
+        _ensure_live_backend()
+        import jax as _jax
+
+        cap = {"metric": "embed1b", "backend": _jax.default_backend()}
+        cap.update(embed1b_row())
         print(json.dumps(cap))
         hist_path = os.environ.get("BENCH_HISTORY")
         if hist_path:

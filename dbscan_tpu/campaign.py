@@ -1078,6 +1078,7 @@ def run_frontier(
     success_path: Optional[str] = None,
     lease_s: Optional[float] = None,
     poll_s: float = 0.5,
+    count_done: Optional[Callable[[str], int]] = None,
 ) -> FrontierResult:
     """Run a frontier campaign: each lease launches ``argv`` as one
     subprocess leg (child_m100 / ``--leg`` mold) that resumes from the
@@ -1089,9 +1090,17 @@ def run_frontier(
     burning budget — and adds lease accounting, the priced replay
     budget, and the ``campaign``-site drills (TRANSIENT kills the child
     after its next banked chunk; PERSISTENT wedges the lease for
-    ``lease_s`` so the next leg steals it)."""
+    ``lease_s`` so the next leg steals it).
+
+    ``count_done`` overrides the banked-chunk census (default: the m100
+    p1-chunk count) so campaigns over other restart-point grains — the
+    embed engine's bucket-band files — price replay against THEIR
+    durable artifacts; the sidecar progress counter stays the shared
+    progress signal either way."""
     from dbscan_tpu.parallel import checkpoint as ckpt_mod
 
+    if count_done is None:
+        count_done = ckpt_mod.count_p1_chunks
     lease_s = float(
         lease_s if lease_s is not None
         else config.env("DBSCAN_CAMPAIGN_LEASE_S")
@@ -1140,7 +1149,7 @@ def run_frontier(
             obs.count("campaign.degrades")
             obs.event("campaign.degrade", leg=legs, error="injected")
         counter0 = progress_counter(ckpt_dir)
-        done0 = ckpt_mod.count_p1_chunks(ckpt_dir)
+        done0 = count_done(ckpt_dir)
         leg_start = time.time()
         t_leg = time.monotonic()
         # honor the campaign budget even against a WEDGED (not crashed)
@@ -1196,7 +1205,7 @@ def run_frontier(
             and not killed
             and (success_path is None or os.path.exists(success_path))
         )
-        done1 = ckpt_mod.count_p1_chunks(ckpt_dir)
+        done1 = count_done(ckpt_dir)
         if ok:
             complete = True
             break
@@ -1238,7 +1247,7 @@ def run_frontier(
             stall = 0
         if legs < max_leases:
             time.sleep(rest_s)
-    chunks_done = ckpt_mod.count_p1_chunks(ckpt_dir)
+    chunks_done = count_done(ckpt_dir)
     total = ckpt_mod.read_progress(ckpt_dir).get("chunks_total")
     obs.count("campaign.work_wall_s", work_wall)
     obs.count("campaign.replayed_wall_s", replayed_wall)

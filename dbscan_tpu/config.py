@@ -662,6 +662,37 @@ ENV_VARS = _env_table(
         "partitioner.",
     ),
     EnvVar(
+        "DBSCAN_EMBED_SHARD", "bool", True,
+        "Shard embed_dbscan over a passed device mesh: the hash "
+        "dispatch runs row-sharded, each chip owns a contiguous band "
+        "of buckets (instance-balanced), bucket dispatches run "
+        "chip-local, and the finalize routes through the collective "
+        "halo-merge. Off = single-device dispatch even when a mesh is "
+        "passed (labels byte-identical either way, PARITY.md 'Sharded "
+        "embed contract').",
+    ),
+    EnvVar(
+        "DBSCAN_EMBED_QUANTIZER", "str", "srp",
+        "Embed binning front-end: 'srp' (hyperplane boundary-spill "
+        "over the primary LSH table) or 'ivf' (IVF-style coarse "
+        "quantizer — the spill tree's farthest-point/Lloyd kernels "
+        "with k-means cells replacing SRP planes; exact r_c+halo "
+        "bands, ARI-gated like the sampled mode).",
+    ),
+    EnvVar(
+        "DBSCAN_EMBED_IVF_CELLS", "int", 0,
+        "Coarse-quantizer cell count of the embed engine's 'ivf' "
+        "front-end (ladder-quantized on device); 0 (the default) "
+        "auto-sizes to ~2x the payload/maxpp ratio.",
+    ),
+    EnvVar(
+        "DBSCAN_EMBED_BAND", "int", 0,
+        "Buckets per bucket-band chunk of an embed campaign "
+        "(checkpoint_dir banking grain: one band = one durable "
+        "restart point / one frontier-leg lease unit); 0 (the "
+        "default) auto-sizes to ~8 bands per run.",
+    ),
+    EnvVar(
         "DBSCAN_DENSITY_CHUNK", "int", 512,
         "Packing-window chunk rows per density.core dispatch of the "
         "density engine (dbscan_tpu/density): each chunk is one "
@@ -862,6 +893,14 @@ TUNABLES = (
     Tunable(
         "DBSCAN_CELLCC_FUSED", "str", ("auto", "1", "0"),
         "fused Pallas unpack+fold+propagate vs split unpack/cc",
+    ),
+    Tunable(
+        "DBSCAN_EMBED_QUANTIZER", "str", ("srp", "ivf"),
+        "embed binning front-end: SRP hyperplanes vs IVF k-means cells",
+    ),
+    Tunable(
+        "DBSCAN_EMBED_IVF_CELLS", "int", (0, 16, 32, 64, 128),
+        "IVF coarse-quantizer cell count (0 = auto ~2x n/maxpp)",
     ),
 )
 

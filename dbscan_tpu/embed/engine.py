@@ -41,7 +41,9 @@ declared floor (PARITY.md "Embed accuracy contract").
 from __future__ import annotations
 
 import functools
+import hashlib
 import logging
+import os
 import time
 from typing import Tuple
 
@@ -49,12 +51,97 @@ import numpy as np
 
 from dbscan_tpu import config, faults, obs
 from dbscan_tpu.embed import lsh, neighbors, oracle
+from dbscan_tpu.embed import quantize as quantize_mod
 from dbscan_tpu.obs import compile as obs_compile
 from dbscan_tpu.ops import propagation as prop_propagation
 from dbscan_tpu.ops.labels import NOISE, NOT_FLAGGED, SEED_NONE
 from dbscan_tpu.parallel.binning import _ladder_width
 
 logger = logging.getLogger(__name__)
+
+#: collective halo-merge ratchet floors (binning._ratchet idiom):
+#: module-global so repeated sharded embed runs reuse exact jit
+#: signatures instead of re-padding per run
+_MERGE_FLOORS: dict = {}
+
+#: bucket-band checkpoint file (one durable restart point per band)
+_BAND_FILE = "emband{:05d}.npz"
+_BAND_FMT = 1
+
+
+def shard_active(mesh) -> bool:
+    """True when embed dispatch shards over ``mesh``: a real
+    (multi-device) mesh with ``DBSCAN_EMBED_SHARD`` on."""
+    from dbscan_tpu.parallel import mesh as mesh_mod
+
+    return (
+        mesh is not None
+        and mesh_mod.mesh_size(mesh) > 1
+        and bool(config.env("DBSCAN_EMBED_SHARD"))
+    )
+
+
+def _bucket_owner(counts_p: np.ndarray, k: int) -> np.ndarray:
+    """[n_parts] owning-device index: contiguous bucket bands balanced
+    by INSTANCE count (the work proxy), the embed analog of
+    ``mesh.parts_spec``'s contiguous block ownership. Bucket p goes to
+    the band its cumulative-instance midpoint falls in, so owners are
+    monotone nondecreasing — each chip owns one contiguous band."""
+    n_parts = len(counts_p)
+    if n_parts == 0 or k <= 1:
+        return np.zeros(n_parts, dtype=np.int32)
+    cum = np.cumsum(counts_p, dtype=np.float64)
+    total = float(cum[-1])
+    if total <= 0:
+        return np.zeros(n_parts, dtype=np.int32)
+    mid = cum - counts_p / 2.0
+    owner = np.floor(mid / total * k).astype(np.int32)
+    return np.clip(owner, 0, k - 1)
+
+
+def _band_ranges(n_parts: int):
+    """Bucket-band chunking of the campaign/checkpoint grain:
+    ``DBSCAN_EMBED_BAND`` buckets per band (0 = auto, ~8 bands).
+    Returns ``(band_size, n_bands)``."""
+    band_size = int(config.env("DBSCAN_EMBED_BAND"))
+    if band_size <= 0:
+        band_size = max(1, -(-n_parts // 8))
+    return band_size, max(1, -(-n_parts // band_size))
+
+
+def count_banked_bands(ckpt_dir: str) -> int:
+    """Banked bucket-band files in ``ckpt_dir`` — the frontier
+    campaign's ``count_done`` hook (fingerprints are verified at load
+    time, not here; the p1-chunk counting discipline)."""
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return 0
+    return sum(
+        1 for nm in names
+        if nm.startswith("emband") and nm.endswith(".npz")
+    )
+
+
+def _band_fingerprint(
+    unit32, eps, min_points, engine, maxpp, seed, frac, quant,
+    n_parts, band_size,
+) -> str:
+    """Digest of everything that determines a band's bytes: the
+    (sampled) payload plus every knob that moves the binning or the
+    per-bucket results. checkpoint.run_fingerprint's sampling rationale
+    applies verbatim — same-machine resume, not content addressing."""
+    h = hashlib.sha256()
+    h.update(
+        f"emb{_BAND_FMT}|{unit32.shape}|{unit32.dtype}|{float(eps)}|"
+        f"{int(min_points)}|{engine}|{int(maxpp)}|{int(seed)}|"
+        f"{float(frac)}|{quant}|{int(n_parts)}|{int(band_size)}|"
+        .encode()
+    )
+    step = max(1, len(unit32) // 4096)
+    for a in (unit32[:4096], unit32[-4096:], unit32[::step]):
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()[:16]
 
 
 def _resolve_frac(sample_frac) -> float:
@@ -87,6 +174,9 @@ def embed_dbscan(
     sample_frac: float = None,
     oracle_fallback: bool = True,
     stats_out: dict = None,
+    mesh=None,
+    quantizer: str = None,
+    checkpoint_dir: str = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Cosine DBSCAN over dense ``[N, D]`` embeddings.
 
@@ -105,6 +195,19 @@ def embed_dbscan(
     ``stats_out`` (optional dict) receives run diagnostics in the
     driver's stats idiom (``n_partitions``, ``duplication_factor``,
     ``timings``, embed counters).
+
+    ``mesh`` (a ``jax.sharding.Mesh``) shards the run over the device
+    mesh when ``DBSCAN_EMBED_SHARD`` is on: the hash dispatch runs
+    row-sharded, each chip owns a contiguous instance-balanced band of
+    buckets (chip-local neighbor dispatches), and the finalize routes
+    the border-union step through the collective halo-merge
+    (``parallel/halo.py``) — labels byte-identical to the unsharded run
+    (PARITY.md "Sharded embed contract"). ``quantizer`` picks the
+    binning front-end (``'srp'`` | ``'ivf'``; None reads
+    ``DBSCAN_EMBED_QUANTIZER``). ``checkpoint_dir`` banks per-
+    bucket-band results as durable restart points (the campaign grain:
+    a killed run resumes from the banked bands and finalizes
+    byte-identically; ``campaign.run_frontier`` legs ride this).
     """
     engine = getattr(engine, "value", engine)
     if engine not in ("naive", "archery"):
@@ -122,6 +225,14 @@ def embed_dbscan(
             f"max_points_per_partition must be >= 1, got {maxpp}"
         )
     frac = _resolve_frac(sample_frac)
+    if quantizer is None:
+        quant = quantize_mod.default_quantizer()
+    else:
+        quant = str(quantizer).lower()
+        if quant not in ("srp", "ivf"):
+            raise ValueError(
+                f"quantizer must be 'srp' or 'ivf', got {quantizer!r}"
+            )
     obs.ensure_env()
 
     n = len(x)
@@ -149,6 +260,7 @@ def embed_dbscan(
             sub_c, sub_f = _embed_unit(
                 unit[nz_rows], eps, min_points,
                 engine, maxpp, seed, frac, oracle_fallback, stats_out,
+                mesh, quant, checkpoint_dir,
             )
             clusters[nz_rows] = sub_c
             flags[nz_rows] = sub_f
@@ -163,7 +275,7 @@ def embed_dbscan(
         return clusters, flags
     return _embed_unit(
         unit, eps, min_points, engine, maxpp, seed,
-        frac, oracle_fallback, stats_out,
+        frac, oracle_fallback, stats_out, mesh, quant, checkpoint_dir,
     )
 
 
@@ -192,11 +304,13 @@ def _whole_run_oracle(unit32, eps, min_points, engine, stats_out, t0):
 
 def _embed_unit(
     unit32, eps, min_points, engine, maxpp, seed, frac,
-    oracle_fallback, stats_out,
+    oracle_fallback, stats_out, mesh=None, quant="srp",
+    checkpoint_dir=None,
 ):
     """The engine body over PRE-NORMALIZED f32 rows (no zero rows)."""
     import jax
 
+    from dbscan_tpu.parallel import mesh as mesh_mod
     from dbscan_tpu.parallel import pipeline as pipe_mod
     from dbscan_tpu.parallel import spill as spill_mod
     from dbscan_tpu.parallel.driver import _check_dense_width, finalize_merge
@@ -205,11 +319,21 @@ def _embed_unit(
     n, dim = unit32.shape
     obs.count("embed.points", int(n))
     obs.gauge("embed.sample_frac", float(frac))
+    shard = shard_active(mesh)
+    n_shards = mesh_mod.mesh_size(mesh) if shard else 1
+    devices = list(mesh.devices.flat) if shard else None
+    if shard:
+        obs.gauge("embed.shards", float(n_shards))
     # spill halo in chord units; the quantization term covers the
     # neighbor kernel's f32 similarity rounding (error ~ D * 2^-24 per
     # dot), so every kernel-accepted pair is inside the spill band
     halo = spill_mod.chord_halo(eps, dim * 2.0**-23, dim=dim)
     bin_info: dict = {}
+
+    def spill_fallback(idx):
+        return spill_mod.spill_partition(
+            unit32[idx], maxpp, halo, seed=seed
+        )
 
     with obs.span("embed.run", n=int(n), d=int(dim)):
         if n <= maxpp:
@@ -222,6 +346,29 @@ def _embed_unit(
                 "occupancy": [n],
             }
             t_hash = t_bin = time.perf_counter()
+        elif quant == "ivf":
+            # IVF coarse-quantizer front-end: the spill tree's
+            # fp/Lloyd kernels place k-means cells, the exact r_c+halo
+            # bands are the copy-set (embed/quantize.py); the hash
+            # stage does not run at all
+            t_hash = time.perf_counter()
+            try:
+                with obs.span("embed.bin", n=int(n)):
+                    part_ids, point_idx, n_parts, home_of = (
+                        quantize_mod.ivf_bin_points(
+                            unit32, halo, maxpp, seed, spill_fallback,
+                            info=bin_info,
+                        )
+                    )
+            except faults.FatalDeviceFault:
+                # same gate as a persistently-failing hash dispatch:
+                # the quantizer IS the front-end dispatch on this route
+                if not oracle_fallback or n > oracle.ORACLE_MAX_POINTS:
+                    raise
+                return _whole_run_oracle(
+                    unit32, eps, min_points, engine, stats_out, t_start
+                )
+            t_bin = time.perf_counter()
         else:
             bits = lsh.default_bits()
             tables = lsh.default_tables()
@@ -232,7 +379,14 @@ def _embed_unit(
             x_pad[:n, :dim] = unit32
             try:
                 _codes, proj0 = lsh.hash_points(
-                    x_pad, planes, bits, tables
+                    x_pad, planes, bits, tables,
+                    sharding=(
+                        jax.sharding.NamedSharding(
+                            mesh, mesh_mod.parts_spec(mesh)
+                        )
+                        if shard
+                        else None
+                    ),
                 )
             except faults.FatalDeviceFault:
                 if not oracle_fallback or n > oracle.ORACLE_MAX_POINTS:
@@ -241,11 +395,6 @@ def _embed_unit(
                     unit32, eps, min_points, engine, stats_out, t_start
                 )
             t_hash = time.perf_counter()
-
-            def spill_fallback(idx):
-                return spill_mod.spill_partition(
-                    unit32[idx], maxpp, halo, seed=seed
-                )
 
             with obs.span("embed.bin", n=int(n)):
                 part_ids, point_idx, n_parts, home_of = lsh.bin_points(
@@ -278,6 +427,13 @@ def _embed_unit(
         eff_min = neighbors.eff_min_points(min_points, frac)
         keep_num = neighbors.keep_threshold(frac)
         pull_pipe = pipe_mod.get_engine()
+        # contiguous instance-balanced bucket bands, one per chip — the
+        # embed analog of mesh.parts_spec's contiguous block ownership
+        owner = (
+            _bucket_owner(counts_p, n_shards)
+            if shard
+            else np.zeros(n_parts, dtype=np.int32)
+        )
         results: dict = {}
         edges = 0
         cc_iters_max = 0
@@ -309,8 +465,9 @@ def _embed_unit(
 
         def _dispatch(p: int, w: int):
             """One supervised ``embed.neighbors`` dispatch for bucket
-            ``p`` at W rung ``w``; returns the device (or fallback
-            numpy) output tuple plus the layout it was built from."""
+            ``p`` at W rung ``w``; sharded runs place the inputs on the
+            bucket's owning chip first (jit follows placement, so the
+            dispatch runs chip-local)."""
             import jax.numpy as jnp
 
             lo, hi = int(offsets[p]), int(offsets[p + 1])
@@ -330,20 +487,35 @@ def _embed_unit(
                 if oracle_fallback
                 else None
             )
-            with obs.span("embed.bucket", p=int(p), b=b, w=int(w)):
+
+            def _call(_budget):
+                xb_d = jnp.asarray(xb)
+                maskb_d = jnp.asarray(maskb)
+                ids_d = jnp.asarray(ids)
+                if shard:
+                    dev = devices[int(owner[p])]
+                    xb_d = jax.device_put(xb_d, dev)
+                    maskb_d = jax.device_put(maskb_d, dev)
+                    ids_d = jax.device_put(ids_d, dev)
+                return obs_compile.tracked_call(
+                    "embed.neighbors",
+                    fn,
+                    xb_d,
+                    maskb_d,
+                    ids_d,
+                    float(eps),
+                    int(eff_min),
+                    int(keep_num),
+                    int(seed),
+                )
+
+            span_args = {"p": int(p), "b": b, "w": int(w)}
+            if shard:
+                span_args["shard"] = int(owner[p])
+            with obs.span("embed.bucket", **span_args):
                 out = faults.supervised(
                     faults.SITE_EMBED,
-                    lambda _budget: obs_compile.tracked_call(
-                        "embed.neighbors",
-                        fn,
-                        jnp.asarray(xb),
-                        jnp.asarray(maskb),
-                        jnp.asarray(ids),
-                        float(eps),
-                        int(eff_min),
-                        int(keep_num),
-                        int(seed),
-                    ),
+                    _call,
                     fallback=fallback,
                     label=f"bucket{p}",
                 )
@@ -373,66 +545,156 @@ def _embed_unit(
                 int(iters),
             )
 
-        jobs = []
-        disp_w: dict = {}
-        try:
-            for p in range(n_parts):
-                w = neighbors.w_floor(int(widths[p]), eff_min)
-                disp_w[p] = w
-                out = _dispatch(p, w)
-                if pull_pipe is not None:
-                    jobs.append(
-                        (
-                            pull_pipe.submit(
-                                functools.partial(_land, p, out),
-                                bytes_hint=int(widths[p]) * 9,
-                                label=f"embed{p}",
-                            ),
-                            functools.partial(_land, p, out),
-                        )
-                    )
-                else:
-                    _land(p, out)
-        except BaseException:
-            # mirror spill_device's orphan-drain: pulls already
-            # submitted must not outlive a failing dispatch loop on the
-            # shared worker (their results land in state this frame is
-            # about to drop)
-            for job, _work in jobs:
-                try:
-                    pull_pipe.wait(job)
-                except Exception:  # noqa: BLE001 — already failing
-                    pass
-            raise
-        for job, work in jobs:
-            pull_pipe.settle(job, work)
-        t_dispatch = time.perf_counter()
+        band_size, n_bands = _band_ranges(n_parts)
+        bands_loaded = 0
+        fingerprint = None
+        ckpt_mod = None
+        if checkpoint_dir is not None:
+            from dbscan_tpu.parallel import checkpoint as ckpt_mod
 
-        # W-rung escalation: any bucket whose table truncated re-runs
-        # synchronously at the rung its observed max degree needs; the
-        # ratchet pins the settled rung so the NEXT same-width bucket
-        # starts there (zero recompiles at steady state)
-        for p in range(n_parts):
-            seed_h, flag_h, cnt_h, ovf, iters = results[p]
-            b = int(widths[p])
-            w = int(disp_w[p])
-            while ovf:
-                c = int(counts_p[p])
-                need = int(cnt_h[:c].max()) - 1 if c else 1
-                w = neighbors.next_w(b, need)  # > old w: overflow
-                # means some observed degree exceeded the old rung
-                escalations += 1
-                obs.count("embed.neighbor_escalations")
-                _land(p, _dispatch(p, w))
-                seed_h, flag_h, cnt_h, ovf, iters = results[p]
-            neighbors.note_w(b, w)
-            lo, hi = int(offsets[p]), int(offsets[p + 1])
-            c = hi - lo
-            inst_seed[lo:hi] = seed_h[:c]
-            inst_flag[lo:hi] = flag_h[:c]
-            edges += int(np.asarray(cnt_h[:c], dtype=np.int64).sum())
-            cc_iters_max = max(cc_iters_max, int(iters))
-            prop_sweeps += int(iters)
+            os.makedirs(checkpoint_dir, exist_ok=True)
+            fingerprint = _band_fingerprint(
+                unit32, eps, min_points, engine, maxpp, seed, frac,
+                quant, n_parts, band_size,
+            )
+            ckpt_mod.write_progress(
+                checkpoint_dir, chunks_total=int(n_bands)
+            )
+
+        def _load_band(band: int, lo_b: int, hi_b: int) -> bool:
+            """Restore one banked band; False (re-run the band) on any
+            mismatch — a stale fingerprint must never splice another
+            run's instances into this one."""
+            nonlocal edges, cc_iters_max, prop_sweeps, bands_loaded
+            path = os.path.join(
+                checkpoint_dir, _BAND_FILE.format(band)
+            )
+            lo0, hi0 = int(offsets[lo_b]), int(offsets[hi_b])
+            try:
+                with np.load(path, allow_pickle=False) as z:
+                    if str(z["fp"]) != fingerprint:
+                        return False
+                    seed_b = np.asarray(z["seed"], dtype=np.int32)
+                    flag_b = np.asarray(z["flag"], dtype=np.int8)
+                    if len(seed_b) != hi0 - lo0:
+                        return False
+                    inst_seed[lo0:hi0] = seed_b
+                    inst_flag[lo0:hi0] = flag_b
+                    edges += int(z["edges"])
+                    cc_iters_max = max(cc_iters_max, int(z["iters"]))
+                    prop_sweeps += int(z["sweeps"])
+            except (OSError, KeyError, ValueError):
+                return False
+            obs.count("embed.bands_loaded")
+            bands_loaded += 1
+            return True
+
+        def _bank_band(band, lo_b, hi_b, edges_b, iters_b, sweeps_b):
+            """Bank one settled band atomically (tmp + rename), then
+            bump the sidecar progress counter — the frontier campaign's
+            ``leg_progressed`` signal."""
+            path = os.path.join(
+                checkpoint_dir, _BAND_FILE.format(band)
+            )
+            lo0, hi0 = int(offsets[lo_b]), int(offsets[hi_b])
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                np.savez(
+                    fh,
+                    fp=np.asarray(fingerprint),
+                    seed=inst_seed[lo0:hi0],
+                    flag=inst_flag[lo0:hi0],
+                    edges=np.int64(edges_b),
+                    iters=np.int64(iters_b),
+                    sweeps=np.int64(sweeps_b),
+                )
+            os.replace(tmp, path)
+            obs.count("embed.bands_banked")
+            ckpt_mod.bump_progress(
+                checkpoint_dir, ckpt_mod.PROGRESS_WRITE_COUNTER
+            )
+
+        dur_dispatch = 0.0
+        dur_pull = 0.0
+        for band in range(n_bands):
+            lo_b = band * band_size
+            hi_b = min(n_parts, lo_b + band_size)
+            if checkpoint_dir is not None and _load_band(
+                band, lo_b, hi_b
+            ):
+                continue
+            t_b0 = time.perf_counter()
+            edges0, sweeps0 = edges, prop_sweeps
+            band_iters = 0
+            jobs = []
+            disp_w: dict = {}
+            try:
+                for p in range(lo_b, hi_b):
+                    w = neighbors.w_floor(int(widths[p]), eff_min)
+                    disp_w[p] = w
+                    out = _dispatch(p, w)
+                    if pull_pipe is not None:
+                        jobs.append(
+                            (
+                                pull_pipe.submit(
+                                    functools.partial(_land, p, out),
+                                    bytes_hint=int(widths[p]) * 9,
+                                    label=f"embed{p}",
+                                ),
+                                functools.partial(_land, p, out),
+                            )
+                        )
+                    else:
+                        _land(p, out)
+            except BaseException:
+                # mirror spill_device's orphan-drain: pulls already
+                # submitted must not outlive a failing dispatch loop on
+                # the shared worker (their results land in state this
+                # frame is about to drop)
+                for job, _work in jobs:
+                    try:
+                        pull_pipe.wait(job)
+                    except Exception:  # noqa: BLE001 — already failing
+                        pass
+                raise
+            for job, work in jobs:
+                pull_pipe.settle(job, work)
+            dur_dispatch += time.perf_counter() - t_b0
+            t_b1 = time.perf_counter()
+
+            # W-rung escalation: any bucket whose table truncated
+            # re-runs synchronously at the rung its observed max degree
+            # needs; the ratchet pins the settled rung so the NEXT
+            # same-width bucket starts there (zero recompiles at
+            # steady state)
+            for p in range(lo_b, hi_b):
+                seed_h, flag_h, cnt_h, ovf, iters = results.pop(p)
+                b = int(widths[p])
+                w = int(disp_w[p])
+                while ovf:
+                    c = int(counts_p[p])
+                    need = int(cnt_h[:c].max()) - 1 if c else 1
+                    w = neighbors.next_w(b, need)  # > old w: overflow
+                    # means some observed degree exceeded the old rung
+                    escalations += 1
+                    obs.count("embed.neighbor_escalations")
+                    _land(p, _dispatch(p, w))
+                    seed_h, flag_h, cnt_h, ovf, iters = results.pop(p)
+                neighbors.note_w(b, w)
+                lo, hi = int(offsets[p]), int(offsets[p + 1])
+                c = hi - lo
+                inst_seed[lo:hi] = seed_h[:c]
+                inst_flag[lo:hi] = flag_h[:c]
+                edges += int(np.asarray(cnt_h[:c], dtype=np.int64).sum())
+                band_iters = max(band_iters, int(iters))
+                cc_iters_max = max(cc_iters_max, int(iters))
+                prop_sweeps += int(iters)
+            dur_pull += time.perf_counter() - t_b1
+            if checkpoint_dir is not None:
+                _bank_band(
+                    band, lo_b, hi_b,
+                    edges - edges0, band_iters, prop_sweeps - sweeps0,
+                )
         obs.count("embed.edges", int(edges))
         if prop_sweeps:
             # the shared propagation telemetry (ops/propagation.py):
@@ -440,15 +702,22 @@ def _embed_unit(
             # prop.sweeps so leg-1's collapse is measured on the embed
             # path too, not just the banded cellcc finalize
             prop_propagation.note_sweeps(prop_sweeps)
-        t_pull = time.perf_counter()
+        t_bands = time.perf_counter()
 
         cand, inst_inner = spill_mod.band_membership(
             part_ids, point_idx, home_of, n
         )
+        # sharded finalize routes the border-union step through the
+        # collective halo-merge (parallel/halo.py): the boundary-spill
+        # duplicates ARE the eps-halo points, so cross-chip components
+        # reconcile with no new merge algebra; canonical numbering
+        # keeps the labels byte-identical to the unsharded run
         with obs.span("embed.merge", instances=int(m_tot)):
             clusters, flags, n_clusters = finalize_merge(
                 part_ids, point_idx, inst_seed, inst_flag, cand,
                 inst_inner, n, n_parts, max_b, canonical=True,
+                mesh=mesh if shard else None,
+                shape_floors=_MERGE_FLOORS if shard else None,
             )
         t_end = time.perf_counter()
 
@@ -465,12 +734,18 @@ def _embed_unit(
             embed_cc_iters=int(cc_iters_max),
             embed_escalations=int(escalations),
             embed_oracle_buckets=int(oracle_buckets[0]),
+            embed_quantizer=quant,
+            embed_ivf_cells=int(bin_info.get("cells", 0)),
+            embed_shards=int(n_shards),
+            campaign_chunks_total=int(n_bands),
+            campaign_bands_loaded=int(bands_loaded),
+            resumed_from_checkpoint=bool(bands_loaded),
             timings={
                 "hash_s": round(t_hash - t_start, 6),
                 "bin_s": round(t_bin - t_hash, 6),
-                "dispatch_s": round(t_dispatch - t_bin, 6),
-                "pull_s": round(t_pull - t_dispatch, 6),
-                "merge_s": round(t_end - t_pull, 6),
+                "dispatch_s": round(dur_dispatch, 6),
+                "pull_s": round(dur_pull, 6),
+                "merge_s": round(t_end - t_bands, 6),
                 "total_s": round(t_end - t_start, 6),
             },
         )

@@ -116,14 +116,19 @@ def _hash_fn(bits: int, tables: int):
 
 
 def hash_points(
-    x_pad: np.ndarray, planes: np.ndarray, bits: int, tables: int
+    x_pad: np.ndarray, planes: np.ndarray, bits: int, tables: int,
+    sharding=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """``embed.hash`` device dispatch under fault supervision: returns
     host ``(codes [n_pad, T] int32, proj0 [n_pad, H] f32)``.
 
     ``x_pad`` is the ladder-padded [n_pad, d_pad] f32 payload (zero
     rows/columns hash harmlessly — padded rows' codes are never read,
-    padded columns meet zero plane weights). A persistent device fault
+    padded columns meet zero plane weights). ``sharding`` (a
+    ``jax.sharding.NamedSharding`` over the row axis) runs the matmul
+    row-sharded over the mesh with the small plane matrix replicated —
+    per-row results are the single-device bytes exactly, since each
+    output row reads only its own input row. A persistent device fault
     raises :class:`dbscan_tpu.faults.FatalDeviceFault`; the engine owns
     the whole-run oracle degradation decision."""
     import jax
@@ -131,6 +136,15 @@ def hash_points(
 
     fn = _hash_fn(int(bits), int(tables))
     obs.count("embed.hash_dispatches")
+
+    def _call(_b):
+        xd = jnp.asarray(x_pad)
+        if sharding is not None:
+            xd = jax.device_put(xd, sharding)
+        return obs_compile.tracked_call(
+            "embed.hash", fn, xd, jnp.asarray(planes)
+        )
+
     with obs.span(
         "embed.hash",
         n=int(x_pad.shape[0]),
@@ -140,12 +154,7 @@ def hash_points(
     ) as sp:
         out = faults.supervised(
             faults.SITE_EMBED,
-            lambda _b: obs_compile.tracked_call(
-                "embed.hash",
-                fn,
-                jnp.asarray(x_pad),
-                jnp.asarray(planes),
-            ),
+            _call,
             label="hash",
         )
         sp.sync(out)

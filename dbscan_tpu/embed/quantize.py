@@ -1,0 +1,218 @@
+"""IVF-style coarse quantizer front-end for the embed engine
+(``DBSCAN_EMBED_QUANTIZER=ivf``).
+
+The spill tree's farthest-point/Lloyd kernels ARE the quantizer: one
+``embed.quantize`` dispatch reuses ``spill_device._farthest_lloyd_fn``
+(fp seeding + Lloyd steps, already device-resident and
+dimension-agnostic) to place ``m`` k-means cells on the unit sphere and
+computes the full ``[n, m]`` chord matrix in the same compiled body.
+Host side, membership is the spill tree's EXACT band formula
+(``spill._membership``: intersection of the radius band ``r_c + halo``
+and the classic ``d_min + 2*halo``), so the coverage argument is the
+spill tree's own, verbatim: a point assigned to cell c pulls every
+chord-halo neighbor into c's member set — neighborhood completeness at
+the home cell, the invariant ``finalize_merge`` needs for exact core
+flags. Cells the bands still leave over ``maxpp`` recurse through the
+same pivot-spill fallback the SRP path uses; pairs crossing the
+fallback cell's boundary were already covered by the cell bands, pairs
+inside it are the spill tree's standard guarantee — the identical
+composition ``embed/lsh.py`` documents for its hyperplane recursion.
+
+k-means cells replace SRP planes as the BINNING only: bucket
+dispatches, escalation, merge, and the canonical numbering are the
+shared engine path, so on bridge-free workloads the label vector is
+byte-identical to the SRP route (and to any mesh shape) — the contract
+tests/test_embed_sharded.py pins, with the ARI >= 0.95 gate declared
+alongside the sampled mode's in PARITY.md.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Tuple
+
+import numpy as np
+
+from dbscan_tpu import config, faults, obs
+from dbscan_tpu.obs import compile as obs_compile
+from dbscan_tpu.parallel.binning import _ladder_width
+
+
+def default_quantizer() -> str:
+    """The binning front-end: ``DBSCAN_EMBED_QUANTIZER`` ('srp' |
+    'ivf'); unknown values raise — a typo must not silently run the
+    default partitioner under a benchmark labeled 'ivf'."""
+    q = str(config.env("DBSCAN_EMBED_QUANTIZER") or "srp").lower()
+    if q not in ("srp", "ivf"):
+        raise ValueError(
+            f"DBSCAN_EMBED_QUANTIZER must be 'srp' or 'ivf', got {q!r}"
+        )
+    return q
+
+
+def default_cells(n: int, maxpp: int) -> int:
+    """IVF cell count: the knob when set, else ~2x the payload/maxpp
+    ratio (each cell targets ~half a bucket so the band duplication
+    rarely pushes a cell over ``maxpp``), clamped to the spill ladder's
+    range."""
+    cells = int(config.env("DBSCAN_EMBED_IVF_CELLS"))
+    if cells <= 0:
+        cells = 2 * max(1, -(-int(n) // max(1, int(maxpp))))
+    return max(2, min(192, cells))
+
+
+@functools.lru_cache(maxsize=32)
+def _quantize_fn(m: int, dim: int):
+    """Jitted ``embed.quantize`` body: the spill tree's fp+Lloyd kernel
+    (``spill_device._farthest_lloyd_fn`` — called inside this jit, so
+    the two compile as ONE dispatch) followed by the [n, m] chord
+    matrix against the surviving pivots; empty cells chord +inf so the
+    host membership can never assign to them."""
+    import jax
+    import jax.numpy as jnp
+
+    from dbscan_tpu.parallel import spill_device
+
+    inner = spill_device._farthest_lloyd_fn(m, dim)
+
+    def fn(x, seed0):
+        piv, mass = inner(x, seed0)
+        d = 2.0 - 2.0 * (x.astype(jnp.float32) @ piv.T)
+        d = jnp.sqrt(jnp.maximum(d, 0.0))
+        d = jnp.where((mass > 0)[None, :], d, jnp.inf)
+        return piv, mass, d
+
+    return jax.jit(fn)
+
+
+def quantize_points(
+    unit32: np.ndarray, cells: int, seed: int
+) -> np.ndarray:
+    """One supervised ``embed.quantize`` dispatch over the (pad-
+    replicated) payload: returns the host ``[n, m]`` chord matrix.
+
+    Rows are padded to the shared 128-ladder by REPLICATING row 0 —
+    zero-pad rows would sit at chord sqrt(2) from every unit row and
+    the farthest-point seeding would elect them as pivots; duplicates
+    of a real row have chord 0 to it and can never be re-chosen.
+    A persistent device fault raises
+    :class:`dbscan_tpu.faults.FatalDeviceFault`; the engine owns the
+    whole-run oracle degradation decision (the hash dispatch's gate).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n, dim = unit32.shape
+    m = _ladder8_cells(cells)
+    n_pad = _ladder_width(n, 128)
+    d_pad = _ladder_width(dim, 8)
+    x_pad = np.zeros((n_pad, d_pad), dtype=np.float32)
+    x_pad[:n, :dim] = unit32
+    x_pad[n:, :dim] = unit32[0]
+    rng = np.random.default_rng([seed, n, dim, m])
+    seed0 = int(rng.integers(n))
+    fn = _quantize_fn(m, d_pad)
+    obs.count("embed.quantize_dispatches")
+    obs.gauge("embed.ivf_cells", float(m))
+    with obs.span(
+        "embed.quantize", n=int(n), d=int(dim), cells=int(m)
+    ) as sp:
+        out = faults.supervised(
+            faults.SITE_EMBED,
+            lambda _b: obs_compile.tracked_call(
+                "embed.quantize", fn, jnp.asarray(x_pad), seed0
+            ),
+            label="quantize",
+        )
+        sp.sync(out)
+    _piv, _mass, d = jax.device_get(out)
+    obs.count("transfer.h2d_bytes", int(x_pad.nbytes))
+    obs.count("transfer.d2h_bytes", int(np.asarray(d).nbytes))
+    return np.asarray(d, dtype=np.float64)[:n]
+
+
+def _ladder8_cells(m: int) -> int:
+    from dbscan_tpu.parallel.spill_device import _ladder8
+
+    return _ladder8(int(m))
+
+
+def ivf_bin_points(
+    unit32: np.ndarray,
+    halo: float,
+    maxpp: int,
+    seed: int,
+    spill_fallback: Callable,
+    info: dict = None,
+) -> Tuple[np.ndarray, np.ndarray, int, np.ndarray]:
+    """IVF binning with the exact spill-band copy-set: returns
+    ``(part_ids [M], point_idx [M], n_parts, home_of [N])`` in the
+    (partition, point)-sorted layout ``band_membership`` and
+    ``finalize_merge`` consume — the same contract as
+    ``lsh.bin_points``, with k-means cells in place of hyperplane
+    leaves. ``info`` receives the binning diagnostics dict the engine
+    folds into counters (plus ``cells``, the surviving cell count)."""
+    from dbscan_tpu.parallel import spill as spill_mod
+
+    n = len(unit32)
+    d = quantize_points(unit32, default_cells(n, maxpp), seed)
+    assign, _d_min, _r, member = spill_mod._membership(d, float(halo))
+
+    part_blocks = []
+    home_of = np.full(n, -1, dtype=np.int32)
+    occupancy: list = []
+    next_pid = 0
+    buckets = 0
+    fallbacks = 0
+    fallback_points = 0
+    live_cells = 0
+    for c in range(d.shape[1]):
+        idx = np.flatnonzero(member[:, c])
+        if len(idx) == 0:
+            continue
+        live_cells += 1
+        home = assign[idx] == c
+        if len(idx) <= maxpp:
+            pid = next_pid
+            next_pid += 1
+            buckets += 1
+            occupancy.append(len(idx))
+            part_blocks.append(
+                (np.full(len(idx), pid, dtype=np.int64), idx)
+            )
+            home_of[idx[home]] = pid
+            continue
+        # a cell the bands still leave oversized recurses through the
+        # pivot spill tree over ITS member rows — crossing pairs were
+        # covered by the cell bands, inner pairs by the tree (the
+        # composition lsh.bin_points documents)
+        fallbacks += 1
+        fallback_points += len(idx)
+        pa, pi, n_sub, home_sub = spill_fallback(idx)
+        part_blocks.append(
+            (np.asarray(pa, np.int64) + next_pid, idx[pi])
+        )
+        sizes = np.bincount(pa, minlength=n_sub)
+        occupancy.extend(int(s) for s in sizes)
+        home_of[idx[home]] = (
+            np.asarray(home_sub, np.int64) + next_pid
+        )[home].astype(np.int32)
+        next_pid += int(n_sub)
+
+    if part_blocks:
+        part_ids = np.concatenate([b[0] for b in part_blocks])
+        point_idx = np.concatenate([b[1] for b in part_blocks])
+        order = np.lexsort((point_idx, part_ids))
+        part_ids = part_ids[order]
+        point_idx = point_idx[order]
+    else:
+        part_ids = np.empty(0, np.int64)
+        point_idx = np.empty(0, np.int64)
+    if info is not None:
+        info["buckets"] = buckets
+        info["fallbacks"] = fallbacks
+        info["fallback_points"] = fallback_points
+        info["occupancy"] = occupancy
+        info["cells"] = live_cells
+    assert (home_of >= 0).all(), "every point needs exactly one home cell"
+    return part_ids, point_idx, next_pid, home_of

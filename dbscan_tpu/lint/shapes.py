@@ -518,6 +518,26 @@ def _models() -> Dict[str, FamilyModel]:
                 "rung — data-scaled, runtime-gated",
             ),
             FamilyModel(
+                "embed.quantize",
+                [
+                    ArgModel("x", ("N", "D"), FLOAT),
+                ],
+                # temps/outs: the [N, M] chord matrix (f32 on device)
+                # + the [M, D] pivot matrix, masses, and the fp/Lloyd
+                # working copies. M (the post-ladder IVF cell count) is
+                # not an arg dim — data-scaled like embed.neighbors' W,
+                # runtime-gated; the fp seed rides as a plain Python
+                # scalar.
+                overhead=_sy("N") * _sy("M") * 8
+                + _sy("M") * (_sy("D") * 8 + 8),
+                static_slots=None,
+                note="IVF coarse quantizer for the embed engine "
+                "(dbscan_tpu/embed/quantize.py): the spill tree's "
+                "fp+Lloyd kernel over the padded payload plus the "
+                "[N, M] chord matrix against M post-ladder cells — "
+                "data-scaled, runtime-gated",
+            ),
+            FamilyModel(
                 "density.core",
                 [
                     ArgModel("x", ("N", "D"), FLOAT),

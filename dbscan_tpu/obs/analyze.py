@@ -89,6 +89,7 @@ SECTIONS = {
     "campaign": ("counter", schema.PREFIX_CAMPAIGN),
     "serve": ("counter", schema.PREFIX_SERVE),
     "embed": ("counter", schema.PREFIX_EMBED),
+    "embed_shards": ("span", ("embed.bucket",)),
     "devtime": ("counter", _DEVTIME_KEYS),
     "pull_check": ("counter", _PULL_CHECK_KEYS),
     "requests": ("span", None),  # rid-stamped spans; no name filter
@@ -499,6 +500,7 @@ def analyze(data: dict, top: Optional[int] = None) -> dict:
         "campaign": _campaign_rollup(counters),
         "serve": _serve_rollup(counters, spans),
         "embed": _embed_rollup(counters, data["gauges"]),
+        "embed_shards": _embed_shard_rollup(spans),
         "devtime": _devtime_rollup(counters, spans),
         "pull_check": _pull_device_check(counters, spans),
         "requests": _requests_rollup(data, top=top or 10),
@@ -626,6 +628,51 @@ def _embed_rollup(counters: dict, gauges: dict) -> dict:
     if frac is not None:
         out["embed.sampled_edge_frac"] = round(float(frac), 6)
     return out
+
+
+def _embed_shard_rollup(spans: list) -> dict:
+    """Per-shard busy share of a sharded embed run: EXACT interval
+    union of each shard's ``embed.bucket`` dispatch windows (the
+    ``_union_intervals`` primitive, so a shard's overlapping
+    escalation re-runs never double-count), with shares normalized
+    over the total busy seconds — near-equal shares across the mesh is
+    the bucket-band balance evidence ROADMAP item 1 asks --merge to
+    show. The shard id prefers the span-arg ``shard`` (the owning chip
+    the engine stamps) and falls back to the merge-assigned process
+    shard, so both a single-process mesh capture and an
+    ``obs.analyze --merge`` of per-process traces roll up. Empty ({})
+    when no bucket span carries a shard — unsharded captures render
+    identically to before."""
+    by_shard: dict = {}
+    for sp in spans:
+        if sp.get("name") != "embed.bucket":
+            continue
+        shard = (sp.get("args") or {}).get("shard", sp.get("shard"))
+        if shard is None:
+            continue
+        by_shard.setdefault(int(shard), []).append(
+            (sp["t0"], sp["t0"] + sp["dur"])
+        )
+    if not by_shard:
+        return {}
+    rows = []
+    busies = {}
+    for shard in sorted(by_shard):
+        iv = _union_intervals(by_shard[shard])
+        busies[shard] = sum(t1 - t0 for t0, t1 in iv)
+        rows.append(
+            {
+                "shard": shard,
+                "buckets": len(by_shard[shard]),
+                "busy_s": round(busies[shard], 6),
+            }
+        )
+    total = sum(busies.values())
+    for r in rows:
+        r["busy_share"] = (
+            round(busies[r["shard"]] / total, 6) if total > 0 else 0.0
+        )
+    return {"shards": rows, "busy_s": round(total, 6)}
 
 
 def _serve_rollup(counters: dict, spans: list) -> dict:
@@ -1016,6 +1063,19 @@ def render(report: dict) -> str:
         for k, v in report["embed"].items():
             v = round(v, 6) if isinstance(v, float) else v
             out.append(f"{k:<36} {v:>12}")
+    es = report.get("embed_shards") or {}
+    if es:
+        out.append("")
+        out.append("-- embed shards (bucket-band busy share) --")
+        out.append(
+            f"{'shard':<8} {'buckets':>8} {'busy_s':>10} {'share':>8}"
+        )
+        for r in es["shards"]:
+            out.append(
+                f"{r['shard']:<8} {r['buckets']:>8} "
+                f"{r['busy_s']:>10.3f} {r['busy_share']:>8.3f}"
+            )
+        out.append(f"total busy {es['busy_s']:.3f}s")
     dev = report.get("devtime") or {}
     if dev:
         out.append("")

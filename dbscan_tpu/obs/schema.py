@@ -56,6 +56,7 @@ COMPILE_FAMILIES = (
     "serve.broadcast",
     "embed.hash",
     "embed.neighbors",
+    "embed.quantize",
     "density.core",
     "density.boruvka",
     "density.condense",
@@ -256,6 +257,13 @@ COUNTERS = {
     "embed.oracle_fallbacks": "embed dispatches degraded to the numpy "
     "host oracle after persistent faults (per bucket, or one for a "
     "whole-run hash degradation)",
+    "embed.quantize_dispatches": "embed.quantize IVF coarse-quantizer "
+    "dispatches issued (fp seeding + Lloyd + chord matrix, one per "
+    "ivf-routed run)",
+    "embed.bands_banked": "bucket-band checkpoint files banked by "
+    "checkpointed embed runs (the campaign restart-point grain)",
+    "embed.bands_loaded": "bucket-band checkpoint files restored on "
+    "resume (fingerprint-verified; loaded bands skip their dispatches)",
     "embed.occ_le_64": "embed buckets holding <= 64 points "
     "(occupancy-histogram edge)",
     "embed.occ_le_1024": "embed buckets holding 65..1024 points",
@@ -339,6 +347,10 @@ GAUGES = {
     "embed.sample_frac": "sampled-edge keep probability of the last "
     "embed run (1.0 = exact path) — the declared accuracy knob the "
     "analyzer's sampled-edge fraction reads back",
+    "embed.ivf_cells": "IVF coarse-quantizer cell count (post-ladder) "
+    "of the last embed.quantize dispatch",
+    "embed.shards": "device count of the last sharded embed run "
+    "(mesh size; unsharded runs never set this)",
     "prop.mode": "resolved propagation mode of the last settled "
     "window_cc-family fixed point (1.0 = unionfind, 0.0 = iterated — "
     "DBSCAN_PROP_UNIONFIND, ops/propagation.py note_sweeps)",
@@ -402,7 +414,10 @@ SPANS = {
     "embed.bin": "host boundary-spill binning over the primary-table "
     "projections (spill-tree fallbacks nest inside)",
     "embed.bucket": "one embed bucket neighbor dispatch window "
-    "(partition id, width, W rung attached)",
+    "(partition id, width, W rung attached; sharded runs attach the "
+    "owning shard — the per-shard busy-share section's input)",
+    "embed.quantize": "embed IVF coarse-quantizer dispatch window "
+    "(fp seeding + Lloyd + chord matrix; n, d, cells attached)",
     "embed.merge": "embed instance-table merge (shared finalize_merge)",
     "density.run": "root span over one density-engine run (n, metric, "
     "kind=hdbscan/optics attached)",

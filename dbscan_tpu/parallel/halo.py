@@ -46,6 +46,13 @@ Shapes ride the usual ladders: nodes and edges pad to
 ZERO new kernels. ``DBSCAN_MESH_MERGE=0`` keeps the host union-find as
 the parity oracle; runs without a mesh (or a 1-device mesh) never enter
 this path.
+
+The sharded embed engine (embed/engine.py, ``DBSCAN_EMBED_SHARD``)
+rides this kernel unchanged: its LSH boundary-spill duplicates ARE the
+eps-halo points — a point spilled into two buckets is observed by both
+owning chips, exactly like a doubly-labeled border seed — so the
+cross-chip component union needs no embed-specific merge algebra, just
+these border unions over bucket-band shards.
 """
 
 from __future__ import annotations

@@ -1,0 +1,510 @@
+"""Sharded embed campaigns suite (ISSUE 20): byte-identical labels
+across 1/2/4/8-device meshes x LSH seeds x quantizer front-ends
+(``srp`` | ``ivf``), the IVF route's ARI >= 0.95 gate vs the exact
+spill route, bucket-band checkpoint banking with a mid-campaign
+SIGTERM drill resuming byte-identical, the frontier campaign kill
+drill over bucket-band chunks (``count_done=count_banked_bands``), the
+knob/telemetry/family registrations, the ``DBSCAN_SHAPECHECK=1``
+subprocess drill covering embed.hash/embed.neighbors/embed.quantize,
+the exact-arithmetic per-shard busy-share rollup, and the
+embed1b_mpts/embed1b_replay_frac history promotion + gate directions.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dbscan_tpu import config, embed_dbscan, faults
+from dbscan_tpu.embed import engine as embed_engine
+from dbscan_tpu.embed import neighbors
+from dbscan_tpu.utils.ari import adjusted_rand_index
+
+pytestmark = pytest.mark.embed
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_embed_state(monkeypatch):
+    monkeypatch.setenv("DBSCAN_FAULT_BACKOFF_S", "0")
+    faults.reset_registry()
+    neighbors.reset_w_floors()
+    yield
+    faults.reset_registry()
+
+
+def _blobs(rng, d, k, per, noise, n_noise=0):
+    c = rng.normal(size=(k, d))
+    c /= np.linalg.norm(c, axis=1, keepdims=True)
+    x = np.repeat(c, per, axis=0) + noise * rng.normal(size=(k * per, d))
+    if n_noise:
+        x = np.concatenate([x, rng.normal(size=(n_noise, d))])
+    return x
+
+
+def _mesh(k):
+    import jax
+
+    from dbscan_tpu.parallel import mesh as mesh_mod
+
+    return mesh_mod.make_mesh(jax.devices()[:k])
+
+
+# --- tentpole: byte-identity across meshes x seeds x quantizers --------
+
+
+@pytest.mark.parametrize("quantizer", ["srp", "ivf"])
+def test_labels_byte_identical_across_mesh_shapes(quantizer):
+    """THE sharding contract: the label vector is a function of the
+    data alone — 1/2/4/8-device meshes all produce the unsharded run's
+    exact bytes, on both binning front-ends."""
+    rng = np.random.default_rng(7)
+    x = _blobs(rng, 24, 6, 40, 0.01, n_noise=12)
+    kw = dict(max_points_per_partition=64, quantizer=quantizer)
+    base_c, base_f = embed_dbscan(x, 0.05, 5, **kw)
+    assert len(np.unique(base_c[base_c > 0])) == 6
+    for k in (2, 4, 8):
+        stats: dict = {}
+        c, f = embed_dbscan(x, 0.05, 5, mesh=_mesh(k), stats_out=stats, **kw)
+        np.testing.assert_array_equal(c, base_c)
+        np.testing.assert_array_equal(f, base_f)
+        assert stats["embed_shards"] == k
+
+
+def test_labels_byte_identical_across_lsh_seeds_on_mesh():
+    """Sharded runs keep the canonical renumbering contract: the LSH
+    seed moves buckets and bucket owners, never a label."""
+    rng = np.random.default_rng(11)
+    x = _blobs(rng, 16, 5, 36, 0.01, n_noise=10)
+    kw = dict(max_points_per_partition=64)
+    base_c, _bf = embed_dbscan(x, 0.05, 5, seed=0, **kw)
+    for seed in (0, 1, 5):
+        c, _f = embed_dbscan(x, 0.05, 5, seed=seed, mesh=_mesh(4), **kw)
+        np.testing.assert_array_equal(c, base_c)
+
+
+def test_shard_knob_off_disables_mesh_dispatch(monkeypatch):
+    """DBSCAN_EMBED_SHARD=0 is the escape hatch: a passed mesh is
+    ignored (shard_active False) and labels are unchanged."""
+    monkeypatch.setenv("DBSCAN_EMBED_SHARD", "0")
+    rng = np.random.default_rng(3)
+    x = _blobs(rng, 16, 4, 30, 0.01)
+    base_c, _ = embed_dbscan(x, 0.05, 5, max_points_per_partition=48)
+    assert not embed_engine.shard_active(_mesh(4))
+    stats: dict = {}
+    c, _ = embed_dbscan(
+        x, 0.05, 5, max_points_per_partition=48, mesh=_mesh(4),
+        stats_out=stats,
+    )
+    np.testing.assert_array_equal(c, base_c)
+    assert stats["embed_shards"] == 1
+
+
+def test_bucket_owner_contiguous_and_balanced():
+    """Bucket bands are contiguous (owners monotone nondecreasing) and
+    instance-balanced: equal-weight buckets split evenly."""
+    counts = np.full(8, 100, dtype=np.int64)
+    owner = embed_engine._bucket_owner(counts, 4)
+    assert (np.diff(owner) >= 0).all()
+    np.testing.assert_array_equal(np.bincount(owner, minlength=4), [2, 2, 2, 2])
+    # a dominant bucket pulls the band boundaries around it
+    skew = np.array([1000, 1, 1, 1, 1, 1, 1, 1], dtype=np.int64)
+    owner = embed_engine._bucket_owner(skew, 4)
+    assert (np.diff(owner) >= 0).all()
+    assert owner.min() >= 0 and owner.max() <= 3
+    # degenerate shapes never index out of range
+    assert len(embed_engine._bucket_owner(np.empty(0, np.int64), 4)) == 0
+    assert (embed_engine._bucket_owner(counts, 1) == 0).all()
+
+
+# --- IVF coarse-quantizer front-end ------------------------------------
+
+
+def test_ivf_route_meets_declared_ari_floor():
+    """The PARITY-declared accuracy gate: IVF labels vs the exact spill
+    route score ARI >= 0.95 (byte-identical on bridge-free workloads,
+    so the gate holds with margin)."""
+    rng = np.random.default_rng(19)
+    x = _blobs(rng, 32, 8, 50, 0.01, n_noise=20)
+    exact_c, _ = embed_dbscan(x, 0.05, 5, max_points_per_partition=96)
+    stats: dict = {}
+    ivf_c, _ = embed_dbscan(
+        x, 0.05, 5, max_points_per_partition=96, quantizer="ivf",
+        stats_out=stats,
+    )
+    assert stats["embed_quantizer"] == "ivf"
+    assert stats["embed_ivf_cells"] >= 2
+    assert float(adjusted_rand_index(ivf_c, exact_c)) >= 0.95
+
+
+def test_ivf_knob_routes_and_bad_value_raises(monkeypatch):
+    rng = np.random.default_rng(2)
+    x = _blobs(rng, 16, 4, 40, 0.01)
+    monkeypatch.setenv("DBSCAN_EMBED_QUANTIZER", "ivf")
+    stats: dict = {}
+    embed_dbscan(x, 0.05, 5, max_points_per_partition=64, stats_out=stats)
+    assert stats["embed_quantizer"] == "ivf"
+    with pytest.raises(ValueError, match="quantizer"):
+        embed_dbscan(x, 0.05, 5, quantizer="kd")
+    monkeypatch.setenv("DBSCAN_EMBED_QUANTIZER", "kd")
+    with pytest.raises(ValueError, match="DBSCAN_EMBED_QUANTIZER"):
+        embed_dbscan(x, 0.05, 5)
+
+
+def test_ivf_cells_knob_and_auto_sizing(monkeypatch):
+    from dbscan_tpu.embed import quantize
+
+    monkeypatch.setenv("DBSCAN_EMBED_IVF_CELLS", "32")
+    assert quantize.default_cells(10000, 100) == 32
+    monkeypatch.setenv("DBSCAN_EMBED_IVF_CELLS", "0")
+    # auto: ~2x the payload/maxpp ratio, clamped to the ladder range
+    assert quantize.default_cells(1000, 100) == 20
+    assert quantize.default_cells(50, 100) == 2
+    assert quantize.default_cells(10**9, 100) == 192
+
+
+# --- knob / telemetry / family registrations ---------------------------
+
+
+def test_shard_knobs_registered():
+    ev = config.ENV_VARS
+    assert ev["DBSCAN_EMBED_SHARD"].kind == "bool"
+    assert ev["DBSCAN_EMBED_SHARD"].default is True
+    assert ev["DBSCAN_EMBED_QUANTIZER"].default == "srp"
+    assert ev["DBSCAN_EMBED_IVF_CELLS"].kind == "int"
+    assert ev["DBSCAN_EMBED_BAND"].kind == "int"
+    tu = {t.name: t for t in config.TUNABLES}
+    assert tu["DBSCAN_EMBED_QUANTIZER"].choices == ("srp", "ivf")
+    assert 0 in tu["DBSCAN_EMBED_IVF_CELLS"].choices
+
+
+def test_quantize_family_and_telemetry_declared():
+    from dbscan_tpu.lint import shapes
+    from dbscan_tpu.obs import schema
+
+    assert "embed.quantize" in schema.COMPILE_FAMILIES
+    fam = set(shapes.FAMILY_MODELS)
+    assert {"embed.quantize", "embed.hash", "embed.neighbors"} <= fam
+    for counter in (
+        "embed.quantize_dispatches",
+        "embed.bands_banked",
+        "embed.bands_loaded",
+    ):
+        assert schema.is_declared("counter", counter), counter
+    assert schema.is_declared("gauge", "embed.ivf_cells")
+    assert schema.is_declared("gauge", "embed.shards")
+    assert schema.is_declared("span", "embed.quantize")
+    # the generator loop gave the new family its compile/devtime names
+    assert schema.is_declared("counter", "compiles.embed.quantize")
+    assert schema.is_declared("span", "devtime.embed.quantize")
+
+
+def test_shapecheck_subprocess_covers_all_embed_families(tmp_path):
+    """DBSCAN_SHAPECHECK=1 rerun of a srp + ivf embed run in a fresh
+    process: the atexit JSON report must be violation-free with ALL
+    THREE embed families covered."""
+    report = tmp_path / "shapecheck.json"
+    code = (
+        "import numpy as np\n"
+        "from dbscan_tpu import embed_dbscan\n"
+        "rng = np.random.default_rng(0)\n"
+        "c = rng.normal(size=(5, 16))\n"
+        "c /= np.linalg.norm(c, axis=1, keepdims=True)\n"
+        "x = np.repeat(c, 40, axis=0)"
+        " + 0.01 * rng.normal(size=(200, 16))\n"
+        "a, _ = embed_dbscan(x, 0.05, 5, max_points_per_partition=64)\n"
+        "b, _ = embed_dbscan(x, 0.05, 5, max_points_per_partition=64,"
+        " quantizer='ivf')\n"
+        "assert np.array_equal(a, b)\n"
+    )
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        DBSCAN_SHAPECHECK="1",
+        DBSCAN_SHAPECHECK_REPORT=str(report),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env, capture_output=True, timeout=600, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr.decode(errors="replace")[-2000:]
+    rep = json.loads(report.read_text())
+    assert rep["violations"] == []
+    assert "embed.hash" in rep["sites"]
+    assert "embed.neighbors" in rep["sites"]
+    assert "embed.quantize" in rep["sites"]
+
+
+# --- bucket-band checkpoints -------------------------------------------
+
+
+def _campaign_payload():
+    rng = np.random.default_rng(23)
+    return _blobs(rng, 16, 6, 60, 0.01, n_noise=20)
+
+
+def test_checkpoint_bank_and_resume_byte_identical(tmp_path, monkeypatch):
+    """A checkpointed run banks one band file per bucket band; a resume
+    loads them all (zero re-dispatches of settled bands) and finalizes
+    byte-identically — including a partial resume after losing bands."""
+    monkeypatch.setenv("DBSCAN_EMBED_BAND", "2")
+    x = _campaign_payload()
+    kw = dict(max_points_per_partition=64)
+    clean_c, clean_f = embed_dbscan(x, 0.05, 5, **kw)
+    ck = str(tmp_path / "ck")
+    s1: dict = {}
+    c1, f1 = embed_dbscan(x, 0.05, 5, checkpoint_dir=ck, stats_out=s1, **kw)
+    np.testing.assert_array_equal(c1, clean_c)
+    n_bands = s1["campaign_chunks_total"]
+    assert embed_engine.count_banked_bands(ck) == n_bands >= 2
+    assert s1["campaign_bands_loaded"] == 0
+    s2: dict = {}
+    c2, f2 = embed_dbscan(x, 0.05, 5, checkpoint_dir=ck, stats_out=s2, **kw)
+    np.testing.assert_array_equal(c2, clean_c)
+    np.testing.assert_array_equal(f2, clean_f)
+    assert s2["campaign_bands_loaded"] == n_bands
+    assert s2["resumed_from_checkpoint"] is True
+    # lose a band: the next run recomputes exactly the missing one
+    os.unlink(os.path.join(ck, embed_engine._BAND_FILE.format(0)))
+    s3: dict = {}
+    c3, _ = embed_dbscan(x, 0.05, 5, checkpoint_dir=ck, stats_out=s3, **kw)
+    np.testing.assert_array_equal(c3, clean_c)
+    assert s3["campaign_bands_loaded"] == n_bands - 1
+
+
+def test_stale_fingerprint_rejects_banked_band(tmp_path, monkeypatch):
+    """A banked band from DIFFERENT knobs (here: another seed) must be
+    recomputed, never spliced in — the fingerprint is the gate."""
+    monkeypatch.setenv("DBSCAN_EMBED_BAND", "2")
+    x = _campaign_payload()
+    kw = dict(max_points_per_partition=64)
+    ck = str(tmp_path / "ck")
+    embed_dbscan(x, 0.05, 5, seed=0, checkpoint_dir=ck, **kw)
+    stats: dict = {}
+    c, _ = embed_dbscan(
+        x, 0.05, 5, seed=1, checkpoint_dir=ck, stats_out=stats, **kw
+    )
+    assert stats["campaign_bands_loaded"] == 0
+    base_c, _ = embed_dbscan(x, 0.05, 5, seed=1, **kw)
+    np.testing.assert_array_equal(c, base_c)
+
+
+def _wait_for(pred, timeout_s, what):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+_CHILD_CODE = (
+    "import sys\n"
+    "import numpy as np\n"
+    "from dbscan_tpu import embed_dbscan\n"
+    "x = np.load(sys.argv[1])\n"
+    "c, f = embed_dbscan(x, 0.05, 5, max_points_per_partition=96,"
+    " checkpoint_dir=sys.argv[2])\n"
+    "np.save(sys.argv[3] + '.tmp.npy', c)\n"
+    "import os; os.replace(sys.argv[3] + '.tmp.npy', sys.argv[3])\n"
+)
+
+
+def test_sigterm_mid_campaign_resumes_byte_identical(tmp_path, monkeypatch):
+    """The mid-campaign SIGTERM drill: a worker killed between band
+    banks leaves its bands as intact restart points; the resume loads
+    them and finalizes byte-identical to a clean run."""
+    monkeypatch.setenv("DBSCAN_EMBED_BAND", "1")
+    rng = np.random.default_rng(31)
+    # enough buckets (~20+) that banking spans real wall time after the
+    # first band lands — the SIGTERM window is wide and real
+    x = _blobs(rng, 32, 20, 70, 0.01, n_noise=40)
+    clean_c, clean_f = embed_dbscan(x, 0.05, 5, max_points_per_partition=96)
+    pts_path = str(tmp_path / "pts.npy")
+    np.save(pts_path, np.asarray(x))
+    ck = str(tmp_path / "ck")
+    out = str(tmp_path / "labels.npy")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "DBSCAN_EMBED_BAND": "1",
+    }
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD_CODE, pts_path, ck, out],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        _wait_for(
+            lambda: embed_engine.count_banked_bands(ck) >= 1,
+            timeout_s=300,
+            what="first banked band",
+        )
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    if proc.returncode == 0:  # pragma: no cover - tiny-machine race
+        pytest.skip("leg finished before SIGTERM landed")
+    banked = embed_engine.count_banked_bands(ck)
+    assert banked >= 1  # the kill left durable restart points
+    stats: dict = {}
+    c, f = embed_dbscan(
+        x, 0.05, 5, max_points_per_partition=96,
+        checkpoint_dir=ck, stats_out=stats,
+    )
+    np.testing.assert_array_equal(c, clean_c)
+    np.testing.assert_array_equal(f, clean_f)
+    assert stats["campaign_bands_loaded"] >= 1
+    assert stats["resumed_from_checkpoint"] is True
+
+
+def test_frontier_kill_drill_over_bucket_bands(tmp_path, monkeypatch):
+    """campaign.run_frontier over embed legs with
+    ``count_done=count_banked_bands``: a TRANSIENT campaign clause
+    kills leg 1 right after it banks a band; leg 2 resumes from the
+    banked bands and completes with byte-identical labels, and the
+    killed leg's unbanked wall is priced into replay_frac."""
+    from dbscan_tpu import campaign as camp
+
+    monkeypatch.setenv("DBSCAN_FAULT_SPEC", "campaign#0:TRANSIENT")
+    faults.reset_registry()
+    rng = np.random.default_rng(37)
+    x = _blobs(rng, 32, 20, 70, 0.01, n_noise=40)
+    clean_c, _cf = embed_dbscan(x, 0.05, 5, max_points_per_partition=96)
+    pts_path = str(tmp_path / "pts.npy")
+    np.save(pts_path, np.asarray(x))
+    ck = str(tmp_path / "ck")
+    out = str(tmp_path / "labels.npy")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "DBSCAN_EMBED_BAND": "1",
+    }
+    env.pop("DBSCAN_FAULT_SPEC", None)  # the drill is the PARENT's
+    fr = camp.run_frontier(
+        ck,
+        [sys.executable, "-c", _CHILD_CODE, pts_path, ck, out],
+        env=env,
+        max_leases=3,
+        budget_s=600.0,
+        leg_timeout_s=300.0,
+        rest_s=0.1,
+        poll_s=0.05,
+        success_path=out,
+        count_done=embed_engine.count_banked_bands,
+    )
+    assert fr.complete, fr.last_error
+    assert fr.kills == 1
+    assert fr.legs == 2
+    assert fr.replay_frac > 0.0
+    assert fr.chunks_done == fr.chunks_total >= 2
+    np.testing.assert_array_equal(np.load(out), clean_c)
+
+
+# --- per-shard busy-share rollup (exact arithmetic) --------------------
+
+
+def test_embed_shard_rollup_exact_arithmetic():
+    """The --merge busy-share section is exact interval-union
+    arithmetic: overlapping same-shard windows union (never double-
+    count), shares normalize over total busy seconds."""
+    from dbscan_tpu.obs import analyze
+
+    def sp(t0, dur, shard):
+        return {
+            "name": "embed.bucket", "t0": t0, "dur": dur, "tid": 1,
+            "depth": 1, "args": {"p": 0, "b": 128, "w": 16, "shard": shard},
+        }
+
+    spans = [sp(0.0, 1.0, 0), sp(0.5, 1.0, 0), sp(0.0, 2.0, 1)]
+    roll = analyze._embed_shard_rollup(spans)
+    assert roll["busy_s"] == 3.5
+    rows = {r["shard"]: r for r in roll["shards"]}
+    assert rows[0]["busy_s"] == 1.5 and rows[0]["buckets"] == 2
+    assert rows[1]["busy_s"] == 2.0 and rows[1]["buckets"] == 1
+    assert rows[0]["busy_share"] == round(1.5 / 3.5, 6)
+    assert rows[1]["busy_share"] == round(2.0 / 3.5, 6)
+    # merge-assigned process shard is the fallback id
+    merged = [dict(sp(0.0, 1.0, 0), shard=3) for _ in range(1)]
+    del merged[0]["args"]["shard"]
+    assert analyze._embed_shard_rollup(merged)["shards"][0]["shard"] == 3
+    # unsharded spans roll up empty (section renders nothing)
+    un = [sp(0.0, 1.0, 0)]
+    del un[0]["args"]["shard"]
+    un[0].pop("shard", None)
+    assert analyze._embed_shard_rollup(un) == {}
+
+
+def test_sharded_run_renders_busy_share_section(tmp_path):
+    """A real mesh run records shard-stamped bucket spans and the
+    analyzer renders the busy-share section with every shard's row."""
+    from dbscan_tpu import obs
+    from dbscan_tpu.obs import analyze
+
+    rng = np.random.default_rng(13)
+    x = _blobs(rng, 16, 6, 40, 0.01)
+    trace = tmp_path / "shard_trace.jsonl"
+    was = obs.active()
+    obs.enable(trace_path=str(trace))
+    try:
+        embed_dbscan(
+            x, 0.05, 5, max_points_per_partition=48, mesh=_mesh(4)
+        )
+    finally:
+        obs.flush()
+        if not was:
+            obs.disable()
+    report = analyze.analyze(analyze.load_trace(str(trace)))
+    shards = {r["shard"] for r in report["embed_shards"]["shards"]}
+    assert shards == {0, 1, 2, 3}
+    assert abs(
+        sum(r["busy_share"] for r in report["embed_shards"]["shards"]) - 1.0
+    ) < 1e-3
+    text = analyze.render(report)
+    assert "embed shards (bucket-band busy share)" in text
+
+
+# --- embed1b history promotion + gate directions -----------------------
+
+
+def test_embed1b_metrics_promote_and_gate(tmp_path):
+    """The two flagship figures promote into bench/history.jsonl with
+    the right units and regress directions: embed1b_mpts a throughput
+    (regress-down), embed1b_replay_frac a ratio (regress-up)."""
+    from dbscan_tpu.obs import bench_history, regress
+
+    cap = tmp_path / "BENCH_EMBED1B_r9.json"
+    cap.write_text(json.dumps({
+        "metric": "embed1b", "backend": "cpu",
+        "embed1b_mpts": 1.25, "embed1b_replay_frac": 0.05,
+        "embed1b_ari": 1.0, "embed1b_wall_s": 10.0,
+        "embed1b_kills": 1, "embed1b_complete": True,
+    }))
+    hist = tmp_path / "history.jsonl"
+    added, _skipped = bench_history.ingest([str(cap)], str(hist), rev="t")
+    recs = {
+        r["metric"]: r
+        for r in map(json.loads, hist.read_text().splitlines())
+    }
+    assert recs["embed1b_mpts"]["unit"] == "Mpoints/s"
+    assert recs["embed1b_replay_frac"]["unit"] == "ratio"
+    assert "embed1b_ari" in recs and "embed1b_wall_s" in recs
+    assert regress.direction("embed1b_mpts") == regress.HIGHER_BETTER
+    assert regress.direction("embed1b_replay_frac") == regress.LOWER_BETTER
+    assert regress.direction("embed1b_ari") == regress.HIGHER_BETTER
+    # the committed capture's figures are in the committed history
+    hist_live = os.path.join(REPO, "bench", "history.jsonl")
+    metrics = {
+        json.loads(line)["metric"]
+        for line in open(hist_live)
+    }
+    assert {"embed1b_mpts", "embed1b_replay_frac"} <= metrics
